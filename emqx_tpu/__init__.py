@@ -1,0 +1,30 @@
+"""emqx_tpu — a TPU-native distributed MQTT broker framework.
+
+A ground-up re-architecture of the capability set of EMQX 5.0.14
+(reference: /root/reference, Erlang/OTP) where the per-message routing hot
+path — wildcard subscription matching and subscriber fan-out — executes as
+batched JAX/XLA (and Pallas) kernels over a level-packed topic trie resident
+in TPU HBM, while the broker runtime (protocol engine, sessions, cluster
+plane, control plane) is host-side Python/C++.
+
+Package map (SURVEY.md §2 component inventory → our layout):
+
+- ``core``      topic algebra, message model  (emqx_topic.erl, emqx.hrl)
+- ``router``    host trie oracle, route table, device trie index
+                (emqx_trie.erl, emqx_router.erl)
+- ``ops``       batched device kernels: trie match, bitmap fan-out
+                (replaces emqx_trie:match/1 per-message ETS walk)
+- ``parallel``  mesh/sharding: dp (topic batch) × tp (subscriber-bitmap
+                shard) over jax.sharding.Mesh (replaces mria/gen_rpc scale-out)
+- ``models``    the flagship jittable "router model": match + fan-out step
+- ``mqtt``      MQTT 3.1/3.1.1/5.0 frame codec (emqx_frame.erl)
+- ``session``   inflight / mqueue / session FSM (emqx_session.erl et al.)
+- ``broker``    pub/sub fabric, hooks, dispatch (emqx_broker.erl, emqx_hooks.erl)
+- ``access``    authn chains, authz sources, banned, limiter
+- ``rules``     SQL rule engine (emqx_rule_engine)
+- ``cluster``   route-delta replication, forwarding, versioned protos
+- ``observe``   metrics, stats, $SYS, tracing, prometheus
+- ``utils``     config, pool, guid, misc
+"""
+
+__version__ = "0.1.0"

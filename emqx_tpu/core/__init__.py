@@ -1,0 +1,4 @@
+from emqx_tpu.core import topic
+from emqx_tpu.core.message import Message
+
+__all__ = ["topic", "Message"]

@@ -1,0 +1,4 @@
+from emqx_tpu.router.trie import Trie
+from emqx_tpu.router.router import Router
+
+__all__ = ["Trie", "Router"]

@@ -1,0 +1,125 @@
+"""Topic algebra tests — mirrors apps/emqx/test/emqx_topic_SUITE.erl."""
+
+import random
+
+from emqx_tpu.core import topic as T
+
+
+def test_words():
+    assert T.words("a/b/c") == ["a", "b", "c"]
+    assert T.words("a//c") == ["a", "", "c"]
+    assert T.words("/a") == ["", "a"]
+    assert T.words("a/") == ["a", ""]
+    assert T.join(["a", "b", "c"]) == "a/b/c"
+
+
+def test_wildcard():
+    assert T.wildcard("a/+/c")
+    assert T.wildcard("a/b/#")
+    assert not T.wildcard("a/b/c")
+    assert not T.wildcard("a/b+/c#")  # embedded chars are not wildcards
+
+
+def test_validate():
+    assert T.validate_name("a/b/c")
+    assert not T.validate_name("a/+/c")
+    assert not T.validate_name("")
+    assert not T.validate_name("a/\x00/c")
+    assert T.validate_filter("a/+/c")
+    assert T.validate_filter("a/b/#")
+    assert T.validate_filter("#")
+    assert T.validate_filter("+")
+    assert not T.validate_filter("a/#/c")     # '#' must be last
+    assert not T.validate_filter("a/b+/c")    # '+' must fill the level
+    assert not T.validate_filter("a/b#")
+    assert T.validate_filter("a//c")          # empty level is legal
+
+
+# (name, filter, matches?) — cases from emqx_topic_SUITE + MQTT-5 spec 4.7
+MATCH_CASES = [
+    ("a/b/c", "a/b/c", True),
+    ("a/b/c", "a/+/c", True),
+    ("a/b/c", "a/#", True),
+    ("a/b/c", "#", True),
+    ("a/b/c", "+/+/+", True),
+    ("a/b/c", "a/b", False),
+    ("a/b", "a/b/c", False),
+    ("a/b/c", "a/+", False),
+    ("a", "a/#", True),            # '#' matches the parent level
+    ("a/b", "a/#", True),
+    ("a", "a/+", False),
+    ("a/", "a/+", True),           # '+' matches the empty level
+    ("/b", "+/b", True),
+    ("/b", "#", True),
+    ("sport/tennis/player1", "sport/tennis/player1/#", True),
+    ("sport/tennis/player1/ranking", "sport/tennis/player1/#", True),
+    ("sport", "sport/#", True),
+    ("$SYS/broker", "#", False),   # '$' topics hidden from root wildcards
+    ("$SYS/broker", "+/broker", False),
+    ("$SYS/broker", "$SYS/broker", True),
+    ("$SYS/broker", "$SYS/#", True),
+    ("$SYS/broker", "$SYS/+", True),
+    ("a/$SYS/b", "a/+/b", True),   # '$' rule only applies at level 0
+    ("a/b/c/d/e", "a/b/#", True),
+    ("abc", "+", True),
+    ("a/b", "+", False),
+]
+
+
+def test_match_table():
+    for name, filt, expect in MATCH_CASES:
+        assert T.match(name, filt) is expect, (name, filt, expect)
+
+
+def test_match_randomized_vs_bruteforce():
+    """Random topics/filters vs an independent recursive matcher."""
+
+    def brute(n, f):
+        if n and f and n[0].startswith("$") and f[0] in ("+", "#"):
+            return False
+
+        def rec(n, f):
+            if not f:
+                return not n
+            if f[0] == "#":
+                return True
+            if not n:
+                return False
+            if f[0] == "+" or f[0] == n[0]:
+                return rec(n[1:], f[1:])
+            return False
+
+        return rec(n, f)
+
+    rng = random.Random(7)
+    alphabet = ["a", "b", "c", "$x", ""]
+    for _ in range(3000):
+        name = [rng.choice(alphabet[:4]) for _ in range(rng.randint(1, 5))]
+        filt = [
+            rng.choice(alphabet + ["+", "+", "#"])
+            for _ in range(rng.randint(1, 5))
+        ]
+        # keep filter valid: truncate at first '#'
+        if "#" in filt:
+            filt = filt[: filt.index("#") + 1]
+        got = T.match_words(name, filt)
+        assert got == brute(name, filt), (name, filt)
+
+
+def test_parse_share():
+    assert T.parse_share("$share/g1/t/1") == ("g1", "t/1")
+    assert T.parse_share("$queue/t") == ("$queue", "t")
+    assert T.parse_share("t/1") == (None, "t/1")
+    assert T.parse_share("$share/g/+/x") == ("g", "+/x")
+
+
+def test_is_sys():
+    assert T.is_sys("$SYS/a")
+    assert T.is_sys("$share/g/t")
+    assert not T.is_sys("a/$SYS")
+
+
+def test_feed_var_no_cascade():
+    assert T.feed_var("x/%c/%u", {"%c": "has%u", "%u": "U"}) == "x/has%u/U"
+    assert T.feed_var("m/${clientid}/t", {"${clientid}": "c1"}) == "m/c1/t"
+    assert T.feed_var("a/%c", {"%c": None}) == "a/"

@@ -1,0 +1,148 @@
+"""Trie oracle tests — mirrors apps/emqx/test/emqx_trie_SUITE.erl and the
+inline eunit block in emqx_trie.erl:356-420."""
+
+import random
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.router.trie import Trie
+from emqx_tpu.router.router import Router
+
+
+def test_insert_match_basic():
+    t = Trie()
+    for f in ["a/+/c", "a/#", "+/b/c", "#", "a/b/+"]:
+        t.insert(f)
+    assert sorted(t.match("a/b/c")) == sorted(["a/+/c", "a/#", "+/b/c", "#", "a/b/+"])
+    assert sorted(t.match("a")) == sorted(["a/#", "#"])
+    assert sorted(t.match("x/y")) == ["#"]
+    assert t.match("$SYS/x") == []
+
+
+def test_refcounts():
+    t = Trie()
+    assert t.insert("a/+") is True
+    assert t.insert("a/+") is False     # second ref
+    assert t.delete("a/+") is False     # still one ref left
+    assert t.match("a/b") == ["a/+"]
+    assert t.delete("a/+") is True
+    assert t.match("a/b") == []
+    assert t.is_empty()
+
+
+def test_delete_prunes_but_keeps_shared_prefix():
+    t = Trie()
+    t.insert("a/b/+")
+    t.insert("a/b/#")
+    t.delete("a/b/+")
+    assert t.match("a/b/c") == ["a/b/#"]
+    t.delete("a/b/#")
+    assert t.is_empty()
+
+
+def test_delete_nonexistent():
+    t = Trie()
+    t.insert("a/+")
+    assert t.delete("a/#") is False
+    assert t.delete("x/+") is False
+    assert t.match("a/z") == ["a/+"]
+
+
+def test_match_randomized_vs_linear_scan(rng):
+    alphabet = ["a", "b", "c", "d", ""]
+    filters = set()
+    t = Trie()
+    for _ in range(400):
+        ws = [rng.choice(alphabet + ["+", "#"]) for _ in range(rng.randint(1, 6))]
+        if "#" in ws:
+            ws = ws[: ws.index("#") + 1]
+        f = T.join(ws)
+        if not T.wildcard(ws):
+            ws[rng.randrange(len(ws))] = "+"
+            f = T.join(ws)
+        if T.validate_filter(f):
+            filters.add(f)
+            t.insert(f)
+    for _ in range(2000):
+        nw = [rng.choice(["a", "b", "c", "d", "$x"]) for _ in range(rng.randint(1, 6))]
+        name = T.join(nw)
+        expect = sorted(f for f in filters if T.match(name, f))
+        got = sorted(t.match(name))
+        assert got == expect, (name, got, expect)
+
+
+def test_churn_refcount_consistency(rng):
+    """Random insert/delete interleavings keep match == linear scan."""
+    t = Trie()
+    counts: dict[str, int] = {}
+    pool = ["a/+", "a/#", "+/+", "a/b/+", "+/b/#", "#", "+"]
+    for _ in range(3000):
+        f = rng.choice(pool)
+        if rng.random() < 0.55:
+            t.insert(f)
+            counts[f] = counts.get(f, 0) + 1
+        else:
+            expect_gone = counts.get(f, 0) == 1
+            got = t.delete(f)
+            if counts.get(f, 0) > 0:
+                assert got is expect_gone
+                counts[f] -= 1
+    live = sorted(f for f, c in counts.items() if c > 0)
+    assert sorted(f for f, _ in t.filters()) == live
+
+
+def test_router_match_routes():
+    r = Router()
+    r.add_route("a/b/c", "node1")
+    r.add_route("a/+/c", "node2")
+    r.add_route("a/#", "node1")
+    r.add_route("x/y", "node3")
+    got = {(rt.topic, rt.dest) for rt in r.match_routes("a/b/c")}
+    assert got == {("a/b/c", "node1"), ("a/+/c", "node2"), ("a/#", "node1")}
+    assert r.stats() == {"routes.count": 4, "topics.count": 4, "filters.count": 2}
+
+
+def test_router_multi_dest_and_cleanup():
+    r = Router()
+    r.add_route("t/+", "n1")
+    r.add_route("t/+", "n2")
+    assert len(r.match_routes("t/x")) == 2
+    # trie holds one filter entry per distinct dest insert (refcounted)
+    r.delete_route("t/+", "n1")
+    assert [rt.dest for rt in r.match_routes("t/x")] == ["n2"]
+    r.add_route("u/#", "n2")
+    assert r.cleanup_dest("n2") == 2
+    assert r.match_routes("t/x") == []
+    assert r.stats()["filters.count"] == 0
+
+
+def test_router_delta_log():
+    r = Router()
+    r.add_route("a/+", "n1")
+    r.add_route("a/+", "n2")
+    r.delete_route("a/+", "n1")
+    deltas = r.deltas_since(0)
+    assert [(d.op, d.dest, d.filter_new) for d in deltas] == [
+        ("add", "n1", True),
+        ("add", "n2", False),
+        ("del", "n1", False),
+    ]
+    assert r.deltas_since(r.seq) == []
+
+
+def test_deep_filter_no_recursion_limit():
+    t = Trie()
+    deep = "/".join(["a"] * 3000) + "/#"
+    t.insert(deep)
+    assert t.match("/".join(["a"] * 3500)) == [deep]
+
+
+def test_delta_log_trim():
+    r = Router()
+    for i in range(5):
+        r.add_route(f"t/{i}/+", "n")
+    r.trim_log(3)
+    assert r.deltas_since(2) is None          # trimmed → full resync
+    assert [d.seq for d in r.deltas_since(3)] == [4, 5]
+    r.trim_log(100)                            # clamped to current seq
+    assert r.deltas_since(5) == []
+    assert len(r.snapshot_filters()) == 5

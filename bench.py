@@ -1,0 +1,172 @@
+"""Route-match throughput benchmark (the BASELINE.json north-star metric).
+
+Measures the flagship device step — batched wildcard match + compact +
+subscriber-shard fan-out — against a connected-vehicle-style filter set
+(BASELINE configs 2/3: ~1M subscriptions, ~10% single-level '+' wildcards,
+7-level topic tree). The reference equivalent is `emqx_router:match_routes/1`
+(per-message Erlang trie walk over ETS, apps/emqx/src/emqx_router.erl:141-153,
+driven in-VM by apps/emqx/src/emqx_broker_bench.erl).
+
+Prints ONE JSON line:
+  {"metric": "route-matches/sec", "value": N, "unit": "topics/sec",
+   "vs_baseline": X}
+
+vs_baseline: ratio against the reference's own headline sustained cluster
+throughput of 1M msg/s (reference README.md:16) — every routed message
+needs exactly one match_routes call, so topics-matched/sec is directly
+comparable. No per-config BEAM numbers are published (BASELINE.md).
+
+Env knobs: BENCH_FILTERS (default 1_000_000), BENCH_BATCH (4096),
+BENCH_ITERS (30), BENCH_SHARDS (8192 subscriber fan-out shards).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_filters(n: int, rng: np.random.Generator) -> list[str]:
+    """Vehicle-fleet topic tree, 7 levels deep, ~10% '+' wildcards,
+    a few percent '#' — the BASELINE config 2/3 shape."""
+    n_vehicles = max(1000, n // 2)
+    filters = []
+    kinds = rng.random(n)
+    vids = rng.integers(0, n_vehicles, n)
+    fleets = rng.integers(0, 512, n)
+    metrics = rng.integers(0, 16, n)
+    parts = rng.integers(0, 8, n)
+    for i in range(n):
+        v, fl, m, p = vids[i], fleets[i], metrics[i], parts[i]
+        k = kinds[i]
+        if k < 0.80:      # exact 7-level
+            f = f"fleet/f{fl}/vehicle/v{v}/part/p{p}/m{m}"
+        elif k < 0.90:    # single-level '+'
+            f = f"fleet/f{fl}/vehicle/+/part/p{p}/m{m}"
+        elif k < 0.95:
+            f = f"fleet/f{fl}/vehicle/v{v}/part/+/m{m}"
+        elif k < 0.98:    # multi-level '#'
+            f = f"fleet/f{fl}/vehicle/v{v}/#"
+        else:
+            f = f"fleet/+/vehicle/v{v}/part/p{p}/#"
+        filters.append(f)
+    return filters
+
+
+def main() -> None:
+    n_filters = int(os.environ.get("BENCH_FILTERS", 1_000_000))
+    B = int(os.environ.get("BENCH_BATCH", 4096))
+    iters = int(os.environ.get("BENCH_ITERS", 30))
+    n_shards = int(os.environ.get("BENCH_SHARDS", 8192))
+
+    import jax
+
+    from emqx_tpu.models.router_model import RouterModel
+    from emqx_tpu.router.index import TrieIndex
+
+    rng = np.random.default_rng(42)
+    t0 = time.time()
+    filters = build_filters(n_filters, rng)
+    log(f"built {len(filters)} filters in {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    index = TrieIndex(max_levels=8)
+    model = RouterModel(index, n_sub_slots=n_shards, K=32, M=128)
+    index.load(filters)
+    # one subscriber shard per subscription (slot = hash of i)
+    slot_of = rng.integers(0, n_shards, len(index.filters))
+    for fid in range(len(index.filters)):
+        if index.filters[fid] is not None:
+            model._subs.setdefault(fid, set()).add(int(slot_of[fid]))
+    log(f"loaded index in {time.time()-t0:.1f}s "
+        f"({len(index.filters)} distinct filters)")
+
+    t0 = time.time()
+    model.refresh()
+    arrays = index.arrays
+    log(f"rebuilt device arrays in {time.time()-t0:.1f}s: "
+        f"nodes={arrays.n_nodes} ht={arrays.ht_parent.shape[0]} "
+        f"bitmap={model.build_bitmaps().nbytes >> 20}MiB "
+        f"device={jax.devices()[0]}")
+
+    # pre-tokenized topic batches (the C++ ingest host's job in production).
+    # Publishers publish into the subscribed tree (emqx_broker_bench shape):
+    # instantiate a random subscribed filter's wildcards with concrete words.
+    n_vehicles = max(1000, n_filters // 2)
+    n_batches = 8
+    t0 = time.time()
+    live = [f for f in index.filters if f is not None]
+    batches = []
+    for _ in range(n_batches):
+        picks = rng.integers(0, len(live), B)
+        v = rng.integers(0, n_vehicles, B)
+        p = rng.integers(0, 8, B)
+        m = rng.integers(0, 16, B)
+        fl = rng.integers(0, 512, B)
+        topics = []
+        for i in range(B):
+            ws = live[picks[i]].split("/")
+            out = []
+            for j, w in enumerate(ws):
+                if w == "+":
+                    out.append(
+                        f"v{v[i]}" if j == 3 else f"p{p[i]}" if j == 5 else f"f{fl[i]}"
+                    )
+                elif w == "#":
+                    out.extend([f"part/p{p[i]}", f"m{m[i]}"][: 7 - j])
+                    break
+                else:
+                    out.append(w)
+            topics.append("/".join(out))
+        tok, lens, sysf, too_long = index.tokenize(topics)
+        assert not too_long
+        batches.append(
+            tuple(jax.device_put(x) for x in (tok, lens, sysf))
+        )
+    log(f"tokenized {n_batches}x{B} topics in {time.time()-t0:.1f}s")
+
+    step = model._step
+    trie_dev, bm_dev = model._trie_dev, model._bitmaps_dev
+
+    # warmup / compile
+    t0 = time.time()
+    out = step(trie_dev, bm_dev, *batches[0])
+    jax.block_until_ready(out)
+    log(f"compile+first step {time.time()-t0:.1f}s")
+
+    # steady-state throughput
+    lat = []
+    t_start = time.time()
+    for i in range(iters):
+        t0 = time.time()
+        out = step(trie_dev, bm_dev, *batches[i % n_batches])
+        jax.block_until_ready(out)
+        lat.append(time.time() - t0)
+    wall = time.time() - t_start
+    topics_per_sec = iters * B / wall
+
+    counts = np.asarray(out[2])
+    lat_ms = np.array(lat) * 1e3
+    log(f"matched-subscriber shards/topic: mean={counts.mean():.2f}")
+    log(f"step latency ms: p50={np.percentile(lat_ms,50):.2f} "
+        f"p99={np.percentile(lat_ms,99):.2f} (batch={B})")
+    log(f"throughput: {topics_per_sec:,.0f} topics/sec @ {n_filters} subs")
+
+    print(json.dumps({
+        "metric": "route-matches/sec",
+        "value": round(topics_per_sec),
+        "unit": "topics/sec",
+        "vs_baseline": round(topics_per_sec / 1_000_000, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from emqx_tpu.models.router_model import RouterModel
+
+__all__ = ["RouterModel"]

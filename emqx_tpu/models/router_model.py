@@ -1,0 +1,210 @@
+"""RouterModel — the flagship device program: match → compact → fan-out.
+
+One jittable step replaces the reference's entire per-message read path
+``emqx_router:match_routes/1`` → ``emqx_trie:match/1`` → subscriber-table
+lookups → pid fan-out loop (emqx_router.erl:141-157,
+emqx_broker.erl:546-579) with a single batched XLA program over HBM-
+resident tables:
+
+    tokens [B, L] ──trie match──► cand [B, S] ──compact──► fids [B, M]
+                                                  │
+               subscriber bitmaps [F, W] ──OR────►└─► fanout [B, W], counts
+
+Sharding (see emqx_tpu.parallel.mesh): match runs with B over the full
+dp×tp mesh; fids then reshard to dp-only (XLA inserts an all-gather of the
+small [B, M] tensor along tp) so fan-out can keep W sharded over tp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from emqx_tpu.ops import fanout as fo
+from emqx_tpu.ops import trie_match as tm
+from emqx_tpu.parallel import mesh as pmesh
+from emqx_tpu.router.index import TrieIndex
+
+
+def router_step(
+    trie: tm.DeviceTrie,
+    bitmaps: jax.Array,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    sys_flags: jax.Array,
+    *,
+    K: int = 32,
+    M: int = 128,
+    max_probes: int = 8,
+    shardings: Optional[dict[str, NamedSharding]] = None,
+):
+    """The full publish-batch routing step (pure, jittable).
+
+    Returns (fids [B, M], fanout [B, W], counts [B], overflow [B]).
+    """
+    cand, overflow = tm.match_batch(
+        trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
+    )
+    fids, truncated = tm.compact_fids(cand, M=M)
+    if shardings is not None:
+        # reshard the compacted fids to dp-only before the tp-sharded OR
+        fids = jax.lax.with_sharding_constraint(fids, shardings["batch_dp"])
+    out = fo.fanout_bitmaps(bitmaps, fids)
+    if shardings is not None:
+        out = jax.lax.with_sharding_constraint(out, shardings["fanout_out"])
+    counts = fo.bitmap_to_counts(out)
+    return fids, out, counts, overflow | truncated
+
+
+class RouterModel:
+    """Host wrapper: TrieIndex + subscriber bitmaps + the jitted step.
+
+    The broker layer registers subscribers into per-filter bitmap rows
+    (slot = subscriber id from the connection manager); ``publish_batch``
+    tokenizes topics, runs the device step, and reports matches.
+    """
+
+    def __init__(
+        self,
+        index: Optional[TrieIndex] = None,
+        *,
+        n_sub_slots: int = 1024,
+        K: int = 32,
+        M: int = 128,
+        mesh: Optional[Mesh] = None,
+    ) -> None:
+        self.index = index or TrieIndex()
+        self.n_sub_slots = n_sub_slots
+        self.K, self.M = K, M
+        self.mesh = mesh
+        self.shardings = pmesh.router_shardings(mesh) if mesh else None
+        self._subs: dict[int, set[int]] = {}      # fid -> subscriber slots
+        self._trie_dev: Optional[tm.DeviceTrie] = None
+        self._bitmaps_dev: Optional[jax.Array] = None
+        self._dirty = True
+        self._step = jax.jit(
+            functools.partial(
+                router_step,
+                K=K,
+                M=M,
+                max_probes=self.index.max_probes,
+                shardings=self.shardings,
+            )
+        )
+
+    # -- subscription surface (driven by the broker layer) -----------------
+
+    def subscribe(self, filt: str, slot: int) -> int:
+        if not 0 <= slot < self.n_sub_slots:
+            raise ValueError(
+                f"subscriber slot {slot} out of range [0, {self.n_sub_slots})"
+            )
+        fid = self.index.insert(filt)
+        slots = self._subs.setdefault(fid, set())
+        if slot not in slots:
+            slots.add(slot)
+            self._dirty = True
+        return fid
+
+    def unsubscribe(self, filt: str, slot: int) -> None:
+        fid = self.index._filter_ids.get(filt)
+        if fid is None:
+            return
+        slots = self._subs.get(fid)
+        if slots and slot in slots:
+            slots.discard(slot)
+            if not slots:
+                self._subs.pop(fid, None)
+                self.index.delete(filt)
+            self._dirty = True
+
+    # -- device refresh (double-buffered full rebuild, round-1 policy) -----
+
+    @property
+    def bitmap_words(self) -> int:
+        return max(1, (self.n_sub_slots + 31) // 32)
+
+    def build_bitmaps(self) -> np.ndarray:
+        W = self.bitmap_words
+        F = max(1, len(self.index.filters))   # fid slots incl. freelist holes
+        bm = np.zeros((F, W), np.uint32)
+        if self._subs:
+            fids = np.fromiter(
+                (f for f, ss in self._subs.items() for _ in ss), np.int64
+            )
+            slots = np.fromiter(
+                (s for ss in self._subs.values() for s in ss), np.int64
+            )
+            np.bitwise_or.at(
+                bm, (fids, slots // 32),
+                (np.uint32(1) << (slots % 32).astype(np.uint32)),
+            )
+        return bm
+
+    def refresh(self) -> None:
+        arrays = self.index.ensure()
+        trie_dev = tm.device_trie(arrays)
+        bitmaps = self.build_bitmaps()
+        if self.shardings is not None:
+            trie_dev = jax.device_put(trie_dev, self.shardings["replicated"])
+            bitmaps = jax.device_put(bitmaps, self.shardings["bitmaps"])
+        else:
+            bitmaps = jnp.asarray(bitmaps)
+        self._trie_dev, self._bitmaps_dev = trie_dev, bitmaps
+        self._dirty = False
+
+    # -- the hot path ------------------------------------------------------
+
+    def publish_batch(self, topics: Sequence[str]):
+        """Route a batch of publish topics.
+
+        Returns (matched_filters: list[list[str]], sub_slots: list[list[int]]).
+        Topics flagged overflow/too-long fall back to the host oracle path
+        upstream (router.match_filters) — reported via the third element.
+        """
+        if self._dirty or self._trie_dev is None:
+            self.refresh()
+        n = len(topics)
+        # pad the batch to a pow2 bucket (≥64) — keeps the set of compiled
+        # program shapes small, the {active,N}-style batching discipline
+        B = 64
+        while B < n:
+            B *= 2
+        padded = list(topics) + [""] * (B - n)
+        tokens, lengths, sys_flags, too_long = self.index.tokenize(padded)
+        too_long = [b for b in too_long if b < n]
+        # padding rows: length 0 + sys flag so even the root '#'/'+' filters
+        # (which match an empty prefix) cannot emit for them
+        lengths[n:] = 0
+        sys_flags[n:] = True
+        args = (tokens, lengths, sys_flags)
+        if self.shardings is not None:
+            args = jax.device_put(args, self.shardings["batch_full"])
+        fids, fanout, counts, overflow = self._step(
+            self._trie_dev, self._bitmaps_dev, *args
+        )
+        fids = np.asarray(fids)
+        fan = np.asarray(fanout)
+        overflow = np.asarray(overflow)
+        matched: list[list[str]] = []
+        slots: list[list[int]] = []
+        for b in range(len(topics)):
+            row = fids[b][fids[b] >= 0]
+            matched.append([self.index.filters[f] for f in row])
+            bits = fan[b]
+            (word_idx,) = np.nonzero(bits)
+            out = []
+            for w in word_idx:
+                v = int(bits[w])
+                while v:
+                    low = v & -v
+                    out.append(int(w) * 32 + low.bit_length() - 1)
+                    v ^= low
+            slots.append(out)
+        fallback = sorted(set(too_long) | set(np.nonzero(overflow)[0].tolist()))
+        return matched, slots, fallback

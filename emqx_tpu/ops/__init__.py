@@ -1,0 +1,15 @@
+from emqx_tpu.ops.trie_match import (
+    DeviceTrie,
+    device_trie,
+    match_batch,
+    match_counts,
+    compact_fids,
+)
+
+__all__ = [
+    "DeviceTrie",
+    "device_trie",
+    "match_batch",
+    "match_counts",
+    "compact_fids",
+]

@@ -1,0 +1,224 @@
+"""Batched wildcard-trie match on device — the north-star kernel.
+
+Replaces the reference's per-message trie walk (``emqx_trie:match/1``,
+emqx_trie.erl:282-344 — one ETS lookup per topic level, ×2 at '+'/'#'
+branches) with one XLA program matching a whole ``[B, L]`` batch of
+tokenized topics against the HBM-resident flat trie of
+``emqx_tpu.router.index.TrieIndex``.
+
+Algorithm: K-capped frontier walk. The frontier at step *i* holds the trie
+nodes whose path matches the first *i* topic words (≤K of them; K bounds
+the number of simultaneously-alive wildcard branches, overflow is reported
+so the host oracle can take over for that topic). Each scan step does:
+
+1. emit ``hash_fid`` of every frontier node (a ``prefix/#`` filter matches
+   any remaining suffix, including the empty one);
+2. at end-of-topic, emit ``node_fid`` (filters ending exactly here);
+3. advance: exact child via ≤``max_probes`` linear probes of the edge hash
+   table + ``+`` child, then pack the ≤2K candidates back into K slots.
+
+Every matching filter id is emitted exactly once per topic (tree-ness of
+the trie — see index.py), so the output needs masking but no dedup.
+
+All control flow is static (lax.scan over L+1 steps, unrolled probe loop):
+no data-dependent shapes, everything fuses into gathers + elementwise ops —
+HBM-bandwidth-bound, which is the right regime for this workload.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from emqx_tpu.router.index import HASH_ID, PAD, TrieIndexArrays
+
+# plain Python ints: module-level jnp scalars are concrete device arrays,
+# and closure-captured device arrays inside a scan body hit a catastrophic
+# slow path on TPU (measured ~400ms vs 0.03ms for the same probe loop)
+_MIX_A = 0x9E3779B1
+_MIX_B = 0x85EBCA77
+
+
+class DeviceTrie(NamedTuple):
+    """TrieIndexArrays uploaded to device (a jit-friendly pytree)."""
+
+    ht_parent: jax.Array   # [H] int32, -1 = empty slot
+    ht_word: jax.Array     # [H]
+    ht_child: jax.Array    # [H]
+    plus_child: jax.Array  # [N]
+    hash_fid: jax.Array    # [N]
+    node_fid: jax.Array    # [N]
+
+
+def device_trie(arrays: TrieIndexArrays) -> DeviceTrie:
+    return DeviceTrie(
+        ht_parent=jnp.asarray(arrays.ht_parent),
+        ht_word=jnp.asarray(arrays.ht_word),
+        ht_child=jnp.asarray(arrays.ht_child),
+        plus_child=jnp.asarray(arrays.plus_child),
+        hash_fid=jnp.asarray(arrays.hash_fid),
+        node_fid=jnp.asarray(arrays.node_fid),
+    )
+
+
+def _g(x: jax.Array) -> jax.Array:
+    """Fusion barrier after a table gather.
+
+    XLA-TPU fuses a gather into its elementwise consumers, and the fused
+    loop serializes (~500× slowdown measured on v5e: 11ms → 0.02ms for a
+    131k-element probe round). The barrier keeps each gather a standalone
+    fast-path gather op.
+    """
+    return jax.lax.optimization_barrier(x)
+
+
+def _edge_hash(parent: jax.Array, word: jax.Array, mask: int) -> jax.Array:
+    """Must stay bit-identical to index.edge_hash (host builder)."""
+    h = (
+        parent.astype(jnp.uint32) * jnp.uint32(_MIX_A)
+        ^ word.astype(jnp.uint32) * jnp.uint32(_MIX_B)
+    )
+    h ^= h >> jnp.uint32(15)
+    h *= jnp.uint32(0x2C1B3C6D)
+    h ^= h >> jnp.uint32(12)
+    return (h & jnp.uint32(mask)).astype(jnp.int32)
+
+
+def _probe_exact(
+    trie: DeviceTrie, parent: jax.Array, word: jax.Array, max_probes: int
+) -> jax.Array:
+    """Exact-edge lookup for [B, K] (parent, word) pairs; -1 on miss.
+
+    The probe bound is builder-verified, so the loop unrolls statically.
+    """
+    hmask = trie.ht_parent.shape[0] - 1
+    # hash the raw parent (-1 included): indices stay in-bounds via the
+    # mask, invalid lanes are killed by `done`, and the obvious
+    # where-clamp here triggers an XLA-TPU lowering cliff (~5× slower —
+    # a select feeding a gather's index chain inside scan de-vectorizes)
+    h = _edge_hash(parent, word, hmask)
+    child = jnp.full_like(parent, -1)
+    done = parent < 0
+    for p in range(max_probes):
+        s = (h + p) & hmask
+        slot_parent = _g(trie.ht_parent[s])
+        hit = (slot_parent == parent) & (_g(trie.ht_word[s]) == word) & ~done
+        child = jnp.where(hit, _g(trie.ht_child[s]), child)
+        done = done | hit | (slot_parent == -1)
+    return child
+
+
+def _pack_frontier(cand: jax.Array, K: int) -> tuple[jax.Array, jax.Array]:
+    """Pack valid (≥0) entries of [B, 2K] into [B, K] slots.
+
+    The frontier is a *set* — order is irrelevant — so a descending sort
+    (valid node ids ≥ 0 sort ahead of the -1 padding) packs without any
+    scatter; TPU scatters serialized this step badly in profiling.
+
+    Returns (packed [B, K], overflowed [B]).
+    """
+    n_valid = jnp.sum(cand >= 0, axis=1)                   # [B]
+    packed = _g(-jnp.sort(-cand, axis=1)[:, :K])
+    return packed, n_valid > K
+
+
+@functools.partial(jax.jit, static_argnames=("K", "max_probes"))
+def match_batch(
+    trie: DeviceTrie,
+    tokens: jax.Array,     # [B, L] int32 word ids (PAD beyond length)
+    lengths: jax.Array,    # [B] int32
+    sys_flags: jax.Array,  # [B] bool — first level starts with '$'
+    *,
+    K: int = 32,
+    max_probes: int = 8,
+) -> tuple[jax.Array, jax.Array]:
+    """Match a topic batch against the trie.
+
+    Returns ``(cand_fids [B, (L+1)*2K] int32, overflow [B] bool)``.
+    ``cand_fids`` holds each matched filter id exactly once, -1 elsewhere.
+    ``overflow[b]`` means topic *b*'s frontier exceeded K and the result
+    may be incomplete — route it through the host oracle.
+    """
+    B, L = tokens.shape
+    tokens_ext = jnp.concatenate(
+        [tokens, jnp.full((B, 1), PAD, tokens.dtype)], axis=1
+    )
+
+    frontier0 = jnp.full((B, K), -1, jnp.int32).at[:, 0].set(0)  # root
+    overflow0 = jnp.zeros((B,), bool)
+
+    def step(carry, xs):
+        frontier, overflow = carry
+        i, tok = xs                               # i scalar, tok [B]
+        valid = frontier >= 0
+        node = jnp.where(valid, frontier, 0)
+        active = (i <= lengths)[:, None]          # may still emit '#'
+        ended = (i == lengths)[:, None]
+        advancing = (i < lengths)[:, None]
+        sys_block = (sys_flags & (i == 0))[:, None]
+
+        hash_em = jnp.where(
+            valid & active & ~sys_block, _g(trie.hash_fid[node]), -1
+        )
+        end_em = jnp.where(valid & ended, _g(trie.node_fid[node]), -1)
+
+        wordk = jnp.broadcast_to(tok[:, None], (B, K))
+        exact = _probe_exact(
+            trie, jnp.where(advancing, frontier, -1), wordk, max_probes
+        )
+        plus = jnp.where(
+            valid & advancing & ~sys_block, _g(trie.plus_child[node]), -1
+        )
+        nxt, over = _pack_frontier(
+            jnp.concatenate([exact, plus], axis=1), K
+        )
+        return (nxt, overflow | over), (hash_em, end_em)
+
+    (_, overflow), (hash_ems, end_ems) = jax.lax.scan(
+        step,
+        (frontier0, overflow0),
+        (jnp.arange(L + 1), tokens_ext.T),
+    )
+    # [L+1, B, K] → [B, (L+1)*K] each → concat
+    cand = jnp.concatenate(
+        [
+            jnp.moveaxis(hash_ems, 0, 1).reshape(B, -1),
+            jnp.moveaxis(end_ems, 0, 1).reshape(B, -1),
+        ],
+        axis=1,
+    )
+    return cand, overflow
+
+
+@functools.partial(jax.jit, static_argnames=("K", "max_probes"))
+def match_counts(
+    trie: DeviceTrie,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    sys_flags: jax.Array,
+    *,
+    K: int = 32,
+    max_probes: int = 8,
+) -> tuple[jax.Array, jax.Array]:
+    """Matched-filter count per topic (the emqx_broker_bench LookupRps
+    analogue — the full match with only the reduction materialized)."""
+    cand, overflow = match_batch(
+        trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
+    )
+    return jnp.sum(cand >= 0, axis=1), overflow
+
+
+@functools.partial(jax.jit, static_argnames=("M",))
+def compact_fids(cand: jax.Array, *, M: int = 128) -> tuple[jax.Array, jax.Array]:
+    """Compact sparse candidates [B, S] to the first M matches [B, M].
+
+    Returns (fids [B, M] padded with -1, truncated [B]). Stable order.
+    """
+    order = _g(jnp.argsort(cand < 0, axis=1, stable=True))
+    packed = _g(jnp.take_along_axis(cand, order[:, :M], axis=1))
+    n = jnp.sum(cand >= 0, axis=1)
+    return packed, n > M

@@ -1,0 +1,8 @@
+from emqx_tpu.parallel.mesh import (
+    DP,
+    TP,
+    make_mesh,
+    router_shardings,
+)
+
+__all__ = ["DP", "TP", "make_mesh", "router_shardings"]

@@ -1,0 +1,72 @@
+"""Device mesh + sharding layout for the routing data plane.
+
+The reference scales out with mria replication + gen_rpc forwarding
+(SURVEY.md §2.5); the TPU-native equivalents are XLA collectives over an
+ICI mesh. Axis mapping (broker → mesh):
+
+- ``dp``  (data/batch): the publish-topic batch dimension B. Matching is
+  embarrassingly parallel across topics — the analogue of EMQX's
+  connection/worker-pool parallelism (§2.5-1/2).
+- ``tp``  (fan-out/tensor): the subscriber-bitmap word dimension W.
+  Fan-out over 10M+ subscribers is a bitmap-OR whose bandwidth scales
+  linearly with tp — the analogue of subscriber sharding at >1024 subs
+  (emqx_broker_helper.erl:55,82-92).
+- ``sp``  (sequence): topic depth L is walked sequentially inside the
+  kernel (lax.scan) — intentionally NOT sharded: L ≤ 16 while B is
+  thousands, so the parallel win lives on dp/tp (this is the design
+  answer to ring/Ulysses-style sequence parallelism for this workload).
+- The trie itself is **replicated** across devices — the same decision as
+  the reference's full route-table replication per node
+  (emqx_router.erl:148-153): matching must be local; only fan-out shards.
+
+During a step, match runs with B sharded over BOTH axes (dp×tp — full
+data parallelism), then matched fids reshard to dp-only (an all-gather
+along tp that XLA inserts from the sharding constraints) so the bitmap-OR
+can run with W sharded over tp. That collective rides ICI and moves only
+the compacted [B, M] fid tensor, never the bitmaps.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP = "dp"
+TP = "tp"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    shape: Optional[tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A (dp, tp) mesh. Default split: tp = min(4, largest pow2 divisor)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if shape is None:
+        tp = math.gcd(n, 4)
+        shape = (n // tp, tp)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    import numpy as np
+
+    return Mesh(np.asarray(devices).reshape(shape), (DP, TP))
+
+
+def router_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Named shardings for the routing step's operands."""
+
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "replicated": s(),
+        "batch_full": s((DP, TP)),       # tokens/lengths/sys: B over dp×tp
+        "batch_dp": s(DP),               # fids after reshard: B over dp
+        "bitmaps": s(None, TP),          # [F, W]: W over tp, F replicated
+        "fanout_out": s(DP, TP),         # [B, W] result tiles
+    }

@@ -1,0 +1,276 @@
+"""TrieIndex — the level-packed, device-resident form of the wildcard trie.
+
+This is the TPU-era answer to ``emqx_trie.erl``'s ETS ordered_set walk
+(emqx_trie.erl:282-344): instead of one ETS lookup per topic level per
+message, the whole trie lives in HBM as flat int32 arrays and a *batch* of
+topics is matched per kernel launch (emqx_tpu.ops.trie_match).
+
+Layout
+------
+Nodes are integer ids (root = 0). Per node:
+
+- ``plus_child[n]``  child via a ``+`` edge, -1 if none
+- ``hash_fid[n]``    filter id of the ``prefix/#`` filter hanging under n
+                     (``#`` is always terminal, so the '#' child is folded
+                     into its parent as a filter id), -1 if none
+- ``node_fid[n]``    filter id of a filter ending exactly at n, -1 if none
+
+Exact (non-wildcard) edges live in one open-addressed hash table keyed by
+``(parent_node, word_id)``:
+
+- ``ht_parent[s] / ht_word[s] / ht_child[s]`` with -1 marking empty slots;
+  linear probing, builder-verified max probe length ≤ ``max_probes`` (the
+  table is grown until that bound holds, so the device probe loop is a
+  *static* unrolled bound).
+
+Words are interned host-side: PAD=0 (beyond end of topic), PLUS=1, HASH=2,
+UNK=3 (topic word never seen in any filter — can only match wildcards),
+real words ≥ 4. Wildcard ids never appear as hash-table keys, which is what
+makes the device walk agree with the host oracle on degenerate topics
+containing literal '+'/'#'.
+
+Match-uniqueness invariant (why the kernel needs no dedup): a filter is
+emitted either as ``hash_fid`` at exactly one (node, depth) or as
+``node_fid`` at exactly one node at end-of-topic; trie nodes are a tree, so
+a frontier never contains the same node twice ⇒ every matching filter id is
+emitted exactly once per topic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from emqx_tpu.core import topic as T
+
+PAD = 0
+PLUS_ID = 1
+HASH_ID = 2
+UNK = 3
+FIRST_WORD_ID = 4
+
+_MIX_A = np.uint32(0x9E3779B1)
+_MIX_B = np.uint32(0x85EBCA77)
+
+
+def edge_hash(parent: np.ndarray, word: np.ndarray, mask: int) -> np.ndarray:
+    """Slot hash for the (parent, word) edge key — same formula on host
+    (builder) and device (prober); uint32 wraparound arithmetic."""
+    with np.errstate(over="ignore"):  # uint32 wraparound is the point
+        p = parent.astype(np.uint32) * _MIX_A
+        w = word.astype(np.uint32) * _MIX_B
+        h = p ^ w
+        h ^= h >> np.uint32(15)
+        h *= np.uint32(0x2C1B3C6D)
+        h ^= h >> np.uint32(12)
+        return (h & np.uint32(mask)).astype(np.int32)
+
+
+@dataclass
+class TrieIndexArrays:
+    """The device-side arrays (numpy here; moved to HBM by the matcher)."""
+
+    ht_parent: np.ndarray
+    ht_word: np.ndarray
+    ht_child: np.ndarray
+    plus_child: np.ndarray
+    hash_fid: np.ndarray
+    node_fid: np.ndarray
+    n_nodes: int
+    n_filters: int
+    max_probes: int
+
+
+class TrieIndex:
+    """Host-side builder: filters → interned vocab + flat trie arrays.
+
+    Built from ``Router.snapshot_filters()`` (full rebuild) or patched via
+    ``insert``/``delete`` then ``rebuild()`` — round-1 policy is
+    double-buffered full rebuilds (cheap: one linear pass over filters);
+    true in-place device deltas are a later optimisation, the refcount
+    bookkeeping for them already lives in the host ``Trie``.
+    """
+
+    def __init__(self, max_levels: int = 16, max_probes: int = 8) -> None:
+        self.max_levels = max_levels
+        self.max_probes = max_probes
+        self.vocab: dict[str, int] = {}
+        self.filters: list[str] = []       # fid -> filter string
+        self._filter_ids: dict[str, int] = {}
+        self._free_fids: list[int] = []
+        self.arrays: Optional[TrieIndexArrays] = None
+        self._dirty = True
+
+    # -- vocab -------------------------------------------------------------
+
+    def intern(self, word: str) -> int:
+        wid = self.vocab.get(word)
+        if wid is None:
+            wid = FIRST_WORD_ID + len(self.vocab)
+            self.vocab[word] = wid
+        return wid
+
+    def word_id(self, word: str) -> int:
+        if word == T.PLUS:
+            return PLUS_ID
+        if word == T.HASH:
+            return HASH_ID
+        return self.vocab.get(word, UNK)
+
+    # -- filter set mutation ----------------------------------------------
+
+    def insert(self, filt: str) -> int:
+        """Register a filter, return its stable fid."""
+        fid = self._filter_ids.get(filt)
+        if fid is not None:
+            return fid
+        if self._free_fids:
+            fid = self._free_fids.pop()
+            self.filters[fid] = filt
+        else:
+            fid = len(self.filters)
+            self.filters.append(filt)
+        self._filter_ids[filt] = fid
+        for w in T.words(filt):
+            if w not in (T.PLUS, T.HASH):
+                self.intern(w)
+        self._dirty = True
+        return fid
+
+    def delete(self, filt: str) -> Optional[int]:
+        fid = self._filter_ids.pop(filt, None)
+        if fid is None:
+            return None
+        self.filters[fid] = None
+        self._free_fids.append(fid)
+        self._dirty = True
+        return fid
+
+    def load(self, filters: Sequence[str]) -> None:
+        for f in filters:
+            self.insert(f)
+
+    # -- build -------------------------------------------------------------
+
+    def rebuild(self) -> TrieIndexArrays:
+        """One linear pass over filters → flat arrays."""
+        # 1. build a pointer trie over word ids
+        children: list[dict[int, int]] = [{}]   # node -> {word_id: child}
+        plus: list[int] = [-1]
+        hashf: list[int] = [-1]
+        nodef: list[int] = [-1]
+
+        def new_node() -> int:
+            children.append({})
+            plus.append(-1)
+            hashf.append(-1)
+            nodef.append(-1)
+            return len(children) - 1
+
+        n_edges = 0
+        for fid, filt in enumerate(self.filters):
+            if filt is None:
+                continue
+            node = 0
+            ws = T.words(filt)
+            for i, w in enumerate(ws):
+                if w == T.HASH:
+                    hashf[node] = fid        # '#' is terminal: fold to parent
+                    break
+                if w == T.PLUS:
+                    if plus[node] == -1:
+                        plus[node] = new_node()
+                    node = plus[node]
+                else:
+                    wid = self.intern(w)
+                    nxt = children[node].get(wid)
+                    if nxt is None:
+                        nxt = new_node()
+                        children[node][wid] = nxt
+                        n_edges += 1
+                    node = nxt
+            else:
+                nodef[node] = fid
+        n_nodes = len(children)
+
+        # 2. open-addressed edge table, grown until probe bound holds
+        size = 64
+        while size < 4 * max(1, n_edges):
+            size *= 2
+        while True:
+            ht_parent = np.full(size, -1, np.int32)
+            ht_word = np.full(size, -1, np.int32)
+            ht_child = np.full(size, -1, np.int32)
+            mask = size - 1
+            ok = True
+            for parent, edges in enumerate(children):
+                for wid, child in edges.items():
+                    slot = int(edge_hash(np.int32(parent), np.int32(wid), mask))
+                    for probe in range(self.max_probes):
+                        s = (slot + probe) & mask
+                        if ht_parent[s] == -1:
+                            ht_parent[s] = parent
+                            ht_word[s] = wid
+                            ht_child[s] = child
+                            break
+                    else:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if ok:
+                break
+            size *= 2
+
+        self.arrays = TrieIndexArrays(
+            ht_parent=ht_parent,
+            ht_word=ht_word,
+            ht_child=ht_child,
+            plus_child=np.asarray(plus, np.int32),
+            hash_fid=np.asarray(hashf, np.int32),
+            node_fid=np.asarray(nodef, np.int32),
+            n_nodes=n_nodes,
+            n_filters=len(self.filters),
+            max_probes=self.max_probes,
+        )
+        self._dirty = False
+        return self.arrays
+
+    def ensure(self) -> TrieIndexArrays:
+        if self._dirty or self.arrays is None:
+            return self.rebuild()
+        return self.arrays
+
+    # -- topic tokenizer ---------------------------------------------------
+
+    def tokenize(
+        self, topics: Sequence[str]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[int]]:
+        """topics → (tokens [B,L], lengths [B], sys_flags [B], too_long).
+
+        ``too_long`` lists batch positions whose topic exceeds max_levels —
+        they must take the host-oracle fallback (mirrors the reference's
+        escape hatch for pathological topics).
+        """
+        B, L = len(topics), self.max_levels
+        tokens = np.zeros((B, L), np.int32)
+        lengths = np.zeros(B, np.int32)
+        sys_flags = np.zeros(B, bool)
+        too_long: list[int] = []
+        for b, topic in enumerate(topics):
+            ws = T.words(topic)
+            if len(ws) > L:
+                too_long.append(b)
+                # length 0 + sys flag ⇒ the kernel emits nothing for this
+                # row (even root '#'/'+' which match an empty prefix);
+                # caller routes it through the host oracle instead
+                lengths[b] = 0
+                sys_flags[b] = True
+                continue
+            lengths[b] = len(ws)
+            sys_flags[b] = ws[0].startswith("$") if ws else False
+            for i, w in enumerate(ws):
+                tokens[b, i] = self.word_id(w)
+        return tokens, lengths, sys_flags, too_long

@@ -108,7 +108,10 @@ class Trie:
                     # '#' child matches the remainder (incl. zero levels)
                     if hash_child.term_count > 0:
                         out.append(hash_child.filter)
-                exact = node.children.get(w)
+                # a literal '#' topic word (illegal in validated names) must
+                # not descend into the '#' terminal — it already matched via
+                # hash_child above; descending would emit the filter twice
+                exact = node.children.get(w) if w != T.HASH else None
                 if exact is not None:
                     nxt.append(exact)
                 # w == '+' (legal only in not-yet-validated names) would make

@@ -7,12 +7,19 @@ dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/tpu default
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# the axon sitecustomize force-registers the TPU platform via
+# jax.config.update("jax_platforms", ...), which beats the env var —
+# override it back so tests run on the virtual 8-device CPU mesh
+jax.config.update("jax_platforms", "cpu")
 
 import random
 

@@ -1,0 +1,92 @@
+"""RouterModel end-to-end: match + fan-out, single-device and on the mesh."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.models.router_model import RouterModel
+from emqx_tpu.router.index import TrieIndex
+from emqx_tpu.router.trie import Trie
+
+
+def make_model(mesh=None, n_sub_slots=256):
+    m = RouterModel(TrieIndex(max_levels=8), n_sub_slots=n_sub_slots, K=16, M=32, mesh=mesh)
+    m.subscribe("a/+/c", 3)
+    m.subscribe("a/#", 3)
+    m.subscribe("a/#", 7)
+    m.subscribe("x/y", 100)
+    m.subscribe("#", 200)
+    return m
+
+
+def test_publish_batch_single_device():
+    m = make_model()
+    matched, slots, fallback = m.publish_batch(["a/b/c", "x/y", "nope", "$SYS/x"])
+    assert fallback == []
+    assert sorted(matched[0]) == ["#", "a/#", "a/+/c"]
+    assert slots[0] == [3, 7, 200]
+    assert sorted(matched[1]) == ["#", "x/y"]
+    assert slots[1] == [100, 200]
+    assert matched[2] == ["#"] and slots[2] == [200]
+    assert matched[3] == [] and slots[3] == []
+
+
+def test_unsubscribe_updates_fanout():
+    m = make_model()
+    m.unsubscribe("a/#", 3)
+    matched, slots, _ = m.publish_batch(["a/q"])
+    assert sorted(matched[0]) == ["#", "a/#"]
+    assert slots[0] == [7, 200]
+    m.unsubscribe("a/#", 7)   # last subscriber → filter drops out
+    matched, slots, _ = m.publish_batch(["a/q"])
+    assert sorted(matched[0]) == ["#"]
+
+
+def test_batch_padding_no_phantom_matches():
+    m = make_model()
+    # 3 topics pad to a 64-bucket; padding rows must match nothing
+    matched, slots, _ = m.publish_batch(["q", "q", "q"])
+    assert all(mm == ["#"] for mm in matched)
+    assert len(matched) == 3
+
+
+def test_mesh_sharded_equals_single(rng):
+    import jax
+    from emqx_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8, shape=(4, 2))
+    # W=16 words → shards 8 per device over tp=2
+    m1 = make_model(mesh=None, n_sub_slots=512)
+    m2 = make_model(mesh=mesh, n_sub_slots=512)
+    topics = ["a/b/c", "x/y", "a/zz", "$SYS/x"] * 16
+    r1 = m1.publish_batch(topics)
+    r2 = m2.publish_batch(topics)
+    assert r1[0] == r2[0]
+    assert r1[1] == r2[1]
+    assert r1[2] == r2[2]
+
+
+def test_randomized_model_vs_oracle(rng):
+    oracle = Trie()
+    m = RouterModel(TrieIndex(max_levels=8), n_sub_slots=1024, K=32, M=64)
+    subs: dict[str, set[int]] = {}
+    words = ["a", "b", "c"]
+    for i in range(300):
+        ws = [rng.choice(words + ["+"]) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.3:
+            ws.append("#")
+        f = "/".join(ws)
+        slot = rng.randrange(1024)
+        m.subscribe(f, slot)
+        if f not in subs:
+            subs[f] = set()
+            oracle.insert(f)
+        subs[f].add(slot)
+    topics = ["/".join(rng.choice(words) for _ in range(rng.randint(1, 6))) for _ in range(128)]
+    matched, slots, fallback = m.publish_batch(topics)
+    for b, t in enumerate(topics):
+        if b in fallback:
+            continue
+        assert sorted(matched[b]) == sorted(oracle.match(t)), t
+        expect_slots = sorted(set().union(*[subs[f] for f in matched[b]]) if matched[b] else set())
+        assert slots[b] == expect_slots, t

@@ -1,0 +1,148 @@
+"""Device trie matcher ↔ host oracle equivalence (the round-1 "aha" slice:
+same results as the emqx_trie-semantics oracle on randomized filter sets)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.router.index import TrieIndex
+from emqx_tpu.router.trie import Trie
+from emqx_tpu.ops import trie_match as tm
+
+
+def build(filters, max_levels=10):
+    idx = TrieIndex(max_levels=max_levels)
+    idx.load(filters)
+    arrays = idx.ensure()
+    return idx, tm.device_trie(arrays)
+
+
+def run_match(idx, trie_dev, topics, K=32):
+    tokens, lengths, sys_flags, too_long = idx.tokenize(topics)
+    assert not too_long
+    cand, overflow = tm.match_batch(
+        trie_dev, np.asarray(tokens), np.asarray(lengths), np.asarray(sys_flags), K=K
+    )
+    cand = np.asarray(cand)
+    out = []
+    for b in range(len(topics)):
+        fids = cand[b][cand[b] >= 0]
+        assert len(set(fids.tolist())) == len(fids), "duplicate emission"
+        out.append(sorted(idx.filters[f] for f in fids))
+    return out, np.asarray(overflow)
+
+
+def test_basic_match():
+    filters = ["a/+/c", "a/#", "+/b/c", "#", "a/b/+", "a/b/c", "x"]
+    idx, dev = build(filters)
+    got, overflow = run_match(idx, dev, ["a/b/c", "a", "x", "q/r", "$SYS/x"])
+    assert not overflow.any()
+    assert got[0] == sorted(["a/+/c", "a/#", "+/b/c", "#", "a/b/+", "a/b/c"])
+    assert got[1] == sorted(["a/#", "#"])
+    assert got[2] == sorted(["#", "x"])
+    assert got[3] == sorted(["#"])
+    assert got[4] == []
+
+
+def test_hash_matches_parent_and_empty_levels():
+    filters = ["sport/#", "sport/+", "+/+", "a//c", "a/+/c"]
+    idx, dev = build(filters)
+    got, _ = run_match(idx, dev, ["sport", "sport/", "a//c", "sport/tennis/x"])
+    assert got[0] == ["sport/#"]
+    assert got[1] == sorted(["sport/#", "sport/+", "+/+"])
+    assert got[2] == sorted(["a//c", "a/+/c"])
+    assert got[3] == ["sport/#"]
+
+
+def test_unknown_words_match_only_wildcards():
+    idx, dev = build(["+/x", "#", "known/x"])
+    got, _ = run_match(idx, dev, ["zzz/x", "zzz/zzz"])
+    assert got[0] == sorted(["+/x", "#"])
+    assert got[1] == ["#"]
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_randomized_equivalence_vs_oracle(seed):
+    rng = random.Random(seed)
+    alphabet = ["a", "b", "c", "d", "e", ""]
+    oracle = Trie()
+    filters = set()
+    for _ in range(600):
+        ws = [rng.choice(alphabet + ["+", "#"]) for _ in range(rng.randint(1, 7))]
+        if "#" in ws:
+            ws = ws[: ws.index("#") + 1]
+        f = T.join(ws)
+        if T.validate_filter(f) and f not in filters:
+            filters.add(f)
+            oracle.insert(f)
+    # exact-topic filters too (no wildcard)
+    for _ in range(100):
+        f = T.join(rng.choice(alphabet[:5]) for _ in range(rng.randint(1, 7)))
+        if f not in filters:
+            filters.add(f)
+            oracle.insert(f)
+
+    idx, dev = build(sorted(filters))
+    topics = []
+    for _ in range(256):
+        nw = [rng.choice(alphabet[:5] + ["$x", "zz"]) for _ in range(rng.randint(1, 8))]
+        topics.append(T.join(nw))
+
+    got, overflow = run_match(idx, dev, topics, K=64)
+    for b, topic in enumerate(topics):
+        expect = sorted(oracle.match(topic))
+        if overflow[b]:
+            continue  # kernel reported incompleteness → host fallback
+        assert got[b] == expect, (topic, got[b], expect)
+    assert overflow.sum() < len(topics) // 4
+
+
+def test_frontier_overflow_reported_not_wrong():
+    """With tiny K the kernel must flag overflow rather than silently drop."""
+    # '+' and exact branch points along one path grow the frontier
+    filters = ["+/" * d + "#" for d in range(0, 7)] + ["a/" * d + "#" for d in range(0, 7)]
+    filters = sorted(set(f for f in filters if T.validate_filter(f)))
+    idx, dev = build(filters)
+    oracle = Trie()
+    for f in filters:
+        oracle.insert(f)
+    topics = ["a/a/a/a/a/a"]
+    got, overflow = run_match(idx, dev, topics, K=2)
+    if not overflow[0]:
+        assert got[0] == sorted(oracle.match(topics[0]))
+
+
+def test_deleted_filters_dont_match():
+    idx = TrieIndex(max_levels=6)
+    idx.load(["a/+", "a/#", "b/+"])
+    idx.delete("a/#")
+    dev = tm.device_trie(idx.ensure())
+    got, _ = run_match(idx, dev, ["a/x"])
+    assert got[0] == ["a/+"]
+    # fid slot reuse: new filter takes the freed id
+    fid = idx.insert("c/+")
+    assert idx.filters[fid] == "c/+"
+    dev = tm.device_trie(idx.ensure())
+    got, _ = run_match(idx, dev, ["c/z", "a/x"])
+    assert got[0] == ["c/+"]
+    assert got[1] == ["a/+"]
+
+
+def test_compact_fids():
+    import jax.numpy as jnp
+
+    cand = jnp.array([[-1, 5, -1, 3, -1], [7, -1, -1, -1, -1], [-1] * 5])
+    packed, truncated = tm.compact_fids(cand, M=2)
+    assert packed.tolist() == [[5, 3], [7, -1], [-1, -1]]
+    assert truncated.tolist() == [False, False, False]
+
+
+def test_match_counts():
+    idx, dev = build(["a/+", "a/#", "#"])
+    tokens, lengths, sys_flags, _ = idx.tokenize(["a/x", "q", "$S/x"])
+    counts, overflow = tm.match_counts(
+        dev, np.asarray(tokens), np.asarray(lengths), np.asarray(sys_flags)
+    )
+    assert counts.tolist() == [3, 1, 0]

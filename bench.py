@@ -94,7 +94,7 @@ def main() -> None:
     arrays = index.arrays
     log(f"rebuilt device arrays in {time.time()-t0:.1f}s: "
         f"nodes={arrays.n_nodes} ht={arrays.ht_parent.shape[0]} "
-        f"bitmap={model.build_bitmaps().nbytes >> 20}MiB "
+        f"bitmap={int(model._bitmaps_dev.nbytes) >> 20}MiB "
         f"device={jax.devices()[0]}")
 
     # pre-tokenized topic batches (the C++ ingest host's job in production).

@@ -1,0 +1,4 @@
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.broker import Broker
+
+__all__ = ["Hooks", "Broker"]

@@ -1,0 +1,303 @@
+"""The pub/sub fabric — parity with ``apps/emqx/src/emqx_broker.erl``.
+
+Node-local subscription tables + the publish pipeline:
+
+- ``suboption``   {(sid, topic) → SubOpts}   (emqx_broker.erl:105-118)
+- ``subscription`` {sid → set(topic)}
+- ``subscriber``   {topic → set(sid)}
+- publish pipeline: 'message.publish' hook fold → route match → dispatch
+  (:218-232, :284-300), remote routes handed to the cluster plane
+- subscriber slots: every local subscriber id (session) gets a bitmap
+  slot so the device fan-out can address it; slots are recycled on
+  subscriber_down (the emqx_broker_helper shard-assignment analogue)
+
+Two read paths share one source of truth (the Router's trie):
+
+- ``publish``        host path, one message (the oracle walk)
+- ``publish_batch``  device path, a topic batch through RouterModel —
+  the {active,N}-style coalescing surface the connection host feeds
+
+Delivery is returned, not performed: ``{sid: [(sub_topic, Message)]}`` —
+the channel/connection layer owns sockets (process boundary in the
+reference, function boundary here).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import Message, SubOpts
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.router.router import Router
+
+Sid = str  # subscriber id (session/clientid)
+
+
+class SlotRegistry:
+    """sid ↔ bitmap-slot allocation with recycling."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._slot_of: dict[Sid, int] = {}
+        self._sid_of: dict[int, Sid] = {}
+        self._free: list[int] = []
+        self._next = 0
+
+    def get_or_assign(self, sid: Sid) -> int:
+        slot = self._slot_of.get(sid)
+        if slot is not None:
+            return slot
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = self._next
+            self._next += 1
+            while slot >= self.capacity:
+                self.capacity *= 2   # RouterModel rebuilds bitmaps lazily
+        self._slot_of[sid] = slot
+        self._sid_of[slot] = sid
+        return slot
+
+    def lookup_sid(self, slot: int) -> Optional[Sid]:
+        return self._sid_of.get(slot)
+
+    def lookup_slot(self, sid: Sid) -> Optional[int]:
+        return self._slot_of.get(sid)
+
+    def release(self, sid: Sid) -> Optional[int]:
+        slot = self._slot_of.pop(sid, None)
+        if slot is not None:
+            del self._sid_of[slot]
+            self._free.append(slot)
+        return slot
+
+    def slot_count(self) -> int:
+        return self._next
+
+
+class Broker:
+    """Single-node pub/sub core; the cluster plane plugs in via
+    ``forward_fn`` (gen_rpc analogue) for remote-node routes."""
+
+    def __init__(
+        self,
+        node: str = "node1",
+        hooks: Optional[Hooks] = None,
+        router: Optional[Router] = None,
+        router_model=None,       # emqx_tpu.models.RouterModel (device path)
+        forward_fn=None,         # fn(node, delivery) for remote routes
+        shared_dispatch=None,    # fn(group, topic, msg) -> [(sid, sub_topic)]
+    ) -> None:
+        self.node = node
+        self.hooks = hooks or Hooks()
+        self.router = router or Router()
+        self.model = router_model
+        self.forward_fn = forward_fn
+        self.shared_dispatch = shared_dispatch
+        self.slots = SlotRegistry()
+        self._lock = threading.RLock()
+        self.suboption: dict[tuple[Sid, str], SubOpts] = {}
+        self.subscription: dict[Sid, set[str]] = {}
+        self.subscriber: dict[str, set[Sid]] = {}
+        self.metrics: dict[str, int] = {}
+
+    def _inc(self, key: str, n: int = 1) -> None:
+        self.metrics[key] = self.metrics.get(key, 0) + n
+
+    # -- subscribe / unsubscribe (emqx_broker.erl:134-173) ------------------
+
+    def subscribe(self, sid: Sid, topic: str, opts: Optional[SubOpts] = None) -> None:
+        opts = opts or SubOpts()
+        group, real_topic = T.parse_share(topic)
+        if group:
+            opts = SubOpts(**{**opts.__dict__, "share": group})
+        with self._lock:
+            key = (sid, topic)
+            is_new = key not in self.suboption
+            self.suboption[key] = opts
+            self.subscription.setdefault(sid, set()).add(topic)
+            if is_new:
+                subs_key = real_topic if not group else topic
+                subs = self.subscriber.setdefault(subs_key, set())
+                first_local = not subs
+                subs.add(sid)
+                if group:
+                    # shared subs route as {group, node}
+                    # (emqx_shared_sub.erl:420); one route per group+topic
+                    if first_local:
+                        self.router.add_route(real_topic, (group, self.node))
+                else:
+                    # one (topic, node) route per topic regardless of local
+                    # subscriber count (emqx_broker.erl route aggregation)
+                    if first_local:
+                        self.router.add_route(real_topic, self.node)
+                    if self.model is not None:
+                        slot = self.slots.get_or_assign(sid)
+                        self._ensure_model_capacity()
+                        self.model.subscribe(real_topic, slot)
+        self.hooks.run("session.subscribed", (sid, topic, opts))
+
+    def unsubscribe(self, sid: Sid, topic: str) -> bool:
+        group, real_topic = T.parse_share(topic)
+        with self._lock:
+            opts = self.suboption.pop((sid, topic), None)
+            if opts is None:
+                return False
+            self.subscription.get(sid, set()).discard(topic)
+            subs_key = real_topic if not group else topic
+            subs = self.subscriber.get(subs_key)
+            last_local = False
+            if subs is not None:
+                subs.discard(sid)
+                if not subs:
+                    del self.subscriber[subs_key]
+                    last_local = True
+            if group:
+                if last_local:
+                    self.router.delete_route(real_topic, (group, self.node))
+            else:
+                if last_local:
+                    self.router.delete_route(real_topic, self.node)
+                if self.model is not None:
+                    # read-only lookup: a teardown path must never mint a
+                    # fresh slot for an already-released sid
+                    slot = self.slots.lookup_slot(sid)
+                    if slot is not None:
+                        self.model.unsubscribe(real_topic, slot)
+        self.hooks.run("session.unsubscribed", (sid, topic))
+        return True
+
+    def subscriber_down(self, sid: Sid) -> int:
+        """Batch-clean a dead subscriber (emqx_broker.erl:361-383)."""
+        with self._lock:
+            topics = list(self.subscription.get(sid, ()))
+            for topic in topics:
+                self.unsubscribe(sid, topic)
+            self.subscription.pop(sid, None)
+            self.slots.release(sid)
+            return len(topics)
+
+    def subscriptions(self, sid: Sid) -> list[tuple[str, SubOpts]]:
+        with self._lock:
+            return [
+                (t, self.suboption[(sid, t)])
+                for t in self.subscription.get(sid, ())
+            ]
+
+    def _ensure_model_capacity(self) -> None:
+        if self.model is not None and self.slots.capacity > self.model.n_sub_slots:
+            self.model.n_sub_slots = self.slots.capacity
+            self.model._dirty = True
+
+    # -- publish (emqx_broker.erl:218-232) ----------------------------------
+
+    def publish(self, msg: Message) -> dict[Sid, list[tuple[str, Message]]]:
+        """Host-path publish of one message. Returns local deliveries
+        {sid: [(sub_topic, msg)]}; remote routes are forwarded."""
+        msg = self.hooks.run_fold("message.publish", (), msg)
+        if msg is None or msg.headers.get("allow_publish") is False:
+            self._inc("messages.dropped")
+            return {}
+        self._inc("messages.publish")
+        return self._route(msg.topic, msg)
+
+    def publish_batch(
+        self, msgs: Sequence[Message]
+    ) -> list[dict[Sid, list[tuple[str, Message]]]]:
+        """Device-path publish: one kernel launch for the whole batch
+        (falls back to the host oracle per overflow/too-long topic)."""
+        msgs = [
+            self.hooks.run_fold("message.publish", (), m) for m in msgs
+        ]
+        live = [
+            (i, m) for i, m in enumerate(msgs)
+            if m is not None and m.headers.get("allow_publish") is not False
+        ]
+        out: list[dict[Sid, list[tuple[str, Message]]]] = [{} for _ in msgs]
+        if not live:
+            return out
+        if self.model is None:
+            for i, m in live:
+                out[i] = self._route(m.topic, m)
+            return out
+        matched, slots, fallback = self.model.publish_batch(
+            [m.topic for _, m in live]
+        )
+        fb = set(fallback)
+        for j, (i, m) in enumerate(live):
+            self._inc("messages.publish")
+            if j in fb:
+                out[i] = self._route(m.topic, m)   # oracle fallback
+                continue
+            deliveries: dict[Sid, list[tuple[str, Message]]] = {}
+            for slot in slots[j]:
+                sid = self.slots.lookup_sid(slot)
+                if sid is None:
+                    continue
+                for filt in matched[j]:
+                    if (sid, filt) in self.suboption:
+                        deliveries.setdefault(sid, []).append((filt, m))
+            # shared groups + remote nodes still come from the route table
+            self._dispatch_nonlocal(m.topic, m, deliveries)
+            out[i] = deliveries
+        return out
+
+    # -- dispatch (emqx_broker.erl:264-337, :546-579) ------------------------
+
+    def _route(self, topic: str, msg: Message) -> dict[Sid, list[tuple[str, Message]]]:
+        deliveries: dict[Sid, list[tuple[str, Message]]] = {}
+        routes = self.router.match_routes(topic)
+        if not routes:
+            self._inc("messages.dropped.no_subscribers")
+            self.hooks.run("message.dropped", (msg, "no_subscribers"))
+        seen_groups = set()
+        for route in routes:
+            dest = route.dest
+            if isinstance(dest, tuple):        # ({group, node}) shared
+                group = dest[0]
+                if group in seen_groups:
+                    continue
+                seen_groups.add(group)
+                if self.shared_dispatch is not None:
+                    for sid, sub_topic in self.shared_dispatch(
+                        group, route.topic, msg
+                    ):
+                        deliveries.setdefault(sid, []).append((sub_topic, msg))
+            elif dest == self.node:
+                self._dispatch_local(route.topic, msg, deliveries)
+            elif self.forward_fn is not None:
+                self.forward_fn(dest, route.topic, msg)
+                self._inc("messages.forward")
+        return deliveries
+
+    def _dispatch_local(
+        self, filt: str, msg: Message,
+        deliveries: dict[Sid, list[tuple[str, Message]]],
+    ) -> None:
+        for sid in self.subscriber.get(filt, ()):
+            deliveries.setdefault(sid, []).append((filt, msg))
+            self._inc("messages.delivered")
+
+    def _dispatch_nonlocal(
+        self, topic: str, msg: Message,
+        deliveries: dict[Sid, list[tuple[str, Message]]],
+    ) -> None:
+        """Shared-group + remote legs for the device path (the bitmap only
+        covers local direct subscribers)."""
+        seen_groups = set()
+        for route in self.router.match_routes(topic):
+            dest = route.dest
+            if isinstance(dest, tuple):
+                group = dest[0]
+                if group not in seen_groups:
+                    seen_groups.add(group)
+                    if self.shared_dispatch is not None:
+                        for sid, sub_topic in self.shared_dispatch(
+                            group, route.topic, msg
+                        ):
+                            deliveries.setdefault(sid, []).append((sub_topic, msg))
+            elif dest != self.node and self.forward_fn is not None:
+                self.forward_fn(dest, route.topic, msg)
+                self._inc("messages.forward")

@@ -1,0 +1,92 @@
+"""Connection/session manager — parity with ``apps/emqx/src/emqx_cm.erl``.
+
+Registry of clientid → live channel, session open with clean-start /
+resume semantics, takeover/discard/kick (emqx_cm.erl:268-341, :377-429,
+:433-560). The reference's per-clientid distributed lock (emqx_cm_locker)
+maps to a per-clientid threading lock here; the cross-node legs ride the
+cluster plane's versioned protos once connected.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from emqx_tpu.session.session import Session
+
+
+class CM:
+    def __init__(self) -> None:
+        self._channels: dict[str, Any] = {}     # clientid -> Channel
+        self._locks: dict[str, threading.Lock] = {}
+        self._glock = threading.Lock()
+
+    def _lock_for(self, clientid: str) -> threading.Lock:
+        with self._glock:
+            return self._locks.setdefault(clientid, threading.Lock())
+
+    def lookup_channel(self, clientid: str) -> Optional[Any]:
+        return self._channels.get(clientid)
+
+    def register_channel(self, clientid: str, channel: Any) -> None:
+        self._channels[clientid] = channel
+
+    def unregister_channel(self, clientid: str, channel: Any = None) -> None:
+        cur = self._channels.get(clientid)
+        if channel is None or cur is channel:
+            self._channels.pop(clientid, None)
+
+    def all_channels(self) -> list[tuple[str, Any]]:
+        return list(self._channels.items())
+
+    def open_session(
+        self, clean_start: bool, clientid: str, new_channel: Any,
+        session_opts: Optional[dict] = None,
+    ) -> tuple[Session, bool, list]:
+        """Returns (session, session_present, pending_messages).
+
+        clean_start=True  → discard any live channel + fresh session
+        clean_start=False → takeover: old channel yields its session and
+                            pending messages, then dies (2-phase:
+                            emqx_cm.erl takeover_session)
+        """
+        with self._lock_for(clientid):
+            old = self._channels.get(clientid)
+            if clean_start:
+                if old is not None and old is not new_channel:
+                    old.discard()                     # kicked (RC 0x8E)
+                session = Session(
+                    clientid=clientid, clean_start=True,
+                    **(session_opts or {}),
+                )
+                self._channels[clientid] = new_channel
+                return session, False, []
+            # resume path
+            if old is not None and old is not new_channel:
+                session, pending = old.takeover()
+                self._channels[clientid] = new_channel
+                if session is not None:
+                    session.clean_start = False
+                    return session, True, pending
+            self._channels[clientid] = new_channel
+            session = Session(
+                clientid=clientid, clean_start=False,
+                **(session_opts or {}),
+            )
+            return session, False, []
+
+    def dispatch(self, deliveries: dict[str, list]) -> None:
+        """Fan broker deliveries out to each target channel's socket."""
+        for sid, items in deliveries.items():
+            ch = self._channels.get(sid)
+            if ch is not None:
+                ch.send(ch.handle_deliver(items))
+
+    def kick(self, clientid: str) -> bool:
+        """Administrative kick (emqx_cm:kick_session)."""
+        with self._lock_for(clientid):
+            ch = self._channels.pop(clientid, None)
+            if ch is None:
+                return False
+            ch.discard()
+            return True

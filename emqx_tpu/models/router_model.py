@@ -112,7 +112,7 @@ class RouterModel:
         return fid
 
     def unsubscribe(self, filt: str, slot: int) -> None:
-        fid = self.index._filter_ids.get(filt)
+        fid = self.index.fid_of(filt)
         if fid is None:
             return
         slots = self._subs.get(fid)

@@ -1,0 +1,4 @@
+from emqx_tpu.mqtt import packet
+from emqx_tpu.mqtt.frame import ParseState, Parser, serialize
+
+__all__ = ["packet", "ParseState", "Parser", "serialize"]

@@ -121,8 +121,16 @@ class TrieIndex:
 
     # -- filter set mutation ----------------------------------------------
 
+    def fid_of(self, filt: str) -> Optional[int]:
+        return self._filter_ids.get(filt)
+
     def insert(self, filt: str) -> int:
         """Register a filter, return its stable fid."""
+        if not T.validate_filter(filt):
+            # same guard as Router.add_route: an invalid filter (e.g.
+            # 'a/#/b') would be silently truncated at '#' by rebuild() and
+            # diverge from the host oracle
+            raise ValueError(f"invalid topic filter: {filt!r}")
         fid = self._filter_ids.get(filt)
         if fid is not None:
             return fid

@@ -1,0 +1,5 @@
+from emqx_tpu.session.inflight import Inflight
+from emqx_tpu.session.mqueue import MQueue
+from emqx_tpu.session.session import Session
+
+__all__ = ["Inflight", "MQueue", "Session"]

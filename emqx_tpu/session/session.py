@@ -1,0 +1,296 @@
+"""Session state machine — parity with ``apps/emqx/src/emqx_session.erl``.
+
+Holds the per-client messaging state: subscriptions, the QoS1/2 outbound
+inflight window, the backlog mqueue, and the incoming-QoS2 awaiting_rel
+set (emqx_session.erl:108-146). Pure state + explicit clock: methods
+return the packets to emit, the connection layer does IO — the same
+separation as channel/session in the reference.
+
+Reference behaviors implemented:
+- deliver with inflight backpressure → mqueue (:542-589)
+- enqueue with drop policy (:594-607)
+- incoming QoS2 dedup via awaiting_rel + receive-maximum quota (:379-399)
+- puback/pubrec/pubrel/pubcomp lifecycle (:432-530)
+- retry (redeliver with dup) and await_rel expiry timers
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from emqx_tpu.core.message import Message, SubOpts, now_ms
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.session.inflight import Inflight
+from emqx_tpu.session.mqueue import MQueue, MQueueOpts
+
+
+@dataclass
+class InflightEntry:
+    packet_id: int
+    msg: Message
+    phase: str            # "publish" (await PUBACK/PUBREC) | "pubrel" (await PUBCOMP)
+    sent_at: int
+    qos: int
+    subopts: "SubOpts" = None  # as-delivered opts (subid/rap survive retry)
+
+
+class SessionError(Exception):
+    def __init__(self, rc: int):
+        super().__init__(f"rc=0x{rc:02x}")
+        self.rc = rc
+
+
+@dataclass
+class Session:
+    clientid: str
+    clean_start: bool = True
+    max_inflight: int = 32
+    max_awaiting_rel: int = 100
+    retry_interval_ms: int = 30_000
+    await_rel_timeout_ms: int = 300_000
+    session_expiry_ms: int = 0          # 0 = ends with connection
+    max_subscriptions: int = 0          # 0 = unlimited
+    upgrade_qos: bool = False
+    mqueue_opts: MQueueOpts = field(default_factory=MQueueOpts)
+    created_at: int = field(default_factory=now_ms)
+
+    def __post_init__(self) -> None:
+        self.subscriptions: dict[str, SubOpts] = {}
+        self.inflight = Inflight(self.max_inflight)
+        self.mqueue = MQueue(self.mqueue_opts)
+        self.awaiting_rel: dict[int, int] = {}     # packet_id -> ts
+        self._next_pkt_id = 0
+
+    # -- packet ids --------------------------------------------------------
+
+    def next_packet_id(self) -> int:
+        for _ in range(65535):
+            self._next_pkt_id = self._next_pkt_id % 65535 + 1
+            if not self.inflight.contain(self._next_pkt_id):
+                return self._next_pkt_id
+        raise SessionError(P.RC_RECEIVE_MAXIMUM_EXCEEDED)
+
+    # -- subscriptions (the broker layer mirrors these into the router) ----
+
+    def subscribe(self, topic: str, opts: SubOpts) -> None:
+        if (
+            self.max_subscriptions
+            and topic not in self.subscriptions
+            and len(self.subscriptions) >= self.max_subscriptions
+        ):
+            raise SessionError(P.RC_QUOTA_EXCEEDED)
+        self.subscriptions[topic] = opts
+
+    def unsubscribe(self, topic: str) -> SubOpts:
+        if topic not in self.subscriptions:
+            raise SessionError(P.RC_NO_SUBSCRIPTION_EXISTED)
+        return self.subscriptions.pop(topic)
+
+    # -- incoming publish (client → broker), QoS2 dedup --------------------
+
+    def publish_in(self, packet_id: Optional[int], msg: Message,
+                   now: Optional[int] = None) -> None:
+        """Track incoming QoS2 for exactly-once (emqx_session.erl:379-399).
+        Raises SessionError on dup packet id or quota exceeded."""
+        if msg.qos != 2:
+            return
+        now = now_ms() if now is None else now
+        if packet_id in self.awaiting_rel:
+            raise SessionError(P.RC_PACKET_IDENTIFIER_IN_USE)
+        if (
+            self.max_awaiting_rel
+            and len(self.awaiting_rel) >= self.max_awaiting_rel
+        ):
+            raise SessionError(P.RC_RECEIVE_MAXIMUM_EXCEEDED)
+        self.awaiting_rel[packet_id] = now
+
+    def pubrel_in(self, packet_id: int) -> None:
+        """Incoming PUBREL completes the QoS2 receive (:478-492)."""
+        if packet_id not in self.awaiting_rel:
+            raise SessionError(P.RC_PACKET_IDENTIFIER_NOT_FOUND)
+        del self.awaiting_rel[packet_id]
+
+    # -- outbound delivery (broker → client) -------------------------------
+
+    def deliver(self, deliveries: list[tuple[str, Message]],
+                now: Optional[int] = None) -> list[P.Packet]:
+        """Route matched messages into the window/queue; return PUBLISH
+        packets ready to send (emqx_session.erl:542-589)."""
+        now = now_ms() if now is None else now
+        out: list[P.Packet] = []
+        for sub_topic, msg in deliveries:
+            opts = self.subscriptions.get(sub_topic)
+            if opts is None:
+                # late delivery after unsubscribe — drop
+                continue
+            if opts.nl and msg.from_ == self.clientid:
+                continue  # MQTT5 no-local
+            qos = max(opts.qos, msg.qos) if self.upgrade_qos else min(opts.qos, msg.qos)
+            if msg.is_expired(now):
+                continue
+            if qos == 0:
+                out.append(self._pub_packet(None, msg, qos, opts))
+            elif self.inflight.is_full():
+                self.mqueue.insert(self._with_sub(msg, sub_topic))
+            else:
+                pid = self.next_packet_id()
+                self.inflight.insert(
+                    pid, InflightEntry(pid, msg, "publish", now, qos, opts)
+                )
+                out.append(self._pub_packet(pid, msg, qos, opts))
+        return out
+
+    def _with_sub(self, msg: Message, sub_topic: str) -> Message:
+        return msg.set_header("sub_topic", sub_topic)
+
+    def _pub_packet(self, pid: Optional[int], msg: Message, qos: int,
+                    opts: SubOpts) -> P.Publish:
+        props = dict(msg.headers.get("properties") or {})
+        if opts.subid is not None:
+            props["Subscription-Identifier"] = [opts.subid]
+        retain = msg.retain if opts.rap else False
+        if msg.headers.get("retained"):
+            retain = True  # messages replayed from the retainer keep retain=1
+        return P.Publish(
+            topic=msg.topic, payload=msg.payload, qos=qos,
+            retain=retain, dup=False, packet_id=pid, properties=props,
+        )
+
+    def enqueue(self, sub_topic: str, msg: Message) -> None:
+        """Buffer while disconnected (persistent sessions, :594-607)."""
+        opts = self.subscriptions.get(sub_topic)
+        if opts is None:
+            return
+        if opts.nl and msg.from_ == self.clientid:
+            return
+        self.mqueue.insert(self._with_sub(msg, sub_topic))
+
+    # -- acks --------------------------------------------------------------
+
+    def puback(self, packet_id: int,
+               now: Optional[int] = None) -> list[P.Packet]:
+        entry = self.inflight.lookup(packet_id)
+        if entry is None or entry.phase != "publish" or entry.qos != 1:
+            raise SessionError(P.RC_PACKET_IDENTIFIER_NOT_FOUND)
+        self.inflight.delete(packet_id)
+        return self.dequeue(now)
+
+    def pubrec(self, packet_id: int,
+               now: Optional[int] = None) -> P.PubRel:
+        """QoS2 leg 1 acked → move to await-PUBCOMP, emit PUBREL (:466-476)."""
+        entry = self.inflight.lookup(packet_id)
+        if entry is None or entry.qos != 2 or entry.phase != "publish":
+            raise SessionError(P.RC_PACKET_IDENTIFIER_NOT_FOUND)
+        entry.phase = "pubrel"
+        entry.sent_at = now_ms() if now is None else now
+        # payload no longer needed once PUBREC is in (reference stores
+        # 'pubrel' marker only)
+        entry.msg = None
+        return P.PubRel(packet_id=packet_id)
+
+    def pubcomp(self, packet_id: int,
+                now: Optional[int] = None) -> list[P.Packet]:
+        entry = self.inflight.lookup(packet_id)
+        if entry is None or entry.phase != "pubrel":
+            raise SessionError(P.RC_PACKET_IDENTIFIER_NOT_FOUND)
+        self.inflight.delete(packet_id)
+        return self.dequeue(now)
+
+    def dequeue(self, now: Optional[int] = None) -> list[P.Packet]:
+        """Fill freed inflight slots from the mqueue (:520-530)."""
+        now = now_ms() if now is None else now
+        out: list[P.Packet] = []
+        while not self.inflight.is_full():
+            msg = self.mqueue.pop()
+            if msg is None:
+                break
+            sub_topic = msg.headers.get("sub_topic", msg.topic)
+            opts = self.subscriptions.get(sub_topic)
+            if opts is None:
+                continue
+            qos = max(opts.qos, msg.qos) if self.upgrade_qos else min(opts.qos, msg.qos)
+            if msg.is_expired(now):
+                continue
+            if qos == 0:
+                out.append(self._pub_packet(None, msg, qos, opts))
+            else:
+                pid = self.next_packet_id()
+                self.inflight.insert(
+                    pid, InflightEntry(pid, msg, "publish", now, qos, opts)
+                )
+                out.append(self._pub_packet(pid, msg, qos, opts))
+        return out
+
+    # -- timers ------------------------------------------------------------
+
+    def retry(self, now: Optional[int] = None) -> list[P.Packet]:
+        """Redeliver inflight entries older than retry_interval with DUP
+        (the retry_delivery timer, emqx_session.erl retry logic)."""
+        now = now_ms() if now is None else now
+        out: list[P.Packet] = []
+        for pid, entry in self.inflight.items():
+            if now - entry.sent_at < self.retry_interval_ms:
+                continue
+            entry.sent_at = now
+            if entry.phase == "pubrel":
+                out.append(P.PubRel(packet_id=pid))
+            elif entry.msg is not None:
+                if entry.msg.is_expired(now):
+                    self.inflight.delete(pid)
+                    continue
+                # reuse the as-delivered subopts so Subscription-Identifier
+                # and retain-as-published survive the retransmission
+                opts = entry.subopts or SubOpts(qos=entry.qos)
+                pkt = self._pub_packet(pid, entry.msg, entry.qos, opts)
+                pkt.dup = True
+                out.append(pkt)
+        return out
+
+    def expire_awaiting_rel(self, now: Optional[int] = None) -> int:
+        """Drop incoming-QoS2 trackers past await_rel_timeout."""
+        now = now_ms() if now is None else now
+        victims = [
+            pid for pid, ts in self.awaiting_rel.items()
+            if now - ts >= self.await_rel_timeout_ms
+        ]
+        for pid in victims:
+            del self.awaiting_rel[pid]
+        return len(victims)
+
+    # -- takeover / resume -------------------------------------------------
+
+    def pending_for_resume(self) -> list[Message]:
+        """Messages that would replay on session resume (read-only view)."""
+        out = [e.msg for e in self.inflight.values()
+               if e.msg is not None]
+        out.extend(self.mqueue.peek_all())
+        return out
+
+    def take_pending(self) -> list[Message]:
+        """Drain publish-phase inflight + mqueue for takeover redelivery.
+
+        The resuming channel re-delivers these through a fresh window (new
+        packet ids). 'pubrel'-phase QoS2 entries stay in the inflight — the
+        retry timer re-emits their PUBREL on the new connection."""
+        out: list[Message] = []
+        for pid, entry in self.inflight.items():
+            if entry.phase == "publish" and entry.msg is not None:
+                out.append(entry.msg)
+                self.inflight.delete(pid)
+        out.extend(self.mqueue.peek_all())
+        while self.mqueue.pop() is not None:
+            pass
+        return out
+
+    def info(self) -> dict[str, Any]:
+        return {
+            "clientid": self.clientid,
+            "subscriptions_cnt": len(self.subscriptions),
+            "inflight_cnt": len(self.inflight),
+            "mqueue_len": len(self.mqueue),
+            "mqueue_dropped": self.mqueue.dropped,
+            "awaiting_rel_cnt": len(self.awaiting_rel),
+            "created_at": self.created_at,
+        }

@@ -1,0 +1,170 @@
+"""Broker core tests — mirrors emqx_broker_SUITE / emqx_hooks_SUITE."""
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker, SlotRegistry
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.core.message import Message, SubOpts
+
+
+def msg(topic="t/1", **kw):
+    return Message(topic=topic, **kw)
+
+
+# -- hooks ------------------------------------------------------------------
+
+def test_hooks_priority_and_stop():
+    h = Hooks()
+    calls = []
+    h.add("p", lambda: calls.append("lo"), priority=1)
+    h.add("p", lambda: calls.append("hi"), priority=10)
+    h.run("p")
+    assert calls == ["hi", "lo"]
+
+    h2 = Hooks()
+    h2.add("p", lambda: Hooks.STOP, priority=5)
+    h2.add("p", lambda: calls.append("never"), priority=1)
+    h2.run("p")
+    assert "never" not in calls
+
+
+def test_hooks_run_fold():
+    h = Hooks()
+    h.add("f", lambda acc: acc + 1, priority=3)
+    h.add("f", lambda acc: (Hooks.OK, acc * 10), priority=2)
+    h.add("f", lambda acc: (Hooks.STOP, acc + 5), priority=1)
+    h.add("f", lambda acc: acc + 100, priority=0)   # never reached
+    assert h.run_fold("f", (), 1) == 25             # ((1+1)*10)+5
+
+
+def test_hooks_put_replaces_and_delete():
+    h = Hooks()
+    def a(acc): return acc + 1
+    h.add("f", a, priority=1)
+    h.add("f", a, priority=9)      # idempotent add: keeps first
+    assert h.run_fold("f", (), 0) == 1
+    h.put("f", a, priority=2)
+    assert h.run_fold("f", (), 0) == 1
+    h.delete("f", a)
+    assert h.run_fold("f", (), 0) == 0
+
+
+# -- slot registry ----------------------------------------------------------
+
+def test_slot_registry_recycling():
+    r = SlotRegistry(capacity=2)
+    s1, s2 = r.get_or_assign("a"), r.get_or_assign("b")
+    assert {s1, s2} == {0, 1}
+    assert r.get_or_assign("a") == s1
+    r.release("a")
+    assert r.lookup_sid(s1) is None
+    assert r.get_or_assign("c") == s1   # recycled
+    r.get_or_assign("d")
+    assert r.capacity == 4              # grew
+
+
+# -- pub/sub ----------------------------------------------------------------
+
+def test_subscribe_publish_deliver():
+    b = Broker()
+    b.subscribe("s1", "t/+")
+    b.subscribe("s2", "t/1", SubOpts(qos=1))
+    b.subscribe("s3", "other")
+    d = b.publish(msg("t/1"))
+    assert set(d) == {"s1", "s2"}
+    assert d["s1"] == [("t/+", d["s1"][0][1])]
+    assert b.metrics["messages.delivered"] == 2
+
+
+def test_unsubscribe_and_subscriber_down():
+    b = Broker()
+    b.subscribe("s1", "a/#")
+    b.subscribe("s1", "b")
+    b.subscribe("s2", "b")
+    assert b.unsubscribe("s1", "a/#") is True
+    assert b.unsubscribe("s1", "a/#") is False
+    assert set(b.publish(msg("b"))) == {"s1", "s2"}
+    assert b.subscriber_down("s1") == 1
+    assert set(b.publish(msg("b"))) == {"s2"}
+    assert b.router.stats()["filters.count"] == 0
+
+
+def test_publish_hook_can_rewrite_and_drop():
+    b = Broker()
+    b.subscribe("s1", "t")
+    b.hooks.add("message.publish", lambda m: m.set_header("tag", 1))
+    d = b.publish(msg("t"))
+    assert d["s1"][0][1].headers["tag"] == 1
+    # drop via allow_publish=False (the emqx header convention)
+    b.hooks.put(
+        "message.publish",
+        lambda m: m.set_header("allow_publish", False) and None or
+        m.set_header("allow_publish", False),
+        priority=99,
+    )
+    assert b.publish(msg("t")) == {}
+    assert b.metrics["messages.dropped"] == 1
+
+
+def test_remote_route_forwarding():
+    fwd = []
+    b = Broker(node="n1", forward_fn=lambda node, t, m: fwd.append((node, t)))
+    b.subscribe("s1", "t")
+    b.router.add_route("t", "n2")     # simulated remote subscriber
+    d = b.publish(msg("t"))
+    assert set(d) == {"s1"}
+    assert fwd == [("n2", "t")]
+
+
+def test_shared_group_routes_to_dispatcher():
+    picked = []
+    def dispatch(group, topic, m):
+        picked.append(group)
+        return [("member1", f"$share/{group}/{topic}")]
+    b = Broker(shared_dispatch=dispatch)
+    b.subscribe("member1", "$share/g1/t")
+    d = b.publish(msg("t"))
+    assert picked == ["g1"]
+    assert set(d) == {"member1"}
+
+
+def test_no_subscribers_drop_metric():
+    b = Broker()
+    dropped = []
+    b.hooks.add("message.dropped", lambda m, why: dropped.append(why))
+    assert b.publish(msg("nobody")) == {}
+    assert dropped == ["no_subscribers"]
+
+
+# -- device-path batch ------------------------------------------------------
+
+def test_publish_batch_device_path_equals_host():
+    from emqx_tpu.models.router_model import RouterModel
+    from emqx_tpu.router.index import TrieIndex
+
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    b = Broker(router_model=model)
+    b.subscribe("s1", "t/+")
+    b.subscribe("s2", "t/1")
+    b.subscribe("s3", "zzz/#")
+    msgs = [msg("t/1"), msg("t/2"), msg("nope"), msg("zzz/a/b")]
+    got_dev = b.publish_batch(msgs)
+
+    b2 = Broker()
+    for s, t in [("s1", "t/+"), ("s2", "t/1"), ("s3", "zzz/#")]:
+        b2.subscribe(s, t)
+    got_host = [b2.publish(m) for m in msgs]
+    for dd, hh in zip(got_dev, got_host):
+        assert {k: [t for t, _ in v] for k, v in dd.items()} == \
+               {k: [t for t, _ in v] for k, v in hh.items()}
+
+
+def test_publish_batch_with_hook_drop():
+    b = Broker()
+    b.subscribe("s1", "a")
+    b.hooks.add(
+        "message.publish",
+        lambda m: m.set_header("allow_publish", False) if m.topic == "a" else m,
+    )
+    out = b.publish_batch([msg("a"), msg("a")])
+    assert out == [{}, {}]

@@ -1,0 +1,303 @@
+"""Channel FSM + CM tests — mirrors emqx_channel_SUITE / emqx_cm_SUITE:
+whole client flows driven at the parsed-packet level."""
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel, ConnInfo
+from emqx_tpu.broker.cm import CM
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.core.message import SubOpts
+from emqx_tpu.mqtt import packet as P
+
+
+class Harness:
+    """A tiny single-node broker with packet-level clients."""
+
+    def __init__(self):
+        self.broker = Broker()
+        self.cm = CM()
+        self.channels: dict[str, Channel] = {}
+
+    def connect(self, clientid, clean_start=True, proto=P.MQTT_V4, **kw):
+        ch = Channel(self.broker, self.cm)
+        out = ch.handle_in(P.Connect(
+            clientid=clientid, clean_start=clean_start, proto_ver=proto, **kw
+        ))
+        self.channels[clientid] = ch
+        return ch, out
+
+    def publish(self, ch, topic, payload=b"", qos=0, pid=None, **kw):
+        """Publish from a client and fan deliveries out to all channels."""
+        acks = ch.handle_in(P.Publish(
+            topic=topic, payload=payload, qos=qos, packet_id=pid, **kw
+        ))
+        # route once more to capture deliveries (publish already happened
+        # inside handle_in; we emulate the conn layer fan-out by publishing
+        # via broker? no — handle_in called broker.publish which returned
+        # deliveries we dropped. For tests, deliver explicitly:
+        return acks
+
+
+def connect_flow():
+    h = Harness()
+    ch, out = h.connect("c1")
+    return h, ch, out
+
+
+def test_connect_connack():
+    h, ch, out = connect_flow()
+    assert out == [P.Connack(session_present=False)]
+    assert ch.conn_state == "connected"
+    assert h.cm.lookup_channel("c1") is ch
+
+
+def test_first_packet_must_be_connect():
+    h = Harness()
+    ch = Channel(h.broker, h.cm)
+    with pytest.raises(P.FrameError):
+        ch.handle_in(P.PingReq())
+
+
+def test_duplicate_connect_is_protocol_error():
+    h, ch, _ = connect_flow()
+    with pytest.raises(P.FrameError):
+        ch.handle_in(P.Connect(clientid="c1"))
+
+
+def test_empty_clientid_v5_assigned():
+    h = Harness()
+    ch, out = h.connect("", proto=P.MQTT_V5)
+    assert out[0].reason_code == P.RC_SUCCESS
+    assert "Assigned-Client-Identifier" in out[0].properties
+    assert ch.clientid
+
+
+def test_empty_clientid_v4_persistent_rejected():
+    h = Harness()
+    ch, out = h.connect("", clean_start=False, proto=P.MQTT_V4)
+    assert out[0].reason_code == 2     # v3 "identifier rejected"
+
+
+def test_subscribe_publish_qos1_end_to_end():
+    h = Harness()
+    sub_ch, _ = h.connect("sub")
+    suback = sub_ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[
+        ("t/+", {"qos": 1}), ("bad/#/x", {"qos": 0}),
+    ]))
+    assert suback[0].reason_codes == [1, P.RC_TOPIC_FILTER_INVALID]
+
+    pub_ch, _ = h.connect("pub")
+    deliveries_seen = []
+    # emulate the connection host: deliver broker output to the sub channel
+    acks = pub_ch.handle_in(P.Publish(topic="t/1", payload=b"hi", qos=1,
+                                      packet_id=10))
+    assert acks == [P.PubAck(packet_id=10)]
+    # deliveries from broker.publish happen inside handle_in; drive them:
+    out = sub_ch.handle_deliver([("t/+",
+                                  __import__("emqx_tpu.core.message",
+                                             fromlist=["Message"]).Message(
+                                      topic="t/1", payload=b"hi", qos=1))])
+    assert len(out) == 1 and out[0].qos == 1 and out[0].payload == b"hi"
+    # client acks
+    assert sub_ch.handle_in(P.PubAck(packet_id=out[0].packet_id)) == []
+
+
+def test_publish_qos2_exactly_once():
+    h = Harness()
+    ch, _ = h.connect("c")
+    got = []
+    h.broker.hooks.add("message.publish", lambda m: got.append(m.topic) or m)
+    rec = ch.handle_in(P.Publish(topic="q2", qos=2, packet_id=5))
+    assert rec == [P.PubRec(packet_id=5)]
+    # duplicate PUBLISH with same pid before PUBREL → not re-published
+    rec2 = ch.handle_in(P.Publish(topic="q2", qos=2, packet_id=5))
+    assert rec2[0].reason_code == P.RC_PACKET_IDENTIFIER_IN_USE
+    assert got.count("q2") == 1
+    comp = ch.handle_in(P.PubRel(packet_id=5))
+    assert comp == [P.PubComp(packet_id=5)]
+    # unknown PUBREL
+    comp2 = ch.handle_in(P.PubRel(packet_id=99))
+    assert comp2[0].reason_code == P.RC_PACKET_IDENTIFIER_NOT_FOUND
+
+
+def test_authz_deny_publish():
+    h = Harness()
+    ch, _ = h.connect("c")
+    h.broker.hooks.add(
+        "client.authorize",
+        lambda who, action, topic, acc: "deny" if topic == "secret" else acc,
+    )
+    assert ch.handle_in(P.Publish(topic="secret", qos=1, packet_id=1)) == \
+        [P.PubAck(packet_id=1, reason_code=P.RC_NOT_AUTHORIZED)]
+    suback = ch.handle_in(P.Subscribe(packet_id=2, topic_filters=[
+        ("secret", {"qos": 0})]))
+    assert suback[0].reason_codes == [P.RC_NOT_AUTHORIZED]
+
+
+def test_authn_reject():
+    h = Harness()
+    h.broker.hooks.add(
+        "client.authenticate",
+        lambda info, acc: {"result": "error", "rc": P.RC_BAD_USER_NAME_OR_PASSWORD}
+        if info["username"] != "root" else acc,
+    )
+    ch, out = h.connect("c", proto=P.MQTT_V5, username="eve", password=b"x")
+    assert out[0].reason_code == P.RC_BAD_USER_NAME_OR_PASSWORD
+    ch2, out2 = h.connect("c2", proto=P.MQTT_V5, username="root", password=b"x")
+    assert out2[0].reason_code == P.RC_SUCCESS
+
+
+def test_takeover_preserves_pending():
+    h = Harness()
+    ch1, _ = h.connect("dev1", clean_start=False, proto=P.MQTT_V5,
+                       properties={"Session-Expiry-Interval": 3600})
+    ch1.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 1})]))
+    # backlog: deliver more than the inflight window while "slow"
+    from emqx_tpu.core.message import Message
+    ch1.session.max_inflight = 1
+    ch1.session.inflight.max_size = 1
+    ch1.handle_deliver([("t", Message(topic="t", qos=1, payload=b"a"))])
+    ch1.handle_deliver([("t", Message(topic="t", qos=1, payload=b"b"))])
+    assert len(ch1.session.mqueue) == 1
+    # second client resumes the session
+    ch2, out = h.connect("dev1", clean_start=False, proto=P.MQTT_V5,
+                         properties={"Session-Expiry-Interval": 3600})
+    assert out[0].session_present is True
+    assert ch1.conn_state == "disconnected"
+    # the carried-over window is 1, so one replay flies, one re-queues
+    replays = [p for p in out if isinstance(p, P.Publish)]
+    assert [p.payload for p in replays] == [b"a"]
+    assert len(ch2.session.mqueue) == 1
+    assert h.cm.lookup_channel("dev1") is ch2
+    # acking the first frees the window for the second
+    nxt = ch2.handle_in(P.PubAck(packet_id=replays[0].packet_id))
+    assert [p.payload for p in nxt] == [b"b"]
+
+
+def test_clean_start_discards_old_session():
+    h = Harness()
+    ch1, _ = h.connect("dev", clean_start=False, proto=P.MQTT_V5,
+                       properties={"Session-Expiry-Interval": 3600})
+    ch1.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 1})]))
+    ch2, out = h.connect("dev", clean_start=True)
+    assert out[0].session_present is False
+    assert ch1.conn_state == "disconnected"
+
+
+def test_will_message_on_abnormal_disconnect():
+    h = Harness()
+    watcher, _ = h.connect("w")
+    watcher.handle_in(P.Subscribe(packet_id=1, topic_filters=[("will/t", {"qos": 0})]))
+    seen = []
+    h.broker.hooks.add("message.publish", lambda m: seen.append(m.topic) or m)
+    ch, _ = h.connect("dying", will_flag=True, will_qos=0,
+                      will_topic="will/t", will_payload=b"gone")
+    ch.terminate("socket_error")
+    assert "will/t" in seen
+    # normal DISCONNECT discards the will
+    ch2, _ = h.connect("polite", will_flag=True, will_qos=0,
+                       will_topic="will/t", will_payload=b"oops")
+    seen.clear()
+    ch2.handle_in(P.Disconnect())
+    assert seen == []
+
+
+def test_keepalive_expiry():
+    h = Harness()
+    ch, _ = h.connect("k")
+    ch.conninfo.keepalive = 10
+    ch.last_packet_at = 0
+    assert ch.keepalive_expired(now=15_001)
+    assert not ch.keepalive_expired(now=14_999)
+
+
+def test_unsubscribe():
+    h = Harness()
+    ch, _ = h.connect("c")
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 0})]))
+    out = ch.handle_in(P.Unsubscribe(packet_id=2, topic_filters=["t", "never"]))
+    assert out[0].reason_codes == [P.RC_SUCCESS, P.RC_NO_SUBSCRIPTION_EXISTED]
+    assert h.broker.publish(
+        __import__("emqx_tpu.core.message", fromlist=["Message"]).Message(topic="t")
+    ) == {}
+
+
+def test_topic_alias_v5():
+    h = Harness()
+    ch, _ = h.connect("a", proto=P.MQTT_V5)
+    got = []
+    h.broker.hooks.add("message.publish", lambda m: got.append(m.topic) or m)
+    ch.handle_in(P.Publish(topic="long/topic", qos=0,
+                           properties={"Topic-Alias": 1}))
+    ch.handle_in(P.Publish(topic="", qos=0, properties={"Topic-Alias": 1}))
+    assert got == ["long/topic", "long/topic"]
+    with pytest.raises(P.FrameError):
+        ch.handle_in(P.Publish(topic="", qos=0, properties={"Topic-Alias": 9}))
+
+
+def test_mountpoint_namespacing():
+    h = Harness()
+    ch = Channel(h.broker, h.cm, mountpoint="tenant/%c/")
+    ch.handle_in(P.Connect(clientid="c9"))
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 0})]))
+    assert h.broker.router.has_route("tenant/c9/t", h.broker.node)
+    from emqx_tpu.core.message import Message
+    out = ch.handle_deliver([("tenant/c9/t",
+                              Message(topic="tenant/c9/t", payload=b"x"))])
+    assert out[0].topic == "t"    # unmounted on the way out
+
+
+def test_cm_kick():
+    h = Harness()
+    ch, _ = h.connect("k1")
+    assert h.cm.kick("k1") is True
+    assert h.cm.kick("k1") is False
+    assert ch.conn_state == "disconnected"
+
+
+def test_publish_actually_reaches_subscriber_socket():
+    """End-to-end: publisher handle_in drives bytes into the subscriber's
+    outbox without any test-side glue (the review-found missing link)."""
+    h = Harness()
+    sub_ch, _ = h.connect("sub2")
+    sub_ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("e2e/+", {"qos": 1})]))
+    pub_ch, _ = h.connect("pub2")
+    pub_ch.handle_in(P.Publish(topic="e2e/x", payload=b"live", qos=1, packet_id=3))
+    got = [p for p in sub_ch.outbox if isinstance(p, P.Publish)]
+    assert len(got) == 1 and got[0].payload == b"live" and got[0].topic == "e2e/x"
+
+
+def test_discard_cleans_broker_state():
+    h = Harness()
+    ch1, _ = h.connect("dev", clean_start=False, proto=P.MQTT_V5,
+                       properties={"Session-Expiry-Interval": 3600})
+    ch1.handle_in(P.Subscribe(packet_id=1, topic_filters=[("leak/t", {"qos": 0})]))
+    h.connect("dev", clean_start=True)       # clean start discards old
+    assert "leak/t" not in h.broker.subscriber
+    assert h.broker.router.match_routes("leak/t") == []
+
+
+def test_mountpoint_shared_sub():
+    h = Harness()
+    ch = Channel(h.broker, h.cm, mountpoint="ns/")
+    ch.handle_in(P.Connect(clientid="sc"))
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("$share/g/t", {"qos": 0})]))
+    # route must be a shared-group route for the mounted real topic
+    assert h.broker.router.has_route("ns/t", ("g", h.broker.node))
+    out = ch.handle_in(P.Unsubscribe(packet_id=2, topic_filters=["$share/g/t"]))
+    assert out[0].reason_codes == [P.RC_SUCCESS]
+    assert not h.broker.router.has_route("ns/t", ("g", h.broker.node))
+
+
+def test_dequeued_packet_unmounted():
+    h = Harness()
+    ch = Channel(h.broker, h.cm, mountpoint="m/")
+    ch.handle_in(P.Connect(clientid="dq"))
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 1})]))
+    ch.session.inflight.max_size = 1
+    from emqx_tpu.core.message import Message
+    first = ch.handle_deliver([("m/t", Message(topic="m/t", qos=1, payload=b"1"))])
+    ch.handle_deliver([("m/t", Message(topic="m/t", qos=1, payload=b"2"))])
+    nxt = ch.handle_in(P.PubAck(packet_id=first[0].packet_id))
+    assert nxt[0].topic == "t"               # unmounted on dequeue too

@@ -1,0 +1,174 @@
+"""MQTT frame codec tests — mirrors apps/emqx/test/emqx_frame_SUITE.erl and
+the parse∘serialize roundtrip property (apps/emqx/test/props/prop_emqx_frame.erl)."""
+
+import random
+
+import pytest
+
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import FrameError, Parser, serialize
+
+
+def roundtrip(pkt, ver=P.MQTT_V4):
+    data = serialize(pkt, ver)
+    parser = Parser(version=ver)
+    out = parser.feed(data)
+    assert len(out) == 1, out
+    return out[0]
+
+
+def test_connect_roundtrip_v4():
+    pkt = P.Connect(
+        proto_ver=P.MQTT_V4, clean_start=True, keepalive=30,
+        clientid="c1", username="u", password=b"p",
+        will_flag=True, will_qos=1, will_retain=False,
+        will_topic="will/t", will_payload=b"bye",
+    )
+    got = roundtrip(pkt)
+    assert got == pkt
+
+
+def test_connect_roundtrip_v5_properties():
+    pkt = P.Connect(
+        proto_ver=P.MQTT_V5, clientid="c2",
+        properties={
+            "Session-Expiry-Interval": 3600,
+            "Receive-Maximum": 20,
+            "User-Property": [("k", "v"), ("k", "v2")],
+        },
+        will_flag=True, will_topic="w", will_payload=b"",
+        will_props={"Will-Delay-Interval": 5},
+    )
+    got = roundtrip(pkt, P.MQTT_V5)
+    assert got == pkt
+
+
+def test_publish_roundtrip():
+    for ver in (P.MQTT_V4, P.MQTT_V5):
+        pkt = P.Publish(topic="a/b", payload=b"\x00\xffhello", qos=1,
+                        packet_id=7, retain=True, dup=True)
+        assert roundtrip(pkt, ver) == pkt
+
+
+def test_publish_v5_props():
+    pkt = P.Publish(
+        topic="t", payload=b"x", qos=2, packet_id=99,
+        properties={
+            "Topic-Alias": 3,
+            "Message-Expiry-Interval": 60,
+            "Subscription-Identifier": [1, 268435455],
+            "Correlation-Data": b"\x01\x02",
+            "Response-Topic": "r/t",
+        },
+    )
+    assert roundtrip(pkt, P.MQTT_V5) == pkt
+
+
+def test_qos3_rejected():
+    data = serialize(P.Publish(topic="t", qos=2, packet_id=1))
+    bad = bytes([data[0] | 0x06]) + data[1:]
+    with pytest.raises(FrameError):
+        Parser().feed(bad)
+
+
+def test_acks_and_subs_roundtrip():
+    assert roundtrip(P.PubAck(packet_id=5)) == P.PubAck(packet_id=5)
+    v5ack = P.PubAck(packet_id=5, reason_code=P.RC_NO_MATCHING_SUBSCRIBERS)
+    assert roundtrip(v5ack, P.MQTT_V5) == v5ack
+    sub = P.Subscribe(packet_id=2, topic_filters=[
+        ("a/+", {"qos": 1, "nl": 0, "rap": 0, "rh": 0}),
+        ("b/#", {"qos": 2, "nl": 1, "rap": 1, "rh": 2}),
+    ])
+    assert roundtrip(sub, P.MQTT_V5) == sub
+    assert roundtrip(P.SubAck(packet_id=2, reason_codes=[0, 1, 0x80])) == \
+        P.SubAck(packet_id=2, reason_codes=[0, 1, 0x80])
+    unsub = P.Unsubscribe(packet_id=3, topic_filters=["a/+", "b"])
+    assert roundtrip(unsub) == unsub
+    assert roundtrip(P.PingReq()) == P.PingReq()
+    assert roundtrip(P.PingResp()) == P.PingResp()
+    d5 = P.Disconnect(reason_code=P.RC_SESSION_TAKEN_OVER)
+    assert roundtrip(d5, P.MQTT_V5) == d5
+    auth = P.Auth(reason_code=0x18,
+                  properties={"Authentication-Method": "SCRAM-SHA-1"})
+    assert roundtrip(auth, P.MQTT_V5) == auth
+
+
+def test_incremental_byte_by_byte():
+    """The {active,N} contract: packets split at arbitrary boundaries."""
+    pkts = [
+        P.Connect(clientid="c"),
+        P.Publish(topic="x/y", payload=b"z" * 300, qos=1, packet_id=1),
+        P.PingReq(),
+        P.Publish(topic="q", payload=b""),
+    ]
+    stream = b"".join(serialize(p) for p in pkts)
+    parser = Parser()
+    got = []
+    for i in range(len(stream)):
+        got.extend(parser.feed(stream[i : i + 1]))
+    assert got == pkts
+    # random chunking
+    rng = random.Random(1)
+    for _ in range(50):
+        parser = Parser()
+        got = []
+        i = 0
+        while i < len(stream):
+            j = min(len(stream), i + rng.randint(1, 40))
+            got.extend(parser.feed(stream[i:j]))
+            i = j
+        assert got == pkts
+
+
+def test_remaining_length_bounds():
+    # 4-byte varint max is valid framing; 5 bytes is malformed
+    parser = Parser()
+    with pytest.raises(FrameError):
+        parser.feed(bytes([0x30, 0x80, 0x80, 0x80, 0x80, 0x01]))
+    # max_size enforcement (emqx mqtt.max_packet_size analogue)
+    parser = Parser(max_size=100)
+    big = serialize(P.Publish(topic="t", payload=b"x" * 200))
+    with pytest.raises(FrameError) as ei:
+        parser.feed(big)
+    assert ei.value.rc == P.RC_PACKET_TOO_LARGE
+
+
+def test_malformed_utf8_and_truncation():
+    pkt = serialize(P.Publish(topic="tt", payload=b"p"))
+    # corrupt the topic bytes with invalid utf8
+    bad = bytearray(pkt)
+    bad[4:6] = b"\xff\xfe"
+    with pytest.raises(FrameError):
+        Parser().feed(bytes(bad))
+
+
+def test_unknown_property_rejected():
+    # property id 0x7f is not defined
+    body = b"\x00\x01t" + bytes([2, 0x7F, 0x00]) + b"payload"
+    frame = bytes([0x30]) + bytes([len(body)]) + body
+    with pytest.raises(FrameError):
+        Parser(version=P.MQTT_V5).feed(frame)
+
+
+def test_randomized_roundtrip(rng):
+    topics = ["a", "a/b", "x/+/y", "looooong/" * 10 + "end", "ü/码"]
+    for _ in range(300):
+        qos = rng.randrange(3)
+        pkt = P.Publish(
+            topic=rng.choice(topics),
+            payload=bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200))),
+            qos=qos,
+            retain=rng.random() < 0.5,
+            dup=rng.random() < 0.5,
+            packet_id=rng.randrange(1, 65536) if qos else None,
+        )
+        ver = rng.choice([P.MQTT_V4, P.MQTT_V5])
+        assert roundtrip(pkt, ver) == pkt
+
+
+def test_connect_reserved_flag():
+    data = bytearray(serialize(P.Connect(clientid="c")))
+    # connect flags byte: header(1) + len(1) + "MQTT"(6) + ver(1) = offset 9
+    data[9] |= 0x01
+    with pytest.raises(FrameError):
+        Parser().feed(bytes(data))

@@ -1,0 +1,212 @@
+"""Session layer tests — mirrors emqx_inflight_SUITE, emqx_mqueue_SUITE,
+emqx_session_SUITE."""
+
+import pytest
+
+from emqx_tpu.core.message import Message, SubOpts
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.session.inflight import Inflight
+from emqx_tpu.session.mqueue import MQueue, MQueueOpts
+from emqx_tpu.session.session import Session, SessionError
+
+
+def msg(topic="t", qos=1, **kw):
+    return Message(topic=topic, qos=qos, **kw)
+
+
+# -- inflight ---------------------------------------------------------------
+
+def test_inflight_window():
+    inf = Inflight(max_size=2)
+    inf.insert(1, "a")
+    inf.insert(2, "b")
+    assert inf.is_full()
+    with pytest.raises(KeyError):
+        inf.insert(1, "dup")
+    assert inf.delete(1) == "a"
+    assert not inf.is_full()
+    assert inf.peek_oldest() == (2, "b")
+
+
+# -- mqueue -----------------------------------------------------------------
+
+def test_mqueue_drop_oldest():
+    q = MQueue(MQueueOpts(max_len=3))
+    dropped = [q.insert(msg(payload=bytes([i]))) for i in range(5)]
+    assert dropped[:3] == [None, None, None]
+    assert dropped[3].payload == b"\x00"    # oldest dropped
+    assert dropped[4].payload == b"\x01"
+    assert q.dropped == 2
+    assert [m.payload for m in [q.pop(), q.pop(), q.pop()]] == [b"\x02", b"\x03", b"\x04"]
+    assert q.pop() is None
+
+
+def test_mqueue_qos0_bypass():
+    q = MQueue(MQueueOpts(store_qos0=False))
+    d = q.insert(msg(qos=0))
+    assert d is not None and len(q) == 0
+    assert q.insert(msg(qos=1)) is None and len(q) == 1
+
+
+def test_mqueue_priorities():
+    q = MQueue(MQueueOpts(priorities={"hi": 10, "lo": 1}, shift_multiplier=100))
+    q.insert(msg(topic="lo", payload=b"1"))
+    q.insert(msg(topic="hi", payload=b"2"))
+    q.insert(msg(topic="plain", payload=b"3"))
+    assert q.pop().topic == "hi"
+    assert q.pop().topic == "lo"
+    assert q.pop().topic == "plain"
+
+
+# -- session QoS flows ------------------------------------------------------
+
+def make_session(**kw):
+    s = Session(clientid="c1", max_inflight=2, **kw)
+    s.subscribe("t", SubOpts(qos=2))
+    s.subscribe("t0", SubOpts(qos=0))
+    return s
+
+
+def test_deliver_qos0():
+    s = make_session()
+    out = s.deliver([("t0", msg(topic="t0", qos=0))])
+    assert len(out) == 1 and out[0].qos == 0 and out[0].packet_id is None
+    assert s.inflight.is_empty()
+
+
+def test_deliver_qos1_ack_cycle():
+    s = make_session()
+    out = s.deliver([("t", msg(qos=1))])
+    pid = out[0].packet_id
+    assert out[0].qos == 1 and pid is not None
+    assert len(s.inflight) == 1
+    assert s.puback(pid) == []
+    assert s.inflight.is_empty()
+    with pytest.raises(SessionError):
+        s.puback(pid)
+
+
+def test_deliver_qos2_full_cycle():
+    s = make_session()
+    out = s.deliver([("t", msg(qos=2))])
+    pid = out[0].packet_id
+    rel = s.pubrec(pid)
+    assert isinstance(rel, P.PubRel) and rel.packet_id == pid
+    # pubrec twice → error (phase moved on)
+    with pytest.raises(SessionError):
+        s.pubrec(pid)
+    assert s.pubcomp(pid) == []
+    assert s.inflight.is_empty()
+
+
+def test_backpressure_enqueue_and_dequeue():
+    s = make_session()
+    out = s.deliver([("t", msg(qos=1, payload=bytes([i]))) for i in range(5)])
+    assert len(out) == 2                      # window = 2
+    assert len(s.mqueue) == 3
+    nxt = s.puback(out[0].packet_id)
+    assert len(nxt) == 1 and nxt[0].payload == b"\x02"
+    assert len(s.mqueue) == 2
+
+
+def test_min_qos_rule():
+    s = Session(clientid="c")
+    s.subscribe("q1", SubOpts(qos=1))
+    out = s.deliver([("q1", msg(topic="q1", qos=2))])
+    assert out[0].qos == 1                     # min(sub_qos, msg_qos)
+
+
+def test_no_local():
+    s = Session(clientid="me")
+    s.subscribe("t", SubOpts(qos=1, nl=1))
+    assert s.deliver([("t", msg(qos=1, from_="me"))]) == []
+    assert len(s.deliver([("t", msg(qos=1, from_="other"))])) == 1
+
+
+def test_qos2_receive_dedup():
+    s = make_session()
+    m = msg(qos=2)
+    s.publish_in(10, m)
+    with pytest.raises(SessionError) as ei:
+        s.publish_in(10, m)
+    assert ei.value.rc == P.RC_PACKET_IDENTIFIER_IN_USE
+    s.pubrel_in(10)
+    s.publish_in(10, m)   # free again after PUBREL
+    with pytest.raises(SessionError):
+        s.pubrel_in(99)
+
+
+def test_awaiting_rel_quota_and_expiry():
+    s = Session(clientid="c", max_awaiting_rel=2, await_rel_timeout_ms=100)
+    s.publish_in(1, msg(qos=2), now=1000)
+    s.publish_in(2, msg(qos=2), now=1000)
+    with pytest.raises(SessionError) as ei:
+        s.publish_in(3, msg(qos=2), now=1000)
+    assert ei.value.rc == P.RC_RECEIVE_MAXIMUM_EXCEEDED
+    assert s.expire_awaiting_rel(now=1100) == 2
+    s.publish_in(3, msg(qos=2), now=1101)
+
+
+def test_retry_redelivers_with_dup():
+    s = make_session(retry_interval_ms=100)
+    out = s.deliver([("t", msg(qos=1))], now=1000)
+    pid = out[0].packet_id
+    assert s.retry(now=1050) == []            # not yet
+    redel = s.retry(now=1200)
+    assert len(redel) == 1 and redel[0].dup and redel[0].packet_id == pid
+    # QoS2 pubrel phase retries as PUBREL
+    out2 = s.deliver([("t", msg(qos=2))], now=1200)
+    s.pubrec(out2[0].packet_id, now=1200)
+    redel2 = s.retry(now=1400)
+    assert any(isinstance(p, P.PubRel) for p in redel2)
+
+
+def test_packet_id_wraps_and_skips_inflight():
+    s = Session(clientid="c", max_inflight=0)
+    s._next_pkt_id = 65534
+    assert s.next_packet_id() == 65535
+    assert s.next_packet_id() == 1
+    s.inflight.insert(2, "x")
+    assert s.next_packet_id() == 3
+
+
+def test_unsubscribe_then_late_delivery_dropped():
+    s = make_session()
+    s.unsubscribe("t")
+    assert s.deliver([("t", msg(qos=1))]) == []
+    with pytest.raises(SessionError):
+        s.unsubscribe("t")
+
+
+def test_pending_for_resume():
+    s = make_session()
+    out = s.deliver([("t", msg(qos=1, payload=bytes([i]))) for i in range(4)])
+    pend = s.pending_for_resume()
+    assert len(pend) == 4   # 2 inflight + 2 queued
+
+
+def test_mqueue_priority_eviction_when_full():
+    q = MQueue(MQueueOpts(max_len=1, priorities={"hi": 5}))
+    q.insert(msg(topic="plain"))
+    dropped = q.insert(msg(topic="hi"))      # evicts the low-prio resident
+    assert dropped is not None and dropped.topic == "plain"
+    assert q.pop().topic == "hi"
+    # and an incoming message below everything queued is itself dropped
+    q2 = MQueue(MQueueOpts(max_len=1, priorities={"hi": 5}))
+    q2.insert(msg(topic="hi"))
+    d2 = q2.insert(msg(topic="plain"))
+    assert d2 is not None and d2.topic == "plain"
+    assert q2.pop().topic == "hi"
+
+
+def test_retry_preserves_subid_and_rap():
+    s = Session(clientid="c", retry_interval_ms=10)
+    s.subscribe("t", SubOpts(qos=1, rap=1, subid=7))
+    m = msg(qos=1)
+    m = m.set_flag("retain", True)
+    out = s.deliver([("t", m)], now=0)
+    assert out[0].retain and out[0].properties["Subscription-Identifier"] == [7]
+    redel = s.retry(now=1000)
+    assert redel[0].dup
+    assert redel[0].retain is True
+    assert redel[0].properties["Subscription-Identifier"] == [7]

@@ -1,0 +1,116 @@
+"""Application assembly — the ``emqx_machine``/``emqx_sup`` analogue.
+
+Builds the broker with its standard services wired onto hookpoints, in
+the same composition the reference boots: shared-sub dispatch, retainer,
+delayed publish — each attached via hooks, no core changes
+(SURVEY.md §2.2: "emqx_retainer, emqx_slow_subs, etc register via hooks").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.cm import CM
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.broker.shared_sub import SharedSub
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import Message
+from emqx_tpu.services.delayed import Delayed
+from emqx_tpu.services.retainer import Retainer
+
+
+class BrokerApp:
+    """Broker + CM + standard services, hook-wired."""
+
+    def __init__(
+        self,
+        node: str = "node1",
+        shared_strategy: str = "round_robin",
+        max_retained: int = 0,
+        retained_expiry_ms: int = 0,
+        router_model=None,
+        forward_fn=None,
+    ):
+        self.hooks = Hooks()
+        self.cm = CM()
+        self.shared = SharedSub(node=node, strategy=shared_strategy)
+        self.broker = Broker(
+            node=node,
+            hooks=self.hooks,
+            router_model=router_model,
+            forward_fn=forward_fn,
+            shared_dispatch=self._shared_dispatch,
+        )
+        self.retainer = Retainer(
+            max_retained=max_retained, default_expiry_ms=retained_expiry_ms
+        )
+        self.delayed = Delayed(publish_fn=self._publish_dispatch)
+
+        # hook wiring — delayed intercepts first (STOP), retainer observes
+        self.delayed.attach(self.hooks, priority=100)
+        self.hooks.add("message.publish", self._retain_on_publish, priority=-100)
+        self.hooks.add("session.subscribed", self._retained_on_subscribe)
+        self.hooks.add("session.subscribed", self._shared_on_subscribe)
+        self.hooks.add("session.unsubscribed", self._shared_on_unsubscribe)
+        self.hooks.add("session.terminated", self._shared_on_terminated)
+        self.hooks.add("session.discarded", self._shared_on_terminated)
+
+    # -- delayed -----------------------------------------------------------
+
+    def _publish_dispatch(self, msg: Message) -> None:
+        self.cm.dispatch(self.broker.publish(msg))
+
+    # -- retainer ----------------------------------------------------------
+
+    def _retain_on_publish(self, msg: Message):
+        self.retainer.on_publish(msg)
+        if msg.retain and not msg.payload:
+            # an empty retained publish clears the slot and is NOT routed
+            return msg.set_header("allow_publish", False)
+        return None
+
+    def _retained_on_subscribe(self, sid: str, topic: str, opts,
+                               is_new: bool = True) -> None:
+        rh = getattr(opts, "rh", 0)
+        if rh == 2 or (rh == 1 and not is_new):
+            # rh=1: send retained only when the subscription did not
+            # previously exist (MQTT5 3.8.3.1)
+            return
+        group, real = T.parse_share(topic)
+        if group:
+            return                      # shared subs get no retained msgs
+        msgs = self.retainer.match(real)
+        if msgs:
+            self.cm.dispatch({sid: [(topic, m) for m in msgs]})
+
+    # -- shared subs --------------------------------------------------------
+
+    def _shared_on_subscribe(self, sid: str, topic: str, opts,
+                             is_new: bool = True) -> None:
+        group, real = T.parse_share(topic)
+        if group:
+            self.shared.join(group, real, sid)
+
+    def _shared_on_unsubscribe(self, sid: str, topic: str) -> None:
+        group, real = T.parse_share(topic)
+        if group:
+            self.shared.leave(group, real, sid)
+
+    def _shared_on_terminated(self, sid: str, *args) -> None:
+        self.shared.member_down(sid)
+
+    def _shared_dispatch(self, group: str, topic: str, msg: Message):
+        def deliver_fn(sid: str) -> bool:
+            ch = self.cm.lookup_channel(sid)
+            return ch is not None and ch.conn_state == "connected"
+        return self.shared.dispatch(group, topic, msg, deliver_fn=deliver_fn)
+
+    # -- housekeeping (server timer) ----------------------------------------
+
+    def tick(self) -> None:
+        self.delayed.tick()
+        # delayed wills of disconnected-but-registered channels
+        for _cid, ch in self.cm.all_channels():
+            if getattr(ch, "pending_will_at", None) is not None:
+                ch.will_tick()

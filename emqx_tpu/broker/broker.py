@@ -137,7 +137,8 @@ class Broker:
                         slot = self.slots.get_or_assign(sid)
                         self._ensure_model_capacity()
                         self.model.subscribe(real_topic, slot)
-        self.hooks.run("session.subscribed", (sid, topic, opts))
+        # is_new lets rh=1 (send-retained-if-new) distinguish resubscribes
+        self.hooks.run("session.subscribed", (sid, topic, opts, is_new))
 
     def unsubscribe(self, sid: Sid, topic: str) -> bool:
         group, real_topic = T.parse_share(topic)
@@ -256,10 +257,13 @@ class Broker:
         for route in routes:
             dest = route.dest
             if isinstance(dest, tuple):        # ({group, node}) shared
+                # one dispatch per {group, topic-filter} route: the same
+                # group may subscribe via several matching filters with
+                # disjoint membership lists
                 group = dest[0]
-                if group in seen_groups:
+                if (group, route.topic) in seen_groups:
                     continue
-                seen_groups.add(group)
+                seen_groups.add((group, route.topic))
                 if self.shared_dispatch is not None:
                     for sid, sub_topic in self.shared_dispatch(
                         group, route.topic, msg
@@ -291,8 +295,8 @@ class Broker:
             dest = route.dest
             if isinstance(dest, tuple):
                 group = dest[0]
-                if group not in seen_groups:
-                    seen_groups.add(group)
+                if (group, route.topic) not in seen_groups:
+                    seen_groups.add((group, route.topic))
                     if self.shared_dispatch is not None:
                         for sid, sub_topic in self.shared_dispatch(
                             group, route.topic, msg

@@ -78,6 +78,7 @@ class Channel:
         # accumulate in outbox for the host to drain
         self.outbox: list[P.Packet] = []
         self._send = send if send is not None else self.outbox.extend
+        self.pending_will_at: Optional[int] = None   # MQTT5 will-delay
 
     def send(self, pkts: list[P.Packet]) -> None:
         if pkts:
@@ -488,8 +489,21 @@ class Channel:
         pending = session.take_pending() if session else []
         self.conn_state = "disconnected"
         self.session = None
+        # resuming in time cancels a delayed will (MQTT5 3.1.3.2.2)
+        self.pending_will_at = None
+        self.will = None
         self.hooks.run("session.takenover", (self.clientid,))
         return session, pending
+
+    def will_tick(self, now: Optional[int] = None) -> None:
+        """Fire a due delayed will (driven by the app housekeeping timer)."""
+        if self.pending_will_at is None or self.will is None:
+            return
+        now = now_ms() if now is None else now
+        if now >= self.pending_will_at:
+            self._publish_and_dispatch(self.will.msg)
+            self.will = None
+            self.pending_will_at = None
 
     def discard(self) -> None:
         """Kicked by a clean-start connect or admin (RC 0x8E). Unlike
@@ -507,8 +521,16 @@ class Channel:
             return
         self.conn_state = "disconnected"
         if self.will is not None and reason != "normal":
-            self.broker.publish(self.will.msg)
-            self.will = None
+            if (
+                self.will.delay_ms > 0
+                and self.conninfo.expiry_interval_ms > 0
+            ):
+                # MQTT5 Will Delay: withhold; cancelled if the session is
+                # resumed before it fires (will_tick / takeover)
+                self.pending_will_at = now_ms() + self.will.delay_ms
+            else:
+                self._publish_and_dispatch(self.will.msg)
+                self.will = None
         if self.conninfo.expiry_interval_ms == 0:
             # session dies with the connection
             if self.session is not None:

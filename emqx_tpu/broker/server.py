@@ -1,0 +1,202 @@
+"""TCP connection host + listener — the ``emqx_connection.erl`` /
+``emqx_listeners.erl`` analogue.
+
+One asyncio task per connection (the BEAM's process-per-connection on an
+event loop): socket reads feed the incremental parser in ``{active,N}``
+style batches, parsed packets drive the channel FSM, outgoing packets
+serialize back to the socket. Periodic housekeeping covers keepalive
+(1.5×), retry, and awaiting-rel expiry (the channel's timer set,
+emqx_channel.erl:125-132).
+
+The production ingest path is the C++ host in ``emqx_tpu/native`` feeding
+publish batches to the device router; this asyncio host is the reference
+implementation and the control-plane/test surface. Both speak to the same
+Broker/Channel objects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.cm import CM
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import FrameError, Parser, serialize
+
+log = logging.getLogger("emqx_tpu.server")
+
+READ_CHUNK = 65536          # {active,N}-ish coalescing
+HOUSEKEEP_INTERVAL = 5.0
+
+
+class Connection:
+    """One client socket: parser + channel + writer."""
+
+    def __init__(self, server: "BrokerServer",
+                 reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        self.parser = Parser(max_size=server.max_packet_size)
+        self.channel = Channel(
+            server.broker, server.cm,
+            mountpoint=server.mountpoint,
+            send=self._send_packets,
+        )
+        self.channel.conninfo.peername = f"{peer[0]}:{peer[1]}"
+        self.closed = False
+
+    def _send_packets(self, pkts) -> None:
+        if self.closed:
+            return
+        data = b"".join(
+            serialize(p, self.channel.conninfo.proto_ver) for p in pkts
+        )
+        if data:
+            self.writer.write(data)
+
+    async def run(self) -> None:
+        try:
+            while not self.closed:
+                data = await self.reader.read(READ_CHUNK)
+                if not data:
+                    break
+                for pkt in self.parser.feed(data):
+                    if pkt.type == P.CONNECT:
+                        self.parser.set_version(pkt.proto_ver)
+                        self.channel.conninfo.proto_ver = pkt.proto_ver
+                    out = self.channel.handle_in(pkt)
+                    self._send_packets(out)
+                    if self.channel.conn_state == "disconnected":
+                        self.closed = True
+                        break
+                await self._drain()
+        except FrameError as e:
+            log.info("frame error from %s: %s",
+                     self.channel.conninfo.peername, e)
+            if self.channel.conninfo.proto_ver == P.MQTT_V5:
+                self._send_packets([P.Disconnect(reason_code=e.rc)])
+                await self._drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            await self.close("sock_closed")
+
+    async def _drain(self) -> None:
+        try:
+            await self.writer.drain()
+        except ConnectionError:
+            pass
+
+    async def close(self, reason: str) -> None:
+        if not self.closed:
+            self.closed = True
+        self.channel.terminate(reason)
+        self.server.connections.discard(self)
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except (ConnectionError, Exception):
+            pass
+
+    def housekeep(self) -> None:
+        if self.channel.keepalive_expired():
+            asyncio.ensure_future(self.close("keepalive_timeout"))
+            return
+        self._send_packets(self.channel.handle_timeout("retry"))
+        self.channel.handle_timeout("expire_awaiting_rel")
+
+
+class BrokerServer:
+    """Listener lifecycle (emqx_listeners:start_listener analogue)."""
+
+    def __init__(
+        self,
+        broker: Optional[Broker] = None,
+        cm: Optional[CM] = None,
+        host: str = "127.0.0.1",
+        port: int = 1883,
+        max_packet_size: int = 1 << 20,
+        max_connections: int = 1_000_000,
+        mountpoint: str = "",
+        app=None,
+    ):
+        if app is None and broker is None:
+            from emqx_tpu.app import BrokerApp
+
+            app = BrokerApp()
+        self.app = app
+        self.broker = broker or app.broker
+        self.cm = cm or (app.cm if app else CM())
+        self.host, self.port = host, port
+        self.max_packet_size = max_packet_size
+        self.max_connections = max_connections
+        self.mountpoint = mountpoint
+        self.connections: set[Connection] = set()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._housekeeper: Optional[asyncio.Task] = None
+
+    async def _on_connect(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        if len(self.connections) >= self.max_connections:
+            writer.close()          # esockd max-conn limiting
+            return
+        conn = Connection(self, reader, writer)
+        self.connections.add(conn)
+        await conn.run()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connect, self.host, self.port
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._housekeeper = asyncio.create_task(self._housekeep_loop())
+        log.info("listening on %s:%d", self.host, self.port)
+
+    async def _housekeep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(HOUSEKEEP_INTERVAL)
+            if self.app is not None:
+                self.app.tick()          # delayed-publish scheduler etc.
+            for conn in list(self.connections):
+                conn.housekeep()
+
+    async def stop(self) -> None:
+        if self._housekeeper:
+            self._housekeeper.cancel()
+        for conn in list(self.connections):
+            await conn.close("server_shutdown")
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    ap = argparse.ArgumentParser(description="emqx_tpu broker")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(BrokerServer(host=args.host, port=args.port).serve_forever())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,147 @@
+"""Shared subscriptions (``$share/group/topic``) — parity with
+``apps/emqx/src/emqx_shared_sub.erl``.
+
+Group membership per (group, topic) with the reference's 7 dispatch
+strategies (emqx_shared_sub.erl:78-85, :309-379):
+
+- ``random``               uniform pick
+- ``round_robin``          per-(group,topic) rotating cursor
+- ``round_robin_per_group`` one cursor per group (all topics share it)
+- ``sticky``               pin to one member until it leaves
+- ``local``                prefer members on this node, else random
+- ``hash_clientid``        publisher clientid hash
+- ``hash_topic``           topic hash
+
+QoS1/2 ack/redispatch (:190-217, :244-266): if the picked member nacks
+(session window full / down), redispatch to another member not yet tried.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from typing import Any, Optional
+
+from emqx_tpu.core.message import Message
+
+
+class SharedSub:
+    def __init__(self, node: str = "node1", strategy: str = "round_robin",
+                 seed: Optional[int] = None):
+        self.node = node
+        self.strategy = strategy
+        # (group, topic) -> [(sid, node)]
+        self._members: dict[tuple[str, str], list[tuple[str, str]]] = {}
+        self._rr: dict[tuple[str, str], int] = {}
+        self._rr_group: dict[str, int] = {}
+        self._sticky: dict[tuple[str, str], tuple[str, str]] = {}
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+
+    # -- membership --------------------------------------------------------
+
+    def join(self, group: str, topic: str, sid: str,
+             node: Optional[str] = None) -> None:
+        with self._lock:
+            members = self._members.setdefault((group, topic), [])
+            entry = (sid, node or self.node)
+            if entry not in members:
+                members.append(entry)
+
+    def leave(self, group: str, topic: str, sid: str,
+              node: Optional[str] = None) -> None:
+        with self._lock:
+            key = (group, topic)
+            members = self._members.get(key)
+            if not members:
+                return
+            entry = (sid, node or self.node)
+            if entry in members:
+                members.remove(entry)
+            if not members:
+                self._members.pop(key, None)
+                self._rr.pop(key, None)
+                self._sticky.pop(key, None)
+            elif self._sticky.get(key) == entry:
+                self._sticky.pop(key, None)
+
+    def member_down(self, sid: str) -> None:
+        """Clean a dead subscriber out of every group, any node
+        (emqx_shared_sub.erl:456-519)."""
+        with self._lock:
+            for key in list(self._members):
+                members = self._members[key]
+                members[:] = [m for m in members if m[0] != sid]
+                if not members:
+                    self._members.pop(key, None)
+                    self._rr.pop(key, None)
+                    self._sticky.pop(key, None)
+                elif (sticky := self._sticky.get(key)) and sticky[0] == sid:
+                    self._sticky.pop(key, None)
+
+    def groups_for(self, topic: str) -> list[str]:
+        with self._lock:
+            return [g for (g, t) in self._members if t == topic]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def pick(self, group: str, topic: str, msg: Message,
+             exclude: Optional[set] = None) -> Optional[tuple[str, str]]:
+        """Pick one member (sid, node) per the strategy; ``exclude`` is the
+        already-nacked set during redispatch."""
+        with self._lock:
+            key = (group, topic)
+            members = [
+                m for m in self._members.get(key, ())
+                if not exclude or m not in exclude
+            ]
+            if not members:
+                return None
+            s = self.strategy
+            if s == "sticky":
+                cur = self._sticky.get(key)
+                if cur in members:
+                    return cur
+                choice = self._rng.choice(members)
+                self._sticky[key] = choice
+                return choice
+            if s == "round_robin":
+                i = self._rr.get(key, -1) + 1
+                self._rr[key] = i
+                return members[i % len(members)]
+            if s == "round_robin_per_group":
+                i = self._rr_group.get(group, -1) + 1
+                self._rr_group[group] = i
+                return members[i % len(members)]
+            if s == "local":
+                local = [m for m in members if m[1] == self.node]
+                return self._rng.choice(local or members)
+            # deterministic hash (erlang:phash2 analogue): Python's hash()
+            # is salted per process and would repick after restarts/nodes
+            if s == "hash_clientid":
+                return members[zlib.crc32(msg.from_.encode()) % len(members)]
+            if s == "hash_topic":
+                return members[zlib.crc32(msg.topic.encode()) % len(members)]
+            return self._rng.choice(members)   # random
+
+    def dispatch(self, group: str, topic: str, msg: Message,
+                 deliver_fn=None) -> list[tuple[str, str]]:
+        """Broker-facing dispatch: pick a member; with ``deliver_fn`` (sid →
+        bool ack) retry un-acked members (QoS>0 redispatch semantics).
+        Returns [(sid, sub_topic)] that accepted the message."""
+        sub_topic = f"$share/{group}/{topic}"
+        tried: set = set()
+        while True:
+            member = self.pick(group, topic, msg, exclude=tried)
+            if member is None:
+                return []
+            sid = member[0]
+            if deliver_fn is None or msg.qos == 0:
+                return [(sid, sub_topic)]
+            if deliver_fn(sid):
+                return [(sid, sub_topic)]
+            tried.add(member)
+            if self.strategy == "sticky":
+                # nacked: unpin so the next pick rotates
+                self._sticky.pop((group, topic), None)

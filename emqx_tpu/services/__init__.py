@@ -1,0 +1,4 @@
+from emqx_tpu.services.retainer import Retainer
+from emqx_tpu.services.delayed import Delayed
+
+__all__ = ["Retainer", "Delayed"]

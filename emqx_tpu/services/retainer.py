@@ -1,0 +1,187 @@
+"""Retained messages — parity with ``apps/emqx_retainer``.
+
+Store: retained message per exact topic; empty payload deletes
+(MQTT spec). Lookup is the *inverse* trie problem (SURVEY.md §7-6): given
+a subscription filter, find all retained topic *names* matching it — a
+name-trie walked under the filter's ``+``/``#`` branching (the reference
+builds word-position indices for this, emqx_retainer_mnesia.erl /
+emqx_retainer_index.erl; a name-trie gives the same pruning).
+
+Broker wiring (same hookpoints as the reference):
+- ``message.publish``      retain flag ⇒ store/delete (and deliver a copy)
+- ``session.subscribed``   dispatch matching retained msgs per the
+                           retain-handling (rh) subopt
+TTL: per-message Message-Expiry-Interval plus a store-wide default;
+expired entries are dropped lazily on read + via ``sweep()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import Message, now_ms
+
+
+@dataclass
+class _Node:
+    children: dict[str, "_Node"] = field(default_factory=dict)
+    msg: Optional[Message] = None       # retained message ending here
+    stored_at: int = 0
+
+
+class Retainer:
+    def __init__(self, max_retained: int = 0, default_expiry_ms: int = 0):
+        self._root = _Node()
+        self._count = 0
+        self.max_retained = max_retained          # 0 = unlimited
+        self.default_expiry_ms = default_expiry_ms
+        self._lock = threading.RLock()
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    # -- store -------------------------------------------------------------
+
+    def on_publish(self, msg: Message) -> None:
+        if not msg.retain:
+            return
+        if msg.payload:
+            self.store(msg)
+        else:
+            self.delete(msg.topic)     # empty retained payload = clear
+
+    def store(self, msg: Message, now: Optional[int] = None) -> bool:
+        now = now_ms() if now is None else now
+        with self._lock:
+            node = self._root
+            path = []
+            for w in T.words(msg.topic):
+                node = node.children.setdefault(w, _Node())
+                path.append(node)
+            if node.msg is None:
+                if self.max_retained and self._count >= self.max_retained:
+                    self.dropped += 1
+                    return False       # table full: new topics rejected
+                self._count += 1
+            # retained copies keep the retain flag when replayed
+            node.msg = msg.set_header("retained", True)
+            node.stored_at = now
+            return True
+
+    def delete(self, topic: str) -> bool:
+        with self._lock:
+            node = self._root
+            path: list[tuple[_Node, str]] = []
+            for w in T.words(topic):
+                child = node.children.get(w)
+                if child is None:
+                    return False
+                path.append((node, w))
+                node = child
+            if node.msg is None:
+                return False
+            node.msg = None
+            self._count -= 1
+            for parent, w in reversed(path):
+                child = parent.children[w]
+                if child.msg is None and not child.children:
+                    del parent.children[w]
+                else:
+                    break
+            return True
+
+    # -- inverse-trie lookup -------------------------------------------------
+
+    def match(self, filt: str, now: Optional[int] = None) -> list[Message]:
+        """All live retained messages whose topic matches ``filt``."""
+        now = now_ms() if now is None else now
+        fw = T.words(filt)
+        out: list[Message] = []
+        with self._lock:
+            self._expired_paths: list[str] = []
+            self._walk(self._root, fw, 0, first_level=True, out=out, now=now)
+            # lazily-expired entries prune their empty trie branches too
+            # (delete() owns the pruning loop)
+            for topic in self._expired_paths:
+                self.delete(topic)
+        return out
+
+    def _expired(self, node: _Node, now: int) -> bool:
+        msg = node.msg
+        if msg.is_expired(now):
+            return True
+        if self.default_expiry_ms and now - node.stored_at >= self.default_expiry_ms:
+            return True
+        return False
+
+    def _emit(self, node: _Node, out: list[Message], now: int) -> None:
+        if node.msg is not None:
+            if self._expired(node, now):
+                self._expired_paths.append(node.msg.topic)
+            else:
+                out.append(node.msg)
+
+    def _walk(self, node: _Node, fw: list[str], i: int,
+              first_level: bool, out: list[Message], now: int) -> None:
+        if i == len(fw):
+            self._emit(node, out, now)
+            return
+        w = fw[i]
+        if w == T.HASH:
+            # '#' matches the parent level and everything below — but a
+            # root wildcard must not expose '$'-topics (MQTT 4.7.2)
+            self._emit(node, out, now)
+            stack = [
+                c for name, c in node.children.items()
+                if not (first_level and name.startswith("$"))
+            ]
+            while stack:
+                n = stack.pop()
+                self._emit(n, out, now)
+                stack.extend(n.children.values())
+            return
+        if w == T.PLUS:
+            for name, child in node.children.items():
+                if first_level and name.startswith("$"):
+                    continue
+                self._walk(child, fw, i + 1, False, out, now)
+        else:
+            child = node.children.get(w)
+            if child is not None:
+                self._walk(child, fw, i + 1, False, out, now)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def sweep(self, now: Optional[int] = None) -> int:
+        """Periodic clear of expired entries (emqx_retainer clear timer)."""
+        now = now_ms() if now is None else now
+        removed = 0
+        with self._lock:
+            victims = []
+            walk = [(self._root, [])]
+            while walk:
+                node, path = walk.pop()
+                if node.msg is not None and self._expired(node, now):
+                    victims.append(T.join(path))
+                for w, c in node.children.items():
+                    walk.append((c, path + [w]))
+            for topic in victims:
+                if self.delete(topic):
+                    removed += 1
+        return removed
+
+    def topics(self) -> list[str]:
+        with self._lock:
+            out = []
+            walk = [(self._root, [])]
+            while walk:
+                node, path = walk.pop()
+                if node.msg is not None:
+                    out.append(T.join(path))
+                for w, c in node.children.items():
+                    walk.append((c, path + [w]))
+            return out

@@ -301,3 +301,38 @@ def test_dequeued_packet_unmounted():
     ch.handle_deliver([("m/t", Message(topic="m/t", qos=1, payload=b"2"))])
     nxt = ch.handle_in(P.PubAck(packet_id=first[0].packet_id))
     assert nxt[0].topic == "t"               # unmounted on dequeue too
+
+
+def test_will_delay_cancelled_by_resume():
+    from emqx_tpu.core.message import now_ms
+    h = Harness()
+    watcher, _ = h.connect("w9")
+    watcher.handle_in(P.Subscribe(packet_id=1, topic_filters=[("will/d", {"qos": 0})]))
+    ch, _ = h.connect("dev9", clean_start=False, proto=P.MQTT_V5,
+                      properties={"Session-Expiry-Interval": 600},
+                      will_flag=True, will_topic="will/d", will_payload=b"late",
+                      will_props={"Will-Delay-Interval": 30})
+    ch.terminate("socket_error")
+    assert ch.pending_will_at is not None
+    assert watcher.outbox == []                  # withheld
+    # resume before the delay fires → will cancelled
+    ch2, _ = h.connect("dev9", clean_start=False, proto=P.MQTT_V5,
+                       properties={"Session-Expiry-Interval": 600})
+    assert ch.pending_will_at is None and ch.will is None
+    ch.will_tick(now=now_ms() + 60_000)
+    assert all(not isinstance(p, P.Publish) for p in watcher.outbox)
+
+
+def test_will_delay_fires_when_due():
+    from emqx_tpu.core.message import now_ms
+    h = Harness()
+    watcher, _ = h.connect("w8")
+    watcher.handle_in(P.Subscribe(packet_id=1, topic_filters=[("will/f", {"qos": 0})]))
+    ch, _ = h.connect("dev8", clean_start=False, proto=P.MQTT_V5,
+                      properties={"Session-Expiry-Interval": 600},
+                      will_flag=True, will_topic="will/f", will_payload=b"boom",
+                      will_props={"Will-Delay-Interval": 1})
+    ch.terminate("socket_error")
+    ch.will_tick(now=now_ms() + 2000)
+    pubs = [p for p in watcher.outbox if isinstance(p, P.Publish)]
+    assert [p.payload for p in pubs] == [b"boom"]

@@ -1,0 +1,250 @@
+"""Shared subs, retainer, delayed — mirrors emqx_shared_sub_SUITE,
+emqx_retainer_SUITE, emqx_delayed_SUITE."""
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.shared_sub import SharedSub
+from emqx_tpu.core.message import Message
+from emqx_tpu.services.delayed import Delayed, parse_delayed
+from emqx_tpu.services.retainer import Retainer
+
+
+def msg(topic="t", payload=b"x", qos=0, retain=False, **kw):
+    return Message(topic=topic, payload=payload, qos=qos,
+                   flags={"retain": retain}, **kw)
+
+
+# -- shared sub strategies --------------------------------------------------
+
+def members(n):
+    return [f"m{i}" for i in range(n)]
+
+
+def test_round_robin():
+    s = SharedSub(strategy="round_robin")
+    for m in members(3):
+        s.join("g", "t", m)
+    picks = [s.pick("g", "t", msg())[0] for _ in range(6)]
+    assert picks == ["m0", "m1", "m2", "m0", "m1", "m2"]
+
+
+def test_round_robin_per_group_shares_cursor_across_topics():
+    s = SharedSub(strategy="round_robin_per_group")
+    for m in members(2):
+        s.join("g", "t1", m)
+        s.join("g", "t2", m)
+    p1 = s.pick("g", "t1", msg())[0]
+    p2 = s.pick("g", "t2", msg())[0]
+    assert {p1, p2} == {"m0", "m1"}
+
+
+def test_sticky_until_leave():
+    s = SharedSub(strategy="sticky", seed=1)
+    for m in members(3):
+        s.join("g", "t", m)
+    first = s.pick("g", "t", msg())[0]
+    assert all(s.pick("g", "t", msg())[0] == first for _ in range(5))
+    s.leave("g", "t", first)
+    second = s.pick("g", "t", msg())[0]
+    assert second != first
+    assert all(s.pick("g", "t", msg())[0] == second for _ in range(5))
+
+
+def test_hash_strategies_are_deterministic():
+    for strat, key in [("hash_clientid", "from_"), ("hash_topic", "topic")]:
+        s = SharedSub(strategy=strat)
+        for m in members(4):
+            s.join("g", "t", m)
+        m1 = msg(topic="t", from_="alice")
+        assert len({s.pick("g", "t", m1)[0] for _ in range(8)}) == 1
+
+
+def test_local_prefers_local_node():
+    s = SharedSub(node="n1", strategy="local")
+    s.join("g", "t", "remote_m", node="n2")
+    s.join("g", "t", "local_m", node="n1")
+    assert all(s.pick("g", "t", msg())[0] == "local_m" for _ in range(5))
+    s.leave("g", "t", "local_m")
+    assert s.pick("g", "t", msg())[0] == "remote_m"
+
+
+def test_redispatch_on_nack():
+    s = SharedSub(strategy="round_robin")
+    for m in members(3):
+        s.join("g", "t", m)
+    alive = {"m2"}
+    got = s.dispatch("g", "t", msg(qos=1),
+                     deliver_fn=lambda sid: sid in alive)
+    assert got == [("m2", "$share/g/t")]
+    # nobody alive → no delivery (and no infinite loop)
+    assert s.dispatch("g", "t", msg(qos=1), deliver_fn=lambda s_: False) == []
+
+
+def test_member_down_cleans_all_groups():
+    s = SharedSub()
+    s.join("g1", "t", "m")
+    s.join("g2", "u", "m")
+    s.member_down("m")
+    assert s.pick("g1", "t", msg()) is None
+    assert s.pick("g2", "u", msg()) is None
+
+
+# -- retainer ---------------------------------------------------------------
+
+def test_retain_store_match_delete():
+    r = Retainer()
+    r.on_publish(msg("a/b", b"1", retain=True))
+    r.on_publish(msg("a/c", b"2", retain=True))
+    r.on_publish(msg("x", b"3", retain=True))
+    assert {m.payload for m in r.match("a/+")} == {b"1", b"2"}
+    assert [m.payload for m in r.match("#")] == [b"3", b"1", b"2"] or \
+           {m.payload for m in r.match("#")} == {b"1", b"2", b"3"}
+    assert r.match("a/b")[0].headers["retained"] is True
+    r.on_publish(msg("a/b", b"", retain=True))    # empty payload clears
+    assert r.match("a/b") == []
+    assert len(r) == 2
+
+
+def test_retained_overwrite_and_sys_hidden():
+    r = Retainer()
+    r.on_publish(msg("t", b"old", retain=True))
+    r.on_publish(msg("t", b"new", retain=True))
+    assert [m.payload for m in r.match("t")] == [b"new"]
+    assert len(r) == 1
+    r.on_publish(msg("$SYS/x", b"s", retain=True))
+    assert r.match("#") and all(m.topic != "$SYS/x" for m in r.match("#"))
+    assert [m.topic for m in r.match("$SYS/#")] == ["$SYS/x"]
+
+
+def test_retained_expiry():
+    r = Retainer(default_expiry_ms=1000)
+    r.store(msg("t", b"1", retain=True), now=0)
+    assert r.match("t", now=500)
+    assert r.match("t", now=1500) == []
+    assert len(r) == 0
+
+
+def test_retained_max_limit():
+    r = Retainer(max_retained=1)
+    assert r.store(msg("a", retain=True))
+    assert not r.store(msg("b", retain=True))
+    assert r.store(msg("a", b"upd", retain=True))   # overwrite always ok
+    assert r.dropped == 1
+
+
+# -- delayed ----------------------------------------------------------------
+
+def test_parse_delayed():
+    assert parse_delayed("$delayed/5/a/b") == (5, "a/b")
+    assert parse_delayed("a/b") is None
+    with pytest.raises(ValueError):
+        parse_delayed("$delayed/xx/a")
+    with pytest.raises(ValueError):
+        parse_delayed("$delayed/99999999999/a")
+
+
+def test_delayed_scheduler_order():
+    fired = []
+    d = Delayed(publish_fn=lambda m: fired.append(m.topic))
+    d.store(msg("$delayed/2/later"), 2, "later", now=0)
+    d.store(msg("$delayed/1/sooner"), 1, "sooner", now=0)
+    assert d.tick(now=500) == 0
+    assert d.tick(now=1500) == 1 and fired == ["sooner"]
+    assert d.tick(now=2500) == 1 and fired == ["sooner", "later"]
+
+
+# -- app wiring -------------------------------------------------------------
+
+def test_app_delayed_intercepts_publish():
+    app = BrokerApp()
+    app.broker.subscribe("s1", "real/t")
+    deliveries = app.broker.publish(msg("$delayed/1/real/t", b"soon"))
+    assert deliveries == {}                 # intercepted, not routed
+    assert len(app.delayed) == 1
+    fired = []
+    app.cm.dispatch = lambda d: fired.append(d)
+    app.delayed.tick(now=app.delayed.next_due() + 1)
+    assert fired and "s1" in fired[0]
+
+
+def test_app_retained_on_subscribe():
+    app = BrokerApp()
+    app.broker.publish(msg("news/today", b"headline", retain=True))
+    got = []
+    app.cm.dispatch = lambda d: got.append(d)
+    app.broker.subscribe("reader", "news/+")
+    assert got and got[0]["reader"][0][1].payload == b"headline"
+    # rh=2 suppresses retained dispatch
+    got.clear()
+    from emqx_tpu.core.message import SubOpts
+    app.broker.subscribe("reader2", "news/+", SubOpts(rh=2))
+    assert got == []
+
+
+def test_app_shared_group_end_to_end():
+    app = BrokerApp(shared_strategy="round_robin")
+    app.broker.subscribe("w1", "$share/g/jobs")
+    app.broker.subscribe("w2", "$share/g/jobs")
+    sids = []
+    for _ in range(4):
+        d = app.broker.publish(msg("jobs", b"j"))
+        assert len(d) == 1
+        sids.append(next(iter(d)))
+    assert set(sids) == {"w1", "w2"}
+    # member down → remaining member gets everything
+    app.broker.subscriber_down("w1")
+    app.hooks.run("session.terminated", ("w1", "down"))
+    d = app.broker.publish(msg("jobs", b"j"))
+    assert set(d) == {"w2"}
+
+
+def test_malformed_delayed_topic_dropped_not_crash():
+    app = BrokerApp()
+    app.broker.subscribe("s1", "#")
+    assert app.broker.publish(msg("$delayed/xx/t")) == {}
+    assert app.broker.publish(msg("$delayed/99999999999/t")) == {}
+    assert app.delayed.dropped == 2
+    assert len(app.delayed) == 0
+
+
+def test_rh1_no_retained_on_resubscribe():
+    from emqx_tpu.core.message import SubOpts
+    app = BrokerApp()
+    app.broker.publish(msg("n/t", b"r", retain=True))
+    got = []
+    app.cm.dispatch = lambda d: got.append(d)
+    app.broker.subscribe("c", "n/+", SubOpts(rh=1))
+    assert len(got) == 1                 # new subscription → retained sent
+    app.broker.subscribe("c", "n/+", SubOpts(rh=1))
+    assert len(got) == 1                 # resubscribe → suppressed
+    app.broker.subscribe("c", "n/+", SubOpts(rh=0))
+    assert len(got) == 2                 # rh=0 always sends
+
+
+def test_shared_group_two_filters_both_dispatch():
+    app = BrokerApp()
+    app.broker.subscribe("w1", "$share/g/a/+")
+    app.broker.subscribe("w2", "$share/g/a/b")
+    d = app.broker.publish(msg("a/b"))
+    # both (group, filter) routes dispatch: w1 via 'a/+', w2 via 'a/b'
+    assert set(d) == {"w1", "w2"}
+
+
+def test_hash_strategy_deterministic_across_instances():
+    import zlib
+    s1 = SharedSub(strategy="hash_clientid")
+    s2 = SharedSub(strategy="hash_clientid")
+    for s in (s1, s2):
+        for m in members(5):
+            s.join("g", "t", m)
+    m1 = msg(from_="publisher-x")
+    assert s1.pick("g", "t", m1) == s2.pick("g", "t", m1)
+
+
+def test_retainer_lazy_expiry_prunes_nodes():
+    r = Retainer(default_expiry_ms=10)
+    r.store(msg("deep/a/b/c", b"1", retain=True), now=0)
+    assert r.match("deep/#", now=100) == []
+    assert len(r) == 0
+    assert r._root.children == {}        # branches pruned, not leaked

@@ -1,0 +1,80 @@
+"""Password hashing — parity with
+``apps/emqx_authn/src/emqx_authn_password_hashing.erl``.
+
+Simple algorithms (plain/md5/sha/sha256/sha512 with salt position
+prefix|suffix|disable) plus pbkdf2. bcrypt is delegated to the optional
+``bcrypt`` wheel when present (the reference uses a C NIF); absent that,
+creating bcrypt credentials raises — verification of foreign hashes is
+then unavailable, mirroring how the reference gates the NIF.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+
+try:  # optional accelerator, like the reference's bcrypt NIF
+    import bcrypt as _bcrypt  # type: ignore
+except Exception:  # pragma: no cover
+    _bcrypt = None
+
+_SIMPLE = {"plain", "md5", "sha", "sha256", "sha512"}
+_DIGEST = {"md5": "md5", "sha": "sha1", "sha256": "sha256",
+           "sha512": "sha512"}
+
+
+@dataclass(frozen=True)
+class HashSpec:
+    name: str = "sha256"             # plain|md5|sha|sha256|sha512|pbkdf2|bcrypt
+    salt_position: str = "prefix"    # prefix|suffix|disable (simple algos)
+    mac_fun: str = "sha256"          # pbkdf2 PRF
+    iterations: int = 4096           # pbkdf2
+    dk_length: int = 32              # pbkdf2 derived-key bytes
+    salt_rounds: int = 10            # bcrypt cost
+
+
+def gen_salt(spec: HashSpec) -> bytes:
+    if spec.name == "bcrypt":
+        if _bcrypt is None:
+            raise RuntimeError("bcrypt not available in this build")
+        return _bcrypt.gensalt(rounds=spec.salt_rounds)
+    if spec.name == "plain" or spec.salt_position == "disable":
+        return b""
+    return os.urandom(16).hex().encode()
+
+
+def hash_password(spec: HashSpec, salt: bytes, password: bytes) -> bytes:
+    if spec.name == "plain":
+        return password
+    if spec.name == "pbkdf2":
+        return hashlib.pbkdf2_hmac(
+            spec.mac_fun, password, salt, spec.iterations, spec.dk_length
+        ).hex().encode()
+    if spec.name == "bcrypt":
+        if _bcrypt is None:
+            raise RuntimeError("bcrypt not available in this build")
+        return _bcrypt.hashpw(password, salt)
+    if spec.name in _SIMPLE:
+        if spec.salt_position == "prefix":
+            data = salt + password
+        elif spec.salt_position == "suffix":
+            data = password + salt
+        else:
+            data = password
+        return hashlib.new(_DIGEST[spec.name], data).hexdigest().encode()
+    raise ValueError(f"unknown hash algorithm {spec.name!r}")
+
+
+def check_password(
+    spec: HashSpec, salt: bytes, stored: bytes, password: bytes
+) -> bool:
+    if spec.name == "bcrypt":
+        if _bcrypt is None:
+            return False
+        try:
+            return _bcrypt.checkpw(password, stored)
+        except ValueError:
+            return False
+    return hmac.compare_digest(hash_password(spec, salt, password), stored)

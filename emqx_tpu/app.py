@@ -31,8 +31,17 @@ class BrokerApp:
         retained_expiry_ms: int = 0,
         router_model=None,
         forward_fn=None,
+        access_control=None,
     ):
         self.hooks = Hooks()
+        # security layer (emqx_access_control): banned/authn/authz hooks.
+        # Default-constructed = anonymous allow-all, as an unconfigured
+        # reference broker behaves.
+        if access_control is None:
+            from emqx_tpu.access.control import AccessControl
+            access_control = AccessControl()
+        self.access = access_control
+        self.access.attach(self.hooks)
         self.cm = CM()
         self.shared = SharedSub(node=node, strategy=shared_strategy)
         self.broker = Broker(
@@ -110,6 +119,12 @@ class BrokerApp:
 
     def tick(self) -> None:
         self.delayed.tick()
+        self.access.banned.expire()
+        if self.access.flapping is not None:
+            self.access.flapping.gc()
+        for p in self.access.authn.providers:
+            if hasattr(p, "gc"):
+                p.gc()
         # delayed wills of disconnected-but-registered channels
         for _cid, ch in self.cm.all_channels():
             if getattr(ch, "pending_will_at", None) is not None:

@@ -274,7 +274,8 @@ class Channel:
         # authorize (client.authorize hook fold: allow | deny)
         verdict = self.hooks.run_fold(
             "client.authorize",
-            (dict(clientid=self.clientid, username=self.conninfo.username),
+            (dict(clientid=self.clientid, username=self.conninfo.username,
+                  peername=self.conninfo.peername),
              "publish", mounted),
             "allow",
         )
@@ -386,7 +387,8 @@ class Channel:
             verdict = self.hooks.run_fold(
                 "client.authorize",
                 (dict(clientid=self.clientid,
-                      username=self.conninfo.username),
+                      username=self.conninfo.username,
+                      peername=self.conninfo.peername),
                  "subscribe", mounted_real),
                 "allow",
             )

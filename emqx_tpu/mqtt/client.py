@@ -55,6 +55,10 @@ class MqttClient:
         ))
         pkt = await self._expect(P.CONNACK, timeout)
         self.connack = pkt
+        if pkt.reason_code != P.RC_SUCCESS:
+            await self.close()
+            raise ConnectionRefusedError(
+                f"CONNACK reason_code=0x{pkt.reason_code:02x}")
         return pkt
 
     async def _send(self, pkt: P.Packet) -> None:

@@ -1,0 +1,325 @@
+"""Security layer tests: hashing, authn chain, authz sources, banned,
+flapping, and end-to-end channel integration (the reference covers the
+same ground in emqx_authn/emqx_authz suites + emqx_banned_SUITE)."""
+
+import time
+
+import pytest
+
+from emqx_tpu.access.authn import (
+    AuthnChain, BuiltinDbProvider, HttpProvider, JwtProvider,
+    ScramProvider, jwt_sign,
+)
+from emqx_tpu.access.authz import (
+    Authz, AuthzCache, BuiltinSource, ClientAclSource, FileSource,
+    HttpAclSource, Rule,
+)
+from emqx_tpu.access.banned import Banned
+from emqx_tpu.access.control import AccessControl
+from emqx_tpu.access.flapping import Flapping
+from emqx_tpu.access.hashing import (
+    HashSpec, check_password, gen_salt, hash_password,
+)
+
+
+# -- hashing ---------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["plain", "md5", "sha", "sha256", "sha512",
+                                  "pbkdf2"])
+def test_hash_roundtrip(name):
+    spec = HashSpec(name=name)
+    salt = gen_salt(spec)
+    stored = hash_password(spec, salt, b"s3cret")
+    assert check_password(spec, salt, stored, b"s3cret")
+    assert not check_password(spec, salt, stored, b"wrong")
+
+
+def test_salt_positions_differ():
+    pw = b"pw"
+    pre = HashSpec(name="sha256", salt_position="prefix")
+    suf = HashSpec(name="sha256", salt_position="suffix")
+    assert hash_password(pre, b"salt", pw) != hash_password(suf, b"salt", pw)
+
+
+# -- authn -----------------------------------------------------------------
+
+def test_empty_chain_is_anonymous_allow():
+    assert AuthnChain().authenticate({"username": "x"})[0] == "ok"
+
+
+def test_builtin_db_chain():
+    db = BuiltinDbProvider()
+    db.add_user("alice", "wonder", is_superuser=True)
+    chain = AuthnChain([db])
+    ok, extras = chain.authenticate(
+        {"username": "alice", "password": b"wonder"})
+    assert ok == "ok" and extras["is_superuser"]
+    assert chain.authenticate(
+        {"username": "alice", "password": b"nope"})[0] == "error"
+    # unknown user: provider ignores; all-ignored chain denies
+    assert chain.authenticate(
+        {"username": "bob", "password": b"x"})[0] == "error"
+
+
+def test_chain_fallthrough_order():
+    db1 = BuiltinDbProvider()
+    db2 = BuiltinDbProvider()
+    db2.add_user("carol", "pw")
+    chain = AuthnChain([db1, db2])
+    assert chain.authenticate(
+        {"username": "carol", "password": "pw"})[0] == "ok"
+
+
+def test_jwt_provider():
+    secret = b"topsecret"
+    p = JwtProvider(secret)
+    good = jwt_sign({"username": "dave", "exp": time.time() + 60,
+                     "is_superuser": True,
+                     "acl": {"pub": ["t/1"], "sub": ["t/#"]}}, secret)
+    ret = p.authenticate({"username": "dave", "password": good})
+    assert ret[0] == "ok"
+    assert ret[1]["is_superuser"] and "acl" in ret[1]
+    expired = jwt_sign({"exp": time.time() - 1}, secret)
+    assert p.authenticate({"password": expired}) == ("error", "token_expired")
+    forged = jwt_sign({"exp": time.time() + 60}, b"other")
+    assert p.authenticate({"password": forged})[1] == "bad_token_signature"
+    # non-JWT password → ignore so password providers can run after
+    assert p.authenticate({"password": b"plain-pw"}) == "ignore"
+
+
+def test_jwt_verify_claims_placeholder():
+    secret = b"s"
+    p = JwtProvider(secret, verify_claims={"sub": "${clientid}"})
+    tok = jwt_sign({"sub": "c1", "exp": time.time() + 60}, secret)
+    assert p.authenticate({"clientid": "c1", "password": tok})[0] == "ok"
+    assert p.authenticate(
+        {"clientid": "c2", "password": tok})[1] == "claim_mismatch"
+
+
+def test_http_provider():
+    calls = []
+
+    def fake(body):
+        calls.append(body)
+        if body["username"] == "ok":
+            return {"result": "allow", "is_superuser": True}
+        if body["username"] == "no":
+            return {"result": "deny"}
+        return {"result": "ignore"}
+
+    p = HttpProvider(fake)
+    assert p.authenticate({"username": "ok", "password": b"x"})[0] == "ok"
+    assert p.authenticate({"username": "no", "password": b"x"})[0] == "error"
+    assert p.authenticate({"username": "??", "password": b"x"}) == "ignore"
+    assert calls[0]["password"] == "x"
+
+
+def test_scram_full_exchange():
+    import base64
+    import hashlib
+    import hmac as hm
+
+    p = ScramProvider(iterations=256)
+    p.add_user("eve", "pw", is_superuser=True)
+    cnonce = b"abc123"
+    st, server_first = p.step("c1", b"n=eve,r=" + cnonce)
+    assert st == "continue"
+    fields = dict(kv.split(b"=", 1)
+                  for kv in server_first.split(b",") if b"=" in kv)
+    snonce, salt = fields[b"r"], base64.b64decode(fields[b"s"])
+    iters = int(fields[b"i"])
+    salted = hashlib.pbkdf2_hmac("sha256", b"pw", salt, iters)
+    ckey = hm.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored = hashlib.sha256(ckey).digest()
+    without_proof = b"c=biws,r=" + snonce
+    auth_msg = (b"n=eve,r=" + cnonce + b"," + server_first + b","
+                + without_proof)
+    sig = hm.new(stored, auth_msg, hashlib.sha256).digest()
+    proof = bytes(a ^ b for a, b in zip(ckey, sig))
+    final = without_proof + b",p=" + base64.b64encode(proof)
+    st, extras = p.step("c1", final)
+    assert st == "ok" and extras["is_superuser"]
+    assert extras["server_final"].startswith(b"v=")
+
+
+# -- authz -----------------------------------------------------------------
+
+def _ci(**kw):
+    return {"clientid": "c1", "username": "u1",
+            "peername": "10.1.2.3:5000", **kw}
+
+
+def test_file_source_rules():
+    src = FileSource.parse("""
+        # dashboard user may watch $SYS
+        allow  user=dashboard  subscribe  $SYS/#
+        deny   all             subscribe  $SYS/#
+        allow  clientid=c1     publish    t/${clientid}/up
+        allow  ipaddr=10.0.0.0/8  all     local/#
+        deny   all             all        #
+    """)
+    az = Authz([src], no_match="deny")
+    assert az.authorize(_ci(username="dashboard"), "subscribe",
+                        "$SYS/brokers") == "allow"
+    assert az.authorize(_ci(), "subscribe", "$SYS/brokers") == "deny"
+    assert az.authorize(_ci(), "publish", "t/c1/up") == "allow"
+    assert az.authorize(_ci(), "publish", "t/c2/up") == "deny"
+    assert az.authorize(_ci(), "subscribe", "local/x") == "allow"
+    assert az.authorize(
+        _ci(peername="192.168.0.9:1"), "subscribe", "local/x") == "deny"
+
+
+def test_eq_topic_pins_literal():
+    src = FileSource([Rule("allow", "all", "subscribe", ("eq t/+",))])
+    az = Authz([src], no_match="deny")
+    # 'eq' matches the literal '+' only, not the wildcard expansion
+    assert az.authorize(_ci(), "subscribe", "t/+") == "allow"
+    assert az.authorize(_ci(), "subscribe", "t/x") == "deny"
+
+
+def test_builtin_source_precedence_and_no_match():
+    src = BuiltinSource()
+    src.set_rules(("clientid", "c1"),
+                  [Rule("deny", "all", "publish", ("secret/#",))])
+    src.set_rules("all", [Rule("allow", "all", "all", ("#",))])
+    az = Authz([src], no_match="deny")
+    assert az.authorize(_ci(), "publish", "secret/x") == "deny"
+    assert az.authorize(_ci(), "publish", "open/x") == "allow"
+    assert Authz([], no_match="allow").authorize(_ci(), "publish", "a") \
+        == "allow"
+
+
+def test_superuser_bypasses_sources():
+    src = FileSource([Rule("deny", "all", "all", ("#",))])
+    az = Authz([src])
+    assert az.authorize(_ci(is_superuser=True), "publish", "x") == "allow"
+
+
+def test_client_acl_source():
+    src = ClientAclSource()
+    ci = _ci(acl={"pub": ["up/${clientid}"], "sub": ["down/#"]})
+    assert src.authorize(ci, "publish", "up/c1") == "allow"
+    assert src.authorize(ci, "subscribe", "down/a/b") == "allow"
+    assert src.authorize(ci, "publish", "other") == "deny"
+    assert src.authorize(_ci(), "publish", "x") == "ignore"
+
+
+def test_http_acl_source():
+    src = HttpAclSource(lambda req: {"result": "deny"}
+                        if req["topic"].startswith("adm/") else None)
+    assert src.authorize(_ci(), "publish", "adm/x") == "deny"
+    assert src.authorize(_ci(), "publish", "t/x") == "ignore"
+
+
+def test_authz_cache_lru_ttl():
+    c = AuthzCache(max_size=2, ttl_ms=10_000)
+    c.put("publish", "a", "allow")
+    c.put("publish", "b", "deny")
+    assert c.get("publish", "a") == "allow"
+    c.put("publish", "c", "allow")            # evicts LRU ("b")
+    assert c.get("publish", "b") is None
+    assert c.get("publish", "a") == "allow"
+    c._d[("publish", "a")] = ("allow", time.time() - 11)
+    assert c.get("publish", "a") is None      # TTL expired
+
+
+# -- banned / flapping -----------------------------------------------------
+
+def test_banned_check_and_expiry():
+    b = Banned()
+    b.create("clientid", "evil")
+    b.create("peerhost", "9.9.9.9", duration_s=0.01)
+    assert b.check({"clientid": "evil"})
+    assert b.check({"clientid": "x", "peername": "9.9.9.9:123"})
+    time.sleep(0.02)
+    assert not b.check({"clientid": "x", "peername": "9.9.9.9:123"})
+    assert b.check({"clientid": "evil"})      # no expiry → still banned
+    b.delete("clientid", "evil")
+    assert not b.check({"clientid": "evil"})
+
+
+def test_flapping_trips_ban():
+    b = Banned()
+    f = Flapping(b, max_count=3, window_s=10, ban_duration_s=100)
+    now = 1000.0
+    assert not f.on_disconnect("c1", now)
+    assert not f.on_disconnect("c1", now + 1)
+    assert f.on_disconnect("c1", now + 2)
+    assert b.check({"clientid": "c1"})
+    # outside the window events don't count
+    assert not f.on_disconnect("c2", now)
+    assert not f.on_disconnect("c2", now + 20)
+    assert not f.on_disconnect("c2", now + 40)
+
+
+# -- channel integration ---------------------------------------------------
+
+def _connect_app(app, clientid="c1", username=None, password=None):
+    from emqx_tpu.broker.channel import Channel, ConnInfo
+    from emqx_tpu.mqtt import packet as P
+
+    ch = Channel(app.broker, app.cm,
+                 conninfo=ConnInfo(peername="10.0.0.1:1234"))
+    out = ch.handle_in(P.Connect(
+        proto_ver=P.MQTT_V5, clientid=clientid, username=username,
+        password=password, clean_start=True))
+    return ch, out
+
+
+def test_channel_authn_authz_end_to_end():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.mqtt import packet as P
+
+    db = BuiltinDbProvider()
+    db.add_user("alice", "pw")
+    ac = AccessControl(
+        authn=AuthnChain([db]),
+        authz=Authz([FileSource.parse(
+            "allow all publish t/#\ndeny all all #")], no_match="deny"),
+    )
+    app = BrokerApp(access_control=ac)
+
+    # wrong password rejected at CONNECT
+    _, out = _connect_app(app, username="alice", password=b"bad")
+    assert out[0].reason_code == P.RC_BAD_USER_NAME_OR_PASSWORD
+
+    ch, out = _connect_app(app, username="alice", password=b"pw")
+    assert out[0].reason_code == P.RC_SUCCESS
+
+    # authz: publish t/1 allowed, subscribe denied by the catch-all
+    acks = ch.handle_in(P.Publish(topic="t/1", qos=1, packet_id=1,
+                                  payload=b"x"))
+    assert acks[0].reason_code == P.RC_SUCCESS
+    suback = ch.handle_in(P.Subscribe(packet_id=2,
+                                      topic_filters=[("t/#", {"qos": 0})]))
+    assert suback[0].reason_codes == [P.RC_NOT_AUTHORIZED]
+
+
+def test_channel_banned_at_connect():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.mqtt import packet as P
+
+    app = BrokerApp()
+    app.access.banned.create("clientid", "evil")
+    _, out = _connect_app(app, clientid="evil")
+    assert out[0].reason_code == P.RC_BANNED
+
+
+def test_jwt_acl_enforced_via_channel():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.mqtt import packet as P
+
+    secret = b"k"
+    ac = AccessControl(authn=AuthnChain([JwtProvider(secret)]),
+                       authz=Authz(no_match="deny"))
+    app = BrokerApp(access_control=ac)
+    tok = jwt_sign({"exp": time.time() + 60,
+                    "acl": {"pub": ["up/${clientid}"]}}, secret)
+    ch, out = _connect_app(app, clientid="dev7", password=tok)
+    assert out[0].reason_code == P.RC_SUCCESS
+    ok = ch.handle_in(P.Publish(topic="up/dev7", qos=1, packet_id=1,
+                                payload=b""))
+    assert ok[0].reason_code == P.RC_SUCCESS
+    bad = ch.handle_in(P.Publish(topic="up/dev8", qos=1, packet_id=2,
+                                 payload=b""))
+    assert bad[0].reason_code == P.RC_NOT_AUTHORIZED

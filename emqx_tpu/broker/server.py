@@ -43,6 +43,7 @@ class Connection:
         self.writer = writer
         peer = writer.get_extra_info("peername") or ("?", 0)
         self.parser = Parser(max_size=server.max_packet_size)
+        self.limiter = server.make_limiter_container()
         self.channel = Channel(
             server.broker, server.cm,
             mountpoint=server.mountpoint,
@@ -66,7 +67,12 @@ class Connection:
                 data = await self.reader.read(READ_CHUNK)
                 if not data:
                     break
+                # bytes_in limit: pause the socket until tokens free up
+                # (the esockd-htb backpressure, emqx_connection.erl:528-535)
+                await self._limit("bytes_in", len(data))
                 for pkt in self.parser.feed(data):
+                    if pkt.type == P.PUBLISH:
+                        await self._limit("message_in", 1)
                     if pkt.type == P.CONNECT:
                         self.parser.set_version(pkt.proto_ver)
                         self.channel.conninfo.proto_ver = pkt.proto_ver
@@ -86,6 +92,13 @@ class Connection:
             pass
         finally:
             await self.close("sock_closed")
+
+    async def _limit(self, type_: str, n: float) -> None:
+        while not self.closed:
+            ok, retry = self.limiter.check(type_, n)
+            if ok:
+                return
+            await asyncio.sleep(min(max(retry, 0.005), 1.0))
 
     async def _drain(self) -> None:
         try:
@@ -125,6 +138,8 @@ class BrokerServer:
         max_connections: int = 1_000_000,
         mountpoint: str = "",
         app=None,
+        limiter=None,
+        listener_id: str = "tcp:default",
     ):
         if app is None and broker is None:
             from emqx_tpu.app import BrokerApp
@@ -138,14 +153,28 @@ class BrokerServer:
         self.max_connections = max_connections
         self.mountpoint = mountpoint
         self.connections: set[Connection] = set()
+        self.limiter = limiter          # LimiterServer | None
+        self.listener_id = listener_id
         self._server: Optional[asyncio.AbstractServer] = None
         self._housekeeper: Optional[asyncio.Task] = None
+
+    def make_limiter_container(self):
+        from emqx_tpu.broker.limiter import LimiterContainer
+
+        if self.limiter is None:
+            return LimiterContainer()
+        return self.limiter.make_container(self.listener_id)
 
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         if len(self.connections) >= self.max_connections:
             writer.close()          # esockd max-conn limiting
             return
+        if self.limiter is not None:
+            ok, _retry = self.limiter.connect(self.listener_id)
+            if not ok:
+                writer.close()      # conn-rate limit: refuse at accept
+                return
         conn = Connection(self, reader, writer)
         self.connections.add(conn)
         await conn.run()

@@ -33,7 +33,15 @@ class BrokerApp:
         forward_fn=None,
         access_control=None,
     ):
+        from emqx_tpu.observe.alarm import AlarmManager
+        from emqx_tpu.observe.metrics import Metrics
+        from emqx_tpu.observe.stats import Stats
+        from emqx_tpu.observe.sys import SysHeartbeat
+
         self.hooks = Hooks()
+        self.metrics = Metrics()
+        self.stats = Stats()
+        self.alarms = AlarmManager(on_change=self._on_alarm)
         # security layer (emqx_access_control): banned/authn/authz hooks.
         # Default-constructed = anonymous allow-all, as an unconfigured
         # reference broker behaves.
@@ -50,6 +58,11 @@ class BrokerApp:
             router_model=router_model,
             forward_fn=forward_fn,
             shared_dispatch=self._shared_dispatch,
+            metrics=self.metrics,
+        )
+        self.sys = SysHeartbeat(
+            node=node, publish_fn=self._publish_dispatch,
+            metrics=self.metrics, stats=self.stats,
         )
         self.retainer = Retainer(
             max_retained=max_retained, default_expiry_ms=retained_expiry_ms
@@ -64,6 +77,77 @@ class BrokerApp:
         self.hooks.add("session.unsubscribed", self._shared_on_unsubscribe)
         self.hooks.add("session.terminated", self._shared_on_terminated)
         self.hooks.add("session.discarded", self._shared_on_terminated)
+        self._wire_observability()
+
+    # -- observability -------------------------------------------------------
+
+    def _wire_observability(self) -> None:
+        m, hooks = self.metrics, self.hooks
+        hooks.add("client.connected",
+                  lambda ci: m.inc("client.connected"), priority=-1000)
+        hooks.add("client.disconnected",
+                  lambda ci, reason: m.inc("client.disconnected"),
+                  priority=-1000)
+        hooks.add("client.connack",
+                  lambda ci, rc: m.inc("client.connack"), priority=-1000)
+        hooks.add("message.delivered",
+                  lambda cid, topic: m.inc("messages.delivered"),
+                  priority=-1000)
+        hooks.add("message.acked",
+                  lambda cid, pid: m.inc("messages.acked"), priority=-1000)
+        for ev in ("created", "resumed", "takenover", "discarded",
+                   "terminated"):
+            hooks.add(f"session.{ev}",
+                      (lambda ev: lambda *a: m.inc(f"session.{ev}"))(ev),
+                      priority=-1000)
+        s, cm, broker = self.stats, self.cm, self.broker
+        s.set_updater("connections.count",
+                      lambda: sum(1 for _ in cm.all_channels()),
+                      "connections.max")
+        s.set_updater(
+            "live_connections.count",
+            lambda: sum(1 for _, ch in cm.all_channels()
+                        if getattr(ch, "conn_state", "") == "connected"),
+            "live_connections.max")
+        s.set_updater("sessions.count",
+                      lambda: sum(1 for _ in cm.all_channels()),
+                      "sessions.max")
+        s.set_updater("topics.count",
+                      lambda: len(broker.router.topics()), "topics.max")
+        s.set_updater("subscribers.count",
+                      lambda: sum(len(v) for v in broker.subscriber.values()),
+                      "subscribers.max")
+        s.set_updater("subscriptions.count",
+                      lambda: len(broker.suboption), "subscriptions.max")
+        s.set_updater("suboptions.count", lambda: len(broker.suboption),
+                      "suboptions.max")
+        s.set_updater("subscriptions.shared.count",
+                      lambda: sum(1 for (_, t) in broker.suboption
+                                  if T.parse_share(t)[0]),
+                      "subscriptions.shared.max")
+        s.set_updater("retained.count", lambda: len(self.retainer),
+                      "retained.max")
+        s.set_updater("delayed.count", lambda: len(self.delayed),
+                      "delayed.max")
+
+    def _on_alarm(self, event: str, alarm) -> None:
+        """$SYS alarm notification (emqx_alarm publishes to
+        $SYS/brokers/<node>/alarms/activate|deactivate)."""
+        import json as _json
+
+        self._publish_dispatch(Message(
+            topic=f"$SYS/brokers/{self.broker.node}/alarms/{event}",
+            payload=_json.dumps(
+                {"name": alarm.name, "message": alarm.message}).encode(),
+            qos=0, from_="$SYS", flags={"sys": True},
+        ))
+
+    def prometheus(self) -> str:
+        from emqx_tpu.observe import prometheus
+
+        self.stats.tick()
+        return prometheus.render(self.metrics, self.stats,
+                                 node=self.broker.node)
 
     # -- delayed -----------------------------------------------------------
 
@@ -119,6 +203,8 @@ class BrokerApp:
 
     def tick(self) -> None:
         self.delayed.tick()
+        self.stats.tick()
+        self.sys.tick()
         self.access.banned.expire()
         if self.access.flapping is not None:
             self.access.flapping.gc()

@@ -89,6 +89,7 @@ class Broker:
         router_model=None,       # emqx_tpu.models.RouterModel (device path)
         forward_fn=None,         # fn(node, delivery) for remote routes
         shared_dispatch=None,    # fn(group, topic, msg) -> [(sid, sub_topic)]
+        metrics=None,            # observe.metrics.Metrics (shared node-wide)
     ) -> None:
         self.node = node
         self.hooks = hooks or Hooks()
@@ -101,10 +102,13 @@ class Broker:
         self.suboption: dict[tuple[Sid, str], SubOpts] = {}
         self.subscription: dict[Sid, set[str]] = {}
         self.subscriber: dict[str, set[Sid]] = {}
-        self.metrics: dict[str, int] = {}
+        if metrics is None:
+            from emqx_tpu.observe.metrics import Metrics
+            metrics = Metrics()
+        self.metrics = metrics
 
     def _inc(self, key: str, n: int = 1) -> None:
-        self.metrics[key] = self.metrics.get(key, 0) + n
+        self.metrics.inc(key, n)
 
     # -- subscribe / unsubscribe (emqx_broker.erl:134-173) ------------------
 

@@ -50,6 +50,7 @@ class Connection:
             send=self._send_packets,
         )
         self.channel.conninfo.peername = f"{peer[0]}:{peer[1]}"
+        self.metrics = getattr(server.app, "metrics", None)
         self.closed = False
 
     def _send_packets(self, pkts) -> None:
@@ -60,6 +61,13 @@ class Connection:
         )
         if data:
             self.writer.write(data)
+            if self.metrics is not None:
+                self.metrics.inc("bytes.sent", len(data))
+                for p in pkts:
+                    self.metrics.inc_sent_packet(
+                        P.TYPE_NAMES.get(p.type, "reserved").lower())
+                    if p.type == P.PUBLISH:
+                        self.metrics.inc_msg("sent", p.qos)
 
     async def run(self) -> None:
         try:
@@ -70,9 +78,16 @@ class Connection:
                 # bytes_in limit: pause the socket until tokens free up
                 # (the esockd-htb backpressure, emqx_connection.erl:528-535)
                 await self._limit("bytes_in", len(data))
+                if self.metrics is not None:
+                    self.metrics.inc("bytes.received", len(data))
                 for pkt in self.parser.feed(data):
                     if pkt.type == P.PUBLISH:
                         await self._limit("message_in", 1)
+                    if self.metrics is not None:
+                        self.metrics.inc_recv_packet(
+                            P.TYPE_NAMES.get(pkt.type, "reserved").lower())
+                        if pkt.type == P.PUBLISH:
+                            self.metrics.inc_msg("received", pkt.qos)
                     if pkt.type == P.CONNECT:
                         self.parser.set_version(pkt.proto_ver)
                         self.channel.conninfo.proto_ver = pkt.proto_ver

@@ -1,0 +1,2 @@
+"""Observability (SURVEY.md §1 L12): counters, gauges, alarms,
+$SYS heartbeats, Prometheus exposition, slow-subscriber tracking."""

@@ -1,0 +1,174 @@
+"""Fixed-slot metric counters — parity with
+``apps/emqx/src/emqx_metrics.erl``.
+
+The reference allocates one BEAM ``counters`` array (C, per-scheduler
+striped) at boot with a fixed name→index map kept in ``persistent_term``
+(emqx_metrics.erl:338-384,541-542). Here: one numpy int64 array + a
+frozen name→slot dict built at construction; ``inc`` is a single
+in-place array add under the GIL. Dynamic late registration appends to a
+spillover dict (the reference forbids it; we allow it for rule/bridge
+metrics which the reference hosts in emqx_metrics_worker instead — see
+``MetricsWorker`` below).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+# emqx_metrics.hrl name set (bytes/packets/messages/delivery/client/
+# session/authz slices), trimmed of reserved-for-future slots
+BYTES = ["bytes.received", "bytes.sent"]
+PACKETS = [
+    "packets.received", "packets.sent",
+    "packets.connect.received", "packets.connack.sent",
+    "packets.publish.received", "packets.publish.sent",
+    "packets.publish.error", "packets.publish.auth_error",
+    "packets.publish.dropped",
+    "packets.puback.received", "packets.puback.sent",
+    "packets.puback.missed",
+    "packets.pubrec.received", "packets.pubrec.sent",
+    "packets.pubrec.missed",
+    "packets.pubrel.received", "packets.pubrel.sent",
+    "packets.pubrel.missed",
+    "packets.pubcomp.received", "packets.pubcomp.sent",
+    "packets.pubcomp.missed",
+    "packets.subscribe.received", "packets.suback.sent",
+    "packets.subscribe.error", "packets.subscribe.auth_error",
+    "packets.unsubscribe.received", "packets.unsuback.sent",
+    "packets.unsubscribe.error",
+    "packets.pingreq.received", "packets.pingresp.sent",
+    "packets.disconnect.received", "packets.disconnect.sent",
+    "packets.auth.received", "packets.auth.sent",
+    "packets.connect.error", "packets.connect.auth_error",
+]
+MESSAGES = [
+    "messages.received", "messages.sent",
+    "messages.qos0.received", "messages.qos0.sent",
+    "messages.qos1.received", "messages.qos1.sent",
+    "messages.qos2.received", "messages.qos2.sent",
+    "messages.publish", "messages.dropped",
+    "messages.dropped.await_pubrel_timeout", "messages.dropped.no_subscribers",
+    "messages.forward", "messages.retained", "messages.delayed",
+    "messages.delivered", "messages.acked",
+]
+DELIVERY = [
+    "delivery.dropped", "delivery.dropped.no_local",
+    "delivery.dropped.too_large", "delivery.dropped.qos0_msg",
+    "delivery.dropped.queue_full", "delivery.dropped.expired",
+]
+CLIENT = [
+    "client.connect", "client.connack", "client.connected",
+    "client.authenticate", "client.auth.anonymous", "client.authorize",
+    "client.subscribe", "client.unsubscribe", "client.disconnected",
+]
+SESSION = [
+    "session.created", "session.resumed", "session.takenover",
+    "session.discarded", "session.terminated",
+]
+AUTHZ = ["authorization.allow", "authorization.deny",
+         "authorization.cache_hit", "authorization.cache_miss"]
+OLP = ["olp.delay.ok", "olp.delay.timeout", "olp.hbn", "olp.gc",
+       "olp.new_conn"]
+
+ALL_NAMES: list[str] = (BYTES + PACKETS + MESSAGES + DELIVERY + CLIENT
+                        + SESSION + AUTHZ + OLP)
+
+
+class Metrics:
+    def __init__(self, names: Optional[Iterable[str]] = None) -> None:
+        names = list(names) if names is not None else list(ALL_NAMES)
+        self._idx: dict[str, int] = {n: i for i, n in enumerate(names)}
+        self._c = np.zeros(len(names), dtype=np.int64)
+        self._dyn: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        i = self._idx.get(name)
+        if i is not None:
+            self._c[i] += n
+            return
+        with self._lock:
+            self._dyn[name] = self._dyn.get(name, 0) + n
+
+    def val(self, name: str) -> int:
+        i = self._idx.get(name)
+        if i is not None:
+            return int(self._c[i])
+        return self._dyn.get(name, 0)
+
+    def all(self) -> dict[str, int]:
+        out = {n: int(self._c[i]) for n, i in self._idx.items()}
+        out.update(self._dyn)
+        return out
+
+    def reset(self) -> None:
+        self._c[:] = 0
+        with self._lock:
+            self._dyn.clear()
+
+    # -- convenience used by the packet host --------------------------------
+
+    def inc_recv_packet(self, type_name: str) -> None:
+        self.inc("packets.received")
+        self.inc(f"packets.{type_name}.received")
+
+    def inc_sent_packet(self, type_name: str) -> None:
+        self.inc("packets.sent")
+        self.inc(f"packets.{type_name}.sent")
+
+    def inc_msg(self, direction: str, qos: int) -> None:
+        self.inc(f"messages.{direction}")
+        if qos in (0, 1, 2):
+            self.inc(f"messages.qos{qos}.{direction}")
+
+
+class MetricsWorker:
+    """Per-resource dynamic counters + EWMA rates — parity with
+    ``apps/emqx/src/emqx_metrics_worker.erl`` (rule-engine / bridge
+    metrics). Each (id, name) holds a counter and a 5s-EWMA rate."""
+
+    TAU = 5.0
+
+    def __init__(self) -> None:
+        self._c: dict[str, dict[str, int]] = {}
+        self._rate: dict[str, dict[str, tuple[float, float, int]]] = {}
+        # rate entry: (ewma_per_s, last_ts, last_count)
+
+    def create_metrics(self, id_: str,
+                       names: Iterable[str] = ()) -> None:
+        self._c.setdefault(id_, {n: 0 for n in names})
+        self._rate.setdefault(id_, {})
+
+    def clear_metrics(self, id_: str) -> None:
+        self._c.pop(id_, None)
+        self._rate.pop(id_, None)
+
+    def inc(self, id_: str, name: str, n: int = 1) -> None:
+        d = self._c.setdefault(id_, {})
+        d[name] = d.get(name, 0) + n
+
+    def get(self, id_: str, name: str) -> int:
+        return self._c.get(id_, {}).get(name, 0)
+
+    def get_counters(self, id_: str) -> dict[str, int]:
+        return dict(self._c.get(id_, {}))
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Advance EWMA rates (the reference's per-second timer)."""
+        now = time.time() if now is None else now
+        for id_, counters in self._c.items():
+            rates = self._rate.setdefault(id_, {})
+            for name, count in counters.items():
+                ewma, last_ts, last_count = rates.get(
+                    name, (0.0, now, count))
+                dt = max(now - last_ts, 1e-9)
+                inst = (count - last_count) / dt
+                alpha = 1.0 - pow(2.718281828, -dt / self.TAU)
+                rates[name] = (ewma + alpha * (inst - ewma), now, count)
+
+    def get_rate(self, id_: str, name: str) -> float:
+        return self._rate.get(id_, {}).get(name, (0.0, 0.0, 0))[0]

@@ -1,0 +1,50 @@
+"""Prometheus text exposition — parity with
+``apps/emqx_prometheus/src/emqx_prometheus.erl``.
+
+Renders the metric counters, stat gauges, and VM/process figures into
+the text 0.0.4 format the scrape endpoint serves. Metric names map
+``a.b.c`` → ``emqx_a_b_c`` as the reference's collector does.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+
+def _san(name: str) -> str:
+    return "emqx_" + name.replace(".", "_")
+
+
+def render(metrics=None, stats=None, extra: Optional[dict] = None,
+           node: str = "emqx_tpu") -> str:
+    lines: list[str] = []
+    label = f'{{node="{node}"}}'
+    if metrics is not None:
+        for name, val in sorted(metrics.all().items()):
+            mn = _san(name)
+            lines.append(f"# TYPE {mn} counter")
+            lines.append(f"{mn}{label} {val}")
+    if stats is not None:
+        for name, val in sorted(stats.all().items()):
+            mn = _san(name)
+            lines.append(f"# TYPE {mn} gauge")
+            lines.append(f"{mn}{label} {val}")
+    # VM slice (the reference exports erlang_vm_*; we export process RSS)
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        lines.append("# TYPE emqx_vm_memory_bytes gauge")
+        lines.append(
+            f"emqx_vm_memory_bytes{label} "
+            f"{rss_pages * os.sysconf('SC_PAGE_SIZE')}")
+    except OSError:
+        pass
+    if extra:
+        for name, val in sorted(extra.items()):
+            mn = _san(name)
+            lines.append(f"# TYPE {mn} gauge")
+            lines.append(f"{mn}{label} {val}")
+    lines.append(f"# EOF scraped_at={int(time.time())}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,73 @@
+"""$SYS heartbeat topics — parity with ``apps/emqx/src/emqx_sys.erl``.
+
+Publishes retained broker liveness under ``$SYS/brokers[/<node>/...]``
+(version/uptime/datetime/sysdescr, emqx_sys.erl:80-120) on a heartbeat
+interval, plus stats and metrics trees on a (slower) tick. $SYS messages
+are produced broker-internally and routed like any publish — wildcard
+root filters never see them ($SYS exclusion in the trie matcher).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from emqx_tpu.core.message import Message
+
+VERSION = "0.1.0"
+SYSDESCR = "emqx_tpu broker"
+
+
+class SysHeartbeat:
+    def __init__(self, node: str, publish_fn: Callable[[Message], None],
+                 metrics=None, stats=None,
+                 heartbeat_s: float = 30.0, tick_s: float = 60.0) -> None:
+        self.node = node
+        self.publish_fn = publish_fn
+        self.metrics = metrics
+        self.stats = stats
+        self.heartbeat_s = heartbeat_s
+        self.tick_s = tick_s
+        self.started_at = time.time()
+        self._last_heartbeat = 0.0
+        self._last_tick = 0.0
+
+    def uptime_s(self) -> float:
+        return time.time() - self.started_at
+
+    def _pub(self, subtopic: str, payload: str) -> None:
+        self.publish_fn(Message(
+            topic=f"$SYS/brokers/{self.node}/{subtopic}",
+            payload=payload.encode(), qos=0, from_="$SYS",
+            flags={"retain": True, "sys": True},
+        ))
+
+    def heartbeat(self) -> None:
+        self.publish_fn(Message(
+            topic="$SYS/brokers", payload=self.node.encode(), qos=0,
+            from_="$SYS", flags={"retain": True, "sys": True}))
+        self._pub("version", VERSION)
+        self._pub("sysdescr", SYSDESCR)
+        self._pub("uptime", str(int(self.uptime_s())))
+        self._pub("datetime",
+                  time.strftime("%Y-%m-%dT%H:%M:%S%z", time.localtime()))
+
+    def publish_stats(self) -> None:
+        if self.stats is not None:
+            for name, val in self.stats.all().items():
+                self._pub(f"stats/{name}", str(val))
+
+    def publish_metrics(self) -> None:
+        if self.metrics is not None:
+            for name, val in self.metrics.all().items():
+                self._pub(f"metrics/{name}", str(val))
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        if now - self._last_heartbeat >= self.heartbeat_s:
+            self._last_heartbeat = now
+            self.heartbeat()
+        if now - self._last_tick >= self.tick_s:
+            self._last_tick = now
+            self.publish_stats()
+            self.publish_metrics()

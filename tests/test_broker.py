@@ -73,7 +73,7 @@ def test_subscribe_publish_deliver():
     d = b.publish(msg("t/1"))
     assert set(d) == {"s1", "s2"}
     assert d["s1"] == [("t/+", d["s1"][0][1])]
-    assert b.metrics["messages.delivered"] == 2
+    assert b.metrics.val("messages.delivered") == 2
 
 
 def test_unsubscribe_and_subscriber_down():
@@ -103,7 +103,7 @@ def test_publish_hook_can_rewrite_and_drop():
         priority=99,
     )
     assert b.publish(msg("t")) == {}
-    assert b.metrics["messages.dropped"] == 1
+    assert b.metrics.val("messages.dropped") == 1
 
 
 def test_remote_route_forwarding():

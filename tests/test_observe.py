@@ -1,0 +1,183 @@
+"""Observability tests: metrics counters, metrics-worker rates, stats
+gauges/updaters, alarms, $SYS heartbeats, prometheus rendering, and
+live-broker counter integration."""
+
+import asyncio
+
+from emqx_tpu.observe import prometheus
+from emqx_tpu.observe.alarm import AlarmManager
+from emqx_tpu.observe.metrics import Metrics, MetricsWorker
+from emqx_tpu.observe.stats import Stats
+
+
+def test_metrics_fixed_and_dynamic():
+    m = Metrics()
+    m.inc("messages.publish")
+    m.inc("messages.publish", 4)
+    assert m.val("messages.publish") == 5
+    m.inc("rules.my_rule.matched")          # dynamic spillover
+    assert m.val("rules.my_rule.matched") == 1
+    assert m.all()["messages.publish"] == 5
+    m.reset()
+    assert m.val("messages.publish") == 0
+    assert m.val("rules.my_rule.matched") == 0
+
+
+def test_metrics_packet_helpers():
+    m = Metrics()
+    m.inc_recv_packet("connect")
+    m.inc_sent_packet("connack")
+    m.inc_msg("received", 1)
+    assert m.val("packets.received") == 1
+    assert m.val("packets.connect.received") == 1
+    assert m.val("packets.connack.sent") == 1
+    assert m.val("messages.qos1.received") == 1
+
+
+def test_metrics_worker_counters_and_rates():
+    w = MetricsWorker()
+    w.create_metrics("rule:1", ["matched", "failed"])
+    for _ in range(10):
+        w.inc("rule:1", "matched")
+    assert w.get("rule:1", "matched") == 10
+    assert w.get_counters("rule:1") == {"matched": 10, "failed": 0}
+    t = 100.0
+    w.tick(t)
+    for _ in range(50):
+        w.inc("rule:1", "matched")
+    w.tick(t + 5.0)                          # 10/s instantaneous
+    assert w.get_rate("rule:1", "matched") > 3.0
+    w.clear_metrics("rule:1")
+    assert w.get("rule:1", "matched") == 0
+
+
+def test_stats_setstat_and_watermark():
+    s = Stats()
+    s.setstat("connections.count", 5, "connections.max")
+    s.setstat("connections.count", 3, "connections.max")
+    assert s.getstat("connections.count") == 3
+    assert s.getstat("connections.max") == 5
+
+
+def test_stats_updaters():
+    s = Stats()
+    n = {"v": 7}
+    s.set_updater("topics.count", lambda: n["v"], "topics.max")
+    s.tick()
+    assert s.getstat("topics.count") == 7
+    n["v"] = 3
+    s.tick()
+    assert s.getstat("topics.count") == 3
+    assert s.getstat("topics.max") == 7
+
+
+def test_alarm_lifecycle_and_history():
+    events = []
+    a = AlarmManager(history_size=2,
+                     on_change=lambda ev, al: events.append((ev, al.name)))
+    assert a.activate("high_cpu", {"usage": 99}, "cpu high")
+    assert not a.activate("high_cpu")        # already active
+    assert a.is_active("high_cpu")
+    assert a.deactivate("high_cpu")
+    assert not a.deactivate("high_cpu")
+    assert [e[0] for e in events] == ["activated", "deactivated"]
+    for i in range(4):
+        a.activate(f"al{i}")
+        a.deactivate(f"al{i}")
+    assert len(a.get_alarms("deactivated")) == 2       # bounded history
+    a.ensure("mem", True)
+    a.ensure("mem", True)                    # idempotent
+    assert len(a.get_alarms("activated")) == 1
+    a.delete_all_deactivated()
+    assert a.get_alarms("deactivated") == []
+
+
+def test_sys_heartbeat_publishes_retained():
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    msgs = []
+    sys_hb = SysHeartbeat("n1", msgs.append, heartbeat_s=30)
+    sys_hb.heartbeat()
+    topics = [m.topic for m in msgs]
+    assert "$SYS/brokers" in topics
+    assert "$SYS/brokers/n1/version" in topics
+    assert "$SYS/brokers/n1/uptime" in topics
+    assert all(m.retain for m in msgs)
+    # tick twice in the same window: only one heartbeat
+    msgs.clear()
+    sys_hb.tick(1000.0)
+    sys_hb.tick(1001.0)
+    assert len([m for m in msgs if m.topic.endswith("version")]) == 1
+
+
+def test_prometheus_render():
+    m = Metrics()
+    m.inc("messages.publish", 42)
+    s = Stats()
+    s.setstat("connections.count", 3)
+    text = prometheus.render(m, s, node="n1")
+    assert 'emqx_messages_publish{node="n1"} 42' in text
+    assert 'emqx_connections_count{node="n1"} 3' in text
+    assert "# TYPE emqx_messages_publish counter" in text
+    assert "# TYPE emqx_connections_count gauge" in text
+
+
+def test_live_broker_metrics_and_stats():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.mqtt.client import MqttClient
+
+    async def main():
+        app = BrokerApp()
+        srv = BrokerServer(app=app, port=0)
+        await srv.start()
+        try:
+            c = MqttClient(port=srv.port, clientid="obs1")
+            await c.connect()
+            await c.subscribe("t/#", qos=1)
+            await c.publish("t/1", b"x", qos=1)
+            await c.recv()
+            m = app.metrics
+            assert m.val("packets.connect.received") == 1
+            assert m.val("packets.connack.sent") == 1
+            assert m.val("packets.publish.received") == 1
+            assert m.val("packets.publish.sent") >= 1
+            assert m.val("messages.qos1.received") == 1
+            assert m.val("client.connected") == 1
+            assert m.val("bytes.received") > 0
+            app.stats.tick()
+            assert app.stats.getstat("connections.count") == 1
+            assert app.stats.getstat("subscriptions.count") == 1
+            text = app.prometheus()
+            assert "emqx_packets_connect_received" in text
+            await c.disconnect()
+            await c.close()
+            await asyncio.sleep(0.05)
+            assert m.val("client.disconnected") == 1
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_sys_messages_reach_subscribers_via_broker():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.mqtt import packet as P
+
+    app = BrokerApp()
+    ch = Channel(app.broker, app.cm)
+    ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="sysw"))
+    ch.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("$SYS/brokers/#", {"qos": 0})]))
+    ch.outbox.clear()
+    app.sys.heartbeat()
+    got = [p.topic for p in ch.outbox if isinstance(p, P.Publish)]
+    assert any(t.startswith("$SYS/brokers/") for t in got)
+    # root wildcard must NOT see $SYS
+    ch2 = Channel(app.broker, app.cm)
+    ch2.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="rootw"))
+    ch2.handle_in(P.Subscribe(packet_id=1, topic_filters=[("#", {"qos": 0})]))
+    ch2.outbox.clear()
+    app.sys.heartbeat()
+    assert not [p for p in ch2.outbox if isinstance(p, P.Publish)]

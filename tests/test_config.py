@@ -1,0 +1,223 @@
+"""Config system tests: HOCON parsing, schema checking, layering,
+update handlers, zones (reference ground: emqx_config_SUITE,
+emqx_schema_tests, hocon's own suite)."""
+
+import pytest
+
+from emqx_tpu.config import hocon
+from emqx_tpu.config.config import Config, ConfigError
+from emqx_tpu.config.hocon import ByteSize, Duration, HoconError
+from emqx_tpu.config.schema import Field, SchemaError, Struct, root_schema
+
+
+# -- hocon -----------------------------------------------------------------
+
+def test_hocon_scalars_and_nesting():
+    doc = hocon.loads("""
+    # comment
+    node {
+      name = "emqx@host"        // inline comment
+      cookie = secret
+    }
+    mqtt.max_packet_size = 1MB
+    mqtt.retry_interval = 30s
+    mqtt.keepalive_backoff = 0.75
+    listeners.tcp.default { bind = "0.0.0.0:1883", enabled = true }
+    tags = [a, b, "c d"]
+    ratio = 80%
+    empty = null
+    """)
+    assert doc["node"]["name"] == "emqx@host"
+    assert doc["node"]["cookie"] == "secret"
+    assert doc["mqtt"]["max_packet_size"] == 1024 * 1024
+    assert isinstance(doc["mqtt"]["max_packet_size"], ByteSize)
+    assert doc["mqtt"]["retry_interval"] == 30.0
+    assert isinstance(doc["mqtt"]["retry_interval"], Duration)
+    assert doc["mqtt"]["keepalive_backoff"] == 0.75
+    assert doc["listeners"]["tcp"]["default"]["enabled"] is True
+    assert doc["tags"] == ["a", "b", "c d"]
+    assert doc["ratio"] == 0.8
+    assert doc["empty"] is None
+
+
+def test_hocon_object_merge_and_substitution():
+    doc = hocon.loads("""
+    a { x = 1 }
+    a { y = 2 }
+    a.z = ${a.x}
+    arr = [{n = 1}, {n = 2}]
+    """)
+    assert doc["a"] == {"x": 1, "y": 2, "z": 1}
+    assert doc["arr"][1]["n"] == 2
+
+
+def test_hocon_durations():
+    doc = hocon.loads("a=100ms\nb=5m\nc=2h\nd=1d")
+    assert doc["a"] == pytest.approx(0.1)
+    assert doc["b"] == 300.0
+    assert doc["c"] == 7200.0
+    assert doc["d"] == 86400.0
+
+
+def test_hocon_errors():
+    with pytest.raises(HoconError):
+        hocon.loads("a = ")
+    with pytest.raises(HoconError):
+        hocon.loads('a = "unterminated')
+    with pytest.raises(HoconError):
+        hocon.loads("a = ${nope}")
+
+
+# -- schema ----------------------------------------------------------------
+
+def test_schema_defaults_and_check():
+    conf = root_schema().check({})
+    assert conf["mqtt"]["max_inflight"] == 32
+    assert conf["mqtt"]["session_expiry_interval"] == 7200.0
+    assert conf["authorization"]["no_match"] == "allow"
+    assert conf["shared_subscription_strategy"] == "round_robin"
+
+
+def test_schema_rejects_unknown_and_bad_types():
+    with pytest.raises(SchemaError, match="unknown config key"):
+        root_schema().check({"mqtt": {"max_inflightt": 1}})
+    with pytest.raises(SchemaError, match="expected int"):
+        root_schema().check({"mqtt": {"max_inflight": "many"}})
+    with pytest.raises(SchemaError, match="one of"):
+        root_schema().check({"log": {"level": "loud"}})
+    with pytest.raises(SchemaError, match="validation failed"):
+        root_schema().check({"mqtt": {"max_qos_allowed": 3}})
+
+
+def test_schema_array_items_and_open_structs():
+    s = Struct({"xs": Field("array", default=[], item=Field("int"))})
+    assert s.check({"xs": [1, 2]})["xs"] == [1, 2]
+    with pytest.raises(SchemaError):
+        s.check({"xs": [1, "two"]})
+    listeners = root_schema().check(
+        {"listeners": {"tcp": {"default": {"bind": "x", "extra": 1}}}})
+    assert listeners["listeners"]["tcp"]["default"]["extra"] == 1
+
+
+def test_schema_to_doc():
+    doc = root_schema().to_doc()
+    assert doc["fields"]["mqtt"]["fields"]["max_inflight"]["default"] == 32
+
+
+# -- layered store ---------------------------------------------------------
+
+def test_config_layering_order():
+    c = Config()
+    c.init_load("mqtt.max_inflight = 10",
+                cluster_override={"mqtt": {"max_inflight": 20}},
+                local_override={"mqtt": {"max_inflight": 30}})
+    assert c.get("mqtt.max_inflight") == 30
+    c2 = Config()
+    c2.init_load("mqtt.max_inflight = 10",
+                 cluster_override={"mqtt": {"max_inflight": 20}})
+    assert c2.get("mqtt.max_inflight") == 20
+
+
+def test_config_put_recheck_and_rollback():
+    c = Config()
+    c.init_load("")
+    c.put("mqtt.max_inflight", 64)
+    assert c.get("mqtt.max_inflight") == 64
+    with pytest.raises(SchemaError):
+        c.put("mqtt.max_inflight", "lots")
+    assert c.get("mqtt.max_inflight") == 64        # rolled back
+    cluster, _local = c.overrides()
+    assert cluster == {"mqtt": {"max_inflight": 64}}
+
+
+def test_config_update_handler_and_listener():
+    c = Config()
+    c.init_load("")
+    seen = []
+
+    def clamp(path, val, old_root):
+        if val > 1000:
+            raise ConfigError("too big")
+        return val
+
+    c.add_handler("mqtt.max_inflight", clamp)
+    c.add_listener(lambda p, v: seen.append((".".join(p), v)))
+    c.put("mqtt.max_inflight", 100)
+    assert c.get("mqtt.max_inflight") == 100
+    with pytest.raises(ConfigError):
+        c.put("mqtt.max_inflight", 5000)
+    assert c.get("mqtt.max_inflight") == 100
+    assert seen == [("mqtt.max_inflight", 100)]
+    # deepest-prefix handler also fires for nested paths
+    c.add_handler("retainer", lambda p, v, old: v)
+    c.put("retainer.enable", False)
+    assert c.get("retainer.enable") is False
+
+
+def test_zone_conf_fallback():
+    c = Config()
+    c.init_load("""
+    mqtt.max_inflight = 32
+    zones.iot.max_inflight = 4
+    """)
+    assert c.get_zone_conf("iot", "max_inflight") == 4
+    assert c.get_zone_conf("iot", "max_mqueue_len") == 1000   # global
+    assert c.get_zone_conf("other", "max_inflight") == 32
+
+
+def test_get_raw_vs_checked():
+    c = Config()
+    c.init_load("mqtt.retry_interval = 10s")
+    assert c.get("mqtt.retry_interval") == 10.0
+    assert c.get("mqtt.max_inflight") == 32       # default filled
+    assert c.get_raw("mqtt.max_inflight") is None  # raw has no default
+
+
+# -- app boot from config --------------------------------------------------
+
+def test_broker_app_from_config_end_to_end():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.mqtt import packet as P
+
+    c = Config()
+    c.init_load("""
+    node.name = "tpu1@127.0.0.1"
+    shared_subscription_strategy = sticky
+    retainer.max_retained_messages = 100
+    authorization {
+      no_match = deny
+      sources = [
+        {type = file, rules = "allow all all t/#"}
+      ]
+    }
+    authentication = [
+      {mechanism = password_based, backend = built_in_database,
+       bootstrap_users = [{user_id = "u1", password = "pw"}]}
+    ]
+    flapping_detect { enable = true, max_count = 3 }
+    """)
+    app = BrokerApp.from_config(c)
+    assert app.broker.node == "tpu1"
+    assert app.shared.strategy == "sticky"
+    assert app.retainer.max_retained == 100
+    assert app.access.flapping is not None
+
+    ch = Channel(app.broker, app.cm)
+    out = ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="c1",
+                                 username="u1", password=b"pw"))
+    assert out[0].reason_code == P.RC_SUCCESS
+    bad = Channel(app.broker, app.cm)
+    out = bad.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="c2",
+                                  username="u1", password=b"wrong"))
+    assert out[0].reason_code == P.RC_BAD_USER_NAME_OR_PASSWORD
+    # authz from config: t/# allowed, others denied (no_match=deny)
+    acks = ch.handle_in(P.Publish(topic="t/1", qos=1, packet_id=1,
+                                  payload=b"x"))
+    assert acks[0].reason_code == P.RC_SUCCESS
+    acks = ch.handle_in(P.Publish(topic="other", qos=1, packet_id=2,
+                                  payload=b"x"))
+    assert acks[0].reason_code == P.RC_NOT_AUTHORIZED
+    # live update: strategy swap applies without restart
+    c.put("shared_subscription_strategy", "random")
+    assert app.shared.strategy == "random"

@@ -39,6 +39,7 @@ class BrokerApp:
         from emqx_tpu.observe.sys import SysHeartbeat
 
         self.hooks = Hooks()
+        self._tickers: list = []
         self.metrics = Metrics()
         self.stats = Stats()
         self.alarms = AlarmManager(on_change=self._on_alarm)
@@ -270,18 +271,28 @@ class BrokerApp:
         self.shared.member_down(sid)
 
     def _shared_dispatch(self, group: str, topic: str, msg: Message):
-        def deliver_fn(sid: str) -> bool:
+        def deliver_fn(sid: str, node: str) -> bool:
             ch = self.cm.lookup_channel(sid)
             return ch is not None and ch.conn_state == "connected"
-        return self.shared.dispatch(group, topic, msg, deliver_fn=deliver_fn)
+        return [
+            (sid, sub_topic)
+            for sid, _node, sub_topic in self.shared.dispatch(
+                group, topic, msg, deliver_fn=deliver_fn)
+        ]
 
     # -- housekeeping (server timer) ----------------------------------------
+
+    def add_ticker(self, fn) -> None:
+        """Register extra housekeeping work (cluster heartbeat etc.)."""
+        self._tickers.append(fn)
 
     def tick(self) -> None:
         self.delayed.tick()
         self.stats.tick()
         self.sys.tick()
         self.access.banned.expire()
+        for fn in self._tickers:
+            fn()
         if self.access.flapping is not None:
             self.access.flapping.gc()
         for p in self.access.authn.providers:

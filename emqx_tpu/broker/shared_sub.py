@@ -66,23 +66,37 @@ class SharedSub:
             elif self._sticky.get(key) == entry:
                 self._sticky.pop(key, None)
 
-    def member_down(self, sid: str) -> None:
-        """Clean a dead subscriber out of every group, any node
-        (emqx_shared_sub.erl:456-519)."""
+    def _purge(self, dead) -> None:
+        """Drop members matching ``dead((sid, node))`` from every group."""
         with self._lock:
             for key in list(self._members):
                 members = self._members[key]
-                members[:] = [m for m in members if m[0] != sid]
+                members[:] = [m for m in members if not dead(m)]
                 if not members:
                     self._members.pop(key, None)
                     self._rr.pop(key, None)
                     self._sticky.pop(key, None)
-                elif (sticky := self._sticky.get(key)) and sticky[0] == sid:
+                elif (sticky := self._sticky.get(key)) and dead(sticky):
                     self._sticky.pop(key, None)
+
+    def member_down(self, sid: str) -> None:
+        """Clean a dead subscriber out of every group, any node
+        (emqx_shared_sub.erl:456-519)."""
+        self._purge(lambda m: m[0] == sid)
 
     def groups_for(self, topic: str) -> list[str]:
         with self._lock:
             return [g for (g, t) in self._members if t == topic]
+
+    def members(self) -> dict[tuple[str, str], list[tuple[str, str]]]:
+        """{(group, topic): [(sid, node)]} snapshot (cluster bootstrap)."""
+        with self._lock:
+            return {k: list(v) for k, v in self._members.items()}
+
+    def node_down(self, node: str) -> None:
+        """Purge every member hosted on a dead node
+        (emqx_shared_sub node-down sweep)."""
+        self._purge(lambda m: m[1] == node)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -126,21 +140,21 @@ class SharedSub:
             return self._rng.choice(members)   # random
 
     def dispatch(self, group: str, topic: str, msg: Message,
-                 deliver_fn=None) -> list[tuple[str, str]]:
-        """Broker-facing dispatch: pick a member; with ``deliver_fn`` (sid →
-        bool ack) retry un-acked members (QoS>0 redispatch semantics).
-        Returns [(sid, sub_topic)] that accepted the message."""
+                 deliver_fn=None) -> list[tuple[str, str, str]]:
+        """Broker-facing dispatch: pick a member; with ``deliver_fn``
+        ((sid, node) → bool ack) retry un-acked members (QoS>0 redispatch
+        semantics). Returns [(sid, node, sub_topic)] that accepted."""
         sub_topic = f"$share/{group}/{topic}"
         tried: set = set()
         while True:
             member = self.pick(group, topic, msg, exclude=tried)
             if member is None:
                 return []
-            sid = member[0]
+            sid, node = member
             if deliver_fn is None or msg.qos == 0:
-                return [(sid, sub_topic)]
-            if deliver_fn(sid):
-                return [(sid, sub_topic)]
+                return [(sid, node, sub_topic)]
+            if deliver_fn(sid, node):
+                return [(sid, node, sub_topic)]
             tried.add(member)
             if self.strategy == "sticky":
                 # nacked: unpin so the next pick rotates

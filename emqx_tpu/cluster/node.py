@@ -1,0 +1,493 @@
+"""Cluster node — binds a BrokerApp to the cluster planes.
+
+Responsibilities and their reference counterparts:
+
+- **route replication** (mria rlog, ``emqx_router.erl:78-92``): every
+  local route mutation appends to the Router's delta log; ``flush``
+  pushes per-peer delta streams (``rlog.apply_deltas``); a trimmed log or
+  fresh joiner triggers full ``rlog.bootstrap``. Each node thus holds a
+  full route-table replica and match stays node-local
+  (emqx_router.erl:148-153's design decision).
+- **message forwarding** (gen_rpc, ``emqx_broker.erl:302-324``): routes
+  whose dest is a peer node cast ``broker.dispatch`` on the peer's
+  ordered lane.
+- **shared subscriptions** (``emqx_shared_sub.erl``): membership
+  replicates via ``rlog.shared_delta`` into the node-aware member table;
+  the publishing node's strategy picks ONE member cluster-wide, remote
+  members get ``shared_sub.deliver``.
+- **clientid registry + takeover** (``emqx_cm_registry`` /
+  ``emqx_cm_proto_v1``): connects broadcast ``rlog.registry_delta``; a
+  resume finding the session on a peer calls ``cm.takeover``, which
+  serializes the session (subscriptions + pending queue) and tears down
+  the old owner — the 2-phase takeover of emqx_cm.erl:377-429.
+- **failure detection** (``emqx_router_helper``): missed heartbeats mark
+  a peer down; its routes, shared members and registry entries purge; a
+  succeeding ping re-bootstraps both sides (ekka autoheal analogue).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.cluster import bpapi, codec
+from emqx_tpu.cluster.transport import Transport, TransportError
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import Message
+from emqx_tpu.session.session import Session
+
+
+class ClusterNode:
+    def __init__(self, name: str, transport: Transport,
+                 app: Optional[BrokerApp] = None,
+                 heartbeat_misses: int = 2, **app_kw: Any) -> None:
+        self.name = name
+        self.transport = transport
+        self.app = app or BrokerApp(node=name, forward_fn=self._forward,
+                                    **app_kw)
+        if self.app.broker.forward_fn is None:
+            self.app.broker.forward_fn = self._forward
+        self.app.broker.shared_dispatch = self._shared_dispatch
+        self.registry: dict[str, str] = {}        # clientid → node
+        self.members: dict[str, dict] = {}        # peer → {alive, missed}
+        self._peer_cursor: dict[str, int] = {}    # peer → flushed seq
+        self.heartbeat_misses = heartbeat_misses
+        self._lock = threading.RLock()
+
+        t = self.transport
+        t.register("broker.dispatch", self._h_dispatch)
+        t.register("shared_sub.deliver", self._h_shared_deliver)
+        t.register("cm.takeover", self._h_takeover)
+        t.register("cm.kick", self._h_kick)
+        t.register("cm.lookup", self._h_lookup)
+        t.register("rlog.apply_deltas", self._h_apply_deltas)
+        t.register("rlog.bootstrap", self._h_bootstrap)
+        t.register("rlog.shared_delta", self._h_shared_delta)
+        t.register("rlog.registry_delta", self._h_registry_delta)
+        t.register("node.hello", self._h_hello)
+        t.register("node.ping", self._h_ping)
+        t.register("node.bye", self._h_bye)
+
+        hooks = self.app.hooks
+        hooks.add("session.subscribed", self._on_subscribed, priority=-500)
+        hooks.add("session.unsubscribed", self._on_unsubscribed,
+                  priority=-500)
+        hooks.add("client.connected", self._on_client_connected,
+                  priority=-500)
+        hooks.add("session.terminated", self._on_session_gone,
+                  priority=-500)
+        hooks.add("session.discarded", self._on_session_gone,
+                  priority=-500)
+        # cross-node session lookup/takeover seam
+        self._orig_open_session = self.app.cm.open_session
+        self.app.cm.open_session = self._open_session
+        self.app.add_ticker(self.tick)   # heartbeat on app housekeeping
+
+    # -- membership ---------------------------------------------------------
+
+    def join(self, seeds: list[str]) -> None:
+        """Static-seed discovery (ekka join): hello each seed, learn the
+        full membership, bootstrap state from the first live seed."""
+        for seed in seeds:
+            if seed == self.name:
+                continue
+            try:
+                resp = self.transport.call(
+                    seed, "node.hello", node=self.name,
+                    versions=bpapi.supported_versions())
+            except TransportError:
+                continue
+            bpapi.negotiate(resp["versions"], "rlog")    # compat gate
+            self._mark_alive(seed)
+            # learned members start UNVERIFIED (alive only on direct
+            # contact — a dead peer in the seed's list must not receive
+            # deltas that vanish silently)
+            others = [m for m in resp.get("members", [])
+                      if m not in (self.name, seed)]
+            with self._lock:
+                for other in others:
+                    self.members.setdefault(
+                        other, {"alive": False, "missed": 0})
+            # announce ourselves; a successful hello IS the verification
+            for other in others:
+                try:
+                    self.transport.call(
+                        other, "node.hello", node=self.name,
+                        versions=bpapi.supported_versions())
+                    self._mark_alive(other)
+                except TransportError:
+                    pass
+            self._bootstrap_from(seed)
+            return
+        # no live seed: boot as a single-node cluster (first core)
+
+    def leave(self) -> None:
+        for peer in self.alive_peers():
+            try:
+                self.transport.cast(peer, "node.bye", node=self.name)
+            except TransportError:
+                pass
+
+    def alive_peers(self) -> list[str]:
+        with self._lock:
+            return [n for n, m in self.members.items() if m.get("alive")]
+
+    def _mark_alive(self, node: str) -> None:
+        with self._lock:
+            was_down = (node in self.members
+                        and not self.members[node]["alive"])
+            self.members[node] = {"alive": True, "missed": 0}
+            if was_down:
+                self._peer_cursor[node] = 0      # full re-flush of ours
+        if was_down:
+            # healed partition: pull the peer's state; the peer pulls
+            # ours when its own ping sees us (ekka autoheal, both sides
+            # resync). RPC happens OUTSIDE the lock: the peer's handler
+            # takes its own lock and may call back into us.
+            try:
+                self._bootstrap_from(node)
+                self.flush()
+            except TransportError:
+                with self._lock:
+                    self.members[node] = {"alive": False, "missed": 99}
+
+    def _nodedown(self, node: str) -> None:
+        """Purge everything owned by a dead peer
+        (emqx_router_helper:cleanup_routes + shared/registry sweeps)."""
+        with self._lock:
+            self.members[node] = {"alive": False, "missed": 99}
+            dead_cids = [c for c, n in self.registry.items() if n == node]
+            for cid in dead_cids:
+                del self.registry[cid]
+        self._drop_peer_routes(node)
+        self.app.shared.node_down(node)
+
+    def tick(self) -> None:
+        """Heartbeat + route flush (housekeeping timer)."""
+        self.flush()
+        with self._lock:
+            peers = list(self.members)
+        for peer in peers:
+            try:
+                self.transport.call(peer, "node.ping", node=self.name)
+                self._mark_alive(peer)
+            except TransportError:
+                with self._lock:
+                    m = self.members.get(peer)
+                    if m is None:
+                        continue
+                    m["missed"] = m.get("missed", 0) + 1
+                    down_now = (m["alive"]
+                                and m["missed"] >= self.heartbeat_misses)
+                if down_now:
+                    self._nodedown(peer)
+
+    # -- route replication --------------------------------------------------
+
+    def _own_deltas(self, deltas) -> list[dict]:
+        mine = []
+        for d in deltas:
+            dest = d.dest
+            if dest == self.name or (
+                    isinstance(dest, tuple) and dest[1] == self.name):
+                mine.append({"op": d.op, "topic": d.topic, "dest": dest})
+        return mine
+
+    def flush(self) -> None:
+        """Push pending route deltas to every live peer. Replication is
+        a confirmed ``call`` (mria transactions are acked) — the cursor
+        only advances on success, so a dropped frame is retransmitted
+        next flush; the message-forwarding lane stays fire-and-forget."""
+        router = self.app.broker.router
+        head = router.seq
+        for peer in self.alive_peers():
+            with self._lock:
+                cursor = self._peer_cursor.get(peer, 0)
+            if cursor >= head:
+                continue
+            deltas = router.deltas_since(cursor)
+            try:
+                if deltas is None:
+                    # our log no longer reaches the peer's cursor: the
+                    # peer re-pulls a full snapshot (replicant bootstrap)
+                    self.transport.call(peer, "rlog.apply_deltas",
+                                        from_node=self.name, deltas=None)
+                else:
+                    mine = self._own_deltas(deltas)
+                    if mine:
+                        self.transport.call(peer, "rlog.apply_deltas",
+                                            from_node=self.name,
+                                            deltas=mine)
+                with self._lock:
+                    self._peer_cursor[peer] = max(
+                        self._peer_cursor.get(peer, 0), head)
+            except TransportError:
+                pass                              # retried next flush
+
+    def _h_apply_deltas(self, from_node: str,
+                        deltas: Optional[list]) -> None:
+        router = self.app.broker.router
+        if deltas is None:                        # sender asks us to re-pull
+            self._drop_peer_routes(from_node)
+            self._bootstrap_from(from_node)
+            return
+        for d in deltas:
+            if d["op"] == "add":
+                router.add_route(d["topic"], d["dest"])
+            else:
+                router.delete_route(d["topic"], d["dest"])
+
+    def _drop_peer_routes(self, node: str) -> None:
+        router = self.app.broker.router
+        router.cleanup_dest(node)
+        for t in list(router.topics()):
+            for r in router.lookup_routes(t):
+                if isinstance(r.dest, tuple) and r.dest[1] == node:
+                    router.delete_route(t, r.dest)
+
+    def _snapshot(self) -> dict:
+        """Everything a joiner needs: all routes we know (ours + third
+        party), shared membership, clientid registry."""
+        router = self.app.broker.router
+        routes = []
+        for t in router.topics():
+            for r in router.lookup_routes(t):
+                routes.append({"topic": t, "dest": r.dest})
+        shared = [
+            {"group": g, "topic": tp, "sid": sid, "node": node}
+            for (g, tp), ms in self.app.shared.members().items()
+            for sid, node in ms
+        ]
+        with self._lock:
+            registry = dict(self.registry)
+        return {"routes": routes, "shared": shared,
+                "registry": registry, "node": self.name}
+
+    def _apply_snapshot(self, snap: dict) -> None:
+        router = self.app.broker.router
+        for r in snap["routes"]:
+            dest = r["dest"]
+            if dest != self.name and not (
+                    isinstance(dest, tuple) and dest[1] == self.name):
+                router.add_route(r["topic"], dest)
+        for s in snap["shared"]:
+            if s["node"] != self.name:
+                self.app.shared.join(s["group"], s["topic"], s["sid"],
+                                     node=s["node"])
+        with self._lock:
+            for cid, node in snap["registry"].items():
+                if node != self.name:
+                    self.registry[cid] = node
+
+    def _bootstrap_from(self, peer: str) -> None:
+        snap = self.transport.call(peer, "rlog.bootstrap",
+                                   from_node=self.name)
+        self._apply_snapshot(snap)
+        self._peer_cursor.setdefault(peer, 0)
+
+    def _h_bootstrap(self, from_node: str) -> dict:
+        if from_node not in self.members:
+            self._mark_alive(from_node)
+        return self._snapshot()
+
+    # -- forwarding (gen_rpc lane) ------------------------------------------
+
+    def _forward(self, dest: str, filt: str, msg: Message) -> None:
+        with self._lock:
+            alive = self.members.get(dest, {}).get("alive", False)
+        if not alive:
+            return                    # stale route; purge is in flight
+        try:
+            # the broker's _route counts messages.forward for this leg
+            self.transport.cast(dest, "broker.dispatch", filter=filt,
+                                msg=codec.msg_to_dict(msg))
+        except TransportError:
+            pass
+
+    def _h_dispatch(self, filter: str, msg: dict) -> int:
+        """Remote leg of emqx_broker:dispatch/2 (emqx_broker.erl:326-337)."""
+        m = codec.msg_from_dict(msg)
+        deliveries: dict[str, list] = {}
+        self.app.broker._dispatch_local(filter, m, deliveries)
+        self.app.cm.dispatch(deliveries)
+        return len(deliveries)
+
+    # -- shared subscriptions -----------------------------------------------
+
+    def _shared_dispatch(self, group: str, topic: str, msg: Message):
+        def deliver_fn(sid: str, node: str) -> bool:
+            if node == self.name:
+                ch = self.app.cm.lookup_channel(sid)
+                return ch is not None and ch.conn_state == "connected"
+            return self.members.get(node, {}).get("alive", False)
+
+        local = []
+        for sid, node, sub_topic in self.app.shared.dispatch(
+                group, topic, msg, deliver_fn=deliver_fn):
+            if node == self.name:
+                local.append((sid, sub_topic))
+            else:
+                try:
+                    self.transport.cast(
+                        node, "shared_sub.deliver", sid=sid,
+                        sub_topic=sub_topic, msg=codec.msg_to_dict(msg))
+                except TransportError:
+                    pass
+        return local
+
+    def _h_shared_deliver(self, sid: str, sub_topic: str, msg: dict) -> None:
+        self.app.cm.dispatch(
+            {sid: [(sub_topic, codec.msg_from_dict(msg))]})
+
+    def _on_subscribed(self, sid: str, topic: str, opts,
+                       is_new: bool = True) -> None:
+        group, real = T.parse_share(topic)
+        if group and is_new:
+            self._broadcast("rlog.shared_delta", op="join", group=group,
+                            topic=real, sid=sid)
+        self.flush()
+
+    def _on_unsubscribed(self, sid: str, topic: str) -> None:
+        group, real = T.parse_share(topic)
+        if group:
+            self._broadcast("rlog.shared_delta", op="leave", group=group,
+                            topic=real, sid=sid)
+        self.flush()
+
+    def _h_shared_delta(self, from_node: str, op: str, group: str,
+                        topic: str, sid: str) -> None:
+        if op == "join":
+            self.app.shared.join(group, topic, sid, node=from_node)
+        elif op == "leave":
+            self.app.shared.leave(group, topic, sid, node=from_node)
+        else:                                     # "down": all groups
+            self.app.shared.member_down(sid)
+
+    # -- clientid registry + takeover ---------------------------------------
+
+    def _on_client_connected(self, ci) -> None:
+        cid = getattr(ci, "clientid", None)
+        if cid:
+            with self._lock:
+                self.registry[cid] = self.name
+            self._broadcast("rlog.registry_delta", op="register",
+                            clientid=cid)
+
+    def _on_session_gone(self, sid: str, *a) -> None:
+        with self._lock:
+            owned = self.registry.get(sid) == self.name
+            if owned:
+                del self.registry[sid]
+        if owned:
+            self._broadcast("rlog.registry_delta", op="unregister",
+                            clientid=sid)
+        # shared membership cleanup replicates as leaves via unsubscribe
+        # hooks; a crashed channel's members go with member_down locally
+        # and with registry_delta on peers
+        self._broadcast("rlog.shared_delta", op="down", group="",
+                        topic="", sid=sid)
+
+    def _h_registry_delta(self, from_node: str, op: str,
+                          clientid: str) -> None:
+        with self._lock:
+            if op == "register":
+                self.registry[clientid] = from_node
+            elif self.registry.get(clientid) == from_node:
+                del self.registry[clientid]
+
+    def _open_session(self, clean_start: bool, clientid: str,
+                      new_channel, session_opts: Optional[dict] = None):
+        """Cross-node open_session: consult the replicated registry; if
+        the session lives on a peer, kick (clean start) or take it over
+        (emqx_cm.erl:268-341 + cm_proto_v1)."""
+        local = self.app.cm.lookup_channel(clientid)
+        with self._lock:
+            owner = self.registry.get(clientid)
+            owner_alive = self.members.get(owner, {}).get("alive", False)
+        if (local is None and owner is not None and owner != self.name
+                and owner_alive):
+            if clean_start:
+                try:
+                    self.transport.call(owner, "cm.kick",
+                                        clientid=clientid)
+                except TransportError:
+                    pass
+                return self._orig_open_session(
+                    True, clientid, new_channel, session_opts)
+            try:
+                state = self.transport.call(owner, "cm.takeover",
+                                            clientid=clientid)
+            except TransportError:
+                state = None
+            if state is not None:
+                session = Session(clientid=clientid, clean_start=False,
+                                  **(session_opts or {}))
+                for t, o in state["subscriptions"].items():
+                    opts = codec.subopts_from_dict(o)
+                    session.subscribe(t, opts)
+                    self.app.broker.subscribe(clientid, t, opts)
+                pending = [codec.msg_from_dict(d)
+                           for d in state["pending"]]
+                self.app.cm.register_channel(clientid, new_channel)
+                return session, True, pending
+        return self._orig_open_session(clean_start, clientid, new_channel,
+                                       session_opts)
+
+    def _h_takeover(self, clientid: str) -> Optional[dict]:
+        ch = self.app.cm.lookup_channel(clientid)
+        if ch is None or ch.session is None:
+            return None
+        session, pending = ch.takeover()
+        subs = {t: codec.subopts_to_dict(o)
+                for t, o in session.subscriptions.items()}
+        # the old owner's broker footprint migrates with the session
+        self.app.broker.subscriber_down(clientid)
+        self.app.cm.unregister_channel(clientid)
+        with self._lock:
+            if self.registry.get(clientid) == self.name:
+                del self.registry[clientid]
+        self.flush()
+        return {"subscriptions": subs,
+                "pending": [codec.msg_to_dict(m) for m in pending]}
+
+    def _h_kick(self, clientid: str) -> bool:
+        return self.app.cm.kick(clientid)
+
+    def _h_lookup(self, clientid: str) -> bool:
+        return self.app.cm.lookup_channel(clientid) is not None
+
+    # -- hello/ping/bye -----------------------------------------------------
+
+    def _h_hello(self, node: str, versions: dict) -> dict:
+        bpapi.negotiate(versions, "rlog")
+        with self._lock:
+            members = list(self.members) + [self.name]
+        self._mark_alive(node)
+        return {"versions": bpapi.supported_versions(), "members": members}
+
+    def _h_ping(self, node: str) -> str:
+        with self._lock:
+            known_down = (node in self.members
+                          and not self.members[node]["alive"])
+            if node not in self.members:
+                self.members[node] = {"alive": True, "missed": 0}
+        if known_down:
+            self._mark_alive(node)
+        return "pong"
+
+    def _h_bye(self, node: str) -> None:
+        with self._lock:
+            known = node in self.members
+        if known:
+            self._nodedown(node)
+            with self._lock:
+                self.members.pop(node, None)
+
+    def _broadcast(self, method: str, **kwargs: Any) -> None:
+        for peer in self.alive_peers():
+            try:
+                self.transport.cast(peer, method,
+                                    from_node=self.name, **kwargs)
+            except TransportError:
+                pass

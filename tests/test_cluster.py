@@ -1,0 +1,307 @@
+"""Cluster plane tests — the multi-node scenarios the reference covers
+with peer BEAM nodes (emqx_shared_sub_SUITE cross-node dispatch,
+emqx_router_helper_SUITE purge-on-nodedown, takeover suites), run here
+on in-process nodes with the real replication/RPC/codec stack."""
+
+import pytest
+
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.cluster import bpapi, codec
+from emqx_tpu.cluster.harness import make_cluster, stop, sync
+from emqx_tpu.cluster.transport import LocalBus, TransportError
+from emqx_tpu.core.message import Message, SubOpts
+from emqx_tpu.mqtt import packet as P
+
+
+def connect(node, clientid, clean_start=True, proto=P.MQTT_V5, props=None):
+    ch = Channel(node.app.broker, node.app.cm)
+    out = ch.handle_in(P.Connect(proto_ver=proto, clientid=clientid,
+                                 clean_start=clean_start,
+                                 properties=props or {}))
+    assert out[0].reason_code == P.RC_SUCCESS, out[0]
+    return ch
+
+
+def publishes(ch):
+    return [p for p in ch.outbox if isinstance(p, P.Publish)]
+
+
+# -- codec -----------------------------------------------------------------
+
+def test_codec_roundtrip_bytes_tuples():
+    obj = {"dest": ("g", "node2"), "payload": b"\x00\xffbin",
+           "n": 3, "arr": [("a", 1), b"x"], "s": "txt"}
+    assert codec.decode(codec.encode(obj)) == obj
+
+
+def test_codec_message_roundtrip():
+    m = Message(topic="t/1", payload=b"\x01\x02", qos=2, from_="c9",
+                flags={"retain": True}, headers={"username": "u"})
+    m2 = codec.msg_from_dict(codec.decode(codec.encode(
+        codec.msg_to_dict(m))))
+    assert (m2.topic, m2.payload, m2.qos, m2.from_) == \
+        ("t/1", b"\x01\x02", 2, "c9")
+    assert m2.retain and m2.headers["username"] == "u"
+
+
+# -- bpapi -----------------------------------------------------------------
+
+def test_bpapi_snapshot_frozen():
+    """The BPAPI compatibility snapshot (emqx_bpapi_static_checks
+    analogue): changing any released proto signature fails this test —
+    add a new version instead."""
+    assert bpapi.snapshot() == {
+        "broker_v1": {"dispatch": ["filter", "msg"]},
+        "cm_v1": {"kick": ["clientid"], "lookup": ["clientid"],
+                  "takeover": ["clientid"]},
+        "node_v1": {"bye": ["node"], "hello": ["node", "versions"],
+                    "ping": ["node"]},
+        "rlog_v1": {"apply_deltas": ["from_node", "deltas"],
+                    "bootstrap": ["from_node"],
+                    "registry_delta": ["from_node", "op", "clientid"],
+                    "shared_delta": ["from_node", "op", "group", "topic",
+                                     "sid"]},
+        "shared_sub_v1": {"deliver": ["sid", "sub_topic", "msg"]},
+    }
+
+
+def test_bpapi_negotiate():
+    assert bpapi.negotiate({"rlog": [1, 2]}, "rlog") == 1
+    with pytest.raises(ValueError):
+        bpapi.negotiate({"rlog": [9]}, "rlog")
+
+
+# -- routing across nodes --------------------------------------------------
+
+def test_cross_node_publish():
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    sub = connect(n2, "sub1")
+    sub.handle_in(P.Subscribe(packet_id=1,
+                              topic_filters=[("t/+", {"qos": 0})]))
+    sync(nodes)
+    assert n1.app.broker.router.has_route("t/+", "node2")
+    pub = connect(n1, "pub1")
+    pub.handle_in(P.Publish(topic="t/x", qos=0, payload=b"hello"))
+    got = publishes(sub)
+    assert len(got) == 1 and got[0].payload == b"hello"
+    assert n1.app.metrics.val("messages.forward") == 1
+    stop(nodes)
+
+
+def test_route_delete_replicates():
+    nodes = make_cluster(3)
+    n1, n2, n3 = nodes
+    sub = connect(n3, "s3")
+    sub.handle_in(P.Subscribe(packet_id=1,
+                              topic_filters=[("a/#", {"qos": 0})]))
+    sync(nodes)
+    assert n1.app.broker.router.has_route("a/#", "node3")
+    sub.handle_in(P.Unsubscribe(packet_id=2, topic_filters=["a/#"]))
+    sync(nodes)
+    assert not n1.app.broker.router.has_route("a/#", "node3")
+    assert not n2.app.broker.router.has_route("a/#", "node3")
+    stop(nodes)
+
+
+def test_late_joiner_bootstraps_existing_routes():
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    sub = connect(n1, "s1")
+    sub.handle_in(P.Subscribe(packet_id=1,
+                              topic_filters=[("x/#", {"qos": 0})]))
+    sync(nodes)
+    # third node joins later and must learn x/# → node1 via bootstrap
+    from emqx_tpu.cluster.node import ClusterNode
+    n3 = ClusterNode("node3", LocalBus("node3", n1.transport.fabric))
+    n3.join(["node1"])
+    assert n3.app.broker.router.has_route("x/#", "node1")
+    pub = connect(n3, "p3")
+    pub.handle_in(P.Publish(topic="x/1", qos=0, payload=b"late"))
+    assert publishes(sub)[0].payload == b"late"
+    nodes.append(n3)
+    stop(nodes)
+
+
+# -- shared subscriptions across nodes ------------------------------------
+
+def test_shared_group_single_delivery_across_nodes():
+    nodes = make_cluster(2, shared_strategy="round_robin")
+    n1, n2 = nodes
+    a = connect(n1, "a")
+    a.handle_in(P.Subscribe(packet_id=1,
+                            topic_filters=[("$share/g/t", {"qos": 0})]))
+    b = connect(n2, "b")
+    b.handle_in(P.Subscribe(packet_id=1,
+                            topic_filters=[("$share/g/t", {"qos": 0})]))
+    sync(nodes)
+    pub = connect(n1, "p")
+    for i in range(6):
+        pub.handle_in(P.Publish(topic="t", qos=0,
+                                payload=b"m%d" % i))
+    # exactly one delivery per message, balanced across nodes
+    na, nb = len(publishes(a)), len(publishes(b))
+    assert na + nb == 6
+    assert na == 3 and nb == 3            # round_robin balance
+    stop(nodes)
+
+
+def test_shared_member_down_redispatches_to_other_node():
+    nodes = make_cluster(2, shared_strategy="round_robin")
+    n1, n2 = nodes
+    a = connect(n1, "a")
+    a.handle_in(P.Subscribe(packet_id=1,
+                            topic_filters=[("$share/g/t", {"qos": 1})]))
+    b = connect(n2, "b")
+    b.handle_in(P.Subscribe(packet_id=1,
+                            topic_filters=[("$share/g/t", {"qos": 1})]))
+    sync(nodes)
+    # kill b: its node announces session gone
+    b.handle_in(P.Disconnect())
+    pub = connect(n1, "p")
+    for i in range(4):
+        pub.handle_in(P.Publish(topic="t", qos=1, packet_id=10 + i,
+                                payload=b"x"))
+    assert len(publishes(a)) == 4          # all land on the live member
+    stop(nodes)
+
+
+# -- takeover across nodes -------------------------------------------------
+
+def test_cross_node_session_takeover():
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    props = {"Session-Expiry-Interval": 3600}
+    c1 = connect(n1, "dev", clean_start=False, props=props)
+    c1.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("d/#", {"qos": 1})]))
+    sync(nodes)
+    # client roams to node2, resumes
+    ch2 = Channel(n2.app.broker, n2.app.cm)
+    out = ch2.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="dev",
+                                  clean_start=False, properties=props))
+    assert out[0].session_present is True
+    assert "d/#" in ch2.session.subscriptions
+    sync(nodes)
+    # old node no longer owns it; routes moved
+    assert n1.app.cm.lookup_channel("dev") is None
+    assert n2.app.broker.router.has_route("d/#", "node2")
+    assert not n1.app.broker.router.has_route("d/#", "node1")
+    # publishes from node1 now reach the channel on node2
+    pub = connect(n1, "p")
+    pub.handle_in(P.Publish(topic="d/1", qos=1, packet_id=5,
+                            payload=b"roam"))
+    assert publishes(ch2)[0].payload == b"roam"
+    stop(nodes)
+
+
+def test_cross_node_clean_start_kicks_remote():
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    c1 = connect(n1, "dup")
+    ch2 = connect(n2, "dup", clean_start=True)
+    sync(nodes)
+    assert n1.app.cm.lookup_channel("dup") is None
+    assert n2.app.cm.lookup_channel("dup") is ch2
+    stop(nodes)
+
+
+# -- failure handling ------------------------------------------------------
+
+def test_nodedown_purges_routes_and_members():
+    nodes = make_cluster(3)
+    n1, n2, n3 = nodes
+    s = connect(n3, "s3")
+    s.handle_in(P.Subscribe(packet_id=1, topic_filters=[
+        ("t/#", {"qos": 0}), ("$share/g/q", {"qos": 0})]))
+    sync(nodes)
+    assert n1.app.broker.router.has_route("t/#", "node3")
+    # partition node3 away from both peers; their heartbeats fail
+    fabric = n1.transport.fabric
+    fabric.partition("node1", "node3")
+    fabric.partition("node2", "node3")
+    for _ in range(2):
+        n1.tick()
+        n2.tick()
+    assert not n1.app.broker.router.has_route("t/#", "node3")
+    assert not n2.app.broker.router.has_route("t/#", "node3")
+    assert n1.app.shared.members() == {}
+    # publish on n1 goes nowhere but doesn't error
+    pub = connect(n1, "p")
+    pub.handle_in(P.Publish(topic="t/1", qos=0, payload=b"x"))
+    stop(nodes)
+
+
+def test_partition_heal_resyncs():
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    fabric = n1.transport.fabric
+    fabric.partition("node1", "node2")
+    for _ in range(2):
+        n1.tick()
+        n2.tick()
+    assert "node2" not in n1.alive_peers()
+    # while partitioned, node2 gains a subscriber
+    s = connect(n2, "s2")
+    s.handle_in(P.Subscribe(packet_id=1,
+                            topic_filters=[("h/#", {"qos": 0})]))
+    fabric.heal("node1", "node2")
+    n1.tick()
+    n2.tick()
+    assert "node2" in n1.alive_peers()
+    assert n1.app.broker.router.has_route("h/#", "node2")
+    pub = connect(n1, "p")
+    pub.handle_in(P.Publish(topic="h/i", qos=0, payload=b"healed"))
+    assert publishes(s)[0].payload == b"healed"
+    stop(nodes)
+
+
+# -- TCP transport ---------------------------------------------------------
+
+def test_tcp_transport_cluster():
+    nodes = make_cluster(2, transport="tcp")
+    n1, n2 = nodes
+    try:
+        sub = connect(n2, "tsub")
+        sub.handle_in(P.Subscribe(packet_id=1,
+                                  topic_filters=[("tt/#", {"qos": 0})]))
+        sync(nodes)
+        import time
+        deadline = time.time() + 5
+        while (not n1.app.broker.router.has_route("tt/#", "node2")
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert n1.app.broker.router.has_route("tt/#", "node2")
+        pub = connect(n1, "tpub")
+        pub.handle_in(P.Publish(topic="tt/1", qos=0, payload=b"over-tcp"))
+        deadline = time.time() + 5
+        while not publishes(sub) and time.time() < deadline:
+            time.sleep(0.01)
+        assert publishes(sub)[0].payload == b"over-tcp"
+    finally:
+        stop(nodes)
+
+
+def test_transport_error_on_unknown_node():
+    fabric = LocalBus.Fabric()
+    bus = LocalBus("n1", fabric)
+    with pytest.raises(TransportError):
+        bus.call("ghost", "node.ping", node="n1")
+
+
+def test_tcp_handler_may_issue_blocking_calls():
+    """Regression: RPC handlers run off the transport loop thread, so a
+    handler that itself makes a blocking call back to the caller (the
+    bootstrap-from-handler paths) must not deadlock the loop."""
+    from emqx_tpu.cluster.transport import TcpTransport
+
+    t1, t2 = TcpTransport("n1"), TcpTransport("n2")
+    try:
+        t1.add_peer("n2", t2.host, t2.port)
+        t2.add_peer("n1", t1.host, t1.port)
+        t1.register("echo", lambda x: x)
+        t2.register("relay", lambda x: t2.call("n1", "echo", x=x) + 1)
+        assert t1.call("n2", "relay", x=41, _timeout=5) == 42
+    finally:
+        t1.close()
+        t2.close()

@@ -75,10 +75,10 @@ def test_redispatch_on_nack():
         s.join("g", "t", m)
     alive = {"m2"}
     got = s.dispatch("g", "t", msg(qos=1),
-                     deliver_fn=lambda sid: sid in alive)
-    assert got == [("m2", "$share/g/t")]
+                     deliver_fn=lambda sid, node: sid in alive)
+    assert got == [("m2", "node1", "$share/g/t")]
     # nobody alive → no delivery (and no infinite loop)
-    assert s.dispatch("g", "t", msg(qos=1), deliver_fn=lambda s_: False) == []
+    assert s.dispatch("g", "t", msg(qos=1), deliver_fn=lambda s_, n_: False) == []
 
 
 def test_member_down_cleans_all_groups():

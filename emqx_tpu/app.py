@@ -69,6 +69,10 @@ class BrokerApp:
             max_retained=max_retained, default_expiry_ms=retained_expiry_ms
         )
         self.delayed = Delayed(publish_fn=self._publish_dispatch)
+        from emqx_tpu.rules.engine import RuleEngine
+        self.rules = RuleEngine(node=node,
+                                publish_fn=self._publish_dispatch)
+        self.rules.attach(self.hooks)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)

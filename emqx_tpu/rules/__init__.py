@@ -1,0 +1,3 @@
+"""SQL rule engine (SURVEY.md §1 L8) — parity with
+``apps/emqx_rule_engine``: SQL over hook events, builtin function
+library, republish/console/custom actions."""

@@ -1,0 +1,226 @@
+"""Rule engine — parity with
+``apps/emqx_rule_engine/src/emqx_rule_engine.erl`` +
+``emqx_rule_actions.erl``.
+
+Rules = SQL + ordered actions, keyed by id. FROM topics split into the
+message.publish path (topic-filter matched per publish,
+emqx_rule_engine.erl:198-205's topic index) and $events/* hookpoints.
+Actions: ``republish`` (topic/payload/qos templates with ``${var}``
+placeholders — emqx_plugin_libs_rule's preproc_tmpl), ``console``, and
+registered custom functions (the bridge seam). Per-rule counters ride a
+MetricsWorker ('matched'/'passed'/'failed'/'actions.success'/...).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from emqx_tpu.broker.hooks import Hooks
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import Message
+from emqx_tpu.observe.metrics import MetricsWorker
+from emqx_tpu.rules import events as EV
+from emqx_tpu.rules.runtime import apply_select, eval_expr
+from emqx_tpu.rules.sqlparser import Select, parse
+
+log = logging.getLogger("emqx_tpu.rules")
+
+_TMPL = re.compile(r"\$\{([^}]+)\}")
+
+RULE_COUNTERS = ["matched", "passed", "failed", "failed.exception",
+                 "failed.no_result", "actions.total", "actions.success",
+                 "actions.failed"]
+
+
+def render_template(tmpl: str, columns: dict) -> str:
+    """${a.b} placeholder substitution (preproc_tmpl/proc_tmpl)."""
+    from emqx_tpu.rules.funcs import _str
+
+    def sub(m):
+        val = eval_expr(("var", m.group(1).split(".")), columns)
+        if isinstance(val, (dict, list)):
+            import json
+            return json.dumps(val, separators=(",", ":"))
+        return _str(val)
+
+    return _TMPL.sub(sub, tmpl)
+
+
+@dataclass
+class Rule:
+    id: str
+    sql: str
+    select: Select
+    actions: list = field(default_factory=list)
+    enabled: bool = True
+    description: str = ""
+    # split FROM list
+    publish_topics: list[str] = field(default_factory=list)
+    event_topics: list[str] = field(default_factory=list)
+
+
+class RuleEngine:
+    def __init__(self, node: str = "node1",
+                 publish_fn: Optional[Callable[[Message], None]] = None
+                 ) -> None:
+        self.node = node
+        self.publish_fn = publish_fn
+        self.rules: dict[str, Rule] = {}
+        self.metrics = MetricsWorker()
+        self._action_types: dict[str, Callable] = {
+            "republish": self._act_republish,
+            "console": self._act_console,
+        }
+        self._console_out: list[dict] = []       # console sink (tests/CLI)
+        self._hooked: Optional[Hooks] = None
+
+    # -- rule CRUD (emqx_rule_engine API) -----------------------------------
+
+    def create_rule(self, id: str, sql: str, actions: list,
+                    enabled: bool = True, description: str = "") -> Rule:
+        select = parse(sql)
+        publish_topics, event_topics = [], []
+        for t in select.topics:
+            if t in EV.EVENT_TOPICS:
+                event_topics.append(t)
+            elif t.startswith("$events/"):
+                raise ValueError(f"unknown event topic {t!r}")
+            else:
+                T.validate_filter(t)
+                publish_topics.append(t)
+        rule = Rule(id=id, sql=sql, select=select, actions=list(actions),
+                    enabled=enabled, description=description,
+                    publish_topics=publish_topics,
+                    event_topics=event_topics)
+        self.rules[id] = rule
+        self.metrics.create_metrics(id, RULE_COUNTERS)
+        return rule
+
+    def delete_rule(self, id: str) -> bool:
+        self.metrics.clear_metrics(id)
+        return self.rules.pop(id, None) is not None
+
+    def get_rule(self, id: str) -> Optional[Rule]:
+        return self.rules.get(id)
+
+    def list_rules(self) -> list[Rule]:
+        return list(self.rules.values())
+
+    def register_action(self, name: str, fn: Callable) -> None:
+        """Custom action type (the bridge seam): fn(columns, args)."""
+        self._action_types[name] = fn
+
+    # -- hook wiring --------------------------------------------------------
+
+    def attach(self, hooks: Hooks) -> None:
+        self._hooked = hooks
+        hooks.add("message.publish", self._on_publish, priority=-50)
+        for event_topic, hookpoint in EV.EVENT_TOPICS.items():
+            if hookpoint == "message.publish":
+                continue
+            hooks.add(hookpoint, self._make_event_cb(event_topic),
+                      priority=-50)
+
+    def _make_event_cb(self, event_topic: str):
+        hookpoint = EV.EVENT_TOPICS[event_topic]
+
+        def cb(*args):
+            for rule in self.rules.values():
+                if rule.enabled and event_topic in rule.event_topics:
+                    cols = EV.event_columns(hookpoint, args, self.node)
+                    self._apply_rule(rule, cols)
+            return None
+        return cb
+
+    # -- the publish path (topic-indexed, emqx_rule_engine.erl:198-205) -----
+
+    def rules_for_topic(self, topic: str) -> list[Rule]:
+        return [
+            r for r in self.rules.values()
+            if r.enabled and any(T.match(topic, f)
+                                 for f in r.publish_topics)
+        ]
+
+    def _on_publish(self, msg: Message, *rest):
+        if msg.topic.startswith("$SYS/"):
+            return None
+        rules = self.rules_for_topic(msg.topic)
+        if rules:
+            cols = EV.message_columns(msg, self.node)
+            loop_guard = msg.headers.get("republish_by")
+            for rule in rules:
+                if rule.id == loop_guard:
+                    continue          # a rule never re-fires on its own
+                self._apply_rule(rule, cols)
+        return None
+
+    # -- evaluation (emqx_rule_runtime:apply_rules) --------------------------
+
+    def _apply_rule(self, rule: Rule, columns: dict) -> None:
+        self.metrics.inc(rule.id, "matched")
+        try:
+            results = apply_select(rule.select, columns)
+        except Exception:
+            log.exception("rule %s SQL failed", rule.id)
+            self.metrics.inc(rule.id, "failed")
+            self.metrics.inc(rule.id, "failed.exception")
+            return
+        if results is None:
+            self.metrics.inc(rule.id, "failed")
+            self.metrics.inc(rule.id, "failed.no_result")
+            return
+        self.metrics.inc(rule.id, "passed")
+        for res in results:
+            for action in rule.actions:
+                self._run_action(rule, action, res)
+
+    def _run_action(self, rule: Rule, action: dict, columns: dict) -> None:
+        self.metrics.inc(rule.id, "actions.total")
+        fn = self._action_types.get(action.get("function", "console"))
+        try:
+            if fn is None:
+                raise ValueError(
+                    f"unknown action {action.get('function')!r}")
+            fn({**columns, "__rule_id": rule.id},
+               action.get("args") or {})
+            self.metrics.inc(rule.id, "actions.success")
+        except Exception:
+            log.exception("rule %s action failed", rule.id)
+            self.metrics.inc(rule.id, "actions.failed")
+
+    # -- builtin actions ----------------------------------------------------
+
+    def _act_republish(self, columns: dict, args: dict) -> None:
+        if self.publish_fn is None:
+            raise RuntimeError("republish: no publish_fn wired")
+        topic = render_template(args.get("topic", "${topic}"), columns)
+        payload = render_template(
+            args.get("payload", "${payload}"), columns)
+        qos_t = args.get("qos", 0)
+        qos = (int(render_template(str(qos_t), columns))
+               if isinstance(qos_t, str) else int(qos_t))
+        retain = bool(args.get("retain", False))
+        self.publish_fn(Message(
+            topic=topic, payload=payload.encode(), qos=qos,
+            from_=str(columns.get("clientid") or "rule_engine"),
+            flags={"retain": retain},
+            headers={"republish_by": columns.get("__rule_id"),
+                     "properties": {}},
+        ))
+
+    def _act_console(self, columns: dict, args: dict) -> None:
+        out = {k: v for k, v in columns.items() if not k.startswith("__")}
+        self._console_out.append(out)
+        del self._console_out[:-200]
+        log.info("rule %s console: %s", columns.get("__rule_id"), out)
+
+    # -- SQL test API (emqx_rule_sqltester) ---------------------------------
+
+    def test_sql(self, sql: str, context: dict) -> Optional[list[dict]]:
+        """Dry-run a SQL statement against a sample context (the
+        dashboard's rule tester)."""
+        sel = parse(sql)
+        return apply_select(sel, context)

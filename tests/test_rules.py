@@ -1,0 +1,259 @@
+"""Rule-engine tests: SQL parse, runtime eval, builtin funcs, events,
+actions, metrics, end-to-end via the broker (reference ground:
+emqx_rule_engine_SUITE, emqx_rule_funcs_SUITE)."""
+
+import json
+
+import pytest
+
+from emqx_tpu.core.message import Message
+from emqx_tpu.rules.engine import RuleEngine, render_template
+from emqx_tpu.rules.funcs import FUNCS
+from emqx_tpu.rules.runtime import apply_select, eval_expr
+from emqx_tpu.rules.sqlparser import SqlError, parse
+
+
+def run_sql(sql, **columns):
+    out = apply_select(parse(sql), columns)
+    return out if out is None else out[0] if len(out) == 1 else out
+
+
+# -- parser ----------------------------------------------------------------
+
+def test_parse_basic_select():
+    s = parse("SELECT * FROM 't/#'")
+    assert s.fields == [("*",)] and s.topics == ["t/#"] and s.where is None
+
+
+def test_parse_fields_aliases_where():
+    s = parse("SELECT payload.x as x, qos + 1 AS q FROM 't/1', 't/2' "
+              "WHERE qos > 0 and clientid != 'admin'")
+    assert len(s.fields) == 2 and s.topics == ["t/1", "t/2"]
+    assert s.where[0] == "and"
+
+
+def test_parse_errors():
+    with pytest.raises(SqlError):
+        parse("SELECT FROM 't'")
+    with pytest.raises(SqlError):
+        parse("SELECT * FROM")
+    with pytest.raises(SqlError):
+        parse("SELECT * FROM 't' WHERE x = ")
+    with pytest.raises(SqlError):
+        parse("SELECT * FROM 't' garbage")
+
+
+# -- runtime ---------------------------------------------------------------
+
+def test_select_projection_and_where():
+    out = run_sql("SELECT payload.temp AS t, clientid FROM 't/#' "
+                  "WHERE payload.temp > 20",
+                  payload=b'{"temp": 25}', clientid="c1", topic="t/1")
+    assert out == {"t": 25, "clientid": "c1"}
+    assert run_sql("SELECT * FROM 't/#' WHERE payload.temp > 20",
+                   payload=b'{"temp": 15}', clientid="c1") is None
+
+
+def test_select_star_and_nested_alias():
+    out = run_sql("SELECT *, qos + 1 AS meta.next_qos FROM 't'",
+                  qos=1, topic="t", clientid="c")
+    assert out["qos"] == 1 and out["meta"]["next_qos"] == 2
+
+
+def test_arithmetic_and_precedence():
+    assert run_sql("SELECT 2 + 3 * 4 AS v FROM 't'")["v"] == 14
+    assert run_sql("SELECT (2 + 3) * 4 AS v FROM 't'")["v"] == 20
+    assert run_sql("SELECT 7 div 2 AS v FROM 't'")["v"] == 3
+    assert run_sql("SELECT 7 mod 2 AS v FROM 't'")["v"] == 1
+    assert run_sql("SELECT -payload.x AS v FROM 't'",
+                   payload=b'{"x": 5}')["v"] == -5
+
+
+def test_string_concat_and_compare():
+    out = run_sql("SELECT 'a' + clientid AS s FROM 't'", clientid="b")
+    assert out["s"] == "ab"
+    assert run_sql("SELECT * FROM 't' WHERE clientid = 'c1'",
+                   clientid="c1") is not None
+    # payload bytes compare equal to strings
+    assert run_sql("SELECT * FROM 't' WHERE payload = 'on'",
+                   payload=b"on") is not None
+
+
+def test_in_case_and_index():
+    assert run_sql("SELECT * FROM 't' WHERE qos IN (1, 2)",
+                   qos=2) is not None
+    assert run_sql("SELECT * FROM 't' WHERE qos IN (1, 2)", qos=0) is None
+    out = run_sql("SELECT CASE WHEN qos > 1 THEN 'hi' ELSE 'lo' END AS l "
+                  "FROM 't'", qos=2)
+    assert out["l"] == "hi"
+    out = run_sql("SELECT payload.xs[2] AS second FROM 't'",
+                  payload=b'{"xs": [10, 20, 30]}')
+    assert out["second"] == 20
+
+
+def test_foreach_do_incase():
+    sql = ("FOREACH payload.sensors AS s DO s.name AS name, s.v AS v "
+           "INCASE s.v > 10 FROM 't'")
+    payload = json.dumps({"sensors": [
+        {"name": "a", "v": 5}, {"name": "b", "v": 15},
+        {"name": "c", "v": 25}]}).encode()
+    out = apply_select(parse(sql), {"payload": payload})
+    assert out == [{"name": "b", "v": 15}, {"name": "c", "v": 25}]
+
+
+def test_like_operator():
+    assert run_sql("SELECT * FROM 't' WHERE clientid LIKE 'dev-%'",
+                   clientid="dev-42") is not None
+    assert run_sql("SELECT * FROM 't' WHERE clientid LIKE 'dev-%'",
+                   clientid="sensor-1") is None
+
+
+# -- funcs -----------------------------------------------------------------
+
+def test_builtin_funcs_sampler():
+    assert FUNCS["upper"]("abc") == "ABC"
+    assert FUNCS["substr"]("hello", 1, 3) == "ell"
+    assert FUNCS["split"]("a,b,c") == ["a", "b", "c"]
+    assert FUNCS["concat"]("a", 1, "b") == "a1b"
+    assert FUNCS["nth"](2, [1, 2, 3]) == 2
+    assert FUNCS["map_get"]("k", {"k": "v"}) == "v"
+    assert FUNCS["json_decode"]('{"a":1}') == {"a": 1}
+    assert FUNCS["base64_decode"](FUNCS["base64_encode"](b"xy")) == b"xy"
+    assert FUNCS["md5"]("abc") == "900150983cd24fb0d6963f7d28e17f72"
+    assert FUNCS["regex_match"]("v1.2", r"^v\d")
+    assert FUNCS["nth_topic_level"](2, "a/b/c") == "b"
+    assert FUNCS["topic"]("a", "b", 1) == "a/b/1"
+    assert FUNCS["now_timestamp"]() > 1_700_000_000
+    assert FUNCS["is_num"](3) and not FUNCS["is_num"](True)
+
+
+def test_funcs_in_sql():
+    out = run_sql("SELECT upper(clientid) AS u, "
+                  "nth_topic_level(2, topic) AS lvl FROM 't/#'",
+                  clientid="dev1", topic="t/abc")
+    assert out == {"u": "DEV1", "lvl": "abc"}
+
+
+def test_template_render():
+    cols = {"topic": "t/1", "payload": b'{"v": 7}', "clientid": "c",
+            "nested": {"a": [1, 2]}}
+    assert render_template("up/${clientid}/${topic}", cols) == "up/c/t/1"
+    assert render_template("${payload.v}", cols) == "7"
+    assert render_template("${nested}", cols) == '{"a":[1,2]}'
+
+
+# -- engine ----------------------------------------------------------------
+
+def _engine():
+    out = []
+    eng = RuleEngine(publish_fn=out.append)
+    return eng, out
+
+
+def test_rule_republish_action():
+    eng, out = _engine()
+    eng.create_rule(
+        "r1", "SELECT payload.v AS v, topic FROM 'sensor/#' WHERE "
+        "payload.v > 10",
+        [{"function": "republish",
+          "args": {"topic": "alert/${topic}", "payload": "v=${v}",
+                   "qos": 1}}])
+    eng._on_publish(Message(topic="sensor/1", payload=b'{"v": 99}'))
+    assert len(out) == 1
+    assert out[0].topic == "alert/sensor/1"
+    assert out[0].payload == b"v=99" and out[0].qos == 1
+    eng._on_publish(Message(topic="sensor/1", payload=b'{"v": 3}'))
+    assert len(out) == 1                          # filtered by WHERE
+    m = eng.metrics.get_counters("r1")
+    assert m["matched"] == 2 and m["passed"] == 1
+    assert m["failed.no_result"] == 1 and m["actions.success"] == 1
+
+
+def test_rule_no_self_loop():
+    eng, out = _engine()
+    eng.create_rule("loop", "SELECT * FROM 't/#'",
+                    [{"function": "republish",
+                      "args": {"topic": "t/again", "payload": "x"}}])
+    eng._on_publish(Message(topic="t/1", payload=b"go"))
+    assert len(out) == 1
+    # feed the republished message back: the same rule must not re-fire
+    eng._on_publish(out[0])
+    assert len(out) == 1
+
+
+def test_event_rules():
+    eng, out = _engine()
+    eng.create_rule(
+        "ev", "SELECT clientid, reason FROM '$events/client_disconnected'",
+        [{"function": "console"}])
+    from emqx_tpu.broker.hooks import Hooks
+    hooks = Hooks()
+    eng.attach(hooks)
+
+    class CI:
+        clientid = "c7"
+        username = None
+    hooks.run("client.disconnected", (CI(), "keepalive_timeout"))
+    assert eng._console_out[-1]["clientid"] == "c7"
+    assert eng._console_out[-1]["reason"] == "keepalive_timeout"
+    assert eng.metrics.get("ev", "passed") == 1
+
+
+def test_unknown_event_topic_rejected():
+    eng, _ = _engine()
+    with pytest.raises(ValueError):
+        eng.create_rule("bad", "SELECT * FROM '$events/nope'", [])
+
+
+def test_custom_action_and_disable():
+    eng, _ = _engine()
+    got = []
+    eng.register_action("collect", lambda cols, args: got.append(
+        (cols["topic"], args.get("tag"))))
+    r = eng.create_rule("c1", "SELECT * FROM 'x/#'",
+                        [{"function": "collect", "args": {"tag": "T"}}])
+    eng._on_publish(Message(topic="x/1", payload=b""))
+    assert got == [("x/1", "T")]
+    r.enabled = False
+    eng._on_publish(Message(topic="x/1", payload=b""))
+    assert len(got) == 1
+
+
+def test_sql_test_api():
+    eng, _ = _engine()
+    res = eng.test_sql("SELECT upper(clientid) AS u FROM 't'",
+                       {"clientid": "ab"})
+    assert res == [{"u": "AB"}]
+
+
+def test_rules_via_live_broker():
+    """End-to-end: rule transforms device telemetry into an alert topic
+    another subscriber receives."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.mqtt import packet as P
+
+    app = BrokerApp()
+    app.rules.create_rule(
+        "alert", "SELECT payload.temp AS t, clientid FROM 'dev/+/temp' "
+        "WHERE payload.temp > 30",
+        [{"function": "republish",
+          "args": {"topic": "alerts/${clientid}",
+                   "payload": "overheat ${t}"}}])
+    watcher = Channel(app.broker, app.cm)
+    watcher.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="watch"))
+    watcher.handle_in(P.Subscribe(packet_id=1,
+                                  topic_filters=[("alerts/#", {"qos": 0})]))
+    dev = Channel(app.broker, app.cm)
+    dev.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="dev42"))
+    dev.handle_in(P.Publish(topic="dev/42/temp", qos=0,
+                            payload=b'{"temp": 41}'))
+    pubs = [p for p in watcher.outbox if isinstance(p, P.Publish)]
+    assert len(pubs) == 1
+    assert pubs[0].topic == "alerts/dev42"
+    assert pubs[0].payload == b"overheat 41"
+    # below threshold → no alert
+    dev.handle_in(P.Publish(topic="dev/42/temp", qos=0,
+                            payload=b'{"temp": 20}'))
+    assert len([p for p in watcher.outbox
+                if isinstance(p, P.Publish)]) == 1

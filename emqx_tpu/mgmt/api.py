@@ -1,0 +1,528 @@
+"""REST management API — parity with ``apps/emqx_management`` +
+``apps/emqx_dashboard`` (minirest/cowboy + swagger).
+
+Endpoints (subset mirroring emqx_mgmt_api_*.erl, /api/v5 prefix):
+
+    POST /login                     → bearer token (dashboard JWT slot)
+    GET  /status /nodes /metrics /stats /prometheus /alarms
+    GET  /clients [?page,limit,like_clientid]   GET/DELETE /clients/{id}
+    GET  /subscriptions             GET /topics (the route table)
+    POST /publish                   {topic, payload, qos, retain}
+    GET/POST /banned                DELETE /banned/{kind}/{value}
+    GET  /configs?path=a.b          PUT /configs {path, value}
+    GET/POST /rules   GET/PUT/DELETE /rules/{id}   POST /rule_test
+    GET  /retainer/messages         DELETE /retainer/message/{topic}
+    GET  /api-docs.json             (swagger-ish doc from the registry)
+
+Auth: ``Authorization: Bearer <token>`` from /login, or API-key basic
+auth (emqx_mgmt_auth analogue). Runs a stdlib ThreadingHTTPServer on a
+daemon thread beside the asyncio broker.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+from emqx_tpu.core.message import Message
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, code: str, message: str = "") -> None:
+        super().__init__(message or code)
+        self.status = status
+        self.code = code
+
+
+class ApiKeys:
+    """API key/secret pairs (emqx_mgmt_auth.erl)."""
+
+    def __init__(self) -> None:
+        self._keys: dict[str, str] = {}       # key → sha256(secret)
+
+    def create(self, key: Optional[str] = None,
+               secret: Optional[str] = None) -> tuple[str, str]:
+        key = key or base64.urlsafe_b64encode(os.urandom(9)).decode()
+        secret = secret or base64.urlsafe_b64encode(os.urandom(18)).decode()
+        self._keys[key] = hashlib.sha256(secret.encode()).hexdigest()
+        return key, secret
+
+    def check(self, key: str, secret: str) -> bool:
+        want = self._keys.get(key)
+        return want is not None and hmac.compare_digest(
+            want, hashlib.sha256(secret.encode()).hexdigest())
+
+    def delete(self, key: str) -> bool:
+        return self._keys.pop(key, None) is not None
+
+    def list(self) -> list[str]:
+        return list(self._keys)
+
+
+class Dashboard:
+    """Admin users + bearer tokens (emqx_dashboard_admin/_token)."""
+
+    TOKEN_TTL_S = 3600.0
+
+    def __init__(self) -> None:
+        self._users: dict[str, str] = {}
+        self._tokens: dict[str, tuple[str, float]] = {}
+        self.add_user("admin", "public")      # the reference's default
+
+    def add_user(self, username: str, password: str) -> None:
+        self._users[username] = hashlib.sha256(password.encode()).hexdigest()
+
+    def login(self, username: str, password: str) -> Optional[str]:
+        want = self._users.get(username)
+        if want is None or not hmac.compare_digest(
+                want, hashlib.sha256(password.encode()).hexdigest()):
+            return None
+        token = base64.urlsafe_b64encode(os.urandom(24)).decode()
+        self._tokens[token] = (username, time.time() + self.TOKEN_TTL_S)
+        return token
+
+    def verify(self, token: str) -> bool:
+        hit = self._tokens.get(token)
+        if hit is None:
+            return False
+        if time.time() > hit[1]:
+            del self._tokens[token]
+            return False
+        return True
+
+
+class ManagementApi:
+    """Route registry + handlers over a BrokerApp (and optional cluster
+    node for /nodes)."""
+
+    def __init__(self, app, cluster_node=None) -> None:
+        self.app = app
+        self.cluster = cluster_node
+        self.api_keys = ApiKeys()
+        self.dashboard = Dashboard()
+        self._routes: list[tuple[str, re.Pattern, list[str], Callable,
+                                 str]] = []
+        self._register_all()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+
+    # -- routing ------------------------------------------------------------
+
+    def route(self, method: str, path: str, fn: Callable,
+              desc: str = "") -> None:
+        names = re.findall(r"\{(\w+)\}", path)
+        pat = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", path) + "$")
+        self._routes.append((method, pat, names, fn, desc or path))
+
+    def handle(self, method: str, path: str, query: dict,
+               body: Any, authed: bool) -> tuple[int, Any]:
+        if path == "/api/v5/login" and method == "POST":
+            return self._login(body or {})
+        if path == "/api-docs.json" and method == "GET":
+            return 200, self._docs()
+        if not authed:
+            return 401, {"code": "UNAUTHORIZED",
+                         "message": "missing or bad credentials"}
+        for m, pat, names, fn, _desc in self._routes:
+            if m != method:
+                continue
+            match = pat.match(path)
+            if match is None:
+                continue
+            try:
+                kwargs = {n: urllib.parse.unquote(match.group(n))
+                          for n in names}
+                result = fn(query=query, body=body, **kwargs)
+                if isinstance(result, tuple):
+                    return result
+                return (204, None) if result is None else (200, result)
+            except ApiError as e:
+                return e.status, {"code": e.code, "message": str(e)}
+            except Exception as e:        # noqa: BLE001 — surface as 500
+                return 500, {"code": "INTERNAL_ERROR", "message": str(e)}
+        return 404, {"code": "NOT_FOUND", "message": path}
+
+    def _login(self, body: dict) -> tuple[int, Any]:
+        token = self.dashboard.login(body.get("username", ""),
+                                     body.get("password", ""))
+        if token is None:
+            return 401, {"code": "BAD_USERNAME_OR_PWD"}
+        return 200, {"token": token, "version": "5"}
+
+    def check_auth(self, headers) -> bool:
+        auth = headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            return self.dashboard.verify(auth[7:].strip())
+        if auth.startswith("Basic "):
+            try:
+                user, _, pw = base64.b64decode(
+                    auth[6:].strip()).decode().partition(":")
+            except Exception:
+                return False
+            return self.api_keys.check(user, pw)
+        return False
+
+    def _docs(self) -> dict:
+        from emqx_tpu.config.schema import root_schema
+
+        return {
+            "openapi": "3.0-ish",
+            "paths": sorted({f"{m} {d}" for m, _p, _n, _f, d
+                             in self._routes}),
+            "config_schema": root_schema().to_doc(),
+        }
+
+    # -- handlers -----------------------------------------------------------
+
+    def _register_all(self) -> None:
+        r = self.route
+        r("GET", "/api/v5/status", self.h_status)
+        r("GET", "/api/v5/nodes", self.h_nodes)
+        r("GET", "/api/v5/metrics", self.h_metrics)
+        r("GET", "/api/v5/stats", self.h_stats)
+        r("GET", "/api/v5/prometheus", self.h_prometheus)
+        r("GET", "/api/v5/alarms", self.h_alarms)
+        r("GET", "/api/v5/clients", self.h_clients)
+        r("GET", "/api/v5/clients/{clientid}", self.h_client)
+        r("DELETE", "/api/v5/clients/{clientid}", self.h_kick)
+        r("GET", "/api/v5/subscriptions", self.h_subscriptions)
+        r("GET", "/api/v5/topics", self.h_topics)
+        r("POST", "/api/v5/publish", self.h_publish)
+        r("GET", "/api/v5/banned", self.h_banned_list)
+        r("POST", "/api/v5/banned", self.h_banned_create)
+        r("DELETE", "/api/v5/banned/{kind}/{value}", self.h_banned_delete)
+        r("GET", "/api/v5/configs", self.h_config_get)
+        r("PUT", "/api/v5/configs", self.h_config_put)
+        r("GET", "/api/v5/rules", self.h_rules_list)
+        r("POST", "/api/v5/rules", self.h_rules_create)
+        r("GET", "/api/v5/rules/{id}", self.h_rule_get)
+        r("PUT", "/api/v5/rules/{id}", self.h_rule_put)
+        r("DELETE", "/api/v5/rules/{id}", self.h_rule_delete)
+        r("POST", "/api/v5/rule_test", self.h_rule_test)
+        r("GET", "/api/v5/retainer/messages", self.h_retained)
+        r("DELETE", "/api/v5/retainer/message/{topic}",
+          self.h_retained_delete)
+        r("GET", "/api/v5/api_key", self.h_api_keys)
+        r("POST", "/api/v5/api_key", self.h_api_key_create)
+
+    @staticmethod
+    def _page(items: list, query: dict) -> dict:
+        page = int(query.get("page", 1))
+        limit = int(query.get("limit", 100))
+        return {
+            "data": items[(page - 1) * limit: page * limit],
+            "meta": {"page": page, "limit": limit, "count": len(items)},
+        }
+
+    def h_status(self, query, body):
+        return {"node": self.app.broker.node, "status": "running",
+                "uptime": int(self.app.sys.uptime_s()),
+                "version": __import__(
+                    "emqx_tpu.observe.sys", fromlist=["VERSION"]).VERSION}
+
+    def h_nodes(self, query, body):
+        me = {"node": self.app.broker.node, "status": "running",
+              "role": "core"}
+        if self.cluster is None:
+            return [me]
+        return [me] + [
+            {"node": n, "status": "running" if m.get("alive")
+             else "stopped", "role": "core"}
+            for n, m in self.cluster.members.items()
+        ]
+
+    def h_metrics(self, query, body):
+        return self.app.metrics.all()
+
+    def h_stats(self, query, body):
+        self.app.stats.tick()
+        return self.app.stats.all()
+
+    def h_prometheus(self, query, body):
+        return 200, self.app.prometheus()        # text passthrough
+
+    def h_alarms(self, query, body):
+        which = ("activated" if query.get("activated") in ("true", "1")
+                 else "all")
+        return [
+            {"name": a.name, "message": a.message, "details": a.details,
+             "activate_at": a.activate_at, "deactivate_at": a.deactivate_at}
+            for a in self.app.alarms.get_alarms(which)
+        ]
+
+    def _client_info(self, cid: str, ch) -> dict:
+        ci = ch.conninfo
+        return {
+            "clientid": cid, "username": ci.username,
+            "peername": ci.peername, "proto_ver": ci.proto_ver,
+            "keepalive": ci.keepalive, "clean_start": ci.clean_start,
+            "connected": ch.conn_state == "connected",
+            "connected_at": ci.connected_at,
+            "subscriptions_cnt": len(ch.session.subscriptions)
+            if ch.session else 0,
+        }
+
+    def h_clients(self, query, body):
+        like = query.get("like_clientid")
+        items = [
+            self._client_info(cid, ch)
+            for cid, ch in sorted(self.app.cm.all_channels())
+            if like is None or like in cid
+        ]
+        return self._page(items, query)
+
+    def h_client(self, query, body, clientid):
+        ch = self.app.cm.lookup_channel(clientid)
+        if ch is None:
+            raise ApiError(404, "CLIENTID_NOT_FOUND")
+        return self._client_info(clientid, ch)
+
+    def h_kick(self, query, body, clientid):
+        if not self.app.cm.kick(clientid):
+            raise ApiError(404, "CLIENTID_NOT_FOUND")
+        return None
+
+    def h_subscriptions(self, query, body):
+        items = [
+            {"clientid": sid, "topic": t, "qos": opts.qos, "nl": opts.nl,
+             "rap": opts.rap, "rh": opts.rh}
+            for (sid, t), opts in sorted(self.app.broker.suboption.items())
+        ]
+        return self._page(items, query)
+
+    def h_topics(self, query, body):
+        router = self.app.broker.router
+        items = [
+            {"topic": t, "node": str(r.dest)}
+            for t in sorted(router.topics())
+            for r in router.lookup_routes(t)
+        ]
+        return self._page(items, query)
+
+    def h_publish(self, query, body):
+        body = body or {}
+        topic = body.get("topic")
+        if not topic:
+            raise ApiError(400, "BAD_REQUEST", "topic required")
+        payload = body.get("payload", "")
+        if body.get("payload_encoding") == "base64":
+            payload = base64.b64decode(payload)
+        elif isinstance(payload, str):
+            payload = payload.encode()
+        msg = Message(
+            topic=topic, payload=payload, qos=int(body.get("qos", 0)),
+            from_="mgmt_api",
+            flags={"retain": bool(body.get("retain", False))},
+            headers={"properties": body.get("properties") or {}},
+        )
+        self.app.cm.dispatch(self.app.broker.publish(msg))
+        return {"id": msg.id}
+
+    def h_banned_list(self, query, body):
+        return self._page([
+            {"as": e.kind, "who": e.value, "by": e.by, "reason": e.reason,
+             "at": e.at, "until": e.until}
+            for e in self.app.access.banned.all()
+        ], query)
+
+    def h_banned_create(self, query, body):
+        body = body or {}
+        try:
+            entry = self.app.access.banned.create(
+                body.get("as", "clientid"), body["who"],
+                by=body.get("by", "mgmt_api"),
+                reason=body.get("reason", ""),
+                duration_s=body.get("seconds"))
+        except (KeyError, ValueError) as e:
+            raise ApiError(400, "BAD_REQUEST", str(e)) from e
+        return 201, {"as": entry.kind, "who": entry.value}
+
+    def h_banned_delete(self, query, body, kind, value):
+        if not self.app.access.banned.delete(kind, value):
+            raise ApiError(404, "NOT_FOUND")
+        return None
+
+    def _conf(self):
+        conf = getattr(self.app, "config", None)
+        if conf is None:
+            raise ApiError(503, "NO_CONFIG",
+                           "app not booted from a Config")
+        return conf
+
+    def h_config_get(self, query, body):
+        return {"value": self._conf().get(query.get("path", ""))}
+
+    def h_config_put(self, query, body):
+        body = body or {}
+        try:
+            value = self._conf().put(body["path"], body["value"])
+        except KeyError as e:
+            raise ApiError(400, "BAD_REQUEST", "path/value required") from e
+        except Exception as e:
+            raise ApiError(400, "BAD_VALUE", str(e)) from e
+        return {"value": value}
+
+    def _rule_info(self, rule) -> dict:
+        return {"id": rule.id, "sql": rule.sql, "enable": rule.enabled,
+                "description": rule.description, "actions": rule.actions,
+                "metrics": self.app.rules.metrics.get_counters(rule.id)}
+
+    def h_rules_list(self, query, body):
+        return self._page([self._rule_info(r)
+                           for r in self.app.rules.list_rules()], query)
+
+    def h_rules_create(self, query, body):
+        body = body or {}
+        try:
+            rule = self.app.rules.create_rule(
+                body.get("id") or f"rule_{int(time.time() * 1000):x}",
+                body["sql"], body.get("actions", []),
+                enabled=body.get("enable", True),
+                description=body.get("description", ""))
+        except KeyError as e:
+            raise ApiError(400, "BAD_REQUEST", "sql required") from e
+        except ValueError as e:
+            raise ApiError(400, "BAD_SQL", str(e)) from e
+        return 201, self._rule_info(rule)
+
+    def h_rule_get(self, query, body, id):
+        rule = self.app.rules.get_rule(id)
+        if rule is None:
+            raise ApiError(404, "RULE_NOT_FOUND")
+        return self._rule_info(rule)
+
+    def h_rule_put(self, query, body, id):
+        if self.app.rules.get_rule(id) is None:
+            raise ApiError(404, "RULE_NOT_FOUND")
+        body = body or {}
+        self.app.rules.delete_rule(id)
+        try:
+            rule = self.app.rules.create_rule(
+                id, body["sql"], body.get("actions", []),
+                enabled=body.get("enable", True),
+                description=body.get("description", ""))
+        except ValueError as e:
+            raise ApiError(400, "BAD_SQL", str(e)) from e
+        return self._rule_info(rule)
+
+    def h_rule_delete(self, query, body, id):
+        if not self.app.rules.delete_rule(id):
+            raise ApiError(404, "RULE_NOT_FOUND")
+        return None
+
+    def h_rule_test(self, query, body):
+        body = body or {}
+        try:
+            res = self.app.rules.test_sql(body["sql"],
+                                          body.get("context", {}))
+        except KeyError as e:
+            raise ApiError(400, "BAD_REQUEST", "sql required") from e
+        except ValueError as e:
+            raise ApiError(400, "BAD_SQL", str(e)) from e
+        if res is None:
+            raise ApiError(412, "SQL_NO_MATCH", "WHERE filtered out")
+        return res
+
+    def h_retained(self, query, body):
+        items = []
+        for t in sorted(self.app.retainer.topics()):
+            for m in self.app.retainer.match(t):
+                items.append({
+                    "topic": m.topic, "qos": m.qos,
+                    "payload": base64.b64encode(m.payload).decode(),
+                    "from_clientid": m.from_, "publish_at": m.timestamp})
+        return self._page(items, query)
+
+    def h_retained_delete(self, query, body, topic):
+        if not self.app.retainer.delete(topic):
+            raise ApiError(404, "NOT_FOUND")
+        return None
+
+    def h_api_keys(self, query, body):
+        return [{"api_key": k} for k in self.api_keys.list()]
+
+    def h_api_key_create(self, query, body):
+        body = body or {}
+        key, secret = self.api_keys.create(body.get("api_key"),
+                                           body.get("api_secret"))
+        return 201, {"api_key": key, "api_secret": secret}
+
+    # -- http server --------------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _run(self, method: str) -> None:
+                parsed = urllib.parse.urlsplit(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                body = None
+                ln = int(self.headers.get("Content-Length") or 0)
+                if ln:
+                    raw = self.rfile.read(ln)
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype or not ctype:
+                        try:
+                            body = json.loads(raw)
+                        except ValueError:
+                            self._reply(400, {"code": "BAD_JSON"})
+                            return
+                    else:
+                        body = raw
+                status, result = api.handle(
+                    method, parsed.path, query, body,
+                    authed=api.check_auth(self.headers))
+                self._reply(status, result)
+
+            def _reply(self, status: int, result: Any) -> None:
+                if isinstance(result, str):
+                    data = result.encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif result is None:
+                    data = b""
+                    ctype = "application/json"
+                else:
+                    data = json.dumps(result).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_PUT(self):
+                self._run("PUT")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="mgmt-api").start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

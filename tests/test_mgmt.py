@@ -1,0 +1,234 @@
+"""Management REST API + CLI tests (reference ground:
+apps/emqx_management/test/emqx_mgmt_api_*_SUITE.erl driven over HTTP)."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.config.config import Config
+from emqx_tpu.mgmt.api import ManagementApi
+from emqx_tpu.mgmt.cli import CtlClient, main as cli_main
+from emqx_tpu.mqtt import packet as P
+
+
+@pytest.fixture()
+def api():
+    conf = Config()
+    conf.init_load("")
+    app = BrokerApp.from_config(conf)
+    mgmt = ManagementApi(app)
+    mgmt.start(port=0)
+    yield mgmt
+    mgmt.stop()
+
+
+def _token(mgmt) -> str:
+    return _req(mgmt, "POST", "/api/v5/login",
+                {"username": "admin", "password": "public"},
+                auth=None)[1]["token"]
+
+
+def _req(mgmt, method, path, body=None, auth="token", token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{mgmt.port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method)
+    req.add_header("Content-Type", "application/json")
+    if auth == "token":
+        req.add_header("Authorization",
+                       f"Bearer {token or _token(mgmt)}")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            raw = resp.read()
+            return resp.status, (json.loads(raw) if raw else None)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, (json.loads(raw) if raw else None)
+
+
+def _mqtt_client(app, clientid):
+    ch = Channel(app.broker, app.cm)
+    ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid=clientid))
+    return ch
+
+
+def test_login_and_auth_required(api):
+    code, err = _req(api, "GET", "/api/v5/status", auth=None)
+    assert code == 401
+    code, body = _req(api, "POST", "/api/v5/login",
+                      {"username": "admin", "password": "wrong"},
+                      auth=None)
+    assert code == 401
+    tok = _token(api)
+    code, body = _req(api, "GET", "/api/v5/status", token=tok)
+    assert code == 200 and body["status"] == "running"
+
+
+def test_api_key_basic_auth(api):
+    tok = _token(api)
+    code, created = _req(api, "POST", "/api/v5/api_key", {}, token=tok)
+    assert code == 201
+    raw = f"{created['api_key']}:{created['api_secret']}".encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/api/v5/metrics")
+    req.add_header("Authorization",
+                   "Basic " + base64.b64encode(raw).decode())
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.status == 200
+
+
+def test_clients_subscriptions_topics_kick(api):
+    app = api.app
+    ch = _mqtt_client(app, "web1")
+    ch.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("t/#", {"qos": 1})]))
+    tok = _token(api)
+    code, page = _req(api, "GET", "/api/v5/clients", token=tok)
+    assert code == 200 and page["meta"]["count"] == 1
+    assert page["data"][0]["clientid"] == "web1"
+    code, one = _req(api, "GET", "/api/v5/clients/web1", token=tok)
+    assert one["subscriptions_cnt"] == 1
+    code, subs = _req(api, "GET", "/api/v5/subscriptions", token=tok)
+    assert subs["data"][0]["topic"] == "t/#"
+    code, topics = _req(api, "GET", "/api/v5/topics", token=tok)
+    assert any(t["topic"] == "t/#" for t in topics["data"])
+    code, _ = _req(api, "DELETE", "/api/v5/clients/web1", token=tok)
+    assert code == 204
+    code, _ = _req(api, "GET", "/api/v5/clients/web1", token=tok)
+    assert code == 404
+
+
+def test_publish_endpoint_delivers(api):
+    app = api.app
+    ch = _mqtt_client(app, "watcher")
+    ch.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("cmd/#", {"qos": 0})]))
+    code, body = _req(api, "POST", "/api/v5/publish",
+                      {"topic": "cmd/go", "payload": "now", "qos": 0})
+    assert code == 200 and "id" in body
+    pubs = [p for p in ch.outbox if isinstance(p, P.Publish)]
+    assert pubs and pubs[-1].payload == b"now"
+    code, err = _req(api, "POST", "/api/v5/publish", {"payload": "x"})
+    assert code == 400
+
+
+def test_banned_endpoints(api):
+    tok = _token(api)
+    code, made = _req(api, "POST", "/api/v5/banned",
+                      {"as": "clientid", "who": "evil"}, token=tok)
+    assert code == 201
+    code, page = _req(api, "GET", "/api/v5/banned", token=tok)
+    assert page["meta"]["count"] == 1
+    assert api.app.access.banned.check({"clientid": "evil"})
+    code, _ = _req(api, "DELETE", "/api/v5/banned/clientid/evil",
+                   token=tok)
+    assert code == 204
+    code, _ = _req(api, "DELETE", "/api/v5/banned/clientid/evil",
+                   token=tok)
+    assert code == 404
+
+
+def test_config_endpoints(api):
+    tok = _token(api)
+    code, got = _req(api, "GET", "/api/v5/configs?path=mqtt.max_inflight",
+                     token=tok)
+    assert got["value"] == 32
+    code, put = _req(api, "PUT", "/api/v5/configs",
+                     {"path": "mqtt.max_inflight", "value": 64}, token=tok)
+    assert code == 200 and put["value"] == 64
+    code, err = _req(api, "PUT", "/api/v5/configs",
+                     {"path": "mqtt.max_inflight", "value": "lots"},
+                     token=tok)
+    assert code == 400
+
+
+def test_rules_crud_and_test(api):
+    tok = _token(api)
+    code, rule = _req(api, "POST", "/api/v5/rules", {
+        "id": "r1", "sql": "SELECT * FROM 't/#'",
+        "actions": [{"function": "console"}]}, token=tok)
+    assert code == 201
+    code, lst = _req(api, "GET", "/api/v5/rules", token=tok)
+    assert lst["meta"]["count"] == 1
+    code, upd = _req(api, "PUT", "/api/v5/rules/r1",
+                     {"sql": "SELECT qos FROM 'u/#'"}, token=tok)
+    assert code == 200 and upd["sql"] == "SELECT qos FROM 'u/#'"
+    code, res = _req(api, "POST", "/api/v5/rule_test",
+                     {"sql": "SELECT qos + 1 AS q FROM 't'",
+                      "context": {"qos": 1}}, token=tok)
+    assert res == [{"q": 2}]
+    code, err = _req(api, "POST", "/api/v5/rules",
+                     {"sql": "SELEC nope"}, token=tok)
+    assert code == 400
+    code, _ = _req(api, "DELETE", "/api/v5/rules/r1", token=tok)
+    assert code == 204
+
+
+def test_retainer_endpoints(api):
+    app = api.app
+    ch = _mqtt_client(app, "r1")
+    ch.handle_in(P.Publish(topic="cfg/a", qos=0, retain=True,
+                           payload=b"v1"))
+    tok = _token(api)
+    code, page = _req(api, "GET", "/api/v5/retainer/messages", token=tok)
+    assert page["meta"]["count"] == 1
+    assert base64.b64decode(page["data"][0]["payload"]) == b"v1"
+    code, _ = _req(api, "DELETE", "/api/v5/retainer/message/cfg%2Fa",
+                   token=tok)
+    assert code == 204
+    assert len(app.retainer) == 0
+
+
+def test_metrics_stats_prometheus_alarms(api):
+    _mqtt_client(api.app, "m1")
+    tok = _token(api)
+    code, metrics = _req(api, "GET", "/api/v5/metrics", token=tok)
+    assert metrics["client.connected"] == 1
+    code, stats = _req(api, "GET", "/api/v5/stats", token=tok)
+    assert stats["connections.count"] == 1
+    api.app.alarms.activate("test_alarm", {"x": 1}, "boom")
+    code, alarms = _req(api, "GET", "/api/v5/alarms?activated=true",
+                        token=tok)
+    assert alarms[0]["name"] == "test_alarm"
+    # prometheus is text
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/api/v5/prometheus")
+    req.add_header("Authorization", f"Bearer {tok}")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        text = resp.read().decode()
+    assert "emqx_client_connected" in text
+
+
+def test_api_docs_public(api):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/api-docs.json", timeout=5) as r:
+        doc = json.loads(r.read())
+    assert any("GET /api/v5/clients" in p for p in doc["paths"])
+    assert "mqtt" in doc["config_schema"]["fields"]
+
+
+def test_cli_verbs(api, capsys):
+    url = f"http://127.0.0.1:{api.port}"
+    _mqtt_client(api.app, "cli1")
+    assert cli_main(["--url", url, "status"]) == 0
+    out = capsys.readouterr().out
+    assert "running" in out
+    assert cli_main(["--url", url, "clients", "list"]) == 0
+    assert "cli1" in capsys.readouterr().out
+    assert cli_main(["--url", url, "publish", "a/b", "hi", "--qos", "0"]
+                    ) == 0
+    capsys.readouterr()
+    assert cli_main(["--url", url, "banned", "add", "clientid", "bad"]
+                    ) == 0
+    capsys.readouterr()
+    assert cli_main(["--url", url, "banned", "list"]) == 0
+    assert "bad" in capsys.readouterr().out
+    assert cli_main(["--url", url, "clients", "kick", "cli1"]) == 0
+    assert "kicked" in capsys.readouterr().out
+    with pytest.raises(SystemExit):
+        cli_main(["--url", url, "clients", "show", "ghost"])

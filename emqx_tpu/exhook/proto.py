@@ -1,0 +1,84 @@
+"""Exhook wire protocol — the ``exhook.proto`` surface
+(apps/emqx_exhook/priv/protos/exhook.proto:29-72) over length-prefixed
+codec frames.
+
+RPCs (same names/cardinality as the reference's 21-RPC HookProvider
+service, plus the TPU-era batch publish):
+
+    OnProviderLoaded(broker)             → {hooks: [hookpoint...]}
+    OnProviderUnloaded()
+    OnClientConnect/Connack/Connected/Disconnected(...)
+    OnClientAuthenticate(clientinfo)     → valued bool
+    OnClientAuthorize(clientinfo, action, topic) → valued bool
+    OnClientSubscribe/Unsubscribe(...)
+    OnSessionCreated/Subscribed/Unsubscribed/Resumed/Discarded/
+      Takenover/Terminated(...)
+    OnMessagePublish(message)            → valued message (rewrite/drop)
+    OnMessagePublishBatch(messages)      → per-message verdicts  [TPU]
+    OnMessageDelivered/Acked/Dropped(...)
+
+Responses carry {"type": "CONTINUE" | "STOP_AND_RETURN" | "IGNORE",
+"value": ...} — the ValuedResponse of the reference.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Optional
+
+from emqx_tpu.cluster import codec
+
+# hookpoint name (broker side) → RPC name
+HOOK_RPCS = {
+    "client.connect": "OnClientConnect",
+    "client.connack": "OnClientConnack",
+    "client.connected": "OnClientConnected",
+    "client.disconnected": "OnClientDisconnected",
+    "client.authenticate": "OnClientAuthenticate",
+    "client.authorize": "OnClientAuthorize",
+    "client.subscribe": "OnClientSubscribe",
+    "client.unsubscribe": "OnClientUnsubscribe",
+    "session.created": "OnSessionCreated",
+    "session.subscribed": "OnSessionSubscribed",
+    "session.unsubscribed": "OnSessionUnsubscribed",
+    "session.resumed": "OnSessionResumed",
+    "session.discarded": "OnSessionDiscarded",
+    "session.takenover": "OnSessionTakenover",
+    "session.terminated": "OnSessionTerminated",
+    "message.publish": "OnMessagePublish",
+    "message.delivered": "OnMessageDelivered",
+    "message.acked": "OnMessageAcked",
+    "message.dropped": "OnMessageDropped",
+}
+RPC_HOOKS = {v: k for k, v in HOOK_RPCS.items()}
+
+CONTINUE = "CONTINUE"
+STOP_AND_RETURN = "STOP_AND_RETURN"
+IGNORE = "IGNORE"
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    body = codec.encode(obj)
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Any]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (ln,) = struct.unpack(">I", head)
+    body = _recv_exact(sock, ln)
+    if body is None:
+        return None
+    return codec.decode(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

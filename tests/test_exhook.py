@@ -1,0 +1,161 @@
+"""Exhook tests: provider load/dispatch, rewrite/drop on publish,
+authenticate/authorize verdicts, failed_action semantics, batch RPC
+(reference ground: emqx_exhook_SUITE + its demo gRPC server)."""
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.core.message import Message
+from emqx_tpu.exhook import proto
+from emqx_tpu.exhook.provider import HookProvider, ProviderServer
+from emqx_tpu.exhook.server import ExhookMgr, ExhookServer
+from emqx_tpu.mqtt import packet as P
+
+
+class RewritingProvider(HookProvider):
+    """Rewrites topics under rw/, drops topics under blk/, denies
+    user 'mallory', records lifecycle notifications."""
+
+    def __init__(self) -> None:
+        self.events: list[tuple] = []
+
+    def on_client_authenticate(self, args):
+        ci = args.get("clientinfo") or {}
+        if ci.get("username") == "mallory":
+            return {"type": proto.STOP_AND_RETURN,
+                    "value": {"result": False}}
+        return {"type": proto.CONTINUE}
+
+    def on_client_authorize(self, args):
+        if args.get("topic", "").startswith("forbidden/"):
+            return {"type": proto.STOP_AND_RETURN,
+                    "value": {"result": False}}
+        return {"type": proto.CONTINUE}
+
+    def on_message_publish(self, args):
+        m = args["message"]
+        if m["topic"].startswith("blk/"):
+            return {"type": proto.STOP_AND_RETURN, "value": {"drop": True}}
+        if m["topic"].startswith("rw/"):
+            m = {**m, "topic": "rewritten/" + m["topic"][3:],
+                 "payload": m["payload"] + b"!"}
+            return {"type": proto.STOP_AND_RETURN,
+                    "value": {"message": m}}
+        return {"type": proto.CONTINUE}
+
+    def on_client_connected(self, args):
+        self.events.append(("connected", args))
+
+
+@pytest.fixture()
+def wired():
+    prov = RewritingProvider()
+    psrv = ProviderServer(prov)
+    psrv.start()
+    app = BrokerApp()
+    mgr = ExhookMgr(metrics=app.metrics)
+    mgr.attach(app.hooks)
+    server = ExhookServer("default", psrv.host, psrv.port,
+                          pool_size=2, timeout_s=2.0)
+    wanted = mgr.enable(server)
+    yield app, mgr, prov, psrv, wanted
+    mgr.disable("default")
+    psrv.stop()
+
+
+def _connect(app, clientid="c1", username=None):
+    ch = Channel(app.broker, app.cm)
+    out = ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid=clientid,
+                                 username=username))
+    return ch, out
+
+
+def test_provider_loaded_hooks(wired):
+    _app, _mgr, _prov, _psrv, wanted = wired
+    assert "message.publish" in wanted
+    assert "client.authenticate" in wanted
+    assert "client.authorize" in wanted
+    assert "client.connected" in wanted
+    assert "session.created" not in wanted        # not overridden
+
+
+def test_exhook_authenticate_deny(wired):
+    app, *_ = wired
+    _ch, out = _connect(app, username="mallory")
+    assert out[0].reason_code == P.RC_NOT_AUTHORIZED
+    _ch, out = _connect(app, clientid="c2", username="alice")
+    assert out[0].reason_code == P.RC_SUCCESS
+
+
+def test_exhook_authorize_and_publish_rewrite(wired):
+    app, *_ = wired
+    watcher, _ = _connect(app, "w")
+    watcher.handle_in(P.Subscribe(packet_id=1, topic_filters=[
+        ("rewritten/#", {"qos": 0}), ("blk/#", {"qos": 0}),
+        ("rw/#", {"qos": 0})]))
+    dev, _ = _connect(app, "d")
+    # rewrite: rw/x → rewritten/x with payload suffix
+    dev.handle_in(P.Publish(topic="rw/x", qos=0, payload=b"data"))
+    pubs = [p for p in watcher.outbox if isinstance(p, P.Publish)]
+    assert len(pubs) == 1
+    assert pubs[0].topic == "rewritten/x" and pubs[0].payload == b"data!"
+    # drop: blk/* never delivered
+    dev.handle_in(P.Publish(topic="blk/secret", qos=0, payload=b"x"))
+    assert len([p for p in watcher.outbox
+                if isinstance(p, P.Publish)]) == 1
+    # authorize: forbidden/* → puback error
+    acks = dev.handle_in(P.Publish(topic="forbidden/z", qos=1,
+                                   packet_id=9, payload=b""))
+    assert acks[0].reason_code == P.RC_NOT_AUTHORIZED
+
+
+def test_exhook_notifications(wired):
+    app, _mgr, prov, *_ = wired
+    _connect(app, "notifyme")
+    import time
+    deadline = time.time() + 2
+    while not prov.events and time.time() < deadline:
+        time.sleep(0.01)
+    assert prov.events and prov.events[0][0] == "connected"
+    assert prov.events[0][1]["args"][0]["clientid"] == "notifyme"
+
+
+def test_batch_publish_rpc(wired):
+    _app, mgr, *_ = wired
+    msgs = [Message(topic="rw/a", payload=b"1"),
+            Message(topic="blk/b", payload=b"2"),
+            Message(topic="ok/c", payload=b"3")]
+    out = mgr.on_message_publish_batch(msgs)
+    assert out[0].topic == "rewritten/a" and out[0].payload == b"1!"
+    assert out[1] is None                          # dropped
+    assert out[2].topic == "ok/c"                  # untouched
+
+
+def test_failed_action_deny_vs_ignore():
+    app = BrokerApp()
+    mgr = ExhookMgr()
+    mgr.attach(app.hooks)
+    # no listener on this port → every call fails fast
+    dead = ExhookServer("dead", "127.0.0.1", 9, pool_size=1,
+                        timeout_s=0.2, failed_action="deny")
+    dead.loaded = True
+    dead.hooks_wanted = ["message.publish", "client.authenticate"]
+    mgr.servers["dead"] = dead
+    _ch, out = _connect(app, "x")
+    assert out[0].reason_code == P.RC_NOT_AUTHORIZED   # deny on failure
+    dead.failed_action = "ignore"
+    _ch, out = _connect(app, "y")
+    assert out[0].reason_code == P.RC_SUCCESS          # ignore on failure
+    # publish with deny drops the message
+    dead.failed_action = "deny"
+    deliveries = app.broker.publish(Message(topic="t/1", payload=b"x"))
+    assert deliveries == {}
+
+
+def test_disable_removes_provider(wired):
+    app, mgr, *_ = wired
+    assert mgr.disable("default")
+    _ch, out = _connect(app, "afterwards", username="mallory")
+    assert out[0].reason_code == P.RC_SUCCESS      # no provider anymore
+    assert not mgr.disable("default")

@@ -32,6 +32,7 @@ class BrokerApp:
         router_model=None,
         forward_fn=None,
         access_control=None,
+        persistent_store=None,   # session.persistent store; None = disabled
     ):
         from emqx_tpu.observe.alarm import AlarmManager
         from emqx_tpu.observe.metrics import Metrics
@@ -51,7 +52,18 @@ class BrokerApp:
             access_control = AccessControl()
         self.access = access_control
         self.access.attach(self.hooks)
-        self.cm = CM()
+        # persistent sessions (opt-in, like the reference's
+        # persistent_session_store.enable) — must exist before the CM so
+        # resume can consult it
+        self.persistent = None
+        if persistent_store is not None:
+            from emqx_tpu.session.persistent import PersistentSessions
+
+            self.persistent = PersistentSessions(
+                store=persistent_store,
+                is_persistent=self._session_is_persistent,
+            )
+        self.cm = CM(persistence=self.persistent)
         self.shared = SharedSub(node=node, strategy=shared_strategy)
         self.broker = Broker(
             node=node,
@@ -82,6 +94,12 @@ class BrokerApp:
         self.hooks.add("session.unsubscribed", self._shared_on_unsubscribe)
         self.hooks.add("session.terminated", self._shared_on_terminated)
         self.hooks.add("session.discarded", self._shared_on_terminated)
+        if self.persistent is not None:
+            self.persistent.attach(self.hooks)
+            self.hooks.add("client.disconnected", self._persistent_on_disc)
+            self.hooks.add(
+                "client.connected",
+                lambda ci: self.persistent.note_connected(ci.clientid))
         self._wire_observability()
 
     # -- observability -------------------------------------------------------
@@ -258,6 +276,18 @@ class BrokerApp:
         if msgs:
             self.cm.dispatch({sid: [(topic, m) for m in msgs]})
 
+    # -- persistent sessions -------------------------------------------------
+
+    def _session_is_persistent(self, sid: str) -> bool:
+        ch = self.cm.lookup_channel(sid)
+        return (ch is not None
+                and getattr(ch.conninfo, "expiry_interval_ms", 0) > 0)
+
+    def _persistent_on_disc(self, ci, reason) -> None:
+        if ci.expiry_interval_ms > 0 and ci.clientid:
+            self.persistent.note_disconnected(
+                ci.clientid, ci.expiry_interval_ms)
+
     # -- shared subs --------------------------------------------------------
 
     def _shared_on_subscribe(self, sid: str, topic: str, opts,
@@ -297,6 +327,8 @@ class BrokerApp:
         self.access.banned.expire()
         for fn in self._tickers:
             fn()
+        if self.persistent is not None:
+            self.persistent.gc()
         if self.access.flapping is not None:
             self.access.flapping.gc()
         for p in self.access.authn.providers:

@@ -112,7 +112,11 @@ class Broker:
 
     # -- subscribe / unsubscribe (emqx_broker.erl:134-173) ------------------
 
-    def subscribe(self, sid: Sid, topic: str, opts: Optional[SubOpts] = None) -> None:
+    def subscribe(self, sid: Sid, topic: str, opts: Optional[SubOpts] = None,
+                  restore: bool = False) -> None:
+        """``restore=True`` rebuilds tables/routes for a resumed session
+        without firing 'session.subscribed' — a resume is not a SUBSCRIBE,
+        so retained messages must not re-dispatch (MQTT5 3.8.3.1)."""
         opts = opts or SubOpts()
         group, real_topic = T.parse_share(topic)
         if group:
@@ -142,7 +146,8 @@ class Broker:
                         self._ensure_model_capacity()
                         self.model.subscribe(real_topic, slot)
         # is_new lets rh=1 (send-retained-if-new) distinguish resubscribes
-        self.hooks.run("session.subscribed", (sid, topic, opts, is_new))
+        if not restore:
+            self.hooks.run("session.subscribed", (sid, topic, opts, is_new))
 
     def unsubscribe(self, sid: Sid, topic: str) -> bool:
         group, real_topic = T.parse_share(topic)

@@ -213,6 +213,12 @@ class Channel:
             pkt.clean_start, clientid, self, self.session_opts
         )
         self.session = session
+        # restart-resume: the store prefilled session.subscriptions —
+        # rebuild the broker's routes/tables for any not already live
+        for sub_topic, sub_opts in session.subscriptions.items():
+            if (clientid, sub_topic) not in self.broker.suboption:
+                self.broker.subscribe(clientid, sub_topic, sub_opts,
+                                      restore=True)
         ci.connected_at = now_ms()
         self.conn_state = "connected"
         self.hooks.run("client.connected", (ci,))

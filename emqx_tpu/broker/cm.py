@@ -16,10 +16,14 @@ from emqx_tpu.session.session import Session
 
 
 class CM:
-    def __init__(self) -> None:
+    def __init__(self, persistence: Any = None) -> None:
         self._channels: dict[str, Any] = {}     # clientid -> Channel
         self._locks: dict[str, threading.Lock] = {}
         self._glock = threading.Lock()
+        # optional PersistentSessions service: the restart-surviving tier
+        # behind the in-memory disconnected-channel state (emqx_cm checks
+        # emqx_persistent_session on resume with no live channel)
+        self.persistence = persistence
 
     def _lock_for(self, clientid: str) -> threading.Lock:
         with self._glock:
@@ -55,6 +59,10 @@ class CM:
             if clean_start:
                 if old is not None and old is not new_channel:
                     old.discard()                     # kicked (RC 0x8E)
+                elif self.persistence is not None:
+                    # no live channel, but a clean start still wipes any
+                    # stored session state (MQTT5 3.1.2.4)
+                    self.persistence.discard(clientid)
                 session = Session(
                     clientid=clientid, clean_start=True,
                     **(session_opts or {}),
@@ -67,12 +75,30 @@ class CM:
                 self._channels[clientid] = new_channel
                 if session is not None:
                     session.clean_start = False
+                    if (self.persistence is not None
+                            and self.persistence.lookup(clientid)
+                            is not None):
+                        # consume the stored markers too, or a later node
+                        # restart replays messages this takeover already
+                        # delivered; merge any the in-memory queue dropped
+                        _subs, stored = self.persistence.resume(clientid)
+                        seen = {m.id for m in pending}
+                        pending = pending + [
+                            m for m in stored if m.id not in seen
+                        ]
                     return session, True, pending
             self._channels[clientid] = new_channel
             session = Session(
                 clientid=clientid, clean_start=False,
                 **(session_opts or {}),
             )
+            # restart-resume: no live channel — replay from the store
+            # (emqx_persistent_session:resume, :275-310)
+            if (self.persistence is not None
+                    and self.persistence.lookup(clientid) is not None):
+                subs, pending = self.persistence.resume(clientid)
+                session.subscriptions.update(subs)
+                return session, True, pending
             return session, False, []
 
     def dispatch(self, deliveries: dict[str, list]) -> None:
@@ -81,6 +107,13 @@ class CM:
             ch = self._channels.get(sid)
             if ch is not None:
                 ch.send(ch.handle_deliver(items))
+                if (self.persistence is not None
+                        and ch.conn_state == "connected"):
+                    # reached a live connection: the replay marker is
+                    # spent (disconnected sessions keep theirs so a node
+                    # restart can replay from the store)
+                    self.persistence.mark_delivered(
+                        sid, [m.id for _, m in items])
 
     def kick(self, clientid: str) -> bool:
         """Administrative kick (emqx_cm:kick_session)."""

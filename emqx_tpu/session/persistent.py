@@ -1,0 +1,431 @@
+"""Persistent sessions — parity with ``apps/emqx/src/persistent_session/``.
+
+The in-memory layer already survives *disconnects* (a disconnected
+channel keeps its session until expiry — emqx_channel disconnected
+state). This subsystem adds what the reference's opt-in persistence
+adds: surviving a *node restart*. Three pieces, mirroring the reference:
+
+- ``SessionRouter``: a dedicated route table + trie for persistent
+  sessions (emqx_session_router.erl + the ``*_session`` trie variants,
+  emqx_trie.erl:84-106) so ``persist_message`` can cheaply find which
+  persistent sessions a publish matches.
+- message persistence: every published message matching a persistent
+  session's filters is stored once (by GUID) plus one unconsumed marker
+  per matching session (emqx_persistent_session.erl:93-109); markers are
+  consumed on delivery / resume-replay; GC drops fully-consumed
+  messages and expired sessions (emqx_persistent_session_gc.erl).
+- resume: a clean_start=false CONNECT with no live channel replays the
+  saved subscriptions + pending messages from the store
+  (emqx_persistent_session.erl:275-310).
+
+Backends mirror the reference's trio: ``MemStore`` (ram copies),
+``DiskStore`` (append-only op log + compaction — the disc/rocksdb slot,
+kept host-side: SURVEY §5 "the HBM trie is a pure cache; persistence
+stays host-side"), and ``DummyStore`` (the null backend,
+emqx_persistent_session_backend_dummy.erl).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from typing import Any, Optional
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import Message, SubOpts, now_ms
+from emqx_tpu.router.trie import Trie
+
+
+def msg_to_dict(m: Message) -> dict:
+    return {
+        "topic": m.topic,
+        "payload": base64.b64encode(m.payload).decode(),
+        "qos": m.qos,
+        "from": m.from_,
+        "id": m.id,
+        "flags": m.flags,
+        "headers": {k: v for k, v in m.headers.items()
+                    if isinstance(v, (str, int, float, bool, dict, list))},
+        "timestamp": m.timestamp,
+    }
+
+
+def msg_from_dict(d: dict) -> Message:
+    return Message(
+        topic=d["topic"],
+        payload=base64.b64decode(d["payload"]),
+        qos=d["qos"],
+        from_=d["from"],
+        id=d["id"],
+        flags=dict(d.get("flags") or {}),
+        headers=dict(d.get("headers") or {}),
+        timestamp=d["timestamp"],
+    )
+
+
+class SessionRouter:
+    """filter → persistent session ids, trie-indexed for publish match."""
+
+    def __init__(self) -> None:
+        self._trie = Trie()
+        self._routes: dict[str, set[str]] = {}     # filter -> sids
+        self._lock = threading.RLock()
+
+    def add_route(self, filt: str, sid: str) -> None:
+        with self._lock:
+            sids = self._routes.setdefault(filt, set())
+            if not sids and T.wildcard(filt):
+                self._trie.insert(filt)
+            sids.add(sid)
+
+    def delete_route(self, filt: str, sid: str) -> None:
+        with self._lock:
+            sids = self._routes.get(filt)
+            if sids is None:
+                return
+            sids.discard(sid)
+            if not sids:
+                del self._routes[filt]
+                if T.wildcard(filt):
+                    self._trie.delete(filt)
+
+    def match(self, topic: str) -> set[str]:
+        return set(self.match_filters(topic))
+
+    def match_filters(self, topic: str) -> dict[str, str]:
+        """sid → one matching filter (the sub_topic the replayed message
+        is delivered under)."""
+        with self._lock:
+            out: dict[str, str] = {}
+            for filt in [topic, *self._trie.match(topic)]:
+                for sid in self._routes.get(filt, ()):
+                    out.setdefault(sid, filt)
+            return out
+
+    def routes_of(self, sid: str) -> list[str]:
+        with self._lock:
+            return [f for f, sids in self._routes.items() if sid in sids]
+
+    def is_empty(self) -> bool:
+        return not self._routes
+
+
+class MemStore:
+    """RAM backend (mnesia ram_copies analogue) — fast, not restart-safe."""
+
+    persistent = True
+
+    def __init__(self) -> None:
+        self.sessions: dict[str, dict] = {}     # sid -> {subs, expiry_ms, ts}
+        self.messages: dict[int, dict] = {}     # guid -> msg dict
+        self.markers: dict[str, dict[int, str]] = {}  # sid -> {guid: sub_topic}
+
+    def put_session(self, sid: str, record: dict) -> None:
+        self.sessions[sid] = record
+
+    def get_session(self, sid: str) -> Optional[dict]:
+        return self.sessions.get(sid)
+
+    def delete_session(self, sid: str) -> None:
+        self.sessions.pop(sid, None)
+        self.markers.pop(sid, None)
+
+    def put_message(self, guid: int, msg: dict) -> None:
+        self.messages.setdefault(guid, msg)
+
+    def put_marker(self, sid: str, guid: int, sub_topic: str) -> None:
+        self.markers.setdefault(sid, {})[guid] = sub_topic
+
+    def consume_marker(self, sid: str, guid: int) -> None:
+        self.markers.get(sid, {}).pop(guid, None)
+
+    def pending(self, sid: str) -> list[tuple[int, str]]:
+        return list(self.markers.get(sid, {}).items())
+
+    def gc_messages(self) -> int:
+        live = {g for ms in self.markers.values() for g in ms}
+        dead = [g for g in self.messages if g not in live]
+        for g in dead:
+            del self.messages[g]
+        return len(dead)
+
+    def all_sessions(self) -> list[tuple[str, dict]]:
+        return list(self.sessions.items())
+
+    def close(self) -> None:
+        pass
+
+
+class DummyStore(MemStore):
+    """Null backend (emqx_persistent_session_backend_dummy.erl): accepts
+    every write, remembers nothing."""
+
+    persistent = False
+
+    def put_session(self, sid: str, record: dict) -> None:
+        pass
+
+    def put_message(self, guid: int, msg: dict) -> None:
+        pass
+
+    def put_marker(self, sid: str, guid: int, sub_topic: str) -> None:
+        pass
+
+
+class DiskStore(MemStore):
+    """Append-only JSON op log + in-memory index; compacts when the log
+    grows past ``compact_every`` ops. Restart-safe."""
+
+    def __init__(self, dir: str, compact_every: int = 10_000) -> None:
+        super().__init__()
+        self.dir = dir
+        self.compact_every = compact_every
+        self._ops = 0
+        self._lock = threading.RLock()
+        os.makedirs(dir, exist_ok=True)
+        self._path = os.path.join(dir, "sessions.log")
+        self._replay()
+        self._f = open(self._path, "a")
+
+    def _replay(self) -> None:
+        try:
+            with open(self._path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        op = json.loads(line)
+                    except ValueError:
+                        continue                  # torn tail write
+                    self._apply(op)
+                    self._ops += 1
+        except FileNotFoundError:
+            pass
+
+    def _apply(self, op: dict) -> None:
+        kind = op["op"]
+        if kind == "sess":
+            MemStore.put_session(self, op["sid"], op["rec"])
+        elif kind == "del_sess":
+            MemStore.delete_session(self, op["sid"])
+        elif kind == "msg":
+            MemStore.put_message(self, op["guid"], op["m"])
+        elif kind == "mark":
+            MemStore.put_marker(self, op["sid"], op["guid"], op["st"])
+        elif kind == "consume":
+            MemStore.consume_marker(self, op["sid"], op["guid"])
+
+    def _log(self, op: dict) -> None:
+        with self._lock:
+            self._f.write(json.dumps(op) + "\n")
+            self._f.flush()
+            self._ops += 1
+            if self._ops >= self.compact_every:
+                self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the log as the current state (drops consumed churn)."""
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            for sid, rec in self.sessions.items():
+                f.write(json.dumps({"op": "sess", "sid": sid, "rec": rec}) + "\n")
+            live = {g for ms in self.markers.values() for g in ms}
+            for guid, m in self.messages.items():
+                if guid in live:
+                    f.write(json.dumps({"op": "msg", "guid": guid, "m": m}) + "\n")
+            for sid, ms in self.markers.items():
+                for guid, st in ms.items():
+                    f.write(json.dumps(
+                        {"op": "mark", "sid": sid, "guid": guid, "st": st}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self._path)
+        self._f = open(self._path, "a")
+        self._ops = len(self.sessions) + len(self.messages) + sum(
+            len(m) for m in self.markers.values())
+
+    def put_session(self, sid: str, record: dict) -> None:
+        MemStore.put_session(self, sid, record)
+        self._log({"op": "sess", "sid": sid, "rec": record})
+
+    def delete_session(self, sid: str) -> None:
+        MemStore.delete_session(self, sid)
+        self._log({"op": "del_sess", "sid": sid})
+
+    def put_message(self, guid: int, msg: dict) -> None:
+        if guid not in self.messages:
+            MemStore.put_message(self, guid, msg)
+            self._log({"op": "msg", "guid": guid, "m": msg})
+
+    def put_marker(self, sid: str, guid: int, sub_topic: str) -> None:
+        MemStore.put_marker(self, sid, guid, sub_topic)
+        self._log({"op": "mark", "sid": sid, "guid": guid, "st": sub_topic})
+
+    def consume_marker(self, sid: str, guid: int) -> None:
+        if guid in self.markers.get(sid, {}):
+            MemStore.consume_marker(self, sid, guid)
+            self._log({"op": "consume", "sid": sid, "guid": guid})
+
+    def gc_messages(self) -> int:
+        with self._lock:
+            n = MemStore.gc_messages(self)
+            if n:
+                self._compact()
+            return n
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class PersistentSessions:
+    """The service: hook-wired message persistence + resume/discard/GC.
+
+    ``is_persistent(sid)`` tells whether a live session opted in (MQTT5
+    Session-Expiry-Interval > 0 / v3 clean_start=false); the app wires it
+    to the CM. Persistence is a *superset* of the in-memory disconnected
+    state — resume prefers the live channel (takeover) and only falls
+    back to the store after a restart.
+    """
+
+    def __init__(self, store=None, is_persistent=None) -> None:
+        self.store = store if store is not None else MemStore()
+        self.router = SessionRouter()
+        self.is_persistent = is_persistent or (lambda sid: True)
+        self._lock = threading.RLock()
+        # restore session routes from a restart-surviving store
+        for sid, rec in self.store.all_sessions():
+            for filt in rec.get("subs", {}):
+                group, real = T.parse_share(filt)
+                if group is None:
+                    self.router.add_route(real, sid)
+
+    # -- hook wiring ---------------------------------------------------------
+
+    def attach(self, hooks) -> None:
+        # persist after the service layer has had its say (retainer at
+        # -100 observes too; we only need to run after delayed's STOP)
+        hooks.add("message.publish", self._on_publish, priority=-200)
+        hooks.add("session.subscribed", self._on_subscribed)
+        hooks.add("session.unsubscribed", self._on_unsubscribed)
+        hooks.add("session.discarded", self.discard)
+        hooks.add("session.terminated", lambda sid, reason: self.discard(sid))
+
+    def _on_publish(self, msg: Message):
+        if not msg.sys:
+            self.persist_message(msg)
+        return None
+
+    def _on_subscribed(self, sid: str, topic: str, opts: SubOpts,
+                       is_new: bool = True) -> None:
+        if not self.is_persistent(sid):
+            return
+        group, real = T.parse_share(topic)
+        if group is not None:
+            return            # shared subs are not persisted (reference)
+        with self._lock:
+            self.router.add_route(real, sid)
+            rec = self.store.get_session(sid) or {
+                "subs": {}, "ts": now_ms()}
+            rec["subs"][topic] = opts.__dict__
+            self.store.put_session(sid, rec)
+
+    def _on_unsubscribed(self, sid: str, topic: str) -> None:
+        group, real = T.parse_share(topic)
+        if group is not None:
+            return
+        with self._lock:
+            self.router.delete_route(real, sid)
+            rec = self.store.get_session(sid)
+            if rec is not None and topic in rec.get("subs", {}):
+                del rec["subs"][topic]
+                self.store.put_session(sid, rec)
+
+    # -- persistence ---------------------------------------------------------
+
+    def persist_message(self, msg: Message) -> int:
+        """Store msg + one marker per matching persistent session
+        (emqx_persistent_session:persist_message). Returns marker count."""
+        sids = self.router.match_filters(msg.topic)
+        if not sids:
+            return 0
+        d = msg_to_dict(msg)
+        self.store.put_message(msg.id, d)
+        n = 0
+        for sid, filt in sids.items():
+            self.store.put_marker(sid, msg.id, filt)
+            n += 1
+        return n
+
+    def mark_delivered(self, sid: str, msg_ids: list[int]) -> None:
+        """Connected-path consumption: the message reached the session's
+        window, so its replay marker is spent."""
+        for mid in msg_ids:
+            self.store.consume_marker(sid, mid)
+
+    # -- resume / discard ----------------------------------------------------
+
+    def lookup(self, sid: str) -> Optional[dict]:
+        return self.store.get_session(sid)
+
+    def resume(self, sid: str) -> tuple[dict[str, SubOpts], list[Message]]:
+        """Returns (saved subscriptions, pending messages) and consumes
+        the replayed markers (emqx_persistent_session:resume)."""
+        rec = self.store.get_session(sid)
+        subs: dict[str, SubOpts] = {}
+        if rec is not None:
+            for topic, od in rec.get("subs", {}).items():
+                subs[topic] = SubOpts(**od)
+            if rec.get("disconnected_at") is not None:
+                rec.pop("disconnected_at", None)
+                self.store.put_session(sid, rec)
+        out: list[Message] = []
+        for guid, sub_topic in sorted(self.store.pending(sid)):
+            d = self.store.messages.get(guid)
+            if d is not None:
+                m = msg_from_dict(d)
+                if not m.is_expired():
+                    # deliver under the matched filter so the session can
+                    # find its SubOpts (the takeover path's sub_topic hdr)
+                    out.append(m.set_header("sub_topic", sub_topic))
+            self.store.consume_marker(sid, guid)
+        out.sort(key=lambda m: m.timestamp)
+        return subs, out
+
+    def discard(self, sid: str, *args) -> None:
+        with self._lock:
+            for filt in self.router.routes_of(sid):
+                self.router.delete_route(filt, sid)
+            self.store.delete_session(sid)
+
+    # -- GC (emqx_persistent_session_gc.erl) ---------------------------------
+
+    def gc(self, now: Optional[int] = None) -> int:
+        """Drop expired sessions, then messages with no live markers."""
+        now = now_ms() if now is None else now
+        for sid, rec in list(self.store.all_sessions()):
+            exp = rec.get("expiry_ms")
+            if exp and rec.get("disconnected_at") and \
+                    now - rec["disconnected_at"] >= exp:
+                self.discard(sid)
+        return self.store.gc_messages()
+
+    def note_disconnected(self, sid: str, expiry_ms: int,
+                          now: Optional[int] = None) -> None:
+        rec = self.store.get_session(sid)
+        if rec is not None:
+            rec["disconnected_at"] = now_ms() if now is None else now
+            rec["expiry_ms"] = expiry_ms
+            self.store.put_session(sid, rec)
+
+    def note_connected(self, sid: str) -> None:
+        """Reconnect cancels the expiry clock — otherwise gc() would
+        discard the stored session of a live client once the *old*
+        disconnect timestamp ages past the expiry interval."""
+        rec = self.store.get_session(sid)
+        if rec is not None and rec.get("disconnected_at") is not None:
+            rec.pop("disconnected_at", None)
+            self.store.put_session(sid, rec)

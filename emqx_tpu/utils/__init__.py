@@ -1,0 +1,1 @@
+"""Host-side utility kit (the reference's replayq / emqx_misc corner)."""

@@ -1,0 +1,282 @@
+"""Persistent sessions + replayq — mirrors emqx_persistent_session_SUITE
+(resume/replay/GC) and the replayq disk-queue contract."""
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.core.message import Message, SubOpts
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.session.persistent import (
+    DiskStore, DummyStore, MemStore, PersistentSessions, SessionRouter,
+)
+from emqx_tpu.utils.replayq import ReplayQ
+
+
+# -- replayq ----------------------------------------------------------------
+
+def test_replayq_mem_fifo():
+    q = ReplayQ(mem_only=True)
+    q.append([b"a", b"b", b"c"])
+    ref, items = q.pop(2)
+    assert items == [b"a", b"b"]
+    q.ack(ref)
+    assert q.pop(5)[1] == [b"c"]
+    assert q.count() == 1
+
+
+def test_replayq_disk_survives_reopen(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d)
+    q.append([b"one", b"two", b"three"])
+    ref, items = q.pop(1)
+    q.ack(ref)                       # consume "one"
+    q.close()
+    q2 = ReplayQ(d)
+    assert q2.pop(10)[1] == [b"two", b"three"]
+
+
+def test_replayq_ack_persists_across_segments(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d, seg_bytes=16)     # force several segments
+    q.append([bytes([65 + i]) * 10 for i in range(6)])
+    ref, _ = q.pop(4)
+    q.ack(ref)
+    q2 = ReplayQ(d)
+    assert q2.count() == 2
+    assert q2.pop(10)[1] == [b"E" * 10, b"F" * 10]
+
+
+def test_replayq_append_after_full_drain_survives_reopen(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d)
+    q.append([b"a"])
+    ref, _ = q.pop(1)
+    q.ack(ref)                       # queue fully drained
+    q.append([b"b"])                 # must not land below the ack point
+    q2 = ReplayQ(d)
+    assert q2.pop(10)[1] == [b"b"]
+
+
+def test_replayq_overflow_drops_new():
+    q = ReplayQ(mem_only=True, max_total_bytes=10)
+    assert q.append([b"12345", b"67890", b"xxxxx"]) == 2
+    assert q.dropped == 1
+
+
+# -- session router ---------------------------------------------------------
+
+def test_session_router_exact_and_wildcard():
+    r = SessionRouter()
+    r.add_route("a/b", "s1")
+    r.add_route("a/+", "s2")
+    r.add_route("a/#", "s3")
+    assert r.match("a/b") == {"s1", "s2", "s3"}
+    assert r.match("a/c") == {"s2", "s3"}
+    r.delete_route("a/+", "s2")
+    assert r.match("a/c") == {"s3"}
+
+
+# -- stores -----------------------------------------------------------------
+
+@pytest.mark.parametrize("mk", [
+    lambda tmp: MemStore(),
+    lambda tmp: DiskStore(str(tmp / "ps")),
+])
+def test_store_marker_lifecycle(mk, tmp_path):
+    s = mk(tmp_path)
+    s.put_session("c1", {"subs": {"a/+": {}}, "ts": 0})
+    s.put_message(7, {"topic": "a/b"})
+    s.put_marker("c1", 7, "a/+")
+    assert s.pending("c1") == [(7, "a/+")]
+    s.consume_marker("c1", 7)
+    assert s.pending("c1") == []
+    assert s.gc_messages() == 1
+    assert 7 not in s.messages
+
+
+def test_disk_store_replays_after_reopen(tmp_path):
+    d = str(tmp_path / "ps")
+    s = DiskStore(d)
+    s.put_session("c1", {"subs": {"t": {"qos": 1}}, "ts": 1})
+    s.put_message(42, {"topic": "t"})
+    s.put_marker("c1", 42, "t")
+    s.close()
+    s2 = DiskStore(d)
+    assert s2.get_session("c1")["subs"] == {"t": {"qos": 1}}
+    assert s2.pending("c1") == [(42, "t")]
+
+
+def test_disk_store_compaction_preserves_state(tmp_path):
+    s = DiskStore(str(tmp_path / "ps"), compact_every=10)
+    for i in range(30):
+        s.put_message(i, {"topic": f"t{i}"})
+        s.put_marker("c1", i, f"t{i}")
+        s.consume_marker("c1", i)
+    s.put_marker("c1", 29, "t29")          # one live marker
+    s.gc_messages()
+    s.close()
+    s2 = DiskStore(str(tmp_path / "ps"))
+    assert s2.pending("c1") == [(29, "t29")]
+    assert set(s2.messages) == {29}
+
+
+def test_dummy_store_remembers_nothing():
+    s = DummyStore()
+    s.put_session("c1", {"subs": {}})
+    s.put_message(1, {})
+    s.put_marker("c1", 1, "t")
+    assert s.get_session("c1") is None
+    assert s.pending("c1") == []
+
+
+# -- service-level persist/resume -------------------------------------------
+
+def _mkmsg(topic, payload=b"x", **kw):
+    return Message(topic=topic, payload=payload, **kw)
+
+
+def test_persist_message_stores_one_marker_per_session():
+    ps = PersistentSessions(MemStore())
+    ps.router.add_route("a/+", "c1")
+    ps.router.add_route("a/b", "c2")
+    m = _mkmsg("a/b")
+    assert ps.persist_message(m) == 2
+    assert ps.store.pending("c1") == [(m.id, "a/+")]
+
+
+def test_resume_replays_in_publish_order():
+    ps = PersistentSessions(MemStore())
+    ps.router.add_route("t", "c1")
+    m1, m2 = _mkmsg("t", b"1"), _mkmsg("t", b"2")
+    ps.persist_message(m1)
+    ps.persist_message(m2)
+    subs, pending = ps.resume("c1")
+    assert [m.payload for m in pending] == [b"1", b"2"]
+    # markers consumed: a second resume replays nothing
+    assert ps.resume("c1")[1] == []
+
+
+def test_gc_drops_expired_sessions():
+    ps = PersistentSessions(MemStore())
+    ps.store.put_session("c1", {"subs": {"t": {}}, "ts": 0})
+    ps.router.add_route("t", "c1")
+    ps.note_disconnected("c1", expiry_ms=1000, now=1_000_000)
+    ps.gc(now=1_000_500)
+    assert ps.lookup("c1") is not None
+    ps.gc(now=1_002_000)
+    assert ps.lookup("c1") is None
+    assert ps.router.match("t") == set()
+
+
+# -- end-to-end: broker restart resume --------------------------------------
+
+class Client:
+    """Packet-level client bound to an app (the emqtt stand-in)."""
+
+    def __init__(self, app, clientid, **connect_kw):
+        self.app = app
+        self.ch = Channel(app.broker, app.cm)
+        self.out = self.ch.handle_in(P.Connect(
+            clientid=clientid, proto_ver=P.MQTT_V5, **connect_kw))
+
+    def subscribe(self, topic, qos=1):
+        return self.ch.handle_in(P.Subscribe(
+            packet_id=1, topic_filters=[(topic, {"qos": qos})]))
+
+    def publish(self, topic, payload, qos=1, pid=10):
+        return self.ch.handle_in(P.Publish(
+            topic=topic, payload=payload, qos=qos, packet_id=pid))
+
+
+def _app(tmp_path):
+    return BrokerApp(persistent_store=DiskStore(str(tmp_path / "ps")))
+
+
+def test_restart_resume_replays_offline_messages(tmp_path):
+    app1 = _app(tmp_path)
+    sub = Client(app1, "sub1",
+                 properties={"Session-Expiry-Interval": 3600})
+    sub.subscribe("news/+")
+    # publisher on the same node
+    pub = Client(app1, "pub1")
+    pub.publish("news/a", b"while-up", qos=1)
+    # delivered live → marker consumed; now the node "crashes"
+    app1.persistent.store.close()
+
+    # a second node boots on the same store: only subscriptions survive
+    app2 = _app(tmp_path)
+    # messages published while sub1's node is gone
+    pub2 = Client(app2, "pub2")
+    pub2.publish("news/b", b"while-down", qos=1)
+
+    sub2 = Client(app2, "sub1", clean_start=False,
+                  properties={"Session-Expiry-Interval": 3600})
+    connack = sub2.out[0]
+    assert connack.session_present is True
+    # the offline message replays; the live-delivered one does not
+    pubs = [p for p in sub2.out if isinstance(p, P.Publish)]
+    assert [p.payload for p in pubs] == [b"while-down"]
+    assert pubs[0].topic == "news/b"
+    # subscription itself was restored into the broker
+    deliveries = app2.broker.publish(_mkmsg("news/c", b"live"))
+    assert "sub1" in deliveries
+
+
+def test_reconnect_cancels_expiry_clock(tmp_path):
+    app = _app(tmp_path)
+    c = Client(app, "c1", properties={"Session-Expiry-Interval": 1})
+    c.subscribe("t")
+    c.ch.terminate("sock_closed")           # starts the expiry clock
+    # reconnect (takeover) well before expiry, then stay connected
+    c2 = Client(app, "c1", clean_start=False,
+                properties={"Session-Expiry-Interval": 1})
+    assert c2.ch.conn_state == "connected"
+    rec = app.persistent.lookup("c1")
+    assert rec is not None and rec.get("disconnected_at") is None
+    app.persistent.gc(now=Message(topic="x").timestamp + 10_000_000)
+    assert app.persistent.lookup("c1") is not None
+
+
+def test_takeover_consumes_stored_markers(tmp_path):
+    app = _app(tmp_path)
+    sub = Client(app, "s1", properties={"Session-Expiry-Interval": 3600})
+    sub.subscribe("t")
+    sub.ch.terminate("sock_closed")
+    pub = Client(app, "p1")
+    pub.publish("t", b"offline", qos=1)
+    assert app.persistent.store.pending("s1")          # marker stored
+    sub2 = Client(app, "s1", clean_start=False,
+                  properties={"Session-Expiry-Interval": 3600})
+    pubs = [p for p in sub2.out if isinstance(p, P.Publish)]
+    assert [p.payload for p in pubs] == [b"offline"]   # delivered once
+    assert app.persistent.store.pending("s1") == []    # marker consumed
+
+
+def test_restart_resume_does_not_resend_retained(tmp_path):
+    app1 = _app(tmp_path)
+    pub = Client(app1, "p1")
+    pub.publish("t", b"retained-payload", qos=0, pid=None)
+    app1.broker.publish(Message(topic="t", payload=b"r",
+                                flags={"retain": True}))
+    sub = Client(app1, "s1", properties={"Session-Expiry-Interval": 3600})
+    out = sub.subscribe("t")
+    app1.persistent.store.close()
+    app2 = _app(tmp_path)
+    sub2 = Client(app2, "s1", clean_start=False,
+                  properties={"Session-Expiry-Interval": 3600})
+    # resume is not a SUBSCRIBE: the retained message must not replay
+    assert not [p for p in sub2.out if isinstance(p, P.Publish)
+                and p.retain]
+
+
+def test_clean_start_wipes_stored_session(tmp_path):
+    app1 = _app(tmp_path)
+    sub = Client(app1, "c1", properties={"Session-Expiry-Interval": 3600})
+    sub.subscribe("t")
+    app1.persistent.store.close()
+
+    app2 = _app(tmp_path)
+    c = Client(app2, "c1", clean_start=True)
+    assert c.out[0].session_present is False
+    assert app2.persistent.lookup("c1") is None

@@ -85,6 +85,10 @@ class BrokerApp:
         self.rules = RuleEngine(node=node,
                                 publish_fn=self._publish_dispatch)
         self.rules.attach(self.hooks)
+        from emqx_tpu.bridge.bridge import BridgeManager
+        self.bridges = BridgeManager(
+            rules=self.rules, publish_fn=self._publish_dispatch,
+            hooks=self.hooks)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)
@@ -329,6 +333,7 @@ class BrokerApp:
             fn()
         if self.persistent is not None:
             self.persistent.gc()
+        self.bridges.tick()
         if self.access.flapping is not None:
             self.access.flapping.gc()
         for p in self.access.authn.providers:

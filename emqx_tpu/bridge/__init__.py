@@ -1,0 +1,3 @@
+"""Data bridges — the ``emqx_bridge`` app."""
+
+from emqx_tpu.bridge.bridge import Bridge, BridgeManager   # noqa: F401

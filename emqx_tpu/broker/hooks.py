@@ -18,9 +18,12 @@ terminated, message.publish/delivered/acked/dropped.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -61,12 +64,24 @@ class Hooks:
 
     def run(self, name: str, args: tuple = ()) -> None:
         for cb in self._chain(name):
-            if cb.fn(*args) is Hooks.STOP:
+            try:
+                ret = cb.fn(*args)
+            except Exception:
+                # a crashing callback must not break the chain or kill
+                # the caller (emqx_hooks wraps every callback the same
+                # way: log and continue)
+                log.exception("hook %s callback %r crashed", name, cb.fn)
+                continue
+            if ret is Hooks.STOP:
                 return
 
     def run_fold(self, name: str, args: tuple, acc: Any) -> Any:
         for cb in self._chain(name):
-            ret = cb.fn(*args, acc)
+            try:
+                ret = cb.fn(*args, acc)
+            except Exception:
+                log.exception("hook %s callback %r crashed", name, cb.fn)
+                continue
             if ret is None:
                 continue
             if ret is Hooks.STOP:

@@ -52,6 +52,7 @@ class Connection:
         self.channel.conninfo.peername = f"{peer[0]}:{peer[1]}"
         self.metrics = getattr(server.app, "metrics", None)
         self.closed = False
+        self._loop = asyncio.get_event_loop()
 
     def _send_packets(self, pkts) -> None:
         if self.closed:
@@ -60,7 +61,17 @@ class Connection:
             serialize(p, self.channel.conninfo.proto_ver) for p in pkts
         )
         if data:
-            self.writer.write(data)
+            try:
+                on_loop = asyncio.get_running_loop() is self._loop
+            except RuntimeError:
+                on_loop = False
+            if on_loop:
+                self.writer.write(data)
+            else:
+                # dispatch from a foreign thread (bridge ingress, app
+                # tick in to_thread): asyncio transports are not
+                # thread-safe — marshal the write onto the owning loop
+                self._loop.call_soon_threadsafe(self.writer.write, data)
             if self.metrics is not None:
                 self.metrics.inc("bytes.sent", len(data))
                 for p in pkts:
@@ -207,7 +218,9 @@ class BrokerServer:
         while True:
             await asyncio.sleep(HOUSEKEEP_INTERVAL)
             if self.app is not None:
-                self.app.tick()          # delayed-publish scheduler etc.
+                # off-loop: the tick may block (bridge reconnects, disk
+                # queue flushes) and must never stall the accept loop
+                await asyncio.to_thread(self.app.tick)
             for conn in list(self.connections):
                 conn.housekeep()
 

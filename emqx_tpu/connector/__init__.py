@@ -1,0 +1,7 @@
+"""Connector clients — the ``emqx_connector`` app (HTTP, MQTT bridge,
+plus a memory test double standing in for the SQL/NoSQL pool clients).
+"""
+
+from emqx_tpu.connector.memory import MemoryConnector     # noqa: F401
+from emqx_tpu.connector.http import HttpConnector         # noqa: F401
+from emqx_tpu.connector.mqtt import MqttConnector         # noqa: F401

@@ -1,0 +1,4 @@
+"""Managed external-resource runtime — the ``emqx_resource`` app."""
+
+from emqx_tpu.resource.resource import Resource, ResourceManager   # noqa: F401
+from emqx_tpu.resource.worker import BufferWorker                  # noqa: F401

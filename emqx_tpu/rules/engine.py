@@ -113,6 +113,9 @@ class RuleEngine:
         """Custom action type (the bridge seam): fn(columns, args)."""
         self._action_types[name] = fn
 
+    def unregister_action(self, name: str) -> None:
+        self._action_types.pop(name, None)
+
     # -- hook wiring --------------------------------------------------------
 
     def attach(self, hooks: Hooks) -> None:
@@ -143,6 +146,12 @@ class RuleEngine:
             if r.enabled and any(T.match(topic, f)
                                  for f in r.publish_topics)
         ]
+
+    def ingest(self, msg: Message) -> None:
+        """Feed a non-broker message into rule matching — the bridge
+        ingress hook-topic path ('$bridges/...', emqx_rule_events.erl:145)
+        where rules fire without a broker publish."""
+        self._on_publish(msg)
 
     def _on_publish(self, msg: Message, *rest):
         if msg.topic.startswith("$SYS/"):

@@ -349,22 +349,24 @@ class PersistentSessions:
     def persist_message(self, msg: Message) -> int:
         """Store msg + one marker per matching persistent session
         (emqx_persistent_session:persist_message). Returns marker count."""
-        sids = self.router.match_filters(msg.topic)
-        if not sids:
-            return 0
-        d = msg_to_dict(msg)
-        self.store.put_message(msg.id, d)
-        n = 0
-        for sid, filt in sids.items():
-            self.store.put_marker(sid, msg.id, filt)
-            n += 1
-        return n
+        with self._lock:
+            sids = self.router.match_filters(msg.topic)
+            if not sids:
+                return 0
+            d = msg_to_dict(msg)
+            self.store.put_message(msg.id, d)
+            n = 0
+            for sid, filt in sids.items():
+                self.store.put_marker(sid, msg.id, filt)
+                n += 1
+            return n
 
     def mark_delivered(self, sid: str, msg_ids: list[int]) -> None:
         """Connected-path consumption: the message reached the session's
         window, so its replay marker is spent."""
-        for mid in msg_ids:
-            self.store.consume_marker(sid, mid)
+        with self._lock:
+            for mid in msg_ids:
+                self.store.consume_marker(sid, mid)
 
     # -- resume / discard ----------------------------------------------------
 
@@ -374,26 +376,27 @@ class PersistentSessions:
     def resume(self, sid: str) -> tuple[dict[str, SubOpts], list[Message]]:
         """Returns (saved subscriptions, pending messages) and consumes
         the replayed markers (emqx_persistent_session:resume)."""
-        rec = self.store.get_session(sid)
-        subs: dict[str, SubOpts] = {}
-        if rec is not None:
-            for topic, od in rec.get("subs", {}).items():
-                subs[topic] = SubOpts(**od)
-            if rec.get("disconnected_at") is not None:
-                rec.pop("disconnected_at", None)
-                self.store.put_session(sid, rec)
-        out: list[Message] = []
-        for guid, sub_topic in sorted(self.store.pending(sid)):
-            d = self.store.messages.get(guid)
-            if d is not None:
-                m = msg_from_dict(d)
-                if not m.is_expired():
-                    # deliver under the matched filter so the session can
-                    # find its SubOpts (the takeover path's sub_topic hdr)
-                    out.append(m.set_header("sub_topic", sub_topic))
-            self.store.consume_marker(sid, guid)
-        out.sort(key=lambda m: m.timestamp)
-        return subs, out
+        with self._lock:
+            rec = self.store.get_session(sid)
+            subs: dict[str, SubOpts] = {}
+            if rec is not None:
+                for topic, od in rec.get("subs", {}).items():
+                    subs[topic] = SubOpts(**od)
+                if rec.get("disconnected_at") is not None:
+                    rec.pop("disconnected_at", None)
+                    self.store.put_session(sid, rec)
+            out: list[Message] = []
+            for guid, sub_topic in sorted(self.store.pending(sid)):
+                d = self.store.messages.get(guid)
+                if d is not None:
+                    m = msg_from_dict(d)
+                    if not m.is_expired():
+                        # deliver under the matched filter so the session
+                        # can find its SubOpts (the takeover sub_topic hdr)
+                        out.append(m.set_header("sub_topic", sub_topic))
+                self.store.consume_marker(sid, guid)
+            out.sort(key=lambda m: m.timestamp)
+            return subs, out
 
     def discard(self, sid: str, *args) -> None:
         with self._lock:
@@ -405,27 +408,30 @@ class PersistentSessions:
 
     def gc(self, now: Optional[int] = None) -> int:
         """Drop expired sessions, then messages with no live markers."""
-        now = now_ms() if now is None else now
-        for sid, rec in list(self.store.all_sessions()):
-            exp = rec.get("expiry_ms")
-            if exp and rec.get("disconnected_at") and \
-                    now - rec["disconnected_at"] >= exp:
-                self.discard(sid)
-        return self.store.gc_messages()
+        with self._lock:
+            now = now_ms() if now is None else now
+            for sid, rec in list(self.store.all_sessions()):
+                exp = rec.get("expiry_ms")
+                if exp and rec.get("disconnected_at") and \
+                        now - rec["disconnected_at"] >= exp:
+                    self.discard(sid)
+            return self.store.gc_messages()
 
     def note_disconnected(self, sid: str, expiry_ms: int,
                           now: Optional[int] = None) -> None:
-        rec = self.store.get_session(sid)
-        if rec is not None:
-            rec["disconnected_at"] = now_ms() if now is None else now
-            rec["expiry_ms"] = expiry_ms
-            self.store.put_session(sid, rec)
+        with self._lock:
+            rec = self.store.get_session(sid)
+            if rec is not None:
+                rec["disconnected_at"] = now_ms() if now is None else now
+                rec["expiry_ms"] = expiry_ms
+                self.store.put_session(sid, rec)
 
     def note_connected(self, sid: str) -> None:
         """Reconnect cancels the expiry clock — otherwise gc() would
         discard the stored session of a live client once the *old*
         disconnect timestamp ages past the expiry interval."""
-        rec = self.store.get_session(sid)
-        if rec is not None and rec.get("disconnected_at") is not None:
-            rec.pop("disconnected_at", None)
-            self.store.put_session(sid, rec)
+        with self._lock:
+            rec = self.store.get_session(sid)
+            if rec is not None and rec.get("disconnected_at") is not None:
+                rec.pop("disconnected_at", None)
+                self.store.put_session(sid, rec)

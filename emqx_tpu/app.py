@@ -89,6 +89,8 @@ class BrokerApp:
         self.bridges = BridgeManager(
             rules=self.rules, publish_fn=self._publish_dispatch,
             hooks=self.hooks)
+        from emqx_tpu.gateway.ctx import GatewayManager
+        self.gateway = GatewayManager(self)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)

@@ -125,32 +125,41 @@ class BridgeManager:
             self.bridges[bid] = bridge
         if start:
             manager.start()
-        cleanups = []
-        # rule-action seam: actions reference bridges as "type:name"
-        if self.rules is not None:
-            self.rules.register_action(
-                bid, lambda columns, args, b=bridge: b.send(columns))
-            cleanups.append(lambda: self.rules.unregister_action(bid))
-        # direct egress from a local topic filter (config-only path)
-        local = ((conf.get("egress") or {}).get("local") or {})
-        if local.get("topic") and self.hooks is not None:
-            filt = local["topic"]
-            hook_fn = (lambda msg, b=bridge, f=filt:
-                       self._direct_egress(msg, b, f))
-            self.hooks.add("message.publish", hook_fn, priority=-150)
-            cleanups.append(
-                lambda: self.hooks.delete("message.publish", hook_fn))
-        # mqtt ingress leg
-        ingress = ((conf.get("ingress") or {}).get("remote") or {})
-        if ingress.get("topic") and hasattr(connector, "subscribe_remote"):
-            rfilt = ingress["topic"]
-            connector.subscribe_remote(
-                rfilt,
-                lambda t, p, q, b=bridge: self._on_ingress(b, t, p, q),
-            )
-            cleanups.append(
-                lambda: connector.unsubscribe_remote(rfilt))
-        bridge._cleanups = cleanups
+        # record each detach as soon as its attach lands, so a failure
+        # mid-way (e.g. ingress subscribe on a dead remote) can always
+        # unwind completely — otherwise delete() would leave a rule
+        # action / publish hook pointing at a dead bridge forever
+        bridge._cleanups = cleanups = []
+        try:
+            # rule-action seam: actions reference bridges as "type:name"
+            if self.rules is not None:
+                self.rules.register_action(
+                    bid, lambda columns, args, b=bridge: b.send(columns))
+                cleanups.append(
+                    lambda: self.rules.unregister_action(bid))
+            # direct egress from a local topic filter (config-only path)
+            local = ((conf.get("egress") or {}).get("local") or {})
+            if local.get("topic") and self.hooks is not None:
+                filt = local["topic"]
+                hook_fn = (lambda msg, b=bridge, f=filt:
+                           self._direct_egress(msg, b, f))
+                self.hooks.add("message.publish", hook_fn, priority=-150)
+                cleanups.append(
+                    lambda: self.hooks.delete("message.publish", hook_fn))
+            # mqtt ingress leg
+            ingress = ((conf.get("ingress") or {}).get("remote") or {})
+            if ingress.get("topic") and hasattr(connector,
+                                                "subscribe_remote"):
+                rfilt = ingress["topic"]
+                connector.subscribe_remote(
+                    rfilt,
+                    lambda t, p, q, b=bridge: self._on_ingress(b, t, p, q),
+                )
+                cleanups.append(
+                    lambda: connector.unsubscribe_remote(rfilt))
+        except Exception:
+            self.delete(bid)
+            raise
         return bridge
 
     def _direct_egress(self, msg: Message, bridge: Bridge, filt: str):

@@ -75,12 +75,19 @@ class Hooks:
             if ret is Hooks.STOP:
                 return
 
+    # folds whose accumulator is a security verdict: a crashing callback
+    # must abort the operation (fail closed), not fall through to the
+    # permissive default accumulator
+    FAIL_CLOSED = frozenset({"client.authenticate", "client.authorize"})
+
     def run_fold(self, name: str, args: tuple, acc: Any) -> Any:
         for cb in self._chain(name):
             try:
                 ret = cb.fn(*args, acc)
             except Exception:
                 log.exception("hook %s callback %r crashed", name, cb.fn)
+                if name in self.FAIL_CLOSED:
+                    raise
                 continue
             if ret is None:
                 continue

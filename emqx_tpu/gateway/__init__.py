@@ -1,0 +1,6 @@
+"""Polyglot edge-protocol gateways — the ``emqx_gateway`` app
+(STOMP, MQTT-SN, CoAP, LwM2M, ExProto behind shared behaviours)."""
+
+from emqx_tpu.gateway.ctx import (          # noqa: F401
+    GatewayManager, GwContext, GwFrame, GwChannel, GatewayImpl,
+)

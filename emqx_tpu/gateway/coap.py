@@ -1,0 +1,270 @@
+"""CoAP gateway — parity with ``apps/emqx_gateway/src/coap/``
+(message codec: emqx_coap_frame.erl / RFC 7252; pub-sub resource:
+emqx_coap_pubsub_handler.erl).
+
+Codec is full RFC 7252 (options with 13/14 delta/length extensions,
+tokens, all four message types). The pub/sub surface:
+
+    PUT/POST coap://host/ps/{topic}          → publish (2.04)
+    GET      .../ps/{topic} Observe:0        → subscribe (2.05 + seq)
+    GET      .../ps/{topic} Observe:1        → unsubscribe (2.07-ish 2.05)
+    GET      .../ps/{topic}                  → read latest retained (2.05/4.04)
+
+Observed deliveries arrive as NON 2.05 notifications carrying the
+subscribe token and a rolling Observe sequence.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from emqx_tpu.gateway.ctx import GatewayImpl, GwChannel, GwContext, GwFrame
+
+CON, NON, ACK, RST = 0, 1, 2, 3
+
+# method / response codes (class.detail → byte)
+EMPTY = 0x00
+GET, POST, PUT, DELETE = 0x01, 0x02, 0x03, 0x04
+CREATED, DELETED, VALID, CHANGED, CONTENT = 0x41, 0x42, 0x43, 0x44, 0x45
+BAD_REQUEST, UNAUTHORIZED, NOT_FOUND, NOT_ALLOWED = 0x80, 0x81, 0x84, 0x85
+
+# option numbers
+OPT_OBSERVE, OPT_URI_PATH, OPT_CONTENT_FORMAT, OPT_URI_QUERY = 6, 11, 12, 15
+OPT_LOCATION_PATH = 8
+
+
+@dataclass
+class CoapMessage:
+    type: int = CON
+    code: int = EMPTY
+    mid: int = 0
+    token: bytes = b""
+    options: list = field(default_factory=list)   # [(number, bytes)]
+    payload: bytes = b""
+
+    def opt(self, number: int) -> Optional[bytes]:
+        for n, v in self.options:
+            if n == number:
+                return v
+        return None
+
+    def opts(self, number: int) -> list[bytes]:
+        return [v for n, v in self.options if n == number]
+
+    def uri_path(self) -> list[str]:
+        return [v.decode("utf-8", "replace")
+                for v in self.opts(OPT_URI_PATH)]
+
+    def queries(self) -> dict[str, str]:
+        out = {}
+        for v in self.opts(OPT_URI_QUERY):
+            k, _, val = v.decode("utf-8", "replace").partition("=")
+            out[k] = val
+        return out
+
+    def observe(self) -> Optional[int]:
+        v = self.opt(OPT_OBSERVE)
+        if v is None:
+            return None
+        return int.from_bytes(v, "big") if v else 0
+
+
+def _ext_decode(nibble: int, data: bytes, off: int) -> tuple[int, int]:
+    if nibble == 13:
+        return data[off] + 13, off + 1
+    if nibble == 14:
+        return struct.unpack_from(">H", data, off)[0] + 269, off + 2
+    return nibble, off
+
+
+def _ext_encode(value: int) -> tuple[int, bytes]:
+    if value < 13:
+        return value, b""
+    if value < 269:
+        return 13, bytes([value - 13])
+    return 14, struct.pack(">H", value - 269)
+
+
+class Frame(GwFrame):
+    """One datagram = one message."""
+
+    def parse(self, data: bytes, state) -> tuple[list, None]:
+        if len(data) < 4:
+            return [], None
+        b0, code, mid = data[0], data[1], struct.unpack_from(">H", data, 2)[0]
+        ver, typ, tkl = b0 >> 6, (b0 >> 4) & 0x3, b0 & 0xF
+        if ver != 1 or tkl > 8:
+            return [], None
+        off = 4
+        token, off = data[off:off + tkl], off + tkl
+        options: list = []
+        number = 0
+        while off < len(data) and data[off] != 0xFF:
+            d, ln = data[off] >> 4, data[off] & 0xF
+            off += 1
+            d, off = _ext_decode(d, data, off)
+            ln, off = _ext_decode(ln, data, off)
+            number += d
+            options.append((number, data[off:off + ln]))
+            off += ln
+        payload = data[off + 1:] if off < len(data) else b""
+        return [CoapMessage(typ, code, mid, token, options, payload)], None
+
+    def serialize(self, m: CoapMessage) -> bytes:
+        out = bytearray()
+        out.append((1 << 6) | (m.type << 4) | len(m.token))
+        out.append(m.code)
+        out += struct.pack(">H", m.mid)
+        out += m.token
+        prev = 0
+        for number, value in sorted(m.options, key=lambda o: o[0]):
+            d, dext = _ext_encode(number - prev)
+            ln, lext = _ext_encode(len(value))
+            out.append((d << 4) | ln)
+            out += dext + lext + value
+            prev = number
+        if m.payload:
+            out.append(0xFF)
+            out += m.payload
+        return bytes(out)
+
+
+def uri_path_opts(path: str) -> list:
+    return [(OPT_URI_PATH, seg.encode())
+            for seg in path.split("/") if seg]
+
+
+class Channel(GwChannel):
+    """One CoAP endpoint (per UDP peer)."""
+
+    PS_PREFIX = "ps"
+
+    def __init__(self, ctx: GwContext) -> None:
+        self.ctx = ctx
+        self.conn_state = "connected"       # connectionless transport
+        self.clientid: Optional[str] = None
+        self.observers: dict[str, bytes] = {}     # topic -> token
+        self._obs_seq = 0
+        self._mid = 0
+        self._registered = False
+
+    def _next_mid(self) -> int:
+        self._mid = self._mid % 0xFFFF + 1
+        return self._mid
+
+    def _ensure_client(self, m: CoapMessage) -> bool:
+        if self._registered:
+            return True
+        q = m.queries()
+        self.clientid = q.get("clientid") or f"coap-{id(self):x}"
+        if not self.ctx.authenticate(self.clientid,
+                                     username=q.get("username"),
+                                     password=q.get("password")):
+            return False
+        self.ctx.open_session(self.clientid, self)
+        self._registered = True
+        return True
+
+    # -- inbound -------------------------------------------------------------
+
+    def handle_in(self, m: CoapMessage) -> list[CoapMessage]:
+        if m.type == RST or m.code == EMPTY:
+            return []
+        reply_type = ACK if m.type == CON else NON
+        path = m.uri_path()
+
+        def reply(code: int, payload: bytes = b"", options=()) -> CoapMessage:
+            return CoapMessage(reply_type, code, m.mid, m.token,
+                               list(options), payload)
+
+        if not path or path[0] != self.PS_PREFIX:
+            return [reply(NOT_FOUND)]
+        topic = "/".join(path[1:])
+        if not topic:
+            return [reply(BAD_REQUEST)]
+        if not self._ensure_client(m):
+            return [reply(UNAUTHORIZED)]
+
+        if m.code in (PUT, POST):
+            qos = int(m.queries().get("qos", 0))
+            retain = m.queries().get("retain") in ("true", "1")
+            self.ctx.publish(self.clientid, topic, m.payload, qos,
+                             retain=retain)
+            return [reply(CHANGED)]
+        if m.code == GET:
+            obs = m.observe()
+            if obs == 0:
+                self.observers[topic] = m.token
+                self.ctx.subscribe(self.clientid, topic,
+                                   qos=int(m.queries().get("qos", 0)))
+                self._obs_seq += 1
+                return [reply(CONTENT, options=[
+                    (OPT_OBSERVE, self._obs_seq.to_bytes(3, "big"))])]
+            if obs == 1:
+                self.observers.pop(topic, None)
+                self.ctx.unsubscribe(self.clientid, topic)
+                return [reply(CONTENT)]
+            # plain read: latest retained message on the topic
+            msgs = getattr(self.ctx.app, "retainer", None)
+            if msgs is not None:
+                found = msgs.match(self.ctx.mount(topic))
+                if found:
+                    return [reply(CONTENT, payload=found[-1].payload)]
+            return [reply(NOT_FOUND)]
+        if m.code == DELETE:
+            return [reply(DELETED)]
+        return [reply(NOT_ALLOWED)]
+
+    # -- outbound ------------------------------------------------------------
+
+    def handle_deliver(self, deliveries: list) -> list[CoapMessage]:
+        out = []
+        for sub_topic, msg in deliveries:
+            plain = self.ctx.unmount(msg.topic)
+            token = None
+            for obs_topic, tok in self.observers.items():
+                from emqx_tpu.core import topic as T
+                if T.match(plain, obs_topic):
+                    token = tok
+                    break
+            if token is None:
+                continue
+            self._obs_seq += 1
+            out.append(CoapMessage(
+                NON, CONTENT, self._next_mid(), token,
+                [(OPT_OBSERVE, self._obs_seq.to_bytes(3, "big"))],
+                msg.payload))
+        return out
+
+    def terminate(self, reason: str) -> None:
+        if self._registered:
+            self._registered = False
+            self.ctx.close_session(self.clientid, self, reason)
+
+
+class CoapGateway(GatewayImpl):
+    name = "coap"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5683) -> None:
+        self.host, self.port = host, port
+        self.listener = None
+        self.ctx: Optional[GwContext] = None
+
+    def on_gateway_load(self, ctx: GwContext, conf: dict) -> None:
+        from emqx_tpu.gateway.conn import UdpGwListener
+
+        self.ctx = ctx
+        self.host = conf.get("host", self.host)
+        self.port = conf.get("port", self.port)
+        self.listener = UdpGwListener(
+            lambda: Channel(self.ctx), Frame(),
+            host=self.host, port=self.port)
+
+    async def start_listeners(self) -> None:
+        await self.listener.start()
+        self.port = self.listener.port
+
+    async def stop_listeners(self) -> None:
+        await self.listener.stop()

@@ -1,0 +1,190 @@
+"""Gateway behaviours + context + manager — parity with
+``apps/emqx_gateway/src/bhvrs/`` (emqx_gateway_frame / _channel / _impl,
+apps/emqx_gateway/src/bhvrs/emqx_gateway_channel.erl:29-105) and
+``emqx_gateway_ctx.erl`` (the broker-facing API handed to channels).
+
+A gateway = Impl (lifecycle + listeners) + Frame (codec) + Channel
+(per-client FSM). Channels never touch the broker directly: everything
+goes through the GwContext, which applies the gateway's mountpoint and
+registers the channel with the core CM so broker dispatch reaches it
+(``ch.send(ch.handle_deliver(items))`` duck-type, broker/cm.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from emqx_tpu.core.message import Message, SubOpts
+
+
+class GwFrame:
+    """Frame codec behaviour (emqx_gateway_frame.erl)."""
+
+    def initial_parse_state(self, opts: Optional[dict] = None) -> Any:
+        return b""
+
+    def parse(self, data: bytes, state: Any) -> tuple[list, Any]:
+        raise NotImplementedError
+
+    def serialize(self, pkt: Any) -> bytes:
+        raise NotImplementedError
+
+
+class GwChannel:
+    """Per-client protocol FSM behaviour (emqx_gateway_channel.erl).
+
+    Ducks the core Channel surface the CM dispatch path expects.
+    """
+
+    conn_state = "idle"
+    clientid: Optional[str] = None
+
+    def handle_in(self, frame: Any) -> list:
+        raise NotImplementedError
+
+    def handle_deliver(self, deliveries: list) -> list:
+        raise NotImplementedError
+
+    def handle_timeout(self, kind: str) -> list:
+        return []
+
+    def terminate(self, reason: str) -> None:
+        pass
+
+    def send(self, frames: list) -> None:
+        """Bound to the transport by the conn adapter."""
+
+    # CM duck-type (takeover/discard on clientid clash)
+    def takeover(self):
+        return None, []
+
+    def discard(self) -> None:
+        self.terminate("discarded")
+
+
+class GatewayImpl:
+    """Gateway lifecycle behaviour (emqx_gateway_impl.erl)."""
+
+    name = "?"
+
+    def on_gateway_load(self, ctx: "GwContext", conf: dict) -> None:
+        raise NotImplementedError
+
+    async def start_listeners(self) -> None:
+        raise NotImplementedError
+
+    async def stop_listeners(self) -> None:
+        pass
+
+    def on_gateway_unload(self) -> None:
+        pass
+
+
+class GwContext:
+    """emqx_gateway_ctx: the only broker surface a channel sees."""
+
+    def __init__(self, app, gwname: str, mountpoint: str = "") -> None:
+        self.app = app
+        self.gwname = gwname
+        self.mountpoint = mountpoint
+
+    # -- topic namespace -----------------------------------------------------
+
+    def mount(self, topic: str) -> str:
+        return self.mountpoint + topic if self.mountpoint else topic
+
+    def unmount(self, topic: str) -> str:
+        if self.mountpoint and topic.startswith(self.mountpoint):
+            return topic[len(self.mountpoint):]
+        return topic
+
+    # -- client lifecycle ----------------------------------------------------
+
+    def open_session(self, clientid: str, channel) -> None:
+        """Register with the core CM (clientid clash kicks the old one,
+        the gateway default — emqx_gateway_cm discard semantics)."""
+        old = self.app.cm.lookup_channel(clientid)
+        if old is not None and old is not channel:
+            old.discard()
+        self.app.cm.register_channel(clientid, channel)
+        self.app.hooks.run("client.connected",
+                           ({"clientid": clientid, "gateway": self.gwname},))
+
+    def close_session(self, clientid: str, channel=None,
+                      reason: str = "closed") -> None:
+        self.app.broker.subscriber_down(clientid)
+        self.app.cm.unregister_channel(clientid, channel)
+        self.app.hooks.run(
+            "client.disconnected",
+            ({"clientid": clientid, "gateway": self.gwname}, reason))
+
+    def authenticate(self, clientid: str, username=None,
+                     password=None) -> bool:
+        try:
+            res = self.app.hooks.run_fold(
+                "client.authenticate",
+                ({"clientid": clientid, "username": username,
+                  "password": password, "peername": "gw"},),
+                {"result": "ok"},
+            )
+        except Exception:
+            return False      # fail closed, like the core channel
+        # authenticators answer 'ok' / 'error' (access/control.py) —
+        # anything but 'ok' is a denial (broker/channel.py does the same)
+        return (res or {}).get("result", "ok") == "ok"
+
+    # -- pub/sub -------------------------------------------------------------
+
+    def publish(self, clientid: str, topic: str, payload: bytes,
+                qos: int = 0, retain: bool = False,
+                props: Optional[dict] = None) -> None:
+        msg = Message(
+            topic=self.mount(topic), payload=payload, qos=qos,
+            from_=clientid, flags={"retain": retain} if retain else {},
+            headers={"properties": props or {}, "gateway": self.gwname},
+        )
+        self.app.cm.dispatch(self.app.broker.publish(msg))
+
+    def subscribe(self, clientid: str, topic: str, qos: int = 0) -> None:
+        self.app.broker.subscribe(
+            clientid, self.mount(topic), SubOpts(qos=qos))
+
+    def unsubscribe(self, clientid: str, topic: str) -> bool:
+        return self.app.broker.unsubscribe(clientid, self.mount(topic))
+
+    def metrics_inc(self, key: str) -> None:
+        self.app.metrics.inc(f"gateway.{self.gwname}.{key}")
+
+
+class GatewayManager:
+    """Load/unload gateway instances (emqx_gateway.erl registry)."""
+
+    def __init__(self, app) -> None:
+        self.app = app
+        self.gateways: dict[str, GatewayImpl] = {}
+
+    def load(self, impl: GatewayImpl, conf: Optional[dict] = None
+             ) -> GatewayImpl:
+        conf = conf or {}
+        if impl.name in self.gateways:
+            raise ValueError(f"gateway {impl.name} already loaded")
+        ctx = GwContext(self.app, impl.name,
+                        mountpoint=conf.get("mountpoint", ""))
+        impl.on_gateway_load(ctx, conf)
+        self.gateways[impl.name] = impl
+        return impl
+
+    def unload(self, name: str) -> bool:
+        impl = self.gateways.pop(name, None)
+        if impl is None:
+            return False
+        impl.on_gateway_unload()
+        return True
+
+    def get(self, name: str) -> Optional[GatewayImpl]:
+        return self.gateways.get(name)
+
+    def list(self) -> list[dict]:
+        return [
+            {"name": n, "status": "running"} for n in self.gateways
+        ]

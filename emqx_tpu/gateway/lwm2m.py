@@ -1,0 +1,153 @@
+"""LwM2M gateway — parity with ``apps/emqx_gateway/src/lwm2m/``
+(emqx_lwm2m_channel.erl registration interface + the mqtt-topic
+up/down link convention), riding the CoAP codec from coap.py.
+
+Registration interface (OMA LwM2M 1.0 §8.2, CoAP bootstrap):
+
+    POST /rd?ep={name}&lt={s}&lwm2m={ver}   → 2.01 + Location /rd/{id}
+    POST /rd/{id}  (update)                 → 2.04
+    DELETE /rd/{id}                         → 2.02
+
+Uplink events publish to ``lwm2m/{ep}/up/{event}`` (register, update,
+notify); downlink commands are MQTT messages on ``lwm2m/{ep}/dn/#``
+delivered to the device as CoAP POSTs carrying the payload.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from emqx_tpu.gateway.coap import (
+    ACK, BAD_REQUEST, CHANGED, CREATED, DELETE, DELETED, Frame, GET,
+    NON, NOT_FOUND, OPT_LOCATION_PATH, POST, PUT, CoapMessage,
+)
+from emqx_tpu.gateway.ctx import GatewayImpl, GwChannel, GwContext
+
+UPLINK = "lwm2m/{ep}/up/{event}"
+DOWNLINK = "lwm2m/{ep}/dn/#"
+
+
+class Channel(GwChannel):
+    def __init__(self, ctx: GwContext) -> None:
+        self.ctx = ctx
+        self.conn_state = "connected"
+        self.clientid: Optional[str] = None
+        self.endpoint: Optional[str] = None
+        self.reg_id: Optional[str] = None
+        self.lifetime = 86400
+        self._mid = 0
+
+    def _next_mid(self) -> int:
+        self._mid = self._mid % 0xFFFF + 1
+        return self._mid
+
+    def _uplink(self, event: str, data: dict) -> None:
+        self.ctx.publish(
+            self.clientid,
+            UPLINK.format(ep=self.endpoint, event=event),
+            json.dumps(data).encode(), qos=0)
+
+    # -- inbound -------------------------------------------------------------
+
+    def handle_in(self, m: CoapMessage) -> list[CoapMessage]:
+        reply_type = ACK if m.type == 0 else NON
+        path = m.uri_path()
+
+        def reply(code: int, options=(), payload: bytes = b"") -> CoapMessage:
+            return CoapMessage(reply_type, code, m.mid, m.token,
+                               list(options), payload)
+
+        if not path or path[0] != "rd":
+            return [reply(NOT_FOUND)]
+        if m.code == POST and len(path) == 1:
+            q = m.queries()
+            ep = q.get("ep")
+            if not ep:
+                return [reply(BAD_REQUEST)]
+            self.endpoint = ep
+            self.clientid = f"lwm2m-{ep}"
+            if not self.ctx.authenticate(self.clientid):
+                return [reply(BAD_REQUEST)]
+            self.lifetime = int(q.get("lt", 86400))
+            self.reg_id = f"{abs(hash(ep)) % 100000}"
+            self.ctx.open_session(self.clientid, self)
+            # downlink command subscription for this endpoint
+            self.ctx.subscribe(self.clientid, DOWNLINK.format(ep=ep), 0)
+            self._uplink("register", {
+                "ep": ep, "lt": self.lifetime,
+                "lwm2m": q.get("lwm2m", "1.0"),
+                "objects": m.payload.decode("utf-8", "replace"),
+            })
+            return [reply(CREATED, options=[
+                (OPT_LOCATION_PATH, b"rd"),
+                (OPT_LOCATION_PATH, self.reg_id.encode()),
+            ])]
+        if m.code == POST and len(path) == 2:
+            if path[1] != self.reg_id:
+                return [reply(NOT_FOUND)]
+            q = m.queries()
+            if "lt" in q:
+                self.lifetime = int(q["lt"])
+            self._uplink("update", {"ep": self.endpoint,
+                                    "lt": self.lifetime})
+            return [reply(CHANGED)]
+        if m.code == DELETE and len(path) == 2:
+            if path[1] != self.reg_id:
+                return [reply(NOT_FOUND)]
+            self._uplink("deregister", {"ep": self.endpoint})
+            self.conn_state = "disconnected"
+            return [reply(DELETED)]
+        # device-originated notify (e.g. POST /rd/{id}/notify)
+        if m.code == POST and len(path) == 3 and path[2] == "notify":
+            self._uplink("notify", {
+                "ep": self.endpoint,
+                "payload": m.payload.decode("utf-8", "replace")})
+            return [reply(CHANGED)]
+        return [reply(NOT_FOUND)]
+
+    # -- outbound (downlink commands as CoAP POSTs) --------------------------
+
+    def handle_deliver(self, deliveries: list) -> list[CoapMessage]:
+        out = []
+        for _sub_topic, msg in deliveries:
+            plain = self.ctx.unmount(msg.topic)
+            parts = plain.split("/")
+            # lwm2m/{ep}/dn/... → POST /dn/{...} to the device
+            cmd_path = parts[3:] if len(parts) > 3 else []
+            opts = [(11, seg.encode()) for seg in (["dn"] + cmd_path)]
+            out.append(CoapMessage(
+                0, POST, self._next_mid(),
+                b"", opts, msg.payload))        # CON request to device
+        return out
+
+    def terminate(self, reason: str) -> None:
+        if self.clientid is not None:
+            self.ctx.close_session(self.clientid, self, reason)
+            self.clientid = None
+
+
+class Lwm2mGateway(GatewayImpl):
+    name = "lwm2m"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5783) -> None:
+        self.host, self.port = host, port
+        self.listener = None
+        self.ctx: Optional[GwContext] = None
+
+    def on_gateway_load(self, ctx: GwContext, conf: dict) -> None:
+        from emqx_tpu.gateway.conn import UdpGwListener
+
+        self.ctx = ctx
+        self.host = conf.get("host", self.host)
+        self.port = conf.get("port", self.port)
+        self.listener = UdpGwListener(
+            lambda: Channel(self.ctx), Frame(),
+            host=self.host, port=self.port)
+
+    async def start_listeners(self) -> None:
+        await self.listener.start()
+        self.port = self.listener.port
+
+    async def stop_listeners(self) -> None:
+        await self.listener.stop()

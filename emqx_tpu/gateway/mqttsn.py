@@ -1,0 +1,342 @@
+"""MQTT-SN 1.2 gateway over UDP — parity with
+``apps/emqx_gateway/src/mqttsn/`` (frame: emqx_sn_frame.erl, channel:
+emqx_sn_channel.erl, topic-id registry: emqx_sn_registry.erl).
+
+Topic-id spaces: normal (per-client REGISTER/auto-register on deliver),
+predefined (gateway-wide table from config), short (2-char names).
+QoS0/1 bridge plus the spec's QoS -1 publish-without-connect for
+predefined topics.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from emqx_tpu.gateway.ctx import GatewayImpl, GwChannel, GwContext, GwFrame
+
+# message types (MQTT-SN 1.2 §5.2.1)
+ADVERTISE, SEARCHGW, GWINFO = 0x00, 0x01, 0x02
+CONNECT, CONNACK = 0x04, 0x05
+WILLTOPICREQ, WILLTOPIC, WILLMSGREQ, WILLMSG = 0x06, 0x07, 0x08, 0x09
+REGISTER, REGACK = 0x0A, 0x0B
+PUBLISH, PUBACK, PUBCOMP, PUBREC, PUBREL = 0x0C, 0x0D, 0x0E, 0x0F, 0x10
+SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 0x12, 0x13, 0x14, 0x15
+PINGREQ, PINGRESP, DISCONNECT = 0x16, 0x17, 0x18
+
+RC_ACCEPTED, RC_CONGESTION, RC_INVALID_TOPIC_ID, RC_NOT_SUPPORTED = 0, 1, 2, 3
+
+# flag bits
+F_DUP, F_RETAIN, F_WILL, F_CLEAN = 0x80, 0x10, 0x08, 0x04
+TID_NORMAL, TID_PREDEF, TID_SHORT = 0, 1, 2
+
+
+def qos_of(flags: int) -> int:
+    q = (flags >> 5) & 0x3
+    return -1 if q == 3 else q
+
+
+def qos_flags(qos: int) -> int:
+    return 0x60 if qos < 0 else (qos & 0x3) << 5
+
+
+@dataclass
+class SnMessage:
+    type: int
+    flags: int = 0
+    topic_id: int = 0
+    msg_id: int = 0
+    topic_name: str = ""
+    data: bytes = b""
+    duration: int = 0
+    clientid: str = ""
+    rc: int = 0
+
+
+class Frame(GwFrame):
+    """One datagram = one message (length-prefixed, emqx_sn_frame.erl)."""
+
+    def parse(self, data: bytes, state) -> tuple[list, None]:
+        out = []
+        while data:
+            if data[0] == 0x01:
+                (ln,) = struct.unpack_from(">H", data, 1)
+                body, data = data[3:ln], data[ln:]
+            else:
+                ln = data[0]
+                body, data = data[1:ln], data[ln:]
+            if body:
+                out.append(self._parse_body(body))
+        return out, None
+
+    def _parse_body(self, b: bytes) -> SnMessage:
+        t = b[0]
+        m = SnMessage(type=t)
+        if t == CONNECT:
+            m.flags, _proto = b[1], b[2]
+            (m.duration,) = struct.unpack_from(">H", b, 3)
+            m.clientid = b[5:].decode("utf-8", "replace")
+        elif t in (CONNACK, WILLTOPICREQ, WILLMSGREQ, PINGRESP):
+            if len(b) > 1:
+                m.rc = b[1]
+        elif t == REGISTER:
+            m.topic_id, m.msg_id = struct.unpack_from(">HH", b, 1)
+            m.topic_name = b[5:].decode("utf-8", "replace")
+        elif t == REGACK:
+            m.topic_id, m.msg_id = struct.unpack_from(">HH", b, 1)
+            m.rc = b[5]
+        elif t == PUBLISH:
+            m.flags = b[1]
+            m.topic_id, m.msg_id = struct.unpack_from(">HH", b, 2)
+            m.data = b[6:]
+        elif t == PUBACK:
+            m.topic_id, m.msg_id = struct.unpack_from(">HH", b, 1)
+            m.rc = b[5]
+        elif t in (PUBREC, PUBREL, PUBCOMP, UNSUBACK):
+            (m.msg_id,) = struct.unpack_from(">H", b, 1)
+        elif t in (SUBSCRIBE, UNSUBSCRIBE):
+            m.flags = b[1]
+            (m.msg_id,) = struct.unpack_from(">H", b, 2)
+            rest = b[4:]
+            if m.flags & 0x3 in (TID_PREDEF,):
+                (m.topic_id,) = struct.unpack_from(">H", rest, 0)
+            else:
+                m.topic_name = rest.decode("utf-8", "replace")
+        elif t == SUBACK:
+            m.flags = b[1]
+            m.topic_id, m.msg_id = struct.unpack_from(">HH", b, 2)
+            m.rc = b[6]
+        elif t == PINGREQ:
+            m.clientid = b[1:].decode("utf-8", "replace")
+        elif t == DISCONNECT:
+            if len(b) >= 3:
+                (m.duration,) = struct.unpack_from(">H", b, 1)
+        elif t == SEARCHGW:
+            m.rc = b[1] if len(b) > 1 else 0       # radius
+        return m
+
+    def serialize(self, m: SnMessage) -> bytes:
+        t = m.type
+        if t == CONNACK:
+            body = bytes([t, m.rc])
+        elif t == CONNECT:
+            body = bytes([t, m.flags, 0x01]) + struct.pack(
+                ">H", m.duration) + m.clientid.encode()
+        elif t == REGISTER:
+            body = bytes([t]) + struct.pack(
+                ">HH", m.topic_id, m.msg_id) + m.topic_name.encode()
+        elif t == REGACK:
+            body = bytes([t]) + struct.pack(
+                ">HH", m.topic_id, m.msg_id) + bytes([m.rc])
+        elif t == PUBLISH:
+            body = bytes([t, m.flags]) + struct.pack(
+                ">HH", m.topic_id, m.msg_id) + m.data
+        elif t == PUBACK:
+            body = bytes([t]) + struct.pack(
+                ">HH", m.topic_id, m.msg_id) + bytes([m.rc])
+        elif t in (PUBREC, PUBREL, PUBCOMP, UNSUBACK):
+            body = bytes([t]) + struct.pack(">H", m.msg_id)
+        elif t in (SUBSCRIBE, UNSUBSCRIBE):
+            body = bytes([t, m.flags]) + struct.pack(">H", m.msg_id)
+            if m.flags & 0x3 == TID_PREDEF:
+                body += struct.pack(">H", m.topic_id)
+            else:
+                body += m.topic_name.encode()
+        elif t == SUBACK:
+            body = bytes([t, m.flags]) + struct.pack(
+                ">HH", m.topic_id, m.msg_id) + bytes([m.rc])
+        elif t in (PINGREQ, PINGRESP):
+            body = bytes([t])
+        elif t == DISCONNECT:
+            body = bytes([t])
+        elif t == GWINFO:
+            body = bytes([t, m.rc])
+        elif t == ADVERTISE:
+            body = bytes([t, m.rc]) + struct.pack(">H", m.duration)
+        else:
+            body = bytes([t])
+        ln = len(body) + 1
+        if ln < 256:
+            return bytes([ln]) + body
+        return b"\x01" + struct.pack(">H", ln + 2) + body
+
+
+class Registry:
+    """Gateway-wide predefined ids + per-client registered ids
+    (emqx_sn_registry.erl)."""
+
+    def __init__(self, predefined: Optional[dict[int, str]] = None) -> None:
+        self.predefined = dict(predefined or {})
+
+    def predefined_topic(self, tid: int) -> Optional[str]:
+        return self.predefined.get(tid)
+
+
+class Channel(GwChannel):
+    def __init__(self, ctx: GwContext, registry: Registry) -> None:
+        self.ctx = ctx
+        self.registry = registry
+        self.conn_state = "idle"
+        self.clientid: Optional[str] = None
+        self.topic_of_id: dict[int, str] = {}      # normal ids, per client
+        self.id_of_topic: dict[str, int] = {}
+        self._next_tid = 0
+        self._next_mid = 0
+        self.awake = True
+
+    def _alloc_tid(self, topic: str) -> int:
+        tid = self.id_of_topic.get(topic)
+        if tid is None:
+            self._next_tid += 1
+            tid = self._next_tid
+            self.id_of_topic[topic] = tid
+            self.topic_of_id[tid] = topic
+        return tid
+
+    def _mid(self) -> int:
+        self._next_mid = self._next_mid % 0xFFFF + 1
+        return self._next_mid
+
+    def _resolve(self, m: SnMessage) -> Optional[str]:
+        kind = m.flags & 0x3
+        if kind == TID_PREDEF:
+            return self.registry.predefined_topic(m.topic_id)
+        if kind == TID_SHORT:
+            return struct.pack(">H", m.topic_id).decode("latin1")
+        return self.topic_of_id.get(m.topic_id)
+
+    # -- inbound -------------------------------------------------------------
+
+    def handle_in(self, m: SnMessage) -> list[SnMessage]:
+        t = m.type
+        if t == SEARCHGW:
+            return [SnMessage(GWINFO, rc=1)]       # gw id 1
+        if t == CONNECT:
+            self.clientid = m.clientid or f"sn-{id(self):x}"
+            if not self.ctx.authenticate(self.clientid):
+                return [SnMessage(CONNACK, rc=RC_NOT_SUPPORTED)]
+            self.ctx.open_session(self.clientid, self)
+            self.conn_state = "connected"
+            return [SnMessage(CONNACK, rc=RC_ACCEPTED)]
+        if t == PUBLISH and qos_of(m.flags) == -1:
+            # QoS -1: fire-and-forget on a predefined/short topic,
+            # no connection required (MQTT-SN §6.8)
+            topic = (self.registry.predefined_topic(m.topic_id)
+                     if m.flags & 0x3 == TID_PREDEF else self._resolve(m))
+            if topic:
+                self.ctx.publish(m.clientid or "sn-anon", topic, m.data, 0,
+                                 retain=bool(m.flags & F_RETAIN))
+            return []
+        if self.conn_state != "connected":
+            return ([SnMessage(DISCONNECT)]
+                    if t not in (PINGREQ, DISCONNECT) else [])
+        if t == REGISTER:
+            tid = self._alloc_tid(m.topic_name)
+            return [SnMessage(REGACK, topic_id=tid, msg_id=m.msg_id,
+                              rc=RC_ACCEPTED)]
+        if t == PUBLISH:
+            topic = self._resolve(m)
+            qos = max(0, qos_of(m.flags))
+            if topic is None:
+                return ([SnMessage(PUBACK, topic_id=m.topic_id,
+                                   msg_id=m.msg_id,
+                                   rc=RC_INVALID_TOPIC_ID)]
+                        if qos > 0 else [])
+            self.ctx.publish(self.clientid, topic, m.data, qos,
+                             retain=bool(m.flags & F_RETAIN))
+            if qos > 0:
+                return [SnMessage(PUBACK, topic_id=m.topic_id,
+                                  msg_id=m.msg_id, rc=RC_ACCEPTED)]
+            return []
+        if t == SUBSCRIBE:
+            kind = m.flags & 0x3
+            if kind == TID_PREDEF:
+                topic = self.registry.predefined_topic(m.topic_id)
+                tid = m.topic_id
+            else:
+                topic = m.topic_name
+                # wildcard filters get no id (delivery registers one)
+                tid = (0 if ("#" in topic or "+" in topic)
+                       else self._alloc_tid(topic))
+            if not topic:
+                return [SnMessage(SUBACK, flags=m.flags, topic_id=0,
+                                  msg_id=m.msg_id,
+                                  rc=RC_INVALID_TOPIC_ID)]
+            qos = max(0, qos_of(m.flags))
+            self.ctx.subscribe(self.clientid, topic, qos)
+            return [SnMessage(SUBACK, flags=qos_flags(qos), topic_id=tid,
+                              msg_id=m.msg_id, rc=RC_ACCEPTED)]
+        if t == UNSUBSCRIBE:
+            topic = (self.registry.predefined_topic(m.topic_id)
+                     if m.flags & 0x3 == TID_PREDEF else m.topic_name)
+            if topic:
+                self.ctx.unsubscribe(self.clientid, topic)
+            return [SnMessage(UNSUBACK, msg_id=m.msg_id)]
+        if t == PUBACK:
+            return []
+        if t == PINGREQ:
+            self.awake = True
+            return [SnMessage(PINGRESP)]
+        if t == DISCONNECT:
+            if m.duration:           # sleep mode: keep session, stop io
+                self.awake = False
+                return [SnMessage(DISCONNECT)]
+            self.conn_state = "disconnected"
+            return [SnMessage(DISCONNECT)]
+        return []
+
+    # -- outbound ------------------------------------------------------------
+
+    def handle_deliver(self, deliveries: list) -> list[SnMessage]:
+        out: list[SnMessage] = []
+        for _sub_topic, msg in deliveries:
+            topic = self.ctx.unmount(msg.topic)
+            tid = self.id_of_topic.get(topic)
+            if tid is None:
+                # auto-register so the client can decode the id
+                tid = self._alloc_tid(topic)
+                out.append(SnMessage(REGISTER, topic_id=tid,
+                                     msg_id=self._mid(),
+                                     topic_name=topic))
+            out.append(SnMessage(
+                PUBLISH, flags=qos_flags(min(msg.qos, 1)),
+                topic_id=tid,
+                msg_id=self._mid() if msg.qos else 0,
+                data=msg.payload))
+        return out
+
+    def terminate(self, reason: str) -> None:
+        if self.conn_state == "connected":
+            self.conn_state = "disconnected"
+            self.ctx.close_session(self.clientid, self, reason)
+
+
+class MqttsnGateway(GatewayImpl):
+    name = "mqttsn"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 1884,
+                 predefined: Optional[dict[int, str]] = None) -> None:
+        self.host, self.port = host, port
+        self.registry = Registry(predefined)
+        self.listener = None
+        self.ctx: Optional[GwContext] = None
+
+    def on_gateway_load(self, ctx: GwContext, conf: dict) -> None:
+        from emqx_tpu.gateway.conn import UdpGwListener
+
+        self.ctx = ctx
+        self.host = conf.get("host", self.host)
+        self.port = conf.get("port", self.port)
+        for tid, topic in (conf.get("predefined") or {}).items():
+            self.registry.predefined[int(tid)] = topic
+        self.listener = UdpGwListener(
+            lambda: Channel(self.ctx, self.registry), Frame(),
+            host=self.host, port=self.port)
+
+    async def start_listeners(self) -> None:
+        await self.listener.start()
+        self.port = self.listener.port
+
+    async def stop_listeners(self) -> None:
+        await self.listener.stop()

@@ -1,0 +1,246 @@
+"""STOMP 1.2 gateway — parity with
+``apps/emqx_gateway/src/stomp/`` (frame: emqx_stomp_frame.erl,
+channel: emqx_stomp_channel.erl).
+
+STOMP destinations map 1:1 onto topics. SEND publishes; SUBSCRIBE
+(id + destination) bridges into the broker; deliveries come back as
+MESSAGE frames carrying ``subscription``/``message-id``. RECEIPT is
+honored on any client frame carrying ``receipt``; ERROR closes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from emqx_tpu.gateway.ctx import GatewayImpl, GwChannel, GwContext, GwFrame
+
+SUPPORTED_VERSIONS = ("1.0", "1.1", "1.2")
+
+
+@dataclass
+class StompFrame:
+    command: str
+    headers: dict = field(default_factory=dict)
+    body: bytes = b""
+
+
+# -- codec (emqx_stomp_frame.erl) -------------------------------------------
+
+def _unescape(s: str) -> str:
+    return (s.replace("\\r", "\r").replace("\\n", "\n")
+             .replace("\\c", ":").replace("\\\\", "\\"))
+
+
+def _escape(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\r", "\\r")
+             .replace("\n", "\\n").replace(":", "\\c"))
+
+
+class Frame(GwFrame):
+    def initial_parse_state(self, opts: Optional[dict] = None) -> bytes:
+        return b""
+
+    def parse(self, data: bytes, state: bytes) -> tuple[list, bytes]:
+        buf = (state or b"") + data
+        out: list[StompFrame] = []
+        while True:
+            # heart-beats: bare EOLs between frames
+            buf = buf.lstrip(b"\r\n")
+            # header block ends at the first blank line — LF or CRLF
+            # line endings are both spec-legal (STOMP 1.2 §augmented BNF)
+            p_lf, p_crlf = buf.find(b"\n\n"), buf.find(b"\r\n\r\n")
+            if p_crlf >= 0 and (p_lf < 0 or p_crlf < p_lf):
+                head, body_start = buf[:p_crlf], p_crlf + 4
+            elif p_lf >= 0:
+                head, body_start = buf[:p_lf], p_lf + 2
+            else:
+                break                                 # incomplete head
+            lines = head.decode("utf-8", "replace").split("\n")
+            command = lines[0].strip("\r")
+            headers: dict = {}
+            for line in lines[1:]:
+                line = line.rstrip("\r")
+                if not line:
+                    continue
+                k, _, v = line.partition(":")
+                # repeated header: first occurrence wins (spec)
+                headers.setdefault(_unescape(k), _unescape(v))
+            # content-length framing lets bodies carry NUL bytes;
+            # without it the body ends at the first NUL
+            clen = headers.get("content-length")
+            if clen is not None and clen.isdigit():
+                n = int(clen)
+                if len(buf) < body_start + n + 1:
+                    break                             # incomplete body
+                body = buf[body_start:body_start + n]
+                buf = buf[body_start + n + 1:]        # skip the NUL
+            else:
+                end = buf.find(b"\x00", body_start)
+                if end < 0:
+                    break
+                body = buf[body_start:end]
+                buf = buf[end + 1:]
+            out.append(StompFrame(command, headers, body))
+        return out, buf
+
+    def serialize(self, pkt: StompFrame) -> bytes:
+        if pkt.command == "":            # server heart-beat
+            return b"\n"
+        lines = [pkt.command]
+        hdrs = dict(pkt.headers)
+        if pkt.body and "content-length" not in hdrs:
+            hdrs["content-length"] = str(len(pkt.body))
+        for k, v in hdrs.items():
+            lines.append(f"{_escape(str(k))}:{_escape(str(v))}")
+        return ("\n".join(lines) + "\n\n").encode() + pkt.body + b"\x00"
+
+
+# -- channel (emqx_stomp_channel.erl) ---------------------------------------
+
+class Channel(GwChannel):
+    def __init__(self, ctx: GwContext) -> None:
+        self.ctx = ctx
+        self.conn_state = "idle"
+        self.clientid: Optional[str] = None
+        self.subs: dict[str, str] = {}       # sub id -> destination
+        self._msg_seq = 0
+
+    # -- inbound -------------------------------------------------------------
+
+    def handle_in(self, frame: StompFrame) -> list[StompFrame]:
+        cmd = frame.command.upper()
+        if self.conn_state == "idle" and cmd not in ("CONNECT", "STOMP"):
+            return [self._error("Not connected")]
+        try:
+            handler = getattr(self, f"_in_{cmd.lower()}", None)
+            if handler is None:
+                return [self._error(f"Unknown command {cmd}")]
+            out = handler(frame)
+        except Exception as e:
+            return [self._error(str(e))]
+        receipt = frame.headers.get("receipt")
+        if receipt and cmd != "CONNECT":
+            out.append(StompFrame("RECEIPT", {"receipt-id": receipt}))
+        return out
+
+    def _in_connect(self, frame: StompFrame) -> list[StompFrame]:
+        if self.conn_state == "connected":
+            return [self._error("Already connected")]
+        accepts = (frame.headers.get("accept-version") or "1.0").split(",")
+        version = max((v for v in accepts if v in SUPPORTED_VERSIONS),
+                      default=None)
+        if version is None:
+            return [self._error("Supported protocol versions < 1.2")]
+        login = frame.headers.get("login")
+        self.clientid = (frame.headers.get("client-id")
+                         or login or f"stomp-{id(self):x}")
+        if not self.ctx.authenticate(
+                self.clientid, username=login,
+                password=frame.headers.get("passcode")):
+            return [self._error("Login failed")]
+        self.ctx.open_session(self.clientid, self)
+        self.conn_state = "connected"
+        return [StompFrame("CONNECTED", {
+            "version": version, "server": "emqx-tpu",
+            "heart-beat": frame.headers.get("heart-beat", "0,0"),
+        })]
+
+    _in_stomp = _in_connect
+
+    def _in_send(self, frame: StompFrame) -> list[StompFrame]:
+        dest = frame.headers.get("destination")
+        if not dest:
+            return [self._error("Missing destination")]
+        self.ctx.publish(self.clientid, dest, frame.body,
+                         qos=0, props={
+                             k: v for k, v in frame.headers.items()
+                             if k not in ("destination", "receipt",
+                                          "content-length", "transaction")
+                         })
+        return []
+
+    def _in_subscribe(self, frame: StompFrame) -> list[StompFrame]:
+        sid = frame.headers.get("id")
+        dest = frame.headers.get("destination")
+        if not sid or not dest:
+            return [self._error("Missing id or destination")]
+        if sid in self.subs:
+            return [self._error(f"Subscription id {sid} already exists")]
+        self.subs[sid] = dest
+        self.ctx.subscribe(self.clientid, dest, qos=0)
+        return []
+
+    def _in_unsubscribe(self, frame: StompFrame) -> list[StompFrame]:
+        sid = frame.headers.get("id")
+        dest = self.subs.pop(sid, None)
+        if dest is not None and dest not in self.subs.values():
+            self.ctx.unsubscribe(self.clientid, dest)
+        return []
+
+    def _in_ack(self, frame: StompFrame) -> list[StompFrame]:
+        return []        # QoS0 bridge: ack is a no-op (reference parity)
+
+    def _in_nack(self, frame: StompFrame) -> list[StompFrame]:
+        return []
+
+    def _in_disconnect(self, frame: StompFrame) -> list[StompFrame]:
+        self.conn_state = "disconnected"
+        return []
+
+    # -- outbound ------------------------------------------------------------
+
+    def handle_deliver(self, deliveries: list) -> list[StompFrame]:
+        out = []
+        for sub_topic, msg in deliveries:
+            plain = self.ctx.unmount(sub_topic)
+            for sid, dest in self.subs.items():
+                if _dest_match(plain, dest):
+                    self._msg_seq += 1
+                    out.append(StompFrame("MESSAGE", {
+                        "subscription": sid,
+                        "message-id": str(self._msg_seq),
+                        "destination": self.ctx.unmount(msg.topic),
+                    }, msg.payload))
+                    break
+        return out
+
+    def terminate(self, reason: str) -> None:
+        if self.conn_state == "connected":
+            self.conn_state = "disconnected"
+            self.ctx.close_session(self.clientid, self, reason)
+
+    def _error(self, text: str) -> StompFrame:
+        self.conn_state = "disconnected"
+        return StompFrame("ERROR", {"message": text}, text.encode())
+
+
+def _dest_match(topic: str, dest: str) -> bool:
+    from emqx_tpu.core import topic as T
+    return T.match(topic, dest)
+
+
+class StompGateway(GatewayImpl):
+    name = "stomp"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 61613) -> None:
+        self.host, self.port = host, port
+        self.listener = None
+        self.ctx: Optional[GwContext] = None
+
+    def on_gateway_load(self, ctx: GwContext, conf: dict) -> None:
+        from emqx_tpu.gateway.conn import TcpGwListener
+
+        self.ctx = ctx
+        self.host = conf.get("host", self.host)
+        self.port = conf.get("port", self.port)
+        self.listener = TcpGwListener(
+            lambda: Channel(self.ctx), Frame(),
+            host=self.host, port=self.port)
+
+    async def start_listeners(self) -> None:
+        await self.listener.start()
+        self.port = self.listener.port
+
+    async def stop_listeners(self) -> None:
+        await self.listener.stop()

@@ -81,8 +81,11 @@ class BufferWorker:
                 return False
             if self._next_flush_at == 0.0:
                 self._next_flush_at = now + self.batch_time_s
-            if self.q.count() >= self.batch_size:
-                self.flush(now)
+            # NOTE: no inline flush here even at batch_size — enqueue is
+            # called from publish hooks on the event-loop thread, and a
+            # flush does blocking network I/O. All I/O happens on the
+            # housekeeping thread (tick/flush), which server.py already
+            # runs via asyncio.to_thread.
             return True
 
     def queuing(self) -> int:

@@ -1,0 +1,502 @@
+"""Gateway framework + 5 protocol gateways — mirrors the
+emqx_gateway test suites (emqx_stomp_SUITE, emqx_sn_frame/protocol
+SUITEs, emqx_coap_SUITE, emqx_lwm2m_SUITE, emqx_exproto_SUITE), driven
+over real TCP/UDP sockets against a live BrokerApp."""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.gateway import coap as C
+from emqx_tpu.gateway import mqttsn as SN
+from emqx_tpu.gateway import stomp as ST
+from emqx_tpu.gateway.coap import CoapMessage, Frame as CoapFrame
+from emqx_tpu.gateway.exproto import (
+    ConnectionHandler, ExprotoGateway, HandlerServer,
+)
+from emqx_tpu.gateway.lwm2m import Lwm2mGateway
+from emqx_tpu.mqtt.client import MqttClient
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- stomp codec --------------------------------------------------------------
+
+def test_stomp_frame_roundtrip():
+    f = ST.Frame()
+    frame = ST.StompFrame("SEND", {"destination": "a/b",
+                                   "weird:h": "x\ny"}, b"hello")
+    pkts, rest = f.parse(f.serialize(frame), b"")
+    assert rest == b""
+    assert pkts[0].command == "SEND"
+    assert pkts[0].headers["destination"] == "a/b"
+    assert pkts[0].headers["weird:h"] == "x\ny"
+    assert pkts[0].body == b"hello"
+
+
+def test_stomp_frame_partial_and_pipelined():
+    f = ST.Frame()
+    data = (f.serialize(ST.StompFrame("SEND", {"destination": "t"}, b"1"))
+            + f.serialize(ST.StompFrame("SEND", {"destination": "t"}, b"2")))
+    pkts1, st = f.parse(data[:10], b"")
+    assert pkts1 == []
+    pkts2, st = f.parse(data[10:], st)
+    assert [p.body for p in pkts2] == [b"1", b"2"]
+
+
+def test_stomp_frame_crlf_line_endings():
+    f = ST.Frame()
+    raw = b"SEND\r\ndestination:t\r\n\r\nhello\x00"
+    pkts, rest = f.parse(raw, b"")
+    assert rest == b""
+    assert pkts[0].headers["destination"] == "t"
+    assert pkts[0].body == b"hello"
+
+
+def test_stomp_frame_content_length_allows_nul_in_body():
+    f = ST.Frame()
+    body = b"bin\x00ary"
+    raw = (f"SEND\ndestination:t\ncontent-length:{len(body)}\n\n"
+           .encode() + body + b"\x00")
+    pkts, rest = f.parse(raw, b"")
+    assert rest == b""
+    assert pkts[0].body == body
+    # incomplete content-length body buffers until complete
+    pkts1, st = f.parse(raw[:-3], b"")
+    assert pkts1 == []
+    pkts2, _ = f.parse(raw[-3:], st)
+    assert pkts2[0].body == body
+
+
+def test_gateway_auth_denies_bad_credentials():
+    """GwContext.authenticate must fail closed on authn error verdicts."""
+    from emqx_tpu.access.authn import AuthnChain, BuiltinDbProvider
+    from emqx_tpu.access.control import AccessControl
+
+    p = BuiltinDbProvider()
+    p.add_user("alice", "secret")
+    app = BrokerApp(access_control=AccessControl(authn=AuthnChain([p])))
+    from emqx_tpu.gateway.ctx import GwContext
+    ctx = GwContext(app, "test")
+    assert ctx.authenticate("c1", username="alice", password="secret")
+    assert not ctx.authenticate("c1", username="alice", password="wrong")
+    assert not ctx.authenticate("c1", username="nobody", password="x")
+
+
+def test_udp_gateway_expires_idle_channels():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(SN.MqttsnGateway(port=0))
+        await gw.start_listeners()
+        gw.listener.idle_timeout_s = 0.01
+        dev = SnClient(gw.port)
+        await dev.start()
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="sleepy"))
+        await dev.recv()
+        assert len(gw.listener.channels) == 1
+        assert app.cm.lookup_channel("sleepy") is not None
+        await asyncio.sleep(0.05)
+        assert gw.listener.expire_idle() == 1
+        assert gw.listener.channels == {}
+        assert app.cm.lookup_channel("sleepy") is None
+        await gw.stop_listeners()
+
+    run(main())
+
+
+# -- stomp end-to-end over TCP ------------------------------------------------
+
+class StompClient:
+    def __init__(self, port):
+        self.port = port
+        self.f = ST.Frame()
+        self.state = b""
+        self.pending = []
+
+    async def connect(self):
+        self.r, self.w = await asyncio.open_connection("127.0.0.1",
+                                                       self.port)
+
+    async def send(self, cmd, headers=None, body=b""):
+        self.w.write(self.f.serialize(ST.StompFrame(cmd, headers or {},
+                                                    body)))
+        await self.w.drain()
+
+    async def recv(self, timeout=5.0):
+        while not self.pending:
+            data = await asyncio.wait_for(self.r.read(4096), timeout)
+            assert data, "connection closed"
+            pkts, self.state = self.f.parse(data, self.state)
+            self.pending.extend(pkts)
+        return self.pending.pop(0)
+
+
+def test_stomp_pubsub_and_mqtt_interop():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        from emqx_tpu.broker.server import BrokerServer
+        srv = BrokerServer(port=0, app=app)
+        await srv.start()
+
+        c1 = StompClient(gw.port)
+        await c1.connect()
+        await c1.send("CONNECT", {"accept-version": "1.2",
+                                  "login": "alice"})
+        connected = await c1.recv()
+        assert connected.command == "CONNECTED"
+        await c1.send("SUBSCRIBE", {"id": "0", "destination": "cars/+"})
+        # an MQTT client publishes; the STOMP side must receive
+        mq = MqttClient(port=srv.port, clientid="m1")
+        await mq.connect()
+        await mq.publish("cars/tesla", b"vroom")
+        msg = await c1.recv()
+        assert msg.command == "MESSAGE"
+        assert msg.headers["destination"] == "cars/tesla"
+        assert msg.headers["subscription"] == "0"
+        assert msg.body == b"vroom"
+        # STOMP SEND reaches MQTT subscribers
+        await mq.subscribe("stomp/#")
+        await c1.send("SEND", {"destination": "stomp/out",
+                               "receipt": "r1"}, b"from-stomp")
+        rec = await c1.recv()
+        assert rec.command == "RECEIPT"
+        assert rec.headers["receipt-id"] == "r1"
+        got = await mq.recv()
+        assert got.topic == "stomp/out" and got.payload == b"from-stomp"
+        await mq.close()
+        await gw.stop_listeners()
+        await srv.stop()
+
+    run(main())
+
+
+# -- mqtt-sn codec -------------------------------------------------------------
+
+def test_sn_frame_roundtrip():
+    f = SN.Frame()
+    m = SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1), topic_id=7,
+                     msg_id=42, data=b"xyz")
+    pkts, _ = f.parse(f.serialize(m), None)
+    p = pkts[0]
+    assert (p.type, p.topic_id, p.msg_id, p.data) == (SN.PUBLISH, 7, 42,
+                                                      b"xyz")
+    assert SN.qos_of(p.flags) == 1
+
+
+def test_sn_connect_roundtrip():
+    f = SN.Frame()
+    m = SN.SnMessage(SN.CONNECT, flags=SN.F_CLEAN, duration=30,
+                     clientid="dev1")
+    p = f.parse(f.serialize(m), None)[0][0]
+    assert p.clientid == "dev1" and p.duration == 30
+
+
+class SnClient:
+    def __init__(self, port):
+        self.f = SN.Frame()
+        self.port = port
+
+    async def start(self):
+        loop = asyncio.get_running_loop()
+        self.q = asyncio.Queue()
+        cli = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                for m in cli.f.parse(data, None)[0]:
+                    cli.q.put_nowait(m)
+
+        self.tr, _ = await loop.create_datagram_endpoint(
+            Proto, remote_addr=("127.0.0.1", self.port))
+
+    def send(self, m):
+        self.tr.sendto(self.f.serialize(m))
+
+    async def recv(self, timeout=5.0):
+        return await asyncio.wait_for(self.q.get(), timeout)
+
+
+def test_mqttsn_register_publish_subscribe():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(SN.MqttsnGateway(port=0),
+                              {"predefined": {1: "pre/defined"}})
+        await gw.start_listeners()
+
+        dev = SnClient(gw.port)
+        await dev.start()
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="sn-dev"))
+        assert (await dev.recv()).rc == SN.RC_ACCEPTED
+        # register + publish qos1
+        dev.send(SN.SnMessage(SN.REGISTER, msg_id=1,
+                              topic_name="sensors/t1"))
+        regack = await dev.recv()
+        tid = regack.topic_id
+        assert regack.rc == SN.RC_ACCEPTED and tid > 0
+        # subscribe by name (another device), then publish by id
+        dev2 = SnClient(gw.port)
+        await dev2.start()
+        dev2.send(SN.SnMessage(SN.CONNECT, clientid="sn-dev2"))
+        await dev2.recv()
+        dev2.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(0),
+                               msg_id=2, topic_name="sensors/#"))
+        suback = await dev2.recv()
+        assert suback.type == SN.SUBACK and suback.rc == SN.RC_ACCEPTED
+        dev.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                              topic_id=tid, msg_id=3, data=b"21.5"))
+        puback = await dev.recv()
+        assert puback.type == SN.PUBACK and puback.rc == SN.RC_ACCEPTED
+        # dev2 gets auto-REGISTER then PUBLISH
+        reg = await dev2.recv()
+        assert reg.type == SN.REGISTER and reg.topic_name == "sensors/t1"
+        pub = await dev2.recv()
+        assert pub.type == SN.PUBLISH and pub.data == b"21.5"
+        assert pub.topic_id == reg.topic_id
+        await gw.stop_listeners()
+
+    run(main())
+
+
+def test_mqttsn_qos_minus_one_predefined():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(SN.MqttsnGateway(port=0),
+                              {"predefined": {1: "pre/defined"}})
+        await gw.start_listeners()
+        seen = []
+        app.hooks.add("message.publish",
+                      lambda m: seen.append((m.topic, m.payload)) or None,
+                      priority=-500)
+        dev = SnClient(gw.port)
+        await dev.start()
+        # QoS -1 publish without CONNECT on predefined topic id 1
+        dev.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(-1) | 0x1,
+                              topic_id=1, data=b"fire"))
+        await asyncio.sleep(0.1)
+        assert ("pre/defined", b"fire") in seen
+        await gw.stop_listeners()
+
+    run(main())
+
+
+# -- coap codec ----------------------------------------------------------------
+
+def test_coap_codec_roundtrip_with_extended_options():
+    f = CoapFrame()
+    m = CoapMessage(C.CON, C.GET, 0x1234, b"tok1",
+                    [(C.OPT_URI_PATH, b"ps"), (C.OPT_URI_PATH, b"a"),
+                     (C.OPT_OBSERVE, b"\x00"),
+                     (2000, b"x" * 300)],       # forces 14-extensions
+                    b"payload")
+    out = f.parse(f.serialize(m), None)[0][0]
+    assert out.code == C.GET and out.mid == 0x1234
+    assert out.token == b"tok1"
+    assert out.uri_path() == ["ps", "a"]
+    assert out.opt(2000) == b"x" * 300
+    assert out.payload == b"payload"
+
+
+class CoapClient:
+    def __init__(self, port):
+        self.f = CoapFrame()
+        self.port = port
+        self._mid = 0
+
+    async def start(self):
+        loop = asyncio.get_running_loop()
+        self.q = asyncio.Queue()
+        cli = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                for m in cli.f.parse(data, None)[0]:
+                    cli.q.put_nowait(m)
+
+        self.tr, _ = await loop.create_datagram_endpoint(
+            Proto, remote_addr=("127.0.0.1", self.port))
+
+    def request(self, code, path, payload=b"", options=(), token=b"t",
+                queries=()):
+        self._mid += 1
+        opts = list(options) + C.uri_path_opts(path)
+        for q in queries:
+            opts.append((C.OPT_URI_QUERY, q.encode()))
+        self.tr.sendto(self.f.serialize(CoapMessage(
+            C.CON, code, self._mid, token, opts, payload)))
+
+    async def recv(self, timeout=5.0):
+        return await asyncio.wait_for(self.q.get(), timeout)
+
+
+def test_coap_pubsub_observe():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(C.CoapGateway(port=0))
+        await gw.start_listeners()
+
+        sub = CoapClient(gw.port)
+        await sub.start()
+        sub.request(C.GET, "ps/room/temp", token=b"obs1",
+                    options=[(C.OPT_OBSERVE, b"")],
+                    queries=["clientid=c-sub"])
+        ack = await sub.recv()
+        assert ack.code == C.CONTENT
+
+        pub = CoapClient(gw.port)
+        await pub.start()
+        pub.request(C.PUT, "ps/room/temp", payload=b"21",
+                    queries=["clientid=c-pub"])
+        ack2 = await pub.recv()
+        assert ack2.code == C.CHANGED
+
+        notify = await sub.recv()
+        assert notify.code == C.CONTENT and notify.payload == b"21"
+        assert notify.token == b"obs1"
+        assert notify.opt(C.OPT_OBSERVE) is not None
+        await gw.stop_listeners()
+
+    run(main())
+
+
+# -- lwm2m ---------------------------------------------------------------------
+
+def test_lwm2m_register_update_uplink_downlink():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        uplinks = []
+        app.hooks.add(
+            "message.publish",
+            lambda m: uplinks.append((m.topic, m.payload)) or None,
+            priority=-500)
+
+        dev = CoapClient(gw.port)
+        await dev.start()
+        dev.request(C.POST, "rd", payload=b"</1/0>,</3/0>",
+                    queries=["ep=ep-1", "lt=120", "lwm2m=1.0"])
+        created = await dev.recv()
+        assert created.code == C.CREATED
+        loc = [v.decode() for v in created.opts(C.OPT_LOCATION_PATH)]
+        assert loc[0] == "rd" and len(loc) == 2
+        assert any(t == "lwm2m/ep-1/up/register" for t, _ in uplinks)
+        reg = json.loads([p for t, p in uplinks
+                          if t == "lwm2m/ep-1/up/register"][0])
+        assert reg["lt"] == 120 and "</1/0>" in reg["objects"]
+
+        # update
+        dev.request(C.POST, f"rd/{loc[1]}", queries=["lt=300"])
+        assert (await dev.recv()).code == C.CHANGED
+
+        # downlink: publish a command to the device's dn topic
+        from emqx_tpu.core.message import Message
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/ep-1/dn/read", payload=b'{"path":"/3/0/0"}')))
+        cmd = await dev.recv()
+        assert cmd.code == C.POST
+        assert cmd.uri_path() == ["dn", "read"]
+        assert cmd.payload == b'{"path":"/3/0/0"}'
+        await gw.stop_listeners()
+
+    run(main())
+
+
+# -- exproto -------------------------------------------------------------------
+
+class EchoLineProtocol(ConnectionHandler):
+    """A toy external protocol: 'AUTH <id>' authenticates, 'SUB <t>'
+    subscribes, 'PUB <t> <msg>' publishes, deliveries are sent back as
+    'MSG <t> <payload>' lines."""
+
+    def on_received_bytes(self, args):
+        line = bytes.fromhex(args["bytes_hex"]).decode().strip()
+        verb, _, rest = line.partition(" ")
+        if verb == "AUTH":
+            return [{"type": "authenticate", "clientid": rest},
+                    {"type": "send", "bytes_hex": b"OK\n".hex()}]
+        if verb == "SUB":
+            return [{"type": "subscribe", "topic": rest, "qos": 0},
+                    {"type": "send", "bytes_hex": b"OK\n".hex()}]
+        if verb == "PUB":
+            t, _, payload = rest.partition(" ")
+            return [{"type": "publish", "topic": t,
+                     "payload_hex": payload.encode().hex()}]
+        return [{"type": "send", "bytes_hex": b"ERR\n".hex()}]
+
+    def on_received_messages(self, args):
+        out = []
+        for m in args["messages"]:
+            line = (f"MSG {m['topic']} "
+                    + bytes.fromhex(m["payload_hex"]).decode() + "\n")
+            out.append({"type": "send", "bytes_hex": line.encode().hex()})
+        return out
+
+
+def test_exproto_external_protocol_bridges_to_broker():
+    async def main():
+        handler = HandlerServer(EchoLineProtocol())
+        handler.start()
+        app = BrokerApp()
+        gw = app.gateway.load(ExprotoGateway(
+            handler_port=handler.port, port=0))
+        await gw.start_listeners()
+        from emqx_tpu.broker.server import BrokerServer
+        srv = BrokerServer(port=0, app=app)
+        await srv.start()
+
+        r, w = await asyncio.open_connection("127.0.0.1", gw.port)
+        w.write(b"AUTH dev-9\n")
+        assert await r.readline() == b"OK\n"
+        w.write(b"SUB alerts/#\n")
+        assert await r.readline() == b"OK\n"
+
+        mq = MqttClient(port=srv.port, clientid="m1")
+        await mq.connect()
+        await mq.subscribe("from-device/#")
+        # device → broker
+        w.write(b"PUB from-device/d9 ping\n")
+        got = await mq.recv()
+        assert got.topic == "from-device/d9" and got.payload == b"ping"
+        # broker → device
+        await mq.publish("alerts/red", b"evacuate")
+        line = await asyncio.wait_for(r.readline(), 5)
+        assert line == b"MSG alerts/red evacuate\n"
+
+        w.close()
+        await mq.close()
+        await gw.stop_listeners()
+        await srv.stop()
+        handler.stop()
+
+    run(main())
+
+
+# -- manager -------------------------------------------------------------------
+
+def test_gateway_manager_load_unload_and_mountpoint():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0),
+                              {"mountpoint": "stomp/"})
+        await gw.start_listeners()
+        assert app.gateway.list() == [{"name": "stomp",
+                                       "status": "running"}]
+        c = StompClient(gw.port)
+        await c.connect()
+        await c.send("CONNECT", {"accept-version": "1.2"})
+        await c.recv()
+        await c.send("SUBSCRIBE", {"id": "0", "destination": "x"})
+        await asyncio.sleep(0.05)
+        assert any(t == "stomp/x" for t in app.broker.subscriber)
+        await gw.stop_listeners()
+        assert app.gateway.unload("stomp")
+        assert not app.gateway.unload("stomp")
+
+    run(main())

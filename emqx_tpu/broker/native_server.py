@@ -1,0 +1,166 @@
+"""Broker listener on the native (C++ epoll) connection host.
+
+The C++ side (``emqx_tpu/native/src/host.cc``) owns sockets and framing;
+this driver consumes complete-frame events, runs the same ``Channel`` FSM
+the asyncio server uses, and pushes serialized replies back down. One
+Python thread drives the loop — the C++ host does the per-byte work
+(accept, read, frame-split, write, backpressure), which is the part the
+reference delegates to the BEAM's C core (emqx_connection.erl:132
+``{active,N}`` batching).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from emqx_tpu import native
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.cm import CM
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import FrameError, parse_one, serialize
+
+log = logging.getLogger("emqx_tpu.native_server")
+
+HOUSEKEEP_INTERVAL = 5.0
+
+
+class _NativeConn:
+    __slots__ = ("conn_id", "channel", "server")
+
+    def __init__(self, server: "NativeBrokerServer", conn_id: int, peer: str):
+        self.server = server
+        self.conn_id = conn_id
+        self.channel = Channel(
+            server.broker, server.cm,
+            mountpoint=server.mountpoint,
+            send=self._send_packets,
+        )
+        self.channel.conninfo.peername = peer
+
+    def _send_packets(self, pkts) -> None:
+        data = b"".join(
+            serialize(p, self.channel.conninfo.proto_ver) for p in pkts)
+        if data:
+            self.server.host.send(self.conn_id, data)
+
+
+class NativeBrokerServer:
+    """Same surface as ``BrokerServer`` but socket IO lives in C++."""
+
+    def __init__(
+        self,
+        broker: Optional[Broker] = None,
+        cm: Optional[CM] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_packet_size: int = 1 << 20,
+        max_connections: int = 1_000_000,
+        mountpoint: str = "",
+        app=None,
+    ):
+        if not native.available():
+            raise RuntimeError(
+                f"native host unavailable: {native.build_error()}")
+        if app is None and broker is None:
+            from emqx_tpu.app import BrokerApp
+
+            app = BrokerApp()
+        self.app = app
+        self.broker = broker or app.broker
+        self.cm = cm or (app.cm if app else CM())
+        self.mountpoint = mountpoint
+        self.host = native.NativeHost(
+            host=host, port=port,
+            max_size=max_packet_size, max_conns=max_connections)
+        self.port = self.host.port
+        self.conns: dict[int, _NativeConn] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_housekeep = time.monotonic()
+
+    # -- event loop ---------------------------------------------------------
+
+    def _step(self, timeout_ms: int = 100) -> None:
+        for kind, conn_id, payload in self.host.poll(timeout_ms):
+            if kind == native.EV_OPEN:
+                self.conns[conn_id] = _NativeConn(
+                    self, conn_id, payload.decode("ascii", "replace"))
+            elif kind == native.EV_FRAME:
+                conn = self.conns.get(conn_id)
+                if conn is not None:
+                    self._on_frame(conn, payload)
+            elif kind == native.EV_CLOSED:
+                conn = self.conns.pop(conn_id, None)
+                if conn is not None:
+                    conn.channel.terminate(payload.decode("ascii", "replace"))
+        now = time.monotonic()
+        if now - self._last_housekeep >= HOUSEKEEP_INTERVAL:
+            self._last_housekeep = now
+            self._housekeep()
+
+    def _on_frame(self, conn: _NativeConn, frame: bytes) -> None:
+        ch = conn.channel
+        try:
+            pkt = parse_one(frame, ch.conninfo.proto_ver)
+            if pkt.type == P.CONNECT:
+                ch.conninfo.proto_ver = pkt.proto_ver
+            out = ch.handle_in(pkt)
+        except (FrameError, IndexError) as e:
+            # per-connection fault isolation: a bad frame (or a channel
+            # protocol error) drops this client, never the poll thread —
+            # same containment the asyncio server gets from its per-conn task
+            log.info("frame error from %s: %s", ch.conninfo.peername, e)
+            if ch.conninfo.proto_ver == P.MQTT_V5:
+                rc = getattr(e, "rc", P.RC_MALFORMED_PACKET)
+                conn._send_packets([P.Disconnect(reason_code=rc)])
+            self._drop(conn, "frame_error")
+            return
+        except Exception:
+            log.exception("channel error from %s", ch.conninfo.peername)
+            self._drop(conn, "channel_error")
+            return
+        conn._send_packets(out)
+        if ch.conn_state == "disconnected":
+            self._drop(conn, "normal")
+
+    def _drop(self, conn: _NativeConn, reason: str) -> None:
+        self.conns.pop(conn.conn_id, None)
+        conn.channel.terminate(reason)
+        self.host.close_conn(conn.conn_id)
+
+    def _housekeep(self) -> None:
+        if self.app is not None:
+            self.app.tick()
+        for conn in list(self.conns.values()):
+            ch = conn.channel
+            if ch.keepalive_expired():
+                self._drop(conn, "keepalive_timeout")
+                continue
+            conn._send_packets(ch.handle_timeout("retry"))
+            ch.handle_timeout("expire_awaiting_rel")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Run the poll loop on a background thread."""
+        self._thread = threading.Thread(
+            target=self._run, name="emqx-native-host", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._step(timeout_ms=50)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for conn in list(self.conns.values()):
+            conn.channel.terminate("server_shutdown")
+        self.conns.clear()
+        self.host.destroy()

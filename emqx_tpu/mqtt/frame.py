@@ -359,6 +359,16 @@ def _parse_packet(header: int, body: bytes, ver: int) -> P.Packet:
     raise FrameError(f"unhandled packet type {ptype}")
 
 
+def parse_one(frame: bytes, version: int = P.MQTT_V4) -> P.Packet:
+    """Parse one *complete* wire frame (as emitted by the native framer,
+    header byte + remaining-length varint + body) into a packet."""
+    header = frame[0]
+    pos = 1
+    while frame[pos] & 0x80:
+        pos += 1
+    return _parse_packet(header, frame[pos + 1:], version)
+
+
 # --------------------------------------------------------------------------
 # serializer
 

@@ -1,0 +1,202 @@
+"""Native (C++) runtime components and their ctypes bindings.
+
+The shared library is built on first use with the system toolchain and
+cached next to the sources; ``available()`` gates every caller so the
+pure-Python paths remain fully functional without a compiler.
+
+Components (see ``src/``):
+
+- ``frame.h``   — incremental MQTT frame splitter (emqx_frame.erl:163-217
+  analogue, byte-level only);
+- ``host.cc``   — epoll connection host: accept/read/frame/write in C++,
+  complete frames exchanged with Python as compact event records (the
+  SURVEY.md §2.4 "[NATIVE] BEAM schedulers/ports" replacement).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libemqx_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_SRC_DIR, f)) > lib_mtime
+        for f in os.listdir(_SRC_DIR)
+    )
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        os.path.join(_SRC_DIR, "host.cc"),
+        "-o", _LIB_PATH,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.emqx_host_create.restype = ctypes.c_void_p
+    lib.emqx_host_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32]
+    lib.emqx_host_port.restype = ctypes.c_int
+    lib.emqx_host_port.argtypes = [ctypes.c_void_p]
+    lib.emqx_host_poll.restype = ctypes.c_long
+    lib.emqx_host_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+    lib.emqx_host_send.restype = ctypes.c_int
+    lib.emqx_host_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
+    lib.emqx_host_close_conn.restype = ctypes.c_int
+    lib.emqx_host_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_host_destroy.restype = None
+    lib.emqx_host_destroy.argtypes = [ctypes.c_void_p]
+    lib.emqx_framer_create.restype = ctypes.c_void_p
+    lib.emqx_framer_create.argtypes = [ctypes.c_uint32]
+    lib.emqx_framer_feed.restype = ctypes.c_int
+    lib.emqx_framer_feed.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_framer_destroy.restype = None
+    lib.emqx_framer_destroy.argtypes = [ctypes.c_void_p]
+    lib.emqx_buf_free.restype = None
+    lib.emqx_buf_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if stale) and load the native library; None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        try:
+            if _needs_build():
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except (OSError, subprocess.CalledProcessError) as e:
+            _build_error = (
+                e.stderr if isinstance(e, subprocess.CalledProcessError)
+                else str(e))
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+# ---------------------------------------------------------------------------
+# thin object wrappers
+
+
+class NativeFramer:
+    """ctypes wrapper over the C++ incremental framer (parity-test surface)."""
+
+    def __init__(self, max_size: int = 0x0FFFFFFF):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        self._h = self._lib.emqx_framer_create(max_size)
+
+    def feed(self, data: bytes) -> list[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        st = self._lib.emqx_framer_feed(
+            self._h, data, len(data), ctypes.byref(out), ctypes.byref(out_len))
+        raw = ctypes.string_at(out, out_len.value)
+        self._lib.emqx_buf_free(out)
+        frames, pos = [], 0
+        while pos < len(raw):
+            n = int.from_bytes(raw[pos:pos + 4], "little")
+            pos += 4
+            frames.append(raw[pos:pos + n])
+            pos += n
+        if st != 0:
+            raise ValueError(f"frame error status={st}")
+        return frames
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.emqx_framer_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# event kinds from host.cc
+EV_OPEN, EV_FRAME, EV_CLOSED = 1, 2, 3
+
+
+class NativeHost:
+    """The epoll connection host. One thread calls ``poll()``; ``send`` and
+    ``close_conn`` are safe from any thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_size: int = 1 << 20, max_conns: int = 1_000_000):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        self._h = self._lib.emqx_host_create(
+            host.encode(), port, max_size, max_conns)
+        if not self._h:
+            raise OSError(f"cannot bind {host}:{port}")
+        self.port = self._lib.emqx_host_port(self._h)
+        self._buf = ctypes.create_string_buffer(1 << 20)
+
+    def poll(self, timeout_ms: int = 100) -> Iterator[tuple[int, int, bytes]]:
+        """Yield ``(kind, conn_id, payload)`` events from one loop step."""
+        n = self._lib.emqx_host_poll(
+            self._h, self._buf, len(self._buf), timeout_ms)
+        if n <= 0:
+            return
+        raw = self._buf.raw[:n]
+        pos = 0
+        while pos < n:
+            kind = raw[pos]
+            conn = int.from_bytes(raw[pos + 1:pos + 9], "little")
+            plen = int.from_bytes(raw[pos + 9:pos + 13], "little")
+            pos += 13
+            yield kind, conn, raw[pos:pos + plen]
+            pos += plen
+
+    def send(self, conn: int, data: bytes) -> None:
+        self._lib.emqx_host_send(self._h, conn, data, len(data))
+
+    def close_conn(self, conn: int) -> None:
+        self._lib.emqx_host_close_conn(self._h, conn)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.emqx_host_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.destroy()
+        except Exception:
+            pass

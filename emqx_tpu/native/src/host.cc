@@ -1,0 +1,398 @@
+// Native connection host: an epoll event loop owning listener + client
+// sockets, doing MQTT framing in C++ and exchanging complete frames with
+// the Python protocol layer through a compact event-record stream.
+//
+// This is the TPU-era answer to the BEAM's role in the reference
+// (SURVEY.md §2.4 "[NATIVE] BEAM VM schedulers/ports"): the reference
+// relies on the VM's C-level {active,N} socket polling + per-process
+// mailboxes (emqx_connection.erl:132); here a C++ epoll loop performs
+// accept/read/frame/write and batches complete frames up to the driver,
+// which runs the channel FSM and the device router.
+//
+// Threading contract:
+//   - exactly ONE thread calls emqx_host_poll (it runs the event loop);
+//   - emqx_host_send / emqx_host_close_conn are thread-safe and may be
+//     called from any thread (they enqueue + wake the poller via eventfd);
+//   - emqx_host_destroy only after the polling thread has stopped.
+//
+// Event record wire format (host -> Python), little-endian:
+//   u8 kind | u64 conn_id | u32 len | payload[len]
+//   kind 1 = OPEN   payload = "ip:port" of the peer
+//   kind 2 = FRAME  payload = one complete MQTT frame (verbatim bytes)
+//   kind 3 = CLOSED payload = reason string
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "frame.h"
+
+namespace emqx_native {
+namespace {
+
+constexpr size_t kReadChunk = 64 * 1024;
+
+struct Conn {
+  int fd = -1;
+  Framer framer;
+  std::string outbuf;   // unsent bytes (partial-write backlog)
+  size_t outpos = 0;
+  bool want_close = false;  // close once outbuf drains
+};
+
+std::string EncodeRecord(uint8_t kind, uint64_t id, const char* data,
+                         size_t len) {
+  std::string rec;
+  rec.reserve(13 + len);
+  rec.push_back(static_cast<char>(kind));
+  for (int i = 0; i < 8; i++)
+    rec.push_back(static_cast<char>((id >> (8 * i)) & 0xFF));
+  for (int i = 0; i < 4; i++)
+    rec.push_back(static_cast<char>((len >> (8 * i)) & 0xFF));
+  rec.append(data, len);
+  return rec;
+}
+
+int SetNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags < 0 ? -1 : fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+class Host {
+ public:
+  Host(uint32_t max_size, uint32_t max_conns)
+      : max_size_(max_size), max_conns_(max_conns) {}
+
+  ~Host() {
+    for (auto& [id, c] : conns_) close(c.fd);
+    if (listen_fd_ >= 0) close(listen_fd_);
+    if (wake_fd_ >= 0) close(wake_fd_);
+    if (epoll_fd_ >= 0) close(epoll_fd_);
+  }
+
+  bool Init(const char* bind_addr, uint16_t port) {
+    epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (epoll_fd_ < 0 || wake_fd_ < 0 || listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) return false;
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    if (listen(listen_fd_, 1024) < 0) return false;
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenTag;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.events = EPOLLIN;
+    ev.data.u64 = kWakeTag;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    return true;
+  }
+
+  int port() const { return port_; }
+
+  // Thread-safe enqueue of outbound bytes for a connection.
+  int Send(uint64_t id, const uint8_t* data, size_t len) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      pending_.emplace_back(id, std::string(
+          reinterpret_cast<const char*>(data), len));
+    }
+    Wake();
+    return 0;
+  }
+
+  int CloseConn(uint64_t id) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      pending_closes_.push_back(id);
+    }
+    Wake();
+    return 0;
+  }
+
+  // Run one event-loop step on the calling thread; fill `buf` with as
+  // many whole event records as fit. Returns bytes written (0 on
+  // timeout with no events).
+  long Poll(uint8_t* buf, size_t cap, int timeout_ms) {
+    if (events_.empty()) {
+      ApplyPending();
+      epoll_event evs[256];
+      int n = epoll_wait(epoll_fd_, evs, 256, timeout_ms);
+      if (n < 0) return errno == EINTR ? 0 : -1;
+      for (int i = 0; i < n; i++) HandleEvent(evs[i]);
+      ApplyPending();
+    }
+    size_t written = 0;
+    while (!events_.empty()) {
+      const std::string& rec = events_.front();
+      if (written + rec.size() > cap) break;
+      memcpy(buf + written, rec.data(), rec.size());
+      written += rec.size();
+      events_.pop_front();
+    }
+    return static_cast<long>(written);
+  }
+
+ private:
+  static constexpr uint64_t kListenTag = ~0ull;
+  static constexpr uint64_t kWakeTag = ~0ull - 1;
+
+  void Wake() {
+    uint64_t one = 1;
+    [[maybe_unused]] ssize_t r = write(wake_fd_, &one, sizeof(one));
+  }
+
+  // Move cross-thread sends/closes into connection write buffers.
+  void ApplyPending() {
+    std::vector<std::pair<uint64_t, std::string>> sends;
+    std::vector<uint64_t> closes;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      sends.swap(pending_);
+      closes.swap(pending_closes_);
+    }
+    for (auto& [id, data] : sends) {
+      auto it = conns_.find(id);
+      if (it == conns_.end()) continue;
+      it->second.outbuf += data;
+      Flush(id, it->second);
+    }
+    for (uint64_t id : closes) {
+      auto it = conns_.find(id);
+      if (it == conns_.end()) continue;
+      it->second.want_close = true;
+      if (it->second.outbuf.size() == it->second.outpos)
+        Drop(id, "closed_by_host", false);
+    }
+  }
+
+  void HandleEvent(const epoll_event& ev) {
+    if (ev.data.u64 == kWakeTag) {
+      uint64_t junk;
+      while (read(wake_fd_, &junk, sizeof(junk)) > 0) {}
+      return;
+    }
+    if (ev.data.u64 == kListenTag) {
+      Accept();
+      return;
+    }
+    uint64_t id = ev.data.u64;
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    if (ev.events & (EPOLLHUP | EPOLLERR)) {
+      Drop(id, "sock_error", true);
+      return;
+    }
+    if (ev.events & EPOLLOUT) {
+      Flush(id, it->second);
+      it = conns_.find(id);
+      if (it == conns_.end()) return;
+    }
+    if (ev.events & EPOLLIN) Read(id, it->second);
+  }
+
+  void Accept() {
+    for (;;) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = accept4(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &plen,
+                       SOCK_NONBLOCK | SOCK_CLOEXEC);
+      if (fd < 0) return;
+      if (conns_.size() >= max_conns_) {  // esockd max-conn limiting
+        close(fd);
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint64_t id = next_id_++;
+      Conn c;
+      c.fd = fd;
+      c.framer = Framer(max_size_);
+      conns_.emplace(id, std::move(c));
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u64 = id;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+      char ip[INET_ADDRSTRLEN] = "?";
+      inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+      std::string info = std::string(ip) + ":" +
+                         std::to_string(ntohs(peer.sin_port));
+      events_.push_back(EncodeRecord(1, id, info.data(), info.size()));
+    }
+  }
+
+  void Read(uint64_t id, Conn& c) {
+    uint8_t chunk[kReadChunk];
+    for (;;) {
+      ssize_t n = recv(c.fd, chunk, sizeof(chunk), 0);
+      if (n > 0) {
+        std::vector<std::string> frames;
+        FrameStatus st = c.framer.Feed(chunk, static_cast<size_t>(n), &frames);
+        for (auto& f : frames)
+          events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+        if (st != FrameStatus::kOk) {
+          Drop(id, "frame_error", true);
+          return;
+        }
+        if (static_cast<size_t>(n) < sizeof(chunk)) return;
+      } else if (n == 0) {
+        Drop(id, "sock_closed", true);
+        return;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EINTR) continue;
+        Drop(id, "sock_error", true);
+        return;
+      }
+    }
+  }
+
+  void Flush(uint64_t id, Conn& c) {
+    while (c.outpos < c.outbuf.size()) {
+      ssize_t n = ::send(c.fd, c.outbuf.data() + c.outpos,
+                         c.outbuf.size() - c.outpos, MSG_NOSIGNAL);
+      if (n > 0) {
+        c.outpos += static_cast<size_t>(n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.u64 = id;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+        return;
+      } else if (n < 0 && errno == EINTR) {
+        continue;
+      } else {
+        Drop(id, "sock_error", true);
+        return;
+      }
+    }
+    c.outbuf.clear();
+    c.outpos = 0;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = id;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+    if (c.want_close) Drop(id, "closed_by_host", false);
+  }
+
+  void Drop(uint64_t id, const char* reason, bool notify) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    close(it->second.fd);
+    conns_.erase(it);
+    if (notify)
+      events_.push_back(EncodeRecord(3, id, reason, strlen(reason)));
+  }
+
+  uint32_t max_size_;
+  uint32_t max_conns_;
+  int epoll_fd_ = -1;
+  int listen_fd_ = -1;
+  int wake_fd_ = -1;
+  int port_ = 0;
+  uint64_t next_id_ = 1;
+  std::unordered_map<uint64_t, Conn> conns_;
+  std::deque<std::string> events_;  // encoded records awaiting pickup
+  std::mutex mu_;
+  std::vector<std::pair<uint64_t, std::string>> pending_;
+  std::vector<uint64_t> pending_closes_;
+};
+
+}  // namespace
+}  // namespace emqx_native
+
+// ---------------------------------------------------------------------------
+// C ABI for ctypes
+
+extern "C" {
+
+void* emqx_host_create(const char* bind_addr, uint16_t port,
+                       uint32_t max_size, uint32_t max_conns) {
+  auto* h = new emqx_native::Host(max_size, max_conns);
+  if (!h->Init(bind_addr, port)) {
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+int emqx_host_port(void* h) {
+  return static_cast<emqx_native::Host*>(h)->port();
+}
+
+long emqx_host_poll(void* h, uint8_t* buf, size_t cap, int timeout_ms) {
+  return static_cast<emqx_native::Host*>(h)->Poll(buf, cap, timeout_ms);
+}
+
+int emqx_host_send(void* h, uint64_t conn, const uint8_t* data, size_t len) {
+  return static_cast<emqx_native::Host*>(h)->Send(conn, data, len);
+}
+
+int emqx_host_close_conn(void* h, uint64_t conn) {
+  return static_cast<emqx_native::Host*>(h)->CloseConn(conn);
+}
+
+void emqx_host_destroy(void* h) {
+  delete static_cast<emqx_native::Host*>(h);
+}
+
+// --- standalone framer (for parity tests + non-socket embedding) ----------
+
+void* emqx_framer_create(uint32_t max_size) {
+  return new emqx_native::Framer(max_size);
+}
+
+// Feeds a chunk; returns a malloc'd buffer of concatenated
+// [u32 len][frame bytes] records in *out/*out_len (caller frees with
+// emqx_buf_free). Returns the FrameStatus as int.
+int emqx_framer_feed(void* f, const uint8_t* data, size_t len, uint8_t** out,
+                     size_t* out_len) {
+  std::vector<std::string> frames;
+  auto st = static_cast<emqx_native::Framer*>(f)->Feed(data, len, &frames);
+  size_t total = 0;
+  for (auto& fr : frames) total += 4 + fr.size();
+  uint8_t* buf = static_cast<uint8_t*>(malloc(total ? total : 1));
+  size_t pos = 0;
+  for (auto& fr : frames) {
+    uint32_t n = static_cast<uint32_t>(fr.size());
+    memcpy(buf + pos, &n, 4);
+    pos += 4;
+    memcpy(buf + pos, fr.data(), fr.size());
+    pos += fr.size();
+  }
+  *out = buf;
+  *out_len = total;
+  return static_cast<int>(st);
+}
+
+void emqx_framer_destroy(void* f) {
+  delete static_cast<emqx_native::Framer*>(f);
+}
+
+void emqx_buf_free(void* p) { free(p); }
+
+}  // extern "C"

@@ -120,6 +120,9 @@ class BridgeManager:
             qdir = None
             if self.queue_base_dir and conf.get("disk_queue", False):
                 qdir = f"{self.queue_base_dir}/{type}_{name}"
+            # auto_flush: production bridges honour batch_time_s with a
+            # dedicated flusher; the 5s app tick is only the safety net
+            worker_opts.setdefault("auto_flush", True)
             worker = BufferWorker(manager, queue_dir=qdir, **worker_opts)
             bridge = Bridge(type, name, conf, manager, worker)
             self.bridges[bid] = bridge
@@ -202,6 +205,7 @@ class BridgeManager:
             except Exception:
                 pass
         bridge.enabled = False
+        bridge.worker.close()
         bridge.manager.stop()
         return True
 

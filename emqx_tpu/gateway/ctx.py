@@ -135,19 +135,39 @@ class GwContext:
 
     # -- pub/sub -------------------------------------------------------------
 
+    def authorize(self, clientid: str, action: str, topic: str) -> bool:
+        """client.authorize fold, same contract as the MQTT channel
+        (broker/channel.py:281) — gateway clients go through the very
+        same ACL chain."""
+        verdict = self.app.hooks.run_fold(
+            "client.authorize",
+            ({"clientid": clientid, "username": None,
+              "peername": f"gw:{self.gwname}"}, action, topic),
+            "allow",
+        )
+        return verdict == "allow"
+
     def publish(self, clientid: str, topic: str, payload: bytes,
                 qos: int = 0, retain: bool = False,
-                props: Optional[dict] = None) -> None:
+                props: Optional[dict] = None) -> bool:
+        mounted = self.mount(topic)
+        if not self.authorize(clientid, "publish", mounted):
+            self.metrics_inc("messages.dropped.authz")
+            return False
         msg = Message(
-            topic=self.mount(topic), payload=payload, qos=qos,
+            topic=mounted, payload=payload, qos=qos,
             from_=clientid, flags={"retain": retain} if retain else {},
             headers={"properties": props or {}, "gateway": self.gwname},
         )
         self.app.cm.dispatch(self.app.broker.publish(msg))
+        return True
 
-    def subscribe(self, clientid: str, topic: str, qos: int = 0) -> None:
-        self.app.broker.subscribe(
-            clientid, self.mount(topic), SubOpts(qos=qos))
+    def subscribe(self, clientid: str, topic: str, qos: int = 0) -> bool:
+        mounted = self.mount(topic)
+        if not self.authorize(clientid, "subscribe", mounted):
+            return False
+        self.app.broker.subscribe(clientid, mounted, SubOpts(qos=qos))
+        return True
 
     def unsubscribe(self, clientid: str, topic: str) -> bool:
         return self.app.broker.unsubscribe(clientid, self.mount(topic))
@@ -178,6 +198,14 @@ class GatewayManager:
         impl = self.gateways.pop(name, None)
         if impl is None:
             return False
+        # an unloaded gateway must stop accepting traffic: tear down its
+        # listeners (scheduled if we're on a running loop, inline otherwise)
+        import asyncio
+
+        try:
+            asyncio.get_running_loop().create_task(impl.stop_listeners())
+        except RuntimeError:
+            asyncio.run(impl.stop_listeners())
         impl.on_gateway_unload()
         return True
 

@@ -24,6 +24,7 @@ Commands (the ConnectionAdapter surface the external service drives):
 
 from __future__ import annotations
 
+import queue
 import socket
 import socketserver
 import threading
@@ -56,23 +57,48 @@ class Channel(GwChannel):
         self.conn_state = "connected"
         self.clientid: Optional[str] = None
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
-        self._call("OnSocketCreated",
-                   {"conn": self.conn_ref, "peername": "tcp"})
+        # handler RPCs are blocking network calls and must never run on
+        # the broker's event loop — a per-channel worker serializes them
+        # (per-connection ordering) and pushes replies via the
+        # thread-safe ``send`` the conn adapter binds
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._drain, daemon=True,
+            name=f"exproto-{self.conn_ref}")
+        self._worker.start()
+        self._enqueue("OnSocketCreated",
+                      {"conn": self.conn_ref, "peername": "tcp"})
 
-    # -- RPC to the external handler -----------------------------------------
+    # -- RPC to the external handler (worker thread only) --------------------
+
+    def _enqueue(self, rpc_name: str, args: dict) -> None:
+        self._queue.put((rpc_name, args))
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            frames = self._call(*item)
+            if frames:
+                self.send(frames)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _call(self, rpc_name: str, args: dict) -> list:
-        with self._lock:
-            try:
-                if self._sock is None:
-                    self._sock = socket.create_connection(
-                        self.handler_addr, timeout=self.timeout_s)
-                rpc.send_frame(self._sock, {"rpc": rpc_name, "args": args})
-                resp = rpc.recv_frame(self._sock)
-            except OSError:
-                self._sock = None
-                return [{"type": "close"}]
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self.handler_addr, timeout=self.timeout_s)
+            rpc.send_frame(self._sock, {"rpc": rpc_name, "args": args})
+            resp = rpc.recv_frame(self._sock)
+        except OSError:
+            self._sock = None
+            return self._exec([{"type": "close"}])
         if resp is None or resp.get("error"):
             return []
         return self._exec(resp.get("result") or [])
@@ -107,8 +133,9 @@ class Channel(GwChannel):
     # -- GwChannel -----------------------------------------------------------
 
     def handle_in(self, data: bytes) -> list[bytes]:
-        return self._call("OnReceivedBytes",
-                          {"conn": self.conn_ref, "bytes_hex": data.hex()})
+        self._enqueue("OnReceivedBytes",
+                      {"conn": self.conn_ref, "bytes_hex": data.hex()})
+        return []      # replies arrive via send() once the worker answers
 
     def handle_deliver(self, deliveries: list) -> list[bytes]:
         msgs = [{
@@ -116,23 +143,18 @@ class Channel(GwChannel):
             "payload_hex": msg.payload.hex(),
             "qos": msg.qos,
         } for _st, msg in deliveries]
-        return self._call("OnReceivedMessages",
-                          {"conn": self.conn_ref, "messages": msgs})
+        self._enqueue("OnReceivedMessages",
+                      {"conn": self.conn_ref, "messages": msgs})
+        return []
 
     def terminate(self, reason: str) -> None:
         if self.conn_state != "terminated":
             self.conn_state = "terminated"
-            self._call("OnSocketClosed",
-                       {"conn": self.conn_ref, "reason": reason})
+            self._enqueue("OnSocketClosed",
+                          {"conn": self.conn_ref, "reason": reason})
+            self._queue.put(None)     # worker closes the RPC socket
             if self.clientid is not None:
                 self.ctx.close_session(self.clientid, self, reason)
-            with self._lock:
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
 
 
 class ExprotoGateway(GatewayImpl):

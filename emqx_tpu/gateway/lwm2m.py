@@ -65,10 +65,10 @@ class Channel(GwChannel):
             ep = q.get("ep")
             if not ep:
                 return [reply(BAD_REQUEST)]
+            if not self.ctx.authenticate(f"lwm2m-{ep}"):
+                return [reply(BAD_REQUEST)]
             self.endpoint = ep
             self.clientid = f"lwm2m-{ep}"
-            if not self.ctx.authenticate(self.clientid):
-                return [reply(BAD_REQUEST)]
             self.lifetime = int(q.get("lt", 86400))
             self.reg_id = f"{abs(hash(ep)) % 100000}"
             self.ctx.open_session(self.clientid, self)
@@ -98,8 +98,11 @@ class Channel(GwChannel):
             self._uplink("deregister", {"ep": self.endpoint})
             self.conn_state = "disconnected"
             return [reply(DELETED)]
-        # device-originated notify (e.g. POST /rd/{id}/notify)
+        # device-originated notify (e.g. POST /rd/{id}/notify) — only from
+        # a registered endpoint, addressed by its own registration id
         if m.code == POST and len(path) == 3 and path[2] == "notify":
+            if self.reg_id is None or path[1] != self.reg_id:
+                return [reply(NOT_FOUND)]
             self._uplink("notify", {
                 "ep": self.endpoint,
                 "payload": m.payload.decode("utf-8", "replace")})

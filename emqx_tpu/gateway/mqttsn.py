@@ -61,10 +61,16 @@ class Frame(GwFrame):
         out = []
         while data:
             if data[0] == 0x01:
+                if len(data) < 3:
+                    break
                 (ln,) = struct.unpack_from(">H", data, 1)
+                if ln < 4:          # length covers the 3-byte prefix + type
+                    break           # malformed: refuse, don't spin
                 body, data = data[3:ln], data[ln:]
             else:
                 ln = data[0]
+                if ln < 2:          # ln==0/1 would not consume any bytes
+                    break
                 body, data = data[1:ln], data[ln:]
             if body:
                 out.append(self._parse_body(body))
@@ -184,6 +190,7 @@ class Channel(GwChannel):
         self._next_tid = 0
         self._next_mid = 0
         self.awake = True
+        self._sleep_buffer: list = []   # deliveries parked during sleep
 
     def _alloc_tid(self, topic: str) -> int:
         tid = self.id_of_topic.get(topic)
@@ -264,7 +271,9 @@ class Channel(GwChannel):
                                   msg_id=m.msg_id,
                                   rc=RC_INVALID_TOPIC_ID)]
             qos = max(0, qos_of(m.flags))
-            self.ctx.subscribe(self.clientid, topic, qos)
+            if not self.ctx.subscribe(self.clientid, topic, qos):
+                return [SnMessage(SUBACK, flags=m.flags, topic_id=0,
+                                  msg_id=m.msg_id, rc=RC_NOT_SUPPORTED)]
             return [SnMessage(SUBACK, flags=qos_flags(qos), topic_id=tid,
                               msg_id=m.msg_id, rc=RC_ACCEPTED)]
         if t == UNSUBSCRIBE:
@@ -276,8 +285,11 @@ class Channel(GwChannel):
         if t == PUBACK:
             return []
         if t == PINGREQ:
+            # waking from sleep flushes parked messages, then PINGRESP
+            # (MQTT-SN §6.14: buffered delivery on the keepalive ping)
             self.awake = True
-            return [SnMessage(PINGRESP)]
+            parked, self._sleep_buffer = self._sleep_buffer, []
+            return self.handle_deliver(parked) + [SnMessage(PINGRESP)]
         if t == DISCONNECT:
             if m.duration:           # sleep mode: keep session, stop io
                 self.awake = False
@@ -289,6 +301,10 @@ class Channel(GwChannel):
     # -- outbound ------------------------------------------------------------
 
     def handle_deliver(self, deliveries: list) -> list[SnMessage]:
+        if not self.awake:
+            # asleep (radio off): park until the next PINGREQ
+            self._sleep_buffer.extend(deliveries)
+            return []
         out: list[SnMessage] = []
         for _sub_topic, msg in deliveries:
             topic = self.ctx.unmount(msg.topic)

@@ -45,6 +45,7 @@ class BufferWorker:
         encode: Callable[[Any], bytes] = _default_encode,
         decode: Callable[[bytes], Any] = _default_decode,
         on_result: Optional[Callable[[Any, Any], None]] = None,
+        auto_flush: bool = False,
     ) -> None:
         self.manager = manager
         self.batch_size = batch_size
@@ -67,6 +68,17 @@ class BufferWorker:
         # (app.tick runs in to_thread): without this, both pop/ack the
         # same batch — duplicated sends + silently discarded requests
         self._lock = threading.RLock()
+        # auto_flush: a dedicated flusher honours batch_time_s/batch_size
+        # instead of waiting for the (much slower) app housekeeping tick.
+        # Off by default so tests with simulated clocks stay deterministic.
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+        if auto_flush:
+            self._flusher = threading.Thread(
+                target=self._run_flusher, daemon=True,
+                name=f"buffer-{manager.id}")
+            self._flusher.start()
 
     # -- enqueue -------------------------------------------------------------
 
@@ -83,9 +95,10 @@ class BufferWorker:
                 self._next_flush_at = now + self.batch_time_s
             # NOTE: no inline flush here even at batch_size — enqueue is
             # called from publish hooks on the event-loop thread, and a
-            # flush does blocking network I/O. All I/O happens on the
-            # housekeeping thread (tick/flush), which server.py already
-            # runs via asyncio.to_thread.
+            # flush does blocking network I/O. The flusher thread (or the
+            # housekeeping tick) does the I/O; a full batch just wakes it.
+            if self._flusher is not None and self.q.count() >= self.batch_size:
+                self._wake.set()
             return True
 
     def queuing(self) -> int:
@@ -96,9 +109,29 @@ class BufferWorker:
     def tick(self, now: Optional[float] = None) -> None:
         with self._lock:
             now = time.monotonic() if now is None else now
-            if self.q.count() and now >= max(self._next_flush_at,
-                                             self._next_retry_at):
+            if self.q.count() and (
+                    self.q.count() >= self.batch_size
+                    or now >= self._next_flush_at
+            ) and now >= self._next_retry_at:
                 self.flush(now)
+
+    def _run_flusher(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.batch_time_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.tick()
+            except Exception:
+                log.exception("buffer %s flusher", self.manager.id)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=2)
+            self._flusher = None
 
     def flush(self, now: Optional[float] = None) -> int:
         """Drain as many full/partial batches as the resource accepts;

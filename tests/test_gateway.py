@@ -500,3 +500,78 @@ def test_gateway_manager_load_unload_and_mountpoint():
         assert not app.gateway.unload("stomp")
 
     run(main())
+
+
+# -- review-fix regressions ----------------------------------------------------
+
+def test_mqttsn_frame_malformed_length_does_not_loop():
+    f = SN.Frame()
+    # zero/one length octets and a truncated 3-byte-prefix header must
+    # terminate parsing instead of spinning forever
+    for bad in (b"\x00", b"\x01", b"\x01\x00", b"\x01\x00\x00",
+                b"\x01\x00\x02\x00"):
+        pkts, _ = f.parse(bad, None)
+        assert pkts == []
+
+
+def test_mqttsn_sleep_mode_buffers_until_pingreq():
+    from emqx_tpu.gateway.ctx import GwContext
+
+    app = BrokerApp()
+    ch = SN.Channel(GwContext(app, "mqttsn"), SN.Registry())
+    assert ch.handle_in(SN.SnMessage(SN.CONNECT, clientid="dev1"))[0].rc == 0
+    # enter sleep
+    out = ch.handle_in(SN.SnMessage(SN.DISCONNECT, duration=60))
+    assert out[0].type == SN.DISCONNECT and not ch.awake
+    from emqx_tpu.core.message import Message
+    delivered = ch.handle_deliver(
+        [("t", Message(topic="t", payload=b"zzz", qos=0))])
+    assert delivered == []                       # parked, not sent
+    woke = ch.handle_in(SN.SnMessage(SN.PINGREQ))
+    kinds = [m.type for m in woke]
+    assert kinds[-1] == SN.PINGRESP
+    assert SN.PUBLISH in kinds                   # parked message flushed
+
+
+def test_gateway_ctx_runs_authorize_hook():
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.gateway.ctx import GwContext
+
+    app = BrokerApp()
+    app.hooks.add(
+        "client.authorize",
+        lambda ci, action, topic, acc:
+            (Hooks.STOP, "deny") if topic.startswith("secret/") else None,
+        priority=2000)      # outrank the AccessControl chain terminator
+    ctx = GwContext(app, "test")
+    assert ctx.publish("c1", "ok/topic", b"x") is True
+    assert ctx.publish("c1", "secret/topic", b"x") is False
+    assert ctx.subscribe("c1", "secret/#") is False
+    assert ctx.subscribe("c1", "ok/#") is True
+
+
+def test_lwm2m_notify_requires_registration():
+    from emqx_tpu.gateway.ctx import GwContext
+    from emqx_tpu.gateway.lwm2m import Channel as LwChannel, NOT_FOUND, POST
+
+    app = BrokerApp()
+    ch = LwChannel(GwContext(app, "lwm2m"))
+    m = CoapMessage(0, POST, 1, b"", [(11, b"rd"), (11, b"999"),
+                                      (11, b"notify")], b"{}")
+    out = ch.handle_in(m)
+    assert out[0].code == NOT_FOUND              # unregistered → rejected
+
+
+def test_gateway_unload_stops_listeners():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        port = gw.port
+        assert app.gateway.unload("stomp") is True
+        await asyncio.sleep(0.05)                # scheduled teardown runs
+        with pytest.raises(OSError):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            # if something still accepts, fail loudly
+            w.close()
+    run(main())

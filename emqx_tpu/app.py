@@ -91,6 +91,16 @@ class BrokerApp:
             hooks=self.hooks)
         from emqx_tpu.gateway.ctx import GatewayManager
         self.gateway = GatewayManager(self)
+        from emqx_tpu.broker.olp import Congestion, GcPolicy, Olp
+        from emqx_tpu.observe.trace import TraceManager
+        from emqx_tpu.services.slow_subs import SlowSubs
+        self.trace = TraceManager()
+        self.trace.attach(self.hooks)
+        self.slow_subs = SlowSubs()
+        self.slow_subs.attach(self.hooks)
+        self.olp = Olp()
+        self.gc_policy = GcPolicy()
+        self.congestion = Congestion(alarms=self.alarms)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)
@@ -238,6 +248,8 @@ class BrokerApp:
             **overrides,
         )
         app.config = conf
+        app.broker.exclusive_enabled = bool(
+            conf.get("mqtt.exclusive_subscription"))
         app.sys.heartbeat_s = float(
             conf.get("sys_topics.sys_heartbeat_interval"))
         app.sys.tick_s = float(conf.get("sys_topics.sys_msg_interval"))
@@ -330,6 +342,8 @@ class BrokerApp:
         self.delayed.tick()
         self.stats.tick()
         self.sys.tick()
+        self.trace.tick()
+        self.slow_subs.gc()
         self.access.banned.expire()
         for fn in self._tickers:
             fn()

@@ -220,6 +220,7 @@ class BridgeManager:
         if b is None:
             return False
         b.enabled = on
+        b.worker.paused = not on     # keep buffered data while disabled
         if on and b.manager.state == "stopped":
             b.manager.start()
         elif not on:

@@ -77,6 +77,14 @@ class SlotRegistry:
         return self._next
 
 
+class ExclusiveLocked(Exception):
+    """$exclusive/... topic already held by another subscriber."""
+
+    def __init__(self, topic: str, holder: Sid) -> None:
+        super().__init__(f"{topic} exclusively held by {holder}")
+        self.topic, self.holder = topic, holder
+
+
 class Broker:
     """Single-node pub/sub core; the cluster plane plugs in via
     ``forward_fn`` (gen_rpc analogue) for remote-node routes."""
@@ -102,6 +110,12 @@ class Broker:
         self.suboption: dict[tuple[Sid, str], SubOpts] = {}
         self.subscription: dict[Sid, set[str]] = {}
         self.subscriber: dict[str, set[Sid]] = {}
+        # $exclusive/... topics: one subscriber at a time
+        # (emqx_exclusive_subscription.erl — mnesia there, a guarded map
+        # here; clusterwide exclusivity rides the route-replication log).
+        # Gated by the mqtt.exclusive_subscription cap (emqx_mqtt_caps).
+        self.exclusive: dict[str, Sid] = {}
+        self.exclusive_enabled = True
         if metrics is None:
             from emqx_tpu.observe.metrics import Metrics
             metrics = Metrics()
@@ -122,6 +136,14 @@ class Broker:
         if group:
             opts = SubOpts(**{**opts.__dict__, "share": group})
         with self._lock:
+            if not group and getattr(opts, "exclusive", False):
+                # subscription already carries the real (stripped) topic;
+                # exclusivity is a lock keyed by it (try_subscribe txn,
+                # emqx_exclusive_subscription.erl)
+                holder = self.exclusive.get(topic)
+                if holder is not None and holder != sid:
+                    raise ExclusiveLocked(topic, holder)
+                self.exclusive[topic] = sid
             key = (sid, topic)
             is_new = key not in self.suboption
             self.suboption[key] = opts
@@ -155,6 +177,9 @@ class Broker:
             opts = self.suboption.pop((sid, topic), None)
             if opts is None:
                 return False
+            if (getattr(opts, "exclusive", False)
+                    and self.exclusive.get(topic) == sid):
+                del self.exclusive[topic]
             self.subscription.get(sid, set()).discard(topic)
             subs_key = real_topic if not group else topic
             subs = self.subscriber.get(subs_key)

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.broker import Broker, ExclusiveLocked
 from emqx_tpu.broker.cm import CM
 from emqx_tpu.broker.hooks import Hooks
 from emqx_tpu.core import topic as T
@@ -215,10 +215,15 @@ class Channel:
         self.session = session
         # restart-resume: the store prefilled session.subscriptions —
         # rebuild the broker's routes/tables for any not already live
-        for sub_topic, sub_opts in session.subscriptions.items():
+        for sub_topic, sub_opts in list(session.subscriptions.items()):
             if (clientid, sub_topic) not in self.broker.suboption:
-                self.broker.subscribe(clientid, sub_topic, sub_opts,
-                                      restore=True)
+                try:
+                    self.broker.subscribe(clientid, sub_topic, sub_opts,
+                                          restore=True)
+                except ExclusiveLocked:
+                    # the $exclusive topic was claimed while we were away:
+                    # degrade that one subscription, never the whole resume
+                    session.subscriptions.pop(sub_topic, None)
         ci.connected_at = now_ms()
         self.conn_state = "connected"
         self.hooks.run("client.connected", (ci,))
@@ -375,6 +380,15 @@ class Channel:
             subid = subid[0] if subid else None
         for filt, opts in pkt.topic_filters:
             group, real = T.parse_share(filt)
+            exclusive = False
+            if not group:
+                # $exclusive/t → exclusive flag + real topic t
+                # (emqx_topic.erl:225-230 parse)
+                exclusive, real = T.parse_exclusive(real)
+            if exclusive and not self.broker.exclusive_enabled:
+                # cap disabled → invalid filter (emqx_mqtt_caps:do_check_sub)
+                rcs.append(P.RC_TOPIC_FILTER_INVALID)
+                continue
             if not T.validate_filter(real):
                 rcs.append(P.RC_TOPIC_FILTER_INVALID)
                 continue
@@ -404,14 +418,21 @@ class Channel:
             subopts = SubOpts(
                 qos=opts.get("qos", 0), nl=opts.get("nl", 0),
                 rap=opts.get("rap", 0), rh=opts.get("rh", 0),
-                share=group, subid=subid,
+                share=group, subid=subid, exclusive=exclusive,
             )
             try:
                 self.session.subscribe(mounted_key, subopts)
             except SessionError as e:
                 rcs.append(e.rc)
                 continue
-            self.broker.subscribe(self.clientid, mounted_key, subopts)
+            try:
+                self.broker.subscribe(self.clientid, mounted_key, subopts)
+            except ExclusiveLocked:
+                # $exclusive/... already held → 0x97, same rc the
+                # reference returns (emqx_exclusive_subscription.erl)
+                self.session.unsubscribe(mounted_key)
+                rcs.append(P.RC_QUOTA_EXCEEDED)
+                continue
             rcs.append(subopts.qos)  # granted qos
         return [P.SubAck(packet_id=pkt.packet_id, reason_codes=rcs)]
 
@@ -419,6 +440,8 @@ class Channel:
         rcs: list[int] = []
         for filt in pkt.topic_filters:
             group, real = T.parse_share(filt)
+            if not group:
+                _excl, real = T.parse_exclusive(real)
             mounted_real = self._mount(real)
             mounted_key = (
                 f"{T.SHARE_PREFIX}/{group}/{mounted_real}" if group
@@ -467,7 +490,16 @@ class Channel:
                 if self.session is not None:
                     self.session.enqueue(sub_topic, msg)
             return []
-        return self._postprocess_out(self.session.deliver(list(deliveries)))
+        out = self._postprocess_out(self.session.deliver(list(deliveries)))
+        # per-delivery latency from the deliver-begin stamp (the
+        # reference's mark_begin_deliver, emqx_session.erl:908) → slow_subs
+        now = now_ms()
+        for sub_topic, msg in deliveries:
+            begin = msg.extra.get("deliver_begin_at", msg.timestamp)
+            self.hooks.run(
+                "delivery.completed",
+                (self.clientid, msg.topic, now - begin))
+        return out
 
     # -- timers -------------------------------------------------------------
 

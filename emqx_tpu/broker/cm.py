@@ -103,7 +103,16 @@ class CM:
 
     def dispatch(self, deliveries: dict[str, list]) -> None:
         """Fan broker deliveries out to each target channel's socket."""
+        from emqx_tpu.core.message import now_ms
+
+        begin = now_ms()
         for sid, items in deliveries.items():
+            # deliver-begin stamp (emqx_session.erl:908 mark_begin_deliver):
+            # slow-subs latency measures dispatch→flush, not storage age —
+            # retained/delayed messages would otherwise report their shelf
+            # time as delivery latency
+            for _st, m in items:
+                m.extra.setdefault("deliver_begin_at", begin)
             ch = self._channels.get(sid)
             if ch is not None:
                 ch.send(ch.handle_deliver(items))

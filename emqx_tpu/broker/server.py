@@ -91,6 +91,10 @@ class Connection:
                 await self._limit("bytes_in", len(data))
                 if self.metrics is not None:
                     self.metrics.inc("bytes.received", len(data))
+                gc_policy = getattr(self.server.app, "gc_policy", None)
+                if gc_policy is not None:
+                    gc_policy.note(1, len(data),
+                                   getattr(self.server.app, "olp", None))
                 for pkt in self.parser.feed(data):
                     if pkt.type == P.PUBLISH:
                         await self._limit("message_in", 1)
@@ -137,6 +141,9 @@ class Connection:
             self.closed = True
         self.channel.terminate(reason)
         self.server.connections.discard(self)
+        congestion = getattr(self.server.app, "congestion", None)
+        if congestion is not None:
+            congestion.forget(self.channel.conninfo.peername)
         try:
             self.writer.close()
             await self.writer.wait_closed()
@@ -196,6 +203,10 @@ class BrokerServer:
         if len(self.connections) >= self.max_connections:
             writer.close()          # esockd max-conn limiting
             return
+        olp = getattr(self.app, "olp", None)
+        if olp is not None and olp.backoff_new_conn():
+            writer.close()          # overload shedding (emqx_olp)
+            return
         if self.limiter is not None:
             ok, _retry = self.limiter.connect(self.listener_id)
             if not ok:
@@ -215,14 +226,27 @@ class BrokerServer:
         log.info("listening on %s:%d", self.host, self.port)
 
     async def _housekeep_loop(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
+            before = loop.time()
             await asyncio.sleep(HOUSEKEEP_INTERVAL)
+            # scheduling drift = our run-queue signal (emqx_olp)
+            lag_ms = (loop.time() - before - HOUSEKEEP_INTERVAL) * 1000
+            olp = getattr(self.app, "olp", None)
+            if olp is not None:
+                olp.note_lag(lag_ms)
             if self.app is not None:
                 # off-loop: the tick may block (bridge reconnects, disk
                 # queue flushes) and must never stall the accept loop
                 await asyncio.to_thread(self.app.tick)
+            congestion = getattr(self.app, "congestion", None)
             for conn in list(self.connections):
                 conn.housekeep()
+                if congestion is not None and not conn.closed:
+                    transport = conn.writer.transport
+                    congestion.check(
+                        conn.channel.conninfo.peername,
+                        transport.get_write_buffer_size())
 
     async def stop(self) -> None:
         if self._housekeeper:

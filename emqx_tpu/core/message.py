@@ -113,6 +113,7 @@ class SubOpts:
     nl: int = 0      # no-local
     share: Optional[str] = None   # $share group name
     subid: Optional[int] = None   # MQTT5 subscription identifier
+    exclusive: bool = False       # came in as $exclusive/... (is_exclusive)
 
     def effective_qos(self, msg_qos: int) -> int:
         """Granted delivery QoS = min(subscription max QoS, message QoS)."""

@@ -120,6 +120,19 @@ def parse_share(topic: str) -> tuple[Optional[str], str]:
     return None, topic
 
 
+EXCLUSIVE_PREFIX = "$exclusive"
+
+
+def parse_exclusive(topic: str) -> tuple[bool, str]:
+    """``$exclusive/t/1`` → ``(True, "t/1")`` — the reference strips the
+    prefix and flags the subopts (emqx_topic.erl:225-230); the
+    subscription itself lands on the real topic."""
+    ws = words(topic)
+    if ws[0] == EXCLUSIVE_PREFIX and len(ws) >= 2:
+        return True, join(ws[1:])
+    return False, topic
+
+
 def feed_var(template: str, bindings: dict[str, str]) -> str:
     """Substitute ``%c``/``%u``-style or ``${var}`` placeholders in a topic.
 

@@ -27,6 +27,7 @@ class TcpGwConnection:
         self.closed = False
         self._loop = asyncio.get_event_loop()
         channel.send = self.send_frames
+        channel.request_close = self.request_close
 
     def send_frames(self, pkts: list) -> None:
         if self.closed or not pkts:
@@ -40,6 +41,17 @@ class TcpGwConnection:
             self.writer.write(data)
         else:
             self._loop.call_soon_threadsafe(self.writer.write, data)
+
+    def request_close(self) -> None:
+        """Thread-safe transport teardown: closing the writer unblocks the
+        reader so the run loop exits and terminates the channel."""
+        def do() -> None:
+            self.closed = True
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+        self._loop.call_soon_threadsafe(do)
 
     async def run(self) -> None:
         try:
@@ -145,9 +157,19 @@ class UdpGwListener(asyncio.DatagramProtocol):
         """Drop peers silent past idle_timeout_s — without this the
         per-addr channel map grows forever (spoofed source ports, dead
         clients that never DISCONNECT)."""
+        import time as _time
+
         now = self._loop.time() if now is None else now
-        dead = [addr for addr, t in self._last_seen.items()
-                if now - t >= self.idle_timeout_s]
+        wall = _time.time()
+        dead = [
+            addr for addr, t in self._last_seen.items()
+            if now - t >= self.idle_timeout_s
+            # a sleeping client (MQTT-SN) is expected-silent until its
+            # announced wake deadline — don't GC its session away
+            and not (
+                (su := getattr(self.channels.get(addr), "sleep_until",
+                               None)) is not None and wall < su)
+        ]
         for addr in dead:
             ch = self.channels.pop(addr, None)
             self._last_seen.pop(addr, None)
@@ -172,6 +194,7 @@ class UdpGwListener(asyncio.DatagramProtocol):
         if ch is None:
             ch = self.make_channel()
             ch.send = self._sender(addr)
+            ch.request_close = self._closer(addr)
             self.channels[addr] = ch
         self._last_seen[addr] = self._loop.time()
         try:
@@ -184,6 +207,17 @@ class UdpGwListener(asyncio.DatagramProtocol):
                 self._last_seen.pop(addr, None)
         except Exception:
             log.exception("udp gateway datagram crashed")
+
+    def _closer(self, addr: tuple) -> Callable[[], None]:
+        def close() -> None:
+            def do() -> None:
+                ch = self.channels.pop(addr, None)
+                self._last_seen.pop(addr, None)
+                if ch is not None:
+                    ch.terminate("closed")
+            if self._loop is not None:
+                self._loop.call_soon_threadsafe(do)
+        return close
 
     def _sender(self, addr: tuple) -> Callable[[list], None]:
         def send(pkts: list) -> None:

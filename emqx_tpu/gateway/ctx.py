@@ -54,6 +54,12 @@ class GwChannel:
     def send(self, frames: list) -> None:
         """Bound to the transport by the conn adapter."""
 
+    def request_close(self) -> None:
+        """Ask the transport to drop this connection; bound by the conn
+        adapter, thread-safe. Needed by channels whose disconnect
+        decision lands on a worker thread (exproto) — the run loop only
+        polls conn_state after inbound frames."""
+
     # CM duck-type (takeover/discard on clientid clash)
     def takeover(self):
         return None, []
@@ -182,6 +188,7 @@ class GatewayManager:
     def __init__(self, app) -> None:
         self.app = app
         self.gateways: dict[str, GatewayImpl] = {}
+        self._unload_tasks: set = set()   # keep refs: loop holds weak refs
 
     def load(self, impl: GatewayImpl, conf: Optional[dict] = None
              ) -> GatewayImpl:
@@ -199,14 +206,20 @@ class GatewayManager:
         if impl is None:
             return False
         # an unloaded gateway must stop accepting traffic: tear down its
-        # listeners (scheduled if we're on a running loop, inline otherwise)
+        # listeners first, then run the impl's unload hook (scheduled if
+        # we're on a running loop, inline otherwise)
         import asyncio
 
+        async def teardown() -> None:
+            await impl.stop_listeners()
+            impl.on_gateway_unload()
+
         try:
-            asyncio.get_running_loop().create_task(impl.stop_listeners())
+            task = asyncio.get_running_loop().create_task(teardown())
+            self._unload_tasks.add(task)
+            task.add_done_callback(self._unload_tasks.discard)
         except RuntimeError:
-            asyncio.run(impl.stop_listeners())
-        impl.on_gateway_unload()
+            asyncio.run(teardown())
         return True
 
     def get(self, name: str) -> Optional[GatewayImpl]:

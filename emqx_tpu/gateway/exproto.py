@@ -128,6 +128,9 @@ class Channel(GwChannel):
                 self.ctx.unsubscribe(self.clientid, cmd["topic"])
             elif kind == "close":
                 self.conn_state = "disconnected"
+                # the conn loop only polls conn_state after inbound data;
+                # we're on the worker thread, so drop the transport actively
+                self.request_close()
         return out
 
     # -- GwChannel -----------------------------------------------------------

@@ -191,6 +191,8 @@ class Channel(GwChannel):
         self._next_mid = 0
         self.awake = True
         self._sleep_buffer: list = []   # deliveries parked during sleep
+        self.max_sleep_buffer = 1000    # drop-oldest past this (mqueue-ish)
+        self.sleep_until: Optional[float] = None   # wall-clock deadline
 
     def _alloc_tid(self, topic: str) -> int:
         tid = self.id_of_topic.get(topic)
@@ -288,11 +290,14 @@ class Channel(GwChannel):
             # waking from sleep flushes parked messages, then PINGRESP
             # (MQTT-SN §6.14: buffered delivery on the keepalive ping)
             self.awake = True
+            self.sleep_until = None
             parked, self._sleep_buffer = self._sleep_buffer, []
             return self.handle_deliver(parked) + [SnMessage(PINGRESP)]
         if t == DISCONNECT:
             if m.duration:           # sleep mode: keep session, stop io
                 self.awake = False
+                import time as _time
+                self.sleep_until = _time.time() + m.duration
                 return [SnMessage(DISCONNECT)]
             self.conn_state = "disconnected"
             return [SnMessage(DISCONNECT)]
@@ -302,8 +307,12 @@ class Channel(GwChannel):
 
     def handle_deliver(self, deliveries: list) -> list[SnMessage]:
         if not self.awake:
-            # asleep (radio off): park until the next PINGREQ
+            # asleep (radio off): park until the next PINGREQ, bounded
+            # drop-oldest like the session mqueue
             self._sleep_buffer.extend(deliveries)
+            overflow = len(self._sleep_buffer) - self.max_sleep_buffer
+            if overflow > 0:
+                del self._sleep_buffer[:overflow]
             return []
         out: list[SnMessage] = []
         for _sub_topic, msg in deliveries:

@@ -214,6 +214,13 @@ class ManagementApi:
           self.h_retained_delete)
         r("GET", "/api/v5/api_key", self.h_api_keys)
         r("POST", "/api/v5/api_key", self.h_api_key_create)
+        r("GET", "/api/v5/trace", self.h_trace_list)
+        r("POST", "/api/v5/trace", self.h_trace_create)
+        r("DELETE", "/api/v5/trace/{name}", self.h_trace_delete)
+        r("PUT", "/api/v5/trace/{name}/stop", self.h_trace_stop)
+        r("GET", "/api/v5/trace/{name}/log", self.h_trace_log)
+        r("GET", "/api/v5/slow_subscriptions", self.h_slow_subs)
+        r("DELETE", "/api/v5/slow_subscriptions", self.h_slow_subs_clear)
 
     @staticmethod
     def _page(items: list, query: dict) -> dict:
@@ -456,6 +463,46 @@ class ManagementApi:
         key, secret = self.api_keys.create(body.get("api_key"),
                                            body.get("api_secret"))
         return 201, {"api_key": key, "api_secret": secret}
+
+    # -- trace / slow subs (emqx_mgmt_api_trace, emqx_slow_subs_api) ---------
+
+    def h_trace_list(self, query, body):
+        return self.app.trace.list()
+
+    def h_trace_create(self, query, body):
+        body = body or {}
+        try:
+            self.app.trace.start(
+                body["name"], body.get("type", "clientid"),
+                body.get(body.get("type", "clientid"), body.get("value", "")),
+                duration_s=body.get("duration"))
+        except (KeyError, ValueError) as e:
+            raise ApiError(400, "BAD_REQUEST", str(e)) from None
+        return 201, {"name": body["name"]}
+
+    def h_trace_delete(self, query, body, name):
+        if not self.app.trace.delete(name):
+            raise ApiError(404, "NOT_FOUND")
+        return 204, None
+
+    def h_trace_stop(self, query, body, name):
+        if not self.app.trace.stop(name):
+            raise ApiError(404, "NOT_FOUND")
+        return {"name": name, "status": "stopped"}
+
+    def h_trace_log(self, query, body, name):
+        return 200, "\n".join(self.app.trace.log_lines(name))
+
+    def h_slow_subs(self, query, body):
+        return self._page([
+            {"clientid": e.clientid, "topic": e.topic,
+             "timespan": e.latency_ms, "last_update_time": e.last_update}
+            for e in self.app.slow_subs.top()
+        ], query)
+
+    def h_slow_subs_clear(self, query, body):
+        self.app.slow_subs.clear()
+        return 204, None
 
     # -- http server --------------------------------------------------------
 

@@ -1,0 +1,164 @@
+"""Client/topic trace — the ``apps/emqx/src/emqx_trace/`` analogue.
+
+The reference installs filtered ``logger_disk_log_h`` handlers per trace
+(filter_clientid | filter_topic | filter_ip_address,
+emqx_trace_handler.erl:89-145) over scheduled start/stop records kept in
+mnesia (emqx_trace.erl:152,295-364). Here each trace is a filter + ring
+buffer (optionally mirrored to a file) fed from the broker hookpoints;
+the management API exposes list/start/stop/download.
+
+TPU note: device-side match batches are traced at batch granularity by
+the router model's stats; this module covers the host-side per-client
+flight recorder the operator actually greps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from emqx_tpu.core import topic as T
+
+
+@dataclass
+class Trace:
+    name: str
+    filter_type: str            # clientid | topic | ip_address
+    filter_value: str
+    start_at: float
+    end_at: Optional[float] = None          # None = until stopped
+    status: str = "running"                 # running | stopped
+    max_lines: int = 10_000
+    lines: deque = field(default_factory=deque)
+
+    def matches(self, clientid: str, topic: Optional[str],
+                peername: str) -> bool:
+        if self.filter_type == "clientid":
+            return clientid == self.filter_value
+        if self.filter_type == "topic":
+            return topic is not None and T.match(topic, self.filter_value)
+        if self.filter_type == "ip_address":
+            return peername.split(":")[0] == self.filter_value
+        return False
+
+    def log(self, event: str, detail: str) -> None:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        self.lines.append(f"{ts} [{event}] {detail}")
+        while len(self.lines) > self.max_lines:
+            self.lines.popleft()
+
+
+class TraceManager:
+    """Start/stop-scheduled traces fed from hookpoints."""
+
+    def __init__(self, max_traces: int = 32) -> None:
+        self.max_traces = max_traces
+        self.traces: dict[str, Trace] = {}
+        self._lock = threading.RLock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, name: str, filter_type: str, filter_value: str,
+              duration_s: Optional[float] = None) -> Trace:
+        if filter_type not in ("clientid", "topic", "ip_address"):
+            raise ValueError(f"bad trace filter type {filter_type}")
+        with self._lock:
+            if name in self.traces:
+                raise ValueError(f"trace {name} already exists")
+            if len(self.traces) >= self.max_traces:
+                raise ValueError("too many traces")
+            now = time.time()
+            tr = Trace(name=name, filter_type=filter_type,
+                       filter_value=filter_value, start_at=now,
+                       end_at=now + duration_s if duration_s else None)
+            self.traces[name] = tr
+            return tr
+
+    def stop(self, name: str) -> bool:
+        with self._lock:
+            tr = self.traces.get(name)
+            if tr is None:
+                return False
+            tr.status = "stopped"
+            return True
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            return self.traces.pop(name, None) is not None
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "name": t.name, "type": t.filter_type,
+                "value": t.filter_value, "status": t.status,
+                "lines": len(t.lines),
+            } for t in self.traces.values()]
+
+    def log_lines(self, name: str) -> list[str]:
+        with self._lock:
+            tr = self.traces.get(name)
+            return list(tr.lines) if tr else []
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Expire scheduled traces (the reference's trace scheduler)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            for tr in self.traces.values():
+                if (tr.status == "running" and tr.end_at is not None
+                        and now >= tr.end_at):
+                    tr.status = "stopped"
+
+    # -- event feed (hook callbacks) -----------------------------------------
+
+    def _active(self):
+        with self._lock:
+            return [t for t in self.traces.values() if t.status == "running"]
+
+    def trace(self, event: str, clientid: str, topic: Optional[str],
+              peername: str, detail: str) -> None:
+        for tr in self._active():
+            if tr.matches(clientid, topic, peername):
+                tr.log(event, detail)
+
+    def attach(self, hooks) -> None:
+        """Wire onto the standard hookpoints (?TRACE call sites:
+        emqx_broker.erl:224 publish, channel connect/subscribe)."""
+        hooks.add("message.publish", self._on_publish, priority=-900)
+        hooks.add("client.connected", self._on_connected, priority=-900)
+        hooks.add("client.disconnected", self._on_disconnected,
+                  priority=-900)
+        hooks.add("session.subscribed", self._on_subscribed, priority=-900)
+        hooks.add("session.unsubscribed", self._on_unsubscribed,
+                  priority=-900)
+
+    def _on_publish(self, msg):
+        if not msg.sys:
+            self.trace("PUBLISH", msg.from_, msg.topic,
+                       str(msg.headers.get("peername", "")),
+                       f"{msg.topic} qos{msg.qos} {len(msg.payload)}B")
+        return None
+
+    def _on_connected(self, ci) -> None:
+        cid = getattr(ci, "clientid", None) or (
+            ci.get("clientid", "") if isinstance(ci, dict) else "")
+        peer = getattr(ci, "peername", None) or (
+            ci.get("peername", "") if isinstance(ci, dict) else "")
+        self.trace("CONNECT", cid, None, str(peer), f"client {cid} up")
+
+    def _on_disconnected(self, ci, reason) -> None:
+        cid = getattr(ci, "clientid", None) or (
+            ci.get("clientid", "") if isinstance(ci, dict) else "")
+        peer = getattr(ci, "peername", None) or (
+            ci.get("peername", "") if isinstance(ci, dict) else "")
+        self.trace("DISCONNECT", cid, None, str(peer),
+                   f"client {cid} down: {reason}")
+
+    def _on_subscribed(self, sid, topic, opts, is_new=True) -> None:
+        self.trace("SUBSCRIBE", sid, topic, "", f"{sid} subscribed {topic}")
+
+    def _on_unsubscribed(self, sid, topic) -> None:
+        self.trace("UNSUBSCRIBE", sid, topic, "",
+                   f"{sid} unsubscribed {topic}")

@@ -73,6 +73,9 @@ class BufferWorker:
         # Off by default so tests with simulated clocks stay deterministic.
         self._stop = threading.Event()
         self._wake = threading.Event()
+        # paused: queue accepts but nothing flushes (disabled bridge keeps
+        # its buffered data instead of burning retries into drops)
+        self.paused = False
         self._flusher: Optional[threading.Thread] = None
         if auto_flush:
             self._flusher = threading.Thread(
@@ -107,6 +110,8 @@ class BufferWorker:
     # -- flush ---------------------------------------------------------------
 
     def tick(self, now: Optional[float] = None) -> None:
+        if self.paused:
+            return
         with self._lock:
             now = time.monotonic() if now is None else now
             if self.q.count() and (

@@ -1,0 +1,80 @@
+"""Slow-subscriber tracking — ``apps/emqx_slow_subs/`` analogue.
+
+Per-delivery latency (publish timestamp → delivery, the
+``mark_begin_deliver`` stamp emqx_session.erl:908) feeds a bounded
+top-K table of the slowest (clientid, topic) pairs; entries expire after
+``expire_interval_s`` so the table reflects the recent window, exactly
+the reference's moving top-K (emqx_slow_subs.erl).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class SlowEntry:
+    clientid: str
+    topic: str
+    latency_ms: int
+    last_update: float
+
+
+class SlowSubs:
+    def __init__(self, threshold_ms: int = 500, top_k: int = 10,
+                 expire_interval_s: float = 300.0) -> None:
+        self.threshold_ms = threshold_ms
+        self.top_k = top_k
+        self.expire_interval_s = expire_interval_s
+        self._table: dict[tuple[str, str], SlowEntry] = {}
+        self._lock = threading.RLock()
+
+    def attach(self, hooks) -> None:
+        hooks.add("delivery.completed", self._on_delivery, priority=-900)
+
+    def _on_delivery(self, clientid: str, topic: str,
+                     latency_ms: int) -> None:
+        self.record(clientid, topic, latency_ms)
+
+    def record(self, clientid: str, topic: str, latency_ms: int,
+               now: Optional[float] = None) -> None:
+        if latency_ms < self.threshold_ms:
+            return
+        now = time.time() if now is None else now
+        with self._lock:
+            key = (clientid, topic)
+            cur = self._table.get(key)
+            if cur is None or latency_ms > cur.latency_ms:
+                self._table[key] = SlowEntry(clientid, topic,
+                                             latency_ms, now)
+            else:
+                cur.last_update = now
+            if len(self._table) > self.top_k:
+                # evict the fastest of the slow (bounded top-K)
+                worst = min(self._table.values(),
+                            key=lambda e: e.latency_ms)
+                del self._table[(worst.clientid, worst.topic)]
+
+    def top(self) -> list[SlowEntry]:
+        with self._lock:
+            return sorted(self._table.values(),
+                          key=lambda e: -e.latency_ms)
+
+    def gc(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        with self._lock:
+            dead = [k for k, e in self._table.items()
+                    if now - e.last_update >= self.expire_interval_s]
+            for k in dead:
+                del self._table[k]
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._table.clear()
+
+    def __len__(self) -> int:
+        return len(self._table)

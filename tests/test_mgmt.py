@@ -232,3 +232,25 @@ def test_cli_verbs(api, capsys):
     assert "kicked" in capsys.readouterr().out
     with pytest.raises(SystemExit):
         cli_main(["--url", url, "clients", "show", "ghost"])
+
+
+def test_trace_and_slow_subs_endpoints(api):
+    tok = _token(api)
+    st, _ = _req(api, "POST", "/api/v5/trace",
+                 {"name": "t1", "type": "clientid", "clientid": "dev-1"},
+                 token=tok)
+    assert st == 201
+    _mqtt_client(api.app, "dev-1").handle_in(
+        P.Publish(topic="a/b", payload=b"x", qos=0))
+    st, data = _req(api, "GET", "/api/v5/trace", token=tok)
+    assert st == 200 and data[0]["name"] == "t1" and data[0]["lines"] >= 1
+    st, _ = _req(api, "PUT", "/api/v5/trace/t1/stop", token=tok)
+    assert st == 200
+    st, _ = _req(api, "DELETE", "/api/v5/trace/t1", token=tok)
+    assert st == 204
+    # slow subs
+    api.app.slow_subs.record("c9", "t/9", 900)
+    st, data = _req(api, "GET", "/api/v5/slow_subscriptions", token=tok)
+    assert st == 200 and data["data"][0]["clientid"] == "c9"
+    st, _ = _req(api, "DELETE", "/api/v5/slow_subscriptions", token=tok)
+    assert st == 204

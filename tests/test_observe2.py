@@ -1,0 +1,172 @@
+"""Trace, slow-subs, OLP/GC/congestion, exclusive subscriptions —
+the emqx_trace_SUITE / emqx_slow_subs_SUITE / emqx_olp_SUITE /
+emqx_exclusive_sub mirror."""
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.broker import ExclusiveLocked
+from emqx_tpu.broker.olp import Congestion, GcPolicy, Olp
+from emqx_tpu.core.message import Message, SubOpts
+from emqx_tpu.observe.alarm import AlarmManager
+from emqx_tpu.observe.trace import TraceManager
+from emqx_tpu.services.slow_subs import SlowSubs
+
+
+# -- trace ---------------------------------------------------------------------
+
+def test_trace_by_clientid_records_publish_and_lifecycle():
+    app = BrokerApp()
+    app.trace.start("t1", "clientid", "dev-1")
+    app.broker.publish(Message(topic="a/b", payload=b"x", from_="dev-1"))
+    app.broker.publish(Message(topic="a/b", payload=b"y", from_="dev-2"))
+    lines = app.trace.log_lines("t1")
+    assert len(lines) == 1 and "a/b" in lines[0] and "PUBLISH" in lines[0]
+
+
+def test_trace_by_topic_wildcard_filter():
+    tm = TraceManager()
+    tm.start("w", "topic", "room/+/temp")
+    tm.trace("PUBLISH", "c1", "room/7/temp", "", "m1")
+    tm.trace("PUBLISH", "c1", "hall/temp", "", "m2")
+    assert len(tm.log_lines("w")) == 1
+
+
+def test_trace_scheduled_stop_and_limits():
+    tm = TraceManager(max_traces=1)
+    tm.start("t", "clientid", "c", duration_s=10)
+    with pytest.raises(ValueError):
+        tm.start("u", "clientid", "c2")
+    tm.tick(now=tm.traces["t"].start_at + 11)
+    assert tm.traces["t"].status == "stopped"
+    tm.trace("PUBLISH", "c", "x", "", "after-stop")
+    assert tm.log_lines("t") == []          # stopped traces record nothing
+    assert tm.delete("t") and tm.list() == []
+
+
+# -- slow subs -----------------------------------------------------------------
+
+def test_slow_subs_topk_and_expiry():
+    ss = SlowSubs(threshold_ms=100, top_k=2, expire_interval_s=60)
+    ss.record("c1", "t1", 150, now=0)
+    ss.record("c2", "t2", 500, now=0)
+    ss.record("c3", "t3", 300, now=0)      # evicts c1 (fastest of the slow)
+    tops = ss.top()
+    assert [(e.clientid, e.latency_ms) for e in tops] == [
+        ("c2", 500), ("c3", 300)]
+    ss.record("c1", "t1", 50, now=0)       # under threshold → ignored
+    assert len(ss) == 2
+    assert ss.gc(now=61) == 2 and len(ss) == 0
+
+
+def test_slow_subs_via_delivery_hook():
+    app = BrokerApp()
+    app.slow_subs.threshold_ms = 0         # record everything
+    app.broker.subscribe("s1", "a/#", SubOpts(qos=0))
+
+    class FakeCh:
+        conn_state = "connected"
+        def handle_deliver(self, items):
+            return []
+        def send(self, pkts):
+            pass
+    app.cm.register_channel("s1", FakeCh())
+    app.cm.dispatch(app.broker.publish(Message(topic="a/b", payload=b"x")))
+    # the hook fires from the real Channel only; emulate its call here
+    app.hooks.run("delivery.completed", ("s1", "a/b", 7))
+    assert app.slow_subs.top()[0].clientid == "s1"
+
+
+# -- olp / gc / congestion -----------------------------------------------------
+
+def test_olp_backoff_after_sustained_lag():
+    olp = Olp(backoff_delay_ms=50)
+    assert not olp.backoff_new_conn()
+    for _ in range(20):
+        olp.note_lag(500)
+    assert olp.is_overloaded() and olp.backoff_new_conn()
+    for _ in range(50):
+        olp.note_lag(0)
+    assert not olp.is_overloaded()
+
+
+def test_gc_policy_budgets():
+    gp = GcPolicy(count=10, bytes_=10_000)
+    assert not gp.note(5, 100)
+    assert gp.note(5, 100)                 # count budget exhausted → GC
+    assert not gp.note(1, 9_000)
+    assert gp.note(1, 2_000)               # bytes budget exhausted → GC
+    olp = Olp(backoff_delay_ms=1)
+    for _ in range(20):
+        olp.note_lag(100)
+    assert not gp.note(100, 100, olp)      # overloaded → GC skipped
+
+
+def test_congestion_alarm_lifecycle():
+    alarms = AlarmManager()
+    c = Congestion(alarms=alarms, high_watermark=1000, low_watermark=100,
+                   min_alarm_sustain_s=1.0)
+    c.check("peer:1", 5000, now=0.0)
+    assert "peer:1" not in c.congested     # not sustained yet
+    c.check("peer:1", 5000, now=1.5)
+    assert "peer:1" in c.congested
+    assert any(a.name.startswith("conn_congestion/")
+               for a in alarms.get_alarms("activated"))
+    c.check("peer:1", 50, now=2.0)
+    assert ("peer:1" not in c.congested
+            and not alarms.get_alarms("activated"))
+
+
+# -- exclusive subscriptions ---------------------------------------------------
+
+def test_exclusive_subscription_single_holder():
+    app = BrokerApp()
+    ex = SubOpts(qos=1, exclusive=True)
+    app.broker.subscribe("c1", "job/1", ex)
+    with pytest.raises(ExclusiveLocked):
+        app.broker.subscribe("c2", "job/1", ex)
+    # resubscribe by the holder is fine
+    app.broker.subscribe("c1", "job/1", SubOpts(qos=0, exclusive=True))
+    # non-exclusive subscribers of the same topic are unaffected
+    app.broker.subscribe("c9", "job/1", SubOpts(qos=0))
+    # release frees the slot
+    app.broker.unsubscribe("c1", "job/1")
+    app.broker.subscribe("c2", "job/1", ex)
+    # subscriber_down releases too
+    app.broker.subscriber_down("c2")
+    app.broker.subscribe("c3", "job/1", ex)
+
+
+def test_exclusive_channel_strips_prefix_and_delivers():
+    """$exclusive/t subscribes the REAL topic t (emqx_topic.erl:225-230);
+    publishes to t reach the exclusive holder; second holder gets 0x97;
+    disabled cap → 0x8F."""
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.mqtt import packet as P
+
+    app = BrokerApp()
+    sent: list = []
+    ch = Channel(app.broker, app.cm, send=sent.extend)
+    ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="ex1"))
+    suback = ch.handle_in(P.Subscribe(
+        packet_id=1, topic_filters=[("$exclusive/job/9", {"qos": 1})]))
+    assert suback[0].reason_codes == [1]
+    ch2 = Channel(app.broker, app.cm, send=lambda p: None)
+    ch2.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="ex2"))
+    suback2 = ch2.handle_in(P.Subscribe(
+        packet_id=1, topic_filters=[("$exclusive/job/9", {"qos": 1})]))
+    assert suback2[0].reason_codes == [P.RC_QUOTA_EXCEEDED]
+    # delivery arrives on the real topic
+    app.cm.dispatch(app.broker.publish(Message(topic="job/9", payload=b"m")))
+    assert any(getattr(p, "topic", None) == "job/9" for p in sent)
+    # unsubscribe with the $exclusive form releases the lock
+    ch.handle_in(P.Unsubscribe(packet_id=2,
+                               topic_filters=["$exclusive/job/9"]))
+    suback3 = ch2.handle_in(P.Subscribe(
+        packet_id=2, topic_filters=[("$exclusive/job/9", {"qos": 1})]))
+    assert suback3[0].reason_codes == [1]
+    # cap disabled → topic filter invalid (emqx_mqtt_caps:do_check_sub)
+    app.broker.exclusive_enabled = False
+    suback4 = ch2.handle_in(P.Subscribe(
+        packet_id=3, topic_filters=[("$exclusive/other", {"qos": 0})]))
+    assert suback4[0].reason_codes == [P.RC_TOPIC_FILTER_INVALID]

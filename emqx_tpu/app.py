@@ -101,6 +101,21 @@ class BrokerApp:
         self.olp = Olp()
         self.gc_policy = GcPolicy()
         self.congestion = Congestion(alarms=self.alarms)
+        from emqx_tpu.access.psk import PskStore
+        from emqx_tpu.observe.statsd import StatsdPusher
+        from emqx_tpu.services.auto_subscribe import AutoSubscribe
+        from emqx_tpu.services.rewrite import TopicRewrite
+        from emqx_tpu.services.telemetry import Telemetry
+        from emqx_tpu.services.topic_metrics import TopicMetrics
+        self.rewrite = TopicRewrite()
+        self.rewrite.attach(self.hooks)
+        self.topic_metrics = TopicMetrics()
+        self.topic_metrics.attach(self.hooks)
+        self.auto_subscribe = AutoSubscribe(self)
+        self.auto_subscribe.attach(self.hooks)
+        self.telemetry = Telemetry(self)
+        self.statsd = StatsdPusher(self)
+        self.psk = PskStore(enable=False)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)
@@ -250,6 +265,35 @@ class BrokerApp:
         app.config = conf
         app.broker.exclusive_enabled = bool(
             conf.get("mqtt.exclusive_subscription"))
+        for spec in conf.get("rewrite") or []:
+            app.rewrite.add_rule(
+                action=spec.get("action", "all"),
+                source_topic=spec["source_topic"],
+                re=spec["re"], dest_topic=spec["dest_topic"])
+        for spec in conf.get("auto_subscribe.topics") or []:
+            app.auto_subscribe.add(
+                topic=spec["topic"], qos=int(spec.get("qos", 0)),
+                nl=int(spec.get("nl", 0)), rh=int(spec.get("rh", 0)),
+                rap=int(spec.get("rap", 0)))
+        app.telemetry.enable = bool(conf.get("telemetry.enable"))
+        app.statsd.enable = bool(conf.get("statsd.enable"))
+        host, _, port = str(conf.get("statsd.server")).partition(":")
+        app.statsd.addr = (host, int(port or 8125))
+        app.statsd.flush_interval_s = float(
+            conf.get("statsd.flush_time_interval"))
+        app.psk.enable = bool(conf.get("psk_authentication.enable"))
+        if app.psk.enable and conf.get("psk_authentication.init_file"):
+            app.psk.separator = conf.get("psk_authentication.separator")
+            try:
+                app.psk.import_file(
+                    conf.get("psk_authentication.init_file"))
+            except OSError:
+                pass
+        ss = app.slow_subs
+        ss.enable = bool(conf.get("slow_subs.enable"))
+        ss.threshold_ms = int(float(conf.get("slow_subs.threshold")) * 1000)
+        ss.top_k = int(conf.get("slow_subs.top_k_num"))
+        ss.expire_interval_s = float(conf.get("slow_subs.expire_interval"))
         app.sys.heartbeat_s = float(
             conf.get("sys_topics.sys_heartbeat_interval"))
         app.sys.tick_s = float(conf.get("sys_topics.sys_msg_interval"))
@@ -344,6 +388,8 @@ class BrokerApp:
         self.sys.tick()
         self.trace.tick()
         self.slow_subs.gc()
+        self.telemetry.tick()
+        self.statsd.tick()
         self.access.banned.expire()
         for fn in self._tickers:
             fn()

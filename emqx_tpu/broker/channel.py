@@ -378,7 +378,16 @@ class Channel:
         subid = (pkt.properties or {}).get("Subscription-Identifier")
         if isinstance(subid, list):
             subid = subid[0] if subid else None
-        for filt, opts in pkt.topic_filters:
+        # client.subscribe fold: rewrite/veto filters before processing
+        # (emqx_rewrite registers here, emqx_rewrite.erl:101-102)
+        topic_filters = self.hooks.run_fold(
+            "client.subscribe",
+            (dict(clientid=self.clientid,
+                  username=self.conninfo.username),
+             pkt.properties or {}),
+            pkt.topic_filters,
+        )
+        for filt, opts in topic_filters:
             group, real = T.parse_share(filt)
             exclusive = False
             if not group:
@@ -420,6 +429,9 @@ class Channel:
                 rap=opts.get("rap", 0), rh=opts.get("rh", 0),
                 share=group, subid=subid, exclusive=exclusive,
             )
+            # remember any prior subscription to this key so a rejected
+            # exclusive upgrade can roll back without destroying it
+            prior_opts = self.session.subscriptions.get(mounted_key)
             try:
                 self.session.subscribe(mounted_key, subopts)
             except SessionError as e:
@@ -430,7 +442,10 @@ class Channel:
             except ExclusiveLocked:
                 # $exclusive/... already held → 0x97, same rc the
                 # reference returns (emqx_exclusive_subscription.erl)
-                self.session.unsubscribe(mounted_key)
+                if prior_opts is not None:
+                    self.session.subscriptions[mounted_key] = prior_opts
+                else:
+                    self.session.unsubscribe(mounted_key)
                 rcs.append(P.RC_QUOTA_EXCEEDED)
                 continue
             rcs.append(subopts.qos)  # granted qos
@@ -438,7 +453,14 @@ class Channel:
 
     def _in_unsubscribe(self, pkt: P.Unsubscribe) -> list[P.Packet]:
         rcs: list[int] = []
-        for filt in pkt.topic_filters:
+        topic_filters = self.hooks.run_fold(
+            "client.unsubscribe",
+            (dict(clientid=self.clientid,
+                  username=self.conninfo.username),
+             pkt.properties or {}),
+            pkt.topic_filters,
+        )
+        for filt in topic_filters:
             group, real = T.parse_share(filt)
             if not group:
                 _excl, real = T.parse_exclusive(real)

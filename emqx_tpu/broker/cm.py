@@ -110,9 +110,11 @@ class CM:
             # deliver-begin stamp (emqx_session.erl:908 mark_begin_deliver):
             # slow-subs latency measures dispatch→flush, not storage age —
             # retained/delayed messages would otherwise report their shelf
-            # time as delivery latency
+            # time as delivery latency. Unconditional: a replay of a stored
+            # message (retainer keeps a copy sharing this extra dict) is a
+            # NEW delivery and must restamp.
             for _st, m in items:
-                m.extra.setdefault("deliver_begin_at", begin)
+                m.extra["deliver_begin_at"] = begin
             ch = self._channels.get(sid)
             if ch is not None:
                 ch.send(ch.handle_deliver(items))

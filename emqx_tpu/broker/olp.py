@@ -108,9 +108,12 @@ class Congestion:
                     self.alarms.activate(
                         f"conn_congestion/{conn_id}",
                         message=f"send buffer {buffered}B > {self.high}B")
-        elif buffered <= self.low:
+        else:
+            # below high: the sustain clock resets (must be continuously
+            # over the watermark); the ALARM clears only under the low
+            # watermark (hysteresis band keeps it active in between)
             self._over_since.pop(conn_id, None)
-            if conn_id in self.congested:
+            if buffered <= self.low and conn_id in self.congested:
                 self.congested.discard(conn_id)
                 if self.alarms is not None:
                     self.alarms.deactivate(f"conn_congestion/{conn_id}")

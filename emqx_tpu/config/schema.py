@@ -266,6 +266,29 @@ def root_schema() -> Struct:
         "rule_engine": Field("map", default={}),
         "bridges": Field("map", default={}),
         "gateway": Field("map", default={}),
+        "rewrite": Field("array", default=[], item=Field("map")),
+        "auto_subscribe": Struct({
+            "topics": Field("array", default=[], item=Field("map")),
+        }),
+        "telemetry": Struct({
+            "enable": Field("bool", default=False),
+        }),
+        "statsd": Struct({
+            "enable": Field("bool", default=False),
+            "server": Field("string", default="127.0.0.1:8125"),
+            "flush_time_interval": Field("duration", default=30.0),
+        }),
+        "psk_authentication": Struct({
+            "enable": Field("bool", default=False),
+            "init_file": Field("string", default=""),
+            "separator": Field("string", default=":"),
+        }),
+        "slow_subs": Struct({
+            "enable": Field("bool", default=True),
+            "threshold": Field("duration", default=0.5),
+            "top_k_num": Field("int", default=10),
+            "expire_interval": Field("duration", default=300.0),
+        }),
         "api": Struct({
             "enable": Field("bool", default=False),
             "bind": Field("string", default="127.0.0.1:18083"),

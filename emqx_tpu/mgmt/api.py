@@ -221,6 +221,14 @@ class ManagementApi:
         r("GET", "/api/v5/trace/{name}/log", self.h_trace_log)
         r("GET", "/api/v5/slow_subscriptions", self.h_slow_subs)
         r("DELETE", "/api/v5/slow_subscriptions", self.h_slow_subs_clear)
+        r("GET", "/api/v5/mqtt/topic_metrics", self.h_topic_metrics)
+        r("POST", "/api/v5/mqtt/topic_metrics", self.h_topic_metrics_add)
+        r("DELETE", "/api/v5/mqtt/topic_metrics/{topic}",
+          self.h_topic_metrics_del)
+        r("GET", "/api/v5/mqtt/topic_rewrite", self.h_rewrite_get)
+        r("PUT", "/api/v5/mqtt/topic_rewrite", self.h_rewrite_put)
+        r("GET", "/api/v5/mqtt/auto_subscribe", self.h_auto_sub_get)
+        r("PUT", "/api/v5/mqtt/auto_subscribe", self.h_auto_sub_put)
 
     @staticmethod
     def _page(items: list, query: dict) -> dict:
@@ -491,6 +499,8 @@ class ManagementApi:
         return {"name": name, "status": "stopped"}
 
     def h_trace_log(self, query, body, name):
+        if name not in self.app.trace.traces:
+            raise ApiError(404, "NOT_FOUND")
         return 200, "\n".join(self.app.trace.log_lines(name))
 
     def h_slow_subs(self, query, body):
@@ -503,6 +513,64 @@ class ManagementApi:
     def h_slow_subs_clear(self, query, body):
         self.app.slow_subs.clear()
         return 204, None
+
+    # -- mqtt modules (emqx_mgmt_api_topic_metrics / _rewrite / _auto_sub) ---
+
+    def h_topic_metrics(self, query, body):
+        return self.app.topic_metrics.all()
+
+    def h_topic_metrics_add(self, query, body):
+        try:
+            if not self.app.topic_metrics.register((body or {})["topic"]):
+                raise ApiError(400, "BAD_REQUEST", "already registered")
+        except (KeyError, ValueError) as e:
+            raise ApiError(400, "BAD_REQUEST", str(e)) from None
+        return 201, {"topic": body["topic"]}
+
+    def h_topic_metrics_del(self, query, body, topic):
+        if not self.app.topic_metrics.deregister(topic):
+            raise ApiError(404, "NOT_FOUND")
+        return 204, None
+
+    def h_rewrite_get(self, query, body):
+        return self.app.rewrite.list()
+
+    def h_rewrite_put(self, query, body):
+        # validate the full replacement set first — a bad body must leave
+        # the existing rules untouched
+        from emqx_tpu.services.rewrite import TopicRewrite
+
+        staged = TopicRewrite()
+        import re as _re
+        try:
+            for spec in body or []:
+                staged.add_rule(
+                    action=spec.get("action", "all"),
+                    source_topic=spec["source_topic"],
+                    re=spec["re"], dest_topic=spec["dest_topic"])
+        except (KeyError, ValueError, TypeError, _re.error) as e:
+            raise ApiError(400, "BAD_REQUEST", str(e)) from None
+        self.app.rewrite.pub_rules = staged.pub_rules
+        self.app.rewrite.sub_rules = staged.sub_rules
+        return self.app.rewrite.list()
+
+    def h_auto_sub_get(self, query, body):
+        return self.app.auto_subscribe.topics
+
+    def h_auto_sub_put(self, query, body):
+        from emqx_tpu.services.auto_subscribe import AutoSubscribe
+
+        staged = AutoSubscribe(self.app)     # validate before swapping in
+        try:
+            for spec in body or []:
+                staged.add(
+                    topic=spec["topic"], qos=int(spec.get("qos", 0)),
+                    nl=int(spec.get("nl", 0)), rh=int(spec.get("rh", 0)),
+                    rap=int(spec.get("rap", 0)))
+        except (KeyError, ValueError, TypeError) as e:
+            raise ApiError(400, "BAD_REQUEST", str(e)) from None
+        self.app.auto_subscribe.topics = staged.topics
+        return self.app.auto_subscribe.topics
 
     # -- http server --------------------------------------------------------
 

@@ -25,7 +25,9 @@ class SlowEntry:
 
 class SlowSubs:
     def __init__(self, threshold_ms: int = 500, top_k: int = 10,
-                 expire_interval_s: float = 300.0) -> None:
+                 expire_interval_s: float = 300.0,
+                 enable: bool = True) -> None:
+        self.enable = enable
         self.threshold_ms = threshold_ms
         self.top_k = top_k
         self.expire_interval_s = expire_interval_s
@@ -41,7 +43,7 @@ class SlowSubs:
 
     def record(self, clientid: str, topic: str, latency_ms: int,
                now: Optional[float] = None) -> None:
-        if latency_ms < self.threshold_ms:
+        if not self.enable or latency_ms < self.threshold_ms:
             return
         now = time.time() if now is None else now
         with self._lock:

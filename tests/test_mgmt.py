@@ -254,3 +254,22 @@ def test_trace_and_slow_subs_endpoints(api):
     assert st == 200 and data["data"][0]["clientid"] == "c9"
     st, _ = _req(api, "DELETE", "/api/v5/slow_subscriptions", token=tok)
     assert st == 204
+
+
+def test_mqtt_module_endpoints(api):
+    tok = _token(api)
+    st, _ = _req(api, "POST", "/api/v5/mqtt/topic_metrics",
+                 {"topic": "m/+/x"}, token=tok)
+    assert st == 201
+    st, data = _req(api, "GET", "/api/v5/mqtt/topic_metrics", token=tok)
+    assert st == 200 and data[0]["topic"] == "m/+/x"
+    st, _ = _req(api, "DELETE", "/api/v5/mqtt/topic_metrics/m%2F%2B%2Fx",
+                 token=tok)
+    assert st == 204
+    st, data = _req(api, "PUT", "/api/v5/mqtt/topic_rewrite",
+                    [{"action": "publish", "source_topic": "a/#",
+                      "re": "^a/(.+)$", "dest_topic": "b/$1"}], token=tok)
+    assert st == 200 and len(data) == 1
+    st, data = _req(api, "PUT", "/api/v5/mqtt/auto_subscribe",
+                    [{"topic": "c/%c", "qos": 1}], token=tok)
+    assert st == 200 and data[0]["topic"] == "c/%c"

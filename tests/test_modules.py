@@ -1,0 +1,145 @@
+"""emqx_modules-family services: rewrite, topic metrics, telemetry,
+auto-subscribe, PSK, statsd (reference suites: emqx_rewrite_SUITE,
+emqx_topic_metrics_SUITE, emqx_telemetry_SUITE, emqx_auto_subscribe_SUITE,
+emqx_psk_SUITE, emqx_statsd_SUITE)."""
+
+import pytest
+
+from emqx_tpu.access.psk import PskStore
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.core.message import Message
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.observe.statsd import StatsdPusher, render_lines
+from emqx_tpu.services.rewrite import TopicRewrite
+from emqx_tpu.services.telemetry import Telemetry
+from emqx_tpu.services.topic_metrics import TopicMetrics
+
+
+def _connect(app, cid, username=None):
+    ch = Channel(app.broker, app.cm)
+    ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid=cid,
+                           username=username))
+    return ch
+
+
+# -- rewrite -------------------------------------------------------------------
+
+def test_rewrite_publish_with_captures_and_binds():
+    rw = TopicRewrite()
+    rw.add_rule("publish", "x/#", r"^x/y/(.+)$", "z/y/$1/%c")
+    app = BrokerApp()
+    rw.attach(app.hooks)
+    got = []
+    app.hooks.add("message.publish", lambda m: got.append(m.topic) or None,
+                  priority=500)     # after rewrite (1000), before routing
+    app.broker.publish(Message(topic="x/y/2", from_="dev1"))
+    assert got == ["z/y/2/dev1"]
+    # filter hit, regex miss → unchanged
+    got.clear()
+    app.broker.publish(Message(topic="x/other", from_="dev1"))
+    assert got == ["x/other"]
+
+
+def test_rewrite_subscribe_end_to_end():
+    app = BrokerApp()
+    app.rewrite.add_rule("subscribe", "y/+/z/#", r"^y/(.+)/z/(.+)$",
+                         "y/z/$2")
+    ch = _connect(app, "c1")
+    ch.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("y/a/z/b", {"qos": 1})]))
+    # the stored subscription is the REWRITTEN filter
+    assert ("c1", "y/z/b") in app.broker.suboption
+    # delivery flows through the rewritten filter
+    sent = []
+    ch._send = sent.extend
+    app.cm.dispatch(app.broker.publish(Message(topic="y/z/b", payload=b"m")))
+    assert any(getattr(p, "topic", None) == "y/z/b" for p in sent)
+    # unsubscribe applies the same rewrite
+    ch.handle_in(P.Unsubscribe(packet_id=2, topic_filters=["y/a/z/b"]))
+    assert ("c1", "y/z/b") not in app.broker.suboption
+
+
+# -- topic metrics -------------------------------------------------------------
+
+def test_topic_metrics_counts_in_out():
+    app = BrokerApp()
+    app.topic_metrics.register("room/+/temp")
+    with pytest.raises(ValueError):
+        app.topic_metrics.register("bad/#/filter")
+    sub = _connect(app, "tm-sub")
+    sub.handle_in(P.Subscribe(packet_id=1,
+                              topic_filters=[("room/#", {"qos": 0})]))
+    pub = _connect(app, "tm-pub")
+    pub.handle_in(P.Publish(topic="room/7/temp", payload=b"20", qos=1,
+                            packet_id=1))
+    pub.handle_in(P.Publish(topic="hall/temp", payload=b"20", qos=0))
+    m = app.topic_metrics.metrics("room/+/temp")
+    assert m["messages.in"] == 1 and m["messages.qos1.in"] == 1
+    assert m["messages.out"] == 1          # delivered to tm-sub
+    assert app.topic_metrics.deregister("room/+/temp")
+
+
+# -- telemetry -----------------------------------------------------------------
+
+def test_telemetry_report_and_schedule():
+    app = BrokerApp()
+    _connect(app, "t-c1")
+    sent = []
+    tel = Telemetry(app, enable=True, send_fn=sent.append)
+    report = tel.build_report()
+    assert report["num_clients"] == 1 and "uuid" in report
+    assert tel.tick(now=1e9) and sent        # first due immediately
+    assert not tel.tick(now=1e9 + 60)        # not due again for a week
+    tel.enable = False
+    assert not tel.tick(now=2e9)
+
+
+# -- auto subscribe ------------------------------------------------------------
+
+def test_auto_subscribe_on_connect_with_placeholders():
+    app = BrokerApp()
+    app.auto_subscribe.add("devices/%c/cmd", qos=1)
+    app.auto_subscribe.add("users/%u/inbox")
+    ch = _connect(app, "dev-7", username="alice")
+    assert ("dev-7", "devices/dev-7/cmd") in app.broker.suboption
+    assert ("dev-7", "users/alice/inbox") in app.broker.suboption
+    # session is coherent → delivery works
+    sent = []
+    ch._send = sent.extend
+    app.cm.dispatch(app.broker.publish(
+        Message(topic="devices/dev-7/cmd", payload=b"reboot")))
+    assert any(getattr(p, "topic", None) == "devices/dev-7/cmd"
+               for p in sent)
+
+
+# -- psk -----------------------------------------------------------------------
+
+def test_psk_store_import_and_lookup(tmp_path):
+    f = tmp_path / "psk.txt"
+    f.write_text("# fixtures\nclient1:AABBCC\nclient2:00112233\nbadline\n")
+    store = PskStore(init_file=str(f))
+    assert len(store) == 2
+    assert store.lookup("client1") == bytes.fromhex("AABBCC")
+    assert store.lookup("nope") is None
+    store.enable = False
+    assert store.lookup("client1") is None   # disabled → reject handshakes
+    store.enable = True
+    assert store.delete("client1") and store.lookup("client1") is None
+
+
+# -- statsd --------------------------------------------------------------------
+
+def test_statsd_lines_and_flush():
+    app = BrokerApp()
+    app.metrics.inc("messages.received", 5)
+    datagrams = []
+    pusher = StatsdPusher(app, enable=True, flush_interval_s=10,
+                          send_fn=datagrams.append)
+    assert pusher.tick(now=100.0)
+    assert not pusher.tick(now=105.0)        # inside interval
+    assert pusher.tick(now=111.0)
+    text = b"\n".join(datagrams).decode()
+    assert "emqx.messages.received:5|g" in text
+    lines = render_lines(app.metrics, app.stats)
+    assert all(l.endswith("|g") for l in lines)

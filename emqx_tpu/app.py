@@ -116,6 +116,10 @@ class BrokerApp:
         self.telemetry = Telemetry(self)
         self.statsd = StatsdPusher(self)
         self.psk = PskStore(enable=False)
+        from emqx_tpu.observe.monitor import DashboardMonitor
+        from emqx_tpu.services.plugins import PluginManager
+        self.monitor = DashboardMonitor(self)
+        self.plugins = PluginManager(self, install_dir="plugins")
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)
@@ -289,6 +293,11 @@ class BrokerApp:
                     conf.get("psk_authentication.init_file"))
             except OSError:
                 pass
+        import os as _os
+        app.plugins.install_dir = _os.path.join(
+            conf.get("node.data_dir", "data"), "plugins")
+        app.plugins.scan()
+        app.plugins.ensure_started()      # enabled plugins, in order
         ss = app.slow_subs
         ss.enable = bool(conf.get("slow_subs.enable"))
         ss.threshold_ms = int(float(conf.get("slow_subs.threshold")) * 1000)
@@ -390,6 +399,7 @@ class BrokerApp:
         self.slow_subs.gc()
         self.telemetry.tick()
         self.statsd.tick()
+        self.monitor.tick()
         self.access.banned.expire()
         for fn in self._tickers:
             fn()

@@ -173,14 +173,9 @@ class ManagementApi:
         return False
 
     def _docs(self) -> dict:
-        from emqx_tpu.config.schema import root_schema
+        from emqx_tpu.mgmt import swagger
 
-        return {
-            "openapi": "3.0-ish",
-            "paths": sorted({f"{m} {d}" for m, _p, _n, _f, d
-                             in self._routes}),
-            "config_schema": root_schema().to_doc(),
-        }
+        return swagger.generate(self)
 
     # -- handlers -----------------------------------------------------------
 
@@ -229,6 +224,11 @@ class ManagementApi:
         r("PUT", "/api/v5/mqtt/topic_rewrite", self.h_rewrite_put)
         r("GET", "/api/v5/mqtt/auto_subscribe", self.h_auto_sub_get)
         r("PUT", "/api/v5/mqtt/auto_subscribe", self.h_auto_sub_put)
+        r("GET", "/api/v5/plugins", self.h_plugins)
+        r("PUT", "/api/v5/plugins/{name}/{action}", self.h_plugin_action)
+        r("DELETE", "/api/v5/plugins/{name}", self.h_plugin_delete)
+        r("GET", "/api/v5/monitor", self.h_monitor)
+        r("GET", "/api/v5/monitor_current", self.h_monitor_current)
 
     @staticmethod
     def _page(items: list, query: dict) -> dict:
@@ -571,6 +571,53 @@ class ManagementApi:
             raise ApiError(400, "BAD_REQUEST", str(e)) from None
         self.app.auto_subscribe.topics = staged.topics
         return self.app.auto_subscribe.topics
+
+    # -- plugins / monitor (emqx_mgmt_api_plugins, emqx_dashboard_monitor) --
+
+    def h_plugins(self, query, body):
+        self.app.plugins.scan()
+        return self.app.plugins.list()
+
+    def h_plugin_action(self, query, body, name, action):
+        pm = self.app.plugins
+        pm.scan()
+        if name not in pm.plugins:
+            raise ApiError(404, "NOT_FOUND", f"plugin {name} not installed")
+        try:
+            if action == "start":
+                pm.ensure_enabled(name)
+                pm.ensure_started(name)
+                if pm.plugins[name].error:
+                    raise ApiError(400, "BAD_PLUGIN",
+                                   pm.plugins[name].error)
+            elif action == "stop":
+                pm.ensure_stopped(name)
+                pm.ensure_disabled(name)
+            elif action == "restart":
+                pm.restart(name)
+            else:
+                raise ApiError(400, "BAD_REQUEST",
+                               f"unknown action {action}")
+        except ValueError as e:
+            raise ApiError(404, "NOT_FOUND", str(e)) from None
+        return pm.describe(name)
+
+    def h_plugin_delete(self, query, body, name):
+        if not self.app.plugins.ensure_uninstalled(name):
+            raise ApiError(404, "NOT_FOUND")
+        return 204, None
+
+    def h_monitor(self, query, body):
+        latest = query.get("latest")
+        try:
+            window = float(latest) if latest else None
+        except ValueError:
+            raise ApiError(400, "BAD_REQUEST",
+                           f"latest must be numeric: {latest!r}") from None
+        return self.app.monitor.history(window)
+
+    def h_monitor_current(self, query, body):
+        return self.app.monitor.current()
 
     # -- http server --------------------------------------------------------
 

@@ -52,6 +52,20 @@ class AutoSubscribe:
             topic = T.feed_var(spec["topic"], binds)
             if not T.validate_filter(topic):
                 continue
+            # same pipeline guarantees the channel's SUBSCRIBE has: the
+            # client's mountpoint applies and the ACL chain can veto
+            # (the reference routes auto-subscribe through the channel's
+            # normal subscribe path for exactly this)
+            if ch is not None and hasattr(ch, "_mount"):
+                topic = ch._mount(topic)
+            verdict = self.app.hooks.run_fold(
+                "client.authorize",
+                ({"clientid": cid, "username": username,
+                  "peername": peer}, "subscribe", topic),
+                "allow",
+            )
+            if verdict != "allow":
+                continue
             opts = SubOpts(qos=spec["qos"], nl=spec["nl"],
                            rh=spec["rh"], rap=spec["rap"])
             # through the session when there is one (keeps resume state

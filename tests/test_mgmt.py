@@ -208,8 +208,9 @@ def test_api_docs_public(api):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{api.port}/api-docs.json", timeout=5) as r:
         doc = json.loads(r.read())
-    assert any("GET /api/v5/clients" in p for p in doc["paths"])
-    assert "mqtt" in doc["config_schema"]["fields"]
+    assert "/api/v5/clients" in doc["paths"]
+    assert "get" in doc["paths"]["/api/v5/clients"]
+    assert "mqtt" in doc["components"]["schemas"]["Config"]["properties"]
 
 
 def test_cli_verbs(api, capsys):

@@ -1,0 +1,138 @@
+"""Plugins, dashboard monitor, swagger generation — emqx_plugins_SUITE /
+emqx_dashboard_monitor_SUITE mirrors."""
+
+import json
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.core.message import Message
+from emqx_tpu.mgmt import swagger
+from emqx_tpu.mgmt.api import ManagementApi
+from emqx_tpu.observe.monitor import DashboardMonitor
+from emqx_tpu.services.plugins import PluginManager
+
+PLUGIN_PY = '''
+STARTED = []
+
+def on_start(app):
+    app.hooks.add("message.publish", _tag, priority=900)
+    STARTED.append(True)
+
+def on_stop(app):
+    app.hooks.delete("message.publish", _tag)
+
+def _tag(msg):
+    return msg.set_header("via_plugin", True)
+'''
+
+
+def _mk_plugin(root, name_vsn="tagger-1.0.0", desc="tags messages"):
+    pdir = root / name_vsn
+    pdir.mkdir(parents=True)
+    (pdir / "release.json").write_text(json.dumps(
+        {"name": name_vsn.split("-")[0], "rel_vsn": "1.0.0",
+         "description": desc}))
+    (pdir / "plugin.py").write_text(PLUGIN_PY)
+    return pdir
+
+
+def test_plugin_lifecycle_and_hook_effect(tmp_path):
+    _mk_plugin(tmp_path)
+    app = BrokerApp()
+    pm = PluginManager(app, str(tmp_path))
+    assert pm.scan() == ["tagger-1.0.0"]
+    pm.ensure_enabled("tagger-1.0.0")
+    pm.ensure_started()
+    assert pm.describe("tagger-1.0.0")["running"]
+    # the plugin's hook actually runs in the publish pipeline
+    seen = []
+    app.hooks.add("message.publish",
+                  lambda m: seen.append(m.headers.get("via_plugin")) or None,
+                  priority=800)
+    app.broker.publish(Message(topic="p/t", payload=b"x"))
+    assert seen == [True]
+    pm.ensure_stopped("tagger-1.0.0")
+    seen.clear()
+    app.broker.publish(Message(topic="p/t", payload=b"x"))
+    assert seen == [None]                     # hook detached on stop
+    assert pm.ensure_uninstalled("tagger-1.0.0")
+    assert pm.list() == []
+
+
+def test_plugin_error_isolated(tmp_path):
+    pdir = tmp_path / "broken-0.1.0"
+    pdir.mkdir()
+    (pdir / "release.json").write_text('{"name": "broken"}')
+    (pdir / "plugin.py").write_text("def on_start(app):\n    boom()\n")
+    app = BrokerApp()
+    pm = PluginManager(app, str(tmp_path))
+    pm.scan()
+    pm.ensure_enabled("broken-0.1.0")
+    pm.ensure_started()                       # must not raise
+    d = pm.describe("broken-0.1.0")
+    assert not d["running"] and "NameError" in d["error"]
+
+
+def test_dashboard_monitor_rates_and_history():
+    app = BrokerApp()
+    mon = DashboardMonitor(app, interval_s=10)
+    mon.sample(now=1000.0)
+    app.metrics.inc("messages.received", 50)
+    app.metrics.inc("messages.sent", 30)
+    point = mon.sample(now=1010.0)
+    assert point["received_rate"] == 5.0 and point["sent_rate"] == 3.0
+    assert not mon.tick(now=1011.0)           # inside interval
+    assert mon.tick(now=1021.0)
+    assert len(mon.history()) == 3
+    cur = mon.current()
+    assert cur["messages.received"] == 50 and "received_rate" in cur
+
+
+def test_swagger_from_routes_and_schema():
+    app = BrokerApp()
+    api = ManagementApi(app)
+    doc = swagger.generate(api)
+    assert doc["openapi"].startswith("3.")
+    assert "/api/v5/clients/{clientid}" in doc["paths"]
+    ops = doc["paths"]["/api/v5/clients/{clientid}"]
+    assert "get" in ops and "delete" in ops
+    assert ops["get"]["parameters"][0]["name"] == "clientid"
+    cfg = doc["components"]["schemas"]["Config"]
+    assert cfg["properties"]["mqtt"]["properties"][
+        "max_packet_size"]["type"] == "string"
+    assert cfg["properties"]["retainer"]["additionalProperties"] is True
+
+
+def test_plugin_state_persists_and_uninstall_purges(tmp_path):
+    _mk_plugin(tmp_path)
+    app = BrokerApp()
+    pm = PluginManager(app, str(tmp_path))
+    pm.scan()
+    pm.ensure_enabled("tagger-1.0.0")
+    # a fresh manager (broker restart) sees the persisted enablement
+    pm2 = PluginManager(BrokerApp(), str(tmp_path))
+    pm2.scan()
+    assert pm2.plugins["tagger-1.0.0"].enabled
+    pm2.ensure_started()
+    assert pm2.describe("tagger-1.0.0")["running"]
+    # uninstall purges the package dir — a rescan cannot resurrect it
+    assert pm2.ensure_uninstalled("tagger-1.0.0")
+    assert pm2.scan() == [] and pm2.list() == []
+
+
+def test_auto_subscribe_respects_acl():
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.hooks import Hooks
+    from emqx_tpu.mqtt import packet as P
+
+    app = BrokerApp()
+    app.auto_subscribe.add("ok/%c")
+    app.auto_subscribe.add("secret/%c")
+    app.hooks.add(
+        "client.authorize",
+        lambda ci, action, topic, acc:
+            (Hooks.STOP, "deny") if topic.startswith("secret/") else None,
+        priority=2000)
+    ch = Channel(app.broker, app.cm)
+    ch.handle_in(P.Connect(proto_ver=P.MQTT_V5, clientid="acl-1"))
+    assert ("acl-1", "ok/acl-1") in app.broker.suboption
+    assert ("acl-1", "secret/acl-1") not in app.broker.suboption

@@ -54,6 +54,11 @@ class Connection:
         self.closed = False
         self._loop = asyncio.get_event_loop()
 
+    def _transport_wrap(self, data: bytes) -> bytes:
+        """Frame serialized MQTT bytes for the wire (identity for raw
+        TCP; the WS transport wraps into an RFC6455 binary frame)."""
+        return data
+
     def _send_packets(self, pkts) -> None:
         if self.closed:
             return
@@ -61,17 +66,18 @@ class Connection:
             serialize(p, self.channel.conninfo.proto_ver) for p in pkts
         )
         if data:
+            frame = self._transport_wrap(data)
             try:
                 on_loop = asyncio.get_running_loop() is self._loop
             except RuntimeError:
                 on_loop = False
             if on_loop:
-                self.writer.write(data)
+                self.writer.write(frame)
             else:
                 # dispatch from a foreign thread (bridge ingress, app
                 # tick in to_thread): asyncio transports are not
                 # thread-safe — marshal the write onto the owning loop
-                self._loop.call_soon_threadsafe(self.writer.write, data)
+                self._loop.call_soon_threadsafe(self.writer.write, frame)
             if self.metrics is not None:
                 self.metrics.inc("bytes.sent", len(data))
                 for p in pkts:
@@ -80,37 +86,42 @@ class Connection:
                     if p.type == P.PUBLISH:
                         self.metrics.inc_msg("sent", p.qos)
 
+    async def _on_bytes(self, data: bytes) -> None:
+        """Shared MQTT byte-stream stage: limits, accounting, parse,
+        channel FSM — both raw-TCP and WS reads land here."""
+        # bytes_in limit: pause the socket until tokens free up
+        # (the esockd-htb backpressure, emqx_connection.erl:528-535)
+        await self._limit("bytes_in", len(data))
+        if self.metrics is not None:
+            self.metrics.inc("bytes.received", len(data))
+        gc_policy = getattr(self.server.app, "gc_policy", None)
+        if gc_policy is not None:
+            gc_policy.note(1, len(data),
+                           getattr(self.server.app, "olp", None))
+        for pkt in self.parser.feed(data):
+            if pkt.type == P.PUBLISH:
+                await self._limit("message_in", 1)
+            if self.metrics is not None:
+                self.metrics.inc_recv_packet(
+                    P.TYPE_NAMES.get(pkt.type, "reserved").lower())
+                if pkt.type == P.PUBLISH:
+                    self.metrics.inc_msg("received", pkt.qos)
+            if pkt.type == P.CONNECT:
+                self.parser.set_version(pkt.proto_ver)
+                self.channel.conninfo.proto_ver = pkt.proto_ver
+            out = self.channel.handle_in(pkt)
+            self._send_packets(out)
+            if self.channel.conn_state == "disconnected":
+                self.closed = True
+                break
+
     async def run(self) -> None:
         try:
             while not self.closed:
                 data = await self.reader.read(READ_CHUNK)
                 if not data:
                     break
-                # bytes_in limit: pause the socket until tokens free up
-                # (the esockd-htb backpressure, emqx_connection.erl:528-535)
-                await self._limit("bytes_in", len(data))
-                if self.metrics is not None:
-                    self.metrics.inc("bytes.received", len(data))
-                gc_policy = getattr(self.server.app, "gc_policy", None)
-                if gc_policy is not None:
-                    gc_policy.note(1, len(data),
-                                   getattr(self.server.app, "olp", None))
-                for pkt in self.parser.feed(data):
-                    if pkt.type == P.PUBLISH:
-                        await self._limit("message_in", 1)
-                    if self.metrics is not None:
-                        self.metrics.inc_recv_packet(
-                            P.TYPE_NAMES.get(pkt.type, "reserved").lower())
-                        if pkt.type == P.PUBLISH:
-                            self.metrics.inc_msg("received", pkt.qos)
-                    if pkt.type == P.CONNECT:
-                        self.parser.set_version(pkt.proto_ver)
-                        self.channel.conninfo.proto_ver = pkt.proto_ver
-                    out = self.channel.handle_in(pkt)
-                    self._send_packets(out)
-                    if self.channel.conn_state == "disconnected":
-                        self.closed = True
-                        break
+                await self._on_bytes(data)
                 await self._drain()
         except FrameError as e:
             log.info("frame error from %s: %s",

@@ -595,12 +595,15 @@ class ManagementApi:
                 pm.ensure_disabled(name)
             elif action == "restart":
                 pm.restart(name)
+                if pm.plugins[name].error:
+                    raise ApiError(400, "BAD_PLUGIN",
+                                   pm.plugins[name].error)
             else:
                 raise ApiError(400, "BAD_REQUEST",
                                f"unknown action {action}")
-        except ValueError as e:
+            return pm.describe(name)
+        except (ValueError, KeyError) as e:
             raise ApiError(404, "NOT_FOUND", str(e)) from None
-        return pm.describe(name)
 
     def h_plugin_delete(self, query, body, name):
         if not self.app.plugins.ensure_uninstalled(name):

@@ -33,10 +33,14 @@ class DashboardMonitor:
         self._last_sample_at = 0.0
         self._lock = threading.RLock()
 
-    def _read(self) -> tuple[dict[str, int], dict[str, int]]:
+    def _read(self, tick_stats: bool = False
+              ) -> tuple[dict[str, int], dict[str, int]]:
         m = self.app.metrics
         counters = {k: m.val(k) for k in RATE_COUNTERS}
-        self.app.stats.tick()
+        if tick_stats:
+            # only when nothing else refreshed the gauges (REST reads);
+            # the housekeeping path ticks stats right before monitor.tick
+            self.app.stats.tick()
         s = self.app.stats.all()
         gauges = {k: s.get(k, 0) for k in GAUGES}
         return counters, gauges
@@ -77,7 +81,7 @@ class DashboardMonitor:
     def current(self) -> dict:
         """The dashboard's headline card: live gauges + latest rates."""
         with self._lock:
-            counters, gauges = self._read()
+            counters, gauges = self._read(tick_stats=True)
             latest = self.samples[-1] if self.samples else {}
             return {
                 **counters, **gauges,

@@ -186,16 +186,10 @@ class PluginManager:
         """Stop, forget, and (by default) delete the package directory —
         without the purge a later scan() would re-discover it."""
         with self._lock:
-            p = self.plugins.pop(name_vsn, None)
-            if p is None:
+            if name_vsn not in self.plugins:
                 return False
-            if p.running:
-                p.running = False
-                try:
-                    if p.module is not None and hasattr(p.module, "on_stop"):
-                        p.module.on_stop(self.app)
-                except Exception:
-                    pass
+            self.ensure_stopped(name_vsn)
+            p = self.plugins.pop(name_vsn)
             if name_vsn in self.order:
                 self.order.remove(name_vsn)
             self._save_state()
@@ -211,11 +205,15 @@ class PluginManager:
                     if n in self.plugins]
 
     def describe(self, name_vsn: str) -> dict:
-        p = self.plugins[name_vsn]
-        return {
-            "name_vsn": p.name_vsn,
-            "description": p.info.get("description", ""),
-            "enabled": p.enabled,
-            "running": p.running,
-            **({"error": p.error} if p.error else {}),
-        }
+        with self._lock:
+            p = self.plugins.get(name_vsn)
+            if p is None:
+                # concurrent uninstall: surface as not-found, not a crash
+                raise ValueError(f"plugin {name_vsn} not installed")
+            return {
+                "name_vsn": p.name_vsn,
+                "description": p.info.get("description", ""),
+                "enabled": p.enabled,
+                "running": p.running,
+                **({"error": p.error} if p.error else {}),
+            }

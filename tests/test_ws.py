@@ -1,0 +1,179 @@
+"""MQTT-over-WebSocket listener (emqx_ws_connection analogue): RFC6455
+codec, handshake, and full MQTT flows through the WS transport."""
+
+import asyncio
+import base64
+import os
+import struct
+
+import pytest
+
+from emqx_tpu.broker.ws import (
+    OP_BINARY, OP_CLOSE, OP_PING, OP_PONG, FrameDecoder, WsBrokerServer,
+    WsError, accept_key, encode_frame,
+)
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import Parser, serialize
+
+
+# -- codec ---------------------------------------------------------------------
+
+def test_frame_roundtrip_masked_and_sizes():
+    dec = FrameDecoder(require_mask=True)
+    for size in (0, 1, 125, 126, 65535, 65536, 100_000):
+        payload = os.urandom(size)
+        msgs = dec.feed(encode_frame(OP_BINARY, payload, mask=True))
+        assert msgs == [(OP_BINARY, payload)]
+
+
+def test_frame_fragmentation_and_interleaved_control():
+    dec = FrameDecoder(require_mask=False)
+    # two fragments with a PING between them
+    p1, p2 = b"hello ", b"world"
+    f1 = bytearray(encode_frame(OP_BINARY, p1))
+    f1[0] &= 0x7F                                  # clear FIN
+    ping = encode_frame(OP_PING, b"hb")
+    f2 = bytearray(encode_frame(0x0, p2))          # continuation, FIN set
+    msgs = dec.feed(bytes(f1) + ping + bytes(f2))
+    assert msgs == [(OP_PING, b"hb"), (OP_BINARY, b"hello world")]
+
+
+def test_frame_unmasked_client_rejected():
+    dec = FrameDecoder(require_mask=True)
+    with pytest.raises(WsError):
+        dec.feed(encode_frame(OP_BINARY, b"x", mask=False))
+
+
+def test_accept_key_rfc_example():
+    # the RFC6455 §1.3 worked example
+    assert (accept_key("dGhlIHNhbXBsZSBub25jZQ==")
+            == "s3pPLMBiTxaQ9kYGzzhZRbK+xOo=")
+
+
+# -- live listener -------------------------------------------------------------
+
+class WsTestClient:
+    """Minimal masked-frame WS client speaking the mqtt subprotocol."""
+
+    def __init__(self, port: int, path: str = "/mqtt"):
+        self.port, self.path = port, path
+        self.dec = FrameDecoder(require_mask=False)   # server→client unmasked
+        self.parser = Parser()
+        self.inbox: list = []
+
+    async def connect_ws(self):
+        self.r, self.w = await asyncio.open_connection("127.0.0.1", self.port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.w.write((
+            f"GET {self.path} HTTP/1.1\r\nHost: localhost\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n"
+            "Sec-WebSocket-Protocol: mqtt\r\n\r\n").encode())
+        resp = await self.r.readuntil(b"\r\n\r\n")
+        assert b"101" in resp.split(b"\r\n")[0]
+        assert accept_key(key).encode() in resp
+        return self
+
+    async def send_mqtt(self, pkt, ver=P.MQTT_V4):
+        self.w.write(encode_frame(OP_BINARY, serialize(pkt, ver), mask=True))
+        await self.w.drain()
+
+    async def recv_mqtt(self, timeout=5.0):
+        while not self.inbox:
+            data = await asyncio.wait_for(self.r.read(65536), timeout)
+            assert data, "server closed"
+            for op, payload in self.dec.feed(data):
+                if op == OP_BINARY:
+                    self.inbox.extend(self.parser.feed(payload))
+        return self.inbox.pop(0)
+
+    async def close(self):
+        self.w.close()
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_mqtt_pubsub_over_websocket():
+    async def main():
+        server = WsBrokerServer(port=0)
+        await server.start()
+        try:
+            sub = await WsTestClient(server.port).connect_ws()
+            await sub.send_mqtt(P.Connect(clientid="ws-sub"))
+            assert (await sub.recv_mqtt()).reason_code == 0
+            await sub.send_mqtt(P.Subscribe(
+                packet_id=1, topic_filters=[("ws/+/t", {"qos": 1})]))
+            assert (await sub.recv_mqtt()).reason_codes == [1]
+
+            pub = await WsTestClient(server.port).connect_ws()
+            await pub.send_mqtt(P.Connect(clientid="ws-pub"))
+            await pub.recv_mqtt()
+            await pub.send_mqtt(P.Publish(topic="ws/1/t", payload=b"over-ws",
+                                          qos=1, packet_id=7))
+            got = await sub.recv_mqtt()
+            assert isinstance(got, P.Publish) and got.payload == b"over-ws"
+            assert (await pub.recv_mqtt()).packet_id == 7   # puback
+            await sub.close()
+            await pub.close()
+        finally:
+            await server.stop()
+    run(main())
+
+
+def test_ws_ping_pong_and_bad_path():
+    async def main():
+        server = WsBrokerServer(port=0)
+        await server.start()
+        try:
+            c = await WsTestClient(server.port).connect_ws()
+            c.w.write(encode_frame(OP_PING, b"x", mask=True))
+            data = await asyncio.wait_for(c.r.read(1024), 5)
+            assert c.dec.feed(data)[0] == (OP_PONG, b"x")
+            await c.close()
+            # wrong path → 400, no upgrade
+            r, w = await asyncio.open_connection("127.0.0.1", server.port)
+            w.write(b"GET /nope HTTP/1.1\r\nHost: x\r\n"
+                    b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                    b"Sec-WebSocket-Key: AAAAAAAAAAAAAAAAAAAAAA==\r\n\r\n")
+            resp = await asyncio.wait_for(r.read(1024), 5)
+            assert b"400" in resp
+            w.close()
+        finally:
+            await server.stop()
+    run(main())
+
+
+def test_ws_mixed_with_tcp_same_broker():
+    """One app, two listeners: a WS subscriber receives from a TCP
+    publisher (the reference's multi-listener norm)."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.mqtt.client import MqttClient
+
+    async def main():
+        app = BrokerApp()
+        tcp = BrokerServer(port=0, app=app)
+        ws = WsBrokerServer(port=0, app=app)
+        await tcp.start()
+        await ws.start()
+        try:
+            sub = await WsTestClient(ws.port).connect_ws()
+            await sub.send_mqtt(P.Connect(clientid="w1"))
+            await sub.recv_mqtt()
+            await sub.send_mqtt(P.Subscribe(
+                packet_id=1, topic_filters=[("x/#", {"qos": 0})]))
+            await sub.recv_mqtt()
+            c = MqttClient(port=tcp.port, clientid="t1")
+            await c.connect()
+            await c.publish("x/y", b"cross")
+            got = await sub.recv_mqtt()
+            assert got.payload == b"cross"
+            await c.disconnect()
+            await sub.close()
+        finally:
+            await ws.stop()
+            await tcp.stop()
+    run(main())

@@ -117,9 +117,11 @@ class BrokerApp:
         self.statsd = StatsdPusher(self)
         self.psk = PskStore(enable=False)
         from emqx_tpu.observe.monitor import DashboardMonitor
+        from emqx_tpu.observe.sysmon import SysMon
         from emqx_tpu.services.plugins import PluginManager
         self.monitor = DashboardMonitor(self)
         self.plugins = PluginManager(self, install_dir="plugins")
+        self.sysmon = SysMon(self.alarms, olp=self.olp)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)
@@ -293,6 +295,15 @@ class BrokerApp:
                     conf.get("psk_authentication.init_file"))
             except OSError:
                 pass
+        app.sysmon.cpu_high = float(
+            conf.get("sysmon.os.cpu_high_watermark"))
+        app.sysmon.cpu_low = float(conf.get("sysmon.os.cpu_low_watermark"))
+        app.sysmon.mem_high = float(
+            conf.get("sysmon.os.mem_high_watermark"))
+        gc_conf = conf.get("force_gc")
+        app.gc_policy.enable = bool(gc_conf["enable"])
+        app.gc_policy.count_budget = int(gc_conf["count"])
+        app.gc_policy.bytes_budget = int(gc_conf["bytes"])
         import os as _os
         app.plugins.install_dir = _os.path.join(
             conf.get("node.data_dir", "data"), "plugins")
@@ -400,6 +411,7 @@ class BrokerApp:
         self.telemetry.tick()
         self.statsd.tick()
         self.monitor.tick()
+        self.sysmon.tick()
         self.access.banned.expire()
         for fn in self._tickers:
             fn()

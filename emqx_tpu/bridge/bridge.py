@@ -70,6 +70,22 @@ class Bridge:
                 "qos": remote.get("qos", columns.get("qos", 0)),
                 "retain": bool(remote.get("retain", False)),
             }
+        if self.type == "redis":
+            # emqx_ee_bridge_redis: command template per message
+            tmpl = c.get("command_template") or [
+                "LPUSH", "mqtt:${topic}", "${payload}"]
+            return {"cmd": [render_template(x, columns) for x in tmpl]}
+        if self.type == "influxdb":
+            # emqx_ee_bridge_influxdb: write_syntax template → one line
+            # of line protocol, shipped over the HTTP connector's /write
+            tmpl = c.get("write_syntax") or \
+                "mqtt,topic=${topic} payload=\"${payload}\""
+            return {
+                "method": "post",
+                "path": c.get("path", "/write"),
+                "headers": {"Content-Type": "text/plain"},
+                "body": render_template(tmpl, columns),
+            }
         # generic connectors take the columns (bytes decoded — requests
         # must survive the buffer worker's JSON disk codec)
         return {k: (v.decode("utf-8", "replace") if isinstance(v, bytes)

@@ -170,3 +170,31 @@ def test_exclusive_channel_strips_prefix_and_delivers():
     suback4 = ch2.handle_in(P.Subscribe(
         packet_id=3, topic_filters=[("$exclusive/other", {"qos": 0})]))
     assert suback4[0].reason_codes == [P.RC_TOPIC_FILTER_INVALID]
+
+
+# -- sysmon --------------------------------------------------------------------
+
+def test_sysmon_watermarks_and_alarms():
+    from emqx_tpu.observe.sysmon import SysMon
+
+    alarms = AlarmManager()
+    olp = Olp(backoff_delay_ms=50)
+    sm = SysMon(alarms, olp=olp, cpu_high=0.8, mem_high=2.0)  # mem never fires
+    readings = sm.check()
+    # on Linux /proc is present; at minimum mem+fds read back
+    assert "mem" in readings and 0 <= readings["mem"] <= 1
+    assert "fds" in readings
+    assert not alarms.is_active("high_system_memory_usage")
+    # overload signal propagates as an alarm
+    for _ in range(20):
+        olp.note_lag(500)
+    sm.check()
+    assert alarms.is_active("runtime_overloaded")
+    for _ in range(80):
+        olp.note_lag(0)
+    sm.check()
+    assert not alarms.is_active("runtime_overloaded")
+    # interval gating
+    assert sm.tick(now=0.0) or True
+    sm._last_check = 100.0
+    assert not sm.tick(now=100.5)

@@ -32,7 +32,7 @@ def render_cmd(template: list[str], cred: dict) -> list[str]:
         binds[key] = v or ""
     # peerhost derives from the credential's peername "ip:port"
     peer = cred.get("peerhost") or str(cred.get("peername") or "")
-    binds["peerhost"] = peer.split(":")[0]
+    binds["peerhost"] = peer.rsplit(":", 1)[0]   # IPv6-safe
     out = []
     for part in template:
         for key, val in binds.items():

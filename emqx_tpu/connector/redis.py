@@ -105,7 +105,18 @@ class RedisClient:
                 return self._do(args)
             except (OSError, ConnectionError):
                 self.close()
-                raise
+                if connecting:
+                    raise
+                # stale pooled connection (server restarted, idle drop):
+                # one fresh-connection retry before surfacing the error —
+                # otherwise a healthy backend still fails one request per
+                # connection drop (and authn maps that to a denial)
+                try:
+                    self._connect()
+                    return self._do(args)
+                except (OSError, ConnectionError, RedisError):
+                    self.close()
+                    raise
             except RedisError:
                 if connecting:
                     # handshake rejection (AUTH/SELECT error, -LOADING):

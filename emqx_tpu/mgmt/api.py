@@ -660,7 +660,15 @@ class ManagementApi:
                     data = b""
                     ctype = "application/json"
                 else:
-                    data = json.dumps(result).encode()
+                    # rule_test / trace results can carry bytes (gzip,
+                    # payloads); never let a reply crash the handler
+                    data = json.dumps(
+                        result,
+                        default=lambda o: (
+                            o.decode("utf-8", "replace")
+                            if isinstance(o, (bytes, bytearray))
+                            else str(o)),
+                    ).encode()
                     ctype = "application/json"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)

@@ -1,11 +1,13 @@
 """Builtin SQL functions — parity with
-``apps/emqx_rule_engine/src/emqx_rule_funcs.erl`` (~200 funcs there;
-the ~90 the docs/examples actually exercise here, same names/semantics).
+``apps/emqx_rule_engine/src/emqx_rule_funcs.erl`` — 131 funcs covering
+the math/bit/string/map/array/date/compression/hash/topic families, same
+names/semantics.
 """
 
 from __future__ import annotations
 
 import base64
+import gzip as _gzip
 import hashlib
 import json
 import math
@@ -202,3 +204,182 @@ def _nth_topic_level(n, topic):
 
 FUNCS["term_to_binary"] = lambda x: json.dumps(x).encode()
 FUNCS["binary_to_term"] = lambda b: json.loads(b)
+
+
+# -- bit / binary ops (emqx_rule_funcs.erl bit* family) --------------------
+
+FUNCS["bitand"] = lambda x, y: int(_num(x)) & int(_num(y))
+FUNCS["bitor"] = lambda x, y: int(_num(x)) | int(_num(y))
+FUNCS["bitxor"] = lambda x, y: int(_num(x)) ^ int(_num(y))
+FUNCS["bitnot"] = lambda x: ~int(_num(x))
+FUNCS["bitsl"] = lambda x, n: int(_num(x)) << int(_num(n))
+FUNCS["bitsr"] = lambda x, n: int(_num(x)) >> int(_num(n))
+FUNCS["bitsize"] = lambda b: len(b) * 8 if isinstance(b, (bytes, bytearray)) \
+    else len(_str(b).encode()) * 8
+FUNCS["mod"] = lambda x, y: int(_num(x)) % int(_num(y))
+FUNCS["eq"] = lambda x, y: x == y
+
+
+@f("subbits")
+def _subbits(b, start_or_len, ln=None):
+    """subbits(Bytes, Len) / subbits(Bytes, Start, Len) — big-endian
+    unsigned int of the selected bit range (1-based start)."""
+    data = b if isinstance(b, (bytes, bytearray)) else _str(b).encode()
+    val = int.from_bytes(data, "big")
+    total = len(data) * 8
+    if ln is None:
+        start, ln = 1, int(_num(start_or_len))
+    else:
+        start, ln = int(_num(start_or_len)), int(_num(ln))
+    if start < 1 or start + ln - 1 > total:
+        return None
+    return (val >> (total - (start - 1) - ln)) & ((1 << ln) - 1)
+
+
+# -- inverse hyperbolics ----------------------------------------------------
+
+FUNCS["acosh"] = lambda x: math.acosh(_num(x))
+FUNCS["asinh"] = lambda x: math.asinh(_num(x))
+FUNCS["atanh"] = lambda x: math.atanh(_num(x))
+def _float2str(x, prec=10):
+    s = f"{_num(x):.{int(prec)}f}"
+    # only trim the fractional part — prec=0 must not eat integer zeros
+    return s.rstrip("0").rstrip(".") if "." in s else s
+
+
+FUNCS["float2str"] = _float2str
+
+
+# -- compression / hashing / encoding ---------------------------------------
+
+def _as_bytes(x):
+    return x if isinstance(x, (bytes, bytearray)) else _str(x).encode()
+
+
+FUNCS["gzip"] = lambda b: _gzip.compress(_as_bytes(b))
+FUNCS["gunzip"] = lambda b: _gzip.decompress(_as_bytes(b))
+FUNCS["zip"] = lambda b: zlib.compress(_as_bytes(b), 9)[2:-4]
+FUNCS["unzip"] = lambda b: zlib.decompress(_as_bytes(b), wbits=-15)
+FUNCS["zip_compress"] = lambda b: zlib.compress(_as_bytes(b))
+FUNCS["zip_uncompress"] = lambda b: zlib.decompress(_as_bytes(b))
+
+
+@f("hash")
+def _hash(alg, data):
+    return hashlib.new(_str(alg), _as_bytes(data)).hexdigest()
+
+
+FUNCS["term_encode"] = FUNCS["term_to_binary"]
+FUNCS["term_decode"] = FUNCS["binary_to_term"]
+
+
+# -- topic predicates --------------------------------------------------------
+
+@f("contains_topic")
+def _contains_topic(topics, topic, *rest):
+    items = topics if isinstance(topics, list) else [topics]
+    return any(_str(t) == _str(topic) for t in items)
+
+
+@f("contains_topic_match")
+def _contains_topic_match(filters, topic, *rest):
+    from emqx_tpu.core import topic as T
+
+    items = filters if isinstance(filters, list) else [filters]
+    return any(T.match(_str(topic), _str(fl)) for fl in items)
+
+
+@f("find_topic_filter")
+def _find_topic_filter(filters, topic):
+    from emqx_tpu.core import topic as T
+
+    items = filters if isinstance(filters, list) else [filters]
+    for fl in items:
+        if T.match(_str(topic), _str(fl)):
+            return _str(fl)
+    return None
+
+
+# -- maps --------------------------------------------------------------------
+
+FUNCS["map_new"] = lambda: {}
+FUNCS["map"] = lambda x=None: dict(x) if isinstance(x, dict) else {}
+
+
+@f("map_path")
+def _map_path(path, obj):
+    cur = obj
+    for seg in _str(path).lstrip("$.").split("."):
+        if isinstance(cur, dict) and seg in cur:
+            cur = cur[seg]
+        else:
+            return None
+    return cur
+
+
+# -- dates -------------------------------------------------------------------
+
+@f("format_date")
+def _format_date(unit, offset, fmt, ts=None):
+    from datetime import datetime, timedelta, timezone
+
+    ts_s = (_num(ts) if ts is not None else time.time()) / {
+        "second": 1, "millisecond": 1000, "microsecond": 1e6,
+        "nanosecond": 1e9}.get(_str(unit), 1)
+    off = _str(offset)
+    if off in ("", "local"):
+        return time.strftime(_str(fmt), time.localtime(ts_s))
+    if off in ("Z", "z", "+00:00", "0"):
+        tz = timezone.utc
+    else:
+        sign = -1 if off.startswith("-") else 1
+        hh, _, mm = off.lstrip("+-").partition(":")
+        tz = timezone(sign * timedelta(hours=int(hh or 0),
+                                       minutes=int(mm or 0)))
+    return datetime.fromtimestamp(ts_s, tz).strftime(_str(fmt))
+
+
+@f("date_to_unix_ts")
+def _date_to_unix_ts(unit, fmt, date):
+    mult = {"second": 1, "millisecond": 1000, "microsecond": 1_000_000,
+            "nanosecond": 1_000_000_000}.get(_str(unit), 1)
+    return int(time.mktime(time.strptime(_str(date), _str(fmt)))) * mult
+
+
+@f("rfc3339_to_unix_ts")
+def _rfc3339_to_unix_ts(date, unit="second"):
+    from datetime import datetime
+
+    dt = datetime.fromisoformat(_str(date).replace("Z", "+00:00"))
+    mult = {"second": 1, "millisecond": 1000, "microsecond": 1_000_000,
+            "nanosecond": 1_000_000_000}.get(_str(unit), 1)
+    return int(dt.timestamp() * mult)
+
+
+FUNCS["time_unit"] = lambda u: {"second": 1, "millisecond": 1000,
+                                "microsecond": 1_000_000,
+                                "nanosecond": 1_000_000_000}.get(_str(u), 1)
+
+
+# -- per-rule kv store (emqx_rule_funcs kv_store_* / proc_dict_*) -----------
+
+_KV_STORE: dict = {}
+
+
+@f("kv_store_put")
+def _kv_store_put(k, v):
+    _KV_STORE[_str(k)] = v
+    return v
+
+
+FUNCS["kv_store_get"] = lambda k, default=None: _KV_STORE.get(
+    _str(k), default)
+
+
+@f("kv_store_del")
+def _kv_store_del(k):
+    _KV_STORE.pop(_str(k), None)
+    return None
+FUNCS["proc_dict_put"] = FUNCS["kv_store_put"]
+FUNCS["proc_dict_get"] = FUNCS["kv_store_get"]
+FUNCS["proc_dict_del"] = FUNCS["kv_store_del"]

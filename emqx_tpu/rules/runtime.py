@@ -79,6 +79,8 @@ def eval_expr(ast, columns: dict) -> Any:
             if _truthy(eval_expr(cond, columns)):
                 return eval_expr(then, columns)
         return eval_expr(ast[2], columns) if ast[2] is not None else None
+    if tag == "list":
+        return [eval_expr(item, columns) for item in ast[1]]
     if tag == "index":
         seq = eval_expr(ast[1], columns)
         idx = eval_expr(ast[2], columns)

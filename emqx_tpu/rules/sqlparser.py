@@ -281,6 +281,16 @@ class _P:
             e = self._expr()
             self.expect_op(")")
             return e
+        if t.kind == "op" and t.val == "[":
+            # array literal: ["a", b.c, 1] (rulesql array syntax)
+            items = []
+            if not self.at_op("]"):
+                while True:
+                    items.append(self._expr())
+                    if not self.at_op(","):
+                        break
+                self.expect_op("]")
+            return ("list", items)
         if t.kind == "word":
             low = t.val.lower()
             if low == "true":

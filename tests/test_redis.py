@@ -136,3 +136,32 @@ def test_redis_bridge_end_to_end(server):
     assert bad.worker.metrics["failed"] >= 1 or \
         bad.worker.metrics["success"] == 0
     probe.close()
+
+
+def test_client_reconnects_after_server_restart():
+    """A stale pooled connection must not fail a request against a
+    healthy backend (one transparent reconnect)."""
+    s1 = MiniRedis().start()
+    c = RedisClient(port=s1.port)
+    assert c.command(["PING"]) == "PONG"
+    port = s1.port
+    s1.stop()
+    s2 = MiniRedis(host="127.0.0.1", port=port).start()
+    try:
+        assert c.command(["PING"]) == "PONG"     # retried on fresh conn
+    finally:
+        c.close()
+        s2.stop()
+
+
+def test_funcs_fix_regressions():
+    from emqx_tpu.rules.funcs import FUNCS
+
+    assert FUNCS["float2str"](100, 0) == "100"
+    assert FUNCS["float2str"](1.50, 2) == "1.5"
+    FUNCS["kv_store_put"]("zero", 0)
+    assert FUNCS["kv_store_del"]("zero") is None
+    # format_date honours the offset argument
+    utc = FUNCS["format_date"]("second", "+00:00", "%H", 3600 * 5)
+    plus8 = FUNCS["format_date"]("second", "+08:00", "%H", 3600 * 5)
+    assert (int(plus8) - int(utc)) % 24 == 8

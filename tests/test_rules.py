@@ -257,3 +257,26 @@ def test_rules_via_live_broker():
                             payload=b'{"temp": 20}'))
     assert len([p for p in watcher.outbox
                 if isinstance(p, P.Publish)]) == 1
+
+
+def test_builtin_funcs_long_tail_via_sql():
+    """The bit/compression/topic/map/date func families added for parity
+    with emqx_rule_funcs.erl, exercised through real SQL."""
+    from emqx_tpu.rules.engine import RuleEngine
+    from emqx_tpu.core.message import Message
+
+    eng = RuleEngine(node="n1")
+    got = []
+    eng.register_action("probe", lambda cols, args: got.append(cols))
+    eng.create_rule(
+        id="tail",
+        sql=("SELECT bitand(12, 10) as band, mod(7, 3) as m, "
+             "contains_topic_match(['t/+'], topic) as hit, "
+             "map_path('a.b', json_decode(payload)) as nested, "
+             "hash('sha256', 'x') as h "
+             'FROM "t/#"'),
+        actions=[{"function": "probe"}])
+    eng.ingest(Message(topic="t/1", payload=b'{"a": {"b": 42}}'))
+    assert got and got[0]["band"] == 8 and got[0]["m"] == 1
+    assert got[0]["hit"] is True and got[0]["nested"] == 42
+    assert len(got[0]["h"]) == 64

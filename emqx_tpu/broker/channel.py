@@ -213,6 +213,12 @@ class Channel:
             pkt.clean_start, clientid, self, self.session_opts
         )
         self.session = session
+        # client flow control: its Receive-Maximum caps our send window
+        # (MQTT5 3.1.2-11; reference folds it into the inflight limit)
+        rm = (pkt.properties or {}).get("Receive-Maximum")
+        if rm:
+            session.max_inflight = max(1, min(session.max_inflight, int(rm)))
+            session.inflight.max_size = session.max_inflight
         # restart-resume: the store prefilled session.subscriptions —
         # rebuild the broker's routes/tables for any not already live
         for sub_topic, sub_opts in list(session.subscriptions.items()):
@@ -232,6 +238,12 @@ class Channel:
         props: dict[str, Any] = {}
         if assigned is not None and self._v5():
             props["Assigned-Client-Identifier"] = assigned
+        if self._v5():
+            # server capability advertisement (emqx_channel connack props)
+            props["Receive-Maximum"] = session.max_inflight
+            props["Topic-Alias-Maximum"] = 65535   # inbound aliases accepted
+            if not self.broker.shared_dispatch:
+                props["Shared-Subscription-Available"] = 0
         connack = P.Connack(
             session_present=present, reason_code=P.RC_SUCCESS,
             properties=props,

@@ -1,0 +1,60 @@
+"""Standalone cluster peer process — one real broker node in its own OS
+process, the piece ``ct_slave`` provides the reference (real peer BEAM
+nodes on one host, emqx_common_test_helpers.erl:553-620). The test
+harness spawns N of these, wires their loopback cluster ports together,
+and drives them with real MQTT clients; killing one exercises the
+failure-detection path for real.
+
+Usage:
+    python -m emqx_tpu.cluster.peer --name n1 \
+        --cluster-port 7001 --mqtt-port 1884 \
+        --peer n2:127.0.0.1:7002 --seed n2
+
+Prints ``READY <mqtt_port>`` on stdout once both listeners serve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--cluster-port", type=int, default=0)
+    ap.add_argument("--mqtt-port", type=int, default=0)
+    ap.add_argument("--peer", action="append", default=[],
+                    help="name:host:port, repeatable")
+    ap.add_argument("--seed", default=None,
+                    help="node name to join (first peer by default)")
+    args = ap.parse_args()
+
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.cluster.node import ClusterNode
+    from emqx_tpu.cluster.transport import TcpTransport
+
+    transport = TcpTransport(args.name, port=args.cluster_port)
+    for spec in args.peer:
+        name, host, port = spec.rsplit(":", 2)
+        transport.add_peer(name, host, int(port))
+    node = ClusterNode(args.name, transport)
+    if args.peer:
+        seed = args.seed or args.peer[0].split(":", 1)[0]
+        node.join([seed])
+
+    async def serve() -> None:
+        server = BrokerServer(port=args.mqtt_port, app=node.app)
+        await server.start()
+        print(f"READY {server.port}", flush=True)
+        await asyncio.Event().wait()          # run until killed
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -150,6 +150,12 @@ class Session:
         props = dict(msg.headers.get("properties") or {})
         if opts.subid is not None:
             props["Subscription-Identifier"] = [opts.subid]
+        mei = props.get("Message-Expiry-Interval")
+        if mei is not None:
+            # forward the REMAINING interval (MQTT5 3.3.2-6): queue/store
+            # time already consumed from the expiry budget
+            elapsed_s = (now_ms() - msg.timestamp) // 1000
+            props["Message-Expiry-Interval"] = max(1, int(mei) - elapsed_s)
         retain = msg.retain if opts.rap else False
         if msg.headers.get("retained"):
             retain = True  # messages replayed from the retainer keep retain=1

@@ -1,0 +1,146 @@
+"""Distributed tests with REAL peer processes — the ct_slave pattern
+(SURVEY.md §4.3): every node is its own OS process running the full
+broker + cluster stack on loopback; clients are real sockets; failure
+injection = killing a process."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Peer:
+    def __init__(self, name: str, cluster_port: int,
+                 peers: list[str], seed: str | None) -> None:
+        cmd = [sys.executable, "-m", "emqx_tpu.cluster.peer",
+               "--name", name, "--cluster-port", str(cluster_port),
+               "--mqtt-port", "0"]
+        for p in peers:
+            cmd += ["--peer", p]
+        if seed:
+            cmd += ["--seed", seed]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=os.path.dirname(os.path.dirname(__file__)),
+            env=env)
+        line = self.proc.stdout.readline().strip()
+        assert line.startswith("READY"), f"peer {name} failed: {line!r}"
+        self.mqtt_port = int(line.split()[1])
+
+    def kill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+@pytest.fixture()
+def two_peers():
+    p1_port, p2_port = _free_port(), _free_port()
+    n1 = Peer("n1", p1_port, [f"n2:127.0.0.1:{p2_port}"], seed=None)
+    n2 = Peer("n2", p2_port, [f"n1:127.0.0.1:{p1_port}"], seed="n1")
+    yield n1, n2
+    n1.stop()
+    n2.stop()
+
+
+def test_cross_process_pubsub(two_peers):
+    """Subscribe on n2, publish on n1 → route replication + forwarding
+    across a REAL process/socket boundary."""
+    import asyncio
+
+    from emqx_tpu.mqtt.client import MqttClient
+
+    n1, n2 = two_peers
+
+    async def main():
+        sub = MqttClient(port=n2.mqtt_port, clientid="sub-proc")
+        await sub.connect()
+        await sub.subscribe("fleet/+/speed", qos=1)
+        await asyncio.sleep(0.6)       # route replication settles
+        pub = MqttClient(port=n1.mqtt_port, clientid="pub-proc")
+        await pub.connect()
+        await pub.publish("fleet/v1/speed", b"88", qos=1)
+        got = await sub.recv(timeout=10)
+        assert got.topic == "fleet/v1/speed" and got.payload == b"88"
+        await pub.disconnect()
+        await sub.disconnect()
+    asyncio.run(main())
+
+
+def test_peer_kill_purges_routes_and_keeps_serving(two_peers):
+    """SIGKILL one peer: the survivor must detect the death, purge its
+    routes, and keep serving local traffic (emqx_router_helper nodedown,
+    SURVEY.md §5 failure detection)."""
+    import asyncio
+
+    from emqx_tpu.mqtt.client import MqttClient
+
+    n1, n2 = two_peers
+
+    async def main():
+        sub2 = MqttClient(port=n2.mqtt_port, clientid="doomed")
+        await sub2.connect()
+        await sub2.subscribe("will-vanish/#", qos=0)
+        await asyncio.sleep(0.6)
+        n2.kill()
+        # survivor keeps serving; publish to the dead route must not wedge
+        c = MqttClient(port=n1.mqtt_port, clientid="survivor")
+        await c.connect()
+        await c.publish("will-vanish/x", b"into-the-void")
+        await c.subscribe("local/#", qos=0)
+        await c.publish("local/ok", b"alive")
+        got = await c.recv(timeout=10)
+        assert got.payload == b"alive"
+        await c.disconnect()
+    asyncio.run(main())
+
+
+def test_cross_process_session_takeover(two_peers):
+    """clean_start=False reconnect on the OTHER node takes the session
+    over across the process boundary (emqx_cm takeover, SURVEY §3.4)."""
+    import asyncio
+
+    from emqx_tpu.mqtt.client import MqttClient
+
+    n1, n2 = two_peers
+
+    async def main():
+        c1 = MqttClient(port=n1.mqtt_port, clientid="roamer",
+                        clean_start=False)
+        await c1.connect()
+        await c1.subscribe("sticky/#", qos=1)
+        await asyncio.sleep(0.6)
+        # reconnect on the other node with the same clientid
+        c2 = MqttClient(port=n2.mqtt_port, clientid="roamer",
+                        clean_start=False)
+        ack = await c2.connect()
+        assert ack.session_present          # session migrated
+        await asyncio.sleep(0.6)
+        pub = MqttClient(port=n1.mqtt_port, clientid="tk-pub")
+        await pub.connect()
+        await pub.publish("sticky/1", b"followed-you", qos=1)
+        got = await c2.recv(timeout=10)
+        assert got.payload == b"followed-you"
+        await pub.disconnect()
+        await c2.disconnect()
+    asyncio.run(main())

@@ -1,0 +1,168 @@
+"""Property-based tests — the PropEr suites of the reference
+(apps/emqx/test/props/prop_emqx_frame.erl parse∘serialize roundtrip,
+emqx_topic match laws, trie-vs-oracle equivalence) on hypothesis."""
+
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.frame import Parser, parse_one, serialize
+from emqx_tpu.router.trie import Trie
+
+# -- generators ---------------------------------------------------------------
+
+word = st.text(alphabet=string.ascii_lowercase + string.digits,
+               min_size=1, max_size=6)
+topic_name = st.lists(word, min_size=1, max_size=7).map("/".join)
+
+
+@st.composite
+def topic_filter(draw):
+    n = draw(st.integers(1, 7))
+    parts = []
+    for i in range(n):
+        kind = draw(st.integers(0, 9))
+        if kind == 0:
+            parts.append("+")
+        elif kind == 1 and i == n - 1:
+            parts.append("#")
+        else:
+            parts.append(draw(word))
+    return "/".join(parts)
+
+
+qos = st.integers(0, 2)
+payload = st.binary(max_size=512)
+
+
+@st.composite
+def publish_packet(draw):
+    q = draw(qos)
+    return P.Publish(
+        topic=draw(topic_name), payload=draw(payload), qos=q,
+        retain=draw(st.booleans()), dup=draw(st.booleans()) if q else False,
+        packet_id=draw(st.integers(1, 0xFFFF)) if q else None)
+
+
+@st.composite
+def any_packet(draw):
+    return draw(st.one_of(
+        publish_packet(),
+        st.builds(P.Connect, clientid=word, keepalive=st.integers(0, 0xFFFF),
+                  clean_start=st.booleans()),
+        st.builds(P.Subscribe, packet_id=st.integers(1, 0xFFFF),
+                  topic_filters=st.lists(
+                      st.tuples(topic_filter(),
+                                st.fixed_dictionaries({"qos": qos})),
+                      min_size=1, max_size=4)),
+        st.builds(P.Unsubscribe, packet_id=st.integers(1, 0xFFFF),
+                  topic_filters=st.lists(topic_filter(), min_size=1,
+                                         max_size=4)),
+        st.builds(P.PubAck, packet_id=st.integers(1, 0xFFFF)),
+        st.builds(P.PubRel, packet_id=st.integers(1, 0xFFFF)),
+        st.just(P.PingReq()),
+        st.just(P.Disconnect()),
+    ))
+
+
+# -- frame codec: parse ∘ serialize == id (prop_emqx_frame) -------------------
+
+@settings(max_examples=200)
+@given(any_packet())
+def test_frame_roundtrip(pkt):
+    wire = serialize(pkt)
+    (got,) = Parser().feed(wire)
+    assert type(got) is type(pkt)
+    assert serialize(got) == wire            # canonical re-serialization
+
+
+@settings(max_examples=100)
+@given(st.lists(any_packet(), min_size=1, max_size=5),
+       st.integers(1, 13))
+def test_frame_roundtrip_chunked(pkts, chunk):
+    """Arbitrary chunking never changes the parse (the {active,N}
+    invariant the incremental state machine must hold)."""
+    wire = b"".join(serialize(p) for p in pkts)
+    parser = Parser()
+    got = []
+    for i in range(0, len(wire), chunk):
+        got.extend(parser.feed(wire[i:i + chunk]))
+    assert [type(p) for p in got] == [type(p) for p in pkts]
+    assert b"".join(serialize(p) for p in got) == wire
+    assert [parse_one(serialize(p)).type for p in pkts] == \
+        [p.type for p in pkts]
+
+
+# -- topic match laws ---------------------------------------------------------
+
+@settings(max_examples=300)
+@given(topic_name)
+def test_topic_matches_itself(name):
+    assert T.match(name, name)
+
+
+@settings(max_examples=300)
+@given(topic_name)
+def test_hash_matches_everything_except_sys(name):
+    assert T.match(name, "#") == (not name.startswith("$"))
+
+
+@settings(max_examples=300)
+@given(topic_name, topic_filter())
+def test_match_equals_wordwise_oracle(name, filt):
+    """T.match vs a brute-force recursive matcher."""
+    def brute(nw, fw):
+        if not fw:
+            return not nw
+        if fw[0] == "#":
+            return True
+        if not nw:
+            return False
+        return (fw[0] == "+" or fw[0] == nw[0]) and brute(nw[1:], fw[1:])
+
+    nw, fw = name.split("/"), filt.split("/")
+    expect = brute(nw, fw) and not (
+        name.startswith("$") and fw[0] in ("+", "#"))
+    assert T.match(name, filt) == expect
+
+
+# -- trie vs linear-scan oracle ----------------------------------------------
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(topic_filter(), min_size=1, max_size=40, unique=True),
+       st.lists(topic_name, min_size=1, max_size=20))
+def test_trie_match_equals_linear_scan(filters, names):
+    trie = Trie()
+    for f in filters:
+        if T.wildcard(f):
+            trie.insert(f)
+    for name in names:
+        got = sorted(trie.match(name))
+        expect = sorted(f for f in filters
+                        if T.wildcard(f) and T.match(name, f))
+        assert got == expect
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(topic_filter(), min_size=2, max_size=30, unique=True),
+       st.data())
+def test_trie_refcounted_delete(filters, data):
+    """Insert all, delete a random subset — matches must equal the
+    linear scan over survivors (emqx_trie refcount discipline)."""
+    wild = [f for f in filters if T.wildcard(f)]
+    trie = Trie()
+    for f in wild:
+        trie.insert(f)
+        trie.insert(f)                       # refcount 2
+    removed = [f for f in wild if data.draw(st.booleans(), label=f)]
+    for f in removed:
+        trie.delete(f)
+        trie.delete(f)                       # both refs gone
+    survivors = [f for f in wild if f not in removed]
+    for f in wild:
+        name = f.replace("+", "x").replace("#", "tail")
+        got = sorted(trie.match(name))
+        expect = sorted(s for s in survivors if T.match(name, s))
+        assert got == expect

@@ -210,3 +210,47 @@ def test_retry_preserves_subid_and_rap():
     assert redel[0].dup
     assert redel[0].retain is True
     assert redel[0].properties["Subscription-Identifier"] == [7]
+
+
+def test_mqtt5_receive_maximum_caps_window():
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.mqtt import packet as P
+
+    app = BrokerApp()
+    sent = []
+    ch = Channel(app.broker, app.cm, send=sent.extend)
+    connack = ch.handle_in(P.Connect(
+        proto_ver=P.MQTT_V5, clientid="rm1",
+        properties={"Receive-Maximum": 2}))[0]
+    assert ch.session.max_inflight == 2
+    assert connack.properties["Receive-Maximum"] == 2
+    assert connack.properties["Topic-Alias-Maximum"] == 65535
+    ch.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("w/#", {"qos": 1})]))
+    sent.clear()
+    from emqx_tpu.core.message import Message
+    for i in range(5):
+        app.cm.dispatch(app.broker.publish(
+            Message(topic="w/x", payload=str(i).encode(), qos=1)))
+    pubs = [p for p in sent if isinstance(p, P.Publish)]
+    assert len(pubs) == 2                      # window capped at RM=2
+    assert len(ch.session.mqueue) == 3         # rest queued
+
+
+def test_mqtt5_message_expiry_remaining_interval():
+    from emqx_tpu.core.message import Message, SubOpts, now_ms
+    from emqx_tpu.session.session import Session
+
+    s = Session(clientid="me1")
+    s.subscribe("t", SubOpts(qos=0))
+    old = Message(topic="t", payload=b"x", qos=0,
+                  headers={"properties": {"Message-Expiry-Interval": 60}})
+    old.timestamp = now_ms() - 10_000          # 10s on the shelf
+    (pkt,) = s.deliver([("t", old)])
+    assert 49 <= pkt.properties["Message-Expiry-Interval"] <= 51
+    # fully expired → dropped
+    dead = Message(topic="t", payload=b"y", qos=0,
+                   headers={"properties": {"Message-Expiry-Interval": 5}})
+    dead.timestamp = now_ms() - 6_000
+    assert s.deliver([("t", dead)]) == []

@@ -111,11 +111,17 @@ class Broker:
         self.subscription: dict[Sid, set[str]] = {}
         self.subscriber: dict[str, set[Sid]] = {}
         # $exclusive/... topics: one subscriber at a time
-        # (emqx_exclusive_subscription.erl — mnesia there, a guarded map
-        # here; clusterwide exclusivity rides the route-replication log).
+        # (emqx_exclusive_subscription.erl — a mnesia transaction there).
+        # This map covers LOCAL holders; cluster-wide exclusivity is the
+        # exclusive_try_fn/exclusive_release_fn seam that ClusterNode
+        # wires to a peer-confirmed acquire (cluster/node.py), mirroring
+        # the reference's cluster-wide try_subscribe txn.  Standalone
+        # (fn unset) the lock is node-local.
         # Gated by the mqtt.exclusive_subscription cap (emqx_mqtt_caps).
         self.exclusive: dict[str, Sid] = {}
         self.exclusive_enabled = True
+        self.exclusive_try_fn = None      # fn(topic, sid) -> Optional[holder]
+        self.exclusive_release_fn = None  # fn(topic, sid)
         if metrics is None:
             from emqx_tpu.observe.metrics import Metrics
             metrics = Metrics()
@@ -135,6 +141,14 @@ class Broker:
         group, real_topic = T.parse_share(topic)
         if group:
             opts = SubOpts(**{**opts.__dict__, "share": group})
+        if (not group and getattr(opts, "exclusive", False)
+                and self.exclusive_try_fn is not None):
+            # Cluster-wide acquire BEFORE the broker lock: the try fn does
+            # peer RPC and must not run under self._lock (a peer acquiring
+            # concurrently would deadlock on the crossed handler calls).
+            remote_holder = self.exclusive_try_fn(topic, sid)
+            if remote_holder is not None:
+                raise ExclusiveLocked(topic, remote_holder)
         with self._lock:
             if not group and getattr(opts, "exclusive", False):
                 # subscription already carries the real (stripped) topic;
@@ -142,6 +156,10 @@ class Broker:
                 # emqx_exclusive_subscription.erl)
                 holder = self.exclusive.get(topic)
                 if holder is not None and holder != sid:
+                    if self.exclusive_release_fn is not None:
+                        # roll back the cluster claim made above — a local
+                        # subscriber beat us between try_fn and the lock
+                        self.exclusive_release_fn(topic, sid)
                     raise ExclusiveLocked(topic, holder)
                 self.exclusive[topic] = sid
             key = (sid, topic)
@@ -180,6 +198,8 @@ class Broker:
             if (getattr(opts, "exclusive", False)
                     and self.exclusive.get(topic) == sid):
                 del self.exclusive[topic]
+                if self.exclusive_release_fn is not None:
+                    self.exclusive_release_fn(topic, sid)
             self.subscription.get(sid, set()).discard(topic)
             subs_key = real_topic if not group else topic
             subs = self.subscriber.get(subs_key)

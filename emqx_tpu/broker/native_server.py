@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from emqx_tpu import native
@@ -81,6 +82,11 @@ class NativeBrokerServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_housekeep = time.monotonic()
+        self._tick_running = threading.Event()
+        # one long-lived worker for app.tick() — spawning a thread per
+        # housekeep cycle would churn an OS thread every few seconds
+        self._tick_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="emqx-native-tick")
 
     # -- event loop ---------------------------------------------------------
 
@@ -133,8 +139,23 @@ class NativeBrokerServer:
         self.host.close_conn(conn.conn_id)
 
     def _housekeep(self) -> None:
-        if self.app is not None:
-            self.app.tick()
+        # app.tick() can block on bridge reconnects / disk-queue flushes;
+        # run it off the poll thread (the asyncio server offloads it with
+        # asyncio.to_thread for the same reason) so frame processing and
+        # keepalive handling never stall behind it.  _tick_running keeps
+        # at most one tick in flight.
+        if self.app is not None and not self._tick_running.is_set():
+            self._tick_running.set()
+
+            def _tick():
+                try:
+                    self.app.tick()
+                except Exception:  # pragma: no cover - defensive
+                    log.exception("app.tick failed")
+                finally:
+                    self._tick_running.clear()
+
+            self._tick_pool.submit(_tick)
         for conn in list(self.conns.values()):
             ch = conn.channel
             if ch.keepalive_expired():
@@ -163,4 +184,5 @@ class NativeBrokerServer:
         for conn in list(self.conns.values()):
             conn.channel.terminate("server_shutdown")
         self.conns.clear()
+        self._tick_pool.shutdown(wait=False)
         self.host.destroy()

@@ -94,6 +94,15 @@ RLOG_V1 = register(Proto("rlog", 1, {
     "registry_delta": ["from_node", "op", "clientid"],
 }))
 
+EXCL_V1 = register(Proto("excl", 1, {
+    # $exclusive cluster lock (emqx_exclusive_subscription try_subscribe):
+    # peer-confirmed acquire + release broadcast + periodic claim sync
+    # (the GC for claims orphaned by lost casts)
+    "try": ["from_node", "topic", "sid"],
+    "release": ["from_node", "topic", "sid"],
+    "sync": ["from_node", "holders"],
+}))
+
 NODE_V1 = register(Proto("node", 1, {
     "hello": ["node", "versions"],
     "ping": ["node"],

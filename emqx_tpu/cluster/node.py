@@ -50,6 +50,14 @@ class ClusterNode:
             self.app.broker.forward_fn = self._forward
         self.app.broker.shared_dispatch = self._shared_dispatch
         self.registry: dict[str, str] = {}        # clientid → node
+        # topic → (sid, node) for $exclusive holders on OTHER nodes; local
+        # holders live in broker.exclusive (emqx_exclusive_subscription's
+        # cluster-wide mnesia table, split per node here)
+        self.exclusive_remote: dict[str, tuple[str, str]] = {}
+        # topic → sid for claims WE are acquiring or hold: registered
+        # BEFORE the peer RPC so a concurrent claim from another node
+        # sees it in _h_excl_try (mutual-reject, never double-grant)
+        self.exclusive_local: dict[str, str] = {}
         self.members: dict[str, dict] = {}        # peer → {alive, missed}
         self._peer_cursor: dict[str, int] = {}    # peer → flushed seq
         self.heartbeat_misses = heartbeat_misses
@@ -65,6 +73,9 @@ class ClusterNode:
         t.register("rlog.bootstrap", self._h_bootstrap)
         t.register("rlog.shared_delta", self._h_shared_delta)
         t.register("rlog.registry_delta", self._h_registry_delta)
+        t.register("excl.try", self._h_excl_try)
+        t.register("excl.release", self._h_excl_release)
+        t.register("excl.sync", self._h_excl_sync)
         t.register("node.hello", self._h_hello)
         t.register("node.ping", self._h_ping)
         t.register("node.bye", self._h_bye)
@@ -79,6 +90,9 @@ class ClusterNode:
                   priority=-500)
         hooks.add("session.discarded", self._on_session_gone,
                   priority=-500)
+        # cluster-wide $exclusive locking seam (broker/broker.py)
+        self.app.broker.exclusive_try_fn = self._exclusive_try
+        self.app.broker.exclusive_release_fn = self._exclusive_release
         # cross-node session lookup/takeover seam
         self._orig_open_session = self.app.cm.open_session
         self.app.cm.open_session = self._open_session
@@ -160,12 +174,19 @@ class ClusterNode:
             dead_cids = [c for c, n in self.registry.items() if n == node]
             for cid in dead_cids:
                 del self.registry[cid]
+            for t in [t for t, (_, n) in self.exclusive_remote.items()
+                      if n == node]:
+                del self.exclusive_remote[t]
         self._drop_peer_routes(node)
         self.app.shared.node_down(node)
 
     def tick(self) -> None:
         """Heartbeat + route flush (housekeeping timer)."""
         self.flush()
+        with self._lock:
+            holders = [{"topic": t, "sid": s}
+                       for t, s in self.exclusive_local.items()]
+        self._broadcast("excl.sync", holders=holders)
         with self._lock:
             peers = list(self.members)
         for peer in peers:
@@ -261,8 +282,13 @@ class ClusterNode:
         ]
         with self._lock:
             registry = dict(self.registry)
+            exclusive = [{"topic": t, "sid": s, "node": n}
+                         for t, (s, n) in self.exclusive_remote.items()]
+            exclusive += [{"topic": t, "sid": s, "node": self.name}
+                          for t, s in self.exclusive_local.items()]
         return {"routes": routes, "shared": shared,
-                "registry": registry, "node": self.name}
+                "registry": registry, "exclusive": exclusive,
+                "node": self.name}
 
     def _apply_snapshot(self, snap: dict) -> None:
         router = self.app.broker.router
@@ -279,6 +305,10 @@ class ClusterNode:
             for cid, node in snap["registry"].items():
                 if node != self.name:
                     self.registry[cid] = node
+            for e in snap.get("exclusive", ()):
+                if e["node"] != self.name:
+                    self.exclusive_remote.setdefault(
+                        e["topic"], (e["sid"], e["node"]))
 
     def _bootstrap_from(self, peer: str) -> None:
         snap = self.transport.call(peer, "rlog.bootstrap",
@@ -363,6 +393,95 @@ class ClusterNode:
             self.app.shared.leave(group, topic, sid, node=from_node)
         else:                                     # "down": all groups
             self.app.shared.member_down(sid)
+
+    # -- $exclusive cluster lock --------------------------------------------
+    #
+    # The reference makes $exclusive cluster-wide with one mnesia
+    # transaction (emqx_exclusive_subscription.erl try_subscribe).  Here
+    # the acquire is peer-confirmed: every live peer must accept the
+    # claim before the local subscribe proceeds.  Two nodes claiming the
+    # same topic concurrently can both be rejected (each sees the
+    # other's in-flight claim) — safe, never double-granted; the client
+    # simply retries.  Claims are purged on release, session teardown
+    # (via unsubscribe) and nodedown.
+
+    def _exclusive_try(self, topic: str, sid: str):
+        """Cluster acquire; returns the holding sid on conflict, else
+        None.  Runs OUTSIDE the broker lock (broker/broker.py)."""
+        with self._lock:
+            mine = self.exclusive_local.get(topic)
+            if mine is not None and mine != sid:
+                return mine
+            rh = self.exclusive_remote.get(topic)
+            if rh is not None and rh[0] != sid:
+                return rh[0]
+            # Register the in-flight claim BEFORE any RPC: a concurrent
+            # excl.try from another node must see it and reject (both
+            # claimants may mutually reject — safe; never double-grant).
+            self.exclusive_local[topic] = sid
+        accepted: list[str] = []
+        for peer in self.alive_peers():
+            try:
+                conflict = self.transport.call(
+                    peer, "excl.try", from_node=self.name,
+                    topic=topic, sid=sid)
+            except TransportError:
+                continue   # dead/flaky peer: its stale view of this
+                #            claim reconciles via the periodic excl.sync
+            if conflict is not None:
+                with self._lock:
+                    if self.exclusive_local.get(topic) == sid:
+                        del self.exclusive_local[topic]
+                for p in accepted:
+                    try:
+                        self.transport.cast(p, "excl.release",
+                                            from_node=self.name,
+                                            topic=topic, sid=sid)
+                    except TransportError:
+                        pass   # dangling claim on p GC'd by excl.sync
+                return conflict
+            accepted.append(peer)
+        return None
+
+    def _exclusive_release(self, topic: str, sid: str) -> None:
+        with self._lock:
+            if self.exclusive_local.get(topic) == sid:
+                del self.exclusive_local[topic]
+        self._broadcast("excl.release", topic=topic, sid=sid)
+
+    def _h_excl_try(self, from_node: str, topic: str, sid: str):
+        """Peer's side of the acquire: record the claim unless we know a
+        different holder.  Touches only our own state — never calls back
+        into the claimant (deadlock-free by construction)."""
+        with self._lock:
+            mine = self.exclusive_local.get(topic)
+            if mine is not None and mine != sid:
+                return mine
+            rh = self.exclusive_remote.get(topic)
+            if rh is not None and rh[0] != sid:
+                return rh[0]
+            self.exclusive_remote[topic] = (sid, from_node)
+        return None
+
+    def _h_excl_release(self, from_node: str, topic: str, sid: str) -> None:
+        with self._lock:
+            rh = self.exclusive_remote.get(topic)
+            if rh is not None and rh[0] == sid:
+                del self.exclusive_remote[topic]
+
+    def _h_excl_sync(self, from_node: str, holders: list) -> None:
+        """Authoritative claim set from one node: drop every claim we
+        attribute to that node that it no longer asserts (GC for claims
+        orphaned by lost release casts / timed-out acquires)."""
+        asserted = {(h["topic"], h["sid"]) for h in holders}
+        with self._lock:
+            stale = [t for t, (s, n) in self.exclusive_remote.items()
+                     if n == from_node and (t, s) not in asserted]
+            for t in stale:
+                del self.exclusive_remote[t]
+            for h in holders:
+                self.exclusive_remote.setdefault(
+                    h["topic"], (h["sid"], from_node))
 
     # -- clientid registry + takeover ---------------------------------------
 

@@ -96,27 +96,52 @@ class RedisClient:
         self._sock.sendall(encode_command(args))
         return self._reader.read()
 
+    # Commands that are safe to resend after an ambiguous failure (the
+    # request may or may not have executed server-side).  Write commands
+    # (LPUSH/INCR/SET...) are excluded: a reply-phase drop after the
+    # request was written would make a blind retry execute them twice.
+    _IDEMPOTENT = frozenset({
+        "PING", "GET", "MGET", "EXISTS", "TYPE", "TTL", "PTTL", "STRLEN",
+        "HGET", "HMGET", "HGETALL", "HLEN", "HEXISTS", "HKEYS", "HVALS",
+        "LRANGE", "LLEN", "LINDEX", "SMEMBERS", "SISMEMBER", "SCARD",
+        "ZRANGE", "ZSCORE", "ZCARD", "KEYS", "SCAN", "INFO", "TIME",
+        "SELECT", "AUTH",
+    })
+
     def command(self, args: list) -> Any:
         with self._lock:
             connecting = self._sock is None
             try:
                 if connecting:
-                    self._connect()
-                return self._do(args)
-            except (OSError, ConnectionError):
-                self.close()
-                if connecting:
-                    raise
-                # stale pooled connection (server restarted, idle drop):
-                # one fresh-connection retry before surfacing the error —
-                # otherwise a healthy backend still fails one request per
-                # connection drop (and authn maps that to a denial)
+                    try:
+                        self._connect()
+                    except (OSError, ConnectionError):
+                        self.close()
+                        raise
+                request_written = False
                 try:
-                    self._connect()
-                    return self._do(args)
-                except (OSError, ConnectionError, RedisError):
+                    self._sock.sendall(encode_command(args))
+                    request_written = True
+                    return self._reader.read()
+                except (OSError, ConnectionError):
                     self.close()
-                    raise
+                    if connecting:
+                        raise
+                    # Stale pooled connection (server restarted, idle
+                    # drop): retry once on a fresh connection — but only
+                    # when the failure provably preceded the request
+                    # (nothing written yet) or the command is idempotent.
+                    # A non-idempotent command that may already have
+                    # executed must surface the error to the caller.
+                    cmd = str(args[0]).upper() if args else ""
+                    if request_written and cmd not in self._IDEMPOTENT:
+                        raise
+                    try:
+                        self._connect()
+                        return self._do(args)
+                    except (OSError, ConnectionError, RedisError):
+                        self.close()
+                        raise
             except RedisError:
                 if connecting:
                     # handshake rejection (AUTH/SELECT error, -LOADING):

@@ -166,7 +166,11 @@ class NativeHost:
         if not self._h:
             raise OSError(f"cannot bind {host}:{port}")
         self.port = self._lib.emqx_host_port(self._h)
-        self._buf = ctypes.create_string_buffer(1 << 20)
+        # The poll buffer must hold at least one whole event record: 13-byte
+        # header + payload up to max_size (a max-size PUBLISH frame).  A
+        # smaller buffer would leave host.cc unable to ever deliver that
+        # record, busy-spinning the poll thread forever.
+        self._buf = ctypes.create_string_buffer(max_size + 64)
 
     def poll(self, timeout_ms: int = 100) -> Iterator[tuple[int, int, bytes]]:
         """Yield ``(kind, conn_id, payload)`` events from one loop step."""

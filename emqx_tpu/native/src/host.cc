@@ -148,7 +148,20 @@ class Host {
     size_t written = 0;
     while (!events_.empty()) {
       const std::string& rec = events_.front();
-      if (written + rec.size() > cap) break;
+      if (written + rec.size() > cap) {
+        // A record larger than the caller's whole buffer can never be
+        // delivered; retaining it would busy-spin Poll forever.  Drop it
+        // and close the offending connection with an error event (which
+        // is small and will fit on a later call).
+        if (written == 0 && rec.size() > cap) {
+          uint64_t id;
+          memcpy(&id, rec.data() + 1, 8);
+          events_.pop_front();
+          if (id != kListenTag && id != kWakeTag) Drop(id, "oversized", true);
+          continue;
+        }
+        break;
+      }
       memcpy(buf + written, rec.data(), rec.size());
       written += rec.size();
       events_.pop_front();

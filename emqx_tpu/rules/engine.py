@@ -23,6 +23,7 @@ from emqx_tpu.core import topic as T
 from emqx_tpu.core.message import Message
 from emqx_tpu.observe.metrics import MetricsWorker
 from emqx_tpu.rules import events as EV
+from emqx_tpu.rules import funcs as rule_funcs
 from emqx_tpu.rules.runtime import apply_select, eval_expr
 from emqx_tpu.rules.sqlparser import Select, parse
 
@@ -101,6 +102,7 @@ class RuleEngine:
 
     def delete_rule(self, id: str) -> bool:
         self.metrics.clear_metrics(id)
+        rule_funcs.drop_rule_store(id)
         return self.rules.pop(id, None) is not None
 
     def get_rule(self, id: str) -> Optional[Rule]:
@@ -170,21 +172,28 @@ class RuleEngine:
 
     def _apply_rule(self, rule: Rule, columns: dict) -> None:
         self.metrics.inc(rule.id, "matched")
+        # kv_store_*/proc_dict_* funcs are scoped per rule (reference:
+        # the rule worker's process dictionary); the contextvar tells
+        # them whose store is active
+        ctx_token = rule_funcs.set_rule_context(rule.id)
         try:
-            results = apply_select(rule.select, columns)
-        except Exception:
-            log.exception("rule %s SQL failed", rule.id)
-            self.metrics.inc(rule.id, "failed")
-            self.metrics.inc(rule.id, "failed.exception")
-            return
-        if results is None:
-            self.metrics.inc(rule.id, "failed")
-            self.metrics.inc(rule.id, "failed.no_result")
-            return
-        self.metrics.inc(rule.id, "passed")
-        for res in results:
-            for action in rule.actions:
-                self._run_action(rule, action, res)
+            try:
+                results = apply_select(rule.select, columns)
+            except Exception:
+                log.exception("rule %s SQL failed", rule.id)
+                self.metrics.inc(rule.id, "failed")
+                self.metrics.inc(rule.id, "failed.exception")
+                return
+            if results is None:
+                self.metrics.inc(rule.id, "failed")
+                self.metrics.inc(rule.id, "failed.no_result")
+                return
+            self.metrics.inc(rule.id, "passed")
+            for res in results:
+                for action in rule.actions:
+                    self._run_action(rule, action, res)
+        finally:
+            rule_funcs.reset_rule_context(ctx_token)
 
     def _run_action(self, rule: Rule, action: dict, columns: dict) -> None:
         self.metrics.inc(rule.id, "actions.total")

@@ -362,23 +362,54 @@ FUNCS["time_unit"] = lambda u: {"second": 1, "millisecond": 1000,
 
 
 # -- per-rule kv store (emqx_rule_funcs kv_store_* / proc_dict_*) -----------
+#
+# The reference scopes the store per rule (the rule's worker process
+# dictionary); a process-global dict would let rules collide on keys and
+# grow without bound.  The engine sets the active rule id around each
+# apply (engine.py) via a contextvar; keys are bounded per rule with
+# oldest-first eviction.
 
-_KV_STORE: dict = {}
+import contextvars
+
+_RULE_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "emqx_rule_id", default="")
+_KV_STORE: dict = {}          # rule_id → {key: value}
+_KV_MAX_KEYS = 10_000
+
+
+def set_rule_context(rule_id):
+    """Returns a token for reset_rule_context (used by the engine)."""
+    return _RULE_CTX.set(rule_id)
+
+
+def reset_rule_context(token) -> None:
+    _RULE_CTX.reset(token)
+
+
+def drop_rule_store(rule_id) -> None:
+    _KV_STORE.pop(rule_id, None)
+
+
+def _kv() -> dict:
+    return _KV_STORE.setdefault(_RULE_CTX.get(), {})
 
 
 @f("kv_store_put")
 def _kv_store_put(k, v):
-    _KV_STORE[_str(k)] = v
+    d = _kv()
+    k = _str(k)
+    if len(d) >= _KV_MAX_KEYS and k not in d:
+        d.pop(next(iter(d)))          # evict oldest insertion
+    d[k] = v
     return v
 
 
-FUNCS["kv_store_get"] = lambda k, default=None: _KV_STORE.get(
-    _str(k), default)
+FUNCS["kv_store_get"] = lambda k, default=None: _kv().get(_str(k), default)
 
 
 @f("kv_store_del")
 def _kv_store_del(k):
-    _KV_STORE.pop(_str(k), None)
+    _kv().pop(_str(k), None)
     return None
 FUNCS["proc_dict_put"] = FUNCS["kv_store_put"]
 FUNCS["proc_dict_get"] = FUNCS["kv_store_get"]

@@ -54,6 +54,9 @@ def test_bpapi_snapshot_frozen():
         "broker_v1": {"dispatch": ["filter", "msg"]},
         "cm_v1": {"kick": ["clientid"], "lookup": ["clientid"],
                   "takeover": ["clientid"]},
+        "excl_v1": {"release": ["from_node", "topic", "sid"],
+                    "sync": ["from_node", "holders"],
+                    "try": ["from_node", "topic", "sid"]},
         "node_v1": {"bye": ["node"], "hello": ["node", "versions"],
                     "ping": ["node"]},
         "rlog_v1": {"apply_deltas": ["from_node", "deltas"],
@@ -305,3 +308,67 @@ def test_tcp_handler_may_issue_blocking_calls():
     finally:
         t1.close()
         t2.close()
+
+
+# -- $exclusive across nodes ------------------------------------------------
+
+def test_exclusive_subscription_cluster_wide():
+    """A client on node2 cannot take an $exclusive topic a node1 client
+    holds (emqx_exclusive_subscription's cluster-wide transaction);
+    unsubscribe releases it everywhere."""
+    from emqx_tpu.broker.broker import ExclusiveLocked
+    from emqx_tpu.core.message import SubOpts
+
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    try:
+        n1.app.broker.subscribe(
+            "c1", "$exclusive/t/1", SubOpts(exclusive=True))
+        sync(nodes)
+        with pytest.raises(ExclusiveLocked):
+            n2.app.broker.subscribe(
+                "c2", "$exclusive/t/1", SubOpts(exclusive=True))
+        # release on node1 → node2 can take it
+        n1.app.broker.unsubscribe("c1", "$exclusive/t/1")
+        sync(nodes)
+        n2.app.broker.subscribe(
+            "c2", "$exclusive/t/1", SubOpts(exclusive=True))
+    finally:
+        stop(nodes)
+
+
+def test_exclusive_released_on_nodedown():
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    try:
+        n1.app.broker.subscribe(
+            "c1", "$exclusive/t/2", SubOpts(exclusive=True))
+        sync(nodes)
+        assert n2.exclusive_remote["$exclusive/t/2"][0] == "c1"
+        n2._nodedown("node1")
+        assert "$exclusive/t/2" not in n2.exclusive_remote
+        n2.app.broker.subscribe(
+            "c2", "$exclusive/t/2", SubOpts(exclusive=True))
+    finally:
+        stop(nodes)
+
+
+def test_exclusive_visible_to_late_joiner():
+    """Bootstrap snapshot carries exclusive holders to a fresh node."""
+    from emqx_tpu.broker.broker import ExclusiveLocked
+    from emqx_tpu.cluster.node import ClusterNode
+
+    nodes = make_cluster(2)
+    try:
+        nodes[0].app.broker.subscribe(
+            "c1", "$exclusive/t/3", SubOpts(exclusive=True))
+        sync(nodes)
+        n3 = ClusterNode(
+            "node3", LocalBus("node3", nodes[0].transport.fabric))
+        n3.join(["node1"])
+        nodes.append(n3)
+        with pytest.raises(ExclusiveLocked):
+            n3.app.broker.subscribe(
+                "c9", "$exclusive/t/3", SubOpts(exclusive=True))
+    finally:
+        stop(nodes)

@@ -165,3 +165,55 @@ def test_funcs_fix_regressions():
     utc = FUNCS["format_date"]("second", "+00:00", "%H", 3600 * 5)
     plus8 = FUNCS["format_date"]("second", "+08:00", "%H", 3600 * 5)
     assert (int(plus8) - int(utc)) % 24 == 8
+
+
+def test_nonidempotent_command_not_resent_after_reply_drop():
+    """A write command whose connection dies AFTER the request was
+    written must surface the error instead of silently re-executing
+    (ADVICE: LPUSH/INCR could run twice server-side)."""
+    import socket as socket_mod
+
+    from emqx_tpu.connector.redis import RedisClient
+
+    # server that answers the first command on each connection (so the
+    # client holds an ESTABLISHED pooled connection), then drops the
+    # second one after reading it but before replying — the ambiguous
+    # failure window where the request may have executed server-side
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    import threading
+
+    incr_seen = {"n": 0}
+
+    def serve():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                data = c.recv(4096)              # first command: reply
+                if b"INCR" in data:
+                    incr_seen["n"] += 1
+                c.sendall(b"+PONG\r\n")
+                data = c.recv(4096)              # second: read, drop
+                if b"INCR" in data:
+                    incr_seen["n"] += 1
+            except OSError:
+                pass
+            c.close()
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    try:
+        cli = RedisClient("127.0.0.1", port, timeout_s=2.0)
+        assert cli.command(["PING"]) == "PONG"   # connection established
+        with pytest.raises((OSError, ConnectionError)):
+            cli.command(["INCR", "counter"])
+        # exactly one INCR reached a server socket — no blind resend on
+        # a fresh connection
+        assert incr_seen["n"] == 1
+    finally:
+        srv.close()

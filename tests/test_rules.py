@@ -280,3 +280,40 @@ def test_builtin_funcs_long_tail_via_sql():
     assert got and got[0]["band"] == 8 and got[0]["m"] == 1
     assert got[0]["hit"] is True and got[0]["nested"] == 42
     assert len(got[0]["h"]) == 64
+
+
+def test_kv_store_scoped_per_rule(engine_and_broker=None):
+    """kv_store_*/proc_dict_* are namespaced per rule (the reference
+    scopes them to the rule worker's process dictionary) — two rules
+    using the same key must not collide."""
+    from emqx_tpu.rules import funcs as F
+
+    t1 = F.set_rule_context("rule_a")
+    try:
+        F.FUNCS["kv_store_put"]("k", 1)
+        assert F.FUNCS["kv_store_get"]("k") == 1
+    finally:
+        F.reset_rule_context(t1)
+    t2 = F.set_rule_context("rule_b")
+    try:
+        assert F.FUNCS["kv_store_get"]("k") is None
+        F.FUNCS["kv_store_put"]("k", 2)
+        assert F.FUNCS["kv_store_get"]("k") == 2
+    finally:
+        F.reset_rule_context(t2)
+    F.drop_rule_store("rule_a")
+    F.drop_rule_store("rule_b")
+
+
+def test_kv_store_bounded():
+    from emqx_tpu.rules import funcs as F
+
+    tok = F.set_rule_context("rule_bound")
+    try:
+        for i in range(F._KV_MAX_KEYS + 50):
+            F.FUNCS["kv_store_put"](f"k{i}", i)
+        assert len(F._KV_STORE["rule_bound"]) == F._KV_MAX_KEYS
+        assert F.FUNCS["kv_store_get"]("k0") is None      # evicted oldest
+    finally:
+        F.reset_rule_context(tok)
+        F.drop_rule_store("rule_bound")

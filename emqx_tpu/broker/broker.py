@@ -141,6 +141,7 @@ class Broker:
         group, real_topic = T.parse_share(topic)
         if group:
             opts = SubOpts(**{**opts.__dict__, "share": group})
+        cluster_claimed = False
         if (not group and getattr(opts, "exclusive", False)
                 and self.exclusive_try_fn is not None):
             # Cluster-wide acquire BEFORE the broker lock: the try fn does
@@ -149,6 +150,25 @@ class Broker:
             remote_holder = self.exclusive_try_fn(topic, sid)
             if remote_holder is not None:
                 raise ExclusiveLocked(topic, remote_holder)
+            cluster_claimed = True
+        try:
+            is_new = self._subscribe_locked(sid, topic, opts, group,
+                                            real_topic)
+        except BaseException:
+            # ANY failure after the cluster claim (a local holder beat us,
+            # an invalid filter, a model slot error) must roll the claim
+            # back or it leaks cluster-wide forever (excl.sync would keep
+            # re-asserting it); release runs OUTSIDE the broker lock (the
+            # broadcast does peer IO)
+            if cluster_claimed and self.exclusive_release_fn is not None:
+                self.exclusive_release_fn(topic, sid)
+            raise
+        # is_new lets rh=1 (send-retained-if-new) distinguish resubscribes
+        if not restore:
+            self.hooks.run("session.subscribed", (sid, topic, opts, is_new))
+
+    def _subscribe_locked(self, sid: Sid, topic: str, opts: SubOpts,
+                          group, real_topic: str) -> bool:
         with self._lock:
             if not group and getattr(opts, "exclusive", False):
                 # subscription already carries the real (stripped) topic;
@@ -156,10 +176,6 @@ class Broker:
                 # emqx_exclusive_subscription.erl)
                 holder = self.exclusive.get(topic)
                 if holder is not None and holder != sid:
-                    if self.exclusive_release_fn is not None:
-                        # roll back the cluster claim made above — a local
-                        # subscriber beat us between try_fn and the lock
-                        self.exclusive_release_fn(topic, sid)
                     raise ExclusiveLocked(topic, holder)
                 self.exclusive[topic] = sid
             key = (sid, topic)
@@ -185,12 +201,11 @@ class Broker:
                         slot = self.slots.get_or_assign(sid)
                         self._ensure_model_capacity()
                         self.model.subscribe(real_topic, slot)
-        # is_new lets rh=1 (send-retained-if-new) distinguish resubscribes
-        if not restore:
-            self.hooks.run("session.subscribed", (sid, topic, opts, is_new))
+            return is_new
 
     def unsubscribe(self, sid: Sid, topic: str) -> bool:
         group, real_topic = T.parse_share(topic)
+        release_exclusive = False
         with self._lock:
             opts = self.suboption.pop((sid, topic), None)
             if opts is None:
@@ -198,8 +213,10 @@ class Broker:
             if (getattr(opts, "exclusive", False)
                     and self.exclusive.get(topic) == sid):
                 del self.exclusive[topic]
-                if self.exclusive_release_fn is not None:
-                    self.exclusive_release_fn(topic, sid)
+                # the cluster release broadcast does peer IO — deferred
+                # to after the lock (a slow peer must not stall every
+                # subscribe/unsubscribe on the node)
+                release_exclusive = self.exclusive_release_fn is not None
             self.subscription.get(sid, set()).discard(topic)
             subs_key = real_topic if not group else topic
             subs = self.subscriber.get(subs_key)
@@ -221,18 +238,29 @@ class Broker:
                     slot = self.slots.lookup_slot(sid)
                     if slot is not None:
                         self.model.unsubscribe(real_topic, slot)
+        if release_exclusive:
+            self.exclusive_release_fn(topic, sid)
         self.hooks.run("session.unsubscribed", (sid, topic))
         return True
 
     def subscriber_down(self, sid: Sid) -> int:
-        """Batch-clean a dead subscriber (emqx_broker.erl:361-383)."""
+        """Batch-clean a dead subscriber (emqx_broker.erl:361-383).
+        Snapshot-then-unsubscribe: each unsubscribe takes the lock itself
+        so the exclusive release / hook legs run outside it.  The final
+        teardown is conditional — a concurrent re-subscribe for the same
+        sid (reconnect racing the old session's expiry) must keep its
+        fresh subscription set and slot."""
         with self._lock:
             topics = list(self.subscription.get(sid, ()))
-            for topic in topics:
-                self.unsubscribe(sid, topic)
-            self.subscription.pop(sid, None)
-            self.slots.release(sid)
-            return len(topics)
+        for topic in topics:
+            self.unsubscribe(sid, topic)
+        with self._lock:
+            remaining = self.subscription.get(sid)
+            if remaining is not None and not remaining:
+                self.subscription.pop(sid, None)
+            if not self.subscription.get(sid):
+                self.slots.release(sid)
+        return len(topics)
 
     def subscriptions(self, sid: Sid) -> list[tuple[str, SubOpts]]:
         with self._lock:
@@ -266,15 +294,18 @@ class Broker:
         msgs = [
             self.hooks.run_fold("message.publish", (), m) for m in msgs
         ]
-        live = [
-            (i, m) for i, m in enumerate(msgs)
-            if m is not None and m.headers.get("allow_publish") is not False
-        ]
+        live = []
+        for i, m in enumerate(msgs):
+            if m is None or m.headers.get("allow_publish") is False:
+                self._inc("messages.dropped")     # same as publish()
+            else:
+                live.append((i, m))
         out: list[dict[Sid, list[tuple[str, Message]]]] = [{} for _ in msgs]
         if not live:
             return out
         if self.model is None:
             for i, m in live:
+                self._inc("messages.publish")
                 out[i] = self._route(m.topic, m)
             return out
         matched, slots, fallback = self.model.publish_batch(
@@ -294,8 +325,15 @@ class Broker:
                 for filt in matched[j]:
                     if (sid, filt) in self.suboption:
                         deliveries.setdefault(sid, []).append((filt, m))
+                        self._inc("messages.delivered")
             # shared groups + remote nodes still come from the route table
-            self._dispatch_nonlocal(m.topic, m, deliveries)
+            nonlocal_legs = self._dispatch_nonlocal(m.topic, m, deliveries)
+            if not matched[j] and not nonlocal_legs:
+                # hook/metric parity with the host path (_route): rules on
+                # $events/message_dropped and dashboards keep working with
+                # the device router enabled
+                self._inc("messages.dropped.no_subscribers")
+                self.hooks.run("message.dropped", (m, "no_subscribers"))
             out[i] = deliveries
         return out
 
@@ -341,16 +379,19 @@ class Broker:
     def _dispatch_nonlocal(
         self, topic: str, msg: Message,
         deliveries: dict[Sid, list[tuple[str, Message]]],
-    ) -> None:
+    ) -> int:
         """Shared-group + remote legs for the device path (the bitmap only
-        covers local direct subscribers)."""
+        covers local direct subscribers).  Returns the number of nonlocal
+        route legs taken (0 ⇒ message had no nonlocal audience)."""
         seen_groups = set()
+        legs = 0
         for route in self.router.match_routes(topic):
             dest = route.dest
             if isinstance(dest, tuple):
                 group = dest[0]
                 if (group, route.topic) not in seen_groups:
                     seen_groups.add((group, route.topic))
+                    legs += 1
                     if self.shared_dispatch is not None:
                         for sid, sub_topic in self.shared_dispatch(
                             group, route.topic, msg
@@ -359,3 +400,5 @@ class Broker:
             elif dest != self.node and self.forward_fn is not None:
                 self.forward_fn(dest, route.topic, msg)
                 self._inc("messages.forward")
+                legs += 1
+        return legs

@@ -61,6 +61,7 @@ class Channel:
         session_opts: Optional[dict] = None,
         mountpoint: str = "",
         send=None,
+        publish_sink=None,
     ) -> None:
         self.broker = broker
         self.cm = cm
@@ -78,6 +79,10 @@ class Channel:
         # accumulate in outbox for the host to drain
         self.outbox: list[P.Packet] = []
         self._send = send if send is not None else self.outbox.extend
+        # device-path seam: when the host wires a PublishPipeline sink,
+        # publishes coalesce into batched kernel launches instead of the
+        # per-message host walk (broker/pipeline.py)
+        self.publish_sink = publish_sink
         self.pending_will_at: Optional[int] = None   # MQTT5 will-delay
 
     def send(self, pkts: list[P.Packet]) -> None:
@@ -86,7 +91,12 @@ class Channel:
 
     def _publish_and_dispatch(self, msg: Message) -> None:
         """Publish + fan deliveries out to the target channels' sockets
-        (the process-boundary send in the reference, emqx_broker.erl:546)."""
+        (the process-boundary send in the reference, emqx_broker.erl:546).
+        With a publish_sink, the message joins the next device batch; acks
+        don't depend on fan-out, so the FSM's replies are unchanged."""
+        if self.publish_sink is not None:
+            self.publish_sink(msg)
+            return
         deliveries = self.broker.publish(msg)
         self.cm.dispatch(deliveries)
 

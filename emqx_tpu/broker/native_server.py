@@ -35,10 +35,12 @@ class _NativeConn:
     def __init__(self, server: "NativeBrokerServer", conn_id: int, peer: str):
         self.server = server
         self.conn_id = conn_id
+        pipeline = server.pipeline
         self.channel = Channel(
             server.broker, server.cm,
             mountpoint=server.mountpoint,
             send=self._send_packets,
+            publish_sink=pipeline.submit if pipeline is not None else None,
         )
         self.channel.conninfo.peername = peer
 
@@ -83,6 +85,9 @@ class NativeBrokerServer:
         self._thread: Optional[threading.Thread] = None
         self._last_housekeep = time.monotonic()
         self._tick_running = threading.Event()
+        # device serving path: one poll step's PUBLISHes coalesce into
+        # one kernel launch (the epoll batch IS the {active,N} batch)
+        self.pipeline = getattr(app, "pipeline", None)
         # one long-lived worker for app.tick() — spawning a thread per
         # housekeep cycle would churn an OS thread every few seconds
         self._tick_pool = ThreadPoolExecutor(
@@ -103,6 +108,8 @@ class NativeBrokerServer:
                 conn = self.conns.pop(conn_id, None)
                 if conn is not None:
                     conn.channel.terminate(payload.decode("ascii", "replace"))
+        if self.pipeline is not None:
+            self.pipeline.flush()
         now = time.monotonic()
         if now - self._last_housekeep >= HOUSEKEEP_INTERVAL:
             self._last_housekeep = now

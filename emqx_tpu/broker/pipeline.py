@@ -1,0 +1,143 @@
+"""PublishPipeline — the {active,N}-style coalescing stage that puts the
+device router on the LIVE serving path.
+
+The reference's hot loop is one trie walk per message inside the
+publishing client's process (emqx_broker.erl:218-232 via
+emqx_connection.erl:132's ``{active,N}`` socket batching).  The TPU-era
+shape inverts it: connections *submit* publishes into a queue; a single
+flusher drains whatever accumulated — while the previous device step was
+in flight — into one ``Broker.publish_batch`` kernel launch, then fans
+the merged deliveries out through the CM.  Batch assembly overlaps
+device execution exactly like ``{active,N}`` overlaps socket reads with
+dispatch (SURVEY.md §2.5-6 pipeline parallelism).
+
+Correctness notes:
+
+- per-publisher ordering: FIFO queue + in-order batch results ⇒ a
+  client's publishes deliver in submission order (the reference's
+  per-connection ordering guarantee);
+- acks don't wait: QoS1/2 acks depend only on local session state, not
+  on delivery fan-out (same as the reference, where PUBACK is sent as
+  soon as ``emqx_broker:publish/1`` returns and the actual subscriber
+  sends are async process messages);
+- hooks (`message.publish` fold: rules, retainer, delayed...) run at
+  flush time inside ``publish_batch`` — same hook surface, same order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import deque
+from typing import Optional
+
+from emqx_tpu.core.message import Message
+
+log = logging.getLogger("emqx_tpu.pipeline")
+
+
+class PublishPipeline:
+    """Thread-safe publish coalescer over ``Broker.publish_batch``.
+
+    Servers wire ``submit`` as the channels' ``publish_sink``; the
+    asyncio host runs ``flusher()`` as a background task, the native
+    host calls ``flush()`` after each poll step.
+    """
+
+    def __init__(self, broker, cm, max_batch: int = 512) -> None:
+        self.broker = broker
+        self.cm = cm
+        self.max_batch = max_batch
+        self._q: deque[Message] = deque()
+        self._lock = threading.Lock()
+        # serializes concurrent consumers (the flusher task's to_thread
+        # flush vs. stop()'s final drain): batches must never interleave
+        # or race the model's donated device buffers
+        self._consumer_lock = threading.Lock()
+        self._flusher_task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._wake: Optional[asyncio.Event] = None
+        self.batches = 0          # flush count (≈ kernel launches)
+        self.published = 0
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, msg: Message) -> None:
+        with self._lock:
+            self._q.append(msg)
+        wake, loop = self._wake, self._loop
+        if wake is not None and loop is not None:
+            try:
+                if asyncio.get_running_loop() is loop:
+                    wake.set()
+                    return
+            except RuntimeError:
+                pass
+            try:
+                loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                pass          # loop closed; stop()'s final flush drains
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    # -- consumer side ------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain the queue in ≤max_batch launches; returns messages
+        flushed.  Safe from multiple consumer threads (serialized)."""
+        total = 0
+        with self._consumer_lock:
+            while True:
+                with self._lock:
+                    if not self._q:
+                        return total
+                    batch = [
+                        self._q.popleft()
+                        for _ in range(min(len(self._q), self.max_batch))]
+                results = self.broker.publish_batch(batch)
+                self.batches += 1
+                total += len(batch)
+                self.published += len(batch)
+                merged: dict[str, list] = {}
+                for d in results:
+                    for sid, items in d.items():
+                        merged.setdefault(sid, []).extend(items)
+                if merged:
+                    self.cm.dispatch(merged)
+
+    def ensure_flusher(self) -> asyncio.Task:
+        """Start (or adopt) the ONE flusher task for the running loop.
+        The pipeline owns the task — several listeners sharing one app
+        (tcp + ws) must not each spawn/cancel their own flusher, or one
+        listener's shutdown would orphan the others' deliveries."""
+        loop = asyncio.get_running_loop()
+        if (self._flusher_task is None or self._flusher_task.done()
+                or self._loop is not loop):
+            self._loop = loop
+            self._wake = asyncio.Event()
+            self._flusher_task = loop.create_task(self.flusher())
+        return self._flusher_task
+
+    async def flusher(self) -> None:
+        """Asyncio consumer: wake on submit, drain off-loop (the device
+        step blocks a thread, not the accept loop; submissions landing
+        during a flush coalesce into the next batch — the overlap).
+        A failing batch is logged and dropped — one poisoned message (a
+        raising hook, a device error) must not kill delivery forever."""
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
+        if self._wake is None:
+            self._wake = asyncio.Event()
+        wake = self._wake
+        while True:
+            await wake.wait()
+            wake.clear()
+            try:
+                await asyncio.to_thread(self.flush)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("publish flush failed; batch dropped")

@@ -44,10 +44,12 @@ class Connection:
         peer = writer.get_extra_info("peername") or ("?", 0)
         self.parser = Parser(max_size=server.max_packet_size)
         self.limiter = server.make_limiter_container()
+        pipeline = getattr(server, "pipeline", None)
         self.channel = Channel(
             server.broker, server.cm,
             mountpoint=server.mountpoint,
             send=self._send_packets,
+            publish_sink=pipeline.submit if pipeline is not None else None,
         )
         self.channel.conninfo.peername = f"{peer[0]}:{peer[1]}"
         self.metrics = getattr(server.app, "metrics", None)
@@ -199,8 +201,12 @@ class BrokerServer:
         self.connections: set[Connection] = set()
         self.limiter = limiter          # LimiterServer | None
         self.listener_id = listener_id
+        # device serving path: batch publishes through the app's pipeline
+        # when the router model is configured (router.device.enable)
+        self.pipeline = getattr(app, "pipeline", None)
         self._server: Optional[asyncio.AbstractServer] = None
         self._housekeeper: Optional[asyncio.Task] = None
+        self._flusher: Optional[asyncio.Task] = None
 
     def make_limiter_container(self):
         from emqx_tpu.broker.limiter import LimiterContainer
@@ -234,6 +240,10 @@ class BrokerServer:
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         self._housekeeper = asyncio.create_task(self._housekeep_loop())
+        if self.pipeline is not None:
+            # the pipeline owns ONE flusher per loop, shared by every
+            # listener on the same app (tcp + ws)
+            self._flusher = self.pipeline.ensure_flusher()
         log.info("listening on %s:%d", self.host, self.port)
 
     async def _housekeep_loop(self) -> None:
@@ -262,6 +272,11 @@ class BrokerServer:
     async def stop(self) -> None:
         if self._housekeeper:
             self._housekeeper.cancel()
+        if self.pipeline is not None and self.pipeline.pending():
+            # final drain; flush() serializes with any in-flight flusher
+            # run, and the shared flusher task is NOT cancelled here —
+            # other listeners on this app may still be serving
+            await asyncio.to_thread(self.pipeline.flush)
         for conn in list(self.connections):
             await conn.close("server_shutdown")
         if self._server:

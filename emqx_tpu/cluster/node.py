@@ -58,6 +58,7 @@ class ClusterNode:
         # BEFORE the peer RPC so a concurrent claim from another node
         # sees it in _h_excl_try (mutual-reject, never double-grant)
         self.exclusive_local: dict[str, str] = {}
+        self._excl_sync_was_nonempty = False
         self.members: dict[str, dict] = {}        # peer → {alive, missed}
         self._peer_cursor: dict[str, int] = {}    # peer → flushed seq
         self.heartbeat_misses = heartbeat_misses
@@ -186,7 +187,12 @@ class ClusterNode:
         with self._lock:
             holders = [{"topic": t, "sid": s}
                        for t, s in self.exclusive_local.items()]
-        self._broadcast("excl.sync", holders=holders)
+        # claim reconciliation: skip the broadcast while the feature is
+        # idle (one final empty sync after the last claim disappears is
+        # all the GC needs — steady-state O(nodes²) chatter otherwise)
+        if holders or self._excl_sync_was_nonempty:
+            self._broadcast("excl.sync", holders=holders)
+        self._excl_sync_was_nonempty = bool(holders)
         with self._lock:
             peers = list(self.members)
         for peer in peers:

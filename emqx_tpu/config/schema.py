@@ -225,6 +225,19 @@ def root_schema() -> Struct:
             "enable": Field("bool", default=True),
             "max_delayed_messages": Field("int", default=0),
         }),
+        "router": Struct({
+            # the TPU device router on the serving path: subscriptions
+            # compile into the HBM trie + subscriber bitmaps; publishes
+            # coalesce into batched match kernel launches
+            "device": Struct({
+                "enable": Field("bool", default=False),
+                "n_sub_slots": Field("int", default=1024),
+                "batch_max": Field("int", default=512),
+                "max_levels": Field("int", default=16),
+                "frontier_k": Field("int", default=32),
+                "match_cap": Field("int", default=128),
+            }),
+        }),
         "shared_subscription_strategy": Field(
             "enum", enum=["random", "round_robin", "round_robin_per_group",
                           "sticky", "local", "hash_clientid", "hash_topic"],

@@ -18,6 +18,7 @@ small [B, M] tensor along tp) so fan-out can keep W sharded over tp.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -61,12 +62,55 @@ def router_step(
     return fids, out, counts, overflow | truncated
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _apply_patches(trie: tm.DeviceTrie, bm: jax.Array,
+                   tupd: dict, bm_upd: tuple) -> tuple:
+    """ONE dispatch applying every pending element update to the donated
+    HBM buffers (XLA reuses the donated allocations, so the work is
+    O(#updates), not O(table); one launch keeps the subscribe→routable
+    path at a single host→device round trip)."""
+    new = {}
+    for name in tm.DeviceTrie._fields:
+        arr = getattr(trie, name)
+        idx, vals = tupd[name]
+        new[name] = arr.at[idx].set(vals)
+    rows, cols, vals = bm_upd
+    return tm.DeviceTrie(**new), bm.at[rows, cols].set(vals)
+
+
+def _patch_bucket(n: int) -> int:
+    """Shared pad size for ALL update vectors of one _apply_patches call:
+    a 4×-stepped ladder so the jit compiles a handful of variants total
+    (per-array pow2 pads would make the cross product of shapes explode
+    into a fresh ~100ms compile almost every refresh — measured)."""
+    cap = 64
+    while cap < n:
+        cap *= 4
+    return cap
+
+
+def _pad_to(cap: int, idx: np.ndarray, vals: np.ndarray):
+    """Pad update vectors to cap by repeating the first element —
+    a duplicate scatter of an identical value is a no-op."""
+    pad = cap - len(idx)
+    return (np.concatenate([idx, np.repeat(idx[:1], pad)]),
+            np.concatenate([vals, np.repeat(vals[:1], pad)]))
+
+
 class RouterModel:
     """Host wrapper: TrieIndex + subscriber bitmaps + the jitted step.
 
     The broker layer registers subscribers into per-filter bitmap rows
     (slot = subscriber id from the connection manager); ``publish_batch``
     tokenizes topics, runs the device step, and reports matches.
+
+    Mutations are applied to the device arrays *incrementally*: the
+    TrieIndex patches its host arrays in place and records dirty indices;
+    ``refresh`` scatters just those elements into HBM with donated jits
+    (subscribe→routable is O(topic-depth)).  A full re-upload happens
+    only when the index signals structural growth (``needs_rebuild``) or
+    the bitmap capacity changes — the emqx_trie.erl:113-144 incremental
+    insert/delete semantics, device-resident.
     """
 
     def __init__(
@@ -84,9 +128,23 @@ class RouterModel:
         self.mesh = mesh
         self.shardings = pmesh.router_shardings(mesh) if mesh else None
         self._subs: dict[int, set[int]] = {}      # fid -> subscriber slots
+        # One lock over index mutation, pending-update drain, device
+        # refresh AND the step launch: subscribes arrive on the server's
+        # event-loop thread while the pipeline flushes on a worker
+        # thread — an unsynchronized drain could scatter a half-applied
+        # insert (torn trie) into HBM, and a refresh mid-launch would
+        # donate away buffers the step still reads.  The serialization
+        # mirrors the reference's per-topic router_pool discipline
+        # (emqx_router.erl:200-204) at model granularity.
+        self._mlock = threading.RLock()
         self._trie_dev: Optional[tm.DeviceTrie] = None
         self._bitmaps_dev: Optional[jax.Array] = None
+        self._bm_host: Optional[np.ndarray] = None   # [F_cap, W] uint32
+        self._bm_dirty: set[tuple[int, int]] = set() # dirty (fid, word)
         self._dirty = True
+        self.upload_count = 0      # full device uploads (test/obs hook)
+        self.patch_count = 0       # incremental scatter flushes
+        self.launch_count = 0      # publish_batch kernel launches
         self._step = jax.jit(
             functools.partial(
                 router_step,
@@ -104,26 +162,41 @@ class RouterModel:
             raise ValueError(
                 f"subscriber slot {slot} out of range [0, {self.n_sub_slots})"
             )
-        fid = self.index.insert(filt)
-        slots = self._subs.setdefault(fid, set())
-        if slot not in slots:
-            slots.add(slot)
-            self._dirty = True
-        return fid
+        with self._mlock:
+            fid = self.index.insert(filt)
+            slots = self._subs.setdefault(fid, set())
+            if slot not in slots:
+                slots.add(slot)
+                self._set_bit(fid, slot, on=True)
+                self._dirty = True
+            return fid
 
     def unsubscribe(self, filt: str, slot: int) -> None:
-        fid = self.index.fid_of(filt)
-        if fid is None:
-            return
-        slots = self._subs.get(fid)
-        if slots and slot in slots:
-            slots.discard(slot)
-            if not slots:
-                self._subs.pop(fid, None)
-                self.index.delete(filt)
-            self._dirty = True
+        with self._mlock:
+            fid = self.index.fid_of(filt)
+            if fid is None:
+                return
+            slots = self._subs.get(fid)
+            if slots and slot in slots:
+                slots.discard(slot)
+                self._set_bit(fid, slot, on=False)
+                if not slots:
+                    self._subs.pop(fid, None)
+                    self.index.delete(filt)
+                self._dirty = True
 
-    # -- device refresh (double-buffered full rebuild, round-1 policy) -----
+    def _set_bit(self, fid: int, slot: int, *, on: bool) -> None:
+        bm = self._bm_host
+        if bm is None or fid >= bm.shape[0] or slot // 32 >= bm.shape[1]:
+            self._bm_host = None          # capacity growth → full rebuild
+            return
+        if on:
+            bm[fid, slot // 32] |= np.uint32(1) << np.uint32(slot % 32)
+        else:
+            bm[fid, slot // 32] &= ~(np.uint32(1) << np.uint32(slot % 32))
+        self._bm_dirty.add((fid, slot // 32))
+
+    # -- device refresh ----------------------------------------------------
 
     @property
     def bitmap_words(self) -> int:
@@ -131,7 +204,12 @@ class RouterModel:
 
     def build_bitmaps(self) -> np.ndarray:
         W = self.bitmap_words
-        F = max(1, len(self.index.filters))   # fid slots incl. freelist holes
+        # capacity rows beyond the live fid range so freshly-inserted
+        # filters land inside the allocated bitmap
+        live = max(1, len(self.index.filters))
+        F = 64
+        while F < live + live // 2:
+            F *= 2
         bm = np.zeros((F, W), np.uint32)
         if self._subs:
             fids = np.fromiter(
@@ -147,15 +225,71 @@ class RouterModel:
         return bm
 
     def refresh(self) -> None:
-        arrays = self.index.ensure()
-        trie_dev = tm.device_trie(arrays)
-        bitmaps = self.build_bitmaps()
-        if self.shardings is not None:
-            trie_dev = jax.device_put(trie_dev, self.shardings["replicated"])
-            bitmaps = jax.device_put(bitmaps, self.shardings["bitmaps"])
-        else:
-            bitmaps = jnp.asarray(bitmaps)
-        self._trie_dev, self._bitmaps_dev = trie_dev, bitmaps
+        """Bring the device arrays up to date: one fused scatter dispatch
+        when possible, full upload on structural growth."""
+        with self._mlock:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        full_trie = (self.index.needs_rebuild or self.index.arrays is None
+                     or self._trie_dev is None)
+        if full_trie:
+            arrays = self.index.ensure()
+            trie_dev = tm.device_trie(arrays)
+            if self.shardings is not None:
+                trie_dev = jax.device_put(
+                    trie_dev, self.shardings["replicated"])
+            self._trie_dev = trie_dev
+            self.index.drain_updates()    # superseded by the upload
+            self.upload_count += 1
+
+        full_bm = (self._bm_host is None
+                   or self._bitmaps_dev is None
+                   or self._bm_host.shape[1] != self.bitmap_words)
+        if full_bm:
+            self._bm_host = self.build_bitmaps()
+            bitmaps = self._bm_host
+            if self.shardings is not None:
+                bitmaps = jax.device_put(bitmaps, self.shardings["bitmaps"])
+            else:
+                bitmaps = jnp.asarray(bitmaps)
+            self._bitmaps_dev = bitmaps
+            self._bm_dirty.clear()
+
+        updates = {} if full_trie else self.index.drain_updates()
+        bm_dirty = [] if full_bm else sorted(self._bm_dirty)
+        if updates or bm_dirty:
+            cap = _patch_bucket(max(
+                max((len(v) for v in updates.values()), default=0),
+                len(bm_dirty)))
+            arrays = self.index.arrays
+            tupd = {}
+            for name in tm.DeviceTrie._fields:
+                idxs = updates.get(name)
+                host = getattr(arrays, name)
+                if idxs:
+                    idx = np.asarray(idxs, np.int32)
+                else:
+                    idx = np.zeros(1, np.int32)    # no-op self-write
+                vals = host[idx]
+                idx, vals = _pad_to(cap, idx, vals)
+                tupd[name] = (jnp.asarray(idx), jnp.asarray(vals))
+            if bm_dirty:
+                rows = np.asarray([r for r, _ in bm_dirty], np.int32)
+                cols = np.asarray([c for _, c in bm_dirty], np.int32)
+            else:
+                rows = np.zeros(1, np.int32)
+                cols = np.zeros(1, np.int32)
+            vals = self._bm_host[rows, cols]
+            # pad rows/cols/vals with the SAME (row0, col0, val0) triple:
+            # a duplicate write of the identical value is a no-op
+            rows, vals = _pad_to(cap, rows, vals)
+            cols, _ = _pad_to(cap, cols, cols)
+            self._trie_dev, self._bitmaps_dev = _apply_patches(
+                self._trie_dev, self._bitmaps_dev, tupd,
+                (jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals)))
+            self._bm_dirty.clear()
+            self.patch_count += 1
         self._dirty = False
 
     # -- the hot path ------------------------------------------------------
@@ -167,8 +301,13 @@ class RouterModel:
         Topics flagged overflow/too-long fall back to the host oracle path
         upstream (router.match_filters) — reported via the third element.
         """
+        with self._mlock:
+            return self._publish_batch_locked(topics)
+
+    def _publish_batch_locked(self, topics: Sequence[str]):
         if self._dirty or self._trie_dev is None:
-            self.refresh()
+            self._refresh_locked()
+        self.launch_count += 1
         n = len(topics)
         # pad the batch to a pow2 bucket (≥64) — keeps the set of compiled
         # program shapes small, the {active,N}-style batching discipline

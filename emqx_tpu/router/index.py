@@ -34,11 +34,33 @@ emitted either as ``hash_fid`` at exactly one (node, depth) or as
 ``node_fid`` at exactly one node at end-of-topic; trie nodes are a tree, so
 a frontier never contains the same node twice ⇒ every matching filter id is
 emitted exactly once per topic.
+
+Incremental maintenance (emqx_trie.erl:113-144 — O(topic-depth) insert
+and delete, the BASELINE.json north-star sentence)
+---------------------------------------------------------------------
+The numpy arrays ARE the trie: ``insert``/``delete`` walk them directly
+and patch in place —
+
+- insert appends nodes into pre-allocated capacity (arrays are built
+  with ~1.5× headroom and every slot pre-initialised to -1, so a fresh
+  node needs **no** device write), claims free edge-table slots within
+  the probe bound, and sets the terminal fid;
+- delete clears the terminal fid only.  Edges/nodes of dead paths stay
+  as garbage until the next compaction — they match nothing (fid = -1)
+  and removing them eagerly would need probe-chain repair.  ``garbage``
+  counts them so the owner can ``rebuild()`` opportunistically.
+
+Every patched index is recorded in ``pending`` (array-name → dirty
+indices); the device owner (models.RouterModel) drains it and scatters
+just those elements into HBM with a donated jit — subscribe→routable is
+O(topic-depth), not O(table).  Structural growth (node capacity, edge
+load > 50%, probe-bound overflow) flips ``needs_rebuild`` and the next
+``ensure()`` does a double-buffered full rebuild with fresh headroom.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import numpy as np
@@ -70,7 +92,10 @@ def edge_hash(parent: np.ndarray, word: np.ndarray, mask: int) -> np.ndarray:
 
 @dataclass
 class TrieIndexArrays:
-    """The device-side arrays (numpy here; moved to HBM by the matcher)."""
+    """The device-side arrays (numpy here; moved to HBM by the matcher).
+
+    Arrays are allocated at CAPACITY (≥ live size) so in-place appends
+    need no realloc; ``n_nodes`` is the live node count."""
 
     ht_parent: np.ndarray
     ht_word: np.ndarray
@@ -84,24 +109,28 @@ class TrieIndexArrays:
 
 
 class TrieIndex:
-    """Host-side builder: filters → interned vocab + flat trie arrays.
-
-    Built from ``Router.snapshot_filters()`` (full rebuild) or patched via
-    ``insert``/``delete`` then ``rebuild()`` — round-1 policy is
-    double-buffered full rebuilds (cheap: one linear pass over filters);
-    true in-place device deltas are a later optimisation, the refcount
-    bookkeeping for them already lives in the host ``Trie``.
-    """
+    """Host-side builder + incremental maintainer: filters → interned
+    vocab + flat trie arrays, patched in place per mutation (see module
+    docstring)."""
 
     def __init__(self, max_levels: int = 16, max_probes: int = 8) -> None:
         self.max_levels = max_levels
         self.max_probes = max_probes
         self.vocab: dict[str, int] = {}
-        self.filters: list[str] = []       # fid -> filter string
+        self.filters: list[Optional[str]] = []   # fid -> filter string
         self._filter_ids: dict[str, int] = {}
         self._free_fids: list[int] = []
         self.arrays: Optional[TrieIndexArrays] = None
-        self._dirty = True
+        self.n_nodes = 0
+        self.n_edges = 0
+        self.garbage = 0          # deletes since last rebuild (dead paths)
+        self.needs_rebuild = True
+        self.rebuild_count = 0    # observability + test hook
+        # array-name → set of dirty indices awaiting device scatter
+        self.pending: dict[str, set[int]] = {
+            "ht_parent": set(), "ht_word": set(), "ht_child": set(),
+            "plus_child": set(), "hash_fid": set(), "node_fid": set(),
+        }
 
     # -- vocab -------------------------------------------------------------
 
@@ -125,7 +154,8 @@ class TrieIndex:
         return self._filter_ids.get(filt)
 
     def insert(self, filt: str) -> int:
-        """Register a filter, return its stable fid."""
+        """Register a filter, return its stable fid.  O(topic-depth)
+        in-place patch unless a rebuild is already pending."""
         if not T.validate_filter(filt):
             # same guard as Router.add_route: an invalid filter (e.g.
             # 'a/#/b') would be silently truncated at '#' by rebuild() and
@@ -141,10 +171,13 @@ class TrieIndex:
             fid = len(self.filters)
             self.filters.append(filt)
         self._filter_ids[filt] = fid
-        for w in T.words(filt):
-            if w not in (T.PLUS, T.HASH):
-                self.intern(w)
-        self._dirty = True
+        if not self.needs_rebuild and self.arrays is not None:
+            self._insert_arrays(filt, fid)
+        else:
+            self.needs_rebuild = True
+            for w in T.words(filt):
+                if w not in (T.PLUS, T.HASH):
+                    self.intern(w)
         return fid
 
     def delete(self, filt: str) -> Optional[int]:
@@ -153,17 +186,129 @@ class TrieIndex:
             return None
         self.filters[fid] = None
         self._free_fids.append(fid)
-        self._dirty = True
+        if not self.needs_rebuild and self.arrays is not None:
+            self._delete_arrays(filt, fid)
+            self.garbage += 1
         return fid
 
     def load(self, filters: Sequence[str]) -> None:
         for f in filters:
             self.insert(f)
 
+    # -- incremental array patching ---------------------------------------
+
+    def _mark(self, name: str, idx: int) -> None:
+        self.pending[name].add(idx)
+
+    def _new_node(self) -> Optional[int]:
+        a = self.arrays
+        if self.n_nodes >= a.plus_child.shape[0]:
+            self.needs_rebuild = True
+            return None
+        idx = self.n_nodes
+        self.n_nodes = idx + 1
+        a.n_nodes = self.n_nodes
+        # plus/hash/node entries are pre-initialised -1 on host AND
+        # device, so a fresh node costs zero writes
+        return idx
+
+    def _ht_find(self, parent: int, wid: int
+                 ) -> tuple[Optional[int], Optional[int]]:
+        """(child, free_slot): child if the edge exists, else the first
+        free slot within the probe bound (None, None = no room)."""
+        a = self.arrays
+        mask = a.ht_parent.shape[0] - 1
+        slot = int(edge_hash(np.int32(parent), np.int32(wid), mask))
+        for p in range(self.max_probes):
+            s = (slot + p) & mask
+            sp = int(a.ht_parent[s])
+            if sp == -1:
+                return None, s
+            if sp == parent and int(a.ht_word[s]) == wid:
+                return int(a.ht_child[s]), None
+        return None, None
+
+    def _insert_arrays(self, filt: str, fid: int) -> None:
+        a = self.arrays
+        node = 0
+        for w in T.words(filt):
+            if w == T.HASH:           # '#' is terminal: fold to parent
+                a.hash_fid[node] = fid
+                self._mark("hash_fid", node)
+                a.n_filters = len(self.filters)
+                return
+            if w == T.PLUS:
+                c = int(a.plus_child[node])
+                if c == -1:
+                    c = self._new_node()
+                    if c is None:
+                        return              # rebuild pending
+                    a.plus_child[node] = c
+                    self._mark("plus_child", node)
+                node = c
+            else:
+                wid = self.intern(w)
+                child, free = self._ht_find(node, wid)
+                if child is None:
+                    c = self._new_node()
+                    if c is None:
+                        return
+                    if free is None:        # probe bound full here
+                        self.needs_rebuild = True
+                        return
+                    a.ht_parent[free] = node
+                    a.ht_word[free] = wid
+                    a.ht_child[free] = c
+                    for nm in ("ht_parent", "ht_word", "ht_child"):
+                        self._mark(nm, free)
+                    self.n_edges += 1
+                    if 2 * self.n_edges > a.ht_parent.shape[0]:
+                        # >50% load: grow at the NEXT ensure(); this
+                        # insert itself is already placed and valid
+                        self.needs_rebuild = True
+                    node = c
+                else:
+                    node = child
+        a.node_fid[node] = fid
+        self._mark("node_fid", node)
+        a.n_filters = len(self.filters)
+
+    def _delete_arrays(self, filt: str, fid: int) -> None:
+        a = self.arrays
+        node = 0
+        for w in T.words(filt):
+            if w == T.HASH:
+                if int(a.hash_fid[node]) == fid:
+                    a.hash_fid[node] = -1
+                    self._mark("hash_fid", node)
+                return
+            if w == T.PLUS:
+                node = int(a.plus_child[node])
+            else:
+                wid = self.vocab.get(w)
+                if wid is None:
+                    return                  # never inserted ⇒ no-op
+                node, _ = self._ht_find(node, wid)  # type: ignore
+            if node is None or node < 0:
+                return                      # path absent (defensive)
+        if int(a.node_fid[node]) == fid:
+            a.node_fid[node] = -1
+            self._mark("node_fid", node)
+
+    def drain_updates(self) -> dict[str, list[int]]:
+        """Dirty indices per array since the last drain (values live in
+        ``self.arrays``); clears the pending sets."""
+        out = {k: sorted(v) for k, v in self.pending.items() if v}
+        for v in self.pending.values():
+            v.clear()
+        return out
+
     # -- build -------------------------------------------------------------
 
     def rebuild(self) -> TrieIndexArrays:
-        """One linear pass over filters → flat arrays."""
+        """Double-buffered full rebuild: one linear pass over filters →
+        fresh flat arrays with ~1.5× node headroom and ≤25% edge-table
+        load (so the next growth rebuild is a long way off)."""
         # 1. build a pointer trie over word ids
         children: list[dict[int, int]] = [{}]   # node -> {word_id: child}
         plus: list[int] = [-1]
@@ -202,6 +347,9 @@ class TrieIndex:
             else:
                 nodef[node] = fid
         n_nodes = len(children)
+        cap = 64
+        while cap < n_nodes + n_nodes // 2:
+            cap *= 2
 
         # 2. open-addressed edge table, grown until probe bound holds
         size = 64
@@ -232,22 +380,33 @@ class TrieIndex:
                 break
             size *= 2
 
+        def padded(src: list[int]) -> np.ndarray:
+            out = np.full(cap, -1, np.int32)
+            out[:n_nodes] = src
+            return out
+
         self.arrays = TrieIndexArrays(
             ht_parent=ht_parent,
             ht_word=ht_word,
             ht_child=ht_child,
-            plus_child=np.asarray(plus, np.int32),
-            hash_fid=np.asarray(hashf, np.int32),
-            node_fid=np.asarray(nodef, np.int32),
+            plus_child=padded(plus),
+            hash_fid=padded(hashf),
+            node_fid=padded(nodef),
             n_nodes=n_nodes,
             n_filters=len(self.filters),
             max_probes=self.max_probes,
         )
-        self._dirty = False
+        self.n_nodes = n_nodes
+        self.n_edges = n_edges
+        self.garbage = 0
+        self.needs_rebuild = False
+        self.rebuild_count += 1
+        for v in self.pending.values():      # superseded by the rebuild
+            v.clear()
         return self.arrays
 
     def ensure(self) -> TrieIndexArrays:
-        if self._dirty or self.arrays is None:
+        if self.needs_rebuild or self.arrays is None:
             return self.rebuild()
         return self.arrays
 

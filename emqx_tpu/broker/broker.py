@@ -36,14 +36,29 @@ Sid = str  # subscriber id (session/clientid)
 
 
 class SlotRegistry:
-    """sid ↔ bitmap-slot allocation with recycling."""
+    """sid ↔ bitmap-slot allocation over a FIXED shard space.
 
-    def __init__(self, capacity: int = 1024):
+    The emqx_broker_helper.erl:55,82-92 discipline, TPU-shaped: while
+    unique slots remain, each sid owns one (exact decode, no false
+    positives); past capacity, new sids hash into the same [0, capacity)
+    space and a slot becomes a subscriber *shard* — decode filters
+    candidates through the suboption table.  Capacity never grows, so
+    the device-side structures are fixed-size at 10M subscribers and no
+    capacity-doubling rebuild stall exists (round-1 weak #4)."""
+
+    def __init__(self, capacity: int = 8192):
         self.capacity = capacity
         self._slot_of: dict[Sid, int] = {}
-        self._sid_of: dict[int, Sid] = {}
+        self._sids_of: dict[int, set[Sid]] = {}
         self._free: list[int] = []
         self._next = 0
+
+    @staticmethod
+    def _hash(sid: Sid) -> int:
+        # stable across processes (phash2 analogue); Python's hash() is
+        # salted per-process and would break cluster-symmetric decode
+        import zlib
+        return zlib.crc32(sid.encode() if isinstance(sid, str) else sid)
 
     def get_or_assign(self, sid: Sid) -> int:
         slot = self._slot_of.get(sid)
@@ -51,17 +66,18 @@ class SlotRegistry:
             return slot
         if self._free:
             slot = self._free.pop()
-        else:
+        elif self._next < self.capacity:
             slot = self._next
             self._next += 1
-            while slot >= self.capacity:
-                self.capacity *= 2   # RouterModel rebuilds bitmaps lazily
+        else:
+            slot = self._hash(sid) % self.capacity
         self._slot_of[sid] = slot
-        self._sid_of[slot] = sid
+        self._sids_of.setdefault(slot, set()).add(sid)
         return slot
 
-    def lookup_sid(self, slot: int) -> Optional[Sid]:
-        return self._sid_of.get(slot)
+    def lookup_sids(self, slot: int):
+        """All sids sharing the slot (1 in the unique regime)."""
+        return self._sids_of.get(slot, ())
 
     def lookup_slot(self, sid: Sid) -> Optional[int]:
         return self._slot_of.get(sid)
@@ -69,8 +85,12 @@ class SlotRegistry:
     def release(self, sid: Sid) -> Optional[int]:
         slot = self._slot_of.pop(sid, None)
         if slot is not None:
-            del self._sid_of[slot]
-            self._free.append(slot)
+            sids = self._sids_of.get(slot)
+            if sids is not None:
+                sids.discard(sid)
+                if not sids:
+                    del self._sids_of[slot]
+                    self._free.append(slot)
         return slot
 
     def slot_count(self) -> int:
@@ -105,7 +125,9 @@ class Broker:
         self.model = router_model
         self.forward_fn = forward_fn
         self.shared_dispatch = shared_dispatch
-        self.slots = SlotRegistry()
+        self.slots = SlotRegistry(
+            capacity=router_model.n_sub_slots
+            if router_model is not None else 8192)
         self._lock = threading.RLock()
         self.suboption: dict[tuple[Sid, str], SubOpts] = {}
         self.subscription: dict[Sid, set[str]] = {}
@@ -199,7 +221,6 @@ class Broker:
                         self.router.add_route(real_topic, self.node)
                     if self.model is not None:
                         slot = self.slots.get_or_assign(sid)
-                        self._ensure_model_capacity()
                         self.model.subscribe(real_topic, slot)
             return is_new
 
@@ -269,11 +290,6 @@ class Broker:
                 for t in self.subscription.get(sid, ())
             ]
 
-    def _ensure_model_capacity(self) -> None:
-        if self.model is not None and self.slots.capacity > self.model.n_sub_slots:
-            self.model.n_sub_slots = self.slots.capacity
-            self.model._dirty = True
-
     # -- publish (emqx_broker.erl:218-232) ----------------------------------
 
     def publish(self, msg: Message) -> dict[Sid, list[tuple[str, Message]]]:
@@ -319,13 +335,11 @@ class Broker:
                 continue
             deliveries: dict[Sid, list[tuple[str, Message]]] = {}
             for slot in slots[j]:
-                sid = self.slots.lookup_sid(slot)
-                if sid is None:
-                    continue
-                for filt in matched[j]:
-                    if (sid, filt) in self.suboption:
-                        deliveries.setdefault(sid, []).append((filt, m))
-                        self._inc("messages.delivered")
+                for sid in self.slots.lookup_sids(slot):
+                    for filt in matched[j]:
+                        if (sid, filt) in self.suboption:
+                            deliveries.setdefault(sid, []).append((filt, m))
+                            self._inc("messages.delivered")
             # shared groups + remote nodes still come from the route table
             nonlocal_legs = self._dispatch_nonlocal(m.topic, m, deliveries)
             if not matched[j] and not nonlocal_legs:

@@ -49,6 +49,38 @@ def fanout_bitmaps(bitmaps: jax.Array, fids: jax.Array) -> jax.Array:
 
 
 @jax.jit
+def fanout_pool(rowmap: jax.Array, pool: jax.Array,
+                fids: jax.Array) -> jax.Array:
+    """Hybrid fan-out: OR the DENSE-POOL rows of matched filters.
+
+    rowmap: [F] int32 — fid → pool row, -1 for low-degree filters (their
+            slots decode host-side from the subscription table; storing a
+            dense row per filter would cost F·W words — 16 GB at 10M
+            filters — where the pool costs P·W for the few high-degree
+            broadcast filters that actually need bitmap aggregation).
+    pool:   [P, W] uint32 — subscriber-shard bitmaps, W shardable over tp.
+    fids:   [B, M] int32, -1 padding.
+    returns: [B, W] uint32 — shard slots contributed by dense filters.
+    """
+    B, M = fids.shape
+    W = pool.shape[1]
+    valid = fids >= 0
+    safe = jnp.where(valid, fids, 0)
+    rows = jnp.where(valid, rowmap[safe], -1)          # [B, M]
+    has = rows >= 0
+    safe_rows = jnp.where(has, rows, 0)
+
+    def step(acc, xs):
+        r, v = xs                                       # [B], [B]
+        gathered = jax.lax.optimization_barrier(pool[r])    # [B, W]
+        return acc | jnp.where(v[:, None], gathered, jnp.uint32(0)), None
+
+    init = jnp.zeros((B, W), jnp.uint32)
+    out, _ = jax.lax.scan(step, init, (safe_rows.T, has.T))
+    return out
+
+
+@jax.jit
 def bitmap_to_counts(fanout: jax.Array) -> jax.Array:
     """Population count per topic: number of matched subscriber slots."""
     # popcount via uint8 view-free nibble trick (XLA has population_count)

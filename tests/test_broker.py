@@ -52,15 +52,34 @@ def test_hooks_put_replaces_and_delete():
 # -- slot registry ----------------------------------------------------------
 
 def test_slot_registry_recycling():
-    r = SlotRegistry(capacity=2)
+    r = SlotRegistry(capacity=4)
     s1, s2 = r.get_or_assign("a"), r.get_or_assign("b")
     assert {s1, s2} == {0, 1}
     assert r.get_or_assign("a") == s1
     r.release("a")
-    assert r.lookup_sid(s1) is None
+    assert list(r.lookup_sids(s1)) == []
     assert r.get_or_assign("c") == s1   # recycled
     r.get_or_assign("d")
-    assert r.capacity == 4              # grew
+    assert r.capacity == 4              # FIXED — never grows
+
+
+def test_slot_registry_shards_past_capacity():
+    """Past capacity, sids hash into the fixed shard space and a slot
+    holds several candidates (emqx_broker_helper sharding analogue)."""
+    r = SlotRegistry(capacity=4)
+    sids = [f"client-{i}" for i in range(20)]
+    slots = [r.get_or_assign(s) for s in sids]
+    assert all(0 <= s < 4 for s in slots)
+    assert r.capacity == 4
+    # every sid is findable through its slot
+    for sid, slot in zip(sids, slots):
+        assert sid in r.lookup_sids(slot)
+    # release keeps co-tenants intact
+    r.release(sids[10])
+    assert sids[10] not in r.lookup_sids(slots[10])
+    for sid, slot in zip(sids, slots):
+        if sid != sids[10]:
+            assert sid in r.lookup_sids(slot)
 
 
 # -- pub/sub ----------------------------------------------------------------

@@ -36,6 +36,13 @@ import time
 
 import numpy as np
 
+if os.environ.get("JAX_PLATFORMS"):
+    # the axon sitecustomize force-registers the TPU platform via
+    # jax.config.update, which beats the env var — honour an explicit
+    # JAX_PLATFORMS so the bench can be verified off-TPU
+    import jax as _jax
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)

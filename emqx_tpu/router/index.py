@@ -451,7 +451,8 @@ class TrieIndex:
                 if w == T.HASH:
                     hash_pos[i] = j
                     break
-                toks[i, j] = (PLUS_ID if w == T.PLUS else vocab[w])
+                if j < L:
+                    toks[i, j] = (PLUS_ID if w == T.PLUS else vocab[w])
         eff_len = np.where(hash_pos >= 0, hash_pos, lengths)
 
         cur = np.zeros(F, np.int64)           # current node per filter
@@ -484,9 +485,14 @@ class TrieIndex:
         node_fid = np.full(cap, -1, np.int32)
         for rp, rc in plus_edges:
             plus_child[rp] = rc
-        has_hash = hash_pos >= 0
+        # terminals beyond depth L are unreachable from the device matcher
+        # (topics deeper than max_levels take the host-oracle fallback in
+        # tokenize()), so — like the scalar builder's deeper-than-L nodes —
+        # they are simply not marked; marking them at the truncated depth-L
+        # node would create FALSE matches for depth-L topics
+        has_hash = (hash_pos >= 0) & (hash_pos <= L)
         hash_fid[cur[has_hash]] = live_fids[has_hash]
-        ends = (~has_hash) & (lengths <= L)
+        ends = (hash_pos < 0) & (lengths <= L)
         node_fid[cur[ends]] = live_fids[ends]
 
         ep = np.concatenate([e[0] for e in exact_edges]) \
@@ -523,8 +529,10 @@ class TrieIndex:
                 ht_word[uslot] = ew[winners]
                 ht_child[uslot] = ec[winners]
                 placed = np.zeros(len(unplaced), bool)
-                placed[free] = np.isin(cs, uslot) & (
-                    ht_child[s] == ec[unplaced])
+                # a candidate is placed iff its slot now holds its own
+                # child id (child ids are unique per edge, so equality
+                # identifies the winner; losers retry at the next probe)
+                placed[free] = ht_child[cs] == ec[cand]
                 unplaced = unplaced[~placed]
             else:
                 ok = len(unplaced) == 0

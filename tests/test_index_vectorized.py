@@ -1,0 +1,149 @@
+"""Scalar vs vectorized TrieIndex builder equivalence.
+
+The vectorized level-synchronous builder (router/index.py
+``_rebuild_vectorized``) only engages above ``VECTOR_BUILD_MIN`` live
+filters — above every other test's scale — so it gets its own direct
+coverage here: both builders must produce semantically identical tries
+(same match results for every topic) on randomized filter sets with
+collisions, across edge-table growth/probe-overflow, and at the real
+``VECTOR_BUILD_MIN`` engagement scale that the live serving path hits
+(mirrors emqx_trie.erl:113-144 insert/match semantics).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.core import topic as T
+from emqx_tpu.ops import trie_match as tm
+from emqx_tpu.router.index import TrieIndex
+from emqx_tpu.router.trie import Trie
+
+
+def random_filters(rng, n, alphabet, max_depth=7):
+    filters = set()
+    while len(filters) < n:
+        ws = [rng.choice(alphabet + ["+", "#"])
+              for _ in range(rng.randint(1, max_depth))]
+        if "#" in ws:
+            ws = ws[: ws.index("#") + 1]
+        f = T.join(ws)
+        if T.validate_filter(f):
+            filters.add(f)
+    return sorted(filters)
+
+
+def build_pair(filters, max_levels=10, max_probes=8):
+    """Same filter set through both builders."""
+    scalar = TrieIndex(max_levels=max_levels, max_probes=max_probes)
+    scalar.load(filters)
+    scalar._rebuild_scalar()
+    vec = TrieIndex(max_levels=max_levels, max_probes=max_probes)
+    vec.load(filters)
+    vec._rebuild_vectorized()
+    return scalar, vec
+
+
+def match_all(idx, topics, K=64):
+    dev = tm.device_trie(idx.arrays)
+    tokens, lengths, sys_flags, too_long = idx.tokenize(topics)
+    assert not too_long
+    cand, overflow = tm.match_batch(
+        dev, np.asarray(tokens), np.asarray(lengths),
+        np.asarray(sys_flags), K=K)
+    cand = np.asarray(cand)
+    out = []
+    for b in range(len(topics)):
+        fids = cand[b][cand[b] >= 0]
+        out.append(sorted(idx.filters[f] for f in fids))
+    return out, np.asarray(overflow)
+
+
+@pytest.mark.parametrize("seed,n_filters", [(11, 2_000), (12, 20_000)])
+def test_vectorized_equals_scalar_randomized(seed, n_filters):
+    """The r2 regression repro: 20k filters crashed ``_rebuild_vectorized``
+    with a numpy broadcast error the moment any probe slot was occupied
+    (router/index.py:526).  Equivalence is checked semantically — node
+    numbering differs between builders by design."""
+    rng = random.Random(seed)
+    alphabet = [f"w{i}" for i in range(40)] + ["", "a", "b"]
+    filters = random_filters(rng, n_filters, alphabet)
+    scalar, vec = build_pair(filters)
+
+    assert vec.n_nodes == scalar.n_nodes
+    assert vec.n_edges == scalar.n_edges
+
+    topics = []
+    for _ in range(512):
+        nw = [rng.choice(alphabet[:24] + ["zz"])
+              for _ in range(rng.randint(1, 8))]
+        topics.append(T.join(nw))
+    got_s, ov_s = match_all(scalar, topics, K=128)
+    got_v, ov_v = match_all(vec, topics, K=128)
+    for b, topic in enumerate(topics):
+        if ov_s[b] or ov_v[b]:
+            continue
+        assert got_s[b] == got_v[b], (topic, got_s[b], got_v[b])
+    assert (ov_s == ov_v).all()
+
+
+def test_vectorized_probe_overflow_grows_table():
+    """Tight probe bound forces collision handling through multiple probe
+    rounds and (usually) at least one table-growth retry — the loop the
+    broken `placed` bookkeeping corrupted."""
+    rng = random.Random(7)
+    alphabet = [f"n{i}" for i in range(300)]
+    filters = random_filters(rng, 5_000, alphabet, max_depth=5)
+    scalar, vec = build_pair(filters, max_probes=2)
+    topics = [T.join([rng.choice(alphabet)
+                      for _ in range(rng.randint(1, 5))])
+              for _ in range(256)]
+    got_s, _ = match_all(scalar, topics)
+    got_v, _ = match_all(vec, topics)
+    assert got_s == got_v
+
+
+def test_vectorized_engages_on_live_path():
+    """Above VECTOR_BUILD_MIN, rebuild() must take the vectorized path and
+    produce a usable trie (this is the ≥50k-live-filter state in which the
+    r2 device broker dropped every publish)."""
+    n = TrieIndex.VECTOR_BUILD_MIN
+    idx = TrieIndex(max_levels=10)
+    idx.load([f"fleet/{i}/+/telemetry" for i in range(n)])
+    arrays = idx.ensure()          # would raise before the fix
+    assert arrays.n_filters == n
+    got, overflow = match_all(idx, ["fleet/17/axle3/telemetry", "fleet/x/y"])
+    assert not overflow.any()
+    assert got[0] == ["fleet/17/+/telemetry"]
+    assert got[1] == []
+
+
+def test_vectorized_vs_oracle_with_deletes_and_overdepth():
+    """Vectorized build over a filter set containing over-depth filters
+    (deeper than max_levels — previously an IndexError) and post-build
+    incremental mutations must stay equivalent to the host oracle."""
+    rng = random.Random(3)
+    alphabet = ["a", "b", "c", "d", ""]
+    filters = random_filters(rng, 800, alphabet, max_depth=6)
+    deep = ["a/b/c/d/a/b/c/d/+", "a/b/c/d/a/b/c/d/e/#"]  # > max_levels=6
+    idx = TrieIndex(max_levels=6)
+    idx.load(filters + deep)
+    idx._rebuild_vectorized()
+
+    dropped = set(rng.sample(filters, 200))
+    for f in dropped:
+        idx.delete(f)
+    oracle = Trie()
+    for f in filters:
+        if f not in dropped:
+            oracle.insert(f)
+
+    topics = [T.join([rng.choice(alphabet[:4] + ["q"])
+                      for _ in range(rng.randint(1, 6))])
+              for _ in range(300)]
+    got, overflow = match_all(idx, topics, K=128)
+    for b, topic in enumerate(topics):
+        if overflow[b]:
+            continue
+        assert got[b] == sorted(oracle.match(topic)), topic

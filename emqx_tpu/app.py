@@ -128,6 +128,8 @@ class BrokerApp:
         self.monitor = DashboardMonitor(self)
         self.plugins = PluginManager(self, install_dir="plugins")
         self.sysmon = SysMon(self.alarms, olp=self.olp)
+        from emqx_tpu.broker.listeners import Listeners
+        self.listeners = Listeners(self)
 
         # hook wiring — delayed intercepts first (STOP), retainer observes
         self.delayed.attach(self.hooks, priority=100)

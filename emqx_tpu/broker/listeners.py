@@ -1,0 +1,142 @@
+"""Config-driven listener lifecycle — the ``emqx_listeners.erl`` start
+surface: the ``listeners`` config map (name → conf) becomes running
+tcp / ssl / ws / wss servers bound to one BrokerApp.
+
+Mirrors ``emqx_listeners:start/0`` → ``start_listener/3``
+(emqx_listeners.erl:189-238): tcp+ssl ride the stream listener
+(BrokerServer), ws+wss the websocket listener (WsBrokerServer); ssl/wss
+build an ``ssl.SSLContext`` from the listener's ``ssl_options`` (and the
+app's PskStore when ``enable_psk``). ``quic`` is an explicitly gated
+slot: the reference's quicer/msquic NIF has no stdlib counterpart, so a
+quic listener config is accepted by the schema but start raises with the
+descope reason rather than pretending to serve
+(emqx_quic_connection.erl — SURVEY §2.4 native-deps table).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from emqx_tpu.broker.server import BrokerServer
+from emqx_tpu.broker.ws import WsBrokerServer
+
+log = logging.getLogger("emqx_tpu.listeners")
+
+
+def parse_bind(bind: "str | int", default_port: int = 1883
+               ) -> tuple[str, int]:
+    """'0.0.0.0:1883' | ':1883' | '1883' | 1883 | '[::1]:1883'
+    → (host, port)."""
+    if isinstance(bind, int):
+        return "0.0.0.0", bind
+    s = str(bind).strip()
+    host, sep, port = s.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]                 # bracketed IPv6 literal
+    elif ":" in host:
+        # '::1' with no port — rpartition split inside the address
+        host, port = s.strip("[]"), ""
+    elif not sep and not port.isdigit():
+        host, port = port, ""             # bare hostname, default port
+    try:
+        return host or "0.0.0.0", int(port) if port else default_port
+    except ValueError:
+        raise ValueError(f"invalid listener bind {bind!r} "
+                         "(expected host:port, :port, or port)") from None
+
+
+def build_listener(app, name: str, conf: dict):
+    """One listener conf → an (unstarted) server object."""
+    ltype = conf.get("type", "tcp")
+    host, port = parse_bind(conf.get("bind", "0.0.0.0:1883"))
+    ssl_context = None
+    extra_ssl: dict = {}
+    if ltype in ("ssl", "wss"):
+        from emqx_tpu.broker import tls
+
+        psk_store = None
+        if conf.get("ssl_options", {}).get("enable_psk"):
+            psk_store = getattr(app, "psk", None)
+        ssl_context = tls.make_server_context(
+            conf.get("ssl_options", {}), psk_store=psk_store)
+        hs = conf.get("ssl_options", {}).get("handshake_timeout")
+        if hs:
+            extra_ssl = {"ssl_handshake_timeout": float(hs)}
+        else:
+            extra_ssl = {}
+    elif ltype == "quic":
+        raise NotImplementedError(
+            "quic listener: the reference rides the quicer/msquic C NIF; "
+            "no msquic binding ships in this build — use tcp/ssl/ws/wss "
+            "(config slot reserved, emqx_quic_connection.erl)")
+
+    def _ident(key: str) -> Optional[str]:
+        v = conf.get(key, "disabled")
+        return None if v in ("disabled", None, "") else v
+
+    kw = dict(
+        app=app,
+        host=host,
+        port=port,
+        max_connections=int(conf.get("max_connections", 1_000_000)),
+        mountpoint=conf.get("mountpoint", ""),
+        listener_id=f"{ltype}:{name}",
+        ssl_context=ssl_context,
+        **extra_ssl,
+        peer_cert_as_username=_ident("peer_cert_as_username"),
+        peer_cert_as_clientid=_ident("peer_cert_as_clientid"),
+        limiter=getattr(app, "limiter", None),
+    )
+    if ltype in ("ws", "wss"):
+        return WsBrokerServer(path=conf.get("websocket_path", "/mqtt"), **kw)
+    return BrokerServer(**kw)
+
+
+class Listeners:
+    """Supervisor for the app's listener set (start/stop/restart by id)."""
+
+    def __init__(self, app) -> None:
+        self.app = app
+        self.servers: dict[str, BrokerServer] = {}   # "type:name" → server
+
+    async def start_all(self, listeners_conf: dict) -> list[str]:
+        started = []
+        for name, conf in (listeners_conf or {}).items():
+            if not conf.get("enabled", True):
+                continue
+            server = build_listener(self.app, name, conf)
+            await server.start()
+            self.servers[server.listener_id] = server
+            started.append(server.listener_id)
+            log.info("listener %s on %s:%d%s", server.listener_id,
+                     server.host, server.port,
+                     " (tls)" if server.ssl_context else "")
+        return started
+
+    async def stop(self, listener_id: str) -> bool:
+        server = self.servers.pop(listener_id, None)
+        if server is None:
+            return False
+        await server.stop()
+        return True
+
+    async def stop_all(self) -> None:
+        for lid in list(self.servers):
+            await self.stop(lid)
+
+    def find(self, listener_id: str) -> Optional[BrokerServer]:
+        return self.servers.get(listener_id)
+
+    def info(self) -> list[dict]:
+        return [
+            {
+                "id": lid,
+                "type": lid.split(":", 1)[0],
+                "bind": f"{s.host}:{s.port}",
+                "running": s._server is not None,
+                "current_connections": len(s.connections),
+                "max_connections": s.max_connections,
+            }
+            for lid, s in self.servers.items()
+        ]

@@ -55,6 +55,13 @@ class Connection:
         self.metrics = getattr(server.app, "metrics", None)
         self.closed = False
         self._loop = asyncio.get_event_loop()
+        # TLS listeners: capture the handshake's peer certificate for
+        # cert-based identity (emqx_schema peer_cert_as_username|clientid)
+        self.cert_identity: dict = {}
+        if server.ssl_context is not None:
+            from emqx_tpu.broker.tls import peer_cert_identity
+            self.cert_identity = peer_cert_identity(
+                writer.get_extra_info("peercert"))
 
     def _transport_wrap(self, data: bytes) -> bytes:
         """Frame serialized MQTT bytes for the wire (identity for raw
@@ -111,6 +118,15 @@ class Connection:
             if pkt.type == P.CONNECT:
                 self.parser.set_version(pkt.proto_ver)
                 self.channel.conninfo.proto_ver = pkt.proto_ver
+                # TLS identity substitution happens at the listener, not
+                # the FSM — the channel sees the effective identity
+                # (emqx_channel.erl peer_cert_as_username handling)
+                sel = self.server.peer_cert_as_username
+                if sel and self.cert_identity.get(sel):
+                    pkt.username = self.cert_identity[sel]
+                sel = self.server.peer_cert_as_clientid
+                if sel and self.cert_identity.get(sel):
+                    pkt.clientid = self.cert_identity[sel]
             out = self.channel.handle_in(pkt)
             self._send_packets(out)
             if self.channel.conn_state == "disconnected":
@@ -186,6 +202,10 @@ class BrokerServer:
         app=None,
         limiter=None,
         listener_id: str = "tcp:default",
+        ssl_context=None,
+        ssl_handshake_timeout: Optional[float] = None,
+        peer_cert_as_username: Optional[str] = None,   # "cn" | "dn"
+        peer_cert_as_clientid: Optional[str] = None,
     ):
         if app is None and broker is None:
             from emqx_tpu.app import BrokerApp
@@ -201,6 +221,10 @@ class BrokerServer:
         self.connections: set[Connection] = set()
         self.limiter = limiter          # LimiterServer | None
         self.listener_id = listener_id
+        self.ssl_context = ssl_context  # ssl.SSLContext | None (ssl/wss)
+        self.ssl_handshake_timeout = ssl_handshake_timeout
+        self.peer_cert_as_username = peer_cert_as_username
+        self.peer_cert_as_clientid = peer_cert_as_clientid
         # device serving path: batch publishes through the app's pipeline
         # when the router model is configured (router.device.enable)
         self.pipeline = getattr(app, "pipeline", None)
@@ -234,8 +258,14 @@ class BrokerServer:
         await conn.run()
 
     async def start(self) -> None:
+        kw = {}
+        if self.ssl_context is not None and self.ssl_handshake_timeout:
+            # bound slow/stalled handshakes (esockd handshake_timeout;
+            # without this asyncio's 60s default governs)
+            kw["ssl_handshake_timeout"] = self.ssl_handshake_timeout
         self._server = await asyncio.start_server(
-            self._on_connect, self.host, self.port
+            self._on_connect, self.host, self.port,
+            ssl=self.ssl_context, **kw,
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]

@@ -86,6 +86,9 @@ class Field:
         elif t == "map":
             if not isinstance(val, dict):
                 raise SchemaError(path, f"expected object, got {val!r}")
+            if self.item is not None:      # value schema (e.g. listeners)
+                val = {k: self.item.check(v, f"{path}.{k}")
+                       for k, v in val.items()}
         else:
             raise SchemaError(path, f"unknown field type {t!r}")
         if self.validator is not None and not self.validator(val):
@@ -172,6 +175,26 @@ def mqtt_schema() -> Struct:
     })
 
 
+def ssl_options_schema() -> Struct:
+    """esockd ssl_options surface (emqx_listeners.erl:196-238,
+    emqx_schema.erl ssl defaults)."""
+    return Struct({
+        "certfile": Field("string", default=""),
+        "keyfile": Field("string", default=""),
+        "password": Field("string", default=""),
+        "cacertfile": Field("string", default=""),
+        "verify": Field("enum", enum=["verify_none", "verify_peer"],
+                        default="verify_none"),
+        "fail_if_no_peer_cert": Field("bool", default=False),
+        "versions": Field("array", default=["tlsv1.2", "tlsv1.3"],
+                          item=Field("enum", enum=[
+                              "tlsv1", "tlsv1.1", "tlsv1.2", "tlsv1.3"])),
+        "ciphers": Field("array", default=[], item=Field("string")),
+        "handshake_timeout": Field("duration", default=15.0),
+        "enable_psk": Field("bool", default=False),
+    }, open=True)
+
+
 def listener_schema() -> Struct:
     return Struct({
         "type": Field("enum", enum=["tcp", "ssl", "ws", "wss", "quic"],
@@ -182,6 +205,12 @@ def listener_schema() -> Struct:
         "mountpoint": Field("string", default=""),
         "zone": Field("string", default="default"),
         "proxy_protocol": Field("bool", default=False),
+        "websocket_path": Field("string", default="/mqtt"),
+        "peer_cert_as_username": Field(
+            "enum", enum=["disabled", "cn", "dn"], default="disabled"),
+        "peer_cert_as_clientid": Field(
+            "enum", enum=["disabled", "cn", "dn"], default="disabled"),
+        "ssl_options": ssl_options_schema(),
     }, open=True)
 
 
@@ -202,7 +231,8 @@ def root_schema() -> Struct:
         }, open=True),
         "mqtt": mqtt_schema(),
         "zones": Field("map", default={}),       # name → mqtt overrides
-        "listeners": Field("map", default={}),   # name → listener conf
+        # name → listener conf, each checked against listener_schema
+        "listeners": Field("map", default={}, item=listener_schema()),
         "authentication": Field("array", default=[], item=Field("map")),
         "authorization": Struct({
             "no_match": Field("enum", enum=["allow", "deny"],

@@ -18,8 +18,11 @@ class MqttClient:
                  username: Optional[str] = None,
                  password: Optional[bytes] = None,
                  properties: Optional[dict] = None,
-                 will: Optional[P.Connect] = None):
+                 will: Optional[P.Connect] = None,
+                 ssl=None, server_hostname: Optional[str] = None):
         self.host, self.port = host, port
+        self.ssl = ssl                  # ssl.SSLContext | None
+        self.server_hostname = server_hostname
         self.clientid = clientid
         self.proto_ver = proto_ver
         self.clean_start = clean_start
@@ -41,8 +44,12 @@ class MqttClient:
 
     async def connect(self, will_topic=None, will_payload=b"",
                       will_qos=0, timeout: float = 5.0) -> P.Connack:
+        kw = {}
+        if self.ssl is not None:
+            kw["ssl"] = self.ssl
+            kw["server_hostname"] = self.server_hostname or self.host
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host, self.port, **kw
         )
         self._recv_task = asyncio.create_task(self._recv_loop())
         await self._send(P.Connect(

@@ -63,8 +63,16 @@ def build_listener(app, name: str, conf: dict):
         hs = conf.get("ssl_options", {}).get("handshake_timeout")
         if hs:
             extra_ssl = {"ssl_handshake_timeout": float(hs)}
-        else:
-            extra_ssl = {}
+        if (conf.get("ssl_options", {}).get("verify", "verify_none")
+                != "verify_peer"
+                and any(conf.get(k) not in ("disabled", None, "")
+                        for k in ("peer_cert_as_username",
+                                  "peer_cert_as_clientid"))):
+            raise ValueError(
+                f"listener {name!r}: peer_cert_as_username/clientid "
+                "needs ssl_options.verify = verify_peer — without it "
+                "the server never requests a client certificate and "
+                "the cert identity would silently not apply")
     elif ltype == "quic":
         raise NotImplementedError(
             "quic listener: the reference rides the quicer/msquic C NIF; "
@@ -102,16 +110,23 @@ class Listeners:
 
     async def start_all(self, listeners_conf: dict) -> list[str]:
         started = []
-        for name, conf in (listeners_conf or {}).items():
-            if not conf.get("enabled", True):
-                continue
-            server = build_listener(self.app, name, conf)
-            await server.start()
-            self.servers[server.listener_id] = server
-            started.append(server.listener_id)
-            log.info("listener %s on %s:%d%s", server.listener_id,
-                     server.host, server.port,
-                     " (tls)" if server.ssl_context else "")
+        try:
+            for name, conf in (listeners_conf or {}).items():
+                if not conf.get("enabled", True):
+                    continue
+                server = build_listener(self.app, name, conf)
+                await server.start()
+                self.servers[server.listener_id] = server
+                started.append(server.listener_id)
+                log.info("listener %s on %s:%d%s", server.listener_id,
+                         server.host, server.port,
+                         " (tls)" if server.ssl_context else "")
+        except Exception:
+            # all-or-nothing boot: a half-started listener set would keep
+            # ports bound and make the retry fail with EADDRINUSE
+            for lid in started:
+                await self.stop(lid)
+            raise
         return started
 
     async def stop(self, listener_id: str) -> bool:

@@ -49,6 +49,15 @@ def _apply_versions(ctx: ssl.SSLContext, versions) -> None:
         raise ValueError(
             f"unknown TLS version(s) {unknown!r} in ssl_options.versions "
             f"(expected one of {sorted(_VERSIONS)})")
+    order = list(_VERSIONS)
+    idx = sorted(order.index(v.lower()) for v in versions)
+    if idx != list(range(idx[0], idx[-1] + 1)):
+        # SSLContext can only express a min/max range; a non-contiguous
+        # list ("tlsv1" + "tlsv1.3") would silently enable the versions
+        # in between — refuse rather than weaken the configured posture
+        raise ValueError(
+            f"non-contiguous TLS version list {sorted(versions)!r}: the "
+            "runtime enforces a continuous min..max range")
     vs = sorted(_VERSIONS[v.lower()] for v in versions)
     ctx.minimum_version = vs[0]
     ctx.maximum_version = vs[-1]

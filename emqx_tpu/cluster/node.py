@@ -41,8 +41,13 @@ from emqx_tpu.session.session import Session
 class ClusterNode:
     def __init__(self, name: str, transport: Transport,
                  app: Optional[BrokerApp] = None,
-                 heartbeat_misses: int = 2, **app_kw: Any) -> None:
+                 heartbeat_misses: int = 2,
+                 role: str = "core", **app_kw: Any) -> None:
         self.name = name
+        # mria core/replicant split (emqx_machine.erl:86-87): cores
+        # coordinate config txns and serve bootstrap; replicants
+        # forward writes and replicate
+        self.role = role
         self.transport = transport
         self.app = app or BrokerApp(node=name, forward_fn=self._forward,
                                     **app_kw)
@@ -81,6 +86,19 @@ class ClusterNode:
         t.register("node.ping", self._h_ping)
         t.register("node.bye", self._h_bye)
 
+        # cluster-replicated config transactions (emqx_cluster_rpc)
+        from emqx_tpu.cluster.conf import ClusterConf
+        self.conf = ClusterConf(self)
+        t.register("conf.append", self.conf.h_append)
+        t.register("conf.commit", self.conf.h_commit)
+        t.register("conf.catchup", self.conf.h_catchup)
+        t.register("conf.status", self.conf.h_status)
+        config = getattr(self.app, "config", None)
+        if config is not None:
+            # PUT /configs (and every cluster-layer Config.put) becomes
+            # a cluster-wide transaction
+            config.cluster_fn = self.conf.multicall
+
         hooks = self.app.hooks
         hooks.add("session.subscribed", self._on_subscribed, priority=-500)
         hooks.add("session.unsubscribed", self._on_unsubscribed,
@@ -110,11 +128,11 @@ class ClusterNode:
             try:
                 resp = self.transport.call(
                     seed, "node.hello", node=self.name,
-                    versions=bpapi.supported_versions())
+                    versions=bpapi.supported_versions(), role=self.role)
             except TransportError:
                 continue
             bpapi.negotiate(resp["versions"], "rlog")    # compat gate
-            self._mark_alive(seed)
+            self._mark_alive(seed, role=resp.get("role", "core"))
             # learned members start UNVERIFIED (alive only on direct
             # contact — a dead peer in the seed's list must not receive
             # deltas that vanish silently)
@@ -127,10 +145,11 @@ class ClusterNode:
             # announce ourselves; a successful hello IS the verification
             for other in others:
                 try:
-                    self.transport.call(
+                    r2 = self.transport.call(
                         other, "node.hello", node=self.name,
-                        versions=bpapi.supported_versions())
-                    self._mark_alive(other)
+                        versions=bpapi.supported_versions(),
+                        role=self.role)
+                    self._mark_alive(other, role=r2.get("role", "core"))
                 except TransportError:
                     pass
             self._bootstrap_from(seed)
@@ -148,11 +167,14 @@ class ClusterNode:
         with self._lock:
             return [n for n, m in self.members.items() if m.get("alive")]
 
-    def _mark_alive(self, node: str) -> None:
+    def _mark_alive(self, node: str, role: Optional[str] = None) -> None:
         with self._lock:
             was_down = (node in self.members
                         and not self.members[node]["alive"])
-            self.members[node] = {"alive": True, "missed": 0}
+            kept_role = role or self.members.get(node, {}).get(
+                "role", "core")
+            self.members[node] = {"alive": True, "missed": 0,
+                                  "role": kept_role}
             if was_down:
                 self._peer_cursor[node] = 0      # full re-flush of ours
         if was_down:
@@ -165,13 +187,16 @@ class ClusterNode:
                 self.flush()
             except TransportError:
                 with self._lock:
-                    self.members[node] = {"alive": False, "missed": 99}
+                    self.members[node] = {"alive": False, "missed": 99,
+                                          "role": kept_role}
 
     def _nodedown(self, node: str) -> None:
         """Purge everything owned by a dead peer
         (emqx_router_helper:cleanup_routes + shared/registry sweeps)."""
         with self._lock:
-            self.members[node] = {"alive": False, "missed": 99}
+            self.members[node] = {
+                "alive": False, "missed": 99,
+                "role": self.members.get(node, {}).get("role", "core")}
             dead_cids = [c for c, n in self.registry.items() if n == node]
             for cid in dead_cids:
                 del self.registry[cid]
@@ -184,6 +209,7 @@ class ClusterNode:
     def tick(self) -> None:
         """Heartbeat + route flush (housekeeping timer)."""
         self.flush()
+        self.conf.tick()          # retry stalled / pull missing config txns
         with self._lock:
             holders = [{"topic": t, "sid": s}
                        for t, s in self.exclusive_local.items()]
@@ -197,8 +223,11 @@ class ClusterNode:
             peers = list(self.members)
         for peer in peers:
             try:
-                self.transport.call(peer, "node.ping", node=self.name)
-                self._mark_alive(peer)
+                resp = self.transport.call(peer, "node.ping",
+                                           node=self.name, role=self.role)
+                self._mark_alive(
+                    peer, role=(resp.get("role")
+                                if isinstance(resp, dict) else None))
             except TransportError:
                 with self._lock:
                     m = self.members.get(peer)
@@ -294,7 +323,7 @@ class ClusterNode:
                           for t, s in self.exclusive_local.items()]
         return {"routes": routes, "shared": shared,
                 "registry": registry, "exclusive": exclusive,
-                "node": self.name}
+                "conf": self.conf.snapshot(), "node": self.name}
 
     def _apply_snapshot(self, snap: dict) -> None:
         router = self.app.broker.router
@@ -315,6 +344,9 @@ class ClusterNode:
                 if e["node"] != self.name:
                     self.exclusive_remote.setdefault(
                         e["topic"], (e["sid"], e["node"]))
+        # config-txn catch-up on join (emqx_cluster_rpc.erl:92-105)
+        self.conf.apply_snapshot(snap.get("conf", {}),
+                                 from_node=snap.get("node", ""))
 
     def _bootstrap_from(self, peer: str) -> None:
         snap = self.transport.call(peer, "rlog.bootstrap",
@@ -584,22 +616,30 @@ class ClusterNode:
 
     # -- hello/ping/bye -----------------------------------------------------
 
-    def _h_hello(self, node: str, versions: dict) -> dict:
+    def _h_hello(self, node: str, versions: dict,
+                 role: str = "core") -> dict:
         bpapi.negotiate(versions, "rlog")
         with self._lock:
             members = list(self.members) + [self.name]
-        self._mark_alive(node)
-        return {"versions": bpapi.supported_versions(), "members": members}
+        self._mark_alive(node, role=role)
+        return {"versions": bpapi.supported_versions(),
+                "members": members, "role": self.role}
 
-    def _h_ping(self, node: str) -> str:
+    def _h_ping(self, node: str, role: Optional[str] = None) -> dict:
         with self._lock:
             known_down = (node in self.members
                           and not self.members[node]["alive"])
             if node not in self.members:
-                self.members[node] = {"alive": True, "missed": 0}
+                self.members[node] = {"alive": True, "missed": 0,
+                                      "role": role or "core"}
+            elif role is not None:
+                self.members[node]["role"] = role
         if known_down:
-            self._mark_alive(node)
-        return "pong"
+            self._mark_alive(node, role=role)
+        # role rides the pong so a peer that learned us indirectly (seed
+        # member list, no hello) still classifies us correctly — a
+        # replicant misread as core could be elected coordinator
+        return {"pong": True, "role": self.role}
 
     def _h_bye(self, node: str) -> None:
         with self._lock:

@@ -29,25 +29,40 @@ def main() -> None:
                     help="name:host:port, repeatable")
     ap.add_argument("--seed", default=None,
                     help="node name to join (first peer by default)")
+    ap.add_argument("--role", default="core",
+                    choices=["core", "replicant"])
+    ap.add_argument("--mgmt", action="store_true",
+                    help="also serve the REST API (port printed on READY)")
     args = ap.parse_args()
 
+    from emqx_tpu.app import BrokerApp
     from emqx_tpu.broker.server import BrokerServer
     from emqx_tpu.cluster.node import ClusterNode
     from emqx_tpu.cluster.transport import TcpTransport
+    from emqx_tpu.config.config import Config
 
+    conf = Config()
+    conf.init_load("")
+    app = BrokerApp.from_config(conf, node=args.name)
     transport = TcpTransport(args.name, port=args.cluster_port)
     for spec in args.peer:
         name, host, port = spec.rsplit(":", 2)
         transport.add_peer(name, host, int(port))
-    node = ClusterNode(args.name, transport)
+    node = ClusterNode(args.name, transport, app=app, role=args.role)
     if args.peer:
         seed = args.seed or args.peer[0].split(":", 1)[0]
         node.join([seed])
 
+    mgmt_port = 0
+    if args.mgmt:
+        from emqx_tpu.mgmt.api import ManagementApi
+        mgmt = ManagementApi(app, cluster_node=node)
+        mgmt_port = mgmt.start()
+
     async def serve() -> None:
         server = BrokerServer(port=args.mqtt_port, app=node.app)
         await server.start()
-        print(f"READY {server.port}", flush=True)
+        print(f"READY {server.port} {mgmt_port}", flush=True)
         await asyncio.Event().wait()          # run until killed
 
     try:

@@ -51,6 +51,10 @@ class Config:
         self._checked: dict = self.schema.check({})
         self._handlers: dict[Path, Callable] = {}
         self._listeners: list[Callable[[Path, Any], None]] = []
+        # cluster seam: when a ClusterNode binds this config, cluster-layer
+        # writes route through the replicated txn log (emqx_cluster_rpc);
+        # signature: cluster_fn(kind, path_tuple, value) -> applied value
+        self.cluster_fn: Optional[Callable] = None
 
     # -- load (emqx_config:init_load) ---------------------------------------
 
@@ -132,12 +136,19 @@ class Config:
     # -- writes (emqx_config:update / emqx_conf:update) ---------------------
 
     def put(self, path: "str | Path", value: Any,
-            layer: str = "cluster") -> Any:
+            layer: str = "cluster", local: bool = False) -> Any:
         """Runtime update: handler → overlay → recheck → swap → notify.
-        Returns the new checked value at ``path``."""
+        Returns the new checked value at ``path``.
+
+        With a cluster seam bound, cluster-layer writes become
+        cluster-wide transactions (the reference's ``emqx_conf:update``
+        → ``emqx_cluster_rpc:multicall``); ``local=True`` is the
+        txn-apply path itself (and node-local maintenance)."""
         p = _path(path)
         if not p:
             raise ConfigError("empty update path")
+        if self.cluster_fn is not None and layer == "cluster" and not local:
+            return self.cluster_fn("put", p, value)
         found = self._handler_for(p)
         if found is not None:
             _hpath, handler = found
@@ -166,8 +177,12 @@ class Config:
             fn(p, new_val)
         return new_val
 
-    def remove(self, path: "str | Path", layer: str = "cluster") -> None:
+    def remove(self, path: "str | Path", layer: str = "cluster",
+               local: bool = False) -> None:
         p = _path(path)
+        if self.cluster_fn is not None and layer == "cluster" and not local:
+            self.cluster_fn("remove", p, None)
+            return
         over = (self._cluster_override if layer == "cluster"
                 else self._local_override)
         node: Any = over
@@ -179,6 +194,24 @@ class Config:
         self._recheck()
         for fn in self._listeners:
             fn(p, self.get(p))
+
+    def adopt_cluster_override(self, raw: dict) -> None:
+        """Replace the cluster override wholesale (split-brain re-merge:
+        the autoheal loser adopts the winner's replicated layer)."""
+        old = self._cluster_override
+        self._cluster_override = copy.deepcopy(raw)
+        try:
+            self._recheck()
+        except Exception:
+            self._cluster_override = old
+            self._recheck()
+            raise
+        # notify per affected top-level section: listeners dispatch on
+        # path prefixes (e.g. BrokerApp._on_config_change), which an
+        # empty path would never match
+        for key in sorted(set(old) | set(raw)):
+            for fn in self._listeners:
+                fn((key,), self.get((key,)))
 
     # -- persistence of the override layers ---------------------------------
 

@@ -198,6 +198,10 @@ class ManagementApi:
         r("DELETE", "/api/v5/banned/{kind}/{value}", self.h_banned_delete)
         r("GET", "/api/v5/configs", self.h_config_get)
         r("PUT", "/api/v5/configs", self.h_config_put)
+        r("GET", "/api/v5/cluster_rpc", self.h_cluster_rpc_status)
+        r("POST", "/api/v5/cluster_rpc/skip", self.h_cluster_rpc_skip)
+        r("POST", "/api/v5/cluster_rpc/fast_forward",
+          self.h_cluster_rpc_ff)
         r("GET", "/api/v5/rules", self.h_rules_list)
         r("POST", "/api/v5/rules", self.h_rules_create)
         r("GET", "/api/v5/rules/{id}", self.h_rule_get)
@@ -247,14 +251,35 @@ class ManagementApi:
 
     def h_nodes(self, query, body):
         me = {"node": self.app.broker.node, "status": "running",
-              "role": "core"}
+              "role": getattr(self.cluster, "role", "core")}
         if self.cluster is None:
             return [me]
         return [me] + [
             {"node": n, "status": "running" if m.get("alive")
-             else "stopped", "role": "core"}
+             else "stopped", "role": m.get("role", "core")}
             for n, m in self.cluster.members.items()
         ]
+
+    def _cluster_conf(self):
+        if self.cluster is None:
+            raise ApiError(503, "NO_CLUSTER",
+                           "node is not part of a cluster")
+        return self.cluster.conf
+
+    def h_cluster_rpc_status(self, query, body):
+        return {"data": self._cluster_conf().cluster_status()}
+
+    def h_cluster_rpc_skip(self, query, body):
+        return {"tnx_id": self._cluster_conf().skip_failed_commit()}
+
+    def h_cluster_rpc_ff(self, query, body):
+        body = body or {}
+        try:
+            tnx_id = int(body["tnx_id"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise ApiError(400, "BAD_REQUEST", "tnx_id required") from e
+        return {"tnx_id":
+                self._cluster_conf().fast_forward_to_commit(tnx_id)}
 
     def h_metrics(self, query, body):
         return self.app.metrics.all()
@@ -378,11 +403,23 @@ class ManagementApi:
         return {"value": self._conf().get(query.get("path", ""))}
 
     def h_config_put(self, query, body):
+        from emqx_tpu.cluster.conf import (ClusterConfError,
+                                           ClusterConfRejected)
+
         body = body or {}
         try:
             value = self._conf().put(body["path"], body["value"])
         except KeyError as e:
             raise ApiError(400, "BAD_REQUEST", "path/value required") from e
+        except ClusterConfRejected as e:
+            # validation failure on the coordinator — permanently bad
+            # value, same 400 a non-clustered node would return
+            raise ApiError(400, "BAD_VALUE", str(e)) from e
+        except ClusterConfError as e:
+            # transient cluster condition (no core reachable, coordinator
+            # catching up, local apply stalled) — retryable, not a bad
+            # request
+            raise ApiError(503, "CLUSTER_UNAVAILABLE", str(e)) from e
         except Exception as e:
             raise ApiError(400, "BAD_VALUE", str(e)) from e
         return {"value": value}

@@ -1,0 +1,352 @@
+"""Cluster-replicated config transactions (emqx_cluster_rpc.erl:26-44,
+71-140): ordered commit log via the core coordinator, per-node cursors,
+catch-up on join, stall + skip_failed_commit / fast_forward escape
+hatches, core/replicant roles."""
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.cluster.conf import ClusterConfError
+from emqx_tpu.cluster.harness import stop as stop_nodes
+from emqx_tpu.cluster.node import ClusterNode
+from emqx_tpu.cluster.transport import LocalBus
+from emqx_tpu.config.config import Config
+
+
+def make_conf_cluster(names, roles=None):
+    fabric = LocalBus.Fabric()
+    nodes = []
+    for i, name in enumerate(names):
+        conf = Config()
+        conf.init_load("")
+        app = BrokerApp.from_config(conf, node=name)
+        node = ClusterNode(
+            name, LocalBus(name, fabric), app=app,
+            role=(roles or {}).get(name, "core"))
+        node.fabric = fabric
+        nodes.append(node)
+    for node in nodes[1:]:
+        node.join([names[0]])
+    return nodes
+
+
+def test_put_replicates_to_all_nodes():
+    nodes = make_conf_cluster(["n1", "n2", "n3"])
+    try:
+        # write on the coordinator (lowest core = n1)
+        nodes[0].app.config.put("mqtt.max_packet_size", 2048)
+        for n in nodes:
+            assert n.app.config.get("mqtt.max_packet_size") == 2048
+        # write on a NON-coordinator routes through the coordinator
+        nodes[2].app.config.put("mqtt.max_qos_allowed", 1)
+        for n in nodes:
+            assert n.app.config.get("mqtt.max_qos_allowed") == 1
+        ids = {n.conf.cursor for n in nodes}
+        assert ids == {2}, "all cursors advance through the same log"
+    finally:
+        stop_nodes(nodes)
+
+
+def test_replicant_forwards_and_requires_a_core():
+    nodes = make_conf_cluster(
+        ["n1", "n2"], roles={"n1": "core", "n2": "replicant"})
+    n1, n2 = nodes
+    try:
+        assert n2.conf.coordinator() == "n1"
+        n2.app.config.put("mqtt.retain_available", False)
+        assert n1.app.config.get("mqtt.retain_available") is False
+        # core gone → replicant cannot commit (mria: replicants don't own
+        # the table)
+        n2._nodedown("n1")
+        with pytest.raises(ClusterConfError, match="core"):
+            n2.app.config.put("mqtt.retain_available", True)
+    finally:
+        stop_nodes(nodes)
+
+
+def test_joiner_catches_up_from_snapshot():
+    nodes = make_conf_cluster(["n1", "n2"])
+    try:
+        for i, v in enumerate((1024, 2048, 4096)):
+            nodes[0].app.config.put("mqtt.max_packet_size", v)
+        # a fresh node joins AFTER the txns — bootstrap replays the log
+        conf = Config()
+        conf.init_load("")
+        app = BrokerApp.from_config(conf, node="n9")
+        late = ClusterNode("n9", LocalBus("n9", nodes[0].fabric), app=app)
+        late.join(["n1"])
+        assert late.app.config.get("mqtt.max_packet_size") == 4096
+        assert late.conf.cursor == 3
+        late.transport.close()
+    finally:
+        stop_nodes(nodes)
+
+
+def test_failed_commit_stalls_then_skip_advances():
+    nodes = make_conf_cluster(["n1", "n2", "n3"])
+    n1, n2, n3 = nodes
+    try:
+        # poison handler ONLY on n2: the txn applies on n1/n3, n2 stalls
+        def poison(path, value, old):
+            if value == 666:
+                raise ValueError("n2 rejects 666")
+            return value
+
+        n2.app.config.add_handler("mqtt.max_inflight", poison)
+        n1.app.config.put("mqtt.max_inflight", 666)
+        assert n1.app.config.get("mqtt.max_inflight") == 666
+        assert n3.app.config.get("mqtt.max_inflight") == 666
+        assert n2.app.config.get("mqtt.max_inflight") != 666
+        st = n2.conf.status()
+        assert st["failed"] and st["failed"]["tnx_id"] == 1
+        assert st["tnx_id"] == 0
+
+        # later txns queue behind the stall (strict order)
+        n1.app.config.put("mqtt.max_awaiting_rel", 50)
+        assert n2.app.config.get("mqtt.max_awaiting_rel") != 50
+        assert n2.conf.max_seen == 2
+
+        # operator skips the poison entry; queued entries then apply
+        assert n2.conf.skip_failed_commit() == 2
+        assert n2.app.config.get("mqtt.max_awaiting_rel") == 50
+        assert n2.conf.status()["failed"] is None
+
+        # cluster_status sees every node's cursor
+        view = {s["node"]: s["tnx_id"] for s in n1.conf.cluster_status()}
+        assert view == {"n1": 2, "n2": 2, "n3": 2}
+    finally:
+        stop_nodes(nodes)
+
+
+def test_coordinator_rejects_locally_failing_txn():
+    """The reference aborts a multicall whose MFA fails on the initiating
+    node — nothing commits anywhere."""
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        def poison(path, value, old):
+            raise ValueError("bad value")
+
+        n1.app.config.add_handler("mqtt.server_keepalive", poison)
+        with pytest.raises(Exception):
+            n1.app.config.put("mqtt.server_keepalive", 30)
+        assert n1.conf.max_seen == 0
+        assert n2.conf.max_seen == 0
+        # a non-coordinator initiator gets the rejection surfaced too
+        with pytest.raises(ClusterConfError, match="rejected"):
+            n2.app.config.put("mqtt.server_keepalive", 30)
+        assert n2.conf.max_seen == 0
+    finally:
+        stop_nodes(nodes)
+
+
+def test_fast_forward_to_commit():
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        def poison(path, value, old):
+            raise ValueError("nope")
+
+        n2.app.config.add_handler("mqtt.max_topic_levels", poison)
+        n1.app.config.put("mqtt.max_topic_levels", 9)
+        n1.app.config.put("mqtt.max_subscriptions", 77)
+        assert n2.conf.status()["failed"]
+        # operator asserts n2's state is fine as-is and jumps the cursor
+        assert n2.conf.fast_forward_to_commit(2) == 2
+        assert n2.app.config.get("mqtt.max_subscriptions") != 77  # skipped
+        assert n2.conf.status()["failed"] is None
+        # new txns apply normally again
+        n1.app.config.put("mqtt.max_subscriptions", 88)
+        assert n2.app.config.get("mqtt.max_subscriptions") == 88
+    finally:
+        stop_nodes(nodes)
+
+
+def test_remove_replicates():
+    nodes = make_conf_cluster(["n1", "n2"])
+    try:
+        nodes[0].app.config.put("mqtt.max_packet_size", 555)
+        assert nodes[1].app.config.get("mqtt.max_packet_size") == 555
+        nodes[1].app.config.remove("mqtt.max_packet_size")
+        default = Config().get("mqtt.max_packet_size")
+        for n in nodes:
+            assert n.app.config.get("mqtt.max_packet_size") == default
+    finally:
+        stop_nodes(nodes)
+
+
+def test_split_brain_heal_adopts_winner():
+    """Both sides of a partition commit conflicting tnx_ids; on heal the
+    higher-named core adopts the lower's log + override wholesale (the
+    ekka-autoheal outcome: the minority island's writes are discarded)."""
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        n1.app.config.put("mqtt.max_packet_size", 1111)   # tnx 1 everywhere
+        # partition: both sides mark the other down
+        n1._nodedown("n2")
+        n2._nodedown("n1")
+        # both sides keep accepting writes (availability like the
+        # reference); each assigns tnx 2 with different content
+        n1.app.config.put("mqtt.max_packet_size", 2222)
+        n2.app.config.put("mqtt.max_packet_size", 3333)
+        assert n1.conf.max_seen == n2.conf.max_seen == 2
+        assert n1.app.config.get("mqtt.max_packet_size") == 2222
+        assert n2.app.config.get("mqtt.max_packet_size") == 3333
+        # heal: both re-bootstrap from each other
+        n1._mark_alive("n2")
+        n2._mark_alive("n1")
+        # n1 < n2 → n1 wins the tie-break; n2 adopts n1's state
+        assert n1.app.config.get("mqtt.max_packet_size") == 2222
+        assert n2.app.config.get("mqtt.max_packet_size") == 2222
+        assert n2.conf.cursor == n1.conf.cursor == 2
+        # post-heal txns replicate normally again
+        n2.app.config.put("mqtt.max_packet_size", 4444)
+        assert n1.app.config.get("mqtt.max_packet_size") == 4444
+        assert n2.app.config.get("mqtt.max_packet_size") == 4444
+    finally:
+        stop_nodes(nodes)
+
+
+def test_two_node_cluster_survives_nodedown():
+    """The surviving core keeps committing config txns after the other
+    node dies (availability parity: the reference's cluster_rpc does not
+    halt on nodedown — the dead node catches up on rejoin)."""
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        n1.app.config.put("mqtt.max_packet_size", 1000)
+        n1._nodedown("n2")
+        n1.app.config.put("mqtt.max_packet_size", 2000)   # must not raise
+        assert n1.app.config.get("mqtt.max_packet_size") == 2000
+        # n2 also keeps serving (it becomes its own coordinator)
+        n2._nodedown("n1")
+        n2.app.config.put("mqtt.max_qos_allowed", 1)
+        assert n2.app.config.get("mqtt.max_qos_allowed") == 1
+    finally:
+        stop_nodes(nodes)
+
+
+def test_failover_tail_sync_no_duplicate_tnx_id():
+    """The old coordinator's last commit reached n3 but not n2; when n2
+    takes over it must learn the tail from n3 before assigning ids —
+    otherwise it re-issues the same tnx_id and n3 silently diverges."""
+    nodes = make_conf_cluster(["n1", "n2", "n3"])
+    n1, n2, n3 = nodes
+    try:
+        n1.app.config.put("mqtt.max_packet_size", 1111)   # tnx 1
+        # simulate the lost cast: hand-deliver tnx 2 to n3 only
+        entry = {"tnx_id": 2, "kind": "put",
+                 "path": ["mqtt", "max_packet_size"], "value": 2222,
+                 "initiator": "n1"}
+        with n1.conf._lock:
+            n1.conf.log[2] = entry
+            n1.conf.max_seen = 2
+            n1.conf.cursor = 2
+        n3.conf.h_commit("n1", entry)
+        assert n3.conf.cursor == 2 and n2.conf.cursor == 1
+        # n1 dies; n2 becomes coordinator and must NOT reuse tnx 2
+        n2._nodedown("n1")
+        n3._nodedown("n1")
+        n2.app.config.put("mqtt.max_inflight", 64)
+        assert n2.conf.log[3]["path"] == ["mqtt", "max_inflight"]
+        assert n2.conf.log[2] == entry          # learned from n3
+        assert n2.app.config.get("mqtt.max_packet_size") == 2222
+        assert n3.app.config.get("mqtt.max_inflight") == 64
+    finally:
+        stop_nodes(nodes)
+
+
+def test_stalled_initiator_surfaces_error_not_stale_success():
+    """A txn that commits cluster-wide but fails to apply on the
+    INITIATING node must raise, not return the stale value as success."""
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        def poison(path, value, old):
+            raise ValueError("n2 cannot apply this")
+
+        n2.app.config.add_handler("mqtt.max_mqueue_len", poison)
+        with pytest.raises(ClusterConfError, match="committed cluster-wide"):
+            n2.app.config.put("mqtt.max_mqueue_len", 42)
+        # ...but the cluster did commit it (n1 applied)
+        assert n1.app.config.get("mqtt.max_mqueue_len") == 42
+        assert n2.conf.status()["failed"]["tnx_id"] == 1
+    finally:
+        stop_nodes(nodes)
+
+
+def test_log_pruning_and_snapshot_adoption():
+    """Applied entries compact beyond the KEEP window; a joiner that is
+    behind the compaction horizon adopts the snapshot wholesale."""
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        old_keep = type(n1.conf).KEEP
+        type(n1.conf).KEEP = 5
+        for i in range(12):
+            n1.app.config.put("mqtt.max_packet_size", 1000 + i)
+        n1.conf.prune()
+        assert n1.conf.compacted_to == 12 - 5
+        assert len(n1.conf.log) == 5
+        # fresh joiner behind the horizon → snapshot adoption
+        conf = Config()
+        conf.init_load("")
+        app = BrokerApp.from_config(conf, node="n8")
+        late = ClusterNode("n8", LocalBus("n8", nodes[0].fabric), app=app)
+        late.join(["n1"])
+        assert late.app.config.get("mqtt.max_packet_size") == 1011
+        assert late.conf.cursor == 12
+        # and catchup() against a compacted peer also adopts
+        resp = n1.conf.h_catchup("nX", since=2)
+        assert "snapshot" in resp
+        late.transport.close()
+    finally:
+        type(nodes[0].conf).KEEP = old_keep
+        stop_nodes(nodes)
+
+
+def test_rejected_vs_unavailable_error_classes():
+    """Validation failure on the coordinator is ClusterConfRejected
+    (permanent → HTTP 400); infra conditions stay ClusterConfError
+    (transient → 503)."""
+    from emqx_tpu.cluster.conf import ClusterConfRejected
+
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        # schema rejection travels back to the non-coordinator initiator
+        # as the Rejected subclass
+        with pytest.raises(ClusterConfRejected):
+            n2.app.config.put("mqtt.max_packet_size", "not-an-int")
+        # transient: no core reachable is plain ClusterConfError
+        n2._nodedown("n1")
+        n2.role = "replicant"
+        try:
+            n2.app.config.put("mqtt.max_packet_size", 1)
+            raise AssertionError("should have raised")
+        except ClusterConfRejected:
+            raise AssertionError("transient error misclassified")
+        except Exception as e:
+            assert "core" in str(e)
+    finally:
+        stop_nodes(nodes)
+
+
+def test_adoption_fires_section_listeners():
+    """Split-brain adoption must notify per top-level config section so
+    runtime state (e.g. shared-sub strategy) follows the adopted tree."""
+    nodes = make_conf_cluster(["n1", "n2"])
+    n1, n2 = nodes
+    try:
+        n1._nodedown("n2")
+        n2._nodedown("n1")
+        n1.app.config.put("shared_subscription_strategy", "local")
+        n2.app.config.put("shared_subscription_strategy", "sticky")
+        # heal: n2 adopts n1's override and must re-wire runtime state
+        n2._mark_alive("n1")
+        n1._mark_alive("n2")
+        assert n2.app.config.get("shared_subscription_strategy") == "local"
+        assert n2.app.shared.strategy == "local"
+    finally:
+        stop_nodes(nodes)

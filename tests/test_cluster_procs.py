@@ -23,7 +23,8 @@ def _free_port() -> int:
 
 class Peer:
     def __init__(self, name: str, cluster_port: int,
-                 peers: list[str], seed: str | None) -> None:
+                 peers: list[str], seed: str | None,
+                 mgmt: bool = False) -> None:
         cmd = [sys.executable, "-m", "emqx_tpu.cluster.peer",
                "--name", name, "--cluster-port", str(cluster_port),
                "--mqtt-port", "0"]
@@ -31,6 +32,8 @@ class Peer:
             cmd += ["--peer", p]
         if seed:
             cmd += ["--seed", seed]
+        if mgmt:
+            cmd += ["--mgmt"]
         env = {**os.environ, "JAX_PLATFORMS": "cpu"}
         self.proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
@@ -38,7 +41,9 @@ class Peer:
             env=env)
         line = self.proc.stdout.readline().strip()
         assert line.startswith("READY"), f"peer {name} failed: {line!r}"
-        self.mqtt_port = int(line.split()[1])
+        parts = line.split()
+        self.mqtt_port = int(parts[1])
+        self.mgmt_port = int(parts[2]) if len(parts) > 2 else 0
 
     def kill(self) -> None:
         self.proc.send_signal(signal.SIGKILL)
@@ -144,3 +149,84 @@ def test_cross_process_session_takeover(two_peers):
         await pub.disconnect()
         await c2.disconnect()
     asyncio.run(main())
+
+
+# -- cluster config transactions across real processes -------------------------
+
+def _http(port, method, path, body=None, token=None):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method)
+    req.add_header("Content-Type", "application/json")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read() or b"null")
+
+
+def _login(port):
+    return _http(port, "POST", "/api/v5/login",
+                 {"username": "admin", "password": "public"})["token"]
+
+
+def test_config_txn_replication_and_lagging_peer_catchup():
+    """emqx_cluster_rpc across REAL processes: a PUT /configs on one node
+    is visible on the other; a node that was DEAD during several txns
+    catches the whole log up when it rejoins (emqx_conf_app_SUITE's
+    cluster_rpc catch-up scenario)."""
+    import time as _t
+
+    p1_port, p2_port = _free_port(), _free_port()
+    n1 = Peer("n1", p1_port, [f"n2:127.0.0.1:{p2_port}"], seed=None,
+              mgmt=True)
+    n2 = Peer("n2", p2_port, [f"n1:127.0.0.1:{p1_port}"], seed="n1",
+              mgmt=True)
+    n2b = None
+    try:
+        t1 = _login(n1.mgmt_port)
+        t2 = _login(n2.mgmt_port)
+        # cluster-wide PUT via n2 (non-coordinator: forwards to n1)
+        _http(n2.mgmt_port, "PUT", "/api/v5/configs",
+              {"path": "mqtt.max_packet_size", "value": 4096}, t2)
+        v1 = _http(n1.mgmt_port, "GET",
+                   "/api/v5/configs?path=mqtt.max_packet_size",
+                   token=t1)["value"]
+        assert v1 == 4096
+
+        status = _http(n1.mgmt_port, "GET", "/api/v5/cluster_rpc",
+                       token=t1)["data"]
+        assert {s["node"]: s["tnx_id"] for s in status} == \
+            {"n1": 1, "n2": 1}
+
+        # n2 dies; txns continue on n1
+        n2.kill()
+        for v in (8192, 16384):
+            _http(n1.mgmt_port, "PUT", "/api/v5/configs",
+                  {"path": "mqtt.max_packet_size", "value": v}, t1)
+
+        # n2 rejoins on the same ports → bootstrap replays the conf log
+        n2b = Peer("n2", p2_port, [f"n1:127.0.0.1:{p1_port}"], seed="n1",
+                   mgmt=True)
+        t2b = _login(n2b.mgmt_port)
+        deadline = _t.time() + 15
+        val = None
+        while _t.time() < deadline:
+            val = _http(n2b.mgmt_port, "GET",
+                        "/api/v5/configs?path=mqtt.max_packet_size",
+                        token=t2b)["value"]
+            if val == 16384:
+                break
+            _t.sleep(0.5)
+        assert val == 16384, f"lagging peer never caught up (saw {val})"
+        st2 = _http(n2b.mgmt_port, "GET", "/api/v5/cluster_rpc",
+                    token=t2b)["data"]
+        assert any(s["node"] == "n2" and s["tnx_id"] == 3 for s in st2)
+    finally:
+        n1.stop()
+        n2.stop()
+        if n2b is not None:
+            n2b.stop()

@@ -348,3 +348,37 @@ def test_quic_listener_slot_is_gated():
     app = BrokerApp()
     with pytest.raises(NotImplementedError, match="msquic"):
         build_listener(app, "q", {"type": "quic", "bind": "127.0.0.1:0"})
+
+
+def test_noncontiguous_tls_versions_rejected(pki):
+    with pytest.raises(ValueError, match="non-contiguous"):
+        tls.make_server_context(
+            server_opts(pki, versions=["tlsv1", "tlsv1.3"]))
+
+
+def test_peer_cert_identity_requires_verify_peer(pki):
+    app = BrokerApp()
+    with pytest.raises(ValueError, match="verify_peer"):
+        build_listener(app, "bad", {
+            "type": "ssl", "bind": "127.0.0.1:0",
+            "peer_cert_as_username": "cn",
+            "ssl_options": server_opts(pki)})
+
+
+def test_start_all_rolls_back_on_failure(pki):
+    """A failing listener must unbind the ones already started so a
+    retry doesn't hit EADDRINUSE."""
+    async def main():
+        app = BrokerApp()
+        sup = Listeners(app)
+        good = {"type": "tcp", "bind": "127.0.0.1:0"}
+        bad = {"type": "ssl", "bind": "127.0.0.1:0",
+               "ssl_options": {"certfile": "/nonexistent.pem"}}
+        with pytest.raises(Exception):
+            await sup.start_all({"a": good, "b": bad})
+        assert sup.info() == []          # nothing left bound
+        # retry with the bad listener fixed succeeds
+        started = await sup.start_all({"a": good})
+        assert started == ["tcp:a"]
+        await sup.stop_all()
+    asyncio.run(main())

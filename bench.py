@@ -275,11 +275,30 @@ def bench_e2e() -> None:
     n_pub = int(os.environ.get("BENCH_E2E_PUBS", 16))
     n_sub = int(os.environ.get("BENCH_E2E_SUBS", 16))
     n_msg = int(os.environ.get("BENCH_E2E_MSGS", 250))  # per publisher
+    n_rules = int(os.environ.get("BENCH_RULES", 1000))  # config 5
 
     conf = Config()
     conf.put("router.device.enable", True)
     conf.put("router.device.max_levels", 8)
     app = BrokerApp.from_config(conf)
+
+    # BASELINE config 5: rule-engine SQL topic filters co-batched with the
+    # router match — every FROM filter rides the SAME kernel launch as
+    # fan-out; per-publish rule lookup is O(matched), not O(rules)
+    # (emqx_rule_engine.erl:198-205)
+    rule_hits = [0]
+    if n_rules:
+        app.rules.register_action(
+            "bench_sink", lambda cols, args: rule_hits.__setitem__(
+                0, rule_hits[0] + 1))
+        for r in range(n_rules):
+            # a few rules match live bench traffic; the rest are realistic
+            # dead weight over the same topic space
+            filt = (f"bench/{r % max(1, n_sub)}/+" if r < 8
+                    else f"rules/fleet{r}/+/telemetry")
+            app.rules.create_rule(
+                f"bench_rule_{r}", f'SELECT topic FROM "{filt}"',
+                [{"function": "bench_sink", "args": {}}])
 
     async def run():
         server = BrokerServer(port=0, app=app)
@@ -349,7 +368,8 @@ def bench_e2e() -> None:
         log(f"e2e broker: {got}/{expected} msgs in {wall:.2f}s = "
             f"{got / wall:,.0f} msg/s end-to-end "
             f"(pubs={n_pub} subs={n_sub} qos=0, device path, "
-            f"kernel launches={app.broker.model.launch_count})")
+            f"kernel launches={app.broker.model.launch_count}, "
+            f"rules={n_rules} co-batched, rule fires={rule_hits[0]})")
         if len(lat_ms):
             log(f"e2e delivery latency ms: p50={np.percentile(lat_ms, 50):.2f} "
                 f"p99={np.percentile(lat_ms, 99):.2f}")

@@ -91,6 +91,11 @@ class BrokerApp:
         self.rules = RuleEngine(node=node,
                                 publish_fn=self._publish_dispatch)
         self.rules.attach(self.hooks)
+        if self.broker.model is not None:
+            # co-batch rule FROM filters with router match on the device
+            # (config 5): publish_batch feeds fan-out AND rule matching
+            self.rules.attach_model(self.broker.model)
+            self.broker.rules_matched_fn = self.rules.on_matched
         from emqx_tpu.bridge.bridge import BridgeManager
         self.bridges = BridgeManager(
             rules=self.rules, publish_fn=self._publish_dispatch,

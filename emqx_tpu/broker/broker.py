@@ -125,6 +125,10 @@ class Broker:
         self.model = router_model
         self.forward_fn = forward_fn
         self.shared_dispatch = shared_dispatch
+        # device co-batching sink for the rule engine (config 5): called
+        # with (msg, matched_filters) after the kernel, or (msg, None)
+        # for fallback topics the kernel couldn't cover
+        self.rules_matched_fn = None
         self.slots = SlotRegistry(
             capacity=router_model.n_sub_slots
             if router_model is not None else 8192)
@@ -307,9 +311,20 @@ class Broker:
     ) -> list[dict[Sid, list[tuple[str, Message]]]]:
         """Device-path publish: one kernel launch for the whole batch
         (falls back to the host oracle per overflow/too-long topic)."""
+        cobatch = self.rules_matched_fn is not None and self.model is not None
+        if cobatch:
+            # the rule engine defers to the kernel's matches (delivered
+            # via rules_matched_fn below) instead of matching in the
+            # message.publish hook — one trie walk for fan-out AND rules
+            for m in msgs:
+                m.headers["rules_cobatch"] = True
         msgs = [
             self.hooks.run_fold("message.publish", (), m) for m in msgs
         ]
+        if cobatch:
+            for m in msgs:
+                if m is not None:
+                    m.headers.pop("rules_cobatch", None)
         live = []
         for i, m in enumerate(msgs):
             if m is None or m.headers.get("allow_publish") is False:
@@ -324,15 +339,19 @@ class Broker:
                 self._inc("messages.publish")
                 out[i] = self._route(m.topic, m)
             return out
-        matched, slots, fallback = self.model.publish_batch(
+        matched, aux, slots, fallback = self.model.publish_batch(
             [m.topic for _, m in live]
         )
         fb = set(fallback)
         for j, (i, m) in enumerate(live):
             self._inc("messages.publish")
             if j in fb:
+                if cobatch:
+                    self.rules_matched_fn(m, None)  # host-match rules
                 out[i] = self._route(m.topic, m)   # oracle fallback
                 continue
+            if cobatch:
+                self.rules_matched_fn(m, matched[j] + aux[j])
             deliveries: dict[Sid, list[tuple[str, Message]]] = {}
             for slot in slots[j]:
                 for sid in self.slots.lookup_sids(slot):

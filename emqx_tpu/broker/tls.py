@@ -50,7 +50,7 @@ def _apply_versions(ctx: ssl.SSLContext, versions) -> None:
             f"unknown TLS version(s) {unknown!r} in ssl_options.versions "
             f"(expected one of {sorted(_VERSIONS)})")
     order = list(_VERSIONS)
-    idx = sorted(order.index(v.lower()) for v in versions)
+    idx = sorted({order.index(v.lower()) for v in versions})
     if idx != list(range(idx[0], idx[-1] + 1)):
         # SSLContext can only express a min/max range; a non-contiguous
         # list ("tlsv1" + "tlsv1.3") would silently enable the versions

@@ -162,7 +162,11 @@ class ClusterConf:
                     f"coordinator still catching up "
                     f"(applied {self.cursor}/{self.max_seen}) — retry")
             entry = {"tnx_id": tnx_id, "kind": kind, "path": path,
-                     "value": value, "initiator": self.node.name}
+                     "value": value, "initiator": self.node.name,
+                     # committing coordinator: the split-brain tie-break
+                     # compares the CONFLICTING ENTRIES' coordinators so
+                     # every node on both sides reaches the same verdict
+                     "coord": self.node.name}
             # validate: the txn must apply cleanly on the coordinator
             # (reference: multicall aborts if the MFA fails on the
             # initiating node — nothing is committed)
@@ -358,18 +362,26 @@ class ClusterConf:
     def apply_snapshot(self, snap: dict, from_node: str = "") -> None:
         entries = list(snap.get("log", ()))
         with self._lock:
-            conflict = any(
-                self.log.get(e["tnx_id"]) is not None
-                and self.log[e["tnx_id"]] != e
-                for e in entries)
+            conflicting = [
+                e for e in entries
+                if self.log.get(e["tnx_id"]) is not None
+                and self.log[e["tnx_id"]] != e]
+            mine = (self.log[conflicting[0]["tnx_id"]]
+                    if conflicting else None)
             behind_compaction = snap.get("compacted_to", 0) > self.cursor
-        if conflict:
+        if conflicting:
             # split-brain re-merge: same tnx_id, different content on the
-            # two sides. Coordinator tie-break (lowest core name) decides
-            # the winner; the loser adopts log + override wholesale and
-            # its partition-era writes are discarded (ekka autoheal
-            # restarts the minority — same outcome)
-            if from_node and from_node < self.node.name:
+            # two sides. The tie-break compares the CONFLICTING ENTRIES'
+            # committing coordinators (not the snapshot sender — a node
+            # can receive the winning log from any peer of the other
+            # side): lower coordinator name wins, so every node on both
+            # sides reaches the same verdict. The losing side adopts log
+            # + override wholesale and its partition-era writes are
+            # discarded (ekka autoheal restarts the minority — same
+            # outcome)
+            theirs_coord = conflicting[0].get("coord", from_node)
+            mine_coord = mine.get("coord", self.node.name)
+            if theirs_coord < mine_coord:
                 self._adopt(snap)
             return                       # else: the peer adopts ours
         if behind_compaction:

@@ -148,6 +148,12 @@ class RouterModel:
         # hash many sids into one), so a slot stays set while any local
         # subscriber of the filter lives in it
         self._subs: dict[int, dict[int, int]] = {}
+        # fid → refcount for AUXILIARY filters (rule-engine FROM filters
+        # co-batched with router match, BASELINE config 5): they live in
+        # the same device trie but own no subscriber slots; the batch
+        # decode reports them separately so fan-out and rule matching
+        # both ride one kernel launch (emqx_rule_engine.erl:198-205)
+        self._aux_refs: dict[int, int] = {}
         # high-degree filters promoted into the device dense pool
         self._dense_row: dict[int, int] = {}      # fid → pool row
         self._row_free: list[int] = []
@@ -213,8 +219,35 @@ class RouterModel:
                 self._slot_removed(fid, slot)
                 if not slots:
                     self._subs.pop(fid, None)
-                    self.index.delete(filt)
+                    # an aux registration (rule FROM filter) keeps the
+                    # trie entry alive past the last subscriber
+                    if fid not in self._aux_refs:
+                        self.index.delete(filt)
                 self._dirty = True
+
+    # -- auxiliary (rule-engine) filters ------------------------------------
+
+    def aux_register(self, filt: str) -> int:
+        """Co-batch a non-subscriber filter (rule FROM clause) into the
+        device trie; refcounted across rules sharing a filter."""
+        with self._mlock:
+            fid = self.index.insert(filt)
+            self._aux_refs[fid] = self._aux_refs.get(fid, 0) + 1
+            self._dirty = True
+            return fid
+
+    def aux_release(self, filt: str) -> None:
+        with self._mlock:
+            fid = self.index.fid_of(filt)
+            if fid is None or fid not in self._aux_refs:
+                return
+            self._aux_refs[fid] -= 1
+            if self._aux_refs[fid] > 0:
+                return
+            del self._aux_refs[fid]
+            if fid not in self._subs:      # no subscribers either
+                self.index.delete(filt)
+            self._dirty = True
 
     # -- dense-pool promotion / demotion -----------------------------------
 
@@ -393,9 +426,13 @@ class RouterModel:
     def publish_batch(self, topics: Sequence[str]):
         """Route a batch of publish topics.
 
-        Returns (matched_filters: list[list[str]], sub_slots: list[list[int]]).
-        Topics flagged overflow/too-long fall back to the host oracle path
-        upstream (router.match_filters) — reported via the third element.
+        Returns ``(matched, aux, slots, fallback)``:
+        - matched: per-topic subscriber filter strings
+        - aux: per-topic auxiliary (rule FROM) filter strings matched by
+          the same kernel launch — config-5 co-batching
+        - slots: per-topic subscriber shard slots
+        - fallback: batch positions (overflow/too-long) that must take
+          the host-oracle path upstream (router.match_filters)
         """
         with self._mlock:
             return self._publish_batch_locked(topics)
@@ -427,10 +464,21 @@ class RouterModel:
         fan = np.asarray(fanout)
         overflow = np.asarray(overflow)
         matched: list[list[str]] = []
+        aux: list[list[str]] = []
         slots: list[list[int]] = []
         for b in range(len(topics)):
             row = fids[b][fids[b] >= 0]
-            matched.append([self.index.filters[f] for f in row])
+            sub_f: list[str] = []
+            aux_f: list[str] = []
+            for f in row:
+                fi = int(f)
+                name = self.index.filters[fi]
+                if fi in self._subs or fi in self._dense_row:
+                    sub_f.append(name)
+                if fi in self._aux_refs:
+                    aux_f.append(name)
+            matched.append(sub_f)
+            aux.append(aux_f)
             # hybrid decode: dense (high-degree) filters' shard slots
             # come from the device OR; low-degree filters' slots from
             # the host dict — O(actual deliveries) either way
@@ -448,4 +496,4 @@ class RouterModel:
                     v ^= low
             slots.append(sorted(out_slots))
         fallback = sorted(set(too_long) | set(np.nonzero(overflow)[0].tolist()))
-        return matched, slots, fallback
+        return matched, aux, slots, fallback

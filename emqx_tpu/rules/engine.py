@@ -22,6 +22,7 @@ from emqx_tpu.broker.hooks import Hooks
 from emqx_tpu.core import topic as T
 from emqx_tpu.core.message import Message
 from emqx_tpu.observe.metrics import MetricsWorker
+from emqx_tpu.router.trie import Trie
 from emqx_tpu.rules import events as EV
 from emqx_tpu.rules import funcs as rule_funcs
 from emqx_tpu.rules.runtime import apply_select, eval_expr
@@ -77,6 +78,14 @@ class RuleEngine:
         }
         self._console_out: list[dict] = []       # console sink (tests/CLI)
         self._hooked: Optional[Hooks] = None
+        # topic index over rule FROM filters: per-publish rule lookup is
+        # O(matched filters), not O(rules) — the emqx_rule_engine.erl
+        # :198-205 topic-index semantics (host side); with a RouterModel
+        # attached the same filters also co-batch into the device trie
+        # (BASELINE config 5) and arrive pre-matched via on_matched
+        self._pub_trie = Trie()
+        self._filter_rules: dict[str, set[str]] = {}   # filter → rule ids
+        self._model = None                             # RouterModel | None
 
     # -- rule CRUD (emqx_rule_engine API) -----------------------------------
 
@@ -90,20 +99,59 @@ class RuleEngine:
             elif t.startswith("$events/"):
                 raise ValueError(f"unknown event topic {t!r}")
             else:
-                T.validate_filter(t)
+                if not T.validate_filter(t):
+                    # reject BEFORE any state mutates — a late failure in
+                    # _index (device aux_register) would leave a
+                    # half-registered rule
+                    raise ValueError(f"invalid topic filter {t!r}")
                 publish_topics.append(t)
         rule = Rule(id=id, sql=sql, select=select, actions=list(actions),
                     enabled=enabled, description=description,
                     publish_topics=publish_topics,
                     event_topics=event_topics)
+        if id in self.rules:
+            self._unindex(self.rules[id])
         self.rules[id] = rule
+        self._index(rule)
         self.metrics.create_metrics(id, RULE_COUNTERS)
         return rule
 
     def delete_rule(self, id: str) -> bool:
         self.metrics.clear_metrics(id)
         rule_funcs.drop_rule_store(id)
-        return self.rules.pop(id, None) is not None
+        rule = self.rules.pop(id, None)
+        if rule is not None:
+            self._unindex(rule)
+        return rule is not None
+
+    def _index(self, rule: Rule) -> None:
+        for f in rule.publish_topics:
+            rids = self._filter_rules.setdefault(f, set())
+            if not rids:
+                self._pub_trie.insert(f)
+                if self._model is not None:
+                    self._model.aux_register(f)
+            rids.add(rule.id)
+
+    def _unindex(self, rule: Rule) -> None:
+        for f in rule.publish_topics:
+            rids = self._filter_rules.get(f)
+            if rids is None:
+                continue
+            rids.discard(rule.id)
+            if not rids:
+                del self._filter_rules[f]
+                self._pub_trie.delete(f)
+                if self._model is not None:
+                    self._model.aux_release(f)
+
+    def attach_model(self, model) -> None:
+        """Co-batch rule FROM filters into the device router's trie
+        (publish_batch then reports rule matches alongside fan-out —
+        BASELINE config 5)."""
+        self._model = model
+        for f in self._filter_rules:
+            model.aux_register(f)
 
     def get_rule(self, id: str) -> Optional[Rule]:
         return self.rules.get(id)
@@ -140,14 +188,24 @@ class RuleEngine:
             return None
         return cb
 
-    # -- the publish path (topic-indexed, emqx_rule_engine.erl:198-205) -----
+    # -- the publish path ----------------------------------------------------
 
     def rules_for_topic(self, topic: str) -> list[Rule]:
-        return [
-            r for r in self.rules.values()
-            if r.enabled and any(T.match(topic, f)
-                                 for f in r.publish_topics)
-        ]
+        """Trie-indexed lookup: O(matched filters), not O(rules)
+        (emqx_rule_engine.erl:198-205 get_rules_for_topic)."""
+        return self._rules_of(self._pub_trie.match(topic))
+
+    def _rules_of(self, filters) -> list[Rule]:
+        out: list[Rule] = []
+        seen: set[str] = set()
+        for f in filters:
+            for rid in self._filter_rules.get(f, ()):
+                if rid not in seen:
+                    seen.add(rid)
+                    rule = self.rules.get(rid)
+                    if rule is not None and rule.enabled:
+                        out.append(rule)
+        return out
 
     def ingest(self, msg: Message) -> None:
         """Feed a non-broker message into rule matching — the bridge
@@ -158,15 +216,37 @@ class RuleEngine:
     def _on_publish(self, msg: Message, *rest):
         if msg.topic.startswith("$SYS/"):
             return None
-        rules = self.rules_for_topic(msg.topic)
-        if rules:
-            cols = EV.message_columns(msg, self.node)
-            loop_guard = msg.headers.get("republish_by")
-            for rule in rules:
-                if rule.id == loop_guard:
-                    continue          # a rule never re-fires on its own
-                self._apply_rule(rule, cols)
+        if self._model is not None and msg.headers.get("rules_cobatch"):
+            # device batch in flight: the kernel matches this topic
+            # against the co-batched rule filters; the broker hands the
+            # result to on_matched — no second trie walk here
+            return None
+        self._fire(msg, self.rules_for_topic(msg.topic))
         return None
+
+    def on_matched(self, msg: Message, matched_filters) -> None:
+        """Device co-batch sink (broker.rules_matched_fn): the kernel
+        already matched ``msg.topic`` against the shared trie;
+        ``matched_filters`` maps to rules with dict lookups only.
+        ``None`` means the topic took the host-oracle fallback — match
+        on the host trie instead."""
+        if msg.topic.startswith("$SYS/"):
+            return
+        if matched_filters is None:
+            rules = self.rules_for_topic(msg.topic)
+        else:
+            rules = self._rules_of(matched_filters)
+        self._fire(msg, rules)
+
+    def _fire(self, msg: Message, rules: list[Rule]) -> None:
+        if not rules:
+            return
+        cols = EV.message_columns(msg, self.node)
+        loop_guard = msg.headers.get("republish_by")
+        for rule in rules:
+            if rule.id == loop_guard:
+                continue          # a rule never re-fires on its own
+            self._apply_rule(rule, cols)
 
     # -- evaluation (emqx_rule_runtime:apply_rules) --------------------------
 

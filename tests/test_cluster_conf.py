@@ -350,3 +350,30 @@ def test_adoption_fires_section_listeners():
         assert n2.app.shared.strategy == "local"
     finally:
         stop_nodes(nodes)
+
+
+def test_split_brain_tiebreak_is_by_entry_coordinator_not_sender():
+    """A node must reach the same adoption verdict no matter WHICH peer
+    delivers the winning log — the tie-break compares the conflicting
+    entries' committing coordinators."""
+    nodes = make_conf_cluster(["a", "b", "c"])
+    a, b, c = nodes
+    try:
+        a.app.config.put("mqtt.max_packet_size", 1)     # tnx 1 everywhere
+        # partition: {a} vs {b, c}
+        a._nodedown("b"); a._nodedown("c")
+        b._nodedown("a"); c._nodedown("a")
+        a.app.config.put("mqtt.max_packet_size", 100)   # coord a, tnx 2
+        b.app.config.put("mqtt.max_packet_size", 200)   # coord b, tnx 2
+        assert c.app.config.get("mqtt.max_packet_size") == 200
+        # heal: a receives the OTHER side's log from c (sender 'c' > 'a',
+        # but the conflicting entry's coord is 'b'... and a's own is 'a':
+        # 'a' < 'b' → side {b,c} must adopt side {a}; a keeps its log
+        # regardless of who the sender is)
+        a._mark_alive("c"); c._mark_alive("a")
+        b._mark_alive("a"); a._mark_alive("b")
+        for n in nodes:
+            assert n.app.config.get("mqtt.max_packet_size") == 100, n.name
+        assert {n.conf.cursor for n in nodes} == {2}
+    finally:
+        stop_nodes(nodes)

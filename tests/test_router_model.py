@@ -20,7 +20,7 @@ def make_model(mesh=None, n_sub_slots=256):
 
 def test_publish_batch_single_device():
     m = make_model()
-    matched, slots, fallback = m.publish_batch(["a/b/c", "x/y", "nope", "$SYS/x"])
+    matched, aux, slots, fallback = m.publish_batch(["a/b/c", "x/y", "nope", "$SYS/x"])
     assert fallback == []
     assert sorted(matched[0]) == ["#", "a/#", "a/+/c"]
     assert slots[0] == [3, 7, 200]
@@ -33,18 +33,18 @@ def test_publish_batch_single_device():
 def test_unsubscribe_updates_fanout():
     m = make_model()
     m.unsubscribe("a/#", 3)
-    matched, slots, _ = m.publish_batch(["a/q"])
+    matched, _aux, slots, _ = m.publish_batch(["a/q"])
     assert sorted(matched[0]) == ["#", "a/#"]
     assert slots[0] == [7, 200]
     m.unsubscribe("a/#", 7)   # last subscriber → filter drops out
-    matched, slots, _ = m.publish_batch(["a/q"])
+    matched, _aux, slots, _ = m.publish_batch(["a/q"])
     assert sorted(matched[0]) == ["#"]
 
 
 def test_batch_padding_no_phantom_matches():
     m = make_model()
     # 3 topics pad to a 64-bucket; padding rows must match nothing
-    matched, slots, _ = m.publish_batch(["q", "q", "q"])
+    matched, _aux, slots, _ = m.publish_batch(["q", "q", "q"])
     assert all(mm == ["#"] for mm in matched)
     assert len(matched) == 3
 
@@ -83,7 +83,7 @@ def test_randomized_model_vs_oracle(rng):
             oracle.insert(f)
         subs[f].add(slot)
     topics = ["/".join(rng.choice(words) for _ in range(rng.randint(1, 6))) for _ in range(128)]
-    matched, slots, fallback = m.publish_batch(topics)
+    matched, aux, slots, fallback = m.publish_batch(topics)
     for b, t in enumerate(topics):
         if b in fallback:
             continue
@@ -140,7 +140,7 @@ def test_incremental_deltas_vs_oracle(rng):
                     subs[f] = set()
                     oracle.insert(f)
                 subs[f].add(slot)
-        matched, slots, fallback = m.publish_batch(topics)
+        matched, aux, slots, fallback = m.publish_batch(topics)
         for b, t in enumerate(topics):
             if b in fallback:
                 continue
@@ -163,10 +163,10 @@ def test_incremental_growth_triggers_rebuild():
     # pile on distinct filters until the headroom runs out
     for i in range(3000):
         m.subscribe(f"grow/{i}/leaf", i % 64)
-    matched, _, _ = m.publish_batch(["grow/2999/leaf"])
+    matched, _aux, _, _ = m.publish_batch(["grow/2999/leaf"])
     assert matched[0] == ["grow/2999/leaf"]
     assert m.upload_count > uploads0            # grew via full rebuild
-    matched, _, _ = m.publish_batch(["seed/x"])
+    matched, _aux, _, _ = m.publish_batch(["seed/x"])
     assert matched[0] == ["seed/x"]
 
 
@@ -178,11 +178,11 @@ def test_incremental_filter_reinsert_after_delete(rng):
     m.subscribe("c/d", 2)
     m.publish_batch(["a/b"])
     m.unsubscribe("a/b", 1)             # filter drops out, fid freed
-    matched, _, _ = m.publish_batch(["a/b"])
+    matched, _aux, _, _ = m.publish_batch(["a/b"])
     assert matched[0] == []
     m.subscribe("e/f", 3)               # likely reuses the freed fid
     m.subscribe("a/b", 4)
-    matched, slots, _ = m.publish_batch(["a/b", "e/f", "c/d"])
+    matched, _aux, slots, _ = m.publish_batch(["a/b", "e/f", "c/d"])
     assert matched[0] == ["a/b"] and slots[0] == [4]
     assert matched[1] == ["e/f"] and slots[1] == [3]
     assert matched[2] == ["c/d"] and slots[2] == [2]
@@ -197,7 +197,7 @@ def test_dense_pool_promotion_and_demotion(rng):
     for s in range(40):                      # degree 40 > threshold 16
         m.subscribe("hot/topic", s)
     m.subscribe("cold/topic", 7)
-    matched, slots, _ = m.publish_batch(["hot/topic", "cold/topic"])
+    matched, _aux, slots, _ = m.publish_batch(["hot/topic", "cold/topic"])
     fid = m.index.fid_of("hot/topic")
     assert fid in m._dense_row               # promoted
     assert matched[0] == ["hot/topic"] and slots[0] == list(range(40))
@@ -206,13 +206,13 @@ def test_dense_pool_promotion_and_demotion(rng):
     for s in range(36):
         m.unsubscribe("hot/topic", s)
     assert fid not in m._dense_row           # demoted
-    matched, slots, _ = m.publish_batch(["hot/topic"])
+    matched, _aux, slots, _ = m.publish_batch(["hot/topic"])
     assert slots[0] == [36, 37, 38, 39]
     # pool row was freed and zeroed: a new hot filter reusing it must
     # not inherit stale bits
     for s in range(100, 120):
         m.subscribe("hot2/t", s)
-    matched, slots, _ = m.publish_batch(["hot2/t"])
+    matched, _aux, slots, _ = m.publish_batch(["hot2/t"])
     assert slots[0] == list(range(100, 120))
 
 
@@ -253,7 +253,7 @@ def test_hybrid_randomized_vs_oracle(rng):
         topics = ["/".join(rng.choice(words)
                            for _ in range(rng.randint(1, 5)))
                   for _ in range(64)]
-        matched, slots, fallback = m.publish_batch(topics)
+        matched, aux, slots, fallback = m.publish_batch(topics)
         for b, t in enumerate(topics):
             if b in fallback:
                 continue

@@ -317,3 +317,105 @@ def test_kv_store_bounded():
     finally:
         F.reset_rule_context(tok)
         F.drop_rule_store("rule_bound")
+
+
+# -- topic index + device co-batching (BASELINE config 5) ----------------------
+
+def _mk_engine():
+    from emqx_tpu.rules.engine import RuleEngine
+
+    return RuleEngine(node="n1")
+
+
+def test_rules_for_topic_is_trie_indexed():
+    e = _mk_engine()
+    e.create_rule("r1", 'SELECT * FROM "fleet/+/speed"', [])
+    e.create_rule("r2", 'SELECT * FROM "fleet/#"', [])
+    e.create_rule("r3", 'SELECT * FROM "other/x"', [])
+    e.create_rule("r4", 'SELECT * FROM "fleet/+/speed"', [])  # shared filter
+    got = sorted(r.id for r in e.rules_for_topic("fleet/v1/speed"))
+    assert got == ["r1", "r2", "r4"]
+    assert [r.id for r in e.rules_for_topic("other/x")] == ["r3"]
+    assert e.rules_for_topic("unrelated") == []
+    # disabled rules stay indexed but don't fire
+    e.rules["r2"].enabled = False
+    got = sorted(r.id for r in e.rules_for_topic("fleet/v1/speed"))
+    assert got == ["r1", "r4"]
+    # deleting one sharer keeps the filter; deleting both removes it
+    e.delete_rule("r1")
+    assert [r.id for r in e.rules_for_topic("fleet/v9/speed")] == ["r4"]
+    e.delete_rule("r4")
+    e.rules["r2"].enabled = True
+    assert [r.id for r in e.rules_for_topic("fleet/v9/speed")] == ["r2"]
+    assert "fleet/+/speed" not in e._filter_rules
+
+
+def test_device_cobatch_fires_rules_once():
+    """With a RouterModel attached, publish_batch matches rule filters in
+    the SAME kernel launch; the hook path must not double-fire."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.models.router_model import RouterModel
+
+    model = RouterModel(n_sub_slots=64)
+    app = BrokerApp(router_model=model)
+    fired = []
+    app.rules.register_action("record", lambda cols, args: fired.append(
+        cols["topic"]))
+    app.rules.create_rule(
+        "rb", 'SELECT topic FROM "fleet/+/speed"',
+        [{"function": "record", "args": {}}])
+    # rule filter must be co-batched into the device index
+    assert model.index.fid_of("fleet/+/speed") is not None
+    app.broker.subscribe("subA", "fleet/#")
+    out = app.broker.publish_batch([
+        Message(topic="fleet/v1/speed", payload=b"1"),
+        Message(topic="fleet/v1/other", payload=b"2"),
+    ])
+    assert fired == ["fleet/v1/speed"]          # exactly once, first msg only
+    assert "subA" in out[0] and "subA" in out[1]  # fan-out unaffected
+    # host-path publish still fires rules (hook path, host trie)
+    app.broker.publish(Message(topic="fleet/v2/speed", payload=b"3"))
+    assert fired == ["fleet/v1/speed", "fleet/v2/speed"]
+
+
+def test_cobatch_fallback_topic_still_fires_rules():
+    """A topic deeper than max_levels takes the host-oracle fallback —
+    rules must still fire for it (host trie via on_matched(None))."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.models.router_model import RouterModel
+    from emqx_tpu.router.index import TrieIndex
+
+    model = RouterModel(TrieIndex(max_levels=4), n_sub_slots=64)
+    app = BrokerApp(router_model=model)
+    fired = []
+    app.rules.register_action("record", lambda cols, args: fired.append(
+        cols["topic"]))
+    app.rules.create_rule(
+        "rf", 'SELECT topic FROM "deep/#"', [{"function": "record", "args": {}}])
+    deep = "deep/a/b/c/d/e/f"
+    app.broker.subscribe("subD", "deep/#")
+    out = app.broker.publish_batch([Message(topic=deep, payload=b"x")])
+    assert fired == [deep]
+    assert "subD" in out[0]
+
+
+def test_rule_filter_shared_with_subscription_survives_unsubscribe():
+    """A rule FROM filter that equals a live subscription's filter must
+    stay in the device index after the subscriber leaves (and vice
+    versa)."""
+    from emqx_tpu.models.router_model import RouterModel
+
+    model = RouterModel(n_sub_slots=64)
+    fid = model.aux_register("shared/+")
+    model.subscribe("shared/+", slot=3)
+    model.unsubscribe("shared/+", slot=3)
+    assert model.index.fid_of("shared/+") == fid      # aux ref keeps it
+    model.aux_release("shared/+")
+    assert model.index.fid_of("shared/+") is None     # now gone
+    # other direction: subscriber keeps it after rule release
+    model.subscribe("keep/+", slot=1)
+    model.aux_register("keep/+")
+    model.aux_release("keep/+")
+    assert model.index.fid_of("keep/+") is not None

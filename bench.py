@@ -247,6 +247,9 @@ def main() -> None:
     log(f"incremental update amortized (pipelined chain of {n_chain}): "
         f"{chain_ms:.2f} ms/update")
 
+    if os.environ.get("BENCH_SHARED", "1") != "0":
+        bench_shared_retained()
+
     if os.environ.get("BENCH_E2E", "1") != "0":
         bench_e2e()
 
@@ -256,6 +259,60 @@ def main() -> None:
         "unit": "topics/sec",
         "vs_baseline": round(topics_per_sec / 1_000_000, 3),
     }))
+
+
+def bench_shared_retained() -> None:
+    """BASELINE config 4: shared subscriptions + retained messages at
+    100K groups. Measures strategy-pick dispatch throughput across the
+    group table (emqx_shared_sub.erl:138-157) and wildcard retained
+    lookup against a populated store (emqx_retainer_index semantics)."""
+    import time as _time
+
+    from emqx_tpu.broker.shared_sub import SharedSub
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.services.retainer import Retainer
+
+    n_groups = int(os.environ.get("BENCH_GROUPS", 100_000))
+    members_per = int(os.environ.get("BENCH_GROUP_MEMBERS", 4))
+    rng = np.random.default_rng(7)
+
+    shared = SharedSub(node="bench", strategy="round_robin")
+    t0 = _time.time()
+    for g in range(n_groups):
+        topic = f"fleet/f{g % 512}/group{g}/+"
+        for m in range(members_per):
+            shared.join(f"g{g}", topic, f"sess-{g}-{m}", node="bench")
+    log(f"shared: {n_groups} groups x {members_per} members joined "
+        f"in {_time.time()-t0:.1f}s")
+
+    picks = rng.integers(0, n_groups, 50_000)
+    msg = Message(topic="x", payload=b"p")
+    t0 = _time.time()
+    n_dispatched = 0
+    for g in picks:
+        # dispatch is keyed by the subscribed FILTER (the route topic),
+        # exactly as broker._route hands it over
+        got = shared.dispatch(f"g{g}", f"fleet/f{g % 512}/group{g}/+",
+                              msg, deliver_fn=lambda s, n: True)
+        n_dispatched += len(got)
+    dt = _time.time() - t0
+    log(f"shared dispatch: {len(picks)/dt:,.0f} dispatches/sec "
+        f"@ {n_groups} groups ({n_dispatched} deliveries)")
+
+    retainer = Retainer(max_retained=n_groups + 10)
+    t0 = _time.time()
+    for g in range(n_groups):
+        retainer.store(Message(
+            topic=f"fleet/f{g % 512}/group{g}/state", payload=b"s",
+            flags={"retain": True}))
+    log(f"retainer: {n_groups} retained in {_time.time()-t0:.1f}s")
+    t0 = _time.time()
+    n_hits = 0
+    for f in range(512):
+        n_hits += len(retainer.match(f"fleet/f{f}/+/state"))
+    dt = _time.time() - t0
+    log(f"retained wildcard lookup: {512/dt:,.0f} lookups/sec "
+        f"({n_hits} total hits @ {n_groups} retained)")
 
 
 def bench_e2e() -> None:

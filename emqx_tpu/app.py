@@ -96,6 +96,7 @@ class BrokerApp:
             # (config 5): publish_batch feeds fan-out AND rule matching
             self.rules.attach_model(self.broker.model)
             self.broker.rules_matched_fn = self.rules.on_matched
+            self.broker.rules_gate_fn = self.rules.publish_gate
         from emqx_tpu.bridge.bridge import BridgeManager
         self.bridges = BridgeManager(
             rules=self.rules, publish_fn=self._publish_dispatch,

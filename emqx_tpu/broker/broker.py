@@ -127,8 +127,10 @@ class Broker:
         self.shared_dispatch = shared_dispatch
         # device co-batching sink for the rule engine (config 5): called
         # with (msg, matched_filters) after the kernel, or (msg, None)
-        # for fallback topics the kernel couldn't cover
+        # for fallback topics the kernel couldn't cover; rules_gate_fn
+        # brackets the batch's hook fold (RuleEngine.publish_gate)
         self.rules_matched_fn = None
+        self.rules_gate_fn = None
         self.slots = SlotRegistry(
             capacity=router_model.n_sub_slots
             if router_model is not None else 8192)
@@ -311,24 +313,33 @@ class Broker:
     ) -> list[dict[Sid, list[tuple[str, Message]]]]:
         """Device-path publish: one kernel launch for the whole batch
         (falls back to the host oracle per overflow/too-long topic)."""
-        cobatch = self.rules_matched_fn is not None and self.model is not None
+        cobatch = (self.rules_matched_fn is not None
+                   and self.rules_gate_fn is not None
+                   and self.model is not None)
         if cobatch:
             # the rule engine defers to the kernel's matches (delivered
             # via rules_matched_fn below) instead of matching in the
-            # message.publish hook — one trie walk for fan-out AND rules
-            for m in msgs:
-                m.headers["rules_cobatch"] = True
-        msgs = [
-            self.hooks.run_fold("message.publish", (), m) for m in msgs
-        ]
-        if cobatch:
-            for m in msgs:
-                if m is not None:
-                    m.headers.pop("rules_cobatch", None)
+            # message.publish hook — one trie walk for fan-out AND rules.
+            # Gated via thread-local state, NOT a message header: hooks
+            # may store copies (delayed queue, retainer) that a header
+            # would poison past this batch.
+            self.rules_gate_fn(True)
+        try:
+            msgs = [
+                self.hooks.run_fold("message.publish", (), m) for m in msgs
+            ]
+        finally:
+            if cobatch:
+                self.rules_gate_fn(False)
         live = []
         for i, m in enumerate(msgs):
             if m is None or m.headers.get("allow_publish") is False:
                 self._inc("messages.dropped")     # same as publish()
+                if cobatch and m is not None:
+                    # host-path hook order runs rules BEFORE the deny
+                    # (rules prio -50, retainer -100): a denied-but-real
+                    # message still rule-matches (host trie)
+                    self.rules_matched_fn(m, None)
             else:
                 live.append((i, m))
         out: list[dict[Sid, list[tuple[str, Message]]]] = [{} for _ in msgs]
@@ -351,7 +362,9 @@ class Broker:
                 out[i] = self._route(m.topic, m)   # oracle fallback
                 continue
             if cobatch:
-                self.rules_matched_fn(m, matched[j] + aux[j])
+                # aux alone suffices: every rule FROM filter is
+                # aux-registered (subscriber-shared ones included)
+                self.rules_matched_fn(m, aux[j])
             deliveries: dict[Sid, list[tuple[str, Message]]] = {}
             for slot in slots[j]:
                 for sid in self.slots.lookup_sids(slot):

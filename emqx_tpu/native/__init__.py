@@ -22,7 +22,14 @@ import threading
 from typing import Iterator, Optional
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "libemqx_native.so")
+
+# EMQX_NATIVE_SANITIZE=address|thread builds/loads a sanitized variant
+# (separate artifact; the sanitizer runtime must be LD_PRELOADed into the
+# interpreter — see tests/test_native_sanitizers.py for the harness)
+_SANITIZE = os.environ.get("EMQX_NATIVE_SANITIZE", "")
+_LIB_NAME = (f"libemqx_native.{_SANITIZE}.so" if _SANITIZE
+             else "libemqx_native.so")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), _LIB_NAME)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -45,6 +52,9 @@ def _build() -> None:
         os.path.join(_SRC_DIR, "host.cc"),
         "-o", _LIB_PATH,
     ]
+    if _SANITIZE:
+        cmd[1:1] = [f"-fsanitize={_SANITIZE}", "-g",
+                    "-fno-omit-frame-pointer"]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 

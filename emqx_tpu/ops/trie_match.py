@@ -97,6 +97,19 @@ def _edge_hash(parent: jax.Array, word: jax.Array, mask: int) -> jax.Array:
     return (h & jnp.uint32(mask)).astype(jnp.int32)
 
 
+def _edge_step(parent: jax.Array, word: jax.Array, mask: int) -> jax.Array:
+    """Double-hashing stride; must stay bit-identical to index.edge_step
+    (odd → coprime with the pow2 table)."""
+    h = (
+        parent.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+        ^ word.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+    )
+    h ^= h >> jnp.uint32(13)
+    h *= jnp.uint32(0x165667B1)
+    h ^= h >> jnp.uint32(16)
+    return ((h | jnp.uint32(1)) & jnp.uint32(mask)).astype(jnp.int32)
+
+
 def _probe_exact(
     trie: DeviceTrie, parent: jax.Array, word: jax.Array, max_probes: int
 ) -> jax.Array:
@@ -110,10 +123,11 @@ def _probe_exact(
     # where-clamp here triggers an XLA-TPU lowering cliff (~5× slower —
     # a select feeding a gather's index chain inside scan de-vectorizes)
     h = _edge_hash(parent, word, hmask)
+    step = _edge_step(parent, word, hmask)
     child = jnp.full_like(parent, -1)
     done = parent < 0
     for p in range(max_probes):
-        s = (h + p) & hmask
+        s = (h + p * step) & hmask
         slot_parent = _g(trie.ht_parent[s])
         hit = (slot_parent == parent) & (_g(trie.ht_word[s]) == word) & ~done
         child = jnp.where(hit, _g(trie.ht_child[s]), child)

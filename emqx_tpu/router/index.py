@@ -90,6 +90,23 @@ def edge_hash(parent: np.ndarray, word: np.ndarray, mask: int) -> np.ndarray:
         return (h & np.uint32(mask)).astype(np.int32)
 
 
+def edge_step(parent: np.ndarray, word: np.ndarray, mask: int) -> np.ndarray:
+    """Double-hashing probe stride for the edge key (odd → coprime with
+    the pow2 table, so the sequence visits distinct slots). Linear
+    probing's primary clustering made >8-probe chains common enough at
+    tens of millions of edges to force table doublings (r2's 10M build
+    grew the table 4×); per-key strides keep the probe bound honest at
+    4× load. Must match the device prober (ops/trie_match.py)."""
+    with np.errstate(over="ignore"):
+        p = parent.astype(np.uint32) * np.uint32(0xC2B2AE3D)
+        w = word.astype(np.uint32) * np.uint32(0x27D4EB2F)
+        h = p ^ w
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0x165667B1)
+        h ^= h >> np.uint32(16)
+        return ((h | np.uint32(1)) & np.uint32(mask)).astype(np.int32)
+
+
 @dataclass
 class TrieIndexArrays:
     """The device-side arrays (numpy here; moved to HBM by the matcher).
@@ -219,8 +236,9 @@ class TrieIndex:
         a = self.arrays
         mask = a.ht_parent.shape[0] - 1
         slot = int(edge_hash(np.int32(parent), np.int32(wid), mask))
+        step = int(edge_step(np.int32(parent), np.int32(wid), mask))
         for p in range(self.max_probes):
-            s = (slot + p) & mask
+            s = (slot + p * step) & mask
             sp = int(a.ht_parent[s])
             if sp == -1:
                 return None, s
@@ -376,8 +394,10 @@ class TrieIndex:
             for parent, edges in enumerate(children):
                 for wid, child in edges.items():
                     slot = int(edge_hash(np.int32(parent), np.int32(wid), mask))
+                    step = int(edge_step(np.int32(parent), np.int32(wid),
+                                         mask))
                     for probe in range(self.max_probes):
-                        s = (slot + probe) & mask
+                        s = (slot + probe * step) & mask
                         if ht_parent[s] == -1:
                             ht_parent[s] = parent
                             ht_word[s] = wid
@@ -434,26 +454,35 @@ class TrieIndex:
         word_lists = [T.words(self.filters[f]) for f in live_fids]
         L = self.max_levels
         # intern new words through the existing vocab (ids must stay
-        # stable — tokenize depends on them)
-        flat = [w for ws in word_lists for w in ws
-                if w not in (T.PLUS, T.HASH)]
-        if flat:
-            for w in np.unique(np.asarray(flat, object)):
-                self.intern(w)
+        # stable — tokenize depends on them); dict-dedupe + sorted for a
+        # deterministic id order (an object-dtype np.unique here cost a
+        # 30s python-string sort at 2M filters)
+        fresh = {w for ws in word_lists for w in ws
+                 if w not in (T.PLUS, T.HASH) and w not in self.vocab}
+        for w in sorted(fresh):
+            self.intern(w)
         F = len(live_fids)
         toks = np.full((F, max(1, L)), -1, np.int64)
-        lengths = np.zeros(F, np.int64)
-        hash_pos = np.full(F, -1, np.int64)
-        vocab = self.vocab
-        for i, ws in enumerate(word_lists):
-            lengths[i] = len(ws)
-            for j, w in enumerate(ws):
-                if w == T.HASH:
-                    hash_pos[i] = j
-                    break
-                if j < L:
-                    toks[i, j] = (PLUS_ID if w == T.PLUS else vocab[w])
+        lengths = np.fromiter(map(len, word_lists), np.int64, F)
+        # validate_filter guarantees '#' is only ever the LAST word, so
+        # hash detection is a tail check, not a scan
+        has_hash_l = np.fromiter(
+            (1 if ws and ws[-1] == T.HASH else 0 for ws in word_lists),
+            np.int64, F)
+        hash_pos = np.where(has_hash_l == 1, lengths - 1, -np.int64(1))
         eff_len = np.where(hash_pos >= 0, hash_pos, lengths)
+        # scatter the (depth-clipped) token ids in one shot
+        clip = np.minimum(eff_len, L)
+        vocab = self.vocab
+        flat_ids = np.fromiter(
+            (PLUS_ID if w == T.PLUS else vocab[w]
+             for ws, n in zip(word_lists, clip.tolist())
+             for w in ws[:n]),
+            np.int64)
+        rows = np.repeat(np.arange(F), clip)
+        ends = np.cumsum(clip)
+        cols = np.arange(len(flat_ids)) - np.repeat(ends - clip, clip)
+        toks[rows, cols] = flat_ids
 
         cur = np.zeros(F, np.int64)           # current node per filter
         n_nodes = 1
@@ -513,12 +542,13 @@ class TrieIndex:
             mask = size - 1
             home = edge_hash(ep.astype(np.int32), ew.astype(np.int32),
                              mask).astype(np.int64)
+            stride = edge_step(ep.astype(np.int32), ew.astype(np.int32),
+                               mask).astype(np.int64)
             unplaced = np.arange(n_edges)
-            ok = True
             for probe in range(self.max_probes):
                 if len(unplaced) == 0:
                     break
-                s = (home[unplaced] + probe) & mask
+                s = (home[unplaced] + probe * stride[unplaced]) & mask
                 free = ht_parent[s] == -1
                 cand = unplaced[free]
                 cs = s[free]
@@ -534,11 +564,13 @@ class TrieIndex:
                 # identifies the winner; losers retry at the next probe)
                 placed[free] = ht_child[cs] == ec[cand]
                 unplaced = unplaced[~placed]
-            else:
-                ok = len(unplaced) == 0
-            if ok and len(unplaced) == 0:
+            if len(unplaced) and self._kick_place(
+                    unplaced, ep, ew, ec, home, stride,
+                    ht_parent, ht_word, ht_child, mask):
+                unplaced = unplaced[:0]
+            if len(unplaced) == 0:
                 break
-            size *= 2
+            size *= 2                     # pathological fallback only
 
         self.arrays = TrieIndexArrays(
             ht_parent=ht_parent, ht_word=ht_word, ht_child=ht_child,
@@ -554,6 +586,45 @@ class TrieIndex:
         for v in self.pending.values():
             v.clear()
         return self.arrays
+
+    def _kick_place(self, unplaced, ep, ew, ec, home, stride,
+                    ht_parent, ht_word, ht_child, mask) -> bool:
+        """Depth-1 displacement for the rare edges whose whole probe
+        window is full (expected O(n·α^max_probes) ≈ a handful at 4×
+        headroom): evict one window occupant to the first EMPTY slot of
+        ITS OWN probe sequence and take its place.
+
+        Correctness of the device prober's stop-at-empty rule is
+        preserved: a kick only CONSUMES empties (the vacated slot is
+        immediately refilled by the stuck edge), so every key's probe
+        prefix stays fully occupied. Returns False if any edge stays
+        unplaceable (caller doubles the table — pathological hash
+        behaviour only)."""
+        for e in unplaced:
+            placed = False
+            for p in range(self.max_probes):
+                s = int((home[e] + p * stride[e]) & mask)
+                # the occupant's key is right there in the table — derive
+                # its probe sequence and find an empty alternative
+                op, ow = np.int32(ht_parent[s]), np.int32(ht_word[s])
+                oh = int(edge_hash(op, ow, mask))
+                ostep = int(edge_step(op, ow, mask))
+                for p2 in range(self.max_probes):
+                    s2 = (oh + p2 * ostep) & mask
+                    if ht_parent[s2] == -1:
+                        ht_parent[s2] = op
+                        ht_word[s2] = ow
+                        ht_child[s2] = ht_child[s]
+                        ht_parent[s] = ep[e]
+                        ht_word[s] = ew[e]
+                        ht_child[s] = ec[e]
+                        placed = True
+                        break
+                if placed:
+                    break
+            if not placed:
+                return False
+        return True
 
     def ensure(self) -> TrieIndexArrays:
         if self.needs_rebuild or self.arrays is None:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -86,6 +87,12 @@ class RuleEngine:
         self._pub_trie = Trie()
         self._filter_rules: dict[str, set[str]] = {}   # filter → rule ids
         self._model = None                             # RouterModel | None
+        # device co-batch gate: while the broker folds a device batch's
+        # message.publish hooks ON THIS THREAD, _on_publish defers to
+        # on_matched. Thread-local state (not a message header): a header
+        # would leak into copies other hooks store (e.g. the delayed
+        # queue) and silently suppress rules on their later republish
+        self._gate = threading.local()
 
     # -- rule CRUD (emqx_rule_engine API) -----------------------------------
 
@@ -213,10 +220,15 @@ class RuleEngine:
         where rules fire without a broker publish."""
         self._on_publish(msg)
 
+    def publish_gate(self, on: bool) -> None:
+        """broker.publish_batch brackets its hook fold with this so the
+        kernel (not the hook) does the matching for batched messages."""
+        self._gate.on = on
+
     def _on_publish(self, msg: Message, *rest):
         if msg.topic.startswith("$SYS/"):
             return None
-        if self._model is not None and msg.headers.get("rules_cobatch"):
+        if self._model is not None and getattr(self._gate, "on", False):
             # device batch in flight: the kernel matches this topic
             # against the co-batched rule filters; the broker hands the
             # result to on_matched — no second trie walk here

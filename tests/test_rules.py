@@ -419,3 +419,58 @@ def test_rule_filter_shared_with_subscription_survives_unsubscribe():
     model.aux_register("keep/+")
     model.aux_release("keep/+")
     assert model.index.fid_of("keep/+") is not None
+
+
+def test_delayed_message_from_device_batch_still_fires_rules():
+    """r3 review regression guard: the co-batch gate must not leak into
+    messages hooks store (the delayed queue) — their later republish on
+    the host path must still rule-match."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.models.router_model import RouterModel
+
+    model = RouterModel(n_sub_slots=64)
+    app = BrokerApp(router_model=model)
+    fired = []
+    app.rules.register_action("record", lambda cols, args: fired.append(
+        cols["topic"]))
+    app.rules.create_rule(
+        "rd", 'SELECT topic FROM "sensor/t"',
+        [{"function": "record", "args": {}}])
+    # $delayed publish enters through the DEVICE batch path
+    app.broker.publish_batch(
+        [Message(topic="$delayed/1/sensor/t", payload=b"x")])
+    assert fired == []                       # intercepted, queued
+    assert len(app.delayed) == 1
+    # force the due-time and tick the delayed service (host republish)
+    due, seq, msg = app.delayed._heap[0]
+    app.delayed._heap[0] = (0, seq, msg)
+    app.delayed.tick(now=1)
+    assert fired == ["sensor/t"], "rule suppressed after delayed republish"
+
+
+def test_denied_publish_still_fires_rules_on_device_path():
+    """Host hook order runs rules before a deny (retainer-style
+    allow_publish=False); the device path must match that."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.models.router_model import RouterModel
+
+    model = RouterModel(n_sub_slots=64)
+    app = BrokerApp(router_model=model)
+    fired = []
+    app.rules.register_action("record", lambda cols, args: fired.append(
+        cols["topic"]))
+    app.rules.create_rule(
+        "rx", 'SELECT topic FROM "audit/#"',
+        [{"function": "record", "args": {}}])
+
+    def deny(msg):
+        msg.headers["allow_publish"] = False
+        return msg
+
+    app.hooks.add("message.publish", deny, priority=-200)  # after rules
+    out = app.broker.publish_batch(
+        [Message(topic="audit/evt", payload=b"x")])
+    assert out == [{}]                        # routing denied
+    assert fired == ["audit/evt"], "rules must fire before the deny"

@@ -236,6 +236,43 @@ class BrokerApp:
         from emqx_tpu.access.authz import Authz, BuiltinSource, FileSource
         from emqx_tpu.access.control import AccessControl
 
+        def _hash_spec(spec):
+            from emqx_tpu.access.hashing import HashSpec
+            alg = spec.get("password_hash_algorithm") or {}
+            if isinstance(alg, str):
+                alg = {"name": alg}
+            kw = {}
+            for field, conv in (("salt_position", str), ("mac_fun", str),
+                                ("iterations", int), ("dk_length", int),
+                                ("salt_rounds", int)):
+                if alg.get(field) is not None:
+                    kw[field] = conv(alg[field])
+            return HashSpec(name=alg.get("name", "plain"), **kw)
+
+        def _db_client(backend, spec):
+            if backend == "redis":
+                from emqx_tpu.connector.redis import RedisClient
+                host, _, port = str(
+                    spec.get("server", "127.0.0.1:6379")).partition(":")
+                return RedisClient(host, int(port or 6379),
+                                   password=spec.get("password") or None,
+                                   db=int(spec.get("database", 0) or 0))
+            host, _, port = str(spec.get("server", "")).partition(":")
+            kw = dict(host=host or "127.0.0.1",
+                      database=spec.get("database", "mqtt"))
+            if backend == "mysql":
+                from emqx_tpu.connector.mysql import MySqlClient
+                return MySqlClient(port=int(port or 3306),
+                                   user=spec.get("username", "root"),
+                                   password=spec.get("password", ""), **kw)
+            if backend == "postgresql":
+                from emqx_tpu.connector.pgsql import PgClient
+                return PgClient(port=int(port or 5432),
+                                user=spec.get("username", "postgres"),
+                                password=spec.get("password", ""), **kw)
+            from emqx_tpu.connector.mongodb import MongoClient
+            return MongoClient(port=int(port or 27017), **kw)
+
         providers = []
         for spec in conf.get("authentication", []) or []:
             mech = spec.get("mechanism", "password_based")
@@ -251,6 +288,26 @@ class BrokerApp:
                     p.add_user(u["user_id"], u["password"],
                                bool(u.get("is_superuser")))
                 providers.append(p)
+            elif mech == "password_based" and backend == "redis":
+                from emqx_tpu.access.redis_backends import RedisAuthnProvider
+                cmd = spec.get("cmd")
+                providers.append(RedisAuthnProvider(
+                    _db_client("redis", spec),
+                    cmd=cmd.split() if isinstance(cmd, str) else cmd,
+                    hash_spec=_hash_spec(spec)))
+            elif mech == "password_based" and backend in (
+                    "mysql", "postgresql"):
+                from emqx_tpu.access.db_backends import SqlAuthnProvider
+                providers.append(SqlAuthnProvider(
+                    _db_client(backend, spec), query=spec.get("query"),
+                    hash_spec=_hash_spec(spec), backend=backend))
+            elif mech == "password_based" and backend == "mongodb":
+                from emqx_tpu.access.db_backends import MongoAuthnProvider
+                providers.append(MongoAuthnProvider(
+                    _db_client("mongodb", spec),
+                    collection=spec.get("collection", "mqtt_user"),
+                    filter_=spec.get("filter"),
+                    hash_spec=_hash_spec(spec)))
             # unknown specs are skipped (optional backends not built)
         sources = []
         for spec in conf.get("authorization.sources", []) or []:
@@ -259,6 +316,23 @@ class BrokerApp:
                 sources.append(FileSource.parse(spec["rules"]))
             elif stype == "built_in_database":
                 sources.append(BuiltinSource())
+            elif stype == "redis":
+                from emqx_tpu.access.redis_backends import RedisAclSource
+                cmd = spec.get("cmd")
+                sources.append(RedisAclSource(
+                    _db_client("redis", spec),
+                    cmd=cmd.split() if isinstance(cmd, str) else cmd))
+            elif stype in ("mysql", "postgresql"):
+                from emqx_tpu.access.db_backends import SqlAclSource
+                sources.append(SqlAclSource(
+                    _db_client(stype, spec), query=spec.get("query"),
+                    backend=stype))
+            elif stype == "mongodb":
+                from emqx_tpu.access.db_backends import MongoAclSource
+                sources.append(MongoAclSource(
+                    _db_client("mongodb", spec),
+                    collection=spec.get("collection", "mqtt_acl"),
+                    filter_=spec.get("filter")))
         az_conf = conf.get("authorization")
         fl = conf.get("flapping_detect")
         ac = AccessControl(

@@ -24,6 +24,13 @@ from emqx_tpu.core.message import Message
 from emqx_tpu.resource.resource import ResourceManager
 from emqx_tpu.resource.worker import BufferWorker
 from emqx_tpu.rules.engine import render_template
+
+
+def _json_safe(columns: dict) -> dict:
+    """Bytes → str so a rendered request survives the buffer worker's
+    JSON disk-queue codec (one rule for every renderer branch)."""
+    return {k: (v.decode("utf-8", "replace") if isinstance(v, bytes)
+                else v) for k, v in columns.items()}
 from emqx_tpu.rules.events import message_columns
 
 BRIDGE_HOOK_PREFIX = "$bridges"
@@ -75,6 +82,24 @@ class Bridge:
             tmpl = c.get("command_template") or [
                 "LPUSH", "mqtt:${topic}", "${payload}"]
             return {"cmd": [render_template(x, columns) for x in tmpl]}
+        if self.type in ("mysql", "pgsql", "postgresql"):
+            # emqx_ee_bridge_mysql/pgsql: one INSERT per message from a
+            # sql template (client-side bound, connector/pgsql.render_sql)
+            tmpl = c.get("sql") or (
+                "INSERT INTO mqtt_msg (topic, qos, payload) VALUES "
+                "(${topic}, ${qos}, ${payload})")
+            return {"sql": tmpl, "binds": _json_safe(columns)}
+        if self.type == "mongodb":
+            # emqx_ee_bridge_mongodb: payload template → one document
+            coll = c.get("collection", "mqtt_msg")
+            tmpl = c.get("payload_template")
+            if tmpl:
+                doc = {"payload": render_template(tmpl, columns)}
+            else:
+                doc = {k: v for k, v in _json_safe(columns).items()
+                       if isinstance(v, (str, int, float, bool))
+                       or v is None}
+            return {"insert": coll, "documents": [doc]}
         if self.type == "influxdb":
             # emqx_ee_bridge_influxdb: write_syntax template → one line
             # of line protocol, shipped over the HTTP connector's /write
@@ -88,8 +113,7 @@ class Bridge:
             }
         # generic connectors take the columns (bytes decoded — requests
         # must survive the buffer worker's JSON disk codec)
-        return {k: (v.decode("utf-8", "replace") if isinstance(v, bytes)
-                    else v) for k, v in columns.items()}
+        return _json_safe(columns)
 
     def send(self, columns: dict) -> bool:
         if not self.enabled:

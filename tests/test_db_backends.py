@@ -1,0 +1,270 @@
+"""MySQL / PostgreSQL / MongoDB stacks: wire clients against the in-repo
+protocol-faithful mini servers, authn providers + authz sources through a
+real broker CONNECT/PUBLISH, and data bridges fed by rules (the
+reference's authn/authz/bridge suites run against real containers —
+SURVEY §4.5; these miniatures speak the real protocols)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.server import BrokerServer
+from emqx_tpu.config.config import Config
+from emqx_tpu.connector.mongodb import (MiniMongo, MongoClient,
+                                        MongoConnector, bson_decode,
+                                        bson_encode)
+from emqx_tpu.connector.mysql import MiniMySQL, MySqlClient, MySqlConnector
+from emqx_tpu.connector.pgsql import (MiniPg, PgClient, PgConnector,
+                                      quote_literal, render_sql)
+from emqx_tpu.mqtt.client import MqttClient
+
+
+USERS = [{"username": "alice", "password_hash": "pw-alice", "salt": "",
+          "is_superuser": "0"}]
+ACL = [
+    {"username": "alice", "permission": "allow", "action": "publish",
+     "topic": "up/${username}/#"},
+    {"username": "alice", "permission": "allow", "action": "subscribe",
+     "topic": "up/#"},
+    {"username": "alice", "permission": "deny", "action": "publish",
+     "topic": "#"},
+]
+
+
+# -- wire clients --------------------------------------------------------------
+
+def test_pg_wire_roundtrip():
+    srv = MiniPg(password="pgpass").start()
+    try:
+        srv.tables["t"] = [{"a": "1", "b": None}, {"a": "o'brien", "b": "x"}]
+        c = PgClient(port=srv.port, user="emqx", password="pgpass")
+        assert c.query("SELECT 1")[1] == [["1"]]
+        cols, rows = c.query("SELECT a, b FROM t WHERE a = 'o''brien'")
+        assert cols == ["a", "b"] and rows == [["o'brien", "x"]]
+        # NULL round-trips as None
+        assert c.query("SELECT b FROM t WHERE a = '1'")[1] == [[None]]
+        c.query("INSERT INTO logs (m) VALUES ('hi')")
+        assert srv.tables["logs"] == [{"m": "hi"}]
+        with pytest.raises(Exception):
+            PgClient(port=srv.port, password="bad").query("SELECT 1")
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_mysql_wire_roundtrip():
+    srv = MiniMySQL(user="emqx", password="mypass").start()
+    try:
+        srv.tables["t"] = [{"a": "v1", "n": None}]
+        c = MySqlClient(port=srv.port, user="emqx", password="mypass")
+        assert c.query("SELECT 1")[1] == [["1"]]
+        cols, rows = c.query("SELECT a, n FROM t WHERE a = 'v1'")
+        assert cols == ["a", "n"] and rows == [["v1", None]]
+        c.query("INSERT INTO logs (m) VALUES ('hey')")
+        assert srv.tables["logs"] == [{"m": "hey"}]
+        from emqx_tpu.connector.mysql import MySqlError
+        with pytest.raises(MySqlError):
+            MySqlClient(port=srv.port, user="emqx",
+                        password="bad").query("SELECT 1")
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_bson_roundtrip_and_mongo_wire():
+    doc = {"s": "x", "i": 3, "big": 1 << 40, "f": 1.5, "t": True,
+           "n": None, "sub": {"a": 1}, "arr": ["p", 2], "bin": b"\x00\x01"}
+    assert bson_decode(bson_encode(doc))[0] == doc
+    srv = MiniMongo().start()
+    try:
+        srv.collections["c"] = [{"k": "v", "n": 7}]
+        c = MongoClient(port=srv.port)
+        assert c.command({"ping": 1})["ok"] == 1.0
+        assert c.find("c", {"k": "v"}) == [{"k": "v", "n": 7}]
+        assert c.find("c", {"k": "zz"}) == []
+        assert c.insert("c2", [{"a": 1}, {"a": 2}]) == 2
+        assert len(srv.collections["c2"]) == 2
+        from emqx_tpu.connector.mongodb import MongoError
+        with pytest.raises(MongoError):
+            c.command({"nonsense": 1})
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_sql_literal_quoting():
+    assert quote_literal("a'b") == "'a''b'"
+    assert quote_literal(None) == "NULL"
+    assert quote_literal(5) == "5"
+    assert render_sql("SELECT x WHERE u = ${u}", {"u": "a'; DROP --"}) \
+        == "SELECT x WHERE u = 'a''; DROP --'"
+
+
+# -- connector resources -------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["pgsql", "mysql", "mongodb"])
+def test_connector_health_and_query(kind):
+    if kind == "pgsql":
+        srv = MiniPg().start()
+        conn = PgConnector(port=srv.port)
+    elif kind == "mysql":
+        srv = MiniMySQL().start()
+        conn = MySqlConnector(port=srv.port, user="root", password="")
+    else:
+        srv = MiniMongo().start()
+        conn = MongoConnector(port=srv.port)
+    try:
+        conn.on_start({})
+        assert conn.on_health_check()
+        if kind == "mongodb":
+            assert conn.on_query(
+                {"insert": "x", "documents": [{"a": 1}]}) == 1
+            assert conn.on_query({"find": "x", "filter": {"a": 1}}) \
+                == [{"a": 1}]
+        else:
+            conn.on_query({"sql": "INSERT INTO x (a) VALUES (${a})",
+                           "binds": {"a": "1"}})
+            cols, rows = conn.on_query("SELECT a FROM x")
+            assert rows == [["1"]]
+        conn.on_stop()
+        # clients reconnect lazily — a health check after stop re-opens
+        # (same as the reference's pooled clients)
+        assert conn.on_health_check()
+    finally:
+        srv.stop()
+
+
+# -- authn / authz through a live broker ---------------------------------------
+
+def _db_spec(kind, srv):
+    if kind == "mysql":
+        return {"mechanism": "password_based", "backend": "mysql",
+                "server": f"127.0.0.1:{srv.port}", "username": "root",
+                "password": "", "database": "mqtt"}
+    if kind == "postgresql":
+        return {"mechanism": "password_based", "backend": "postgresql",
+                "server": f"127.0.0.1:{srv.port}", "username": "postgres",
+                "password": "", "database": "mqtt"}
+    return {"mechanism": "password_based", "backend": "mongodb",
+            "server": f"127.0.0.1:{srv.port}", "database": "mqtt"}
+
+
+def _seed(kind, srv):
+    if kind == "mongodb":
+        srv.collections["mqtt_user"] = [
+            {"username": "alice", "password_hash": "pw-alice",
+             "salt": "", "is_superuser": False}]
+        srv.collections["mqtt_acl"] = [
+            {"username": "alice", "permission": "allow",
+             "action": "publish", "topics": ["up/${username}/#"]},
+            {"username": "alice", "permission": "allow",
+             "action": "subscribe", "topics": ["up/#"]},
+            {"username": "alice", "permission": "deny",
+             "action": "publish", "topics": ["#"]}]
+    else:
+        srv.tables["mqtt_user"] = [dict(u) for u in USERS]
+        srv.tables["mqtt_acl"] = [dict(r) for r in ACL]
+
+
+@pytest.mark.parametrize("kind", ["mysql", "postgresql", "mongodb"])
+def test_authn_authz_via_live_broker(kind):
+    srv = {"mysql": MiniMySQL(user="root", password=""),
+           "postgresql": MiniPg(),
+           "mongodb": MiniMongo()}[kind].start()
+    _seed(kind, srv)
+
+    async def main():
+        conf = Config()
+        conf.init_load("authorization { no_match = deny }")
+        conf.put("authentication", [_db_spec(kind, srv)], layer="local")
+        spec = dict(_db_spec(kind, srv))
+        spec["type"] = kind
+        conf.put("authorization.sources", [spec], layer="local")
+        app = BrokerApp.from_config(conf)
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+
+        bad = MqttClient(port=server.port, clientid="b1", proto_ver=5,
+                         username="alice", password=b"wrong")
+        with pytest.raises(ConnectionRefusedError):
+            await bad.connect()
+
+        good = MqttClient(port=server.port, clientid="g1", proto_ver=5,
+                          username="alice", password=b"pw-alice")
+        ack = await good.connect()
+        assert ack.reason_code == 0, f"{kind}: good password rejected"
+
+        # authz: allow up/alice/#, deny everything else (deny row + fold)
+        sub = MqttClient(port=server.port, clientid="s1", proto_ver=5,
+                         username="alice", password=b"pw-alice")
+        await sub.connect()
+        await sub.subscribe("up/#", qos=0)   # no_match deny? subscribe...
+        await good.publish("up/alice/data", b"ok", qos=0)
+        await good.publish("other/topic", b"denied", qos=0)
+        try:
+            msg = await asyncio.wait_for(sub.messages.get(), 5)
+            assert msg.topic == "up/alice/data"
+        finally:
+            await good.disconnect()
+            await sub.disconnect()
+            await server.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        srv.stop()
+
+
+# -- bridges -------------------------------------------------------------------
+
+def test_sql_bridge_inserts_per_message():
+    srv = MiniPg().start()
+    try:
+        app = BrokerApp()
+        app.bridges.create(
+            "pgsql", "audit", PgConnector(port=srv.port),
+            {"sql": "INSERT INTO mqtt_msg (topic, payload) VALUES "
+                    "(${topic}, ${payload})"},
+            batch_size=1, batch_time_s=0.0)
+        app.rules.create_rule(
+            "to-pg", 'SELECT topic, payload FROM "audit/#"',
+            [{"function": "pgsql:audit", "args": {}}])
+        from emqx_tpu.core.message import Message
+        app.broker.publish(Message(topic="audit/x", payload=b"evt-1"))
+        app.bridges.tick()
+        deadline = 50
+        while not srv.tables.get("mqtt_msg") and deadline:
+            import time
+            time.sleep(0.1)
+            app.bridges.tick()
+            deadline -= 1
+        assert srv.tables.get("mqtt_msg") == [
+            {"topic": "audit/x", "payload": "evt-1"}]
+    finally:
+        srv.stop()
+
+
+def test_mongo_bridge_inserts_documents():
+    srv = MiniMongo().start()
+    try:
+        app = BrokerApp()
+        app.bridges.create(
+            "mongodb", "sink", MongoConnector(port=srv.port),
+            {"collection": "mqtt_msg"}, batch_size=1, batch_time_s=0.0)
+        app.rules.create_rule(
+            "to-mongo", 'SELECT topic, payload FROM "m/#"',
+            [{"function": "mongodb:sink", "args": {}}])
+        from emqx_tpu.core.message import Message
+        app.broker.publish(Message(topic="m/1", payload=b"doc-1"))
+        deadline = 50
+        while not srv.collections.get("mqtt_msg") and deadline:
+            import time
+            time.sleep(0.1)
+            app.bridges.tick()
+            deadline -= 1
+        docs = srv.collections.get("mqtt_msg")
+        assert docs and docs[0]["topic"] == "m/1" \
+            and docs[0]["payload"] == "doc-1"
+    finally:
+        srv.stop()

@@ -136,6 +136,94 @@ def uri_path_opts(path: str) -> list:
             for seg in path.split("/") if seg]
 
 
+# -- transport machine (emqx_coap_tm.erl) -----------------------------------
+
+ACK_TIMEOUT = 2.0            # RFC 7252 §4.8
+ACK_RANDOM_FACTOR = 1.5
+MAX_RETRANSMIT = 4
+EXCHANGE_LIFETIME = 247.0    # §4.8.2 — dedup window for CON exchanges
+NON_LIFETIME = 145.0
+
+
+class TransportManager:
+    """Per-endpoint CoAP message-layer state (emqx_coap_tm.erl):
+
+    - **inbound dedup**: a retransmitted CON (same mid inside
+      EXCHANGE_LIFETIME) gets the CACHED response replayed instead of
+      re-executing the request (publish/subscribe are not idempotent);
+      duplicate NONs are dropped silently.
+    - **outbound reliability**: CON messages we originate are tracked
+      until ACK/RST, retransmitted with exponential backoff
+      (ACK_TIMEOUT×ACK_RANDOM_FACTOR, doubling, MAX_RETRANSMIT tries);
+      a give-up surfaces the mids so the channel can cancel state
+      (e.g. drop a dead observer, §4.2).
+    """
+
+    def __init__(self, now_fn=None) -> None:
+        import time as _time
+        self.now = now_fn or _time.monotonic
+        # inbound: mid → (cached response frames, expire_at)
+        self._seen: dict[int, tuple[list, float]] = {}
+        # outbound: mid → [msg, tries, next_at, timeout]
+        self._pending: dict[int, list] = {}
+
+    # -- inbound dedup -------------------------------------------------------
+
+    def dedup(self, m: CoapMessage):
+        """None = fresh message; list = replay this cached response."""
+        hit = self._seen.get(m.mid)
+        if hit is None:
+            return None
+        frames, expire_at = hit
+        if self.now() >= expire_at:
+            del self._seen[m.mid]
+            return None
+        return frames        # may be [] (duplicate NON → drop silently)
+
+    def remember(self, m: CoapMessage, response: list) -> None:
+        life = EXCHANGE_LIFETIME if m.type == CON else NON_LIFETIME
+        self._seen[m.mid] = (list(response), self.now() + life)
+
+    # -- outbound CON reliability -------------------------------------------
+
+    def track(self, m: CoapMessage) -> CoapMessage:
+        if m.type == CON:
+            timeout = ACK_TIMEOUT * ACK_RANDOM_FACTOR
+            self._pending[m.mid] = [m, 0, self.now() + timeout, timeout]
+        return m
+
+    def on_ack(self, mid: int) -> bool:
+        return self._pending.pop(mid, None) is not None
+
+    on_rst = on_ack
+
+    def tick(self) -> tuple[list[CoapMessage], list[int]]:
+        """(messages to retransmit now, mids given up on)."""
+        now = self.now()
+        retx: list[CoapMessage] = []
+        gave_up: list[int] = []
+        for mid, st in list(self._pending.items()):
+            msg, tries, next_at, timeout = st
+            if now < next_at:
+                continue
+            if tries >= MAX_RETRANSMIT:
+                del self._pending[mid]
+                gave_up.append(mid)
+                continue
+            st[1] = tries + 1
+            st[3] = timeout * 2
+            st[2] = now + st[3]
+            retx.append(msg)
+        # dedup-cache GC rides the same tick
+        for mid, (_f, exp) in list(self._seen.items()):
+            if now >= exp:
+                del self._seen[mid]
+        return retx, gave_up
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+
 class Channel(GwChannel):
     """One CoAP endpoint (per UDP peer)."""
 
@@ -145,10 +233,12 @@ class Channel(GwChannel):
         self.ctx = ctx
         self.conn_state = "connected"       # connectionless transport
         self.clientid: Optional[str] = None
-        self.observers: dict[str, bytes] = {}     # topic -> token
+        self.observers: dict[str, tuple[bytes, int]] = {}  # topic→(token,qos)
         self._obs_seq = 0
         self._mid = 0
         self._registered = False
+        self.tm = TransportManager()
+        self._con_topic: dict[int, str] = {}   # pending notify mid → topic
 
     def _next_mid(self) -> int:
         self._mid = self._mid % 0xFFFF + 1
@@ -170,8 +260,37 @@ class Channel(GwChannel):
     # -- inbound -------------------------------------------------------------
 
     def handle_in(self, m: CoapMessage) -> list[CoapMessage]:
-        if m.type == RST or m.code == EMPTY:
+        if m.code == EMPTY and m.type == CON:
+            # CoAP ping (RFC 7252 §4.3): pong with RST. The client's mid
+            # space is independent of ours — it must NOT settle a
+            # pending notify that happens to share the number.
+            return [CoapMessage(RST, EMPTY, m.mid, b"")]
+        if m.type in (ACK, RST):
+            # message-layer signal for a CON we originated (notify):
+            # ACK settles it; RST additionally cancels the observation
+            # (RFC 7641 §3.6 / emqx_coap_tm ack handling)
+            if m.type == RST:
+                self.tm.on_rst(m.mid)
+                self._cancel_observe(self._con_topic.pop(m.mid, None))
+            else:
+                self.tm.on_ack(m.mid)
+                self._con_topic.pop(m.mid, None)
             return []
+        if m.code == EMPTY:
+            return []               # NON empty: nothing to do
+        cached = self.tm.dedup(m)
+        if cached is not None:
+            return list(cached)     # retransmitted request: replay reply
+        out = self._handle_request(m)
+        self.tm.remember(m, out)
+        return out
+
+    def _cancel_observe(self, topic: Optional[str]) -> None:
+        if topic is not None and topic in self.observers:
+            del self.observers[topic]
+            self.ctx.unsubscribe(self.clientid, topic)
+
+    def _handle_request(self, m: CoapMessage) -> list[CoapMessage]:
         reply_type = ACK if m.type == CON else NON
         path = m.uri_path()
 
@@ -196,15 +315,15 @@ class Channel(GwChannel):
         if m.code == GET:
             obs = m.observe()
             if obs == 0:
-                self.observers[topic] = m.token
-                self.ctx.subscribe(self.clientid, topic,
-                                   qos=int(m.queries().get("qos", 0)))
+                qos = int(m.queries().get("qos", 0))
+                self.observers[topic] = (m.token, qos)
+                self.ctx.subscribe(self.clientid, topic, qos=qos)
                 self._obs_seq += 1
                 return [reply(CONTENT, options=[
                     (OPT_OBSERVE, self._obs_seq.to_bytes(3, "big"))])]
             if obs == 1:
-                self.observers.pop(topic, None)
-                self.ctx.unsubscribe(self.clientid, topic)
+                self._cancel_observe(topic if topic in self.observers
+                                     else None)
                 return [reply(CONTENT)]
             # plain read: latest retained message on the topic
             msgs = getattr(self.ctx.app, "retainer", None)
@@ -223,20 +342,37 @@ class Channel(GwChannel):
         out = []
         for sub_topic, msg in deliveries:
             plain = self.ctx.unmount(msg.topic)
-            token = None
-            for obs_topic, tok in self.observers.items():
+            token = qos = obs_topic_hit = None
+            for obs_topic, (tok, q) in self.observers.items():
                 from emqx_tpu.core import topic as T
                 if T.match(plain, obs_topic):
-                    token = tok
+                    token, qos, obs_topic_hit = tok, q, obs_topic
                     break
             if token is None:
                 continue
             self._obs_seq += 1
-            out.append(CoapMessage(
-                NON, CONTENT, self._next_mid(), token,
+            # QoS≥1 subscriptions notify as CON: tracked, retransmitted,
+            # observation cancelled on RST or give-up (emqx_coap
+            # notify_type per-subscription qos)
+            mtype = CON if qos else NON
+            mid = self._next_mid()
+            note = CoapMessage(
+                mtype, CONTENT, mid, token,
                 [(OPT_OBSERVE, self._obs_seq.to_bytes(3, "big"))],
-                msg.payload))
+                msg.payload)
+            if mtype == CON:
+                self.tm.track(note)
+                self._con_topic[mid] = obs_topic_hit
+            out.append(note)
         return out
+
+    def housekeep(self) -> list[CoapMessage]:
+        """Listener tick: retransmit due CONs; a give-up drops the dead
+        observer (RFC 7641 §4.5 — stop notifying unresponsive clients)."""
+        retx, gave_up = self.tm.tick()
+        for mid in gave_up:
+            self._cancel_observe(self._con_topic.pop(mid, None))
+        return retx
 
     def terminate(self, reason: str) -> None:
         if self._registered:

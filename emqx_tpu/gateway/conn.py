@@ -128,17 +128,20 @@ class UdpGwListener(asyncio.DatagramProtocol):
     def __init__(self, make_channel: Callable[[], GwChannel],
                  frame: GwFrame, host: str = "127.0.0.1",
                  port: int = 0, idle_timeout_s: float = 300.0,
-                 gc_interval_s: float = 30.0) -> None:
+                 gc_interval_s: float = 30.0,
+                 tick_interval_s: float = 0.5) -> None:
         self.make_channel = make_channel
         self.frame = frame
         self.host, self.port = host, port
         self.idle_timeout_s = idle_timeout_s
         self.gc_interval_s = gc_interval_s
+        self.tick_interval_s = tick_interval_s
         self.channels: dict[tuple, GwChannel] = {}
         self._last_seen: dict[tuple, float] = {}
         self.transport: Optional[asyncio.DatagramTransport] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._gc_task: Optional[asyncio.Task] = None
+        self._tick_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
@@ -147,11 +150,32 @@ class UdpGwListener(asyncio.DatagramProtocol):
         if self.port == 0:
             self.port = self.transport.get_extra_info("sockname")[1]
         self._gc_task = self._loop.create_task(self._gc_loop())
+        self._tick_task = self._loop.create_task(self._tick_loop())
 
     async def _gc_loop(self) -> None:
         while True:
             await asyncio.sleep(self.gc_interval_s)
             self.expire_idle()
+
+    async def _tick_loop(self) -> None:
+        """Sub-second channel housekeeping: protocols with their own
+        transport reliability (CoAP CON retransmission) return frames
+        due for (re)send from ``housekeep()``."""
+        while True:
+            await asyncio.sleep(self.tick_interval_s)
+            self.tick_channels()
+
+    def tick_channels(self) -> None:
+        for ch in list(self.channels.values()):
+            hk = getattr(ch, "housekeep", None)
+            if hk is None:
+                continue
+            try:
+                frames = hk()
+                if frames:
+                    ch.send(frames)
+            except Exception:
+                log.exception("gateway channel housekeep crashed")
 
     def expire_idle(self, now: Optional[float] = None) -> int:
         """Drop peers silent past idle_timeout_s — without this the
@@ -180,6 +204,8 @@ class UdpGwListener(asyncio.DatagramProtocol):
     async def stop(self) -> None:
         if self._gc_task is not None:
             self._gc_task.cancel()
+        if self._tick_task is not None:
+            self._tick_task.cancel()
         for ch in list(self.channels.values()):
             ch.terminate("server_shutdown")
         self.channels.clear()

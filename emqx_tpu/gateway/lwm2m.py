@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 from typing import Optional
 
+from emqx_tpu.gateway import lwm2m_objects as objects
 from emqx_tpu.gateway.coap import (
     ACK, BAD_REQUEST, CHANGED, CREATED, DELETE, DELETED, Frame, GET,
     NON, NOT_FOUND, OPT_LOCATION_PATH, POST, PUT, CoapMessage,
@@ -30,13 +31,20 @@ DOWNLINK = "lwm2m/{ep}/dn/#"
 
 class Channel(GwChannel):
     def __init__(self, ctx: GwContext) -> None:
+        from emqx_tpu.gateway.coap import TransportManager
+
         self.ctx = ctx
         self.conn_state = "connected"
         self.clientid: Optional[str] = None
         self.endpoint: Optional[str] = None
         self.reg_id: Optional[str] = None
         self.lifetime = 86400
+        self.objects: list[dict] = []      # registry-resolved reg links
         self._mid = 0
+        # same message-layer machine as the coap gateway: registration
+        # CON retransmits must not re-execute (duplicate register
+        # uplinks), and downlink CON POSTs retransmit until ACKed
+        self.tm = TransportManager()
 
     def _next_mid(self) -> int:
         self._mid = self._mid % 0xFFFF + 1
@@ -51,6 +59,27 @@ class Channel(GwChannel):
     # -- inbound -------------------------------------------------------------
 
     def handle_in(self, m: CoapMessage) -> list[CoapMessage]:
+        from emqx_tpu.gateway.coap import CON, EMPTY, RST
+
+        if m.code == EMPTY and m.type == CON:        # CoAP ping → RST pong
+            return [CoapMessage(RST, EMPTY, m.mid, b"")]
+        if m.type in (ACK, RST):                     # settles downlink CONs
+            self.tm.on_ack(m.mid)
+            return []
+        if m.code == EMPTY:
+            return []
+        cached = self.tm.dedup(m)
+        if cached is not None:
+            return list(cached)      # duplicate CON: replay, don't re-run
+        out = self._handle_request(m)
+        self.tm.remember(m, out)
+        return out
+
+    def housekeep(self) -> list[CoapMessage]:
+        retx, _gave_up = self.tm.tick()
+        return retx
+
+    def _handle_request(self, m: CoapMessage) -> list[CoapMessage]:
         reply_type = ACK if m.type == 0 else NON
         path = m.uri_path()
 
@@ -74,10 +103,17 @@ class Channel(GwChannel):
             self.ctx.open_session(self.clientid, self)
             # downlink command subscription for this endpoint
             self.ctx.subscribe(self.clientid, DOWNLINK.format(ep=ep), 0)
+            # registry-resolved object list (emqx_lwm2m_xml_object):
+            # CoRE links → [{path, oid, instance, name}] so consumers see
+            # 'Device'/'Firmware Update', not bare numeric ids
+            links = objects.parse_core_links(
+                m.payload.decode("utf-8", "replace"))
+            self.objects = links
             self._uplink("register", {
                 "ep": ep, "lt": self.lifetime,
                 "lwm2m": q.get("lwm2m", "1.0"),
-                "objects": m.payload.decode("utf-8", "replace"),
+                "objects": links,
+                "alternatePath": q.get("apn", "/"),
             })
             return [reply(CREATED, options=[
                 (OPT_LOCATION_PATH, b"rd"),
@@ -111,6 +147,12 @@ class Channel(GwChannel):
 
     # -- outbound (downlink commands as CoAP POSTs) --------------------------
 
+    # write-attr targets notification ATTRIBUTES (pmin/pmax/gt/lt) of
+    # readable/observable resources, not the resource value — gate it on
+    # R, not W (OMA TS §5.1.2)
+    _OPS = {"read": "R", "observe": "R", "discover": "R",
+            "write": "W", "write-attr": "R", "execute": "E"}
+
     def handle_deliver(self, deliveries: list) -> list[CoapMessage]:
         out = []
         for _sub_topic, msg in deliveries:
@@ -118,10 +160,34 @@ class Channel(GwChannel):
             parts = plain.split("/")
             # lwm2m/{ep}/dn/... → POST /dn/{...} to the device
             cmd_path = parts[3:] if len(parts) > 3 else []
+            # JSON commands ({msgType, data.path}) validate against the
+            # object registry before reaching the device: an operation a
+            # resource doesn't support answers an uplink error instead
+            # (emqx_lwm2m_cmd + xml_object op checks)
+            try:
+                cmd = json.loads(msg.payload.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                cmd = None
+            if isinstance(cmd, dict) and cmd.get("msgType") in self._OPS:
+                path = str((cmd.get("data") or {}).get("path", ""))
+                if path and not objects.check_operation(
+                        path, self._OPS[cmd["msgType"]]):
+                    self._uplink("response", {
+                        "ep": self.endpoint,
+                        "reqID": cmd.get("reqID"),
+                        "msgType": cmd["msgType"],
+                        "data": {"path": path,
+                                 "code": "4.05",
+                                 "codeMsg": "method not allowed",
+                                 "name": objects.translate_path(path)},
+                    })
+                    continue
             opts = [(11, seg.encode()) for seg in (["dn"] + cmd_path)]
-            out.append(CoapMessage(
+            cmd_msg = CoapMessage(
                 0, POST, self._next_mid(),
-                b"", opts, msg.payload))        # CON request to device
+                b"", opts, msg.payload)         # CON request to device
+            self.tm.track(cmd_msg)              # retransmit until ACKed
+            out.append(cmd_msg)
         return out
 
     def terminate(self, reason: str) -> None:

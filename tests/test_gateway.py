@@ -389,7 +389,8 @@ def test_lwm2m_register_update_uplink_downlink():
         assert any(t == "lwm2m/ep-1/up/register" for t, _ in uplinks)
         reg = json.loads([p for t, p in uplinks
                           if t == "lwm2m/ep-1/up/register"][0])
-        assert reg["lt"] == 120 and "</1/0>" in reg["objects"]
+        assert reg["lt"] == 120
+        assert {o["path"] for o in reg["objects"]} == {"/1/0", "/3/0"}
 
         # update
         dev.request(C.POST, f"rd/{loc[1]}", queries=["lt=300"])
@@ -575,3 +576,248 @@ def test_gateway_unload_stops_listeners():
             # if something still accepts, fail loudly
             w.close()
     run(main())
+
+
+# -- coap transport machine (emqx_coap_tm) -------------------------------------
+
+def test_coap_tm_dedup_replays_cached_response():
+    """A retransmitted CON request must get the SAME cached reply without
+    re-executing (a duplicated PUT must not publish twice)."""
+    async def main():
+        app = BrokerApp()
+        published = []
+        app.hooks.add("message.publish",
+                      lambda m: published.append(m.topic) or None,
+                      priority=-500)
+        gw = app.gateway.load(C.CoapGateway(port=0))
+        await gw.start_listeners()
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.PUT, "ps/dup/t", payload=b"once",
+                    queries=["clientid=c-dup"])
+        a1 = await cli.recv()
+        # retransmit the SAME mid (simulated lost ACK)
+        cli._mid -= 1
+        cli.request(C.PUT, "ps/dup/t", payload=b"once",
+                    queries=["clientid=c-dup"])
+        a2 = await cli.recv()
+        assert (a1.code, a1.mid) == (a2.code, a2.mid) == (C.CHANGED, 1)
+        assert published.count("dup/t") == 1, "duplicate CON re-executed"
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_coap_qos1_notify_is_con_and_retransmits():
+    """QoS1 observers get CON notifications; an unacked CON retransmits
+    with backoff and finally cancels the observation."""
+    from emqx_tpu.gateway.coap import TransportManager
+
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(C.CoapGateway(port=0))
+        await gw.start_listeners()
+        sub = CoapClient(gw.port)
+        await sub.start()
+        sub.request(C.GET, "ps/alarm/#", token=b"ob2",
+                    options=[(C.OPT_OBSERVE, b"")],
+                    queries=["clientid=c-q1", "qos=1"])
+        await sub.recv()
+
+        pub = CoapClient(gw.port)
+        await pub.start()
+        pub.request(C.PUT, "ps/alarm/fire", payload=b"!",
+                    queries=["clientid=c-p2"])
+        await pub.recv()
+        notify = await sub.recv()
+        assert notify.type == C.CON, "qos1 notify must be confirmable"
+        (addr, ch), = [(a, c) for a, c in gw.listener.channels.items()
+                       if c.observers]
+        assert ch.tm.pending_count() == 1
+
+        # no ACK ever: force the clock forward through every retransmit
+        import time as _t
+        t = [_t.monotonic()]
+        ch.tm.now = lambda: t[0]
+        total = 0
+        for i in range(C.MAX_RETRANSMIT + 1):
+            t[0] += 200.0
+            retx, gave_up = ch.tm.tick()
+            total += len(retx)
+        assert total == C.MAX_RETRANSMIT
+        assert gave_up == [notify.mid]
+        # channel housekeep on give-up cancels the dead observer
+        ch._con_topic[notify.mid] = "alarm/#"
+        ch.tm._pending[notify.mid] = [notify, C.MAX_RETRANSMIT, 0.0, 1.0]
+        ch.housekeep()
+        assert "alarm/#" not in ch.observers
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_coap_ack_settles_con_and_rst_cancels_observe():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(C.CoapGateway(port=0))
+        await gw.start_listeners()
+        sub = CoapClient(gw.port)
+        await sub.start()
+        sub.request(C.GET, "ps/st/1", token=b"ob3",
+                    options=[(C.OPT_OBSERVE, b"")],
+                    queries=["clientid=c-q2", "qos=1"])
+        await sub.recv()
+        pub = CoapClient(gw.port)
+        await pub.start()
+        pub.request(C.PUT, "ps/st/1", payload=b"x",
+                    queries=["clientid=c-p3"])
+        await pub.recv()
+        notify = await sub.recv()
+        (_, ch), = [(a, c) for a, c in gw.listener.channels.items()
+                    if c.observers]
+        # client ACKs the notify → pending settles
+        sub.tr.sendto(sub.f.serialize(CoapMessage(
+            C.ACK, C.EMPTY, notify.mid, b"")))
+        await asyncio.sleep(0.2)
+        assert ch.tm.pending_count() == 0
+        assert "st/1" in ch.observers
+
+        # next notify answered by RST → observation cancelled (RFC 7641)
+        pub.request(C.PUT, "ps/st/1", payload=b"y",
+                    queries=["clientid=c-p3"])
+        await pub.recv()
+        n2 = await sub.recv()
+        sub.tr.sendto(sub.f.serialize(CoapMessage(
+            C.RST, C.EMPTY, n2.mid, b"")))
+        await asyncio.sleep(0.2)
+        assert "st/1" not in ch.observers
+        await gw.stop_listeners()
+    run(main())
+
+
+# -- lwm2m object registry -----------------------------------------------------
+
+def test_lwm2m_object_registry_lookup_and_paths():
+    from emqx_tpu.gateway import lwm2m_objects as O
+
+    dev = O.object_by_id(3)
+    assert dev.name == "Device" and dev.urn.endswith(":3")
+    assert O.object_by_name("Firmware Update").oid == 5
+    assert dev.resource(0).name == "Manufacturer"
+    assert dev.resource(4).operations == "E"
+    assert O.translate_path("/3/0/0") == "Device/0/Manufacturer"
+    assert O.translate_path("/6/0/1") == "Location/0/Longitude"
+    assert O.translate_path("/99/0/1") is None
+    assert O.parse_path("/3/0") == (3, 0, None)
+    assert O.parse_path("/bogus") == (None, None, None)
+    # operation validation
+    assert O.check_operation("/3/0/0", "R")          # Manufacturer: R
+    assert not O.check_operation("/3/0/0", "W")
+    assert O.check_operation("/3/0/4", "E")          # Reboot: E
+    assert not O.check_operation("/3/0/4", "R")
+    assert O.check_operation("/5/0/1", "W")          # Package URI: W
+    assert O.check_operation("/3/0", "R")            # instance read ok
+    assert O.check_operation("/99/1/2", "R")         # vendor obj: forward
+    links = O.parse_core_links('</3/0>,</5>;ver=1.0,</31024/11>')
+    assert links[0] == {"path": "/3/0", "oid": 3, "instance": 0,
+                        "name": "Device"}
+    assert links[1]["name"] == "Firmware Update"
+    assert links[2]["name"] is None                  # vendor object
+
+
+def test_lwm2m_register_resolves_objects_and_validates_downlink():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        uplinks = []
+        app.hooks.add(
+            "message.publish",
+            lambda m: uplinks.append((m.topic, m.payload)) or None,
+            priority=-500)
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.POST, "rd", payload=b"</3/0>,</5/0>",
+                    queries=["ep=dev9", "lt=120", "lwm2m=1.0"])
+        ack = await cli.recv()
+        assert ack.code == C.CREATED
+        reg = json.loads(dict(uplinks)["lwm2m/dev9/up/register"])
+        assert {o["name"] for o in reg["objects"]} == \
+            {"Device", "Firmware Update"}
+
+        # downlink: write to a read-only resource → uplink 4.05 response,
+        # nothing sent to the device
+        from emqx_tpu.core.message import Message
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/dev9/dn/cmd",
+            payload=json.dumps({
+                "reqID": 7, "msgType": "write",
+                "data": {"path": "/3/0/0", "value": "x"}}).encode())))
+        await asyncio.sleep(0.2)
+        resp = json.loads(dict(uplinks)["lwm2m/dev9/up/response"])
+        assert resp["data"]["code"] == "4.05"
+        assert resp["data"]["name"] == "Device/0/Manufacturer"
+        assert resp["reqID"] == 7
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_coap_ping_gets_rst_pong_and_does_not_settle_notifies():
+    """CON+EMPTY is a CoAP ping (RFC 7252 §4.3): answer RST, and never
+    treat the client's mid as an ACK of OUR pending notify."""
+    from emqx_tpu.gateway.coap import Channel as CoapChannel, TransportManager
+
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(C.CoapGateway(port=0))
+        await gw.start_listeners()
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.tr.sendto(cli.f.serialize(CoapMessage(C.CON, C.EMPTY, 42, b"")))
+        pong = await cli.recv()
+        assert (pong.type, pong.code, pong.mid) == (C.RST, C.EMPTY, 42)
+        await gw.stop_listeners()
+
+    run(main())
+    # unit: ping mid colliding with a pending CON must not settle it
+    ch = CoapChannel.__new__(CoapChannel)
+    ch.tm = TransportManager()
+    ch._con_topic = {}
+    ch.observers = {}
+    pending = CoapMessage(C.CON, C.CONTENT, 7, b"tk")
+    ch.tm.track(pending)
+    out = CoapChannel.handle_in(ch, CoapMessage(C.CON, C.EMPTY, 7, b""))
+    assert out[0].type == C.RST
+    assert ch.tm.pending_count() == 1, "ping settled a pending notify"
+
+
+def test_lwm2m_duplicate_register_is_deduped():
+    """A retransmitted CON POST /rd (lost ACK) must replay the cached
+    2.01 — not re-register and double-publish the register uplink."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        uplinks = []
+        app.hooks.add("message.publish",
+                      lambda m: uplinks.append(m.topic) or None,
+                      priority=-500)
+        cli = CoapClient(gw.port)
+        await cli.start()
+        for _ in range(2):                  # original + retransmission
+            cli._mid = 5
+            cli.request(C.POST, "rd", payload=b"</3/0>",
+                        queries=["ep=dup-ep", "lt=60"])
+            ack = await cli.recv()
+            assert ack.code == C.CREATED
+        assert uplinks.count("lwm2m/dup-ep/up/register") == 1
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_vendor_object_commands_are_forwarded():
+    from emqx_tpu.gateway import lwm2m_objects as O
+
+    assert O.check_operation("/31024/11/0", "W")     # vendor: forward
+    assert not O.check_operation("/not-a-path", "R")
+    assert O.parse_path("/--1/0") == (None, None, None)
+    # write-attr allowed on readable resources
+    assert O.check_operation("/3/0/9", "R")

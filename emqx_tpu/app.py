@@ -533,7 +533,10 @@ class BrokerApp:
         for p in self.access.authn.providers:
             if hasattr(p, "gc"):
                 p.gc()
-        # delayed wills of disconnected-but-registered channels
+        # delayed wills + session-expiry deadlines of
+        # disconnected-but-registered channels
         for _cid, ch in self.cm.all_channels():
             if getattr(ch, "pending_will_at", None) is not None:
                 ch.will_tick()
+            if getattr(ch, "session_expire_at", None) is not None:
+                ch.expire_tick()

@@ -84,6 +84,7 @@ class Channel:
         # per-message host walk (broker/pipeline.py)
         self.publish_sink = publish_sink
         self.pending_will_at: Optional[int] = None   # MQTT5 will-delay
+        self.session_expire_at: Optional[int] = None  # disconnected TTL
 
     def send(self, pkts: list[P.Packet]) -> None:
         if pkts:
@@ -610,8 +611,12 @@ class Channel:
                 and self.conninfo.expiry_interval_ms > 0
             ):
                 # MQTT5 Will Delay: withhold; cancelled if the session is
-                # resumed before it fires (will_tick / takeover)
-                self.pending_will_at = now_ms() + self.will.delay_ms
+                # resumed before it fires (will_tick / takeover). The will
+                # MUST be published no later than session end (MQTT5
+                # 3.1.2.5: earlier of Will Delay and Session Expiry), so
+                # the delay caps at the expiry interval.
+                self.pending_will_at = now_ms() + min(
+                    self.will.delay_ms, self.conninfo.expiry_interval_ms)
             else:
                 self._publish_and_dispatch(self.will.msg)
                 self.will = None
@@ -622,7 +627,34 @@ class Channel:
                 self.hooks.run("session.terminated", (self.clientid, reason))
                 self.session = None
             self.cm.unregister_channel(self.clientid, self)
-        # else: stay registered as a disconnected channel holding the
-        # session until expiry/resume (the reference keeps the channel
-        # process alive in this state, emqx_channel.erl disconnected)
+        else:
+            # stay registered as a disconnected channel holding the
+            # session until expiry/resume (the reference keeps the channel
+            # process alive in this state, emqx_channel.erl disconnected);
+            # the deadline is enforced by expire_tick
+            self.session_expire_at = (
+                now_ms() + self.conninfo.expiry_interval_ms)
         self.hooks.run("client.disconnected", (self.conninfo, reason))
+
+    def expire_tick(self, now: Optional[int] = None) -> bool:
+        """Enforce a disconnected channel's session-expiry deadline
+        (MQTT5 3.1.2-23: session state MUST be discarded when the
+        interval elapses). Returns True when the session expired."""
+        if (self.conn_state != "disconnected"
+                or self.session is None
+                or self.session_expire_at is None):
+            return False
+        now = now_ms() if now is None else now
+        if now < self.session_expire_at:
+            return False
+        # a still-pending delayed will fires at session end at the latest
+        if self.will is not None and self.pending_will_at is not None:
+            self._publish_and_dispatch(self.will.msg)
+            self.will = None
+            self.pending_will_at = None
+        self.broker.subscriber_down(self.clientid)
+        self.hooks.run("session.terminated", (self.clientid, "expired"))
+        self.session = None
+        self.session_expire_at = None
+        self.cm.unregister_channel(self.clientid, self)
+        return True

@@ -362,7 +362,12 @@ class Channel(GwChannel):
                 msg.payload)
             if mtype == CON:
                 self.tm.track(note)
-                self._con_topic[mid] = obs_topic_hit
+            # NON notifies are remembered too: a client that lost its
+            # observe state answers RST, which must cancel the
+            # observation for ANY notification type (RFC 7641 §3.6)
+            self._con_topic[mid] = obs_topic_hit
+            if len(self._con_topic) > 512:        # bound NON history
+                self._con_topic.pop(next(iter(self._con_topic)))
             out.append(note)
         return out
 

@@ -63,8 +63,18 @@ class Channel(GwChannel):
 
         if m.code == EMPTY and m.type == CON:        # CoAP ping → RST pong
             return [CoapMessage(RST, EMPTY, m.mid, b"")]
-        if m.type in (ACK, RST):                     # settles downlink CONs
-            self.tm.on_ack(m.mid)
+        if m.type in (ACK, RST):
+            settled = self.tm.on_ack(m.mid)          # settles downlink CONs
+            if settled and m.type == ACK and m.code != EMPTY:
+                # piggybacked device response to a downlink command
+                # (read value / write result) — surface it as the uplink
+                # the reference's emqx_lwm2m_cmd produces
+                self._uplink("response", {
+                    "ep": self.endpoint,
+                    "data": {
+                        "code": f"{m.code >> 5}.{m.code & 0x1F:02d}",
+                        "content": m.payload.decode("utf-8", "replace"),
+                    }})
             return []
         if m.code == EMPTY:
             return []
@@ -76,7 +86,13 @@ class Channel(GwChannel):
         return out
 
     def housekeep(self) -> list[CoapMessage]:
-        retx, _gave_up = self.tm.tick()
+        retx, gave_up = self.tm.tick()
+        for _mid in gave_up:
+            # an unresponsive device surfaces as a timeout uplink rather
+            # than silence (the reference's command timeout response)
+            self._uplink("response", {
+                "ep": self.endpoint,
+                "data": {"code": "5.04", "codeMsg": "timeout"}})
         return retx
 
     def _handle_request(self, m: CoapMessage) -> list[CoapMessage]:
@@ -125,8 +141,13 @@ class Channel(GwChannel):
             q = m.queries()
             if "lt" in q:
                 self.lifetime = int(q["lt"])
+            if m.payload:
+                # registration update may carry a fresh object list
+                self.objects = objects.parse_core_links(
+                    m.payload.decode("utf-8", "replace"))
             self._uplink("update", {"ep": self.endpoint,
-                                    "lt": self.lifetime})
+                                    "lt": self.lifetime,
+                                    "objects": self.objects})
             return [reply(CHANGED)]
         if m.code == DELETE and len(path) == 2:
             if path[1] != self.reg_id:

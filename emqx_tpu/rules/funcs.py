@@ -414,3 +414,46 @@ def _kv_store_del(k):
 FUNCS["proc_dict_put"] = FUNCS["kv_store_put"]
 FUNCS["proc_dict_get"] = FUNCS["kv_store_get"]
 FUNCS["proc_dict_del"] = FUNCS["kv_store_del"]
+
+
+# -- remaining emqx_rule_funcs.erl surface (round 3) -----------------------
+
+FUNCS["null"] = lambda: None        # null/0: the SQL undefined literal
+
+
+@f("find_s")
+def _find_s(s, sub, direction="leading"):
+    """find with an explicit direction (find_s/3): 'leading' scans from
+    the left (= find/2), 'trailing' from the right."""
+    s, sub = _str(s), _str(sub)
+    i = s.find(sub) if _str(direction) == "leading" else s.rfind(sub)
+    return s[i:] if i >= 0 else ""
+
+
+FUNCS["sprintf_s"] = FUNCS["sprintf"]       # erlang-side alias
+
+
+@f("jq")
+def _jq(*_a):
+    # the reference gates jq/2,3 on the optional libjq NIF (mix.exs:641);
+    # no libjq ships here either — same observable failure mode: the
+    # rule errors, metrics count failed.exception
+    raise RuntimeError("jq/2: libjq is not available in this build")
+
+
+# -- message-context accessors (clientid/0, payload/0, ... in the
+# reference read the event's message record; here they read the rule's
+# event columns via the CONTEXT_FUNCS registry the runtime passes
+# columns into)
+
+CONTEXT_FUNCS: dict[str, Callable] = {}
+
+
+for _col in ("clientid", "username", "payload", "qos", "topic",
+             "peerhost", "flags", "timestamp"):
+    CONTEXT_FUNCS[_col] = (lambda c: lambda cols: cols.get(c))(_col)
+CONTEXT_FUNCS["clientip"] = lambda cols: cols.get("peerhost")
+CONTEXT_FUNCS["msgid"] = lambda cols: cols.get("id")
+CONTEXT_FUNCS["flag"] = lambda cols, name: (
+    (cols.get("flags") or {}).get(_str(name)))
+CONTEXT_FUNCS["rule_id"] = lambda cols: _RULE_CTX.get()

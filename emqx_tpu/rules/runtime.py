@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from typing import Any, Optional
 
-from emqx_tpu.rules.funcs import FUNCS
+from emqx_tpu.rules.funcs import CONTEXT_FUNCS, FUNCS
 from emqx_tpu.rules.sqlparser import Select, SqlError
 
 
@@ -97,6 +97,14 @@ def eval_expr(ast, columns: dict) -> Any:
                       eval_expr(ast[2], columns),
                       eval_expr(ast[3], columns))
     if tag == "call":
+        cfn = CONTEXT_FUNCS.get(ast[1])
+        if cfn is not None and not (ast[2] and ast[1] in FUNCS):
+            # message-context accessors (clientid(), payload(), flag(x))
+            # read the event columns, not just their arguments. Names
+            # shared with value builtins keep the builtin when called
+            # WITH arguments: topic() reads the column, topic('a', id)
+            # stays the join function.
+            return cfn(columns, *[eval_expr(a, columns) for a in ast[2]])
         fn = FUNCS.get(ast[1])
         if fn is None:
             raise RuleEvalError(f"unknown SQL function {ast[1]!r}")

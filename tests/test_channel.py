@@ -336,3 +336,120 @@ def test_will_delay_fires_when_due():
     ch.will_tick(now=now_ms() + 2000)
     pubs = [p for p in watcher.outbox if isinstance(p, P.Publish)]
     assert [p.payload for p in pubs] == [b"boom"]
+
+
+# -- round 3 v5 conformance (emqx_mqtt_protocol_v5_SUITE gaps) -----------------
+
+def test_will_delay_capped_by_session_expiry():
+    """MQTT5 3.1.2.5: the will fires at the EARLIER of Will-Delay and
+    Session-Expiry — a 300s delay with a 5s session expires at ~5s."""
+    from emqx_tpu.core.message import now_ms
+    h = Harness()
+    watcher, _ = h.connect("w-cap")
+    watcher.handle_in(P.Subscribe(packet_id=1,
+                                  topic_filters=[("will/cap", {"qos": 0})]))
+    ch, _ = h.connect("dev-cap", clean_start=False, proto=P.MQTT_V5,
+                      properties={"Session-Expiry-Interval": 5},
+                      will_flag=True, will_topic="will/cap",
+                      will_payload=b"capped",
+                      will_props={"Will-Delay-Interval": 300})
+    t0 = now_ms()
+    ch.terminate("socket_error")
+    assert ch.pending_will_at is not None
+    assert ch.pending_will_at - t0 <= 5_000 + 500, \
+        "will delay not capped by session expiry"
+    ch.will_tick(now=t0 + 6_000)
+    pubs = [p for p in watcher.outbox if isinstance(p, P.Publish)]
+    assert [p.payload for p in pubs] == [b"capped"]
+
+
+def test_session_expiry_discards_state_and_fires_will():
+    """MQTT5 3.1.2-23: the session is discarded when the expiry interval
+    elapses; a pending delayed will is published no later than that."""
+    from emqx_tpu.core.message import now_ms
+    h = Harness()
+    watcher, _ = h.connect("w-exp")
+    watcher.handle_in(P.Subscribe(packet_id=1,
+                                  topic_filters=[("will/e", {"qos": 0})]))
+    ch, _ = h.connect("dev-exp", clean_start=False, proto=P.MQTT_V5,
+                      properties={"Session-Expiry-Interval": 10},
+                      will_flag=True, will_topic="will/e",
+                      will_payload=b"gone",
+                      will_props={"Will-Delay-Interval": 10})
+    ch.handle_in(P.Subscribe(packet_id=2,
+                             topic_filters=[("keep/x", {"qos": 1})]))
+    t0 = now_ms()
+    ch.terminate("socket_error")
+    assert ch.session is not None                 # held for resume
+    assert not ch.expire_tick(now=t0 + 5_000)     # not yet
+    assert ch.expire_tick(now=t0 + 11_000)        # expired
+    assert ch.session is None
+    assert h.cm.lookup_channel("dev-exp") is None
+    # will delivered, subscription state cleaned
+    pubs = [p for p in watcher.outbox if isinstance(p, P.Publish)]
+    assert [p.payload for p in pubs] == [b"gone"]
+    assert not h.broker.subscriber.get("keep/x")
+    # a resume AFTER expiry starts a fresh session
+    ch2, out2 = h.connect("dev-exp", clean_start=False, proto=P.MQTT_V5,
+                          properties={"Session-Expiry-Interval": 10})
+    assert out2[0].session_present is False
+
+
+def test_resume_before_expiry_keeps_session():
+    from emqx_tpu.core.message import now_ms
+    h = Harness()
+    ch, _ = h.connect("dev-r", clean_start=False, proto=P.MQTT_V5,
+                      properties={"Session-Expiry-Interval": 600})
+    ch.handle_in(P.Subscribe(packet_id=1,
+                             topic_filters=[("keep/y", {"qos": 1})]))
+    ch.terminate("socket_error")
+    assert ch.session_expire_at is not None
+    ch2, out2 = h.connect("dev-r", clean_start=False, proto=P.MQTT_V5,
+                          properties={"Session-Expiry-Interval": 600})
+    assert out2[0].session_present is True
+    # the old channel's deadline is inert: its session moved
+    assert not ch.expire_tick(now=now_ms() + 10**9)
+    assert "keep/y" in ch2.session.subscriptions
+
+
+def test_subscription_identifiers_on_delivery():
+    """MQTT5 3.8.3.1.2/3.3.2.3.8: deliveries carry each matching
+    subscription's identifier; overlapping subscriptions with different
+    ids produce one packet per subscription, each with its own id."""
+    h = Harness()
+    sub, _ = h.connect("sid-sub", proto=P.MQTT_V5)
+    sub.handle_in(P.Subscribe(
+        packet_id=1, topic_filters=[("a/+", {"qos": 0})],
+        properties={"Subscription-Identifier": [7]}))
+    sub.handle_in(P.Subscribe(
+        packet_id=2, topic_filters=[("a/#", {"qos": 0})],
+        properties={"Subscription-Identifier": [9]}))
+    pub, _ = h.connect("sid-pub", proto=P.MQTT_V5)
+    deliveries = h.broker.publish(__import__(
+        "emqx_tpu.core.message", fromlist=["Message"]).Message(
+            topic="a/x", payload=b"m", from_="sid-pub"))
+    out = sub.handle_deliver(deliveries["sid-sub"])
+    sids = sorted((p.properties or {}).get(
+        "Subscription-Identifier", [None])[0] for p in out
+        if isinstance(p, P.Publish))
+    assert sids == [7, 9]
+
+
+def test_receive_maximum_exhaustion_rc_0x93():
+    """Flow control: QoS2 receives past the receive-maximum window get
+    PUBREC 0x93 (RC_RECEIVE_MAXIMUM_EXCEEDED) until quota frees."""
+    h = Harness()
+    ch = Channel(h.broker, h.cm, session_opts={"max_awaiting_rel": 2})
+    ch.handle_in(P.Connect(clientid="fc", proto_ver=P.MQTT_V5))
+    rcs = []
+    for pid in (11, 12, 13):
+        (rec,) = ch.handle_in(P.Publish(
+            topic="f/x", payload=b"q2", qos=2, packet_id=pid))
+        rcs.append(rec.reason_code)
+    assert rcs[:2] == [0, 0]
+    assert rcs[2] == P.RC_RECEIVE_MAXIMUM_EXCEEDED
+    # releasing one slot restores quota
+    ch.handle_in(P.PubRel(packet_id=11))
+    (rec4,) = ch.handle_in(P.Publish(
+        topic="f/x", payload=b"q2", qos=2, packet_id=14))
+    assert rec4.reason_code == 0

@@ -821,3 +821,80 @@ def test_vendor_object_commands_are_forwarded():
     assert O.parse_path("/--1/0") == (None, None, None)
     # write-attr allowed on readable resources
     assert O.check_operation("/3/0/9", "R")
+
+
+def test_lwm2m_device_response_and_timeout_uplinks():
+    """A device ACK carrying a result becomes an up/response; an
+    unresponsive device surfaces a 5.04 timeout uplink."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        uplinks = []
+        app.hooks.add("message.publish",
+                      lambda m: uplinks.append((m.topic, m.payload)) or None,
+                      priority=-500)
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.POST, "rd", payload=b"</3/0>", queries=["ep=rsp-ep"])
+        await cli.recv()
+        (ch,) = [c for c in gw.listener.channels.values()
+                 if getattr(c, "endpoint", None) == "rsp-ep"]
+        # downlink read → device CON POST
+        from emqx_tpu.core.message import Message
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/rsp-ep/dn/cmd",
+            payload=json.dumps({"reqID": 1, "msgType": "read",
+                                "data": {"path": "/3/0/0"}}).encode())))
+        cmd = await cli.recv()
+        assert cmd.type == C.CON
+        # device answers with piggybacked 2.05 + value
+        cli.tr.sendto(cli.f.serialize(CoapMessage(
+            C.ACK, C.CONTENT, cmd.mid, cmd.token, [], b"ACME Corp")))
+        await asyncio.sleep(0.2)
+        resp = json.loads(dict(uplinks)["lwm2m/rsp-ep/up/response"])
+        assert resp["data"]["code"] == "2.05"
+        assert resp["data"]["content"] == "ACME Corp"
+
+        # second command never ACKed → timeout uplink on give-up
+        uplinks.clear()
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/rsp-ep/dn/cmd2",
+            payload=json.dumps({"reqID": 2, "msgType": "read",
+                                "data": {"path": "/3/0/1"}}).encode())))
+        await cli.recv()
+        for st in ch.tm._pending.values():
+            st[1] = C.MAX_RETRANSMIT       # exhaust retries
+            st[2] = 0.0
+        ch.housekeep()
+        resp = json.loads(dict(uplinks)["lwm2m/rsp-ep/up/response"])
+        assert resp["data"]["code"] == "5.04"
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_coap_rst_on_non_notify_cancels_observe():
+    """RFC 7641 §3.6: RST answering ANY notification (CON or NON)
+    deregisters the observer."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(C.CoapGateway(port=0))
+        await gw.start_listeners()
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.GET, "ps/n0/t", token=b"ob0",
+                    options=[(C.OPT_OBSERVE, b"")],
+                    queries=["clientid=c-n0"])       # qos0 → NON notifies
+        await cli.recv()
+        from emqx_tpu.core.message import Message
+        app.cm.dispatch(app.broker.publish(
+            Message(topic="n0/t", payload=b"v1")))
+        note = await cli.recv()
+        assert note.type == C.NON
+        (ch,) = [c for c in gw.listener.channels.values() if c.observers]
+        cli.tr.sendto(cli.f.serialize(CoapMessage(
+            C.RST, C.EMPTY, note.mid, b"")))
+        await asyncio.sleep(0.2)
+        assert not ch.observers, "RST on NON notify must cancel observe"
+        await gw.stop_listeners()
+    run(main())

@@ -474,3 +474,53 @@ def test_denied_publish_still_fires_rules_on_device_path():
         [Message(topic="audit/evt", payload=b"x")])
     assert out == [{}]                        # routing denied
     assert fired == ["audit/evt"], "rules must fire before the deny"
+
+
+def test_round3_rule_funcs_and_context_accessors():
+    from emqx_tpu.rules.funcs import FUNCS
+    from emqx_tpu.rules.runtime import eval_expr
+
+    assert FUNCS["null"]() is None
+    assert FUNCS["find_s"]("a-b-c", "-", "leading") == "-b-c"
+    assert FUNCS["find_s"]("a-b-c", "-", "trailing") == "-c"
+    assert FUNCS["find_s"]("abc", "x") == ""
+    assert FUNCS["sprintf_s"] is FUNCS["sprintf"]
+    import pytest as _p
+    with _p.raises(RuntimeError, match="libjq"):
+        FUNCS["jq"](".", "{}")
+
+    cols = {"clientid": "c1", "username": "u1", "payload": b"pp",
+            "qos": 1, "topic": "t/x", "peerhost": "1.2.3.4",
+            "id": "m-9", "flags": {"retain": True}}
+    assert eval_expr(("call", "clientid", []), cols) == "c1"
+    assert eval_expr(("call", "msgid", []), cols) == "m-9"
+    assert eval_expr(("call", "clientip", []), cols) == "1.2.3.4"
+    assert eval_expr(("call", "flag", [("const", "retain")]), cols) is True
+    assert eval_expr(("call", "flags", []), cols) == {"retain": True}
+
+
+def test_context_accessor_via_sql():
+    from emqx_tpu.rules.engine import RuleEngine
+    from emqx_tpu.core.message import Message
+
+    e = RuleEngine(node="n1")
+    got = []
+    e.register_action("rec", lambda cols, args: got.append(cols))
+    e.create_rule("r", 'SELECT clientid() as who, flag(\'retain\') as r '
+                       'FROM "t/#"', [{"function": "rec", "args": {}}])
+    m = Message(topic="t/1", payload=b"x", from_="dev-7",
+                flags={"retain": True})
+    e._on_publish(m)
+    assert got and got[0]["who"] == "dev-7"
+    assert got[0]["r"] is True
+
+
+def test_topic_builtin_not_shadowed_by_context_accessor():
+    from emqx_tpu.rules.runtime import eval_expr
+
+    cols = {"topic": "real/topic", "clientid": "c"}
+    # zero-arg: the column accessor
+    assert eval_expr(("call", "topic", []), cols) == "real/topic"
+    # with args: the join builtin
+    assert eval_expr(("call", "topic",
+                      [("const", "a"), ("const", "b")]), cols) == "a/b"

@@ -253,12 +253,103 @@ def main() -> None:
     if os.environ.get("BENCH_E2E", "1") != "0":
         bench_e2e()
 
+    if os.environ.get("BENCH_NATIVE", "1") != "0":
+        bench_native_vs_asyncio()
+
     print(json.dumps({
         "metric": "route-matches/sec",
         "value": round(topics_per_sec),
         "unit": "topics/sec",
         "vs_baseline": round(topics_per_sec / 1_000_000, 3),
     }))
+
+
+def bench_native_vs_asyncio() -> None:
+    """VERDICT r2 item 8: prove (or revise) the C++ host story with a
+    measured comparison — same broker, same channel FSM, host path only
+    (no device router), identical pub/sub workload against the asyncio
+    listener and the C++ epoll listener."""
+    import asyncio
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.mqtt.client import MqttClient
+
+    n_pub = int(os.environ.get("BENCH_NATIVE_PUBS", 8))
+    n_msg = int(os.environ.get("BENCH_NATIVE_MSGS", 2000))
+
+    async def drive(port: str) -> float:
+        subs = [MqttClient(port=port, clientid=f"ns{i}") for i in range(8)]
+        for i, s in enumerate(subs):
+            await s.connect()
+            await s.subscribe(f"nb/{i}/+", qos=0)
+        pubs = [MqttClient(port=port, clientid=f"np{i}")
+                for i in range(n_pub)]
+        for p in pubs:
+            await p.connect()
+        expected = n_pub * n_msg
+        got = 0
+        done = asyncio.Event()
+
+        async def drain(s):
+            nonlocal got
+            while got < expected:
+                try:
+                    await s.recv(timeout=10)
+                except asyncio.TimeoutError:
+                    break
+                got += 1
+                if got >= expected:
+                    done.set()
+        drains = [asyncio.create_task(drain(s)) for s in subs]
+
+        async def blast(i, p):
+            for j in range(n_msg):
+                await p.publish(f"nb/{(i + j) % 8}/m", b"x", qos=0)
+        t0 = time.time()
+        await asyncio.gather(*(blast(i, p) for i, p in enumerate(pubs)))
+        try:
+            await asyncio.wait_for(done.wait(), timeout=60)
+        except asyncio.TimeoutError:
+            pass
+        wall = time.time() - t0
+        for d in drains:
+            d.cancel()
+        for c in subs + pubs:
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        return got / wall
+
+    async def run_asyncio() -> float:
+        server = BrokerServer(port=0, app=BrokerApp())
+        await server.start()
+        try:
+            return await drive(server.port)
+        finally:
+            await server.stop()
+
+    def run_native() -> float:
+        server = NativeBrokerServer(port=0, app=BrokerApp())
+        server.start()
+        try:
+            return asyncio.run(drive(server.port))
+        finally:
+            server.stop()
+
+    aio = asyncio.run(run_asyncio())
+    nat = run_native()
+    log(f"host comparison (pubs={n_pub} x {n_msg} msgs, qos0, host path): "
+        f"asyncio={aio:,.0f} msg/s  native(C++ epoll)={nat:,.0f} msg/s  "
+        f"ratio={nat / max(aio, 1):.2f}x")
 
 
 def bench_shared_retained() -> None:

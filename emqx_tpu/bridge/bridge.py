@@ -100,6 +100,19 @@ class Bridge:
                        if isinstance(v, (str, int, float, bool))
                        or v is None}
             return {"insert": coll, "documents": [doc]}
+        if self.type == "kafka":
+            # emqx_ee_bridge_kafka (wolff): key/value templates per
+            # message; key defaults to clientid (the reference's
+            # key_template default), value to the payload
+            key_t = c.get("key_template") or "${clientid}"
+            val_t = c.get("value_template")
+            value = (render_template(val_t, columns) if val_t
+                     else _json_safe(columns).get("payload", ""))
+            return {
+                "topic": c.get("kafka_topic", "mqtt"),
+                "key": render_template(key_t, columns),
+                "value": value,
+            }
         if self.type == "influxdb":
             # emqx_ee_bridge_influxdb: write_syntax template → one line
             # of line protocol, shipped over the HTTP connector's /write

@@ -366,8 +366,13 @@ class Channel(GwChannel):
             # observe state answers RST, which must cancel the
             # observation for ANY notification type (RFC 7641 §3.6)
             self._con_topic[mid] = obs_topic_hit
-            if len(self._con_topic) > 512:        # bound NON history
-                self._con_topic.pop(next(iter(self._con_topic)))
+            if len(self._con_topic) > 512:        # bound NON history —
+                # but never evict a mid whose CON is still awaiting ACK
+                # (losing it would orphan the give-up/RST cancel path)
+                for old in list(self._con_topic):
+                    if old not in self.tm._pending:
+                        del self._con_topic[old]
+                        break
             out.append(note)
         return out
 

@@ -45,6 +45,9 @@ class Channel(GwChannel):
         # CON retransmits must not re-execute (duplicate register
         # uplinks), and downlink CON POSTs retransmit until ACKed
         self.tm = TransportManager()
+        # mid → {reqID, msgType, path} so device responses / timeouts
+        # correlate back to the command they answer
+        self._cmd_ctx: dict[int, dict] = {}
 
     def _next_mid(self) -> int:
         self._mid = self._mid % 0xFFFF + 1
@@ -65,13 +68,18 @@ class Channel(GwChannel):
             return [CoapMessage(RST, EMPTY, m.mid, b"")]
         if m.type in (ACK, RST):
             settled = self.tm.on_ack(m.mid)          # settles downlink CONs
+            ctx = self._cmd_ctx.pop(m.mid, {})
             if settled and m.type == ACK and m.code != EMPTY:
                 # piggybacked device response to a downlink command
                 # (read value / write result) — surface it as the uplink
-                # the reference's emqx_lwm2m_cmd produces
+                # the reference's emqx_lwm2m_cmd produces, echoing the
+                # command's reqID/msgType/path for correlation
                 self._uplink("response", {
                     "ep": self.endpoint,
+                    "reqID": ctx.get("reqID"),
+                    "msgType": ctx.get("msgType"),
                     "data": {
+                        "path": ctx.get("path"),
                         "code": f"{m.code >> 5}.{m.code & 0x1F:02d}",
                         "content": m.payload.decode("utf-8", "replace"),
                     }})
@@ -87,12 +95,16 @@ class Channel(GwChannel):
 
     def housekeep(self) -> list[CoapMessage]:
         retx, gave_up = self.tm.tick()
-        for _mid in gave_up:
+        for mid in gave_up:
             # an unresponsive device surfaces as a timeout uplink rather
             # than silence (the reference's command timeout response)
+            ctx = self._cmd_ctx.pop(mid, {})
             self._uplink("response", {
                 "ep": self.endpoint,
-                "data": {"code": "5.04", "codeMsg": "timeout"}})
+                "reqID": ctx.get("reqID"),
+                "msgType": ctx.get("msgType"),
+                "data": {"path": ctx.get("path"),
+                         "code": "5.04", "codeMsg": "timeout"}})
         return retx
 
     def _handle_request(self, m: CoapMessage) -> list[CoapMessage]:
@@ -208,6 +220,12 @@ class Channel(GwChannel):
                 0, POST, self._next_mid(),
                 b"", opts, msg.payload)         # CON request to device
             self.tm.track(cmd_msg)              # retransmit until ACKed
+            if isinstance(cmd, dict):
+                self._cmd_ctx[cmd_msg.mid] = {
+                    "reqID": cmd.get("reqID"),
+                    "msgType": cmd.get("msgType"),
+                    "path": (cmd.get("data") or {}).get("path"),
+                }
             out.append(cmd_msg)
         return out
 

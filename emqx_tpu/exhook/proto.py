@@ -19,6 +19,45 @@ service, plus the TPU-era batch publish):
 
 Responses carry {"type": "CONTINUE" | "STOP_AND_RETURN" | "IGNORE",
 "value": ...} — the ValuedResponse of the reference.
+
+WIRE FORMAT (normative — what an external provider must speak)
+==============================================================
+
+Transport: one TCP connection per pool slot, provider is the listener.
+Framing: every message is::
+
+    +----------------+----------------------------------+
+    | uint32 big-end | body: UTF-8 JSON, that many bytes|
+    +----------------+----------------------------------+
+
+No TLS at this layer (front it with a TLS proxy if needed). Requests
+and responses alternate strictly on one connection (synchronous RPC;
+concurrency comes from the pool, one in-flight call per connection —
+the same discipline as the reference's per-conn gRPC streams).
+
+Request body::
+
+    {"rpc": "<RpcName>", "args": {...}}
+
+Response body::
+
+    {"type": "CONTINUE" | "STOP_AND_RETURN" | "IGNORE", "value": ...}
+
+`args` payloads mirror exhook.proto messages field-for-field in JSON:
+clientinfo {clientid, username, peername, proto_ver}, message {id,
+topic, payload, qos, retain, from, timestamp, headers}. Binary fields
+(payload) use the codec's tagged encoding: {"$b": "<base64>"}
+(cluster/codec.py) — providers must decode/encode that tag.
+
+DESIGN NOTE — why framed JSON-RPC and not gRPC: the reference's
+HookProvider is gRPC over HTTP/2 (grpc-erl); this build has no gRPC
+runtime in-image and implements the same 21-RPC service over the
+framing above. A stock gRPC HookProvider therefore CANNOT connect
+directly — it needs this ~40-line adapter (length-prefixed JSON ↔ its
+handler functions; see tests/test_exhook.py's providers for working
+examples in Python). The RPC names, request fields, ValuedResponse
+semantics, pool sizing, timeout and failed_action behaviour are
+otherwise identical, so a provider port is mechanical.
 """
 
 from __future__ import annotations

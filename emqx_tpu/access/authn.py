@@ -132,19 +132,126 @@ def jwt_sign(claims: dict, secret: bytes, alg: str = "HS256") -> str:
 
 
 class JwtProvider(Provider):
-    """HMAC JWT verification (emqx_authn_jwt.erl hmac-based flavor):
-    password carries the token; verifies signature + exp/nbf, checks
-    optional pinned claims, extracts acl/is_superuser claims."""
+    """JWT verification (emqx_authn_jwt.erl): password carries the
+    token; verifies signature + exp/nbf, checks optional pinned claims,
+    extracts acl/is_superuser claims.
+
+    Three key sources, as in the reference:
+    - ``secret``: HMAC (HS256/384/512)
+    - ``public_key_pem``: RSA/ECDSA public key (RS256/384/512, ES256)
+    - ``jwks`` / ``jwks_fn``: a JWKS document (or a zero-arg fetcher —
+      the endpoint transport is injected like HttpProvider's, so tests
+      run socketless); keys select by the token header's ``kid`` and a
+      verification miss triggers ONE refresh (key rotation)."""
 
     id = "jwt"
 
-    def __init__(self, secret: bytes, algorithm: str = "HS256",
+    def __init__(self, secret: bytes = b"", algorithm: str = "HS256",
                  verify_claims: Optional[dict] = None,
-                 from_field: str = "password") -> None:
+                 from_field: str = "password",
+                 public_key_pem: Optional[bytes] = None,
+                 jwks: Optional[dict] = None,
+                 jwks_fn: Optional[Callable[[], dict]] = None) -> None:
         self.secret = secret
         self.algorithm = algorithm
         self.verify_claims = verify_claims or {}
         self.from_field = from_field             # password | username
+        self.public_key_pem = public_key_pem
+        self._static_key = None
+        if public_key_pem is not None:
+            # parse ONCE — a malformed PEM fails at config time, not per
+            # CONNECT, and the hot path skips re-parsing
+            from cryptography.hazmat.primitives.serialization import (
+                load_pem_public_key)
+            self._static_key = load_pem_public_key(public_key_pem)
+        self.jwks_fn = jwks_fn
+        self._jwks = jwks or ({} if jwks_fn is None else None)
+        # refresh throttle: a flood of bad-signature tokens must not
+        # amplify into one endpoint fetch each (the reference refreshes
+        # on an interval, emqx_authn_jwt ssl/refresh_interval)
+        self.jwks_min_refresh_s = 5.0
+        self._jwks_fetched_at = 0.0
+
+    # -- asymmetric verification -------------------------------------------
+
+    _RS = {"RS256": "sha256", "RS384": "sha384", "RS512": "sha512"}
+
+    def _jwks_doc(self, refresh: bool = False) -> dict:
+        if (self._jwks is None or refresh) and self.jwks_fn is not None:
+            now = time.time()
+            if (self._jwks is None
+                    or now - self._jwks_fetched_at
+                    >= self.jwks_min_refresh_s):
+                self._jwks_fetched_at = now
+                try:
+                    self._jwks = self.jwks_fn() or {}
+                except Exception:
+                    self._jwks = self._jwks or {}
+        return self._jwks or {}
+
+    def _candidate_keys(self, alg: str, header: dict,
+                        refresh: bool = False) -> list:
+        """All plausibly matching public keys (kid match if present,
+        kty compatible with alg) — a no-kid token against a multi-key
+        JWKS tries each."""
+        if self._static_key is not None:
+            return [self._static_key]
+        want_kty = "RSA" if alg in self._RS else "EC"
+        kid = header.get("kid")
+        out = []
+        for jwk in self._jwks_doc(refresh).get("keys", []):
+            if kid is not None and jwk.get("kid") != kid:
+                continue
+            if jwk.get("kty") != want_kty:
+                continue
+            try:
+                if want_kty == "RSA":
+                    from cryptography.hazmat.primitives.asymmetric.rsa \
+                        import RSAPublicNumbers
+                    n = int.from_bytes(_unb64url(jwk["n"]), "big")
+                    e = int.from_bytes(_unb64url(jwk["e"]), "big")
+                    out.append(RSAPublicNumbers(e, n).public_key())
+                elif jwk.get("crv") == "P-256":
+                    from cryptography.hazmat.primitives.asymmetric.ec \
+                        import SECP256R1, EllipticCurvePublicNumbers
+                    x = int.from_bytes(_unb64url(jwk["x"]), "big")
+                    y = int.from_bytes(_unb64url(jwk["y"]), "big")
+                    out.append(EllipticCurvePublicNumbers(
+                        x, y, SECP256R1()).public_key())
+            except Exception:            # malformed JWK entry: skip it
+                continue
+        return out
+
+    def _verify_asym(self, alg: str, header: dict, signing: bytes,
+                     sig: bytes) -> bool:
+        from cryptography.hazmat.primitives import hashes as chashes
+
+        digest = {"sha256": chashes.SHA256, "sha384": chashes.SHA384,
+                  "sha512": chashes.SHA512}
+        for refresh in (False, True):
+            for key in self._candidate_keys(alg, header, refresh=refresh):
+                try:
+                    if alg in self._RS:
+                        from cryptography.hazmat.primitives.asymmetric \
+                            .padding import PKCS1v15
+                        key.verify(sig, signing, PKCS1v15(),
+                                   digest[self._RS[alg]]())
+                    else:                # ES256: raw r||s → DER
+                        from cryptography.hazmat.primitives.asymmetric \
+                            .ec import ECDSA
+                        from cryptography.hazmat.primitives.asymmetric \
+                            .utils import encode_dss_signature
+                        half = len(sig) // 2
+                        der = encode_dss_signature(
+                            int.from_bytes(sig[:half], "big"),
+                            int.from_bytes(sig[half:], "big"))
+                        key.verify(der, signing, ECDSA(chashes.SHA256()))
+                    return True
+                except Exception:        # wrong key type/size included —
+                    continue             # any failure = not verified
+            if self.jwks_fn is None:
+                return False             # static keys can't refresh
+        return False
 
     def authenticate(self, cred: Credential):
         token = cred.get(self.from_field)
@@ -164,16 +271,21 @@ class JwtProvider(Provider):
         if not isinstance(header, dict) or not isinstance(claims, dict):
             return ("error", "bad_token")
         alg = header.get("alg")
-        digest = {"HS256": "sha256", "HS384": "sha384",
-                  "HS512": "sha512"}.get(alg)
-        if digest is None or alg != self.algorithm:
+        if alg != self.algorithm:
             return ("error", "bad_token_algorithm")
-        expect = hmac.new(
-            self.secret, f"{parts[0]}.{parts[1]}".encode(),
-            getattr(hashlib, digest),
-        ).digest()
-        if not hmac.compare_digest(expect, sig):
-            return ("error", "bad_token_signature")
+        signing = f"{parts[0]}.{parts[1]}".encode()
+        if alg in ("HS256", "HS384", "HS512"):
+            digest = {"HS256": "sha256", "HS384": "sha384",
+                      "HS512": "sha512"}[alg]
+            expect = hmac.new(self.secret, signing,
+                              getattr(hashlib, digest)).digest()
+            if not hmac.compare_digest(expect, sig):
+                return ("error", "bad_token_signature")
+        elif alg in ("RS256", "RS384", "RS512", "ES256"):
+            if not self._verify_asym(alg, header, signing, sig):
+                return ("error", "bad_token_signature")
+        else:
+            return ("error", "bad_token_algorithm")
         now = time.time()
         try:
             exp = float(claims["exp"]) if "exp" in claims else None

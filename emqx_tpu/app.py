@@ -278,9 +278,23 @@ class BrokerApp:
             mech = spec.get("mechanism", "password_based")
             backend = spec.get("backend", "built_in_database")
             if mech == "jwt":
+                jwks_fn = None
+                if spec.get("endpoint"):        # JWKS URL (emqx_authn_jwt)
+                    import json as _json
+                    import urllib.request as _rq
+                    url = str(spec["endpoint"])
+
+                    def jwks_fn(u=url):
+                        with _rq.urlopen(u, timeout=5) as r:
+                            return _json.loads(r.read())
                 providers.append(JwtProvider(
                     secret=str(spec.get("secret", "")).encode(),
-                    algorithm=spec.get("algorithm", "HS256")))
+                    algorithm=spec.get("algorithm", "HS256"),
+                    public_key_pem=(
+                        str(spec["public_key"]).encode()
+                        if spec.get("public_key") else None),
+                    jwks_fn=jwks_fn,
+                    verify_claims=spec.get("verify_claims")))
             elif mech == "password_based" and backend == "built_in_database":
                 p = BuiltinDbProvider(
                     user_id_type=spec.get("user_id_type", "username"))

@@ -368,8 +368,10 @@ class ClusterNode:
             return                    # stale route; purge is in flight
         try:
             # the broker's _route counts messages.forward for this leg
-            self.transport.cast(dest, "broker.dispatch", filter=filt,
-                                msg=codec.msg_to_dict(msg))
+            # per-topic lane keeps one topic's messages ordered while
+            # different topics parallelize (gen_rpc key, emqx_rpc.erl:79)
+            self.transport.cast(dest, "broker.dispatch", _key=filt,
+                                filter=filt, msg=codec.msg_to_dict(msg))
         except TransportError:
             pass
 
@@ -398,8 +400,9 @@ class ClusterNode:
             else:
                 try:
                     self.transport.cast(
-                        node, "shared_sub.deliver", sid=sid,
-                        sub_topic=sub_topic, msg=codec.msg_to_dict(msg))
+                        node, "shared_sub.deliver", _key=sub_topic,
+                        sid=sid, sub_topic=sub_topic,
+                        msg=codec.msg_to_dict(msg))
                 except TransportError:
                     pass
         return local

@@ -51,7 +51,12 @@ class Transport:
     def call(self, to: str, method: str, **kwargs: Any) -> Any:
         raise NotImplementedError
 
-    def cast(self, to: str, method: str, **kwargs: Any) -> None:
+    def cast(self, to: str, method: str, _key: Any = None,
+             **kwargs: Any) -> None:
+        """Fire-and-forget. ``_key`` (gen_rpc's per-{Key,Node} client
+        pools, emqx_rpc.erl:79-84) pins all casts sharing a key to ONE
+        ordered lane to the peer; different keys may ride parallel
+        lanes. None = the default lane."""
         raise NotImplementedError
 
     def peers(self) -> list[str]:
@@ -96,8 +101,9 @@ class LocalBus(Transport):
         wire = codec.decode(codec.encode(kwargs))
         return codec.decode(codec.encode(peer._dispatch(method, wire)))
 
-    def cast(self, to: str, method: str, **kwargs: Any) -> None:
-        self.call(to, method, **kwargs)
+    def cast(self, to: str, method: str, _key: Any = None,
+             **kwargs: Any) -> None:
+        self.call(to, method, **kwargs)     # in-process: always ordered
 
     def peers(self) -> list[str]:
         return [n for n in self.fabric.nodes if n != self.node]
@@ -107,20 +113,31 @@ class LocalBus(Transport):
 
 
 class TcpTransport(Transport):
-    """Length-prefixed frames over one TCP connection per peer.
+    """Length-prefixed frames over N_LANES TCP connections per peer.
 
     Runs its own event loop in a daemon thread so the synchronous
     call/cast surface works from broker code. Frame = 4-byte BE length +
     codec.encode({id, kind: req|resp|cast, method, kwargs | result |
     error}).
+
+    Lanes are the gen_rpc client-pool analogue (emqx_rpc.erl:74-84,
+    ?DefaultClientNum): casts carrying the same ``_key`` (the topic, at
+    the forwarding call sites) always take the same connection — TCP
+    framing plus the server's sequential per-connection dispatch keep
+    per-key order — while different keys spread across lanes and are
+    processed in parallel on the peer. Lane 0 carries calls and keyless
+    casts.
     """
+
+    N_LANES = 4
 
     def __init__(self, node: str, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         super().__init__(node)
         self.host, self.port = host, port
         self._peer_addrs: dict[str, tuple[str, int]] = {}
-        self._writers: dict[str, asyncio.StreamWriter] = {}
+        self._writers: dict[tuple[str, int], asyncio.StreamWriter] = {}
+        self._conn_futs: dict[tuple[str, int], asyncio.Future] = {}
         self._futures: dict[int, asyncio.Future] = {}
         self._req_id = 0
         self._loop = asyncio.new_event_loop()
@@ -194,21 +211,40 @@ class TcpTransport(Transport):
 
     # -- client side --------------------------------------------------------
 
-    async def _get_writer(self, node: str) -> asyncio.StreamWriter:
-        w = self._writers.get(node)
-        if w is not None and not w.is_closing():
-            return w
+    async def _open_lane(self, node: str, lane: int) -> asyncio.StreamWriter:
         addr = self._peer_addrs.get(node)
         if addr is None:
             raise TransportError(f"unknown node {node}")
         reader, writer = await asyncio.open_connection(*addr)
-        self._writers[node] = writer
+        self._writers[(node, lane)] = writer
         # responses to our requests come back on this same connection
         asyncio.ensure_future(self._on_conn(reader, writer))
         return writer
 
-    async def _send(self, node: str, obj: dict) -> None:
-        writer = await self._get_writer(node)
+    async def _get_writer(self, node: str,
+                          lane: int = 0) -> asyncio.StreamWriter:
+        # single connect future per (node, lane): a burst of same-key
+        # casts before the lane exists must all await ONE connection —
+        # racing opens would split the lane across sockets and break the
+        # per-key ordering the lane exists to provide
+        key = (node, lane)
+        fut = self._conn_futs.get(key)
+        if fut is None or (fut.done() and (
+                fut.exception() is not None
+                or fut.result().is_closing())):
+            fut = self._conn_futs[key] = self._loop.create_task(
+                self._open_lane(node, lane))
+        return await asyncio.shield(fut)
+
+    @classmethod
+    def _lane_for(cls, key: Any) -> int:
+        if key is None:
+            return 0
+        import zlib
+        return 1 + zlib.crc32(str(key).encode()) % max(1, cls.N_LANES - 1)
+
+    async def _send(self, node: str, obj: dict, lane: int = 0) -> None:
+        writer = await self._get_writer(node, lane)
         writer.write(self._frame(obj))
         await writer.drain()
 
@@ -235,11 +271,15 @@ class TcpTransport(Transport):
                 TimeoutError) as e:
             raise TransportError(f"call {method} to {to}: {e}") from e
 
-    def cast(self, to: str, method: str, **kwargs: Any) -> None:
+    def cast(self, to: str, method: str, _key: Any = None,
+             **kwargs: Any) -> None:
+        lane = self._lane_for(_key)
+
         async def go():
             try:
                 await self._send(to, {"id": 0, "kind": "cast",
-                                      "method": method, "kwargs": kwargs})
+                                      "method": method, "kwargs": kwargs},
+                                 lane)
             except (ConnectionError, OSError):
                 pass                            # async mode drops on error
         asyncio.run_coroutine_threadsafe(go(), self._loop)

@@ -372,3 +372,51 @@ def test_exclusive_visible_to_late_joiner():
                 "c9", "$exclusive/t/3", SubOpts(exclusive=True))
     finally:
         stop(nodes)
+
+
+def test_tcp_transport_per_key_lanes_order_and_parallelism():
+    """gen_rpc-analogue lanes: casts sharing a _key stay ordered on one
+    connection; different keys ride parallel lanes (a slow key must not
+    block another key's delivery)."""
+    import threading
+    import time as _t
+
+    from emqx_tpu.cluster.transport import TcpTransport
+
+    a = TcpTransport("la")
+    b = TcpTransport("lb")
+    a.add_peer("lb", b.host, b.port)
+    got: list = []
+    slow_started = threading.Event()
+    fast_done = threading.Event()
+
+    def handler(seq: int, key: str) -> None:
+        if key == "slow" and seq == 0:
+            slow_started.set()
+            _t.sleep(1.0)
+        got.append((key, seq))
+        if key == "fast":
+            fast_done.set()
+
+    b.register("lane.probe", handler)
+    try:
+        # interleave: slow key first, then 50 ordered casts on key kA
+        a.cast("lb", "lane.probe", _key="slow", seq=0, key="slow")
+        assert slow_started.wait(5)
+        for i in range(50):
+            a.cast("lb", "lane.probe", _key="kA", seq=i, key="kA")
+        a.cast("lb", "lane.probe", _key="fast", seq=0, key="fast")
+        assert fast_done.wait(5), \
+            "a slow lane blocked an unrelated key's lane"
+        deadline = _t.time() + 10
+        while _t.time() < deadline and \
+                len([g for g in got if g[0] == "kA"]) < 50:
+            _t.sleep(0.05)
+        ka = [seq for key, seq in got if key == "kA"]
+        assert ka == list(range(50)), "per-key order violated"
+        # distinct lanes actually used (connection map keyed by lane)
+        lanes = {lane for (_n, lane) in a._writers}
+        assert len(lanes) >= 2
+    finally:
+        a.close()
+        b.close()

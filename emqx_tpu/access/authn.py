@@ -152,6 +152,12 @@ class JwtProvider(Provider):
                  public_key_pem: Optional[bytes] = None,
                  jwks: Optional[dict] = None,
                  jwks_fn: Optional[Callable[[], dict]] = None) -> None:
+        if algorithm.startswith("HS") and not secret:
+            # an empty HMAC secret verifies attacker-minted tokens
+            # (HMAC(b"") is computable by anyone) — refuse at config
+            # time; key sources don't help, HS* only ever uses `secret`
+            raise ValueError(
+                "jwt: HS* algorithms require a non-empty secret")
         self.secret = secret
         self.algorithm = algorithm
         self.verify_claims = verify_claims or {}
@@ -166,6 +172,8 @@ class JwtProvider(Provider):
             self._static_key = load_pem_public_key(public_key_pem)
         self.jwks_fn = jwks_fn
         self._jwks = jwks or ({} if jwks_fn is None else None)
+        self._jwks_keys = (None if self._jwks is None
+                           else self._parse_jwks(self._jwks))
         # refresh throttle: a flood of bad-signature tokens must not
         # amplify into one endpoint fetch each (the reference refreshes
         # on an interval, emqx_authn_jwt ssl/refresh_interval)
@@ -176,18 +184,56 @@ class JwtProvider(Provider):
 
     _RS = {"RS256": "sha256", "RS384": "sha384", "RS512": "sha512"}
 
-    def _jwks_doc(self, refresh: bool = False) -> dict:
-        if (self._jwks is None or refresh) and self.jwks_fn is not None:
+    @staticmethod
+    def _parse_jwks(doc: dict) -> list:
+        """JWKS → [(kid, kty, key_object)], parsed ONCE per fetch — key
+        construction is off the per-CONNECT hot path."""
+        out = []
+        for jwk in (doc or {}).get("keys", []):
+            try:
+                if jwk.get("kty") == "RSA":
+                    from cryptography.hazmat.primitives.asymmetric.rsa \
+                        import RSAPublicNumbers
+                    n = int.from_bytes(_unb64url(jwk["n"]), "big")
+                    e = int.from_bytes(_unb64url(jwk["e"]), "big")
+                    out.append((jwk.get("kid"), "RSA",
+                                RSAPublicNumbers(e, n).public_key()))
+                elif jwk.get("kty") == "EC" and jwk.get("crv") == "P-256":
+                    from cryptography.hazmat.primitives.asymmetric.ec \
+                        import SECP256R1, EllipticCurvePublicNumbers
+                    x = int.from_bytes(_unb64url(jwk["x"]), "big")
+                    y = int.from_bytes(_unb64url(jwk["y"]), "big")
+                    out.append((jwk.get("kid"), "EC",
+                                EllipticCurvePublicNumbers(
+                                    x, y, SECP256R1()).public_key()))
+            except Exception:            # malformed JWK entry: skip it
+                continue
+        return out
+
+    def _refresh_jwks(self, blocking: bool = True) -> None:
+        try:
+            doc = self.jwks_fn() or {}
+        except Exception:
+            return
+        self._jwks = doc
+        self._jwks_keys = self._parse_jwks(doc)
+
+    def _jwks_keys_view(self, refresh: bool = False) -> list:
+        if self.jwks_fn is not None:
             now = time.time()
-            if (self._jwks is None
-                    or now - self._jwks_fetched_at
+            first = self._jwks is None
+            if (first or refresh) and (
+                    first or now - self._jwks_fetched_at
                     >= self.jwks_min_refresh_s):
                 self._jwks_fetched_at = now
-                try:
-                    self._jwks = self.jwks_fn() or {}
-                except Exception:
-                    self._jwks = self._jwks or {}
-        return self._jwks or {}
+                # the first fetch and a verification-miss refresh (key
+                # rotation) must complete before verification proceeds;
+                # the throttle bounds loop stalls to one fetch per
+                # jwks_min_refresh_s even under a bad-token flood
+                self._refresh_jwks(blocking=True)
+        if self._jwks_keys is None:
+            self._jwks_keys = self._parse_jwks(self._jwks)
+        return self._jwks_keys
 
     def _candidate_keys(self, alg: str, header: dict,
                         refresh: bool = False) -> list:
@@ -198,29 +244,10 @@ class JwtProvider(Provider):
             return [self._static_key]
         want_kty = "RSA" if alg in self._RS else "EC"
         kid = header.get("kid")
-        out = []
-        for jwk in self._jwks_doc(refresh).get("keys", []):
-            if kid is not None and jwk.get("kid") != kid:
-                continue
-            if jwk.get("kty") != want_kty:
-                continue
-            try:
-                if want_kty == "RSA":
-                    from cryptography.hazmat.primitives.asymmetric.rsa \
-                        import RSAPublicNumbers
-                    n = int.from_bytes(_unb64url(jwk["n"]), "big")
-                    e = int.from_bytes(_unb64url(jwk["e"]), "big")
-                    out.append(RSAPublicNumbers(e, n).public_key())
-                elif jwk.get("crv") == "P-256":
-                    from cryptography.hazmat.primitives.asymmetric.ec \
-                        import SECP256R1, EllipticCurvePublicNumbers
-                    x = int.from_bytes(_unb64url(jwk["x"]), "big")
-                    y = int.from_bytes(_unb64url(jwk["y"]), "big")
-                    out.append(EllipticCurvePublicNumbers(
-                        x, y, SECP256R1()).public_key())
-            except Exception:            # malformed JWK entry: skip it
-                continue
-        return out
+        return [key for k_kid, k_kty, key
+                in self._jwks_keys_view(refresh)
+                if k_kty == want_kty
+                and (kid is None or k_kid == kid)]
 
     def _verify_asym(self, alg: str, header: dict, signing: bytes,
                      sig: bytes) -> bool:

@@ -287,9 +287,14 @@ class BrokerApp:
                     def jwks_fn(u=url):
                         with _rq.urlopen(u, timeout=5) as r:
                             return _json.loads(r.read())
+                # asymmetric key sources default to RS256 — falling back
+                # to HS256-with-empty-secret would let anyone mint valid
+                # tokens (JwtProvider also hard-refuses that combination)
+                default_alg = ("RS256" if spec.get("endpoint")
+                               or spec.get("public_key") else "HS256")
                 providers.append(JwtProvider(
                     secret=str(spec.get("secret", "")).encode(),
-                    algorithm=spec.get("algorithm", "HS256"),
+                    algorithm=spec.get("algorithm", default_alg),
                     public_key_pem=(
                         str(spec["public_key"]).encode()
                         if spec.get("public_key") else None),

@@ -43,6 +43,9 @@ class ConnInfo:
     clean_start: bool = True
     expiry_interval_ms: int = 0
     connected_at: int = 0
+    # client's announced Maximum-Packet-Size: the server MUST NOT send a
+    # larger packet (MQTT5 3.1.2-25); 0 = no limit announced
+    max_packet_out: int = 0
 
 
 @dataclass
@@ -224,6 +227,11 @@ class Channel:
             pkt.clean_start, clientid, self, self.session_opts
         )
         self.session = session
+        # the client's Maximum-Packet-Size caps every packet we send
+        # (enforced at serialization by the connection host)
+        mps = (pkt.properties or {}).get("Maximum-Packet-Size")
+        if mps:
+            ci.max_packet_out = int(mps)
         # client flow control: its Receive-Maximum caps our send window
         # (MQTT5 3.1.2-11; reference folds it into the inflight limit)
         rm = (pkt.properties or {}).get("Receive-Maximum")

@@ -71,9 +71,34 @@ class Connection:
     def _send_packets(self, pkts) -> None:
         if self.closed:
             return
-        data = b"".join(
-            serialize(p, self.channel.conninfo.proto_ver) for p in pkts
-        )
+        ver = self.channel.conninfo.proto_ver
+        limit = self.channel.conninfo.max_packet_out
+        chunks = []
+        sent_pkts = []
+        queue = list(pkts)
+        while queue:
+            p = queue.pop(0)
+            b = serialize(p, ver)
+            if limit and len(b) > limit and p.type == P.PUBLISH:
+                # MQTT5 3.1.2-25: never exceed the client's announced
+                # Maximum-Packet-Size — the message is dropped for THIS
+                # client (acks/connacks are never oversized in practice).
+                # A QoS>0 drop must also release its inflight slot ("as
+                # if it had completed sending") or the window leaks and
+                # retry re-drops it forever.
+                if self.metrics is not None:
+                    self.metrics.inc("delivery.dropped.too_large")
+                session = self.channel.session
+                if p.qos and p.packet_id is not None and session is not None:
+                    # freed slot may pull queued messages forward; they
+                    # take the channel's normal unmount/hook postprocess
+                    queue.extend(self.channel._postprocess_out(
+                        session.discard_delivery(p.packet_id)))
+                continue
+            chunks.append(b)
+            sent_pkts.append(p)
+        data = b"".join(chunks)
+        pkts = sent_pkts
         if data:
             frame = self._transport_wrap(data)
             try:

@@ -175,6 +175,15 @@ class Session:
 
     # -- acks --------------------------------------------------------------
 
+    def discard_delivery(self, packet_id: int,
+                         now: Optional[int] = None) -> list[P.Packet]:
+        """Server-side 'as if it had completed sending' (MQTT5 3.1.2-25:
+        an outgoing publish the client's Maximum-Packet-Size forbids is
+        dropped): release the window slot regardless of QoS/phase and
+        pull the next queued messages into it."""
+        self.inflight.delete(packet_id)
+        return self.dequeue(now)
+
     def puback(self, packet_id: int,
                now: Optional[int] = None) -> list[P.Packet]:
         entry = self.inflight.lookup(packet_id)

@@ -460,3 +460,31 @@ def test_jwks_refresh_is_throttled():
     for _ in range(20):                  # bad-signature flood
         assert p.authenticate({"password": token})[0] == "error"
     assert len(fetches) <= 2, "refresh not throttled"
+
+
+def test_jwt_empty_hs_secret_refused():
+    from emqx_tpu.access.authn import JwtProvider
+
+    with pytest.raises(ValueError, match="non-empty secret"):
+        JwtProvider(secret=b"", algorithm="HS256")
+    # asymmetric flavors don't need a secret
+    JwtProvider(algorithm="RS256", jwks={"keys": []})
+
+
+def test_jwt_factory_defaults_to_rs256_with_key_source():
+    """{'mechanism': 'jwt', 'endpoint': ...} without an algorithm must
+    NOT fall back to HS256-with-empty-secret (auth bypass)."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.config import Config
+
+    conf = Config()
+    conf.init_load("")
+    conf.put("authentication", [
+        {"mechanism": "jwt", "endpoint": "http://127.0.0.1:9/jwks"},
+    ], layer="local")
+    app = BrokerApp.from_config(conf)
+    (p,) = app.access.authn.providers
+    assert p.algorithm == "RS256"
+    # an attacker's HS256 token with the empty-secret HMAC is rejected
+    forged = jwt_sign({"exp": time.time() + 60}, b"")
+    assert p.authenticate({"password": forged})[0] == "error"

@@ -170,3 +170,77 @@ def test_retained_and_shared_over_tcp(run):
         for c in (p, late, w1, w2):
             await c.disconnect()
     run(scenario)
+
+
+def test_outbound_maximum_packet_size_enforced():
+    """MQTT5 3.1.2-25: a PUBLISH exceeding the client's announced
+    Maximum-Packet-Size is dropped for that client (and counted), while
+    small packets and other clients flow normally."""
+    import asyncio
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.mqtt.client import MqttClient
+
+    async def main():
+        app = BrokerApp()
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+        tiny = MqttClient(port=server.port, clientid="tiny", proto_ver=5,
+                          properties={"Maximum-Packet-Size": 64})
+        await tiny.connect()
+        await tiny.subscribe("mps/t", qos=0)
+        big = MqttClient(port=server.port, clientid="bigc", proto_ver=5)
+        await big.connect()
+        await big.subscribe("mps/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="p", proto_ver=5)
+        await pub.connect()
+        await pub.publish("mps/t", b"x" * 500, qos=0)   # > 64 bytes framed
+        await pub.publish("mps/t", b"ok", qos=0)
+        # big client gets both; tiny client only the small one
+        m1 = await asyncio.wait_for(big.messages.get(), 5)
+        m2 = await asyncio.wait_for(big.messages.get(), 5)
+        assert {m1.payload, m2.payload} == {b"x" * 500, b"ok"}
+        mt = await asyncio.wait_for(tiny.messages.get(), 5)
+        assert mt.payload == b"ok"
+        assert tiny.messages.empty()
+        assert app.metrics.val("delivery.dropped.too_large") == 1
+        await tiny.disconnect(); await big.disconnect(); await pub.disconnect()
+        await server.stop()
+    asyncio.run(main())
+
+
+def test_size_dropped_qos1_releases_inflight_window():
+    """MQTT5 3.1.2-25 follow-through: an oversized QoS1 publish releases
+    its window slot, so later (small) messages still flow."""
+    import asyncio
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.mqtt.client import MqttClient
+
+    async def main():
+        app = BrokerApp()
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+        tiny = MqttClient(port=server.port, clientid="tq", proto_ver=5,
+                          properties={"Maximum-Packet-Size": 64,
+                                      "Receive-Maximum": 2})
+        await tiny.connect()
+        await tiny.subscribe("mq/t", qos=1)
+        pub = MqttClient(port=server.port, clientid="pq", proto_ver=5)
+        await pub.connect()
+        # fill the 2-slot window with oversized messages, then small ones
+        for _ in range(3):
+            await pub.publish("mq/t", b"z" * 300, qos=1)
+        for i in range(3):
+            await pub.publish("mq/t", f"s{i}".encode(), qos=1)
+        got = []
+        for _ in range(3):
+            m = await asyncio.wait_for(tiny.messages.get(), 5)
+            got.append(m.payload)
+        assert got == [b"s0", b"s1", b"s2"], got
+        ch = app.cm.lookup_channel("tq")
+        assert len(ch.session.inflight) <= 2
+        await tiny.disconnect(); await pub.disconnect(); await server.stop()
+    asyncio.run(main())

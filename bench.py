@@ -42,6 +42,31 @@ if os.environ.get("JAX_PLATFORMS"):
     # JAX_PLATFORMS so the bench can be verified off-TPU
     import jax as _jax
     _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+def _supervise() -> None:
+    """A flaky device tunnel can pass any pre-probe and still hang the
+    bench mid-upload — which would leave the round without an artifact
+    (the r2 failure mode: rc!=0, zero numbers). Re-invoke this script as
+    a supervised child with a hard deadline; if the device run hangs or
+    dies, run ONCE more pinned to CPU so a measured (slower, clearly
+    labelled) artifact always exists."""
+    import subprocess as _sp
+
+    # a healthy-tunnel run at defaults takes ~5 min; 25 min of headroom
+    # still leaves room for the CPU retry inside a 1h driver budget
+    deadline = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", 1500))
+    base_env = {**os.environ, "BENCH_SUPERVISED": "1"}
+    try:
+        rc = _sp.run([sys.executable, "-u", os.path.abspath(__file__)],
+                     env=base_env, timeout=deadline).returncode
+        if rc == 0:
+            sys.exit(0)
+        log(f"device bench exited rc={rc}; retrying on CPU")
+    except _sp.TimeoutExpired:
+        log(f"device bench exceeded {deadline:.0f}s (tunnel hang?); "
+            "retrying on CPU — numbers below are NOT TPU numbers")
+    cpu_env = {**base_env, "JAX_PLATFORMS": "cpu"}
+    sys.exit(_sp.run([sys.executable, "-u", os.path.abspath(__file__)],
+                     env=cpu_env, timeout=deadline).returncode)
 
 
 def log(*a):
@@ -526,4 +551,6 @@ def bench_e2e() -> None:
 
 
 if __name__ == "__main__":
+    if os.environ.get("BENCH_SUPERVISED") != "1":
+        _supervise()
     main()

@@ -210,10 +210,15 @@ class JwtProvider(Provider):
                 continue
         return out
 
-    def _refresh_jwks(self, blocking: bool = True) -> None:
+    def _refresh_jwks(self) -> None:
         try:
             doc = self.jwks_fn() or {}
         except Exception:
+            # keep (or establish) a doc even on failure: `_jwks is None`
+            # marks "never fetched" and would bypass the refresh
+            # throttle, turning a dead endpoint into per-token blocking
+            # fetches
+            self._jwks = self._jwks or {}
             return
         self._jwks = doc
         self._jwks_keys = self._parse_jwks(doc)
@@ -230,7 +235,7 @@ class JwtProvider(Provider):
                 # rotation) must complete before verification proceeds;
                 # the throttle bounds loop stalls to one fetch per
                 # jwks_min_refresh_s even under a bad-token flood
-                self._refresh_jwks(blocking=True)
+                self._refresh_jwks()
         if self._jwks_keys is None:
             self._jwks_keys = self._parse_jwks(self._jwks)
         return self._jwks_keys

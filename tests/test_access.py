@@ -488,3 +488,21 @@ def test_jwt_factory_defaults_to_rs256_with_key_source():
     # an attacker's HS256 token with the empty-secret HMAC is rejected
     forged = jwt_sign({"exp": time.time() + 60}, b"")
     assert p.authenticate({"password": forged})[0] == "error"
+
+
+def test_jwks_dead_endpoint_fetches_are_throttled():
+    """A JWKS endpoint that is DOWN from the start must not be re-fetched
+    per token — the throttle applies to failures too."""
+    from emqx_tpu.access.authn import JwtProvider
+
+    fetches = []
+
+    def broken():
+        fetches.append(1)
+        raise OSError("endpoint down")
+
+    p = JwtProvider(algorithm="RS256", jwks_fn=broken)
+    tok, _j, _pem = _rsa_jwt({"exp": time.time() + 60})
+    for _ in range(20):
+        assert p.authenticate({"password": tok})[0] == "error"
+    assert len(fetches) <= 2, f"dead endpoint fetched {len(fetches)} times"

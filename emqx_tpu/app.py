@@ -270,6 +270,13 @@ class BrokerApp:
                 return PgClient(port=int(port or 5432),
                                 user=spec.get("username", "postgres"),
                                 password=spec.get("password", ""), **kw)
+            if backend == "ldap":
+                from emqx_tpu.connector.ldap import LdapClient
+                return LdapClient(host=host or "127.0.0.1",
+                                  port=int(port or 389),
+                                  bind_dn=spec.get("bind_dn", ""),
+                                  bind_password=spec.get(
+                                      "bind_password", ""))
             from emqx_tpu.connector.mongodb import MongoClient
             return MongoClient(port=int(port or 27017), **kw)
 
@@ -327,6 +334,12 @@ class BrokerApp:
                     collection=spec.get("collection", "mqtt_user"),
                     filter_=spec.get("filter"),
                     hash_spec=_hash_spec(spec)))
+            elif mech == "password_based" and backend == "ldap":
+                from emqx_tpu.access.ldap_backends import LdapAuthnProvider
+                providers.append(LdapAuthnProvider(
+                    _db_client("ldap", spec),
+                    base_dn=spec.get("base_dn", "dc=emqx,dc=io"),
+                    filter_=spec.get("filter")))
             # unknown specs are skipped (optional backends not built)
         sources = []
         for spec in conf.get("authorization.sources", []) or []:
@@ -351,6 +364,12 @@ class BrokerApp:
                 sources.append(MongoAclSource(
                     _db_client("mongodb", spec),
                     collection=spec.get("collection", "mqtt_acl"),
+                    filter_=spec.get("filter")))
+            elif stype == "ldap":
+                from emqx_tpu.access.ldap_backends import LdapAclSource
+                sources.append(LdapAclSource(
+                    _db_client("ldap", spec),
+                    base_dn=spec.get("base_dn", "dc=emqx,dc=io"),
                     filter_=spec.get("filter")))
         az_conf = conf.get("authorization")
         fl = conf.get("flapping_detect")

@@ -1,0 +1,228 @@
+"""LDAP stack: BER codec + RFC 4515 filters, the LDAPv3 wire client
+against MiniLDAP, and authn/authz through a live broker (reference:
+emqx_connector_ldap.erl search/4; CI runs a real openldap container)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.server import BrokerServer
+from emqx_tpu.config.config import Config
+from emqx_tpu.connector.ldap import (LdapClient, LdapConnector, LdapError,
+                                     MiniLDAP, ber_read, ber_seq,
+                                     parse_filter)
+from emqx_tpu.mqtt.client import MqttClient
+
+
+def _directory() -> MiniLDAP:
+    srv = MiniLDAP()
+    srv.add("uid=alice,ou=mqtt,dc=emqx,dc=io",
+            objectClass=["mqttUser"], uid="alice",
+            userPassword="pw-alice", isSuperuser="false",
+            mqttPublishTopic="up/alice/#", mqttSubscriptionTopic="up/#")
+    srv.add("uid=bob,ou=mqtt,dc=emqx,dc=io",
+            objectClass=["mqttUser"], uid="bob",
+            userPassword="pw-bob", isSuperuser="true")
+    srv.add("ou=mqtt,dc=emqx,dc=io", objectClass=["organizationalUnit"],
+            ou="mqtt")
+    return srv
+
+
+# -- BER / filter unit tests ---------------------------------------------------
+
+def test_ber_long_length_roundtrip():
+    from emqx_tpu.connector.ldap import ber
+    content = b"x" * 300
+    tag, got, used = ber_read(ber(0x30, content), 0)
+    assert (tag, got) == (0x30, content) and used == 300 + 4
+
+
+def test_filter_parse_shapes():
+    # equality, presence, and, or, not, substring all encode
+    for s in ("(uid=alice)", "(uid=*)", "(&(a=1)(b=2))",
+              "(|(a=1)(!(b=2)))", "(cn=al*ce*)", "(n>=5)"):
+        tlv = parse_filter(s)
+        ber_read(tlv, 0)   # well-formed
+    with pytest.raises(LdapError):
+        parse_filter("(uid=alice")
+    with pytest.raises(LdapError):
+        parse_filter("(&)")
+    with pytest.raises(LdapError):
+        parse_filter("(nooper)")
+
+
+def test_filter_escapes():
+    tlv = parse_filter(r"(cn=a\2ab)")        # \2a = literal '*'
+    _tag, content, _ = ber_read(tlv, 0)
+    parts = ber_seq(content)
+    assert parts[1][1] == b"a*b"
+    for bad in (r"(cn=a\zz)", "(cn=a\\5)"):  # non-hex / truncated escape
+        with pytest.raises(LdapError):
+            parse_filter(bad)
+
+
+def test_filter_injection_blocked():
+    """${username} substitution must RFC 4515-escape metacharacters: a
+    username of 'al*' must not wildcard-match alice's entry."""
+    srv = _directory().start()
+    try:
+        from emqx_tpu.access.ldap_backends import LdapAuthnProvider
+        p = LdapAuthnProvider(LdapClient(port=srv.port))
+        assert p.authenticate(
+            {"username": "al*", "password": b"pw-alice"}) == "ignore"
+        # and the escaped literal still matches an exact entry
+        assert p.authenticate(
+            {"username": "alice", "password": b"pw-alice"})[0] == "ok"
+    finally:
+        srv.stop()
+
+
+def test_empty_password_is_not_unauthenticated_bind():
+    """RFC 4513 §5.1.2: empty password must fail authn outright, never
+    reach the server as an unauthenticated bind."""
+    srv = _directory().start()
+    try:
+        from emqx_tpu.access.ldap_backends import LdapAuthnProvider
+        p = LdapAuthnProvider(LdapClient(port=srv.port))
+        assert p.authenticate(
+            {"username": "alice", "password": b""}) == (
+                "error", "bad_username_or_password")
+    finally:
+        srv.stop()
+
+
+def test_scope_respects_dn_component_boundary():
+    """A sibling tree whose string merely ends with the base DN is out
+    of scope (comma-boundary check)."""
+    srv = MiniLDAP()
+    srv.add("cn=x,otherdc=emqx,dc=io", cn="x")
+    srv.add("cn=y,dc=emqx,dc=io", cn="y")
+    srv.start()
+    try:
+        c = LdapClient(port=srv.port)
+        hits = c.search("dc=emqx,dc=io", "(cn=*)")
+        assert [dn for dn, _ in hits] == ["cn=y,dc=emqx,dc=io"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- wire client vs MiniLDAP ---------------------------------------------------
+
+def test_ldap_search_and_bind():
+    srv = _directory().start()
+    try:
+        c = LdapClient(port=srv.port, bind_dn="cn=admin,dc=emqx,dc=io",
+                       bind_password="admin")
+        hits = c.search("dc=emqx,dc=io",
+                        "(&(objectClass=mqttUser)(uid=alice))")
+        assert len(hits) == 1
+        dn, attrs = hits[0]
+        assert dn == "uid=alice,ou=mqtt,dc=emqx,dc=io"
+        assert attrs["mqttpublishtopic"] == ["up/alice/#"]
+        # attribute selection narrows the entry
+        hits = c.search("dc=emqx,dc=io", "(uid=alice)", ("uid",))
+        assert list(hits[0][1]) == ["uid"]
+        # presence + substring + scope=one
+        assert len(c.search("dc=emqx,dc=io", "(uid=*)")) == 2
+        assert len(c.search("dc=emqx,dc=io", "(uid=*li*)")) == 1
+        one = c.search("dc=emqx,dc=io", "(objectClass=*)", scope="one")
+        assert [dn for dn, _ in one] == ["ou=mqtt,dc=emqx,dc=io"]
+        assert len(c.search("ou=mqtt,dc=emqx,dc=io", "(objectClass=*)",
+                            scope="one")) == 2
+        # bind-as-user password check
+        assert c.check_bind("uid=alice,ou=mqtt,dc=emqx,dc=io", "pw-alice")
+        assert not c.check_bind("uid=alice,ou=mqtt,dc=emqx,dc=io", "nope")
+        c.close()
+        # wrong root bind refused at connect time
+        bad = LdapClient(port=srv.port, bind_dn="cn=admin,dc=emqx,dc=io",
+                         bind_password="wrong")
+        with pytest.raises(LdapError):
+            bad.search("dc=emqx,dc=io", "(uid=*)")
+    finally:
+        srv.stop()
+
+
+def test_ldap_connector_resource():
+    srv = _directory().start()
+    try:
+        conn = LdapConnector(port=srv.port)
+        conn.on_start({})
+        assert conn.on_health_check()
+        hits = conn.on_query({"search": "dc=emqx,dc=io",
+                              "filter": "(uid=bob)",
+                              "attributes": ("isSuperuser",)})
+        assert hits[0][1]["issuperuser"] == ["true"]
+        assert conn.on_query({"bind": "uid=bob,ou=mqtt,dc=emqx,dc=io",
+                              "password": "pw-bob"})
+        conn.on_stop()
+        assert conn.on_health_check()   # lazily reconnects
+    finally:
+        srv.stop()
+
+
+def test_ldap_client_survives_server_restart():
+    srv = _directory().start()
+    port = srv.port
+    c = LdapClient(port=port)
+    assert len(c.search("dc=emqx,dc=io", "(uid=*)")) == 2
+    srv.stop()
+    srv2 = MiniLDAP(port=port)
+    srv2.add("uid=carol,dc=emqx,dc=io", uid="carol")
+    srv2.start()
+    try:
+        # retry-once reconnect picks the fresh server up
+        assert len(c.search("dc=emqx,dc=io", "(uid=*)")) == 1
+        c.close()
+    finally:
+        srv2.stop()
+
+
+# -- authn / authz through a live broker ---------------------------------------
+
+def test_ldap_authn_authz_via_live_broker():
+    srv = _directory().start()
+
+    async def main():
+        conf = Config()
+        conf.init_load("authorization { no_match = deny }")
+        spec = {"mechanism": "password_based", "backend": "ldap",
+                "server": f"127.0.0.1:{srv.port}",
+                "base_dn": "dc=emqx,dc=io"}
+        conf.put("authentication", [spec], layer="local")
+        conf.put("authorization.sources",
+                 [{**spec, "type": "ldap"}], layer="local")
+        app = BrokerApp.from_config(conf)
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+
+        bad = MqttClient(port=server.port, clientid="b1", proto_ver=5,
+                         username="alice", password=b"wrong")
+        with pytest.raises(ConnectionRefusedError):
+            await bad.connect()
+
+        good = MqttClient(port=server.port, clientid="g1", proto_ver=5,
+                          username="alice", password=b"pw-alice")
+        ack = await good.connect()
+        assert ack.reason_code == 0
+
+        sub = MqttClient(port=server.port, clientid="s1", proto_ver=5,
+                         username="alice", password=b"pw-alice")
+        await sub.connect()
+        await sub.subscribe("up/#", qos=0)
+        await good.publish("up/alice/data", b"ok", qos=0)
+        await good.publish("other/topic", b"denied", qos=0)
+        try:
+            msg = await asyncio.wait_for(sub.messages.get(), 5)
+            assert msg.topic == "up/alice/data"
+            assert sub.messages.empty()
+        finally:
+            await good.disconnect()
+            await sub.disconnect()
+            await server.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        srv.stop()

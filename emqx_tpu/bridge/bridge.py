@@ -113,6 +113,24 @@ class Bridge:
                 "key": render_template(key_t, columns),
                 "value": value,
             }
+        if self.type == "gcp_pubsub":
+            # emqx_ee_connector_gcp_pubsub encode_payload/2: data =
+            # base64 of the rendered payload template (whole-columns
+            # JSON when no template); orderingKey/attributes templates
+            # are renderer extras on the same message shape
+            import base64 as _b64
+            tmpl = c.get("payload_template")
+            data = (render_template(tmpl, columns) if tmpl
+                    else json.dumps(_json_safe(columns)))
+            msg: dict = {"data": _b64.b64encode(data.encode()).decode()}
+            if c.get("attributes_template"):
+                msg["attributes"] = {
+                    k: render_template(v, columns)
+                    for k, v in c["attributes_template"].items()}
+            if c.get("ordering_key_template"):
+                msg["orderingKey"] = render_template(
+                    c["ordering_key_template"], columns)
+            return {"messages": [msg]}
         if self.type == "influxdb":
             # emqx_ee_bridge_influxdb: write_syntax template → one line
             # of line protocol, shipped over the HTTP connector's /write

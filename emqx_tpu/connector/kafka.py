@@ -147,9 +147,15 @@ def murmur2(data: bytes) -> int:
 # -- record batch v2 -------------------------------------------------------
 
 
+CODEC_NONE, CODEC_SNAPPY = 0, 2               # attributes bits 0-2
+
+
 def encode_record_batch(records: list[tuple[Optional[bytes], bytes]],
-                        base_ts: Optional[int] = None) -> bytes:
-    """[(key, value)] → one record batch (magic 2, no compression)."""
+                        base_ts: Optional[int] = None,
+                        codec: int = CODEC_NONE) -> bytes:
+    """[(key, value)] → one record batch (magic 2). ``codec``
+    compresses the records section (snappy = raw block format for
+    magic-2 batches — no xerial framing, that is magic 0/1 only)."""
     base_ts = int(time.time() * 1000) if base_ts is None else base_ts
     recs = bytearray()
     for i, (key, value) in enumerate(records):
@@ -165,9 +171,15 @@ def encode_record_batch(records: list[tuple[Optional[bytes], bytes]],
         body += varint(0)                      # headers count
         recs += varint(len(body)) + body
 
+    if codec == CODEC_SNAPPY:
+        from emqx_tpu.utils.snappy import compress
+        recs = bytearray(compress(bytes(recs)))
+    elif codec != CODEC_NONE:
+        raise KafkaError(f"unsupported codec {codec}")
+
     n = len(records)
     tail = bytearray()
-    tail += b"\x00\x00"                        # attributes (no compression)
+    tail += struct.pack(">h", codec)           # attributes
     tail += struct.pack(">i", n - 1)           # last offset delta
     tail += struct.pack(">q", base_ts)         # first timestamp
     tail += struct.pack(">q", base_ts)         # max timestamp
@@ -198,8 +210,18 @@ def decode_record_batch(data: bytes) -> list[tuple[Optional[bytes], bytes]]:
     tail = data[21:]
     if crc32c(tail) != crc:
         raise KafkaError("record batch CRC mismatch")
+    (attrs,) = struct.unpack_from(">h", tail, 0)
     (n,) = struct.unpack_from(">i", tail, 2 + 4 + 8 + 8 + 8 + 2 + 4)
     pos = 2 + 4 + 8 + 8 + 8 + 2 + 4 + 4
+    codec = attrs & 0x07
+    if codec == CODEC_SNAPPY:
+        from emqx_tpu.utils.snappy import SnappyError, decompress
+        try:
+            tail = tail[:pos] + decompress(bytes(tail[pos:]))
+        except SnappyError as e:
+            raise KafkaError(f"bad snappy records section: {e}") from None
+    elif codec != CODEC_NONE:
+        raise KafkaError(f"unsupported codec {codec}")
     out = []
     for _ in range(n):
         _ln, pos = read_varint(tail, pos)
@@ -262,11 +284,17 @@ class KafkaClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9092,
                  client_id: str = "emqx_tpu", timeout_s: float = 5.0,
-                 acks: int = -1) -> None:
+                 acks: int = -1, compression: str = "none") -> None:
         self.addr = (host, port)               # bootstrap
         self.client_id = client_id
         self.timeout_s = timeout_s
         self.acks = acks
+        try:
+            self.codec = {"none": CODEC_NONE,
+                          "snappy": CODEC_SNAPPY}[compression]
+        except KeyError:
+            raise KafkaError(
+                f"unsupported compression {compression!r}") from None
         self._conns: dict[Optional[int], _BrokerConn] = {}
         self._brokers: dict[int, tuple] = {}   # node id → (host, port)
         self._leaders: dict[tuple, int] = {}   # (topic, part) → node id
@@ -407,7 +435,7 @@ class KafkaClient:
 
     def _produce_batch_locked(self, topic: str, partition: int,
                               records: list) -> int:
-        batch = encode_record_batch(records)
+        batch = encode_record_batch(records, codec=self.codec)
         body = _str16(None)                            # transactional id
         body += struct.pack(">hi", self.acks, 10_000)  # acks, timeout
         body += struct.pack(">i", 1) + _str16(topic)

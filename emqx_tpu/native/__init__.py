@@ -50,6 +50,7 @@ def _build() -> None:
     cmd = [
         "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
         os.path.join(_SRC_DIR, "host.cc"),
+        os.path.join(_SRC_DIR, "snappy.cc"),
         "-o", _LIB_PATH,
     ]
     if _SANITIZE:
@@ -85,6 +86,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_framer_destroy.argtypes = [ctypes.c_void_p]
     lib.emqx_buf_free.restype = None
     lib.emqx_buf_free.argtypes = [ctypes.c_void_p]
+    lib.emqx_snappy_max_compressed.restype = ctypes.c_long
+    lib.emqx_snappy_max_compressed.argtypes = [ctypes.c_long]
+    lib.emqx_snappy_compress.restype = ctypes.c_long
+    lib.emqx_snappy_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+    lib.emqx_snappy_uncompressed_length.restype = ctypes.c_long
+    lib.emqx_snappy_uncompressed_length.argtypes = [
+        ctypes.c_char_p, ctypes.c_long]
+    lib.emqx_snappy_decompress.restype = ctypes.c_long
+    lib.emqx_snappy_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
     return lib
 
 

@@ -1,0 +1,114 @@
+"""Snappy codec: pure-Python vs native C++ differential tests, format
+edge cases, and compressed Kafka record batches end-to-end through
+MiniKafka (reference: snappyer NIF via wolff — SURVEY.md §2.4)."""
+
+import random
+
+import pytest
+
+from emqx_tpu.connector.kafka import (CODEC_SNAPPY, KafkaClient, KafkaError,
+                                      MiniKafka, decode_record_batch,
+                                      encode_record_batch)
+from emqx_tpu.utils.snappy import (SnappyError, compress, decompress,
+                                   py_compress, py_decompress)
+
+
+def _corpus():
+    rng = random.Random(7)
+    return [
+        b"",
+        b"a",
+        b"abcd",
+        b"hello hello hello hello hello",         # short repeats
+        b"x" * 100_000,                            # long run (overlap copies)
+        bytes(rng.randrange(256) for _ in range(5000)),   # incompressible
+        b"".join(b"sensor/%d/temp=%d;" % (i % 40, i % 7)
+                 for i in range(3000)),            # structured, compressible
+        bytes(rng.randrange(4) for _ in range(70_000)),   # low entropy, big
+    ]
+
+
+def test_py_roundtrip():
+    for data in _corpus():
+        assert py_decompress(py_compress(data)) == data
+
+
+def test_compression_actually_compresses():
+    data = b"topic/device/telemetry " * 500
+    out = py_compress(data)
+    assert len(out) < len(data) // 4
+
+
+def test_native_vs_python_differential():
+    from emqx_tpu import native
+    if not native.available():
+        pytest.skip(f"native lib unavailable: {native.build_error()}")
+    for data in _corpus():
+        c_native = compress(data)
+        # each implementation decodes the other's stream
+        assert py_decompress(c_native) == data
+        assert decompress(py_compress(data)) == data
+        assert decompress(c_native) == data
+
+
+def test_adversarial_far_matches_stay_in_bound():
+    """4-byte matches at >=64KiB offsets would emit 5-byte copy4 tags
+    (expansion) — the cost-effective-copy rule must keep the output
+    within max_compressed so the native path cannot overflow its
+    buffer."""
+    rng = random.Random(3)
+    # unique 4-byte blocks, then the same blocks again 70KB later:
+    # every match is exactly 4 bytes at offset ~70000
+    blocks = [bytes([rng.randrange(256) for _ in range(3)]) + b"\xaa"
+              for _ in range(8000)]
+    data = b"".join(blocks) + bytes(40_000) + b"".join(blocks)
+    for codec in (py_compress, compress):
+        out = codec(data)
+        assert len(out) <= 32 + len(data) + len(data) // 6
+        assert py_decompress(out) == data
+
+
+def test_implausible_length_header_rejected_before_alloc():
+    """A tiny stream claiming a 4 GiB uncompressed length must be
+    rejected up front, not allocated."""
+    huge = b"\xff\xff\xff\xff\x0f" + b"\x00a"   # varint ~4G, 1 literal
+    with pytest.raises(SnappyError):
+        decompress(huge)
+    with pytest.raises(SnappyError):
+        py_decompress(huge)
+
+
+def test_malformed_streams_rejected():
+    for bad in (b"", b"\xff\xff\xff\xff\xff\xff",   # unterminated varint
+                b"\x05\x01",                        # copy before any output
+                b"\x05\xfc" + b"x" * 3,             # literal past end
+                b"\x02\x00a"):                      # length mismatch (says 2)
+        with pytest.raises(SnappyError):
+            py_decompress(bad)
+        with pytest.raises(SnappyError):
+            decompress(bad)
+
+
+def test_record_batch_snappy_roundtrip():
+    records = [(b"k%d" % i, b"payload-%d " % i * 20) for i in range(50)]
+    batch = encode_record_batch(records, codec=CODEC_SNAPPY)
+    plain = encode_record_batch(records)
+    assert len(batch) < len(plain) // 2
+    assert decode_record_batch(batch) == records
+    with pytest.raises(KafkaError):
+        encode_record_batch(records, codec=1)     # gzip unsupported
+
+
+def test_produce_snappy_through_minikafka():
+    srv = MiniKafka(topics={"zt": 1}).start()
+    try:
+        c = KafkaClient(port=srv.port, compression="snappy")
+        offs = c.produce_many("zt", [(b"k", b"compressed " * 50)] * 3)
+        assert offs == [0, 1, 2]
+        assert [v for _k, v in srv.records[("zt", 0)]] == \
+            [b"compressed " * 50] * 3
+        c.close()
+        with pytest.raises(KafkaError):
+            KafkaClient(port=srv.port, compression="zstd")
+    finally:
+        srv.stop()

@@ -434,11 +434,16 @@ FUNCS["sprintf_s"] = FUNCS["sprintf"]       # erlang-side alias
 
 
 @f("jq")
-def _jq(*_a):
-    # the reference gates jq/2,3 on the optional libjq NIF (mix.exs:641);
-    # no libjq ships here either — same observable failure mode: the
-    # rule errors, metrics count failed.exception
-    raise RuntimeError("jq/2: libjq is not available in this build")
+def _jq(program, value, _timeout_ms=None):
+    # the reference runs this through the optional libjq NIF
+    # (emqx_rule_funcs.erl:806-828, jq:process_json/3 → list of
+    # outputs); this build ships its own jq-subset interpreter instead
+    # (utils/jq.py). jq/3's timeout is a NIF-dirty-scheduler concern
+    # the in-process evaluator doesn't have; accepted and ignored.
+    from emqx_tpu.utils.jq import jq as run_jq
+    if isinstance(program, (bytes, bytearray)):
+        program = program.decode("utf-8")
+    return run_jq(program, value)
 
 
 # -- message-context accessors (clientid/0, payload/0, ... in the

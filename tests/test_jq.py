@@ -1,0 +1,162 @@
+"""jq-subset interpreter (utils/jq.py) — cases from the jq manual plus
+the rule-engine seam (jq/2, emqx_rule_funcs.erl:806-828)."""
+
+import pytest
+
+from emqx_tpu.utils.jq import JqError, jq
+
+
+@pytest.mark.parametrize("prog,input_,want", [
+    # identity / paths
+    (".", {"a": 1}, [{"a": 1}]),
+    (".a", {"a": 1}, [1]),
+    (".a.b", {"a": {"b": 2}}, [2]),
+    (".a.b.c", {"a": {"b": {"c": 5}}}, [5]),   # 3+ segments: per-segment
+    (".w.x.y.z", {"w": {"x": {"y": {"z": 9}}}}, [9]),   # name binding
+    ('.["a b"]', {"a b": 3}, [3]),
+    (".a", {"b": 1}, [None]),                  # missing key -> null
+    (".a?", 7, []),                            # optional suppresses error
+    (".[0]", [10, 20], [10]),
+    (".[-1]", [10, 20], [20]),
+    (".[5]", [10], [None]),
+    (".[1:3]", [0, 1, 2, 3], [[1, 2]]),
+    (".[:2]", "abcd", ["ab"]),
+    # iteration, pipe, comma
+    (".[]", [1, 2, 3], [1, 2, 3]),
+    (".[]", {"a": 1, "b": 2}, [1, 2]),
+    (".a[]", {"a": [4, 5]}, [4, 5]),
+    (".[] | .x", [{"x": 1}, {"x": 2}], [1, 2]),
+    (".a, .b", {"a": 1, "b": 2}, [1, 2]),
+    # literals, construction
+    ("[.[] | . * 2]", [1, 2], [[2, 4]]),
+    ('{t: .topic, "q": .qos}', {"topic": "x", "qos": 1},
+     [{"t": "x", "q": 1}]),
+    ("{a}", {"a": 9, "b": 1}, [{"a": 9}]),
+    ("[]", None, [[]]),
+    # arithmetic
+    (".a + .b", {"a": 1, "b": 2}, [3]),
+    ('.a + "s"', {"a": "x"}, ["xs"]),
+    (".a + .b", {"a": [1], "b": [2]}, [[1, 2]]),
+    (".a + .b", {"a": {"x": 1}, "b": {"y": 2}}, [{"x": 1, "y": 2}]),
+    ("null + 5", None, [5]),
+    ("10 - 3", None, [7]),
+    ("[1,2,3] - [2]", None, [[1, 3]]),
+    ("6 / 2", None, [3.0]),
+    ('"a,b" / ","', None, [["a", "b"]]),
+    ("7 % 3", None, [1]),
+    ("-(.a)", {"a": 4}, [-4]),
+    # comparisons / booleans / select
+    (".a == 1", {"a": 1}, [True]),
+    (".[] | select(. > 2)", [1, 2, 3, 4], [3, 4]),
+    ('.[] | select(.t == "on")',
+     [{"t": "on", "i": 1}, {"t": "off", "i": 2}], [{"t": "on", "i": 1}]),
+    ("1 < 2 and 2 < 1", None, [False]),
+    ("1 < 2 or 2 < 1", None, [True]),
+    (".a | not", {"a": False}, [True]),
+    ("null < 1", None, [True]),                # jq total order
+    # alternative, if
+    (".a // 42", {}, [42]),
+    (".a // 42", {"a": 7}, [7]),
+    ("if . > 0 then \"pos\" elif . == 0 then \"zero\" else \"neg\" end",
+     -3, ["neg"]),
+    ("if . then 1 end", False, [False]),       # default else = identity
+    # builtins
+    ("length", [1, 2, 3], [3]),
+    ("length", "abcd", [4]),
+    ("length", None, [0]),
+    ("keys", {"b": 1, "a": 2}, [["a", "b"]]),
+    ("has(\"a\")", {"a": 1}, [True]),
+    ("type", [1], ["array"]),
+    ("empty", 1, []),
+    ("add", [1, 2, 3], [6]),
+    ("add", [[1], [2]], [[1, 2]]),
+    ("min, max", [3, 1, 2], [1, 3]),
+    ("sort", [3, 1, 2], [[1, 2, 3]]),
+    ("sort_by(.x)", [{"x": 2}, {"x": 1}], [[{"x": 1}, {"x": 2}]]),
+    ("unique", [2, 1, 2], [[1, 2]]),
+    ("reverse", [1, 2], [[2, 1]]),
+    ('join("-")', ["a", "b"], ["a-b"]),
+    ('split(",")', "a,b", [["a", "b"]]),
+    ("map(. + 1)", [1, 2], [[2, 3]]),
+    ("any(. > 2)", [1, 3], [True]),
+    ("all(. > 2)", [1, 3], [False]),
+    ("range(3)", None, [0, 1, 2]),
+    ("first, last", [5, 6, 7], [5, 7]),
+    ("first, last", [], [None, None]),         # first = .[0] on empty
+    ('{("a","b"): 1}', None, [{"a": 1}, {"b": 1}]),   # key backtracking
+    ("floor, ceil", 1.5, [1, 2]),
+    ("tostring", 5, ["5"]),
+    ("tonumber", "5", [5]),
+    ("tojson", {"a": 1}, ['{"a": 1}']),
+    ('fromjson | .a', '"{\\"a\\": 3}"', [3]),
+    ("ascii_upcase", "ab", ["AB"]),
+    ('startswith("ab")', "abc", [True]),
+    ('ltrimstr("ab")', "abc", ["c"]),
+    ('contains("bc")', "abcd", [True]),
+    ("to_entries", {"a": 1}, [[{"key": "a", "value": 1}]]),
+    ("from_entries", [{"key": "a", "value": 1}], [{"a": 1}]),
+    ("values", None, []),
+    ("values", 0, [0]),
+    # stream distribution: operators over cartesian products
+    ("(1,2) + (10,20)", None, [11, 12, 21, 22]),
+])
+def test_jq_manual_cases(prog, input_, want):
+    assert jq(prog, input_) == want
+
+
+def test_json_string_input():
+    # jq/2 accepts a JSON document (the reference passes binaries)
+    assert jq(".sensor.temp", '{"sensor": {"temp": 21.5}}') == [21.5]
+    assert jq(".a", b'{"a": 1}') == [1]
+    with pytest.raises(JqError):
+        jq(".", b"{not json")                 # bytes must be valid JSON
+    assert jq("length", "not json") == [8]    # str falls back to term
+
+
+@pytest.mark.parametrize("prog", [
+    "def f: .; f",          # defs
+    ". as $x | $x",         # variables
+    "reduce .[] as $i (0; . + $i)",
+    "..",                   # recursive descent
+    '"\\(.a)"',             # interpolation
+    "nosuchfn(3)",
+    "(",                    # malformed
+    ". |",
+])
+def test_unsupported_and_malformed_raise(prog):
+    with pytest.raises(JqError):
+        jq(prog, {"a": 1})
+
+
+def test_runtime_errors():
+    with pytest.raises(JqError):
+        jq(".a + .b", {"a": 1, "b": "s"})
+    with pytest.raises(JqError):
+        jq("1 / 0", None)
+    with pytest.raises(JqError):
+        jq('error("boom")', None)
+
+
+def test_rule_func_seam():
+    from emqx_tpu.rules.funcs import FUNCS
+    assert FUNCS["jq"](b".[] | .x", '[{"x": 1}, {"x": 2}]') == [1, 2]
+    assert FUNCS["jq"](".a", {"a": 5}, 1000) == [5]   # jq/3 timeout arg
+
+
+def test_rule_sql_with_jq():
+    """jq inside a full SQL rule — the reference's headline use."""
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.core.message import Message
+
+    app = BrokerApp()
+    got = []
+    app.rules.register_action("sink", lambda cols, args: got.append(cols))
+    app.rules.create_rule(
+        "r1",
+        "SELECT jq('.readings[] | select(.v > 10) | .v', payload) AS hot "
+        "FROM \"jq/t\"",
+        [{"function": "sink", "args": {}}])
+    app.broker.publish(Message(
+        topic="jq/t",
+        payload=b'{"readings": [{"v": 5}, {"v": 11}, {"v": 30}]}'))
+    assert got and got[0]["hot"] == [11, 30]

@@ -19,8 +19,10 @@ class MqttClient:
                  password: Optional[bytes] = None,
                  properties: Optional[dict] = None,
                  will: Optional[P.Connect] = None,
-                 ssl=None, server_hostname: Optional[str] = None):
+                 ssl=None, server_hostname: Optional[str] = None,
+                 auto_ack: bool = True):
         self.host, self.port = host, port
+        self.auto_ack = auto_ack        # False: tests ack via puback()
         self.ssl = ssl                  # ssl.SSLContext | None
         self.server_hostname = server_hostname
         self.clientid = clientid
@@ -87,7 +89,9 @@ class MqttClient:
     async def _route_in(self, pkt: P.Packet) -> None:
         if pkt.type == P.PUBLISH:
             await self.messages.put(pkt)
-            if pkt.qos == 1:
+            if not self.auto_ack:
+                pass
+            elif pkt.qos == 1:
                 await self._send(P.PubAck(packet_id=pkt.packet_id))
             elif pkt.qos == 2:
                 await self._send(P.PubRec(packet_id=pkt.packet_id))
@@ -135,6 +139,10 @@ class MqttClient:
 
     async def recv(self, timeout: float = 5.0) -> P.Publish:
         return await asyncio.wait_for(self.messages.get(), timeout)
+
+    async def puback(self, packet_id: int) -> None:
+        """Manual QoS1 ack (use with auto_ack=False)."""
+        await self._send(P.PubAck(packet_id=packet_id))
 
     async def ping(self) -> None:
         await self._send(P.PingReq())

@@ -28,6 +28,7 @@ Not supported (raises JqError at parse time): ``def``, ``$vars``/``as``,
 
 from __future__ import annotations
 
+import functools
 import json
 import math
 import re
@@ -160,8 +161,13 @@ def _arith(op: str, a: Any, b: Any) -> Any:
         if _num2(a, b):
             if b == 0:
                 raise JqError("jq: division by zero")
+            # exact integer quotients stay integers (jq prints 6/2 as 3)
+            if isinstance(a, int) and isinstance(b, int) and a % b == 0:
+                return a // b
             return a / b
         if isinstance(a, str) and isinstance(b, str):
+            if not b:
+                raise JqError("jq: cannot split by empty string")
             return a.split(b)
     if op == "%":
         if _num2(a, b):
@@ -262,9 +268,11 @@ def _tostring(v):
     return v if isinstance(v, str) else json.dumps(v)
 
 
-def _expect(v, t: type, what: str):
+def _expect(v, t, what: str):
     if isinstance(v, bool) or not isinstance(v, t):
-        raise JqError(f"jq: {what} requires {t.__name__}, got {_type(v)}")
+        names = (t.__name__ if isinstance(t, type)
+                 else "/".join(x.__name__ for x in t))
+        raise JqError(f"jq: {what} requires {names}, got {_type(v)}")
     return v
 
 
@@ -423,6 +431,20 @@ _BUILTINS_F: dict[str, Callable[[Any, Fn], Stream]] = {
 }
 
 
+def _guard(fn: Fn) -> Fn:
+    """Builtins must fail with JqError only — a ValueError out of
+    fromjson/sqrt/split would escape `?` and `//` error suppression."""
+    def run(v, fn=fn):
+        try:
+            yield from fn(v)
+        except JqError:
+            raise
+        except (ValueError, TypeError, AttributeError, KeyError,
+                ArithmeticError) as e:
+            raise JqError(f"jq: {e}") from e
+    return run
+
+
 # ---------------------------------------------------------------------------
 # parser → compiled closures (each: Fn = input -> stream)
 
@@ -507,13 +529,29 @@ class _Parser:
             left = run
         return left
 
+    def _shortcircuit(self, sub, op_name: str, stop_on: bool) -> Fn:
+        """jq and/or: left first, rhs only evaluated when needed —
+        `false and error` is false, not an error."""
+        left = sub()
+        while self.peek() == ("kw", op_name):
+            self.next()
+            right = sub()
+
+            def run(v, left=left, right=right, stop_on=stop_on):
+                for a in left(v):
+                    if _truthy(a) is stop_on:
+                        yield stop_on
+                    else:
+                        for b in right(v):
+                            yield _truthy(b)
+            left = run
+        return left
+
     def parse_or(self) -> Fn:
-        return self._binop(self.parse_and, ("or",),
-                           lambda _o, a, b: _truthy(a) or _truthy(b))
+        return self._shortcircuit(self.parse_and, "or", stop_on=True)
 
     def parse_and(self) -> Fn:
-        return self._binop(self.parse_cmp, ("and",),
-                           lambda _o, a, b: _truthy(a) and _truthy(b))
+        return self._shortcircuit(self.parse_cmp, "and", stop_on=False)
 
     _CMP = {"==": lambda c: c == 0, "!=": lambda c: c != 0,
             "<": lambda c: c < 0, "<=": lambda c: c <= 0,
@@ -598,6 +636,9 @@ class _Parser:
                     for lov in los:
                         his = hi(v) if hi else iter([None])
                         for hiv in his:
+                            if a is None:        # .x[0:2] on null → null
+                                yield None
+                                continue
                             if not isinstance(a, (list, str)):
                                 raise JqError(
                                     f"jq: cannot slice {_type(a)}")
@@ -755,17 +796,20 @@ class _Parser:
                 args.append(self.parse_pipe())
             self.expect(")")
         if not args and name in _BUILTINS_0:
-            return _BUILTINS_0[name]
+            return _guard(_BUILTINS_0[name])
         if len(args) == 1 and name in _BUILTINS_F:
             f = _BUILTINS_F[name]
-            return lambda v, f=f, a=args[0]: f(v, a)
+            return _guard(lambda v, f=f, a=args[0]: f(v, a))
         if len(args) == 1 and name in _BUILTINS_1:
             f = _BUILTINS_1[name]
-            return lambda v, f=f, a=args[0]: f(v, a)
+            return _guard(lambda v, f=f, a=args[0]: f(v, a))
         raise JqError(f"jq: unknown function {name}/{len(args)}")
 
 
+@functools.lru_cache(maxsize=256)
 def compile_program(src: str) -> Fn:
+    """Compiled programs are stateless closures — cached so jq/2 on the
+    per-message rule hot path compiles each program once."""
     p = _Parser(_tokenize(src))
     fn = p.parse_pipe()
     if p.peek()[0] != "eof":

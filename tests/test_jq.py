@@ -41,7 +41,8 @@ from emqx_tpu.utils.jq import JqError, jq
     ("null + 5", None, [5]),
     ("10 - 3", None, [7]),
     ("[1,2,3] - [2]", None, [[1, 3]]),
-    ("6 / 2", None, [3.0]),
+    ("6 / 2", None, [3]),                      # exact quotient stays int
+    ("7 / 2", None, [3.5]),
     ('"a,b" / ","', None, [["a", "b"]]),
     ("7 % 3", None, [1]),
     ("-(.a)", {"a": 4}, [-4]),
@@ -99,6 +100,15 @@ from emqx_tpu.utils.jq import JqError, jq
     ("values", 0, [0]),
     # stream distribution: operators over cartesian products
     ("(1,2) + (10,20)", None, [11, 12, 21, 22]),
+    # and/or short-circuit: rhs must not evaluate when lhs decides
+    (".enabled and (1 / .total > 0.5)", {"enabled": False, "total": 0},
+     [False]),
+    (".done or error(\"x\")", {"done": True}, [True]),
+    # error containment: builtin failures are JqError, so ? suppresses
+    (".p | fromjson? // \"fallback\"", {"p": "not json"}, ["fallback"]),
+    ("(-1 | sqrt)? // null", None, [None]),
+    ("(\"x\" | floor)? // 0", None, [0]),
+    (".maybe[0:2]", {}, [None]),               # slicing null → null
 ])
 def test_jq_manual_cases(prog, input_, want):
     assert jq(prog, input_) == want

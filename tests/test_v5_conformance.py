@@ -1,0 +1,221 @@
+"""MQTT 5 conformance breadth over real sockets — the
+emqx_mqtt_protocol_v5_SUITE areas not covered elsewhere: subscription
+options Retain-As-Published / Retain-Handling, request/response +
+user-property pass-through, client Receive-Maximum governing the
+SERVER's send window, and Message-Expiry-Interval countdown."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.server import BrokerServer
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.mqtt.client import MqttClient
+
+
+@pytest.fixture
+def run():
+    def _run(scenario):
+        async def main():
+            server = BrokerServer(port=0)
+            await server.start()
+            try:
+                await scenario(server)
+            finally:
+                await server.stop()
+        asyncio.run(main())
+    return _run
+
+
+def _c(server, cid, **kw):
+    return MqttClient(port=server.port, clientid=cid, proto_ver=5, **kw)
+
+
+def test_retain_as_published(run):
+    """[MQTT-3.8.3.1] rap=1 keeps the retain flag on forwarded
+    messages; rap=0 clears it."""
+    async def scenario(server):
+        raw = _c(server, "raw")
+        plain = _c(server, "plain")
+        pub = _c(server, "pub")
+        for c in (raw, plain, pub):
+            await c.connect()
+        await raw.subscribe("r/t", qos=0, rap=1)
+        await plain.subscribe("r/t", qos=0)
+        await pub.publish("r/t", b"x", retain=True)
+        assert (await raw.recv()).retain is True
+        assert (await plain.recv()).retain is False
+        for c in (raw, plain, pub):
+            await c.disconnect()
+    run(scenario)
+
+
+def test_retain_handling(run):
+    """[MQTT-3.8.3.1] rh=0 always sends retained on subscribe; rh=1
+    only when the subscription is NEW; rh=2 never."""
+    async def scenario(server):
+        pub = _c(server, "pub")
+        await pub.connect()
+        await pub.publish("rh/t", b"kept", retain=True)
+
+        sub = _c(server, "sub")
+        await sub.connect()
+        await sub.subscribe("rh/t", qos=0, rh=2)       # never
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.3)
+
+        await sub.subscribe("rh/t", qos=0, rh=1)       # existing sub: no
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.3)
+
+        await sub.subscribe("rh/t", qos=0, rh=0)       # always
+        assert (await sub.recv()).payload == b"kept"
+
+        fresh = _c(server, "fresh")
+        await fresh.connect()
+        await fresh.subscribe("rh/t", qos=0, rh=1)     # new sub: yes
+        assert (await fresh.recv()).payload == b"kept"
+        for c in (pub, sub, fresh):
+            await c.disconnect()
+    run(scenario)
+
+
+def test_request_response_properties_pass_through(run):
+    """[MQTT-3.3.2] Response-Topic, Correlation-Data and User-Property
+    must reach the subscriber unchanged (the broker never interprets
+    them)."""
+    async def scenario(server):
+        responder = _c(server, "responder")
+        requester = _c(server, "requester")
+        await responder.connect()
+        await requester.connect()
+        await responder.subscribe("svc/req", qos=1)
+        await requester.subscribe("svc/resp/42", qos=1)
+
+        await requester.publish("svc/req", b"do-it", qos=1, properties={
+            "Response-Topic": "svc/resp/42",
+            "Correlation-Data": b"corr-7",
+            "User-Property": [("trace", "abc"), ("hop", "1")],
+        })
+        req = await responder.recv()
+        props = req.properties or {}
+        assert props.get("Response-Topic") == "svc/resp/42"
+        assert props.get("Correlation-Data") == b"corr-7"
+        assert ("trace", "abc") in (props.get("User-Property") or [])
+
+        # the response flows back over the carried Response-Topic
+        await responder.publish(props["Response-Topic"], b"done", qos=1,
+                                properties={
+                                    "Correlation-Data":
+                                        props["Correlation-Data"]})
+        resp = await requester.recv()
+        assert resp.payload == b"done"
+        assert (resp.properties or {}).get("Correlation-Data") == b"corr-7"
+        await responder.disconnect()
+        await requester.disconnect()
+    run(scenario)
+
+
+def test_client_receive_maximum_caps_server_window(run):
+    """[MQTT-3.1.2-11] CONNECT Receive-Maximum=1: the server may keep
+    only ONE un-acked QoS1 PUBLISH toward us; the next arrives only
+    after our PUBACK."""
+    async def scenario(server):
+        sub = _c(server, "sub", auto_ack=False,
+                 properties={"Receive-Maximum": 1})
+        pub = _c(server, "pub")
+        await sub.connect()
+        await pub.connect()
+        await sub.subscribe("fc/t", qos=1)
+        for i in range(3):
+            await pub.publish("fc/t", b"%d" % i, qos=1)
+
+        first = await sub.recv()
+        assert first.payload == b"0"
+        with pytest.raises(asyncio.TimeoutError):   # window is full
+            await sub.recv(timeout=0.4)
+
+        await sub.puback(first.packet_id)           # frees the window
+        second = await sub.recv()
+        assert second.payload == b"1"
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.4)
+        await sub.puback(second.packet_id)
+        assert (await sub.recv()).payload == b"2"
+        await sub.disconnect()
+        await pub.disconnect()
+    run(scenario)
+
+
+def test_message_expiry_interval_counts_down(run):
+    """[MQTT-3.3.2-6] a queued message's Message-Expiry-Interval is
+    forwarded MINUS the time spent waiting; fully expired messages are
+    not delivered."""
+    async def scenario(server):
+        sub = _c(server, "sub", clean_start=False,
+                 properties={"Session-Expiry-Interval": 300})
+        pub = _c(server, "pub")
+        await sub.connect()
+        await pub.connect()
+        await sub.subscribe("exp/t", qos=1)
+        await sub.close()                     # offline, session kept
+
+        await pub.publish("exp/t", b"keeps", qos=1,
+                          properties={"Message-Expiry-Interval": 100})
+        await asyncio.sleep(1.1)
+
+        back = _c(server, "sub", clean_start=False,
+                  properties={"Session-Expiry-Interval": 300})
+        ack = await back.connect()
+        assert ack.session_present
+        got = await back.recv()
+        assert got.payload == b"keeps"
+        remaining = (got.properties or {}).get("Message-Expiry-Interval")
+        assert remaining is not None and remaining <= 99
+        await back.disconnect()
+        await pub.disconnect()
+    run(scenario)
+
+
+def test_expired_message_not_delivered_on_resume(run):
+    async def scenario(server):
+        sub = _c(server, "sub2", clean_start=False,
+                 properties={"Session-Expiry-Interval": 300})
+        pub = _c(server, "pub")
+        await sub.connect()
+        await pub.connect()
+        await sub.subscribe("exp2/t", qos=1)
+        await sub.close()
+
+        await pub.publish("exp2/t", b"dies", qos=1,
+                          properties={"Message-Expiry-Interval": 1})
+        await pub.publish("exp2/t", b"lives", qos=1)
+        await asyncio.sleep(1.3)
+
+        back = _c(server, "sub2", clean_start=False,
+                  properties={"Session-Expiry-Interval": 300})
+        await back.connect()
+        got = await back.recv()
+        assert got.payload == b"lives"       # the expired one is gone
+        assert back.messages.empty()
+        await back.disconnect()
+        await pub.disconnect()
+    run(scenario)
+
+
+def test_no_local_over_socket(run):
+    """[MQTT-3.8.3.1] nl=1: a client's own publishes do not loop back."""
+    async def scenario(server):
+        c = _c(server, "looper")
+        other = _c(server, "other")
+        await c.connect()
+        await other.connect()
+        await c.subscribe("nl/t", qos=0, nl=1)
+        await c.publish("nl/t", b"self")
+        await other.publish("nl/t", b"peer")
+        got = await c.recv()
+        assert got.payload == b"peer"
+        assert c.messages.empty()
+        await c.disconnect()
+        await other.disconnect()
+    run(scenario)

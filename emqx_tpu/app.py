@@ -41,6 +41,7 @@ class BrokerApp:
 
         self.hooks = Hooks()
         self._tickers: list = []
+        self.exhook = None                 # ExhookMgr once configured
         self.metrics = Metrics()
         self.stats = Stats()
         self.alarms = AlarmManager(on_change=self._on_alarm)
@@ -465,6 +466,39 @@ class BrokerApp:
         app.sys.heartbeat_s = float(
             conf.get("sys_topics.sys_heartbeat_interval"))
         app.sys.tick_s = float(conf.get("sys_topics.sys_msg_interval"))
+        # exhook providers (emqx_exhook_schema: servers with url +
+        # failed_action + pool_size; url schemes: grpc:// and http:// =
+        # the real gRPC HookProvider, grpcs://www and https:// = TLS gRPC,
+        # framed:// and tcp:// = the documented JSON framing). A bad
+        # scheme or missing grpcio is a CONFIG error (fail boot loudly);
+        # a provider merely unreachable stays registered and the
+        # housekeeping tick retries (reference auto_reconnect).
+        _SCHEMES = {"grpc": "grpc", "http": "grpc",
+                    "grpcs": "grpcs", "https": "grpcs",
+                    "framed": "framed", "tcp": "framed"}
+        for spec in conf.get("exhook.servers") or []:
+            from urllib.parse import urlparse as _urlparse
+
+            from emqx_tpu.exhook.server import ExhookMgr, ExhookServer
+            if app.exhook is None:
+                app.exhook = ExhookMgr(metrics=app.metrics)
+                app.exhook.attach(app.hooks)
+                app.add_ticker(app.exhook.tick)
+            u = _urlparse(str(spec.get("url", "")))
+            if u.scheme not in _SCHEMES:
+                raise ValueError(
+                    f"exhook server {spec.get('name')!r}: unknown url "
+                    f"scheme {u.scheme!r} (grpc|grpcs|framed)")
+            server = ExhookServer(
+                name=str(spec.get("name", u.hostname or "default")),
+                host=u.hostname or "127.0.0.1", port=int(u.port or 9000),
+                transport=_SCHEMES[u.scheme],
+                pool_size=int(spec.get("pool_size", 4)),
+                timeout_s=float(spec.get("request_timeout", 5.0)),
+                failed_action=str(spec.get("failed_action", "deny")))
+            app.exhook.enable_async(
+                server,
+                retry_interval_s=float(spec.get("auto_reconnect", 5.0)))
         # live-update seams: strategy + retainer limits apply immediately
         conf.add_listener(app._on_config_change)
         return app

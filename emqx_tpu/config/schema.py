@@ -316,6 +316,12 @@ def root_schema() -> Struct:
         "telemetry": Struct({
             "enable": Field("bool", default=False),
         }),
+        # emqx_exhook_schema: out-of-process hook providers; url scheme
+        # grpc:// = real HookProvider service, framed:// = the
+        # documented JSON framing (exhook/proto.py)
+        "exhook": Struct({
+            "servers": Field("array", default=[], item=Field("map")),
+        }),
         "statsd": Struct({
             "enable": Field("bool", default=False),
             "server": Field("string", default="127.0.0.1:8125"),

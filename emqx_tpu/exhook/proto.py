@@ -49,15 +49,14 @@ topic, payload, qos, retain, from, timestamp, headers}. Binary fields
 (payload) use the codec's tagged encoding: {"$b": "<base64>"}
 (cluster/codec.py) — providers must decode/encode that tag.
 
-DESIGN NOTE — why framed JSON-RPC and not gRPC: the reference's
-HookProvider is gRPC over HTTP/2 (grpc-erl); this build has no gRPC
-runtime in-image and implements the same 21-RPC service over the
-framing above. A stock gRPC HookProvider therefore CANNOT connect
-directly — it needs this ~40-line adapter (length-prefixed JSON ↔ its
-handler functions; see tests/test_exhook.py's providers for working
-examples in Python). The RPC names, request fields, ValuedResponse
-semantics, pool sizing, timeout and failed_action behaviour are
-otherwise identical, so a provider port is mechanical.
+DESIGN NOTE — two transports: ``ExhookServer(transport="grpc")``
+speaks the reference's REAL gRPC ``emqx.exhook.v2.HookProvider``
+service (exhook/grpc_transport.py + the hand-written proto codec in
+exhook/pbwire.py), so stock providers connect with no adapter. This
+framed JSON protocol remains as the dependency-free second transport
+(providers in constrained environments; also what exproto gateways
+reuse). RPC names, request fields, ValuedResponse semantics, timeout
+and failed_action behaviour are identical across both.
 """
 
 from __future__ import annotations

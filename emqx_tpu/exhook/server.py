@@ -66,13 +66,36 @@ class _Conn:
 
 
 class ExhookServer:
+    """transport="framed" speaks the length-prefixed JSON protocol
+    (exhook/proto.py); transport="grpc" speaks the reference's real
+    gRPC HookProvider service (exhook/grpc_transport.py) so stock
+    providers connect with no adapter."""
+
     def __init__(self, name: str, host: str, port: int,
                  pool_size: int = 4, timeout_s: float = 5.0,
-                 failed_action: str = "deny") -> None:
+                 failed_action: str = "deny",
+                 transport: str = "framed") -> None:
         self.name = name
         self.failed_action = failed_action
-        self._pool = [_Conn((host, port), timeout_s)
-                      for _ in range(pool_size)]
+        self.transport = transport
+        if transport in ("grpc", "grpcs"):
+            from emqx_tpu.exhook.grpc_transport import (GrpcConn,
+                                                        grpc_available)
+            if not grpc_available():
+                raise ValueError(
+                    f"exhook {name}: url scheme {transport}:// needs "
+                    "grpcio, which is not importable in this "
+                    "environment — use the framed:// transport")
+            # one channel: HTTP/2 multiplexes; grpcio pools internally
+            self._pool = [GrpcConn((host, port), timeout_s,
+                                   secure=(transport == "grpcs"))]
+        elif transport == "framed":
+            self._pool = [_Conn((host, port), timeout_s)
+                          for _ in range(pool_size)]
+        else:
+            raise ValueError(
+                f"exhook {name}: unknown transport {transport!r} "
+                "(grpc | grpcs | framed)")
         self._rr = 0
         self.hooks_wanted: list[str] = []
         self.loaded = False
@@ -127,6 +150,52 @@ class ExhookMgr:
         wanted = server.load()
         self.servers[server.name] = server
         return wanted
+
+    def enable_async(self, server: ExhookServer,
+                     retry_interval_s: float = 5.0) -> bool:
+        """Register the provider and try to load it; on failure keep it
+        registered unloaded and let tick() retry — the reference's
+        auto_reconnect (emqx_exhook_mgr). Returns whether the first
+        load succeeded. Until loaded, the provider's hooks are not
+        consulted (same fail-open window as the reference's
+        waiting-for-reconnect state)."""
+        self.servers[server.name] = server
+        server.retry_interval_s = retry_interval_s
+        server.next_retry_at = 0.0
+        # boot must not stall timeout_s per blackholed provider: cap the
+        # FIRST attempt at 2s; retries use the configured timeout
+        saved = [c.timeout for c in server._pool]
+        for c in server._pool:
+            c.timeout = min(c.timeout, 2.0)
+        try:
+            server.load()
+            return True
+        except (ConnectionError, OSError, ValueError) as e:
+            import time as _t
+            server.next_retry_at = _t.monotonic() + retry_interval_s
+            log.warning("exhook provider %s unreachable (%s); will "
+                        "retry every %.0fs", server.name, e,
+                        retry_interval_s)
+            return False
+        finally:
+            for c, t in zip(server._pool, saved):
+                c.timeout = t
+
+    def tick(self) -> None:
+        """Housekeeping: retry unloaded providers (auto_reconnect)."""
+        import time as _t
+        now = _t.monotonic()
+        for server in self.servers.values():
+            if server.loaded or now < getattr(server, "next_retry_at",
+                                              float("inf")):
+                continue
+            try:
+                server.load()
+                log.info("exhook provider %s reconnected (hooks: %s)",
+                         server.name, server.hooks_wanted)
+            except (ConnectionError, OSError):
+                server.next_retry_at = now + getattr(
+                    server, "retry_interval_s", 5.0)
 
     def disable(self, name: str) -> bool:
         server = self.servers.pop(name, None)

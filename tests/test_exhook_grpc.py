@@ -1,0 +1,321 @@
+"""Real-gRPC exhook: the hand-written proto codec differentially
+checked against the official google.protobuf runtime, and the full
+broker hook chain driven through a grpcio HookProvider — the
+emqx_exhook_demo_svr / emqx_exhook_SUITE analogue over the actual wire
+(apps/emqx_exhook/priv/protos/exhook.proto)."""
+
+import asyncio
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.server import BrokerServer
+from emqx_tpu.config.config import Config
+from emqx_tpu.exhook import pbwire
+from emqx_tpu.exhook.grpc_transport import GrpcConn, GrpcHookProvider
+from emqx_tpu.exhook.server import ExhookMgr, ExhookServer
+from emqx_tpu.mqtt.client import MqttClient
+
+
+# -- codec vs official protobuf runtime ----------------------------------------
+
+def _dyn_message(name: str, schema: dict, pool, factory):
+    """Build a google.protobuf message class from one of our schema
+    tables (the independent oracle for field numbers/wire types)."""
+    from google.protobuf import descriptor_pb2
+
+    fd = descriptor_pb2.FileDescriptorProto()
+    fd.name = f"dyn_{name.lower()}.proto"
+    fd.package = "dyn"
+    fd.syntax = "proto3"
+    msg = fd.message_type.add()
+    msg.name = name
+    T = descriptor_pb2.FieldDescriptorProto
+    kinds = {"str": T.TYPE_STRING, "bytes": T.TYPE_BYTES,
+             "u32": T.TYPE_UINT32, "u64": T.TYPE_UINT64,
+             "i64": T.TYPE_INT64, "bool": T.TYPE_BOOL,
+             "enum": T.TYPE_INT32}
+    for num, spec in sorted(schema.items()):
+        fname, kind = spec[0], spec[1]
+        f = msg.field.add()
+        f.name = fname
+        f.number = num
+        if isinstance(kind, tuple):        # repeated str only, here
+            f.label = T.LABEL_REPEATED
+            f.type = kinds[kind[1]]
+        elif kind == "map_ss":
+            # maps are repeated entry messages; model as such
+            entry = msg.nested_type.add()
+            entry.name = f"{fname.capitalize()}Entry"
+            entry.options.map_entry = True
+            for i, n in ((1, "key"), (2, "value")):
+                ef = entry.field.add()
+                ef.name, ef.number, ef.type = n, i, T.TYPE_STRING
+                ef.label = T.LABEL_OPTIONAL
+            f.label = T.LABEL_REPEATED
+            f.type = T.TYPE_MESSAGE
+            f.type_name = f".dyn.{name}.{entry.name}"
+        else:
+            f.label = T.LABEL_OPTIONAL
+            f.type = kinds[kind]
+    file_desc = pool.Add(fd)
+    return factory.GetMessageClass(file_desc.message_types_by_name[name])
+
+
+def test_codec_differential_vs_protobuf_runtime():
+    from google.protobuf import descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    factory = message_factory
+
+    cases = [
+        ("ClientInfo", pbwire.CLIENT_INFO,
+         {"clientid": "c-1", "username": "u", "sockport": 1883,
+          "is_superuser": True, "peerhost": "10.0.0.9"}),
+        ("Message", pbwire.MESSAGE,
+         {"id": "m1", "qos": 2, "from": "dev", "topic": "t/1",
+          "payload": b"\x00\x01bin", "timestamp": 1700000000000,
+          "headers": {"username": "u", "allow_publish": "true"}}),
+        ("ConnInfo", pbwire.CONN_INFO,
+         {"clientid": "c", "proto_name": "MQTT", "proto_ver": "5",
+          "keepalive": 60}),
+        ("RequestMeta", pbwire.REQUEST_META,
+         {"node": "emqx@1.2.3.4", "version": "5.0.14",
+          "cluster_name": "emqxcl"}),
+        ("SubOpts", pbwire.SUB_OPTS,
+         {"qos": 1, "share": "g1", "rh": 2, "rap": 1, "nl": 1}),
+        ("HookSpec", pbwire.HOOK_SPEC,
+         {"name": "message.publish", "topics": ["a/#", "b/+"]}),
+    ]
+    for name, schema, values in cases:
+        cls = _dyn_message(name, schema, pool, factory)
+        # our encoder → their decoder
+        official = cls()
+        official.ParseFromString(pbwire.encode(schema, values))
+        for k, v in values.items():
+            got = getattr(official, k)
+            if isinstance(v, dict):
+                assert dict(got) == v, (name, k)
+            elif isinstance(v, list):
+                assert list(got) == v, (name, k)
+            else:
+                assert got == v, (name, k)
+        # their encoder → our decoder
+        ours = pbwire.decode(schema, official.SerializeToString())
+        for k, v in values.items():
+            assert ours[k] == v, (name, k)
+
+
+def test_valued_response_oneof_and_unknown_fields():
+    # bool_result branch
+    data = pbwire.encode(pbwire.VALUED_RESPONSE,
+                         {"type": 2, "bool_result": True})
+    out = pbwire.decode(pbwire.VALUED_RESPONSE, data)
+    assert out["type"] == 2 and out["bool_result"] is True
+    # a FALSE verdict must still appear on the wire (oneof presence):
+    # a conformant peer distinguishes STOP+deny from no-answer
+    deny = pbwire.encode(pbwire.VALUED_RESPONSE,
+                         {"type": 2, "bool_result": False})
+    assert bytes([3 << 3 | 0, 0]) in deny          # field 3, varint 0
+    assert pbwire.decode(pbwire.VALUED_RESPONSE, deny)["bool_result"] \
+        is False
+    # ...and absence stays absent (no default fill for oneof members)
+    assert "bool_result" not in pbwire.decode(
+        pbwire.VALUED_RESPONSE,
+        pbwire.encode(pbwire.VALUED_RESPONSE, {"type": 0}))
+    # message branch
+    data = pbwire.encode(pbwire.VALUED_RESPONSE, {
+        "type": 2, "message": {"topic": "t", "payload": b"p"}})
+    out = pbwire.decode(pbwire.VALUED_RESPONSE, data)
+    assert out["message"]["topic"] == "t"
+    # unknown fields (forward compat) are skipped, not fatal
+    extra = data + bytes([15 << 3 | 0]) + b"\x07"     # field 15 varint
+    assert pbwire.decode(pbwire.VALUED_RESPONSE, extra)["type"] == 2
+
+
+# -- transport + provider end-to-end -------------------------------------------
+
+class _Recorder:
+    hooks = ["client.authenticate", "client.authorize", "message.publish",
+             "client.connected", "session.subscribed",
+             "client.disconnected"]
+
+    def __init__(self):
+        self.notified = []
+        self.denied_user = "mallory"
+
+    def on_client_authenticate(self, ci):
+        if ci.get("username") == self.denied_user:
+            return False
+        return True if ci.get("username") == "trusted" else None
+
+    def on_client_authorize(self, ci, action, topic):
+        if topic.startswith("secret/"):
+            return False
+        return None
+
+    def on_message_publish(self, msg):
+        if msg["topic"] == "drop/me":
+            return False
+        if msg["topic"] == "rewrite/me":
+            return {**msg, "topic": "rewritten/to",
+                    "payload": b"new-" + msg["payload"]}
+        return None
+
+    def on_notify(self, rpc, request):
+        self.notified.append((rpc, request))
+
+
+def test_grpc_hook_provider_end_to_end():
+    """CONNECT/auth/publish through a live broker with a gRPC provider:
+    deny, allow-through, authz deny, drop, rewrite, notify RPCs."""
+    handler = _Recorder()
+    provider = GrpcHookProvider(handler).start()
+
+    async def main():
+        conf = Config()
+        conf.init_load(
+            'exhook { servers = [ { name = "p1", '
+            f'url = "grpc://127.0.0.1:{provider.port}" }}, ] }}')
+        app = BrokerApp.from_config(conf)
+        assert app.exhook is not None
+        assert app.exhook.servers["p1"].loaded
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+        try:
+            bad = MqttClient(port=server.port, clientid="m1",
+                             username="mallory", password=b"x")
+            with pytest.raises(ConnectionRefusedError):
+                await bad.connect()
+
+            sub = MqttClient(port=server.port, clientid="s1",
+                             username="trusted", password=b"x")
+            await sub.connect()
+            await sub.subscribe("#", qos=0)
+
+            pub = MqttClient(port=server.port, clientid="p1",
+                             username="trusted", password=b"x")
+            await pub.connect()
+            await pub.publish("rewrite/me", b"data")
+            got = await sub.recv()
+            assert got.topic == "rewritten/to"
+            assert got.payload == b"new-data"
+
+            await pub.publish("drop/me", b"x")
+            await pub.publish("after/drop", b"ok")
+            got = await sub.recv()
+            assert got.topic == "after/drop"      # dropped one never came
+
+            # authz deny via provider
+            deny = await sub.subscribe("secret/x", qos=0)
+            assert deny.reason_codes[0] >= 0x80
+
+            await pub.disconnect()
+            await sub.disconnect()
+            await asyncio.sleep(0.2)
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(main())
+        rpcs = [r for r, _ in handler.notified]
+        assert "OnClientConnected" in rpcs
+        assert "OnSessionSubscribed" in rpcs
+        assert "OnClientDisconnected" in rpcs
+        # request contents decoded provider-side
+        ci = next(req for r, req in handler.notified
+                  if r == "OnClientConnected")["clientinfo"]
+        assert ci["clientid"] in ("s1", "p1")
+        assert provider.calls.count("OnProviderLoaded") == 1
+    finally:
+        provider.stop()
+
+
+def test_grpc_failed_action_semantics():
+    """Dead gRPC endpoint: failed_action=deny blocks the publish,
+    ignore passes it through (emqx_exhook_server.erl:95-96,433)."""
+    # occupy then free a port so nothing listens on it
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    from emqx_tpu.core.message import Message
+
+    for action, expect_delivery in (("ignore", True), ("deny", False)):
+        app = BrokerApp()
+        mgr = ExhookMgr()
+        mgr.attach(app.hooks)
+        server = ExhookServer("dead", "127.0.0.1", dead_port,
+                              transport="grpc", timeout_s=0.5,
+                              failed_action=action)
+        server.loaded = True                       # simulate loaded-then-died
+        server.hooks_wanted = ["message.publish"]
+        mgr.servers["dead"] = server
+        app.broker.subscribe("sess1", "t/#")
+        deliveries = app.broker.publish(Message(topic="t/1", payload=b"x"))
+        assert bool(deliveries) is expect_delivery, action
+
+
+def test_bad_scheme_is_a_config_error():
+    conf = Config()
+    conf.init_load('exhook { servers = [ { name = "x", '
+                   'url = "ftp://127.0.0.1:1" } ] }')
+    with pytest.raises(ValueError, match="scheme"):
+        BrokerApp.from_config(conf)
+    with pytest.raises(ValueError, match="transport"):
+        ExhookServer("x", "127.0.0.1", 1, transport="carrier-pigeon")
+
+
+def test_provider_down_at_boot_reconnects_via_tick():
+    """enable_async keeps an unreachable provider registered; tick()
+    heals it once the provider comes up (reference auto_reconnect)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    conf = Config()
+    conf.init_load('exhook { servers = [ { name = "late", '
+                   f'url = "grpc://127.0.0.1:{port}", '
+                   'request_timeout = 0.5, auto_reconnect = 0.05 } ] }')
+    app = BrokerApp.from_config(conf)           # boots despite dead provider
+    server = app.exhook.servers["late"]
+    assert not server.loaded
+
+    handler = _Recorder()
+    provider = GrpcHookProvider(handler, port=port).start()
+    try:
+        import time
+        deadline = time.monotonic() + 5
+        while not server.loaded and time.monotonic() < deadline:
+            time.sleep(0.06)
+            app.exhook.tick()
+        assert server.loaded
+        assert "message.publish" in server.hooks_wanted
+    finally:
+        provider.stop()
+
+
+def test_batch_publish_lane_falls_back_to_per_message():
+    """OnMessagePublishBatch over gRPC decomposes into per-message
+    OnMessagePublish calls against a stock provider."""
+    handler = _Recorder()
+    provider = GrpcHookProvider(handler).start()
+    try:
+        conn = GrpcConn(("127.0.0.1", provider.port), 5.0)
+        resp = conn.call("OnMessagePublishBatch", {"messages": [
+            {"topic": "drop/me", "payload": b"a", "qos": 0},
+            {"topic": "keep/me", "payload": b"b", "qos": 0},
+            {"topic": "rewrite/me", "payload": b"c", "qos": 0}]})
+        results = resp["results"]
+        assert results[0].get("drop") is True
+        assert "drop" not in results[1] and "message" not in results[1]
+        assert results[2]["message"]["topic"] == "rewritten/to"
+        conn.close()
+    finally:
+        provider.stop()

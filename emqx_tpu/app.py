@@ -496,9 +496,16 @@ class BrokerApp:
                 pool_size=int(spec.get("pool_size", 4)),
                 timeout_s=float(spec.get("request_timeout", 5.0)),
                 failed_action=str(spec.get("failed_action", "deny")))
-            app.exhook.enable_async(
-                server,
-                retry_interval_s=float(spec.get("auto_reconnect", 5.0)))
+            # auto_reconnect: false disables retry (EMQX semantics);
+            # true = default interval; a number/duration = that interval
+            ar = spec.get("auto_reconnect", 5.0)
+            if ar is False:
+                retry = None
+            elif ar is True:
+                retry = 5.0
+            else:
+                retry = float(ar)
+            app.exhook.enable_async(server, retry_interval_s=retry)
         # live-update seams: strategy + retainer limits apply immediately
         conf.add_listener(app._on_config_change)
         return app

@@ -35,6 +35,42 @@ def grpc_available() -> bool:
 _IDENT = lambda b: b      # noqa: E731 — bytes in/out (no codegen)
 
 
+def make_grpc_server(service: str, rpc_names, dispatch, *,
+                     streaming: bool = False, host: str = "127.0.0.1",
+                     port: int = 0, workers: int = 4):
+    """Generic bytes-in/bytes-out grpcio server for one service.
+
+    ``dispatch(rpc, request)`` gets raw request bytes (or, with
+    ``streaming=True``, the request iterator) and returns raw response
+    bytes. Shared by the exhook provider host and both exproto sides —
+    one place for the method-prefix/handler plumbing. Returns
+    (server, bound_port)."""
+    import concurrent.futures
+
+    import grpc
+
+    class _Svc(grpc.GenericRpcHandler):
+        def service(self, details):
+            prefix = f"/{service}/"
+            if not details.method.startswith(prefix):
+                return None
+            rpc = details.method[len(prefix):]
+            if rpc not in rpc_names:
+                return None
+            make = (grpc.stream_unary_rpc_method_handler if streaming
+                    else grpc.unary_unary_rpc_method_handler)
+            return make(
+                lambda req, ctx, rpc=rpc: dispatch(rpc, req),
+                request_deserializer=_IDENT,
+                response_serializer=_IDENT)
+
+    server = grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=workers))
+    server.add_generic_rpc_handlers((_Svc(),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    return server, bound
+
+
 class GrpcConn:
     """One channel per provider (HTTP/2 multiplexes; the reference's
     per-scheduler pool maps onto grpcio's internal connection mgmt)."""
@@ -83,7 +119,15 @@ class GrpcConn:
         except grpc.RpcError as e:
             raise ConnectionError(
                 f"grpc {rpc}: {e.code().name}") from None
-        return pbwire.parse_response(rpc, resp)
+        try:
+            return pbwire.parse_response(rpc, resp)
+        except ValueError as e:
+            # malformed reply bytes must surface as a PROVIDER failure
+            # (failed_action applies) — a raw ValueError would escape
+            # the hook handlers' (ConnectionError, OSError) guards and
+            # crash the auth/publish path
+            raise ConnectionError(f"grpc {rpc}: bad response: {e}") \
+                from None
 
     def close(self) -> None:
         self._channel.close()
@@ -108,31 +152,11 @@ class GrpcHookProvider:
 
     def __init__(self, handler: Any, host: str = "127.0.0.1",
                  port: int = 0, workers: int = 4) -> None:
-        import concurrent.futures
-
-        import grpc
-
         self.handler = handler
         self.calls: list[str] = []           # observed RPC order (tests)
-        provider = self
-
-        class _Svc(grpc.GenericRpcHandler):
-            def service(self, details):
-                prefix = f"/{pbwire.SERVICE}/"
-                if not details.method.startswith(prefix):
-                    return None
-                rpc = details.method[len(prefix):]
-                if rpc not in pbwire.REQUEST_SCHEMAS:
-                    return None
-                return grpc.unary_unary_rpc_method_handler(
-                    lambda req, ctx, rpc=rpc: provider._dispatch(rpc, req),
-                    request_deserializer=_IDENT,
-                    response_serializer=_IDENT)
-
-        self._server = grpc.server(
-            concurrent.futures.ThreadPoolExecutor(max_workers=workers))
-        self._server.add_generic_rpc_handlers((_Svc(),))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server, self.port = make_grpc_server(
+            pbwire.SERVICE, pbwire.REQUEST_SCHEMAS, self._dispatch,
+            host=host, port=port, workers=workers)
 
     # -- dispatch -----------------------------------------------------------
 

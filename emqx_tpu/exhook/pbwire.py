@@ -364,6 +364,32 @@ def build_request(rpc: str, args: dict, meta: Optional[dict] = None) -> bytes:
                        "sysdescr": str(b.get("sysdescr", "emqx_tpu")),
                        "uptime": int(b.get("uptime", 0)),
                        "datetime": str(b.get("datetime", ""))}
+    elif rpc in ("OnClientConnect", "OnClientConnack"):
+        # these two carry ConnInfo (not ClientInfo) + connack's
+        # result_code; the hook ships positional args through the
+        # notify shape
+        plain = args.get("args") or []
+        dicts = [a for a in plain if isinstance(a, dict)]
+        ci = dicts[0] if dicts else (args.get("conninfo") or {})
+        peer = str(ci.get("peerhost") or ci.get("peername") or "")
+        host, _, port = peer.rpartition(":")
+        conninfo = {"clientid": str(ci.get("clientid") or ""),
+                    "username": str(ci.get("username") or ""),
+                    "peerhost": host or peer,
+                    "proto_name": str(ci.get("proto_name") or "MQTT"),
+                    "proto_ver": str(ci.get("proto_ver") or ""),
+                    "node": "emqx_tpu@127.0.0.1"}
+        if port.isdigit():
+            conninfo["sockport"] = int(port)
+        if ci.get("keepalive"):
+            conninfo["keepalive"] = int(ci["keepalive"])
+        v["conninfo"] = conninfo
+        if rpc == "OnClientConnack":
+            rcs = [a for a in plain if isinstance(a, (int, str))
+                   and not isinstance(a, bool)]
+            rc = rcs[0] if rcs else args.get("result_code", 0)
+            v["result_code"] = ("success" if rc in (0, "0", "success")
+                                else str(rc))
     elif rpc == "OnClientAuthenticate":
         v["clientinfo"] = _pb_clientinfo(args.get("clientinfo") or {})
     elif rpc == "OnClientAuthorize":

@@ -50,6 +50,10 @@ class _Conn:
             except (OSError, socket.timeout):
                 self.close()
                 raise
+            except ValueError as e:      # malformed frame = provider
+                self.close()             # failure, not a broker crash
+                raise ConnectionError(f"bad provider frame: {e}") \
+                    from None
             if resp is None:
                 self.close()
                 raise ConnectionError("provider closed connection")
@@ -152,13 +156,14 @@ class ExhookMgr:
         return wanted
 
     def enable_async(self, server: ExhookServer,
-                     retry_interval_s: float = 5.0) -> bool:
+                     retry_interval_s: Optional[float] = 5.0) -> bool:
         """Register the provider and try to load it; on failure keep it
         registered unloaded and let tick() retry — the reference's
-        auto_reconnect (emqx_exhook_mgr). Returns whether the first
-        load succeeded. Until loaded, the provider's hooks are not
-        consulted (same fail-open window as the reference's
-        waiting-for-reconnect state)."""
+        auto_reconnect (emqx_exhook_mgr). ``retry_interval_s=None`` =
+        auto_reconnect disabled: one attempt, never retried. Returns
+        whether the first load succeeded. Until loaded, the provider's
+        hooks are not consulted (same fail-open window as the
+        reference's waiting-for-reconnect state)."""
         self.servers[server.name] = server
         server.retry_interval_s = retry_interval_s
         server.next_retry_at = 0.0
@@ -172,10 +177,15 @@ class ExhookMgr:
             return True
         except (ConnectionError, OSError, ValueError) as e:
             import time as _t
-            server.next_retry_at = _t.monotonic() + retry_interval_s
-            log.warning("exhook provider %s unreachable (%s); will "
-                        "retry every %.0fs", server.name, e,
-                        retry_interval_s)
+            if retry_interval_s is None:
+                server.next_retry_at = float("inf")
+                log.warning("exhook provider %s unreachable (%s); "
+                            "auto_reconnect disabled", server.name, e)
+            else:
+                server.next_retry_at = _t.monotonic() + retry_interval_s
+                log.warning("exhook provider %s unreachable (%s); will "
+                            "retry every %.0fs", server.name, e,
+                            retry_interval_s)
             return False
         finally:
             for c, t in zip(server._pool, saved):
@@ -193,9 +203,11 @@ class ExhookMgr:
                 server.load()
                 log.info("exhook provider %s reconnected (hooks: %s)",
                          server.name, server.hooks_wanted)
-            except (ConnectionError, OSError):
-                server.next_retry_at = now + getattr(
-                    server, "retry_interval_s", 5.0)
+            except (ConnectionError, OSError, ValueError):
+                # ValueError included: a garbage LoadedResponse must not
+                # escape app.tick and kill broker housekeeping
+                server.next_retry_at = now + (getattr(
+                    server, "retry_interval_s", None) or 5.0)
 
     def disable(self, name: str) -> bool:
         server = self.servers.pop(name, None)

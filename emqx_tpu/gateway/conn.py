@@ -28,6 +28,10 @@ class TcpGwConnection:
         self._loop = asyncio.get_event_loop()
         channel.send = self.send_frames
         channel.request_close = self.request_close
+        channel.peername = writer.get_extra_info("peername")
+        ready = getattr(channel, "on_socket_ready", None)
+        if ready is not None:        # channels that announce the socket
+            ready()                  # to an external service (exproto)
 
     def send_frames(self, pkts: list) -> None:
         if self.closed or not pkts:
@@ -221,6 +225,10 @@ class UdpGwListener(asyncio.DatagramProtocol):
             ch = self.make_channel()
             ch.send = self._sender(addr)
             ch.request_close = self._closer(addr)
+            ch.peername = addr
+            ready = getattr(ch, "on_socket_ready", None)
+            if ready is not None:
+                ready()
             self.channels[addr] = ch
         self._last_seen[addr] = self._loop.time()
         try:

@@ -127,6 +127,14 @@ class GwContext:
     def authenticate(self, clientid: str, username=None,
                      password=None) -> bool:
         try:
+            # banned first — the reference gateway channels carry a
+            # literal "TODO: How to implement the banned in the gateway
+            # instance?" (emqx_stomp_channel.erl:427); enforcing the
+            # shared table here closes that gap for every gateway
+            banned = getattr(self.app.access, "banned", None)
+            if banned is not None and banned.check(
+                    {"clientid": clientid, "username": username}):
+                return False
             res = self.app.hooks.run_fold(
                 "client.authenticate",
                 ({"clientid": clientid, "username": username,

@@ -1,0 +1,162 @@
+"""ExProto over real gRPC: the emqx.exproto.v1 ConnectionHandler
+(broker→service event streams) + ConnectionAdapter (service→broker
+unary ops) against a grpcio protocol-handler host — the
+emqx_exproto_SUITE / exproto_echo_svr analogue on the actual wire
+(apps/emqx_gateway/src/exproto/protos/exproto.proto)."""
+
+import asyncio
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.server import BrokerServer
+from emqx_tpu.gateway.exproto_grpc import (RC_DENY, RC_NOT_ALIVE,
+                                           RC_SUCCESS, AdapterClient,
+                                           GrpcExprotoGateway,
+                                           GrpcProtocolHandlerHost)
+from emqx_tpu.mqtt.client import MqttClient
+
+
+class LineProtocol:
+    """'AUTH <id>' / 'SUB <t>' / 'PUB <t> <msg>' over the adapter;
+    deliveries come back as 'MSG <t> <payload>' lines."""
+
+    def __init__(self):
+        self.conninfos = {}
+
+    def on_socket_created(self, conn, conninfo, adapter):
+        self.conninfos[conn] = conninfo
+
+    def on_received_bytes(self, conn, data, adapter):
+        line = data.decode().strip()
+        verb, _, rest = line.partition(" ")
+        if verb == "AUTH":
+            code, _m = adapter.authenticate(conn, clientid=rest)
+            adapter.send(conn, b"OK\n" if code == RC_SUCCESS else b"NO\n")
+        elif verb == "SUB":
+            adapter.subscribe(conn, rest, qos=0)
+            adapter.send(conn, b"OK\n")
+        elif verb == "PUB":
+            t, _, payload = rest.partition(" ")
+            adapter.publish(conn, t, payload.encode())
+        elif verb == "QUIT":
+            adapter.close(conn)
+        else:
+            adapter.send(conn, b"ERR\n")
+
+    def on_received_messages(self, conn, messages, adapter):
+        for m in messages:
+            adapter.send(
+                conn,
+                b"MSG %s %s\n" % (m["topic"].encode(), m["payload"]))
+
+
+def test_exproto_grpc_end_to_end():
+    async def main():
+        impl = LineProtocol()
+        host = GrpcProtocolHandlerHost(impl).start()
+        app = BrokerApp()
+        gw = app.gateway.load(GrpcExprotoGateway(
+            handler_port=host.port, port=0))
+        await gw.start_listeners()
+        host.connect_adapter("127.0.0.1", gw.adapter.port)
+        srv = BrokerServer(port=0, app=app)
+        await srv.start()
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", gw.port)
+            w.write(b"AUTH dev-g1\n")
+            assert await asyncio.wait_for(r.readline(), 5) == b"OK\n"
+            w.write(b"SUB alerts/#\n")
+            assert await asyncio.wait_for(r.readline(), 5) == b"OK\n"
+
+            mq = MqttClient(port=srv.port, clientid="m1")
+            await mq.connect()
+            await mq.subscribe("from-device/#")
+            # device → broker over adapter Publish
+            w.write(b"PUB from-device/g1 ping\n")
+            got = await mq.recv()
+            assert got.topic == "from-device/g1"
+            assert got.payload == b"ping"
+            # broker → device via OnReceivedMessages stream + Send
+            await mq.publish("alerts/red", b"evacuate")
+            line = await asyncio.wait_for(r.readline(), 5)
+            assert line == b"MSG alerts/red evacuate\n"
+            # OnSocketCreated carried the REAL peer address
+            ci = next(iter(impl.conninfos.values()))
+            peer = ci.get("peername") or {}
+            assert peer.get("host") == "127.0.0.1"
+            assert peer.get("port", 0) > 0
+            # adapter Close drops the transport
+            w.write(b"QUIT\n")
+            assert await asyncio.wait_for(r.read(), 5) == b""
+            await mq.close()
+        finally:
+            await gw.stop_listeners()
+            await srv.stop()
+            host.stop()
+
+    asyncio.run(main())
+
+
+def test_adapter_codes_and_auth_gating():
+    """Adapter semantics: unknown conn → CONN_PROCESS_NOT_ALIVE;
+    publish before authenticate → PERMISSION_DENY; missing clientid →
+    REQUIRED_PARAMS_MISSED class errors."""
+    async def main():
+        host = GrpcProtocolHandlerHost(LineProtocol()).start()
+        app = BrokerApp()
+        gw = app.gateway.load(GrpcExprotoGateway(
+            handler_port=host.port, port=0))
+        await gw.start_listeners()
+        host.connect_adapter("127.0.0.1", gw.adapter.port)
+        try:
+            adapter = AdapterClient("127.0.0.1", gw.adapter.port)
+            code, msg = adapter.send("no-such-conn", b"x")
+            assert code == RC_NOT_ALIVE, (code, msg)
+
+            # open a raw connection to mint a live conn ref
+            r, w = await asyncio.open_connection("127.0.0.1", gw.port)
+            w.write(b"hello")                  # any bytes spin the channel
+            await asyncio.sleep(0.3)
+            (conn_ref,) = list(gw.adapter.channels)
+            code, _ = adapter.publish(conn_ref, "t", b"x")
+            assert code == RC_DENY             # not authenticated yet
+            code, _ = adapter.authenticate(conn_ref, clientid="")
+            assert code != RC_SUCCESS          # clientid required
+            code, _ = adapter.authenticate(conn_ref, clientid="dev-a")
+            assert code == RC_SUCCESS
+            code, _ = adapter.publish(conn_ref, "t", b"x")
+            assert code == RC_SUCCESS
+            adapter.close_channel()
+            w.close()
+        finally:
+            await gw.stop_listeners()
+            host.stop()
+
+    asyncio.run(main())
+
+
+def test_banned_clientid_denied_via_adapter():
+    """ctx.authenticate folds the broker's access control: a banned
+    clientid gets PERMISSION_DENY through the adapter."""
+    async def main():
+        host = GrpcProtocolHandlerHost(LineProtocol()).start()
+        app = BrokerApp()
+        app.access.banned.create("clientid", "evil-dev")
+        gw = app.gateway.load(GrpcExprotoGateway(
+            handler_port=host.port, port=0))
+        await gw.start_listeners()
+        host.connect_adapter("127.0.0.1", gw.adapter.port)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", gw.port)
+            w.write(b"AUTH evil-dev\n")
+            assert await asyncio.wait_for(r.readline(), 5) == b"NO\n"
+            w.close()
+        finally:
+            await gw.stop_listeners()
+            host.stop()
+
+    asyncio.run(main())

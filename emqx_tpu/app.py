@@ -398,6 +398,7 @@ class BrokerApp:
                 n_sub_slots=int(conf.get("router.device.n_sub_slots")),
                 K=int(conf.get("router.device.frontier_k")),
                 M=int(conf.get("router.device.match_cap")),
+                ret_cap=int(conf.get("router.device.return_cap")),
             )
             # Boot-time device touch ON THIS THREAD: JAX backend init from
             # a worker thread (where the pipeline's first flush would

@@ -313,6 +313,13 @@ class Broker:
     ) -> list[dict[Sid, list[tuple[str, Message]]]]:
         """Device-path publish: one kernel launch for the whole batch
         (falls back to the host oracle per overflow/too-long topic)."""
+        return self.publish_batch_collect(self.publish_batch_submit(msgs))
+
+    def publish_batch_submit(self, msgs: Sequence[Message]):
+        """Stage 1: run the publish hooks and dispatch the routing
+        kernel; returns an opaque token for ``publish_batch_collect``.
+        The pipeline overlaps the in-flight device step with the next
+        batch's hooks (double-buffering, SURVEY §2.5-6)."""
         cobatch = (self.rules_matched_fn is not None
                    and self.rules_gate_fn is not None
                    and self.model is not None)
@@ -344,15 +351,28 @@ class Broker:
                 live.append((i, m))
         out: list[dict[Sid, list[tuple[str, Message]]]] = [{} for _ in msgs]
         if not live:
-            return out
+            return (msgs, live, cobatch, out, None)
         if self.model is None:
+            return (msgs, live, cobatch, out, None)
+        pending = self.model.publish_batch_submit(
+            [m.topic for _, m in live])
+        return (msgs, live, cobatch, out, pending)
+
+    def publish_batch_collect(
+        self, token
+    ) -> list[dict[Sid, list[tuple[str, Message]]]]:
+        """Stage 2: collect a submitted batch's routing results and
+        build the per-session delivery map."""
+        msgs, live, cobatch, out, pending = token
+        if not live:
+            return out
+        if pending is None:                    # host-oracle path
             for i, m in live:
                 self._inc("messages.publish")
                 out[i] = self._route(m.topic, m)
             return out
-        matched, aux, slots, fallback = self.model.publish_batch(
-            [m.topic for _, m in live]
-        )
+        matched, aux, slots, fallback = self.model.publish_batch_collect(
+            pending)
         fb = set(fallback)
         for j, (i, m) in enumerate(live):
             self._inc("messages.publish")

@@ -266,6 +266,9 @@ def root_schema() -> Struct:
                 "max_levels": Field("int", default=16),
                 "frontier_k": Field("int", default=32),
                 "match_cap": Field("int", default=128),
+                # device→host columns returned per topic; topics
+                # matching more fall back to the host oracle
+                "return_cap": Field("int", default=16),
             }),
         }),
         "shared_subscription_strategy": Field(

@@ -53,13 +53,23 @@ def router_step(
     K: int = 32,
     M: int = 128,
     max_probes: int = 8,
+    ret_cap: Optional[int] = None,
     shardings: Optional[dict[str, NamedSharding]] = None,
 ):
     """The full publish-batch routing step (pure, jittable).
 
-    Returns (fids [B, M], fanout [B, W], counts [B], overflow [B]);
-    fanout covers the dense-pool (high-degree) filters, low-degree slots
-    decode host-side from the subscription dict.
+    Returns (fids [B, ret_cap or M], fanout [B, W], overflow [B],
+    fan_any []); fanout covers the dense-pool (high-degree) filters,
+    low-degree slots decode host-side from the subscription dict.
+
+    ``ret_cap`` trims the RETURNED fid columns: device→host transfer is
+    the serving path's dominant cost (a tunneled TPU pays ~90 ms/RTT and
+    bandwidth per flush), and mean matches/topic is ~1.7 against M=128
+    buffered columns. Topics matching more than ret_cap filters are
+    flagged overflow and take the host-oracle fallback upstream —
+    correctness never depends on the trim. ``fan_any`` (scalar) lets the
+    host skip fetching the [B, W] fanout block entirely when no
+    dense-pool row matched (the common case below the dense threshold).
     """
     cand, overflow = tm.match_batch(
         trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
@@ -71,8 +81,12 @@ def router_step(
     out = fo.fanout_pool(rowmap, pool, fids)
     if shardings is not None:
         out = jax.lax.with_sharding_constraint(out, shardings["fanout_out"])
-    counts = fo.bitmap_to_counts(out)
-    return fids, out, counts, overflow | truncated
+    fan_any = jnp.any(out != 0)
+    overflow = overflow | truncated
+    if ret_cap is not None and ret_cap < M:
+        overflow = overflow | (jnp.sum(fids >= 0, axis=1) > ret_cap)
+        fids = fids[:, :ret_cap]
+    return fids, out, overflow, fan_any
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -135,12 +149,14 @@ class RouterModel:
         n_sub_slots: int = 8192,
         K: int = 32,
         M: int = 128,
+        ret_cap: int = 16,
         dense_threshold: int = 64,
         mesh: Optional[Mesh] = None,
     ) -> None:
         self.index = index or TrieIndex()
         self.n_sub_slots = n_sub_slots
         self.K, self.M = K, M
+        self.ret_cap = min(ret_cap, M)
         self.dense_threshold = dense_threshold
         self.mesh = mesh
         self.shardings = pmesh.router_shardings(mesh) if mesh else None
@@ -188,6 +204,7 @@ class RouterModel:
                 router_step,
                 K=K,
                 M=M,
+                ret_cap=self.ret_cap,
                 max_probes=self.index.max_probes,
                 shardings=self.shardings,
             )
@@ -460,35 +477,76 @@ class RouterModel:
         - fallback: batch positions (overflow/too-long) that must take
           the host-oracle path upstream (router.match_filters)
         """
-        with self._mlock:
-            return self._publish_batch_locked(topics)
+        return self.publish_batch_collect(self.publish_batch_submit(topics))
 
-    def _publish_batch_locked(self, topics: Sequence[str]):
-        if self._dirty or self._trie_dev is None:
-            self._refresh_locked()
-        self.launch_count += 1
-        n = len(topics)
-        # pad the batch to a pow2 bucket (≥64) — keeps the set of compiled
-        # program shapes small, the {active,N}-style batching discipline
-        B = 64
-        while B < n:
-            B *= 2
-        padded = list(topics) + [""] * (B - n)
-        tokens, lengths, sys_flags, too_long = self.index.tokenize(padded)
-        too_long = [b for b in too_long if b < n]
-        # padding rows: length 0 + sys flag so even the root '#'/'+' filters
-        # (which match an empty prefix) cannot emit for them
-        lengths[n:] = 0
-        sys_flags[n:] = True
-        args = (tokens, lengths, sys_flags)
-        if self.shardings is not None:
-            args = jax.device_put(args, self.shardings["batch_full"])
-        fids, fanout, counts, overflow = self._step(
-            self._trie_dev, self._rowmap_dev, self._pool_dev, *args
-        )
-        fids = np.asarray(fids)
-        fan = np.asarray(fanout)
-        overflow = np.asarray(overflow)
+    def publish_batch_submit(self, topics: Sequence[str]):
+        """Stage 1: tokenize + dispatch the kernel; returns an opaque
+        pending handle WITHOUT waiting for the device. The serving
+        pipeline overlaps this launch's device round trip (~70 ms on a
+        tunneled TPU, fixed per synchronous fetch) with the NEXT batch's
+        hook fold and tokenization — the SURVEY §2.5-6 double-buffering."""
+        with self._mlock:
+            if self._dirty or self._trie_dev is None:
+                self._refresh_locked()
+            self.launch_count += 1
+            n = len(topics)
+            # pad the batch to a pow2 bucket (≥64) — keeps the set of
+            # compiled program shapes small, the {active,N}-style
+            # batching discipline
+            B = 64
+            while B < n:
+                B *= 2
+            padded = list(topics) + [""] * (B - n)
+            tokens, lengths, sys_flags, too_long = self.index.tokenize(
+                padded)
+            too_long = [b for b in too_long if b < n]
+            # padding rows: length 0 + sys flag so even the root '#'/'+'
+            # filters (which match an empty prefix) cannot emit for them
+            lengths[n:] = 0
+            sys_flags[n:] = True
+            args = (tokens, lengths, sys_flags)
+            if self.shardings is not None:
+                args = jax.device_put(args, self.shardings["batch_full"])
+            fids, fanout, overflow, fan_any = self._step(
+                self._trie_dev, self._rowmap_dev, self._pool_dev, *args
+            )
+            # freed fids stay quarantined until this batch is decoded —
+            # a reused fid would decode as the WRONG (new) filter
+            self.index.begin_inflight()
+            return (list(topics), too_long, fids, fanout, overflow,
+                    fan_any)
+
+    def publish_batch_collect(self, pending):
+        """Stage 2: fetch + decode a submitted batch's results."""
+        topics, too_long, fids, fanout, overflow, fan_any = pending
+        try:
+            # ONE device_get for all needed outputs: it issues
+            # copy_to_host_async for every array before materializing,
+            # so the transfers overlap into ~one device round trip.
+            # Serial np.asarray calls cost a full round trip EACH —
+            # measured 3×89 ms per flush on a tunneled TPU, which
+            # dominated the e2e broker latency. The [B, W] fanout block
+            # starts its copy speculatively so the fan_any=True case
+            # (dense rows matched) costs no SECOND dependent round trip;
+            # it only materializes when needed.
+            try:
+                fanout.copy_to_host_async()
+            except AttributeError:     # non-jax array (tests/mocks)
+                pass
+            fids, overflow, fan_any = jax.device_get(
+                (fids, overflow, fan_any))
+            if fan_any:
+                fan = np.asarray(fanout)
+            else:
+                fan = np.zeros(fanout.shape, np.uint32)
+            with self._mlock:
+                return self._decode_locked(topics, too_long, fids, fan,
+                                           overflow)
+        finally:
+            with self._mlock:
+                self.index.end_inflight()
+
+    def _decode_locked(self, topics, too_long, fids, fan, overflow):
         # -- vectorized batch decode (the r2 host hot-spot): classify the
         # whole [B, M] fid block with two mask gathers, and expand ALL
         # delivering bitmap words with one shift table instead of a
@@ -525,8 +583,13 @@ class RouterModel:
         for b in range(B_out):
             row = fb[b]
             sub_fids = row[sub_hit[b]]
-            matched.append([filters[f] for f in sub_fids])
-            aux.append([filters[f] for f in row[aux_hit[b]]]
+            # a fid deleted while the batch was in flight decodes to
+            # None — that unsubscribe raced the publish; drop the leg
+            # (reuse is prevented by the index's in-flight quarantine)
+            matched.append([filters[f] for f in sub_fids
+                            if filters[f] is not None])
+            aux.append([filters[f] for f in row[aux_hit[b]]
+                        if filters[f] is not None]
                        if any_aux else [])
             # hybrid decode: dense (high-degree) filters' shard slots
             # come from the device OR (bitmap words above); low-degree

@@ -137,6 +137,13 @@ class TrieIndex:
         self.filters: list[Optional[str]] = []   # fid -> filter string
         self._filter_ids: dict[str, int] = {}
         self._free_fids: list[int] = []
+        # fid-reuse quarantine: while any publish batch is in flight
+        # (submitted, not yet decoded), freed fids must NOT be reused —
+        # the in-flight results reference them, and a reuse would decode
+        # a stale match as the NEW filter (wrong-subscriber delivery).
+        # RouterModel brackets submit/collect with begin/end_inflight.
+        self._inflight = 0
+        self._quarantined_fids: list[int] = []
         self.arrays: Optional[TrieIndexArrays] = None
         self.n_nodes = 0
         self.n_edges = 0
@@ -197,12 +204,24 @@ class TrieIndex:
                     self.intern(w)
         return fid
 
+    def begin_inflight(self) -> None:
+        self._inflight += 1
+
+    def end_inflight(self) -> None:
+        self._inflight -= 1
+        if self._inflight <= 0:
+            self._inflight = 0
+            if self._quarantined_fids:
+                self._free_fids.extend(self._quarantined_fids)
+                self._quarantined_fids.clear()
+
     def delete(self, filt: str) -> Optional[int]:
         fid = self._filter_ids.pop(filt, None)
         if fid is None:
             return None
         self.filters[fid] = None
-        self._free_fids.append(fid)
+        (self._quarantined_fids if self._inflight
+         else self._free_fids).append(fid)
         if not self.needs_rebuild and self.arrays is not None:
             self._delete_arrays(filt, fid)
             self.garbage += 1

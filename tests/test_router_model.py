@@ -286,3 +286,26 @@ def test_fixed_slot_space_at_scale():
          Message(topic="own/c123", payload=b"y")])
     assert len(deliveries[0]) == n          # every client got the bcast
     assert set(deliveries[1]) == {"c123"}   # sharded slot decode exact
+
+
+def test_inflight_fid_quarantine_prevents_wrong_delivery():
+    """submit/collect split: a fid freed while a batch is in flight must
+    not be REUSED before the batch decodes — reuse would decode the old
+    topic's match as the new filter (wrong-subscriber delivery)."""
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64, K=16,
+                        M=32)
+    model.subscribe("old/topic", 3)
+    model.refresh()
+    pending = model.publish_batch_submit(["old/topic"])
+    # while in flight: the old filter goes away and a new one arrives
+    model.unsubscribe("old/topic", 3)
+    old_fid = None
+    new_fid = model.subscribe("new/topic", 5)
+    matched, _aux, slots, fallback = model.publish_batch_collect(pending)
+    # the raced unsubscribe drops the leg; it must NOT become new/topic
+    assert matched[0] in ([], ["old/topic"])
+    assert "new/topic" not in matched[0]
+    # the freed fid is only reusable AFTER collect
+    assert model.index._inflight == 0
+    f2 = model.publish_batch(["new/topic"])
+    assert f2[0][0] == ["new/topic"] and f2[2][0] == [5]

@@ -88,7 +88,7 @@ def test_e2e_concurrent_publishers_batched(run):
                 for i in range(n_pubs) for j in range(n_msgs)}
         got = set()
         while len(got) < len(want):
-            m = await sub.recv(timeout=10)
+            m = await sub.recv(timeout=30)
             assert m.topic not in got, "duplicate delivery"
             got.add(m.topic)
         assert got == want
@@ -112,7 +112,7 @@ def test_e2e_ordering_per_publisher(run):
         await sub.subscribe("seq/t", qos=1)
         for i in range(20):
             await pub.publish("seq/t", b"%d" % i, qos=1)
-        seen = [int((await sub.recv()).payload) for _ in range(20)]
+        seen = [int((await sub.recv(timeout=30)).payload) for _ in range(20)]
         assert seen == list(range(20))
         await sub.disconnect()
         await pub.disconnect()
@@ -130,7 +130,7 @@ def test_e2e_host_oracle_fallback_deep_topic(run):
         await sub.subscribe("deep/#", qos=0)
         deep = "deep/" + "/".join(str(i) for i in range(12))   # 13 levels
         await pub.publish(deep, b"fb", qos=0)
-        got = await sub.recv()
+        got = await sub.recv(timeout=30)
         assert got.topic == deep and got.payload == b"fb"
         await sub.disconnect()
         await pub.disconnect()
@@ -148,15 +148,15 @@ def test_e2e_shared_and_retained_still_work(run):
         await a.subscribe("$share/g/t", qos=0)
         await b.subscribe("t", qos=0)
         await pub.publish("t", b"ret", qos=0, retain=True)
-        got_b = await b.recv()
+        got_b = await b.recv(timeout=30)
         assert got_b.payload == b"ret"
-        got_a = await a.recv()
+        got_a = await a.recv(timeout=30)
         assert got_a.payload == b"ret"
         # late subscriber gets the retained copy
         c = MqttClient(port=server.port, clientid="c")
         await c.connect()
         await c.subscribe("t", qos=0)
-        got_c = await c.recv()
+        got_c = await c.recv(timeout=30)
         assert got_c.payload == b"ret" and got_c.retain
         for cl in (a, b, pub, c):
             await cl.disconnect()

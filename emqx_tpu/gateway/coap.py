@@ -33,6 +33,30 @@ BAD_REQUEST, UNAUTHORIZED, NOT_FOUND, NOT_ALLOWED = 0x80, 0x81, 0x84, 0x85
 # option numbers
 OPT_OBSERVE, OPT_URI_PATH, OPT_CONTENT_FORMAT, OPT_URI_QUERY = 6, 11, 12, 15
 OPT_LOCATION_PATH = 8
+OPT_ETAG = 4
+OPT_BLOCK2, OPT_BLOCK1 = 23, 27          # RFC 7959 (emqx_coap_frame.erl
+OPT_SIZE2, OPT_SIZE1 = 28, 60            # encode_option block1/block2)
+
+CONTINUE_231 = 0x5F                      # 2.31 Continue
+REQUEST_ENTITY_INCOMPLETE = 0x88         # 4.08
+REQUEST_ENTITY_TOO_LARGE = 0x8D          # 4.13
+
+
+def parse_block(v: bytes) -> tuple[int, int, int]:
+    """Block option value → (num, more, size). RFC 7959 §2.2: 0-3 byte
+    uint of NUM<<4 | M<<3 | SZX, size = 2^(SZX+4). SZX=7 is reserved
+    (RFC 8323 repurposes it as BERT) — rejected, not misread as 2048."""
+    u = int.from_bytes(v, "big")
+    if (u & 0x07) == 7:
+        raise ValueError("reserved SZX 7")
+    return u >> 4, (u >> 3) & 1, 1 << ((u & 0x07) + 4)
+
+
+def encode_block(num: int, more: int, size: int) -> bytes:
+    szx = max(0, size.bit_length() - 5)
+    u = (num << 4) | (more << 3) | szx
+    n = max(1, (u.bit_length() + 7) // 8)
+    return u.to_bytes(n, "big") if u else b"\x00"
 
 
 @dataclass
@@ -239,6 +263,11 @@ class Channel(GwChannel):
         self._registered = False
         self.tm = TransportManager()
         self._con_topic: dict[int, str] = {}   # pending notify mid → topic
+        # RFC 7959 block1 reassembly: uri-path → (next_num, buffer,
+        # last_activity); one in-progress upload per path per endpoint
+        self._block1: dict[str, tuple[int, bytearray, float]] = {}
+        self.max_body = 64 * 1024              # 4.13 past this
+        self.block2_size = 1024                # auto-slice threshold
 
     def _next_mid(self) -> int:
         self._mid = self._mid % 0xFFFF + 1
@@ -307,11 +336,39 @@ class Channel(GwChannel):
             return [reply(UNAUTHORIZED)]
 
         if m.code in (PUT, POST):
+            payload = m.payload
+            b1 = m.opt(OPT_BLOCK1)
+            if b1 is not None:                 # RFC 7959 block1 upload
+                try:
+                    num, more, size = parse_block(b1)
+                except ValueError:
+                    return [reply(BAD_REQUEST)]
+                import time as _t
+                cur = self._block1.get(topic)
+                if num == 0:
+                    cur = (0, bytearray(), 0.0)
+                elif cur is None or cur[0] != num:
+                    # out-of-order / unknown transfer: 4.08 (§2.5)
+                    self._block1.pop(topic, None)
+                    return [reply(REQUEST_ENTITY_INCOMPLETE,
+                                  options=[(OPT_BLOCK1, b1)])]
+                buf = cur[1]
+                buf += m.payload
+                if len(buf) > self.max_body:
+                    self._block1.pop(topic, None)
+                    return [reply(REQUEST_ENTITY_TOO_LARGE)]
+                if more:
+                    self._block1[topic] = (num + 1, buf, _t.monotonic())
+                    return [reply(CONTINUE_231, options=[
+                        (OPT_BLOCK1, encode_block(num, 1, size))])]
+                self._block1.pop(topic, None)
+                payload = bytes(buf)
             qos = int(m.queries().get("qos", 0))
             retain = m.queries().get("retain") in ("true", "1")
-            self.ctx.publish(self.clientid, topic, m.payload, qos,
+            self.ctx.publish(self.clientid, topic, payload, qos,
                              retain=retain)
-            return [reply(CHANGED)]
+            opts = ([(OPT_BLOCK1, b1)] if b1 is not None else [])
+            return [reply(CHANGED, options=opts)]
         if m.code == GET:
             obs = m.observe()
             if obs == 0:
@@ -330,7 +387,35 @@ class Channel(GwChannel):
             if msgs is not None:
                 found = msgs.match(self.ctx.mount(topic))
                 if found:
-                    return [reply(CONTENT, payload=found[-1].payload)]
+                    body = found[-1].payload
+                    b2 = m.opt(OPT_BLOCK2)
+                    if b2 is None and len(body) <= self.block2_size:
+                        return [reply(CONTENT, payload=body)]
+                    # RFC 7959 block2 download: client-requested block
+                    # (or server-initiated slicing past the threshold).
+                    # Stateless: each block re-reads the retained store;
+                    # the ETag (§2.4) lets the client detect a retained
+                    # update between blocks instead of accepting a TORN
+                    # concatenation of old and new bodies.
+                    try:
+                        num, _more, size = (parse_block(b2)
+                                            if b2 is not None
+                                            else (0, 0, self.block2_size))
+                    except ValueError:
+                        return [reply(BAD_REQUEST)]
+                    lo = num * size
+                    if lo >= len(body) and num:
+                        return [reply(BAD_REQUEST)]
+                    chunk = body[lo:lo + size]
+                    more = 1 if lo + size < len(body) else 0
+                    import zlib as _z
+                    etag = _z.crc32(body).to_bytes(4, "big")
+                    return [reply(CONTENT, payload=chunk, options=[
+                        (OPT_ETAG, etag),
+                        (OPT_BLOCK2, encode_block(num, more, size)),
+                        (OPT_SIZE2, len(body).to_bytes(
+                            max(1, (len(body).bit_length() + 7) // 8),
+                            "big"))])]
             return [reply(NOT_FOUND)]
         if m.code == DELETE:
             return [reply(DELETED)]
@@ -382,6 +467,18 @@ class Channel(GwChannel):
         retx, gave_up = self.tm.tick()
         for mid in gave_up:
             self._cancel_observe(self._con_topic.pop(mid, None))
+        # abandoned block1 uploads must not pin buffers forever: a
+        # 60s idle TTL frees them; past the cap, evict the STALEST
+        # (never an actively-progressing upload in insertion order)
+        import time as _t
+        now = _t.monotonic()
+        stale = [k for k, (_n, _b, at) in self._block1.items()
+                 if now - at > 60.0]
+        for k in stale:
+            del self._block1[k]
+        while len(self._block1) > 8:
+            oldest = min(self._block1, key=lambda k: self._block1[k][2])
+            del self._block1[oldest]
         return retx
 
     def terminate(self, reason: str) -> None:

@@ -19,9 +19,11 @@ import json
 from typing import Optional
 
 from emqx_tpu.gateway import lwm2m_objects as objects
+from emqx_tpu.gateway import lwm2m_tlv as tlv
 from emqx_tpu.gateway.coap import (
     ACK, BAD_REQUEST, CHANGED, CREATED, DELETE, DELETED, Frame, GET,
-    NON, NOT_FOUND, OPT_LOCATION_PATH, POST, PUT, CoapMessage,
+    NON, NOT_FOUND, OPT_CONTENT_FORMAT, OPT_LOCATION_PATH, POST, PUT,
+    CoapMessage,
 )
 from emqx_tpu.gateway.ctx import GatewayImpl, GwChannel, GwContext
 
@@ -48,6 +50,9 @@ class Channel(GwChannel):
         # mid → {reqID, msgType, path} so device responses / timeouts
         # correlate back to the command they answer
         self._cmd_ctx: dict[int, dict] = {}
+        # paths this server observed (downlink observe commands) — used
+        # to type TLV notify bodies when the device omits ?path=
+        self._observed: set[str] = set()
 
     def _next_mid(self) -> int:
         self._mid = self._mid % 0xFFFF + 1
@@ -73,7 +78,9 @@ class Channel(GwChannel):
                 # piggybacked device response to a downlink command
                 # (read value / write result) — surface it as the uplink
                 # the reference's emqx_lwm2m_cmd produces, echoing the
-                # command's reqID/msgType/path for correlation
+                # command's reqID/msgType/path for correlation. TLV
+                # bodies decode into typed {path, name, value} rows via
+                # the object registry (emqx_lwm2m_message tlv_to_json)
                 self._uplink("response", {
                     "ep": self.endpoint,
                     "reqID": ctx.get("reqID"),
@@ -81,7 +88,8 @@ class Channel(GwChannel):
                     "data": {
                         "path": ctx.get("path"),
                         "code": f"{m.code >> 5}.{m.code & 0x1F:02d}",
-                        "content": m.payload.decode("utf-8", "replace"),
+                        "content": self._decode_content(
+                            m, ctx.get("path")),
                     }})
             return []
         if m.code == EMPTY:
@@ -172,9 +180,15 @@ class Channel(GwChannel):
         if m.code == POST and len(path) == 3 and path[2] == "notify":
             if self.reg_id is None or path[1] != self.reg_id:
                 return [reply(NOT_FOUND)]
+            # TLV typing needs the observed path: take the device's
+            # ?path= echo when present, else correlate with the ONE
+            # outstanding observe (the common single-observation case)
+            base = m.queries().get("path", "")
+            if not base and len(self._observed) == 1:
+                (base,) = self._observed
             self._uplink("notify", {
                 "ep": self.endpoint,
-                "payload": m.payload.decode("utf-8", "replace")})
+                "payload": self._decode_content(m, base)})
             return [reply(CHANGED)]
         return [reply(NOT_FOUND)]
 
@@ -185,6 +199,25 @@ class Channel(GwChannel):
     # R, not W (OMA TS §5.1.2)
     _OPS = {"read": "R", "observe": "R", "discover": "R",
             "write": "W", "write-attr": "R", "execute": "E"}
+
+    def _decode_content(self, m: CoapMessage, base_path) -> object:
+        """Device payload → structured rows when the content-format says
+        TLV (emqx_lwm2m_message); plain text passes through."""
+        cf = m.opt(OPT_CONTENT_FORMAT)
+        fmt = int.from_bytes(cf, "big") if cf else None
+        if fmt == tlv.CONTENT_TLV:
+            if base_path:
+                try:
+                    return tlv.tlv_to_path_values(str(base_path),
+                                                  m.payload)
+                except (tlv.TlvError, ValueError):
+                    pass             # malformed TLV: hex below
+            # binary without a typing context must surface as hex, not
+            # utf-8 mojibake
+            return m.payload.hex()
+        if fmt == tlv.CONTENT_OPAQUE:
+            return m.payload.hex()
+        return m.payload.decode("utf-8", "replace")
 
     def handle_deliver(self, deliveries: list) -> list[CoapMessage]:
         out = []
@@ -216,9 +249,29 @@ class Channel(GwChannel):
                     })
                     continue
             opts = [(11, seg.encode()) for seg in (["dn"] + cmd_path)]
+            payload = msg.payload
+            # a write command whose data.content is [{path, value}]
+            # rows ships as a typed TLV body (emqx_lwm2m_cmd +
+            # emqx_lwm2m_message json_to_tlv), not raw JSON
+            if (isinstance(cmd, dict) and cmd.get("msgType") == "write"
+                    and isinstance((cmd.get("data") or {}).get("content"),
+                                   list)):
+                data = cmd["data"]
+                try:
+                    payload = tlv.path_values_to_tlv(
+                        str(data.get("basePath") or data.get("path")),
+                        data["content"])
+                    opts.append((OPT_CONTENT_FORMAT,
+                                 tlv.CONTENT_TLV.to_bytes(2, "big")))
+                except (tlv.TlvError, ValueError, TypeError,
+                        KeyError, IndexError):
+                    # unencodable rows: raw JSON falls through — and a
+                    # malformed command must never escape into
+                    # CM.dispatch (it has no per-channel containment)
+                    pass
             cmd_msg = CoapMessage(
                 0, POST, self._next_mid(),
-                b"", opts, msg.payload)         # CON request to device
+                b"", opts, payload)             # CON request to device
             self.tm.track(cmd_msg)              # retransmit until ACKed
             if isinstance(cmd, dict):
                 self._cmd_ctx[cmd_msg.mid] = {
@@ -226,6 +279,11 @@ class Channel(GwChannel):
                     "msgType": cmd.get("msgType"),
                     "path": (cmd.get("data") or {}).get("path"),
                 }
+                obs_path = (cmd.get("data") or {}).get("path")
+                if cmd.get("msgType") == "observe" and obs_path:
+                    self._observed.add(str(obs_path))
+                elif cmd.get("msgType") == "cancel-observe" and obs_path:
+                    self._observed.discard(str(obs_path))
             out.append(cmd_msg)
         return out
 

@@ -998,3 +998,198 @@ def test_coap_block2_download_slices_retained():
         await gw.stop_listeners()
 
     run(main())
+
+
+# -- lwm2m TLV content codec (emqx_lwm2m_tlv + emqx_lwm2m_message) -------------
+
+def test_lwm2m_tlv_structural_roundtrip():
+    from emqx_tpu.gateway import lwm2m_tlv as TLV
+    entries = [
+        {"kind": TLV.OBJ_INSTANCE, "id": 0, "children": [
+            {"kind": TLV.RESOURCE, "id": 0, "value": b"ACME"},
+            {"kind": TLV.RESOURCE, "id": 9, "value": b"\x55"},
+            {"kind": TLV.MULTI_RES, "id": 6, "children": [
+                {"kind": TLV.RES_INSTANCE, "id": 0, "value": b"\x01"},
+                {"kind": TLV.RES_INSTANCE, "id": 1, "value": b"\x05"},
+            ]},
+        ]},
+        {"kind": TLV.RESOURCE, "id": 300, "value": b"x" * 300},  # 16-bit
+    ]
+    assert TLV.tlv_decode(TLV.tlv_encode(entries)) == entries
+    import pytest as _p
+    with _p.raises(TLV.TlvError):
+        TLV.tlv_decode(b"\xc0")                  # truncated identifier
+
+
+def test_lwm2m_tlv_typed_values():
+    from emqx_tpu.gateway import lwm2m_tlv as TLV
+    for value, rtype in ((42, "Integer"), (-7, "Integer"),
+                         (1 << 40, "Integer"), (3.5, "Float"),
+                         (True, "Boolean"), (False, "Boolean"),
+                         ("hello", "String"), ("deadbeef", "Opaque"),
+                         (1700000000, "Time"), ("3:0", "Objlnk")):
+        raw = TLV.encode_value(value, rtype)
+        assert TLV.decode_value(raw, rtype) == value, (value, rtype)
+
+
+def test_lwm2m_tlv_path_values_device_object():
+    """A Read /3/0 TLV response decodes to named, typed rows via the
+    object registry (Device: 0=Manufacturer String, 9=Battery Integer)."""
+    from emqx_tpu.gateway import lwm2m_tlv as TLV
+    body = TLV.tlv_encode([
+        {"kind": TLV.OBJ_INSTANCE, "id": 0, "children": [
+            {"kind": TLV.RESOURCE, "id": 0, "value": b"ACME"},
+            {"kind": TLV.RESOURCE, "id": 9,
+             "value": TLV.encode_value(55, "Integer")},
+        ]}])
+    rows = TLV.tlv_to_path_values("/3", body)
+    by_path = {r["path"]: r for r in rows}
+    assert by_path["/3/0/0"]["value"] == "ACME"
+    assert by_path["/3/0/9"]["value"] == 55
+    assert "Manufacturer" in by_path["/3/0/0"]["name"]
+    # and the Write direction: rows → TLV → rows
+    out = TLV.path_values_to_tlv("/3/0", [{"path": "9", "value": 70}])
+    assert TLV.tlv_to_path_values("/3/0", out)[0]["value"] == 70
+
+
+def test_lwm2m_tlv_read_response_and_typed_write():
+    """End-to-end: a device's TLV Read response surfaces as typed rows
+    in the up/response; a write command with content rows reaches the
+    device as a TLV body with the TLV content-format."""
+    async def main():
+        from emqx_tpu.gateway import lwm2m_tlv as TLV
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        uplinks = []
+        app.hooks.add("message.publish",
+                      lambda m: uplinks.append((m.topic, m.payload)) or None,
+                      priority=-500)
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.POST, "rd", payload=b"</3/0>,</1/0>",
+                    queries=["ep=tlv-ep"])
+        await cli.recv()
+        from emqx_tpu.core.message import Message
+
+        # downlink read; device answers with a TLV body
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/tlv-ep/dn/cmd",
+            payload=json.dumps({"reqID": 9, "msgType": "read",
+                                "data": {"path": "/3/0"}}).encode())))
+        cmd = await cli.recv()
+        body = TLV.tlv_encode([
+            {"kind": TLV.RESOURCE, "id": 0, "value": b"ACME"},
+            {"kind": TLV.RESOURCE, "id": 9,
+             "value": TLV.encode_value(81, "Integer")}])
+        cli.tr.sendto(cli.f.serialize(CoapMessage(
+            C.ACK, C.CONTENT, cmd.mid, cmd.token,
+            [(C.OPT_CONTENT_FORMAT,
+              TLV.CONTENT_TLV.to_bytes(2, "big"))], body)))
+        await asyncio.sleep(0.2)
+        resp = json.loads(dict(uplinks)["lwm2m/tlv-ep/up/response"])
+        rows = {r["path"]: r["value"] for r in resp["data"]["content"]}
+        assert rows == {"/3/0/0": "ACME", "/3/0/9": 81}
+
+        # typed write: content rows → TLV payload at the device
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/tlv-ep/dn/cmd",
+            payload=json.dumps({
+                "reqID": 10, "msgType": "write",
+                "data": {"basePath": "/1/0",
+                         "content": [{"path": "1", "value": 7200}]},
+            }).encode())))
+        wcmd = await cli.recv()
+        cf = wcmd.opt(C.OPT_CONTENT_FORMAT)
+        assert int.from_bytes(cf, "big") == TLV.CONTENT_TLV
+        decoded = TLV.tlv_to_path_values("/1/0", wcmd.payload)
+        assert decoded[0]["value"] == 7200       # Lifetime, Integer-typed
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_lwm2m_tlv_write_nesting_and_malformed_rows():
+    from emqx_tpu.gateway import lwm2m_tlv as TLV
+    import pytest as _p
+    # res-instance row nests MULTI_RES/RES_INSTANCE — NOT a flat
+    # resource 0 (which would overwrite Manufacturer)
+    body = TLV.path_values_to_tlv("/3/0", [
+        {"path": "/3/0/6/0", "value": 1},
+        {"path": "/3/0/6/1", "value": 5}])
+    (entry,) = TLV.tlv_decode(body)
+    assert entry["kind"] == TLV.MULTI_RES and entry["id"] == 6
+    assert [c["id"] for c in entry["children"]] == [0, 1]
+    # object base groups per-instance
+    body = TLV.path_values_to_tlv("/3", [
+        {"path": "/3/0/9", "value": 10}, {"path": "/3/1/9", "value": 20}])
+    entries = TLV.tlv_decode(body)
+    assert [(e["kind"], e["id"]) for e in entries] == \
+        [(TLV.OBJ_INSTANCE, 0), (TLV.OBJ_INSTANCE, 1)]
+    # malformed rows raise TlvError, never KeyError/IndexError
+    for bad in ([{}], [{"path": "", "value": 1}],
+                [{"path": "/9/0/1", "value": 1}],
+                [{"path": "/3/a", "value": 1}]):
+        with _p.raises(TLV.TlvError):
+            TLV.path_values_to_tlv("/3/0", bad)
+
+
+def test_lwm2m_malformed_write_falls_back_not_crash():
+    """A write command with broken content rows must still reach the
+    device (raw JSON), never crash CM.dispatch."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.POST, "rd", payload=b"</3/0>", queries=["ep=bad-ep"])
+        await cli.recv()
+        from emqx_tpu.core.message import Message
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/bad-ep/dn/cmd",
+            payload=json.dumps({"reqID": 1, "msgType": "write",
+                                "data": {"basePath": "/3/0",
+                                         "content": [{}]}}).encode())))
+        cmd = await cli.recv()                  # delivered as raw JSON
+        assert cmd.opt(C.OPT_CONTENT_FORMAT) is None
+        assert b"msgType" in cmd.payload
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_lwm2m_tlv_notify_types_via_observed_path():
+    """A TLV notify without ?path= types through the single
+    outstanding observe; with no context it surfaces as hex."""
+    async def main():
+        from emqx_tpu.gateway import lwm2m_tlv as TLV
+        app = BrokerApp()
+        gw = app.gateway.load(Lwm2mGateway(port=0))
+        await gw.start_listeners()
+        uplinks = []
+        app.hooks.add("message.publish",
+                      lambda m: uplinks.append((m.topic, m.payload)) or None,
+                      priority=-500)
+        cli = CoapClient(gw.port)
+        await cli.start()
+        cli.request(C.POST, "rd", payload=b"</3/0>", queries=["ep=n-ep"])
+        ack = await cli.recv()
+        reg_id = ack.opts(C.OPT_LOCATION_PATH)[1].decode()
+        from emqx_tpu.core.message import Message
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/n-ep/dn/cmd",
+            payload=json.dumps({"reqID": 3, "msgType": "observe",
+                                "data": {"path": "/3/0"}}).encode())))
+        await cli.recv()                        # the observe POST
+        body = TLV.tlv_encode([
+            {"kind": TLV.RESOURCE, "id": 9,
+             "value": TLV.encode_value(64, "Integer")}])
+        cli.request(C.POST, f"rd/{reg_id}/notify", payload=body,
+                    options=[(C.OPT_CONTENT_FORMAT,
+                              TLV.CONTENT_TLV.to_bytes(2, "big"))])
+        await cli.recv()
+        await asyncio.sleep(0.1)
+        note = json.loads(dict(uplinks)["lwm2m/n-ep/up/notify"])
+        assert note["payload"][0]["value"] == 64
+        assert note["payload"][0]["path"] == "/3/0/9"
+        await gw.stop_listeners()
+    run(main())

@@ -95,11 +95,13 @@ class TcpGwListener:
 
     def __init__(self, make_channel: Callable[[], GwChannel],
                  frame: GwFrame, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, tick_interval_s: float = 1.0) -> None:
         self.make_channel = make_channel
         self.frame = frame
         self.host, self.port = host, port
+        self.tick_interval_s = tick_interval_s
         self._server: Optional[asyncio.AbstractServer] = None
+        self._tick_task: Optional[asyncio.Task] = None
         self.connections: set[TcpGwConnection] = set()
 
     async def _on_connect(self, reader, writer) -> None:
@@ -112,12 +114,32 @@ class TcpGwListener:
             self.connections.discard(conn)
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(
             self._on_connect, self.host, self.port)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
+        # channel housekeeping (tx timeouts, retransmits) — the TCP
+        # transport needs the same periodic drive UdpGwListener has
+        self._tick_task = self._loop.create_task(self._tick_loop())
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_interval_s)
+            for conn in list(self.connections):
+                hk = getattr(conn.channel, "housekeep", None)
+                if hk is None:
+                    continue
+                try:
+                    frames = hk()
+                    if frames:
+                        conn.send_frames(frames)
+                except Exception:
+                    log.exception("gateway channel housekeep crashed")
 
     async def stop(self) -> None:
+        if self._tick_task is not None:
+            self._tick_task.cancel()
         for conn in list(self.connections):
             await conn.close("server_shutdown")
         if self._server is not None:

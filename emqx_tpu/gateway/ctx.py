@@ -93,6 +93,9 @@ class GwContext:
         self.app = app
         self.gwname = gwname
         self.mountpoint = mountpoint
+        # live sessions of THIS gateway (clientid → connected_at ms) —
+        # backs the per-gateway clients REST surface (emqx_gateway_api)
+        self.sessions: dict[str, int] = {}
 
     # -- topic namespace -----------------------------------------------------
 
@@ -113,6 +116,8 @@ class GwContext:
         if old is not None and old is not channel:
             old.discard()
         self.app.cm.register_channel(clientid, channel)
+        import time as _t
+        self.sessions[clientid] = int(_t.time() * 1000)
         self.app.hooks.run("client.connected",
                            ({"clientid": clientid, "gateway": self.gwname},))
 
@@ -120,6 +125,7 @@ class GwContext:
                       reason: str = "closed") -> None:
         self.app.broker.subscriber_down(clientid)
         self.app.cm.unregister_channel(clientid, channel)
+        self.sessions.pop(clientid, None)
         self.app.hooks.run(
             "client.disconnected",
             ({"clientid": clientid, "gateway": self.gwname}, reason))
@@ -196,6 +202,7 @@ class GatewayManager:
     def __init__(self, app) -> None:
         self.app = app
         self.gateways: dict[str, GatewayImpl] = {}
+        self.contexts: dict[str, GwContext] = {}
         self._unload_tasks: set = set()   # keep refs: loop holds weak refs
 
     def load(self, impl: GatewayImpl, conf: Optional[dict] = None
@@ -207,10 +214,12 @@ class GatewayManager:
                         mountpoint=conf.get("mountpoint", ""))
         impl.on_gateway_load(ctx, conf)
         self.gateways[impl.name] = impl
+        self.contexts[impl.name] = ctx
         return impl
 
     def unload(self, name: str) -> bool:
         impl = self.gateways.pop(name, None)
+        self.contexts.pop(name, None)
         if impl is None:
             return False
         # an unloaded gateway must stop accepting traffic: tear down its
@@ -226,7 +235,17 @@ class GatewayManager:
             task = asyncio.get_running_loop().create_task(teardown())
             self._unload_tasks.add(task)
             task.add_done_callback(self._unload_tasks.discard)
+            return True
         except RuntimeError:
+            pass
+        # off-loop caller (REST handler thread): the listener's sockets
+        # belong to ITS loop — teardown must run there, not in a fresh
+        # asyncio.run() loop (cross-loop await fails)
+        target = getattr(getattr(impl, "listener", None), "_loop", None)
+        if target is not None and target.is_running():
+            asyncio.run_coroutine_threadsafe(
+                teardown(), target).result(timeout=10)
+        else:
             asyncio.run(teardown())
         return True
 
@@ -234,6 +253,25 @@ class GatewayManager:
         return self.gateways.get(name)
 
     def list(self) -> list[dict]:
-        return [
-            {"name": n, "status": "running"} for n in self.gateways
-        ]
+        out = []
+        # snapshot: called from the REST handler THREAD while the event
+        # loop mutates the registries
+        for n, impl in list(self.gateways.items()):
+            ctx = self.contexts.get(n)
+            out.append({
+                "name": n, "status": "running",
+                "port": getattr(impl, "port", None),
+                "mountpoint": getattr(ctx, "mountpoint", ""),
+                "current_connections": len(ctx.sessions) if ctx else 0,
+            })
+        return out
+
+    def clients(self, name: str) -> Optional[list[dict]]:
+        """Per-gateway connected clients (emqx_gateway_api_clients)."""
+        ctx = self.contexts.get(name)
+        if ctx is None:
+            return None
+        snapshot = dict(ctx.sessions)       # REST thread vs event loop
+        return [{"clientid": cid, "connected_at": at,
+                 "gateway": name}
+                for cid, at in sorted(snapshot.items())]

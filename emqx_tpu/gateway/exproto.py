@@ -158,6 +158,7 @@ class Channel(GwChannel):
             self._queue.put(None)     # worker closes the RPC socket
             if self.clientid is not None:
                 self.ctx.close_session(self.clientid, self, reason)
+            self.request_close()      # admin kick drops the transport
 
 
 class ExprotoGateway(GatewayImpl):

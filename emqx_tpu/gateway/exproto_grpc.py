@@ -297,6 +297,7 @@ class GrpcChannel(GwChannel):
                               {"conn": self.conn_ref, "reason": reason})
             if self.clientid is not None:
                 self.ctx.close_session(self.clientid, self, reason)
+            self.request_close()      # admin kick drops the transport
 
 
 class GrpcExprotoGateway(GatewayImpl):

@@ -74,6 +74,11 @@ class Channel(GwChannel):
         if m.type in (ACK, RST):
             settled = self.tm.on_ack(m.mid)          # settles downlink CONs
             ctx = self._cmd_ctx.pop(m.mid, {})
+            # a REFUSED observe must not poison the single-observation
+            # typing heuristic for TLV notifies
+            if (ctx.get("msgType") == "observe" and m.code >= 0x80
+                    and ctx.get("path")):
+                self._observed.discard(str(ctx["path"]))
             if settled and m.type == ACK and m.code != EMPTY:
                 # piggybacked device response to a downlink command
                 # (read value / write result) — surface it as the uplink

@@ -251,13 +251,23 @@ def path_values_to_tlv(base_path: str, values: list[dict]) -> bytes:
         out = []
         for rid, items in by_rid.items():
             rtype = _rtype(oid, rid)
-            if any(sub is not None for sub, _v in items):
+            subs = [sub for sub, _v in items]
+            if len(set(subs)) != len(subs):
+                raise TlvError(
+                    f"duplicate write target resource {rid}")
+            if any(s is not None for s in subs):
+                if any(s is None for s in subs):
+                    # a whole-resource row mixed with res-instance rows
+                    # has no defined TLV encoding
+                    raise TlvError(
+                        f"resource {rid}: mixed instance and "
+                        "whole-resource rows")
                 out.append({"kind": MULTI_RES, "id": rid, "children": [
-                    {"kind": RES_INSTANCE, "id": sub or 0,
+                    {"kind": RES_INSTANCE, "id": sub,
                      "value": encode_value(v, rtype)}
                     for sub, v in items]})
             else:
-                ((_s, v),) = items[-1:]
+                ((_s, v),) = items
                 out.append({"kind": RESOURCE, "id": rid,
                             "value": encode_value(v, rtype)})
         return out

@@ -105,11 +105,19 @@ class Channel(GwChannel):
         self.clientid: Optional[str] = None
         self.subs: dict[str, str] = {}       # sub id -> destination
         self._msg_seq = 0
+        # STOMP transactions (emqx_stomp_channel.erl:453,547): BEGIN
+        # opens a buffer; SEND/ACK/NACK carrying `transaction` defer
+        # into it; COMMIT replays in order; ABORT (or the timeout in
+        # housekeep) discards. txid → (started_at_monotonic, [thunks])
+        self._tx: dict[str, tuple[float, list]] = {}
+        self.tx_timeout_s = 60.0
 
     # -- inbound -------------------------------------------------------------
 
     def handle_in(self, frame: StompFrame) -> list[StompFrame]:
         cmd = frame.command.upper()
+        if self.conn_state in ("disconnected", "terminated"):
+            return []        # kicked/closed: drop, never publish
         if self.conn_state == "idle" and cmd not in ("CONNECT", "STOMP"):
             return [self._error("Not connected")]
         try:
@@ -152,12 +160,62 @@ class Channel(GwChannel):
         dest = frame.headers.get("destination")
         if not dest:
             return [self._error("Missing destination")]
-        self.ctx.publish(self.clientid, dest, frame.body,
-                         qos=0, props={
-                             k: v for k, v in frame.headers.items()
-                             if k not in ("destination", "receipt",
-                                          "content-length", "transaction")
-                         })
+
+        def do(dest=dest, body=frame.body, headers=dict(frame.headers)):
+            self.ctx.publish(self.clientid, dest, body,
+                             qos=0, props={
+                                 k: v for k, v in headers.items()
+                                 if k not in ("destination", "receipt",
+                                              "content-length",
+                                              "transaction")
+                             })
+        return self._maybe_defer(frame, do)
+
+    # -- transactions --------------------------------------------------------
+
+    def _maybe_defer(self, frame: StompFrame, thunk) -> list[StompFrame]:
+        txid = frame.headers.get("transaction")
+        if txid is None:
+            thunk()
+            return []
+        tx = self._tx.get(txid)
+        if tx is None:
+            return [self._error(f"Transaction {txid} not found")]
+        tx[1].append(thunk)
+        return []
+
+    def _in_begin(self, frame: StompFrame) -> list[StompFrame]:
+        import time
+        txid = frame.headers.get("transaction")
+        if not txid:
+            return [self._error("Missing transaction")]
+        if txid in self._tx:
+            return [self._error(f"Transaction {txid} already started")]
+        self._tx[txid] = (time.monotonic(), [])
+        return []
+
+    def _in_commit(self, frame: StompFrame) -> list[StompFrame]:
+        txid = frame.headers.get("transaction")
+        tx = self._tx.pop(txid, None)
+        if tx is None:
+            return [self._error(f"Transaction {txid} not found")]
+        for thunk in tx[1]:
+            thunk()
+        return []
+
+    def _in_abort(self, frame: StompFrame) -> list[StompFrame]:
+        txid = frame.headers.get("transaction")
+        if self._tx.pop(txid, None) is None:
+            return [self._error(f"Transaction {txid} not found")]
+        return []
+
+    def housekeep(self) -> list[StompFrame]:
+        import time
+        now = time.monotonic()
+        dead = [txid for txid, (at, _ops) in self._tx.items()
+                if now - at > self.tx_timeout_s]
+        for txid in dead:
+            del self._tx[txid]
         return []
 
     def _in_subscribe(self, frame: StompFrame) -> list[StompFrame]:
@@ -179,10 +237,12 @@ class Channel(GwChannel):
         return []
 
     def _in_ack(self, frame: StompFrame) -> list[StompFrame]:
-        return []        # QoS0 bridge: ack is a no-op (reference parity)
+        # QoS0 bridge: ack itself is a no-op (reference parity) — but a
+        # transactional ack must still validate its transaction
+        return self._maybe_defer(frame, lambda: None)
 
     def _in_nack(self, frame: StompFrame) -> list[StompFrame]:
-        return []
+        return self._maybe_defer(frame, lambda: None)
 
     def _in_disconnect(self, frame: StompFrame) -> list[StompFrame]:
         self.conn_state = "disconnected"
@@ -209,6 +269,10 @@ class Channel(GwChannel):
         if self.conn_state == "connected":
             self.conn_state = "disconnected"
             self.ctx.close_session(self.clientid, self, reason)
+            self._tx.clear()
+            # an admin kick must actually drop the socket, not leave it
+            # open until the client's next frame
+            self.request_close()
 
     def _error(self, text: str) -> StompFrame:
         self.conn_state = "disconnected"

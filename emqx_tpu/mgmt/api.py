@@ -127,7 +127,12 @@ class ManagementApi:
     def handle(self, method: str, path: str, query: dict,
                body: Any, authed: bool) -> tuple[int, Any]:
         if path == "/api/v5/login" and method == "POST":
-            return self._login(body or {})
+            # body may be raw bytes when the client skipped the JSON
+            # content-type — a malformed login is a 400, not a crash
+            if not isinstance(body, dict):
+                return 400, {"code": "BAD_REQUEST",
+                             "message": "JSON body required"}
+            return self._login(body)
         if path == "/api-docs.json" and method == "GET":
             return 200, self._docs()
         if not authed:
@@ -233,6 +238,15 @@ class ManagementApi:
         r("DELETE", "/api/v5/plugins/{name}", self.h_plugin_delete)
         r("GET", "/api/v5/monitor", self.h_monitor)
         r("GET", "/api/v5/monitor_current", self.h_monitor_current)
+        # gateways (emqx_gateway_api / _api_clients): list, detail,
+        # per-gateway clients + kick, unload
+        r("GET", "/api/v5/gateways", self.h_gateways)
+        r("GET", "/api/v5/gateways/{name}", self.h_gateway)
+        r("DELETE", "/api/v5/gateways/{name}", self.h_gateway_unload)
+        r("GET", "/api/v5/gateways/{name}/clients",
+          self.h_gateway_clients)
+        r("DELETE", "/api/v5/gateways/{name}/clients/{clientid}",
+          self.h_gateway_kick)
 
     @staticmethod
     def _page(items: list, query: dict) -> dict:
@@ -658,6 +672,38 @@ class ManagementApi:
 
     def h_monitor_current(self, query, body):
         return self.app.monitor.current()
+
+    # -- gateways (emqx_gateway_api / emqx_gateway_api_clients) -------------
+
+    def h_gateways(self, query, body):
+        return self._page(self.app.gateway.list(), query)
+
+    def h_gateway(self, query, body, name):
+        for g in self.app.gateway.list():
+            if g["name"] == name:
+                return g
+        raise ApiError(404, "GATEWAY_NOT_FOUND")
+
+    def h_gateway_unload(self, query, body, name):
+        if not self.app.gateway.unload(name):
+            raise ApiError(404, "GATEWAY_NOT_FOUND")
+        return None
+
+    def h_gateway_clients(self, query, body, name):
+        clients = self.app.gateway.clients(name)
+        if clients is None:
+            raise ApiError(404, "GATEWAY_NOT_FOUND")
+        return self._page(clients, query)
+
+    def h_gateway_kick(self, query, body, name, clientid):
+        ctx = self.app.gateway.contexts.get(name)
+        if ctx is None:
+            raise ApiError(404, "GATEWAY_NOT_FOUND")
+        if clientid not in ctx.sessions:
+            raise ApiError(404, "CLIENTID_NOT_FOUND")
+        if not self.app.cm.kick(clientid):
+            raise ApiError(404, "CLIENTID_NOT_FOUND")
+        return None
 
     # -- http server --------------------------------------------------------
 

@@ -487,8 +487,9 @@ def test_gateway_manager_load_unload_and_mountpoint():
         gw = app.gateway.load(ST.StompGateway(port=0),
                               {"mountpoint": "stomp/"})
         await gw.start_listeners()
-        assert app.gateway.list() == [{"name": "stomp",
-                                       "status": "running"}]
+        (row,) = app.gateway.list()
+        assert row["name"] == "stomp" and row["status"] == "running"
+        assert row["mountpoint"] == "stomp/" and row["port"] == gw.port
         c = StompClient(gw.port)
         await c.connect()
         await c.send("CONNECT", {"accept-version": "1.2"})
@@ -1193,3 +1194,147 @@ def test_lwm2m_tlv_notify_types_via_observed_path():
         assert note["payload"][0]["path"] == "/3/0/9"
         await gw.stop_listeners()
     run(main())
+
+
+# -- stomp transactions (emqx_stomp_channel BEGIN/COMMIT/ABORT) ----------------
+
+def test_stomp_transactions_commit_abort_and_errors():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        from emqx_tpu.broker.server import BrokerServer
+        srv = BrokerServer(port=0, app=app)
+        await srv.start()
+        mq = MqttClient(port=srv.port, clientid="m1")
+        await mq.connect()
+        await mq.subscribe("tx/#")
+
+        c = StompClient(gw.port)
+        await c.connect()
+        await c.send("CONNECT", {"accept-version": "1.2"})
+        assert (await c.recv()).command == "CONNECTED"
+
+        # deferred SENDs publish only on COMMIT, in order
+        await c.send("BEGIN", {"transaction": "tx1"})
+        await c.send("SEND", {"destination": "tx/a",
+                              "transaction": "tx1"}, b"first")
+        await c.send("SEND", {"destination": "tx/b",
+                              "transaction": "tx1"}, b"second")
+        await asyncio.sleep(0.2)
+        assert mq.messages.empty(), "tx SEND leaked before COMMIT"
+        await c.send("COMMIT", {"transaction": "tx1", "receipt": "r1"})
+        rec = await c.recv()
+        assert rec.command == "RECEIPT"
+        m1, m2 = await mq.recv(), await mq.recv()
+        assert (m1.topic, m1.payload) == ("tx/a", b"first")
+        assert (m2.topic, m2.payload) == ("tx/b", b"second")
+
+        # ABORT discards
+        await c.send("BEGIN", {"transaction": "tx2"})
+        await c.send("SEND", {"destination": "tx/c",
+                              "transaction": "tx2"}, b"dropped")
+        await c.send("ABORT", {"transaction": "tx2", "receipt": "r2"})
+        assert (await c.recv()).command == "RECEIPT"
+        await asyncio.sleep(0.2)
+        assert mq.messages.empty()
+
+        # unknown transaction on SEND → ERROR
+        await c.send("SEND", {"destination": "tx/x",
+                              "transaction": "nope"}, b"x")
+        assert (await c.recv()).command == "ERROR"
+        await mq.close()
+        await gw.stop_listeners()
+        await srv.stop()
+    run(main())
+
+
+def test_stomp_transaction_double_begin_and_timeout():
+    from emqx_tpu.gateway.ctx import GwContext
+    app = BrokerApp()
+    ch = ST.Channel(GwContext(app, "stomp"))
+    ch.conn_state = "connected"
+    ch.clientid = "c1"
+    assert ch.handle_in(ST.StompFrame("BEGIN", {"transaction": "t"})) == []
+    out = ch.handle_in(ST.StompFrame("BEGIN", {"transaction": "t"}))
+    assert out and out[0].command == "ERROR"
+    # restart the channel state for timeout path
+    ch2 = ST.Channel(GwContext(app, "stomp"))
+    ch2.conn_state = "connected"
+    ch2.clientid = "c2"
+    ch2.tx_timeout_s = 0.0
+    ch2.handle_in(ST.StompFrame("BEGIN", {"transaction": "t2"}))
+    ch2.housekeep()                       # expires immediately
+    out = ch2.handle_in(ST.StompFrame(
+        "COMMIT", {"transaction": "t2"}))
+    assert out and out[0].command == "ERROR"
+
+
+def test_stomp_kicked_client_cannot_publish_and_socket_drops():
+    """An admin kick closes the transport and the channel drops any
+    frame that still arrives — no post-kick publish."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        from emqx_tpu.broker.server import BrokerServer
+        srv = BrokerServer(port=0, app=app)
+        await srv.start()
+        mq = MqttClient(port=srv.port, clientid="watch")
+        await mq.connect()
+        await mq.subscribe("#")
+        c = StompClient(gw.port)
+        await c.connect()
+        await c.send("CONNECT", {"accept-version": "1.2",
+                                 "client-id": "victim"})
+        await c.recv()
+        assert app.cm.kick("victim")
+        # the transport drops; a racing SEND must not publish
+        try:
+            await c.send("SEND", {"destination": "post/kick"}, b"leak")
+        except ConnectionError:
+            pass
+        await asyncio.sleep(0.3)
+        assert mq.messages.empty(), "kicked client published"
+        try:
+            data = await asyncio.wait_for(c.r.read(64), 5)
+            assert data == b"", "socket not closed by kick"
+        except ConnectionResetError:
+            pass                      # RST is also a closed transport
+        await mq.close()
+        await gw.stop_listeners()
+        await srv.stop()
+    run(main())
+
+
+def test_stomp_tx_swept_by_tcp_listener_tick():
+    """The TCP listener's housekeeping tick expires abandoned
+    transactions (they are not dead code on the TCP transport)."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        gw.listener.tick_interval_s = 0.05
+        c = StompClient(gw.port)
+        await c.connect()
+        await c.send("CONNECT", {"accept-version": "1.2"})
+        await c.recv()
+        await c.send("BEGIN", {"transaction": "stale"})
+        await asyncio.sleep(0.1)
+        (conn,) = gw.listener.connections
+        conn.channel.tx_timeout_s = 0.0
+        await asyncio.sleep(0.3)            # tick sweeps it
+        assert conn.channel._tx == {}
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_lwm2m_tlv_write_duplicate_and_mixed_rows_rejected():
+    from emqx_tpu.gateway import lwm2m_tlv as TLV
+    import pytest as _p
+    with _p.raises(TLV.TlvError):
+        TLV.path_values_to_tlv("/3/0", [{"path": "13", "value": 1},
+                                        {"path": "13", "value": 2}])
+    with _p.raises(TLV.TlvError):
+        TLV.path_values_to_tlv("/3/0", [{"path": "/3/0/6/0", "value": 1},
+                                        {"path": "/3/0/6", "value": 9}])

@@ -274,3 +274,50 @@ def test_mqtt_module_endpoints(api):
     st, data = _req(api, "PUT", "/api/v5/mqtt/auto_subscribe",
                     [{"topic": "c/%c", "qos": 1}], token=tok)
     assert st == 200 and data[0]["topic"] == "c/%c"
+
+
+def test_gateway_rest_surface(api):
+    """emqx_gateway_api: list/detail/clients/kick/unload over REST."""
+    import asyncio
+
+    from emqx_tpu.gateway import stomp as ST
+
+    async def main():
+        gw = api.app.gateway.load(ST.StompGateway(port=0),
+                                  {"mountpoint": "stomp/"})
+        await gw.start_listeners()
+        # a live stomp client session
+        r, w = await asyncio.open_connection("127.0.0.1", gw.port)
+        f = ST.Frame()
+        w.write(f.serialize(ST.StompFrame(
+            "CONNECT", {"accept-version": "1.2", "client-id": "gw-c1"})))
+        await asyncio.wait_for(r.read(256), 5)
+
+        st, gws = await asyncio.to_thread(_req, api, "GET", "/api/v5/gateways")
+        assert st == 200
+        (row,) = [g for g in gws["data"] if g["name"] == "stomp"]
+        assert row["current_connections"] == 1
+        assert row["mountpoint"] == "stomp/"
+
+        st, one = await asyncio.to_thread(_req, api, "GET", "/api/v5/gateways/stomp")
+        assert st == 200 and one["name"] == "stomp"
+        st, _ = await asyncio.to_thread(_req, api, "GET", "/api/v5/gateways/nope")
+        assert st == 404
+
+        st, clients = await asyncio.to_thread(_req, api, "GET", "/api/v5/gateways/stomp/clients")
+        assert st == 200
+        assert clients["data"][0]["clientid"] == "gw-c1"
+
+        st, _ = await asyncio.to_thread(_req, api, "DELETE",
+                     "/api/v5/gateways/stomp/clients/gw-c1")
+        assert st in (200, 204)
+        st, clients = await asyncio.to_thread(_req, api, "GET", "/api/v5/gateways/stomp/clients")
+        assert clients["data"] == []
+
+        st, _ = await asyncio.to_thread(_req, api, "DELETE", "/api/v5/gateways/stomp")
+        assert st in (200, 204)
+        st, _ = await asyncio.to_thread(_req, api, "GET", "/api/v5/gateways/stomp")
+        assert st == 404
+        w.close()
+
+    asyncio.run(main())

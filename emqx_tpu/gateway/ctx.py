@@ -218,8 +218,7 @@ class GatewayManager:
         return impl
 
     def unload(self, name: str) -> bool:
-        impl = self.gateways.pop(name, None)
-        self.contexts.pop(name, None)
+        impl = self.gateways.get(name)
         if impl is None:
             return False
         # an unloaded gateway must stop accepting traffic: tear down its
@@ -232,21 +231,29 @@ class GatewayManager:
             impl.on_gateway_unload()
 
         try:
-            task = asyncio.get_running_loop().create_task(teardown())
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            self.gateways.pop(name, None)
+            self.contexts.pop(name, None)
+            task = loop.create_task(teardown())
             self._unload_tasks.add(task)
             task.add_done_callback(self._unload_tasks.discard)
             return True
-        except RuntimeError:
-            pass
         # off-loop caller (REST handler thread): the listener's sockets
         # belong to ITS loop — teardown must run there, not in a fresh
-        # asyncio.run() loop (cross-loop await fails)
+        # asyncio.run() loop (cross-loop await fails). Deregister only
+        # AFTER teardown succeeds: a timeout must not leave a live
+        # listener invisible to (and un-unloadable by) the API.
         target = getattr(getattr(impl, "listener", None), "_loop", None)
         if target is not None and target.is_running():
             asyncio.run_coroutine_threadsafe(
                 teardown(), target).result(timeout=10)
         else:
             asyncio.run(teardown())
+        self.gateways.pop(name, None)
+        self.contexts.pop(name, None)
         return True
 
     def get(self, name: str) -> Optional[GatewayImpl]:

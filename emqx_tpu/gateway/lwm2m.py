@@ -74,10 +74,11 @@ class Channel(GwChannel):
         if m.type in (ACK, RST):
             settled = self.tm.on_ack(m.mid)          # settles downlink CONs
             ctx = self._cmd_ctx.pop(m.mid, {})
-            # a REFUSED observe must not poison the single-observation
-            # typing heuristic for TLV notifies
-            if (ctx.get("msgType") == "observe" and m.code >= 0x80
-                    and ctx.get("path")):
+            # a REFUSED observe (error ACK, or RST — which carries code
+            # EMPTY) must not poison the single-observation typing
+            # heuristic for TLV notifies
+            if (ctx.get("msgType") == "observe" and ctx.get("path")
+                    and (m.code >= 0x80 or m.type == RST)):
                 self._observed.discard(str(ctx["path"]))
             if settled and m.type == ACK and m.code != EMPTY:
                 # piggybacked device response to a downlink command
@@ -112,6 +113,8 @@ class Channel(GwChannel):
             # an unresponsive device surfaces as a timeout uplink rather
             # than silence (the reference's command timeout response)
             ctx = self._cmd_ctx.pop(mid, {})
+            if ctx.get("msgType") == "observe" and ctx.get("path"):
+                self._observed.discard(str(ctx["path"]))   # never ACKed
             self._uplink("response", {
                 "ep": self.endpoint,
                 "reqID": ctx.get("reqID"),

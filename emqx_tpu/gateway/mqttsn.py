@@ -226,6 +226,7 @@ class Channel(GwChannel):
             if not self.ctx.authenticate(self.clientid):
                 return [SnMessage(CONNACK, rc=RC_NOT_SUPPORTED)]
             self.ctx.open_session(self.clientid, self)
+            self._session_open = True
             self.conn_state = "connected"
             return [SnMessage(CONNACK, rc=RC_ACCEPTED)]
         if t == PUBLISH and qos_of(m.flags) == -1:
@@ -332,9 +333,13 @@ class Channel(GwChannel):
         return out
 
     def terminate(self, reason: str) -> None:
-        if self.conn_state == "connected":
-            self.conn_state = "disconnected"
+        # key on an open session, not conn_state: a device-initiated
+        # DISCONNECT flips conn_state before the UDP listener calls
+        # terminate, which would leak the session registration
+        if getattr(self, "_session_open", False):
+            self._session_open = False
             self.ctx.close_session(self.clientid, self, reason)
+        self.conn_state = "disconnected"
 
 
 class MqttsnGateway(GatewayImpl):

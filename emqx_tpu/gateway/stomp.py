@@ -111,6 +111,9 @@ class Channel(GwChannel):
         # housekeep) discards. txid → (started_at_monotonic, [thunks])
         self._tx: dict[str, tuple[float, list]] = {}
         self.tx_timeout_s = 60.0
+        self.max_tx = 16                 # concurrent txs per channel
+        self.max_tx_ops = 1000           # buffered frames per tx
+        self._session_open = False
 
     # -- inbound -------------------------------------------------------------
 
@@ -128,7 +131,11 @@ class Channel(GwChannel):
         except Exception as e:
             return [self._error(str(e))]
         receipt = frame.headers.get("receipt")
-        if receipt and cmd != "CONNECT":
+        if receipt and cmd != "CONNECT" and not any(
+                f.command == "ERROR" for f in out):
+            # STOMP: a failed frame answers ERROR, never RECEIPT — a
+            # RECEIPT after ERROR would tell the client its COMMIT of
+            # an expired transaction succeeded
             out.append(StompFrame("RECEIPT", {"receipt-id": receipt}))
         return out
 
@@ -148,6 +155,7 @@ class Channel(GwChannel):
                 password=frame.headers.get("passcode")):
             return [self._error("Login failed")]
         self.ctx.open_session(self.clientid, self)
+        self._session_open = True
         self.conn_state = "connected"
         return [StompFrame("CONNECTED", {
             "version": version, "server": "emqx-tpu",
@@ -181,6 +189,9 @@ class Channel(GwChannel):
         tx = self._tx.get(txid)
         if tx is None:
             return [self._error(f"Transaction {txid} not found")]
+        if len(tx[1]) >= self.max_tx_ops:   # bound buffered bodies
+            self._tx.pop(txid, None)
+            return [self._error(f"Transaction {txid} too large")]
         tx[1].append(thunk)
         return []
 
@@ -191,6 +202,8 @@ class Channel(GwChannel):
             return [self._error("Missing transaction")]
         if txid in self._tx:
             return [self._error(f"Transaction {txid} already started")]
+        if len(self._tx) >= self.max_tx:     # bound tx count
+            return [self._error("Too many open transactions")]
         self._tx[txid] = (time.monotonic(), [])
         return []
 
@@ -266,9 +279,15 @@ class Channel(GwChannel):
         return out
 
     def terminate(self, reason: str) -> None:
-        if self.conn_state == "connected":
-            self.conn_state = "disconnected"
+        # session cleanup keys on _session_open, NOT conn_state: a
+        # graceful DISCONNECT (or an ERROR) flips conn_state before the
+        # transport teardown reaches here, and gating on it would leak
+        # ghost entries into ctx.sessions / the gateway REST surface
+        if self._session_open:
+            self._session_open = False
             self.ctx.close_session(self.clientid, self, reason)
+        if self.conn_state != "terminated":
+            self.conn_state = "terminated"
             self._tx.clear()
             # an admin kick must actually drop the socket, not leave it
             # open until the client's next frame

@@ -11,6 +11,8 @@ reference's ctl RPCs into the live node map to HTTP here):
     emqx_ctl banned list | add <kind> <who> | del <kind> <who>
     emqx_ctl rules list | show <id> | delete <id>
     emqx_ctl retainer topics | clean <topic>
+    emqx_ctl gateway list | show <name> | clients <name> |
+             kick <name> <clientid> | unload <name>
 
 Auth via --user/--pass (dashboard login) or EMQX_API_KEY/EMQX_API_SECRET
 (basic auth).
@@ -131,6 +133,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("action", choices=["topics", "clean"])
     p.add_argument("topic", nargs="?")
 
+    # emqx_gateway_cli: gateway list | show <name> | clients <name> |
+    # kick <name> <clientid> | unload <name>
+    p = sub.add_parser("gateway")
+    p.add_argument("action",
+                   choices=["list", "show", "clients", "kick", "unload"])
+    p.add_argument("name", nargs="?")
+    p.add_argument("clientid", nargs="?")
+
     args = ap.parse_args(argv)
     ctl = CtlClient(args.url, args.user, args.password)
 
@@ -184,6 +194,21 @@ def main(argv: Optional[list[str]] = None) -> int:
             ctl.request("DELETE",
                         f"/api/v5/retainer/message/{args.topic}")
             print(f"cleaned {args.topic}")
+    elif args.verb == "gateway":
+        if args.action == "list":
+            _print(ctl.request("GET", "/api/v5/gateways"))
+        elif args.action == "show":
+            _print(ctl.request("GET", f"/api/v5/gateways/{args.name}"))
+        elif args.action == "clients":
+            _print(ctl.request(
+                "GET", f"/api/v5/gateways/{args.name}/clients"))
+        elif args.action == "kick":
+            ctl.request("DELETE", f"/api/v5/gateways/{args.name}"
+                                  f"/clients/{args.clientid}")
+            print(f"kicked {args.clientid} from {args.name}")
+        else:
+            ctl.request("DELETE", f"/api/v5/gateways/{args.name}")
+            print(f"unloaded {args.name}")
     return 0
 
 

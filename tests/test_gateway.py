@@ -1338,3 +1338,39 @@ def test_lwm2m_tlv_write_duplicate_and_mixed_rows_rejected():
     with _p.raises(TLV.TlvError):
         TLV.path_values_to_tlv("/3/0", [{"path": "/3/0/6/0", "value": 1},
                                         {"path": "/3/0/6", "value": 9}])
+
+
+def test_stomp_disconnect_clears_gateway_session():
+    """Graceful DISCONNECT (and ERROR teardown) must drop the session
+    from ctx.sessions — no ghost clients in the REST surface."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        ctx = app.gateway.contexts["stomp"]
+        c = StompClient(gw.port)
+        await c.connect()
+        await c.send("CONNECT", {"accept-version": "1.2",
+                                 "client-id": "ghost?"})
+        await c.recv()
+        assert "ghost?" in ctx.sessions
+        await c.send("DISCONNECT", {"receipt": "bye"})
+        await c.recv()
+        await asyncio.sleep(0.3)
+        assert "ghost?" not in ctx.sessions
+        assert app.cm.lookup_channel("ghost?") is None
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_stomp_error_never_carries_receipt():
+    """A failed frame with a receipt header answers ERROR only — a
+    RECEIPT would claim an expired/bogus COMMIT succeeded."""
+    from emqx_tpu.gateway.ctx import GwContext
+    app = BrokerApp()
+    ch = ST.Channel(GwContext(app, "stomp"))
+    ch.conn_state = "connected"
+    ch.clientid = "c1"
+    out = ch.handle_in(ST.StompFrame(
+        "COMMIT", {"transaction": "nope", "receipt": "r9"}))
+    assert [f.command for f in out] == ["ERROR"]

@@ -321,3 +321,26 @@ def test_gateway_rest_surface(api):
         w.close()
 
     asyncio.run(main())
+
+
+def test_cli_gateway_verbs(api, capsys):
+    import asyncio
+
+    from emqx_tpu.gateway import stomp as ST
+
+    async def main():
+        gw = api.app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        url = f"http://127.0.0.1:{api.port}"
+        assert await asyncio.to_thread(
+            cli_main, ["--url", url, "gateway", "list"]) == 0
+        assert "stomp" in capsys.readouterr().out
+        assert await asyncio.to_thread(
+            cli_main, ["--url", url, "gateway", "show", "stomp"]) == 0
+        assert await asyncio.to_thread(
+            cli_main, ["--url", url, "gateway", "clients", "stomp"]) == 0
+        assert await asyncio.to_thread(
+            cli_main, ["--url", url, "gateway", "unload", "stomp"]) == 0
+        assert api.app.gateway.get("stomp") is None
+
+    asyncio.run(main())

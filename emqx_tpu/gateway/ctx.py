@@ -235,9 +235,26 @@ class GatewayManager:
         except RuntimeError:
             loop = None
         if loop is not None:
+            ctx = self.contexts.get(name)
             self.gateways.pop(name, None)
             self.contexts.pop(name, None)
-            task = loop.create_task(teardown())
+
+            async def guarded() -> None:
+                try:
+                    await teardown()
+                except Exception:
+                    # a failed teardown must not leave a LIVE listener
+                    # invisible to (and un-unloadable by) the API —
+                    # re-register so the operator can retry
+                    import logging
+                    logging.getLogger("emqx_tpu.gateway").exception(
+                        "gateway %s teardown failed; re-registered",
+                        name)
+                    self.gateways[name] = impl
+                    if ctx is not None:
+                        self.contexts[name] = ctx
+
+            task = loop.create_task(guarded())
             self._unload_tasks.add(task)
             task.add_done_callback(self._unload_tasks.discard)
             return True

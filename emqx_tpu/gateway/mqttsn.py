@@ -222,7 +222,15 @@ class Channel(GwChannel):
         if t == SEARCHGW:
             return [SnMessage(GWINFO, rc=1)]       # gw id 1
         if t == CONNECT:
-            self.clientid = m.clientid or f"sn-{id(self):x}"
+            new_cid = m.clientid or f"sn-{id(self):x}"
+            # a re-CONNECT under a different clientid must release the
+            # old registration first, or it leaks as a ghost session
+            if getattr(self, "_session_open", False) \
+                    and self.clientid != new_cid:
+                self._session_open = False
+                self.ctx.close_session(self.clientid, self,
+                                       "reconnected")
+            self.clientid = new_cid
             if not self.ctx.authenticate(self.clientid):
                 return [SnMessage(CONNACK, rc=RC_NOT_SUPPORTED)]
             self.ctx.open_session(self.clientid, self)

@@ -131,7 +131,7 @@ class Channel(GwChannel):
         except Exception as e:
             return [self._error(str(e))]
         receipt = frame.headers.get("receipt")
-        if receipt and cmd != "CONNECT" and not any(
+        if receipt and cmd not in ("CONNECT", "STOMP") and not any(
                 f.command == "ERROR" for f in out):
             # STOMP: a failed frame answers ERROR, never RECEIPT — a
             # RECEIPT after ERROR would tell the client its COMMIT of

@@ -36,6 +36,12 @@ from typing import Any, Callable, Optional
 from emqx_tpu.core.message import Message
 
 
+class HtmlPage(str):
+    """Marker: a handler EXPLICITLY returning HTML. The reply path
+    keys content-type on this type, never on body sniffing — a string
+    handler echoing user data must stay text/plain."""
+
+
 class ApiError(Exception):
     def __init__(self, status: int, code: str, message: str = "") -> None:
         super().__init__(message or code)
@@ -135,6 +141,12 @@ class ManagementApi:
             return self._login(body)
         if path == "/api-docs.json" and method == "GET":
             return 200, self._docs()
+        if path in ("/", "/dashboard") and method == "GET":
+            # minimal built-in status page (the reference ships a full
+            # Vue app from a separate repo; this keeps the dashboard
+            # surface self-contained: login + live monitor over the
+            # same REST API)
+            return 200, HtmlPage(_DASHBOARD_HTML)
         if not authed:
             return 401, {"code": "UNAUTHORIZED",
                          "message": "missing or bad credentials"}
@@ -738,7 +750,9 @@ class ManagementApi:
             def _reply(self, status: int, result: Any) -> None:
                 if isinstance(result, str):
                     data = result.encode()
-                    ctype = "text/plain; version=0.0.4"
+                    ctype = ("text/html; charset=utf-8"
+                             if isinstance(result, HtmlPage)
+                             else "text/plain; version=0.0.4")
                 elif result is None:
                     data = b""
                     ctype = "application/json"
@@ -782,3 +796,80 @@ class ManagementApi:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+# ---------------------------------------------------------------------------
+# built-in status page (served at / — the reference's dashboard is a
+# separate Vue application; this is the self-contained equivalent
+# surface: login + live broker stats over the same REST API)
+
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>emqx_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa}
+ h1{font-size:1.2rem} .err{color:#b00}
+ .grid{display:grid;grid-template-columns:repeat(auto-fill,minmax(220px,1fr));
+       gap:12px;margin-top:1rem}
+ .card{background:#fff;border:1px solid #ddd;border-radius:8px;
+       padding:12px 16px}
+ .card b{display:block;font-size:1.6rem;margin-top:4px}
+ .muted{color:#777;font-size:.85rem}
+ table{border-collapse:collapse;margin-top:1rem;background:#fff;width:100%}
+ td,th{border:1px solid #ddd;padding:6px 10px;font-size:.9rem;
+       text-align:left}
+ input,button{padding:6px 10px;font-size:1rem}
+</style></head><body>
+<h1>emqx_tpu &mdash; broker status</h1>
+<div id="login">
+ <input id="u" placeholder="username" value="admin">
+ <input id="p" placeholder="password" type="password" value="public">
+ <button onclick="login()">Login</button> <span id="msg" class="err"></span>
+</div>
+<div id="main" style="display:none">
+ <div class="grid" id="cards"></div>
+ <table id="clients"><tr><th>client</th><th>connected</th></tr></table>
+ <p class="muted">auto-refreshes every 2s &middot;
+    <a href="/api-docs.json">API docs</a></p>
+</div>
+<script>
+let tok=null;
+// every interpolated value passes through esc(): clientids are
+// ATTACKER-CONTROLLED (any connecting client picks one) and raw
+// innerHTML interpolation would be stored XSS in the admin session
+function esc(v){const d=document.createElement('div');
+  d.textContent=String(v??'');return d.innerHTML}
+async function login(){
+  const r=await fetch('/api/v5/login',{method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({username:u.value,password:p.value})});
+  if(!r.ok){msg.textContent='login failed';return}
+  tok=(await r.json()).token;
+  document.getElementById('login').style.display='none';
+  document.getElementById('main').style.display='';
+  tick();setInterval(tick,2000);
+}
+async function get(p){const r=await fetch(p,
+  {headers:{Authorization:'Bearer '+tok}});return r.json()}
+function card(k,v){return `<div class=card><span class=muted>${esc(k)}</span>`+
+  `<b>${esc(v)}</b></div>`}
+async function tick(){
+  const [st,stats,mon]=await Promise.all([
+    get('/api/v5/status'),get('/api/v5/stats'),
+    get('/api/v5/monitor_current')]);
+  const cards=document.getElementById('cards');
+  cards.innerHTML=
+    card('node',st.node??'-')+
+    card('uptime s',Math.round(st.uptime??0))+
+    card('connections',stats['connections.count']??0)+
+    card('subscriptions',stats['subscriptions.count']??0)+
+    card('topics',stats['topics.count']??0)+
+    card('msgs received',mon.received??0)+
+    card('msgs sent',mon.sent??0);
+  const cl=await get('/api/v5/clients');
+  const rows=(cl.data||[]).slice(0,50).map(c=>
+    `<tr><td>${esc(c.clientid)}</td><td>${esc(c.connected_at)}</td></tr>`);
+  document.getElementById('clients').innerHTML=
+    '<tr><th>client</th><th>connected</th></tr>'+rows.join('');
+}
+</script></body></html>
+"""

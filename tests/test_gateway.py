@@ -1374,3 +1374,31 @@ def test_stomp_error_never_carries_receipt():
     out = ch.handle_in(ST.StompFrame(
         "COMMIT", {"transaction": "nope", "receipt": "r9"}))
     assert [f.command for f in out] == ["ERROR"]
+
+
+def test_sn_reconnect_with_new_clientid_releases_old_session():
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(SN.MqttsnGateway(port=0))
+        await gw.start_listeners()
+        ctx = app.gateway.contexts["mqttsn"]
+        dev = SnClient(gw.port)
+        await dev.start()
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="old-id"))
+        await dev.recv()
+        assert "old-id" in ctx.sessions
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="new-id"))
+        await dev.recv()
+        assert "old-id" not in ctx.sessions      # no ghost
+        assert "new-id" in ctx.sessions
+        await gw.stop_listeners()
+    run(main())
+
+
+def test_stomp_verb_connect_alias_no_receipt():
+    from emqx_tpu.gateway.ctx import GwContext
+    app = BrokerApp()
+    ch = ST.Channel(GwContext(app, "stomp"))
+    out = ch.handle_in(ST.StompFrame(
+        "STOMP", {"accept-version": "1.2", "receipt": "r0"}))
+    assert [f.command for f in out] == ["CONNECTED"]

@@ -344,3 +344,23 @@ def test_cli_gateway_verbs(api, capsys):
         assert api.app.gateway.get("stomp") is None
 
     asyncio.run(main())
+
+
+def test_dashboard_page_served_and_escapes(api):
+    """The built-in status page serves as explicit text/html (marker
+    type, not body sniffing) and escapes interpolated values."""
+    import urllib.request
+    resp = urllib.request.urlopen(f"http://127.0.0.1:{api.port}/")
+    assert resp.headers["Content-Type"].startswith("text/html")
+    html = resp.read().decode()
+    assert "broker status" in html
+    # every dynamic interpolation routes through esc()
+    assert "esc(c.clientid)" in html and "esc(v)" in html
+    # plain-string handlers (prometheus) stay text/plain even though
+    # a crafted metric label could start with a doctype
+    tok = _token(api)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/api/v5/prometheus",
+        headers={"Authorization": f"Bearer {tok}"})
+    assert urllib.request.urlopen(req).headers[
+        "Content-Type"].startswith("text/plain")

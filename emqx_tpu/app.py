@@ -507,6 +507,13 @@ class BrokerApp:
             else:
                 retry = float(ar)
             app.exhook.enable_async(server, retry_interval_s=retry)
+        # structured console logging (emqx_logger_jsonfmt/textfmt +
+        # ?SLOG surface; log.console in emqx_conf_schema)
+        from emqx_tpu.observe.logfmt import setup_logging
+        setup_logging(level=conf.get("log.level"),
+                      formatter=conf.get("log.formatter"),
+                      to=conf.get("log.to"),
+                      file_path=conf.get("log.file"))
         # live-update seams: strategy + retainer limits apply immediately
         conf.add_listener(app._on_config_change)
         return app

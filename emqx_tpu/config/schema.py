@@ -304,6 +304,10 @@ def root_schema() -> Struct:
             "to": Field("enum", enum=["console", "file", "both"],
                         default="console"),
             "file": Field("string", default="log/emqx.log"),
+            # emqx_logger_jsonfmt vs textfmt (emqx_conf_schema
+            # log.console.formatter)
+            "formatter": Field("enum", enum=["text", "json"],
+                               default="text"),
         }),
         "prometheus": Struct({
             "enable": Field("bool", default=False),

@@ -247,6 +247,14 @@ class GatewayManager:
                     # invisible to (and un-unloadable by) the API —
                     # re-register so the operator can retry
                     import logging
+                    if name in self.gateways:
+                        # a NEW gateway was loaded under this name while
+                        # teardown ran — never clobber it with the
+                        # half-torn-down one
+                        logging.getLogger("emqx_tpu.gateway").exception(
+                            "gateway %s teardown failed (name since "
+                            "reused; old instance dropped)", name)
+                        return
                     logging.getLogger("emqx_tpu.gateway").exception(
                         "gateway %s teardown failed; re-registered",
                         name)

@@ -232,6 +232,11 @@ class Channel(GwChannel):
                                        "reconnected")
             self.clientid = new_cid
             if not self.ctx.authenticate(self.clientid):
+                # a rejected (re-)CONNECT must fully de-authenticate the
+                # channel: staying "connected" would let the next
+                # PUBLISH run as the DENIED identity (ban bypass)
+                self.conn_state = "idle"
+                self.clientid = None
                 return [SnMessage(CONNACK, rc=RC_NOT_SUPPORTED)]
             self.ctx.open_session(self.clientid, self)
             self._session_open = True

@@ -863,8 +863,8 @@ async function tick(){
     card('connections',stats['connections.count']??0)+
     card('subscriptions',stats['subscriptions.count']??0)+
     card('topics',stats['topics.count']??0)+
-    card('msgs received',mon.received??0)+
-    card('msgs sent',mon.sent??0);
+    card('msgs received',mon['messages.received']??0)+
+    card('msgs sent',mon['messages.sent']??0);
   const cl=await get('/api/v5/clients');
   const rows=(cl.data||[]).slice(0,50).map(c=>
     `<tr><td>${esc(c.clientid)}</td><td>${esc(c.connected_at)}</td></tr>`);

@@ -1402,3 +1402,25 @@ def test_stomp_verb_connect_alias_no_receipt():
     out = ch.handle_in(ST.StompFrame(
         "STOMP", {"accept-version": "1.2", "receipt": "r0"}))
     assert [f.command for f in out] == ["CONNECTED"]
+
+
+def test_sn_rejected_reconnect_deauthenticates():
+    """A re-CONNECT as a banned clientid must drop the channel back to
+    idle — no publishing as the denied identity."""
+    async def main():
+        app = BrokerApp()
+        app.access.banned.create("clientid", "banned-dev")
+        gw = app.gateway.load(SN.MqttsnGateway(port=0))
+        await gw.start_listeners()
+        ctx = app.gateway.contexts["mqttsn"]
+        dev = SnClient(gw.port)
+        await dev.start()
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="good-dev"))
+        assert (await dev.recv()).rc == SN.RC_ACCEPTED
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="banned-dev"))
+        assert (await dev.recv()).rc != SN.RC_ACCEPTED
+        (ch,) = gw.listener.channels.values()
+        assert ch.conn_state != "connected" and ch.clientid is None
+        assert "good-dev" not in ctx.sessions        # old one released
+        await gw.stop_listeners()
+    run(main())

@@ -198,3 +198,68 @@ def test_sysmon_watermarks_and_alarms():
     assert sm.tick(now=0.0) or True
     sm._last_check = 100.0
     assert not sm.tick(now=100.5)
+
+
+# -- structured logging (emqx_logger_jsonfmt/textfmt + ?SLOG) ------------------
+
+def test_logfmt_json_and_text():
+    import io
+    import json as _json
+    import logging
+
+    from emqx_tpu.observe.logfmt import setup_logging, slog
+
+    buf = io.StringIO()
+    setup_logging(level="info", formatter="json", stream=buf,
+                  logger_name="emqx_tpu.testlog")
+    slog("warning", "client kicked", logger="emqx_tpu.testlog.cm",
+         clientid="c-1", topic="t/1")
+    rec = _json.loads(buf.getvalue())
+    assert rec["level"] == "warning" and rec["msg"] == "client kicked"
+    assert rec["clientid"] == "c-1" and rec["topic"] == "t/1"
+    assert rec["logger"] == "emqx_tpu.testlog.cm"
+
+    buf2 = io.StringIO()
+    setup_logging(level="debug", formatter="text", stream=buf2,
+                  logger_name="emqx_tpu.testlog")
+    slog("info", "published", logger="emqx_tpu.testlog", qos=1)
+    line = buf2.getvalue()
+    assert "[info] published" in line and "qos: 1" in line
+    # below-level records are filtered
+    buf2.truncate(0), buf2.seek(0)
+    logging.getLogger("emqx_tpu.testlog").setLevel(logging.WARNING)
+    slog("debug", "noise", logger="emqx_tpu.testlog")
+    assert buf2.getvalue() == ""
+    # exceptions serialize in both formats
+    buf3 = io.StringIO()
+    setup_logging(level="info", formatter="json", stream=buf3,
+                  logger_name="emqx_tpu.testlog")
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        logging.getLogger("emqx_tpu.testlog").exception("crashed")
+    assert "boom" in _json.loads(buf3.getvalue())["exception"]
+
+
+def test_logfmt_config_wiring():
+    from emqx_tpu.config.config import Config
+    conf = Config()
+    conf.init_load('log { level = "info", formatter = "json" }')
+    assert conf.get("log.formatter") == "json"
+
+
+def test_logfmt_file_handler(tmp_path):
+    import json as _json
+
+    from emqx_tpu.observe.logfmt import setup_logging, slog
+    f = tmp_path / "sub" / "emqx.log"
+    setup_logging(level="info", formatter="json", to="file",
+                  file_path=str(f), logger_name="emqx_tpu.filelog")
+    slog("info", "to disk", logger="emqx_tpu.filelog", n=1)
+    rec = _json.loads(f.read_text())
+    assert rec["msg"] == "to disk" and rec["n"] == 1
+    # reconfigure replaces (no duplicate handlers / leaked fds)
+    setup_logging(level="info", formatter="text", to="file",
+                  file_path=str(f), logger_name="emqx_tpu.filelog")
+    slog("info", "second", logger="emqx_tpu.filelog")
+    assert f.read_text().count("second") == 1

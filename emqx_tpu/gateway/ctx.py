@@ -247,20 +247,20 @@ class GatewayManager:
                     # invisible to (and un-unloadable by) the API —
                     # re-register so the operator can retry
                     import logging
+                    reg_as = name
                     if name in self.gateways:
                         # a NEW gateway was loaded under this name while
-                        # teardown ran — never clobber it with the
-                        # half-torn-down one
-                        logging.getLogger("emqx_tpu.gateway").exception(
-                            "gateway %s teardown failed (name since "
-                            "reused; old instance dropped)", name)
-                        return
+                        # teardown ran — never clobber it; park the
+                        # half-torn-down instance under an alias so its
+                        # possibly-still-bound listeners stay VISIBLE
+                        # and unloadable (retry via the alias)
+                        reg_as = f"{name}~failed-{id(impl) & 0xFFFF:x}"
                     logging.getLogger("emqx_tpu.gateway").exception(
-                        "gateway %s teardown failed; re-registered",
-                        name)
-                    self.gateways[name] = impl
+                        "gateway %s teardown failed; re-registered "
+                        "as %s", name, reg_as)
+                    self.gateways[reg_as] = impl
                     if ctx is not None:
-                        self.contexts[name] = ctx
+                        self.contexts[reg_as] = ctx
 
             task = loop.create_task(guarded())
             self._unload_tasks.add(task)

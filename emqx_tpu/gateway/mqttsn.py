@@ -234,7 +234,13 @@ class Channel(GwChannel):
             if not self.ctx.authenticate(self.clientid):
                 # a rejected (re-)CONNECT must fully de-authenticate the
                 # channel: staying "connected" would let the next
-                # PUBLISH run as the DENIED identity (ban bypass)
+                # PUBLISH run as the DENIED identity (ban bypass). A
+                # same-clientid re-CONNECT that got denied (freshly
+                # banned) also releases its still-open session — it must
+                # not linger as a ghost registration
+                if getattr(self, "_session_open", False):
+                    self._session_open = False
+                    self.ctx.close_session(new_cid, self, "auth_denied")
                 self.conn_state = "idle"
                 self.clientid = None
                 return [SnMessage(CONNACK, rc=RC_NOT_SUPPORTED)]

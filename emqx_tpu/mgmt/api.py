@@ -250,6 +250,9 @@ class ManagementApi:
         r("DELETE", "/api/v5/plugins/{name}", self.h_plugin_delete)
         r("GET", "/api/v5/monitor", self.h_monitor)
         r("GET", "/api/v5/monitor_current", self.h_monitor_current)
+        # listeners (emqx_mgmt_api_listeners): list + stop by id
+        r("GET", "/api/v5/listeners", self.h_listeners)
+        r("DELETE", "/api/v5/listeners/{lid}", self.h_listener_stop)
         # gateways (emqx_gateway_api / _api_clients): list, detail,
         # per-gateway clients + kick, unload
         r("GET", "/api/v5/gateways", self.h_gateways)
@@ -684,6 +687,30 @@ class ManagementApi:
 
     def h_monitor_current(self, query, body):
         return self.app.monitor.current()
+
+    # -- listeners (emqx_mgmt_api_listeners) --------------------------------
+
+    def h_listeners(self, query, body):
+        sup = getattr(self.app, "listeners", None)
+        return sup.info() if sup is not None else []
+
+    def h_listener_stop(self, query, body, lid):
+        import asyncio
+
+        sup = getattr(self.app, "listeners", None)
+        server = sup.find(lid) if sup is not None else None
+        if server is None:
+            raise ApiError(404, "LISTENER_NOT_FOUND")
+        # the listener's sockets live on the broker loop; this handler
+        # runs on the REST thread — stop must execute over there
+        srv = getattr(server, "_server", None)
+        loop = srv.get_loop() if srv is not None else None
+        if loop is not None and loop.is_running():
+            asyncio.run_coroutine_threadsafe(
+                sup.stop(lid), loop).result(timeout=10)
+        else:
+            raise ApiError(409, "LISTENER_NOT_RUNNING")
+        return None
 
     # -- gateways (emqx_gateway_api / emqx_gateway_api_clients) -------------
 

@@ -114,8 +114,18 @@ def setup_logging(level: str = "warning", formatter: str = "text",
     return handler
 
 
+# extra= keys that would collide with LogRecord's own attributes make
+# stdlib makeRecord RAISE ("Attempt to overwrite ..."), crashing the
+# caller that merely tried to log — natural ?SLOG field names like
+# `name` or `module` land in this set, so they are suffixed instead
+_EXTRA_RESERVED = frozenset(logging.LogRecord(
+    "", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
+
+
 def slog(level: str, msg: str, *, logger: Optional[str] = None,
          **fields: Any) -> None:
     """?SLOG: structured fields ride the record, not the message."""
+    safe = {(k if k not in _EXTRA_RESERVED else k + "_"): v
+            for k, v in fields.items()}
     logging.getLogger(logger or "emqx_tpu").log(
-        _LEVELS.get(level, logging.INFO), msg, extra=fields)
+        _LEVELS.get(level, logging.INFO), msg, extra=safe)

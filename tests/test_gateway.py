@@ -1424,3 +1424,24 @@ def test_sn_rejected_reconnect_deauthenticates():
         assert "good-dev" not in ctx.sessions        # old one released
         await gw.stop_listeners()
     run(main())
+
+
+def test_sn_same_clientid_denied_reconnect_releases_session():
+    """Freshly-banned device re-CONNECTs under the SAME clientid: the
+    denial must release the old session, not leak it as a ghost."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(SN.MqttsnGateway(port=0))
+        await gw.start_listeners()
+        ctx = app.gateway.contexts["mqttsn"]
+        dev = SnClient(gw.port)
+        await dev.start()
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="dev-x"))
+        assert (await dev.recv()).rc == SN.RC_ACCEPTED
+        app.access.banned.create("clientid", "dev-x")
+        dev.send(SN.SnMessage(SN.CONNECT, clientid="dev-x"))
+        assert (await dev.recv()).rc != SN.RC_ACCEPTED
+        assert "dev-x" not in ctx.sessions
+        assert app.cm.lookup_channel("dev-x") is None
+        await gw.stop_listeners()
+    run(main())

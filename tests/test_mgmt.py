@@ -364,3 +364,30 @@ def test_dashboard_page_served_and_escapes(api):
         headers={"Authorization": f"Bearer {tok}"})
     assert urllib.request.urlopen(req).headers[
         "Content-Type"].startswith("text/plain")
+
+
+def test_listeners_rest_surface(api):
+    """emqx_mgmt_api_listeners: list the live listener set and stop one
+    over REST (cross-thread onto the broker loop)."""
+    import asyncio
+
+    async def main():
+        started = await api.app.listeners.start_all({
+            "tcp_default": {"type": "tcp", "bind": "127.0.0.1:0"}})
+        assert started == ["tcp:tcp_default"]
+        st, rows = await asyncio.to_thread(
+            _req, api, "GET", "/api/v5/listeners")
+        assert st == 200
+        (row,) = rows
+        assert row["id"] == "tcp:tcp_default" and row["running"]
+        st, _ = await asyncio.to_thread(
+            _req, api, "DELETE", "/api/v5/listeners/tcp:tcp_default")
+        assert st in (200, 204)
+        st, rows = await asyncio.to_thread(
+            _req, api, "GET", "/api/v5/listeners")
+        assert rows == []
+        st, _ = await asyncio.to_thread(
+            _req, api, "DELETE", "/api/v5/listeners/tcp:tcp_default")
+        assert st == 404
+
+    asyncio.run(main())

@@ -263,3 +263,20 @@ def test_logfmt_file_handler(tmp_path):
                   file_path=str(f), logger_name="emqx_tpu.filelog")
     slog("info", "second", logger="emqx_tpu.filelog")
     assert f.read_text().count("second") == 1
+
+
+def test_slog_reserved_field_names_do_not_crash():
+    import io
+    import json as _json
+
+    from emqx_tpu.observe.logfmt import setup_logging, slog
+    buf = io.StringIO()
+    setup_logging(level="info", formatter="json", stream=buf,
+                  logger_name="emqx_tpu.rsv")
+    # `name`/`module` collide with LogRecord attributes; stdlib would
+    # raise KeyError from makeRecord without sanitization
+    slog("info", "gateway loaded", logger="emqx_tpu.rsv",
+         name="stomp", module="gateway", clientid="c1")
+    rec = _json.loads(buf.getvalue())
+    assert rec["name_"] == "stomp" and rec["module_"] == "gateway"
+    assert rec["clientid"] == "c1"

@@ -55,15 +55,44 @@ def _supervise() -> None:
     # still leaves room for the CPU retry inside a 1h driver budget
     deadline = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", 1500))
     base_env = {**os.environ, "BENCH_SUPERVISED": "1"}
+    # cheap tunnel probe FIRST: a wedged tunnel hangs backend init for
+    # many minutes (observed: >1h after a killed in-flight process) —
+    # without this, the device attempt eats its whole deadline before
+    # the CPU fallback even starts
+    def cpu_fallback(reason: str) -> None:
+        log(f"{reason}; falling back to CPU — numbers below are NOT "
+            "TPU numbers")
+
+    device_ok = False
     try:
-        rc = _sp.run([sys.executable, "-u", os.path.abspath(__file__)],
-                     env=base_env, timeout=deadline).returncode
-        if rc == 0:
-            sys.exit(0)
-        log(f"device bench exited rc={rc}; retrying on CPU")
+        # platform must be a real accelerator: bare jax.devices()
+        # SILENTLY falls back to CPU where no device is registered,
+        # which would pass CPU numbers off as device numbers
+        probe = _sp.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "assert d and d[0].platform != 'cpu', d"],
+            env=base_env, timeout=float(
+                os.environ.get("BENCH_PROBE_TIMEOUT_S", 180)),
+            capture_output=True, text=True)
+        device_ok = probe.returncode == 0
+        if not device_ok:
+            tail = (probe.stderr or "").strip().splitlines()[-1:]
+            cpu_fallback("device probe failed"
+                         + (f" ({tail[0][:200]})" if tail else ""))
     except _sp.TimeoutExpired:
-        log(f"device bench exceeded {deadline:.0f}s (tunnel hang?); "
-            "retrying on CPU — numbers below are NOT TPU numbers")
+        cpu_fallback("device probe hung (tunnel wedged)")
+    if device_ok:
+        try:
+            rc = _sp.run(
+                [sys.executable, "-u", os.path.abspath(__file__)],
+                env=base_env, timeout=deadline).returncode
+            if rc == 0:
+                sys.exit(0)
+            cpu_fallback(f"device bench exited rc={rc}")
+        except _sp.TimeoutExpired:
+            cpu_fallback(f"device bench exceeded {deadline:.0f}s "
+                         "(tunnel hang?)")
     cpu_env = {**base_env, "JAX_PLATFORMS": "cpu"}
     sys.exit(_sp.run([sys.executable, "-u", os.path.abspath(__file__)],
                      env=cpu_env, timeout=deadline).returncode)

@@ -30,6 +30,13 @@ class LdapError(Exception):
     pass
 
 
+class TruncatedBer(LdapError):
+    """More bytes may complete the element — retry after recv().
+    Distinct from structural malformation (plain LdapError), which no
+    amount of extra bytes can repair; the client must fail fast on the
+    latter instead of spinning on recv() until the socket timeout."""
+
+
 # ---------------------------------------------------------------------------
 # minimal BER (definite lengths only — LDAP never needs indefinite)
 
@@ -64,20 +71,24 @@ def ber_str(s: str | bytes, tag: int = OCTET_STRING) -> bytes:
 
 
 def ber_read(data: bytes, pos: int) -> tuple[int, bytes, int]:
-    """-> (tag, content, next_pos). Raises on truncation."""
+    """-> (tag, content, next_pos). Raises TruncatedBer when more bytes
+    may complete the element, LdapError on structural malformation."""
     if pos + 2 > len(data):
-        raise LdapError("truncated BER header")
+        raise TruncatedBer("truncated BER header")
     tag = data[pos]
     ln = data[pos + 1]
     pos += 2
     if ln & 0x80:
         k = ln & 0x7F
-        if k == 0 or pos + k > len(data):
-            raise LdapError("bad BER length")
+        if k == 0:            # X.690 8.1.3.6: 0x80 = indefinite form,
+            raise LdapError(  # forbidden in LDAP's DER subset
+                "reserved/indefinite BER length")
+        if pos + k > len(data):
+            raise TruncatedBer("truncated BER length")
         ln = int.from_bytes(data[pos:pos + k], "big")
         pos += k
     if pos + ln > len(data):
-        raise LdapError("truncated BER content")
+        raise TruncatedBer("truncated BER content")
     return tag, data[pos:pos + ln], pos + ln
 
 
@@ -293,16 +304,28 @@ class LdapClient:
         while True:
             try:
                 _tag, content, used = ber_read(self._buf, 0)
-            except LdapError:
-                chunk = self._sock.recv(65536)
+            except TruncatedBer:      # only truncation retries; malformed
+                chunk = self._sock.recv(65536)   # BER fails fast below
                 if not chunk:
                     raise ConnectionError("ldap closed") from None
                 self._buf += chunk
                 continue
+            except LdapError:
+                # wire desync is unrecoverable: drop the connection so
+                # the next call reconnects instead of replaying the
+                # poisoned buffer forever
+                self.close()
+                raise
             self._buf = self._buf[used:]
-            parts = ber_seq(content)
-            msg_id = _decode_int(parts[0][1])
-            op_tag, op_content = parts[1][0], parts[1][1]
+            try:
+                parts = ber_seq(content)
+                msg_id = _decode_int(parts[0][1])
+                op_tag, op_content = parts[1][0], parts[1][1]
+            except (LdapError, IndexError) as e:
+                # a complete outer envelope with malformed content is
+                # the same desync — same teardown
+                self.close()
+                raise LdapError(f"malformed LDAPMessage: {e}") from None
             return msg_id, op_tag, op_content
 
     def _bind(self, dn: str, password: str | bytes) -> tuple[int, str]:
@@ -358,8 +381,8 @@ class LdapClient:
                 try:
                     _t, content, _u = ber_read(buf, 0)
                     break
-                except LdapError:
-                    chunk = sock.recv(65536)
+                except TruncatedBer:    # malformed BER propagates; the
+                    chunk = sock.recv(65536)   # finally closes the sock
                     if not chunk:
                         raise ConnectionError("ldap closed") from None
                     buf += chunk
@@ -474,15 +497,20 @@ class MiniLDAP:
                 try:
                     _t, content, used = ber_read(buf, 0)
                     break
-                except LdapError:
+                except TruncatedBer:
                     chunk = sock.recv(65536)
                     if not chunk:
                         return
                     buf += chunk
+                except LdapError:
+                    return            # malformed frame: drop the session
             buf = buf[used:]
-            parts = ber_seq(content)
-            msg_id = _decode_int(parts[0][1])
-            op_tag, op = parts[1]
+            try:
+                parts = ber_seq(content)
+                msg_id = _decode_int(parts[0][1])
+                op_tag, op = parts[1]
+            except (LdapError, IndexError):
+                return                # malformed content: drop the session
             if op_tag == _OP_UNBIND:
                 return
             if op_tag == _OP_BIND_REQ:
@@ -548,8 +576,12 @@ class MiniLDAP:
 def _in_scope(dn: str, base: str, scope: int) -> bool:
     if scope == 0:
         return dn == base
-    if dn == base or (base and not dn.endswith("," + base)):
-        return base == "" and scope == 2
+    if dn == base:
+        # RFC 4511 §4.5.1.2: wholeSubtree includes the base object;
+        # singleLevel (scope 1) covers immediate subordinates only
+        return scope == 2
+    if base and not dn.endswith("," + base):
+        return False
     rel = dn[:-len(base)].rstrip(",") if base else dn
     if scope == 1:
         return "," not in rel

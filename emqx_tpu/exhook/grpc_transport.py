@@ -18,6 +18,7 @@ a stock gRPC HookProvider (any language) can replace it directly.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Any, Optional
 
@@ -71,9 +72,15 @@ def make_grpc_server(service: str, rpc_names, dispatch, *,
     return server, bound
 
 
+_conn_seq = itertools.count()
+
+
 class GrpcConn:
-    """One channel per provider (HTTP/2 multiplexes; the reference's
-    per-scheduler pool maps onto grpcio's internal connection mgmt)."""
+    """One gRPC channel (= one HTTP/2 connection). ExhookServer opens
+    pool_size of these round-robin — the reference's per-scheduler
+    client pool (emqx_exhook_server.erl:135). The unique channel arg
+    defeats grpc-core's global subchannel dedup, which would otherwise
+    silently collapse N same-target channels onto one TCP connection."""
 
     def __init__(self, addr: tuple, timeout: float,
                  secure: bool = False) -> None:
@@ -81,11 +88,12 @@ class GrpcConn:
 
         self.timeout = timeout
         target = f"{addr[0]}:{addr[1]}"
+        opts = [("emqx_tpu.pool_index", next(_conn_seq))]
         if secure:        # grpcs:// / https:// — system root CAs
             self._channel = grpc.secure_channel(
-                target, grpc.ssl_channel_credentials())
+                target, grpc.ssl_channel_credentials(), options=opts)
         else:
-            self._channel = grpc.insecure_channel(target)
+            self._channel = grpc.insecure_channel(target, options=opts)
         self._stubs: dict[str, Any] = {}
         self._lock = threading.Lock()
 

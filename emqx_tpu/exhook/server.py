@@ -90,12 +90,16 @@ class ExhookServer:
                     f"exhook {name}: url scheme {transport}:// needs "
                     "grpcio, which is not importable in this "
                     "environment — use the framed:// transport")
-            # one channel: HTTP/2 multiplexes; grpcio pools internally
+            # HTTP/2 multiplexes, but a single channel serializes onto
+            # one TCP connection; honor pool_size with N round-robin
+            # channels for parity with the framed transport (the
+            # reference's gRPC client pool, emqx_exhook_server.erl:135)
             self._pool = [GrpcConn((host, port), timeout_s,
-                                   secure=(transport == "grpcs"))]
+                                   secure=(transport == "grpcs"))
+                          for _ in range(max(1, pool_size))]
         elif transport == "framed":
             self._pool = [_Conn((host, port), timeout_s)
-                          for _ in range(pool_size)]
+                          for _ in range(max(1, pool_size))]
         else:
             raise ValueError(
                 f"exhook {name}: unknown transport {transport!r} "

@@ -294,7 +294,15 @@ class Channel(GwChannel):
             self.request_close()
 
     def _error(self, text: str) -> StompFrame:
+        # STOMP 1.2 §ERROR: the server MUST close the connection just
+        # after sending an ERROR frame. The TCP adapter closes on
+        # conn_state == "disconnected" after flushing our reply; the
+        # explicit request_close() makes the close adapter-independent
+        # (it is deferred via call_soon_threadsafe, so the ERROR frame
+        # is written before the socket drops — never a half-open
+        # session whose subsequent frames we silently swallow).
         self.conn_state = "disconnected"
+        self.request_close()
         return StompFrame("ERROR", {"message": text}, text.encode())
 
 

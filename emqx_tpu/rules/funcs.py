@@ -440,9 +440,18 @@ def _jq(program, value, _timeout_ms=None):
     # outputs); this build ships its own jq-subset interpreter instead
     # (utils/jq.py). jq/3's timeout is a NIF-dirty-scheduler concern
     # the in-process evaluator doesn't have; accepted and ignored.
-    from emqx_tpu.utils.jq import jq as run_jq
+    from emqx_tpu.utils.jq import JqError, jq as run_jq
     if isinstance(program, (bytes, bytearray)):
         program = program.decode("utf-8")
+    if isinstance(value, str):
+        # reference semantics: the SQL value is a binary holding JSON
+        # text (jq:process_json/3 parses it); our runtime hands SQL
+        # binaries over as str, so decode here — invalid JSON fails the
+        # rule, same as the NIF. utils/jq.py itself never sniffs str.
+        try:
+            value = json.loads(value)
+        except ValueError as e:
+            raise JqError(f"jq: invalid JSON input: {e}") from None
     return run_jq(program, value)
 
 

@@ -820,19 +820,15 @@ def compile_program(src: str) -> Fn:
 def jq(program: str, value: Any) -> list:
     """Run a jq program; returns the list of ALL outputs.
 
-    ``value`` handling mirrors emqx_rule_funcs:jq/2's binary-vs-term
-    split: bytes are a JSON document (invalid JSON errors); a str is
-    tried as JSON first and falls back to a plain string term (SQL
-    rules hand payloads over in either form); anything else is an
-    already-decoded term."""
+    ``value`` is an already-decoded term, with one exception: bytes are
+    a JSON document (invalid JSON errors). A ``str`` is ALWAYS a plain
+    string term — never sniffed as JSON text, so ``jq(".", "0")`` is
+    ``["0"]``, not ``[0]``. The reference-semantics seam (SQL values
+    are binaries holding JSON text, emqx_rule_funcs.erl:806-828) lives
+    in rules/funcs.py:_jq, which decodes str/bytes before calling here."""
     if isinstance(value, (bytes, bytearray)):
         try:
             value = json.loads(value.decode("utf-8"))
         except ValueError as e:
             raise JqError(f"jq: invalid JSON input: {e}") from None
-    elif isinstance(value, str):
-        try:
-            value = json.loads(value)
-        except ValueError:
-            pass                      # plain string term
     return list(compile_program(program)(value))

@@ -1445,3 +1445,30 @@ def test_sn_same_clientid_denied_reconnect_releases_session():
         assert app.cm.lookup_channel("dev-x") is None
         await gw.stop_listeners()
     run(main())
+
+
+def test_stomp_error_frame_closes_connection():
+    """STOMP 1.2: after sending an ERROR frame the server MUST close
+    the connection — the client receives the ERROR, then EOF; no
+    half-open session that silently swallows subsequent frames
+    (round-3 advisor finding, gateway/stomp.py _error)."""
+    async def main():
+        app = BrokerApp()
+        gw = app.gateway.load(ST.StompGateway(port=0))
+        await gw.start_listeners()
+        c = StompClient(gw.port)
+        await c.connect()
+        await c.send("CONNECT", {"accept-version": "1.2",
+                                 "client-id": "errc"})
+        assert (await c.recv()).command == "CONNECTED"
+        await c.send("SEND", {}, b"no destination header")
+        err = await c.recv()
+        assert err.command == "ERROR"
+        # server closes right after the ERROR frame
+        data = await asyncio.wait_for(c.r.read(64), 5)
+        assert data == b"", "socket left open after ERROR"
+        # session is torn down, not leaked
+        await asyncio.sleep(0.1)
+        assert app.cm.lookup_channel("errc") is None
+        await gw.stop_listeners()
+    run(main())

@@ -89,7 +89,7 @@ from emqx_tpu.utils.jq import JqError, jq
     ("tostring", 5, ["5"]),
     ("tonumber", "5", [5]),
     ("tojson", {"a": 1}, ['{"a": 1}']),
-    ('fromjson | .a', '"{\\"a\\": 3}"', [3]),
+    ('fromjson | .a', '{"a": 3}', [3]),
     ("ascii_upcase", "ab", ["AB"]),
     ('startswith("ab")', "abc", [True]),
     ('ltrimstr("ab")', "abc", ["c"]),
@@ -115,12 +115,24 @@ def test_jq_manual_cases(prog, input_, want):
 
 
 def test_json_string_input():
-    # jq/2 accepts a JSON document (the reference passes binaries)
-    assert jq(".sensor.temp", '{"sensor": {"temp": 21.5}}') == [21.5]
+    # bytes are a JSON document (the reference passes binaries);
+    # a str is ALWAYS a plain term — never sniffed as JSON text
     assert jq(".a", b'{"a": 1}') == [1]
     with pytest.raises(JqError):
         jq(".", b"{not json")                 # bytes must be valid JSON
-    assert jq("length", "not json") == [8]    # str falls back to term
+    assert jq("length", "not json") == [8]    # str is a term
+    assert jq(".", "0") == ["0"]              # NOT [0] — no sniffing
+
+
+def test_rule_seam_str_is_json_text():
+    # the rule-engine seam applies reference semantics: SQL values are
+    # binaries holding JSON text, whether our runtime hands them over
+    # as str or bytes (emqx_rule_funcs.erl:806-828)
+    from emqx_tpu.rules.funcs import FUNCS
+    assert FUNCS["jq"](".sensor.temp", '{"sensor": {"temp": 21.5}}') == [21.5]
+    assert FUNCS["jq"](".", "0") == [0]       # JSON text at the seam
+    with pytest.raises(JqError):
+        FUNCS["jq"](".", "not json")          # invalid JSON fails the rule
 
 
 @pytest.mark.parametrize("prog", [

@@ -226,3 +226,44 @@ def test_ldap_authn_authz_via_live_broker():
         asyncio.run(main())
     finally:
         srv.stop()
+
+
+def test_scope_sub_includes_base_entry():
+    """RFC 4511 wholeSubtree includes the base object itself; onelevel
+    does not (round-3 advisor finding, connector/ldap.py _in_scope)."""
+    srv = MiniLDAP()
+    srv.add("ou=mqtt,dc=emqx,dc=io", objectClass=["organizationalUnit"],
+            ou="mqtt")
+    srv.add("uid=a,ou=mqtt,dc=emqx,dc=io", objectClass=["mqttUser"],
+            uid="a")
+    srv.start()
+    try:
+        c = LdapClient(port=srv.port)
+        sub = c.search("ou=mqtt,dc=emqx,dc=io", "(objectClass=*)",
+                       scope="sub")
+        assert {dn for dn, _ in sub} == {"ou=mqtt,dc=emqx,dc=io",
+                                         "uid=a,ou=mqtt,dc=emqx,dc=io"}
+        one = c.search("ou=mqtt,dc=emqx,dc=io", "(objectClass=*)",
+                       scope="one")
+        assert [dn for dn, _ in one] == ["uid=a,ou=mqtt,dc=emqx,dc=io"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_ber_truncation_vs_malformation():
+    """The client's recv loop retries only on truncation; structurally
+    malformed BER (X.690 indefinite length, forbidden in LDAP) must
+    fail fast instead of spinning until the socket timeout."""
+    from emqx_tpu.connector.ldap import TruncatedBer, ber
+    with pytest.raises(TruncatedBer):
+        ber_read(b"\x30", 0)                       # header cut short
+    with pytest.raises(TruncatedBer):
+        ber_read(b"\x30\x82\x01", 0)               # length bytes cut
+    with pytest.raises(TruncatedBer):
+        ber_read(b"\x30\x05abc", 0)                # content cut short
+    with pytest.raises(LdapError) as ei:
+        ber_read(b"\x30\x80abc\x00\x00", 0)        # indefinite form
+    assert not isinstance(ei.value, TruncatedBer)
+    tag, content, used = ber_read(ber(0x30, b"ok"), 0)
+    assert (tag, content) == (0x30, b"ok")

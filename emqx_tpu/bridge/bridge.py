@@ -173,6 +173,10 @@ class BridgeManager:
         self.queue_base_dir = queue_base_dir
         self.bridges: dict[str, Bridge] = {}
         self._lock = threading.RLock()
+        # fired after create/delete — the native host flushes its
+        # publish permits here so a new egress bridge sees topics that
+        # were already fast-pathing (broker/native_server.py)
+        self.on_topology_change: list = []
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -234,6 +238,8 @@ class BridgeManager:
         except Exception:
             self.delete(bid)
             raise
+        for cb in self.on_topology_change:
+            cb()
         return bridge
 
     def _direct_egress(self, msg: Message, bridge: Bridge, filt: str):
@@ -278,6 +284,8 @@ class BridgeManager:
         bridge.enabled = False
         bridge.worker.close()
         bridge.manager.stop()
+        for cb in self.on_topology_change:
+            cb()
         return True
 
     def get(self, bid: str) -> Optional[Bridge]:

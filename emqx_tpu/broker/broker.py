@@ -154,6 +154,13 @@ class Broker:
             from emqx_tpu.observe.metrics import Metrics
             metrics = Metrics()
         self.metrics = metrics
+        # subscription observers: fn(op, sid, topic, opts) with op in
+        # {"add", "del"}, fired on EVERY table change including
+        # restore=True resumes (unlike the 'session.subscribed' hook) —
+        # the native host mirrors the table through this seam
+        # (broker/native_server.py), so a missed event would make its
+        # fast path silently skip a subscriber
+        self.sub_observers: list = []
 
     def _inc(self, key: str, n: int = 1) -> None:
         self.metrics.inc(key, n)
@@ -191,6 +198,8 @@ class Broker:
             if cluster_claimed and self.exclusive_release_fn is not None:
                 self.exclusive_release_fn(topic, sid)
             raise
+        for obs in self.sub_observers:
+            obs("add", sid, topic, opts)
         # is_new lets rh=1 (send-retained-if-new) distinguish resubscribes
         if not restore:
             self.hooks.run("session.subscribed", (sid, topic, opts, is_new))
@@ -267,6 +276,8 @@ class Broker:
                         self.model.unsubscribe(real_topic, slot)
         if release_exclusive:
             self.exclusive_release_fn(topic, sid)
+        for obs in self.sub_observers:
+            obs("del", sid, topic, opts)
         self.hooks.run("session.unsubscribed", (sid, topic))
         return True
 

@@ -1,12 +1,38 @@
 """Broker listener on the native (C++ epoll) connection host.
 
-The C++ side (``emqx_tpu/native/src/host.cc``) owns sockets and framing;
-this driver consumes complete-frame events, runs the same ``Channel`` FSM
-the asyncio server uses, and pushes serialized replies back down. One
-Python thread drives the loop — the C++ host does the per-byte work
-(accept, read, frame-split, write, backpressure), which is the part the
-reference delegates to the BEAM's C core (emqx_connection.erl:132
-``{active,N}`` batching).
+The C++ side (``emqx_tpu/native/src/host.cc``) owns sockets, framing
+and — since round 4 — the QoS0/1 PUBLISH fast path: parse → match →
+fan-out runs entirely in C++ against a mirror of the broker tables,
+and only the frames that *need* Python (CONNECT/SUBSCRIBE, QoS2,
+retained, $-topics, shared subscriptions, unpermitted topics) come up
+to this driver, which runs the same ``Channel`` FSM the asyncio server
+uses. This is SURVEY.md §7's "host side in C++" design: the reference
+runs its hot loop in per-connection BEAM processes
+(emqx_connection.erl:403-440 → emqx_broker.erl:218-232); the GIL makes
+that shape a ~14k msg/s ceiling in Python (BENCH_r03), so the hot loop
+moves below the GIL instead.
+
+Correctness seams (all of them fail toward the slow path, which is
+always correct):
+
+- **table mirror** — every ``broker.subscribe/unsubscribe`` (including
+  session resumes) fires ``broker.sub_observers``; subscriptions that
+  cannot be natively served (shared groups, persistent sessions,
+  subscription ids, subscribers on other transports) are installed as
+  *punt markers*: one marker in a publish's match set forwards the
+  whole frame to Python, so native fan-out only runs when complete;
+- **permits** — a (conn, topic) publish permit is the authz-cache
+  analogue (emqx_authz cache): granted only after a first publish
+  ran the full Python path and the topic matches no rules, no traces,
+  no topic-metrics pattern, and authorization allows it; granted only
+  once the pipeline is idle so a fast message can never overtake a
+  still-queued slow one on the same topic; flushed on rule changes and
+  on a TTL cadence (the authz cache TTL analogue);
+- **packet ids** — native QoS1 deliveries use pids >= 32768
+  (host.cc kNativePidBase), Python sessions stay below
+  (session/session.py PKT_ID_SPACE), so PUBACKs route unambiguously;
+- **clustered nodes** — remote routes don't traverse the observer, so
+  the fast path disables itself when a forward_fn is wired.
 """
 
 from __future__ import annotations
@@ -21,20 +47,25 @@ from emqx_tpu import native
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.channel import Channel
 from emqx_tpu.broker.cm import CM
+from emqx_tpu.core import topic as T
+from emqx_tpu.core.message import now_ms
 from emqx_tpu.mqtt import packet as P
 from emqx_tpu.mqtt.frame import FrameError, parse_one, serialize
 
 log = logging.getLogger("emqx_tpu.native_server")
 
 HOUSEKEEP_INTERVAL = 5.0
+PERMIT_TTL_S = 60.0          # authz-cache TTL analogue: periodic re-earn
+MAX_PERMITS_PER_CONN = 4096  # mirrors host.cc's per-conn permit cap
 
 
 class _NativeConn:
-    __slots__ = ("conn_id", "channel", "server")
+    __slots__ = ("conn_id", "channel", "server", "fast")
 
     def __init__(self, server: "NativeBrokerServer", conn_id: int, peer: str):
         self.server = server
         self.conn_id = conn_id
+        self.fast = False
         pipeline = server.pipeline
         self.channel = Channel(
             server.broker, server.cm,
@@ -52,7 +83,8 @@ class _NativeConn:
 
 
 class NativeBrokerServer:
-    """Same surface as ``BrokerServer`` but socket IO lives in C++."""
+    """Same surface as ``BrokerServer`` but socket IO and the QoS0/1
+    publish hot path live in C++."""
 
     def __init__(
         self,
@@ -64,6 +96,7 @@ class NativeBrokerServer:
         max_connections: int = 1_000_000,
         mountpoint: str = "",
         app=None,
+        fast_path: bool = True,
     ):
         if not native.available():
             raise RuntimeError(
@@ -76,6 +109,7 @@ class NativeBrokerServer:
         self.broker = broker or app.broker
         self.cm = cm or (app.cm if app else CM())
         self.mountpoint = mountpoint
+        self.fast_path = fast_path and not mountpoint
         self.host = native.NativeHost(
             host=host, port=port,
             max_size=max_packet_size, max_conns=max_connections)
@@ -92,6 +126,218 @@ class NativeBrokerServer:
         # housekeep cycle would churn an OS thread every few seconds
         self._tick_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="emqx-native-tick")
+        # -- fast-path state ------------------------------------------------
+        # punt-marker owner tokens live far above any conn id so the C++
+        # table can hold both in one owner space
+        self._punt_token_next = 1 << 48
+        self._punt_tokens: dict[str, int] = {}          # sid -> token
+        # (sid, sub key) -> (owner, real filter, kind) for removal;
+        # several sub keys can share one punt (token, real) C++ entry
+        # ($share/g1/t + $share/g2/t), so punt entries are refcounted
+        self._mirror: dict[tuple[str, str], tuple[int, str, str]] = {}
+        self._punt_refs: dict[tuple[int, str], int] = {}
+        self._token_refs: dict[str, int] = {}           # sid -> live punts
+        self._fast_conn_of: dict[str, int] = {}         # clientid -> conn
+        self._granted: dict[int, set[str]] = {}         # conn -> topics
+        self._permit_queue: list[tuple[_NativeConn, str]] = []
+        self._last_permit_flush = time.monotonic()
+        self._stats_seen = {k: 0 for k in native.STAT_NAMES}
+        self.broker.sub_observers.append(self._on_sub_event)
+        # mirror subscriptions that existed before this server started
+        # (resumed persistent sessions, other transports on the same app)
+        for (sid, topic), opts in list(self.broker.suboption.items()):
+            self._on_sub_event("add", sid, topic, opts)
+        if app is not None and hasattr(app, "rules"):
+            app.rules.on_topology_change.append(self.flush_permits)
+        if app is not None and hasattr(getattr(app, "bridges", None),
+                                       "on_topology_change"):
+            app.bridges.on_topology_change.append(self.flush_permits)
+
+    # -- fast-path control --------------------------------------------------
+
+    def flush_permits(self) -> None:
+        """Topology changed (rule created, authz update, trace started):
+        every publisher re-earns its permits through the full path."""
+        self.host.permits_flush()
+        self._granted.clear()
+
+    def fast_stats(self) -> dict[str, int]:
+        return self.host.stats()
+
+    def _fast_global(self) -> bool:
+        if not self.fast_path:
+            return False
+        # clustered: remote routes don't traverse sub_observers, so a
+        # native fan-out could silently skip a remote subscriber
+        if self.broker.forward_fn is not None:
+            return False
+        return True
+
+    def _token(self, sid: str) -> int:
+        tok = self._punt_tokens.get(sid)
+        if tok is None:
+            tok = self._punt_token_next
+            self._punt_token_next += 1
+            self._punt_tokens[sid] = tok
+        return tok
+
+    def _add_entry(self, sid: str, owner: int, real: str, kind: str,
+                   qos: int, flags: int) -> None:
+        if kind == "punt":
+            key = (owner, real)
+            self._punt_refs[key] = self._punt_refs.get(key, 0) + 1
+            if self._punt_refs[key] == 1:
+                self._token_refs[sid] = self._token_refs.get(sid, 0) + 1
+                self.host.sub_add(owner, real, 0, native.SUB_PUNT)
+        else:
+            self.host.sub_add(owner, real, qos, flags)
+
+    def _del_entry(self, sid: str, owner: int, real: str,
+                   kind: str) -> None:
+        if kind == "punt":
+            key = (owner, real)
+            n = self._punt_refs.get(key, 0) - 1
+            if n > 0:
+                self._punt_refs[key] = n
+                return                 # another sub key still needs it
+            self._punt_refs.pop(key, None)
+            left = self._token_refs.get(sid, 1) - 1
+            if left <= 0:
+                # last punt for this sid: free its token so clientid
+                # churn doesn't leak dict entries forever
+                self._token_refs.pop(sid, None)
+                self._punt_tokens.pop(sid, None)
+            else:
+                self._token_refs[sid] = left
+        self.host.sub_del(owner, real)
+
+    def _on_sub_event(self, op: str, sid: str, topic: str, opts) -> None:
+        """Mirror one broker-table change into the C++ sub table.
+        Thread-safe: host.sub_add/del enqueue onto the poll thread."""
+        group, real = T.parse_share(topic)
+        if op == "add":
+            conn_id = self._fast_conn_of.get(sid)
+            if (conn_id is not None and not group
+                    and getattr(opts, "subid", None) is None):
+                owner, kind = conn_id, "real"
+                qos = getattr(opts, "qos", 0)
+                flags = native.SUB_NO_LOCAL if getattr(opts, "nl", 0) else 0
+            else:
+                # shared group / persistent session / subscription id /
+                # subscriber living on another transport: punt marker
+                owner, kind = self._token(sid), "punt"
+                qos = flags = 0
+            old = self._mirror.get((sid, topic))
+            if old is not None and (old[0], old[1], old[2]) != (
+                    owner, real, kind):
+                # resubscribe flipped eligibility (e.g. a subscription
+                # id appeared): the previously installed entry must go,
+                # or it would keep delivering after UNSUBSCRIBE
+                self._del_entry(sid, old[0], old[1], old[2])
+            self._add_entry(sid, owner, real, kind, qos, flags)
+            self._mirror[(sid, topic)] = (owner, real, kind)
+        else:
+            ent = self._mirror.pop((sid, topic), None)
+            if ent is not None:
+                self._del_entry(sid, ent[0], ent[1], ent[2])
+
+    def _maybe_enable_fast(self, conn: _NativeConn) -> None:
+        """Post-CONNACK: clean sessions with no expiry get the fast
+        path; persistent sessions keep every message in Python so their
+        mqueue/inflight state stays authoritative."""
+        ch = conn.channel
+        ci = ch.conninfo
+        if not self._fast_global():
+            return
+        if not ci.clean_start or ci.expiry_interval_ms:
+            return
+        conn.fast = True
+        max_inflight = 0
+        sess = getattr(ch, "session", None)
+        if sess is not None and getattr(sess, "max_inflight", 0):
+            # the client's Receive Maximum bounds ALL unacked QoS1/2
+            # deliveries; native and Python deliver independently on the
+            # same wire, so the budget is split between the planes (a
+            # fast conn only sees Python deliveries for punt-served
+            # filters — shared subs etc. — so each half is rarely full)
+            budget = min(int(sess.max_inflight), 32766)
+            max_inflight = max(1, budget // 2)
+            sess.inflight.max_size = max(1, budget - max_inflight)
+        self.host.enable_fast(conn.conn_id, ci.proto_ver, max_inflight)
+        self._fast_conn_of[ch.clientid] = conn.conn_id
+        # an earlier mirror pass may have installed this client's subs
+        # as punt markers (it wasn't fast yet); re-mirror them as real
+        # (_on_sub_event handles removal of the old entry on the flip)
+        for (sid, topic), (owner, real, kind) in list(self._mirror.items()):
+            if sid == ch.clientid and owner != conn.conn_id:
+                opts = self.broker.suboption.get((sid, topic))
+                if opts is not None:
+                    self._on_sub_event("add", sid, topic, opts)
+
+    def _slow_consumers_watch(self, ch, topic: str) -> bool:
+        """True when ANY message-plane consumer needs to see every
+        publish on ``topic`` — the complete enumeration of everything
+        the slow path's 'message.publish' fold can do with a live,
+        non-retained, non-$ message. A topic a consumer watches never
+        earns a permit; consumers added later are covered by the eager
+        flush hooks (rules, bridges) or the permit TTL (the rest)."""
+        app = self.app
+        if app.rules.rules_for_topic(topic):
+            return True                 # rules must see every message
+        if any(t.status == "running" and t.matches(
+                ch.clientid, topic, str(ch.conninfo.peername))
+                for t in app.trace.traces.values()):
+            return True                 # traced topics stay observable
+        if any(T.match(topic, f) for f in app.topic_metrics.topics()):
+            return True
+        rw = getattr(app, "rewrite", None)
+        if rw is not None and any(
+                r.action in ("publish", "all")
+                and T.match(topic, r.source_topic)
+                for r in rw.pub_rules):
+            return True                 # topic rewrite redirects these
+        br = getattr(app, "bridges", None)
+        if br is not None:
+            for b in br.bridges.values():
+                local = ((b.conf.get("egress") or {}).get("local") or {})
+                filt = local.get("topic")
+                if filt and T.match(topic, filt):
+                    return True         # direct egress forwards these
+        ex = getattr(app, "exhook", None)
+        if ex is not None and any(
+                h.startswith("message.")
+                for s in ex.servers.values()
+                for h in s.hooks_wanted):
+            return True                 # providers watch the message plane
+        return False
+
+    def _grant_permits(self) -> None:
+        """Runs after pipeline.flush() in _step: every queued slow-path
+        publish already delivered, so granting now preserves per-topic
+        ordering across the slow→fast transition."""
+        queue, self._permit_queue = self._permit_queue, []
+        for conn, topic in queue:
+            ch = conn.channel
+            if (not conn.fast or ch.conn_state != "connected"
+                    or not self._fast_global()):
+                continue
+            granted = self._granted.setdefault(conn.conn_id, set())
+            if topic in granted or len(granted) >= MAX_PERMITS_PER_CONN:
+                continue
+            app = self.app
+            if app is not None and self._slow_consumers_watch(ch, topic):
+                continue
+            verdict = ch.hooks.run_fold(
+                "client.authorize",
+                (dict(clientid=ch.clientid,
+                      username=ch.conninfo.username,
+                      peername=ch.conninfo.peername),
+                 "publish", topic),
+                "allow")
+            if verdict != "allow":
+                continue
+            granted.add(topic)
+            self.host.permit(conn.conn_id, topic)
 
     # -- event loop ---------------------------------------------------------
 
@@ -107,9 +353,12 @@ class NativeBrokerServer:
             elif kind == native.EV_CLOSED:
                 conn = self.conns.pop(conn_id, None)
                 if conn is not None:
+                    self._forget_fast(conn)
                     conn.channel.terminate(payload.decode("ascii", "replace"))
         if self.pipeline is not None:
             self.pipeline.flush()
+        if self._permit_queue:
+            self._grant_permits()
         now = time.monotonic()
         if now - self._last_housekeep >= HOUSEKEEP_INTERVAL:
             self._last_housekeep = now
@@ -139,9 +388,31 @@ class NativeBrokerServer:
         conn._send_packets(out)
         if ch.conn_state == "disconnected":
             self._drop(conn, "normal")
+            return
+        if pkt.type == P.CONNECT and ch.conn_state == "connected":
+            self._maybe_enable_fast(conn)
+        elif (conn.fast and pkt.type == P.PUBLISH and pkt.qos <= 1
+              and not pkt.retain and pkt.topic
+              and not pkt.topic.startswith("$")):
+            # this publish took the full path (no permit yet): queue the
+            # topic for a permit decision once the pipeline is idle
+            self._permit_queue.append((conn, pkt.topic))
+
+    def _forget_fast(self, conn: _NativeConn) -> None:
+        cid = conn.channel.clientid
+        if self._fast_conn_of.get(cid) == conn.conn_id:
+            del self._fast_conn_of[cid]
+        if conn.fast:
+            conn.fast = False
+            # no-op when the conn is already closing; clears native
+            # permits/inflight if a future caller revokes eligibility
+            # on a live connection
+            self.host.disable_fast(conn.conn_id)
+        self._granted.pop(conn.conn_id, None)
 
     def _drop(self, conn: _NativeConn, reason: str) -> None:
         self.conns.pop(conn.conn_id, None)
+        self._forget_fast(conn)
         conn.channel.terminate(reason)
         self.host.close_conn(conn.conn_id)
 
@@ -163,13 +434,48 @@ class NativeBrokerServer:
                     self._tick_running.clear()
 
             self._tick_pool.submit(_tick)
+        self._merge_fast_metrics()
+        if time.monotonic() - self._last_permit_flush >= PERMIT_TTL_S:
+            # the authz-cache TTL analogue: permits re-earn periodically
+            # so an authz/banned change can't be outrun forever
+            self._last_permit_flush = time.monotonic()
+            if self._granted:
+                self.flush_permits()
         for conn in list(self.conns.values()):
             ch = conn.channel
+            if conn.fast:
+                # fast-path frames never reach the channel; feed its
+                # keepalive clock from the C++ side's last-read stamp
+                idle = self.host.conn_idle_ms(conn.conn_id)
+                if idle >= 0:
+                    ch.last_packet_at = max(
+                        ch.last_packet_at, now_ms() - idle)
             if ch.keepalive_expired():
                 self._drop(conn, "keepalive_timeout")
                 continue
             conn._send_packets(ch.handle_timeout("retry"))
             ch.handle_timeout("expire_awaiting_rel")
+
+    def _merge_fast_metrics(self) -> None:
+        """Fold the C++ counters into the node metrics so $SYS /
+        Prometheus see fast-path traffic (the slow path increments these
+        inline; the fast path batches them per housekeep)."""
+        stats = self.host.stats()
+        m = self.broker.metrics
+        seen = self._stats_seen
+        d_in = stats["fast_in"] - seen["fast_in"]
+        d_out = stats["fast_out"] - seen["fast_out"]
+        d_drop = (stats["drops_backpressure"] + stats["drops_inflight"]
+                  - seen["drops_backpressure"] - seen["drops_inflight"])
+        if d_in:
+            m.inc("messages.received", d_in)
+            m.inc("messages.publish", d_in)
+        if d_out:
+            m.inc("messages.sent", d_out)
+            m.inc("messages.delivered", d_out)
+        if d_drop:
+            m.inc("messages.dropped", d_drop)
+        self._stats_seen = stats
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -188,6 +494,22 @@ class NativeBrokerServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        try:
+            self.broker.sub_observers.remove(self._on_sub_event)
+        except ValueError:
+            pass
+        if self.app is not None and hasattr(self.app, "rules"):
+            try:
+                self.app.rules.on_topology_change.remove(self.flush_permits)
+            except ValueError:
+                pass
+        if self.app is not None and hasattr(getattr(
+                self.app, "bridges", None), "on_topology_change"):
+            try:
+                self.app.bridges.on_topology_change.remove(
+                    self.flush_permits)
+            except ValueError:
+                pass
         for conn in list(self.conns.values()):
             conn.channel.terminate("server_shutdown")
         self.conns.clear()

@@ -51,6 +51,7 @@ def _build() -> None:
         "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
         os.path.join(_SRC_DIR, "host.cc"),
         os.path.join(_SRC_DIR, "snappy.cc"),
+        os.path.join(_SRC_DIR, "loadgen.cc"),
         "-o", _LIB_PATH,
     ]
     if _SANITIZE:
@@ -73,6 +74,48 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
     lib.emqx_host_close_conn.restype = ctypes.c_int
     lib.emqx_host_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_host_enable_fast.restype = ctypes.c_int
+    lib.emqx_host_enable_fast.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32]
+    lib.emqx_host_disable_fast.restype = ctypes.c_int
+    lib.emqx_host_disable_fast.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_host_sub_add.restype = ctypes.c_int
+    lib.emqx_host_sub_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint8, ctypes.c_uint8]
+    lib.emqx_host_sub_del.restype = ctypes.c_int
+    lib.emqx_host_sub_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_host_permit.restype = ctypes.c_int
+    lib.emqx_host_permit.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_host_permits_flush.restype = ctypes.c_int
+    lib.emqx_host_permits_flush.argtypes = [ctypes.c_void_p]
+    lib.emqx_host_stat.restype = ctypes.c_long
+    lib.emqx_host_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_conn_idle_ms.restype = ctypes.c_long
+    lib.emqx_host_conn_idle_ms.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_subtable_create.restype = ctypes.c_void_p
+    lib.emqx_subtable_create.argtypes = []
+    lib.emqx_subtable_destroy.restype = None
+    lib.emqx_subtable_destroy.argtypes = [ctypes.c_void_p]
+    lib.emqx_subtable_add.restype = None
+    lib.emqx_subtable_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint8, ctypes.c_uint8]
+    lib.emqx_subtable_del.restype = ctypes.c_int
+    lib.emqx_subtable_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_subtable_match.restype = ctypes.c_long
+    lib.emqx_subtable_match.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+    lib.emqx_loadgen_run.restype = ctypes.c_int
+    lib.emqx_loadgen_run.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64)]
     lib.emqx_host_destroy.restype = None
     lib.emqx_host_destroy.argtypes = [ctypes.c_void_p]
     lib.emqx_framer_create.restype = ctypes.c_void_p
@@ -173,6 +216,78 @@ class NativeFramer:
 # event kinds from host.cc
 EV_OPEN, EV_FRAME, EV_CLOSED = 1, 2, 3
 
+def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
+                msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
+                proto_ver: int = 4, idle_timeout_ms: int = 5000,
+                window: int = 0, warmup: bool = True) -> dict:
+    """Run the native load generator (loadgen.cc) against a broker.
+    Blocks for the duration of the run (ctypes releases the GIL, so an
+    in-process broker keeps serving). ``window=0`` blasts for peak
+    throughput; ``window>0`` caps total in-flight messages so the
+    latency percentiles measure the broker, not loadgen queue depth.
+    Returns sent/received counts, wall ns and latency percentiles."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = (ctypes.c_uint64 * 8)()
+    rc = lib.emqx_loadgen_run(host.encode(), port, n_subs, n_pubs,
+                              msgs_per_pub, qos, payload_len, proto_ver,
+                              idle_timeout_ms, window, int(warmup), out)
+    if rc != 0:
+        raise RuntimeError(f"loadgen failed rc={rc}")
+    keys = ("sent", "received", "wall_ns", "p50_ns", "p99_ns", "max_ns",
+            "acks", "errors")
+    return dict(zip(keys, out))
+
+
+class NativeSubTable:
+    """Standalone wrapper over the C++ subscription table (router.h) —
+    the differential-test surface against router/trie.py."""
+
+    def __init__(self):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        self._h = self._lib.emqx_subtable_create()
+
+    def add(self, owner: int, filter_: str, qos: int = 0,
+            flags: int = 0) -> None:
+        self._lib.emqx_subtable_add(self._h, owner, filter_.encode(),
+                                    qos, flags)
+
+    def remove(self, owner: int, filter_: str) -> bool:
+        return bool(self._lib.emqx_subtable_del(self._h, owner,
+                                                filter_.encode()))
+
+    def match(self, topic: str) -> list[int]:
+        cap = 256
+        while True:
+            buf = (ctypes.c_uint64 * cap)()
+            n = self._lib.emqx_subtable_match(self._h, topic.encode(),
+                                              buf, cap)
+            if n <= cap:
+                return list(buf[:n])
+            cap = n
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.emqx_subtable_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# fast-path stat slots (host.cc StatSlot order)
+STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
+              "drops_backpressure", "drops_inflight", "native_acks")
+
+# subscription-entry flags (router.h)
+SUB_PUNT, SUB_NO_LOCAL = 1, 2
+
 
 class NativeHost:
     """The epoll connection host. One thread calls ``poll()``; ``send`` and
@@ -215,6 +330,37 @@ class NativeHost:
 
     def close_conn(self, conn: int) -> None:
         self._lib.emqx_host_close_conn(self._h, conn)
+
+    # -- fast-path control plane (thread-safe) -----------------------------
+
+    def enable_fast(self, conn: int, proto_ver: int,
+                    max_inflight: int = 0) -> None:
+        self._lib.emqx_host_enable_fast(self._h, conn, proto_ver,
+                                        max_inflight)
+
+    def disable_fast(self, conn: int) -> None:
+        self._lib.emqx_host_disable_fast(self._h, conn)
+
+    def sub_add(self, owner: int, filter_: str, qos: int = 0,
+                flags: int = 0) -> None:
+        self._lib.emqx_host_sub_add(self._h, owner,
+                                    filter_.encode(), qos, flags)
+
+    def sub_del(self, owner: int, filter_: str) -> None:
+        self._lib.emqx_host_sub_del(self._h, owner, filter_.encode())
+
+    def permit(self, conn: int, topic: str) -> None:
+        self._lib.emqx_host_permit(self._h, conn, topic.encode())
+
+    def permits_flush(self) -> None:
+        self._lib.emqx_host_permits_flush(self._h)
+
+    def stats(self) -> dict[str, int]:
+        return {name: self._lib.emqx_host_stat(self._h, i)
+                for i, name in enumerate(STAT_NAMES)}
+
+    def conn_idle_ms(self, conn: int) -> int:
+        return self._lib.emqx_host_conn_idle_ms(self._h, conn)
 
     def destroy(self) -> None:
         if self._h:

@@ -1,18 +1,44 @@
 // Native connection host: an epoll event loop owning listener + client
 // sockets, doing MQTT framing in C++ and exchanging complete frames with
-// the Python protocol layer through a compact event-record stream.
+// the Python protocol layer through a compact event-record stream —
+// plus, since round 4, the QoS0/1 PUBLISH fast path: parse → match →
+// fan-out entirely in C++ (SURVEY.md §7's "host side in C++" design,
+// the emqx_connection.erl:403-440 → emqx_broker.erl:218-232 hot loop
+// without a VM in the middle).
+//
+// Fast-path contract (enforced here, configured by the Python server):
+//   - a connection only fast-paths after Python enables it post-CONNACK
+//     (clean session, no mountpoint — broker/native_server.py);
+//   - a PUBLISH only fast-paths when qos<=1, retain=0, topic is a plain
+//     non-$ name, v5 property section is empty, AND Python has granted
+//     this (conn, topic) a *permit* — the authz-cache analogue: the
+//     first publish runs the full Python path (authorize, hooks, rules)
+//     and the server grants the permit only if nothing slow listens;
+//   - the match set comes from a mirror of the broker tables
+//     (router.h); any matched *punt marker* (shared sub, persistent
+//     session, non-native subscriber, subscription id) forwards the
+//     frame to Python verbatim — native fan-out only runs when it is
+//     provably complete;
+//   - native QoS1 deliveries allocate packet ids in [32768, 65535];
+//     Python sessions stay in [1, 32767] (session/session.py), so a
+//     subscriber's PUBACK routes unambiguously: high pids are consumed
+//     here, low pids forwarded to the Python session.
 //
 // This is the TPU-era answer to the BEAM's role in the reference
 // (SURVEY.md §2.4 "[NATIVE] BEAM VM schedulers/ports"): the reference
 // relies on the VM's C-level {active,N} socket polling + per-process
 // mailboxes (emqx_connection.erl:132); here a C++ epoll loop performs
-// accept/read/frame/write and batches complete frames up to the driver,
-// which runs the channel FSM and the device router.
+// accept/read/frame/match/fan-out/write and batches the remaining
+// frames up to the driver, which runs the channel FSM and the device
+// router.
 //
 // Threading contract:
 //   - exactly ONE thread calls emqx_host_poll (it runs the event loop);
-//   - emqx_host_send / emqx_host_close_conn are thread-safe and may be
-//     called from any thread (they enqueue + wake the poller via eventfd);
+//   - emqx_host_send / emqx_host_close_conn / the fast-path control
+//     calls (sub_add/sub_del/permit/enable_fast/...) are thread-safe
+//     and may be called from any thread (they enqueue + wake the
+//     poller via eventfd; the loop applies them in ApplyPending, so
+//     table mutations are serialized with matching);
 //   - emqx_host_destroy only after the polling thread has stopped.
 //
 // Event record wire format (host -> Python), little-endian:
@@ -30,21 +56,38 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdint>
 #include <deque>
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "frame.h"
+#include "router.h"
 
 namespace emqx_native {
 namespace {
 
 constexpr size_t kReadChunk = 64 * 1024;
+// Per-connection outbound backlog above which fast-path deliveries to
+// that subscriber are dropped instead of buffered — the mqueue-full
+// drop policy (emqx_mqueue.erl default max_len) applied at the socket.
+constexpr size_t kHighWater = 4 * 1024 * 1024;
+// Native QoS1 packet ids live in [kNativePidBase, 0xFFFF]; Python
+// sessions allocate [1, kNativePidBase-1].
+constexpr uint16_t kNativePidBase = 32768;
+
+inline uint64_t NowMs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC_COARSE, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
 
 struct Conn {
   int fd = -1;
@@ -52,6 +95,52 @@ struct Conn {
   std::string outbuf;   // unsent bytes (partial-write backlog)
   size_t outpos = 0;
   bool want_close = false;  // close once outbuf drains
+  // -- fast path ----------------------------------------------------------
+  bool fast = false;        // Python enabled the PUBLISH fast path
+  uint8_t proto_ver = 4;    // 4 = MQTT 3.1.1, 5 = MQTT 5
+  uint16_t next_pid = kNativePidBase;
+  uint32_t max_inflight = 16384;
+  bool dirty = false;       // has appended-but-unflushed outbuf bytes
+  uint64_t last_rx_ms = 0;  // any inbound bytes (keepalive feed)
+  std::unordered_set<uint16_t> inflight;     // native qos1 pids awaiting ack
+  // qos1 deliveries awaiting an inflight slot — the mqueue analogue
+  // (emqx_mqueue.erl): each element is a serialized PUBLISH with its
+  // pid bytes zeroed + the pid offset to patch at dequeue
+  std::deque<std::pair<std::string, size_t>> pending_qos1;
+  std::unordered_set<std::string> permits;   // publisher-side topic grants
+  std::vector<std::string> own_subs;         // filters owned by this conn
+};
+
+// qos1 mqueue bound per subscriber (emqx_mqueue default max_len 1000);
+// overflow drops the NEW message, counted in kStDropsInflight
+constexpr size_t kMaxPendingQos1 = 1000;
+
+// Fast-path control ops enqueued from Python threads, applied on the
+// poll thread (ApplyPending) so they serialize with matching.
+struct Op {
+  enum Kind : uint8_t {
+    kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush
+  };
+  Kind kind;
+  uint64_t owner = 0;
+  std::string str;       // filter / topic
+  uint8_t qos = 0;
+  uint8_t flags = 0;
+  uint8_t proto_ver = 4;
+  uint32_t max_inflight = 0;
+};
+
+// Stats slot order for emqx_host_stats (keep in sync with
+// native/__init__.py STAT_NAMES).
+enum StatSlot {
+  kStFastIn = 0,       // PUBLISHes fully handled in C++
+  kStFastOut,          // PUBLISH deliveries written by the fast path
+  kStFastBytesOut,
+  kStPunts,            // fast-eligible frames forwarded to Python anyway
+  kStDropsBackpressure,
+  kStDropsInflight,
+  kStNativeAcks,       // QoS1 PUBACKs consumed natively
+  kStatCount
 };
 
 std::string EncodeRecord(uint8_t kind, uint64_t id, const char* data,
@@ -133,6 +222,31 @@ class Host {
     return 0;
   }
 
+  // Thread-safe fast-path control plane (applied in ApplyPending).
+  int Enqueue(Op op) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      pending_ops_.push_back(std::move(op));
+    }
+    Wake();
+    return 0;
+  }
+
+  long Stat(int slot) const {
+    if (slot < 0 || slot >= kStatCount) return -1;
+    return static_cast<long>(stats_[slot].load(std::memory_order_relaxed));
+  }
+
+  long ConnIdleMs(uint64_t id) const {
+    // racy read from other threads is acceptable: the value feeds a
+    // coarse keepalive check, not an invariant
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return -1;
+    uint64_t last = it->second.last_rx_ms;
+    uint64_t now = NowMs();
+    return static_cast<long>(now > last ? now - last : 0);
+  }
+
   // Run one event-loop step on the calling thread; fill `buf` with as
   // many whole event records as fit. Returns bytes written (0 on
   // timeout with no events).
@@ -178,15 +292,18 @@ class Host {
     [[maybe_unused]] ssize_t r = write(wake_fd_, &one, sizeof(one));
   }
 
-  // Move cross-thread sends/closes into connection write buffers.
+  // Move cross-thread sends/closes/control-ops into loop-owned state.
   void ApplyPending() {
     std::vector<std::pair<uint64_t, std::string>> sends;
     std::vector<uint64_t> closes;
+    std::vector<Op> ops;
     {
       std::lock_guard<std::mutex> lk(mu_);
       sends.swap(pending_);
       closes.swap(pending_closes_);
+      ops.swap(pending_ops_);
     }
+    for (auto& op : ops) ApplyOp(op);
     for (auto& [id, data] : sends) {
       auto it = conns_.find(id);
       if (it == conns_.end()) continue;
@@ -199,6 +316,57 @@ class Host {
       it->second.want_close = true;
       if (it->second.outbuf.size() == it->second.outpos)
         Drop(id, "closed_by_host", false);
+    }
+  }
+
+  void ApplyOp(Op& op) {
+    switch (op.kind) {
+      case Op::kSubAdd: {
+        subs_.Add(op.owner, op.str, op.qos, op.flags);
+        // real entries (owner == a live conn id) are torn down with the
+        // conn; remember them on the conn for that cleanup
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end() && !(op.flags & kSubPunt))
+          it->second.own_subs.push_back(op.str);
+        break;
+      }
+      case Op::kSubDel:
+        subs_.Remove(op.owner, op.str);
+        break;
+      case Op::kPermit: {
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end() && it->second.permits.size() < 4096)
+          it->second.permits.insert(op.str);
+        break;
+      }
+      case Op::kEnableFast: {
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end()) {
+          it->second.fast = true;
+          it->second.proto_ver = op.proto_ver;
+          if (op.max_inflight)
+            it->second.max_inflight = op.max_inflight;
+        }
+        break;
+      }
+      case Op::kDisableFast: {
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end()) {
+          it->second.fast = false;
+          it->second.permits.clear();
+          // orphaned native qos1 state would eat acks meant for the
+          // Python session once the conn goes slow-only
+          it->second.inflight.clear();
+          it->second.pending_qos1.clear();
+        }
+        break;
+      }
+      case Op::kPermitsFlush:
+        // topology changed (rule created, authz source changed, trace
+        // enabled...): every publisher re-earns its permits through the
+        // full Python path
+        for (auto& [id, c] : conns_) c.permits.clear();
+        break;
     }
   }
 
@@ -259,27 +427,238 @@ class Host {
 
   void Read(uint64_t id, Conn& c) {
     uint8_t chunk[kReadChunk];
+    c.last_rx_ms = NowMs();
+    bool alive = true;
     for (;;) {
       ssize_t n = recv(c.fd, chunk, sizeof(chunk), 0);
       if (n > 0) {
         std::vector<std::string> frames;
         FrameStatus st = c.framer.Feed(chunk, static_cast<size_t>(n), &frames);
-        for (auto& f : frames)
-          events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+        for (auto& f : frames) {
+          if (!c.fast || !TryFast(id, c, f))
+            events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+        }
         if (st != FrameStatus::kOk) {
           Drop(id, "frame_error", true);
-          return;
+          alive = false;
+          break;
         }
-        if (static_cast<size_t>(n) < sizeof(chunk)) return;
+        if (static_cast<size_t>(n) < sizeof(chunk)) break;
       } else if (n == 0) {
         Drop(id, "sock_closed", true);
-        return;
+        alive = false;
+        break;
       } else {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
         Drop(id, "sock_error", true);
-        return;
+        alive = false;
+        break;
       }
+    }
+    (void)alive;
+    FlushDirty();
+  }
+
+  // Flush every connection the fast path appended to during this read
+  // batch — one send() per touched subscriber instead of one per
+  // delivered message.
+  void FlushDirty() {
+    if (dirty_.empty()) return;
+    std::vector<uint64_t> dirty;
+    dirty.swap(dirty_);
+    for (uint64_t id : dirty) {
+      auto it = conns_.find(id);
+      if (it == conns_.end()) continue;
+      it->second.dirty = false;
+      Flush(id, it->second);
+    }
+  }
+
+  // -- fast path ----------------------------------------------------------
+
+  // Returns true when the frame was fully handled natively (consumed);
+  // false forwards it to Python (the slow path), which is always safe.
+  bool TryFast(uint64_t id, Conn& c, const std::string& f) {
+    uint8_t h = static_cast<uint8_t>(f[0]);
+    uint8_t type = h >> 4;
+    if (type == 4) return TryFastPuback(id, c, f);
+    if (type != 3) return false;  // only PUBLISH / PUBACK fast-path
+    uint8_t qos = (h >> 1) & 3;
+    bool retain = h & 1;
+    if (qos > 1 || retain) return false;  // QoS2 / retained: Python path
+    // parse: [h][varint remaining][topic u16][pid? u16][props? varint][payload]
+    size_t pos = 1;
+    while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
+    pos++;  // last varint byte (framer already validated the length)
+    if (pos + 2 > f.size()) return false;
+    uint16_t tlen = (static_cast<uint8_t>(f[pos]) << 8) |
+                    static_cast<uint8_t>(f[pos + 1]);
+    pos += 2;
+    if (pos + tlen > f.size() || tlen == 0) return false;
+    std::string_view topic(f.data() + pos, tlen);
+    pos += tlen;
+    if (topic[0] == '$') return false;  // $SYS / $delayed / ...: Python
+    for (char ch : topic)
+      if (ch == '+' || ch == '#' || ch == '\0') return false;  // invalid name
+    uint16_t pid = 0;
+    if (qos == 1) {
+      if (pos + 2 > f.size()) return false;
+      pid = (static_cast<uint8_t>(f[pos]) << 8) |
+            static_cast<uint8_t>(f[pos + 1]);
+      pos += 2;
+    }
+    if (c.proto_ver == 5) {
+      // fast path requires an empty property section: a topic alias,
+      // message expiry or response topic needs the Python channel
+      if (pos >= f.size() || f[pos] != 0) return false;
+      pos++;
+    }
+    std::string_view payload(f.data() + pos, f.size() - pos);
+    key_scratch_.assign(topic.data(), topic.size());  // no per-msg alloc
+    if (c.permits.find(key_scratch_) == c.permits.end())
+      return false;  // unpermitted topic: full Python path (authz, rules)
+    match_scratch_.clear();
+    subs_.Match(topic, &match_scratch_);
+    for (const SubEntry* e : match_scratch_) {
+      if (e->flags & kSubPunt) {
+        // a shared-sub group / persistent session / non-native
+        // subscriber matched: Python must run the WHOLE fan-out (it
+        // re-matches and delivers to the native subscribers too)
+        stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+        return false;
+      }
+    }
+    // native fan-out is complete; ack the publisher first (the
+    // reference sends PUBACK as soon as emqx_broker:publish returns)
+    if (qos == 1) {
+      char ack[4] = {0x40, 0x02, static_cast<char>(pid >> 8),
+                     static_cast<char>(pid & 0xFF)};
+      c.outbuf.append(ack, 4);
+      MarkDirty(id, c);
+    }
+    stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
+    // shared serialized frames per (proto, qos=0) — qos1 frames differ
+    // per target (unique pid), built in place
+    std::string frame_v4, frame_v5;
+    for (const SubEntry* e : match_scratch_) {
+      if ((e->flags & kSubNoLocal) && e->owner == id) continue;
+      auto it = conns_.find(e->owner);
+      if (it == conns_.end()) continue;  // stale entry (conn mid-close)
+      Conn& t = it->second;
+      if (t.outbuf.size() - t.outpos > kHighWater) {
+        stats_[kStDropsBackpressure].fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      uint8_t out_qos = qos < e->qos ? qos : e->qos;
+      if (out_qos == 0) {
+        std::string& shared = t.proto_ver == 5 ? frame_v5 : frame_v4;
+        if (shared.empty())
+          BuildPublish(&shared, topic, payload, 0, 0, t.proto_ver == 5);
+        t.outbuf += shared;
+        stats_[kStFastBytesOut].fetch_add(shared.size(),
+                                          std::memory_order_relaxed);
+      } else {
+        if (t.inflight.size() >= t.max_inflight) {
+          // receive window full: queue (the mqueue), drop on overflow
+          if (t.pending_qos1.size() >= kMaxPendingQos1) {
+            stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
+            continue;
+          }
+          pub_scratch_.clear();
+          // pid offset = header(1) + varint + topic length field(2) + topic
+          BuildPublish(&pub_scratch_, topic, payload, 1, 0,
+                       t.proto_ver == 5);
+          size_t var_len = 1;
+          while (static_cast<uint8_t>(pub_scratch_[var_len]) & 0x80)
+            var_len++;
+          size_t pid_off = var_len + 1 + 2 + topic.size();
+          t.pending_qos1.emplace_back(pub_scratch_, pid_off);
+          continue;
+        }
+        uint16_t tp = NextPid(t);
+        pub_scratch_.clear();
+        BuildPublish(&pub_scratch_, topic, payload, 1, tp,
+                     t.proto_ver == 5);
+        t.outbuf += pub_scratch_;
+        stats_[kStFastBytesOut].fetch_add(pub_scratch_.size(),
+                                          std::memory_order_relaxed);
+      }
+      stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
+      MarkDirty(e->owner, t);
+    }
+    return true;
+  }
+
+  bool TryFastPuback(uint64_t id, Conn& c, const std::string& f) {
+    // PUBACK: [h=0x40][varint][pid u16][v5: rc, props...] — pids >=
+    // kNativePidBase belong to the native inflight set; lower pids are
+    // the Python session's and are forwarded
+    size_t pos = 1;
+    while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
+    pos++;
+    if (pos + 2 > f.size()) return false;
+    uint16_t pid = (static_cast<uint8_t>(f[pos]) << 8) |
+                   static_cast<uint8_t>(f[pos + 1]);
+    if (pid < kNativePidBase) return false;
+    c.inflight.erase(pid);
+    stats_[kStNativeAcks].fetch_add(1, std::memory_order_relaxed);
+    // the freed window slot drains the qos1 queue (mqueue dequeue)
+    while (!c.pending_qos1.empty() && c.inflight.size() < c.max_inflight) {
+      auto [frame, pid_off] = std::move(c.pending_qos1.front());
+      c.pending_qos1.pop_front();
+      uint16_t np = NextPid(c);
+      frame[pid_off] = static_cast<char>(np >> 8);
+      frame[pid_off + 1] = static_cast<char>(np & 0xFF);
+      c.outbuf += frame;
+      stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
+      stats_[kStFastBytesOut].fetch_add(frame.size(),
+                                        std::memory_order_relaxed);
+      MarkDirty(id, c);
+    }
+    return true;
+  }
+
+  uint16_t NextPid(Conn& c) {
+    // [kNativePidBase, 0xFFFF], skipping ids still in flight
+    for (int guard = 0; guard < 0x8000; guard++) {
+      uint16_t p = c.next_pid;
+      c.next_pid = p == 0xFFFF ? kNativePidBase : p + 1;
+      if (c.inflight.find(p) == c.inflight.end()) {
+        c.inflight.insert(p);
+        return p;
+      }
+    }
+    return kNativePidBase;  // unreachable: inflight capped below 0x8000
+  }
+
+  static void BuildPublish(std::string* out, std::string_view topic,
+                           std::string_view payload, uint8_t qos,
+                           uint16_t pid, bool v5) {
+    size_t remaining = 2 + topic.size() + (qos ? 2 : 0) + (v5 ? 1 : 0) +
+                       payload.size();
+    out->push_back(static_cast<char>(0x30 | (qos << 1)));
+    size_t r = remaining;
+    do {
+      uint8_t b = r & 0x7F;
+      r >>= 7;
+      out->push_back(static_cast<char>(r ? b | 0x80 : b));
+    } while (r);
+    out->push_back(static_cast<char>(topic.size() >> 8));
+    out->push_back(static_cast<char>(topic.size() & 0xFF));
+    out->append(topic.data(), topic.size());
+    if (qos) {
+      out->push_back(static_cast<char>(pid >> 8));
+      out->push_back(static_cast<char>(pid & 0xFF));
+    }
+    if (v5) out->push_back('\0');  // empty property section
+    out->append(payload.data(), payload.size());
+  }
+
+  void MarkDirty(uint64_t id, Conn& c) {
+    if (!c.dirty) {
+      c.dirty = true;
+      dirty_.push_back(id);
     }
   }
 
@@ -314,6 +693,10 @@ class Host {
   void Drop(uint64_t id, const char* reason, bool notify) {
     auto it = conns_.find(id);
     if (it == conns_.end()) return;
+    // tear down this conn's real subscription entries; punt markers are
+    // owned by Python tokens and removed through the broker observer
+    for (const std::string& filt : it->second.own_subs)
+      subs_.Remove(id, filt);
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
     close(it->second.fd);
     conns_.erase(it);
@@ -333,6 +716,14 @@ class Host {
   std::mutex mu_;
   std::vector<std::pair<uint64_t, std::string>> pending_;
   std::vector<uint64_t> pending_closes_;
+  std::vector<Op> pending_ops_;
+  // fast path (poll-thread-owned)
+  SubTable subs_;
+  std::vector<const SubEntry*> match_scratch_;
+  std::string pub_scratch_;
+  std::string key_scratch_;
+  std::vector<uint64_t> dirty_;
+  std::atomic<uint64_t> stats_[kStatCount] = {};
 };
 
 }  // namespace
@@ -369,8 +760,100 @@ int emqx_host_close_conn(void* h, uint64_t conn) {
   return static_cast<emqx_native::Host*>(h)->CloseConn(conn);
 }
 
+// --- fast-path control plane (thread-safe, applied on the poll thread) ----
+
+int emqx_host_enable_fast(void* h, uint64_t conn, int proto_ver,
+                          uint32_t max_inflight) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kEnableFast;
+  op.owner = conn;
+  op.proto_ver = static_cast<uint8_t>(proto_ver);
+  op.max_inflight = max_inflight;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_disable_fast(void* h, uint64_t conn) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kDisableFast;
+  op.owner = conn;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// flags: bit0 = punt marker, bit1 = no-local
+int emqx_host_sub_add(void* h, uint64_t owner, const char* filter,
+                      uint8_t qos, uint8_t flags) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSubAdd;
+  op.owner = owner;
+  op.str = filter;
+  op.qos = qos;
+  op.flags = flags;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_sub_del(void* h, uint64_t owner, const char* filter) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSubDel;
+  op.owner = owner;
+  op.str = filter;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_permit(void* h, uint64_t conn, const char* topic) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kPermit;
+  op.owner = conn;
+  op.str = topic;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_permits_flush(void* h) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kPermitsFlush;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+long emqx_host_stat(void* h, int slot) {
+  return static_cast<emqx_native::Host*>(h)->Stat(slot);
+}
+
+long emqx_host_conn_idle_ms(void* h, uint64_t conn) {
+  return static_cast<emqx_native::Host*>(h)->ConnIdleMs(conn);
+}
+
 void emqx_host_destroy(void* h) {
   delete static_cast<emqx_native::Host*>(h);
+}
+
+// --- standalone sub table (differential testing vs router/trie.py) --------
+
+void* emqx_subtable_create() { return new emqx_native::SubTable(); }
+
+void emqx_subtable_destroy(void* t) {
+  delete static_cast<emqx_native::SubTable*>(t);
+}
+
+void emqx_subtable_add(void* t, uint64_t owner, const char* filter,
+                       uint8_t qos, uint8_t flags) {
+  static_cast<emqx_native::SubTable*>(t)->Add(owner, filter, qos, flags);
+}
+
+int emqx_subtable_del(void* t, uint64_t owner, const char* filter) {
+  return static_cast<emqx_native::SubTable*>(t)->Remove(owner, filter) ? 1 : 0;
+}
+
+// Fills out[] with the owners of every matching entry; returns the
+// total match count (callers re-invoke with a larger buffer if needed).
+long emqx_subtable_match(void* t, const char* topic, uint64_t* out,
+                         long cap) {
+  std::vector<const emqx_native::SubEntry*> hits;
+  static_cast<emqx_native::SubTable*>(t)->Match(topic, &hits);
+  long n = 0;
+  for (const auto* e : hits) {
+    if (n < cap) out[n] = e->owner;
+    n++;
+  }
+  return n;
 }
 
 // --- standalone framer (for parity tests + non-socket embedding) ----------

@@ -1,0 +1,427 @@
+// Native MQTT load generator — the emqtt-bench analogue (the reference
+// measures its 1M msg/s with an Erlang client fleet; a Python client
+// fleet tops out around 15k msg/s total and would measure itself, not
+// the broker). Single thread, nonblocking sockets, one epoll loop for
+// the whole fleet: subscribers count deliveries and sample end-to-end
+// latency from an 8-byte monotonic-ns timestamp at the head of every
+// payload; publishers blast with TCP backpressure as the only pacing.
+//
+// Driven from bench.py over ctypes (emqx_loadgen_run blocks; ctypes
+// releases the GIL so the broker's poll thread keeps running).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "frame.h"
+
+namespace {
+
+using emqx_native::Framer;
+using emqx_native::FrameStatus;
+
+inline uint64_t NowNs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+void PutU16(std::string* s, uint16_t v) {
+  s->push_back(static_cast<char>(v >> 8));
+  s->push_back(static_cast<char>(v & 0xFF));
+}
+
+void PutVarint(std::string* s, size_t v) {
+  do {
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    s->push_back(static_cast<char>(v ? b | 0x80 : b));
+  } while (v);
+}
+
+std::string Connect(const std::string& clientid, int proto_ver) {
+  std::string body;
+  PutU16(&body, 4);
+  body += "MQTT";
+  body.push_back(static_cast<char>(proto_ver));
+  body.push_back(0x02);  // clean start
+  PutU16(&body, 60);     // keepalive
+  if (proto_ver == 5) body.push_back('\0');  // empty properties
+  PutU16(&body, static_cast<uint16_t>(clientid.size()));
+  body += clientid;
+  std::string f;
+  f.push_back(0x10);
+  PutVarint(&f, body.size());
+  return f + body;
+}
+
+std::string Subscribe(uint16_t pid, const std::string& filt, uint8_t qos,
+                      int proto_ver) {
+  std::string body;
+  PutU16(&body, pid);
+  if (proto_ver == 5) body.push_back('\0');
+  PutU16(&body, static_cast<uint16_t>(filt.size()));
+  body += filt;
+  body.push_back(static_cast<char>(qos));
+  std::string f;
+  f.push_back(static_cast<char>(0x82));
+  PutVarint(&f, body.size());
+  return f + body;
+}
+
+std::string Publish(const std::string& topic, const std::string& payload,
+                    uint8_t qos, uint16_t pid, int proto_ver) {
+  std::string body;
+  PutU16(&body, static_cast<uint16_t>(topic.size()));
+  body += topic;
+  if (qos) PutU16(&body, pid);
+  if (proto_ver == 5) body.push_back('\0');
+  body += payload;
+  std::string f;
+  f.push_back(static_cast<char>(0x30 | (qos << 1)));
+  PutVarint(&f, body.size());
+  return f + body;
+}
+
+std::string Puback(uint16_t pid) {
+  std::string f;
+  f.push_back(0x40);
+  f.push_back(0x02);
+  PutU16(&f, pid);
+  return f;
+}
+
+struct LgConn {
+  int fd = -1;
+  Framer framer{1 << 20};
+  std::string outbuf;
+  size_t outpos = 0;
+  bool connacked = false;
+  bool subacked = false;
+  bool is_sub = false;
+  uint32_t idx = 0;
+};
+
+struct Loadgen {
+  std::vector<LgConn> conns;
+  int ep = -1;
+  uint64_t received = 0, sent = 0, acks = 0, errors = 0;
+  std::vector<uint64_t> lat;
+  int proto_ver = 4;
+  uint8_t qos = 0;
+
+  ~Loadgen() {
+    for (auto& c : conns)
+      if (c.fd >= 0) close(c.fd);
+    if (ep >= 0) close(ep);
+  }
+
+  bool FlushOut(LgConn& c) {
+    while (c.outpos < c.outbuf.size()) {
+      ssize_t n = send(c.fd, c.outbuf.data() + c.outpos,
+                       c.outbuf.size() - c.outpos, MSG_NOSIGNAL);
+      if (n > 0) {
+        c.outpos += static_cast<size_t>(n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.u32 = c.idx;
+        epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+        return true;
+      } else if (n < 0 && errno == EINTR) {
+        continue;
+      } else {
+        return false;
+      }
+    }
+    c.outbuf.clear();
+    c.outpos = 0;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = c.idx;
+    epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+    return true;
+  }
+
+  void OnFrame(LgConn& c, const std::string& f) {
+    uint8_t type = static_cast<uint8_t>(f[0]) >> 4;
+    if (type == 2) {  // CONNACK
+      c.connacked = true;
+    } else if (type == 9) {  // SUBACK
+      c.subacked = true;
+    } else if (type == 3) {  // PUBLISH delivery
+      uint8_t dqos = (static_cast<uint8_t>(f[0]) >> 1) & 3;
+      size_t pos = 1;
+      while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
+      pos++;
+      if (pos + 2 > f.size()) return;
+      uint16_t tlen = (static_cast<uint8_t>(f[pos]) << 8) |
+                      static_cast<uint8_t>(f[pos + 1]);
+      pos += 2 + tlen;
+      if (dqos) {
+        if (pos + 2 > f.size()) return;
+        uint16_t pid = (static_cast<uint8_t>(f[pos]) << 8) |
+                       static_cast<uint8_t>(f[pos + 1]);
+        pos += 2;
+        c.outbuf += Puback(pid);
+      }
+      if (proto_ver == 5 && pos < f.size()) {
+        uint8_t plen = static_cast<uint8_t>(f[pos]);
+        pos += 1 + plen;  // bench properties always fit one varint byte
+      }
+      if (pos + 8 <= f.size()) {
+        uint64_t stamp;
+        memcpy(&stamp, f.data() + pos, 8);
+        uint64_t now = NowNs();
+        if (now > stamp && now - stamp < 60ull * 1000000000ull)
+          lat.push_back(now - stamp);
+      }
+      received++;
+    } else if (type == 4) {  // PUBACK for our qos1 publishes
+      acks++;
+    }
+  }
+
+  // Pump readable/writable conns once; returns false on fatal error.
+  bool Pump(int timeout_ms) {
+    epoll_event evs[128];
+    int n = epoll_wait(ep, evs, 128, timeout_ms);
+    if (n < 0) return errno == EINTR;
+    uint8_t chunk[64 * 1024];
+    for (int i = 0; i < n; i++) {
+      LgConn& c = conns[evs[i].data.u32];
+      if (c.fd < 0) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        errors++;
+        close(c.fd);
+        c.fd = -1;
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) {
+        if (!FlushOut(c)) {
+          errors++;
+          close(c.fd);
+          c.fd = -1;
+          continue;
+        }
+      }
+      if (!(evs[i].events & EPOLLIN)) continue;
+      for (;;) {
+        ssize_t r = recv(c.fd, chunk, sizeof(chunk), 0);
+        if (r > 0) {
+          std::vector<std::string> frames;
+          if (c.framer.Feed(chunk, static_cast<size_t>(r), &frames) !=
+              FrameStatus::kOk) {
+            errors++;
+            close(c.fd);
+            c.fd = -1;
+            break;
+          }
+          for (auto& f : frames) OnFrame(c, f);
+          if (!c.outbuf.empty()) FlushOut(c);  // pubacks
+          if (static_cast<size_t>(r) < sizeof(chunk)) break;
+        } else if (r == 0) {
+          close(c.fd);
+          c.fd = -1;
+          break;
+        } else {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          if (errno == EINTR) continue;
+          errors++;
+          close(c.fd);
+          c.fd = -1;
+          break;
+        }
+      }
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// out[8]: sent, received, wall_ns, p50_ns, p99_ns, max_ns, acks, errors
+//
+// window = 0: blast mode — publishers keep ~64KB buffered and TCP
+//   backpressure paces them; measures peak throughput, but delivery
+//   latency then measures queue depth, not the broker.
+// window > 0: windowed mode — total unreceived messages are capped at
+//   `window`, so latency percentiles measure the broker's delivery
+//   path at a sustainable rate (no coordinated omission).
+// warmup != 0: each publisher first sends one message per topic and
+//   waits, letting the broker's permit machinery move those
+//   (conn, topic) pairs onto the native fast path before the clock
+//   starts (permits are per-connection, so warming must happen in-run).
+int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
+                     uint32_t n_pubs, uint32_t msgs_per_pub, uint8_t qos,
+                     uint32_t payload_len, int proto_ver, int idle_timeout_ms,
+                     uint32_t window, int warmup, uint64_t* out) {
+  Loadgen lg;
+  lg.proto_ver = proto_ver;
+  lg.qos = qos;
+  uint32_t total = n_subs + n_pubs;
+  lg.conns.resize(total);
+  lg.ep = epoll_create1(EPOLL_CLOEXEC);
+  if (lg.ep < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -2;
+
+  for (uint32_t i = 0; i < total; i++) {
+    LgConn& c = lg.conns[i];
+    c.idx = i;
+    c.is_sub = i < n_subs;
+    c.fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (c.fd < 0) return -3;
+    int one = 1;
+    setsockopt(c.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(c.fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 &&
+        errno != EINPROGRESS)
+      return -4;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = i;
+    epoll_ctl(lg.ep, EPOLL_CTL_ADD, c.fd, &ev);
+    std::string cid = (c.is_sub ? "lgs" : "lgp") + std::to_string(i);
+    c.outbuf += Connect(cid, proto_ver);
+    lg.FlushOut(c);
+  }
+
+  // wait for all CONNACKs, then all SUBACKs (handshake deadline 15s)
+  uint64_t deadline = NowNs() + 15ull * 1000000000ull;
+  auto all = [&](bool LgConn::* flag, bool subs_only) {
+    for (auto& c : lg.conns) {
+      if (subs_only && !c.is_sub) continue;
+      if (c.fd >= 0 && !(c.*flag)) return false;
+    }
+    return true;
+  };
+  while (!all(&LgConn::connacked, false)) {
+    if (NowNs() > deadline || !lg.Pump(100)) return -5;
+  }
+  for (uint32_t i = 0; i < n_subs; i++) {
+    LgConn& c = lg.conns[i];
+    if (c.fd < 0) continue;
+    c.outbuf += Subscribe(1, "lg/" + std::to_string(i) + "/+", qos, proto_ver);
+    lg.FlushOut(c);
+  }
+  while (!all(&LgConn::subacked, true)) {
+    if (NowNs() > deadline || !lg.Pump(100)) return -6;
+  }
+
+  uint64_t expected = static_cast<uint64_t>(n_pubs) * msgs_per_pub;
+  lg.lat.reserve(std::min<uint64_t>(expected, 4u << 20));
+  std::string pad(payload_len > 8 ? payload_len - 8 : 0, 'x');
+  std::vector<uint32_t> next_msg(n_pubs, 0);
+
+  if (warmup) {
+    // one slow-path message per (publisher, topic) pair earns the
+    // publish permits; then idle so the broker's grant step runs
+    uint64_t warm_expected = static_cast<uint64_t>(n_pubs) * n_subs;
+    for (uint32_t j = 0; j < n_pubs; j++) {
+      LgConn& c = lg.conns[n_subs + j];
+      if (c.fd < 0) continue;
+      for (uint32_t k = 0; k < n_subs; k++) {
+        uint64_t stamp = NowNs();
+        std::string payload(reinterpret_cast<char*>(&stamp), 8);
+        payload += pad;
+        c.outbuf += Publish("lg/" + std::to_string(k) + "/m", payload, 0, 0,
+                            proto_ver);
+      }
+      lg.FlushOut(c);
+    }
+    uint64_t warm_deadline = NowNs() + 20ull * 1000000000ull;
+    while (lg.received < warm_expected && NowNs() < warm_deadline) {
+      if (!lg.Pump(100)) break;
+    }
+    // grant latency: the broker queues permits and applies them on an
+    // idle poll step; 600ms of pumping is comfortably past that
+    uint64_t settle_until = NowNs() + 600ull * 1000000ull;
+    while (NowNs() < settle_until) lg.Pump(50);
+    lg.received = lg.sent = lg.acks = 0;
+    lg.lat.clear();
+  }
+
+  // blast/windowed: publisher j round-robins the subscriber topics;
+  // payload head is the publish timestamp (ns), refreshed per message
+  uint64_t t0 = NowNs();
+  uint64_t last_progress = t0;
+  uint64_t last_received = 0;
+  uint16_t pid = 1;
+  while (true) {
+    // fill publisher buffers (~64KB each; EAGAIN pacing does the rest;
+    // in windowed mode the in-flight cap paces instead)
+    bool done_sending = true;
+    for (uint32_t j = 0; j < n_pubs; j++) {
+      LgConn& c = lg.conns[n_subs + j];
+      if (c.fd < 0) continue;
+      while (next_msg[j] < msgs_per_pub &&
+             c.outbuf.size() - c.outpos < 64 * 1024 &&
+             (window == 0 || lg.sent - lg.received < window)) {
+        uint64_t stamp = NowNs();
+        std::string payload(reinterpret_cast<char*>(&stamp), 8);
+        payload += pad;
+        std::string topic =
+            "lg/" + std::to_string((j + next_msg[j]) % n_subs) + "/m";
+        if (qos) pid = pid == 0x7FFF ? 1 : pid + 1;
+        c.outbuf += Publish(topic, payload, qos, pid, proto_ver);
+        next_msg[j]++;
+        lg.sent++;
+      }
+      if (next_msg[j] < msgs_per_pub) done_sending = false;
+      if (!c.outbuf.empty() && !lg.FlushOut(c)) {
+        lg.errors++;
+        close(c.fd);
+        c.fd = -1;
+      }
+    }
+    if (lg.received >= expected) break;
+    if (!lg.Pump(done_sending ? 50 : 1)) break;
+    uint64_t now = NowNs();
+    if (lg.received != last_received) {
+      last_received = lg.received;
+      last_progress = now;
+    } else if (now - last_progress >
+               static_cast<uint64_t>(idle_timeout_ms) * 1000000ull) {
+      break;  // stalled: report what we have
+    }
+  }
+  uint64_t wall = NowNs() - t0;
+
+  uint64_t p50 = 0, p99 = 0, mx = 0;
+  if (!lg.lat.empty()) {
+    size_t i50 = lg.lat.size() / 2;
+    size_t i99 = lg.lat.size() * 99 / 100;
+    if (i99 >= lg.lat.size()) i99 = lg.lat.size() - 1;
+    std::nth_element(lg.lat.begin(), lg.lat.begin() + i50, lg.lat.end());
+    p50 = lg.lat[i50];
+    std::nth_element(lg.lat.begin(), lg.lat.begin() + i99, lg.lat.end());
+    p99 = lg.lat[i99];
+    mx = *std::max_element(lg.lat.begin(), lg.lat.end());
+  }
+  out[0] = lg.sent;
+  out[1] = lg.received;
+  out[2] = wall;
+  out[3] = p50;
+  out[4] = p99;
+  out[5] = mx;
+  out[6] = lg.acks;
+  out[7] = lg.errors;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,180 @@
+// Native subscription table: exact-match map + wildcard trie, mirrored
+// from the Python broker tables (emqx_tpu/broker/broker.py) by the
+// native server. This is the C++ twin of the host-oracle trie
+// (emqx_tpu/router/trie.py — itself the emqx_trie.erl:113-160 walk),
+// specialised for the PUBLISH fast path: entries carry the owning
+// connection and delivery flags, and a *punt marker* entry means "this
+// filter's subscriber cannot be served natively" (shared subscription,
+// persistent session, non-native transport, cross-node route, v5
+// subscription identifier). A publish whose match set contains any punt
+// marker is forwarded to Python verbatim, so native fan-out is only
+// ever performed when it is COMPLETE.
+//
+// Threading: mutated and read exclusively on the host's poll thread
+// (Python-side calls enqueue ops that the loop applies in ApplyPending),
+// so no locks here.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace emqx_native {
+
+struct SubEntry {
+  uint64_t owner = 0;  // conn id for real entries; opaque token for punts
+  uint8_t qos = 0;     // granted (subscription) max qos
+  uint8_t flags = 0;   // kSubPunt / kSubNoLocal
+};
+
+constexpr uint8_t kSubPunt = 1;     // matched => forward frame to Python
+constexpr uint8_t kSubNoLocal = 2;  // MQTT5 no-local: skip the publisher
+
+// Split a topic/filter on '/'; MQTT keeps empty levels ("a//b" is three
+// levels, the middle one empty) — emqx_topic.erl:words/1 semantics.
+inline void SplitLevels(std::string_view s, std::vector<std::string_view>* out) {
+  out->clear();
+  size_t start = 0;
+  for (size_t i = 0; i <= s.size(); i++) {
+    if (i == s.size() || s[i] == '/') {
+      out->push_back(s.substr(start, i - start));
+      start = i + 1;
+    }
+  }
+}
+
+class SubTable {
+ public:
+  // Insert or update (owner, filter). A second add with the same owner
+  // and filter updates qos/flags in place (resubscribe upgrades).
+  void Add(uint64_t owner, const std::string& filter, uint8_t qos,
+           uint8_t flags) {
+    if (filter.find('+') == std::string::npos &&
+        filter.find('#') == std::string::npos) {
+      Upsert(&exact_[filter], owner, qos, flags);
+      return;
+    }
+    SplitLevels(filter, &scratch_levels_);
+    Node* n = &root_;
+    for (size_t i = 0; i < scratch_levels_.size(); i++) {
+      std::string_view w = scratch_levels_[i];
+      if (w == "#") {
+        // '#' is only valid as the last level; store at the node ABOVE
+        Upsert(&n->hash, owner, qos, flags);
+        return;
+      }
+      if (w == "+") {
+        if (!n->plus) n->plus = std::make_unique<Node>();
+        n = n->plus.get();
+      } else {
+        auto& kid = n->kids[std::string(w)];
+        if (!kid) kid = std::make_unique<Node>();
+        n = kid.get();
+      }
+    }
+    Upsert(&n->here, owner, qos, flags);
+  }
+
+  // Remove (owner, filter); returns whether an entry was removed.
+  bool Remove(uint64_t owner, const std::string& filter) {
+    if (filter.find('+') == std::string::npos &&
+        filter.find('#') == std::string::npos) {
+      auto it = exact_.find(filter);
+      if (it == exact_.end()) return false;
+      bool hit = Erase(&it->second, owner);
+      if (it->second.empty()) exact_.erase(it);
+      return hit;
+    }
+    SplitLevels(filter, &scratch_levels_);
+    Node* n = &root_;
+    for (size_t i = 0; i < scratch_levels_.size(); i++) {
+      std::string_view w = scratch_levels_[i];
+      if (w == "#") return Erase(&n->hash, owner);
+      if (w == "+") {
+        if (!n->plus) return false;
+        n = n->plus.get();
+      } else {
+        auto it = n->kids.find(std::string(w));
+        if (it == n->kids.end()) return false;
+        n = it->second.get();
+      }
+    }
+    return Erase(&n->here, owner);
+    // empty interior nodes are left in place: subscription churn
+    // re-creates them constantly and the per-node footprint is tiny
+  }
+
+  // Append every entry matching `topic` to *out. The caller guarantees
+  // the topic is a plain name (no wildcards, no leading '$' — the fast
+  // path punts those before matching, which also gives the MQTT rule
+  // that root wildcards must not match $-topics for free).
+  void Match(std::string_view topic, std::vector<const SubEntry*>* out) const {
+    key_scratch_.assign(topic.data(), topic.size());
+    auto it = exact_.find(key_scratch_);
+    if (it != exact_.end())
+      for (const auto& e : it->second) out->push_back(&e);
+    SplitLevels(topic, &match_levels_);
+    MatchNode(&root_, 0, out);
+  }
+
+  size_t exact_count() const { return exact_.size(); }
+
+ private:
+  struct Node {
+    std::unordered_map<std::string, std::unique_ptr<Node>> kids;
+    std::unique_ptr<Node> plus;
+    std::vector<SubEntry> here;  // filters ending exactly at this node
+    std::vector<SubEntry> hash;  // filters ending in '#' one level below
+  };
+
+  static void Upsert(std::vector<SubEntry>* v, uint64_t owner, uint8_t qos,
+                     uint8_t flags) {
+    for (auto& e : *v) {
+      if (e.owner == owner) {
+        e.qos = qos;
+        e.flags = flags;
+        return;
+      }
+    }
+    v->push_back(SubEntry{owner, qos, flags});
+  }
+
+  static bool Erase(std::vector<SubEntry>* v, uint64_t owner) {
+    for (size_t i = 0; i < v->size(); i++) {
+      if ((*v)[i].owner == owner) {
+        (*v)[i] = v->back();
+        v->pop_back();
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void MatchNode(const Node* n, size_t i,
+                 std::vector<const SubEntry*>* out) const {
+    // "a/#" matches "a", "a/b", ... — the '#' list at node a covers the
+    // remainder including zero further levels (emqx_trie 'match #')
+    for (const auto& e : n->hash) out->push_back(&e);
+    if (i == match_levels_.size()) {
+      for (const auto& e : n->here) out->push_back(&e);
+      return;
+    }
+    // assign() reuses the scratch capacity: the per-message hot loop
+    // must not heap-allocate per level just to query the kids map
+    key_scratch_.assign(match_levels_[i].data(), match_levels_[i].size());
+    auto it = n->kids.find(key_scratch_);
+    if (it != n->kids.end()) MatchNode(it->second.get(), i + 1, out);
+    if (n->plus) MatchNode(n->plus.get(), i + 1, out);
+  }
+
+  Node root_;
+  std::unordered_map<std::string, std::vector<SubEntry>> exact_;
+  std::vector<std::string_view> scratch_levels_;
+  mutable std::vector<std::string_view> match_levels_;
+  mutable std::string key_scratch_;
+};
+
+}  // namespace emqx_native

@@ -79,6 +79,10 @@ class RuleEngine:
         }
         self._console_out: list[dict] = []       # console sink (tests/CLI)
         self._hooked: Optional[Hooks] = None
+        # fired after every create/delete — the native host flushes its
+        # publish permits here so a new rule is seen by topics that were
+        # already fast-pathing (broker/native_server.py)
+        self.on_topology_change: list = []
         # topic index over rule FROM filters: per-publish rule lookup is
         # O(matched filters), not O(rules) — the emqx_rule_engine.erl
         # :198-205 topic-index semantics (host side); with a RouterModel
@@ -121,6 +125,8 @@ class RuleEngine:
         self.rules[id] = rule
         self._index(rule)
         self.metrics.create_metrics(id, RULE_COUNTERS)
+        for cb in self.on_topology_change:
+            cb()
         return rule
 
     def delete_rule(self, id: str) -> bool:
@@ -129,6 +135,8 @@ class RuleEngine:
         rule = self.rules.pop(id, None)
         if rule is not None:
             self._unindex(rule)
+            for cb in self.on_topology_change:
+                cb()
         return rule is not None
 
     def _index(self, rule: Rule) -> None:

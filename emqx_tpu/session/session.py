@@ -65,9 +65,16 @@ class Session:
 
     # -- packet ids --------------------------------------------------------
 
+    # Outbound packet ids stay in [1, 32767]: the native host's fast
+    # path allocates [32768, 65535] on the same wire connection
+    # (native/src/host.cc kNativePidBase), so a subscriber's PUBACK
+    # routes unambiguously — high pids consumed in C++, low pids here.
+    # 32767 concurrent unacked deliveries is far beyond any receive-max.
+    PKT_ID_SPACE = 32767
+
     def next_packet_id(self) -> int:
-        for _ in range(65535):
-            self._next_pkt_id = self._next_pkt_id % 65535 + 1
+        for _ in range(self.PKT_ID_SPACE):
+            self._next_pkt_id = self._next_pkt_id % self.PKT_ID_SPACE + 1
             if not self.inflight.contain(self._next_pkt_id):
                 return self._next_pkt_id
         raise SessionError(P.RC_RECEIVE_MAXIMUM_EXCEEDED)

@@ -162,9 +162,12 @@ def test_retry_redelivers_with_dup():
 
 
 def test_packet_id_wraps_and_skips_inflight():
+    # the session's pid space is [1, 32767]: [32768, 65535] belongs to
+    # the native host's fast-path deliveries on the same wire connection
+    # (native/src/host.cc kNativePidBase), so PUBACKs route by range
     s = Session(clientid="c", max_inflight=0)
-    s._next_pkt_id = 65534
-    assert s.next_packet_id() == 65535
+    s._next_pkt_id = Session.PKT_ID_SPACE - 1
+    assert s.next_packet_id() == Session.PKT_ID_SPACE
     assert s.next_packet_id() == 1
     s.inflight.insert(2, "x")
     assert s.next_packet_id() == 3

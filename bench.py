@@ -658,6 +658,10 @@ def bench_e2e() -> None:
     conf = Config()
     conf.put("router.device.enable", True)
     conf.put("router.device.max_levels", 8)
+    # throughput section: pin the knee to 0 so every batch rides the
+    # kernel (round-comparable device numbers); the low-load probe
+    # below switches to the adaptive policy it is measuring
+    conf.put("router.device.min_batch", 0)
     app = BrokerApp.from_config(conf)
 
     # BASELINE config 5: rule-engine SQL topic filters co-batched with the
@@ -736,6 +740,30 @@ def bench_e2e() -> None:
         wall = time.time() - t0
         for d in drains:
             d.cancel()
+
+        # low-load latency (VERDICT r3 #3 done-criterion): sequential
+        # publishes trickle in as 1-message batches, which the pipeline's
+        # knee policy answers from the host oracle — no device RTT
+        app.pipeline.min_device_batch = -1   # the policy under test
+        probe = MqttClient(port=server.port, clientid="lat-probe")
+        await probe.connect()
+        await probe.subscribe("bench/lat/x", qos=0)
+        low = []
+        for i in range(40):
+            t0 = time.perf_counter_ns()
+            await pubs[0].publish("bench/lat/x", b"x", qos=0)
+            try:
+                await probe.recv(timeout=10)
+            except asyncio.TimeoutError:
+                # one dropped probe must not discard the whole e2e
+                # section's already-measured results
+                log(f"low-load probe: recv timeout at sample {i}")
+                break
+            low.append((time.perf_counter_ns() - t0) / 1e6)
+            await asyncio.sleep(0.01)
+        low_a = np.array(low) if low else np.array([float("nan")])
+        await probe.close()
+
         for c in subs + pubs:
             try:
                 await c.disconnect()
@@ -751,6 +779,15 @@ def bench_e2e() -> None:
         if len(lat_ms):
             log(f"e2e delivery latency ms: p50={np.percentile(lat_ms, 50):.2f} "
                 f"p99={np.percentile(lat_ms, 99):.2f}")
+        log(f"e2e LOW-LOAD latency ms (device on, knee="
+            f"{app.pipeline.device_knee()}, host-bypassed batches="
+            f"{app.pipeline.host_batches}): "
+            f"p50={np.percentile(low_a, 50):.2f} "
+            f"p99={np.percentile(low_a, 99):.2f}")
+        HOST_PLANE_RESULTS.update({
+            "e2e_lowload_p50_ms": round(float(np.percentile(low_a, 50)), 2),
+            "e2e_lowload_p99_ms": round(float(np.percentile(low_a, 99)), 2),
+        })
 
     asyncio.run(run())
 

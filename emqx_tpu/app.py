@@ -418,6 +418,8 @@ class BrokerApp:
         )
         if app.pipeline is not None:
             app.pipeline.max_batch = int(conf.get("router.device.batch_max"))
+            app.pipeline.min_device_batch = int(
+                conf.get("router.device.min_batch"))
         app.config = conf
         app.broker.exclusive_enabled = bool(
             conf.get("mqtt.exclusive_subscription"))

@@ -326,12 +326,20 @@ class Broker:
         (falls back to the host oracle per overflow/too-long topic)."""
         return self.publish_batch_collect(self.publish_batch_submit(msgs))
 
-    def publish_batch_submit(self, msgs: Sequence[Message]):
+    def publish_batch_submit(self, msgs: Sequence[Message],
+                             force_host: bool = False):
         """Stage 1: run the publish hooks and dispatch the routing
         kernel; returns an opaque token for ``publish_batch_collect``.
         The pipeline overlaps the in-flight device step with the next
-        batch's hooks (double-buffering, SURVEY §2.5-6)."""
-        cobatch = (self.rules_matched_fn is not None
+        batch's hooks (double-buffering, SURVEY §2.5-6).
+
+        ``force_host=True`` answers from the host oracle without a
+        device launch — the pipeline's small-batch latency bypass
+        (below the RTT knee the oracle walk is faster than the
+        dispatch; SURVEY §7 hard part (b)). Rules then match in the
+        message.publish hook as on the plain host path."""
+        cobatch = (not force_host
+                   and self.rules_matched_fn is not None
                    and self.rules_gate_fn is not None
                    and self.model is not None)
         if cobatch:
@@ -363,7 +371,7 @@ class Broker:
         out: list[dict[Sid, list[tuple[str, Message]]]] = [{} for _ in msgs]
         if not live:
             return (msgs, live, cobatch, out, None)
-        if self.model is None:
+        if self.model is None or force_host:
             return (msgs, live, cobatch, out, None)
         pending = self.model.publish_batch_submit(
             [m.topic for _, m in live])

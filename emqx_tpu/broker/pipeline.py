@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -49,6 +50,19 @@ class PublishPipeline:
         self.broker = broker
         self.cm = cm
         self.max_batch = max_batch
+        # latency policy (SURVEY §7 hard part (b), VERDICT r3 #3): a
+        # batch below the knee answers from the host oracle in
+        # microseconds instead of paying the device round trip.
+        #   min_device_batch >= 0: fixed threshold (config
+        #   router.device.min_batch); -1 (default): adaptive — the knee
+        #   is device_RTT / host_cost from running EMAs of both, so a 70 ms
+        #   tunneled chip floors small batches onto the host while a
+        #   sub-ms local chip keeps the device path for batch >= ~100.
+        self.min_device_batch = -1
+        self._rtt_ema = 5e-3       # device round trip per batch (s)
+        self._host_cost_ema = 6e-6 # host-oracle walk per message (s)
+        self.host_batches = 0      # batches that took the bypass
+        self._since_device = 0     # bypasses since the last device batch
         self._q: deque[Message] = deque()
         self._lock = threading.Lock()
         # serializes concurrent consumers (the flusher task's to_thread
@@ -105,8 +119,27 @@ class PublishPipeline:
                             self._q.popleft()
                             for _ in range(min(len(self._q),
                                                self.max_batch))]
-                    token = (self.broker.publish_batch_submit(batch)
-                             if batch else None)
+                    token = None
+                    if batch:
+                        # small batch: the host oracle answers in µs;
+                        # the device RTT would dominate (latency knee)
+                        bypass = len(batch) < self.device_knee()
+                        if (bypass and self.min_device_batch < 0
+                                and len(batch) >= 8
+                                and self._since_device >= 64):
+                            # adaptive mode must not ratchet one-way: a
+                            # stale RTT prior that saturates the knee
+                            # would otherwise never be re-measured. A
+                            # periodic probe batch rides the device to
+                            # refresh the EMA.
+                            bypass = False
+                        if bypass:
+                            self.host_batches += 1
+                            self._since_device += 1
+                        else:
+                            self._since_device = 0
+                        token = self.broker.publish_batch_submit(
+                            batch, force_host=bypass)
                     prev, pending = pending, (
                         (batch, token) if token is not None else None)
                     if prev is not None:
@@ -135,8 +168,35 @@ class PublishPipeline:
                         log.exception(
                             "pending batch collect failed; batch dropped")
 
+    def device_knee(self) -> int:
+        """Batch size below which the host oracle beats the device.
+        Fixed by config (router.device.min_batch >= 0) or adaptive:
+        knee = device-RTT / host-cost-per-message, both running EMAs
+        measured at collect time. On a ~70 ms tunneled chip the knee
+        saturates at max_batch (host path serves latency, device path
+        serves saturated full batches); on a local sub-ms chip it sits
+        around 10²."""
+        if self.broker.model is None:
+            return 0                    # no device path configured
+        if self.min_device_batch >= 0:
+            return self.min_device_batch
+        return min(self.max_batch,
+                   max(1, int(self._rtt_ema
+                              / max(self._host_cost_ema, 1e-9))))
+
     def _collect_dispatch(self, token) -> None:
+        t0 = time.perf_counter()
         results = self.broker.publish_batch_collect(token)
+        dt = time.perf_counter() - t0
+        live = token[1]
+        if not live:
+            pass          # hook-dropped batch: nothing was routed, so
+        elif token[4] is None:          # no cost signal — don't poison
+            # host-oracle batch: normalize by messages actually routed
+            per_msg = dt / len(live)
+            self._host_cost_ema += 0.2 * (per_msg - self._host_cost_ema)
+        else:                           # device batch: effective blocked
+            self._rtt_ema += 0.2 * (dt - self._rtt_ema)  # time at collect
         merged: dict[str, list] = {}
         for d in results:
             for sid, items in d.items():

@@ -263,6 +263,12 @@ def root_schema() -> Struct:
                 "enable": Field("bool", default=False),
                 "n_sub_slots": Field("int", default=1024),
                 "batch_max": Field("int", default=512),
+                # publish batches smaller than this answer from the
+                # host oracle instead of paying a device round trip
+                # (SURVEY §7 hard part (b): the latency knee).
+                # -1 = adaptive: the pipeline estimates the knee from
+                # measured device RTT and host-oracle cost EMAs
+                "min_batch": Field("int", default=-1),
                 "max_levels": Field("int", default=16),
                 "frontier_k": Field("int", default=32),
                 "match_cap": Field("int", default=128),

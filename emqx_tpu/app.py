@@ -523,6 +523,10 @@ class BrokerApp:
     def _on_config_change(self, path: tuple, value) -> None:
         if path[:1] == ("shared_subscription_strategy",):
             self.shared.strategy = value
+            # the native host serves round_robin groups in C++; any
+            # other strategy must move them back onto the Python path
+            for cb in getattr(self, "on_shared_strategy_change", ()):
+                cb()
         elif path[:1] == ("retainer",):
             self.retainer.max_retained = self.config.get(
                 "retainer.max_retained_messages")

@@ -142,6 +142,19 @@ class NativeBrokerServer:
         self._permit_queue: list[tuple[_NativeConn, str]] = []
         self._last_permit_flush = time.monotonic()
         self._stats_seen = {k: 0 for k in native.STAT_NAMES}
+        # (group, real filter) -> {"members": {sid: opts},
+        #                          "installed": None | "punt" | {sid: conn}}
+        # guarded by _shared_lock: subscribe events arrive on broker
+        # threads while strategy changes arrive on the config thread,
+        # and an interleaved reconcile would desync "installed" from
+        # the C++ table
+        self._shared_state: dict[tuple[str, str], dict] = {}
+        self._sid_groups: dict[str, set[tuple[str, str]]] = {}
+        self._shared_lock = threading.Lock()
+        if app is not None:
+            if not hasattr(app, "on_shared_strategy_change"):
+                app.on_shared_strategy_change = []
+            app.on_shared_strategy_change.append(self.reeval_shared_groups)
         self.broker.sub_observers.append(self._on_sub_event)
         # mirror subscriptions that existed before this server started
         # (resumed persistent sessions, other transports on the same app)
@@ -211,10 +224,110 @@ class NativeBrokerServer:
                 self._token_refs[sid] = left
         self.host.sub_del(owner, real)
 
+    # -- shared groups -------------------------------------------------------
+    # A $share group is natively served only while EVERY member is a
+    # fast native connection AND the node strategy is round_robin (the
+    # only strategy the C++ dispatcher implements — the rest stay on
+    # the Python SharedSub). Any other shape installs one punt marker
+    # per (group, real filter), owned by a group token.
+
+    def _group_token(self, group: str, real: str) -> int:
+        key = ("$g", f"{group}/{real}")
+        tok = self._punt_tokens.get(key)          # reuse the token pool
+        if tok is None:
+            tok = self._punt_token_next
+            self._punt_token_next += 1
+            self._punt_tokens[key] = tok
+        return tok
+
+    def _shared_native_ok(self, sid: str, opts) -> bool:
+        return (self._fast_global()
+                and sid in self._fast_conn_of
+                and getattr(opts, "subid", None) is None
+                and getattr(self.app, "shared", None) is not None
+                and self.app.shared.strategy == "round_robin")
+
+    def _on_shared_event(self, op: str, sid: str, group: str,
+                         real: str, opts) -> None:
+        with self._shared_lock:
+            st = self._shared_state.setdefault(
+                (group, real), {"members": {}, "installed": None})
+            if op == "add":
+                st["members"][sid] = opts
+                self._sid_groups.setdefault(sid, set()).add((group, real))
+            else:
+                st["members"].pop(sid, None)
+                grps = self._sid_groups.get(sid)
+                if grps is not None:
+                    grps.discard((group, real))
+                    if not grps:
+                        del self._sid_groups[sid]
+            self._reconcile_shared(group, real)
+
+    def _reconcile_shared(self, group: str, real: str) -> None:
+        """Idempotent: diff the desired serving shape for one group
+        against what is installed in C++ and apply the delta.
+        Caller holds _shared_lock."""
+        gkey = (group, real)
+        st = self._shared_state.get(gkey)
+        if st is None:
+            return
+        token = self._group_token(group, real)
+        members = st["members"]
+        installed = st["installed"]
+        if not members:
+            if installed == "punt":
+                self.host.sub_del(token, real)
+            elif isinstance(installed, dict):
+                for conn in installed.values():
+                    self.host.shared_del(token, conn, real)
+            self._shared_state.pop(gkey, None)
+            self._punt_tokens.pop(("$g", f"{group}/{real}"), None)
+            return
+        if all(self._shared_native_ok(s, o) for s, o in members.items()):
+            new_map = {s: self._fast_conn_of[s] for s in members}
+            if installed == "punt":
+                self.host.sub_del(token, real)
+            old = installed if isinstance(installed, dict) else {}
+            for s, conn in old.items():
+                if new_map.get(s) != conn:
+                    self.host.shared_del(token, conn, real)
+            for s, conn in new_map.items():
+                o = members[s]
+                # upsert: refreshes qos/nl for existing members too
+                self.host.shared_add(
+                    token, conn, real, getattr(o, "qos", 0),
+                    native.SUB_NO_LOCAL if getattr(o, "nl", 0) else 0)
+            st["installed"] = new_map
+        else:
+            if isinstance(installed, dict):
+                for conn in installed.values():
+                    self.host.shared_del(token, conn, real)
+            if installed != "punt":
+                self.host.sub_add(token, real, 0, native.SUB_PUNT)
+            st["installed"] = "punt"
+
+    def reeval_shared_groups(self) -> None:
+        """Strategy change / membership-eligibility change: re-decide
+        every group's serving mode (app.on_shared_strategy_change)."""
+        with self._shared_lock:
+            for group, real in list(self._shared_state):
+                self._reconcile_shared(group, real)
+
+    def _reconcile_sid_groups(self, sid: str) -> None:
+        """Re-decide only the groups this client belongs to — O(own
+        groups), not O(all groups), per connection event."""
+        with self._shared_lock:
+            for group, real in list(self._sid_groups.get(sid, ())):
+                self._reconcile_shared(group, real)
+
     def _on_sub_event(self, op: str, sid: str, topic: str, opts) -> None:
         """Mirror one broker-table change into the C++ sub table.
         Thread-safe: host.sub_add/del enqueue onto the poll thread."""
         group, real = T.parse_share(topic)
+        if group:
+            self._on_shared_event(op, sid, group, real, opts)
+            return
         if op == "add":
             conn_id = self._fast_conn_of.get(sid)
             if (conn_id is not None and not group
@@ -273,6 +386,8 @@ class NativeBrokerServer:
                 opts = self.broker.suboption.get((sid, topic))
                 if opts is not None:
                     self._on_sub_event("add", sid, topic, opts)
+        # shared groups this client belongs to may now be fully native
+        self._reconcile_sid_groups(ch.clientid)
 
     def _slow_consumers_watch(self, ch, topic: str) -> bool:
         """True when ANY message-plane consumer needs to see every
@@ -409,6 +524,10 @@ class NativeBrokerServer:
             # on a live connection
             self.host.disable_fast(conn.conn_id)
         self._granted.pop(conn.conn_id, None)
+        # groups this client served natively fall back to punt until the
+        # session teardown removes the membership (or a reconnect
+        # re-qualifies it)
+        self._reconcile_sid_groups(cid)
 
     def _drop(self, conn: _NativeConn, reason: str) -> None:
         self.conns.pop(conn.conn_id, None)
@@ -508,6 +627,13 @@ class NativeBrokerServer:
             try:
                 self.app.bridges.on_topology_change.remove(
                     self.flush_permits)
+            except ValueError:
+                pass
+        if self.app is not None and hasattr(self.app,
+                                            "on_shared_strategy_change"):
+            try:
+                self.app.on_shared_strategy_change.remove(
+                    self.reeval_shared_groups)
             except ValueError:
                 pass
         for conn in list(self.conns.values()):

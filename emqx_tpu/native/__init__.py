@@ -89,6 +89,28 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_permit.restype = ctypes.c_int
     lib.emqx_host_permit.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_host_shared_add.restype = ctypes.c_int
+    lib.emqx_host_shared_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint8, ctypes.c_uint8]
+    lib.emqx_host_shared_del.restype = ctypes.c_int
+    lib.emqx_host_shared_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_subtable_shared_add.restype = None
+    lib.emqx_subtable_shared_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint8, ctypes.c_uint8]
+    lib.emqx_subtable_shared_del.restype = ctypes.c_int
+    lib.emqx_subtable_shared_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_subtable_shared_pick.restype = ctypes.c_long
+    lib.emqx_subtable_shared_pick.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+    lib.emqx_subtable_shared_pick_many.restype = ctypes.c_long
+    lib.emqx_subtable_shared_pick_many.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_long)]
     lib.emqx_host_permits_flush.restype = ctypes.c_int
     lib.emqx_host_permits_flush.argtypes = [ctypes.c_void_p]
     lib.emqx_host_stat.restype = ctypes.c_long
@@ -269,6 +291,32 @@ class NativeSubTable:
                 return list(buf[:n])
             cap = n
 
+    def shared_add(self, token: int, owner: int, filter_: str,
+                   qos: int = 0, flags: int = 0) -> None:
+        self._lib.emqx_subtable_shared_add(self._h, token, owner,
+                                           filter_.encode(), qos, flags)
+
+    def shared_del(self, token: int, owner: int, filter_: str) -> bool:
+        return bool(self._lib.emqx_subtable_shared_del(
+            self._h, token, owner, filter_.encode()))
+
+    def shared_pick(self, topic: str) -> list[tuple[int, int]]:
+        """One rotating (group token, picked owner) per matched group."""
+        cap = 512
+        buf = (ctypes.c_uint64 * cap)()
+        n = self._lib.emqx_subtable_shared_pick(self._h, topic.encode(),
+                                                buf, cap)
+        return [(buf[2 * i], buf[2 * i + 1]) for i in range(min(n, cap // 2))]
+
+    def shared_pick_many(self, topics: list[str]) -> tuple[int, int]:
+        """Bulk rotating picks (bench surface): one C call for the whole
+        topic batch. Returns (topics processed, picks made)."""
+        blob = "\n".join(topics).encode()
+        picks = ctypes.c_long()
+        n = self._lib.emqx_subtable_shared_pick_many(
+            self._h, blob, len(blob), ctypes.byref(picks))
+        return n, picks.value
+
     def close(self) -> None:
         if self._h:
             self._lib.emqx_subtable_destroy(self._h)
@@ -283,7 +331,8 @@ class NativeSubTable:
 
 # fast-path stat slots (host.cc StatSlot order)
 STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
-              "drops_backpressure", "drops_inflight", "native_acks")
+              "drops_backpressure", "drops_inflight", "native_acks",
+              "shared_dispatch", "shared_no_member")
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL = 1, 2
@@ -351,6 +400,15 @@ class NativeHost:
 
     def permit(self, conn: int, topic: str) -> None:
         self._lib.emqx_host_permit(self._h, conn, topic.encode())
+
+    def shared_add(self, token: int, conn: int, filter_: str,
+                   qos: int = 0, flags: int = 0) -> None:
+        self._lib.emqx_host_shared_add(self._h, token, conn,
+                                       filter_.encode(), qos, flags)
+
+    def shared_del(self, token: int, conn: int, filter_: str) -> None:
+        self._lib.emqx_host_shared_del(self._h, token, conn,
+                                       filter_.encode())
 
     def permits_flush(self) -> None:
         self._lib.emqx_host_permits_flush(self._h)

@@ -109,6 +109,8 @@ struct Conn {
   std::deque<std::pair<std::string, size_t>> pending_qos1;
   std::unordered_set<std::string> permits;   // publisher-side topic grants
   std::vector<std::string> own_subs;         // filters owned by this conn
+  // (group token, filter) shared memberships owned by this conn
+  std::vector<std::pair<uint64_t, std::string>> own_shared;
 };
 
 // qos1 mqueue bound per subscriber (emqx_mqueue default max_len 1000);
@@ -119,10 +121,12 @@ constexpr size_t kMaxPendingQos1 = 1000;
 // poll thread (ApplyPending) so they serialize with matching.
 struct Op {
   enum Kind : uint8_t {
-    kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush
+    kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush,
+    kSharedAdd, kSharedDel
   };
   Kind kind;
   uint64_t owner = 0;
+  uint64_t token = 0;    // shared-group identity
   std::string str;       // filter / topic
   uint8_t qos = 0;
   uint8_t flags = 0;
@@ -140,6 +144,8 @@ enum StatSlot {
   kStDropsBackpressure,
   kStDropsInflight,
   kStNativeAcks,       // QoS1 PUBACKs consumed natively
+  kStSharedDispatch,   // shared-group picks served natively
+  kStSharedNoMember,   // shared groups with no deliverable member
   kStatCount
 };
 
@@ -367,6 +373,35 @@ class Host {
         // full Python path
         for (auto& [id, c] : conns_) c.permits.clear();
         break;
+      case Op::kSharedAdd: {
+        subs_.SharedAdd(op.token, op.owner, op.str, op.qos, op.flags);
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end()) {
+          auto& own = it->second.own_shared;
+          bool seen = false;
+          for (auto& [tok, filt] : own)
+            if (tok == op.token && filt == op.str) {
+              seen = true;       // reconcile re-upserts constantly;
+              break;             // one bookkeeping entry is enough
+            }
+          if (!seen) own.emplace_back(op.token, op.str);
+        }
+        break;
+      }
+      case Op::kSharedDel: {
+        subs_.SharedRemove(op.token, op.owner, op.str);
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end()) {
+          auto& own = it->second.own_shared;
+          for (size_t i = 0; i < own.size(); i++)
+            if (own[i].first == op.token && own[i].second == op.str) {
+              own[i] = std::move(own.back());
+              own.pop_back();
+              break;
+            }
+        }
+        break;
+      }
     }
   }
 
@@ -519,12 +554,14 @@ class Host {
     if (c.permits.find(key_scratch_) == c.permits.end())
       return false;  // unpermitted topic: full Python path (authz, rules)
     match_scratch_.clear();
-    subs_.Match(topic, &match_scratch_);
+    groups_scratch_.clear();
+    subs_.Match(topic, &match_scratch_, &groups_scratch_);
     for (const SubEntry* e : match_scratch_) {
       if (e->flags & kSubPunt) {
-        // a shared-sub group / persistent session / non-native
-        // subscriber matched: Python must run the WHOLE fan-out (it
-        // re-matches and delivers to the native subscribers too)
+        // a mixed/foreign shared group / persistent session /
+        // non-native subscriber matched: Python must run the WHOLE
+        // fan-out (it re-matches and delivers natively-served
+        // subscribers too)
         stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
         return false;
       }
@@ -540,53 +577,79 @@ class Host {
     stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
     // shared serialized frames per (proto, qos=0) — qos1 frames differ
     // per target (unique pid), built in place
-    std::string frame_v4, frame_v5;
+    frame_v4_.clear();
+    frame_v5_.clear();
     for (const SubEntry* e : match_scratch_) {
       if ((e->flags & kSubNoLocal) && e->owner == id) continue;
-      auto it = conns_.find(e->owner);
-      if (it == conns_.end()) continue;  // stale entry (conn mid-close)
-      Conn& t = it->second;
-      if (t.outbuf.size() - t.outpos > kHighWater) {
-        stats_[kStDropsBackpressure].fetch_add(1, std::memory_order_relaxed);
-        continue;
-      }
-      uint8_t out_qos = qos < e->qos ? qos : e->qos;
-      if (out_qos == 0) {
-        std::string& shared = t.proto_ver == 5 ? frame_v5 : frame_v4;
-        if (shared.empty())
-          BuildPublish(&shared, topic, payload, 0, 0, t.proto_ver == 5);
-        t.outbuf += shared;
-        stats_[kStFastBytesOut].fetch_add(shared.size(),
-                                          std::memory_order_relaxed);
-      } else {
-        if (t.inflight.size() >= t.max_inflight) {
-          // receive window full: queue (the mqueue), drop on overflow
-          if (t.pending_qos1.size() >= kMaxPendingQos1) {
-            stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
-            continue;
-          }
-          pub_scratch_.clear();
-          // pid offset = header(1) + varint + topic length field(2) + topic
-          BuildPublish(&pub_scratch_, topic, payload, 1, 0,
-                       t.proto_ver == 5);
-          size_t var_len = 1;
-          while (static_cast<uint8_t>(pub_scratch_[var_len]) & 0x80)
-            var_len++;
-          size_t pid_off = var_len + 1 + 2 + topic.size();
-          t.pending_qos1.emplace_back(pub_scratch_, pid_off);
-          continue;
-        }
-        uint16_t tp = NextPid(t);
-        pub_scratch_.clear();
-        BuildPublish(&pub_scratch_, topic, payload, 1, tp,
-                     t.proto_ver == 5);
-        t.outbuf += pub_scratch_;
-        stats_[kStFastBytesOut].fetch_add(pub_scratch_.size(),
-                                          std::memory_order_relaxed);
-      }
-      stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
-      MarkDirty(e->owner, t);
+      DeliverTo(e->owner, *e, id, qos, topic, payload);
     }
+    // natively served $share groups: one member per group, rotating;
+    // skipped members (gone / backpressured / window full) get the
+    // redispatch treatment — the next member takes the message
+    // (emqx_shared_sub.erl:190-217)
+    for (SharedGroup* g : groups_scratch_) {
+      size_t nmem = g->members.size();
+      bool delivered = false;
+      for (size_t k = 0; k < nmem && !delivered; k++) {
+        const SubEntry& e = g->members[g->cursor % nmem];
+        g->cursor++;
+        if ((e.flags & kSubNoLocal) && e.owner == id) continue;
+        delivered = DeliverTo(e.owner, e, id, qos, topic, payload);
+      }
+      stats_[delivered ? kStSharedDispatch : kStSharedNoMember].fetch_add(
+          1, std::memory_order_relaxed);
+    }
+    return true;
+  }
+
+  // Write one PUBLISH to `owner` (qos = min(pub, sub)); returns whether
+  // a delivery (or a qos1 queue admit) happened.
+  bool DeliverTo(uint64_t owner, const SubEntry& e, uint64_t publisher,
+                 uint8_t qos, std::string_view topic,
+                 std::string_view payload) {
+    auto it = conns_.find(owner);
+    if (it == conns_.end()) return false;  // stale entry (conn mid-close)
+    Conn& t = it->second;
+    if (t.outbuf.size() - t.outpos > kHighWater) {
+      stats_[kStDropsBackpressure].fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+    uint8_t out_qos = qos < e.qos ? qos : e.qos;
+    if (out_qos == 0) {
+      std::string& shared = t.proto_ver == 5 ? frame_v5_ : frame_v4_;
+      if (shared.empty())
+        BuildPublish(&shared, topic, payload, 0, 0, t.proto_ver == 5);
+      t.outbuf += shared;
+      stats_[kStFastBytesOut].fetch_add(shared.size(),
+                                        std::memory_order_relaxed);
+    } else {
+      if (t.inflight.size() >= t.max_inflight) {
+        // receive window full: queue (the mqueue), drop on overflow
+        if (t.pending_qos1.size() >= kMaxPendingQos1) {
+          stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
+          return false;
+        }
+        pub_scratch_.clear();
+        // pid offset = header(1) + varint + topic length field(2) + topic
+        BuildPublish(&pub_scratch_, topic, payload, 1, 0,
+                     t.proto_ver == 5);
+        size_t var_len = 1;
+        while (static_cast<uint8_t>(pub_scratch_[var_len]) & 0x80)
+          var_len++;
+        size_t pid_off = var_len + 1 + 2 + topic.size();
+        t.pending_qos1.emplace_back(pub_scratch_, pid_off);
+        return true;   // admitted; kStFastOut counts at dequeue
+      }
+      uint16_t tp = NextPid(t);
+      pub_scratch_.clear();
+      BuildPublish(&pub_scratch_, topic, payload, 1, tp,
+                   t.proto_ver == 5);
+      t.outbuf += pub_scratch_;
+      stats_[kStFastBytesOut].fetch_add(pub_scratch_.size(),
+                                        std::memory_order_relaxed);
+    }
+    stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
+    MarkDirty(owner, t);
     return true;
   }
 
@@ -697,6 +760,8 @@ class Host {
     // owned by Python tokens and removed through the broker observer
     for (const std::string& filt : it->second.own_subs)
       subs_.Remove(id, filt);
+    for (const auto& [token, filt] : it->second.own_shared)
+      subs_.SharedRemove(token, id, filt);
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
     close(it->second.fd);
     conns_.erase(it);
@@ -720,8 +785,10 @@ class Host {
   // fast path (poll-thread-owned)
   SubTable subs_;
   std::vector<const SubEntry*> match_scratch_;
+  std::vector<SharedGroup*> groups_scratch_;
   std::string pub_scratch_;
   std::string key_scratch_;
+  std::string frame_v4_, frame_v5_;  // per-publish shared qos0 frames
   std::vector<uint64_t> dirty_;
   std::atomic<uint64_t> stats_[kStatCount] = {};
 };
@@ -799,6 +866,28 @@ int emqx_host_sub_del(void* h, uint64_t owner, const char* filter) {
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
+int emqx_host_shared_add(void* h, uint64_t token, uint64_t conn,
+                         const char* filter, uint8_t qos, uint8_t flags) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSharedAdd;
+  op.token = token;
+  op.owner = conn;
+  op.str = filter;
+  op.qos = qos;
+  op.flags = flags;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_shared_del(void* h, uint64_t token, uint64_t conn,
+                         const char* filter) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSharedDel;
+  op.token = token;
+  op.owner = conn;
+  op.str = filter;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
 int emqx_host_permit(void* h, uint64_t conn, const char* topic) {
   emqx_native::Op op;
   op.kind = emqx_native::Op::kPermit;
@@ -854,6 +943,74 @@ long emqx_subtable_match(void* t, const char* topic, uint64_t* out,
     n++;
   }
   return n;
+}
+
+void emqx_subtable_shared_add(void* t, uint64_t token, uint64_t owner,
+                              const char* filter, uint8_t qos,
+                              uint8_t flags) {
+  static_cast<emqx_native::SubTable*>(t)->SharedAdd(token, owner, filter,
+                                                    qos, flags);
+}
+
+int emqx_subtable_shared_del(void* t, uint64_t token, uint64_t owner,
+                             const char* filter) {
+  return static_cast<emqx_native::SubTable*>(t)->SharedRemove(
+             token, owner, filter)
+             ? 1
+             : 0;
+}
+
+// One rotating pick per matched shared group; out pairs are
+// (group token, picked owner). Returns the group count.
+long emqx_subtable_shared_pick(void* t, const char* topic, uint64_t* out,
+                               long cap) {
+  std::vector<const emqx_native::SubEntry*> hits;
+  std::vector<emqx_native::SharedGroup*> groups;
+  static_cast<emqx_native::SubTable*>(t)->Match(topic, &hits, &groups);
+  long n = 0;
+  for (auto* g : groups) {
+    if (2 * n + 1 < cap && !g->members.empty()) {
+      const auto& e = g->members[g->cursor % g->members.size()];
+      g->cursor++;
+      out[2 * n] = g->token;
+      out[2 * n + 1] = e.owner;
+    }
+    n++;
+  }
+  return n;
+}
+
+// Bulk dispatch benchmark surface: run rotating picks for every
+// newline-separated topic in one call (per-call ctypes overhead would
+// otherwise dominate the measurement). Returns topics processed;
+// *out_picks counts the group picks made.
+long emqx_subtable_shared_pick_many(void* t, const char* topics, size_t len,
+                                    long* out_picks) {
+  auto* table = static_cast<emqx_native::SubTable*>(t);
+  std::vector<const emqx_native::SubEntry*> hits;
+  std::vector<emqx_native::SharedGroup*> groups;
+  long n_topics = 0, picks = 0;
+  size_t start = 0;
+  for (size_t i = 0; i <= len; i++) {
+    if (i == len || topics[i] == '\n') {
+      if (i > start) {
+        hits.clear();
+        groups.clear();
+        table->Match(std::string_view(topics + start, i - start), &hits,
+                     &groups);
+        for (auto* g : groups) {
+          if (!g->members.empty()) {
+            g->cursor++;
+            picks++;
+          }
+        }
+        n_topics++;
+      }
+      start = i + 1;
+    }
+  }
+  *out_picks = picks;
+  return n_topics;
 }
 
 // --- standalone framer (for parity tests + non-socket embedding) ----------

@@ -33,6 +33,18 @@ struct SubEntry {
 constexpr uint8_t kSubPunt = 1;     // matched => forward frame to Python
 constexpr uint8_t kSubNoLocal = 2;  // MQTT5 no-local: skip the publisher
 
+// A $share group on one filter, natively served: the Python server
+// installs one of these ONLY when every member is a fast native
+// connection and the node strategy is round_robin (emqx_shared_sub.erl
+// :309-379); any other membership shape stays a punt marker. Dispatch
+// advances the cursor and skips members whose connection is gone or
+// backpressured — the nack/redispatch analogue (:190-217).
+struct SharedGroup {
+  uint64_t token = 0;              // group identity (interned by Python)
+  uint32_t cursor = 0;
+  std::vector<SubEntry> members;   // owner = conn id
+};
+
 // Split a topic/filter on '/'; MQTT keeps empty levels ("a//b" is three
 // levels, the middle one empty) — emqx_topic.erl:words/1 semantics.
 inline void SplitLevels(std::string_view s, std::vector<std::string_view>* out) {
@@ -107,17 +119,42 @@ class SubTable {
     // re-creates them constantly and the per-node footprint is tiny
   }
 
-  // Append every entry matching `topic` to *out. The caller guarantees
-  // the topic is a plain name (no wildcards, no leading '$' — the fast
-  // path punts those before matching, which also gives the MQTT rule
-  // that root wildcards must not match $-topics for free).
-  void Match(std::string_view topic, std::vector<const SubEntry*>* out) const {
+  // Shared-group membership management: token identifies the group,
+  // owner the member connection. Empty groups are removed.
+  void SharedAdd(uint64_t token, uint64_t owner, const std::string& filter,
+                 uint8_t qos, uint8_t flags) {
+    SharedGroup* g = FindGroup(filter, token, /*create=*/true);
+    if (g) Upsert(&g->members, owner, qos, flags);
+  }
+
+  bool SharedRemove(uint64_t token, uint64_t owner,
+                    const std::string& filter) {
+    SharedGroup* g = FindGroup(filter, token, /*create=*/false);
+    if (!g) return false;
+    bool hit = Erase(&g->members, owner);
+    if (g->members.empty()) DropGroup(filter, token);
+    return hit;
+  }
+
+  // Append every entry matching `topic` to *out, and every natively
+  // served shared group to *groups (mutable: dispatch advances their
+  // cursors). The caller guarantees the topic is a plain name (no
+  // wildcards, no leading '$' — the fast path punts those before
+  // matching, which also gives the MQTT rule that root wildcards must
+  // not match $-topics for free).
+  void Match(std::string_view topic, std::vector<const SubEntry*>* out,
+             std::vector<SharedGroup*>* groups = nullptr) {
     key_scratch_.assign(topic.data(), topic.size());
     auto it = exact_.find(key_scratch_);
     if (it != exact_.end())
       for (const auto& e : it->second) out->push_back(&e);
+    if (groups) {
+      auto git = exact_groups_.find(key_scratch_);
+      if (git != exact_groups_.end())
+        for (auto& g : git->second) groups->push_back(&g);
+    }
     SplitLevels(topic, &match_levels_);
-    MatchNode(&root_, 0, out);
+    MatchNode(&root_, 0, out, groups);
   }
 
   size_t exact_count() const { return exact_.size(); }
@@ -128,7 +165,80 @@ class SubTable {
     std::unique_ptr<Node> plus;
     std::vector<SubEntry> here;  // filters ending exactly at this node
     std::vector<SubEntry> hash;  // filters ending in '#' one level below
+    std::vector<SharedGroup> here_groups;
+    std::vector<SharedGroup> hash_groups;
   };
+
+  // Walk to the filter's terminal vectors; create the path on demand.
+  // Returns (plain, groups) pointers via out-params; null when absent.
+  template <bool Create>
+  bool Terminal(const std::string& filter,
+                std::vector<SharedGroup>** groups) {
+    if (filter.find('+') == std::string::npos &&
+        filter.find('#') == std::string::npos) {
+      if (Create) {
+        *groups = &exact_groups_[filter];
+        return true;
+      }
+      auto it = exact_groups_.find(filter);
+      if (it == exact_groups_.end()) return false;
+      *groups = &it->second;
+      return true;
+    }
+    SplitLevels(filter, &scratch_levels_);
+    Node* n = &root_;
+    for (size_t i = 0; i < scratch_levels_.size(); i++) {
+      std::string_view w = scratch_levels_[i];
+      if (w == "#") {
+        *groups = &n->hash_groups;
+        return true;
+      }
+      if (w == "+") {
+        if (!n->plus) {
+          if (!Create) return false;
+          n->plus = std::make_unique<Node>();
+        }
+        n = n->plus.get();
+      } else {
+        auto it = n->kids.find(std::string(w));
+        if (it == n->kids.end()) {
+          if (!Create) return false;
+          auto& kid = n->kids[std::string(w)];
+          kid = std::make_unique<Node>();
+          n = kid.get();
+          continue;
+        }
+        n = it->second.get();
+      }
+    }
+    *groups = &n->here_groups;
+    return true;
+  }
+
+  SharedGroup* FindGroup(const std::string& filter, uint64_t token,
+                         bool create) {
+    std::vector<SharedGroup>* vec = nullptr;
+    bool ok = create ? Terminal<true>(filter, &vec)
+                     : Terminal<false>(filter, &vec);
+    if (!ok || !vec) return nullptr;
+    for (auto& g : *vec)
+      if (g.token == token) return &g;
+    if (!create) return nullptr;
+    vec->push_back(SharedGroup{token, 0, {}});
+    return &vec->back();
+  }
+
+  void DropGroup(const std::string& filter, uint64_t token) {
+    std::vector<SharedGroup>* vec = nullptr;
+    if (!Terminal<false>(filter, &vec) || !vec) return;
+    for (size_t i = 0; i < vec->size(); i++) {
+      if ((*vec)[i].token == token) {
+        (*vec)[i] = std::move(vec->back());
+        vec->pop_back();
+        return;
+      }
+    }
+  }
 
   static void Upsert(std::vector<SubEntry>* v, uint64_t owner, uint8_t qos,
                      uint8_t flags) {
@@ -153,28 +263,33 @@ class SubTable {
     return false;
   }
 
-  void MatchNode(const Node* n, size_t i,
-                 std::vector<const SubEntry*>* out) const {
+  void MatchNode(Node* n, size_t i, std::vector<const SubEntry*>* out,
+                 std::vector<SharedGroup*>* groups) {
     // "a/#" matches "a", "a/b", ... — the '#' list at node a covers the
     // remainder including zero further levels (emqx_trie 'match #')
     for (const auto& e : n->hash) out->push_back(&e);
+    if (groups)
+      for (auto& g : n->hash_groups) groups->push_back(&g);
     if (i == match_levels_.size()) {
       for (const auto& e : n->here) out->push_back(&e);
+      if (groups)
+        for (auto& g : n->here_groups) groups->push_back(&g);
       return;
     }
     // assign() reuses the scratch capacity: the per-message hot loop
     // must not heap-allocate per level just to query the kids map
     key_scratch_.assign(match_levels_[i].data(), match_levels_[i].size());
     auto it = n->kids.find(key_scratch_);
-    if (it != n->kids.end()) MatchNode(it->second.get(), i + 1, out);
-    if (n->plus) MatchNode(n->plus.get(), i + 1, out);
+    if (it != n->kids.end()) MatchNode(it->second.get(), i + 1, out, groups);
+    if (n->plus) MatchNode(n->plus.get(), i + 1, out, groups);
   }
 
   Node root_;
   std::unordered_map<std::string, std::vector<SubEntry>> exact_;
+  std::unordered_map<std::string, std::vector<SharedGroup>> exact_groups_;
   std::vector<std::string_view> scratch_levels_;
-  mutable std::vector<std::string_view> match_levels_;
-  mutable std::string key_scratch_;
+  std::vector<std::string_view> match_levels_;
+  std::string key_scratch_;
 };
 
 }  // namespace emqx_native

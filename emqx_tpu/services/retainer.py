@@ -1,11 +1,24 @@
 """Retained messages — parity with ``apps/emqx_retainer``.
 
 Store: retained message per exact topic; empty payload deletes
-(MQTT spec). Lookup is the *inverse* trie problem (SURVEY.md §7-6): given
-a subscription filter, find all retained topic *names* matching it — a
-name-trie walked under the filter's ``+``/``#`` branching (the reference
-builds word-position indices for this, emqx_retainer_mnesia.erl /
-emqx_retainer_index.erl; a name-trie gives the same pruning).
+(MQTT spec). Lookup is the *inverse* trie problem (SURVEY.md §7-6):
+given a subscription filter, find all retained topic *names* matching
+it. The reference builds word-position indices for this
+(emqx_retainer_mnesia.erl / emqx_retainer_index.erl); this store goes
+vectorized instead (VERDICT r3 #5 — the recursive Python name-trie
+measured 2.9k lookups/sec at 100K retained):
+
+- every retained topic is a row in a token matrix ``tok[N, L]`` (word
+  ids via an interning vocab) with depth/$-flags in parallel arrays;
+- a filter match is a handful of numpy comparisons over the candidate
+  rows — ``+`` constrains nothing (depth covers it), a word constrains
+  one column, a trailing ``#`` relaxes the depth equality;
+- candidates come from a (level0, level1) prefix bucket when the
+  filter's first two levels are literal (the common
+  ``vendor/device/...`` shape — buckets cut 100K rows to the ~200
+  sharing the prefix), else the whole matrix is scanned;
+- topics deeper than ``MAX_LEVELS`` go to a tiny fallback dict walked
+  with ``T.match`` (they are rare; correctness is preserved).
 
 Broker wiring (same hookpoints as the reference):
 - ``message.publish``      retain flag ⇒ store/delete (and deliver a copy)
@@ -18,28 +31,48 @@ expired entries are dropped lazily on read + via ``sweep()``.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
 from typing import Optional
+
+import numpy as np
 
 from emqx_tpu.core import topic as T
 from emqx_tpu.core.message import Message, now_ms
 
-
-@dataclass
-class _Node:
-    children: dict[str, "_Node"] = field(default_factory=dict)
-    msg: Optional[Message] = None       # retained message ending here
-    stored_at: int = 0
+MAX_LEVELS = 16
 
 
 class Retainer:
     def __init__(self, max_retained: int = 0, default_expiry_ms: int = 0):
-        self._root = _Node()
-        self._count = 0
         self.max_retained = max_retained          # 0 = unlimited
         self.default_expiry_ms = default_expiry_ms
         self._lock = threading.RLock()
         self.dropped = 0
+        self._count = 0               # live retained messages (incl. deep)
+        # row-aligned store
+        self._row_of: dict[str, int] = {}
+        self._topics: list[str] = []
+        self._msgs: list[Optional[Message]] = []
+        self._stored: list[int] = []
+        # per-row absolute expiry deadline (ms; inf = no msg expiry),
+        # precomputed at store so match() can mask expiry vectorized
+        # instead of calling msg.is_expired() per hit
+        self._deadline = np.full(1024, np.inf)
+        self._stored_np = np.zeros(1024, dtype=np.int64)
+        self._vocab: dict[str, int] = {}          # word -> id >= 1
+        cap = 1024
+        self._tok = np.zeros((cap, MAX_LEVELS), dtype=np.int32)
+        self._depth = np.zeros(cap, dtype=np.int32)
+        self._dollar = np.zeros(cap, dtype=bool)
+        self._alive = np.zeros(cap, dtype=bool)
+        self._n = 0                   # rows used (live + tombstoned)
+        self._dead = 0
+        # (id0, id1) -> LIVE row list; _bucket_np caches the compact
+        # per-bucket submatrices (see _bucket_cache), invalidated on any
+        # store/delete touching the bucket
+        self._bucket: dict[tuple[int, int], list[int]] = {}
+        self._bucket_np: dict[tuple[int, int], tuple] = {}
+        # topics deeper than MAX_LEVELS: topic -> (msg, stored_at)
+        self._deep: dict[str, tuple[Message, int]] = {}
 
     def __len__(self) -> int:
         return self._count
@@ -54,105 +87,274 @@ class Retainer:
         else:
             self.delete(msg.topic)     # empty retained payload = clear
 
+    def _wid(self, w: str) -> int:
+        wid = self._vocab.get(w)
+        if wid is None:
+            wid = len(self._vocab) + 1
+            self._vocab[w] = wid
+        return wid
+
+    def _grow(self) -> None:
+        cap = self._tok.shape[0] * 2
+        for name in ("_tok", "_depth", "_dollar", "_alive", "_deadline",
+                     "_stored_np"):
+            old = getattr(self, name)
+            shape = (cap,) + old.shape[1:]
+            fill = np.inf if name == "_deadline" else 0
+            new = np.full(shape, fill, dtype=old.dtype)
+            new[: self._n] = old[: self._n]
+            setattr(self, name, new)
+
     def store(self, msg: Message, now: Optional[int] = None) -> bool:
         now = now_ms() if now is None else now
+        topic = msg.topic
+        kept = msg.set_header("retained", True)
         with self._lock:
-            node = self._root
-            path = []
-            for w in T.words(msg.topic):
-                node = node.children.setdefault(w, _Node())
-                path.append(node)
-            if node.msg is None:
-                if self.max_retained and self._count >= self.max_retained:
-                    self.dropped += 1
-                    return False       # table full: new topics rejected
-                self._count += 1
-            # retained copies keep the retain flag when replayed
-            node.msg = msg.set_header("retained", True)
-            node.stored_at = now
+            words = T.words(topic)
+            if len(words) > MAX_LEVELS:
+                if topic not in self._deep:
+                    if self.max_retained and self._count >= self.max_retained:
+                        self.dropped += 1
+                        return False
+                    self._count += 1
+                self._deep[topic] = (kept, now)
+                return True
+            row = self._row_of.get(topic)
+            if row is not None:
+                self._msgs[row] = kept
+                self._stored[row] = now
+                self._deadline[row] = self._msg_deadline(kept)
+                self._stored_np[row] = now
+                self._bucket_np.pop(
+                    (int(self._tok[row, 0]), int(self._tok[row, 1])), None)
+                return True
+            if self.max_retained and self._count >= self.max_retained:
+                self.dropped += 1
+                return False       # table full: new topics rejected
+            if self._n >= self._tok.shape[0]:
+                self._grow()
+            row = self._n
+            self._n += 1
+            ids = [self._wid(w) for w in words]
+            self._tok[row, : len(ids)] = ids
+            self._tok[row, len(ids):] = 0
+            self._depth[row] = len(ids)
+            self._dollar[row] = topic.startswith("$")
+            self._alive[row] = True
+            self._row_of[topic] = row
+            self._topics.append(topic)
+            self._msgs.append(kept)
+            self._stored.append(now)
+            self._deadline[row] = self._msg_deadline(kept)
+            self._stored_np[row] = now
+            key = (ids[0], ids[1] if len(ids) > 1 else 0)
+            self._bucket.setdefault(key, []).append(row)
+            self._bucket_np.pop(key, None)
+            self._count += 1
             return True
 
     def delete(self, topic: str) -> bool:
         with self._lock:
-            node = self._root
-            path: list[tuple[_Node, str]] = []
-            for w in T.words(topic):
-                child = node.children.get(w)
-                if child is None:
-                    return False
-                path.append((node, w))
-                node = child
-            if node.msg is None:
+            if topic in self._deep:
+                del self._deep[topic]
+                self._count -= 1
+                return True
+            row = self._row_of.pop(topic, None)
+            if row is None:
                 return False
-            node.msg = None
+            self._alive[row] = False
+            self._msgs[row] = None
+            self._dead += 1
             self._count -= 1
-            for parent, w in reversed(path):
-                child = parent.children[w]
-                if child.msg is None and not child.children:
-                    del parent.children[w]
-                else:
-                    break
+            key = (int(self._tok[row, 0]), int(self._tok[row, 1]))
+            rows = self._bucket.get(key)
+            if rows is not None:
+                try:
+                    rows.remove(row)     # buckets hold live rows only
+                except ValueError:
+                    pass
+                if not rows:
+                    del self._bucket[key]
+            self._bucket_np.pop(key, None)
+            # tombstones compact when they dominate — O(n) rebuild
+            # amortized over >= n/2 deletes
+            if self._dead > 1024 and self._dead * 2 > self._n:
+                self._compact()
             return True
 
-    # -- inverse-trie lookup -------------------------------------------------
+    def _compact(self) -> None:
+        live = [r for r in range(self._n) if self._alive[r]]
+        topics = [self._topics[r] for r in live]
+        msgs = [self._msgs[r] for r in live]
+        stored = [self._stored[r] for r in live]
+        for name in ("_depth", "_dollar", "_alive", "_deadline",
+                     "_stored_np"):
+            arr = getattr(self, name)
+            arr[: len(live)] = arr[live]
+        self._n = len(live)
+        self._dead = 0
+        self._topics = topics
+        self._msgs = msgs
+        self._stored = stored
+        self._row_of = {t: i for i, t in enumerate(topics)}
+        # rebuild the vocab from the survivors: without this, unique
+        # topic-name churn (per-UUID topics) grows the intern dict
+        # forever (the old trie pruned nodes on delete)
+        self._vocab = {}
+        self._tok[: self._n] = 0
+        for i, t in enumerate(topics):
+            ids = [self._wid(w) for w in T.words(t)]
+            self._tok[i, : len(ids)] = ids
+        self._bucket.clear()
+        self._bucket_np.clear()
+        for i in range(self._n):
+            key = (int(self._tok[i, 0]), int(self._tok[i, 1]))
+            self._bucket.setdefault(key, []).append(i)
+
+    # -- inverse-trie lookup (vectorized) ------------------------------------
 
     def match(self, filt: str, now: Optional[int] = None) -> list[Message]:
         """All live retained messages whose topic matches ``filt``."""
         now = now_ms() if now is None else now
         fw = T.words(filt)
         out: list[Message] = []
+        expired: list[str] = []
         with self._lock:
-            self._expired_paths: list[str] = []
-            self._walk(self._root, fw, 0, first_level=True, out=out, now=now)
-            # lazily-expired entries prune their empty trie branches too
-            # (delete() owns the pruning loop)
-            for topic in self._expired_paths:
+            self._match_rows(fw, now, out, expired)
+            if self._deep:
+                guard_dollar = fw[0] in (T.PLUS, T.HASH)
+                for topic, (msg, stored_at) in list(self._deep.items()):
+                    if guard_dollar and topic.startswith("$"):
+                        continue
+                    if T.match(topic, filt):
+                        if self._msg_expired(msg, stored_at, now):
+                            expired.append(topic)
+                        else:
+                            out.append(msg)
+            for topic in expired:       # lazy expiry, same as the walk did
                 self.delete(topic)
         return out
 
-    def _expired(self, node: _Node, now: int) -> bool:
-        msg = node.msg
+    def _bucket_cache(self, key: tuple[int, int]):
+        """Per-bucket compact cache: submatrix copies + row-aligned
+        msg/topic lists, rebuilt lazily after any store/delete touching
+        the bucket. Buckets hold only LIVE rows, so the bucketed match
+        needs no alive mask and a full-bucket hit emits with one
+        ``list.extend`` — the per-op numpy overhead on ~10² candidate
+        rows is the budget here, not the arithmetic."""
+        cache = self._bucket_np.get(key)
+        if cache is None:
+            rows = self._bucket.get(key)
+            if not rows:
+                return None
+            idx = np.asarray(rows, dtype=np.int64)
+            dl = self._deadline[idx]
+            cache = (
+                idx,
+                self._tok[idx],
+                self._depth[idx],
+                dl,
+                self._stored_np[idx],
+                [self._msgs[r] for r in rows],
+                [self._topics[r] for r in rows],
+                bool(np.isinf(dl).all()),    # no per-message expiry set
+            )
+            self._bucket_np[key] = cache
+        return cache
+
+    def _match_rows(self, fw: list[str], now: int, out: list[Message],
+                    expired: list[str]) -> None:
+        n = self._n
+        if n == 0:
+            return
+        has_hash = fw[-1] == T.HASH
+        need = len(fw) - 1 if has_hash else len(fw)
+        if need > MAX_LEVELS:
+            # no array row is that deep (deep topics live in _deep,
+            # matched by the caller's fallback walk) — and the literal
+            # loops below must never index past the token matrix
+            return
+        # candidate narrowing: two literal leading levels hit a bucket
+        if len(fw) >= 2 and fw[0] not in (T.PLUS, T.HASH) \
+                and fw[1] not in (T.PLUS, T.HASH):
+            id0 = self._vocab.get(fw[0])
+            id1 = self._vocab.get(fw[1])
+            if id0 is None or id1 is None:
+                return                    # no retained topic has the prefix
+            cache = self._bucket_cache((id0, id1))
+            if cache is None:
+                return
+            idx, tok, depth, dl, stored, msgs, topics, all_inf = cache
+            mask = (depth >= need) if has_hash else (depth == need)
+            # levels 0/1 == the bucket key; need<=MAX_LEVELS bounds i
+            for i in range(2, min(len(fw), MAX_LEVELS)):
+                w = fw[i]
+                if w == T.HASH:
+                    break
+                if w == T.PLUS:
+                    continue
+                wid = self._vocab.get(w)
+                if wid is None:
+                    return                # literal word never stored
+                mask &= tok[:, i] == wid
+            if all_inf and not self.default_expiry_ms:
+                if mask.all():            # hit-dense fast path: one extend
+                    out.extend(msgs)
+                else:
+                    out.extend([msgs[j] for j in np.nonzero(mask)[0].tolist()])
+                return
+            fresh = dl > now
+            if self.default_expiry_ms:
+                fresh &= (now - stored) < self.default_expiry_ms
+            stale = np.nonzero(mask & ~fresh)[0]
+            hitj = np.nonzero(mask & fresh)[0]
+            out.extend([msgs[j] for j in hitj.tolist()])
+            expired.extend([topics[j] for j in stale.tolist()])
+            return
+        # full scan: wildcard in the first two levels
+        tok = self._tok[:n]
+        depth = self._depth[:n]
+        mask = self._alive[:n] & (
+            (depth >= need) if has_hash else (depth == need))
+        if fw[0] in (T.PLUS, T.HASH):
+            # MQTT 4.7.2: root wildcards never expose '$'-topics
+            mask &= ~self._dollar[:n]
+        for i, w in enumerate(fw[:MAX_LEVELS]):
+            if w == T.HASH:
+                break
+            if w == T.PLUS:
+                continue
+            wid = self._vocab.get(w)
+            if wid is None:
+                return                    # literal word never stored
+            mask &= tok[:, i] == wid
+        # expiry is part of the mask: no per-hit Python calls on the
+        # emission path (the workload is hit-bound — VERDICT r3 #5)
+        fresh = self._deadline[:n] > now
+        if self.default_expiry_ms:
+            fresh &= (now - self._stored_np[:n]) < self.default_expiry_ms
+        stale = np.nonzero(mask & ~fresh)[0]
+        hits = np.nonzero(mask & fresh)[0]
+        msgs = self._msgs
+        out.extend([msgs[r] for r in hits.tolist()])
+        if stale.size:
+            topics = self._topics
+            expired.extend([topics[r] for r in stale.tolist()])
+
+    @staticmethod
+    def _msg_deadline(msg: Message) -> float:
+        interval = (msg.headers.get("properties") or {}).get(
+            "Message-Expiry-Interval")
+        if interval is None:
+            return float("inf")
+        return msg.timestamp + interval * 1000
+
+    def _msg_expired(self, msg: Message, stored_at: int, now: int) -> bool:
         if msg.is_expired(now):
             return True
-        if self.default_expiry_ms and now - node.stored_at >= self.default_expiry_ms:
+        if self.default_expiry_ms and now - stored_at >= self.default_expiry_ms:
             return True
         return False
-
-    def _emit(self, node: _Node, out: list[Message], now: int) -> None:
-        if node.msg is not None:
-            if self._expired(node, now):
-                self._expired_paths.append(node.msg.topic)
-            else:
-                out.append(node.msg)
-
-    def _walk(self, node: _Node, fw: list[str], i: int,
-              first_level: bool, out: list[Message], now: int) -> None:
-        if i == len(fw):
-            self._emit(node, out, now)
-            return
-        w = fw[i]
-        if w == T.HASH:
-            # '#' matches the parent level and everything below — but a
-            # root wildcard must not expose '$'-topics (MQTT 4.7.2)
-            self._emit(node, out, now)
-            stack = [
-                c for name, c in node.children.items()
-                if not (first_level and name.startswith("$"))
-            ]
-            while stack:
-                n = stack.pop()
-                self._emit(n, out, now)
-                stack.extend(n.children.values())
-            return
-        if w == T.PLUS:
-            for name, child in node.children.items():
-                if first_level and name.startswith("$"):
-                    continue
-                self._walk(child, fw, i + 1, False, out, now)
-        else:
-            child = node.children.get(w)
-            if child is not None:
-                self._walk(child, fw, i + 1, False, out, now)
 
     # -- maintenance ---------------------------------------------------------
 
@@ -161,14 +363,15 @@ class Retainer:
         now = now_ms() if now is None else now
         removed = 0
         with self._lock:
-            victims = []
-            walk = [(self._root, [])]
-            while walk:
-                node, path = walk.pop()
-                if node.msg is not None and self._expired(node, now):
-                    victims.append(T.join(path))
-                for w, c in node.children.items():
-                    walk.append((c, path + [w]))
+            victims = [
+                self._topics[r]
+                for r in range(self._n)
+                if self._alive[r] and self._msgs[r] is not None
+                and self._msg_expired(self._msgs[r], self._stored[r], now)
+            ]
+            victims.extend(
+                t for t, (m, s) in self._deep.items()
+                if self._msg_expired(m, s, now))
             for topic in victims:
                 if self.delete(topic):
                     removed += 1
@@ -176,12 +379,7 @@ class Retainer:
 
     def topics(self) -> list[str]:
         with self._lock:
-            out = []
-            walk = [(self._root, [])]
-            while walk:
-                node, path = walk.pop()
-                if node.msg is not None:
-                    out.append(T.join(path))
-                for w, c in node.children.items():
-                    walk.append((c, path + [w]))
+            out = [self._topics[r] for r in range(self._n)
+                   if self._alive[r]]
+            out.extend(self._deep)
             return out

@@ -176,10 +176,10 @@ def test_retained_and_sys_topics_punt():
     server.stop()
 
 
-def test_shared_sub_match_punts_whole_publish():
-    """A topic matched by both a normal and a $share subscription must
-    deliver via Python (once to the group, once to the normal sub) —
-    the punt marker forces the full fan-out."""
+def test_shared_group_native_when_all_members_fast():
+    """A $share group whose members are all fast native connections is
+    served by the C++ dispatcher (round_robin): normal + group
+    deliveries both happen natively once the permit lands."""
     server = NativeBrokerServer(port=0, app=BrokerApp())
     server.start()
 
@@ -196,14 +196,123 @@ def test_shared_sub_match_punts_whole_publish():
             await pub.publish("st/x", f"s{i}".encode(), qos=0)
             await _settle(0.2)
         # normal sub saw all three; group member saw all three (single
-        # member); nothing was handled natively
+        # member) — and the steady state ran in C++
         for i in range(3):
             m = await normal.recv(timeout=5)
             assert m.payload == f"s{i}".encode()
             g = await member.recv(timeout=5)
             assert g.payload == f"s{i}".encode()
-        assert server.fast_stats()["fast_in"] == 0
+        stats = server.fast_stats()
+        assert stats["fast_in"] >= 1 and stats["shared_dispatch"] >= 1, stats
         await normal.close(); await member.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_shared_group_round_robin_rotates_natively():
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        m1 = MqttClient(port=server.port, clientid="rr1")
+        await m1.connect(); await m1.subscribe("$share/g/rr/t", qos=0)
+        m2 = MqttClient(port=server.port, clientid="rr2")
+        await m2.connect(); await m2.subscribe("$share/g/rr/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="rrp")
+        await pub.connect()
+        await pub.publish("rr/t", b"warm", qos=0)
+        await _settle()
+        for i in range(8):
+            await pub.publish("rr/t", f"n{i}".encode(), qos=0)
+
+        async def drain(c):
+            got = []
+            while True:
+                try:
+                    got.append((await c.recv(timeout=0.5)).payload)
+                except asyncio.TimeoutError:
+                    return got
+        g1, g2 = await drain(m1), await drain(m2)
+        assert len(g1) + len(g2) == 9, (g1, g2)
+        assert abs(len(g1) - len(g2)) <= 2        # rotating, not sticky
+        assert server.fast_stats()["shared_dispatch"] >= 8
+        await m1.close(); await m2.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_shared_group_mixed_membership_punts():
+    """One persistent-session member makes the whole group punt: the
+    Python SharedSub owns dispatch (its mqueue/offline semantics)."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        fast = MqttClient(port=server.port, clientid="mxf")
+        await fast.connect()
+        await fast.subscribe("$share/g/mx/t", qos=0)
+        persist = MqttClient(port=server.port, clientid="mxp",
+                             clean_start=False, proto_ver=5,
+                             properties={"Session-Expiry-Interval": 300})
+        await persist.connect()
+        await persist.subscribe("$share/g/mx/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="mxpub")
+        await pub.connect()
+        for i in range(4):
+            await pub.publish("mx/t", f"p{i}".encode(), qos=0)
+            await _settle(0.2)
+        stats = server.fast_stats()
+        assert stats["shared_dispatch"] == 0, stats  # group stayed punted
+
+        async def drain(c):
+            got = []
+            while True:
+                try:
+                    got.append((await c.recv(timeout=0.5)).payload)
+                except asyncio.TimeoutError:
+                    return got
+        g1, g2 = await drain(fast), await drain(persist)
+        assert len(g1) + len(g2) == 4, (g1, g2)   # each msg exactly once
+        await fast.close(); await persist.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_shared_strategy_change_moves_groups_off_native():
+    """Only round_robin runs in C++: flipping the strategy reconciles
+    live groups back onto the Python dispatcher."""
+    from emqx_tpu.config.config import Config
+    conf = Config()
+    conf.init_load("")
+    app = BrokerApp.from_config(conf)
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        m1 = MqttClient(port=server.port, clientid="sc1")
+        await m1.connect(); await m1.subscribe("$share/g/sc/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="scp")
+        await pub.connect()
+        await pub.publish("sc/t", b"w", qos=0)
+        await m1.recv(timeout=5)
+        await _settle()
+        await pub.publish("sc/t", b"n", qos=0)
+        await m1.recv(timeout=5)
+        assert await _wait_fast(server, "shared_dispatch", 1)
+        base = server.fast_stats()["shared_dispatch"]
+        conf.put("shared_subscription_strategy", "sticky")
+        await _settle(0.3)
+        for i in range(3):
+            await pub.publish("sc/t", f"s{i}".encode(), qos=0)
+            m = await m1.recv(timeout=5)
+            assert m.payload == f"s{i}".encode()
+            await _settle(0.15)
+        assert server.fast_stats()["shared_dispatch"] == base, \
+            "sticky strategy must not dispatch natively"
+        await m1.close(); await pub.close()
 
     run(main())
     server.stop()
@@ -424,15 +533,18 @@ def test_rewrite_topics_never_earn_permits():
     server.stop()
 
 
-def test_two_share_groups_refcounted_punt():
-    """Two $share groups over one real topic share a single punt
-    marker; unsubscribing one group must NOT remove the marker the
-    other still needs (round-4 review finding: punt refcounting)."""
+def test_two_share_groups_punt_markers_are_independent():
+    """Two punt-mode $share groups over one real topic own separate
+    punt state; unsubscribing one group must NOT remove the marker the
+    other still needs (round-4 review finding). A persistent-session
+    member keeps both groups in punt mode (not natively served)."""
     server = NativeBrokerServer(port=0, app=BrokerApp())
     server.start()
 
     async def main():
-        m1 = MqttClient(port=server.port, clientid="g1m")
+        m1 = MqttClient(port=server.port, clientid="g1m",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
         await m1.connect()
         await m1.subscribe("$share/ga/sh/t", qos=0)
         await m1.subscribe("$share/gb/sh/t", qos=0)
@@ -449,8 +561,10 @@ def test_two_share_groups_refcounted_punt():
             m = await m1.recv(timeout=5)
             assert m.payload == f"x{i}".encode()
             await _settle(0.15)
-        # the surviving group still punts every publish
-        assert server.fast_stats()["fast_in"] == 0
+        # the surviving group still punts every publish (persistent
+        # member => never native)
+        stats = server.fast_stats()
+        assert stats["fast_in"] == 0 and stats["shared_dispatch"] == 0
         await m1.close(); await pub.close()
 
     run(main())

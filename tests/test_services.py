@@ -242,9 +242,78 @@ def test_hash_strategy_deterministic_across_instances():
     assert s1.pick("g", "t", m1) == s2.pick("g", "t", m1)
 
 
-def test_retainer_lazy_expiry_prunes_nodes():
+def test_retainer_lazy_expiry_prunes_store():
     r = Retainer(default_expiry_ms=10)
     r.store(msg("deep/a/b/c", b"1", retain=True), now=0)
     assert r.match("deep/#", now=100) == []
     assert len(r) == 0
-    assert r._root.children == {}        # branches pruned, not leaked
+    # the lazy expiry released the entry, not just hid it: the topic is
+    # re-storable and absent from the dump (the vectorized store
+    # tombstones rows; compaction reclaims them in bulk)
+    assert r.topics() == []
+    assert r._row_of == {}
+    assert r.store(msg("deep/a/b/c", b"2", retain=True), now=200)
+    assert [m.payload for m in r.match("deep/#", now=201)] == [b"2"]
+
+
+def test_retainer_vectorized_store_edges():
+    """Round-4 vectorized retainer: deep-topic fallback, bucket
+    invalidation across delete/re-store, tombstone compaction, and the
+    wildcard-prefix full scan all agree with T.match semantics."""
+    from emqx_tpu.core import topic as T
+
+    r = Retainer()
+    deep = "a/" * 20 + "leaf"            # > MAX_LEVELS: fallback dict
+    r.store(msg(deep, b"deep", retain=True))
+    for i in range(50):
+        r.store(msg(f"v/d{i}/s", bytes(str(i), "ascii"), retain=True))
+    assert [m.payload for m in r.match("a/#")] == [b"deep"]
+    assert len(r.match("v/+/s")) == 50       # full scan (wildcard lvl 1)
+    assert len(r.match("v/d7/s")) == 1       # bucketed
+    # delete + re-store invalidate the warm bucket cache
+    assert len(r.match("v/d7/+")) == 1       # warm the (v, d7) bucket
+    r.delete("v/d7/s")
+    assert r.match("v/d7/+") == []
+    r.store(msg("v/d7/s", b"back", retain=True))
+    assert [m.payload for m in r.match("v/d7/+")] == [b"back"]
+    # mass delete triggers compaction; survivors still match
+    for i in range(50):
+        if i != 7:
+            r.delete(f"v/d{i}/s")
+    for _ in range(1500):                # push past the tombstone gate
+        r.store(msg("w/x/y", b"t", retain=True))
+        r.delete("w/x/y")
+    assert [m.payload for m in r.match("v/#")] == [b"back"]
+    assert sorted(r.topics()) == sorted([deep, "v/d7/s"])
+    # differential spot-check vs T.match over a random mix
+    import random
+    rng = random.Random(3)
+    r2 = Retainer()
+    topics = [f"{rng.choice(['x','y'])}/{rng.choice(['a','b','c'])}/"
+              f"n{i % 7}" for i in range(60)] + ["$sys/u/v"]
+    for i, t in enumerate(set(topics)):
+        r2.store(msg(t, b"m", retain=True))
+    for filt in ["x/+/n1", "+/a/#", "#", "x/#", "+/+/+", "$sys/#",
+                 "x/a/n1", "zz/+/+"]:
+        want = sorted(t for t in r2.topics()
+                      if T.match(t, filt)
+                      and not (filt[0] in "+#" and t.startswith("$")))
+        got = sorted(m.topic for m in r2.match(filt))
+        assert got == want, (filt, got, want)
+
+
+def test_retainer_deep_filters_and_topics():
+    """Filters and topics beyond MAX_LEVELS must neither crash nor miss
+    (round-4 review finding: the literal-word loop indexed past the
+    token matrix for 17+-level filters)."""
+    r = Retainer()
+    r.store(msg("a/b", b"shallow", retain=True))
+    deep_t = "/".join(["d"] * 20)
+    r.store(msg(deep_t, b"deep", retain=True))
+    deep_filt = "/".join(["x"] * 17)         # deeper than MAX_LEVELS
+    assert r.match(deep_filt) == []           # no crash, no hits
+    assert [m.payload for m in r.match("/".join(["d"] * 20))] == [b"deep"]
+    assert [m.payload for m in r.match("d/#")] == [b"deep"]
+    assert [m.payload for m in r.match("a/+")] == [b"shallow"]
+    wild_deep = "/".join(["+"] * 17)
+    assert r.match(wild_deep) == []           # full-scan path, no crash

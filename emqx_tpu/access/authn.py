@@ -33,6 +33,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from emqx_tpu.access import hashing
 from emqx_tpu.access.hashing import (
     HashSpec, check_password, gen_salt, hash_password,
 )
@@ -73,6 +74,7 @@ class BuiltinDbProvider(Provider):
                  hash_spec: Optional[HashSpec] = None) -> None:
         self.user_id_type = user_id_type          # username | clientid
         self.hash_spec = hash_spec or HashSpec()
+        hashing.warm(self.hash_spec)
         self._users: dict[str, _UserRow] = {}
 
     def add_user(self, user_id: str, password: str,

@@ -74,6 +74,7 @@ class BrokerApp:
             shared_dispatch=self._shared_dispatch,
             metrics=self.metrics,
         )
+        self.broker.shared_dispatch_batch = self._shared_dispatch_batch
         # device serving path (router.device): coalesces the servers'
         # publishes into batched kernel launches (broker/pipeline.py)
         self.pipeline = None
@@ -589,15 +590,24 @@ class BrokerApp:
     def _shared_on_terminated(self, sid: str, *args) -> None:
         self.shared.member_down(sid)
 
+    def _shared_deliver_fn(self, sid: str, node: str) -> bool:
+        ch = self.cm.lookup_channel(sid)
+        return ch is not None and ch.conn_state == "connected"
+
     def _shared_dispatch(self, group: str, topic: str, msg: Message):
-        def deliver_fn(sid: str, node: str) -> bool:
-            ch = self.cm.lookup_channel(sid)
-            return ch is not None and ch.conn_state == "connected"
         return [
             (sid, sub_topic)
             for sid, _node, sub_topic in self.shared.dispatch(
-                group, topic, msg, deliver_fn=deliver_fn)
+                group, topic, msg, deliver_fn=self._shared_deliver_fn)
         ]
+
+    def _shared_dispatch_batch(self, legs):
+        """broker.shared_dispatch_batch seam: all of a publish batch's
+        shared legs resolve under ONE SharedSub lock hold
+        (broker/shared_sub.py dispatch_batch)."""
+        picks = self.shared.dispatch_batch(
+            legs, deliver_fn=self._shared_deliver_fn)
+        return [[(p[0], p[2])] if p is not None else [] for p in picks]
 
     # -- housekeeping (server timer) ----------------------------------------
 

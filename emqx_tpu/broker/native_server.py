@@ -284,27 +284,39 @@ class NativeBrokerServer:
             self._shared_state.pop(gkey, None)
             self._punt_tokens.pop(("$g", f"{group}/{real}"), None)
             return
-        if all(self._shared_native_ok(s, o) for s, o in members.items()):
-            new_map = {s: self._fast_conn_of[s] for s in members}
-            if installed == "punt":
-                self.host.sub_del(token, real)
+        # _fast_conn_of is mutated by the poll thread outside this
+        # lock: snapshot with .get and demote to punt on any miss
+        # instead of racing into a KeyError
+        new_map = ({s: self._fast_conn_of.get(s) for s in members}
+                   if all(self._shared_native_ok(s, o)
+                          for s, o in members.items()) else None)
+        if new_map is not None and None not in new_map.values():
+            # install-first ordering: the ops queue applies in FIFO, so
+            # adding the group entries BEFORE deleting the punt marker
+            # leaves no window where the group is served by neither
+            # (overlap is safe — TryFast checks punt markers before any
+            # group dispatch, so a punt+group overlap can't
+            # double-deliver)
             old = installed if isinstance(installed, dict) else {}
-            for s, conn in old.items():
-                if new_map.get(s) != conn:
-                    self.host.shared_del(token, conn, real)
             for s, conn in new_map.items():
                 o = members[s]
                 # upsert: refreshes qos/nl for existing members too
                 self.host.shared_add(
                     token, conn, real, getattr(o, "qos", 0),
                     native.SUB_NO_LOCAL if getattr(o, "nl", 0) else 0)
+            if installed == "punt":
+                self.host.sub_del(token, real)
+            for s, conn in old.items():
+                if new_map.get(s) != conn:
+                    self.host.shared_del(token, conn, real)
             st["installed"] = new_map
         else:
+            # punt-first for the reverse flip, same no-gap reasoning
+            if installed != "punt":
+                self.host.sub_add(token, real, 0, native.SUB_PUNT)
             if isinstance(installed, dict):
                 for conn in installed.values():
                     self.host.shared_del(token, conn, real)
-            if installed != "punt":
-                self.host.sub_add(token, real, 0, native.SUB_PUNT)
             st["installed"] = "punt"
 
     def reeval_shared_groups(self) -> None:

@@ -183,9 +183,9 @@ class SharedSub:
         per leg, so the semantics match the single-message API
         (emqx_shared_sub.erl:138-157 strategy table)."""
         s = self.strategy
-        if deliver_fn is not None or s not in (
-                "round_robin", "round_robin_per_group",
-                "hash_clientid", "hash_topic"):
+        if s not in ("round_robin", "round_robin_per_group",
+                     "hash_clientid", "hash_topic") or (
+                deliver_fn is not None and s != "round_robin"):
             return [
                 (d[0] if (d := self.dispatch(g, t, m,
                                              deliver_fn=deliver_fn))
@@ -203,9 +203,23 @@ class SharedSub:
                         append(None)
                         continue
                     members = ent[0]
+                    n = len(members)
                     i = ent[2] + 1
                     ent[2] = i
-                    m = members[i % len(members)]
+                    m = members[i % n]
+                    if deliver_fn is not None and msg.qos:
+                        # QoS>0 redispatch: rotate past nacked members
+                        # (same skip-forward as dispatch(); the cursor
+                        # keeps the position so the group still rotates)
+                        for _try in range(n):
+                            if deliver_fn(m[0], m[1]):
+                                break
+                            i += 1
+                            ent[2] = i
+                            m = members[i % n]
+                        else:
+                            append(None)
+                            continue
                     append((m[0], m[1], ent[1]))
             elif s == "round_robin_per_group":
                 rrg = self._rr_group

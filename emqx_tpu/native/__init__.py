@@ -52,6 +52,7 @@ def _build() -> None:
         os.path.join(_SRC_DIR, "host.cc"),
         os.path.join(_SRC_DIR, "snappy.cc"),
         os.path.join(_SRC_DIR, "loadgen.cc"),
+        os.path.join(_SRC_DIR, "bcrypt.cc"),
         "-o", _LIB_PATH,
     ]
     if _SANITIZE:
@@ -132,6 +133,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_subtable_match.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+    lib.emqx_bcrypt_hash.restype = ctypes.c_int
+    lib.emqx_bcrypt_hash.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p]
+    lib.emqx_bcrypt_gensalt.restype = ctypes.c_int
+    lib.emqx_bcrypt_gensalt.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
     lib.emqx_loadgen_run.restype = ctypes.c_int
     lib.emqx_loadgen_run.argtypes = [
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32,

@@ -98,7 +98,67 @@ def build_listener(app, name: str, conf: dict):
     )
     if ltype in ("ws", "wss"):
         return WsBrokerServer(path=conf.get("websocket_path", "/mqtt"), **kw)
+    if ltype == "native":
+        return NativeListener(
+            app=app, host=host, port=port,
+            max_connections=kw["max_connections"],
+            mountpoint=kw["mountpoint"],
+            listener_id=kw["listener_id"],
+            fast_path=bool(conf.get("fast_path", True)))
     return BrokerServer(**kw)
+
+
+class NativeListener:
+    """Async-supervisor adapter over the C++ epoll host
+    (``broker/native_server.py``) so ``listeners { n1 { type = native } }``
+    boots it like any other listener. Construction (which may compile
+    the C++ library on first use) and teardown (thread join +
+    host.destroy) run in a worker thread — blocking the event loop here
+    would stall every other listener and the management API."""
+
+    def __init__(self, app, host: str, port: int, max_connections: int,
+                 mountpoint: str, listener_id: str,
+                 fast_path: bool = True) -> None:
+        self._app = app
+        self._bind = (host, port)
+        self._kw = dict(max_connections=max_connections,
+                        mountpoint=mountpoint, fast_path=fast_path)
+        self.listener_id = listener_id
+        self.host = host
+        self.port = port
+        self.max_connections = max_connections
+        self.ssl_context = None
+        self._srv = None
+        self._server = None          # "running" flag for info()
+
+    @property
+    def connections(self):
+        return self._srv.conns if self._srv is not None else {}
+
+    def fast_stats(self) -> dict:
+        return self._srv.fast_stats() if self._srv is not None else {}
+
+    async def start(self) -> None:
+        import asyncio
+
+        def _boot():
+            from emqx_tpu.broker.native_server import NativeBrokerServer
+            srv = NativeBrokerServer(
+                app=self._app, host=self._bind[0], port=self._bind[1],
+                **self._kw)
+            srv.start()
+            return srv
+
+        self._srv = await asyncio.to_thread(_boot)
+        self.port = self._srv.port
+        self._server = self._srv
+
+    async def stop(self) -> None:
+        import asyncio
+
+        srv, self._srv, self._server = self._srv, None, None
+        if srv is not None:
+            await asyncio.to_thread(srv.stop)
 
 
 class Listeners:

@@ -31,8 +31,10 @@ always correct):
 - **packet ids** — native QoS1 deliveries use pids >= 32768
   (host.cc kNativePidBase), Python sessions stay below
   (session/session.py PKT_ID_SPACE), so PUBACKs route unambiguously;
-- **clustered nodes** — remote routes don't traverse the observer, so
-  the fast path disables itself when a forward_fn is wired.
+- **clustered nodes** — remote routes mirror into the C++ table as
+  punt markers via ``router.route_observers`` (fired under the router
+  lock, in table order), so a publish with any remote audience takes
+  the Python path, which forwards it over the cluster plane.
 """
 
 from __future__ import annotations
@@ -137,6 +139,10 @@ class NativeBrokerServer:
         self._mirror: dict[tuple[str, str], tuple[int, str, str]] = {}
         self._punt_refs: dict[tuple[int, str], int] = {}
         self._token_refs: dict[str, int] = {}           # sid -> live punts
+        # serializes the refcounted punt bookkeeping: sub events arrive
+        # on broker threads, route events on cluster threads
+        self._mirror_lock = threading.Lock()
+        self._route_punts: set[tuple[str, str]] = set()
         self._fast_conn_of: dict[str, int] = {}         # clientid -> conn
         self._granted: dict[int, set[str]] = {}         # conn -> topics
         self._permit_queue: list[tuple[_NativeConn, str]] = []
@@ -156,10 +162,15 @@ class NativeBrokerServer:
                 app.on_shared_strategy_change = []
             app.on_shared_strategy_change.append(self.reeval_shared_groups)
         self.broker.sub_observers.append(self._on_sub_event)
+        self.broker.router.route_observers.append(self._on_route_event)
         # mirror subscriptions that existed before this server started
         # (resumed persistent sessions, other transports on the same app)
         for (sid, topic), opts in list(self.broker.suboption.items()):
             self._on_sub_event("add", sid, topic, opts)
+        # ...and pre-existing remote routes (a node joining a live
+        # cluster replays the route snapshot before listeners start)
+        for topic, dest in self.broker.router.dump():
+            self._on_route_event("add", topic, dest)
         if app is not None and hasattr(app, "rules"):
             app.rules.on_topology_change.append(self.flush_permits)
         if app is not None and hasattr(getattr(app, "bridges", None),
@@ -178,51 +189,90 @@ class NativeBrokerServer:
         return self.host.stats()
 
     def _fast_global(self) -> bool:
-        if not self.fast_path:
-            return False
-        # clustered: remote routes don't traverse sub_observers, so a
-        # native fan-out could silently skip a remote subscriber
-        if self.broker.forward_fn is not None:
-            return False
-        return True
+        # clustered nodes stay eligible: remote routes mirror into the
+        # C++ table as punt markers via router.route_observers, so a
+        # publish with any remote audience takes the Python path (which
+        # forwards it over the cluster plane)
+        return self.fast_path
 
     def _token(self, sid: str) -> int:
-        tok = self._punt_tokens.get(sid)
-        if tok is None:
-            tok = self._punt_token_next
-            self._punt_token_next += 1
-            self._punt_tokens[sid] = tok
-        return tok
+        # keys are NAMESPACED ("c:" clientids, "g:" share groups,
+        # "n:" remote nodes) so a hostile clientid like "n:node2" can
+        # never collide with an infrastructure token.
+        # under _mirror_lock: concurrent first-use from a broker thread
+        # and a cluster route thread must not mint two tokens
+        with self._mirror_lock:
+            tok = self._punt_tokens.get(sid)
+            if tok is None:
+                tok = self._punt_token_next
+                self._punt_token_next += 1
+                self._punt_tokens[sid] = tok
+            return tok
 
     def _add_entry(self, sid: str, owner: int, real: str, kind: str,
                    qos: int, flags: int) -> None:
         if kind == "punt":
-            key = (owner, real)
-            self._punt_refs[key] = self._punt_refs.get(key, 0) + 1
-            if self._punt_refs[key] == 1:
-                self._token_refs[sid] = self._token_refs.get(sid, 0) + 1
-                self.host.sub_add(owner, real, 0, native.SUB_PUNT)
+            with self._mirror_lock:
+                key = (owner, real)
+                self._punt_refs[key] = self._punt_refs.get(key, 0) + 1
+                if self._punt_refs[key] == 1:
+                    self._token_refs[sid] = self._token_refs.get(sid, 0) + 1
+                    self.host.sub_add(owner, real, 0, native.SUB_PUNT)
         else:
             self.host.sub_add(owner, real, qos, flags)
 
     def _del_entry(self, sid: str, owner: int, real: str,
                    kind: str) -> None:
         if kind == "punt":
-            key = (owner, real)
-            n = self._punt_refs.get(key, 0) - 1
-            if n > 0:
-                self._punt_refs[key] = n
-                return                 # another sub key still needs it
-            self._punt_refs.pop(key, None)
-            left = self._token_refs.get(sid, 1) - 1
-            if left <= 0:
-                # last punt for this sid: free its token so clientid
-                # churn doesn't leak dict entries forever
-                self._token_refs.pop(sid, None)
-                self._punt_tokens.pop(sid, None)
-            else:
-                self._token_refs[sid] = left
+            with self._mirror_lock:
+                key = (owner, real)
+                n = self._punt_refs.get(key, 0) - 1
+                if n > 0:
+                    self._punt_refs[key] = n
+                    return             # another sub key still needs it
+                self._punt_refs.pop(key, None)
+                left = self._token_refs.get(sid, 1) - 1
+                if left <= 0:
+                    # last punt for this sid: free its token so clientid
+                    # churn doesn't leak dict entries forever
+                    self._token_refs.pop(sid, None)
+                    self._punt_tokens.pop(sid, None)
+                else:
+                    self._token_refs[sid] = left
+                self.host.sub_del(owner, real)
+                return
         self.host.sub_del(owner, real)
+
+    # -- cluster routes ------------------------------------------------------
+    # A remote-node route means subscribers this node cannot see in its
+    # broker tables: mirror it as a punt marker so the fast path punts
+    # matching publishes to Python, whose _route forwards them over the
+    # cluster plane. This replaces the round-4-initial design of
+    # disabling the fast path entirely on clustered nodes.
+
+    def _on_route_event(self, op: str, topic: str, dest) -> None:
+        node = None
+        if isinstance(dest, tuple):
+            node = dest[1]       # ({group}, node) shared route
+        elif isinstance(dest, str):
+            node = dest
+        if node in (None, "local", self.broker.node):
+            return               # local routes come via sub_observers
+        sid = f"n:{node}"
+        key = (sid, topic)
+        # the router fires each (topic, dest) add/del exactly once in
+        # table order; this set makes the bootstrap dump() replay
+        # idempotent against events that raced in before the snapshot
+        if op == "add":
+            if key in self._route_punts:
+                return
+            self._route_punts.add(key)
+            self._add_entry(sid, self._token(sid), topic, "punt", 0, 0)
+        else:
+            if key not in self._route_punts:
+                return
+            self._route_punts.discard(key)
+            self._del_entry(sid, self._token(sid), topic, "punt")
 
     # -- shared groups -------------------------------------------------------
     # A $share group is natively served only while EVERY member is a
@@ -232,13 +282,7 @@ class NativeBrokerServer:
     # per (group, real filter), owned by a group token.
 
     def _group_token(self, group: str, real: str) -> int:
-        key = ("$g", f"{group}/{real}")
-        tok = self._punt_tokens.get(key)          # reuse the token pool
-        if tok is None:
-            tok = self._punt_token_next
-            self._punt_token_next += 1
-            self._punt_tokens[key] = tok
-        return tok
+        return self._token(f"g:{group}/{real}")   # namespaced token pool
 
     def _shared_native_ok(self, sid: str, opts) -> bool:
         return (self._fast_global()
@@ -282,7 +326,8 @@ class NativeBrokerServer:
                 for conn in installed.values():
                     self.host.shared_del(token, conn, real)
             self._shared_state.pop(gkey, None)
-            self._punt_tokens.pop(("$g", f"{group}/{real}"), None)
+            with self._mirror_lock:
+                self._punt_tokens.pop(f"g:{group}/{real}", None)
             return
         # _fast_conn_of is mutated by the poll thread outside this
         # lock: snapshot with .get and demote to punt on any miss
@@ -350,7 +395,7 @@ class NativeBrokerServer:
             else:
                 # shared group / persistent session / subscription id /
                 # subscriber living on another transport: punt marker
-                owner, kind = self._token(sid), "punt"
+                owner, kind = self._token("c:" + sid), "punt"
                 qos = flags = 0
             old = self._mirror.get((sid, topic))
             if old is not None and (old[0], old[1], old[2]) != (
@@ -358,13 +403,13 @@ class NativeBrokerServer:
                 # resubscribe flipped eligibility (e.g. a subscription
                 # id appeared): the previously installed entry must go,
                 # or it would keep delivering after UNSUBSCRIBE
-                self._del_entry(sid, old[0], old[1], old[2])
-            self._add_entry(sid, owner, real, kind, qos, flags)
+                self._del_entry("c:" + sid, old[0], old[1], old[2])
+            self._add_entry("c:" + sid, owner, real, kind, qos, flags)
             self._mirror[(sid, topic)] = (owner, real, kind)
         else:
             ent = self._mirror.pop((sid, topic), None)
             if ent is not None:
-                self._del_entry(sid, ent[0], ent[1], ent[2])
+                self._del_entry("c:" + sid, ent[0], ent[1], ent[2])
 
     def _maybe_enable_fast(self, conn: _NativeConn) -> None:
         """Post-CONNACK: clean sessions with no expiry get the fast
@@ -627,6 +672,10 @@ class NativeBrokerServer:
             self._thread = None
         try:
             self.broker.sub_observers.remove(self._on_sub_event)
+        except ValueError:
+            pass
+        try:
+            self.broker.router.route_observers.remove(self._on_route_event)
         except ValueError:
             pass
         if self.app is not None and hasattr(self.app, "rules"):

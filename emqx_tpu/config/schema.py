@@ -197,8 +197,13 @@ def ssl_options_schema() -> Struct:
 
 def listener_schema() -> Struct:
     return Struct({
-        "type": Field("enum", enum=["tcp", "ssl", "ws", "wss", "quic"],
+        # "native" = the C++ epoll host with the QoS0/1 publish data
+        # plane (broker/native_server.py); fast_path turns the data
+        # plane off while keeping C++ socket IO
+        "type": Field("enum",
+                      enum=["tcp", "ssl", "ws", "wss", "quic", "native"],
                       default="tcp"),
+        "fast_path": Field("bool", default=True),
         "bind": Field("string", default="0.0.0.0:1883"),
         "enabled": Field("bool", default=True),
         "max_connections": Field("int", default=1_000_000),

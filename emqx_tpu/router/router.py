@@ -43,6 +43,15 @@ class Router:
         self._seq = 0
         self._base_seq = 0
         self._log: list[RouteDelta] = []
+        # route observers: fn(op, topic, dest) with op in {"add","del"},
+        # fired UNDER the router lock so callbacks see table order —
+        # an add/del pair for the same route delivered out of order
+        # would permanently desync a mirror. Observers must be quick
+        # and must not call back into the Router. The native host
+        # mirrors REMOTE routes as punt markers through this seam so
+        # its fast path stays complete on a clustered node
+        # (broker/native_server.py)
+        self.route_observers: list = []
 
     # -- mutation (emqx_router:do_add_route/2 :123-138) ---------------------
 
@@ -61,6 +70,8 @@ class Router:
             if T.wildcard(topic):
                 filter_new = self._trie.insert(topic)
             self._append("add", topic, dest, filter_new)
+            for obs in self.route_observers:
+                obs("add", topic, dest)
             return True
 
     def delete_route(self, topic: str, dest: Any = "local") -> bool:
@@ -75,6 +86,8 @@ class Router:
             if T.wildcard(topic):
                 filter_gone = self._trie.delete(topic)
             self._append("del", topic, dest, filter_gone)
+            for obs in self.route_observers:
+                obs("del", topic, dest)
             return True
 
     def _append(self, op: str, topic: str, dest: Any, fnew: bool) -> None:
@@ -100,6 +113,11 @@ class Router:
     def has_route(self, topic: str, dest: Any) -> bool:
         with self._lock:
             return dest in self._routes.get(topic, ())
+
+    def dump(self) -> list[tuple[str, Any]]:
+        """All (topic, dest) pairs — route-observer bootstrap snapshot."""
+        with self._lock:
+            return [(t, d) for t, ds in self._routes.items() for d in ds]
 
     def topics(self) -> list[str]:
         with self._lock:

@@ -569,3 +569,97 @@ def test_two_share_groups_punt_markers_are_independent():
 
     run(main())
     server.stop()
+
+
+def test_config_driven_native_listener():
+    """listeners { n1 { type = native } } boots the C++ host through
+    the standard listener supervisor, data plane included."""
+    from emqx_tpu.config.config import Config
+
+    conf = Config()
+    conf.init_load(
+        'listeners { nat { type = native, bind = "127.0.0.1:0" } }')
+    app = BrokerApp.from_config(conf)
+
+    async def main():
+        ids = await app.listeners.start_all(conf.get("listeners"))
+        assert ids == ["native:nat"]
+        lst = app.listeners.find("native:nat")
+        sub = MqttClient(port=lst.port, clientid="cs")
+        await sub.connect()
+        await sub.subscribe("cl/+", qos=0)
+        pub = MqttClient(port=lst.port, clientid="cp")
+        await pub.connect()
+        await pub.publish("cl/a", b"m0", qos=0)
+        assert (await sub.recv(timeout=5)).payload == b"m0"
+        await _settle()
+        await pub.publish("cl/a", b"m1", qos=0)
+        assert (await sub.recv(timeout=5)).payload == b"m1"
+        assert lst.fast_stats()["fast_in"] >= 1
+        info = app.listeners.info()
+        assert info[0]["type"] == "native" and info[0]["running"]
+        await sub.close(); await pub.close()
+        await app.listeners.stop_all()
+
+    run(main())
+
+
+def test_clustered_node_keeps_fast_path_with_remote_punts():
+    """A clustered node keeps its C++ data plane: topics with a remote
+    audience punt (the route observer mirrors remote routes as
+    markers) and get forwarded; local-only topics stay native."""
+    from emqx_tpu.cluster.harness import make_cluster, stop, sync
+    from emqx_tpu.mqtt import packet as P
+
+    nodes = make_cluster(2)
+    n1, n2 = nodes
+    server = NativeBrokerServer(port=0, app=n1.app)
+    server.start()
+
+    async def main():
+        # remote subscriber on node2 via the cluster plane
+        ch = _cluster_channel(n2, "rsub")
+        ch.handle_in(P.Subscribe(packet_id=1,
+                                 topic_filters=[("far/t", {"qos": 0})]))
+        sync(nodes)
+        assert n1.app.broker.router.has_route("far/t", "node2")
+
+        pub = MqttClient(port=server.port, clientid="np")
+        await pub.connect()
+        loc = MqttClient(port=server.port, clientid="nl")
+        await loc.connect()
+        await loc.subscribe("near/t", qos=0)
+
+        # remote-audience topic: every publish punts + forwards
+        for i in range(3):
+            await pub.publish("far/t", f"f{i}".encode(), qos=0)
+            await _settle(0.2)
+        got = [p for p in ch.outbox if isinstance(p, P.Publish)]
+        assert [p.payload for p in got] == [b"f0", b"f1", b"f2"]
+
+        # local-only topic: still rides the fast path
+        await pub.publish("near/t", b"n0", qos=0)
+        await loc.recv(timeout=5)
+        await _settle()
+        await pub.publish("near/t", b"n1", qos=0)
+        await loc.recv(timeout=5)
+        assert server.fast_stats()["fast_in"] >= 1
+        await pub.close(); await loc.close()
+
+    def _cluster_channel(node, clientid):
+        from emqx_tpu.broker.channel import Channel
+
+        outbox = []
+        ch = Channel(node.app.broker, node.app.cm,
+                     send=lambda pkts: outbox.extend(pkts))
+        ch.outbox = outbox
+        out = ch.handle_in(P.Connect(clientid=clientid, proto_ver=P.MQTT_V5,
+                                     clean_start=True))
+        assert out[0].reason_code == P.RC_SUCCESS
+        return ch
+
+    try:
+        run(main())
+    finally:
+        server.stop()
+        stop(nodes)

@@ -91,8 +91,147 @@ print("SANITIZED-RUN-OK")
 """
 
 
+# Round-4 fast-path coverage: enable_fast + sub/shared/punt control
+# ops racing the poll thread, qos0/1 publish fan-out in C++ (TryFast /
+# DeliverTo / TryFastPuback), native PUBACK consumption, permit churn,
+# and close-during-delivery.
+DRIVER_FASTPATH = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+host = native.NativeHost(port=0, max_size=1 << 16)
+
+def mqtt_connect(cid):
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    return bytes([0x10, len(vh)]) + vh
+
+def mqtt_publish(topic, payload, qos=0, pid=0):
+    body = struct.pack(">H", len(topic)) + topic
+    if qos:
+        body += struct.pack(">H", pid)
+    body += payload
+    return bytes([0x30 | (qos << 1), len(body)]) + body
+
+socks = [socket.create_connection(("127.0.0.1", host.port))
+         for _ in range(3)]
+ids = []
+for i, s in enumerate(socks):
+    s.sendall(mqtt_connect(b"f%%d" %% i))
+deadline = time.time() + 15
+framed = 0
+while (len(ids) < 3 or framed < 3) and time.time() < deadline:
+    for kind, conn, payload in host.poll(50):
+        if kind == native.EV_OPEN:
+            ids.append(conn)
+        elif kind == native.EV_FRAME:
+            framed += 1
+            host.send(conn, b"\x20\x02\x00\x00")
+assert len(ids) == 3 and framed == 3, (ids, framed)
+sub1, sub2, pub = ids       # event order == connect order (one poller)
+
+for c in ids:
+    host.enable_fast(c, 4, 64)
+host.sub_add(sub1, "fp/+", qos=1)
+host.shared_add(7, sub2, "fp/+", 1, 0)  # qos1: acker exercises TryFastPuback
+host.sub_add(1 << 50, "punted/#", 0, native.SUB_PUNT)
+host.permit(pub, "fp/x")
+host.permit(pub, "punted/y")
+
+stop = threading.Event()
+def control_churn():
+    # thread-safe control plane hammering the poll thread's tables
+    j = 0
+    while not stop.is_set():
+        host.sub_add(sub1, "churn/%%d" %% (j %% 7), 0, 0)
+        host.sub_del(sub1, "churn/%%d" %% ((j + 3) %% 7))
+        host.conn_idle_ms(sub1)
+        host.stats()
+        if j %% 50 == 17:
+            host.permits_flush()
+            host.permit(pub, "fp/x")
+            host.permit(pub, "punted/y")   # keep the punt-marker path live
+        j += 1
+        time.sleep(0.0002)
+ctl = threading.Thread(target=control_churn)
+ctl.start()
+
+time.sleep(0.2)   # let the ops apply
+N_MSG = 400
+def blaster():
+    for k in range(N_MSG):
+        qos = k & 1
+        socks[2].sendall(mqtt_publish(b"fp/x", b"p%%03d" %% k, qos,
+                                      1 + (k %% 100)))
+        socks[2].sendall(mqtt_publish(b"punted/y", b"q", 0, 0))
+        if k == N_MSG // 2:
+            socks[0].close()          # close a subscriber mid-delivery
+        time.sleep(0.0002)
+bl = threading.Thread(target=blaster)
+bl.start()
+
+# subscriber 2 acks native qos1 deliveries; the poll loop keeps running
+def acker():
+    buf = b""
+    socks[1].settimeout(0.2)
+    while not stop.is_set():
+        try:
+            chunk = socks[1].recv(4096)
+        except (TimeoutError, OSError):
+            continue
+        if not chunk:
+            return
+        buf += chunk
+        while len(buf) >= 2:
+            ln = buf[1]
+            if ln & 0x80 or len(buf) < 2 + ln:
+                break
+            frame, buf = buf[: 2 + ln], buf[2 + ln:]
+            if frame[0] >> 4 == 3 and (frame[0] >> 1) & 3 == 1:
+                tlen = (frame[2] << 8) | frame[3]
+                pid = (frame[4 + tlen] << 8) | frame[5 + tlen]
+                try:
+                    socks[1].sendall(bytes([0x40, 2, pid >> 8, pid & 0xFF]))
+                except OSError:
+                    return
+ack = threading.Thread(target=acker)
+ack.start()
+
+punts = 0
+deadline = time.time() + 20
+while time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind == native.EV_FRAME:
+            punts += 1            # punted/# frames come up verbatim
+    st = host.stats()
+    # flush-to-re-permit gaps legitimately punt some fp/x messages;
+    # this is a sanitizer drive, not a counting test — exit once every
+    # exercised path has clearly run
+    if (st["fast_in"] > N_MSG // 2 and st["shared_dispatch"] > 0
+            and st["punts"] > 0 and st["native_acks"] > 0):
+        break
+bl.join()
+time.sleep(0.3)
+stop.set(); ctl.join(); ack.join()
+st = host.stats()
+assert st["fast_in"] > 0 and st["fast_out"] > 0, st
+assert st["shared_dispatch"] > 0, st
+assert st["native_acks"] > 0, st       # TryFastPuback ran
+assert st["punts"] > 0, st             # the kSubPunt branch ran
+assert punts > 0, "punted frames never forwarded"
+for s in socks[1:]:
+    try: s.close()
+    except OSError: pass
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+print("SANITIZED-RUN-OK", st)
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
-def test_host_cc_sanitized(sanitizer, tmp_path):
+@pytest.mark.parametrize("driver", ["host", "fastpath"])
+def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -106,9 +245,10 @@ def test_host_cc_sanitized(sanitizer, tmp_path):
         # use-after-free/overflow/race coverage
         "TSAN_OPTIONS": "halt_on_error=1:report_signal_unsafe=0",
     }
+    src = DRIVER if driver == "host" else DRIVER_FASTPATH
     proc = subprocess.run(
-        [sys.executable, "-c", DRIVER % {"repo": repo}],
-        capture_output=True, text=True, env=env, timeout=120)
+        [sys.executable, "-c", src % {"repo": repo}],
+        capture_output=True, text=True, env=env, timeout=180)
     assert "SANITIZED-RUN-OK" in proc.stdout, (
         f"rc={proc.returncode}\nstdout={proc.stdout[-2000:]}\n"
         f"stderr={proc.stderr[-4000:]}")

@@ -51,9 +51,10 @@ def _supervise() -> None:
     labelled) artifact always exists."""
     import subprocess as _sp
 
-    # a healthy-tunnel run at defaults takes ~5 min; 25 min of headroom
-    # still leaves room for the CPU retry inside a 1h driver budget
-    deadline = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", 1500))
+    # a healthy-tunnel run at defaults takes ~5 min + ~8 min for the
+    # 10M config-3 section; 35 min of headroom still leaves room for
+    # the CPU retry (which skips the 10M section) inside a 1h budget
+    deadline = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", 2100))
     base_env = {**os.environ, "BENCH_SUPERVISED": "1"}
     # cheap tunnel probe FIRST: a wedged tunnel hangs backend init for
     # many minutes (observed: >1h after a killed in-flight process) —
@@ -94,8 +95,12 @@ def _supervise() -> None:
             cpu_fallback(f"device bench exceeded {deadline:.0f}s "
                          "(tunnel hang?)")
     cpu_env = {**base_env, "JAX_PLATFORMS": "cpu"}
+    # the CPU retry skips the 10M section and needs far less than the
+    # device deadline; its own cap keeps the worst case (probe 180s +
+    # device 2100s + cpu 900s ≈ 53 min) inside a 1h driver budget
+    cpu_deadline = float(os.environ.get("BENCH_CPU_TIMEOUT_S", 900))
     sys.exit(_sp.run([sys.executable, "-u", os.path.abspath(__file__)],
-                     env=cpu_env, timeout=deadline).returncode)
+                     env=cpu_env, timeout=cpu_deadline).returncode)
 
 
 def log(*a):

@@ -663,3 +663,48 @@ def test_clustered_node_keeps_fast_path_with_remote_punts():
     finally:
         server.stop()
         stop(nodes)
+
+
+def test_cross_transport_subscriber_always_served():
+    """One app, two transports: a subscriber on the asyncio server must
+    receive publishes from a native-listener client forever — its punt
+    marker keeps those topics off the native fan-out."""
+    from emqx_tpu.broker.server import BrokerServer
+
+    app = BrokerApp()
+    nat = NativeBrokerServer(port=0, app=app)
+    nat.start()
+
+    async def main():
+        aio = BrokerServer(port=0, app=app)
+        await aio.start()
+        sub_aio = MqttClient(port=aio.port, clientid="xa")
+        await sub_aio.connect()
+        await sub_aio.subscribe("xt/+", qos=0)
+        sub_nat = MqttClient(port=nat.port, clientid="xn")
+        await sub_nat.connect()
+        await sub_nat.subscribe("xt/+", qos=0)
+        pub = MqttClient(port=nat.port, clientid="xp")
+        await pub.connect()
+        for i in range(4):
+            await pub.publish("xt/k", f"x{i}".encode(), qos=0)
+            a = await sub_aio.recv(timeout=5)
+            n = await sub_nat.recv(timeout=5)
+            assert a.payload == n.payload == f"x{i}".encode()
+            await _settle(0.2)
+        # the asyncio subscriber's punt marker kept the topic slow
+        assert nat.fast_stats()["fast_in"] == 0
+        await sub_aio.unsubscribe("xt/+")
+        await _settle(0.3)
+        # with the cross-transport audience gone, the topic can go fast
+        await pub.publish("xt/k", b"solo0", qos=0)
+        assert (await sub_nat.recv(timeout=5)).payload == b"solo0"
+        await _settle()
+        await pub.publish("xt/k", b"solo1", qos=0)
+        assert (await sub_nat.recv(timeout=5)).payload == b"solo1"
+        assert await _wait_fast(nat, "fast_in", 1)
+        await sub_aio.close(); await sub_nat.close(); await pub.close()
+        await aio.stop()
+
+    run(main())
+    nat.stop()

@@ -831,6 +831,41 @@ def bench_e2e() -> None:
 
     asyncio.run(run())
 
+    # -- device-path ceiling under native load ------------------------------
+    # The same app (warmed model/pipeline) behind the C++ host with the
+    # fast path OFF: every publish runs Channel.handle_in → pipeline →
+    # kernel. This is the honest "Python FSM + device router" e2e bound
+    # (the r3 famine was Python clients measuring themselves; the C++
+    # loadgen removes that), and the gap to the fast-path number above
+    # is the remaining host-plane work for future rounds.
+    from emqx_tpu import native as _native
+
+    if _native.available() and os.environ.get("BENCH_DEVICE_E2E", "1") != "0":
+        from emqx_tpu.broker.native_server import NativeBrokerServer
+
+        app.pipeline.min_device_batch = 0   # measure the KERNEL path,
+        server = NativeBrokerServer(port=0, app=app, fast_path=False)
+        server.start()                      # not the knee's host bypass
+        try:
+            res = _native.loadgen_run(
+                "127.0.0.1", server.port, n_subs=8, n_pubs=8,
+                msgs_per_pub=int(os.environ.get("BENCH_DEVICE_E2E_MSGS",
+                                                1500)),
+                qos=0, payload_len=16, window=2048, warmup=False)
+            wall = res["wall_ns"] / 1e9
+            rate = res["received"] / max(wall, 1e-9)
+            log(f"device-path e2e (native load, fast path OFF, window "
+                f"2048): {res['received']}/{res['sent']} = {rate:,.0f} "
+                f"msg/s through channel FSM + pipeline + kernel "
+                f"(launches={app.broker.model.launch_count})")
+            HOST_PLANE_RESULTS["e2e_device_path_msgs_per_sec"] = round(rate)
+        except Exception as e:  # noqa: BLE001
+            # a loadgen flake must not cost the whole artifact (every
+            # earlier section's numbers print in main()'s final JSON)
+            log(f"device-path e2e section failed, skipping: {e}")
+        finally:
+            server.stop()
+
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_SUPERVISED") != "1":

@@ -145,6 +145,7 @@ class NativeBrokerServer:
         self._route_punts: set[tuple[str, str]] = set()
         self._fast_conn_of: dict[str, int] = {}         # clientid -> conn
         self._granted: dict[int, set[str]] = {}         # conn -> topics
+        self._permit_lock = threading.Lock()
         self._permit_queue: list[tuple[_NativeConn, str]] = []
         self._last_permit_flush = time.monotonic()
         self._stats_seen = {k: 0 for k in native.STAT_NAMES}
@@ -171,19 +172,26 @@ class NativeBrokerServer:
         # cluster replays the route snapshot before listeners start)
         for topic, dest in self.broker.router.dump():
             self._on_route_event("add", topic, dest)
-        if app is not None and hasattr(app, "rules"):
-            app.rules.on_topology_change.append(self.flush_permits)
-        if app is not None and hasattr(getattr(app, "bridges", None),
-                                       "on_topology_change"):
-            app.bridges.on_topology_change.append(self.flush_permits)
+        # eager permit flushes: a new rule/bridge/trace/metric watcher
+        # must see already-fast topics immediately, not after the TTL
+        for comp in ("rules", "bridges", "trace", "topic_metrics"):
+            obj = getattr(app, comp, None) if app is not None else None
+            if hasattr(obj, "on_topology_change"):
+                obj.on_topology_change.append(self.flush_permits)
 
     # -- fast-path control --------------------------------------------------
 
     def flush_permits(self) -> None:
         """Topology changed (rule created, authz update, trace started):
-        every publisher re-earns its permits through the full path."""
-        self.host.permits_flush()
-        self._granted.clear()
+        every publisher re-earns its permits through the full path.
+        Mutually exclusive with _grant_permits — a flush from a
+        management thread landing mid-grant must not leave a stale
+        permit for the freshly watched topic (the grant loop would
+        otherwise add to an orphaned set and install a C++ permit the
+        flush can no longer see)."""
+        with self._permit_lock:
+            self.host.permits_flush()
+            self._granted.clear()
 
     def fast_stats(self) -> dict[str, int]:
         return self.host.stats()
@@ -486,7 +494,15 @@ class NativeBrokerServer:
     def _grant_permits(self) -> None:
         """Runs after pipeline.flush() in _step: every queued slow-path
         publish already delivered, so granting now preserves per-topic
-        ordering across the slow→fast transition."""
+        ordering across the slow→fast transition. Holds _permit_lock so
+        a concurrent flush_permits (trace started on a REST thread)
+        cannot interleave: grants re-check the consumer list under the
+        lock, so they either complete before the flush (which then
+        clears them) or start after it (and see the new watcher)."""
+        with self._permit_lock:
+            self._grant_permits_locked()
+
+    def _grant_permits_locked(self) -> None:
         queue, self._permit_queue = self._permit_queue, []
         for conn, topic in queue:
             ch = conn.channel
@@ -678,18 +694,13 @@ class NativeBrokerServer:
             self.broker.router.route_observers.remove(self._on_route_event)
         except ValueError:
             pass
-        if self.app is not None and hasattr(self.app, "rules"):
-            try:
-                self.app.rules.on_topology_change.remove(self.flush_permits)
-            except ValueError:
-                pass
-        if self.app is not None and hasattr(getattr(
-                self.app, "bridges", None), "on_topology_change"):
-            try:
-                self.app.bridges.on_topology_change.remove(
-                    self.flush_permits)
-            except ValueError:
-                pass
+        for comp in ("rules", "bridges", "trace", "topic_metrics"):
+            obj = getattr(self.app, comp, None) if self.app else None
+            if hasattr(obj, "on_topology_change"):
+                try:
+                    obj.on_topology_change.remove(self.flush_permits)
+                except ValueError:
+                    pass
         if self.app is not None and hasattr(self.app,
                                             "on_shared_strategy_change"):
             try:

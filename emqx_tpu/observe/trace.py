@@ -58,6 +58,11 @@ class TraceManager:
         self.max_traces = max_traces
         self.traces: dict[str, Trace] = {}
         self._lock = threading.RLock()
+        # fired after start/stop/delete — the native host flushes its
+        # publish permits here so a new trace sees topics that were
+        # already on the fast path (broker/native_server.py); without
+        # this a fresh trace could miss up to permit-TTL of messages
+        self.on_topology_change: list = []
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -75,7 +80,9 @@ class TraceManager:
                        filter_value=filter_value, start_at=now,
                        end_at=now + duration_s if duration_s else None)
             self.traces[name] = tr
-            return tr
+        for cb in self.on_topology_change:
+            cb()
+        return tr
 
     def stop(self, name: str) -> bool:
         with self._lock:
@@ -83,11 +90,17 @@ class TraceManager:
             if tr is None:
                 return False
             tr.status = "stopped"
-            return True
+        for cb in self.on_topology_change:
+            cb()
+        return True
 
     def delete(self, name: str) -> bool:
         with self._lock:
-            return self.traces.pop(name, None) is not None
+            hit = self.traces.pop(name, None) is not None
+        if hit:
+            for cb in self.on_topology_change:
+                cb()
+        return hit
 
     def list(self) -> list[dict]:
         with self._lock:
@@ -105,11 +118,18 @@ class TraceManager:
     def tick(self, now: Optional[float] = None) -> None:
         """Expire scheduled traces (the reference's trace scheduler)."""
         now = time.time() if now is None else now
+        expired = 0
         with self._lock:
             for tr in self.traces.values():
                 if (tr.status == "running" and tr.end_at is not None
                         and now >= tr.end_at):
                     tr.status = "stopped"
+                    expired += 1
+        if expired:
+            # same eager flush as an explicit stop(): the slow-path
+            # penalty must not outlive the trace by a permit TTL
+            for cb in self.on_topology_change:
+                cb()
 
     # -- event feed (hook callbacks) -----------------------------------------
 

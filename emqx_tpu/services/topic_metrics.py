@@ -21,6 +21,10 @@ class TopicMetrics:
         self.max_topics = max_topics
         self._metrics: dict[str, dict[str, int]] = {}
         self._created: dict[str, float] = {}
+        # fired after register/deregister — the native host flushes its
+        # publish permits here so a freshly watched topic's messages
+        # come back through Python immediately, not after permit-TTL
+        self.on_topology_change: list = []
         self._lock = threading.RLock()
 
     # -- registry ------------------------------------------------------------
@@ -39,16 +43,25 @@ class TopicMetrics:
                 "messages.qos2.in": 0, "messages.dropped": 0,
             }
             self._created[topic_filter] = time.time()
-            return True
+        for cb in self.on_topology_change:
+            cb()
+        return True
 
     def deregister(self, topic_filter: Optional[str] = None) -> bool:
         with self._lock:
             if topic_filter is None:
+                had = bool(self._metrics)
                 self._metrics.clear()
                 self._created.clear()
-                return True
-            self._created.pop(topic_filter, None)
-            return self._metrics.pop(topic_filter, None) is not None
+                hit = True
+            else:
+                had = True
+                self._created.pop(topic_filter, None)
+                hit = self._metrics.pop(topic_filter, None) is not None
+        if hit and had:
+            for cb in self.on_topology_change:
+                cb()
+        return hit
 
     def topics(self) -> list[str]:
         return list(self._metrics)

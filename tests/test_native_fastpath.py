@@ -768,3 +768,49 @@ def test_qos2_always_on_python_path():
 
     run(main())
     server.stop()
+
+
+def test_trace_start_flushes_permits_immediately():
+    """Starting a topic trace must immediately pull already-fast topics
+    back through Python — a debugging trace cannot wait out the permit
+    TTL before seeing messages."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="ts")
+        await sub.connect()
+        await sub.subscribe("tr/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="tp")
+        await pub.connect()
+        await pub.publish("tr/t", b"w", qos=0)
+        await sub.recv(timeout=5)
+        await _settle()
+        await pub.publish("tr/t", b"fast", qos=0)
+        await sub.recv(timeout=5)
+        assert await _wait_fast(server, "fast_in", 1)
+        base = server.fast_stats()["fast_in"]
+        app.trace.start("t1", "topic", "tr/#")
+        await _settle(0.3)
+        for i in range(3):
+            await pub.publish("tr/t", f"tr{i}".encode(), qos=0)
+            assert (await sub.recv(timeout=5)).payload == f"tr{i}".encode()
+            await _settle(0.15)
+        assert server.fast_stats()["fast_in"] == base, \
+            "traced topic still on the fast path"
+        tr = app.trace.traces["t1"]
+        assert len(tr.lines) >= 1, "trace captured nothing"
+        # stopping the trace frees the topic again
+        app.trace.stop("t1")
+        await _settle(0.3)
+        await pub.publish("tr/t", b"free0", qos=0)
+        await sub.recv(timeout=5)
+        await _settle()
+        await pub.publish("tr/t", b"free1", qos=0)
+        await sub.recv(timeout=5)
+        assert await _wait_fast(server, "fast_in", base + 1)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()

@@ -460,13 +460,13 @@ class NativeBrokerServer:
         the slow path's 'message.publish' fold can do with a live,
         non-retained, non-$ message. A topic a consumer watches never
         earns a permit; consumers added later are covered by the eager
-        flush hooks (rules, bridges) or the permit TTL (the rest)."""
+        flush hooks (rules, bridges, traces, topic metrics) or the
+        permit TTL (rewrite rules, exhook provider reloads)."""
         app = self.app
         if app.rules.rules_for_topic(topic):
             return True                 # rules must see every message
-        if any(t.status == "running" and t.matches(
-                ch.clientid, topic, str(ch.conninfo.peername))
-                for t in app.trace.traces.values()):
+        if any(t.matches(ch.clientid, topic, str(ch.conninfo.peername))
+                for t in app.trace.running()):   # locked snapshot
             return True                 # traced topics stay observable
         if any(T.match(topic, f) for f in app.topic_metrics.topics()):
             return True
@@ -679,7 +679,12 @@ class NativeBrokerServer:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            self._step(timeout_ms=50)
+            try:
+                self._step(timeout_ms=50)
+            except Exception:  # noqa: BLE001 — the poll thread IS the
+                # broker: one bad housekeep/grant cycle (e.g. a raising
+                # authorize hook) must log, not stop serving every conn
+                log.exception("native poll step failed; continuing")
 
     def stop(self) -> None:
         self._stop.set()

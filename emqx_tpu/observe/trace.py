@@ -94,6 +94,14 @@ class TraceManager:
             cb()
         return True
 
+    def running(self) -> list:
+        """Snapshot of running traces — safe to iterate off-thread
+        (the permit-grant path reads this from the broker poll loop
+        while REST threads mutate the table)."""
+        with self._lock:
+            return [t for t in self.traces.values()
+                    if t.status == "running"]
+
     def delete(self, name: str) -> bool:
         with self._lock:
             hit = self.traces.pop(name, None) is not None
@@ -134,8 +142,7 @@ class TraceManager:
     # -- event feed (hook callbacks) -----------------------------------------
 
     def _active(self):
-        with self._lock:
-            return [t for t in self.traces.values() if t.status == "running"]
+        return self.running()
 
     def trace(self, event: str, clientid: str, topic: Optional[str],
               peername: str, detail: str) -> None:

@@ -50,21 +50,22 @@ class TopicMetrics:
     def deregister(self, topic_filter: Optional[str] = None) -> bool:
         with self._lock:
             if topic_filter is None:
-                had = bool(self._metrics)
+                changed = bool(self._metrics)
                 self._metrics.clear()
                 self._created.clear()
                 hit = True
             else:
-                had = True
                 self._created.pop(topic_filter, None)
-                hit = self._metrics.pop(topic_filter, None) is not None
-        if hit and had:
+                changed = hit = (
+                    self._metrics.pop(topic_filter, None) is not None)
+        if changed:               # fire only when something was removed
             for cb in self.on_topology_change:
                 cb()
         return hit
 
     def topics(self) -> list[str]:
-        return list(self._metrics)
+        with self._lock:          # snapshot: off-thread readers iterate
+            return list(self._metrics)
 
     def metrics(self, topic_filter: str) -> Optional[dict[str, int]]:
         m = self._metrics.get(topic_filter)

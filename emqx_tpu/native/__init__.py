@@ -425,6 +425,9 @@ class NativeHost:
                 for i, name in enumerate(STAT_NAMES)}
 
     def conn_idle_ms(self, conn: int) -> int:
+        """POLL-THREAD ONLY (unlike the other control calls): walks the
+        connection table the loop mutates. Call it from the same thread
+        that drives poll() — the server's housekeep does."""
         return self._lib.emqx_host_conn_idle_ms(self._h, conn)
 
     def destroy(self) -> None:

@@ -243,9 +243,15 @@ class Host {
     return static_cast<long>(stats_[slot].load(std::memory_order_relaxed));
   }
 
+  // POLL-THREAD ONLY: walks conns_, which the loop mutates — a
+  // cross-thread call races the hashtable structure itself (TSan
+  // caught exactly this against Drop's erase). The product calls it
+  // from _housekeep inside the poll step; a wrong-thread call fails
+  // fast with -2 instead of silently racing.
   long ConnIdleMs(uint64_t id) const {
-    // racy read from other threads is acceptable: the value feeds a
-    // coarse keepalive check, not an invariant
+    pthread_t poller = poll_thread_.load(std::memory_order_acquire);
+    if (poller != pthread_t{} && !pthread_equal(poller, pthread_self()))
+      return -2;  // wrong thread: refuse rather than race conns_
     auto it = conns_.find(id);
     if (it == conns_.end()) return -1;
     uint64_t last = it->second.last_rx_ms;
@@ -257,6 +263,7 @@ class Host {
   // many whole event records as fit. Returns bytes written (0 on
   // timeout with no events).
   long Poll(uint8_t* buf, size_t cap, int timeout_ms) {
+    poll_thread_.store(pthread_self(), std::memory_order_release);
     if (events_.empty()) {
       ApplyPending();
       epoll_event evs[256];
@@ -791,6 +798,7 @@ class Host {
   std::string frame_v4_, frame_v5_;  // per-publish shared qos0 frames
   std::vector<uint64_t> dirty_;
   std::atomic<uint64_t> stats_[kStatCount] = {};
+  std::atomic<pthread_t> poll_thread_{};  // enforces ConnIdleMs contract
 };
 
 }  // namespace

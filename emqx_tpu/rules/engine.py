@@ -90,6 +90,11 @@ class RuleEngine:
         # (BASELINE config 5) and arrive pre-matched via on_matched
         self._pub_trie = Trie()
         self._filter_rules: dict[str, set[str]] = {}   # filter → rule ids
+        # guards the trie + filter index: create/delete arrive on REST
+        # threads while rules_for_topic runs on every publish (broker
+        # poll thread / pipeline flusher) — an unguarded trie walk over
+        # a mutating dict tree can raise mid-match
+        self._index_lock = threading.RLock()
         self._model = None                             # RouterModel | None
         # device co-batch gate: while the broker folds a device batch's
         # message.publish hooks ON THIS THREAD, _on_publish defers to
@@ -120,10 +125,14 @@ class RuleEngine:
                     enabled=enabled, description=description,
                     publish_topics=publish_topics,
                     event_topics=event_topics)
-        if id in self.rules:
-            self._unindex(self.rules[id])
-        self.rules[id] = rule
-        self._index(rule)
+        with self._index_lock:
+            # replacement is atomic under the lock: a publish matching
+            # between unindex(old) and index(new) would otherwise see
+            # NO rule for a filter both versions share
+            if id in self.rules:
+                self._unindex(self.rules[id])
+            self.rules[id] = rule
+            self._index(rule)
         self.metrics.create_metrics(id, RULE_COUNTERS)
         for cb in self.on_topology_change:
             cb()
@@ -132,41 +141,46 @@ class RuleEngine:
     def delete_rule(self, id: str) -> bool:
         self.metrics.clear_metrics(id)
         rule_funcs.drop_rule_store(id)
-        rule = self.rules.pop(id, None)
+        with self._index_lock:
+            rule = self.rules.pop(id, None)
+            if rule is not None:
+                self._unindex(rule)
         if rule is not None:
-            self._unindex(rule)
             for cb in self.on_topology_change:
                 cb()
         return rule is not None
 
     def _index(self, rule: Rule) -> None:
-        for f in rule.publish_topics:
-            rids = self._filter_rules.setdefault(f, set())
-            if not rids:
-                self._pub_trie.insert(f)
-                if self._model is not None:
-                    self._model.aux_register(f)
-            rids.add(rule.id)
+        with self._index_lock:
+            for f in rule.publish_topics:
+                rids = self._filter_rules.setdefault(f, set())
+                if not rids:
+                    self._pub_trie.insert(f)
+                    if self._model is not None:
+                        self._model.aux_register(f)
+                rids.add(rule.id)
 
     def _unindex(self, rule: Rule) -> None:
-        for f in rule.publish_topics:
-            rids = self._filter_rules.get(f)
-            if rids is None:
-                continue
-            rids.discard(rule.id)
-            if not rids:
-                del self._filter_rules[f]
-                self._pub_trie.delete(f)
-                if self._model is not None:
-                    self._model.aux_release(f)
+        with self._index_lock:
+            for f in rule.publish_topics:
+                rids = self._filter_rules.get(f)
+                if rids is None:
+                    continue
+                rids.discard(rule.id)
+                if not rids:
+                    del self._filter_rules[f]
+                    self._pub_trie.delete(f)
+                    if self._model is not None:
+                        self._model.aux_release(f)
 
     def attach_model(self, model) -> None:
         """Co-batch rule FROM filters into the device router's trie
         (publish_batch then reports rule matches alongside fan-out —
         BASELINE config 5)."""
-        self._model = model
-        for f in self._filter_rules:
-            model.aux_register(f)
+        with self._index_lock:        # uniform guard for _filter_rules
+            self._model = model
+            for f in self._filter_rules:
+                model.aux_register(f)
 
     def get_rule(self, id: str) -> Optional[Rule]:
         return self.rules.get(id)
@@ -196,7 +210,9 @@ class RuleEngine:
         hookpoint = EV.EVENT_TOPICS[event_topic]
 
         def cb(*args):
-            for rule in self.rules.values():
+            with self._index_lock:    # snapshot: REST threads mutate
+                rules = list(self.rules.values())
+            for rule in rules:
                 if rule.enabled and event_topic in rule.event_topics:
                     cols = EV.event_columns(hookpoint, args, self.node)
                     self._apply_rule(rule, cols)
@@ -208,7 +224,8 @@ class RuleEngine:
     def rules_for_topic(self, topic: str) -> list[Rule]:
         """Trie-indexed lookup: O(matched filters), not O(rules)
         (emqx_rule_engine.erl:198-205 get_rules_for_topic)."""
-        return self._rules_of(self._pub_trie.match(topic))
+        with self._index_lock:
+            return self._rules_of(self._pub_trie.match(topic))
 
     def _rules_of(self, filters) -> list[Rule]:
         out: list[Rule] = []

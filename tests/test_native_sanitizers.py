@@ -141,11 +141,13 @@ host.permit(pub, "punted/y")
 stop = threading.Event()
 def control_churn():
     # thread-safe control plane hammering the poll thread's tables
+    # (conn_idle_ms is deliberately NOT here: it is poll-thread-only —
+    # TSan caught its conns_ walk racing Drop's erase when this driver
+    # originally called it cross-thread)
     j = 0
     while not stop.is_set():
         host.sub_add(sub1, "churn/%%d" %% (j %% 7), 0, 0)
         host.sub_del(sub1, "churn/%%d" %% ((j + 3) %% 7))
-        host.conn_idle_ms(sub1)
         host.stats()
         if j %% 50 == 17:
             host.permits_flush()
@@ -203,6 +205,7 @@ while time.time() < deadline:
     for kind, conn, payload in host.poll(20):
         if kind == native.EV_FRAME:
             punts += 1            # punted/# frames come up verbatim
+    host.conn_idle_ms(sub2)       # poll-thread-only query, on-thread here
     st = host.stats()
     # flush-to-re-permit gaps legitimately punt some fp/x messages;
     # this is a sanitizer drive, not a counting test — exit once every

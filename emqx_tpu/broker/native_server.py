@@ -172,9 +172,12 @@ class NativeBrokerServer:
         # cluster replays the route snapshot before listeners start)
         for topic, dest in self.broker.router.dump():
             self._on_route_event("add", topic, dest)
-        # eager permit flushes: a new rule/bridge/trace/metric watcher
-        # must see already-fast topics immediately, not after the TTL
-        for comp in ("rules", "bridges", "trace", "topic_metrics"):
+        # eager permit flushes: a new rule/bridge/trace/metric/rewrite/
+        # exhook watcher must see already-fast topics immediately, not
+        # after the TTL. (app.exhook is None until configured; a server
+        # built before exhook config falls back to the TTL for it.)
+        for comp in ("rules", "bridges", "trace", "topic_metrics",
+                     "rewrite", "exhook"):
             obj = getattr(app, comp, None) if app is not None else None
             if hasattr(obj, "on_topology_change"):
                 obj.on_topology_change.append(self.flush_permits)
@@ -459,9 +462,9 @@ class NativeBrokerServer:
         publish on ``topic`` — the complete enumeration of everything
         the slow path's 'message.publish' fold can do with a live,
         non-retained, non-$ message. A topic a consumer watches never
-        earns a permit; consumers added later are covered by the eager
-        flush hooks (rules, bridges, traces, topic metrics) or the
-        permit TTL (rewrite rules, exhook provider reloads)."""
+        earns a permit; every consumer fires an eager flush hook on
+        change (rules, bridges, traces, topic metrics, pub rewrites,
+        exhook providers), with the permit TTL as the backstop."""
         app = self.app
         if app.rules.rules_for_topic(topic):
             return True                 # rules must see every message
@@ -484,11 +487,14 @@ class NativeBrokerServer:
                 if filt and T.match(topic, filt):
                     return True         # direct egress forwards these
         ex = getattr(app, "exhook", None)
-        if ex is not None and any(
-                h.startswith("message.")
-                for s in ex.servers.values()
-                for h in s.hooks_wanted):
-            return True                 # providers watch the message plane
+        if ex is not None:
+            try:
+                watchers = list(ex.servers.values())
+            except RuntimeError:        # REST thread resizing the dict
+                return True             # conservative: treat as watched
+            if any(h.startswith("message.")
+                   for s in watchers for h in s.hooks_wanted):
+                return True             # providers watch the message plane
         return False
 
     def _grant_permits(self) -> None:
@@ -699,7 +705,8 @@ class NativeBrokerServer:
             self.broker.router.route_observers.remove(self._on_route_event)
         except ValueError:
             pass
-        for comp in ("rules", "bridges", "trace", "topic_metrics"):
+        for comp in ("rules", "bridges", "trace", "topic_metrics",
+                     "rewrite", "exhook"):
             obj = getattr(self.app, comp, None) if self.app else None
             if hasattr(obj, "on_topology_change"):
                 try:

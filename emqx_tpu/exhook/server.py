@@ -136,6 +136,14 @@ class ExhookMgr:
         self.servers: dict[str, ExhookServer] = {}
         self.metrics = metrics
         self._hooks: Optional[Hooks] = None
+        # fired when the provider set (or a provider's wanted hooks)
+        # changes — the native host flushes its publish permits so a
+        # provider watching message.* sees already-fast topics at once
+        self.on_topology_change: list = []
+
+    def _notify(self) -> None:
+        for cb in self.on_topology_change:
+            cb()
 
     def attach(self, hooks: Hooks) -> None:
         self._hooks = hooks
@@ -157,6 +165,7 @@ class ExhookMgr:
     def enable(self, server: ExhookServer) -> list[str]:
         wanted = server.load()
         self.servers[server.name] = server
+        self._notify()
         return wanted
 
     def enable_async(self, server: ExhookServer,
@@ -178,6 +187,7 @@ class ExhookMgr:
             c.timeout = min(c.timeout, 2.0)
         try:
             server.load()
+            self._notify()     # hooks_wanted now known — flush permits
             return True
         except (ConnectionError, OSError, ValueError) as e:
             import time as _t
@@ -207,6 +217,7 @@ class ExhookMgr:
                 server.load()
                 log.info("exhook provider %s reconnected (hooks: %s)",
                          server.name, server.hooks_wanted)
+                self._notify()     # hooks_wanted may have changed
             except (ConnectionError, OSError, ValueError):
                 # ValueError included: a garbage LoadedResponse must not
                 # escape app.tick and kill broker housekeeping
@@ -218,6 +229,7 @@ class ExhookMgr:
         if server is None:
             return False
         server.unload()
+        self._notify()
         return True
 
     def _servers_for(self, hookpoint: str) -> list[ExhookServer]:

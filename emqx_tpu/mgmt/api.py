@@ -616,8 +616,7 @@ class ManagementApi:
                     re=spec["re"], dest_topic=spec["dest_topic"])
         except (KeyError, ValueError, TypeError, _re.error) as e:
             raise ApiError(400, "BAD_REQUEST", str(e)) from None
-        self.app.rewrite.pub_rules = staged.pub_rules
-        self.app.rewrite.sub_rules = staged.sub_rules
+        self.app.rewrite.replace(staged.pub_rules, staged.sub_rules)
         return self.app.rewrite.list()
 
     def h_auto_sub_get(self, query, body):

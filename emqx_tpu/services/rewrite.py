@@ -37,6 +37,10 @@ class TopicRewrite:
     def __init__(self, rules: Optional[list[dict]] = None) -> None:
         self.pub_rules: list[RewriteRule] = []
         self.sub_rules: list[RewriteRule] = []
+        # fired after add_rule/clear — the native host flushes its
+        # publish permits so a new pub rewrite applies to topics that
+        # were already fast-pathing (broker/native_server.py)
+        self.on_topology_change: list = []
         for spec in rules or []:
             self.add_rule(**spec)
 
@@ -46,12 +50,30 @@ class TopicRewrite:
         rule.compiled()                       # surface bad regexes early
         if action in ("publish", "all"):
             self.pub_rules.append(rule)
+            # only pub rewrites affect publish permits; a subscribe-only
+            # rule must not flush every publisher broker-wide
+            for cb in self.on_topology_change:
+                cb()
         if action in ("subscribe", "all"):
             self.sub_rules.append(rule)
 
     def clear(self) -> None:
+        had_pub = bool(self.pub_rules)
         self.pub_rules.clear()
         self.sub_rules.clear()
+        if had_pub:
+            for cb in self.on_topology_change:
+                cb()
+
+    def replace(self, pub_rules: list, sub_rules: list) -> None:
+        """Atomic swap-in of a validated rule set (the REST PUT path)
+        — fires the topology callbacks the way add_rule/clear do."""
+        changed = bool(self.pub_rules) or bool(pub_rules)
+        self.pub_rules = pub_rules
+        self.sub_rules = sub_rules
+        if changed:
+            for cb in self.on_topology_change:
+                cb()
 
     # -- core ----------------------------------------------------------------
 

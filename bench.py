@@ -7,9 +7,25 @@ subscriber-shard fan-out — against a connected-vehicle-style filter set
 (per-message Erlang trie walk over ETS, apps/emqx/src/emqx_router.erl:141-153,
 driven in-VM by apps/emqx/src/emqx_broker_bench.erl).
 
-Prints ONE JSON line:
+Prints a cumulative JSON line after EVERY completed section (the last line
+is the full artifact):
   {"metric": "route-matches/sec", "value": N, "unit": "topics/sec",
-   "vs_baseline": X}
+   "vs_baseline": X, ...}
+
+Supervision model (VERDICT r4 #1 — the artifact must be un-missable):
+  * each section runs as its OWN child process with its OWN deadline, so a
+    tunnel wedge in section k cannot take sections 1..k-1 (or the host-CPU
+    sections) down with it;
+  * sections write partial results to $BENCH_PARTIAL_DIR/section_<name>.json
+    as they go, and the supervisor re-emits the cumulative stdout line after
+    every section — a SIGKILL at any point leaves the newest cumulative
+    line in the tail;
+  * the device probe retries with backoff (~10 min worst case) instead of
+    one 180s shot, and its attempt log lands in the artifact;
+  * on a wedged tunnel mid-run, remaining device sections are skipped (with
+    reasons in the artifact), host sections still run, and a CPU kernel
+    fallback runs ONLY if no device kernel number was captured — captured
+    device sections are never overwritten.
 
 vs_baseline: ratio against the reference's own headline sustained cluster
 throughput of 1M msg/s (reference README.md:16) — every routed message
@@ -24,7 +40,9 @@ device execution, as the reference overlaps socket reads with dispatch via
 
 Env knobs: BENCH_FILTERS (default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (100), BENCH_SHARDS (8192 subscriber fan-out shards),
-BENCH_WINDOW (8 in-flight batches), BENCH_LAT_ITERS (30 sync latency samples).
+BENCH_WINDOW (8 in-flight batches), BENCH_LAT_ITERS (30 sync latency
+samples), BENCH_TOTAL_BUDGET_S (3300), BENCH_SECTION (internal: run one
+section inline), BENCH_PARTIAL_DIR (internal: partial-results directory).
 """
 
 from __future__ import annotations
@@ -42,70 +60,40 @@ if os.environ.get("JAX_PLATFORMS"):
     # JAX_PLATFORMS so the bench can be verified off-TPU
     import jax as _jax
     _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-def _supervise() -> None:
-    """A flaky device tunnel can pass any pre-probe and still hang the
-    bench mid-upload — which would leave the round without an artifact
-    (the r2 failure mode: rc!=0, zero numbers). Re-invoke this script as
-    a supervised child with a hard deadline; if the device run hangs or
-    dies, run ONCE more pinned to CPU so a measured (slower, clearly
-    labelled) artifact always exists."""
-    import subprocess as _sp
-
-    # a healthy-tunnel run at defaults takes ~5 min + ~8 min for the
-    # 10M config-3 section; 35 min of headroom still leaves room for
-    # the CPU retry (which skips the 10M section) inside a 1h budget
-    deadline = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", 2100))
-    base_env = {**os.environ, "BENCH_SUPERVISED": "1"}
-    # cheap tunnel probe FIRST: a wedged tunnel hangs backend init for
-    # many minutes (observed: >1h after a killed in-flight process) —
-    # without this, the device attempt eats its whole deadline before
-    # the CPU fallback even starts
-    def cpu_fallback(reason: str) -> None:
-        log(f"{reason}; falling back to CPU — numbers below are NOT "
-            "TPU numbers")
-
-    device_ok = False
-    try:
-        # platform must be a real accelerator: bare jax.devices()
-        # SILENTLY falls back to CPU where no device is registered,
-        # which would pass CPU numbers off as device numbers
-        probe = _sp.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "assert d and d[0].platform != 'cpu', d"],
-            env=base_env, timeout=float(
-                os.environ.get("BENCH_PROBE_TIMEOUT_S", 180)),
-            capture_output=True, text=True)
-        device_ok = probe.returncode == 0
-        if not device_ok:
-            tail = (probe.stderr or "").strip().splitlines()[-1:]
-            cpu_fallback("device probe failed"
-                         + (f" ({tail[0][:200]})" if tail else ""))
-    except _sp.TimeoutExpired:
-        cpu_fallback("device probe hung (tunnel wedged)")
-    if device_ok:
-        try:
-            rc = _sp.run(
-                [sys.executable, "-u", os.path.abspath(__file__)],
-                env=base_env, timeout=deadline).returncode
-            if rc == 0:
-                sys.exit(0)
-            cpu_fallback(f"device bench exited rc={rc}")
-        except _sp.TimeoutExpired:
-            cpu_fallback(f"device bench exceeded {deadline:.0f}s "
-                         "(tunnel hang?)")
-    cpu_env = {**base_env, "JAX_PLATFORMS": "cpu"}
-    # the CPU retry skips the 10M section and needs far less than the
-    # device deadline; its own cap keeps the worst case (probe 180s +
-    # device 2100s + cpu 900s ≈ 53 min) inside a 1h driver budget
-    cpu_deadline = float(os.environ.get("BENCH_CPU_TIMEOUT_S", 900))
-    sys.exit(_sp.run([sys.executable, "-u", os.path.abspath(__file__)],
-                     env=cpu_env, timeout=cpu_deadline).returncode)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
+
+# ---------------------------------------------------------------------------
+# partial-results plumbing
+# ---------------------------------------------------------------------------
+
+RESULTS: dict = {}
+
+
+def flush_results(section: str) -> None:
+    """Atomically persist this section's results-so-far. Called after every
+    subsection so a mid-section wedge still lands the completed numbers."""
+    d = os.environ.get("BENCH_PARTIAL_DIR")
+    if not d:
+        return
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{section}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(RESULTS, f)
+    os.replace(tmp, os.path.join(d, f"section_{section}.json"))
+
+
+def put(section: str, **kv) -> None:
+    RESULTS.update(kv)
+    flush_results(section)
+
+
+# ---------------------------------------------------------------------------
+# shared builders (BASELINE config 2/3 shape)
+# ---------------------------------------------------------------------------
 
 def build_filters(n: int, rng: np.random.Generator) -> list[str]:
     """Vehicle-fleet topic tree, 7 levels deep, ~10% '+' wildcards,
@@ -134,7 +122,103 @@ def build_filters(n: int, rng: np.random.Generator) -> list[str]:
     return filters
 
 
-def main() -> None:
+def build_model(n_filters: int, rng: np.random.Generator, n_shards: int):
+    """Index + RouterModel with one subscriber shard per subscription,
+    uploaded to the device. Returns (index, model, live_filters)."""
+    from emqx_tpu.models.router_model import RouterModel
+    from emqx_tpu.router.index import TrieIndex
+
+    filters = build_filters(n_filters, rng)
+    index = TrieIndex(max_levels=8)
+    model = RouterModel(index, n_sub_slots=n_shards, K=32, M=128)
+    index.load(filters)
+    slot_of = rng.integers(0, n_shards, len(index.filters))
+    for fid in range(len(index.filters)):
+        if index.filters[fid] is not None:
+            model._subs.setdefault(fid, {})[int(slot_of[fid])] = 1
+    model.refresh()
+    live = [f for f in index.filters if f is not None]
+    return index, model, live
+
+
+def make_topics(live: list[str], rng: np.random.Generator, count: int,
+                n_vehicles: int) -> list[str]:
+    """Publish into the subscribed tree (emqx_broker_bench shape):
+    instantiate a random subscribed filter's wildcards with concrete
+    words."""
+    picks = rng.integers(0, len(live), count)
+    v = rng.integers(0, n_vehicles, count)
+    p = rng.integers(0, 8, count)
+    m = rng.integers(0, 16, count)
+    fl = rng.integers(0, 512, count)
+    topics = []
+    for i in range(count):
+        ws = live[picks[i]].split("/")
+        out = []
+        for j, w in enumerate(ws):
+            if w == "+":
+                out.append(
+                    f"v{v[i]}" if j == 3 else f"p{p[i]}" if j == 5 else f"f{fl[i]}"
+                )
+            elif w == "#":
+                out.extend([f"part/p{p[i]}", f"m{m[i]}"][: 7 - j])
+                break
+            else:
+                out.append(w)
+        topics.append("/".join(out))
+    return topics
+
+
+def make_routable(index, model, warm_topic: str):
+    """Single-topic subscribe→routable probe shared by the kernel and
+    churn sections: a 64-row padded batch whose rows 1.. are masked out
+    (length 0 + sys flag) so only the probe topic can match. Numpy args
+    transfer inside the ONE dispatch — separate device_put calls each
+    cost a full tunnel round trip. Warms the 64-shape program and the
+    scatter shapes off the clock via ``warm_topic``."""
+    import jax
+
+    B2 = 64
+    step = model._step
+
+    def routable(topic: str):
+        tok, lens, sysf, _ = index.tokenize([topic] + [""] * (B2 - 1))
+        lens[1:] = 0
+        sysf[1:] = True
+        return step(model._trie_dev, model._rowmap_dev, model._pool_dev,
+                    tok, lens, sysf)
+
+    model.subscribe(warm_topic, 0)
+    model.refresh()
+    jax.block_until_ready(routable(warm_topic))
+    return routable
+
+
+def windowed_tps(step, args_fn, iters: int, window_n: int, B: int):
+    """Steady-state throughput with a bounded in-flight window; every
+    output is blocked on before leaving the window (nothing unverified
+    in flight). Returns (topics/sec, last_output)."""
+    import jax
+
+    t_start = time.time()
+    window = []
+    last = None
+    for i in range(iters):
+        window.append(step(*args_fn(i)))
+        if len(window) >= window_n:
+            last = window.pop(0)
+            jax.block_until_ready(last)
+    for o in window:
+        last = o
+        jax.block_until_ready(o)
+    return iters * B / (time.time() - t_start), last
+
+
+# ---------------------------------------------------------------------------
+# section: kernel (the headline — 1M-filter device match)
+# ---------------------------------------------------------------------------
+
+def sec_kernel() -> None:
     n_filters = int(os.environ.get("BENCH_FILTERS", 1_000_000))
     B = int(os.environ.get("BENCH_BATCH", 16384))
     iters = int(os.environ.get("BENCH_ITERS", 100))
@@ -143,76 +227,37 @@ def main() -> None:
 
     import jax
 
-    from emqx_tpu.models.router_model import RouterModel
-    from emqx_tpu.router.index import TrieIndex
+    platform = jax.devices()[0].platform
+    put("kernel", kernel_platform=platform, kernel_filters=n_filters)
 
     rng = np.random.default_rng(42)
     t0 = time.time()
-    filters = build_filters(n_filters, rng)
-    log(f"built {len(filters)} filters in {time.time()-t0:.1f}s")
-
-    t0 = time.time()
-    index = TrieIndex(max_levels=8)
-    model = RouterModel(index, n_sub_slots=n_shards, K=32, M=128)
-    index.load(filters)
-    # one subscriber shard per subscription (slot = hash of i)
-    slot_of = rng.integers(0, n_shards, len(index.filters))
-    for fid in range(len(index.filters)):
-        if index.filters[fid] is not None:
-            model._subs.setdefault(fid, {})[int(slot_of[fid])] = 1
-    log(f"loaded index in {time.time()-t0:.1f}s "
-        f"({len(index.filters)} distinct filters)")
-
-    t0 = time.time()
-    model.refresh()
+    index, model, live = build_model(n_filters, rng, n_shards)
     arrays = index.arrays
-    log(f"rebuilt device arrays in {time.time()-t0:.1f}s: "
-        f"nodes={arrays.n_nodes} ht={arrays.ht_parent.shape[0]} "
+    log(f"built+loaded+uploaded {len(index.filters)} filters in "
+        f"{time.time()-t0:.1f}s: nodes={arrays.n_nodes} "
+        f"ht={arrays.ht_parent.shape[0]} "
         f"pool={int(model._pool_dev.nbytes) >> 10}KiB "
         f"rowmap={int(model._rowmap_dev.nbytes) >> 20}MiB "
         f"device={jax.devices()[0]}")
 
-    # pre-tokenized topic batches (the C++ ingest host's job in production).
-    # Publishers publish into the subscribed tree (emqx_broker_bench shape):
-    # instantiate a random subscribed filter's wildcards with concrete words.
+    # pre-tokenized topic batches (the C++ ingest host's job in production)
     n_vehicles = max(1000, n_filters // 2)
     n_batches = 8
     t0 = time.time()
-    live = [f for f in index.filters if f is not None]
     batches = []
+    topics = None
     for _ in range(n_batches):
-        picks = rng.integers(0, len(live), B)
-        v = rng.integers(0, n_vehicles, B)
-        p = rng.integers(0, 8, B)
-        m = rng.integers(0, 16, B)
-        fl = rng.integers(0, 512, B)
-        topics = []
-        for i in range(B):
-            ws = live[picks[i]].split("/")
-            out = []
-            for j, w in enumerate(ws):
-                if w == "+":
-                    out.append(
-                        f"v{v[i]}" if j == 3 else f"p{p[i]}" if j == 5 else f"f{fl[i]}"
-                    )
-                elif w == "#":
-                    out.extend([f"part/p{p[i]}", f"m{m[i]}"][: 7 - j])
-                    break
-                else:
-                    out.append(w)
-            topics.append("/".join(out))
+        topics = make_topics(live, rng, B, n_vehicles)
         tok, lens, sysf, too_long = index.tokenize(topics)
         assert not too_long
-        batches.append(
-            tuple(jax.device_put(x) for x in (tok, lens, sysf))
-        )
+        batches.append(tuple(jax.device_put(x) for x in (tok, lens, sysf)))
     log(f"tokenized {n_batches}x{B} topics in {time.time()-t0:.1f}s")
 
     step = model._step
     trie_dev = model._trie_dev
     bm_dev = (model._rowmap_dev, model._pool_dev)
 
-    # warmup / compile
     t0 = time.time()
     out = step(trie_dev, *bm_dev, *batches[0])
     jax.block_until_ready(out)
@@ -228,21 +273,9 @@ def main() -> None:
         jax.block_until_ready(out)
         lat.append(time.time() - t0)
 
-    # steady-state throughput: bounded in-flight window; every output is
-    # blocked on before leaving the window (nothing unverified in flight)
-    t_start = time.time()
-    window = []
-    last = None
-    for i in range(iters):
-        window.append(step(trie_dev, *bm_dev, *batches[i % n_batches]))
-        if len(window) >= window_n:
-            last = window.pop(0)
-            jax.block_until_ready(last)
-    for o in window:
-        last = o
-        jax.block_until_ready(o)
-    wall = time.time() - t_start
-    topics_per_sec = iters * B / wall
+    tps, last = windowed_tps(
+        step, lambda i: (trie_dev, *bm_dev, *batches[i % n_batches]),
+        iters, window_n, B)
 
     matched_per_topic = np.sum(np.asarray(last[0]) >= 0, axis=1)
     lat_ms = np.array(lat) * 1e3
@@ -250,8 +283,12 @@ def main() -> None:
         f"(dense-pool rows: {len(model._dense_row)})")
     log(f"sync step latency ms: p50={np.percentile(lat_ms,50):.2f} "
         f"p99={np.percentile(lat_ms,99):.2f} (batch={B})")
-    log(f"throughput (window={window_n}): {topics_per_sec:,.0f} topics/sec "
+    log(f"throughput (window={window_n}): {tps:,.0f} topics/sec "
         f"@ {n_filters} subs")
+    put("kernel",
+        kernel_topics_per_sec=round(tps),
+        kernel_sync_p50_ms=round(float(np.percentile(lat_ms, 50)), 2),
+        kernel_sync_p99_ms=round(float(np.percentile(lat_ms, 99)), 2))
 
     # measured in-repo anchor (VERDICT r2 weak #3): the host-oracle trie
     # (router/trie.py — the emqx_trie.erl semantics the kernel is
@@ -269,29 +306,19 @@ def main() -> None:
     t0 = time.time()
     o_hits = sum(len(oracle.match(t)) for t in o_topics)
     oracle_tps = len(o_topics) / (time.time() - t0)
-    vs_oracle = topics_per_sec / oracle_tps
+    vs_oracle = tps / oracle_tps
     log(f"host-oracle anchor: {oracle_tps:,.0f} topics/sec "
         f"(python trie walk, {n_oracle} filters, {o_hits} matches) "
         f"→ device = {vs_oracle:,.1f}x the measured host oracle")
+    put("kernel", vs_host_oracle=round(vs_oracle, 1))
 
     # -- incremental subscribe→routable latency -----------------------------
     # North star: emqx_trie.erl:113-144-style O(topic-depth) insert, NOT a
     # full rebuild (round 1: 106 s at 1M filters). Each sample: subscribe a
     # brand-new filter → scatter-patch HBM → publish a matching topic and
     # block on its fan-out.
-    B2 = 64
-    def routable(topic: str):
-        tok, lens, sysf, _ = index.tokenize([topic] + [""] * (B2 - 1))
-        lens[1:] = 0
-        sysf[1:] = True
-        # numpy args transfer inside the ONE dispatch; separate
-        # device_put calls are each a full tunnel round trip
-        return step(model._trie_dev, model._rowmap_dev, model._pool_dev, tok, lens, sysf)
-
-    # warm the B2-shaped program + the scatter shapes off the clock
-    model.subscribe("fleet/warm/vehicle/w/part/p0/m0", 0)
-    model.refresh()
-    jax.block_until_ready(routable("fleet/warm/vehicle/w/part/p0/m0"))
+    routable = make_routable(index, model,
+                             "fleet/warm/vehicle/w/part/p0/m0")
 
     inc = []
     for i in range(30):
@@ -305,10 +332,14 @@ def main() -> None:
         assert int(np.sum(np.asarray(out[0])[0] >= 0)) >= 1, \
             "new filter not routable"
     inc_ms = np.array(inc) * 1e3
-    rebuilds = model.upload_count
-    log(f"incremental subscribe→routable ms: p50={np.percentile(inc_ms,50):.2f} "
+    log(f"incremental subscribe→routable ms: "
+        f"p50={np.percentile(inc_ms,50):.2f} "
         f"p99={np.percentile(inc_ms,99):.2f} (full uploads since load: "
-        f"{rebuilds - 1}, patches: {model.patch_count})")
+        f"{model.upload_count - 1}, patches: {model.patch_count})")
+    put("kernel",
+        inc_sub_routable_p50_ms=round(float(np.percentile(inc_ms, 50)), 2),
+        inc_sub_routable_p99_ms=round(float(np.percentile(inc_ms, 99)), 2))
+
     # the sync number above is dominated by a fixed ~70ms tunnel
     # synchronization cost (measured: block_until_ready on x+1 over 64
     # ints pays the same) — the amortized chain below shows the actual
@@ -326,63 +357,26 @@ def main() -> None:
     chain_ms = (time.time() - t0) * 1e3 / n_chain
     log(f"incremental update amortized (pipelined chain of {n_chain}): "
         f"{chain_ms:.2f} ms/update")
-
-    if os.environ.get("BENCH_TENM", "1") != "0":
-        bench_ten_million(time.time() - T_START)
-
-    if os.environ.get("BENCH_SHARED", "1") != "0":
-        bench_shared_retained()
-
-    if os.environ.get("BENCH_E2E", "1") != "0":
-        bench_e2e()
-
-    if os.environ.get("BENCH_NATIVE", "1") != "0":
-        bench_host_plane()
-
-    print(json.dumps({
-        "metric": "route-matches/sec",
-        "value": round(topics_per_sec),
-        "unit": "topics/sec",
-        # the MEASURED in-repo anchor leads (VERDICT r3 weak #8): the
-        # host-oracle python trie walk on the same topic distribution
-        "vs_host_oracle": round(vs_oracle, 1),
-        # the reference's published headline (1M msg/s sustained,
-        # reference README.md:16) — kept as the BASELINE.md-defined
-        # denominator for cross-round comparability
-        "vs_baseline": round(topics_per_sec / 1_000_000, 3),
-        # the host-plane e2e + shared/retained/10M sections (real
-        # sockets through the C++ data plane, VERDICT r3 #1/#2)
-        **HOST_PLANE_RESULTS,
-    }))
+    put("kernel", inc_chain_ms=round(chain_ms, 2))
 
 
-HOST_PLANE_RESULTS: dict = {}
-T_START = time.time()
+# ---------------------------------------------------------------------------
+# section: tenm (BASELINE config 3 — 10M subscriptions)
+# ---------------------------------------------------------------------------
 
-
-def bench_ten_million(elapsed_s: float) -> None:
+def sec_tenm() -> None:
     """BASELINE config 3 / the north star's 10M-subscription point
     (VERDICT r3 #2: the 10M run must live in a driver artifact, not a
     commit message). Cold build + device upload + windowed kernel
     throughput + sync p99 at 10M mixed-wildcard filters.
 
-    Skipped on the CPU fallback (a 10M CPU kernel run would blow the
-    supervisor deadline and prove nothing about the device) and when
-    the earlier sections already consumed too much of the budget —
-    partial artifacts beat a deadline kill that loses everything."""
+    Skipped on the CPU fallback (a 10M CPU kernel run would blow its
+    deadline and prove nothing about the device)."""
     import jax
 
     if jax.devices()[0].platform == "cpu":
         log("10M section: skipped on CPU fallback")
         return
-    cutoff = float(os.environ.get("BENCH_TENM_CUTOFF_S", 700))
-    if elapsed_s > cutoff:
-        log(f"10M section: skipped, {elapsed_s:.0f}s already elapsed "
-            f"(cutoff {cutoff:.0f}s)")
-        return
-
-    from emqx_tpu.models.router_model import RouterModel
-    from emqx_tpu.router.index import TrieIndex
 
     n = int(os.environ.get("BENCH_TENM_FILTERS", 10_000_000))
     B = int(os.environ.get("BENCH_BATCH", 16384))
@@ -391,15 +385,7 @@ def bench_ten_million(elapsed_s: float) -> None:
     rng = np.random.default_rng(3)
 
     t0 = time.time()
-    filters = build_filters(n, rng)
-    index = TrieIndex(max_levels=8)
-    model = RouterModel(index, n_sub_slots=n_shards, K=32, M=128)
-    index.load(filters)
-    slot_of = rng.integers(0, n_shards, len(index.filters))
-    for fid in range(len(index.filters)):
-        if index.filters[fid] is not None:
-            model._subs.setdefault(fid, {})[int(slot_of[fid])] = 1
-    model.refresh()
+    index, model, live = build_model(n, rng, n_shards)
     build_s = time.time() - t0
     import jax.tree_util as jtu
     hbm_bytes = (int(model._pool_dev.nbytes) + int(model._rowmap_dev.nbytes)
@@ -407,23 +393,11 @@ def bench_ten_million(elapsed_s: float) -> None:
                        for x in jtu.tree_leaves(model._trie_dev)))
     log(f"10M: built+loaded+uploaded {len(index.filters)} filters in "
         f"{build_s:.0f}s, device bytes={hbm_bytes / (1 << 30):.2f} GiB")
+    put("tenm", tenm_build_s=round(build_s, 1),
+        tenm_device_gib=round(hbm_bytes / (1 << 30), 2))
 
-    live = [f for f in index.filters if f is not None]
-    picks = rng.integers(0, len(live), B)
-    topics = []
-    for i in range(B):
-        ws = live[int(picks[i])].split("/")
-        out = []
-        for j, w in enumerate(ws):
-            if w == "+":
-                out.append("w")
-            elif w == "#":
-                out.extend(["part/p0", "m0"][: 7 - j])
-                break
-            else:
-                out.append(w)
-        topics.append("/".join(out))
-    tok, lens, sysf, too_long = index.tokenize(topics)
+    topics = make_topics(live, rng, B, max(1000, n // 2))
+    tok, lens, sysf, _ = index.tokenize(topics)
     batch = tuple(jax.device_put(x) for x in (tok, lens, sysf))
 
     step = model._step
@@ -440,37 +414,320 @@ def bench_ten_million(elapsed_s: float) -> None:
                  *batch))
         lat.append(time.time() - t0)
     window_n = int(os.environ.get("BENCH_WINDOW", 8))
+    tps, _ = windowed_tps(
+        step,
+        lambda i: (model._trie_dev, model._rowmap_dev, model._pool_dev,
+                   *batch),
+        iters, window_n, B)
+    p99 = float(np.percentile(np.array(lat) * 1e3, 99))
+    log(f"10M: {tps:,.0f} topics/sec (window={window_n}), sync p99 "
+        f"{p99:.1f}ms @ {n} subs")
+    put("tenm", tenm_topics_per_sec=round(tps),
+        tenm_sync_p99_ms=round(p99, 1))
+
+
+# ---------------------------------------------------------------------------
+# section: churn (route updates under load — emqx_trie.erl:113-144 analogue)
+# ---------------------------------------------------------------------------
+
+def sec_churn() -> None:
+    """On-device route churn (VERDICT r4 #6 / SURVEY §7 hard-part (a)):
+    sustained subscribe/unsubscribe ops concurrent with windowed match
+    launches at 1M filters. Reports ops/s, match-throughput degradation
+    vs the quiescent rate from the SAME run, and subscribe→routable p99
+    sampled under load. The reference's anchor is emqx_trie.erl's
+    incremental insert/delete inside a live mnesia transaction stream."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        log("churn section: skipped on CPU fallback")
+        return
+
+    n = int(os.environ.get("BENCH_CHURN_FILTERS", 1_000_000))
+    B = int(os.environ.get("BENCH_BATCH", 16384))
+    window_n = int(os.environ.get("BENCH_WINDOW", 8))
+    n_shards = int(os.environ.get("BENCH_SHARDS", 8192))
+    ops_per_round = int(os.environ.get("BENCH_CHURN_OPS_PER_ROUND", 512))
+    rounds = int(os.environ.get("BENCH_CHURN_ROUNDS", 60))
+    rng = np.random.default_rng(11)
+
     t0 = time.time()
+    index, model, live = build_model(n, rng, n_shards)
+    log(f"churn: built+uploaded {len(index.filters)} filters in "
+        f"{time.time()-t0:.0f}s")
+    put("churn", churn_filters=n)
+
+    topics = make_topics(live, rng, B, max(1000, n // 2))
+    tok, lens, sysf, _ = index.tokenize(topics)
+    batch = tuple(jax.device_put(x) for x in (tok, lens, sysf))
+    step = model._step
+
+    def launch():
+        return step(model._trie_dev, model._rowmap_dev, model._pool_dev,
+                    *batch)
+
+    jax.block_until_ready(launch())
+
+    # quiescent baseline from the same run/shape
+    base_iters = 30
+    base_tps, _ = windowed_tps(step, lambda i: (
+        model._trie_dev, model._rowmap_dev, model._pool_dev, *batch),
+        base_iters, window_n, B)
+    log(f"churn: quiescent baseline {base_tps:,.0f} topics/sec")
+
+    routable = make_routable(index, model,
+                             "fleet/cwarm/vehicle/w/part/p0/m0")
+
+    # churn loop: every round does ops_per_round/2 subscribes +
+    # ops_per_round/2 unsubscribes (of filters added ~8 rounds ago, so
+    # the table size stays ~n), one refresh (flushes the patch batch),
+    # then keeps the match window full. Every 10th round also samples a
+    # full subscribe→routable latency under the running window.
+    added: list[tuple[str, int]] = []
+    ridx = 0
     window = []
-    for i in range(iters):
-        window.append(
-            step(model._trie_dev, model._rowmap_dev, model._pool_dev,
-                 *batch))
+    n_ops = 0
+    sub_lat = []
+    t_start = time.time()
+    for r in range(rounds):
+        half = ops_per_round // 2
+        for i in range(half):
+            f = f"fleet/churn{r}/vehicle/c{i}/part/p{i % 8}/m{i % 16}"
+            slot = int((r * half + i) % n_shards)
+            model.subscribe(f, slot)
+            added.append((f, slot))
+        while len(added) > 8 * half:
+            f, slot = added.pop(0)
+            model.unsubscribe(f, slot)
+            n_ops += 1
+        model.refresh()
+        n_ops += half
+        if r % 10 == 5:
+            # a tracked subscribe→routable sample riding the live window
+            f = f"fleet/probe/vehicle/pr{r}/part/p0/m0"
+            t0 = time.time()
+            model.subscribe(f, 0)
+            model.refresh()
+            out = routable(f)
+            jax.block_until_ready(out)
+            sub_lat.append(time.time() - t0)
+            assert int(np.sum(np.asarray(out[0])[0] >= 0)) >= 1
+            added.append((f, 0))
+        window.append(launch())
         if len(window) >= window_n:
             jax.block_until_ready(window.pop(0))
     for o in window:
         jax.block_until_ready(o)
-    wall = time.time() - t0
-    tps = iters * B / wall
-    p99 = float(np.percentile(np.array(lat) * 1e3, 99))
-    log(f"10M: {tps:,.0f} topics/sec (window={window_n}), sync p99 "
-        f"{p99:.1f}ms @ {n} subs")
-    HOST_PLANE_RESULTS.update({
-        "tenm_build_s": round(build_s, 1),
-        "tenm_device_gib": round(hbm_bytes / (1 << 30), 2),
-        "tenm_topics_per_sec": round(tps),
-        "tenm_sync_p99_ms": round(p99, 1),
-    })
+    wall = time.time() - t_start
+    churn_tps = rounds * B / wall
+    ops_per_sec = n_ops / wall
+    ratio = churn_tps / max(base_tps, 1e-9)
+    sub_ms = np.array(sub_lat) * 1e3 if sub_lat else np.array([float("nan")])
+    log(f"churn: {ops_per_sec:,.0f} route ops/s sustained, match "
+        f"throughput {churn_tps:,.0f} topics/sec ({ratio:.2f}x quiescent), "
+        f"subscribe→routable under load p50="
+        f"{np.percentile(sub_ms,50):.1f}ms p99={np.percentile(sub_ms,99):.1f}ms "
+        f"(patches: {model.patch_count}, uploads: {model.upload_count})")
+    put("churn",
+        churn_ops_per_sec=round(ops_per_sec),
+        churn_match_topics_per_sec=round(churn_tps),
+        churn_match_vs_quiescent=round(ratio, 2),
+        churn_sub_routable_p50_ms=round(float(np.percentile(sub_ms, 50)), 2),
+        churn_sub_routable_p99_ms=round(float(np.percentile(sub_ms, 99)), 2))
 
 
-def bench_host_plane() -> None:
+# ---------------------------------------------------------------------------
+# sections: crossover study (C++ per-message walk vs device kernel)
+# ---------------------------------------------------------------------------
+
+CROSS_SIZES = tuple(
+    int(x) for x in os.environ.get(
+        "BENCH_CROSS_SIZES", "1000,100000,1000000").split(","))
+
+
+def sec_xdev() -> None:
+    """Device half of the crossover study (VERDICT r4 #3): the kernel's
+    windowed throughput at the sub-1M table sizes (the 1M point comes
+    from the kernel section itself; composed by the supervisor)."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        log("xdev section: skipped on CPU fallback")
+        return
+
+    B = int(os.environ.get("BENCH_BATCH", 16384))
+    window_n = int(os.environ.get("BENCH_WINDOW", 8))
+    iters = int(os.environ.get("BENCH_XDEV_ITERS", 40))
+    for n in CROSS_SIZES[:-1]:
+        rng = np.random.default_rng(100 + n % 97)
+        index, model, live = build_model(n, rng, 8192)
+        topics = make_topics(live, rng, B, max(1000, n // 2))
+        tok, lens, sysf, _ = index.tokenize(topics)
+        batch = tuple(jax.device_put(x) for x in (tok, lens, sysf))
+        step = model._step
+        jax.block_until_ready(step(
+            model._trie_dev, model._rowmap_dev, model._pool_dev, *batch))
+        tps, _ = windowed_tps(step, lambda i: (
+            model._trie_dev, model._rowmap_dev, model._pool_dev, *batch),
+            iters, window_n, B)
+        log(f"xdev: {tps:,.0f} topics/sec @ {n} filters")
+        put("xdev", **{f"dev_match_tps_{n}": round(tps)})
+
+
+def sec_xcpp() -> None:
+    """C++ half of the crossover study: the per-message trie walk
+    (native/src/router.h SubTable::Match — the same code the epoll fast
+    path runs per PUBLISH) against the same filter distribution at
+    1k/100k/1M, in the emqx_broker_bench.erl:run1/4 shape (topics
+    published into a wildcard-dense subscribed tree). Single core, bulk
+    C call so ctypes overhead stays off the measurement."""
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"xcpp: native lib unavailable: {native.build_error()}")
+        return
+
+    n_topics = int(os.environ.get("BENCH_XCPP_TOPICS", 65_536))
+    for n in CROSS_SIZES:
+        rng = np.random.default_rng(100 + n % 97)
+        filters = build_filters(n, rng)
+        tab = native.NativeSubTable()
+        t0 = time.time()
+        for i, f in enumerate(filters):
+            tab.add(i, f)
+        build_s = time.time() - t0
+        live = sorted(set(filters))
+        topics = make_topics(live, rng, n_topics, max(1000, n // 2))
+        tab.match_many(topics[:1024])  # warm caches
+        t0 = time.time()
+        reps = 0
+        matches = 0
+        while time.time() - t0 < 2.0:
+            _, m = tab.match_many(topics)
+            matches += m
+            reps += 1
+        dt = time.time() - t0
+        tps = reps * len(topics) / dt
+        log(f"xcpp: {tps:,.0f} topics/sec @ {n} filters "
+            f"({matches / (reps * len(topics)):.2f} matches/topic, "
+            f"table build {build_s:.1f}s, single core)")
+        put("xcpp", **{f"cpp_match_tps_{n}": round(tps)})
+        tab.close()
+
+
+# ---------------------------------------------------------------------------
+# section: shared subscriptions + retained (BASELINE config 4)
+# ---------------------------------------------------------------------------
+
+def sec_shared() -> None:
+    """BASELINE config 4: shared subscriptions + retained messages at
+    100K groups. Measures strategy-pick dispatch throughput across the
+    group table (emqx_shared_sub.erl:138-157) and wildcard retained
+    lookup against a populated store (emqx_retainer_index semantics)."""
+    import time as _time
+
+    from emqx_tpu.broker.shared_sub import SharedSub
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.services.retainer import Retainer
+
+    n_groups = int(os.environ.get("BENCH_GROUPS", 100_000))
+    members_per = int(os.environ.get("BENCH_GROUP_MEMBERS", 4))
+    rng = np.random.default_rng(7)
+
+    shared = SharedSub(node="bench", strategy="round_robin")
+    t0 = _time.time()
+    for g in range(n_groups):
+        topic = f"fleet/f{g % 512}/group{g}/+"
+        for m in range(members_per):
+            shared.join(f"g{g}", topic, f"sess-{g}-{m}", node="bench")
+    log(f"shared: {n_groups} groups x {members_per} members joined "
+        f"in {_time.time()-t0:.1f}s")
+
+    picks = [int(x) for x in rng.integers(0, n_groups, 50_000)]
+    msg = Message(topic="x", payload=b"p")
+    t0 = _time.time()
+    n_dispatched = 0
+    for g in picks:
+        # dispatch is keyed by the subscribed FILTER (the route topic),
+        # exactly as broker._route hands it over
+        got = shared.dispatch(f"g{g}", f"fleet/f{g % 512}/group{g}/+",
+                              msg, deliver_fn=lambda s, n: True)
+        n_dispatched += len(got)
+    dt = _time.time() - t0
+    log(f"shared dispatch (python, per-message): "
+        f"{len(picks)/dt:,.0f} dispatches/sec @ {n_groups} groups "
+        f"({n_dispatched} deliveries)")
+    legs = [(f"g{g}", f"fleet/f{g % 512}/group{g}/+", msg) for g in picks]
+    t0 = _time.time()
+    out = shared.dispatch_batch(legs)
+    dt = _time.time() - t0
+    log(f"shared dispatch (python, batched): "
+        f"{len(legs)/dt:,.0f} dispatches/sec "
+        f"({sum(o is not None for o in out)} picks)")
+    # the native C++ dispatcher — the path that actually serves fully
+    # native groups in the broker (host.cc SharedGroup; VERDICT r3 #7)
+    from emqx_tpu import native as _native
+    if _native.available():
+        tab = _native.NativeSubTable()
+        for g in range(n_groups):
+            filt = f"fleet/f{g % 512}/group{g}/+"
+            for m in range(members_per):
+                tab.shared_add(g + 1, (g << 3) | m, filt)
+        topics = [f"fleet/f{g % 512}/group{g}/x"
+                  for g in rng.integers(0, n_groups, 500_000)]
+        t0 = _time.time()
+        n_t, n_picks = tab.shared_pick_many(topics)
+        dt = _time.time() - t0
+        log(f"shared dispatch (native C++, incl. full topic match): "
+            f"{n_picks/dt:,.0f} picks/sec @ {n_groups} groups")
+        put("shared", shared_native_picks_per_sec=round(n_picks / dt))
+        tab.close()
+
+    retainer = Retainer(max_retained=n_groups + 10)
+    t0 = _time.time()
+    for g in range(n_groups):
+        retainer.store(Message(
+            topic=f"fleet/f{g % 512}/group{g}/state", payload=b"s",
+            flags={"retain": True}))
+    log(f"retainer: {n_groups} retained in {_time.time()-t0:.1f}s")
+    t0 = _time.time()
+    n_cold = sum(len(retainer.match(f"fleet/f{f}/+/state"))
+                 for f in range(512))
+    cold_dt = _time.time() - t0
+    # steady state: the per-bucket submatrix caches are warm (retained
+    # dispatch on subscribe hits the same buckets continuously)
+    reps = 10
+    t0 = _time.time()
+    n_hits = 0
+    for _ in range(reps):
+        for f in range(512):
+            n_hits += len(retainer.match(f"fleet/f{f}/+/state"))
+    dt = _time.time() - t0
+    log(f"retained wildcard lookup: {reps*512/dt:,.0f} lookups/sec warm "
+        f"({512/cold_dt:,.0f} cold) = {n_hits/dt:,.0f} matched msgs/sec "
+        f"(~{n_hits//(512*reps)} matches per lookup @ {n_groups} "
+        f"retained; vectorized store, VERDICT r3 #5)")
+    put("shared",
+        retained_lookups_per_sec=round(reps * 512 / dt),
+        retained_lookups_per_sec_cold=round(512 / cold_dt))
+
+
+# ---------------------------------------------------------------------------
+# section: host plane (C++ epoll data plane; CPU by design)
+# ---------------------------------------------------------------------------
+
+def sec_host() -> None:
     """VERDICT r3 #1 before/after: the round-3 configuration (asyncio
     server, Python clients — measured 14k msg/s host path, 5.5k e2e)
     against the round-4 C++ data plane (epoll host with the native
     PUBLISH fast path, driven by the C++ loadgen — the emqtt-bench
     analogue; a Python client fleet would measure itself, not the
     broker). Reference anchor: 1M msg/s sustained (README.md:16),
-    sub-ms latency."""
+    sub-ms latency.
+
+    NOTE for readers of CPU-fallback artifacts: every number in this
+    section measures the C++ data plane on the host CPU BY DESIGN — a
+    device fallback upstream does not change what it measures."""
     import asyncio
 
     from emqx_tpu import native
@@ -542,14 +799,9 @@ def bench_host_plane() -> None:
     before = asyncio.run(run_before())
     log(f"host plane BEFORE (asyncio + python clients, qos0): "
         f"{before:,.0f} msg/s")
+    put("host", e2e_host_before_msgs_per_sec=round(before))
 
     # -- after: C++ epoll host + native fast path + C++ loadgen -------------
-    # NOTE for readers of CPU-fallback artifacts: every host-plane
-    # number in this section measures the C++ data plane on the host
-    # CPU BY DESIGN — a device fallback upstream does not change what
-    # these sections measure (unlike the kernel/10M sections above)
-    log("host plane sections measure the CPU data plane by design "
-        "(device fallback does not affect them)")
     server = NativeBrokerServer(port=0, app=BrokerApp())
     server.start()
     try:
@@ -562,6 +814,7 @@ def bench_host_plane() -> None:
             f"{blast['received']}/{blast['sent']} in {wall:.2f}s = "
             f"{blast_rate:,.0f} msg/s  ({blast_rate / max(before, 1):,.0f}x "
             f"before, {blast_rate / 1e6:.2f}x the reference's 1M/s headline)")
+        put("host", e2e_host_msgs_per_sec=round(blast_rate))
 
         lat = native.loadgen_run(
             "127.0.0.1", server.port, n_subs=8, n_pubs=8,
@@ -570,6 +823,9 @@ def bench_host_plane() -> None:
         log(f"host plane latency (windowed 64, qos0): "
             f"{lat['received'] / max(lat_wall, 1e-9):,.0f} msg/s  "
             f"p50={lat['p50_ns'] / 1e6:.3f}ms p99={lat['p99_ns'] / 1e6:.3f}ms")
+        put("host",
+            e2e_host_p50_ms=round(lat["p50_ns"] / 1e6, 3),
+            e2e_host_p99_ms=round(lat["p99_ns"] / 1e6, 3))
 
         q1 = native.loadgen_run(
             "127.0.0.1", server.port, n_subs=8, n_pubs=8,
@@ -580,109 +836,18 @@ def bench_host_plane() -> None:
         log(f"host plane qos1 (windowed 4096): {q1_rate:,.0f} msg/s "
             f"acks={q1['acks']} p99={q1['p99_ns'] / 1e6:.2f}ms  "
             f"fast stats: {server.fast_stats()}")
-        HOST_PLANE_RESULTS.update({
-            "e2e_host_msgs_per_sec": round(blast_rate),
-            "e2e_host_before_msgs_per_sec": round(before),
-            "e2e_host_p50_ms": round(lat["p50_ns"] / 1e6, 3),
-            "e2e_host_p99_ms": round(lat["p99_ns"] / 1e6, 3),
-            "e2e_host_qos1_msgs_per_sec": round(q1_rate),
-        })
+        put("host",
+            e2e_host_qos1_msgs_per_sec=round(q1_rate),
+            e2e_host_qos1_p99_ms=round(q1["p99_ns"] / 1e6, 3))
     finally:
         server.stop()
 
 
-def bench_shared_retained() -> None:
-    """BASELINE config 4: shared subscriptions + retained messages at
-    100K groups. Measures strategy-pick dispatch throughput across the
-    group table (emqx_shared_sub.erl:138-157) and wildcard retained
-    lookup against a populated store (emqx_retainer_index semantics)."""
-    import time as _time
+# ---------------------------------------------------------------------------
+# section: e2e (full broker stack with the device router on path)
+# ---------------------------------------------------------------------------
 
-    from emqx_tpu.broker.shared_sub import SharedSub
-    from emqx_tpu.core.message import Message
-    from emqx_tpu.services.retainer import Retainer
-
-    n_groups = int(os.environ.get("BENCH_GROUPS", 100_000))
-    members_per = int(os.environ.get("BENCH_GROUP_MEMBERS", 4))
-    rng = np.random.default_rng(7)
-
-    shared = SharedSub(node="bench", strategy="round_robin")
-    t0 = _time.time()
-    for g in range(n_groups):
-        topic = f"fleet/f{g % 512}/group{g}/+"
-        for m in range(members_per):
-            shared.join(f"g{g}", topic, f"sess-{g}-{m}", node="bench")
-    log(f"shared: {n_groups} groups x {members_per} members joined "
-        f"in {_time.time()-t0:.1f}s")
-
-    picks = [int(x) for x in rng.integers(0, n_groups, 50_000)]
-    msg = Message(topic="x", payload=b"p")
-    t0 = _time.time()
-    n_dispatched = 0
-    for g in picks:
-        # dispatch is keyed by the subscribed FILTER (the route topic),
-        # exactly as broker._route hands it over
-        got = shared.dispatch(f"g{g}", f"fleet/f{g % 512}/group{g}/+",
-                              msg, deliver_fn=lambda s, n: True)
-        n_dispatched += len(got)
-    dt = _time.time() - t0
-    log(f"shared dispatch (python, per-message): "
-        f"{len(picks)/dt:,.0f} dispatches/sec @ {n_groups} groups "
-        f"({n_dispatched} deliveries)")
-    legs = [(f"g{g}", f"fleet/f{g % 512}/group{g}/+", msg) for g in picks]
-    t0 = _time.time()
-    out = shared.dispatch_batch(legs)
-    dt = _time.time() - t0
-    log(f"shared dispatch (python, batched): "
-        f"{len(legs)/dt:,.0f} dispatches/sec "
-        f"({sum(o is not None for o in out)} picks)")
-    # the native C++ dispatcher — the path that actually serves fully
-    # native groups in the broker (host.cc SharedGroup; VERDICT r3 #7)
-    from emqx_tpu import native as _native
-    if _native.available():
-        tab = _native.NativeSubTable()
-        for g in range(n_groups):
-            filt = f"fleet/f{g % 512}/group{g}/+"
-            for m in range(members_per):
-                tab.shared_add(g + 1, (g << 3) | m, filt)
-        topics = [f"fleet/f{g % 512}/group{g}/x"
-                  for g in rng.integers(0, n_groups, 500_000)]
-        t0 = _time.time()
-        n_t, n_picks = tab.shared_pick_many(topics)
-        dt = _time.time() - t0
-        log(f"shared dispatch (native C++, incl. full topic match): "
-            f"{n_picks/dt:,.0f} picks/sec @ {n_groups} groups")
-        HOST_PLANE_RESULTS["shared_native_picks_per_sec"] = round(
-            n_picks / dt)
-        tab.close()
-
-    retainer = Retainer(max_retained=n_groups + 10)
-    t0 = _time.time()
-    for g in range(n_groups):
-        retainer.store(Message(
-            topic=f"fleet/f{g % 512}/group{g}/state", payload=b"s",
-            flags={"retain": True}))
-    log(f"retainer: {n_groups} retained in {_time.time()-t0:.1f}s")
-    t0 = _time.time()
-    n_cold = sum(len(retainer.match(f"fleet/f{f}/+/state"))
-                 for f in range(512))
-    cold_dt = _time.time() - t0
-    # steady state: the per-bucket submatrix caches are warm (retained
-    # dispatch on subscribe hits the same buckets continuously)
-    reps = 10
-    t0 = _time.time()
-    n_hits = 0
-    for _ in range(reps):
-        for f in range(512):
-            n_hits += len(retainer.match(f"fleet/f{f}/+/state"))
-    dt = _time.time() - t0
-    log(f"retained wildcard lookup: {reps*512/dt:,.0f} lookups/sec warm "
-        f"({512/cold_dt:,.0f} cold) = {n_hits/dt:,.0f} matched msgs/sec "
-        f"(~{n_hits//(512*reps)} matches per lookup @ {n_groups} "
-        f"retained; vectorized store, VERDICT r3 #5)")
-
-
-def bench_e2e() -> None:
+def sec_e2e() -> None:
     """End-to-end broker number (VERDICT r1 weak #1): real MQTT clients
     over TCP against the asyncio host with the device router on the
     serving path — msg/s and delivery p99 through the full stack
@@ -692,8 +857,8 @@ def bench_e2e() -> None:
     import asyncio
 
     from emqx_tpu.app import BrokerApp
-    from emqx_tpu.broker.server import BrokerServer
     from emqx_tpu.config.config import Config
+    from emqx_tpu.broker.server import BrokerServer
     from emqx_tpu.mqtt.client import MqttClient
 
     n_pub = int(os.environ.get("BENCH_E2E_PUBS", 16))
@@ -822,18 +987,21 @@ def bench_e2e() -> None:
             f"(pubs={n_pub} subs={n_sub} qos=0, device path, "
             f"kernel launches={app.broker.model.launch_count}, "
             f"rules={n_rules} co-batched, rule fires={rule_hits[0]})")
+        put("e2e", e2e_msgs_per_sec=round(got / max(wall, 1e-9)))
         if len(lat_ms):
             log(f"e2e delivery latency ms: p50={np.percentile(lat_ms, 50):.2f} "
                 f"p99={np.percentile(lat_ms, 99):.2f}")
+            put("e2e",
+                e2e_p50_ms=round(float(np.percentile(lat_ms, 50)), 2),
+                e2e_p99_ms=round(float(np.percentile(lat_ms, 99)), 2))
         log(f"e2e LOW-LOAD latency ms (device on, knee="
             f"{app.pipeline.device_knee()}, host-bypassed batches="
             f"{app.pipeline.host_batches}): "
             f"p50={np.percentile(low_a, 50):.2f} "
             f"p99={np.percentile(low_a, 99):.2f}")
-        HOST_PLANE_RESULTS.update({
-            "e2e_lowload_p50_ms": round(float(np.percentile(low_a, 50)), 2),
-            "e2e_lowload_p99_ms": round(float(np.percentile(low_a, 99)), 2),
-        })
+        put("e2e",
+            e2e_lowload_p50_ms=round(float(np.percentile(low_a, 50)), 2),
+            e2e_lowload_p99_ms=round(float(np.percentile(low_a, 99)), 2))
 
     asyncio.run(run())
 
@@ -864,16 +1032,291 @@ def bench_e2e() -> None:
                 f"2048): {res['received']}/{res['sent']} = {rate:,.0f} "
                 f"msg/s through channel FSM + pipeline + kernel "
                 f"(launches={app.broker.model.launch_count})")
-            HOST_PLANE_RESULTS["e2e_device_path_msgs_per_sec"] = round(rate)
+            put("e2e", e2e_device_path_msgs_per_sec=round(rate))
         except Exception as e:  # noqa: BLE001
             # a loadgen flake must not cost the whole artifact (every
-            # earlier section's numbers print in main()'s final JSON)
+            # earlier section's numbers stay in the partial file)
             log(f"device-path e2e section failed, skipping: {e}")
         finally:
             server.stop()
 
 
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+SECTIONS = {
+    "kernel": sec_kernel,
+    "tenm": sec_tenm,
+    "churn": sec_churn,
+    "xdev": sec_xdev,
+    "xcpp": sec_xcpp,
+    "shared": sec_shared,
+    "host": sec_host,
+    "e2e": sec_e2e,
+}
+
+# (name, needs_device, pin_cpu, deadline_s). Device sections run first —
+# they are the artifact's reason to exist (VERDICT r2/r3/r4) — and in
+# decreasing value order so a budget squeeze drops the cheapest claims.
+DEVICE_PLAN = [
+    ("kernel", True, False, 800),
+    ("tenm", True, False, 800),
+    ("churn", True, False, 500),
+    ("xdev", True, False, 500),
+    ("e2e", True, False, 600),
+    ("xcpp", False, True, 400),
+    ("host", False, True, 500),
+    ("shared", False, True, 400),
+]
+CPU_PLAN = [
+    ("kernel", False, True, 700),
+    ("xcpp", False, True, 400),
+    ("host", False, True, 500),
+    ("shared", False, True, 400),
+    ("e2e", False, True, 600),
+]
+
+_SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
+                  "shared", "host", "e2e", "kernel_cpu"]
+
+
+def _probe_device(attempts: int, timeout_s: float, backoff_s: float) -> dict:
+    """Retrying tunnel probe (VERDICT r4 #1b): a wedged tunnel can
+    recover in minutes; one 180s shot never sees it. The platform must
+    be a real accelerator — bare jax.devices() SILENTLY falls back to
+    CPU where no device is registered, which would pass CPU numbers off
+    as device numbers."""
+    import subprocess as sp
+
+    attempts_log = []
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            p = sp.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "assert d and d[0].platform != 'cpu', d; "
+                 "print(d[0])"],
+                env=dict(os.environ), timeout=timeout_s,
+                capture_output=True, text=True)
+            if p.returncode == 0:
+                dev = (p.stdout or "").strip()
+                attempts_log.append(f"ok in {time.time()-t0:.0f}s: {dev}")
+                log(f"device probe attempt {i+1}/{attempts}: {attempts_log[-1]}")
+                return {"ok": True, "attempts": i + 1,
+                        "log": attempts_log, "device": dev}
+            tail = (p.stderr or "").strip().splitlines()[-1:]
+            attempts_log.append(
+                f"rc={p.returncode}" + (f" {tail[0][:160]}" if tail else ""))
+        except sp.TimeoutExpired:
+            attempts_log.append(f"hung >{timeout_s:.0f}s (tunnel wedged?)")
+        log(f"device probe attempt {i+1}/{attempts}: {attempts_log[-1]}")
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    return {"ok": False, "attempts": attempts, "log": attempts_log}
+
+
+def _kernel_captured(partial_dir: str) -> bool:
+    """A device kernel counts as captured only when its THROUGHPUT
+    landed — a section file holding just the platform/filters keys
+    (child wedged right after its first flush) does not."""
+    path = os.path.join(partial_dir, "section_kernel.json")
+    try:
+        with open(path) as f:
+            return "kernel_topics_per_sec" in json.load(f)
+    except Exception:
+        return False
+
+
+def _compose(partial_dir: str, meta: dict) -> dict:
+    """Merge every captured section file (canonical order) + supervisor
+    metadata into the one cumulative artifact line."""
+    merged: dict = {}
+    kernel_ok = _kernel_captured(partial_dir)
+    for name in _SECTION_ORDER:
+        path = os.path.join(partial_dir, f"section_{name}.json")
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except Exception:
+                continue
+            if name == "kernel_cpu":
+                if kernel_ok:
+                    # a captured device kernel must never be shadowed by
+                    # the CPU fallback rerun (VERDICT r4 #1d)
+                    data = {k: v for k, v in data.items()
+                            if k not in merged}
+                # else: the device kernel section holds at most partial
+                # metadata (platform=tpu without numbers) — the CPU
+                # rerun overrides it wholesale so the artifact can't
+                # pair a 'tpu' label with CPU-measured values
+            merged.update(data)
+
+    platform = merged.get("kernel_platform", "none")
+    value = merged.get("kernel_topics_per_sec", 0)
+    final = {
+        "metric": "route-matches/sec",
+        "value": value,
+        "unit": "topics/sec",
+        # the MEASURED in-repo anchor (VERDICT r3 weak #8): the
+        # host-oracle python trie walk on the same topic distribution
+        "vs_host_oracle": merged.get("vs_host_oracle", 0),
+        # the reference's published headline (1M msg/s sustained,
+        # reference README.md:16) — the BASELINE.md-defined denominator
+        "vs_baseline": round(value / 1_000_000, 3),
+        "platform": platform,
+    }
+    final.update({k: v for k, v in merged.items()
+                  if k not in ("kernel_platform",)})
+    # crossover point: smallest table size where the device kernel beats
+    # the C++ per-message walk (the number that justifies the project)
+    cross = None
+    for n in CROSS_SIZES:
+        dev = merged.get(f"dev_match_tps_{n}",
+                         value if n == CROSS_SIZES[-1]
+                         and platform not in ("cpu", "none") else None)
+        cpp = merged.get(f"cpp_match_tps_{n}")
+        if dev and cpp:
+            final[f"dev_match_tps_{n}"] = dev
+            if cross is None and dev > cpp:
+                cross = n
+    if cross is not None:
+        final["crossover_filters"] = cross
+    final.update(meta)
+    return final
+
+
+def _emit(final: dict) -> None:
+    print(json.dumps(final), flush=True)
+
+
+def supervise() -> None:
+    import subprocess as sp
+    import tempfile
+
+    partial_dir = os.environ.get("BENCH_PARTIAL_DIR")
+    if not partial_dir:
+        partial_dir = tempfile.mkdtemp(prefix="emqx_bench_")
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 3300))
+    t_start = time.time()
+
+    probe = _probe_device(
+        attempts=int(os.environ.get("BENCH_PROBE_RETRIES", 4)),
+        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 120)),
+        backoff_s=float(os.environ.get("BENCH_PROBE_BACKOFF_S", 60)))
+    device_ok = probe["ok"]
+    if not device_ok:
+        log("no usable device after retries; CPU plan — numbers below "
+            "are NOT TPU numbers")
+    plan = list(DEVICE_PLAN if device_ok else CPU_PLAN)
+
+    section_status: dict = {}
+    meta = {
+        "probe_ok": device_ok,
+        "probe_attempts": probe["attempts"],
+        "probe_log": probe["log"][-4:],
+        "sections": section_status,
+    }
+    tunnel_wedged = False
+
+    i = 0
+    while i < len(plan):
+        name, needs_device, pin_cpu, deadline = plan[i]
+        i += 1
+        elapsed = time.time() - t_start
+        remaining = budget - elapsed
+        if remaining < 90:
+            section_status[name] = "skipped: budget exhausted"
+            log(f"section {name}: skipped, {remaining:.0f}s of budget left")
+            continue
+        if needs_device and tunnel_wedged:
+            section_status[name] = "skipped: tunnel wedged mid-run"
+            log(f"section {name}: skipped, tunnel wedged")
+            continue
+        timeout = min(deadline, remaining - 60)
+        env = {**os.environ, "BENCH_SECTION": name,
+               "BENCH_PARTIAL_DIR": partial_dir}
+        child_name = name
+        if pin_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            if name == "kernel":
+                # CPU fallback rerun: its partial file must not clobber
+                # a captured device kernel section
+                child_name = "kernel_cpu"
+                env["BENCH_SECTION_AS"] = child_name
+        log(f"=== section {child_name} (timeout {timeout:.0f}s, "
+            f"{remaining:.0f}s budget left) ===")
+        t0 = time.time()
+        try:
+            rc = sp.run([sys.executable, "-u", os.path.abspath(__file__)],
+                        env=env, timeout=timeout).returncode
+            if rc == 0:
+                section_status[name] = f"ok ({time.time()-t0:.0f}s)"
+            else:
+                section_status[name] = f"failed rc={rc}"
+        except sp.TimeoutExpired:
+            section_status[name] = f"timeout after {timeout:.0f}s"
+            log(f"section {child_name}: killed at {timeout:.0f}s deadline")
+            if needs_device:
+                # quick re-probe: distinguish a slow section from a
+                # wedged tunnel before burning remaining device budget
+                re = _probe_device(attempts=1, timeout_s=60, backoff_s=0)
+                if not re["ok"]:
+                    tunnel_wedged = True
+                    meta["tunnel_wedged_after"] = name
+                    log("tunnel wedged; remaining device sections skipped")
+        # cumulative line lands on stdout after EVERY section — a later
+        # wedge or driver kill still leaves this tail (VERDICT r4 #1a)
+        _emit(_compose(partial_dir, meta))
+
+    # device plan without a captured device kernel NUMBER → one labeled
+    # CPU kernel rerun so the headline slot is never empty. The gate is
+    # the throughput key, not file existence: a kernel child that wedged
+    # after its very first put() leaves a section file with only
+    # platform/filters keys, and that must still trigger the fallback
+    if device_ok and not _kernel_captured(partial_dir):
+        remaining = budget - (time.time() - t_start)
+        if remaining > 120:
+            log("no device kernel captured; running labeled CPU fallback")
+            env = {**os.environ, "BENCH_SECTION": "kernel",
+                   "BENCH_SECTION_AS": "kernel_cpu",
+                   "BENCH_PARTIAL_DIR": partial_dir,
+                   "JAX_PLATFORMS": "cpu"}
+            try:
+                rc = sp.run([sys.executable, "-u",
+                             os.path.abspath(__file__)],
+                            env=env,
+                            timeout=min(700, remaining - 30)).returncode
+                section_status["kernel_cpu"] = (
+                    "ok" if rc == 0 else f"failed rc={rc}")
+            except sp.TimeoutExpired:
+                section_status["kernel_cpu"] = "timeout"
+            _emit(_compose(partial_dir, meta))
+
+    final = _compose(partial_dir, meta)
+    _emit(final)
+    sys.exit(0 if final.get("value") else 1)
+
+
+def run_section(name: str) -> None:
+    """Child entry: run one section inline, persisting partials as the
+    section's own flush cadence dictates."""
+    global flush_results
+    alias = os.environ.get("BENCH_SECTION_AS")
+    if alias:
+        orig = flush_results
+
+        def flush_results(section, _orig=orig, _alias=alias):  # noqa: F811
+            _orig(_alias)
+    SECTIONS[name]()
+    flush_results(name)
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_SUPERVISED") != "1":
-        _supervise()
-    main()
+    section = os.environ.get("BENCH_SECTION")
+    if section:
+        run_section(section)
+    else:
+        supervise()

@@ -415,6 +415,14 @@ class NativeBrokerServer:
                 # id appeared): the previously installed entry must go,
                 # or it would keep delivering after UNSUBSCRIBE
                 self._del_entry("c:" + sid, old[0], old[1], old[2])
+            elif old is not None and kind == "punt":
+                # duplicate 'add' for the same punt shape (resubscribe,
+                # persistent-session resume re-firing every restored
+                # sub): the mirror key holds EXACTLY one ref — a second
+                # _add_entry would leave the refcount at 2 and the
+                # single 'del' at unsubscribe would strand the punt
+                # marker (topic slow-pathed forever) and leak tokens
+                return
             self._add_entry("c:" + sid, owner, real, kind, qos, flags)
             self._mirror[(sid, topic)] = (owner, real, kind)
         else:
@@ -457,7 +465,8 @@ class NativeBrokerServer:
         # shared groups this client belongs to may now be fully native
         self._reconcile_sid_groups(ch.clientid)
 
-    def _slow_consumers_watch(self, ch, topic: str) -> bool:
+    def _slow_consumers_watch(self, ch, topic: str, *,
+                              msg_events: bool | None = None) -> bool:
         """True when ANY message-plane consumer needs to see every
         publish on ``topic`` — the complete enumeration of everything
         the slow path's 'message.publish' fold can do with a live,
@@ -468,6 +477,15 @@ class NativeBrokerServer:
         app = self.app
         if app.rules.rules_for_topic(topic):
             return True                 # rules must see every message
+        if (msg_events if msg_events is not None
+                else app.rules.watches_message_events()):
+            # a $events/message_delivered|acked|dropped rule consumes
+            # per-delivery events that only the Python plane fires —
+            # native deliveries/acks/drops would silently bypass it, so
+            # NO topic may hold a permit while one exists (create_rule's
+            # on_topology_change flush revokes existing permits eagerly;
+            # the grant loop precomputes msg_events once per cycle)
+            return True
         if any(t.matches(ch.clientid, topic, str(ch.conninfo.peername))
                 for t in app.trace.running()):   # locked snapshot
             return True                 # traced topics stay observable
@@ -510,6 +528,15 @@ class NativeBrokerServer:
 
     def _grant_permits_locked(self) -> None:
         queue, self._permit_queue = self._permit_queue, []
+        if not queue:
+            return
+        # topic-independent veto, hoisted so its O(rules) scan runs once
+        # per grant cycle, not once per queued topic; the result feeds
+        # _slow_consumers_watch below so the per-topic path skips it too
+        msg_events = (self.app is not None
+                      and self.app.rules.watches_message_events())
+        if msg_events:
+            return
         for conn, topic in queue:
             ch = conn.channel
             if (not conn.fast or ch.conn_state != "connected"
@@ -519,7 +546,8 @@ class NativeBrokerServer:
             if topic in granted or len(granted) >= MAX_PERMITS_PER_CONN:
                 continue
             app = self.app
-            if app is not None and self._slow_consumers_watch(ch, topic):
+            if app is not None and self._slow_consumers_watch(
+                    ch, topic, msg_events=msg_events):
                 continue
             verdict = ch.hooks.run_fold(
                 "client.authorize",

@@ -194,6 +194,13 @@ class SharedSub:
             ]
         out = []
         append = out.append
+        # QoS>0 legs whose pick must survive a deliver_fn verdict; the
+        # callback runs AFTER the lock is released. dispatch() already
+        # keeps deliver_fn outside its hold, and a batch must match: an
+        # arbitrary callback (it may re-enter SharedSub, block on a
+        # session, or just be slow) must not extend the table hold
+        # across the whole batch and starve concurrent join/leave
+        pending: list = []
         with self._lock:
             tab_get = self._tab.get
             if s == "round_robin":
@@ -203,23 +210,11 @@ class SharedSub:
                         append(None)
                         continue
                     members = ent[0]
-                    n = len(members)
                     i = ent[2] + 1
                     ent[2] = i
-                    m = members[i % n]
+                    m = members[i % len(members)]
                     if deliver_fn is not None and msg.qos:
-                        # QoS>0 redispatch: rotate past nacked members
-                        # (same skip-forward as dispatch(); the cursor
-                        # keeps the position so the group still rotates)
-                        for _try in range(n):
-                            if deliver_fn(m[0], m[1]):
-                                break
-                            i += 1
-                            ent[2] = i
-                            m = members[i % n]
-                        else:
-                            append(None)
-                            continue
+                        pending.append((len(out), group, topic, msg, m))
                     append((m[0], m[1], ent[1]))
             elif s == "round_robin_per_group":
                 rrg = self._rr_group
@@ -244,4 +239,13 @@ class SharedSub:
                     word = msg.from_ if by_client else msg.topic
                     m = members[zlib.crc32(word.encode()) % len(members)]
                     append((m[0], m[1], ent[1]))
+        # outside the lock: confirm QoS>0 picks; a nack falls back to the
+        # single-leg dispatch(), whose rotate-past-nacked retry loop
+        # already interleaves pick() and deliver_fn without holding the
+        # table lock (the cursor advance above keeps rotation fair)
+        for idx, group, topic, msg, m in pending:
+            if deliver_fn(m[0], m[1]):
+                continue
+            d = self.dispatch(group, topic, msg, deliver_fn=deliver_fn)
+            out[idx] = d[0] if d else None
         return out

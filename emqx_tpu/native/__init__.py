@@ -107,7 +107,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_subtable_shared_pick.restype = ctypes.c_long
     lib.emqx_subtable_shared_pick.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
-        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long)]
+    lib.emqx_subtable_match_many.restype = ctypes.c_long
+    lib.emqx_subtable_match_many.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_long)]
     lib.emqx_subtable_shared_pick_many.restype = ctypes.c_long
     lib.emqx_subtable_shared_pick_many.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
@@ -308,12 +313,28 @@ class NativeSubTable:
             self._h, token, owner, filter_.encode()))
 
     def shared_pick(self, topic: str) -> list[tuple[int, int]]:
-        """One rotating (group token, picked owner) per matched group."""
+        """One rotating (group token, picked owner) per matched group.
+        The C side is all-or-nothing: on overflow it writes nothing and
+        advances no cursor (a partial pass would double-rotate on the
+        retry), reporting the needed size — re-invoke bigger."""
         cap = 512
-        buf = (ctypes.c_uint64 * cap)()
-        n = self._lib.emqx_subtable_shared_pick(self._h, topic.encode(),
-                                                buf, cap)
-        return [(buf[2 * i], buf[2 * i + 1]) for i in range(min(n, cap // 2))]
+        while True:
+            buf = (ctypes.c_uint64 * cap)()
+            total = ctypes.c_long()
+            n = self._lib.emqx_subtable_shared_pick(
+                self._h, topic.encode(), buf, cap, ctypes.byref(total))
+            if 2 * total.value <= cap:
+                return [(buf[2 * i], buf[2 * i + 1]) for i in range(n)]
+            cap = 2 * total.value + 2
+
+    def match_many(self, topics: list[str]) -> tuple[int, int]:
+        """Bulk match (bench surface): one C call for the whole topic
+        batch. Returns (topics processed, total entries matched)."""
+        blob = "\n".join(topics).encode()
+        matches = ctypes.c_long()
+        n = self._lib.emqx_subtable_match_many(
+            self._h, blob, len(blob), ctypes.byref(matches))
+        return n, matches.value
 
     def shared_pick_many(self, topics: list[str]) -> tuple[int, int]:
         """Bulk rotating picks (bench surface): one C call for the whole

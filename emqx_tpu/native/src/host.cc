@@ -953,6 +953,32 @@ long emqx_subtable_match(void* t, const char* topic, uint64_t* out,
   return n;
 }
 
+// Bulk match benchmark surface (the emqx_broker_bench.erl:run1/4 shape:
+// many topics against a wildcard-dense table): matches every
+// newline-separated topic in one call so per-call ctypes overhead stays
+// off the measurement. Returns topics processed; *out_matches totals the
+// entries matched across all topics.
+long emqx_subtable_match_many(void* t, const char* topics, size_t len,
+                              long* out_matches) {
+  auto* table = static_cast<emqx_native::SubTable*>(t);
+  std::vector<const emqx_native::SubEntry*> hits;
+  long n_topics = 0, matches = 0;
+  size_t start = 0;
+  for (size_t i = 0; i <= len; i++) {
+    if (i == len || topics[i] == '\n') {
+      if (i > start) {
+        hits.clear();
+        table->Match(std::string_view(topics + start, i - start), &hits);
+        matches += static_cast<long>(hits.size());
+        n_topics++;
+      }
+      start = i + 1;
+    }
+  }
+  *out_matches = matches;
+  return n_topics;
+}
+
 void emqx_subtable_shared_add(void* t, uint64_t token, uint64_t owner,
                               const char* filter, uint8_t qos,
                               uint8_t flags) {
@@ -969,20 +995,30 @@ int emqx_subtable_shared_del(void* t, uint64_t token, uint64_t owner,
 }
 
 // One rotating pick per matched shared group; out pairs are
-// (group token, picked owner). Returns the group count.
+// (group token, picked owner). All-or-nothing: when every pickable
+// group fits the buffer, all pairs are written, cursors advance, and
+// the pair count is returned; on overflow NOTHING is written and NO
+// cursor moves (a retry after a cursor-advancing partial call would
+// double-rotate the already-written groups and starve fixed members),
+// *out_total reports the size to re-invoke with. Empty groups are
+// skipped — no pick exists for them.
 long emqx_subtable_shared_pick(void* t, const char* topic, uint64_t* out,
-                               long cap) {
+                               long cap, long* out_total) {
   std::vector<const emqx_native::SubEntry*> hits;
   std::vector<emqx_native::SharedGroup*> groups;
   static_cast<emqx_native::SubTable*>(t)->Match(topic, &hits, &groups);
+  long total = 0;
+  for (auto* g : groups)
+    if (!g->members.empty()) total++;
+  if (out_total) *out_total = total;
+  if (2 * total > cap) return 0;
   long n = 0;
   for (auto* g : groups) {
-    if (2 * n + 1 < cap && !g->members.empty()) {
-      const auto& e = g->members[g->cursor % g->members.size()];
-      g->cursor++;
-      out[2 * n] = g->token;
-      out[2 * n + 1] = e.owner;
-    }
+    if (g->members.empty()) continue;
+    const auto& e = g->members[g->cursor % g->members.size()];
+    g->cursor++;
+    out[2 * n] = g->token;
+    out[2 * n + 1] = e.owner;
     n++;
   }
   return n;

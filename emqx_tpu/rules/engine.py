@@ -37,6 +37,13 @@ RULE_COUNTERS = ["matched", "passed", "failed", "failed.exception",
                  "failed.no_result", "actions.total", "actions.success",
                  "actions.failed"]
 
+# message-plane event topics: their hookpoints (message.delivered/acked/
+# dropped) fire per-delivery on the Python path only — a subscribed rule
+# is incompatible with native fast-path delivery for ANY topic
+MESSAGE_EVENT_TOPICS = frozenset((
+    "$events/message_delivered", "$events/message_acked",
+    "$events/message_dropped"))
+
 
 def render_template(tmpl: str, columns: dict) -> str:
     """${a.b} placeholder substitution (preproc_tmpl/proc_tmpl)."""
@@ -220,6 +227,23 @@ class RuleEngine:
         return cb
 
     # -- the publish path ----------------------------------------------------
+
+    def watches_message_events(self) -> bool:
+        """True while any enabled rule consumes message-plane events
+        ($events/message_delivered / _acked / _dropped). Those
+        hookpoints fire only on the Python delivery path, so the native
+        fast path must not carry ANY topic while such a rule exists —
+        its deliveries/acks/drops would silently never reach the rule
+        (broker/native_server._slow_consumers_watch). A live scan, not
+        a cached count: callers (and tests) flip rule.enabled in place,
+        which _make_event_cb honours dynamically — the permit gate must
+        see the same state. The grant loop hoists this call out of its
+        per-topic work, so O(rules) runs once per grant cycle."""
+        with self._index_lock:
+            return any(r.enabled
+                       and any(t in MESSAGE_EVENT_TOPICS
+                               for t in r.event_topics)
+                       for r in self.rules.values())
 
     def rules_for_topic(self, topic: str) -> list[Rule]:
         """Trie-indexed lookup: O(matched filters), not O(rules)

@@ -814,3 +814,120 @@ def test_trace_start_flushes_permits_immediately():
 
     run(main())
     server.stop()
+
+
+def test_duplicate_subscribe_punt_ref_stays_single():
+    """Duplicate SUBSCRIBE on a punt-shaped subscription (here: a
+    persistent session, the shape a session resume re-fires for every
+    restored sub) must not double-count the punt ref — round-4 advisor
+    finding: the single ref drop at UNSUBSCRIBE then left the marker in
+    the C++ table forever and leaked punt tokens under clientid churn."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="dup-ps",
+                         clean_start=False, proto_ver=5,
+                         properties={"Session-Expiry-Interval": 300})
+        await sub.connect()
+        await sub.subscribe("dup/t", qos=1)
+        await sub.subscribe("dup/t", qos=1)     # duplicate SUBSCRIBE
+        await _settle(0.3)
+        assert server._punt_refs and max(
+            server._punt_refs.values()) == 1, server._punt_refs
+        assert server._token_refs.get("c:dup-ps", 0) == 1
+        await sub.unsubscribe("dup/t")
+        await _settle(0.3)
+        # ONE unsubscribe fully clears the marker and the token refs
+        assert not server._punt_refs, server._punt_refs
+        assert "c:dup-ps" not in server._token_refs
+        await sub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_message_event_rule_blocks_all_permits():
+    """A rule on $events/message_delivered consumes per-delivery events
+    that only the Python plane fires: while it exists NO topic may hold
+    a fast-path permit, or the rule silently misses every fast-path
+    delivery (round-4 advisor finding). Creating the rule mid-stream
+    must also flush already-granted permits."""
+    app = BrokerApp()
+    hits = []
+    app.rules.register_action("sink", lambda cols, a: hits.append(cols))
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="evs")
+        await sub.connect()
+        await sub.subscribe("ev/+", qos=0)
+        pub = MqttClient(port=server.port, clientid="evp")
+        await pub.connect()
+        # earn a permit on a rule-free topic
+        await pub.publish("ev/t", b"0", qos=0)
+        await sub.recv(timeout=5)
+        await _settle()
+        await pub.publish("ev/t", b"1", qos=0)
+        await sub.recv(timeout=5)
+        assert await _wait_fast(server, "fast_in", 1)
+        # a delivered-event rule appears: permits flush, and every
+        # subsequent delivery fires the rule (i.e. went through Python)
+        app.rules.create_rule(
+            "r-ev", 'SELECT topic FROM "$events/message_delivered"',
+            [{"function": "sink", "args": {}}])
+        await _settle(0.3)
+        fast_before = server.fast_stats()["fast_in"]
+        n_before = len(hits)
+        for i in range(3):
+            await pub.publish("ev/t", f"e{i}".encode(), qos=0)
+            m = await sub.recv(timeout=5)
+            assert m.payload == f"e{i}".encode()
+            await _settle(0.2)
+        assert len(hits) == n_before + 3, "event rule missed deliveries"
+        assert server.fast_stats()["fast_in"] == fast_before
+        # deleting the rule re-opens the fast path
+        app.rules.delete_rule("r-ev")
+        await _settle(0.3)
+        await pub.publish("ev/t", b"again", qos=0)
+        await sub.recv(timeout=5)
+        await _settle()
+        await pub.publish("ev/t", b"fast", qos=0)
+        await sub.recv(timeout=5)
+        assert await _wait_fast(server, "fast_in", fast_before + 1)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_shared_pick_buffer_overflow_and_empty_groups():
+    """shared_pick's count and buffer must never desync (round-4
+    advisor finding: n advanced even when no pair was written). More
+    pickable groups than the buffer holds → the overflowing call writes
+    nothing and advances no cursor; the resized retry returns them all,
+    exactly once per group (a partial first pass would double-rotate).
+    Groups with all members removed are skipped, not emitted as
+    garbage."""
+    tab = native.NativeSubTable()
+    n_groups = 400                       # > the 512-u64 buffer's 256 pairs
+    for g in range(1, n_groups + 1):
+        tab.shared_add(g, g * 10, "of/+")
+        tab.shared_add(g, g * 10 + 1, "of/+")
+    # a few emptied groups interleaved: token present, no members
+    for g in (5, 77, 300):
+        tab.shared_del(g, g * 10, "of/+")
+        tab.shared_del(g, g * 10 + 1, "of/+")
+    picks = tab.shared_pick("of/x")
+    tokens = sorted(p[0] for p in picks)
+    want = sorted(g for g in range(1, n_groups + 1) if g not in (5, 77, 300))
+    assert tokens == want, (len(tokens), len(want))
+    for tok, owner in picks:
+        assert owner in (tok * 10, tok * 10 + 1), (tok, owner)
+    # each group's cursor advanced EXACTLY once despite the overflow
+    # retry: the next pick must rotate to the other 2-member slot
+    first = dict(picks)
+    for tok, owner in tab.shared_pick("of/x"):
+        assert owner != first[tok], (tok, owner, "cursor double-advanced")
+    tab.close()

@@ -317,3 +317,39 @@ def test_retainer_deep_filters_and_topics():
     assert [m.payload for m in r.match("a/+")] == [b"shallow"]
     wild_deep = "/".join(["+"] * 17)
     assert r.match(wild_deep) == []           # full-scan path, no crash
+
+
+def test_dispatch_batch_deliver_fn_runs_outside_lock():
+    """dispatch_batch must not hold the table lock across deliver_fn
+    (round-4 advisor finding): a re-entrant or slow callback — the real
+    member_down-on-dead-session shape here — must neither trip on the
+    held lock nor extend the hold across the whole batch. The nack path
+    must also still redispatch to a live member, matching dispatch()'s
+    semantics."""
+    s = SharedSub(strategy="round_robin")
+    for m in members(3):
+        s.join("g", "t", m)
+    alive = {"m2"}
+
+    def deliver(sid, node):
+        if sid not in alive:
+            # re-enters SharedSub.member_down → self._lock; held lock
+            # here means deadlock (test would hang, caught by timeout)
+            s.member_down(sid)
+            return False
+        return True
+
+    legs = [("g", "t", msg(qos=1)) for _ in range(6)]
+    out = s.dispatch_batch(legs, deliver_fn=deliver)
+    assert all(o is not None and o[0] == "m2" for o in out), out
+    # dead members were reaped by the callback's member_down
+    assert s.pick("g", "t", msg()) == ("m2", "node1")
+
+
+def test_dispatch_batch_all_nacked_gives_none():
+    s = SharedSub(strategy="round_robin")
+    for m in members(2):
+        s.join("g", "t", m)
+    out = s.dispatch_batch([("g", "t", msg(qos=1))] * 3,
+                           deliver_fn=lambda sid, node: False)
+    assert out == [None, None, None]

@@ -104,7 +104,8 @@ def build_listener(app, name: str, conf: dict):
             max_connections=kw["max_connections"],
             mountpoint=kw["mountpoint"],
             listener_id=kw["listener_id"],
-            fast_path=bool(conf.get("fast_path", True)))
+            fast_path=bool(conf.get("fast_path", True)),
+            device_lane=str(conf.get("device_lane", "auto")))
     return BrokerServer(**kw)
 
 
@@ -118,11 +119,13 @@ class NativeListener:
 
     def __init__(self, app, host: str, port: int, max_connections: int,
                  mountpoint: str, listener_id: str,
-                 fast_path: bool = True) -> None:
+                 fast_path: bool = True,
+                 device_lane: str = "auto") -> None:
         self._app = app
         self._bind = (host, port)
         self._kw = dict(max_connections=max_connections,
-                        mountpoint=mountpoint, fast_path=fast_path)
+                        mountpoint=mountpoint, fast_path=fast_path,
+                        device_lane=device_lane)
         self.listener_id = listener_id
         self.host = host
         self.port = port

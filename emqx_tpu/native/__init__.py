@@ -119,6 +119,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_long)]
     lib.emqx_host_permits_flush.restype = ctypes.c_int
     lib.emqx_host_permits_flush.argtypes = [ctypes.c_void_p]
+    lib.emqx_host_set_lane.restype = ctypes.c_int
+    lib.emqx_host_set_lane.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_lane_deliver.restype = ctypes.c_int
+    lib.emqx_host_lane_deliver.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.emqx_host_lane_backlog.restype = ctypes.c_long
+    lib.emqx_host_lane_backlog.argtypes = [ctypes.c_void_p]
+    lib.emqx_host_set_max_qos.restype = ctypes.c_int
+    lib.emqx_host_set_max_qos.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_subtable_match_filter.restype = ctypes.c_long
+    lib.emqx_subtable_match_filter.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
     lib.emqx_host_stat.restype = ctypes.c_long
     lib.emqx_host_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.emqx_host_conn_idle_ms.restype = ctypes.c_long
@@ -248,7 +261,7 @@ class NativeFramer:
 
 
 # event kinds from host.cc
-EV_OPEN, EV_FRAME, EV_CLOSED = 1, 2, 3
+EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE = 1, 2, 3, 4
 
 def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
                 msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
@@ -299,6 +312,18 @@ class NativeSubTable:
             buf = (ctypes.c_uint64 * cap)()
             n = self._lib.emqx_subtable_match(self._h, topic.encode(),
                                               buf, cap)
+            if n <= cap:
+                return list(buf[:n])
+            cap = n
+
+    def match_filter(self, filter_: str) -> list[int]:
+        """Owners registered under EXACTLY this filter (the device
+        lane's delivery lookup; differential-tested against match)."""
+        cap = 256
+        while True:
+            buf = (ctypes.c_uint64 * cap)()
+            n = self._lib.emqx_subtable_match_filter(
+                self._h, filter_.encode(), buf, cap)
             if n <= cap:
                 return list(buf[:n])
             cap = n
@@ -360,7 +385,9 @@ class NativeSubTable:
 # fast-path stat slots (host.cc StatSlot order)
 STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "drops_backpressure", "drops_inflight", "native_acks",
-              "shared_dispatch", "shared_no_member")
+              "shared_dispatch", "shared_no_member",
+              "lane_in", "lane_out", "lane_punts", "lane_fallback",
+              "lane_stale")
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL = 1, 2
@@ -437,6 +464,23 @@ class NativeHost:
     def shared_del(self, token: int, conn: int, filter_: str) -> None:
         self._lib.emqx_host_shared_del(self._h, token, conn,
                                        filter_.encode())
+
+    def set_lane(self, enabled: bool) -> None:
+        """Enable/disable the device match lane; disabling drains every
+        parked frame to the Python slow path in arrival order."""
+        self._lib.emqx_host_set_lane(self._h, 1 if enabled else 0)
+
+    def lane_deliver(self, blob: bytes) -> None:
+        """Apply one pump response blob (see host.cc LaneDeliver)."""
+        self._lib.emqx_host_lane_deliver(self._h, blob, len(blob))
+
+    def lane_backlog(self) -> int:
+        return int(self._lib.emqx_host_lane_backlog(self._h))
+
+    def set_max_qos(self, max_qos: int) -> None:
+        """Mirror mqtt.max_qos_allowed: over-cap publishes skip the
+        fast path so the channel can refuse them per spec."""
+        self._lib.emqx_host_set_max_qos(self._h, int(max_qos))
 
     def permits_flush(self) -> None:
         self._lib.emqx_host_permits_flush(self._h)

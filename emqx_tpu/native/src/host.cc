@@ -117,12 +117,25 @@ struct Conn {
 // overflow drops the NEW message, counted in kStDropsInflight
 constexpr size_t kMaxPendingQos1 = 1000;
 
+// Device-lane bounds: past the soft cap, NEW topics take the C++ walk
+// (correct, just not device-matched); topics with entries already in
+// flight stay on the lane regardless, preserving per-topic order. An
+// entry older than the stale deadline means the pump wedged — the lane
+// drains to Python in order and disables itself.
+constexpr size_t kLaneSoftMax = 65536;
+constexpr uint64_t kLaneStaleMs = 3000;
+// One topic flooding faster than the pump drains cannot walk (its
+// parked predecessors would be overtaken) — past this bound the NEW
+// frame is dropped like any backpressured qos0 delivery (the mqueue-
+// overflow analogue; an unacked qos1 publish is retried by the client)
+constexpr uint32_t kLaneTopicMax = 8192;
+
 // Fast-path control ops enqueued from Python threads, applied on the
 // poll thread (ApplyPending) so they serialize with matching.
 struct Op {
   enum Kind : uint8_t {
     kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush,
-    kSharedAdd, kSharedDel
+    kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos
   };
   Kind kind;
   uint64_t owner = 0;
@@ -146,6 +159,11 @@ enum StatSlot {
   kStNativeAcks,       // QoS1 PUBACKs consumed natively
   kStSharedDispatch,   // shared-group picks served natively
   kStSharedNoMember,   // shared groups with no deliverable member
+  kStLaneIn,           // PUBLISHes queued to the device match lane
+  kStLaneOut,          // lane messages delivered after a device response
+  kStLanePunts,        // lane messages punted (punt filter / spill)
+  kStLaneFallback,     // lane soft-cap hits served by the C++ walk
+  kStLaneStale,        // stale-head lane shutdowns (pump wedge trips)
   kStatCount
 };
 
@@ -243,6 +261,10 @@ class Host {
     return static_cast<long>(stats_[slot].load(std::memory_order_relaxed));
   }
 
+  uint64_t LaneBacklog() const {
+    return lane_backlog_.load(std::memory_order_relaxed);
+  }
+
   // POLL-THREAD ONLY: walks conns_, which the loop mutates — a
   // cross-thread call races the hashtable structure itself (TSan
   // caught exactly this against Drop's erase). The product calls it
@@ -271,6 +293,7 @@ class Host {
       if (n < 0) return errno == EINTR ? 0 : -1;
       for (int i = 0; i < n; i++) HandleEvent(evs[i]);
       ApplyPending();
+      if (!lane_pending_.empty()) LaneStaleScan();
     }
     size_t written = 0;
     while (!events_.empty()) {
@@ -336,6 +359,8 @@ class Host {
     switch (op.kind) {
       case Op::kSubAdd: {
         subs_.Add(op.owner, op.str, op.qos, op.flags);
+        if (op.flags & kSubPunt)
+          punt_subs_.Add(op.owner, op.str, op.qos, op.flags);
         // real entries (owner == a live conn id) are torn down with the
         // conn; remember them on the conn for that cleanup
         auto it = conns_.find(op.owner);
@@ -345,6 +370,7 @@ class Host {
       }
       case Op::kSubDel:
         subs_.Remove(op.owner, op.str);
+        punt_subs_.Remove(op.owner, op.str);
         break;
       case Op::kPermit: {
         auto it = conns_.find(op.owner);
@@ -409,7 +435,251 @@ class Host {
         }
         break;
       }
+      case Op::kSetLane:
+        lane_enabled_ = op.flags != 0;
+        if (!lane_enabled_) LaneDrainToPython();
+        break;
+      case Op::kLaneDeliver:
+        LaneDeliver(op.str);
+        break;
+      case Op::kSetMaxQos:
+        max_qos_allowed_ = op.qos;
+        break;
     }
+  }
+
+  // -- device match lane --------------------------------------------------
+
+  struct LaneEntry {
+    uint64_t publisher = 0;
+    uint8_t qos = 0;
+    uint16_t pid = 0;
+    uint64_t enq_ms = 0;
+    std::string frame;  // original PUBLISH bytes (punts forward these)
+    uint32_t topic_off = 0, topic_len = 0, payload_off = 0;
+  };
+
+  void LaneEnqueue(uint64_t seq, LaneEntry&& le) {
+    key_scratch_.assign(le.frame.data() + le.topic_off, le.topic_len);
+    lane_topic_pending_[key_scratch_]++;
+    lane_pending_.emplace(seq, std::move(le));
+    lane_order_.push_back(seq);
+    lane_backlog_.store(lane_pending_.size(), std::memory_order_relaxed);
+  }
+
+  // Callers invoke this AFTER erasing the entry from lane_pending_, so
+  // the backlog gauge reads the true remaining count (an entry-held
+  // copy of the topic keeps this valid post-erase).
+  void LaneForget(const LaneEntry& le) {
+    key_scratch_.assign(le.frame.data() + le.topic_off, le.topic_len);
+    auto it = lane_topic_pending_.find(key_scratch_);
+    if (it != lane_topic_pending_.end() && --it->second == 0) {
+      lane_topic_pending_.erase(it);
+      // last parked frame for this topic resolved: the poison window
+      // (below) closes — new frames already take the Python path via
+      // the revoked permit
+      lane_poisoned_.erase(key_scratch_);
+    }
+    lane_backlog_.store(lane_pending_.size(), std::memory_order_relaxed);
+  }
+
+  // Punt one parked frame to Python exactly as the walk path would have
+  // BEFORE consuming it: the original bytes go up as a normal frame
+  // event and the channel/broker run the whole fan-out.
+  //
+  // ``revoke_permit`` is the per-(publisher, topic) ordering guard for
+  // NON-deterministic punts (pump failure, tokenizer/K-cap fallback,
+  // stale drain): the next frame from this publisher must also take
+  // the Python path — behind this one in the same FIFO — instead of a
+  // native delivery overtaking it. Marker punts don't need it: the
+  // marker makes every subsequent verdict punt identically, exactly
+  // like the walk path.
+  void LanePunt(LaneEntry& le, bool revoke_permit) {
+    stats_[kStLanePunts].fetch_add(1, std::memory_order_relaxed);
+    stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+    if (revoke_permit) {
+      key_scratch_.assign(le.frame.data() + le.topic_off, le.topic_len);
+      // poison the topic while same-topic frames remain parked in
+      // OTHER in-flight batches: their device verdicts may differ from
+      // this one, and a native delivery would overtake this punt in
+      // Python's pipeline. Poisoned frames punt unconditionally — same
+      // FIFO — until the topic's parked count drains to zero.
+      if (lane_topic_pending_.count(key_scratch_))
+        lane_poisoned_.insert(key_scratch_);
+      auto it = conns_.find(le.publisher);
+      if (it != conns_.end()) it->second.permits.erase(key_scratch_);
+    }
+    events_.push_back(
+        EncodeRecord(2, le.publisher, le.frame.data(), le.frame.size()));
+  }
+
+  // Pump failure / lane shutdown: every parked frame goes to Python in
+  // arrival order (Python's pipeline is FIFO, so per-topic order holds
+  // within the drained set); permits are revoked so trailing frames
+  // queue behind the drained ones instead of overtaking them natively.
+  void LaneDrainToPython() {
+    for (uint64_t seq : lane_order_) {
+      auto it = lane_pending_.find(seq);
+      if (it == lane_pending_.end()) continue;
+      LaneEntry le = std::move(it->second);
+      lane_pending_.erase(it);
+      LaneForget(le);
+      LanePunt(le, /*revoke_permit=*/true);
+    }
+    lane_order_.clear();
+    lane_backlog_.store(0, std::memory_order_relaxed);
+  }
+
+  // A stale head means the Python pump stopped responding (device
+  // wedge, thread death): fail the whole lane over to the slow path
+  // and turn it off. Python watches the kStLaneStale counter and
+  // resyncs its side (and may re-enable once the pump is healthy).
+  void LaneStaleScan() {
+    if (lane_order_.empty()) return;
+    auto it = lane_pending_.find(lane_order_.front());
+    while (it == lane_pending_.end() && !lane_order_.empty()) {
+      lane_order_.pop_front();  // already answered; trim lazily
+      if (lane_order_.empty()) return;
+      it = lane_pending_.find(lane_order_.front());
+    }
+    if (it == lane_pending_.end()) return;
+    if (NowMs() - it->second.enq_ms < kLaneStaleMs) return;
+    lane_enabled_ = false;
+    stats_[kStLaneStale].fetch_add(1, std::memory_order_relaxed);
+    LaneDrainToPython();
+  }
+
+  // Shared native fan-out tail (TryFast walk path + LaneDeliver): the
+  // publisher ack, the per-entry deliveries and the shared-group
+  // rotation MUST stay one code path — callers pre-populate
+  // match_scratch_/groups_scratch_ and have already ruled out punts.
+  void FanOut(uint64_t publisher, uint8_t qos, uint16_t pid,
+              std::string_view topic, std::string_view payload) {
+    if (qos == 1) {
+      // ack first: the reference PUBACKs as soon as
+      // emqx_broker:publish returns
+      auto pit = conns_.find(publisher);
+      if (pit != conns_.end()) {
+        char ack[4] = {0x40, 0x02, static_cast<char>(pid >> 8),
+                       static_cast<char>(pid & 0xFF)};
+        pit->second.outbuf.append(ack, 4);
+        MarkDirty(publisher, pit->second);
+      }
+    }
+    stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
+    // shared serialized frames per (proto, qos=0) — qos1 frames differ
+    // per target (unique pid), built in place
+    frame_v4_.clear();
+    frame_v5_.clear();
+    for (const SubEntry* e : match_scratch_) {
+      if ((e->flags & kSubNoLocal) && e->owner == publisher) continue;
+      DeliverTo(e->owner, *e, publisher, qos, topic, payload);
+    }
+    // natively served $share groups: one member per group, rotating;
+    // skipped members (gone / backpressured / window full) get the
+    // redispatch treatment — the next member takes the message
+    // (emqx_shared_sub.erl:190-217)
+    for (SharedGroup* g : groups_scratch_) {
+      size_t nmem = g->members.size();
+      bool delivered = false;
+      for (size_t k = 0; k < nmem && !delivered; k++) {
+        const SubEntry& e = g->members[g->cursor % nmem];
+        g->cursor++;
+        if ((e.flags & kSubNoLocal) && e.owner == publisher) continue;
+        delivered = DeliverTo(e.owner, e, publisher, qos, topic, payload);
+      }
+      stats_[delivered ? kStSharedDispatch : kStSharedNoMember].fetch_add(
+          1, std::memory_order_relaxed);
+    }
+  }
+
+  // Apply one pump response blob:
+  //   [u32 count] then per item
+  //   [u64 seq][u8 flags][u16 nf] + nf x ([u16 len][filter bytes])
+  // flags bit0 = punt (device overflow / tokenizer reject / pump spill).
+  void LaneDeliver(const std::string& blob) {
+    size_t pos = 0;
+    auto need = [&](size_t n) { return pos + n <= blob.size(); };
+    auto rd_u16 = [&]() {
+      uint16_t v = static_cast<uint8_t>(blob[pos]) |
+                   (static_cast<uint8_t>(blob[pos + 1]) << 8);
+      pos += 2;
+      return v;
+    };
+    if (!need(4)) return;
+    uint32_t count = 0;
+    memcpy(&count, blob.data(), 4);
+    pos = 4;
+    for (uint32_t i = 0; i < count; i++) {
+      if (!need(8 + 1 + 2)) return;  // truncated blob: keep rest parked
+      uint64_t seq = 0;
+      memcpy(&seq, blob.data() + pos, 8);
+      pos += 8;
+      uint8_t rflags = static_cast<uint8_t>(blob[pos++]);
+      uint16_t nf = rd_u16();
+      size_t filters_at = pos;
+      for (uint16_t k = 0; k < nf; k++) {
+        if (!need(2)) return;
+        uint16_t fl = rd_u16();
+        if (!need(fl)) return;
+        pos += fl;
+      }
+      auto it = lane_pending_.find(seq);
+      if (it == lane_pending_.end()) continue;  // drained/stale already
+      LaneEntry le = std::move(it->second);
+      lane_pending_.erase(it);
+      LaneForget(le);
+      std::string_view topic(le.frame.data() + le.topic_off, le.topic_len);
+      std::string_view payload(le.frame.data() + le.payload_off,
+                               le.frame.size() - le.payload_off);
+      key_scratch_.assign(topic.data(), topic.size());
+      if (lane_poisoned_.count(key_scratch_)) {
+        // an earlier same-topic frame was nondeterministically punted;
+        // this one must follow it through Python, not overtake it
+        LanePunt(le, /*revoke_permit=*/true);
+        continue;
+      }
+      if (rflags & 1) {
+        // pump failure / tokenizer reject / K-cap overflow: a verdict
+        // the NEXT message may not repeat — revoke the permit so
+        // per-publisher order survives the switch to the Python path
+        LanePunt(le, /*revoke_permit=*/true);
+        continue;
+      }
+      // the device model only sees broker-table subscriptions; punt
+      // markers it cannot know about (remote routes, flips raced with
+      // this batch) are re-checked against the punt-only trie
+      punt_scratch_.clear();
+      punt_subs_.Match(topic, &punt_scratch_);
+      if (!punt_scratch_.empty()) {
+        LanePunt(le, /*revoke_permit=*/false);
+        continue;
+      }
+      match_scratch_.clear();
+      groups_scratch_.clear();
+      size_t fpos = filters_at;
+      for (uint16_t k = 0; k < nf; k++) {
+        uint16_t fl = static_cast<uint8_t>(blob[fpos]) |
+                      (static_cast<uint8_t>(blob[fpos + 1]) << 8);
+        fpos += 2;
+        subs_.MatchFilter(std::string_view(blob.data() + fpos, fl),
+                          &match_scratch_, &groups_scratch_);
+        fpos += fl;
+      }
+      bool punt = false;
+      for (const SubEntry* e : match_scratch_)
+        if (e->flags & kSubPunt) {
+          punt = true;
+          break;
+        }
+      if (punt) {
+        LanePunt(le, /*revoke_permit=*/false);
+        continue;
+      }
+      stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
+      FanOut(le.publisher, le.qos, le.pid, topic, payload);
+    }
+    FlushDirty();
   }
 
   void HandleEvent(const epoll_event& ev) {
@@ -529,6 +799,9 @@ class Host {
     uint8_t qos = (h >> 1) & 3;
     bool retain = h & 1;
     if (qos > 1 || retain) return false;  // QoS2 / retained: Python path
+    if (qos > max_qos_allowed_) return false;  // over-cap publish must
+    // reach the channel, which answers with DISCONNECT 0x9B
+    // ([MQTT-3.2.2-11]) instead of a native ack
     // parse: [h][varint remaining][topic u16][pid? u16][props? varint][payload]
     size_t pos = 1;
     while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
@@ -560,6 +833,49 @@ class Host {
     key_scratch_.assign(topic.data(), topic.size());  // no per-msg alloc
     if (c.permits.find(key_scratch_) == c.permits.end())
       return false;  // unpermitted topic: full Python path (authz, rules)
+    if (lane_enabled_) {
+      // device lane: park the frame, ship the topic to the batched
+      // device matcher. A topic with entries already in flight MUST
+      // stay on the lane (a walk here would overtake them); new topics
+      // spill to the walk once the lane is soft-capped.
+      auto tp = lane_topic_pending_.find(key_scratch_);
+      bool topic_in_flight = tp != lane_topic_pending_.end();
+      if (topic_in_flight && tp->second >= kLaneTopicMax) {
+        stats_[kStDropsBackpressure].fetch_add(1,
+                                               std::memory_order_relaxed);
+        return true;  // consumed: dropped under per-topic lane overload
+      }
+      if (!topic_in_flight && !punt_subs_.Empty()) {
+        // known punt audience: the device verdict can only be "punt" —
+        // skip the round trip and punt synchronously like the walk.
+        // Topics with entries in flight stay on the lane (ordering).
+        punt_scratch_.clear();
+        punt_subs_.Match(topic, &punt_scratch_);
+        if (!punt_scratch_.empty()) {
+          stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+          return false;
+        }
+      }
+      if (topic_in_flight || lane_pending_.size() < kLaneSoftMax) {
+        uint64_t seq = lane_seq_++;
+        LaneEntry le;
+        le.publisher = id;
+        le.qos = qos;
+        le.pid = pid;
+        le.enq_ms = NowMs();
+        le.topic_off = static_cast<uint32_t>(topic.data() - f.data());
+        le.topic_len = static_cast<uint32_t>(topic.size());
+        le.payload_off = static_cast<uint32_t>(pos);
+        le.frame = f;
+        stats_[kStLaneIn].fetch_add(1, std::memory_order_relaxed);
+        events_.push_back(
+            EncodeRecord(4, seq, topic.data(), topic.size()));
+        LaneEnqueue(seq, std::move(le));
+        return true;
+      }
+      stats_[kStLaneFallback].fetch_add(1, std::memory_order_relaxed);
+      // fall through: the per-message walk serves this one
+    }
     match_scratch_.clear();
     groups_scratch_.clear();
     subs_.Match(topic, &match_scratch_, &groups_scratch_);
@@ -573,39 +889,7 @@ class Host {
         return false;
       }
     }
-    // native fan-out is complete; ack the publisher first (the
-    // reference sends PUBACK as soon as emqx_broker:publish returns)
-    if (qos == 1) {
-      char ack[4] = {0x40, 0x02, static_cast<char>(pid >> 8),
-                     static_cast<char>(pid & 0xFF)};
-      c.outbuf.append(ack, 4);
-      MarkDirty(id, c);
-    }
-    stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
-    // shared serialized frames per (proto, qos=0) — qos1 frames differ
-    // per target (unique pid), built in place
-    frame_v4_.clear();
-    frame_v5_.clear();
-    for (const SubEntry* e : match_scratch_) {
-      if ((e->flags & kSubNoLocal) && e->owner == id) continue;
-      DeliverTo(e->owner, *e, id, qos, topic, payload);
-    }
-    // natively served $share groups: one member per group, rotating;
-    // skipped members (gone / backpressured / window full) get the
-    // redispatch treatment — the next member takes the message
-    // (emqx_shared_sub.erl:190-217)
-    for (SharedGroup* g : groups_scratch_) {
-      size_t nmem = g->members.size();
-      bool delivered = false;
-      for (size_t k = 0; k < nmem && !delivered; k++) {
-        const SubEntry& e = g->members[g->cursor % nmem];
-        g->cursor++;
-        if ((e.flags & kSubNoLocal) && e.owner == id) continue;
-        delivered = DeliverTo(e.owner, e, id, qos, topic, payload);
-      }
-      stats_[delivered ? kStSharedDispatch : kStSharedNoMember].fetch_add(
-          1, std::memory_order_relaxed);
-    }
+    FanOut(id, qos, pid, topic, payload);
     return true;
   }
 
@@ -799,6 +1083,32 @@ class Host {
   std::vector<uint64_t> dirty_;
   std::atomic<uint64_t> stats_[kStatCount] = {};
   std::atomic<pthread_t> poll_thread_{};  // enforces ConnIdleMs contract
+  // -- device match lane (poll-thread-owned) ------------------------------
+  // Permitted PUBLISHes whose wildcard match runs on the DEVICE router
+  // instead of the C++ trie walk: the frame parks here keyed by a lane
+  // sequence number while its topic rides a batched kernel launch
+  // (broker/native_server.py pump → models/router_model.py); the
+  // response names the matched filter strings and delivery resolves
+  // them through SubTable::MatchFilter. The per-message walk stays as
+  // the always-correct fallback (soft cap, stale drain, lane off).
+  bool lane_enabled_ = false;
+  uint8_t max_qos_allowed_ = 2;  // mqtt.max_qos_allowed zone cap mirror
+  uint64_t lane_seq_ = 1;
+  std::unordered_map<uint64_t, LaneEntry> lane_pending_;
+  std::deque<uint64_t> lane_order_;          // seqs in arrival order
+  // per-topic pending counts: a topic with lane entries in flight must
+  // keep going through the lane (a walk fallback would overtake them)
+  std::unordered_map<std::string, uint32_t> lane_topic_pending_;
+  // topics whose remaining parked frames must punt (ordering guard
+  // after a nondeterministic punt); cleared as their counts drain
+  std::unordered_set<std::string> lane_poisoned_;
+  std::atomic<uint64_t> lane_backlog_{0};
+  // punt markers mirrored into their own table: the device model only
+  // covers broker-table subscriptions, so lane delivery re-checks this
+  // (usually tiny) trie per message — remote "n:" routes and any punt
+  // shape the device cannot see still force the Python fan-out
+  SubTable punt_subs_;
+  std::vector<const SubEntry*> punt_scratch_;
 };
 
 }  // namespace
@@ -910,6 +1220,32 @@ int emqx_host_permits_flush(void* h) {
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
+int emqx_host_set_lane(void* h, int enabled) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetLane;
+  op.flags = enabled ? 1 : 0;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_lane_deliver(void* h, const uint8_t* blob, size_t len) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kLaneDeliver;
+  op.str.assign(reinterpret_cast<const char*>(blob), len);
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+long emqx_host_lane_backlog(void* h) {
+  return static_cast<long>(
+      static_cast<emqx_native::Host*>(h)->LaneBacklog());
+}
+
+int emqx_host_set_max_qos(void* h, int max_qos) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetMaxQos;
+  op.qos = static_cast<uint8_t>(max_qos);
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
 long emqx_host_stat(void* h, int slot) {
   return static_cast<emqx_native::Host*>(h)->Stat(slot);
 }
@@ -945,6 +1281,22 @@ long emqx_subtable_match(void* t, const char* topic, uint64_t* out,
                          long cap) {
   std::vector<const emqx_native::SubEntry*> hits;
   static_cast<emqx_native::SubTable*>(t)->Match(topic, &hits);
+  long n = 0;
+  for (const auto* e : hits) {
+    if (n < cap) out[n] = e->owner;
+    n++;
+  }
+  return n;
+}
+
+// Per-filter terminal lookup (the device lane's delivery primitive),
+// exposed for differential testing against Match: the union of
+// MatchFilter over a topic's oracle-matched filters must equal the
+// walk's match set.
+long emqx_subtable_match_filter(void* t, const char* filter, uint64_t* out,
+                                long cap) {
+  std::vector<const emqx_native::SubEntry*> hits;
+  static_cast<emqx_native::SubTable*>(t)->MatchFilter(filter, &hits);
   long n = 0;
   for (const auto* e : hits) {
     if (n < cap) out[n] = e->owner;

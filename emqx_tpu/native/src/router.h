@@ -66,7 +66,7 @@ class SubTable {
            uint8_t flags) {
     if (filter.find('+') == std::string::npos &&
         filter.find('#') == std::string::npos) {
-      Upsert(&exact_[filter], owner, qos, flags);
+      if (Upsert(&exact_[filter], owner, qos, flags)) entry_count_++;
       return;
     }
     SplitLevels(filter, &scratch_levels_);
@@ -75,7 +75,7 @@ class SubTable {
       std::string_view w = scratch_levels_[i];
       if (w == "#") {
         // '#' is only valid as the last level; store at the node ABOVE
-        Upsert(&n->hash, owner, qos, flags);
+        if (Upsert(&n->hash, owner, qos, flags)) entry_count_++;
         return;
       }
       if (w == "+") {
@@ -87,7 +87,7 @@ class SubTable {
         n = kid.get();
       }
     }
-    Upsert(&n->here, owner, qos, flags);
+    if (Upsert(&n->here, owner, qos, flags)) entry_count_++;
   }
 
   // Remove (owner, filter); returns whether an entry was removed.
@@ -97,6 +97,7 @@ class SubTable {
       auto it = exact_.find(filter);
       if (it == exact_.end()) return false;
       bool hit = Erase(&it->second, owner);
+      if (hit) entry_count_--;
       if (it->second.empty()) exact_.erase(it);
       return hit;
     }
@@ -104,7 +105,11 @@ class SubTable {
     Node* n = &root_;
     for (size_t i = 0; i < scratch_levels_.size(); i++) {
       std::string_view w = scratch_levels_[i];
-      if (w == "#") return Erase(&n->hash, owner);
+      if (w == "#") {
+        bool hit = Erase(&n->hash, owner);
+        if (hit) entry_count_--;
+        return hit;
+      }
       if (w == "+") {
         if (!n->plus) return false;
         n = n->plus.get();
@@ -114,7 +119,9 @@ class SubTable {
         n = it->second.get();
       }
     }
-    return Erase(&n->here, owner);
+    bool hit = Erase(&n->here, owner);
+    if (hit) entry_count_--;
+    return hit;
     // empty interior nodes are left in place: subscription churn
     // re-creates them constantly and the per-node footprint is tiny
   }
@@ -124,7 +131,7 @@ class SubTable {
   void SharedAdd(uint64_t token, uint64_t owner, const std::string& filter,
                  uint8_t qos, uint8_t flags) {
     SharedGroup* g = FindGroup(filter, token, /*create=*/true);
-    if (g) Upsert(&g->members, owner, qos, flags);
+    if (g) (void)Upsert(&g->members, owner, qos, flags);
   }
 
   bool SharedRemove(uint64_t token, uint64_t owner,
@@ -157,7 +164,57 @@ class SubTable {
     MatchNode(&root_, 0, out, groups);
   }
 
+  // Entries + shared groups registered under EXACTLY this filter — the
+  // device lane's delivery lookup. The device kernel already did the
+  // wildcard walk and returned matched filter STRINGS; delivery then
+  // needs only each filter's terminal vectors: an O(1) hash probe for
+  // plain names, an O(depth) path walk (no branching) for wildcard
+  // filters, instead of the full per-message trie match.
+  void MatchFilter(std::string_view filter,
+                   std::vector<const SubEntry*>* out,
+                   std::vector<SharedGroup*>* groups = nullptr) {
+    key_scratch_.assign(filter.data(), filter.size());
+    if (key_scratch_.find('+') == std::string::npos &&
+        key_scratch_.find('#') == std::string::npos) {
+      auto it = exact_.find(key_scratch_);
+      if (it != exact_.end())
+        for (const auto& e : it->second) out->push_back(&e);
+      if (groups) {
+        auto git = exact_groups_.find(key_scratch_);
+        if (git != exact_groups_.end())
+          for (auto& g : git->second) groups->push_back(&g);
+      }
+      return;
+    }
+    SplitLevels(key_scratch_, &scratch_levels_);
+    Node* n = &root_;
+    for (size_t i = 0; i < scratch_levels_.size(); i++) {
+      std::string_view w = scratch_levels_[i];
+      if (w == "#") {
+        for (const auto& e : n->hash) out->push_back(&e);
+        if (groups)
+          for (auto& g : n->hash_groups) groups->push_back(&g);
+        return;
+      }
+      if (w == "+") {
+        if (!n->plus) return;
+        n = n->plus.get();
+      } else {
+        auto it = n->kids.find(std::string(w));
+        if (it == n->kids.end()) return;
+        n = it->second.get();
+      }
+    }
+    for (const auto& e : n->here) out->push_back(&e);
+    if (groups)
+      for (auto& g : n->here_groups) groups->push_back(&g);
+  }
+
   size_t exact_count() const { return exact_.size(); }
+
+  // True when no plain (non-shared) entries exist anywhere — interior
+  // trie nodes left by removals don't count. O(1) via entry_count_.
+  bool Empty() const { return entry_count_ == 0; }
 
  private:
   struct Node {
@@ -240,16 +297,19 @@ class SubTable {
     }
   }
 
-  static void Upsert(std::vector<SubEntry>* v, uint64_t owner, uint8_t qos,
+  // Returns true when a NEW entry was inserted (false = qos/flags
+  // update in place) so callers can keep entry_count_ exact.
+  static bool Upsert(std::vector<SubEntry>* v, uint64_t owner, uint8_t qos,
                      uint8_t flags) {
     for (auto& e : *v) {
       if (e.owner == owner) {
         e.qos = qos;
         e.flags = flags;
-        return;
+        return false;
       }
     }
     v->push_back(SubEntry{owner, qos, flags});
+    return true;
   }
 
   static bool Erase(std::vector<SubEntry>* v, uint64_t owner) {
@@ -285,6 +345,7 @@ class SubTable {
   }
 
   Node root_;
+  size_t entry_count_ = 0;
   std::unordered_map<std::string, std::vector<SubEntry>> exact_;
   std::unordered_map<std::string, std::vector<SharedGroup>> exact_groups_;
   std::vector<std::string_view> scratch_levels_;

@@ -931,3 +931,197 @@ def test_shared_pick_buffer_overflow_and_empty_groups():
     for tok, owner in tab.shared_pick("of/x"):
         assert owner != first[tok], (tok, owner, "cursor double-advanced")
     tab.close()
+
+
+# -- device match lane (VERDICT r4 #2: the device router ON the C++ plane) ---
+
+def _lane_app():
+    from emqx_tpu.config.config import Config
+    from emqx_tpu.app import BrokerApp
+
+    conf = Config()
+    conf.put("router.device.enable", True)
+    conf.put("router.device.min_batch", 0)
+    return BrokerApp.from_config(conf)
+
+
+def test_device_lane_end_to_end():
+    """Permitted publishes ride the device matcher and fan out in C++:
+    lane_in/lane_out advance, qos1 gets a native PUBACK and a pid in
+    the native space, and a 150-message burst on one topic arrives in
+    order (per-topic FIFO through park → device batch → response)."""
+    server = NativeBrokerServer(port=0, app=_lane_app(), device_lane="on")
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="dls")
+        await sub.connect()
+        await sub.subscribe("dl/+", qos=1)
+        pub = MqttClient(port=server.port, clientid="dlp")
+        await pub.connect()
+        await pub.publish("dl/t", b"warm", qos=0)   # slow path, earns permit
+        await sub.recv(timeout=20)
+        await _settle(0.5)
+        for i in range(4):
+            await pub.publish("dl/t", f"q{i}".encode(), qos=1)
+            m = await sub.recv(timeout=20)
+            assert m.payload == f"q{i}".encode()
+            assert m.packet_id is None or m.packet_id >= 32768, m.packet_id
+            await asyncio.sleep(0.1)
+        st = server.fast_stats()
+        assert st["lane_in"] >= 1 and st["lane_out"] >= 1, st
+        assert st["native_acks"] >= 1, st
+        for i in range(150):
+            await pub.publish("dl/t", str(i).encode(), qos=0)
+        got = [int((await sub.recv(timeout=20)).payload)
+               for _ in range(150)]
+        assert got == list(range(150)), got[:10]
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_device_lane_punts_on_punt_class_subscriber():
+    """A punt-shaped subscriber (persistent session) joining a laned
+    topic flips delivery back to the complete Python fan-out: both the
+    native and the punt subscriber receive. The punt is SYNCHRONOUS
+    (TryFast consults the punt-only trie before parking — no wasted
+    device round trip), so the generic punts counter advances; the
+    lane-response punt branch itself is exercised by the sanitizer
+    lane driver's flagged responses."""
+    server = NativeBrokerServer(port=0, app=_lane_app(), device_lane="on")
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="dps")
+        await sub.connect()
+        await sub.subscribe("dp/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="dpp")
+        await pub.connect()
+        await pub.publish("dp/t", b"w", qos=0)
+        await sub.recv(timeout=20)
+        await _settle(0.5)
+        await pub.publish("dp/t", b"laned", qos=0)
+        await sub.recv(timeout=20)
+        assert await _wait_fast(server, "lane_out", 1)
+        ps = MqttClient(port=server.port, clientid="dp-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 60})
+        await ps.connect()
+        await ps.subscribe("dp/t", qos=0)
+        await _settle(0.4)
+        punts0 = server.fast_stats()["punts"]
+        await pub.publish("dp/t", b"both", qos=0)
+        assert (await sub.recv(timeout=20)).payload == b"both"
+        assert (await ps.recv(timeout=20)).payload == b"both"
+        assert await _wait_fast(server, "punts", punts0 + 1)
+        await sub.close(); await pub.close(); await ps.close()
+
+    run(main())
+    server.stop()
+
+
+def test_device_lane_disable_drains_to_python():
+    """Turning the lane off mid-stream must lose nothing: parked frames
+    drain to the Python path in order and delivery continues."""
+    server = NativeBrokerServer(port=0, app=_lane_app(), device_lane="on")
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="dds")
+        await sub.connect()
+        await sub.subscribe("dd/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="ddp")
+        await pub.connect()
+        await pub.publish("dd/t", b"w", qos=0)
+        await sub.recv(timeout=20)
+        await _settle(0.5)
+        for i in range(30):
+            await pub.publish("dd/t", str(i).encode(), qos=0)
+        server._set_lane(False)        # drains parked frames to Python
+        got = [int((await sub.recv(timeout=20)).payload)
+               for _ in range(30)]
+        assert got == list(range(30)), got[:10]
+        # lane off: further traffic walks in C++ (fast_in grows, lane_in
+        # stays put)
+        lane_in = server.fast_stats()["lane_in"]
+        await pub.publish("dd/t", b"walked", qos=0)
+        assert (await sub.recv(timeout=20)).payload == b"walked"
+        await _settle(0.2)
+        assert server.fast_stats()["lane_in"] == lane_in
+        assert server.host.lane_backlog() == 0
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_match_filter_union_equals_walk():
+    """Differential: for random topics, the union of MatchFilter over
+    the oracle's matched filters must equal the walk's match set — the
+    invariant the device lane's delivery correctness rests on."""
+    from emqx_tpu.router.trie import Trie
+
+    rng = random.Random(11)
+    words = ["a", "b", "cc", "d4", "+", "#", ""]
+    filters = set()
+    while len(filters) < 300:
+        parts = []
+        for _ in range(rng.randint(1, 6)):
+            w = rng.choice(words)
+            parts.append(w)
+            if w == "#":
+                break
+        filters.add("/".join(parts))
+    filters = sorted(filters)
+    table = native.NativeSubTable()
+    oracle = Trie()
+    for i, f in enumerate(filters):
+        table.add(i + 1, f)
+        oracle.insert(f)
+    for t in _topic_universe(random.Random(12), 2000):
+        want = set(table.match(t))
+        got = set()
+        for f in oracle.match(t):
+            got.update(table.match_filter(f))
+        assert got == want, (t, sorted(got), sorted(want))
+    table.close()
+
+
+def test_max_qos_cap_enforced_on_fast_path():
+    """mqtt.max_qos_allowed must hold even after a topic earns a C++
+    permit: an over-cap qos1 publish skips the fast path and gets the
+    channel's DISCONNECT 0x9B, never a native PUBACK (round-5 review
+    finding)."""
+    from emqx_tpu.config.config import Config
+    from emqx_tpu.mqtt import packet as P
+
+    conf = Config()
+    conf.put("mqtt.max_qos_allowed", 0)
+    app = BrokerApp.from_config(conf)
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="mqs")
+        await sub.connect()
+        await sub.subscribe("cap/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="mqp", proto_ver=5)
+        await pub.connect()
+        # earn the permit at qos0
+        for i in range(2):
+            await pub.publish("cap/t", f"m{i}".encode(), qos=0)
+            await sub.recv(timeout=5)
+            await _settle(0.3)
+        assert server.fast_stats()["fast_in"] >= 1
+        # over-cap publish: raw send (the helper would await a PUBACK
+        # that the refusal replaces with DISCONNECT)
+        await pub._send(P.Publish(topic="cap/t", payload=b"q1", qos=1,
+                                  packet_id=7, properties={}))
+        pkt = await pub._expect(P.DISCONNECT, 5)
+        assert pkt.reason_code == P.RC_QOS_NOT_SUPPORTED, hex(pkt.reason_code)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()

@@ -232,8 +232,137 @@ print("SANITIZED-RUN-OK", st)
 """
 
 
+# Round-5 device-lane coverage: EV_LANE records from TryFast's park
+# path, lane_deliver blobs applied from a foreign thread (Enqueue →
+# ApplyOp → LaneDeliver fan-out incl. the punt branch), set_lane
+# toggles draining parked frames mid-traffic, and lane_backlog reads
+# racing the poll thread.
+DRIVER_LANE = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+host = native.NativeHost(port=0, max_size=1 << 16)
+
+def mqtt_connect(cid):
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    return bytes([0x10, len(vh)]) + vh
+
+def mqtt_publish(topic, payload, qos=0, pid=0):
+    body = struct.pack(">H", len(topic)) + topic
+    if qos:
+        body += struct.pack(">H", pid)
+    body += payload
+    return bytes([0x30 | (qos << 1), len(body)]) + body
+
+socks = [socket.create_connection(("127.0.0.1", host.port))
+         for _ in range(2)]
+ids = []
+for i, s in enumerate(socks):
+    s.sendall(mqtt_connect(b"l%%d" %% i))
+deadline = time.time() + 15
+framed = 0
+while (len(ids) < 2 or framed < 2) and time.time() < deadline:
+    for kind, conn, payload in host.poll(50):
+        if kind == native.EV_OPEN:
+            ids.append(conn)
+        elif kind == native.EV_FRAME:
+            framed += 1
+            host.send(conn, b"\x20\x02\x00\x00")
+assert len(ids) == 2 and framed == 2, (ids, framed)
+sub, pub = ids
+
+for c in ids:
+    host.enable_fast(c, 4, 64)
+host.sub_add(sub, "ln/+", 1, 0)
+host.permit(pub, "ln/x")
+host.set_lane(True)
+
+stop = threading.Event()
+lane_reqs = []
+req_lock = threading.Lock()
+
+def pump():
+    # foreign-thread responder: builds blobs and enqueues them while
+    # the poll thread keeps parking/draining entries
+    k = 0
+    while not stop.is_set():
+        with req_lock:
+            batch, lane_reqs[:] = lane_reqs[:], []
+        if not batch:
+            time.sleep(0.001)
+            continue
+        parts = [struct.pack("<I", len(batch))]
+        for seq, topic in batch:
+            k += 1
+            if k %% 7 == 3:
+                parts.append(struct.pack("<QBH", seq, 1, 0))  # punt
+            else:
+                f = b"ln/+"
+                parts.append(struct.pack("<QBH", seq, 0, 1))
+                parts.append(struct.pack("<H", len(f)))
+                parts.append(f)
+        host.lane_deliver(b"".join(parts))
+pp = threading.Thread(target=pump)
+pp.start()
+
+def control_churn():
+    j = 0
+    while not stop.is_set():
+        host.sub_add(sub, "churn/%%d" %% (j %% 5), 0, 0)
+        host.sub_del(sub, "churn/%%d" %% ((j + 2) %% 5))
+        host.lane_backlog()
+        host.stats()
+        if j %% 97 == 41:
+            host.set_lane(False)   # drain parked frames mid-traffic
+            host.set_lane(True)
+            host.permit(pub, "ln/x")
+        j += 1
+        time.sleep(0.0002)
+ctl = threading.Thread(target=control_churn)
+ctl.start()
+
+time.sleep(0.2)
+N_MSG = 500
+def blaster():
+    for k in range(N_MSG):
+        socks[1].sendall(mqtt_publish(b"ln/x", b"p%%03d" %% k, k & 1,
+                                      1 + (k %% 100)))
+        time.sleep(0.0002)
+bl = threading.Thread(target=blaster)
+bl.start()
+
+drained = 0
+deadline = time.time() + 20
+while time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind == 4:           # EV_LANE
+            with req_lock:
+                lane_reqs.append((conn, payload.decode()))
+        elif kind == native.EV_FRAME:
+            drained += 1        # punted/drained frames come up verbatim
+    st = host.stats()
+    if (st["lane_in"] > N_MSG // 4 and st["lane_out"] > 0
+            and st["lane_punts"] > 0):
+        break
+bl.join()
+time.sleep(0.3)
+stop.set(); ctl.join(); pp.join()
+st = host.stats()
+assert st["lane_in"] > 0 and st["lane_out"] > 0, st
+assert st["lane_punts"] > 0, st
+for s in socks:
+    try: s.close()
+    except OSError: pass
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+print("SANITIZED-RUN-OK", st)
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
-@pytest.mark.parametrize("driver", ["host", "fastpath"])
+@pytest.mark.parametrize("driver", ["host", "fastpath", "lane"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -248,7 +377,8 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
         # use-after-free/overflow/race coverage
         "TSAN_OPTIONS": "halt_on_error=1:report_signal_unsafe=0",
     }
-    src = DRIVER if driver == "host" else DRIVER_FASTPATH
+    src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
+           "lane": DRIVER_LANE}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

@@ -421,9 +421,14 @@ class BrokerApp:
             app.pipeline.max_batch = int(conf.get("router.device.batch_max"))
             app.pipeline.min_device_batch = int(
                 conf.get("router.device.min_batch"))
+            app.pipeline.depth = int(
+                conf.get("router.device.pipeline_depth"))
+            app.pipeline.spill_ms = float(
+                conf.get("router.device.spill_ms"))
         app.config = conf
         app.broker.exclusive_enabled = bool(
             conf.get("mqtt.exclusive_subscription"))
+        app.broker.max_qos_allowed = int(conf.get("mqtt.max_qos_allowed"))
         for spec in conf.get("rewrite") or []:
             app.rewrite.add_rule(
                 action=spec.get("action", "all"),

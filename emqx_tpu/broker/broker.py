@@ -152,6 +152,10 @@ class Broker:
         # Gated by the mqtt.exclusive_subscription cap (emqx_mqtt_caps).
         self.exclusive: dict[str, Sid] = {}
         self.exclusive_enabled = True
+        # mqtt.max_qos_allowed zone cap (emqx_mqtt_caps): <2 is
+        # advertised in CONNACK Maximum-QoS and enforced on PUBLISH
+        # ([MQTT-3.2.2-11]) and will qos ([MQTT-3.2.2-12])
+        self.max_qos_allowed = 2
         self.exclusive_try_fn = None      # fn(topic, sid) -> Optional[holder]
         self.exclusive_release_fn = None  # fn(topic, sid)
         if metrics is None:

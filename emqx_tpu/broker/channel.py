@@ -74,6 +74,10 @@ class Channel:
         self.session: Optional[Session] = None
         self.will: Optional[Will] = None
         self.alias_in: dict[int, str] = {}        # MQTT5 topic aliases (in)
+        # outbound aliasing (server→client): bounded by the client's
+        # announced Topic-Alias-Maximum; assignment is first-come-keep
+        self.alias_out: dict[str, int] = {}
+        self.alias_out_max = 0
         self.session_opts = session_opts or {}
         self.mountpoint = mountpoint
         self.last_packet_at = now_ms()
@@ -198,6 +202,15 @@ class Channel:
             return self._connack_error(
                 auth_result.get("rc", P.RC_NOT_AUTHORIZED))
 
+        if pkt.proto_ver == P.MQTT_V5:
+            self.alias_out_max = int(
+                (pkt.properties or {}).get("Topic-Alias-Maximum", 0) or 0)
+        max_qos = getattr(self.broker, "max_qos_allowed", 2)
+        if pkt.will_flag and pkt.will_qos > max_qos:
+            # [MQTT-3.2.2-12]: a will above the advertised cap is a
+            # connect-time refusal, not a later disconnect
+            return self._connack_error(P.RC_QOS_NOT_SUPPORTED)
+
         # will message
         if pkt.will_flag:
             self.will = Will(
@@ -261,6 +274,8 @@ class Channel:
             # server capability advertisement (emqx_channel connack props)
             props["Receive-Maximum"] = session.max_inflight
             props["Topic-Alias-Maximum"] = 65535   # inbound aliases accepted
+            if max_qos < 2:
+                props["Maximum-QoS"] = max_qos     # [MQTT-3.2.2-9]
             if not self.broker.shared_dispatch:
                 props["Shared-Subscription-Available"] = 0
         connack = P.Connack(
@@ -310,7 +325,15 @@ class Channel:
                     raise P.FrameError("unknown topic alias",
                                        P.RC_PROTOCOL_ERROR)
         if not T.validate_name(topic):
-            return self._puberr(pkt, P.RC_TOPIC_NAME_INVALID)
+            # wildcard/invalid topic NAME is a protocol violation, not a
+            # deliverable error: the reference disconnects with 0x90
+            # (emqx_mqtt_protocol_v5_SUITE t_publish_wildtopic)
+            raise P.FrameError("invalid topic name",
+                               P.RC_TOPIC_NAME_INVALID)
+        if pkt.qos > getattr(self.broker, "max_qos_allowed", 2):
+            # [MQTT-3.2.2-11]: DISCONNECT 0x9B, not a puback error
+            raise P.FrameError("qos not supported",
+                               P.RC_QOS_NOT_SUPPORTED)
 
         mounted = self._mount(topic)
         # authorize (client.authorize hook fold: allow | deny)
@@ -325,12 +348,18 @@ class Channel:
             self.hooks.run("message.dropped.authz", (mounted,))
             return self._puberr(pkt, P.RC_NOT_AUTHORIZED)
 
+        # Topic-Alias is CONNECTION-scoped [MQTT-3.3.2-7]: forwarding the
+        # publisher's inbound alias would hand subscribers an alias THEY
+        # never negotiated (their own aliasing happens in
+        # _postprocess_out against their announced maximum)
+        fwd_props = dict(pkt.properties or {})
+        fwd_props.pop("Topic-Alias", None)
         msg = Message(
             topic=mounted, payload=pkt.payload, qos=pkt.qos,
             from_=self.clientid,
             flags={"retain": pkt.retain, "dup": pkt.dup},
             headers={
-                "properties": pkt.properties or {},
+                "properties": fwd_props,
                 "username": self.conninfo.username,
                 "peername": self.conninfo.peername,
                 "protocol": "mqtt",
@@ -369,6 +398,20 @@ class Channel:
                 self.hooks.run(
                     "message.delivered", (self.clientid, pkt.topic)
                 )
+                if self.alias_out_max and pkt.topic and self._v5():
+                    # outbound alias ([MQTT-3.3.2] server side): known
+                    # topic → alias with empty name; room left → assign
+                    # and send alias WITH the full name this once
+                    a = self.alias_out.get(pkt.topic)
+                    if a is not None:
+                        pkt.properties = {**(pkt.properties or {}),
+                                          "Topic-Alias": a}
+                        pkt.topic = ""
+                    elif len(self.alias_out) < self.alias_out_max:
+                        a = len(self.alias_out) + 1
+                        self.alias_out[pkt.topic] = a
+                        pkt.properties = {**(pkt.properties or {}),
+                                          "Topic-Alias": a}
         return pkts
 
     def _in_puback(self, pkt: P.PubAck) -> list[P.Packet]:

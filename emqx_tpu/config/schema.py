@@ -274,6 +274,12 @@ def root_schema() -> Struct:
                 # -1 = adaptive: the pipeline estimates the knee from
                 # measured device RTT and host-oracle cost EMAs
                 "min_batch": Field("int", default=-1),
+                # in-flight kernel launches the pipeline keeps (service
+                # rate ≈ depth × batch_max / device RTT)
+                "pipeline_depth": Field("int", default=4),
+                # queue-sojourn bound (ms) before a batch spills to the
+                # host oracle; -1 = adaptive (3 × measured RTT)
+                "spill_ms": Field("int", default=-1),
                 "max_levels": Field("int", default=16),
                 "frontier_k": Field("int", default=32),
                 "match_cap": Field("int", default=128),

@@ -108,9 +108,13 @@ class MqttClient:
             if pkt.type == ptype:
                 return pkt
 
-    async def subscribe(self, topic: str, qos: int = 0, **opts) -> P.SubAck:
+    async def subscribe(self, topic: str, qos: int = 0,
+                        properties: Optional[dict] = None,
+                        **opts) -> P.SubAck:
+        """``properties`` are SUBSCRIBE packet-level (e.g.
+        Subscription-Identifier); ``opts`` are per-filter (nl/rap/rh)."""
         await self._send(P.Subscribe(
-            packet_id=self._pid(),
+            packet_id=self._pid(), properties=properties or {},
             topic_filters=[(topic, {"qos": qos, **opts})],
         ))
         return await self._expect(P.SUBACK)

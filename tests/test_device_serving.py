@@ -241,3 +241,58 @@ def test_adaptive_knee_tracks_measured_costs():
     assert p.device_knee() == 32
     p.broker.model = None
     assert p.device_knee() == 0
+
+
+def test_pipeline_depth_preserves_order_and_raises_throughput(run):
+    """VERDICT r4 #4: >2 in-flight launches. At depth 4 the per-
+    publisher order still holds across a burst that spans many batches
+    (collection is strictly in submission order)."""
+    app = make_device_app()
+    app.pipeline.depth = 4
+    app.pipeline.max_batch = 8       # force many small batches
+
+    async def scenario(server):
+        sub = MqttClient(port=server.port, clientid="dsub")
+        pub = MqttClient(port=server.port, clientid="dpub")
+        await sub.connect()
+        await pub.connect()
+        await sub.subscribe("dp/t", qos=0)
+        for i in range(120):
+            await pub.publish("dp/t", b"%d" % i, qos=0)
+        seen = [int((await sub.recv(timeout=30)).payload)
+                for _ in range(120)]
+        assert seen == list(range(120))
+        assert app.pipeline.batches >= 120 // 8
+        await sub.disconnect()
+        await pub.disconnect()
+    run(scenario, app=app)
+
+
+def test_sojourn_spill_bounds_loaded_latency():
+    """VERDICT r4 #4 spill: once a batch's head message has out-waited
+    the deadline, the batch answers from the host oracle instead of
+    joining the device queue — spilled_batches advances and delivery
+    still happens."""
+    import time as _t
+
+    from emqx_tpu.core.message import Message
+
+    app = make_device_app()
+    app.broker.subscribe("s1", "sp/t")
+    pipe = app.pipeline
+    pipe.depth = 2
+    pipe.spill_ms = 5            # tiny deadline: everything spills
+    class _SpyCM:
+        def __init__(self):
+            self.got = []
+
+        def dispatch(self, merged):
+            self.got.append(merged)
+
+    pipe.cm = _SpyCM()
+    old = Message(topic="sp/t", payload=b"x")
+    old.timestamp -= 1000        # aged 1s in the queue
+    pipe.submit(old)
+    pipe.flush()
+    assert pipe.spilled_batches == 1, pipe.spilled_batches
+    assert pipe.cm.got and "s1" in pipe.cm.got[0], pipe.cm.got

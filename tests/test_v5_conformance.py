@@ -2,7 +2,41 @@
 emqx_mqtt_protocol_v5_SUITE areas not covered elsewhere: subscription
 options Retain-As-Published / Retain-Handling, request/response +
 user-property pass-through, client Receive-Maximum governing the
-SERVER's send window, and Message-Expiry-Interval countdown."""
+SERVER's send window, Message-Expiry-Interval countdown, topic-alias
+lifecycle in both directions, CONNACK capability caps, overlapping
+subscriptions, and shared-group member death at QoS2.
+
+Traceability vs the reference suite (every t_ case in
+apps/emqx/test/emqx_mqtt_protocol_v5_SUITE.erl):
+
+| reference case | covered by |
+|---|---|
+| t_basic_test | test_channel.test_subscribe_publish_qos1_end_to_end, test_publish_qos2_exactly_once, test_server socket suite |
+| t_connect_clean_start | test_connack_session_present (here), test_channel.test_clean_start_discards_old_session |
+| t_connect_will_message | test_channel.test_will_message_on_abnormal_disconnect |
+| t_connect_will_retain | test_channel will cases + test_retain_as_published (retain forwarding) |
+| t_batch_subscribe | test_channel.test_unsubscribe (multi-filter SUBSCRIBE/UNSUBACK codes) |
+| t_connect_idle_timeout | test_channel.test_keepalive_expiry (idle close) |
+| t_connect_emit_stats_timeout | N/A — BEAM process-stats emission cadence; stats surface is tests/test_observe.py |
+| t_connect_keepalive_timeout | test_channel.test_keepalive_expiry |
+| t_connect_duplicate_clientid | test_channel.test_takeover_preserves_pending, test_cm_kick |
+| t_connack_session_present | test_connack_session_present (here) |
+| t_connack_max_qos_allowed | test_connack_max_qos_allowed (here) |
+| t_connack_assigned_clienid | test_connack_assigned_clientid (here) |
+| t_publish_rap | test_retain_as_published (here) |
+| t_publish_wildtopic | test_publish_wildtopic_disconnects (here) |
+| t_publish_payload_format_indicator | test_publish_payload_format_indicator (here) |
+| t_publish_topic_alias | test_publish_topic_alias_lifecycle (here) + test_channel.test_topic_alias_v5 |
+| t_publish_response_topic | test_request_response_properties_pass_through (here) |
+| t_publish_properties | test_request_response_properties_pass_through (User-Property leg) |
+| t_publish_overlapping_subscriptions | test_publish_overlapping_subscriptions (here) |
+| t_subscribe_topic_alias | test_subscribe_topic_alias_outbound (here) |
+| t_subscribe_no_local | test_no_local_over_socket (here) |
+| t_subscribe_actions | test_channel.test_subscription_identifiers_on_delivery + subscribe qos grant in test_connack_max_qos_allowed |
+| t_unscbsctibe | test_channel.test_unsubscribe |
+| t_pingreq | exercised by every keepalive test + MqttClient.ping in gateway suites |
+| t_shared_subscriptions_client_terminates_when_qos_eq_2 | test_shared_subscription_qos2_member_death (here; mid-flight ack redispatch at unit level: test_services.test_redispatch_on_nack) |
+"""
 
 import asyncio
 
@@ -218,4 +252,220 @@ def test_no_local_over_socket(run):
         assert c.messages.empty()
         await c.disconnect()
         await other.disconnect()
+    run(scenario)
+
+
+# -- round-5 breadth: the remaining emqx_mqtt_protocol_v5_SUITE cases --------
+
+async def _expect_disconnect(client, rc, timeout=5.0):
+    pkt = await client._expect(P.DISCONNECT, timeout)
+    assert pkt.reason_code == rc, hex(pkt.reason_code)
+
+
+def test_publish_payload_format_indicator(run):
+    """[MQTT-3.3.2-6] (t_publish_payload_format_indicator): publish
+    properties — PFI included — are forwarded verbatim."""
+    async def scenario(server):
+        c = _c(server, "pfi")
+        await c.connect()
+        await c.subscribe("pfi/t", qos=2)
+        await c.publish("pfi/t", b"Payload Format Indicator",
+                        properties={"Payload-Format-Indicator": 1})
+        m = await c.recv()
+        assert m.properties.get("Payload-Format-Indicator") == 1
+        await c.disconnect()
+    run(scenario)
+
+
+def test_publish_topic_alias_lifecycle(run):
+    """t_publish_topic_alias: alias 0 is a protocol error (DISCONNECT
+    0x94 [MQTT-3.3.2-8]); a registered alias then resolves an
+    empty-topic publish [MQTT-3.3.2-12]."""
+    async def scenario(server):
+        bad = _c(server, "alias-bad")
+        await bad.connect()
+        await bad.publish("al/t", b"x",
+                          properties={"Topic-Alias": 0})
+        await _expect_disconnect(bad, P.RC_TOPIC_ALIAS_INVALID)
+        await bad.close()
+
+        c = _c(server, "alias-ok")
+        await c.connect()
+        await c.subscribe("al/t", qos=2)
+        await c.publish("al/t", b"one",
+                        properties={"Topic-Alias": 233})
+        await c.publish("", b"two",
+                        properties={"Topic-Alias": 233})
+        msgs = [await c.recv(), await c.recv()]
+        assert sorted(m.payload for m in msgs) == [b"one", b"two"]
+        for m in msgs:
+            # [MQTT-3.3.2-7]: the publisher's alias is connection-scoped
+            # — this subscriber announced no Topic-Alias-Maximum, so no
+            # alias may reach it
+            assert "Topic-Alias" not in (m.properties or {}), m.properties
+            assert m.topic == "al/t"
+        await c.disconnect()
+    run(scenario)
+
+
+def test_subscribe_topic_alias_outbound(run):
+    """t_subscribe_topic_alias: the client's Topic-Alias-Maximum lets
+    the SERVER alias deliveries — first use carries alias + full name,
+    repeats carry alias + empty name, and topics beyond the budget go
+    un-aliased."""
+    async def scenario(server):
+        c = _c(server, "out-alias",
+               properties={"Topic-Alias-Maximum": 1})
+        await c.connect()
+        await c.subscribe("oa/t1", qos=2)
+        await c.subscribe("oa/t2", qos=2)
+        await c.publish("oa/t1", b"a")
+        m1 = await c.recv()
+        assert m1.topic == "oa/t1"
+        assert m1.properties.get("Topic-Alias") == 1
+        await c.publish("oa/t1", b"b")
+        m2 = await c.recv()
+        assert m2.topic == ""
+        assert m2.properties.get("Topic-Alias") == 1
+        await c.publish("oa/t2", b"c")
+        m3 = await c.recv()
+        assert m3.topic == "oa/t2"
+        assert "Topic-Alias" not in (m3.properties or {})
+        await c.disconnect()
+    run(scenario)
+
+
+def test_publish_overlapping_subscriptions(run):
+    """t_publish_overlapping_subscriptions: two overlapping wildcard
+    subscriptions each deliver ([MQTT-3.3.4-2]: forwarded qos below the
+    publish qos 2; [MQTT-3.3.4-3]: the Subscription-Identifier rides
+    each delivery)."""
+    async def scenario(server):
+        c = _c(server, "overlap")
+        await c.connect()
+        await c.subscribe("ov/+", qos=1,
+                          properties={"Subscription-Identifier": 2333})
+        await c.subscribe("ov/#", qos=0,
+                          properties={"Subscription-Identifier": 2333})
+        await c.publish("ov/t", b"overlap", qos=2)
+        msgs = [await c.recv(), await c.recv()]
+        for m in msgs:
+            assert m.qos < 2
+            assert m.properties.get("Subscription-Identifier") == [2333]
+        await c.disconnect()
+    run(scenario)
+
+
+def test_publish_wildtopic_disconnects(run):
+    """t_publish_wildtopic: publishing to a topic NAME containing
+    wildcards is a protocol violation → DISCONNECT 0x90."""
+    async def scenario(server):
+        c = _c(server, "wildpub")
+        await c.connect()
+        await c.publish("wild/#", b"error topic")
+        await _expect_disconnect(c, P.RC_TOPIC_NAME_INVALID)
+        await c.close()
+    run(scenario)
+
+
+def test_connack_session_present(run):
+    """t_connack_session_present: clean_start=1 → session_present=0
+    [MQTT-3.2.2-2]; reconnect with clean_start=0 and a live expiry →
+    session_present=1 [MQTT-3.2.2-3]."""
+    async def scenario(server):
+        c1 = _c(server, "sp-cid", clean_start=True,
+                properties={"Session-Expiry-Interval": 7200})
+        ack1 = await c1.connect()
+        assert ack1.session_present is False
+        await c1.disconnect()
+        c2 = _c(server, "sp-cid", clean_start=False,
+                properties={"Session-Expiry-Interval": 7200})
+        ack2 = await c2.connect()
+        assert ack2.session_present is True
+        await c2.disconnect()
+    run(scenario)
+
+
+def test_connack_assigned_clientid(run):
+    """t_connack_assigned_clienid [MQTT-3.2.2-16]: an empty v5
+    clientid gets a server-assigned identifier in CONNACK."""
+    async def scenario(server):
+        c = MqttClient(port=server.port, clientid="", proto_ver=5)
+        ack = await c.connect()
+        assigned = (ack.properties or {}).get("Assigned-Client-Identifier")
+        assert assigned, ack.properties
+        await c.disconnect()
+    run(scenario)
+
+
+def test_connack_max_qos_allowed():
+    """t_connack_max_qos_allowed: with mqtt.max_qos_allowed=1 the cap
+    is advertised [MQTT-3.2.2-9], any-qos SUBSCRIBE is still granted
+    [MQTT-3.2.2-10], a qos2 PUBLISH disconnects with 0x9B
+    [MQTT-3.2.2-11], and a qos2 will is refused at CONNECT with 0x9B
+    [MQTT-3.2.2-12]."""
+    import asyncio as aio
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.config.config import Config
+
+    conf = Config()
+    conf.put("mqtt.max_qos_allowed", 1)
+    app = BrokerApp.from_config(conf)
+
+    async def main():
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+        try:
+            c = _c(server, "mq1")
+            ack = await c.connect()
+            assert (ack.properties or {}).get("Maximum-QoS") == 1
+            for q in (0, 1, 2):
+                sa = await c.subscribe("mq/t", qos=q)
+                assert sa.reason_codes[0] == q, sa.reason_codes
+            # raw send: the helper would block awaiting a PUBREC that
+            # the refusal replaces with DISCONNECT
+            await c._send(P.Publish(topic="mq/t", payload=b"too high",
+                                    qos=2, packet_id=c._pid(),
+                                    properties={}))
+            await _expect_disconnect(c, P.RC_QOS_NOT_SUPPORTED)
+            await c.close()
+
+            w = _c(server, "mq-will")
+            with pytest.raises(ConnectionRefusedError, match="0x9b"):
+                await w.connect(will_topic="mq/will", will_qos=2,
+                                will_payload=b"Unsupported Qos")
+            await w.close()
+        finally:
+            await server.stop()
+
+    aio.run(main())
+
+
+def test_shared_subscription_qos2_member_death(run):
+    """t_shared_subscriptions_client_terminates_when_qos_eq_2 essence:
+    a qos2 shared-group message is never lost to a dead member — after
+    one member's socket dies abruptly, the group's traffic lands on the
+    surviving member exactly once. (Mid-flight ack-timeout redispatch
+    is covered at the SharedSub unit level: redispatch-on-nack.)"""
+    async def scenario(server):
+        doomed = _c(server, "sub_client_1")
+        await doomed.connect()
+        await doomed.subscribe("$share/sharename/sq/t", qos=2)
+        survivor = _c(server, "sub_client_2")
+        await survivor.connect()
+        await survivor.subscribe("$share/sharename/sq/t", qos=2)
+        pub = _c(server, "pub_client")
+        await pub.connect()
+        # abrupt death (no DISCONNECT): transport close → terminate →
+        # member_down reaps the membership
+        doomed._writer.close()
+        await asyncio.sleep(0.3)
+        for i in range(4):
+            await pub.publish("sq/t", f"m{i}".encode(), qos=2)
+        got = sorted([(await survivor.recv()).payload for _ in range(4)])
+        assert got == [b"m0", b"m1", b"m2", b"m3"], got
+        with pytest.raises(asyncio.TimeoutError):
+            await survivor.recv(timeout=0.4)   # exactly once, no dup
+        await survivor.disconnect(); await pub.disconnect()
     run(scenario)

@@ -159,11 +159,23 @@ class NativeBrokerServer:
         self._lane_thread: Optional[threading.Thread] = None
         self._lane_stale_seen = 0
         self._lane_retry_at = 0.0    # monotonic backoff after stale trip
-        # recently closed conns: (clientid, proto_ver) kept so a lane
-        # frame punted AFTER its publisher disconnected (EV_FRAME for a
-        # conn already popped) can still be published — on the walk
-        # path the punt is synchronous so this window cannot occur
-        self._closed_conns: dict[int, tuple[str, int]] = {}
+        # recently closed conns: (clientid, proto_ver, username,
+        # peername) kept so a lane frame punted — or a rule tap emitted
+        # — AFTER its publisher disconnected can still be honoured; on
+        # the walk path both are synchronous so this window cannot occur
+        self._closed_conns: dict[int, tuple] = {}
+        # -- rule taps (VERDICT r4 #5: no broad-rule permit cliff) ----------
+        # rule FROM filters mirror into the C++ table as NON-delivering
+        # tap entries; matched frames copy here and a worker runs the
+        # rule engine against them while native fan-out proceeds. The
+        # queue is bounded: under sustained rule-eval overload frames
+        # are counted into tap_dropped instead of stalling the plane.
+        self._rule_taps: dict[str, int] = {}          # filter -> token
+        # entries are BATCH records (~≤192KB each): 128 bounds worst-
+        # case buffering at ~24MB / a few hundred thousand messages
+        self._tap_q: queue.Queue = queue.Queue(maxsize=128)
+        self.tap_dropped = 0
+        self._tap_thread: Optional[threading.Thread] = None
         # the mqtt.max_qos_allowed cap must hold on the fast path too:
         # over-cap publishes fall through to the channel's DISCONNECT
         max_qos = getattr(self.broker, "max_qos_allowed", 2)
@@ -221,13 +233,41 @@ class NativeBrokerServer:
         # exhook watcher must see already-fast topics immediately, not
         # after the TTL. (app.exhook is None until configured; a server
         # built before exhook config falls back to the TTL for it.)
-        for comp in ("rules", "bridges", "trace", "topic_metrics",
+        for comp in ("bridges", "trace", "topic_metrics",
                      "rewrite", "exhook"):
             obj = getattr(app, comp, None) if app is not None else None
             if hasattr(obj, "on_topology_change"):
                 obj.on_topology_change.append(self.flush_permits)
+        # rules get a richer callback: tap entries sync FIRST (ops apply
+        # FIFO on the poll thread, so post-flush grants see the taps),
+        # then the permit flush
+        if app is not None and hasattr(app.rules, "on_topology_change"):
+            app.rules.on_topology_change.append(self._on_rules_change)
+            if self.fast_path:
+                self._sync_rule_taps()
 
     # -- fast-path control --------------------------------------------------
+
+    def _on_rules_change(self) -> None:
+        self._sync_rule_taps()
+        self.flush_permits()
+
+    def _sync_rule_taps(self) -> None:
+        """Reconcile the C++ rule-tap entries with the live FROM
+        filters. Thread-safe (sub_add/del enqueue onto the poll
+        thread); _mirror_lock serializes concurrent topology events."""
+        if not self.fast_path or self.app is None:
+            return
+        want = set(self.app.rules.publish_filters())
+        with self._mirror_lock:
+            cur = self._rule_taps
+            for f in want - cur.keys():
+                tok = self._punt_token_next
+                self._punt_token_next += 1
+                cur[f] = tok
+                self.host.sub_add(tok, f, 0, native.SUB_RULE_TAP)
+            for f in list(cur.keys() - want):
+                self.host.sub_del(cur.pop(f), f)
 
     def flush_permits(self) -> None:
         """Topology changed (rule created, authz update, trace started):
@@ -354,12 +394,23 @@ class NativeBrokerServer:
                                 or (items is None and not inbox)):
                     handle, seqs = pending.popleft()
                     try:
-                        matched, _aux, _slots, fallback = \
+                        matched, aux, _slots, fallback = \
                             model.publish_batch_collect(handle)
                     except Exception:
                         log.exception("lane collect failed; punting")
                         self._lane_respond_punt(seqs)
                         continue
+                    if aux and any(aux):
+                        # aux = co-batched rule FROM filters: they map
+                        # to the C++ RULE-TAP entries, so the response
+                        # must name them or lane traffic would bypass
+                        # the rules. Deduped: a filter both subscribed
+                        # AND ruled appears in m and a, and naming it
+                        # twice would double-deliver to its subscribers
+                        # (MatchFilter appends per name)
+                        matched = [
+                            m + [x for x in a if x not in m] if a else m
+                            for m, a in zip(matched, aux)]
                     self._lane_respond(seqs, matched, fallback)
         except Exception:
             log.exception("lane pump died; lane off")
@@ -679,8 +730,15 @@ class NativeBrokerServer:
         change (rules, bridges, traces, topic metrics, pub rewrites,
         exhook providers), with the permit TTL as the backstop."""
         app = self.app
-        if app.rules.rules_for_topic(topic):
-            return True                 # rules must see every message
+        if app.rules.rules_for_topic(topic) and not self._rule_taps:
+            # rules must see every message. With the tap mirror active
+            # (fast_path servers sync it at startup and on every rule
+            # change) the matched frames COPY to the rule runtime from
+            # the fast path itself, so rules no longer veto permits —
+            # the FROM '#' cliff (130x collapse to the Python plane) is
+            # gone. _rule_taps empty means taps aren't mirrored (e.g.
+            # rules exist but the sync hasn't run): keep the veto.
+            return True
         if (msg_events if msg_events is not None
                 else app.rules.watches_message_events()):
             # a $events/message_delivered|acked|dropped rule consumes
@@ -782,15 +840,19 @@ class NativeBrokerServer:
                 # conn field carries the lane sequence number
                 self._lane_buf.append(
                     (conn_id, payload.decode("utf-8", "replace")))
+            elif kind == native.EV_TAP:
+                self._on_tap(conn_id, payload)
             elif kind == native.EV_CLOSED:
                 conn = self.conns.pop(conn_id, None)
                 if conn is not None:
                     ch = conn.channel
                     if conn.fast:
-                        # a lane punt may still replay this conn's
-                        # parked frames (up to the stale deadline)
+                        # a lane punt / rule tap may still surface this
+                        # conn's frames (up to the stale deadline)
                         self._closed_conns[conn_id] = (
-                            ch.clientid, ch.conninfo.proto_ver)
+                            ch.clientid, ch.conninfo.proto_ver,
+                            ch.conninfo.username,
+                            ch.conninfo.peername)
                         if len(self._closed_conns) > 4096:
                             self._closed_conns.pop(
                                 next(iter(self._closed_conns)))
@@ -842,6 +904,77 @@ class NativeBrokerServer:
             # topic for a permit decision once the pipeline is idle
             self._permit_queue.append((conn, pkt.topic))
 
+    def _conninfo_for(self, conn_id: int):
+        """(clientid, proto_ver, username, peername) for a live or
+        recently closed conn; None when unknown."""
+        conn = self.conns.get(conn_id)
+        if conn is not None:
+            ci = conn.channel.conninfo
+            return (conn.channel.clientid, ci.proto_ver, ci.username,
+                    ci.peername)
+        return self._closed_conns.get(conn_id)
+
+    def _on_tap(self, _conn_id: int, batch: bytes) -> None:
+        """Natively-delivered frames that matched rule-tap entries,
+        BATCHED into one record per C++ poll cycle
+        ([u64 publisher][u32 len][frame]...). The poll thread does ONE
+        queue put per batch — parsing and conninfo resolution happen on
+        the worker (per-message work here measurably throttled the data
+        plane). Bounded: under sustained rule-eval overload whole
+        batches drop, message-counted into tap_dropped."""
+        try:
+            self._tap_q.put_nowait(batch)
+        except queue.Full:
+            n = 0
+            pos = 0
+            while pos + 12 <= len(batch):      # header-only count
+                pos += 12 + int.from_bytes(batch[pos + 8:pos + 12],
+                                           "little")
+                n += 1
+            self.tap_dropped += n
+
+    def _tap_worker(self) -> None:
+        """Evaluate rules against tapped frames off the poll thread.
+        The frames were already natively delivered; only the rule
+        engine sees them here (app.rules.ingest → same _fire path the
+        hook fold uses). conninfo lookups read self.conns cross-thread:
+        GIL-safe, and a conn closed mid-read just falls back to the
+        recently-closed map (or is skipped)."""
+        from emqx_tpu.core.message import Message
+
+        while not self._stop.is_set():
+            try:
+                batch = self._tap_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            pos, n = 0, len(batch)
+            while pos + 12 <= n:
+                publisher = int.from_bytes(batch[pos:pos + 8], "little")
+                flen = int.from_bytes(batch[pos + 8:pos + 12], "little")
+                pos += 12
+                frame = batch[pos:pos + flen]
+                pos += flen
+                info = self._conninfo_for(publisher)
+                if info is None:
+                    continue
+                clientid, proto_ver, username, peername = info
+                try:
+                    pkt = parse_one(frame, proto_ver)
+                    props = dict(pkt.properties or {})
+                    props.pop("Topic-Alias", None)
+                    msg = Message(
+                        topic=pkt.topic, payload=pkt.payload, qos=pkt.qos,
+                        from_=clientid,
+                        flags={"retain": False, "dup": pkt.dup},
+                        headers={"properties": props,
+                                 "username": username,
+                                 "peername": peername,
+                                 "protocol": "mqtt"},
+                    )
+                    self.app.rules.ingest(msg)
+                except Exception:  # noqa: BLE001 — one bad frame/rule
+                    log.exception("rule tap evaluation failed")
+
     def _orphan_frame(self, conn_id: int, frame: bytes) -> None:
         """A frame surfaced for a conn we already tore down — in
         practice a lane punt replaying a parked PUBLISH after its
@@ -853,7 +986,7 @@ class NativeBrokerServer:
         info = self._closed_conns.get(conn_id)
         if info is None:
             return                     # unknown conn: nothing to honour
-        clientid, proto_ver = info
+        clientid, proto_ver, _username, _peername = info
         try:
             pkt = parse_one(frame, proto_ver)
         except Exception:  # noqa: BLE001 — defensive: drop, don't crash
@@ -966,6 +1099,11 @@ class NativeBrokerServer:
         """Run the poll loop on a background thread."""
         if self.device_lane == "on":
             self._set_lane(True)
+        if self.fast_path and self.app is not None:
+            self._tap_thread = threading.Thread(
+                target=self._tap_worker, name="emqx-rule-tap",
+                daemon=True)
+            self._tap_thread.start()
         self._thread = threading.Thread(
             target=self._run, name="emqx-native-host", daemon=True)
         self._thread.start()
@@ -985,6 +1123,9 @@ class NativeBrokerServer:
             self._lane_thread.join(timeout=5)
             self._lane_thread = None
         self._stop.set()
+        if self._tap_thread is not None:
+            self._tap_thread.join(timeout=5)
+            self._tap_thread = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -996,7 +1137,7 @@ class NativeBrokerServer:
             self.broker.router.route_observers.remove(self._on_route_event)
         except ValueError:
             pass
-        for comp in ("rules", "bridges", "trace", "topic_metrics",
+        for comp in ("bridges", "trace", "topic_metrics",
                      "rewrite", "exhook"):
             obj = getattr(self.app, comp, None) if self.app else None
             if hasattr(obj, "on_topology_change"):
@@ -1004,6 +1145,13 @@ class NativeBrokerServer:
                     obj.on_topology_change.remove(self.flush_permits)
                 except ValueError:
                     pass
+        if self.app is not None and hasattr(self.app.rules,
+                                            "on_topology_change"):
+            try:
+                self.app.rules.on_topology_change.remove(
+                    self._on_rules_change)
+            except ValueError:
+                pass
         if self.app is not None and hasattr(self.app,
                                             "on_shared_strategy_change"):
             try:

@@ -110,11 +110,17 @@ class PublishPipeline:
 
     # -- consumer side ------------------------------------------------------
 
-    def spill_deadline_ms(self) -> float:
+    def spill_deadline_ms(self) -> Optional[float]:
         """Queue-sojourn bound before a batch spills to the host
-        oracle; adaptive default tracks the measured device RTT."""
+        oracle; adaptive default tracks the measured device RTT.
+        ``None`` disables the implicit spill: a config that PINS the
+        knee to 0 (force-kernel mode — benches and kernel-path tests
+        that need every batch on the device) must not be silently
+        diverted under load; an explicit spill_ms still applies."""
         if self.spill_ms >= 0:
             return self.spill_ms
+        if self.min_device_batch == 0:
+            return None
         return max(3e3 * self._rtt_ema, 30.0)
 
     def flush(self) -> int:
@@ -156,8 +162,9 @@ class PublishPipeline:
                             # refresh the EMA.
                             bypass = False
                         if not bypass:
+                            deadline = self.spill_deadline_ms()
                             sojourn = time.time() * 1e3 - batch[0].timestamp
-                            if sojourn > self.spill_deadline_ms():
+                            if deadline is not None and sojourn > deadline:
                                 # the device queue is saturated: this
                                 # batch's wait already ate the latency
                                 # budget — the oracle answers now

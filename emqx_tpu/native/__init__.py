@@ -261,7 +261,7 @@ class NativeFramer:
 
 
 # event kinds from host.cc
-EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE = 1, 2, 3, 4
+EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP = 1, 2, 3, 4, 6
 
 def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
                 msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
@@ -387,10 +387,10 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "drops_backpressure", "drops_inflight", "native_acks",
               "shared_dispatch", "shared_no_member",
               "lane_in", "lane_out", "lane_punts", "lane_fallback",
-              "lane_stale")
+              "lane_stale", "taps")
 
 # subscription-entry flags (router.h)
-SUB_PUNT, SUB_NO_LOCAL = 1, 2
+SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP = 1, 2, 4
 
 
 class NativeHost:

@@ -46,6 +46,8 @@
 //   kind 1 = OPEN   payload = "ip:port" of the peer
 //   kind 2 = FRAME  payload = one complete MQTT frame (verbatim bytes)
 //   kind 3 = CLOSED payload = reason string
+//   kind 4 = LANE   conn_id = lane seq, payload = topic (device match)
+//   kind 6 = TAP    payload = frame copy for the rule runtime
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -129,6 +131,9 @@ constexpr uint64_t kLaneStaleMs = 3000;
 // frame is dropped like any backpressured qos0 delivery (the mqueue-
 // overflow analogue; an unacked qos1 publish is retried by the client)
 constexpr uint32_t kLaneTopicMax = 8192;
+// Tap batch record flush threshold — well under the Python-side poll
+// buffer (max_packet_size + 64), since an oversized record is dropped.
+constexpr size_t kTapFlushBytes = 192 * 1024;
 
 // Fast-path control ops enqueued from Python threads, applied on the
 // poll thread (ApplyPending) so they serialize with matching.
@@ -164,6 +169,7 @@ enum StatSlot {
   kStLanePunts,        // lane messages punted (punt filter / spill)
   kStLaneFallback,     // lane soft-cap hits served by the C++ walk
   kStLaneStale,        // stale-head lane shutdowns (pump wedge trips)
+  kStTaps,             // rule-tap frame copies forwarded to Python
   kStatCount
 };
 
@@ -294,6 +300,7 @@ class Host {
       for (int i = 0; i < n; i++) HandleEvent(evs[i]);
       ApplyPending();
       if (!lane_pending_.empty()) LaneStaleScan();
+      FlushTaps();
     }
     size_t written = 0;
     while (!events_.empty()) {
@@ -572,6 +579,7 @@ class Host {
     frame_v4_.clear();
     frame_v5_.clear();
     for (const SubEntry* e : match_scratch_) {
+      if (e->flags & kSubRuleTap) continue;  // rule taps never deliver
       if ((e->flags & kSubNoLocal) && e->owner == publisher) continue;
       DeliverTo(e->owner, *e, publisher, qos, topic, payload);
     }
@@ -666,16 +674,19 @@ class Host {
                           &match_scratch_, &groups_scratch_);
         fpos += fl;
       }
-      bool punt = false;
-      for (const SubEntry* e : match_scratch_)
+      bool punt = false, tapped = false;
+      for (const SubEntry* e : match_scratch_) {
         if (e->flags & kSubPunt) {
           punt = true;
           break;
         }
+        if (e->flags & kSubRuleTap) tapped = true;
+      }
       if (punt) {
         LanePunt(le, /*revoke_permit=*/false);
         continue;
       }
+      if (tapped) EmitTap(le.publisher, le.frame);
       stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
       FanOut(le.publisher, le.qos, le.pid, topic, payload);
     }
@@ -879,18 +890,55 @@ class Host {
     match_scratch_.clear();
     groups_scratch_.clear();
     subs_.Match(topic, &match_scratch_, &groups_scratch_);
+    bool tapped = false;
     for (const SubEntry* e : match_scratch_) {
       if (e->flags & kSubPunt) {
         // a mixed/foreign shared group / persistent session /
         // non-native subscriber matched: Python must run the WHOLE
         // fan-out (it re-matches and delivers natively-served
-        // subscribers too)
+        // subscribers too — and its hook fold runs the rules, so no
+        // tap copy is emitted for punted frames)
         stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
         return false;
       }
+      if (e->flags & kSubRuleTap) tapped = true;
     }
+    if (tapped) EmitTap(id, f);
     FanOut(id, qos, pid, topic, payload);
     return true;
+  }
+
+  // Copy a natively-served frame up to the rule runtime (kSubRuleTap
+  // matched): delivery already happened in C++; Python only evaluates
+  // the rules against it, asynchronously. Copies BATCH into one event
+  // record per poll cycle ([u64 publisher][u32 len][frame]...) — a
+  // per-message record made Python's event decode the data-plane
+  // bottleneck (measured: 1.7M -> 0.3M msg/s under a FROM '#' rule).
+  void EmitTap(uint64_t publisher, const std::string& frame) {
+    stats_[kStTaps].fetch_add(1, std::memory_order_relaxed);
+    // flush BEFORE an append that would overflow the cap: the Python
+    // poll buffer is max_size_+64, and Poll silently drops any record
+    // larger than the caller's whole buffer — a lost batch would be
+    // hundreds of rule messages with no accounting. With this
+    // discipline a record never exceeds max(cap, 12 + max frame) + 13,
+    // which always fits (framer bounds frames at max_size_).
+    size_t cap = kTapFlushBytes;
+    if (cap > max_size_ / 2) cap = max_size_ / 2 + 1;
+    if (!tap_buf_.empty() && tap_buf_.size() + 12 + frame.size() > cap)
+      FlushTaps();
+    char hdr[12];
+    memcpy(hdr, &publisher, 8);
+    uint32_t len = static_cast<uint32_t>(frame.size());
+    memcpy(hdr + 8, &len, 4);
+    tap_buf_.append(hdr, 12);
+    tap_buf_ += frame;
+    if (tap_buf_.size() > cap) FlushTaps();
+  }
+
+  void FlushTaps() {
+    if (tap_buf_.empty()) return;
+    events_.push_back(EncodeRecord(6, 0, tap_buf_.data(), tap_buf_.size()));
+    tap_buf_.clear();
   }
 
   // Write one PUBLISH to `owner` (qos = min(pub, sub)); returns whether
@@ -1109,6 +1157,7 @@ class Host {
   // shape the device cannot see still force the Python fan-out
   SubTable punt_subs_;
   std::vector<const SubEntry*> punt_scratch_;
+  std::string tap_buf_;  // batched rule-tap copies awaiting one event
 };
 
 }  // namespace

@@ -32,6 +32,13 @@ struct SubEntry {
 
 constexpr uint8_t kSubPunt = 1;     // matched => forward frame to Python
 constexpr uint8_t kSubNoLocal = 2;  // MQTT5 no-local: skip the publisher
+// Rule tap (round 5): a rule-engine FROM filter compiled into the
+// table as a NON-delivering entry. A matched tap neither punts nor
+// receives the message — the frame is COPIED up to Python's rule
+// runtime asynchronously while native fan-out proceeds, removing the
+// broad-rule permit cliff (one FROM '#' rule used to de-permit the
+// whole fast path).
+constexpr uint8_t kSubRuleTap = 4;
 
 // A $share group on one filter, natively served: the Python server
 // installs one of these ONLY when every member is a fast native

@@ -228,6 +228,14 @@ class RuleEngine:
 
     # -- the publish path ----------------------------------------------------
 
+    def publish_filters(self) -> list[str]:
+        """Every live FROM topic filter (enabled and disabled rules
+        alike — enablement is re-checked at fire time). The native
+        server mirrors these into the C++ table as rule-tap entries
+        (broker/native_server._sync_rule_taps)."""
+        with self._index_lock:
+            return list(self._filter_rules.keys())
+
     def watches_message_events(self) -> bool:
         """True while any enabled rule consumes message-plane events
         ($events/message_delivered / _acked / _dropped). Those

@@ -318,10 +318,23 @@ def test_shared_strategy_change_moves_groups_off_native():
     server.stop()
 
 
-def test_rule_topics_never_earn_permits_and_rule_creation_flushes():
-    """Rules must see EVERY matching message: a ruled topic never goes
-    native, and creating a rule mid-stream flushes already-granted
-    permits (rules/engine.py on_topology_change)."""
+async def _wait_hits(hits, n, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if len(hits) >= n:
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_ruled_topics_stay_fast_via_taps_and_rules_see_everything():
+    """Round-5 contract (VERDICT r4 #5): rules must see EVERY matching
+    message WITHOUT de-permitting the fast path. Rule FROM filters
+    mirror into the C++ table as non-delivering tap entries; a ruled
+    topic still earns its permit, deliveries run natively, and every
+    fast-path message is copied to the rule runtime (taps counter).
+    Creating a rule mid-stream flushes permits AND installs its tap
+    before re-grant, so no message is missed across the transition."""
     app = BrokerApp()
     hits = []
     app.rules.register_action("sink", lambda cols, a: hits.append(cols))
@@ -337,31 +350,45 @@ def test_rule_topics_never_earn_permits_and_rule_creation_flushes():
         await sub.subscribe("free/+", qos=0)
         pub = MqttClient(port=server.port, clientid="qp")
         await pub.connect()
-        # ruled topic: always slow, rule fires every time
-        for i in range(3):
+        # ruled topic: first publish slow (earns permit), then native —
+        # and the rule fires for EVERY message either way
+        for i in range(5):
             await pub.publish("ruled/t", b"x", qos=0)
             await sub.recv(timeout=5)
             await _settle(0.2)
-        assert len(hits) == 3, hits
-        assert server.fast_stats()["fast_in"] == 0
-        # un-ruled topic goes fast...
+        assert await _wait_hits(hits, 5), len(hits)
+        assert await _wait_fast(server, "fast_in", 1)   # went native
+        assert await _wait_fast(server, "taps", 1)      # and was tapped
+        # a rule created mid-stream over an already-fast topic installs
+        # its tap before the permit flush's re-grants: no missed message
         await pub.publish("free/t", b"f0", qos=0)
         await sub.recv(timeout=5)
         await _settle()
         await pub.publish("free/t", b"f1", qos=0)
         await sub.recv(timeout=5)
-        assert await _wait_fast(server, "fast_in", 1)
-        # ...until a rule over it appears: the permit flush forces the
-        # next message back through Python, where the new rule fires
         app.rules.create_rule("r-live", 'SELECT topic FROM "free/#"',
                               [{"function": "sink", "args": {}}])
         n_before = len(hits)
         await _settle(0.3)
-        await pub.publish("free/t", b"f2", qos=0)
-        m = await sub.recv(timeout=5)
-        assert m.payload == b"f2"
+        for i in range(3):
+            await pub.publish("free/t", b"f%d" % (2 + i), qos=0)
+            await sub.recv(timeout=5)
+            await _settle(0.2)
+        assert await _wait_hits(hits, n_before + 3), \
+            (len(hits), n_before)
+        # deleting every rule removes the taps; the plane stays fast
+        app.rules.delete_rule("r-pre")
+        app.rules.delete_rule("r-live")
         await _settle(0.3)
-        assert len(hits) == n_before + 1, "new rule missed a fast message"
+        taps_before = server.fast_stats()["taps"]
+        await pub.publish("ruled/t", b"y", qos=0)
+        await sub.recv(timeout=5)
+        await _settle()
+        await pub.publish("ruled/t", b"z", qos=0)
+        await sub.recv(timeout=5)
+        await _settle(0.2)
+        assert server.fast_stats()["taps"] == taps_before
+        assert server.tap_dropped == 0
         await sub.close(); await pub.close()
 
     run(main())
@@ -1121,6 +1148,44 @@ def test_max_qos_cap_enforced_on_fast_path():
                                   packet_id=7, properties={}))
         pkt = await pub._expect(P.DISCONNECT, 5)
         assert pkt.reason_code == P.RC_QOS_NOT_SUPPORTED, hex(pkt.reason_code)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_lane_ruled_and_subscribed_filter_delivers_once():
+    """Round-5 review finding: a filter that is BOTH subscribed and a
+    rule FROM filter appears in the lane response's matched and aux
+    lists — without dedup the C++ side delivered the message twice.
+    Exactly-once delivery + the rule still firing is the contract."""
+    app = _lane_app()
+    hits = []
+    app.rules.register_action("sink", lambda cols, a: hits.append(cols))
+    app.rules.create_rule("same", 'SELECT topic FROM "sr/#"',
+                          [{"function": "sink", "args": {}}])
+    server = NativeBrokerServer(port=0, app=app, device_lane="on")
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="srs")
+        await sub.connect()
+        await sub.subscribe("sr/#", qos=0)      # same filter as the rule
+        pub = MqttClient(port=server.port, clientid="srp")
+        await pub.connect()
+        await pub.publish("sr/t", b"w", qos=0)  # slow path, earns permit
+        await sub.recv(timeout=20)
+        await _settle(0.5)
+        for i in range(6):
+            await pub.publish("sr/t", f"m{i}".encode(), qos=0)
+            m = await sub.recv(timeout=20)
+            assert m.payload == f"m{i}".encode()
+            await asyncio.sleep(0.15)
+        assert await _wait_fast(server, "lane_out", 1)
+        # exactly once: no second copy of any payload is queued
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.5)
+        assert await _wait_hits(hits, 7), len(hits)   # rule saw them all
         await sub.close(); await pub.close()
 
     run(main())

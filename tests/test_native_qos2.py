@@ -1,0 +1,234 @@
+"""Native (C++) QoS2 fast path — round-5 stretch: exactly-once PUBLISH
+handling below the GIL.
+
+Reference semantics (emqx_session.erl:379-399 publish_in /
+:478-492 pubrel_in; emqx_channel PUBREC/PUBREL/PUBCOMP exchange):
+publisher-side dedup keys on the packet id while it awaits PUBREL;
+subscriber-side delivery holds an inflight slot across
+PUBLISH→PUBREC→PUBREL→PUBCOMP. The native plane owns a packet id's
+exactly-once state iff the id is in ITS awaiting-rel set (publisher
+side) or >= 32768 (broker-allocated delivery ids); everything else
+forwards to the Python session, so the two planes can never
+double-publish one id.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp            # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer  # noqa: E402
+from emqx_tpu.mqtt import packet as P         # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient   # noqa: E402
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _settle(seconds=0.4):
+    await asyncio.sleep(seconds)
+
+
+async def _wait_stat(server, key, least=1, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if server.fast_stats()[key] >= least:
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_qos2_end_to_end_native():
+    """After the permit lands, a QoS2 publish runs the full
+    PUBLISH→PUBREC→PUBREL→PUBCOMP exchange in C++ (fast_in advances)
+    and the subscriber receives exactly once at qos2 with a native
+    (>=32768) packet id."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="q2s")
+        await sub.connect()
+        await sub.subscribe("q2/+", qos=2)
+        pub = MqttClient(port=server.port, clientid="q2p")
+        await pub.connect()
+        for i in range(5):
+            await pub.publish("q2/t", f"m{i}".encode(), qos=2)
+            m = await sub.recv(timeout=10)
+            assert m.payload == f"m{i}".encode()
+            assert m.qos == 2
+            await _settle(0.25)
+        assert await _wait_stat(server, "fast_in", 1)
+        # exactly once: nothing extra queued
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.5)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_qos2_dup_retransmit_is_deduped_natively():
+    """A retransmitted PUBLISH (same pid, DUP set) while the first copy
+    awaits PUBREL must NOT deliver again — the C++ awaiting-rel set is
+    the dedup [MQTT-4.3.3]. The broker re-answers PUBREC; PUBREL then
+    completes the exchange."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="dds")
+        await sub.connect()
+        await sub.subscribe("dd2/t", qos=2)
+        pub = MqttClient(port=server.port, clientid="ddp", auto_ack=False)
+        await pub.connect()
+        # earn the permit with a normal exchange
+        await pub.publish("dd2/t", b"warm", qos=2)
+        await sub.recv(timeout=10)
+        await _settle(0.5)
+        # manual exchange: PUBLISH, retransmit with DUP, then PUBREL
+        pid = 77
+        await pub._send(P.Publish(topic="dd2/t", payload=b"once", qos=2,
+                                  packet_id=pid, properties={}))
+        rec1 = await pub._expect(P.PUBREC, 10)
+        assert rec1.packet_id == pid
+        await pub._send(P.Publish(topic="dd2/t", payload=b"once", qos=2,
+                                  packet_id=pid, dup=True, properties={}))
+        rec2 = await pub._expect(P.PUBREC, 10)
+        assert rec2.packet_id == pid
+        await pub._send(P.PubRel(packet_id=pid))
+        comp = await pub._expect(P.PUBCOMP, 10)
+        assert comp.packet_id == pid
+        # exactly one delivery despite two transmissions
+        m = await sub.recv(timeout=10)
+        assert m.payload == b"once"
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.5)
+        # pid is released after PUBCOMP: reuse is a fresh publish
+        await pub._send(P.Publish(topic="dd2/t", payload=b"again", qos=2,
+                                  packet_id=pid, properties={}))
+        await pub._expect(P.PUBREC, 10)
+        await pub._send(P.PubRel(packet_id=pid))
+        await pub._expect(P.PUBCOMP, 10)
+        assert (await sub.recv(timeout=10)).payload == b"again"
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_qos2_mixed_planes_share_pid_space_safely():
+    """A publisher can interleave native (permitted) and Python
+    (unpermitted: here a punt-marked topic) QoS2 publishes using
+    arbitrary client pids: each plane completes only the exchanges it
+    owns, nothing is lost, and nothing double-delivers."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        fastsub = MqttClient(port=server.port, clientid="mps")
+        await fastsub.connect()
+        await fastsub.subscribe("mp/fast", qos=2)
+        # a persistent-session subscriber makes mp/slow punt-marked
+        slowsub = MqttClient(port=server.port, clientid="mp-ps",
+                             clean_start=False, proto_ver=5,
+                             properties={"Session-Expiry-Interval": 60})
+        await slowsub.connect()
+        await slowsub.subscribe("mp/slow", qos=2)
+        pub = MqttClient(port=server.port, clientid="mpp")
+        await pub.connect()
+        await pub.publish("mp/fast", b"w", qos=2)   # earn the permit
+        await fastsub.recv(timeout=10)
+        await _settle(0.5)
+        for i in range(4):
+            await pub.publish("mp/fast", f"f{i}".encode(), qos=2)
+            await pub.publish("mp/slow", f"s{i}".encode(), qos=2)
+        fgot = sorted([(await fastsub.recv(timeout=10)).payload
+                       for _ in range(4)])
+        sgot = sorted([(await slowsub.recv(timeout=10)).payload
+                       for _ in range(4)])
+        assert fgot == [b"f0", b"f1", b"f2", b"f3"], fgot
+        assert sgot == [b"s0", b"s1", b"s2", b"s3"], sgot
+        for s in (fastsub, slowsub):
+            with pytest.raises(asyncio.TimeoutError):
+                await s.recv(timeout=0.4)
+        await fastsub.close(); await slowsub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_qos2_subscriber_ack_phases_native():
+    """Broker→subscriber QoS2: the delivery pid is native (>=32768),
+    the broker answers the subscriber's PUBREC with PUBREL and frees
+    the slot on PUBCOMP — all in C++ (native_acks advances while the
+    Python session's inflight stays untouched)."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="aps",
+                         auto_ack=False)
+        await sub.connect()
+        await sub.subscribe("ap/t", qos=2)
+        pub = MqttClient(port=server.port, clientid="app")
+        await pub.connect()
+        await pub.publish("ap/t", b"w", qos=2)
+        m0 = await sub.recv(timeout=10)
+        # manual subscriber-side exchange for the warm message
+        if m0.qos == 2:
+            await sub._send(P.PubRec(packet_id=m0.packet_id))
+            rel = await sub._expect(P.PUBREL, 10)
+            await sub._send(P.PubComp(packet_id=rel.packet_id))
+        await _settle(0.5)
+        await pub.publish("ap/t", b"native", qos=2)
+        m = await sub.recv(timeout=10)
+        assert m.payload == b"native" and m.qos == 2
+        assert m.packet_id >= 32768, m.packet_id
+        await sub._send(P.PubRec(packet_id=m.packet_id))
+        rel = await sub._expect(P.PUBREL, 10)
+        assert rel.packet_id == m.packet_id
+        await sub._send(P.PubComp(packet_id=rel.packet_id))
+        await _settle(0.3)
+        assert server.fast_stats()["native_acks"] >= 1
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_qos2_downgrade_to_subscriber_max():
+    """min(publish qos, subscription qos): a qos1 subscriber of a
+    native qos2 publish gets qos1 with a native pid; a qos0 subscriber
+    gets qos0."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        s1 = MqttClient(port=server.port, clientid="dg1")
+        await s1.connect()
+        await s1.subscribe("dg/t", qos=1)
+        s0 = MqttClient(port=server.port, clientid="dg0")
+        await s0.connect()
+        await s0.subscribe("dg/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="dgp")
+        await pub.connect()
+        for i in range(3):
+            await pub.publish("dg/t", f"m{i}".encode(), qos=2)
+            a = await s1.recv(timeout=10)
+            b = await s0.recv(timeout=10)
+            assert a.qos == 1 and a.payload == f"m{i}".encode()
+            assert b.qos == 0 and b.payload == f"m{i}".encode()
+            await _settle(0.2)
+        assert await _wait_stat(server, "fast_in", 1)
+        await s1.close(); await s0.close(); await pub.close()
+
+    run(main())
+    server.stop()

@@ -364,11 +364,77 @@ def sec_kernel() -> None:
 # section: tenm (BASELINE config 3 — 10M subscriptions)
 # ---------------------------------------------------------------------------
 
+def _tenm_cache_dir(n: int, n_shards: int, B: int) -> str:
+    import tempfile
+
+    root = os.environ.get("BENCH_TENM_CACHE_DIR",
+                          os.path.join(tempfile.gettempdir(),
+                                       "emqx_bench_tenm"))
+    return os.path.join(root, f"n{n}_s{n_shards}_b{B}_v1")
+
+
+_TENM_ARRAYS = ("ht_parent", "ht_word", "ht_child", "plus_child",
+                "hash_fid", "node_fid", "rowmap", "pool",
+                "tok", "lens", "sysf")
+
+
+def _tenm_save_cache(cache: str, index, model, tok, lens, sysf) -> None:
+    """Persist the host-built trie/pool arrays + the tokenized probe
+    batch as individual .npy files (np.savez would defeat mmap). The
+    meta file lands LAST so a killed writer never fakes a valid cache."""
+    tmp = cache + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    arrays = index.ensure()
+    vals = dict(
+        ht_parent=arrays.ht_parent, ht_word=arrays.ht_word,
+        ht_child=arrays.ht_child, plus_child=arrays.plus_child,
+        hash_fid=arrays.hash_fid, node_fid=arrays.node_fid,
+        rowmap=model._rowmap_host, pool=model._pool_host,
+        tok=tok, lens=lens, sysf=sysf)
+    for name in _TENM_ARRAYS:
+        np.save(os.path.join(tmp, f"{name}.npy"), vals[name])
+    live = sum(f is not None for f in index.filters)
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump({"n_nodes": arrays.n_nodes,
+                   "n_filters": arrays.n_filters,
+                   "max_probes": arrays.max_probes,
+                   "live": live}, f)
+    if os.path.isdir(cache):
+        import shutil
+        shutil.rmtree(cache, ignore_errors=True)
+    os.replace(tmp, cache)
+
+
+def _tenm_load_cache(cache: str):
+    """mmap-load a previously built 10M index: the device upload streams
+    straight out of the page cache instead of re-running the ~6-minute
+    host build (VERDICT r5 next #1: the 800s section deadline must buy
+    measurement, not rebuild)."""
+    with open(os.path.join(cache, "meta.json")) as f:
+        meta = json.load(f)
+    arrs = {name: np.load(os.path.join(cache, f"{name}.npy"),
+                          mmap_mode="r")
+            for name in _TENM_ARRAYS}
+    from emqx_tpu.router.index import TrieIndexArrays
+
+    arrays = TrieIndexArrays(
+        ht_parent=arrs["ht_parent"], ht_word=arrs["ht_word"],
+        ht_child=arrs["ht_child"], plus_child=arrs["plus_child"],
+        hash_fid=arrs["hash_fid"], node_fid=arrs["node_fid"],
+        n_nodes=meta["n_nodes"], n_filters=meta["n_filters"],
+        max_probes=meta["max_probes"])
+    return meta, arrays, arrs
+
+
 def sec_tenm() -> None:
     """BASELINE config 3 / the north star's 10M-subscription point
     (VERDICT r3 #2: the 10M run must live in a driver artifact, not a
     commit message). Cold build + device upload + windowed kernel
     throughput + sync p99 at 10M mixed-wildcard filters.
+
+    The host-side build serializes to disk on first success and
+    mmap-loads on every later attempt (~378s → seconds), so a flaky
+    tunnel window that only opens mid-run still yields the TPU number.
 
     Skipped on the CPU fallback (a 10M CPU kernel run would blow its
     deadline and prove nothing about the device)."""
@@ -388,25 +454,56 @@ def sec_tenm() -> None:
     n_shards = int(os.environ.get("BENCH_SHARDS", 8192))
     rng = np.random.default_rng(3)
 
+    from emqx_tpu.models.router_model import RouterModel
+    from emqx_tpu.ops import trie_match as tm
+    from emqx_tpu.router.index import TrieIndex
+
+    cache = _tenm_cache_dir(n, n_shards, B)
+    cached = os.path.exists(os.path.join(cache, "meta.json"))
     t0 = time.time()
-    index, model, live = build_model(n, rng, n_shards)
-    build_s = time.time() - t0
+    if cached:
+        meta, arrays, arrs = _tenm_load_cache(cache)
+        trie_dev = tm.device_trie(arrays)
+        import jax.numpy as jnp
+        rowmap_dev = jnp.asarray(arrs["rowmap"])
+        pool_dev = jnp.asarray(arrs["pool"])
+        batch = tuple(jax.device_put(np.asarray(arrs[k]))
+                      for k in ("tok", "lens", "sysf"))
+        # a bare model supplies the jitted step (same K/M/ret_cap/
+        # max_probes statics as build_model's)
+        step = RouterModel(TrieIndex(max_levels=8),
+                           n_sub_slots=n_shards, K=32, M=128)._step
+        n_live = meta["live"]
+        build_s = time.time() - t0
+        log(f"10M: mmap-loaded {n_live} cached filters in {build_s:.0f}s "
+            f"({cache})")
+    else:
+        index, model, live = build_model(n, rng, n_shards)
+        topics = make_topics(live, rng, B, max(1000, n // 2))
+        tok, lens, sysf, _ = index.tokenize(topics)
+        batch = tuple(jax.device_put(x) for x in (tok, lens, sysf))
+        trie_dev = model._trie_dev
+        rowmap_dev, pool_dev = model._rowmap_dev, model._pool_dev
+        step = model._step
+        n_live = len(index.filters)
+        build_s = time.time() - t0
+        try:
+            t1 = time.time()
+            _tenm_save_cache(cache, index, model, tok, lens, sysf)
+            log(f"10M: cached host build to {cache} "
+                f"({time.time()-t1:.0f}s)")
+        except OSError as e:       # disk-full etc: cache is optional
+            log(f"10M: cache write failed ({e}); continuing uncached")
     import jax.tree_util as jtu
-    hbm_bytes = (int(model._pool_dev.nbytes) + int(model._rowmap_dev.nbytes)
-                 + sum(int(x.nbytes)
-                       for x in jtu.tree_leaves(model._trie_dev)))
-    log(f"10M: built+loaded+uploaded {len(index.filters)} filters in "
+    hbm_bytes = (int(pool_dev.nbytes) + int(rowmap_dev.nbytes)
+                 + sum(int(x.nbytes) for x in jtu.tree_leaves(trie_dev)))
+    log(f"10M: built+loaded+uploaded {n_live} filters in "
         f"{build_s:.0f}s, device bytes={hbm_bytes / (1 << 30):.2f} GiB")
     put("tenm", tenm_build_s=round(build_s, 1),
+        tenm_index_cached=cached,
         tenm_device_gib=round(hbm_bytes / (1 << 30), 2))
-
-    topics = make_topics(live, rng, B, max(1000, n // 2))
-    tok, lens, sysf, _ = index.tokenize(topics)
-    batch = tuple(jax.device_put(x) for x in (tok, lens, sysf))
-
-    step = model._step
     t0 = time.time()
-    out = step(model._trie_dev, model._rowmap_dev, model._pool_dev, *batch)
+    out = step(trie_dev, rowmap_dev, pool_dev, *batch)
     jax.block_until_ready(out)
     log(f"10M: compile+first step {time.time() - t0:.1f}s")
 
@@ -414,14 +511,12 @@ def sec_tenm() -> None:
     for _ in range(5):
         t0 = time.time()
         jax.block_until_ready(
-            step(model._trie_dev, model._rowmap_dev, model._pool_dev,
-                 *batch))
+            step(trie_dev, rowmap_dev, pool_dev, *batch))
         lat.append(time.time() - t0)
     window_n = int(os.environ.get("BENCH_WINDOW", 8))
     tps, _ = windowed_tps(
         step,
-        lambda i: (model._trie_dev, model._rowmap_dev, model._pool_dev,
-                   *batch),
+        lambda i: (trie_dev, rowmap_dev, pool_dev, *batch),
         iters, window_n, B)
     p99 = float(np.percentile(np.array(lat) * 1e3, 99))
     log(f"10M: {tps:,.0f} topics/sec (window={window_n}), sync p99 "
@@ -808,7 +903,13 @@ def sec_host() -> None:
     put("host", e2e_host_before_msgs_per_sec=round(before))
 
     # -- after: C++ epoll host + native fast path + C++ loadgen -------------
-    server = NativeBrokerServer(port=0, app=BrokerApp())
+    # mqtt.max_inflight is a zone knob (emqx_schema default 32): the
+    # reference's 1M msg/s runs tune it up, and the windowed qos1/2
+    # sweep measures the broker, not a 16-slot default window — so the
+    # bench app raises it (the native/python planes split this budget
+    # dynamically per ack cycle, see native_server._on_ack_batch)
+    server = NativeBrokerServer(port=0, app=BrokerApp(),
+                                session_opts={"max_inflight": 1024})
     server.start()
     try:
         blast = native.loadgen_run(
@@ -833,26 +934,67 @@ def sec_host() -> None:
             e2e_host_p50_ms=round(lat["p50_ns"] / 1e6, 3),
             e2e_host_p99_ms=round(lat["p99_ns"] / 1e6, 3))
 
-        # qos1 window sweep (VERDICT r4 #8): at a fixed service rate the
-        # p99 is dominated by Little's-law queueing (window / rate) —
-        # the 4096-window number measures the queue the BENCH chose,
-        # not the broker. Report the low-window points (256/512, the
-        # ≤2ms budget) and 4096 (round-comparability with r04).
-        for win in (256, 512, 4096):
+        # qos1 window sweep (VERDICT r4 #8 / r5 next #10): at a fixed
+        # service rate the p99 is dominated by Little's-law queueing
+        # (window / rate). Every point lands suffixed; the UNSUFFIXED
+        # headline is the best rate among points meeting the 2ms p99
+        # budget (the VERDICT #10 acceptance shape) — or, when no point
+        # qualifies (e.g. a starved CI box), the max-rate point with
+        # its honest p99.
+        best = None          # (rate, p99_ms) best under the 2ms budget
+        peak = None          # max-rate fallback
+        for win in (256, 512, 1024, 2048, 4096):
             q1 = native.loadgen_run(
                 "127.0.0.1", server.port, n_subs=8, n_pubs=8,
                 msgs_per_pub=n_msg_blast // 2, qos=1, payload_len=16,
                 window=win)
             q1_wall = q1["wall_ns"] / 1e9
             q1_rate = q1["received"] / max(q1_wall, 1e-9)
+            q1_p99 = q1["p99_ns"] / 1e6
             log(f"host plane qos1 (windowed {win}): {q1_rate:,.0f} msg/s "
-                f"acks={q1['acks']} p99={q1['p99_ns'] / 1e6:.2f}ms")
-            suffix = "" if win == 4096 else f"_w{win}"
+                f"acks={q1['acks']} p99={q1_p99:.2f}ms")
+            if q1_p99 <= 2.0 and (best is None or q1_rate > best[0]):
+                best = (q1_rate, q1_p99)
+            if peak is None or q1_rate > peak[0]:
+                peak = (q1_rate, q1_p99)
+            # headline keys ride EVERY flush (running best-so-far): a
+            # deadline kill mid-sweep must still leave a headline in
+            # the artifact, not just suffixed points
+            head = best or peak
             put("host", **{
-                f"e2e_host_qos1_msgs_per_sec{suffix}": round(q1_rate),
-                f"e2e_host_qos1_p99_ms{suffix}":
-                    round(q1["p99_ns"] / 1e6, 3)})
-        log(f"fast stats: {server.fast_stats()}")
+                f"e2e_host_qos1_msgs_per_sec_w{win}": round(q1_rate),
+                f"e2e_host_qos1_p99_ms_w{win}": round(q1_p99, 3),
+                "e2e_host_qos1_msgs_per_sec": round(head[0]),
+                "e2e_host_qos1_p99_ms": round(head[1], 3),
+                "e2e_host_qos1_within_p99_budget": bool(best)})
+        head = best or peak
+        log(f"host plane qos1 headline: {head[0]:,.0f} msg/s "
+            f"p99={head[1]:.2f}ms"
+            + ("" if best else "  (NO point met the 2ms budget)"))
+
+        # qos2 e2e (round 6): the native exactly-once plane. Prior
+        # rounds ran qos2 entirely in Python (~5k msg/s, VERDICT r5
+        # missing #2); the four-packet exchange now lives in C++
+        # (host.cc awaiting-rel bitmap + PUBREC/PUBREL/PUBCOMP), so
+        # qos2_fast_in must move and the rate must sit well above the
+        # Python plane's ceiling.
+        q2 = native.loadgen_run(
+            "127.0.0.1", server.port, n_subs=8, n_pubs=8,
+            msgs_per_pub=n_msg_blast // 4, qos=2, payload_len=16,
+            window=1024)
+        q2_wall = q2["wall_ns"] / 1e9
+        q2_rate = q2["received"] / max(q2_wall, 1e-9)
+        st = server.fast_stats()
+        log(f"host plane qos2 (windowed 1024): {q2_rate:,.0f} msg/s "
+            f"p99={q2['p99_ns'] / 1e6:.2f}ms "
+            f"qos2_fast_in={st['qos2_in']} qos2_rel={st['qos2_rel']} "
+            f"({q2_rate / 5311:.0f}x the r05 python-only qos2 rate)")
+        put("host",
+            e2e_host_qos2_msgs_per_sec=round(q2_rate),
+            e2e_host_qos2_p99_ms=round(q2["p99_ns"] / 1e6, 3),
+            qos2_fast_in=st["qos2_in"],
+            qos2_rel_native=st["qos2_rel"])
+        log(f"fast stats: {st}")
     finally:
         server.stop()
 
@@ -1322,7 +1464,15 @@ def supervise() -> None:
         "probe_log": probe["log"][-4:],
         "sections": section_status,
     }
-    tunnel_wedged = False
+    # Per-section re-probe (VERDICT r5 next #1): the r05 run proved a
+    # tunnel can wedge and recover within one bench — a single up-front
+    # probe (or a permanent wedged flag) turns one bad minute into zero
+    # TPU numbers. A device section re-probes right before launch ONLY
+    # when the previous device section failed or timed out (a wedge
+    # always manifests as one of those); a healthy run pays zero probe
+    # overhead, a wedge skips sections one at a time, and a recovered
+    # window still captures the later ones.
+    prev_device_bad = False
 
     i = 0
     while i < len(plan):
@@ -1334,10 +1484,14 @@ def supervise() -> None:
             section_status[name] = "skipped: budget exhausted"
             log(f"section {name}: skipped, {remaining:.0f}s of budget left")
             continue
-        if needs_device and tunnel_wedged:
-            section_status[name] = "skipped: tunnel wedged mid-run"
-            log(f"section {name}: skipped, tunnel wedged")
-            continue
+        if needs_device and prev_device_bad:
+            re = _probe_device(attempts=1, timeout_s=60, backoff_s=0)
+            if not re["ok"]:
+                section_status[name] = "skipped: device probe failed"
+                log(f"section {name}: skipped, device probe failed "
+                    f"(next device section will re-probe)")
+                continue
+            prev_device_bad = False
         timeout = min(deadline, remaining - 60)
         env = {**os.environ, "BENCH_SECTION": name,
                "BENCH_PARTIAL_DIR": partial_dir}
@@ -1359,20 +1513,50 @@ def supervise() -> None:
                 section_status[name] = f"ok ({time.time()-t0:.0f}s)"
             else:
                 section_status[name] = f"failed rc={rc}"
+                if needs_device:
+                    prev_device_bad = True
         except sp.TimeoutExpired:
             section_status[name] = f"timeout after {timeout:.0f}s"
             log(f"section {child_name}: killed at {timeout:.0f}s deadline")
             if needs_device:
-                # quick re-probe: distinguish a slow section from a
-                # wedged tunnel before burning remaining device budget
-                re = _probe_device(attempts=1, timeout_s=60, backoff_s=0)
-                if not re["ok"]:
-                    tunnel_wedged = True
-                    meta["tunnel_wedged_after"] = name
-                    log("tunnel wedged; remaining device sections skipped")
+                # the pre-launch probe of the NEXT device section will
+                # decide whether this was a slow section or a wedge —
+                # no permanent skip flag (a recovered tunnel window
+                # must still capture the remaining sections)
+                prev_device_bad = True
+                meta.setdefault("device_timeouts", []).append(name)
         # cumulative line lands on stdout after EVERY section — a later
         # wedge or driver kill still leaves this tail (VERDICT r4 #1a)
         _emit(_compose(partial_dir, meta))
+
+    # CPU plan (initial probe failed) + budget left → one late re-probe:
+    # a tunnel that wedged at minute 0 and recovered at minute 30 must
+    # still yield TPU numbers (the tenm section's disk cache makes the
+    # second attempt cheap even off a cold child)
+    if not device_ok and budget - (time.time() - t_start) > 300:
+        re = _probe_device(attempts=1, timeout_s=60, backoff_s=0)
+        if re["ok"]:
+            log("device recovered after CPU plan; capturing device "
+                "kernel/tenm in the remaining budget")
+            meta["late_probe_ok"] = True
+            for name, deadline in (("kernel", 800), ("tenm", 800)):
+                remaining = budget - (time.time() - t_start)
+                if remaining < 150:
+                    break
+                env = {**os.environ, "BENCH_SECTION": name,
+                       "BENCH_PARTIAL_DIR": partial_dir}
+                env.pop("JAX_PLATFORMS", None)
+                try:
+                    rc = sp.run([sys.executable, "-u",
+                                 os.path.abspath(__file__)], env=env,
+                                timeout=min(deadline,
+                                            remaining - 60)).returncode
+                    section_status[name] = (
+                        "ok (late window)" if rc == 0
+                        else f"failed rc={rc}")
+                except sp.TimeoutExpired:
+                    section_status[name] = "timeout (late window)"
+                _emit(_compose(partial_dir, meta))
 
     # device plan without a captured device kernel NUMBER → one labeled
     # CPU kernel rerun so the headline slot is never empty. The gate is

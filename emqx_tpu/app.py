@@ -614,6 +614,31 @@ class BrokerApp:
             legs, deliver_fn=self._shared_deliver_fn)
         return [[(p[0], p[2])] if p is not None else [] for p in picks]
 
+    def session_defaults(self) -> dict:
+        """Zone session knobs for new channels (emqx_schema mqtt.*):
+        servers pass these as ``session_opts`` so a configured
+        ``mqtt.max_inflight`` / ``max_awaiting_rel`` / queue policy
+        actually reaches the Session (previously only the per-client
+        Receive-Maximum clamp applied)."""
+        conf = getattr(self, "config", None)
+        if conf is None:
+            return {}
+        from emqx_tpu.session.mqueue import MQueueOpts
+
+        return {
+            "max_inflight": int(conf.get("mqtt.max_inflight")),
+            "max_awaiting_rel": int(conf.get("mqtt.max_awaiting_rel")),
+            "retry_interval_ms": int(
+                float(conf.get("mqtt.retry_interval")) * 1000),
+            "await_rel_timeout_ms": int(
+                float(conf.get("mqtt.await_rel_timeout")) * 1000),
+            "max_subscriptions": int(conf.get("mqtt.max_subscriptions")),
+            "upgrade_qos": bool(conf.get("mqtt.upgrade_qos")),
+            "mqueue_opts": MQueueOpts(
+                max_len=int(conf.get("mqtt.max_mqueue_len")),
+                store_qos0=bool(conf.get("mqtt.mqueue_store_qos0"))),
+        }
+
     # -- housekeeping (server timer) ----------------------------------------
 
     def add_ticker(self, fn) -> None:

@@ -50,6 +50,7 @@ class Connection:
             mountpoint=server.mountpoint,
             send=self._send_packets,
             publish_sink=pipeline.submit if pipeline is not None else None,
+            session_opts=getattr(server, "session_opts", None),
         )
         self.channel.conninfo.peername = f"{peer[0]}:{peer[1]}"
         self.metrics = getattr(server.app, "metrics", None)
@@ -231,6 +232,7 @@ class BrokerServer:
         ssl_handshake_timeout: Optional[float] = None,
         peer_cert_as_username: Optional[str] = None,   # "cn" | "dn"
         peer_cert_as_clientid: Optional[str] = None,
+        session_opts: Optional[dict] = None,
     ):
         if app is None and broker is None:
             from emqx_tpu.app import BrokerApp
@@ -239,6 +241,10 @@ class BrokerServer:
         self.app = app
         self.broker = broker or app.broker
         self.cm = cm or (app.cm if app else CM())
+        # zone session knobs (mqtt.max_inflight & co) reach every channel
+        if session_opts is None and app is not None:
+            session_opts = getattr(app, "session_defaults", dict)()
+        self.session_opts = dict(session_opts or {})
         self.host, self.port = host, port
         self.max_packet_size = max_packet_size
         self.max_connections = max_connections

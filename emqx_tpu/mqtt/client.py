@@ -96,7 +96,14 @@ class MqttClient:
             elif pkt.qos == 2:
                 await self._send(P.PubRec(packet_id=pkt.packet_id))
         elif pkt.type == P.PUBREL:
-            await self._send(P.PubComp(packet_id=pkt.packet_id))
+            if self.auto_ack:
+                await self._send(P.PubComp(packet_id=pkt.packet_id))
+            else:
+                # manual-ack mode: surface the PUBREL so tests can run
+                # the subscriber-side QoS2 exchange by hand (the old
+                # unconditional auto-PubComp swallowed it, making
+                # _expect(PUBREL) unreachable)
+                await self._incoming.put(pkt)
         elif pkt.type == P.PINGRESP:
             pass
         else:

@@ -128,6 +128,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_lane_backlog.argtypes = [ctypes.c_void_p]
     lib.emqx_host_set_max_qos.restype = ctypes.c_int
     lib.emqx_host_set_max_qos.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_set_inflight_cap.restype = ctypes.c_int
+    lib.emqx_host_set_inflight_cap.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
     lib.emqx_subtable_match_filter.restype = ctypes.c_long
     lib.emqx_subtable_match_filter.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
@@ -261,7 +264,7 @@ class NativeFramer:
 
 
 # event kinds from host.cc
-EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP = 1, 2, 3, 4, 6
+EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP, EV_ACKS = 1, 2, 3, 4, 6, 7
 
 def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
                 msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
@@ -387,7 +390,9 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "drops_backpressure", "drops_inflight", "native_acks",
               "shared_dispatch", "shared_no_member",
               "lane_in", "lane_out", "lane_punts", "lane_fallback",
-              "lane_stale", "taps")
+              "lane_stale", "taps",
+              "qos1_in", "qos2_in", "qos2_rel", "lane_topic_overflow",
+              "ack_batches")
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP = 1, 2, 4
@@ -482,6 +487,12 @@ class NativeHost:
         fast path so the channel can refuse them per spec."""
         self._lib.emqx_host_set_max_qos(self._h, int(max_qos))
 
+    def set_inflight_cap(self, conn: int, cap: int) -> None:
+        """Re-divide a conn's receive-maximum budget: set the native
+        plane's inflight cap (the Python session holds the rest; the
+        caller keeps the two caps summing to <= the budget)."""
+        self._lib.emqx_host_set_inflight_cap(self._h, conn, int(cap))
+
     def permits_flush(self) -> None:
         self._lib.emqx_host_permits_flush(self._h)
 
@@ -495,7 +506,14 @@ class NativeHost:
         that drives poll() — the server's housekeep does."""
         return self._lib.emqx_host_conn_idle_ms(self._h, conn)
 
+    # set True by an owner that must abandon the host (a wedged poll
+    # thread may still be inside emqx_host_poll): destroy becomes a
+    # no-op forever, including the gc-time __del__ path
+    leaked = False
+
     def destroy(self) -> None:
+        if self.leaked:
+            return
         if self._h:
             self._lib.emqx_host_destroy(self._h)
             self._h = None

@@ -9,7 +9,7 @@
 // Fast-path contract (enforced here, configured by the Python server):
 //   - a connection only fast-paths after Python enables it post-CONNACK
 //     (clean session, no mountpoint — broker/native_server.py);
-//   - a PUBLISH only fast-paths when qos<=1, retain=0, topic is a plain
+//   - a PUBLISH only fast-paths when qos<=2, retain=0, topic is a plain
 //     non-$ name, v5 property section is empty, AND Python has granted
 //     this (conn, topic) a *permit* — the authz-cache analogue: the
 //     first publish runs the full Python path (authorize, hooks, rules)
@@ -19,10 +19,20 @@
 //     session, non-native subscriber, subscription id) forwards the
 //     frame to Python verbatim — native fan-out only runs when it is
 //     provably complete;
-//   - native QoS1 deliveries allocate packet ids in [32768, 65535];
+//   - native QoS1/2 deliveries allocate packet ids in [32768, 65535];
 //     Python sessions stay in [1, 32767] (session/session.py), so a
-//     subscriber's PUBACK routes unambiguously: high pids are consumed
-//     here, low pids forwarded to the Python session.
+//     subscriber's PUBACK/PUBREC/PUBCOMP routes unambiguously: high
+//     pids are consumed here, low pids forwarded to the Python session;
+//   - publisher-side QoS2 exactly-once keys on the *awaiting-rel*
+//     bitmap (emqx_session.erl:379-399): the native plane owns a
+//     client packet id iff the id is in ITS awaiting-rel set, so a
+//     PUBREL routes to whichever plane accepted the PUBLISH and the
+//     two planes can never double-publish one id;
+//   - window accounting (pid allocation, inflight insert/ack-erase,
+//     window-full → pending-queue overflow) lives entirely here; the
+//     Python sessions see ONE batched ack record per poll cycle
+//     (kind 7, mirroring the rule-tap batching) instead of
+//     per-message round trips.
 //
 // This is the TPU-era answer to the BEAM's role in the reference
 // (SURVEY.md §2.4 "[NATIVE] BEAM VM schedulers/ports"): the reference
@@ -48,6 +58,9 @@
 //   kind 3 = CLOSED payload = reason string
 //   kind 4 = LANE   conn_id = lane seq, payload = topic (device match)
 //   kind 6 = TAP    payload = frame copy for the rule runtime
+//   kind 7 = ACKS   payload = one batched ack/window record per poll
+//                   cycle: [u32 n] + n x ([u64 conn][u32 acked]
+//                   [u32 rel][u32 inflight_now][u32 pending_now])
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -64,6 +77,7 @@
 #include <atomic>
 #include <cstdint>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -91,6 +105,50 @@ inline uint64_t NowMs() {
   return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
+// elevated-qos mqueue bound per subscriber (emqx_mqueue default
+// max_len 1000); overflow drops the NEW message (kStDropsInflight)
+constexpr size_t kMaxPending = 1000;
+// publisher-side qos2 awaiting-rel cap: past it, NEW packet ids take
+// the Python path, whose session enforces max_awaiting_rel quota
+// semantics (emqx_session.erl:379-399)
+constexpr uint32_t kMaxAwaitingRel = 8192;
+
+inline bool BitTest(const uint64_t* b, uint32_t i) {
+  return (b[i >> 6] >> (i & 63)) & 1;
+}
+inline void BitSet(uint64_t* b, uint32_t i) { b[i >> 6] |= 1ull << (i & 63); }
+inline void BitClr(uint64_t* b, uint32_t i) {
+  b[i >> 6] &= ~(1ull << (i & 63));
+}
+
+// Per-connection elevated-qos window state, allocated lazily on the
+// first QoS1/2 interaction so a million idle / qos0-only connections
+// pay nothing. Bitmaps replace the round-4 unordered_set bookkeeping:
+// pid allocation and ack-erase are test-and-set bit ops, the profiled
+// hash/alloc churn on the windowed QoS1 path (BENCH_r05's 641k cap).
+struct AckState {
+  // broker-allocated delivery pids, bit i = pid kNativePidBase + i;
+  // a qos2 delivery holds its bit across the whole
+  // PUBREC/PUBREL/PUBCOMP tail (no separate phase bitmap: nothing
+  // natively retries mid-exchange, so the slot hold IS the state)
+  uint64_t inflight[512] = {};   // allocated, awaiting PUBACK/PUBCOMP
+  uint32_t inflight_cnt = 0;
+  uint16_t next_pid = kNativePidBase;
+  // publisher-side qos2 exactly-once: client pid space, bit = pid
+  uint64_t awaiting_rel[1024] = {};
+  uint32_t awaiting_cnt = 0;
+  // deliveries awaiting an inflight slot — the mqueue analogue
+  // (emqx_mqueue.erl): serialized PUBLISH (qos header already final)
+  // with zeroed pid bytes + the pid offset to patch at dequeue
+  std::deque<std::pair<std::string, size_t>> pending;
+  // per-poll-cycle ack-record accumulators (flushed as ONE kind-7
+  // event per cycle — the rule-tap batching discipline applied to the
+  // ack plane)
+  uint32_t cyc_acked = 0;   // delivery slots freed (PUBACK + PUBCOMP)
+  uint32_t cyc_rel = 0;     // publisher PUBREL exchanges completed
+  bool cyc_dirty = false;   // queued on ack_dirty_ this cycle
+};
+
 struct Conn {
   int fd = -1;
   Framer framer;
@@ -100,24 +158,15 @@ struct Conn {
   // -- fast path ----------------------------------------------------------
   bool fast = false;        // Python enabled the PUBLISH fast path
   uint8_t proto_ver = 4;    // 4 = MQTT 3.1.1, 5 = MQTT 5
-  uint16_t next_pid = kNativePidBase;
   uint32_t max_inflight = 16384;
   bool dirty = false;       // has appended-but-unflushed outbuf bytes
   uint64_t last_rx_ms = 0;  // any inbound bytes (keepalive feed)
-  std::unordered_set<uint16_t> inflight;     // native qos1 pids awaiting ack
-  // qos1 deliveries awaiting an inflight slot — the mqueue analogue
-  // (emqx_mqueue.erl): each element is a serialized PUBLISH with its
-  // pid bytes zeroed + the pid offset to patch at dequeue
-  std::deque<std::pair<std::string, size_t>> pending_qos1;
+  std::unique_ptr<AckState> ack;             // elevated-qos window state
   std::unordered_set<std::string> permits;   // publisher-side topic grants
   std::vector<std::string> own_subs;         // filters owned by this conn
   // (group token, filter) shared memberships owned by this conn
   std::vector<std::pair<uint64_t, std::string>> own_shared;
 };
-
-// qos1 mqueue bound per subscriber (emqx_mqueue default max_len 1000);
-// overflow drops the NEW message, counted in kStDropsInflight
-constexpr size_t kMaxPendingQos1 = 1000;
 
 // Device-lane bounds: past the soft cap, NEW topics take the C++ walk
 // (correct, just not device-matched); topics with entries already in
@@ -140,7 +189,8 @@ constexpr size_t kTapFlushBytes = 192 * 1024;
 struct Op {
   enum Kind : uint8_t {
     kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush,
-    kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos
+    kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos,
+    kSetInflightCap
   };
   Kind kind;
   uint64_t owner = 0;
@@ -170,6 +220,12 @@ enum StatSlot {
   kStLaneFallback,     // lane soft-cap hits served by the C++ walk
   kStLaneStale,        // stale-head lane shutdowns (pump wedge trips)
   kStTaps,             // rule-tap frame copies forwarded to Python
+  kStQos1In,           // native qos1 PUBLISHes (subset of kStFastIn)
+  kStQos2In,           // native qos2 PUBLISHes (subset of kStFastIn)
+  kStQos2Rel,          // publisher PUBREL→PUBCOMP exchanges completed
+  kStLaneTopicOverflow,  // per-topic lane flood drops (was silently
+                         // folded into kStDropsBackpressure)
+  kStAckBatches,       // batched ack records emitted to Python
   kStatCount
 };
 
@@ -301,6 +357,7 @@ class Host {
       ApplyPending();
       if (!lane_pending_.empty()) LaneStaleScan();
       FlushTaps();
+      FlushAcks();
     }
     size_t written = 0;
     while (!events_.empty()) {
@@ -391,7 +448,8 @@ class Host {
           it->second.fast = true;
           it->second.proto_ver = op.proto_ver;
           if (op.max_inflight)
-            it->second.max_inflight = op.max_inflight;
+            it->second.max_inflight =
+                op.max_inflight < 0x7FFFu ? op.max_inflight : 0x7FFFu;
         }
         break;
       }
@@ -400,10 +458,24 @@ class Host {
         if (it != conns_.end()) {
           it->second.fast = false;
           it->second.permits.clear();
-          // orphaned native qos1 state would eat acks meant for the
+          // orphaned native window state would eat acks meant for the
           // Python session once the conn goes slow-only
-          it->second.inflight.clear();
-          it->second.pending_qos1.clear();
+          it->second.ack.reset();
+        }
+        break;
+      }
+      case Op::kSetInflightCap: {
+        // dynamic receive-window split: Python re-divides the client's
+        // receive-maximum budget between the planes per ack cycle; the
+        // caller guarantees native_cap + python_cap <= budget at every
+        // step, so the sum of occupancies can never exceed the budget
+        auto it = conns_.find(op.owner);
+        if (it != conns_.end()) {
+          it->second.max_inflight =
+              op.max_inflight < 0x7FFFu ? op.max_inflight : 0x7FFFu;
+          // a raised cap frees window slots: drain the pending queue
+          DrainPending(op.owner, it->second);
+          FlushDirty();
         }
         break;
       }
@@ -562,22 +634,28 @@ class Host {
   // match_scratch_/groups_scratch_ and have already ruled out punts.
   void FanOut(uint64_t publisher, uint8_t qos, uint16_t pid,
               std::string_view topic, std::string_view payload) {
-    if (qos == 1) {
-      // ack first: the reference PUBACKs as soon as
-      // emqx_broker:publish returns
+    if (qos) {
+      // ack first: the reference PUBACKs (or PUBRECs for qos2) as soon
+      // as emqx_broker:publish returns
       auto pit = conns_.find(publisher);
       if (pit != conns_.end()) {
-        char ack[4] = {0x40, 0x02, static_cast<char>(pid >> 8),
+        char ack[4] = {static_cast<char>(qos == 1 ? 0x40 : 0x50), 0x02,
+                       static_cast<char>(pid >> 8),
                        static_cast<char>(pid & 0xFF)};
         pit->second.outbuf.append(ack, 4);
         MarkDirty(publisher, pit->second);
       }
     }
     stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
-    // shared serialized frames per (proto, qos=0) — qos1 frames differ
-    // per target (unique pid), built in place
+    // shared serialized frames per proto: qos0 frames are reused
+    // verbatim; elevated-qos frames are built ONCE per publish with a
+    // zero pid, then appended and pid/qos-patched in place per target
+    // (the round-5 per-target BuildPublish rebuild was measurable on
+    // the windowed qos1 path)
     frame_v4_.clear();
     frame_v5_.clear();
+    frame_q_v4_.clear();
+    frame_q_v5_.clear();
     for (const SubEntry* e : match_scratch_) {
       if (e->flags & kSubRuleTap) continue;  // rule taps never deliver
       if ((e->flags & kSubNoLocal) && e->owner == publisher) continue;
@@ -636,12 +714,18 @@ class Host {
       if (it == lane_pending_.end()) continue;  // drained/stale already
       LaneEntry le = std::move(it->second);
       lane_pending_.erase(it);
-      LaneForget(le);
       std::string_view topic(le.frame.data() + le.topic_off, le.topic_len);
       std::string_view payload(le.frame.data() + le.payload_off,
                                le.frame.size() - le.payload_off);
+      // poison must be read BEFORE LaneForget: forgetting the LAST
+      // parked frame of a poisoned topic erases the poison, and the
+      // pre-fix order let exactly that frame deliver natively —
+      // overtaking the punted earlier frame still queued in Python's
+      // FIFO (same-topic reorder)
       key_scratch_.assign(topic.data(), topic.size());
-      if (lane_poisoned_.count(key_scratch_)) {
+      bool poisoned = lane_poisoned_.count(key_scratch_) != 0;
+      LaneForget(le);
+      if (poisoned) {
         // an earlier same-topic frame was nondeterministically punted;
         // this one must follow it through Python, not overtake it
         LanePunt(le, /*revoke_permit=*/true);
@@ -688,6 +772,8 @@ class Host {
       }
       if (tapped) EmitTap(le.publisher, le.frame);
       stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
+      if (le.qos == 1)
+        stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
       FanOut(le.publisher, le.qos, le.pid, topic, payload);
     }
     FlushDirty();
@@ -806,10 +892,13 @@ class Host {
     uint8_t h = static_cast<uint8_t>(f[0]);
     uint8_t type = h >> 4;
     if (type == 4) return TryFastPuback(id, c, f);
-    if (type != 3) return false;  // only PUBLISH / PUBACK fast-path
+    if (type == 5) return TryFastPubrec(id, c, f);
+    if (type == 6) return TryFastPubrel(id, c, f);
+    if (type == 7) return TryFastPubcomp(id, c, f);
+    if (type != 3) return false;  // PUBLISH + the four ack types only
     uint8_t qos = (h >> 1) & 3;
     bool retain = h & 1;
-    if (qos > 1 || retain) return false;  // QoS2 / retained: Python path
+    if (qos > 2 || retain) return false;  // malformed qos / retained
     if (qos > max_qos_allowed_) return false;  // over-cap publish must
     // reach the channel, which answers with DISCONNECT 0x9B
     // ([MQTT-3.2.2-11]) instead of a native ack
@@ -828,7 +917,7 @@ class Host {
     for (char ch : topic)
       if (ch == '+' || ch == '#' || ch == '\0') return false;  // invalid name
     uint16_t pid = 0;
-    if (qos == 1) {
+    if (qos >= 1) {
       if (pos + 2 > f.size()) return false;
       pid = (static_cast<uint8_t>(f[pos]) << 8) |
             static_cast<uint8_t>(f[pos + 1]);
@@ -841,10 +930,44 @@ class Host {
       pos++;
     }
     std::string_view payload(f.data() + pos, f.size() - pos);
+    if (qos == 2) {
+      if (c.ack && BitTest(c.ack->awaiting_rel, pid)) {
+        // retransmit of an exchange WE own (dup while awaiting PUBREL):
+        // re-answer PUBREC, no second delivery [MQTT-4.3.3]. Checked
+        // before the permit so a mid-exchange permit flush cannot hand
+        // the id to Python for a double publish.
+        char rec[4] = {0x50, 0x02, static_cast<char>(pid >> 8),
+                       static_cast<char>(pid & 0xFF)};
+        c.outbuf.append(rec, 4);
+        MarkDirty(id, c);
+        return true;
+      }
+      if (h & 0x08) {
+        // DUP retransmit of an exchange we do NOT own: the original
+        // ran on the Python plane (e.g. it earned this very permit),
+        // whose session holds the awaiting-rel state — fast-pathing it
+        // as a fresh publish would deliver a second copy. Forward, and
+        // the session re-answers PUBREC from its own dedup.
+        return false;
+      }
+    }
     key_scratch_.assign(topic.data(), topic.size());  // no per-msg alloc
     if (c.permits.find(key_scratch_) == c.permits.end())
       return false;  // unpermitted topic: full Python path (authz, rules)
-    if (lane_enabled_) {
+    if (lane_enabled_ && qos == 2) {
+      // qos2 never parks on the lane (its exchange state lives here);
+      // with same-topic frames already parked, a walk delivery would
+      // overtake them — poison the topic so the parked frames punt and
+      // everything for it serializes through the Python FIFO
+      auto tp = lane_topic_pending_.find(key_scratch_);
+      if (tp != lane_topic_pending_.end()) {
+        lane_poisoned_.insert(key_scratch_);
+        c.permits.erase(key_scratch_);
+        stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+        return false;
+      }
+      // no parked frames: fall through to the per-message walk below
+    } else if (lane_enabled_) {
       // device lane: park the frame, ship the topic to the batched
       // device matcher. A topic with entries already in flight MUST
       // stay on the lane (a walk here would overtake them); new topics
@@ -852,7 +975,11 @@ class Host {
       auto tp = lane_topic_pending_.find(key_scratch_);
       bool topic_in_flight = tp != lane_topic_pending_.end();
       if (topic_in_flight && tp->second >= kLaneTopicMax) {
-        stats_[kStDropsBackpressure].fetch_add(1,
+        // distinct counter (NOT folded into drops_backpressure):
+        // operators must be able to tell inbound per-topic lane
+        // overload from subscriber delivery backpressure; Python logs
+        // on every advance (native_server._merge_fast_metrics)
+        stats_[kStLaneTopicOverflow].fetch_add(1,
                                                std::memory_order_relaxed);
         return true;  // consumed: dropped under per-topic lane overload
       }
@@ -903,6 +1030,20 @@ class Host {
       }
       if (e->flags & kSubRuleTap) tapped = true;
     }
+    if (qos == 2) {
+      AckState& a = EnsureAck(c);
+      if (a.awaiting_cnt >= kMaxAwaitingRel)
+        return false;  // table full: Python enforces the quota answer
+      // record BEFORE the fan-out: the exchange is owned from the
+      // moment we decide to deliver (a dup racing the fan-out must
+      // dedup against it)
+      BitSet(a.awaiting_rel, pid);
+      a.awaiting_cnt++;
+      AckNote(id, a);
+      stats_[kStQos2In].fetch_add(1, std::memory_order_relaxed);
+    } else if (qos == 1) {
+      stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
+    }
     if (tapped) EmitTap(id, f);
     FanOut(id, qos, pid, topic, payload);
     return true;
@@ -941,8 +1082,21 @@ class Host {
     tap_buf_.clear();
   }
 
+  AckState& EnsureAck(Conn& c) {
+    if (!c.ack) c.ack = std::make_unique<AckState>();
+    return *c.ack;
+  }
+
+  // Queue the conn for this cycle's batched ack record.
+  void AckNote(uint64_t id, AckState& a) {
+    if (!a.cyc_dirty) {
+      a.cyc_dirty = true;
+      ack_dirty_.push_back(id);
+    }
+  }
+
   // Write one PUBLISH to `owner` (qos = min(pub, sub)); returns whether
-  // a delivery (or a qos1 queue admit) happened.
+  // a delivery (or an elevated-qos queue admit) happened.
   bool DeliverTo(uint64_t owner, const SubEntry& e, uint64_t publisher,
                  uint8_t qos, std::string_view topic,
                  std::string_view payload) {
@@ -962,76 +1116,211 @@ class Host {
       stats_[kStFastBytesOut].fetch_add(shared.size(),
                                         std::memory_order_relaxed);
     } else {
-      if (t.inflight.size() >= t.max_inflight) {
+      AckState& a = EnsureAck(t);
+      std::string& sq = t.proto_ver == 5 ? frame_q_v5_ : frame_q_v4_;
+      size_t& qoff = t.proto_ver == 5 ? qpid_off_v5_ : qpid_off_v4_;
+      if (sq.empty()) {
+        // built once per publish: qos1 header, zero pid; per-target
+        // the header qos bits and pid bytes are patched in place.
+        // pid offset = header(1) + varint + topic len field(2) + topic
+        BuildPublish(&sq, topic, payload, 1, 0, t.proto_ver == 5);
+        size_t var_len = 1;
+        while (static_cast<uint8_t>(sq[var_len]) & 0x80) var_len++;
+        qoff = var_len + 1 + 2 + topic.size();
+      }
+      if (a.inflight_cnt >= t.max_inflight) {
         // receive window full: queue (the mqueue), drop on overflow
-        if (t.pending_qos1.size() >= kMaxPendingQos1) {
+        if (a.pending.size() >= kMaxPending) {
           stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
           return false;
         }
-        pub_scratch_.clear();
-        // pid offset = header(1) + varint + topic length field(2) + topic
-        BuildPublish(&pub_scratch_, topic, payload, 1, 0,
-                     t.proto_ver == 5);
-        size_t var_len = 1;
-        while (static_cast<uint8_t>(pub_scratch_[var_len]) & 0x80)
-          var_len++;
-        size_t pid_off = var_len + 1 + 2 + topic.size();
-        t.pending_qos1.emplace_back(pub_scratch_, pid_off);
+        a.pending.emplace_back(sq, qoff);
+        a.pending.back().first[0] =
+            static_cast<char>(0x30 | (out_qos << 1));
+        AckNote(owner, a);
         return true;   // admitted; kStFastOut counts at dequeue
       }
-      uint16_t tp = NextPid(t);
-      pub_scratch_.clear();
-      BuildPublish(&pub_scratch_, topic, payload, 1, tp,
-                   t.proto_ver == 5);
-      t.outbuf += pub_scratch_;
-      stats_[kStFastBytesOut].fetch_add(pub_scratch_.size(),
+      uint16_t tp = NextPid(a);
+      size_t at = t.outbuf.size();
+      t.outbuf += sq;
+      t.outbuf[at] = static_cast<char>(0x30 | (out_qos << 1));
+      t.outbuf[at + qoff] = static_cast<char>(tp >> 8);
+      t.outbuf[at + qoff + 1] = static_cast<char>(tp & 0xFF);
+      stats_[kStFastBytesOut].fetch_add(sq.size(),
                                         std::memory_order_relaxed);
+      AckNote(owner, a);
     }
     stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
     MarkDirty(owner, t);
     return true;
   }
 
-  bool TryFastPuback(uint64_t id, Conn& c, const std::string& f) {
-    // PUBACK: [h=0x40][varint][pid u16][v5: rc, props...] — pids >=
-    // kNativePidBase belong to the native inflight set; lower pids are
-    // the Python session's and are forwarded
+  // [h][varint][pid u16][...]: the shared pid parse for the four ack
+  // packet types (the framer already validated the length varint)
+  static bool ParsePid(const std::string& f, uint16_t* pid) {
     size_t pos = 1;
     while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
     pos++;
     if (pos + 2 > f.size()) return false;
-    uint16_t pid = (static_cast<uint8_t>(f[pos]) << 8) |
-                   static_cast<uint8_t>(f[pos + 1]);
-    if (pid < kNativePidBase) return false;
-    c.inflight.erase(pid);
-    stats_[kStNativeAcks].fetch_add(1, std::memory_order_relaxed);
-    // the freed window slot drains the qos1 queue (mqueue dequeue)
-    while (!c.pending_qos1.empty() && c.inflight.size() < c.max_inflight) {
-      auto [frame, pid_off] = std::move(c.pending_qos1.front());
-      c.pending_qos1.pop_front();
-      uint16_t np = NextPid(c);
+    *pid = (static_cast<uint8_t>(f[pos]) << 8) |
+           static_cast<uint8_t>(f[pos + 1]);
+    return true;
+  }
+
+  // Freed window slots pull queued deliveries in (mqueue dequeue).
+  void DrainPending(uint64_t id, Conn& c) {
+    if (!c.ack) return;
+    AckState& a = *c.ack;
+    while (!a.pending.empty() && a.inflight_cnt < c.max_inflight) {
+      auto [frame, pid_off] = std::move(a.pending.front());
+      a.pending.pop_front();
+      uint16_t np = NextPid(a);
       frame[pid_off] = static_cast<char>(np >> 8);
       frame[pid_off + 1] = static_cast<char>(np & 0xFF);
       c.outbuf += frame;
       stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
       stats_[kStFastBytesOut].fetch_add(frame.size(),
                                         std::memory_order_relaxed);
+      AckNote(id, a);
       MarkDirty(id, c);
+    }
+  }
+
+  bool TryFastPuback(uint64_t id, Conn& c, const std::string& f) {
+    // pids >= kNativePidBase belong to the native inflight set; lower
+    // pids are the Python session's and are forwarded
+    uint16_t pid;
+    if (!ParsePid(f, &pid) || pid < kNativePidBase) return false;
+    if (c.ack) {
+      AckState& a = *c.ack;
+      uint32_t i = pid - kNativePidBase;
+      if (BitTest(a.inflight, i)) {
+        BitClr(a.inflight, i);
+        a.inflight_cnt--;
+        a.cyc_acked++;
+        AckNote(id, a);
+        stats_[kStNativeAcks].fetch_add(1, std::memory_order_relaxed);
+        DrainPending(id, c);
+      }
+    }
+    return true;  // native pid space: consumed even when already freed
+  }
+
+  // Subscriber answered a native qos2 delivery with PUBREC: answer
+  // PUBREL (emqx_session.erl:466-476); the inflight bit stays held
+  // until PUBCOMP — the exactly-once hold-across IS the slot hold.
+  bool TryFastPubrec(uint64_t id, Conn& c, const std::string& f) {
+    uint16_t pid;
+    if (!ParsePid(f, &pid) || pid < kNativePidBase) return false;
+    // answer PUBREL even for an already-freed pid (a retransmitted
+    // PUBREC must still complete the client's flow); Python can never
+    // own a pid in this space, so consuming is always safe
+    char rel[4] = {0x62, 0x02, static_cast<char>(pid >> 8),
+                   static_cast<char>(pid & 0xFF)};
+    c.outbuf.append(rel, 4);
+    MarkDirty(id, c);
+    return true;
+  }
+
+  // Subscriber completed a native qos2 delivery: free the slot.
+  bool TryFastPubcomp(uint64_t id, Conn& c, const std::string& f) {
+    uint16_t pid;
+    if (!ParsePid(f, &pid) || pid < kNativePidBase) return false;
+    if (c.ack) {
+      AckState& a = *c.ack;
+      uint32_t i = pid - kNativePidBase;
+      if (BitTest(a.inflight, i)) {
+        BitClr(a.inflight, i);
+        a.inflight_cnt--;
+        a.cyc_acked++;
+        AckNote(id, a);
+        stats_[kStNativeAcks].fetch_add(1, std::memory_order_relaxed);
+        DrainPending(id, c);
+      }
     }
     return true;
   }
 
-  uint16_t NextPid(Conn& c) {
+  // Publisher released a qos2 exchange the native plane owns (its pid
+  // sits in OUR awaiting-rel set): complete with PUBCOMP. Ids we do
+  // not own forward to the Python session, which owns their state.
+  bool TryFastPubrel(uint64_t id, Conn& c, const std::string& f) {
+    uint16_t pid;
+    if (!ParsePid(f, &pid)) return false;
+    if (!c.ack || !BitTest(c.ack->awaiting_rel, pid)) return false;
+    AckState& a = *c.ack;
+    BitClr(a.awaiting_rel, pid);
+    a.awaiting_cnt--;
+    a.cyc_rel++;
+    AckNote(id, a);
+    stats_[kStQos2Rel].fetch_add(1, std::memory_order_relaxed);
+    char comp[4] = {0x70, 0x02, static_cast<char>(pid >> 8),
+                    static_cast<char>(pid & 0xFF)};
+    c.outbuf.append(comp, 4);
+    MarkDirty(id, c);
+    return true;
+  }
+
+  uint16_t NextPid(AckState& a) {
     // [kNativePidBase, 0xFFFF], skipping ids still in flight
     for (int guard = 0; guard < 0x8000; guard++) {
-      uint16_t p = c.next_pid;
-      c.next_pid = p == 0xFFFF ? kNativePidBase : p + 1;
-      if (c.inflight.find(p) == c.inflight.end()) {
-        c.inflight.insert(p);
+      uint16_t p = a.next_pid;
+      a.next_pid = p == 0xFFFF ? kNativePidBase : p + 1;
+      uint32_t i = p - kNativePidBase;
+      if (!BitTest(a.inflight, i)) {
+        BitSet(a.inflight, i);
+        a.inflight_cnt++;
         return p;
       }
     }
     return kNativePidBase;  // unreachable: inflight capped below 0x8000
+  }
+
+  // Batched ack records per poll cycle (the EmitTap/FlushTaps
+  // discipline applied to the ack plane): Python's per-message PUBACK
+  // bookkeeping becomes one decode per cycle. Chunked at the tap
+  // bound: Poll permanently drops any record larger than the caller's
+  // whole buffer, and the per-cycle counters are reset here BEFORE
+  // emission — an unbounded record would silently lose every conn's
+  // ack deltas each cycle once enough conns are window-active.
+  void FlushAcks() {
+    if (ack_dirty_.empty()) return;
+    size_t cap = kTapFlushBytes;
+    if (cap > max_size_ / 2) cap = max_size_ / 2 + 1;
+    ack_buf_.clear();
+    uint32_t n = 0;
+    char ent[24];
+    auto emit = [&]() {
+      if (!n) return;
+      std::string payload(reinterpret_cast<char*>(&n), 4);
+      payload += ack_buf_;
+      events_.push_back(
+          EncodeRecord(7, 0, payload.data(), payload.size()));
+      stats_[kStAckBatches].fetch_add(1, std::memory_order_relaxed);
+      ack_buf_.clear();
+      n = 0;
+    };
+    for (uint64_t id : ack_dirty_) {
+      auto it = conns_.find(id);
+      if (it == conns_.end() || !it->second.ack) continue;
+      AckState& a = *it->second.ack;
+      a.cyc_dirty = false;
+      memcpy(ent, &id, 8);
+      uint32_t v = a.cyc_acked;
+      memcpy(ent + 8, &v, 4);
+      v = a.cyc_rel;
+      memcpy(ent + 12, &v, 4);
+      v = a.inflight_cnt;
+      memcpy(ent + 16, &v, 4);
+      v = static_cast<uint32_t>(a.pending.size());
+      memcpy(ent + 20, &v, 4);
+      a.cyc_acked = a.cyc_rel = 0;
+      if (4 + ack_buf_.size() + 24 > cap) emit();
+      ack_buf_.append(ent, 24);
+      n++;
+    }
+    ack_dirty_.clear();
+    emit();
   }
 
   static void BuildPublish(std::string* out, std::string_view topic,
@@ -1128,6 +1417,13 @@ class Host {
   std::string pub_scratch_;
   std::string key_scratch_;
   std::string frame_v4_, frame_v5_;  // per-publish shared qos0 frames
+  // per-publish shared elevated-qos frames (zero pid, qos1 header;
+  // patched per target) + their pid byte offsets
+  std::string frame_q_v4_, frame_q_v5_;
+  size_t qpid_off_v4_ = 0, qpid_off_v5_ = 0;
+  // conns with window activity this poll cycle → one kind-7 record
+  std::vector<uint64_t> ack_dirty_;
+  std::string ack_buf_;
   std::vector<uint64_t> dirty_;
   std::atomic<uint64_t> stats_[kStatCount] = {};
   std::atomic<pthread_t> poll_thread_{};  // enforces ConnIdleMs contract
@@ -1286,6 +1582,18 @@ int emqx_host_lane_deliver(void* h, const uint8_t* blob, size_t len) {
 long emqx_host_lane_backlog(void* h) {
   return static_cast<long>(
       static_cast<emqx_native::Host*>(h)->LaneBacklog());
+}
+
+// Dynamic native-plane share of a conn's receive-maximum budget: the
+// Python server re-divides the budget per batched ack cycle (the caps
+// of the two planes always sum to <= the budget, so occupancy cannot
+// exceed the client's Receive Maximum).
+int emqx_host_set_inflight_cap(void* h, uint64_t conn, uint32_t cap) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetInflightCap;
+  op.owner = conn;
+  op.max_inflight = cap;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
 int emqx_host_set_max_qos(void* h, int max_qos) {

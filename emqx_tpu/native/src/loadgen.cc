@@ -94,12 +94,22 @@ std::string Publish(const std::string& topic, const std::string& payload,
   return f + body;
 }
 
-std::string Puback(uint16_t pid) {
+std::string Ack(uint8_t header, uint16_t pid) {
   std::string f;
-  f.push_back(0x40);
+  f.push_back(static_cast<char>(header));
   f.push_back(0x02);
   PutU16(&f, pid);
   return f;
+}
+
+// [h][varint][pid] — pid of PUBACK/PUBREC/PUBREL/PUBCOMP
+uint16_t AckPid(const std::string& f) {
+  size_t pos = 1;
+  while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
+  pos++;
+  if (pos + 2 > f.size()) return 0;
+  return (static_cast<uint8_t>(f[pos]) << 8) |
+         static_cast<uint8_t>(f[pos + 1]);
 }
 
 struct LgConn {
@@ -174,7 +184,9 @@ struct Loadgen {
         uint16_t pid = (static_cast<uint8_t>(f[pos]) << 8) |
                        static_cast<uint8_t>(f[pos + 1]);
         pos += 2;
-        c.outbuf += Puback(pid);
+        // qos1 delivery → PUBACK; qos2 → PUBREC (broker answers
+        // PUBREL, completed below)
+        c.outbuf += Ack(dqos == 1 ? 0x40 : 0x50, pid);
       }
       if (proto_ver == 5 && pos < f.size()) {
         uint8_t plen = static_cast<uint8_t>(f[pos]);
@@ -189,6 +201,12 @@ struct Loadgen {
       }
       received++;
     } else if (type == 4) {  // PUBACK for our qos1 publishes
+      acks++;
+    } else if (type == 5) {  // PUBREC for our qos2 publish → PUBREL
+      c.outbuf += Ack(0x62, AckPid(f));
+    } else if (type == 6) {  // PUBREL from the broker → PUBCOMP
+      c.outbuf += Ack(0x70, AckPid(f));
+    } else if (type == 7) {  // PUBCOMP completes our qos2 publish
       acks++;
     }
   }
@@ -254,6 +272,11 @@ struct Loadgen {
 extern "C" {
 
 // out[8]: sent, received, wall_ns, p50_ns, p99_ns, max_ns, acks, errors
+//
+// qos selects the full exchange depth: 0 = fire-and-forget, 1 =
+// PUBLISH/PUBACK both directions, 2 = the four-packet exactly-once
+// exchange on both the publisher (PUBREC→PUBREL, PUBCOMP counts into
+// acks) and the subscriber (PUBREC, PUBREL→PUBCOMP) sides.
 //
 // window = 0: blast mode — publishers keep ~64KB buffered and TCP
 //   backpressure paces them; measures peak throughput, but delivery

@@ -62,6 +62,13 @@ class Session:
         self.mqueue = MQueue(self.mqueue_opts)
         self.awaiting_rel: dict[int, int] = {}     # packet_id -> ts
         self._next_pkt_id = 0
+        # native ack-plane mirror (broker/native_server.py): the C++
+        # host owns the window state for pids >= 32768 and reports ONE
+        # batched ack record per poll cycle; these gauges are that
+        # record's session-side reflection (surfaced by info())
+        self.native_inflight = 0      # native window occupancy, last cycle
+        self.native_pending = 0       # native mqueue-analogue depth
+        self.native_acked = 0         # cumulative natively-freed slots
 
     # -- packet ids --------------------------------------------------------
 
@@ -220,6 +227,24 @@ class Session:
         self.inflight.delete(packet_id)
         return self.dequeue(now)
 
+    def native_ack_sync(self, inflight_now: int, pending_now: int,
+                        acked: int,
+                        now: Optional[int] = None) -> list[P.Packet]:
+        """Reconcile one batched native ack record into the session
+        (broker/native_server.py drains kind-7 events here once per
+        poll cycle — the per-message PUBACK bookkeeping that capped the
+        windowed QoS1 plane now arrives as one cycle-level delta).
+
+        Returns PUBLISH packets to send when natively-freed window
+        slots let Python-queued messages (punt-served deliveries that
+        overflowed into the mqueue) hand off into the wire window."""
+        self.native_inflight = inflight_now
+        self.native_pending = pending_now
+        self.native_acked += acked
+        if acked and len(self.mqueue) and not self.inflight.is_full():
+            return self.dequeue(now)
+        return []
+
     def dequeue(self, now: Optional[int] = None) -> list[P.Packet]:
         """Fill freed inflight slots from the mqueue (:520-530)."""
         now = now_ms() if now is None else now
@@ -314,5 +339,8 @@ class Session:
             "mqueue_len": len(self.mqueue),
             "mqueue_dropped": self.mqueue.dropped,
             "awaiting_rel_cnt": len(self.awaiting_rel),
+            "native_inflight_cnt": self.native_inflight,
+            "native_pending_len": self.native_pending,
+            "native_acked_cnt": self.native_acked,
             "created_at": self.created_at,
         }

@@ -764,9 +764,13 @@ def test_per_topic_ordering_across_permit_transition():
     server.stop()
 
 
-def test_qos2_always_on_python_path():
-    """QoS2 exactly-once needs the session's awaiting-rel state: the
-    fast path must punt it even on a permitted topic."""
+def test_qos2_stays_on_python_path_until_safe():
+    """The round-6 native ack plane owns QoS2 only behind the same
+    permit/punt seams as QoS0/1: an UNPERMITTED topic and a topic with
+    a punt-class audience (persistent session) must keep the full
+    exchange in the Python session — exactly-once state cannot split
+    planes mid-audience (tests/test_native_qos2.py covers the native
+    side of the seam)."""
     server = NativeBrokerServer(port=0, app=BrokerApp())
     server.start()
 
@@ -774,24 +778,27 @@ def test_qos2_always_on_python_path():
         sub = MqttClient(port=server.port, clientid="q2s")
         await sub.connect()
         await sub.subscribe("q2/t", qos=2)
+        # the persistent-session subscriber makes q2/t punt-marked
+        ps = MqttClient(port=server.port, clientid="q2-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 60})
+        await ps.connect()
+        await ps.subscribe("q2/t", qos=2)
         pub = MqttClient(port=server.port, clientid="q2p")
         await pub.connect()
-        # earn a permit with qos1 traffic first — and PROVE it landed
-        # (else the fast_in == fast0 assertion below passes vacuously)
-        await pub.publish("q2/t", b"warm", qos=1)
-        await sub.recv(timeout=5)
-        await _settle()
-        await pub.publish("q2/t", b"fastproof", qos=1)
-        await sub.recv(timeout=5)
-        assert await _wait_fast(server, "fast_in", 1)
+        # no permit yet AND punt audience: every qos2 publish runs the
+        # Python exchange (python pids < 32768 toward the subscribers)
         fast0 = server.fast_stats()["fast_in"]
         for i in range(3):
             await pub.publish("q2/t", f"e{i}".encode(), qos=2)
             m = await sub.recv(timeout=5)
             assert m.payload == f"e{i}".encode() and m.qos == 2
             assert m.packet_id < 32768          # python session pid
+            mp = await ps.recv(timeout=5)
+            assert mp.payload == f"e{i}".encode()
+            await _settle(0.2)
         assert server.fast_stats()["fast_in"] == fast0, "qos2 fast-pathed"
-        await sub.close(); await pub.close()
+        await sub.close(); await ps.close(); await pub.close()
 
     run(main())
     server.stop()

@@ -1029,6 +1029,180 @@ def sec_host() -> None:
 
 
 # ---------------------------------------------------------------------------
+# section: ws (MQTT-over-WebSocket on the native plane; CPU by design)
+# ---------------------------------------------------------------------------
+
+def sec_ws() -> None:
+    """Round-7 tentpole before/after: the asyncio WS plane (ws.py —
+    every WS client inherited the ~14k msg/s GIL ceiling while native
+    TCP did 1.7M) against RFC6455 in the C++ host (ws.h + host.cc),
+    driven by the loadgen's ws mode (masked frames, nonzero keys, so
+    the broker pays the real unmask cost). Acceptance (ISSUE 2):
+    native-WS >= 0.5x the native-TCP blast on the same box and >= 10x
+    the asyncio WS plane."""
+    import asyncio
+    import base64
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.broker.ws import (OP_BINARY, FrameDecoder,
+                                    WsBrokerServer, encode_frame)
+    from emqx_tpu.mqtt import packet as P
+    from emqx_tpu.mqtt.frame import Parser, serialize
+
+    n_msg_before = int(os.environ.get("BENCH_WS_BEFORE_MSGS", 1200))
+    n_msg_blast = int(os.environ.get("BENCH_WS_BLAST_MSGS", 40000))
+
+    # -- before: asyncio WS listener + python ws clients --------------------
+    class _WsClient:
+        def __init__(self, port):
+            self.port = port
+            self.dec = FrameDecoder(require_mask=False)
+            self.parser = Parser()
+            self.inbox: list = []
+
+        async def connect(self, cid):
+            self.r, self.w = await asyncio.open_connection(
+                "127.0.0.1", self.port)
+            key = base64.b64encode(os.urandom(16)).decode()
+            self.w.write((
+                "GET /mqtt HTTP/1.1\r\nHost: x\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Protocol: mqtt\r\n\r\n").encode())
+            await self.r.readuntil(b"\r\n\r\n")
+            await self.send(P.Connect(clientid=cid))
+            await self.recv()
+            return self
+
+        async def send(self, pkt):
+            self.w.write(encode_frame(
+                OP_BINARY, serialize(pkt, P.MQTT_V4), mask=True))
+            await self.w.drain()
+
+        async def recv(self, timeout=10):
+            while not self.inbox:
+                data = await asyncio.wait_for(self.r.read(65536), timeout)
+                assert data
+                for op, payload in self.dec.feed(data):
+                    if op == OP_BINARY:
+                        self.inbox.extend(self.parser.feed(payload))
+            return self.inbox.pop(0)
+
+    async def run_before() -> float:
+        server = WsBrokerServer(port=0, app=BrokerApp())
+        await server.start()
+        try:
+            subs = [await _WsClient(server.port).connect(f"ws{i}")
+                    for i in range(8)]
+            for i, s in enumerate(subs):
+                await s.send(P.Subscribe(packet_id=1,
+                                         topic_filters=[(f"lg/{i}/+",
+                                                         {"qos": 0})]))
+                await s.recv()
+            pubs = [await _WsClient(server.port).connect(f"wp{i}")
+                    for i in range(8)]
+            expected = 8 * n_msg_before
+            got = 0
+            done = asyncio.Event()
+
+            async def drain(s):
+                nonlocal got
+                while got < expected:
+                    try:
+                        await s.recv(timeout=10)
+                    except asyncio.TimeoutError:
+                        break
+                    got += 1
+                    if got >= expected:
+                        done.set()
+            drains = [asyncio.create_task(drain(s)) for s in subs]
+
+            async def blast(i, p):
+                for j in range(n_msg_before):
+                    await p.send(P.Publish(topic=f"lg/{(i + j) % 8}/m",
+                                           payload=b"x" * 16, qos=0))
+            t0 = time.time()
+            await asyncio.gather(*(blast(i, p) for i, p in enumerate(pubs)))
+            try:
+                await asyncio.wait_for(done.wait(), timeout=60)
+            except asyncio.TimeoutError:
+                pass
+            wall = time.time() - t0
+            for d in drains:
+                d.cancel()
+            for c in subs + pubs:
+                c.w.close()
+            return got / wall
+        finally:
+            await server.stop()
+
+    before = asyncio.run(run_before())
+    log(f"ws plane BEFORE (asyncio + python ws clients, qos0): "
+        f"{before:,.0f} msg/s")
+    put("ws", ws_asyncio_msgs_per_sec=round(before))
+
+    # -- after: C++ RFC6455 listener + ws loadgen ---------------------------
+    server = NativeBrokerServer(port=0, app=BrokerApp(), ws_port=0,
+                                session_opts={"max_inflight": 1024})
+    server.start()
+    try:
+        # same-box native-TCP anchor (the ws_vs_native_tcp denominator
+        # must come from THIS box/run, not a stale artifact)
+        tcp = native.loadgen_run(
+            "127.0.0.1", server.port, n_subs=8, n_pubs=8,
+            msgs_per_pub=n_msg_blast, qos=0, payload_len=16)
+        tcp_rate = tcp["received"] / max(tcp["wall_ns"] / 1e9, 1e-9)
+
+        ws = native.loadgen_run(
+            "127.0.0.1", server.ws_port, n_subs=8, n_pubs=8,
+            msgs_per_pub=n_msg_blast, qos=0, payload_len=16, ws=True)
+        ws_wall = ws["wall_ns"] / 1e9
+        ws_rate = ws["received"] / max(ws_wall, 1e-9)
+        log(f"ws plane AFTER (C++ RFC6455 + fast path, blast qos0): "
+            f"{ws['received']}/{ws['sent']} in {ws_wall:.2f}s = "
+            f"{ws_rate:,.0f} msg/s  ({ws_rate / max(before, 1):,.0f}x "
+            f"asyncio-ws, {ws_rate / max(tcp_rate, 1):.2f}x native-tcp "
+            f"same box)")
+        put("ws",
+            ws_native_msgs_per_sec=round(ws_rate),
+            ws_vs_native_tcp=round(ws_rate / max(tcp_rate, 1), 2),
+            ws_vs_asyncio=round(ws_rate / max(before, 1), 1))
+
+        lat = native.loadgen_run(
+            "127.0.0.1", server.ws_port, n_subs=8, n_pubs=8,
+            msgs_per_pub=3000, qos=0, payload_len=16, window=64, ws=True)
+        log(f"ws plane latency (windowed 64, qos0): "
+            f"p50={lat['p50_ns'] / 1e6:.3f}ms "
+            f"p99={lat['p99_ns'] / 1e6:.3f}ms")
+        put("ws",
+            ws_native_p50_ms=round(lat["p50_ns"] / 1e6, 3),
+            ws_native_p99_ms=round(lat["p99_ns"] / 1e6, 3))
+
+        q1 = native.loadgen_run(
+            "127.0.0.1", server.ws_port, n_subs=8, n_pubs=8,
+            msgs_per_pub=n_msg_blast // 4, qos=1, payload_len=16,
+            window=1024, ws=True)
+        q1_rate = q1["received"] / max(q1["wall_ns"] / 1e9, 1e-9)
+        st = server.fast_stats()
+        log(f"ws plane qos1 (windowed 1024): {q1_rate:,.0f} msg/s "
+            f"acks={q1['acks']} p99={q1['p99_ns'] / 1e6:.2f}ms  "
+            f"ws_handshakes={st['ws_handshakes']}")
+        put("ws",
+            ws_native_qos1_msgs_per_sec=round(q1_rate),
+            ws_native_qos1_p99_ms=round(q1["p99_ns"] / 1e6, 3),
+            ws_handshakes=st["ws_handshakes"])
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # section: e2e (full broker stack with the device router on path)
 # ---------------------------------------------------------------------------
 
@@ -1298,6 +1472,7 @@ SECTIONS = {
     "xcpp": sec_xcpp,
     "shared": sec_shared,
     "host": sec_host,
+    "ws": sec_ws,
     "e2e": sec_e2e,
 }
 
@@ -1312,18 +1487,20 @@ DEVICE_PLAN = [
     ("e2e", True, False, 600),
     ("xcpp", False, True, 400),
     ("host", False, True, 500),
+    ("ws", False, True, 400),
     ("shared", False, True, 400),
 ]
 CPU_PLAN = [
     ("kernel", False, True, 700),
     ("xcpp", False, True, 400),
     ("host", False, True, 500),
+    ("ws", False, True, 400),
     ("shared", False, True, 400),
     ("e2e", False, True, 600),
 ]
 
 _SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
-                  "shared", "host", "e2e", "kernel_cpu"]
+                  "shared", "host", "ws", "e2e", "kernel_cpu"]
 
 
 def _probe_device(attempts: int, timeout_s: float, backoff_s: float) -> dict:

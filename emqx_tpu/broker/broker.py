@@ -381,9 +381,24 @@ class Broker:
             return (msgs, live, cobatch, out, None)
         if self.model is None or force_host:
             return (msgs, live, cobatch, out, None)
-        pending = self.model.publish_batch_submit(
-            [m.topic for _, m in live])
+        try:
+            pending = self.model.publish_batch_submit(
+                [m.topic for _, m in live])
+        except Exception:  # noqa: BLE001 — device loss / reset / OOM
+            # device-loss failover: the host oracle serves the batch
+            # (pending=None token) instead of dropping it; matching is
+            # replicated on the host, so only latency degrades
+            self._device_failover("submit")
+            return (msgs, live, cobatch, out, None)
         return (msgs, live, cobatch, out, pending)
+
+    def _device_failover(self, stage: str) -> None:
+        import logging
+
+        self._inc("messages.device_failover")
+        logging.getLogger("emqx_tpu.broker").exception(
+            "device router %s failed; batch served by the host oracle",
+            stage)
 
     def publish_batch_collect(
         self, token
@@ -396,10 +411,27 @@ class Broker:
         if pending is None:                    # host-oracle path
             for i, m in live:
                 self._inc("messages.publish")
+                if cobatch:
+                    # cobatch with no device result = submit-side device
+                    # failover: the rules deferred to the kernel, so they
+                    # must re-match on the host trie here
+                    self.rules_matched_fn(m, None)
                 out[i] = self._route(m.topic, m)
             return out
-        matched, aux, slots, fallback = self.model.publish_batch_collect(
-            pending)
+        try:
+            matched, aux, slots, fallback = self.model.publish_batch_collect(
+                pending)
+        except Exception:  # noqa: BLE001 — device lost mid-flight
+            # collect-side failover: the submitted launch died with the
+            # device; re-route the whole batch on the host oracle (rules
+            # re-match on the host trie when cobatched)
+            self._device_failover("collect")
+            for i, m in live:
+                self._inc("messages.publish")
+                if cobatch:
+                    self.rules_matched_fn(m, None)
+                out[i] = self._route(m.topic, m)
+            return out
         fb = set(fallback)
         batch_legs: list = []    # (out index, msg, group, route topic)
         for j, (i, m) in enumerate(live):

@@ -99,13 +99,24 @@ def build_listener(app, name: str, conf: dict):
     if ltype in ("ws", "wss"):
         return WsBrokerServer(path=conf.get("websocket_path", "/mqtt"), **kw)
     if ltype == "native":
+        # ws_bind opens the C++ RFC6455 listener next to the TCP one —
+        # both feed the same epoll loop/fast path; the asyncio ws
+        # listener (type = ws) remains the slow-plane oracle
+        ws_bind = conf.get("ws_bind")
+        ws_host = ws_port = None
+        # NOT a truthiness test: the integer bind 0 (ephemeral port)
+        # is a valid, enabled configuration
+        if ws_bind is not None and ws_bind is not False and ws_bind != "":
+            ws_host, ws_port = parse_bind(ws_bind, default_port=8083)
         return NativeListener(
             app=app, host=host, port=port,
             max_connections=kw["max_connections"],
             mountpoint=kw["mountpoint"],
             listener_id=kw["listener_id"],
             fast_path=bool(conf.get("fast_path", True)),
-            device_lane=str(conf.get("device_lane", "auto")))
+            device_lane=str(conf.get("device_lane", "auto")),
+            ws_host=ws_host, ws_port=ws_port,
+            ws_path=conf.get("websocket_path", "/mqtt"))
     return BrokerServer(**kw)
 
 
@@ -120,15 +131,20 @@ class NativeListener:
     def __init__(self, app, host: str, port: int, max_connections: int,
                  mountpoint: str, listener_id: str,
                  fast_path: bool = True,
-                 device_lane: str = "auto") -> None:
+                 device_lane: str = "auto",
+                 ws_host: "str | None" = None,
+                 ws_port: "int | None" = None,
+                 ws_path: str = "/mqtt") -> None:
         self._app = app
         self._bind = (host, port)
         self._kw = dict(max_connections=max_connections,
                         mountpoint=mountpoint, fast_path=fast_path,
-                        device_lane=device_lane)
+                        device_lane=device_lane, ws_host=ws_host,
+                        ws_port=ws_port, ws_path=ws_path)
         self.listener_id = listener_id
         self.host = host
         self.port = port
+        self.ws_port = ws_port       # bound port known after start()
         self.max_connections = max_connections
         self.ssl_context = None
         self._srv = None
@@ -154,6 +170,7 @@ class NativeListener:
 
         self._srv = await asyncio.to_thread(_boot)
         self.port = self._srv.port
+        self.ws_port = self._srv.ws_port
         self._server = self._srv
 
     async def stop(self) -> None:

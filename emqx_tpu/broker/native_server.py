@@ -62,6 +62,7 @@ always correct):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import struct
 import threading
@@ -138,6 +139,9 @@ class NativeBrokerServer:
         fast_path: bool = True,
         device_lane: str = "auto",
         session_opts: Optional[dict] = None,
+        ws_port: Optional[int] = None,
+        ws_path: str = "/mqtt",
+        ws_host: Optional[str] = None,
     ):
         if not native.available():
             raise RuntimeError(
@@ -159,6 +163,19 @@ class NativeBrokerServer:
             host=host, port=port,
             max_size=max_packet_size, max_conns=max_connections)
         self.port = self.host.port
+        # WebSocket plane (round 7): a second C++ listener runs the
+        # RFC6455 handshake + frame codec below the GIL; its conns ride
+        # the SAME fast path (permits, lanes, taps, QoS0/1/2 ack plane)
+        # as TCP — only the transport framing differs. ws_port=None
+        # keeps it off; 0 binds an ephemeral port. broker/ws.py stays
+        # the asyncio slow-plane oracle (and serves non-/mqtt paths).
+        self.ws_port: Optional[int] = None
+        if ws_port is not None:
+            # ws_host defaults to the TCP bind host but stays
+            # independently configurable (e.g. loopback-only WS next to
+            # an all-interfaces TCP listener)
+            self.ws_port = self.host.listen_ws(ws_host or host, ws_port,
+                                               ws_path)
         self.conns: dict[int, _NativeConn] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -947,66 +964,114 @@ class NativeBrokerServer:
                     ci.peername)
         return self._closed_conns.get(conn_id)
 
+    @staticmethod
+    def _tap_count(batch: bytes) -> int:
+        """Entries in one tap batch (header-only walk, drop accounting).
+        Entry: [u64 publisher][u8 flags][u16 tlen][topic] +
+        (flags bit0 ? [u32 plen][payload] : payload of previous entry);
+        flags bits 1-2 = qos, bit 3 = publisher DUP."""
+        n = pos = 0
+        blen = len(batch)
+        while pos + 11 <= blen:
+            flags = batch[pos + 8]
+            tlen = int.from_bytes(batch[pos + 9:pos + 11], "little")
+            pos += 11 + tlen
+            if flags & 1:
+                if pos + 4 > blen:
+                    break
+                pos += 4 + int.from_bytes(batch[pos:pos + 4], "little")
+            n += 1
+        return n
+
     def _on_tap(self, _conn_id: int, batch: bytes) -> None:
-        """Natively-delivered frames that matched rule-tap entries,
-        BATCHED into one record per C++ poll cycle
-        ([u64 publisher][u32 len][frame]...). The poll thread does ONE
-        queue put per batch — parsing and conninfo resolution happen on
-        the worker (per-message work here measurably throttled the data
-        plane). Bounded: under sustained rule-eval overload whole
-        batches drop, message-counted into tap_dropped."""
+        """Natively-delivered publishes that matched rule-tap entries,
+        BATCHED into one record per C++ poll cycle and PRE-PARSED
+        (host.cc EmitTap: topic/qos fields + payload-deduped bytes, the
+        round-7 copy elision). The poll thread does ONE queue put per
+        batch — decoding and conninfo resolution happen on the worker
+        (per-message work here measurably throttled the data plane).
+        Bounded: under sustained rule-eval overload whole batches drop,
+        message-counted into tap_dropped."""
         try:
             self._tap_q.put_nowait(batch)
         except queue.Full:
-            n = 0
-            pos = 0
-            while pos + 12 <= len(batch):      # header-only count
-                pos += 12 + int.from_bytes(batch[pos + 8:pos + 12],
-                                           "little")
-                n += 1
-            self.tap_dropped += n
+            self.tap_dropped += self._tap_count(batch)
 
     def _tap_worker(self) -> None:
-        """Evaluate rules against tapped frames off the poll thread.
-        The frames were already natively delivered; only the rule
-        engine sees them here (app.rules.ingest → same _fire path the
-        hook fold uses). conninfo lookups read self.conns cross-thread:
-        GIL-safe, and a conn closed mid-read just falls back to the
-        recently-closed map (or is skipped)."""
+        """Evaluate rules against tapped publishes off the poll thread.
+        They were already natively delivered; only the rule engine sees
+        them here (app.rules.ingest → same _fire path the hook fold
+        uses). The entries arrive pre-parsed from C++, so no MQTT
+        re-parse runs here — with full-frame copies + parse_one this
+        worker's GIL hold was a chunk of the rule-tap tax on the data
+        plane (BENCH_r05 rule_tap_vs_free=0.59). The rest is GIL
+        latency: rule evaluation is ~20µs/message of pure Python, so
+        without explicit releases the poll thread waits up to the 5 ms
+        switch interval per GIL acquisition. Discipline: sleep(0)
+        every 8 messages (~160 µs of work) hands the GIL over promptly
+        — rule evaluation is elastic, the data plane is not. (No
+        thread-priority drop: see the inline note at the yield.)
+        conninfo lookups read self.conns cross-thread: GIL-safe, and a
+        conn closed mid-read falls back to the recently-closed map (or
+        is skipped)."""
         from emqx_tpu.core.message import Message
 
+        ingest = self.app.rules.ingest
+        done_since_yield = 0
         while not self._stop.is_set():
             try:
                 batch = self._tap_q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            pos, n = 0, len(batch)
-            while pos + 12 <= n:
+            pos, blen = 0, len(batch)
+            payload = b""           # dedup carry (within one batch only)
+            while pos + 11 <= blen:
                 publisher = int.from_bytes(batch[pos:pos + 8], "little")
-                flen = int.from_bytes(batch[pos + 8:pos + 12], "little")
-                pos += 12
-                frame = batch[pos:pos + flen]
-                pos += flen
+                flags = batch[pos + 8]
+                tlen = int.from_bytes(batch[pos + 9:pos + 11], "little")
+                pos += 11
+                topic = batch[pos:pos + tlen].decode("utf-8", "replace")
+                pos += tlen
+                if flags & 1:
+                    if pos + 4 > blen:
+                        break       # truncated batch: defensive stop
+                    plen = int.from_bytes(batch[pos:pos + 4], "little")
+                    pos += 4
+                    payload = batch[pos:pos + plen]
+                    pos += plen
                 info = self._conninfo_for(publisher)
                 if info is None:
                     continue
-                clientid, proto_ver, username, peername = info
+                clientid, _proto_ver, username, peername = info
                 try:
-                    pkt = parse_one(frame, proto_ver)
-                    props = dict(pkt.properties or {})
-                    props.pop("Topic-Alias", None)
+                    # fast-path publishes carry no v5 properties (the
+                    # permit requires an empty property section), so
+                    # the Message builds straight from the tap fields
                     msg = Message(
-                        topic=pkt.topic, payload=pkt.payload, qos=pkt.qos,
-                        from_=clientid,
-                        flags={"retain": False, "dup": pkt.dup},
-                        headers={"properties": props,
+                        topic=topic, payload=payload,
+                        qos=(flags >> 1) & 3, from_=clientid,
+                        flags={"retain": False, "dup": bool(flags & 8)},
+                        headers={"properties": {},
                                  "username": username,
                                  "peername": peername,
                                  "protocol": "mqtt"},
                     )
-                    self.app.rules.ingest(msg)
-                except Exception:  # noqa: BLE001 — one bad frame/rule
+                    ingest(msg)
+                except Exception:  # noqa: BLE001 — one bad entry/rule
                     log.exception("rule tap evaluation failed")
+                done_since_yield += 1
+                if done_since_yield >= 8:
+                    # release the GIL mid-batch: the C++ plane only
+                    # runs while a thread sits inside emqx_host_poll,
+                    # so every ms the poll thread spends WAITING for
+                    # the GIL is a stalled data plane. ~160µs stints
+                    # bound that wait; the sleep(0) costs ~1µs per 8
+                    # messages of ~20µs each. (Deliberately NOT paired
+                    # with a lower thread priority: a deprioritized
+                    # holder parked mid-stint is a priority inversion
+                    # on the GIL.)
+                    done_since_yield = 0
+                    time.sleep(0)
 
     def _on_ack_batch(self, batch: bytes) -> None:
         """Drain ONE batched ack record (host.cc kind 7) — the per-poll

@@ -6,6 +6,13 @@ an incremental frame decoder (fragmentation, ping/pong, close,
 masked-client enforcement), and a listener that feeds the *same*
 ``Channel`` FSM the TCP server drives — WS binary frames are just a
 second byte-transport for the MQTT parser.
+
+Since round 7 the hot WS path lives in the C++ host
+(``native/src/ws.h`` + ``host.cc``; enable with
+``NativeBrokerServer(ws_port=...)`` or ``ws_bind`` on a ``native``
+listener). THIS module stays as the slow-plane oracle and conformance
+reference — ``tests/test_native_ws.py`` drives both ends against each
+other — and serves upgrade paths the native listener rejects.
 """
 
 from __future__ import annotations

@@ -13,6 +13,7 @@ announces ``supported_versions()`` at join; callers pick
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 
@@ -108,3 +109,24 @@ NODE_V1 = register(Proto("node", 1, {
     "ping": ["node"],
     "bye": ["node"],
 }))
+
+
+# -- v2 protos (opt-in rollouts) -------------------------------------------
+#
+# RLOG v2 compacts the delta stream: ``apply_deltas2`` carries
+# (op, topic, dest) tuples instead of keyed dicts. Registration is
+# OPT-IN via EMQX_BPAPI_RLOG_V2=1 — exactly the reference's
+# rolling-upgrade shape (a cluster mixes releases mid-upgrade): a node
+# without the flag announces rlog [1], ``negotiate`` downshifts the v2
+# node to the v1 dict wire, and route replication keeps flowing either
+# way (tests/test_cluster_procs.py drives both mixes with real
+# processes). The v1 signature stays frozen per the snapshot pin.
+RLOG_V2 = None
+if os.environ.get("EMQX_BPAPI_RLOG_V2"):
+    RLOG_V2 = register(Proto("rlog", 2, {
+        "apply_deltas": ["from_node", "deltas"],
+        "apply_deltas2": ["from_node", "deltas"],
+        "bootstrap": ["from_node"],
+        "shared_delta": ["from_node", "op", "group", "topic", "sid"],
+        "registry_delta": ["from_node", "op", "clientid"],
+    }))

@@ -69,6 +69,11 @@ class ClusterNode:
         self.heartbeat_misses = heartbeat_misses
         self._lock = threading.RLock()
 
+        # per-peer negotiated rlog version (bpapi.negotiate at hello,
+        # both directions); absent peer = assume v1, the frozen floor
+        self.proto_rlog: dict[str, int] = {}
+        self._rlog_v2_ok = 2 in bpapi.supported_versions().get("rlog", [])
+
         t = self.transport
         t.register("broker.dispatch", self._h_dispatch)
         t.register("shared_sub.deliver", self._h_shared_deliver)
@@ -76,6 +81,11 @@ class ClusterNode:
         t.register("cm.kick", self._h_kick)
         t.register("cm.lookup", self._h_lookup)
         t.register("rlog.apply_deltas", self._h_apply_deltas)
+        if self._rlog_v2_ok:
+            # the v2 compact delta wire exists only where v2 is
+            # registered (EMQX_BPAPI_RLOG_V2) — a v1 peer can never be
+            # sent to it because flush() gates on the negotiated version
+            t.register("rlog.apply_deltas2", self._h_apply_deltas2)
         t.register("rlog.bootstrap", self._h_bootstrap)
         t.register("rlog.shared_delta", self._h_shared_delta)
         t.register("rlog.registry_delta", self._h_registry_delta)
@@ -131,7 +141,10 @@ class ClusterNode:
                     versions=bpapi.supported_versions(), role=self.role)
             except TransportError:
                 continue
-            bpapi.negotiate(resp["versions"], "rlog")    # compat gate
+            # compat gate + downshift: a v2 node joining a v1 cluster
+            # records 1 here and speaks the v1 dict wire to this peer
+            self.proto_rlog[seed] = bpapi.negotiate(resp["versions"],
+                                                    "rlog")
             self._mark_alive(seed, role=resp.get("role", "core"))
             # learned members start UNVERIFIED (alive only on direct
             # contact — a dead peer in the seed's list must not receive
@@ -149,6 +162,8 @@ class ClusterNode:
                         other, "node.hello", node=self.name,
                         versions=bpapi.supported_versions(),
                         role=self.role)
+                    self.proto_rlog[other] = bpapi.negotiate(
+                        r2["versions"], "rlog")
                     self._mark_alive(other, role=r2.get("role", "core"))
                 except TransportError:
                     pass
@@ -272,9 +287,18 @@ class ClusterNode:
                 else:
                     mine = self._own_deltas(deltas)
                     if mine:
-                        self.transport.call(peer, "rlog.apply_deltas",
-                                            from_node=self.name,
-                                            deltas=mine)
+                        if (self._rlog_v2_ok
+                                and self.proto_rlog.get(peer, 1) >= 2):
+                            # negotiated v2 both ways: compact tuple wire
+                            self.transport.call(
+                                peer, "rlog.apply_deltas2",
+                                from_node=self.name,
+                                deltas=[(d["op"], d["topic"], d["dest"])
+                                        for d in mine])
+                        else:
+                            self.transport.call(peer, "rlog.apply_deltas",
+                                                from_node=self.name,
+                                                deltas=mine)
                 with self._lock:
                     self._peer_cursor[peer] = max(
                         self._peer_cursor.get(peer, 0), head)
@@ -293,6 +317,15 @@ class ClusterNode:
                 router.add_route(d["topic"], d["dest"])
             else:
                 router.delete_route(d["topic"], d["dest"])
+
+    def _h_apply_deltas2(self, from_node: str, deltas: list) -> None:
+        """rlog v2 wire: (op, topic, dest) tuples (bpapi.RLOG_V2)."""
+        router = self.app.broker.router
+        for op, topic, dest in deltas:
+            if op == "add":
+                router.add_route(topic, dest)
+            else:
+                router.delete_route(topic, dest)
 
     def _drop_peer_routes(self, node: str) -> None:
         router = self.app.broker.router
@@ -621,7 +654,9 @@ class ClusterNode:
 
     def _h_hello(self, node: str, versions: dict,
                  role: str = "core") -> dict:
-        bpapi.negotiate(versions, "rlog")
+        # record the negotiated rlog version for the REVERSE direction
+        # too: our flushes to a v1 joiner must use the v1 dict wire
+        self.proto_rlog[node] = bpapi.negotiate(versions, "rlog")
         with self._lock:
             members = list(self.members) + [self.name]
         self._mark_alive(node, role=role)

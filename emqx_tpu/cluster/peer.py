@@ -10,7 +10,10 @@ Usage:
         --cluster-port 7001 --mqtt-port 1884 \
         --peer n2:127.0.0.1:7002 --seed n2
 
-Prints ``READY <mqtt_port>`` on stdout once both listeners serve.
+Prints ``READY <mqtt_port> <mgmt_port> rlog=<v>`` on stdout once both
+listeners serve; ``rlog=<v>`` is the rlog BPAPI version negotiated with
+the join seed (or this node's own max when it boots alone) — the
+mixed-version interop test asserts the downshift on it.
 """
 
 from __future__ import annotations
@@ -60,9 +63,14 @@ def main() -> None:
         mgmt_port = mgmt.start()
 
     async def serve() -> None:
+        from emqx_tpu.cluster import bpapi
+
         server = BrokerServer(port=args.mqtt_port, app=node.app)
         await server.start()
-        print(f"READY {server.port} {mgmt_port}", flush=True)
+        rlog_v = (min(node.proto_rlog.values()) if node.proto_rlog
+                  else max(bpapi.supported_versions()["rlog"]))
+        print(f"READY {server.port} {mgmt_port} rlog={rlog_v}",
+              flush=True)
         await asyncio.Event().wait()          # run until killed
 
     try:

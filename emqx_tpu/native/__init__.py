@@ -67,6 +67,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32]
     lib.emqx_host_port.restype = ctypes.c_int
     lib.emqx_host_port.argtypes = [ctypes.c_void_p]
+    lib.emqx_host_listen_ws.restype = ctypes.c_int
+    lib.emqx_host_listen_ws.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p]
     lib.emqx_host_poll.restype = ctypes.c_long
     lib.emqx_host_poll.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
@@ -164,7 +167,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_loadgen_run.argtypes = [
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32,
         ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint32, ctypes.c_int,
-        ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint64)]
     lib.emqx_host_destroy.restype = None
     lib.emqx_host_destroy.argtypes = [ctypes.c_void_p]
@@ -269,20 +272,24 @@ EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP, EV_ACKS = 1, 2, 3, 4, 6, 7
 def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
                 msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
                 proto_ver: int = 4, idle_timeout_ms: int = 5000,
-                window: int = 0, warmup: bool = True) -> dict:
+                window: int = 0, warmup: bool = True,
+                ws: bool = False) -> dict:
     """Run the native load generator (loadgen.cc) against a broker.
     Blocks for the duration of the run (ctypes releases the GIL, so an
     in-process broker keeps serving). ``window=0`` blasts for peak
     throughput; ``window>0`` caps total in-flight messages so the
     latency percentiles measure the broker, not loadgen queue depth.
-    Returns sent/received counts, wall ns and latency percentiles."""
+    ``ws=True`` runs the fleet over MQTT-over-WebSocket (point ``port``
+    at a WS listener). Returns sent/received counts, wall ns and
+    latency percentiles."""
     lib = load()
     if lib is None:
         raise RuntimeError(f"native lib unavailable: {_build_error}")
     out = (ctypes.c_uint64 * 8)()
     rc = lib.emqx_loadgen_run(host.encode(), port, n_subs, n_pubs,
                               msgs_per_pub, qos, payload_len, proto_ver,
-                              idle_timeout_ms, window, int(warmup), out)
+                              idle_timeout_ms, window, int(warmup),
+                              int(ws), out)
     if rc != 0:
         raise RuntimeError(f"loadgen failed rc={rc}")
     keys = ("sent", "received", "wall_ns", "p50_ns", "p99_ns", "max_ns",
@@ -392,7 +399,8 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "lane_in", "lane_out", "lane_punts", "lane_fallback",
               "lane_stale", "taps",
               "qos1_in", "qos2_in", "qos2_rel", "lane_topic_overflow",
-              "ack_batches")
+              "ack_batches",
+              "ws_handshakes", "ws_rejects", "ws_pings", "ws_closes")
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP = 1, 2, 4
@@ -412,6 +420,7 @@ class NativeHost:
         if not self._h:
             raise OSError(f"cannot bind {host}:{port}")
         self.port = self._lib.emqx_host_port(self._h)
+        self.ws_port = 0       # set by listen_ws()
         # The poll buffer must hold at least one whole event record: 13-byte
         # header + payload up to max_size (a max-size PUBLISH frame).  A
         # smaller buffer would leave host.cc unable to ever deliver that
@@ -433,6 +442,19 @@ class NativeHost:
             pos += 13
             yield kind, conn, raw[pos:pos + plen]
             pos += plen
+
+    def listen_ws(self, host: str = "127.0.0.1", port: int = 0,
+                  path: str = "/mqtt") -> int:
+        """Open the RFC6455 listener (BEFORE the poll thread starts).
+        Conns accepted there run the WS handshake + frame codec in C++
+        in front of the MQTT framer; their OPEN events carry a
+        ``ws:ip:port`` peer string. Returns the bound port."""
+        p = self._lib.emqx_host_listen_ws(
+            self._h, host.encode(), port, path.encode())
+        if p < 0:
+            raise OSError(f"cannot bind ws listener {host}:{port}")
+        self.ws_port = p
+        return p
 
     def send(self, conn: int, data: bytes) -> None:
         self._lib.emqx_host_send(self._h, conn, data, len(data))

@@ -53,14 +53,30 @@
 //
 // Event record wire format (host -> Python), little-endian:
 //   u8 kind | u64 conn_id | u32 len | payload[len]
-//   kind 1 = OPEN   payload = "ip:port" of the peer
+//   kind 1 = OPEN   payload = "ip:port" of the peer ("ws:ip:port" for
+//                   connections accepted on the WebSocket listener)
 //   kind 2 = FRAME  payload = one complete MQTT frame (verbatim bytes)
 //   kind 3 = CLOSED payload = reason string
 //   kind 4 = LANE   conn_id = lane seq, payload = topic (device match)
-//   kind 6 = TAP    payload = frame copy for the rule runtime
+//   kind 6 = TAP    payload = batched rule-tap records, one entry per
+//                   tapped publish: [u64 publisher][u8 flags][u16 tlen]
+//                   [topic] + (flags bit0 ? [u32 plen][payload] :
+//                   payload identical to the PREVIOUS entry in this
+//                   batch); flags bits 1-2 = qos, bit 3 = publisher
+//                   DUP. Pre-parsed and
+//                   payload-deduped so the Python rule worker never
+//                   re-parses MQTT (the old full-frame copies were the
+//                   rule-tap tax: BENCH_r05 rule_tap_vs_free=0.59)
 //   kind 7 = ACKS   payload = one batched ack/window record per poll
 //                   cycle: [u32 n] + n x ([u64 conn][u32 acked]
 //                   [u32 rel][u32 inflight_now][u32 pending_now])
+//
+// WebSocket (round 7): a second listener serves MQTT-over-WebSocket
+// (RFC6455, ws.h) on the SAME data plane: the upgrade handshake and
+// frame codec run below the GIL, decoded payload bytes feed the same
+// Framer/TryFast/ack machinery as TCP, and egress wraps each
+// serialized span in one binary frame. The asyncio WS server
+// (broker/ws.py) stays as the slow-plane oracle.
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -86,6 +102,7 @@
 
 #include "frame.h"
 #include "router.h"
+#include "ws.h"
 
 namespace emqx_native {
 namespace {
@@ -149,12 +166,21 @@ struct AckState {
   bool cyc_dirty = false;   // queued on ack_dirty_ this cycle
 };
 
+// Per-connection WebSocket transport state, allocated only for conns
+// accepted on the WS listener — plain TCP conns pay nothing.
+struct WsConnState {
+  bool open = false;        // 101 sent; frames flow
+  std::string hs_buf;       // HTTP upgrade request accumulation
+  ws::WsDecoder dec{/*require_mask=*/true};  // clients MUST mask (§5.3)
+};
+
 struct Conn {
   int fd = -1;
   Framer framer;
   std::string outbuf;   // unsent bytes (partial-write backlog)
   size_t outpos = 0;
   bool want_close = false;  // close once outbuf drains
+  std::unique_ptr<WsConnState> ws;  // non-null = WebSocket transport
   // -- fast path ----------------------------------------------------------
   bool fast = false;        // Python enabled the PUBLISH fast path
   uint8_t proto_ver = 4;    // 4 = MQTT 3.1.1, 5 = MQTT 5
@@ -226,8 +252,20 @@ enum StatSlot {
   kStLaneTopicOverflow,  // per-topic lane flood drops (was silently
                          // folded into kStDropsBackpressure)
   kStAckBatches,       // batched ack records emitted to Python
+  kStWsHandshakes,     // successful RFC6455 upgrades
+  kStWsRejects,        // upgrade requests answered 400
+  kStWsPings,          // client pings answered with pongs
+  kStWsCloses,         // client-initiated close frames honoured
   kStatCount
 };
+
+// Append one MQTT byte span to a conn's socket buffer; WS conns get it
+// wrapped in a binary frame (one frame per serialized span, matching
+// the asyncio server's one-frame-per-packet-batch shape).
+inline void AppendMqtt(Conn& c, const char* data, size_t len) {
+  if (c.ws) ws::AppendFrameHeader(&c.outbuf, ws::kOpBinary, len);
+  c.outbuf.append(data, len);
+}
 
 std::string EncodeRecord(uint8_t kind, uint64_t id, const char* data,
                          size_t len) {
@@ -255,6 +293,7 @@ class Host {
   ~Host() {
     for (auto& [id, c] : conns_) close(c.fd);
     if (listen_fd_ >= 0) close(listen_fd_);
+    if (listen_ws_fd_ >= 0) close(listen_ws_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
     if (epoll_fd_ >= 0) close(epoll_fd_);
   }
@@ -287,6 +326,42 @@ class Host {
   }
 
   int port() const { return port_; }
+  int ws_port() const { return ws_port_; }
+
+  // Open the WebSocket listener (call BEFORE the poll thread starts —
+  // it mutates the epoll set from the caller's thread). Conns accepted
+  // here run the RFC6455 handshake + frame codec in front of the MQTT
+  // framer; `path` is the required upgrade request-target ("" accepts
+  // any). Returns the bound port, or -1.
+  int ListenWs(const char* bind_addr, uint16_t port, const char* path) {
+    if (listen_ws_fd_ >= 0) return -1;  // one WS listener per host
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1 ||
+        bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        listen(fd, 1024) < 0) {
+      close(fd);
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenWsTag;
+    if (epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev) < 0) {
+      close(fd);
+      return -1;
+    }
+    listen_ws_fd_ = fd;
+    ws_port_ = ntohs(addr.sin_port);
+    ws_path_ = path ? path : "";
+    return ws_port_;
+  }
 
   // Thread-safe enqueue of outbound bytes for a connection.
   int Send(uint64_t id, const uint8_t* data, size_t len) {
@@ -386,6 +461,7 @@ class Host {
  private:
   static constexpr uint64_t kListenTag = ~0ull;
   static constexpr uint64_t kWakeTag = ~0ull - 1;
+  static constexpr uint64_t kListenWsTag = ~0ull - 2;
 
   void Wake() {
     uint64_t one = 1;
@@ -407,7 +483,8 @@ class Host {
     for (auto& [id, data] : sends) {
       auto it = conns_.find(id);
       if (it == conns_.end()) continue;
-      it->second.outbuf += data;
+      // one WS binary frame per send() batch on WS conns
+      AppendMqtt(it->second, data.data(), data.size());
       Flush(id, it->second);
     }
     for (uint64_t id : closes) {
@@ -642,7 +719,7 @@ class Host {
         char ack[4] = {static_cast<char>(qos == 1 ? 0x40 : 0x50), 0x02,
                        static_cast<char>(pid >> 8),
                        static_cast<char>(pid & 0xFF)};
-        pit->second.outbuf.append(ack, 4);
+        AppendMqtt(pit->second, ack, 4);
         MarkDirty(publisher, pit->second);
       }
     }
@@ -770,7 +847,10 @@ class Host {
         LanePunt(le, /*revoke_permit=*/false);
         continue;
       }
-      if (tapped) EmitTap(le.publisher, le.frame);
+      if (tapped)
+        EmitTap(le.publisher, le.qos,
+                (static_cast<uint8_t>(le.frame[0]) & 0x08) != 0, topic,
+                payload);
       stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
       if (le.qos == 1)
         stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
@@ -785,8 +865,8 @@ class Host {
       while (read(wake_fd_, &junk, sizeof(junk)) > 0) {}
       return;
     }
-    if (ev.data.u64 == kListenTag) {
-      Accept();
+    if (ev.data.u64 == kListenTag || ev.data.u64 == kListenWsTag) {
+      Accept(ev.data.u64 == kListenWsTag);
       return;
     }
     uint64_t id = ev.data.u64;
@@ -804,11 +884,12 @@ class Host {
     if (ev.events & EPOLLIN) Read(id, it->second);
   }
 
-  void Accept() {
+  void Accept(bool is_ws) {
+    int lfd = is_ws ? listen_ws_fd_ : listen_fd_;
     for (;;) {
       sockaddr_in peer{};
       socklen_t plen = sizeof(peer);
-      int fd = accept4(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &plen,
+      int fd = accept4(lfd, reinterpret_cast<sockaddr*>(&peer), &plen,
                        SOCK_NONBLOCK | SOCK_CLOEXEC);
       if (fd < 0) return;
       if (conns_.size() >= max_conns_) {  // esockd max-conn limiting
@@ -821,6 +902,7 @@ class Host {
       Conn c;
       c.fd = fd;
       c.framer = Framer(max_size_);
+      if (is_ws) c.ws = std::make_unique<WsConnState>();
       conns_.emplace(id, std::move(c));
       epoll_event ev{};
       ev.events = EPOLLIN;
@@ -828,7 +910,7 @@ class Host {
       epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
       char ip[INET_ADDRSTRLEN] = "?";
       inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
-      std::string info = std::string(ip) + ":" +
+      std::string info = std::string(is_ws ? "ws:" : "") + ip + ":" +
                          std::to_string(ntohs(peer.sin_port));
       events_.push_back(EncodeRecord(1, id, info.data(), info.size()));
     }
@@ -837,36 +919,147 @@ class Host {
   void Read(uint64_t id, Conn& c) {
     uint8_t chunk[kReadChunk];
     c.last_rx_ms = NowMs();
-    bool alive = true;
     for (;;) {
       ssize_t n = recv(c.fd, chunk, sizeof(chunk), 0);
       if (n > 0) {
-        std::vector<std::string> frames;
-        FrameStatus st = c.framer.Feed(chunk, static_cast<size_t>(n), &frames);
-        for (auto& f : frames) {
-          if (!c.fast || !TryFast(id, c, f))
-            events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+        bool ok;
+        if (c.ws) {
+          ok = WsIngest(id, c, chunk, static_cast<size_t>(n));
+        } else {
+          ok = IngestMqtt(id, c, chunk, static_cast<size_t>(n));
+          if (!ok) Drop(id, "frame_error", true);
         }
-        if (st != FrameStatus::kOk) {
-          Drop(id, "frame_error", true);
-          alive = false;
-          break;
-        }
+        if (!ok) break;  // conn dropped (or closing); c is dead
         if (static_cast<size_t>(n) < sizeof(chunk)) break;
       } else if (n == 0) {
         Drop(id, "sock_closed", true);
-        alive = false;
         break;
       } else {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
         Drop(id, "sock_error", true);
-        alive = false;
         break;
       }
     }
-    (void)alive;
     FlushDirty();
+  }
+
+  // Feed post-transport MQTT bytes into the frame scanner + fast path.
+  // Returns false on a framing error (poisoned framer state). Does NOT
+  // Drop: the WS path calls this from inside WsDecoder::Feed, and a
+  // Drop there would destroy the decoder whose stack frame is still
+  // live — callers drop AFTER the codec has unwound.
+  bool IngestMqtt(uint64_t id, Conn& c, const uint8_t* data, size_t len) {
+    std::vector<std::string> frames;
+    FrameStatus st = c.framer.Feed(data, len, &frames);
+    for (auto& f : frames) {
+      if (!c.fast || !TryFast(id, c, f))
+        events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+    }
+    return st == FrameStatus::kOk;
+  }
+
+  // WS transport ingest: HTTP upgrade first, then the RFC6455 codec in
+  // front of IngestMqtt (`data` is mutable: masked payloads unmask in
+  // place). Returns false when the conn is gone.
+  bool WsIngest(uint64_t id, Conn& c, uint8_t* data, size_t len) {
+    WsConnState& w = *c.ws;
+    if (!w.open) {
+      w.hs_buf.append(reinterpret_cast<const char*>(data), len);
+      size_t hdr_end = w.hs_buf.find("\r\n\r\n");
+      if (hdr_end == std::string::npos) {
+        if (w.hs_buf.size() > 16384) {  // runaway pre-upgrade request
+          Drop(id, "ws_handshake_overflow", true);
+          return false;
+        }
+        return true;
+      }
+      std::string key, path;
+      bool mqtt_proto = false;
+      bool ok = ws::ParseUpgradeRequest(
+          std::string_view(w.hs_buf).substr(0, hdr_end + 4), &key, &path,
+          &mqtt_proto);
+      if (!ok || (!ws_path_.empty() && path != ws_path_)) {
+        // same terminal answer as the asyncio oracle: 400, close. A
+        // non-/mqtt target is NOT served here — deployments keep the
+        // asyncio WS listener for any other endpoint.
+        stats_[kStWsRejects].fetch_add(1, std::memory_order_relaxed);
+        c.outbuf += ws::Build400();
+        Flush(id, c);
+        if (conns_.count(id)) Drop(id, "ws_handshake", true);
+        return false;
+      }
+      c.outbuf += ws::BuildUpgradeResponse(ws::AcceptKey(key), mqtt_proto);
+      MarkDirty(id, c);
+      stats_[kStWsHandshakes].fetch_add(1, std::memory_order_relaxed);
+      w.open = true;
+      // a client may pipeline its first frames behind the request
+      std::string leftover = w.hs_buf.substr(hdr_end + 4);
+      w.hs_buf.clear();
+      w.hs_buf.shrink_to_fit();
+      if (leftover.empty()) return true;
+      return WsDecode(id, c,
+                      reinterpret_cast<uint8_t*>(&leftover[0]),
+                      leftover.size());
+    }
+    return WsDecode(id, c, data, len);
+  }
+
+  bool WsDecode(uint64_t id, Conn& c, uint8_t* data, size_t len) {
+    bool mqtt_err = false, closing = false;
+    ws::WsStatus st = c.ws->dec.Feed(
+        data, len,
+        [&](const char* p, size_t n) {
+          // data payload bytes ARE the MQTT byte stream (packets need
+          // not align with WS frames — MQTT 5 §6.0); fragments
+          // reassemble by arriving here in order. A framing error only
+          // FLAGS here: the Drop must wait until Feed has unwound (it
+          // would destroy the decoder running this very callback).
+          if (n && !IngestMqtt(id, c,
+                               reinterpret_cast<const uint8_t*>(p), n)) {
+            mqtt_err = true;
+            return false;
+          }
+          return true;
+        },
+        [&](uint8_t op, const char* p, size_t n) {
+          if (op == ws::kOpPing) {  // pong echoes the ping payload
+            ws::AppendFrameHeader(&c.outbuf, ws::kOpPong, n);
+            c.outbuf.append(p, n);
+            MarkDirty(id, c);
+            stats_[kStWsPings].fetch_add(1, std::memory_order_relaxed);
+            return true;
+          }
+          if (op == ws::kOpClose) {
+            // echo the close (status code included) and tear down
+            ws::AppendFrameHeader(&c.outbuf, ws::kOpClose, n);
+            c.outbuf.append(p, n);
+            stats_[kStWsCloses].fetch_add(1, std::memory_order_relaxed);
+            closing = true;
+            return false;
+          }
+          return true;  // pong: keepalive evidence only
+        });
+    if (mqtt_err) {  // decoder is off the stack now: safe to tear down
+      Drop(id, "frame_error", true);
+      return false;
+    }
+    if (closing || st != ws::WsStatus::kOk) {
+      if (!closing) {
+        // protocol error: best-effort close frame with the oracle's
+        // codes (1002 protocol error / 1009 too big), then drop
+        uint16_t code = st == ws::WsStatus::kCtrlTooBig ? 1009 : 1002;
+        char body[2] = {static_cast<char>(code >> 8),
+                        static_cast<char>(code & 0xFF)};
+        ws::AppendFrameHeader(&c.outbuf, ws::kOpClose, 2);
+        c.outbuf.append(body, 2);
+      }
+      Flush(id, c);  // may itself Drop on sock_error
+      if (conns_.count(id))
+        Drop(id, closing ? "ws_close" : "ws_error", true);
+      return false;
+    }
+    return true;
   }
 
   // Flush every connection the fast path appended to during this read
@@ -938,7 +1131,7 @@ class Host {
         // the id to Python for a double publish.
         char rec[4] = {0x50, 0x02, static_cast<char>(pid >> 8),
                        static_cast<char>(pid & 0xFF)};
-        c.outbuf.append(rec, 4);
+        AppendMqtt(c, rec, 4);
         MarkDirty(id, c);
         return true;
       }
@@ -1044,42 +1237,75 @@ class Host {
     } else if (qos == 1) {
       stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
     }
-    if (tapped) EmitTap(id, f);
+    if (tapped) EmitTap(id, qos, (h & 0x08) != 0, topic, payload);
     FanOut(id, qos, pid, topic, payload);
     return true;
   }
 
-  // Copy a natively-served frame up to the rule runtime (kSubRuleTap
+  // Hand a natively-served publish to the rule runtime (kSubRuleTap
   // matched): delivery already happened in C++; Python only evaluates
-  // the rules against it, asynchronously. Copies BATCH into one event
-  // record per poll cycle ([u64 publisher][u32 len][frame]...) — a
-  // per-message record made Python's event decode the data-plane
-  // bottleneck (measured: 1.7M -> 0.3M msg/s under a FROM '#' rule).
-  void EmitTap(uint64_t publisher, const std::string& frame) {
+  // the rules against it, asynchronously. Entries BATCH into one event
+  // record per poll cycle — a per-message record made Python's event
+  // decode the data-plane bottleneck (measured: 1.7M -> 0.3M msg/s
+  // under a FROM '#' rule). Round 7 copy elision (the remaining
+  // rule-tap tax, BENCH_r05 rule_tap_vs_free=0.59): entries carry the
+  // PRE-PARSED fields ([u64 publisher][u8 flags][u16 tlen][topic]
+  // [u32 plen][payload]) instead of whole-frame copies, so the Python
+  // worker never re-parses MQTT while the blast is live, and a payload
+  // identical to the previous entry's is elided (flags bit0 = 0) — the
+  // shared delivery frames were already built once per publish; the tap
+  // plane now follows the same discipline. flags: bit0 = payload
+  // inline, bits1-2 = qos, bit3 = publisher DUP.
+  void EmitTap(uint64_t publisher, uint8_t qos, bool dup_flag,
+               std::string_view topic, std::string_view payload) {
     stats_[kStTaps].fetch_add(1, std::memory_order_relaxed);
     // flush BEFORE an append that would overflow the cap: the Python
     // poll buffer is max_size_+64, and Poll silently drops any record
     // larger than the caller's whole buffer — a lost batch would be
     // hundreds of rule messages with no accounting. With this
-    // discipline a record never exceeds max(cap, 12 + max frame) + 13,
-    // which always fits (framer bounds frames at max_size_).
+    // discipline a record never exceeds max(cap, one max-size entry)
+    // + 13, which always fits (framer bounds frames at max_size_).
     size_t cap = kTapFlushBytes;
     if (cap > max_size_ / 2) cap = max_size_ / 2 + 1;
-    if (!tap_buf_.empty() && tap_buf_.size() + 12 + frame.size() > cap)
+    size_t entry_max = 15 + topic.size() + payload.size();
+    if (tap_buf_.size() > 13 && tap_buf_.size() - 13 + entry_max > cap)
       FlushTaps();
-    char hdr[12];
+    // header slot AFTER the flush check: a mid-batch flush empties the
+    // buffer, and appending into it headerless would let FlushTaps
+    // stamp the record header over the first entry (corrupt batch)
+    if (tap_buf_.empty()) tap_buf_.assign(13, '\0');
+    bool dup = tap_have_prev_ && payload == tap_prev_payload_;
+    char hdr[11];
     memcpy(hdr, &publisher, 8);
-    uint32_t len = static_cast<uint32_t>(frame.size());
-    memcpy(hdr + 8, &len, 4);
-    tap_buf_.append(hdr, 12);
-    tap_buf_ += frame;
-    if (tap_buf_.size() > cap) FlushTaps();
+    hdr[8] = static_cast<char>((dup ? 0 : 1) | (qos << 1)
+                               | (dup_flag ? 8 : 0));
+    uint16_t tl = static_cast<uint16_t>(topic.size());
+    memcpy(hdr + 9, &tl, 2);
+    tap_buf_.append(hdr, 11);
+    tap_buf_.append(topic.data(), topic.size());
+    if (!dup) {
+      uint32_t pl = static_cast<uint32_t>(payload.size());
+      tap_buf_.append(reinterpret_cast<const char*>(&pl), 4);
+      tap_buf_.append(payload.data(), payload.size());
+      tap_prev_payload_.assign(payload.data(), payload.size());
+      tap_have_prev_ = true;
+    }
+    if (tap_buf_.size() - 13 > cap) FlushTaps();
   }
 
   void FlushTaps() {
-    if (tap_buf_.empty()) return;
-    events_.push_back(EncodeRecord(6, 0, tap_buf_.data(), tap_buf_.size()));
+    if (tap_buf_.size() <= 13) return;
+    // patch the record header in place and MOVE the buffer out: the
+    // batch is copied once (into the poll buffer), not re-copied
+    // through EncodeRecord first
+    tap_buf_[0] = 6;
+    uint64_t id = 0;
+    memcpy(&tap_buf_[1], &id, 8);
+    uint32_t plen = static_cast<uint32_t>(tap_buf_.size() - 13);
+    memcpy(&tap_buf_[9], &plen, 4);
+    events_.push_back(std::move(tap_buf_));
     tap_buf_.clear();
+    tap_have_prev_ = false;  // dedup never crosses a record boundary
   }
 
   AckState& EnsureAck(Conn& c) {
@@ -1112,7 +1338,7 @@ class Host {
       std::string& shared = t.proto_ver == 5 ? frame_v5_ : frame_v4_;
       if (shared.empty())
         BuildPublish(&shared, topic, payload, 0, 0, t.proto_ver == 5);
-      t.outbuf += shared;
+      AppendMqtt(t, shared.data(), shared.size());
       stats_[kStFastBytesOut].fetch_add(shared.size(),
                                         std::memory_order_relaxed);
     } else {
@@ -1141,6 +1367,8 @@ class Host {
         return true;   // admitted; kStFastOut counts at dequeue
       }
       uint16_t tp = NextPid(a);
+      if (t.ws)  // frame header first so `at` lands on the MQTT bytes
+        ws::AppendFrameHeader(&t.outbuf, ws::kOpBinary, sq.size());
       size_t at = t.outbuf.size();
       t.outbuf += sq;
       t.outbuf[at] = static_cast<char>(0x30 | (out_qos << 1));
@@ -1177,7 +1405,7 @@ class Host {
       uint16_t np = NextPid(a);
       frame[pid_off] = static_cast<char>(np >> 8);
       frame[pid_off + 1] = static_cast<char>(np & 0xFF);
-      c.outbuf += frame;
+      AppendMqtt(c, frame.data(), frame.size());
       stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
       stats_[kStFastBytesOut].fetch_add(frame.size(),
                                         std::memory_order_relaxed);
@@ -1217,7 +1445,7 @@ class Host {
     // own a pid in this space, so consuming is always safe
     char rel[4] = {0x62, 0x02, static_cast<char>(pid >> 8),
                    static_cast<char>(pid & 0xFF)};
-    c.outbuf.append(rel, 4);
+    AppendMqtt(c, rel, 4);
     MarkDirty(id, c);
     return true;
   }
@@ -1256,7 +1484,7 @@ class Host {
     stats_[kStQos2Rel].fetch_add(1, std::memory_order_relaxed);
     char comp[4] = {0x70, 0x02, static_cast<char>(pid >> 8),
                     static_cast<char>(pid & 0xFF)};
-    c.outbuf.append(comp, 4);
+    AppendMqtt(c, comp, 4);
     MarkDirty(id, c);
     return true;
   }
@@ -1453,7 +1681,15 @@ class Host {
   // shape the device cannot see still force the Python fan-out
   SubTable punt_subs_;
   std::vector<const SubEntry*> punt_scratch_;
-  std::string tap_buf_;  // batched rule-tap copies awaiting one event
+  // batched rule-tap entries awaiting one event; bytes [0,13) are the
+  // record header slot FlushTaps patches before moving the buffer out
+  std::string tap_buf_;
+  std::string tap_prev_payload_;  // payload-dedup reference
+  bool tap_have_prev_ = false;
+  // -- websocket listener --------------------------------------------------
+  int listen_ws_fd_ = -1;
+  int ws_port_ = 0;
+  std::string ws_path_ = "/mqtt";  // required upgrade request-target
 };
 
 }  // namespace
@@ -1476,6 +1712,15 @@ void* emqx_host_create(const char* bind_addr, uint16_t port,
 
 int emqx_host_port(void* h) {
   return static_cast<emqx_native::Host*>(h)->port();
+}
+
+// Open the RFC6455 listener on an already-created host. Call BEFORE
+// the poll thread starts (the epoll set is mutated from this thread).
+// Returns the bound port, or -1.
+int emqx_host_listen_ws(void* h, const char* bind_addr, uint16_t port,
+                        const char* path) {
+  return static_cast<emqx_native::Host*>(h)->ListenWs(bind_addr, port,
+                                                      path);
 }
 
 long emqx_host_poll(void* h, uint8_t* buf, size_t cap, int timeout_ms) {

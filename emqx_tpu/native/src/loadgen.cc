@@ -25,11 +25,13 @@
 #include <vector>
 
 #include "frame.h"
+#include "ws.h"
 
 namespace {
 
 using emqx_native::Framer;
 using emqx_native::FrameStatus;
+namespace lws = emqx_native::ws;
 
 inline uint64_t NowNs() {
   timespec ts;
@@ -121,6 +123,15 @@ struct LgConn {
   bool subacked = false;
   bool is_sub = false;
   uint32_t idx = 0;
+  // -- ws mode (the emqtt-bench `ws://` analogue) --------------------------
+  bool ws = false;
+  bool ws_open = false;      // 101 received; frames flow
+  std::string ws_hs;         // upgrade response accumulation
+  // server frames arrive unmasked (require_mask=false); the decoder
+  // still handles a masked frame generically should one appear
+  lws::WsDecoder ws_dec{/*require_mask=*/false};
+  uint8_t ws_key[4] = {};    // nonzero client mask key (per conn)
+  std::string cid;           // CONNECT is deferred until the 101 lands
 };
 
 struct Loadgen {
@@ -135,6 +146,22 @@ struct Loadgen {
     for (auto& c : conns)
       if (c.fd >= 0) close(c.fd);
     if (ep >= 0) close(ep);
+  }
+
+  // Append MQTT bytes to a conn's socket buffer; ws conns wrap them in
+  // one masked binary frame (clients MUST mask, RFC6455 §5.3 — the key
+  // is nonzero so the broker pays the real unmask cost).
+  void AppendOut(LgConn& c, const std::string& bytes) {
+    if (!c.ws) {
+      c.outbuf += bytes;
+      return;
+    }
+    lws::AppendFrameHeader(&c.outbuf, lws::kOpBinary, bytes.size(),
+                           c.ws_key);
+    size_t at = c.outbuf.size();
+    c.outbuf += bytes;
+    for (size_t i = 0; i < bytes.size(); i++)
+      c.outbuf[at + i] ^= static_cast<char>(c.ws_key[i & 3]);
   }
 
   bool FlushOut(LgConn& c) {
@@ -186,7 +213,7 @@ struct Loadgen {
         pos += 2;
         // qos1 delivery → PUBACK; qos2 → PUBREC (broker answers
         // PUBREL, completed below)
-        c.outbuf += Ack(dqos == 1 ? 0x40 : 0x50, pid);
+        AppendOut(c, Ack(dqos == 1 ? 0x40 : 0x50, pid));
       }
       if (proto_ver == 5 && pos < f.size()) {
         uint8_t plen = static_cast<uint8_t>(f[pos]);
@@ -203,12 +230,65 @@ struct Loadgen {
     } else if (type == 4) {  // PUBACK for our qos1 publishes
       acks++;
     } else if (type == 5) {  // PUBREC for our qos2 publish → PUBREL
-      c.outbuf += Ack(0x62, AckPid(f));
+      AppendOut(c, Ack(0x62, AckPid(f)));
     } else if (type == 6) {  // PUBREL from the broker → PUBCOMP
-      c.outbuf += Ack(0x70, AckPid(f));
+      AppendOut(c, Ack(0x70, AckPid(f)));
     } else if (type == 7) {  // PUBCOMP completes our qos2 publish
       acks++;
     }
+  }
+
+  // One conn's inbound bytes → MQTT frames (through the ws codec when
+  // applicable; `data` is mutable for in-place unmasking). Returns
+  // false on a framing/protocol error.
+  bool Ingest(LgConn& c, uint8_t* data, size_t len) {
+    if (!c.ws) return FeedMqtt(c, data, len);
+    if (!c.ws_open) {
+      c.ws_hs.append(reinterpret_cast<const char*>(data), len);
+      size_t end = c.ws_hs.find("\r\n\r\n");
+      if (end == std::string::npos) return c.ws_hs.size() <= 16384;
+      if (c.ws_hs.compare(0, 12, "HTTP/1.1 101") != 0) return false;
+      c.ws_open = true;
+      AppendOut(c, Connect(c.cid, proto_ver));  // deferred CONNECT
+      std::string left = c.ws_hs.substr(end + 4);
+      c.ws_hs.clear();
+      if (left.empty()) return true;
+      return WsFeed(c, reinterpret_cast<uint8_t*>(&left[0]), left.size());
+    }
+    return WsFeed(c, data, len);
+  }
+
+  bool WsFeed(LgConn& c, uint8_t* data, size_t len) {
+    bool ok = true;
+    lws::WsStatus st = c.ws_dec.Feed(
+        data, len,
+        [&](const char* p, size_t n) {
+          if (n && !FeedMqtt(c, reinterpret_cast<const uint8_t*>(p), n)) {
+            ok = false;
+            return false;
+          }
+          return true;
+        },
+        [&](uint8_t op, const char* p, size_t n) {
+          if (op == lws::kOpPing) {  // masked pong echo
+            lws::AppendFrameHeader(&c.outbuf, lws::kOpPong, n, c.ws_key);
+            size_t at = c.outbuf.size();
+            c.outbuf.append(p, n);
+            for (size_t i = 0; i < n; i++)
+              c.outbuf[at + i] ^= static_cast<char>(c.ws_key[i & 3]);
+            return true;
+          }
+          return op != lws::kOpClose;  // close ends the conn
+        });
+    return ok && st == lws::WsStatus::kOk;
+  }
+
+  bool FeedMqtt(LgConn& c, const uint8_t* data, size_t len) {
+    std::vector<std::string> frames;
+    if (c.framer.Feed(data, len, &frames) != FrameStatus::kOk)
+      return false;
+    for (auto& f : frames) OnFrame(c, f);
+    return true;
   }
 
   // Pump readable/writable conns once; returns false on fatal error.
@@ -238,15 +318,12 @@ struct Loadgen {
       for (;;) {
         ssize_t r = recv(c.fd, chunk, sizeof(chunk), 0);
         if (r > 0) {
-          std::vector<std::string> frames;
-          if (c.framer.Feed(chunk, static_cast<size_t>(r), &frames) !=
-              FrameStatus::kOk) {
+          if (!Ingest(c, chunk, static_cast<size_t>(r))) {
             errors++;
             close(c.fd);
             c.fd = -1;
             break;
           }
-          for (auto& f : frames) OnFrame(c, f);
           if (!c.outbuf.empty()) FlushOut(c);  // pubacks
           if (static_cast<size_t>(r) < sizeof(chunk)) break;
         } else if (r == 0) {
@@ -288,10 +365,14 @@ extern "C" {
 //   waits, letting the broker's permit machinery move those
 //   (conn, topic) pairs onto the native fast path before the clock
 //   starts (permits are per-connection, so warming must happen in-run).
+// ws != 0: the whole fleet speaks MQTT-over-WebSocket (RFC6455
+//   upgrade on /mqtt, masked binary frames with nonzero keys so the
+//   broker pays the real unmask cost) — point `port` at the broker's
+//   WS listener.
 int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
                      uint32_t n_pubs, uint32_t msgs_per_pub, uint8_t qos,
                      uint32_t payload_len, int proto_ver, int idle_timeout_ms,
-                     uint32_t window, int warmup, uint64_t* out) {
+                     uint32_t window, int warmup, int ws, uint64_t* out) {
   Loadgen lg;
   lg.proto_ver = proto_ver;
   lg.qos = qos;
@@ -320,7 +401,21 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
     ev.data.u32 = i;
     epoll_ctl(lg.ep, EPOLL_CTL_ADD, c.fd, &ev);
     std::string cid = (c.is_sub ? "lgs" : "lgp") + std::to_string(i);
-    c.outbuf += Connect(cid, proto_ver);
+    if (ws) {
+      c.ws = true;
+      c.cid = cid;
+      uint64_t seed = NowNs() ^ (0x9E3779B97F4A7C15ull * (i + 1));
+      for (int b = 0; b < 4; b++)
+        c.ws_key[b] = static_cast<uint8_t>((seed >> (8 * b)) & 0xFF);
+      if (!(c.ws_key[0] | c.ws_key[1] | c.ws_key[2] | c.ws_key[3]))
+        c.ws_key[0] = 1;
+      // handshake request is HTTP, not a frame: raw bytes; the CONNECT
+      // follows from Ingest once the 101 arrives
+      c.outbuf += lws::BuildUpgradeRequest(
+          host, "/mqtt", "bG9hZGdlbi1ub25jZS0wMDE=");
+    } else {
+      c.outbuf += Connect(cid, proto_ver);
+    }
     lg.FlushOut(c);
   }
 
@@ -339,7 +434,8 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
   for (uint32_t i = 0; i < n_subs; i++) {
     LgConn& c = lg.conns[i];
     if (c.fd < 0) continue;
-    c.outbuf += Subscribe(1, "lg/" + std::to_string(i) + "/+", qos, proto_ver);
+    lg.AppendOut(c, Subscribe(1, "lg/" + std::to_string(i) + "/+", qos,
+                              proto_ver));
     lg.FlushOut(c);
   }
   while (!all(&LgConn::subacked, true)) {
@@ -362,8 +458,8 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
         uint64_t stamp = NowNs();
         std::string payload(reinterpret_cast<char*>(&stamp), 8);
         payload += pad;
-        c.outbuf += Publish("lg/" + std::to_string(k) + "/m", payload, 0, 0,
-                            proto_ver);
+        lg.AppendOut(c, Publish("lg/" + std::to_string(k) + "/m", payload,
+                                0, 0, proto_ver));
       }
       lg.FlushOut(c);
     }
@@ -401,7 +497,7 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
         std::string topic =
             "lg/" + std::to_string((j + next_msg[j]) % n_subs) + "/m";
         if (qos) pid = pid == 0x7FFF ? 1 : pid + 1;
-        c.outbuf += Publish(topic, payload, qos, pid, proto_ver);
+        lg.AppendOut(c, Publish(topic, payload, qos, pid, proto_ver));
         next_msg[j]++;
         lg.sent++;
       }

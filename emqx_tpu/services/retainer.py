@@ -17,6 +17,14 @@ measured 2.9k lookups/sec at 100K retained):
   filter's first two levels are literal (the common
   ``vendor/device/...`` shape — buckets cut 100K rows to the ~200
   sharing the prefix), else the whole matrix is scanned;
+- each bucket IS a compact submatrix (token rows, depth, deadline,
+  message/topic lists) maintained INCREMENTALLY on store/delete/expire
+  — append and swap-with-last writes, amortized-doubling growth. The
+  round-6 design rebuilt a per-bucket cache on the first lookup after
+  any churn, which made exactly the lookup the reference's
+  word-position index serves fast (first wildcard match after a churn
+  burst) pay a ~10x rebuild cliff (BENCH_r05
+  retained_lookups_per_sec_cold=11.7k vs 108k warm);
 - topics deeper than ``MAX_LEVELS`` go to a tiny fallback dict walked
   with ``T.match`` (they are rare; correctness is preserved).
 
@@ -39,6 +47,75 @@ from emqx_tpu.core import topic as T
 from emqx_tpu.core.message import Message, now_ms
 
 MAX_LEVELS = 16
+
+
+class _Bucket:
+    """One (level0-id, level1-id) prefix bucket: a compact, always-live
+    submatrix of the retained-topic token matrix, position-aligned with
+    its message/topic lists. Updated in place on every store/delete —
+    append at ``n`` (amortized-doubling growth) and swap-with-last
+    removal — so a lookup right after churn reads ready arrays instead
+    of rebuilding a cache."""
+
+    __slots__ = ("n", "tok", "depth", "deadline", "stored", "msgs",
+                 "topics", "rows", "finite")
+
+    def __init__(self, cap: int = 8):
+        self.n = 0
+        self.tok = np.zeros((cap, MAX_LEVELS), dtype=np.int32)
+        self.depth = np.zeros(cap, dtype=np.int32)
+        self.deadline = np.full(cap, np.inf)
+        self.stored = np.zeros(cap, dtype=np.int64)
+        self.msgs: list = []
+        self.topics: list[str] = []
+        self.rows: list[int] = []    # global row ids, position-aligned
+        # sticky "a finite per-message deadline was ever seen": False
+        # keeps the hit-dense one-extend fast path; deletes never clear
+        # it (conservative)
+        self.finite = False
+
+    def append(self, row: int, tok_row, depth: int, deadline: float,
+               stored: int, msg, topic: str) -> int:
+        if self.n == self.tok.shape[0]:
+            cap = self.n * 2
+            for name in ("tok", "depth", "deadline", "stored"):
+                old = getattr(self, name)
+                new = np.full((cap,) + old.shape[1:],
+                              np.inf if name == "deadline" else 0,
+                              dtype=old.dtype)
+                new[: self.n] = old
+                setattr(self, name, new)
+        pos = self.n
+        self.tok[pos] = tok_row
+        self.depth[pos] = depth
+        self.deadline[pos] = deadline
+        self.stored[pos] = stored
+        self.msgs.append(msg)
+        self.topics.append(topic)
+        self.rows.append(row)
+        if deadline != np.inf:
+            self.finite = True
+        self.n = pos + 1
+        return pos
+
+    def remove(self, pos: int) -> "int | None":
+        """Swap-with-last removal; returns the global row id that moved
+        INTO ``pos`` (the caller re-points its position map), or None."""
+        last = self.n - 1
+        moved = None
+        if pos != last:
+            self.tok[pos] = self.tok[last]
+            self.depth[pos] = self.depth[last]
+            self.deadline[pos] = self.deadline[last]
+            self.stored[pos] = self.stored[last]
+            self.msgs[pos] = self.msgs[last]
+            self.topics[pos] = self.topics[last]
+            self.rows[pos] = moved = self.rows[last]
+        self.msgs.pop()
+        self.topics.pop()
+        self.rows.pop()
+        self.n = last
+        return moved
 
 
 class Retainer:
@@ -66,11 +143,11 @@ class Retainer:
         self._alive = np.zeros(cap, dtype=bool)
         self._n = 0                   # rows used (live + tombstoned)
         self._dead = 0
-        # (id0, id1) -> LIVE row list; _bucket_np caches the compact
-        # per-bucket submatrices (see _bucket_cache), invalidated on any
-        # store/delete touching the bucket
-        self._bucket: dict[tuple[int, int], list[int]] = {}
-        self._bucket_np: dict[tuple[int, int], tuple] = {}
+        # (id0, id1) -> always-live compact submatrix, maintained
+        # incrementally on store/delete/expire (no rebuild-on-miss);
+        # _bpos maps a global row to its position inside its bucket
+        self._bucket: dict[tuple[int, int], _Bucket] = {}
+        self._bpos: dict[int, int] = {}
         # topics deeper than MAX_LEVELS: topic -> (msg, stored_at)
         self._deep: dict[str, tuple[Message, int]] = {}
 
@@ -123,10 +200,18 @@ class Retainer:
             if row is not None:
                 self._msgs[row] = kept
                 self._stored[row] = now
-                self._deadline[row] = self._msg_deadline(kept)
+                dl = self._msg_deadline(kept)
+                self._deadline[row] = dl
                 self._stored_np[row] = now
-                self._bucket_np.pop(
-                    (int(self._tok[row, 0]), int(self._tok[row, 1])), None)
+                # in-place bucket refresh at the row's known position
+                b = self._bucket[(int(self._tok[row, 0]),
+                                  int(self._tok[row, 1]))]
+                pos = self._bpos[row]
+                b.deadline[pos] = dl
+                b.stored[pos] = now
+                b.msgs[pos] = kept
+                if dl != np.inf:
+                    b.finite = True
                 return True
             if self.max_retained and self._count >= self.max_retained:
                 self.dropped += 1
@@ -145,11 +230,15 @@ class Retainer:
             self._topics.append(topic)
             self._msgs.append(kept)
             self._stored.append(now)
-            self._deadline[row] = self._msg_deadline(kept)
+            dl = self._msg_deadline(kept)
+            self._deadline[row] = dl
             self._stored_np[row] = now
             key = (ids[0], ids[1] if len(ids) > 1 else 0)
-            self._bucket.setdefault(key, []).append(row)
-            self._bucket_np.pop(key, None)
+            b = self._bucket.get(key)
+            if b is None:
+                b = self._bucket[key] = _Bucket()
+            self._bpos[row] = b.append(
+                row, self._tok[row], len(ids), dl, now, kept, topic)
             self._count += 1
             return True
 
@@ -167,15 +256,14 @@ class Retainer:
             self._dead += 1
             self._count -= 1
             key = (int(self._tok[row, 0]), int(self._tok[row, 1]))
-            rows = self._bucket.get(key)
-            if rows is not None:
-                try:
-                    rows.remove(row)     # buckets hold live rows only
-                except ValueError:
-                    pass
-                if not rows:
+            b = self._bucket.get(key)
+            pos = self._bpos.pop(row, None)
+            if b is not None and pos is not None:
+                moved = b.remove(pos)    # buckets hold live rows only
+                if moved is not None:
+                    self._bpos[moved] = pos
+                if b.n == 0:
                     del self._bucket[key]
-            self._bucket_np.pop(key, None)
             # tombstones compact when they dominate — O(n) rebuild
             # amortized over >= n/2 deletes
             if self._dead > 1024 and self._dead * 2 > self._n:
@@ -206,10 +294,16 @@ class Retainer:
             ids = [self._wid(w) for w in T.words(t)]
             self._tok[i, : len(ids)] = ids
         self._bucket.clear()
-        self._bucket_np.clear()
-        for i in range(self._n):
+        self._bpos.clear()
+        for i, topic_i in enumerate(topics):
             key = (int(self._tok[i, 0]), int(self._tok[i, 1]))
-            self._bucket.setdefault(key, []).append(i)
+            b = self._bucket.get(key)
+            if b is None:
+                b = self._bucket[key] = _Bucket()
+            self._bpos[i] = b.append(
+                i, self._tok[i], int(self._depth[i]),
+                float(self._deadline[i]), int(self._stored_np[i]),
+                self._msgs[i], topic_i)
 
     # -- inverse-trie lookup (vectorized) ------------------------------------
 
@@ -235,33 +329,6 @@ class Retainer:
                 self.delete(topic)
         return out
 
-    def _bucket_cache(self, key: tuple[int, int]):
-        """Per-bucket compact cache: submatrix copies + row-aligned
-        msg/topic lists, rebuilt lazily after any store/delete touching
-        the bucket. Buckets hold only LIVE rows, so the bucketed match
-        needs no alive mask and a full-bucket hit emits with one
-        ``list.extend`` — the per-op numpy overhead on ~10² candidate
-        rows is the budget here, not the arithmetic."""
-        cache = self._bucket_np.get(key)
-        if cache is None:
-            rows = self._bucket.get(key)
-            if not rows:
-                return None
-            idx = np.asarray(rows, dtype=np.int64)
-            dl = self._deadline[idx]
-            cache = (
-                idx,
-                self._tok[idx],
-                self._depth[idx],
-                dl,
-                self._stored_np[idx],
-                [self._msgs[r] for r in rows],
-                [self._topics[r] for r in rows],
-                bool(np.isinf(dl).all()),    # no per-message expiry set
-            )
-            self._bucket_np[key] = cache
-        return cache
-
     def _match_rows(self, fw: list[str], now: int, out: list[Message],
                     expired: list[str]) -> None:
         n = self._n
@@ -275,16 +342,21 @@ class Retainer:
             # loops below must never index past the token matrix
             return
         # candidate narrowing: two literal leading levels hit a bucket
+        # whose compact arrays are ALWAYS live (no rebuild-on-miss: the
+        # round-6 lazy cache made the first lookup after churn pay ~10x)
         if len(fw) >= 2 and fw[0] not in (T.PLUS, T.HASH) \
                 and fw[1] not in (T.PLUS, T.HASH):
             id0 = self._vocab.get(fw[0])
             id1 = self._vocab.get(fw[1])
             if id0 is None or id1 is None:
                 return                    # no retained topic has the prefix
-            cache = self._bucket_cache((id0, id1))
-            if cache is None:
+            b = self._bucket.get((id0, id1))
+            if b is None:
                 return
-            idx, tok, depth, dl, stored, msgs, topics, all_inf = cache
+            n_b = b.n
+            tok = b.tok[:n_b]
+            depth = b.depth[:n_b]
+            msgs = b.msgs
             mask = (depth >= need) if has_hash else (depth == need)
             # levels 0/1 == the bucket key; need<=MAX_LEVELS bounds i
             for i in range(2, min(len(fw), MAX_LEVELS)):
@@ -297,19 +369,19 @@ class Retainer:
                 if wid is None:
                     return                # literal word never stored
                 mask &= tok[:, i] == wid
-            if all_inf and not self.default_expiry_ms:
+            if not b.finite and not self.default_expiry_ms:
                 if mask.all():            # hit-dense fast path: one extend
                     out.extend(msgs)
                 else:
                     out.extend([msgs[j] for j in np.nonzero(mask)[0].tolist()])
                 return
-            fresh = dl > now
+            fresh = b.deadline[:n_b] > now
             if self.default_expiry_ms:
-                fresh &= (now - stored) < self.default_expiry_ms
+                fresh &= (now - b.stored[:n_b]) < self.default_expiry_ms
             stale = np.nonzero(mask & ~fresh)[0]
             hitj = np.nonzero(mask & fresh)[0]
             out.extend([msgs[j] for j in hitj.tolist()])
-            expired.extend([topics[j] for j in stale.tolist()])
+            expired.extend([b.topics[j] for j in stale.tolist()])
             return
         # full scan: wildcard in the first two levels
         tok = self._tok[:n]

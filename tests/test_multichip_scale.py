@@ -1,9 +1,12 @@
-"""Multi-device proof at engagement scale (VERDICT r3 #6): the same
-100k-filter set must route identically on a single device and a 4x2
-(dp, tp) mesh — including dense-pool (high-degree) filters under
-tp-sharding — and the FULL serving stack (broker + pipeline + kernel)
-must run on a mesh end-to-end. Reference frame: SURVEY §2.5-3/4;
-the mesh axes are emqx's subscriber sharding re-expressed as
+"""Multi-device proof at engagement scale (VERDICT r3 #6, widened in
+round 7 per VERDICT weak #7): the same 100k-filter set must route
+identically on a single device and every (dp, tp) split of an 8-device
+mesh — tp ∈ {1, 2, 4}, including dense-pool (high-degree) filters under
+tp-sharding and an UNEVEN final batch (B not divisible by the mesh
+extent) — the FULL serving stack (broker + pipeline + kernel) must run
+on a mesh end-to-end, and a device loss mid-serving must fail over to
+the host oracle without dropping deliveries. Reference frame: SURVEY
+§2.5-3/4; the mesh axes are emqx's subscriber sharding re-expressed as
 jax.sharding (parallel/mesh.py)."""
 
 import asyncio
@@ -15,7 +18,12 @@ from emqx_tpu.models.router_model import RouterModel
 from emqx_tpu.parallel.mesh import make_mesh
 from emqx_tpu.router.index import TrieIndex
 
-N_SLOTS = 64 * 32 * 2      # divisible by 32*tp for tp=2
+N_SLOTS = 64 * 32 * 2      # divisible by 32*tp for every tp in {1,2,4}
+
+# every 8-device (dp, tp) split: tp=1 (pure data parallel), the default
+# 4x2, and tp=4 (fan-out-heavy) — tp-sharding must stay a pure layout
+# choice at each point
+MESH_SHAPES = [(8, 1), (4, 2), (2, 4)]
 
 
 def _populate(model, n=110_000, dense_fids=8, dense_degree=100):
@@ -62,29 +70,58 @@ def _topics(n=128):
     return out
 
 
-def test_parity_single_vs_mesh_at_100k():
+def _build_model(mesh=None):
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=N_SLOTS,
+                        K=32, M=64, mesh=mesh)
+    _populate(model)
+    return model
+
+
+@pytest.fixture(scope="module")
+def single_model():
     import jax
 
     assert len(jax.devices()) >= 8
-    single = RouterModel(TrieIndex(max_levels=8), n_sub_slots=N_SLOTS,
-                         K=32, M=64)
-    _populate(single)
-    n_distinct = sum(f is not None for f in single.index.filters)
+    model = _build_model()
+    n_distinct = sum(f is not None for f in model.index.filters)
     assert n_distinct >= 100_000, n_distinct
-    assert len(single._dense_row) >= 8, "dense pool not populated"
+    assert len(model._dense_row) >= 8, "dense pool not populated"
+    return model
 
-    mesh = make_mesh(8, shape=(4, 2))
-    sharded = RouterModel(TrieIndex(max_levels=8), n_sub_slots=N_SLOTS,
-                          K=32, M=64, mesh=mesh)
-    _populate(sharded)
-    assert len(sharded._dense_row) >= 8
 
-    topics = _topics()
-    r1 = single.publish_batch(topics)
+@pytest.fixture(scope="module")
+def sharded_models():
+    """One populated model per mesh shape, built lazily and cached for
+    the whole parametrized matrix (a fresh 110k-filter build per case
+    would dominate the suite)."""
+    cache: dict = {}
+
+    def get(shape):
+        if shape not in cache:
+            mesh = make_mesh(8, shape=shape)
+            model = _build_model(mesh)
+            assert len(model._dense_row) >= 8
+            cache[shape] = model
+        return cache[shape]
+
+    return get
+
+
+# 3 shapes x 2 batch geometries = 6 parity cases. nbatch=77 is the
+# UNEVEN final batch: 77 is divisible by none of dp, tp, or dp*tp for
+# any shape here, so the kernel's padding row must mask out cleanly.
+@pytest.mark.parametrize("shape", MESH_SHAPES,
+                         ids=[f"dp{d}tp{t}" for d, t in MESH_SHAPES])
+@pytest.mark.parametrize("nbatch", [128, 77], ids=["aligned", "uneven"])
+def test_parity_single_vs_mesh_at_100k(single_model, sharded_models,
+                                       shape, nbatch):
+    sharded = sharded_models(shape)
+    topics = _topics()[:nbatch]
+    r1 = single_model.publish_batch(topics)
     r2 = sharded.publish_batch(topics)
     # matched filters, aux matches, fan-out slots and fallback set must
-    # be identical — tp-sharding (incl. the dense-pool OR) is a pure
-    # layout choice, never a semantic one
+    # be identical — (dp, tp) sharding (incl. the dense-pool OR) is a
+    # pure layout choice, never a semantic one
     assert r1[0] == r2[0]
     assert r1[1] == r2[1]
     assert [sorted(s) for s in r1[2]] == [sorted(s) for s in r2[2]]
@@ -135,6 +172,60 @@ def test_full_stack_serving_on_mesh():
         assert model.launch_count > launches0, "mesh kernel never launched"
         for c in subs + [pub]:
             await c.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("stage", ["submit", "collect"])
+def test_device_loss_fails_over_to_host(stage):
+    """Device loss mid-serving (VERDICT weak #7): when the mesh kernel
+    dies — at launch or at collect — the broker serves the batch from
+    the host oracle instead of dropping it, counts the failover, and
+    keeps delivering."""
+    import jax
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.mqtt.client import MqttClient
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8, shape=(4, 2))
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=N_SLOTS,
+                        K=32, M=64, mesh=mesh)
+    app = BrokerApp(router_model=model)
+    app.pipeline.min_device_batch = 0      # force the device path
+
+    async def main():
+        server = BrokerServer(port=0, app=app)
+        await server.start()
+        sub = MqttClient(port=server.port, clientid="dl-sub")
+        await sub.connect()
+        await sub.subscribe("loss/+", qos=0)
+        pub = MqttClient(port=server.port, clientid="dl-pub")
+        await pub.connect()
+
+        # healthy first: the device path serves
+        await pub.publish("loss/a", b"pre", qos=0)
+        assert (await sub.recv(timeout=60)).payload == b"pre"
+
+        # kill the device: every subsequent launch (or collect) raises
+        def dead(*a, **k):
+            raise RuntimeError("simulated device loss (ICI reset)")
+
+        if stage == "submit":
+            model.publish_batch_submit = dead
+        else:
+            model.publish_batch_collect = dead
+
+        for i in range(3):
+            await pub.publish(f"loss/{i}", b"post%d" % i, qos=0)
+        got = sorted([(await sub.recv(timeout=60)).payload
+                      for _ in range(3)])
+        assert got == [b"post0", b"post1", b"post2"]
+        assert app.broker.metrics.val("messages.device_failover") > 0
+        await pub.disconnect()
+        await sub.disconnect()
         await server.stop()
 
     asyncio.run(main())

@@ -327,6 +327,54 @@ async def _wait_hits(hits, n, timeout=5.0):
     return False
 
 
+def test_tap_batches_survive_mid_batch_flush_intact():
+    """Round-7 regression: a tap batch that overflows the flush cap
+    mid-cycle must re-seed the record-header slot before the next
+    entry — the first post-flush entry used to land at offset 0 and be
+    OVERWRITTEN by the header patch, corrupting every boundary-crossing
+    batch. A small max_packet_size shrinks the cap (max_size/2+1) so a
+    few hundred fat-payload messages cross many boundaries; every
+    entry must reach the rules with its exact topic AND payload."""
+    app = BrokerApp()
+    hits = []
+    app.rules.register_action("sink", lambda cols, a: hits.append(cols))
+    app.rules.create_rule("r-tapcap",
+                          'SELECT topic, payload FROM "fat/#"',
+                          [{"function": "sink", "args": {}}])
+    server = NativeBrokerServer(port=0, app=app, max_packet_size=4096)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="fs")
+        await sub.connect()
+        await sub.subscribe("fat/+", qos=0)
+        pub = MqttClient(port=server.port, clientid="fp")
+        await pub.connect()
+        await pub.publish("fat/t", b"warm", qos=0)     # earns the permit
+        await sub.recv(timeout=5)
+        await _settle(0.8)
+        n = 300
+        for i in range(n):
+            # ~200B distinct payloads: entries ~230B vs a ~2KB cap →
+            # a flush boundary every ~8 entries
+            await pub.publish("fat/t", (b"p%04d-" % i) + b"x" * 200,
+                              qos=0)
+            await sub.recv(timeout=5)
+        assert await _wait_fast(server, "taps", n)
+        assert await _wait_hits(hits, n + 1, timeout=15), len(hits)
+        assert server.tap_dropped == 0
+        got = sorted(h["payload"] for h in hits
+                     if h["payload"] != b"warm")
+        want = sorted((b"p%04d-" % i) + b"x" * 200 for i in range(n))
+        assert got == want        # exact topics/payloads, no corruption
+        assert all(h["topic"] == "fat/t" for h in hits)
+        await sub.close()
+        await pub.close()
+
+    run(main())
+    server.stop()
+
+
 def test_ruled_topics_stay_fast_via_taps_and_rules_see_everything():
     """Round-5 contract (VERDICT r4 #5): rules must see EVERY matching
     message WITHOUT de-permitting the fast path. Rule FROM filters

@@ -361,8 +361,189 @@ print("SANITIZED-RUN-OK", st)
 """
 
 
+# Round-7 WebSocket coverage: the RFC6455 plane (ws.h + host.cc) under
+# the sanitizers — upgrade handshakes (incl. a rejected one), masked
+# frame decode with in-place unmasking, byte-dribbled and fragmented
+# frames, ping/pong, close echo, fast-path delivery ONTO a ws conn
+# (egress wrapping), cross-thread sends, and close-during-traffic.
+DRIVER_WS = r"""
+import os, socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+host = native.NativeHost(port=0, max_size=1 << 16)
+wsp = host.listen_ws()
+
+def mask(payload, key=b"\x11\x22\x33\x44"):
+    return bytes(b ^ key[i %% 4] for i, b in enumerate(payload))
+
+def frame(op, payload, fin=True, key=b"\x11\x22\x33\x44"):
+    h = bytearray([(0x80 if fin else 0) | op])
+    n = len(payload)
+    if n < 126:
+        h.append(0x80 | n)
+    else:
+        h.append(0x80 | 126); h += struct.pack(">H", n)
+    return bytes(h) + key + mask(payload, key)
+
+def upgrade(s, dribble=False):
+    req = (b"GET /mqtt HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+           b"Connection: Upgrade\r\nSec-WebSocket-Key: AAAAAAAAAAAAAAAAAAAAAA==\r\n"
+           b"Sec-WebSocket-Version: 13\r\n\r\n")
+    if dribble:
+        for i in range(0, len(req), 7):
+            s.sendall(req[i:i + 7]); time.sleep(0.0005)
+    else:
+        s.sendall(req)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(4096)
+    assert b"101" in buf, buf
+
+def mqtt_connect(cid):
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    return bytes([0x10, len(vh)]) + vh
+
+def mqtt_publish(topic, payload, qos=0, pid=0):
+    body = struct.pack(">H", len(topic)) + topic
+    if qos:
+        body += struct.pack(">H", pid)
+    body += payload
+    return bytes([0x30 | (qos << 1), len(body)]) + body
+
+socks = [socket.create_connection(("127.0.0.1", wsp)) for _ in range(3)]
+ids = []
+framed = 0
+deadline = time.time() + 15
+
+def setup():
+    for i, s in enumerate(socks):
+        upgrade(s, dribble=(i == 0))
+        s.sendall(frame(0x2, mqtt_connect(b"w%%d" %% i)))
+su = threading.Thread(target=setup)
+su.start()
+while (len(ids) < 3 or framed < 3) and time.time() < deadline:
+    for kind, conn, payload in host.poll(50):
+        if kind == native.EV_OPEN:
+            assert payload.startswith(b"ws:"), payload
+            ids.append(conn)
+        elif kind == native.EV_FRAME:
+            framed += 1
+            host.send(conn, b"\x20\x02\x00\x00")   # CONNACK (host wraps)
+su.join()
+assert len(ids) == 3 and framed == 3, (ids, framed)
+sub, pub, extra = ids
+
+for c in ids:
+    host.enable_fast(c, 4, 64)
+host.sub_add(sub, "w/+", 1, 0)
+host.permit(pub, "w/x")
+
+stop = threading.Event()
+def control_churn():
+    j = 0
+    while not stop.is_set():
+        host.sub_add(sub, "churn/%%d" %% (j %% 5), 0, 0)
+        host.sub_del(sub, "churn/%%d" %% ((j + 2) %% 5))
+        host.stats()
+        for c in list(ids):
+            host.send(c, b"\xd0\x00")              # cross-thread PINGRESP
+        j += 1
+        time.sleep(0.0003)
+ctl = threading.Thread(target=control_churn)
+ctl.start()
+
+time.sleep(0.2)
+N_MSG = 300
+def blaster():
+    for k in range(N_MSG):
+        pkt = mqtt_publish(b"w/x", b"p%%03d" %% k, k & 1, 1 + (k %% 100))
+        if k %% 5 == 0:
+            # fragmented: binary FIN=0 + continuation FIN=1
+            a, b = pkt[:4], pkt[4:]
+            socks[1].sendall(frame(0x2, a, fin=False) + frame(0x0, b))
+        elif k %% 7 == 0:
+            socks[1].sendall(frame(0x9, b"hb"))     # ping mid-stream
+            socks[1].sendall(frame(0x2, pkt))
+        else:
+            socks[1].sendall(frame(0x2, pkt))
+        if k == N_MSG // 2:
+            socks[2].sendall(frame(0x8, struct.pack(">H", 1000)))  # close
+        time.sleep(0.0003)
+bl = threading.Thread(target=blaster)
+bl.start()
+
+# subscriber acks native qos1 deliveries THROUGH the ws codec
+def acker():
+    buf = b""
+    socks[0].settimeout(0.2)
+    while not stop.is_set():
+        try:
+            chunk = socks[0].recv(8192)
+        except (TimeoutError, OSError):
+            continue
+        if not chunk:
+            return
+        buf += chunk
+        # minimal server-frame walk (unmasked, small payloads)
+        while len(buf) >= 2:
+            n = buf[1] & 0x7F
+            off = 2
+            if n == 126:
+                if len(buf) < 4: break
+                n = struct.unpack(">H", buf[2:4])[0]; off = 4
+            if len(buf) < off + n: break
+            payload, buf = buf[off:off + n], buf[off + n:]
+            if payload and payload[0] >> 4 == 3 and (payload[0] >> 1) & 3 == 1:
+                tlen = (payload[2] << 8) | payload[3]
+                pid = (payload[4 + tlen] << 8) | payload[5 + tlen]
+                try:
+                    socks[0].sendall(frame(0x2, bytes([0x40, 2, pid >> 8, pid & 0xFF])))
+                except OSError:
+                    return
+ack = threading.Thread(target=acker)
+ack.start()
+
+deadline = time.time() + 20
+while time.time() < deadline:
+    list(host.poll(20))
+    st = host.stats()
+    if (st["fast_in"] > N_MSG // 2 and st["ws_pings"] > 0
+            and st["ws_closes"] > 0 and st["native_acks"] > 0):
+        break
+bl.join()
+time.sleep(0.3)
+stop.set(); ctl.join(); ack.join()
+st = host.stats()
+assert st["ws_handshakes"] == 3, st
+assert st["fast_in"] > 0 and st["fast_out"] > 0, st
+assert st["ws_pings"] > 0 and st["ws_closes"] > 0, st
+assert st["native_acks"] > 0, st
+# a rejected upgrade exercises the 400 path under the sanitizer too
+bad = socket.create_connection(("127.0.0.1", wsp))
+bad.settimeout(0.2)
+bad.sendall(b"GET /other HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            b"Connection: Upgrade\r\nSec-WebSocket-Key: A==\r\n\r\n")
+for _ in range(20):
+    list(host.poll(10))
+    try:
+        if b"400" in bad.recv(4096):
+            break
+    except (TimeoutError, OSError):
+        pass
+bad.close()
+for s in socks:
+    try: s.close()
+    except OSError: pass
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+print("SANITIZED-RUN-OK", st)
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
-@pytest.mark.parametrize("driver", ["host", "fastpath", "lane"])
+@pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -378,7 +559,7 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
         "TSAN_OPTIONS": "halt_on_error=1:report_signal_unsafe=0",
     }
     src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
-           "lane": DRIVER_LANE}[driver]
+           "lane": DRIVER_LANE, "ws": DRIVER_WS}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

@@ -125,6 +125,39 @@ def test_retained_expiry():
     assert len(r) == 0
 
 
+def test_retained_compaction_keeps_buckets_consistent():
+    """Round-7 regression: _compact rebuilds the per-bucket submatrices;
+    a stale loop variable used to leave every bucket's topics list
+    holding ONE topic, so a post-compaction expiry deleted the wrong
+    retained message. Force a compaction (tombstones dominate), then
+    expire one bucketed topic and assert the victim — and only the
+    victim — is gone."""
+    from emqx_tpu.core.message import now_ms
+
+    r = Retainer()
+    # one shared (l0, l1) bucket + churn victims to trip the compactor
+    for i in range(1400):
+        r.store(msg(f"churn/z/t{i}", b"c", retain=True))
+    for i in range(40):
+        r.store(msg(f"fleet/f1/g{i}", b"keep%d" % i, retain=True))
+    for i in range(1400):
+        r.delete(f"churn/z/t{i}")          # >1024 dead, dead*2 > n
+    assert r._n < 1440 - 1024              # compaction ran mid-churn
+    # bucket path still matches every survivor with the right payloads
+    got = {m.topic: m.payload for m in r.match("fleet/f1/+")}
+    assert got == {f"fleet/f1/g{i}": b"keep%d" % i for i in range(40)}
+    # re-store ONE topic with a 1s Message-Expiry-Interval, look past
+    # its deadline: exactly that topic must vanish — not a neighbour
+    # (the pre-fix bucket topics list would have named a wrong victim)
+    r.store(msg("fleet/f1/g7", b"dying", retain=True,
+                headers={"properties": {"Message-Expiry-Interval": 1}}))
+    alive = {m.topic for m in r.match("fleet/f1/+", now=now_ms() + 5000)}
+    assert "fleet/f1/g7" not in alive
+    assert alive == {f"fleet/f1/g{i}" for i in range(40) if i != 7}
+    # the lazy expiry really deleted g7 (bucket + row state consistent)
+    assert len(r.match("fleet/f1/g7")) == 0
+
+
 def test_retained_max_limit():
     r = Retainer(max_retained=1)
     assert r.store(msg("a", retain=True))

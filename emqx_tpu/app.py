@@ -42,6 +42,10 @@ class BrokerApp:
         self.hooks = Hooks()
         self._tickers: list = []
         self.exhook = None                 # ExhookMgr once configured
+        # set by NativeBrokerServer: () -> dict of C++ host stat slots,
+        # so the prometheus scrape carries the fast-path counters
+        # (emqx_native_*) next to the node metrics
+        self.native_stats_fn = None
         self.metrics = Metrics()
         self.stats = Stats()
         self.alarms = AlarmManager(on_change=self._on_alarm)
@@ -222,8 +226,14 @@ class BrokerApp:
         from emqx_tpu.observe import prometheus
 
         self.stats.tick()
+        native = None
+        if self.native_stats_fn is not None:
+            try:
+                native = self.native_stats_fn()
+            except Exception:  # noqa: BLE001 — a dying server must not
+                native = None  # break the scrape endpoint
         return prometheus.render(self.metrics, self.stats,
-                                 node=self.broker.node)
+                                 node=self.broker.node, native=native)
 
     @classmethod
     def from_config(cls, conf, node: str = None, **overrides) -> "BrokerApp":

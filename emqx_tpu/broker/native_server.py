@@ -142,6 +142,7 @@ class NativeBrokerServer:
         ws_port: Optional[int] = None,
         ws_path: str = "/mqtt",
         ws_host: Optional[str] = None,
+        telemetry: Optional[bool] = None,
     ):
         if not native.available():
             raise RuntimeError(
@@ -176,6 +177,35 @@ class NativeBrokerServer:
             # an all-interfaces TCP listener)
             self.ws_port = self.host.listen_ws(ws_host or host, ws_port,
                                                ws_path)
+        # -- native telemetry plane (round 8) ------------------------------
+        # In-host latency histograms + per-conn flight recorders, shipped
+        # as batched kind-8 records and folded here into histogram-aware
+        # Metrics (observe/metrics.py), prometheus, $SYS, and slow_subs.
+        # EMQX_NATIVE_TELEMETRY=0 is the product escape hatch (bench.py's
+        # observe_overhead section proves the on-cost < 2%).
+        if telemetry is None:
+            telemetry = os.environ.get("EMQX_NATIVE_TELEMETRY", "1") != "0"
+        self.telemetry = bool(telemetry)
+        self._hists = {}
+        for stage in native.HIST_STAGES:
+            self._hists[stage] = self.broker.metrics.register_hist(
+                f"latency.native.{stage}")
+        slow_ms = (self.app.slow_subs.threshold_ms
+                   if self.app is not None else 500)
+        self.host.set_telemetry(self.telemetry, slow_ack_ms=slow_ms)
+        self._slow_ack_ms = slow_ms
+        # recent flight-recorder dumps: (conn_id, reason, entries)
+        self.flight_records: deque = deque(maxlen=64)
+        # conns currently trace-punted in C++ (clientid-filter traces);
+        # _trace_lock serializes the poll thread's add (enable-fast on
+        # a pre-traced clientid) / discard (conn close) against
+        # _sync_traces' read-modify-write from management threads — an
+        # unsynchronized replace could lose the poll thread's add and
+        # strand the conn trace-punted in C++ after the trace stops
+        self._traced_conns: set[int] = set()
+        self._trace_lock = threading.Lock()
+        if self.app is not None:
+            self.app.native_stats_fn = self.fast_stats
         self.conns: dict[int, _NativeConn] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -278,7 +308,14 @@ class NativeBrokerServer:
                      "rewrite", "exhook"):
             obj = getattr(app, comp, None) if app is not None else None
             if hasattr(obj, "on_topology_change"):
-                obj.on_topology_change.append(self.flush_permits)
+                # traces get a richer callback: clientid traces also
+                # punt their conns at the C++ seam (emqx_host_set_trace)
+                # so the hook fold sees every publish immediately — the
+                # permit flush alone leaves the subscriber-side and any
+                # already-granted permit window open
+                obj.on_topology_change.append(
+                    self._on_trace_change if comp == "trace"
+                    else self.flush_permits)
         # rules get a richer callback: tap entries sync FIRST (ops apply
         # FIFO on the poll thread, so post-flush grants see the taps),
         # then the permit flush
@@ -291,6 +328,42 @@ class NativeBrokerServer:
 
     def _on_rules_change(self) -> None:
         self._sync_rule_taps()
+        self.flush_permits()
+
+    # -- trace punt (observability) -----------------------------------------
+    # A clientid trace must capture publishes from connections already
+    # on the native fast path. Closing the blind spot needs BOTH seams:
+    # set_trace marks the conn in C++ (its PUBLISHes punt to the Python
+    # plane, where the TraceManager hook sees them, and its flight-
+    # recorder tail dumps onto the trace log) and flush_permits revokes
+    # the topic grants so nothing else on those topics overtakes the
+    # punted frames.
+
+    def _traced_clientids(self) -> set:
+        if self.app is None:
+            return set()
+        return {t.filter_value for t in self.app.trace.running()
+                if t.filter_type == "clientid"}
+
+    def _sync_traces(self) -> None:
+        """Reconcile the C++ per-conn trace flags with the running
+        clientid traces. Thread-safe: set_trace enqueues onto the poll
+        thread; _fast_conn_of reads are GIL-atomic snapshots; the
+        bookkeeping set updates under _trace_lock (see its comment)."""
+        with self._trace_lock:
+            want = set()
+            for cid in self._traced_clientids():
+                conn_id = self._fast_conn_of.get(cid)
+                if conn_id is not None:
+                    want.add(conn_id)
+            for conn_id in want - self._traced_conns:
+                self.host.set_trace(conn_id, True)
+            for conn_id in self._traced_conns - want:
+                self.host.set_trace(conn_id, False)
+            self._traced_conns = want
+
+    def _on_trace_change(self) -> None:
+        self._sync_traces()
         self.flush_permits()
 
     def _sync_rule_taps(self) -> None:
@@ -754,6 +827,12 @@ class NativeBrokerServer:
             conn.native_cap = max_inflight
         self.host.enable_fast(conn.conn_id, ci.proto_ver, max_inflight)
         self._fast_conn_of[ch.clientid] = conn.conn_id
+        if ch.clientid in self._traced_clientids():
+            # a running clientid trace predates this connection: punt
+            # its publishes from the first frame, not the next sync
+            with self._trace_lock:
+                self.host.set_trace(conn.conn_id, True)
+                self._traced_conns.add(conn.conn_id)
         # an earlier mirror pass may have installed this client's subs
         # as punt markers (it wasn't fast yet); re-mirror them as real
         # (_on_sub_event handles removal of the old entry on the flip)
@@ -889,7 +968,11 @@ class NativeBrokerServer:
                 self._on_tap(conn_id, payload)
             elif kind == native.EV_ACKS:
                 self._on_ack_batch(payload)
+            elif kind == native.EV_TELEMETRY:
+                self._on_telemetry(payload)
             elif kind == native.EV_CLOSED:
+                with self._trace_lock:
+                    self._traced_conns.discard(conn_id)
                 conn = self.conns.pop(conn_id, None)
                 if conn is not None:
                     ch = conn.channel
@@ -1143,6 +1226,50 @@ class NativeBrokerServer:
             m.inc("messages.acked", tot_acked)
             m.inc("messages.native.acked", tot_acked)
 
+    def _on_telemetry(self, payload: bytes) -> None:
+        """Fold ONE batched kind-8 telemetry record (host.cc): per-cycle
+        histogram deltas into the node metrics' LatencyHistograms,
+        slow-ack samples into slow_subs (the native plane's entry into
+        the slow-subscriber ranking), and flight-recorder dumps into
+        the recent-dumps ring + any matching clientid trace log.
+        Runs on the poll thread: cycle-rate, small records, no I/O."""
+        stages = native.HIST_STAGES
+        for rec in native.parse_telemetry(payload):
+            kind = rec[0]
+            if kind == "hist":
+                _, stage_i, cnt, sum_ns, buckets = rec
+                if stage_i < len(stages):
+                    self._hists[stages[stage_i]].observe_delta(
+                        cnt, sum_ns, buckets)
+            elif kind == "slow_ack":
+                _, conn_id, rtt_us, _qos, topic = rec
+                info = self._conninfo_for(conn_id)
+                if info is not None and self.app is not None:
+                    # rank the SUBSCRIBER whose ack lagged, like the
+                    # delivery.completed hook does on the Python plane
+                    self.app.slow_subs.record(
+                        info[0], topic, rtt_us // 1000, plane="native")
+            else:  # flight-recorder dump
+                _, conn_id, reason, entries = rec
+                self.flight_records.append((conn_id, reason, entries))
+                info = self._conninfo_for(conn_id)
+                if info is None or self.app is None:
+                    continue
+                why = native.FR_REASON_NAMES.get(reason, str(reason))
+                detail = (f"conn={conn_id} reason={why} "
+                          + "; ".join(native.format_flight(entries)))
+                self.app.trace.log_for_client(info[0], "FLIGHT", detail)
+                if reason != 3:  # abnormal close / protocol error
+                    log.debug("flight recorder dump (%s) for %s: %s",
+                              why, info[0], detail)
+
+    def latency_summary(self) -> dict[str, dict]:
+        """Broker-side stage percentiles (p50/p99/p999 in µs + counts)
+        for every stage with observations — the bench.py artifact
+        surface next to the loadgen-side numbers."""
+        return {stage: h.summary()
+                for stage, h in self._hists.items() if h.count > 0}
+
     def _orphan_frame(self, conn_id: int, frame: bytes) -> None:
         """A frame surfaced for a conn we already tore down — in
         practice a lane punt replaying a parked PUBLISH after its
@@ -1179,6 +1306,8 @@ class NativeBrokerServer:
 
     def _forget_fast(self, conn: _NativeConn) -> None:
         cid = conn.channel.clientid
+        with self._trace_lock:
+            self._traced_conns.discard(conn.conn_id)
         if self._fast_conn_of.get(cid) == conn.conn_id:
             del self._fast_conn_of[cid]
         if conn.fast:
@@ -1226,6 +1355,13 @@ class NativeBrokerServer:
                 self._tick_running.clear()
         self._merge_fast_metrics()
         self._lane_auto()
+        if self.app is not None and self.telemetry:
+            # follow a live slow_subs.threshold change (config update)
+            # down to the C++ slow-ack report floor
+            thr = self.app.slow_subs.threshold_ms
+            if thr != self._slow_ack_ms:
+                self._slow_ack_ms = thr
+                self.host.set_telemetry(True, slow_ack_ms=thr)
         if time.monotonic() - self._last_permit_flush >= PERMIT_TTL_S:
             # the authz-cache TTL analogue: permits re-earn periodically
             # so an authz/banned change can't be outrun forever
@@ -1355,9 +1491,14 @@ class NativeBrokerServer:
             obj = getattr(self.app, comp, None) if self.app else None
             if hasattr(obj, "on_topology_change"):
                 try:
-                    obj.on_topology_change.remove(self.flush_permits)
+                    obj.on_topology_change.remove(
+                        self._on_trace_change if comp == "trace"
+                        else self.flush_permits)
                 except ValueError:
                     pass
+        if (self.app is not None
+                and self.app.native_stats_fn == self.fast_stats):
+            self.app.native_stats_fn = None
         if self.app is not None and hasattr(self.app.rules,
                                             "on_topology_change"):
             try:

@@ -131,6 +131,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_lane_backlog.argtypes = [ctypes.c_void_p]
     lib.emqx_host_set_max_qos.restype = ctypes.c_int
     lib.emqx_host_set_max_qos.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_set_trace.restype = ctypes.c_int
+    lib.emqx_host_set_trace.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.emqx_host_set_telemetry.restype = ctypes.c_int
+    lib.emqx_host_set_telemetry.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64]
     lib.emqx_host_set_inflight_cap.restype = ctypes.c_int
     lib.emqx_host_set_inflight_cap.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
@@ -268,6 +274,91 @@ class NativeFramer:
 
 # event kinds from host.cc
 EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP, EV_ACKS = 1, 2, 3, 4, 6, 7
+EV_TELEMETRY = 8
+
+# ---------------------------------------------------------------------------
+# native telemetry plane (host.cc kind-8 records)
+
+# histogram stage order (host.cc HistStage enum)
+HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
+               "lane_dwell", "gil_stint", "ws_ingest")
+
+# flight-recorder event codes (host.cc FrEvent)
+FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
+                  5: "deliver", 6: "drop", 7: "ack"}
+# dump reasons (host.cc FrReason)
+FR_REASON_NAMES = {1: "abnormal_close", 2: "protocol_error", 3: "trace"}
+
+
+def parse_telemetry(payload: bytes) -> list[tuple]:
+    """Decode one kind-8 payload into its sub-records:
+
+    - ``("hist", stage_idx, count_delta, sum_delta_ns, {bucket: delta})``
+    - ``("flight", conn_id, reason, [(ts_ms, event, ptype, arg, topic_hash,
+      arg2), ...])``
+    - ``("slow_ack", conn_id, rtt_us, qos, topic)``
+
+    Sub-records never split across kind-8 chunks (host.cc TeleAppend),
+    so each payload parses independently; histogram deltas from every
+    chunk sum to the C++ totals exactly."""
+    out: list[tuple] = []
+    pos, n = 0, len(payload)
+    while pos < n:
+        sub = payload[pos]
+        pos += 1
+        if sub == 1:
+            stage = payload[pos]
+            cnt = int.from_bytes(payload[pos + 1:pos + 9], "little")
+            sum_ns = int.from_bytes(payload[pos + 9:pos + 17], "little")
+            nb = int.from_bytes(payload[pos + 17:pos + 19], "little")
+            pos += 19
+            buckets = {}
+            for _ in range(nb):
+                buckets[payload[pos]] = int.from_bytes(
+                    payload[pos + 1:pos + 5], "little")
+                pos += 5
+            out.append(("hist", stage, cnt, sum_ns, buckets))
+        elif sub == 2:
+            conn = int.from_bytes(payload[pos:pos + 8], "little")
+            reason = payload[pos + 8]
+            cnt = payload[pos + 9]
+            pos += 10
+            entries = []
+            for _ in range(cnt):
+                entries.append((
+                    int.from_bytes(payload[pos:pos + 4], "little"),
+                    payload[pos + 4], payload[pos + 5],
+                    int.from_bytes(payload[pos + 6:pos + 8], "little"),
+                    int.from_bytes(payload[pos + 8:pos + 12], "little"),
+                    int.from_bytes(payload[pos + 12:pos + 16], "little"),
+                ))
+                pos += 16
+            out.append(("flight", conn, reason, entries))
+        elif sub == 3:
+            conn = int.from_bytes(payload[pos:pos + 8], "little")
+            rtt_us = int.from_bytes(payload[pos + 8:pos + 12], "little")
+            qos = payload[pos + 12]
+            tl = int.from_bytes(payload[pos + 13:pos + 15], "little")
+            pos += 15
+            topic = payload[pos:pos + tl].decode("utf-8", "replace")
+            pos += tl
+            out.append(("slow_ack", conn, rtt_us, qos, topic))
+        else:
+            break  # unknown sub-record kind: length unknowable, stop
+    return out
+
+
+def format_flight(entries: list[tuple]) -> list[str]:
+    """Human-readable flight-recorder lines (for trace logs / debug)."""
+    lines = []
+    base = entries[0][0] if entries else 0
+    for ts_ms, event, ptype, arg, topic_hash, _arg2 in entries:
+        name = FR_EVENT_NAMES.get(event, f"ev{event}")
+        part = f"+{ts_ms - base}ms {name} ptype={ptype} arg={arg}"
+        if topic_hash:
+            part += f" topic#{topic_hash:08x}"
+        lines.append(part)
+    return lines
 
 def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
                 msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
@@ -392,7 +483,9 @@ class NativeSubTable:
             pass
 
 
-# fast-path stat slots (host.cc StatSlot order)
+# fast-path stat slots (host.cc StatSlot order; the drift guard in
+# tests/test_stats_lint.py derives these names from the C++ enum and
+# fails the build on any order/name/coverage mismatch)
 STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "drops_backpressure", "drops_inflight", "native_acks",
               "shared_dispatch", "shared_no_member",
@@ -400,7 +493,8 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "lane_stale", "taps",
               "qos1_in", "qos2_in", "qos2_rel", "lane_topic_overflow",
               "ack_batches",
-              "ws_handshakes", "ws_rejects", "ws_pings", "ws_closes")
+              "ws_handshakes", "ws_rejects", "ws_pings", "ws_closes",
+              "punts_trace", "fr_dumps", "telemetry_batches")
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP = 1, 2, 4
@@ -508,6 +602,21 @@ class NativeHost:
         """Mirror mqtt.max_qos_allowed: over-cap publishes skip the
         fast path so the channel can refuse them per spec."""
         self._lib.emqx_host_set_max_qos(self._h, int(max_qos))
+
+    def set_trace(self, conn: int, on: bool) -> None:
+        """Trace punt: while on, the conn's PUBLISHes bypass the fast
+        path so the Python hook fold (TraceManager) sees every one, and
+        its flight-recorder tail is dumped as a kind-8 record —
+        immediately on attach and again at teardown."""
+        self._lib.emqx_host_set_trace(self._h, conn, 1 if on else 0)
+
+    def set_telemetry(self, enabled: bool,
+                      slow_ack_ms: float = 500.0) -> None:
+        """Master switch for the native telemetry plane (histograms,
+        flight recorders, kind-8 export) plus the slow-ack report floor
+        in milliseconds (sampled ack RTTs past it feed slow_subs)."""
+        self._lib.emqx_host_set_telemetry(
+            self._h, 1 if enabled else 0, int(slow_ack_ms * 1_000_000))
 
     def set_inflight_cap(self, conn: int, cap: int) -> None:
         """Re-divide a conn's receive-maximum budget: set the native

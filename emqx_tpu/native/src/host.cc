@@ -70,6 +70,23 @@
 //   kind 7 = ACKS   payload = one batched ack/window record per poll
 //                   cycle: [u32 n] + n x ([u64 conn][u32 acked]
 //                   [u32 rel][u32 inflight_now][u32 pending_now])
+//   kind 8 = TELEMETRY  payload = concatenated sub-records, chunked at
+//                   the tap bound like kinds 6/7:
+//                   [u8 1] histogram delta: [u8 stage][u64 count_d]
+//                     [u64 sum_d][u16 n] + n x ([u8 bucket][u32 delta])
+//                     — deltas vs the last emission (flushed on a
+//                     ~100ms cadence, not every cycle: the per-cycle
+//                     record + Python decode taxed the blast path);
+//                     summing every delta reproduces the totals exactly
+//                   [u8 2] flight-recorder dump: [u64 conn][u8 reason]
+//                     [u8 n] + n x 16B entries ([u32 ts_ms][u8 event]
+//                     [u8 ptype][u16 arg][u32 topic_hash][u32 arg2]),
+//                     oldest first; emitted on abnormal close, protocol
+//                     error, or trace attach (reason 1/2/3)
+//                   [u8 3] slow-ack sample: [u64 conn][u32 rtt_us]
+//                     [u8 qos][u16 tlen][topic] — a sampled native
+//                     QoS1/2 delivery whose ack RTT crossed the
+//                     slow-ack threshold (feeds services/slow_subs.py)
 //
 // WebSocket (round 7): a second listener serves MQTT-over-WebSocket
 // (RFC6455, ws.h) on the SAME data plane: the upgrade handshake and
@@ -92,6 +109,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
 #include <deque>
 #include <memory>
 #include <mutex>
@@ -121,6 +139,114 @@ inline uint64_t NowMs() {
   clock_gettime(CLOCK_MONOTONIC_COARSE, &ts);
   return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
+
+// Telemetry timestamps need sub-ms resolution (the stages under
+// measurement are microseconds); the vDSO CLOCK_MONOTONIC read is
+// ~20ns, so every per-message call site is SAMPLED (1-in-8) rather
+// than unconditional — see the < 2% overhead budget in bench.py's
+// observe_overhead section.
+inline uint64_t NowNs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+// ---------------------------------------------------------------------------
+// Native telemetry plane (round 8): HDR-histogram-style log-bucketed
+// latency capture + a per-connection flight recorder, exported as ONE
+// batched kind-8 record per poll cycle (the kind-6/7 discipline).
+// Everything here is poll-thread-owned plain memory: no locks, no
+// atomics, no allocation on the record path.
+
+// Histogram stage order (keep in sync with native/__init__.py
+// HIST_STAGES — tests/test_stats_lint.py guards the stat slots; the
+// stage list rides the same convention).
+enum HistStage {
+  kHistIngressRoute = 0,  // sampled: PUBLISH parse -> native fan-out done
+  kHistRouteFlush,        // sampled: fan-out done -> socket flush done
+  kHistQos1Rtt,           // sampled: qos1 delivery write -> PUBACK
+  kHistQos2Rtt,           // sampled: qos2 delivery write -> PUBCOMP
+  kHistLaneDwell,         // every lane dequeue: enqueue -> deliver/punt
+  kHistGilStint,          // every poll: Poll() return -> next Poll() entry
+  kHistWsIngest,          // sampled: WS decode+dispatch per read chunk
+  kHistCount
+};
+
+// 64 log-bucketed (~power-of-√2) slots covering [0, ~4.3s): bucket 0
+// holds [0,2)ns; a value with MSB position e >= 1 lands at 2e-1 (below
+// √2·2^e, approximated as 1448/1024 fixed-point) or 2e; everything
+// >= 2^32 ns clamps into bucket 63. Mirrored exactly by
+// observe/metrics.py HIST_EDGES_NS / hist_bucket (differential test).
+inline int HistBucket(uint64_t ns) {
+  if (ns < 2) return 0;
+  int e = 63 - __builtin_clzll(ns);
+  if (e >= 32) return 63;
+  return 2 * e - 1 + ((ns << 10) >= (1448ull << e) ? 1 : 0);
+}
+
+struct Hist {
+  uint64_t b[64] = {};
+  uint64_t cnt = 0;
+  uint64_t sum = 0;
+};
+
+// Flight-recorder event codes (keep in sync with native/__init__.py
+// FR_EVENT_NAMES).
+enum FrEvent : uint8_t {
+  kFrOpen = 1,   // accepted; arg = 1 for WS conns
+  kFrFrame,      // slow-plane inbound frame; ptype, arg = len lo16
+  kFrPunt,       // fast-eligible frame forwarded to Python anyway
+  kFrFastPub,    // PUBLISH consumed natively; hash = topic hash
+  kFrDeliver,    // fast-path delivery written; hash = topic hash
+  kFrDrop,       // delivery dropped (backpressure / mqueue overflow)
+  kFrAck,        // subscriber ack consumed natively; arg = pid
+};
+
+// Dump reasons (kind-8 sub-record 2 header).
+enum FrReason : uint8_t {
+  kFrReasonClose = 1,  // abnormal close (sock_error, oversized, ...)
+  kFrReasonError = 2,  // protocol error (frame_error, ws_error, ...)
+  kFrReasonTrace = 3,  // trace attach / traced conn teardown
+};
+
+struct FrEntry {
+  uint32_t ts_ms;  // NowMs() truncated — deltas are what matter
+  uint8_t event;   // FrEvent
+  uint8_t ptype;   // MQTT packet type where applicable
+  uint16_t arg;    // event-specific (frame len, pid, reason)
+  uint32_t hash;   // FNV-1a topic hash (0 when n/a)
+  uint32_t arg2;
+};
+static_assert(sizeof(FrEntry) == 16, "kind-8 wire format");
+
+constexpr uint8_t kFrCap = 16;  // entries per conn (256B, lazily alloc'd)
+
+struct FlightRec {
+  FrEntry e[kFrCap];
+  uint8_t head = 0;  // next overwrite slot
+  uint8_t n = 0;     // live entries (<= kFrCap)
+};
+
+inline uint32_t TopicHash(std::string_view t) {
+  uint32_t h = 2166136261u;  // FNV-1a: cheap, stable across planes
+  for (char c : t) {
+    h ^= static_cast<uint8_t>(c);
+    h *= 16777619u;
+  }
+  return h;
+}
+
+// Per-conn cap on concurrently-tracked ack-RTT samples: delivery
+// stamps are taken only while a slot is free, so the steady-state cost
+// is a tiny vector scan and the per-sample topic copy is bounded.
+constexpr size_t kRttSamples = 4;
+
+struct RttSample {
+  uint64_t t0_ns;
+  std::string topic;
+  uint16_t pid;
+  uint8_t qos;
+};
 
 // elevated-qos mqueue bound per subscriber (emqx_mqueue default
 // max_len 1000); overflow drops the NEW message (kStDropsInflight)
@@ -164,6 +290,9 @@ struct AckState {
   uint32_t cyc_acked = 0;   // delivery slots freed (PUBACK + PUBCOMP)
   uint32_t cyc_rel = 0;     // publisher PUBREL exchanges completed
   bool cyc_dirty = false;   // queued on ack_dirty_ this cycle
+  // sampled ack-RTT stamps (delivery write -> PUBACK/PUBCOMP); a
+  // delivery only stamps while a slot is free, so this never grows
+  std::vector<RttSample> rtt;
 };
 
 // Per-connection WebSocket transport state, allocated only for conns
@@ -186,7 +315,11 @@ struct Conn {
   uint8_t proto_ver = 4;    // 4 = MQTT 3.1.1, 5 = MQTT 5
   uint32_t max_inflight = 16384;
   bool dirty = false;       // has appended-but-unflushed outbuf bytes
+  bool traced = false;      // TraceManager attached: PUBLISHes punt to
+                            // Python so the hook fold sees them; the
+                            // flight-recorder tail rides the trace log
   uint64_t last_rx_ms = 0;  // any inbound bytes (keepalive feed)
+  std::unique_ptr<FlightRec> fr;             // telemetry flight recorder
   std::unique_ptr<AckState> ack;             // elevated-qos window state
   std::unordered_set<std::string> permits;   // publisher-side topic grants
   std::vector<std::string> own_subs;         // filters owned by this conn
@@ -216,7 +349,7 @@ struct Op {
   enum Kind : uint8_t {
     kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush,
     kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos,
-    kSetInflightCap
+    kSetInflightCap, kSetTrace, kSetTelemetry
   };
   Kind kind;
   uint64_t owner = 0;
@@ -229,7 +362,9 @@ struct Op {
 };
 
 // Stats slot order for emqx_host_stats (keep in sync with
-// native/__init__.py STAT_NAMES).
+// native/__init__.py STAT_NAMES — enforced by tests/test_stats_lint.py,
+// which parses this enum and cross-checks names, order, and increment
+// sites; slot kStFooBar must be named "foo_bar" on the Python side).
 enum StatSlot {
   kStFastIn = 0,       // PUBLISHes fully handled in C++
   kStFastOut,          // PUBLISH deliveries written by the fast path
@@ -256,6 +391,9 @@ enum StatSlot {
   kStWsRejects,        // upgrade requests answered 400
   kStWsPings,          // client pings answered with pongs
   kStWsCloses,         // client-initiated close frames honoured
+  kStPuntsTrace,       // PUBLISHes punted because the conn is traced
+  kStFrDumps,          // flight-recorder dumps emitted (kind 8)
+  kStTelemetryBatches,  // batched kind-8 telemetry records emitted
   kStatCount
 };
 
@@ -409,8 +547,17 @@ class Host {
   // fast with -2 instead of silently racing.
   long ConnIdleMs(uint64_t id) const {
     pthread_t poller = poll_thread_.load(std::memory_order_acquire);
-    if (poller != pthread_t{} && !pthread_equal(poller, pthread_self()))
+    if (poller != pthread_t{} && !pthread_equal(poller, pthread_self())) {
+      // abort-free warn-once: misuse must show up in plain test output
+      // and sanitizer runs, not as a silent -2 swallowed by a caller
+      if (!idle_misuse_warned_.exchange(true, std::memory_order_relaxed))
+        fprintf(stderr,
+                "emqx_native: emqx_host_conn_idle_ms called off the poll "
+                "thread; refusing (-2). This walks poll-thread-owned "
+                "state — call it from the thread driving emqx_host_poll"
+                ".\n");
       return -2;  // wrong thread: refuse rather than race conns_
+    }
     auto it = conns_.find(id);
     if (it == conns_.end()) return -1;
     uint64_t last = it->second.last_rx_ms;
@@ -423,16 +570,37 @@ class Host {
   // timeout with no events).
   long Poll(uint8_t* buf, size_t cap, int timeout_ms) {
     poll_thread_.store(pthread_self(), std::memory_order_release);
+    if (telemetry_) {
+      fr_now_ms_ = NowMs();  // one stamp per cycle for every FrNote
+      if (poll_exit_ns_) {
+        // the gap since the last Poll return is the caller's GIL
+        // stint: time the Python driver held the plane stalled
+        RecordHist(kHistGilStint, NowNs() - poll_exit_ns_);
+      }
+    }
     if (events_.empty()) {
       ApplyPending();
       epoll_event evs[256];
       int n = epoll_wait(epoll_fd_, evs, 256, timeout_ms);
-      if (n < 0) return errno == EINTR ? 0 : -1;
+      if (n < 0) {
+        if (telemetry_) poll_exit_ns_ = NowNs();
+        return errno == EINTR ? 0 : -1;
+      }
       for (int i = 0; i < n; i++) HandleEvent(evs[i]);
       ApplyPending();
       if (!lane_pending_.empty()) LaneStaleScan();
       FlushTaps();
       FlushAcks();
+      // histogram deltas ride a ~100ms cadence, not every cycle: under
+      // blast the per-cycle record + its Python-side decode measurably
+      // taxed the plane (the observe_overhead budget); flight-recorder
+      // dumps and slow-ack records still flush THIS cycle below
+      if (telemetry_ && hist_dirty_
+          && fr_now_ms_ - last_hist_flush_ms_ >= 100) {
+        last_hist_flush_ms_ = fr_now_ms_;
+        FlushHistDeltas();
+      }
+      FlushTelemetry();
     }
     size_t written = 0;
     while (!events_.empty()) {
@@ -455,6 +623,7 @@ class Host {
       written += rec.size();
       events_.pop_front();
     }
+    if (telemetry_) poll_exit_ns_ = NowNs();
     return static_cast<long>(written);
   }
 
@@ -600,6 +769,24 @@ class Host {
         break;
       case Op::kSetMaxQos:
         max_qos_allowed_ = op.qos;
+        break;
+      case Op::kSetTrace: {
+        auto it = conns_.find(op.owner);
+        if (it == conns_.end()) break;
+        bool on = op.flags != 0;
+        if (on && !it->second.traced) {
+          it->second.traced = true;
+          // attach the pre-trace tail NOW: the events leading up to
+          // trace start are exactly what the operator wants to see
+          EmitFlightRec(op.owner, it->second, kFrReasonTrace);
+        } else if (!on) {
+          it->second.traced = false;
+        }
+        break;
+      }
+      case Op::kSetTelemetry:
+        telemetry_ = op.flags != 0;
+        slow_ack_ns_ = op.token;
         break;
     }
   }
@@ -791,9 +978,18 @@ class Host {
       if (it == lane_pending_.end()) continue;  // drained/stale already
       LaneEntry le = std::move(it->second);
       lane_pending_.erase(it);
+      if (telemetry_) {
+        // lane dwell (enqueue -> device verdict applied): ms-scale by
+        // nature (a device round trip), so the coarse clock suffices
+        uint64_t now_ms = NowMs();
+        RecordHist(kHistLaneDwell,
+                   (now_ms > le.enq_ms ? now_ms - le.enq_ms : 0)
+                       * 1000000ull);
+      }
       std::string_view topic(le.frame.data() + le.topic_off, le.topic_len);
       std::string_view payload(le.frame.data() + le.payload_off,
                                le.frame.size() - le.payload_off);
+      if (telemetry_) cur_hash_ = TopicHash(topic);  // for FanOut notes
       // poison must be read BEFORE LaneForget: forgetting the LAST
       // parked frame of a poisoned topic erases the poison, and the
       // pre-fix order let exactly that frame deliver natively —
@@ -903,7 +1099,8 @@ class Host {
       c.fd = fd;
       c.framer = Framer(max_size_);
       if (is_ws) c.ws = std::make_unique<WsConnState>();
-      conns_.emplace(id, std::move(c));
+      auto& cref = conns_.emplace(id, std::move(c)).first->second;
+      FrNote(cref, kFrOpen, 0, is_ws ? 1 : 0);
       epoll_event ev{};
       ev.events = EPOLLIN;
       ev.data.u64 = id;
@@ -953,8 +1150,14 @@ class Host {
     std::vector<std::string> frames;
     FrameStatus st = c.framer.Feed(data, len, &frames);
     for (auto& f : frames) {
-      if (!c.fast || !TryFast(id, c, f))
+      if (!c.fast || !TryFast(id, c, f)) {
+        // flight recorder: a frame bound for Python is a PUNT when the
+        // conn was fast-eligible, a plain slow-plane FRAME otherwise
+        FrNote(c, c.fast ? kFrPunt : kFrFrame,
+               static_cast<uint8_t>(f[0]) >> 4,
+               static_cast<uint16_t>(f.size() & 0xFFFF));
         events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+      }
     }
     return st == FrameStatus::kOk;
   }
@@ -1005,7 +1208,20 @@ class Host {
     return WsDecode(id, c, data, len);
   }
 
+  // Sampled WS-ingest overhead: the decode+dispatch cost one read
+  // chunk pays on the WS transport (the TCP path feeds IngestMqtt
+  // directly, so this stage is what RFC6455 adds to the plane).
   bool WsDecode(uint64_t id, Conn& c, uint8_t* data, size_t len) {
+    if (telemetry_ && ((++tele_tick_ws_ & 7) == 0)) {
+      uint64_t t0 = NowNs();
+      bool ok = WsDecodeInner(id, c, data, len);
+      RecordHist(kHistWsIngest, NowNs() - t0);
+      return ok;
+    }
+    return WsDecodeInner(id, c, data, len);
+  }
+
+  bool WsDecodeInner(uint64_t id, Conn& c, uint8_t* data, size_t len) {
     bool mqtt_err = false, closing = false;
     ws::WsStatus st = c.ws->dec.Feed(
         data, len,
@@ -1066,7 +1282,10 @@ class Host {
   // batch — one send() per touched subscriber instead of one per
   // delivered message.
   void FlushDirty() {
-    if (dirty_.empty()) return;
+    if (dirty_.empty()) {
+      flush_t0_ = 0;  // sampled publish had no targets: no flush stage
+      return;
+    }
     std::vector<uint64_t> dirty;
     dirty.swap(dirty_);
     for (uint64_t id : dirty) {
@@ -1074,6 +1293,10 @@ class Host {
       if (it == conns_.end()) continue;
       it->second.dirty = false;
       Flush(id, it->second);
+    }
+    if (flush_t0_) {
+      RecordHist(kHistRouteFlush, NowNs() - flush_t0_);
+      flush_t0_ = 0;
     }
   }
 
@@ -1089,6 +1312,11 @@ class Host {
     if (type == 6) return TryFastPubrel(id, c, f);
     if (type == 7) return TryFastPubcomp(id, c, f);
     if (type != 3) return false;  // PUBLISH + the four ack types only
+    // sampled ingress->route stamp (1-in-8): a NowNs per message would
+    // be a measurable tax at 7 figures/s; the ticker is global so a
+    // deterministic share of walk-path publishes lands in the histogram
+    uint64_t t_in = 0;
+    if (telemetry_ && ((++tele_tick_ & 7) == 0)) t_in = NowNs();
     uint8_t qos = (h >> 1) & 3;
     bool retain = h & 1;
     if (qos > 2 || retain) return false;  // malformed qos / retained
@@ -1123,6 +1351,9 @@ class Host {
       pos++;
     }
     std::string_view payload(f.data() + pos, f.size() - pos);
+    // one hash per publish, shared by every FrNote it triggers (the
+    // per-delivery rehash was part of the telemetry tax)
+    if (telemetry_) cur_hash_ = TopicHash(topic);
     if (qos == 2) {
       if (c.ack && BitTest(c.ack->awaiting_rel, pid)) {
         // retransmit of an exchange WE own (dup while awaiting PUBREL):
@@ -1143,6 +1374,17 @@ class Host {
         // the session re-answers PUBREC from its own dedup.
         return false;
       }
+    }
+    if (c.traced) {
+      // TraceManager attached to this client: every publish must run
+      // the Python plane so the hook fold (and the trace log) sees it.
+      // Checked AFTER the awaiting-rel dedup above — a mid-exchange
+      // trace must not hand an owned qos2 id to Python — and BEFORE
+      // the permit, which may still be installed when the trace races
+      // the permit flush.
+      stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+      stats_[kStPuntsTrace].fetch_add(1, std::memory_order_relaxed);
+      return false;
     }
     key_scratch_.assign(topic.data(), topic.size());  // no per-msg alloc
     if (c.permits.find(key_scratch_) == c.permits.end())
@@ -1174,6 +1416,8 @@ class Host {
         // on every advance (native_server._merge_fast_metrics)
         stats_[kStLaneTopicOverflow].fetch_add(1,
                                                std::memory_order_relaxed);
+        if (telemetry_)
+          FrNote(c, kFrDrop, 3, qos, cur_hash_);
         return true;  // consumed: dropped under per-topic lane overload
       }
       if (!topic_in_flight && !punt_subs_.Empty()) {
@@ -1199,6 +1443,8 @@ class Host {
         le.payload_off = static_cast<uint32_t>(pos);
         le.frame = f;
         stats_[kStLaneIn].fetch_add(1, std::memory_order_relaxed);
+        if (telemetry_)  // arg2=1 marks a lane park, not a walk
+          FrNote(c, kFrFastPub, 3, qos, cur_hash_, 1);
         events_.push_back(
             EncodeRecord(4, seq, topic.data(), topic.size()));
         LaneEnqueue(seq, std::move(le));
@@ -1239,6 +1485,16 @@ class Host {
     }
     if (tapped) EmitTap(id, qos, (h & 0x08) != 0, topic, payload);
     FanOut(id, qos, pid, topic, payload);
+    if (telemetry_) {
+      FrNote(c, kFrFastPub, 3, qos, cur_hash_);
+      if (t_in) {
+        uint64_t t1 = NowNs();
+        RecordHist(kHistIngressRoute, t1 - t_in);
+        // the same sampled message anchors the route->flush stage;
+        // FlushDirty closes it when this read batch hits the socket
+        if (!flush_t0_) flush_t0_ = t1;
+      }
+    }
     return true;
   }
 
@@ -1331,6 +1587,7 @@ class Host {
     Conn& t = it->second;
     if (t.outbuf.size() - t.outpos > kHighWater) {
       stats_[kStDropsBackpressure].fetch_add(1, std::memory_order_relaxed);
+      if (telemetry_) FrNote(t, kFrDrop, 3, 0, cur_hash_);
       return false;
     }
     uint8_t out_qos = qos < e.qos ? qos : e.qos;
@@ -1341,6 +1598,7 @@ class Host {
       AppendMqtt(t, shared.data(), shared.size());
       stats_[kStFastBytesOut].fetch_add(shared.size(),
                                         std::memory_order_relaxed);
+      if (telemetry_) FrNote(t, kFrDeliver, 3, 0, cur_hash_);
     } else {
       AckState& a = EnsureAck(t);
       std::string& sq = t.proto_ver == 5 ? frame_q_v5_ : frame_q_v4_;
@@ -1358,6 +1616,7 @@ class Host {
         // receive window full: queue (the mqueue), drop on overflow
         if (a.pending.size() >= kMaxPending) {
           stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
+          if (telemetry_) FrNote(t, kFrDrop, 3, 1, cur_hash_);
           return false;
         }
         a.pending.emplace_back(sq, qoff);
@@ -1367,6 +1626,13 @@ class Host {
         return true;   // admitted; kStFastOut counts at dequeue
       }
       uint16_t tp = NextPid(a);
+      if (telemetry_) {
+        // ack-RTT sample (delivery write -> PUBACK/PUBCOMP): stamped
+        // only while a slot is free, closed out in TeleAckRtt
+        if (a.rtt.size() < kRttSamples)
+          a.rtt.push_back({NowNs(), std::string(topic), tp, out_qos});
+        FrNote(t, kFrDeliver, 3, tp, cur_hash_);
+      }
       if (t.ws)  // frame header first so `at` lands on the MQTT bytes
         ws::AppendFrameHeader(&t.outbuf, ws::kOpBinary, sq.size());
       size_t at = t.outbuf.size();
@@ -1428,6 +1694,8 @@ class Host {
         a.cyc_acked++;
         AckNote(id, a);
         stats_[kStNativeAcks].fetch_add(1, std::memory_order_relaxed);
+        if (!a.rtt.empty()) TeleAckRtt(id, a, pid);
+        FrNote(c, kFrAck, 4, pid);
         DrainPending(id, c);
       }
     }
@@ -1463,6 +1731,8 @@ class Host {
         a.cyc_acked++;
         AckNote(id, a);
         stats_[kStNativeAcks].fetch_add(1, std::memory_order_relaxed);
+        if (!a.rtt.empty()) TeleAckRtt(id, a, pid);
+        FrNote(c, kFrAck, 7, pid);
         DrainPending(id, c);
       }
     }
@@ -1551,6 +1821,159 @@ class Host {
     emit();
   }
 
+  // -- telemetry plane ----------------------------------------------------
+
+  void RecordHist(int stage, uint64_t ns) {
+    Hist& h = hists_[stage];
+    h.b[HistBucket(ns)]++;
+    h.cnt++;
+    h.sum += ns;
+    hist_dirty_ |= 1u << stage;
+  }
+
+  // Ring-buffer note on a conn's flight recorder (lazy 256B alloc).
+  void FrNote(Conn& c, uint8_t event, uint8_t ptype, uint16_t arg,
+              uint32_t hash = 0, uint32_t arg2 = 0) {
+    if (!telemetry_) return;
+    if (!c.fr) c.fr = std::make_unique<FlightRec>();
+    FlightRec& r = *c.fr;
+    // fr_now_ms_ is the cycle stamp (refreshed at Poll entry): ms
+    // resolution is the recorder's contract, and a clock read per
+    // note was a measurable share of the telemetry tax
+    r.e[r.head] = {static_cast<uint32_t>(fr_now_ms_), event, ptype, arg,
+                   hash, arg2};
+    r.head = static_cast<uint8_t>((r.head + 1) % kFrCap);
+    if (r.n < kFrCap) r.n++;
+  }
+
+  size_t TeleCap() const {
+    size_t cap = kTapFlushBytes;
+    if (cap > max_size_ / 2) cap = max_size_ / 2 + 1;
+    return cap;
+  }
+
+  // Append ONE whole sub-record; flushes at the tap bound so a chunk
+  // boundary never splits a sub-record (Poll drops any record larger
+  // than the caller's whole buffer — the kind-6/7 lesson). The header
+  // slot is seeded AFTER the flush check (the round-7 EmitTap bug:
+  // a headerless post-flush append gets overwritten by the patch).
+  void TeleAppend(const char* data, size_t len) {
+    size_t cap = TeleCap();
+    if (tele_buf_.size() > 13 && tele_buf_.size() - 13 + len > cap)
+      FlushTelemetry();
+    if (tele_buf_.empty()) tele_buf_.assign(13, '\0');
+    tele_buf_.append(data, len);
+    if (tele_buf_.size() - 13 > cap) FlushTelemetry();
+  }
+
+  void FlushTelemetry() {
+    if (tele_buf_.size() <= 13) return;
+    tele_buf_[0] = 8;
+    uint64_t id = 0;
+    memcpy(&tele_buf_[1], &id, 8);
+    uint32_t plen = static_cast<uint32_t>(tele_buf_.size() - 13);
+    memcpy(&tele_buf_[9], &plen, 4);
+    events_.push_back(std::move(tele_buf_));
+    tele_buf_.clear();
+    stats_[kStTelemetryBatches].fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // Per-cycle histogram deltas (sub-record 1): only dirty stages, only
+  // buckets that moved. The flushed shadow updates as each record is
+  // BUILT, so the deltas sum to the totals exactly — even when
+  // TeleAppend chunks the cycle across several kind-8 events.
+  void FlushHistDeltas() {
+    if (!telemetry_ || !hist_dirty_) return;
+    for (int s = 0; s < kHistCount; s++) {
+      if (!(hist_dirty_ & (1u << s))) continue;
+      Hist& cur = hists_[s];
+      Hist& old = hists_flushed_[s];
+      tele_scratch_.clear();
+      char hdr[20];
+      hdr[0] = 1;
+      hdr[1] = static_cast<char>(s);
+      uint64_t cd = cur.cnt - old.cnt;
+      uint64_t sd = cur.sum - old.sum;
+      memcpy(hdr + 2, &cd, 8);
+      memcpy(hdr + 10, &sd, 8);
+      tele_scratch_.append(hdr, 20);  // bytes 18-19 patched below
+      uint16_t nb = 0;
+      for (int i = 0; i < 64; i++) {
+        uint64_t d = cur.b[i] - old.b[i];
+        if (!d) continue;
+        char ent[5];
+        ent[0] = static_cast<char>(i);
+        uint32_t d32 = d > 0xFFFFFFFFull ? 0xFFFFFFFFu
+                                         : static_cast<uint32_t>(d);
+        memcpy(ent + 1, &d32, 4);
+        tele_scratch_.append(ent, 5);
+        nb++;
+      }
+      memcpy(&tele_scratch_[18], &nb, 2);
+      old = cur;
+      TeleAppend(tele_scratch_.data(), tele_scratch_.size());
+    }
+    hist_dirty_ = 0;
+  }
+
+  // Dump a conn's flight-recorder tail (sub-record 2), oldest first.
+  void EmitFlightRec(uint64_t id, Conn& c, uint8_t reason) {
+    if (!telemetry_ || !c.fr || c.fr->n == 0) return;
+    FlightRec& r = *c.fr;
+    tele_scratch_.clear();
+    char hdr[11];
+    hdr[0] = 2;
+    memcpy(hdr + 1, &id, 8);
+    hdr[9] = static_cast<char>(reason);
+    hdr[10] = static_cast<char>(r.n);
+    tele_scratch_.append(hdr, 11);
+    uint8_t start = static_cast<uint8_t>((r.head + kFrCap - r.n) % kFrCap);
+    for (uint8_t i = 0; i < r.n; i++) {
+      const FrEntry& e = r.e[(start + i) % kFrCap];
+      tele_scratch_.append(reinterpret_cast<const char*>(&e), sizeof(e));
+    }
+    stats_[kStFrDumps].fetch_add(1, std::memory_order_relaxed);
+    TeleAppend(tele_scratch_.data(), tele_scratch_.size());
+  }
+
+  // Sampled native ack RTT past the slow-ack threshold (sub-record 3):
+  // services/slow_subs.py ranks these next to Python-plane deliveries.
+  void EmitSlowAck(uint64_t id, uint8_t qos, uint64_t rtt_ns,
+                   const std::string& topic) {
+    if (rtt_ns < slow_ack_ns_) return;
+    tele_scratch_.clear();
+    char hdr[16];
+    hdr[0] = 3;
+    memcpy(hdr + 1, &id, 8);
+    uint64_t us = rtt_ns / 1000;
+    uint32_t us32 = us > 0xFFFFFFFFull ? 0xFFFFFFFFu
+                                       : static_cast<uint32_t>(us);
+    memcpy(hdr + 9, &us32, 4);
+    hdr[13] = static_cast<char>(qos);
+    uint16_t tl = topic.size() > 0xFFFF
+                      ? 0xFFFF
+                      : static_cast<uint16_t>(topic.size());
+    memcpy(hdr + 14, &tl, 2);
+    tele_scratch_.append(hdr, 16);
+    tele_scratch_.append(topic.data(), tl);
+    TeleAppend(tele_scratch_.data(), tele_scratch_.size());
+  }
+
+  // Close out a matching ack-RTT sample (PUBACK ends a qos1 stamp,
+  // PUBCOMP a qos2 one — the full exchange RTT by construction, since
+  // the inflight bit holds across PUBREC/PUBREL).
+  void TeleAckRtt(uint64_t id, AckState& a, uint16_t pid) {
+    for (size_t i = 0; i < a.rtt.size(); i++) {
+      if (a.rtt[i].pid != pid) continue;
+      uint64_t rtt = NowNs() - a.rtt[i].t0_ns;
+      RecordHist(a.rtt[i].qos == 2 ? kHistQos2Rtt : kHistQos1Rtt, rtt);
+      if (telemetry_) EmitSlowAck(id, a.rtt[i].qos, rtt, a.rtt[i].topic);
+      a.rtt[i] = std::move(a.rtt.back());
+      a.rtt.pop_back();
+      return;
+    }
+  }
+
   static void BuildPublish(std::string* out, std::string_view topic,
                            std::string_view payload, uint8_t qos,
                            uint16_t pid, bool v5) {
@@ -1612,6 +2035,22 @@ class Host {
   void Drop(uint64_t id, const char* reason, bool notify) {
     auto it = conns_.find(id);
     if (it == conns_.end()) return;
+    if (telemetry_ && it->second.fr) {
+      // flight-recorder dump on abnormal close / protocol error, and
+      // always for traced conns (the tail rides the trace log)
+      Conn& c = it->second;
+      bool benign = strcmp(reason, "sock_closed") == 0 ||
+                    strcmp(reason, "closed_by_host") == 0 ||
+                    strcmp(reason, "ws_close") == 0;
+      if (c.traced || !benign) {
+        uint8_t why = c.traced ? kFrReasonTrace
+                      : (strcmp(reason, "frame_error") == 0 ||
+                         strncmp(reason, "ws_", 3) == 0)
+                          ? kFrReasonError
+                          : kFrReasonClose;
+        EmitFlightRec(id, c, why);
+      }
+    }
     // tear down this conn's real subscription entries; punt markers are
     // owned by Python tokens and removed through the broker observer
     for (const std::string& filt : it->second.own_subs)
@@ -1655,6 +2094,22 @@ class Host {
   std::vector<uint64_t> dirty_;
   std::atomic<uint64_t> stats_[kStatCount] = {};
   std::atomic<pthread_t> poll_thread_{};  // enforces ConnIdleMs contract
+  mutable std::atomic<bool> idle_misuse_warned_{false};
+  // -- telemetry plane (poll-thread-owned) --------------------------------
+  bool telemetry_ = true;        // EMQX_NATIVE_TELEMETRY=0 escape hatch
+  uint64_t slow_ack_ns_ = 500ull * 1000 * 1000;  // slow-ack report floor
+  Hist hists_[kHistCount];
+  Hist hists_flushed_[kHistCount];  // shadow at last kind-8 emission
+  uint32_t hist_dirty_ = 0;         // bit per stage
+  uint64_t poll_exit_ns_ = 0;       // GIL-stint reference stamp
+  uint64_t flush_t0_ = 0;           // sampled route->flush stamp
+  uint32_t tele_tick_ = 0;          // 1-in-8 publish sampling counter
+  uint32_t tele_tick_ws_ = 0;       // 1-in-8 WS-ingest sampling counter
+  uint64_t fr_now_ms_ = 0;          // per-cycle flight-recorder stamp
+  uint64_t last_hist_flush_ms_ = 0;  // hist-delta emission cadence
+  uint32_t cur_hash_ = 0;           // current publish's topic hash
+  std::string tele_buf_;      // kind-8 batch (bytes [0,13) = header slot)
+  std::string tele_scratch_;  // one sub-record under construction
   // -- device match lane (poll-thread-owned) ------------------------------
   // Permitted PUBLISHes whose wildcard match runs on the DEVICE router
   // instead of the C++ trie walk: the frame parks here keyed by a lane
@@ -1838,6 +2293,28 @@ int emqx_host_set_inflight_cap(void* h, uint64_t conn, uint32_t cap) {
   op.kind = emqx_native::Op::kSetInflightCap;
   op.owner = conn;
   op.max_inflight = cap;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Trace punt (observability): a traced conn's PUBLISHes take the
+// Python plane (full hook visibility) and its flight-recorder tail is
+// dumped — immediately on attach and again at teardown (kind 8).
+int emqx_host_set_trace(void* h, uint64_t conn, int on) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetTrace;
+  op.owner = conn;
+  op.flags = on ? 1 : 0;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Telemetry master switch + slow-ack report floor (ns). Histograms,
+// flight recorders, and kind-8 emission all gate on `enabled` — the
+// EMQX_NATIVE_TELEMETRY=0 escape hatch for overhead-sensitive runs.
+int emqx_host_set_telemetry(void* h, int enabled, uint64_t slow_ack_ns) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetTelemetry;
+  op.flags = enabled ? 1 : 0;
+  op.token = slow_ack_ns;
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 

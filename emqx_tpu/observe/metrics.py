@@ -69,6 +69,11 @@ NATIVE = [
     "messages.native.qos1.received", "messages.native.qos2.received",
     "messages.native.acked",
     "messages.native.lane_topic_overflow",
+    # device-path batches served from the host oracle after a model
+    # failure (broker._device_failover) — a fixed slot so it renders at
+    # zero in prometheus/$SYS instead of appearing only after the first
+    # failover (PR 2 counted it; nothing surfaced it)
+    "messages.device_failover",
 ]
 CLIENT = [
     "client.connect", "client.connack", "client.connected",
@@ -88,12 +93,108 @@ ALL_NAMES: list[str] = (BYTES + PACKETS + MESSAGES + DELIVERY + NATIVE
                         + CLIENT + SESSION + AUTHZ + OLP)
 
 
+# ---------------------------------------------------------------------------
+# latency histograms (native telemetry plane)
+#
+# HDR-histogram-style log-bucketed capture: 64 fixed buckets at
+# ~power-of-√2 spacing, mirroring host.cc HistBucket EXACTLY — the C++
+# poll thread bumps plain uint64 arrays and ships per-cycle deltas
+# (event kind 8); this class is the Python accumulator those deltas
+# fold into, and the percentile/exposition surface for prometheus,
+# $SYS, and bench.py.
+
+
+def _hist_edges() -> tuple:
+    """Upper bucket edges in ns. Bucket 0 = [0,2); for MSB position
+    e >= 1, bucket 2e-1 tops at √2·2^e (1448/1024 fixed-point, the C++
+    comparison) and bucket 2e at 2^(e+1); bucket 63 = +inf."""
+    edges: list[float] = [2.0]
+    for e in range(1, 32):
+        edges.append((1448 << e) / 1024.0)
+        edges.append(float(1 << (e + 1)))
+    return tuple(edges + [float("inf")])  # 63 finite edges + inf
+
+
+HIST_EDGES_NS: tuple = _hist_edges()
+
+
+def hist_bucket(ns: int) -> int:
+    """Python mirror of host.cc HistBucket (differential-tested)."""
+    ns = int(ns)
+    if ns < 2:
+        return 0
+    e = ns.bit_length() - 1
+    if e >= 32:
+        return 63
+    return 2 * e - 1 + (1 if (ns << 10) >= (1448 << e) else 0)
+
+
+class LatencyHistogram:
+    """Fixed 64-bucket log-scale latency histogram (sum/count carried
+    alongside, prometheus-histogram shaped). Not thread-safe: owners
+    feed it from one thread (the native poll thread's _on_telemetry)
+    and readers tolerate torn-but-monotone snapshots like the counter
+    array above."""
+
+    __slots__ = ("counts", "sum_ns", "count")
+
+    def __init__(self) -> None:
+        self.counts = np.zeros(64, dtype=np.int64)
+        self.sum_ns = 0
+        self.count = 0
+
+    def observe(self, ns: int) -> None:
+        self.counts[hist_bucket(ns)] += 1
+        self.sum_ns += int(ns)
+        self.count += 1
+
+    def observe_delta(self, count_d: int, sum_d: int,
+                      bucket_deltas: dict[int, int]) -> None:
+        """Fold one kind-8 per-cycle delta record in."""
+        self.count += count_d
+        self.sum_ns += sum_d
+        for idx, d in bucket_deltas.items():
+            self.counts[idx] += d
+
+    def percentile(self, q: float) -> float:
+        """q in [0,1] -> ns, linearly interpolated inside the bucket
+        (the +inf bucket reports its lower edge)."""
+        if self.count <= 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i in range(64):
+            c = int(self.counts[i])
+            if c == 0:
+                continue
+            prev = cum
+            cum += c
+            if cum >= target:
+                lo = HIST_EDGES_NS[i - 1] if i else 0.0
+                hi = HIST_EDGES_NS[i]
+                if hi == float("inf"):
+                    return lo
+                return lo + (hi - lo) * max(0.0, target - prev) / c
+        return 0.0
+
+    def summary(self) -> dict:
+        """p50/p99/p999 in µs + count/sum — the bench artifact shape."""
+        return {
+            "count": int(self.count),
+            "sum_ms": round(self.sum_ns / 1e6, 3),
+            "p50_us": round(self.percentile(0.5) / 1e3, 2),
+            "p99_us": round(self.percentile(0.99) / 1e3, 2),
+            "p999_us": round(self.percentile(0.999) / 1e3, 2),
+        }
+
+
 class Metrics:
     def __init__(self, names: Optional[Iterable[str]] = None) -> None:
         names = list(names) if names is not None else list(ALL_NAMES)
         self._idx: dict[str, int] = {n: i for i, n in enumerate(names)}
         self._c = np.zeros(len(names), dtype=np.int64)
         self._dyn: dict[str, int] = {}
+        self._hists: dict[str, LatencyHistogram] = {}
         self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1) -> None:
@@ -119,6 +220,26 @@ class Metrics:
         self._c[:] = 0
         with self._lock:
             self._dyn.clear()
+            for h in self._hists.values():
+                h.counts[:] = 0
+                h.sum_ns = h.count = 0
+
+    # -- latency histograms -------------------------------------------------
+
+    def register_hist(self, name: str) -> LatencyHistogram:
+        """Idempotent: one LatencyHistogram per name (e.g.
+        ``latency.native.ingress_route``), shared by all callers."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram()
+            return h
+
+    def hist(self, name: str) -> Optional[LatencyHistogram]:
+        return self._hists.get(name)
+
+    def hists(self) -> dict[str, LatencyHistogram]:
+        return dict(self._hists)
 
     # -- convenience used by the packet host --------------------------------
 

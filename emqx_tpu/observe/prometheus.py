@@ -1,9 +1,12 @@
 """Prometheus text exposition — parity with
 ``apps/emqx_prometheus/src/emqx_prometheus.erl``.
 
-Renders the metric counters, stat gauges, and VM/process figures into
-the text 0.0.4 format the scrape endpoint serves. Metric names map
-``a.b.c`` → ``emqx_a_b_c`` as the reference's collector does.
+Renders the metric counters, stat gauges, VM/process figures, the
+native host's fast-path stat slots (``emqx_native_*`` gauges), and the
+native telemetry plane's latency histograms
+(``emqx_latency_*_seconds`` with ``_bucket``/``_sum``/``_count``
+series) into the text 0.0.4 format the scrape endpoint serves. Metric
+names map ``a.b.c`` → ``emqx_a_b_c`` as the reference's collector does.
 """
 
 from __future__ import annotations
@@ -17,8 +20,34 @@ def _san(name: str) -> str:
     return "emqx_" + name.replace(".", "_")
 
 
+def _render_hists(lines: list[str], hists: dict, node: str) -> None:
+    """``_bucket``/``_sum``/``_count`` series per latency histogram.
+
+    Bucket edges convert ns → seconds (prometheus convention); only
+    buckets with occupants are listed (le labels are explicit, so a
+    sparse cumulative series stays well-formed) plus the mandatory
+    ``le="+Inf"`` line.
+    """
+    from emqx_tpu.observe.metrics import HIST_EDGES_NS
+
+    for name, h in sorted(hists.items()):
+        mn = _san(name) + "_seconds"
+        lines.append(f"# TYPE {mn} histogram")
+        cum = 0
+        for i in range(63):  # bucket 63 is the +Inf line below
+            c = int(h.counts[i])
+            if c == 0:
+                continue
+            cum += c
+            lines.append(f'{mn}_bucket{{node="{node}",'
+                         f'le="{HIST_EDGES_NS[i] / 1e9:.9g}"}} {cum}')
+        lines.append(f'{mn}_bucket{{node="{node}",le="+Inf"}} {h.count}')
+        lines.append(f'{mn}_sum{{node="{node}"}} {h.sum_ns / 1e9:.9g}')
+        lines.append(f'{mn}_count{{node="{node}"}} {h.count}')
+
+
 def render(metrics=None, stats=None, extra: Optional[dict] = None,
-           node: str = "emqx_tpu") -> str:
+           node: str = "emqx_tpu", native: Optional[dict] = None) -> str:
     lines: list[str] = []
     label = f'{{node="{node}"}}'
     if metrics is not None:
@@ -26,9 +55,21 @@ def render(metrics=None, stats=None, extra: Optional[dict] = None,
             mn = _san(name)
             lines.append(f"# TYPE {mn} counter")
             lines.append(f"{mn}{label} {val}")
+        hists = getattr(metrics, "hists", None)
+        if callable(hists):
+            h = hists()
+            if h:
+                _render_hists(lines, h, node)
     if stats is not None:
         for name, val in sorted(stats.all().items()):
             mn = _san(name)
+            lines.append(f"# TYPE {mn} gauge")
+            lines.append(f"{mn}{label} {val}")
+    if native:
+        # the C++ host's monotonic stat slots (NativeHost.stats());
+        # tests/test_stats_lint.py asserts every exported slot lands here
+        for name, val in sorted(native.items()):
+            mn = "emqx_native_" + name.replace(".", "_")
             lines.append(f"# TYPE {mn} gauge")
             lines.append(f"{mn}{label} {val}")
     # VM slice (the reference exports erlang_vm_*; we export process RSS)

@@ -62,6 +62,28 @@ class SysHeartbeat:
             for name, val in self.metrics.all().items():
                 self._pub(f"metrics/{name}", str(val))
 
+    def publish_latency(self) -> None:
+        """Latency heartbeat from the telemetry plane's histograms:
+        ``$SYS/brokers/<node>/latency/<stage>/p50|p99|p999`` in ms
+        (plus ``.../count``). Histogram names like
+        ``latency.native.ingress_route`` map to
+        ``latency/native/ingress_route``; stages with no observations
+        publish nothing."""
+        hists = getattr(self.metrics, "hists", None)
+        if not callable(hists):
+            return
+        for name, h in hists().items():
+            if h.count <= 0:
+                continue
+            base = name.replace(".", "/")
+            if not base.startswith("latency/"):
+                base = "latency/" + base
+            for q, v in (("p50", h.percentile(0.5)),
+                         ("p99", h.percentile(0.99)),
+                         ("p999", h.percentile(0.999))):
+                self._pub(f"{base}/{q}", f"{v / 1e6:.3f}")
+            self._pub(f"{base}/count", str(int(h.count)))
+
     def tick(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         if now - self._last_heartbeat >= self.heartbeat_s:
@@ -71,3 +93,4 @@ class SysHeartbeat:
             self._last_tick = now
             self.publish_stats()
             self.publish_metrics()
+            self.publish_latency()

@@ -141,6 +141,17 @@ class TraceManager:
 
     # -- event feed (hook callbacks) -----------------------------------------
 
+    def log_for_client(self, clientid: str, event: str,
+                       detail: str) -> None:
+        """Append one line to every running clientid trace matching
+        ``clientid`` — the native plane's entry point for attaching a
+        connection's flight-recorder tail (broker/native_server.py
+        _on_telemetry) to the trace the operator is watching."""
+        for tr in self.running():
+            if (tr.filter_type == "clientid"
+                    and tr.filter_value == clientid):
+                tr.log(event, detail)
+
     def _active(self):
         return self.running()
 

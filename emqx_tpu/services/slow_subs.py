@@ -21,6 +21,13 @@ class SlowEntry:
     topic: str
     latency_ms: int
     last_update: float
+    # which delivery plane observed the latency: "python" (the
+    # delivery.completed hook, publish-ts -> delivery) or "native" (a
+    # sampled C++ fast-path ack RTT, delivery write -> PUBACK/PUBCOMP —
+    # kind-8 slow-ack records via broker/native_server.py). Before the
+    # telemetry plane the native fast path was invisible here: a slow
+    # native subscriber never ranked.
+    plane: str = "python"
 
 
 class SlowSubs:
@@ -42,7 +49,8 @@ class SlowSubs:
         self.record(clientid, topic, latency_ms)
 
     def record(self, clientid: str, topic: str, latency_ms: int,
-               now: Optional[float] = None) -> None:
+               now: Optional[float] = None,
+               plane: str = "python") -> None:
         if not self.enable or latency_ms < self.threshold_ms:
             return
         now = time.time() if now is None else now
@@ -51,7 +59,7 @@ class SlowSubs:
             cur = self._table.get(key)
             if cur is None or latency_ms > cur.latency_ms:
                 self._table[key] = SlowEntry(clientid, topic,
-                                             latency_ms, now)
+                                             latency_ms, now, plane)
             else:
                 cur.last_update = now
             if len(self._table) > self.top_k:

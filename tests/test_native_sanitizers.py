@@ -542,8 +542,110 @@ print("SANITIZED-RUN-OK", st)
 """
 
 
+# Round-8 telemetry-plane coverage (ISSUE 3 satellite): histogram
+# export + flight-recorder dumps under load, with set_trace /
+# set_telemetry toggles racing the poll thread from a control thread,
+# and a protocol-error teardown dumping the recorder mid-traffic.
+DRIVER_TELEMETRY = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+host = native.NativeHost(port=0, max_size=4096)
+
+def connect(cid):
+    s = socket.create_connection(("127.0.0.1", host.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+def pub_frame(topic, payload, qos=0, pid=0):
+    vh = struct.pack(">H", len(topic)) + topic
+    if qos:
+        vh += struct.pack(">H", pid)
+    vh += payload
+    return bytes([0x30 | (qos << 1), len(vh)]) + vh
+
+socks = [connect(b"t%%02d" %% i) for i in range(6)]
+conns = []
+deadline = time.time() + 15
+while len(conns) < 6 and time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind == native.EV_OPEN:
+            conns.append(conn)
+assert len(conns) == 6, conns
+pub_id, sub_id = conns[0], conns[1]
+host.enable_fast(pub_id, 4)
+host.sub_add(sub_id, "tele/t", qos=1)
+host.permit(pub_id, "tele/t")
+list(host.poll(20))
+
+stop = threading.Event()
+def toggler():
+    # cross-thread control ops racing the poll thread (the contract
+    # under test): trace punts flip and the telemetry master switch
+    # cycles while publishes flow
+    i = 0
+    while not stop.is_set():
+        host.set_trace(conns[2 + (i %% 4)], i %% 2 == 0)
+        if i %% 7 == 0:
+            host.set_telemetry(i %% 14 != 0, slow_ack_ms=0)
+        i += 1
+        time.sleep(0.002)
+tog = threading.Thread(target=toggler)
+tog.start()
+
+tele_records = 0
+flights = 0
+hist_deltas = 0
+for burst in range(30):
+    for i in range(20):
+        socks[0].sendall(pub_frame(b"tele/t", b"p%%02d" %% i,
+                                   qos=(i %% 2), pid=100 + i))
+    t0 = time.time()
+    while time.time() - t0 < 0.05:
+        for kind, conn, payload in host.poll(5):
+            if kind == native.EV_TELEMETRY:
+                tele_records += 1
+                for rec in native.parse_telemetry(payload):
+                    if rec[0] == "flight":
+                        flights += 1
+                    elif rec[0] == "hist":
+                        hist_deltas += 1
+stop.set(); tog.join()
+host.set_telemetry(True, slow_ack_ms=0)
+list(host.poll(20))
+# protocol error mid-traffic: oversized remaining length tears down the
+# conn and dumps its recorder
+socks[0].sendall(bytes([0x30, 0xFF, 0xFF, 0xFF, 0x7F]))
+deadline = time.time() + 5
+closed = False
+while not closed and time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind == native.EV_CLOSED and conn == pub_id:
+            closed = True
+        elif kind == native.EV_TELEMETRY:
+            for rec in native.parse_telemetry(payload):
+                if rec[0] == "flight":
+                    flights += 1
+assert closed
+assert tele_records > 0 and hist_deltas > 0, (tele_records, hist_deltas)
+assert flights > 0, flights
+st = host.stats()
+assert st["telemetry_batches"] > 0 and st["fr_dumps"] > 0, st
+for s in socks:
+    try: s.close()
+    except OSError: pass
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+print("SANITIZED-RUN-OK", st["telemetry_batches"], st["fr_dumps"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
-@pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws"])
+@pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
+                                    "telemetry"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -559,7 +661,8 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
         "TSAN_OPTIONS": "halt_on_error=1:report_signal_unsafe=0",
     }
     src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
-           "lane": DRIVER_LANE, "ws": DRIVER_WS}[driver]
+           "lane": DRIVER_LANE, "ws": DRIVER_WS,
+           "telemetry": DRIVER_TELEMETRY}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

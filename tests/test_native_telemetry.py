@@ -1,0 +1,486 @@
+"""Native telemetry plane (round 8): in-host latency histograms,
+per-poll kind-8 snapshot export, and the fast-path flight recorder.
+
+The C++ host (native/src/host.cc) bumps fixed 64-bucket log-scale
+histograms on the poll thread and ships per-cycle DELTAS as batched
+kind-8 records (chunked at the tap bound like kinds 6/7);
+broker/native_server.py folds them into histogram-aware Metrics
+(observe/metrics.py), prometheus (_bucket/_sum/_count), $SYS latency
+heartbeats, and slow_subs (native ack RTT). TraceManager clientid
+traces punt their conns at the C++ seam (emqx_host_set_trace) so a
+trace captures publishes from a connection that was on the native fast
+path — the ISSUE 3 acceptance shape. Reference anchors: HdrHistogram
+(log-bucketed capture), Dapper (always-on low-overhead recording),
+emqx_slow_subs.erl (ack-latency ranking)."""
+
+import asyncio
+import socket
+import struct
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp            # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer  # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient   # noqa: E402
+from emqx_tpu.observe.metrics import (        # noqa: E402
+    HIST_EDGES_NS, LatencyHistogram, hist_bucket,
+)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _settle(seconds=0.4):
+    await asyncio.sleep(seconds)
+
+
+def _connect_frame(cid: bytes) -> bytes:
+    vh = (b"\x00\x04MQTT\x04\x02\x00\x3c"
+          + struct.pack(">H", len(cid)) + cid)
+    return bytes([0x10, len(vh)]) + vh
+
+
+def _publish_frame(topic: bytes, payload: bytes) -> bytes:
+    vh = struct.pack(">H", len(topic)) + topic + payload
+    assert len(vh) < 128
+    return bytes([0x30, len(vh)]) + vh
+
+
+# -- bucket math (python mirror of host.cc HistBucket) -----------------------
+
+def test_hist_edges_and_bucket_mirror_invariants():
+    assert len(HIST_EDGES_NS) == 64
+    prev = 0.0
+    for e in HIST_EDGES_NS:
+        assert e > prev
+        prev = e
+    assert HIST_EDGES_NS[-1] == float("inf")
+    # every value lands in the bucket whose [lower, upper) contains it
+    for ns in list(range(0, 300)) + [1000, 4095, 123456, 10**6, 10**9,
+                                     2**31, 2**32 - 1, 2**32, 2**40]:
+        b = hist_bucket(ns)
+        lo = HIST_EDGES_NS[b - 1] if b else 0.0
+        hi = HIST_EDGES_NS[b]
+        assert lo <= ns < hi, (ns, b, lo, hi)
+    # ~power-of-√2 spacing: consecutive finite edges within [1.3, 1.6]x
+    for i in range(1, 62):
+        r = HIST_EDGES_NS[i + 1] / HIST_EDGES_NS[i]
+        assert 1.3 < r < 1.6, (i, r)
+
+
+def test_latency_histogram_percentiles_and_delta_fold():
+    h = LatencyHistogram()
+    for v in (100, 200, 400, 800, 100_000):
+        h.observe(v)
+    assert h.count == 5 and h.sum_ns == 101_500
+    p50, p99 = h.percentile(0.5), h.percentile(0.99)
+    assert 200 <= p50 <= 500 and p99 >= 50_000
+    assert p50 <= p99 <= h.percentile(0.999)
+    # folding deltas reproduces an identical histogram
+    h2 = LatencyHistogram()
+    h2.observe_delta(h.count, h.sum_ns,
+                     {i: int(h.counts[i]) for i in range(64)
+                      if h.counts[i]})
+    assert (h2.counts == h.counts).all()
+    assert h2.summary() == h.summary()
+
+
+# -- end-to-end: stage histograms populate and export ------------------------
+
+def test_stage_histograms_populate_and_render():
+    """QoS1 traffic on the fast path fills ingress_route (sampled
+    1-in-8, deterministically), route_flush, qos1_rtt (every
+    windowed delivery while a sample slot is free), and gil_stint —
+    and the whole set renders in prometheus + the $SYS latency
+    heartbeat."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="hs")
+        await sub.connect()
+        await sub.subscribe("h/x", qos=1)
+        pub = MqttClient(port=server.port, clientid="hp")
+        await pub.connect()
+        await pub.publish("h/x", b"warm", qos=1)   # slow path, permit
+        await sub.recv(timeout=10)
+        await _settle(0.6)
+        for i in range(40):
+            await pub.publish("h/x", b"m%d" % i, qos=1)
+            await sub.recv(timeout=10)
+        await _settle(0.6)
+        summ = server.latency_summary()
+        # the global 1-in-8 ticker saw 41 PUBLISH ticks (warm + 40
+        # fast); samples land on ticks 8..40 — all on the walk path
+        assert summ["ingress_route"]["count"] == 5, summ
+        assert summ["route_flush"]["count"] >= 1, summ
+        assert summ["qos1_rtt"]["count"] == 40, summ
+        assert summ["gil_stint"]["count"] > 0, summ
+        for stage in ("ingress_route", "qos1_rtt"):
+            s = summ[stage]
+            assert 0 < s["p50_us"] <= s["p99_us"] <= s["p999_us"], s
+        # histogram-aware Metrics: the same objects live on the node
+        # metrics under latency.native.<stage>
+        h = app.metrics.hist("latency.native.qos1_rtt")
+        assert h is not None and h.count == 40
+        prom = app.prometheus()
+        for stage in ("ingress_route", "route_flush", "qos1_rtt",
+                      "gil_stint"):
+            base = f"emqx_latency_native_{stage}_seconds"
+            assert f"{base}_bucket" in prom, stage
+            assert f"{base}_sum" in prom and f"{base}_count" in prom
+        assert 'le="+Inf"' in prom
+        # $SYS latency heartbeat
+        got = []
+        app.sys.publish_fn = got.append
+        app.sys.publish_latency()
+        topics = {m.topic for m in got}
+        node = app.broker.node
+        for q in ("p50", "p99", "p999", "count"):
+            t = f"$SYS/brokers/{node}/latency/native/qos1_rtt/{q}"
+            assert t in topics, sorted(topics)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- kind-8 chunking + delta totals (satellite: snapshot-under-load) ---------
+
+def test_kind8_chunks_at_tap_bound_and_records_survive():
+    """A cycle whose telemetry exceeds the tap bound must CHUNK into
+    several kind-8 events with no sub-record split or lost (mirror of
+    the kind-7 chunking regression): 60 flight-recorder dumps forced
+    in ONE ApplyPending far exceed the small cap."""
+    host = native.NativeHost(port=0, max_size=2048)  # cap = 1025
+    socks, conns = [], []
+    try:
+        for i in range(60):
+            s = socket.create_connection(("127.0.0.1", host.port))
+            s.sendall(_connect_frame(b"c%03d" % i))
+            socks.append(s)
+        deadline = time.time() + 10
+        frames = 0
+        while (len(conns) < 60 or frames < 60) and time.time() < deadline:
+            for kind, conn, payload in host.poll(20):
+                if kind == native.EV_OPEN:
+                    conns.append(conn)
+                elif kind == native.EV_FRAME:
+                    frames += 1
+        assert len(conns) == 60 and frames == 60
+        # 60 trace attaches queue as ops and apply in ONE poll cycle:
+        # each dumps its recorder (open + frame = 2 entries, ~43B), so
+        # the cycle writes ~2.6KB against a ~1KB cap
+        for c in conns:
+            host.set_trace(c, True)
+        tele_events, flights = [], []
+        deadline = time.time() + 10
+        while len(flights) < 60 and time.time() < deadline:
+            for kind, conn, payload in host.poll(20):
+                if kind == native.EV_TELEMETRY:
+                    tele_events.append(payload)
+                    for rec in native.parse_telemetry(payload):
+                        if rec[0] == "flight":
+                            flights.append(rec)
+        assert len(flights) == 60, len(flights)
+        assert len(tele_events) >= 3, (
+            "expected the cycle to chunk at the tap bound",
+            len(tele_events))
+        for _, conn_id, reason, entries in flights:
+            assert conn_id in conns
+            assert reason == 3                      # trace attach
+            assert len(entries) == 2, entries       # open + connect
+            assert entries[0][1] == 1               # fr open
+            assert entries[1][1] == 2               # slow-plane frame
+            assert entries[1][2] == 1               # CONNECT ptype
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for _ in range(5):
+            list(host.poll(10))
+        host.destroy()
+
+
+def test_kind8_histogram_deltas_sum_to_totals_across_cycles():
+    """Per-cycle histogram deltas folded across MANY cycles (and any
+    chunk boundaries) must reproduce the exact totals: 80 fast-path
+    publishes sample exactly 10 ingress_route observations (global
+    1-in-8 ticker), and the bucket deltas sum to the count deltas."""
+    host = native.NativeHost(port=0, max_size=2048)
+    try:
+        pub = socket.create_connection(("127.0.0.1", host.port))
+        sub = socket.create_connection(("127.0.0.1", host.port))
+        ids = []
+        deadline = time.time() + 10
+        while len(ids) < 2 and time.time() < deadline:
+            for kind, conn, payload in host.poll(20):
+                if kind == native.EV_OPEN:
+                    ids.append(conn)
+        pub_id, sub_id = ids
+        host.enable_fast(pub_id, 4)
+        host.sub_add(sub_id, "t")
+        host.permit(pub_id, "t")
+        list(host.poll(20))                        # apply the ops
+        by_stage = {}                              # stage -> [cnt, sum, {b: d}]
+        fast_in0 = host.stats()["fast_in"]
+        for burst in range(8):                     # 8 bursts x 10 msgs
+            for i in range(10):
+                pub.sendall(_publish_frame(b"t", b"p%02d" % i))
+            # drain a few cycles so each burst's deltas flush separately
+            t0 = time.time()
+            while time.time() - t0 < 0.25:
+                for kind, conn, payload in host.poll(10):
+                    if kind != native.EV_TELEMETRY:
+                        continue
+                    for rec in native.parse_telemetry(payload):
+                        if rec[0] != "hist":
+                            continue
+                        _, stage, cnt, sum_ns, buckets = rec
+                        acc = by_stage.setdefault(stage, [0, 0, {}])
+                        acc[0] += cnt
+                        acc[1] += sum_ns
+                        for b, d in buckets.items():
+                            acc[2][b] = acc[2].get(b, 0) + d
+                if host.stats()["fast_in"] - fast_in0 >= (burst + 1) * 10:
+                    break
+        assert host.stats()["fast_in"] - fast_in0 == 80
+        # final drain: hist deltas flush on a ~100ms cadence, so the
+        # last burst's samples may still be pending
+        t0 = time.time()
+        while time.time() - t0 < 1.0:
+            for kind, conn, payload in host.poll(25):
+                if kind != native.EV_TELEMETRY:
+                    continue
+                for rec in native.parse_telemetry(payload):
+                    if rec[0] != "hist":
+                        continue
+                    _, stage, cnt, sum_ns, buckets = rec
+                    acc = by_stage.setdefault(stage, [0, 0, {}])
+                    acc[0] += cnt
+                    acc[1] += sum_ns
+                    for b, d in buckets.items():
+                        acc[2][b] = acc[2].get(b, 0) + d
+            if by_stage.get(0, [0])[0] >= 10:
+                break
+        ing = by_stage.get(0)                      # kHistIngressRoute
+        assert ing is not None, by_stage.keys()
+        cnt, sum_ns, buckets = ing
+        assert cnt == 10, ing                      # 80 publishes / 8
+        assert sum(buckets.values()) == cnt        # deltas sum to totals
+        assert sum_ns > 0
+        # gil_stint flushed every cycle: its bucket deltas must also
+        # reconcile with its count across all those records
+        gil = by_stage.get(5)                      # kHistGilStint
+        assert gil is not None and sum(gil[2].values()) == gil[0] > 0
+        pub.close(); sub.close()
+    finally:
+        for _ in range(5):
+            list(host.poll(10))
+        host.destroy()
+
+
+# -- trace punt (the ISSUE 3 acceptance criterion) ---------------------------
+
+def test_clientid_trace_captures_fast_path_publishes():
+    """A clientid trace started via TraceManager on a publisher already
+    riding the native fast path must capture its subsequent publishes
+    (full hook visibility via the C++ trace punt) AND receive the
+    connection's flight-recorder tail."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="zs")
+        await sub.connect()
+        await sub.subscribe("z/x", qos=0)
+        pub = MqttClient(port=server.port, clientid="zp")
+        await pub.connect()
+        await pub.publish("z/x", b"warm", qos=0)
+        await sub.recv(timeout=10)
+        await _settle(0.6)
+        for i in range(5):
+            await pub.publish("z/x", b"fast%d" % i, qos=0)
+            await sub.recv(timeout=10)
+        assert server.fast_stats()["fast_in"] >= 5   # provably fast
+        app.trace.start("t-accept", "clientid", "zp")
+        await _settle(0.5)
+        for i in range(3):
+            await pub.publish("z/x", b"traced%d" % i, qos=0)
+            m = await sub.recv(timeout=10)           # still delivered
+            assert m.payload == b"traced%d" % i
+        await _settle(0.5)
+        st = server.fast_stats()
+        assert st["punts_trace"] >= 3, st
+        assert st["fr_dumps"] >= 1, st
+        lines = app.trace.log_lines("t-accept")
+        pubs = [ln for ln in lines if "PUBLISH" in ln and "z/x" in ln]
+        assert len(pubs) >= 3, lines
+        flights = [ln for ln in lines if "FLIGHT" in ln]
+        assert flights and "fast_pub" in flights[0], lines
+        # stopping the trace un-punts AND flushes permits: the first
+        # publish re-earns the grant on the slow path, the next one
+        # rides the fast plane again
+        app.trace.stop("t-accept")
+        await _settle(0.6)
+        before = server.fast_stats()["fast_in"]
+        await pub.publish("z/x", b"re-earn", qos=0)
+        await sub.recv(timeout=10)
+        await _settle(0.8)
+        await pub.publish("z/x", b"after", qos=0)
+        await sub.recv(timeout=10)
+        await _settle(0.5)
+        assert server.fast_stats()["fast_in"] > before
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_trace_started_before_connect_punts_from_first_frame():
+    """A running clientid trace must catch a publisher that connects
+    AFTER trace start — _maybe_enable_fast marks the conn at the C++
+    seam immediately, so not even the first permitted publish is
+    missed."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+    app.trace.start("t-pre", "clientid", "late")
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="ps")
+        await sub.connect()
+        await sub.subscribe("p/x", qos=0)
+        pub = MqttClient(port=server.port, clientid="late")
+        await pub.connect()
+        for i in range(4):
+            await pub.publish("p/x", b"m%d" % i, qos=0)
+            await sub.recv(timeout=10)
+            await _settle(0.3)
+        lines = app.trace.log_lines("t-pre")
+        pubs = [ln for ln in lines if "PUBLISH" in ln and "p/x" in ln]
+        assert len(pubs) == 4, lines            # every single message
+        assert server.fast_stats()["fast_in"] == 0  # none went native
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- flight recorder on protocol error ---------------------------------------
+
+def test_flight_recorder_dumps_on_protocol_error():
+    """A C++-level framing error (oversized remaining-length) tears the
+    conn down AND surfaces its flight-recorder tail to Python."""
+    server = NativeBrokerServer(port=0, app=BrokerApp(),
+                                max_packet_size=4096)
+    server.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port))
+        s.sendall(_connect_frame(b"bad"))
+        time.sleep(0.3)
+        # remaining length ~268M >> max_packet_size: frame_error in C++
+        s.sendall(bytes([0x30, 0xFF, 0xFF, 0xFF, 0x7F]))
+        deadline = time.time() + 5
+        while not server.flight_records and time.time() < deadline:
+            time.sleep(0.05)
+        assert server.flight_records, "no flight-recorder dump arrived"
+        _conn, reason, entries = server.flight_records[-1]
+        assert reason == 2                       # protocol_error
+        events = [e[1] for e in entries]
+        assert 1 in events and 2 in events       # open + the CONNECT
+        assert server.fast_stats()["fr_dumps"] >= 1
+        s.close()
+    finally:
+        server.stop()
+
+
+# -- slow_subs fed by native ack RTT -----------------------------------------
+
+def test_native_ack_rtt_feeds_slow_subs():
+    """slow_subs previously only saw the Python plane; with the
+    slow-ack threshold at 0 every sampled native QoS1 ack RTT reports,
+    and the SUBSCRIBER ranks in the table tagged plane='native'."""
+    app = BrokerApp()
+    app.slow_subs.threshold_ms = 0
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="slow-sub")
+        await sub.connect()
+        await sub.subscribe("s/x", qos=1)
+        pub = MqttClient(port=server.port, clientid="slow-pub")
+        await pub.connect()
+        await pub.publish("s/x", b"warm", qos=1)
+        await sub.recv(timeout=10)
+        await _settle(0.6)
+        for i in range(5):
+            await pub.publish("s/x", b"m%d" % i, qos=1)
+            await sub.recv(timeout=10)
+        await _settle(0.6)
+        entries = [e for e in app.slow_subs.top()
+                   if e.plane == "native"]
+        assert entries, app.slow_subs.top()
+        assert entries[0].clientid == "slow-sub"
+        assert entries[0].topic == "s/x"
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- escape hatch ------------------------------------------------------------
+
+def test_telemetry_escape_hatch_disables_everything():
+    """telemetry=False (the EMQX_NATIVE_TELEMETRY=0 hatch): no
+    histograms, no kind-8 records, no flight recorders — the bench's
+    observe_overhead section measures this exact toggle."""
+    server = NativeBrokerServer(port=0, app=BrokerApp(), telemetry=False)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="os")
+        await sub.connect()
+        await sub.subscribe("o/x", qos=1)
+        pub = MqttClient(port=server.port, clientid="op")
+        await pub.connect()
+        await pub.publish("o/x", b"warm", qos=1)
+        await sub.recv(timeout=10)
+        await _settle(0.6)
+        for i in range(10):
+            await pub.publish("o/x", b"m%d" % i, qos=1)
+            await sub.recv(timeout=10)
+        await _settle(0.5)
+        st = server.fast_stats()
+        assert st["fast_in"] > 0, st             # plane still fast
+        assert st["telemetry_batches"] == 0, st
+        assert st["fr_dumps"] == 0, st
+        assert server.latency_summary() == {}
+        assert not server.flight_records
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_telemetry_env_var_escape_hatch(monkeypatch):
+    monkeypatch.setenv("EMQX_NATIVE_TELEMETRY", "0")
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    assert server.telemetry is False
+    server.host.destroy()
+    monkeypatch.setenv("EMQX_NATIVE_TELEMETRY", "1")
+    server2 = NativeBrokerServer(port=0, app=BrokerApp())
+    assert server2.telemetry is True
+    server2.host.destroy()

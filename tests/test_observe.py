@@ -181,3 +181,76 @@ def test_sys_messages_reach_subscribers_via_broker():
     ch2.outbox.clear()
     app.sys.heartbeat()
     assert not [p for p in ch2.outbox if isinstance(p, P.Publish)]
+
+
+def test_device_failover_is_a_fixed_slot_and_surfaces_everywhere():
+    """ISSUE 3 satellite: messages.device_failover (counted by
+    broker._device_failover since PR 2) must render at ZERO in the
+    prometheus exposition and ride the $SYS metrics heartbeat — a
+    counter only visible after the first failover is useless for
+    alerting on the first failover."""
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    m = Metrics()
+    assert "messages.device_failover" in m.all()      # fixed slot
+    text = prometheus.render(m, node="n1")
+    assert 'emqx_messages_device_failover{node="n1"} 0' in text
+    m.inc("messages.device_failover", 3)
+    assert m.val("messages.device_failover") == 3
+    msgs = []
+    SysHeartbeat("n1", msgs.append, metrics=m).publish_metrics()
+    by_topic = {x.topic: x.payload for x in msgs}
+    assert by_topic[
+        "$SYS/brokers/n1/metrics/messages.device_failover"] == b"3"
+
+
+def test_latency_histograms_render_and_heartbeat():
+    """Histogram-aware Metrics: registered LatencyHistograms render as
+    prometheus _bucket/_sum/_count series (cumulative, seconds) and
+    publish p50/p99/p999 $SYS latency heartbeat topics."""
+    from emqx_tpu.observe.metrics import HIST_EDGES_NS
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    m = Metrics()
+    h = m.register_hist("latency.native.ingress_route")
+    assert m.register_hist("latency.native.ingress_route") is h  # idem
+    for ns in (500, 1_000, 2_000, 1_000_000):
+        h.observe(ns)
+    text = prometheus.render(m, node="n1")
+    base = "emqx_latency_native_ingress_route_seconds"
+    assert f"# TYPE {base} histogram" in text
+    assert f'{base}_bucket{{node="n1",le="+Inf"}} 4' in text
+    assert f'{base}_count{{node="n1"}} 4' in text
+    assert f'{base}_sum{{node="n1"}}' in text
+    # cumulative: the last finite bucket line carries count<=4 and the
+    # le values are ascending seconds
+    les = [ln for ln in text.splitlines() if f"{base}_bucket" in ln]
+    assert len(les) >= 3
+    msgs = []
+    SysHeartbeat("n1", msgs.append, metrics=m).publish_latency()
+    topics = {x.topic for x in msgs}
+    assert "$SYS/brokers/n1/latency/native/ingress_route/p99" in topics
+    assert "$SYS/brokers/n1/latency/native/ingress_route/count" in topics
+    # an empty histogram publishes nothing
+    m2 = Metrics()
+    m2.register_hist("latency.native.lane_dwell")
+    msgs2 = []
+    SysHeartbeat("n1", msgs2.append, metrics=m2).publish_latency()
+    assert not msgs2
+    # reset clears histograms too
+    m.reset()
+    assert h.count == 0 and int(h.counts.sum()) == 0
+
+
+def test_slow_subs_plane_tag():
+    """Native-plane ack RTTs rank next to Python-plane deliveries,
+    distinguishable by the plane tag."""
+    from emqx_tpu.services.slow_subs import SlowSubs
+
+    ss = SlowSubs(threshold_ms=100, top_k=5)
+    ss.record("py-client", "a/b", 500)                      # default
+    ss.record("native-client", "a/b", 900, plane="native")
+    top = ss.top()
+    assert top[0].clientid == "native-client"
+    assert top[0].plane == "native"
+    assert top[1].plane == "python"

@@ -1,0 +1,95 @@
+"""Stats-slot drift guard (ISSUE 3 satellite).
+
+``host.cc`` exports its fast-path counters as a flat slot array whose
+order MUST match ``native/__init__.py STAT_NAMES`` — the "keep in sync"
+comment at the enum was previously enforced by nothing, so a slot added
+on one side silently shifted every later counter's meaning. These tests
+parse the C++ source directly (no compiler needed):
+
+- every ``kSt*`` slot appears in ``STAT_NAMES`` at the same index under
+  the mechanical CamelCase -> snake_case mapping;
+- every slot is actually incremented somewhere in ``host.cc`` (a dead
+  slot is a lie in the export);
+- every exported stat renders in the prometheus text exposition
+  (``emqx_native_<name>``), and the histogram stage list matches the
+  C++ ``HistStage`` enum the same way.
+"""
+
+import os
+import re
+
+from emqx_tpu import native
+
+HOST_CC = os.path.join(os.path.dirname(__file__), "..", "emqx_tpu",
+                       "native", "src", "host.cc")
+
+
+def _src() -> str:
+    with open(HOST_CC) as f:
+        return f.read()
+
+
+def _enum_body(src: str, name: str) -> str:
+    m = re.search(rf"enum {name}\b[^{{]*\{{(.*?)\}};", src, re.S)
+    assert m, f"enum {name} not found in host.cc"
+    # strip // comments: slot docs routinely NAME other slots ("subset
+    # of kStFastIn"), which must not count as enumerators
+    return re.sub(r"//[^\n]*", "", m.group(1))
+
+
+def _snake(camel: str) -> str:
+    return "_".join(p.lower() for p in re.findall(r"[A-Z][a-z0-9]*", camel))
+
+
+def _stat_slots() -> list:
+    # kStatCount is the sentinel ('a' after kSt breaks the [A-Z] match,
+    # so the regex skips it by construction)
+    return re.findall(r"\bkSt([A-Z]\w*)\b", _enum_body(_src(), "StatSlot"))
+
+
+def test_stat_slots_match_python_names_and_order():
+    got = [_snake(s) for s in _stat_slots()]
+    assert got == list(native.STAT_NAMES), (
+        "host.cc StatSlot order/name drifted from native.STAT_NAMES:\n"
+        f"  C++   : {got}\n  Python: {list(native.STAT_NAMES)}")
+
+
+def test_every_stat_slot_is_incremented_in_host_cc():
+    src = _src()
+    for slot in _stat_slots():
+        # direct (stats_[kStX].fetch_add) or selected (ternary inside
+        # the subscript, e.g. stats_[ok ? kStA : kStB].fetch_add)
+        assert re.search(
+            rf"stats_\[[^\]]*\bkSt{slot}\b[^\]]*\]\s*\.?\s*fetch_add",
+            src), (
+            f"kSt{slot} is exported but never incremented in host.cc")
+
+
+def test_hist_stages_match_cpp_enum():
+    stages = re.findall(r"\bkHist([A-Z]\w*)\b",
+                        _enum_body(_src(), "HistStage"))
+    stages = [s for s in stages if s != "Count"]
+    assert [_snake(s) for s in stages] == list(native.HIST_STAGES)
+
+
+def test_prometheus_renders_every_native_stat():
+    from emqx_tpu.observe import prometheus
+
+    out = prometheus.render(native={k: 7 for k in native.STAT_NAMES})
+    for name in native.STAT_NAMES:
+        assert f"emqx_native_{name}" in out, (
+            f"stat {name} exported by the host but absent from the "
+            f"prometheus exposition")
+
+
+def test_app_prometheus_carries_native_stats_when_wired():
+    """app.prometheus() must pass the native server's stats through —
+    the scrape endpoint, not just the render function, sees them."""
+    from emqx_tpu.app import BrokerApp
+
+    app = BrokerApp()
+    assert app.native_stats_fn is None
+    app.native_stats_fn = lambda: {k: 3 for k in native.STAT_NAMES}
+    out = app.prometheus()
+    for name in native.STAT_NAMES:
+        assert f"emqx_native_{name}" in out

@@ -1293,6 +1293,224 @@ def sec_observe_overhead() -> None:
 
 
 # ---------------------------------------------------------------------------
+# section: trunk (cross-node forwarding on the native plane; CPU by design)
+# ---------------------------------------------------------------------------
+
+def sec_trunk() -> None:
+    """ISSUE 4 acceptance: a two-node loopback pair forwarding QoS0
+    cross-node over the NATIVE trunk must run >= 10x the Python gen_rpc
+    lane (TcpTransport casts through both nodes' Python planes — the
+    lane every cross-node leg rode before this round). Same driver both
+    arms: raw-socket publisher on node A, raw-socket subscriber on node
+    B, the cluster plane replicating the route; the arms differ only by
+    attach_native (trunk adverts on hello/ping)."""
+    import socket
+    import struct
+    import threading
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.cluster.node import ClusterNode
+    from emqx_tpu.cluster.transport import TcpTransport
+
+    def mqtt_connect(cid):
+        vh = (b"\x00\x04MQTT\x04\x02\x00\x3c"
+              + struct.pack(">H", len(cid)) + cid)
+        return bytes([0x10, len(vh)]) + vh
+
+    def mqtt_subscribe(pid, topic, qos=0):
+        body = struct.pack(">H", pid) + struct.pack(">H", len(topic)) \
+            + topic + bytes([qos])
+        return bytes([0x82, len(body)]) + body
+
+    def mqtt_publish(topic, payload):
+        body = struct.pack(">H", len(topic)) + topic + payload
+        head = bytes([0x30])
+        remaining = len(body)
+        var = b""
+        while True:
+            b7 = remaining & 0x7F
+            remaining >>= 7
+            var += bytes([b7 | (0x80 if remaining else 0)])
+            if not remaining:
+                break
+        return head + var + body
+
+    def count_publishes(buf, counts):
+        """Consume whole frames from buf, counting PUBLISHes; returns
+        the unconsumed tail."""
+        pos = 0
+        while True:
+            if len(buf) - pos < 2:
+                break
+            rl = 0
+            shift = 0
+            i = pos + 1
+            ok = True
+            while True:
+                if i >= len(buf):
+                    ok = False
+                    break
+                byte = buf[i]
+                rl |= (byte & 0x7F) << shift
+                shift += 7
+                i += 1
+                if not byte & 0x80:
+                    break
+            if not ok or len(buf) - i < rl:
+                break
+            if buf[pos] >> 4 == 3:
+                counts[0] += 1
+            pos = i + rl
+        return buf[pos:]
+
+    def build_pair(trunk: bool, suffix: str):
+        ta = TcpTransport(f"bA{suffix}")
+        tb = TcpTransport(f"bB{suffix}")
+        ta.add_peer(tb.node, tb.host, tb.port)
+        tb.add_peer(ta.node, ta.host, ta.port)
+        na = ClusterNode(ta.node, ta)
+        nb = ClusterNode(tb.node, tb)
+        sa = NativeBrokerServer(port=0, app=na.app,
+                                trunk_port=0 if trunk else None)
+        sb = NativeBrokerServer(port=0, app=nb.app,
+                                trunk_port=0 if trunk else None)
+        if trunk:
+            na.attach_native(sa)
+            nb.attach_native(sb)
+        sa.start()
+        sb.start()
+        nb.join([na.name])
+        return na, nb, sa, sb
+
+    def drive(trunk: bool, suffix: str, n_msg: int, deadline_s: float):
+        na, nb, sa, sb = build_pair(trunk, suffix)
+        try:
+            sub = socket.create_connection(("127.0.0.1", sb.port))
+            sub.sendall(mqtt_connect(b"bsub") + mqtt_subscribe(1, b"bt/x"))
+            pub = socket.create_connection(("127.0.0.1", sa.port))
+            pub.sendall(mqtt_connect(b"bpub"))
+            time.sleep(0.3)
+            na.flush()
+            nb.flush()
+            if trunk:
+                t0 = time.time()
+                while (not sa.trunk_peer_status().get(nb.name)
+                       and time.time() - t0 < 10):
+                    time.sleep(0.05)
+                assert sa.trunk_peer_status().get(nb.name), "trunk not up"
+            counts = [0]
+            stop = threading.Event()
+
+            def drain():
+                buf = b""
+                sub.settimeout(0.2)
+                while not stop.is_set():
+                    try:
+                        chunk = sub.recv(1 << 16)
+                    except (TimeoutError, OSError):
+                        continue
+                    if not chunk:
+                        return
+                    buf = count_publishes(buf + chunk, counts)
+            dt = threading.Thread(target=drain, daemon=True)
+            dt.start()
+            # warm leg earns the permit through the Python lane
+            pub.sendall(mqtt_publish(b"bt/x", b"warm-up-00000"))
+            t0 = time.time()
+            while counts[0] < 1 and time.time() - t0 < 15:
+                time.sleep(0.05)
+            time.sleep(0.6)     # permit grants on an idle poll step
+            frame = mqtt_publish(b"bt/x", b"x" * 16)
+            blob = frame * 256
+            sent = 0
+            t0 = time.time()
+            while sent < n_msg and time.time() - t0 < deadline_s:
+                pub.sendall(blob)
+                sent += 256
+            t_sent = time.time()
+            deadline = t_sent + max(15.0, deadline_s / 2)
+            last = -1
+            while counts[0] < sent + 1 and time.time() < deadline:
+                if counts[0] != last:
+                    last = counts[0]
+                time.sleep(0.05)
+            wall = time.time() - t0
+            received = counts[0] - 1      # minus the warm leg
+            rate = received / max(wall, 1e-9)
+            # windowed cross-node latency: W outstanding, p99 of the
+            # per-window round trip (send last byte -> all W received)
+            lats = []
+            W = 64
+            for _ in range(40):
+                base = counts[0]
+                lt0 = time.time()
+                pub.sendall(frame * W)
+                while counts[0] < base + W and time.time() - lt0 < 5:
+                    time.sleep(0)
+                lats.append((time.time() - lt0) * 1000 / W)
+            lats.sort()
+            p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+            stop.set()
+            dt.join(timeout=2)
+            stats = sa.fast_stats()
+            summ = sa.latency_summary() if trunk else {}
+            for s in (pub, sub):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            return rate, received, sent, p99, stats, summ
+        finally:
+            sa.stop()
+            sb.stop()
+            na.transport.close()
+            nb.transport.close()
+
+    n_py = int(os.environ.get("BENCH_TRUNK_PY_MSGS", 4096))
+    n_tk = int(os.environ.get("BENCH_TRUNK_MSGS", 120000))
+
+    py_rate, py_recv, py_sent, py_p99, py_stats, _ = drive(
+        False, "p", n_py, 60.0)
+    log(f"trunk BEFORE (python gen_rpc lane, qos0 cross-node): "
+        f"{py_recv}/{py_sent} = {py_rate:,.0f} msg/s "
+        f"p99/msg={py_p99:.3f}ms (trunk_out={py_stats['trunk_out']})")
+    put("trunk", trunk_python_fwd_msgs_per_sec=round(py_rate),
+        trunk_python_fwd_p99_ms=round(py_p99, 3))
+
+    tk_rate, tk_recv, tk_sent, tk_p99, tk_stats, summ = drive(
+        True, "t", n_tk, 90.0)
+    ratio = tk_rate / max(py_rate, 1e-9)
+    log(f"trunk AFTER (native trunk, qos0 cross-node): "
+        f"{tk_recv}/{tk_sent} = {tk_rate:,.0f} msg/s "
+        f"p99/msg={tk_p99:.3f}ms  ({ratio:,.1f}x the python lane"
+        f"{'' if ratio >= 10 else ' — UNDER the 10x acceptance'}; "
+        f"trunk_out={tk_stats['trunk_out']} "
+        f"batches={tk_stats['trunk_batches_out']})")
+    put("trunk",
+        trunk_native_msgs_per_sec=round(tk_rate),
+        trunk_native_p99_ms=round(tk_p99, 3),
+        trunk_vs_python=round(ratio, 2),
+        trunk_10x_acceptance=bool(ratio >= 10))
+    # broker-side trunk-stage percentiles (enqueue->peer-ack RTT in us;
+    # batch occupancy's "us" axis is really an entry count / 1000 — the
+    # one count-valued stage, host.cc kHistTrunkBatchN)
+    for stage in ("trunk_rtt", "trunk_batch_n"):
+        if stage in summ:
+            s = summ[stage]
+            log(f"broker-side {stage}: p50={s['p50_us']:.1f} "
+                f"p99={s['p99_us']:.1f} (n={s['count']})")
+            put("trunk", **{
+                f"trunk_broker_{stage}_p50_us": round(s["p50_us"], 1),
+                f"trunk_broker_{stage}_p99_us": round(s["p99_us"], 1)})
+
+
+# ---------------------------------------------------------------------------
 # section: e2e (full broker stack with the device router on path)
 # ---------------------------------------------------------------------------
 
@@ -1571,6 +1789,7 @@ SECTIONS = {
     "shared": sec_shared,
     "host": sec_host,
     "ws": sec_ws,
+    "trunk": sec_trunk,
     "e2e": sec_e2e,
     "observe_overhead": sec_observe_overhead,
 }
@@ -1587,6 +1806,7 @@ DEVICE_PLAN = [
     ("xcpp", False, True, 400),
     ("host", False, True, 500),
     ("ws", False, True, 400),
+    ("trunk", False, True, 400),
     ("shared", False, True, 400),
     ("observe_overhead", False, True, 300),
 ]
@@ -1595,14 +1815,15 @@ CPU_PLAN = [
     ("xcpp", False, True, 400),
     ("host", False, True, 500),
     ("ws", False, True, 400),
+    ("trunk", False, True, 400),
     ("shared", False, True, 400),
     ("e2e", False, True, 600),
     ("observe_overhead", False, True, 300),
 ]
 
 _SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
-                  "shared", "host", "ws", "e2e", "observe_overhead",
-                  "kernel_cpu"]
+                  "shared", "host", "ws", "trunk", "e2e",
+                  "observe_overhead", "kernel_cpu"]
 
 
 def _probe_device(attempts: int, timeout_s: float, backoff_s: float) -> dict:

@@ -486,6 +486,7 @@ class Broker:
             elif dest != self.node and self.forward_fn is not None:
                 self.forward_fn(dest, route.topic, msg)
                 self._inc("messages.forward")
+                self._inc("messages.forward.slow")
                 legs += 1
         return shared_legs, legs
 
@@ -534,6 +535,10 @@ class Broker:
             elif self.forward_fn is not None:
                 self.forward_fn(dest, route.topic, msg)
                 self._inc("messages.forward")
+                # the slow half of the forward split: the Python
+                # forward_fn lane, next to messages.forward.native
+                # (trunked legs counted by the native server's merge)
+                self._inc("messages.forward.slow")
         return deliveries
 
     def _dispatch_local(

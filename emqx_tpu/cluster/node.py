@@ -74,6 +74,14 @@ class ClusterNode:
         self.proto_rlog: dict[str, int] = {}
         self._rlog_v2_ok = 2 in bpapi.supported_versions().get("rlog", [])
 
+        # native cluster trunk (round 9): when this node's listener is
+        # a NativeBrokerServer with a trunk port, hello/ping advertise
+        # it and peers' advertisements wire trunk links — cross-node
+        # QoS0/1 publishes then ride the C++ plane; everything else
+        # (and every non-native peer) stays on the Python lanes below
+        self.native_server = None
+        self._trunk_advertise_host = "127.0.0.1"
+
         t = self.transport
         t.register("broker.dispatch", self._h_dispatch)
         t.register("shared_sub.deliver", self._h_shared_deliver)
@@ -127,6 +135,36 @@ class ClusterNode:
         self.app.cm.open_session = self._open_session
         self.app.add_ticker(self.tick)   # heartbeat on app housekeeping
 
+    # -- native trunk wiring ------------------------------------------------
+
+    def attach_native(self, server, advertise_host: str = "127.0.0.1"
+                      ) -> None:
+        """Bind a NativeBrokerServer with a trunk listener to this
+        node: hello/ping now advertise the trunk address, and peers'
+        advertisements dial it. Call before join() (a later attach
+        converges on the next heartbeat round)."""
+        self.native_server = server
+        self._trunk_advertise_host = advertise_host
+
+    def _trunk_advert(self):
+        srv = self.native_server
+        if srv is None or getattr(srv, "trunk_port", None) is None:
+            return None
+        return [self._trunk_advertise_host, srv.trunk_port]
+
+    def _learn_trunk(self, node: str, trunk) -> None:
+        """Record a peer's advertised trunk address (idempotent for an
+        unchanged address — trunk_register re-dials only on change)."""
+        if self.native_server is None or not trunk:
+            return
+        try:
+            self.native_server.trunk_register(node, trunk[0],
+                                              int(trunk[1]))
+        except Exception:                     # noqa: BLE001 — advisory
+            # a bad advert must not poison membership: the Python
+            # forward lane keeps carrying this peer's traffic
+            pass
+
     # -- membership ---------------------------------------------------------
 
     def join(self, seeds: list[str]) -> None:
@@ -138,13 +176,15 @@ class ClusterNode:
             try:
                 resp = self.transport.call(
                     seed, "node.hello", node=self.name,
-                    versions=bpapi.supported_versions(), role=self.role)
+                    versions=bpapi.supported_versions(), role=self.role,
+                    trunk=self._trunk_advert())
             except TransportError:
                 continue
             # compat gate + downshift: a v2 node joining a v1 cluster
             # records 1 here and speaks the v1 dict wire to this peer
             self.proto_rlog[seed] = bpapi.negotiate(resp["versions"],
                                                     "rlog")
+            self._learn_trunk(seed, resp.get("trunk"))
             self._mark_alive(seed, role=resp.get("role", "core"))
             # learned members start UNVERIFIED (alive only on direct
             # contact — a dead peer in the seed's list must not receive
@@ -161,9 +201,10 @@ class ClusterNode:
                     r2 = self.transport.call(
                         other, "node.hello", node=self.name,
                         versions=bpapi.supported_versions(),
-                        role=self.role)
+                        role=self.role, trunk=self._trunk_advert())
                     self.proto_rlog[other] = bpapi.negotiate(
                         r2["versions"], "rlog")
+                    self._learn_trunk(other, r2.get("trunk"))
                     self._mark_alive(other, role=r2.get("role", "core"))
                 except TransportError:
                     pass
@@ -239,7 +280,10 @@ class ClusterNode:
         for peer in peers:
             try:
                 resp = self.transport.call(peer, "node.ping",
-                                           node=self.name, role=self.role)
+                                           node=self.name, role=self.role,
+                                           trunk=self._trunk_advert())
+                if isinstance(resp, dict):
+                    self._learn_trunk(peer, resp.get("trunk"))
                 self._mark_alive(
                     peer, role=(resp.get("role")
                                 if isinstance(resp, dict) else None))
@@ -653,17 +697,20 @@ class ClusterNode:
     # -- hello/ping/bye -----------------------------------------------------
 
     def _h_hello(self, node: str, versions: dict,
-                 role: str = "core") -> dict:
+                 role: str = "core", trunk=None) -> dict:
         # record the negotiated rlog version for the REVERSE direction
         # too: our flushes to a v1 joiner must use the v1 dict wire
         self.proto_rlog[node] = bpapi.negotiate(versions, "rlog")
+        self._learn_trunk(node, trunk)
         with self._lock:
             members = list(self.members) + [self.name]
         self._mark_alive(node, role=role)
         return {"versions": bpapi.supported_versions(),
-                "members": members, "role": self.role}
+                "members": members, "role": self.role,
+                "trunk": self._trunk_advert()}
 
-    def _h_ping(self, node: str, role: Optional[str] = None) -> dict:
+    def _h_ping(self, node: str, role: Optional[str] = None,
+                trunk=None) -> dict:
         with self._lock:
             known_down = (node in self.members
                           and not self.members[node]["alive"])
@@ -674,15 +721,23 @@ class ClusterNode:
                 self.members[node]["role"] = role
         if known_down:
             self._mark_alive(node, role=role)
+        self._learn_trunk(node, trunk)
         # role rides the pong so a peer that learned us indirectly (seed
         # member list, no hello) still classifies us correctly — a
-        # replicant misread as core could be elected coordinator
-        return {"pong": True, "role": self.role}
+        # replicant misread as core could be elected coordinator; the
+        # trunk advert rides it too so a late attach_native converges
+        # on the next heartbeat round
+        return {"pong": True, "role": self.role,
+                "trunk": self._trunk_advert()}
 
     def _h_bye(self, node: str) -> None:
         with self._lock:
             known = node in self.members
         if known:
+            if self.native_server is not None:
+                # the node LEFT (not a partition): drop its trunk link
+                # and replay ring for good; routes purge below
+                self.native_server.trunk_unregister(node, forget=True)
             self._nodedown(node)
             with self._lock:
                 self.members.pop(node, None)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import struct
 import threading
+from collections import deque
 from typing import Any, Callable, Optional
 
 from emqx_tpu.cluster import codec
@@ -140,6 +141,11 @@ class TcpTransport(Transport):
         self._conn_futs: dict[tuple[str, int], asyncio.Future] = {}
         self._futures: dict[int, asyncio.Future] = {}
         self._req_id = 0
+        # per-lane cast FIFOs + their pump tasks: casts are written to
+        # the socket strictly in enqueue order (see cast() for why a
+        # bare write-after-await cannot keep that promise)
+        self._cast_bufs: dict[tuple[str, int], deque] = {}
+        self._cast_pumps: dict[tuple[str, int], asyncio.Task] = {}
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever, daemon=True,
@@ -273,22 +279,78 @@ class TcpTransport(Transport):
 
     def cast(self, to: str, method: str, _key: Any = None,
              **kwargs: Any) -> None:
+        # Enqueue-then-pump, NOT write-after-await: a coroutine that
+        # awaits the lane's connect future resumes via the event-loop
+        # callback queue (two hops), while a cast issued just AFTER the
+        # connect completed awaits an already-done future and writes
+        # immediately (zero hops) — overtaking every cast still parked
+        # on its wakeup. The deflaked per-key ordering contract (the
+        # gen_rpc client-pool guarantee) therefore pins the ORDER at
+        # enqueue time: the frame is appended to the lane's FIFO as the
+        # pump task's first synchronous step, and one pump per lane
+        # drains it in order.
         lane = self._lane_for(_key)
+        frame = self._frame({"id": 0, "kind": "cast",
+                             "method": method, "kwargs": kwargs})
+        key = (to, lane)
 
-        async def go():
+        def _enq():
+            self._cast_bufs.setdefault(key, deque()).append(frame)
+            t = self._cast_pumps.get(key)
+            if t is None or t.done():
+                self._cast_pumps[key] = self._loop.create_task(
+                    self._pump_casts(key))
+        # call_soon_threadsafe preserves submission order per caller
+        # thread, so enqueue order == cast order
+        self._loop.call_soon_threadsafe(_enq)
+
+    async def _pump_casts(self, key: tuple) -> None:
+        node, lane = key
+        q = self._cast_bufs[key]
+        while q:
+            frame = q.popleft()
             try:
-                await self._send(to, {"id": 0, "kind": "cast",
-                                      "method": method, "kwargs": kwargs},
-                                 lane)
+                writer = await self._get_writer(node, lane)
+                writer.write(frame)
+                await writer.drain()
             except (ConnectionError, OSError):
-                pass                            # async mode drops on error
-        asyncio.run_coroutine_threadsafe(go(), self._loop)
+                # only THIS frame drops (async-mode semantics, same as
+                # the old per-cast _send): the next frame re-dials via
+                # _get_writer — clearing the whole queue here would
+                # silently discard every queued broadcast on a one-frame
+                # transient (e.g. a shared-membership delta after a
+                # peer restart)
+                continue
+        # a cast appended after the final `while q` check sees the task
+        # done() and spawns a fresh pump — both run on the loop thread,
+        # so the check/append interleaving cannot lose a frame
+
+    def flush_casts(self, timeout: float = 10.0) -> None:
+        """Barrier: block until every queued cast has been written AND
+        drained to its socket (the deterministic settle the lane tests
+        need — the bytes are on the wire; the peer's per-connection
+        sequential dispatch does the rest in order)."""
+        async def _wait():
+            while (any(self._cast_bufs.values())
+                   or any(not t.done()
+                          for t in self._cast_pumps.values())):
+                await asyncio.sleep(0.001)
+        fut = asyncio.run_coroutine_threadsafe(_wait(), self._loop)
+        try:
+            fut.result(timeout)
+        except BaseException:
+            # a timed-out (or interrupted) barrier must not leave the
+            # 1ms poll coroutine spinning on the loop forever
+            fut.cancel()
+            raise
 
     def peers(self) -> list[str]:
         return list(self._peer_addrs)
 
     def close(self) -> None:
         async def shutdown():
+            for t in self._cast_pumps.values():
+                t.cancel()
             for w in self._writers.values():
                 w.close()
             self._server.close()

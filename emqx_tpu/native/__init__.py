@@ -131,6 +131,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_lane_backlog.argtypes = [ctypes.c_void_p]
     lib.emqx_host_set_max_qos.restype = ctypes.c_int
     lib.emqx_host_set_max_qos.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_trunk_listen.restype = ctypes.c_int
+    lib.emqx_host_trunk_listen.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16]
+    lib.emqx_host_trunk_connect.restype = ctypes.c_int
+    lib.emqx_host_trunk_connect.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint16]
+    lib.emqx_host_trunk_disconnect.restype = ctypes.c_int
+    lib.emqx_host_trunk_disconnect.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.emqx_host_trunk_route_add.restype = ctypes.c_int
+    lib.emqx_host_trunk_route_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_host_trunk_route_del.restype = ctypes.c_int
+    lib.emqx_host_trunk_route_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
     lib.emqx_host_set_trace.restype = ctypes.c_int
     lib.emqx_host_set_trace.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
@@ -275,13 +290,44 @@ class NativeFramer:
 # event kinds from host.cc
 EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP, EV_ACKS = 1, 2, 3, 4, 6, 7
 EV_TELEMETRY = 8
+EV_TRUNK = 9
+
+# kind-9 trunk event sub-kinds (payload[0])
+TRUNK_UP, TRUNK_DOWN, TRUNK_PUNT = 1, 2, 3
+
+
+def parse_trunk_punts(payload: bytes) -> list[tuple]:
+    """Decode one kind-9 sub-3 record (receiver-side trunk punts) into
+    ``(origin_conn, qos, dup, topic, payload)`` tuples. Payloads are
+    always inline in punt records (host.cc TrunkPuntAppend)."""
+    out: list[tuple] = []
+    pos, n = 1, len(payload)
+    while pos + 11 <= n:
+        origin = int.from_bytes(payload[pos:pos + 8], "little")
+        flags = payload[pos + 8]
+        tlen = int.from_bytes(payload[pos + 9:pos + 11], "little")
+        pos += 11
+        topic = payload[pos:pos + tlen].decode("utf-8", "replace")
+        pos += tlen
+        if pos + 4 > n:
+            break
+        plen = int.from_bytes(payload[pos:pos + 4], "little")
+        pos += 4
+        body = payload[pos:pos + plen]
+        pos += plen
+        out.append((origin, (flags >> 1) & 3, bool(flags & 8), topic, body))
+    return out
 
 # ---------------------------------------------------------------------------
 # native telemetry plane (host.cc kind-8 records)
 
 # histogram stage order (host.cc HistStage enum)
 HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
-               "lane_dwell", "gil_stint", "ws_ingest")
+               "lane_dwell", "gil_stint", "ws_ingest",
+               # trunk stages (round 9): trunk_rtt = batch flush →
+               # peer ack; trunk_batch_n records ENTRIES per flushed
+               # batch (occupancy — a count, not nanoseconds)
+               "trunk_rtt", "trunk_batch_n")
 
 # flight-recorder event codes (host.cc FrEvent)
 FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
@@ -494,10 +540,13 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "qos1_in", "qos2_in", "qos2_rel", "lane_topic_overflow",
               "ack_batches",
               "ws_handshakes", "ws_rejects", "ws_pings", "ws_closes",
-              "punts_trace", "fr_dumps", "telemetry_batches")
+              "punts_trace", "fr_dumps", "telemetry_batches",
+              "trunk_out", "trunk_in", "trunk_batches_out",
+              "trunk_batches_in", "trunk_punts", "trunk_replays",
+              "trunk_shed")
 
 # subscription-entry flags (router.h)
-SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP = 1, 2, 4
+SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP, SUB_REMOTE = 1, 2, 4, 8
 
 
 class NativeHost:
@@ -515,6 +564,7 @@ class NativeHost:
             raise OSError(f"cannot bind {host}:{port}")
         self.port = self._lib.emqx_host_port(self._h)
         self.ws_port = 0       # set by listen_ws()
+        self.trunk_port = 0    # set by trunk_listen()
         # The poll buffer must hold at least one whole event record: 13-byte
         # header + payload up to max_size (a max-size PUBLISH frame).  A
         # smaller buffer would leave host.cc unable to ever deliver that
@@ -549,6 +599,44 @@ class NativeHost:
             raise OSError(f"cannot bind ws listener {host}:{port}")
         self.ws_port = p
         return p
+
+    # -- cluster trunk (round 9) -------------------------------------------
+
+    def trunk_listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Open the cluster-trunk listener (BEFORE the poll thread
+        starts). Peer hosts dial it to forward publishes below the GIL;
+        received batches fan out locally without touching Python.
+        Returns the bound port."""
+        p = self._lib.emqx_host_trunk_listen(self._h, host.encode(), port)
+        if p < 0:
+            raise OSError(f"cannot bind trunk listener {host}:{port}")
+        self.trunk_port = p
+        return p
+
+    def trunk_connect(self, peer_id: int, host: str, port: int) -> None:
+        """Dial (or re-dial) a peer's trunk listener; the outcome
+        arrives as a kind-9 UP/DOWN event. Reconnects replay the peer's
+        unacked qos1 batches before new traffic."""
+        self._lib.emqx_host_trunk_connect(self._h, peer_id,
+                                          host.encode(), port)
+
+    def trunk_disconnect(self, peer_id: int, forget: bool = False) -> None:
+        """Drop the peer link. ``forget=False`` keeps the replay ring
+        for the next connect; ``forget=True`` erases the peer state."""
+        self._lib.emqx_host_trunk_disconnect(self._h, peer_id,
+                                             1 if forget else 0)
+
+    def trunk_route_add(self, peer_id: int, filter_: str) -> None:
+        """Install a REMOTE entry (the third entry kind): publishes
+        matching ``filter_`` forward over ``peer_id``'s trunk for
+        QoS0/1; while the trunk is down the entry behaves as a punt
+        marker and the Python forward lane carries the message."""
+        self._lib.emqx_host_trunk_route_add(self._h, peer_id,
+                                            filter_.encode())
+
+    def trunk_route_del(self, peer_id: int, filter_: str) -> None:
+        self._lib.emqx_host_trunk_route_del(self._h, peer_id,
+                                            filter_.encode())
 
     def send(self, conn: int, data: bytes) -> None:
         self._lib.emqx_host_send(self._h, conn, data, len(data))

@@ -70,6 +70,15 @@
 //   kind 7 = ACKS   payload = one batched ack/window record per poll
 //                   cycle: [u32 n] + n x ([u64 conn][u32 acked]
 //                   [u32 rel][u32 inflight_now][u32 pending_now])
+//   kind 9 = TRUNK  cluster-trunk plane events (trunk.h, round 9):
+//                   payload[0] = sub-kind:
+//                   [u8 1] link UP    conn_id = peer id (replay done)
+//                   [u8 2] link DOWN  conn_id = peer id, rest = reason
+//                   [u8 3] receiver-side punts: trunk entries whose
+//                     local match set contains punt markers (or shared
+//                     groups) — Python runs the local dispatch for
+//                     them; entries in the pre-parse layout with
+//                     payloads always inline (conn_id = 0)
 //   kind 8 = TELEMETRY  payload = concatenated sub-records, chunked at
 //                   the tap bound like kinds 6/7:
 //                   [u8 1] histogram delta: [u8 stage][u64 count_d]
@@ -120,6 +129,7 @@
 
 #include "frame.h"
 #include "router.h"
+#include "trunk.h"
 #include "ws.h"
 
 namespace emqx_native {
@@ -169,6 +179,10 @@ enum HistStage {
   kHistLaneDwell,         // every lane dequeue: enqueue -> deliver/punt
   kHistGilStint,          // every poll: Poll() return -> next Poll() entry
   kHistWsIngest,          // sampled: WS decode+dispatch per read chunk
+  kHistTrunkRtt,          // trunk batch flush -> peer ack (cross-node RTT)
+  kHistTrunkBatchN,       // trunk batch occupancy: ENTRIES per flushed
+                          // batch (a count, not ns — the one stage whose
+                          // axis is not time; bench prints it raw)
   kHistCount
 };
 
@@ -343,13 +357,26 @@ constexpr uint32_t kLaneTopicMax = 8192;
 // buffer (max_packet_size + 64), since an oversized record is dropped.
 constexpr size_t kTapFlushBytes = 192 * 1024;
 
+// -- cluster trunk bounds (round 9) -----------------------------------------
+// Remote-entry owners live far above conn ids AND the Python punt-token
+// space (1 << 48): owner = kTrunkOwnerBase + peer id.
+constexpr uint64_t kTrunkOwnerBase = 1ull << 62;
+// Trunk sock epoll tags carry this bit (conn ids are sequential small
+// ints; the three listener tags sit at ~0ull and below).
+constexpr uint64_t kTrunkSockBit = 1ull << 63;
+// Unacked-batch replay ring bound per peer: past it NEW qos1 publishes
+// with that remote audience degrade to the Python forward lane (the
+// ring itself may overshoot by the in-flight cycle — a soft bound).
+constexpr size_t kTrunkUnackedMax = 512;
+
 // Fast-path control ops enqueued from Python threads, applied on the
 // poll thread (ApplyPending) so they serialize with matching.
 struct Op {
   enum Kind : uint8_t {
     kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush,
     kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos,
-    kSetInflightCap, kSetTrace, kSetTelemetry
+    kSetInflightCap, kSetTrace, kSetTelemetry,
+    kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel
   };
   Kind kind;
   uint64_t owner = 0;
@@ -394,6 +421,13 @@ enum StatSlot {
   kStPuntsTrace,       // PUBLISHes punted because the conn is traced
   kStFrDumps,          // flight-recorder dumps emitted (kind 8)
   kStTelemetryBatches,  // batched kind-8 telemetry records emitted
+  kStTrunkOut,         // publishes forwarded onto a trunk link
+  kStTrunkIn,          // trunk entries received and handled locally
+  kStTrunkBatchesOut,  // trunk batch records flushed to peers
+  kStTrunkBatchesIn,   // trunk batch records applied from peers
+  kStTrunkPunts,       // received trunk entries handed to Python
+  kStTrunkReplays,     // qos1 batches replayed after a reconnect
+  kStTrunkShed,        // qos0 entries shed under trunk-link backpressure
   kStatCount
 };
 
@@ -430,8 +464,10 @@ class Host {
 
   ~Host() {
     for (auto& [id, c] : conns_) close(c.fd);
+    for (auto& [tag, s] : trunk_socks_) close(s.fd);
     if (listen_fd_ >= 0) close(listen_fd_);
     if (listen_ws_fd_ >= 0) close(listen_ws_fd_);
+    if (listen_trunk_fd_ >= 0) close(listen_trunk_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
     if (epoll_fd_ >= 0) close(epoll_fd_);
   }
@@ -465,6 +501,7 @@ class Host {
 
   int port() const { return port_; }
   int ws_port() const { return ws_port_; }
+  int trunk_port() const { return trunk_port_; }
 
   // Open the WebSocket listener (call BEFORE the poll thread starts —
   // it mutates the epoll set from the caller's thread). Conns accepted
@@ -499,6 +536,39 @@ class Host {
     ws_port_ = ntohs(addr.sin_port);
     ws_path_ = path ? path : "";
     return ws_port_;
+  }
+
+  // Open the cluster-trunk listener (call BEFORE the poll thread
+  // starts, like ListenWs — it mutates the epoll set from the caller's
+  // thread). Peers' hosts dial this port to forward publishes below
+  // the GIL. Returns the bound port, or -1.
+  int ListenTrunk(const char* bind_addr, uint16_t port) {
+    if (listen_trunk_fd_ >= 0) return -1;  // one trunk listener per host
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1 ||
+        bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        listen(fd, 64) < 0) {
+      close(fd);
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenTrunkTag;
+    if (epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev) < 0) {
+      close(fd);
+      return -1;
+    }
+    listen_trunk_fd_ = fd;
+    trunk_port_ = ntohs(addr.sin_port);
+    return trunk_port_;
   }
 
   // Thread-safe enqueue of outbound bytes for a connection.
@@ -591,6 +661,7 @@ class Host {
       if (!lane_pending_.empty()) LaneStaleScan();
       FlushTaps();
       FlushAcks();
+      FlushTrunks();
       // histogram deltas ride a ~100ms cadence, not every cycle: under
       // blast the per-cycle record + its Python-side decode measurably
       // taxed the plane (the observe_overhead budget); flight-recorder
@@ -631,6 +702,7 @@ class Host {
   static constexpr uint64_t kListenTag = ~0ull;
   static constexpr uint64_t kWakeTag = ~0ull - 1;
   static constexpr uint64_t kListenWsTag = ~0ull - 2;
+  static constexpr uint64_t kListenTrunkTag = ~0ull - 3;
 
   void Wake() {
     uint64_t one = 1;
@@ -788,6 +860,35 @@ class Host {
         telemetry_ = op.flags != 0;
         slow_ack_ns_ = op.token;
         break;
+      case Op::kTrunkConnect: {
+        trunk::Peer& p = trunk_peers_[op.owner];
+        p.addr = op.str;
+        p.port = static_cast<uint16_t>(op.token);
+        TrunkDial(op.owner, p);
+        break;
+      }
+      case Op::kTrunkDisconnect: {
+        auto it = trunk_peers_.find(op.owner);
+        if (it == trunk_peers_.end()) break;
+        if (it->second.sock_tag) TrunkSockDead(it->second.sock_tag, "drop");
+        // flags != 0 forgets the peer entirely (node left the cluster:
+        // routes are already gone, the replay ring dies with it);
+        // flags == 0 keeps the state so a redial replays unacked qos1
+        if (op.flags) trunk_peers_.erase(op.owner);
+        break;
+      }
+      case Op::kTrunkRouteAdd:
+        // the third entry kind: sibling of the punt marker. Mirrored
+        // into punt_subs_ too so the DEVICE lane (whose model cannot
+        // see remote routes) conservatively punts trunk audiences —
+        // the walk path reads the kSubRemote flag straight from subs_.
+        subs_.Add(kTrunkOwnerBase + op.owner, op.str, 0, kSubRemote);
+        punt_subs_.Add(kTrunkOwnerBase + op.owner, op.str, 0, kSubRemote);
+        break;
+      case Op::kTrunkRouteDel:
+        subs_.Remove(kTrunkOwnerBase + op.owner, op.str);
+        punt_subs_.Remove(kTrunkOwnerBase + op.owner, op.str);
+        break;
     }
   }
 
@@ -896,8 +997,12 @@ class Host {
   // publisher ack, the per-entry deliveries and the shared-group
   // rotation MUST stay one code path — callers pre-populate
   // match_scratch_/groups_scratch_ and have already ruled out punts.
+  // ``count_fast=false`` is the trunk-receiver call shape: the publish
+  // arrived over a trunk link (publisher = 0, no local conn to ack) and
+  // counts as kStTrunkIn at the call site, not kStFastIn here.
   void FanOut(uint64_t publisher, uint8_t qos, uint16_t pid,
-              std::string_view topic, std::string_view payload) {
+              std::string_view topic, std::string_view payload,
+              bool count_fast = true) {
     if (qos) {
       // ack first: the reference PUBACKs (or PUBRECs for qos2) as soon
       // as emqx_broker:publish returns
@@ -910,7 +1015,8 @@ class Host {
         MarkDirty(publisher, pit->second);
       }
     }
-    stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
+    if (count_fast)
+      stats_[kStFastIn].fetch_add(1, std::memory_order_relaxed);
     // shared serialized frames per proto: qos0 frames are reused
     // verbatim; elevated-qos frames are built ONCE per publish with a
     // zero pid, then appended and pid/qos-patched in place per target
@@ -921,7 +1027,9 @@ class Host {
     frame_q_v4_.clear();
     frame_q_v5_.clear();
     for (const SubEntry* e : match_scratch_) {
-      if (e->flags & kSubRuleTap) continue;  // rule taps never deliver
+      // rule taps never deliver; remote entries forward via the trunk
+      // (TryFast enqueues them) or punt — never through a local write
+      if (e->flags & (kSubRuleTap | kSubRemote)) continue;
       if ((e->flags & kSubNoLocal) && e->owner == publisher) continue;
       DeliverTo(e->owner, *e, publisher, qos, topic, payload);
     }
@@ -1063,6 +1171,14 @@ class Host {
     }
     if (ev.data.u64 == kListenTag || ev.data.u64 == kListenWsTag) {
       Accept(ev.data.u64 == kListenWsTag);
+      return;
+    }
+    if (ev.data.u64 == kListenTrunkTag) {
+      TrunkAccept();
+      return;
+    }
+    if (ev.data.u64 & kTrunkSockBit) {
+      TrunkEvent(ev);
       return;
     }
     uint64_t id = ev.data.u64;
@@ -1457,6 +1573,7 @@ class Host {
     groups_scratch_.clear();
     subs_.Match(topic, &match_scratch_, &groups_scratch_);
     bool tapped = false;
+    trunk_scratch_.clear();
     for (const SubEntry* e : match_scratch_) {
       if (e->flags & kSubPunt) {
         // a mixed/foreign shared group / persistent session /
@@ -1467,7 +1584,34 @@ class Host {
         stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
         return false;
       }
-      if (e->flags & kSubRuleTap) tapped = true;
+      if (e->flags & kSubRuleTap) {
+        tapped = true;
+        continue;
+      }
+      if (e->flags & kSubRemote) {
+        // remote entry (round 9): the peer's trunk carries this leg —
+        // unless the trunk is down, the qos1 replay ring is full, or
+        // the publish is qos2 (exactly-once spans two nodes' session
+        // state), in which case the entry degrades to a punt marker
+        // and Python's forward_fn lane carries the message. Decided
+        // BEFORE any side effect: a partial native fan-out followed by
+        // a punt would double-deliver the local audience.
+        uint64_t peer = e->owner - kTrunkOwnerBase;
+        auto tp = trunk_peers_.find(peer);
+        if (tp == trunk_peers_.end() || !tp->second.up || qos == 2 ||
+            (qos == 1 && tp->second.unacked.size() >= kTrunkUnackedMax) ||
+            15 + topic.size() + payload.size() > trunk::kMaxEntryBytes) {
+          stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+          return false;
+        }
+        bool seen = false;
+        for (uint64_t p : trunk_scratch_)
+          if (p == peer) {
+            seen = true;
+            break;
+          }
+        if (!seen) trunk_scratch_.push_back(peer);
+      }
     }
     if (qos == 2) {
       AckState& a = EnsureAck(c);
@@ -1485,6 +1629,10 @@ class Host {
     }
     if (tapped) EmitTap(id, qos, (h & 0x08) != 0, topic, payload);
     FanOut(id, qos, pid, topic, payload);
+    // remote legs last: the local fan-out above and the trunk enqueue
+    // below are the two halves of emqx_broker:publish's route loop
+    for (uint64_t peer : trunk_scratch_)
+      TrunkEnqueue(peer, id, qos, (h & 0x08) != 0, topic, payload);
     if (telemetry_) {
       FrNote(c, kFrFastPub, 3, qos, cur_hash_);
       if (t_in) {
@@ -1821,6 +1969,477 @@ class Host {
     emit();
   }
 
+  // -- cluster trunk (round 9) --------------------------------------------
+  // Cross-node publish forwarding on the C++ plane: per-peer batch
+  // buffers flushed as length-prefixed trunk records (trunk.h) straight
+  // into the peer host's decoder → local fan-out. All state below is
+  // poll-thread-owned; control arrives via ops (kTrunk*).
+
+  void TrunkAccept() {
+    for (;;) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = accept4(listen_trunk_fd_, reinterpret_cast<sockaddr*>(&peer),
+                       &plen, SOCK_NONBLOCK | SOCK_CLOEXEC);
+      if (fd < 0) return;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint64_t tag = kTrunkSockBit | next_trunk_tag_++;
+      trunk::Sock s;
+      s.fd = fd;
+      s.dialer = false;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u64 = tag;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+      trunk_socks_.emplace(tag, std::move(s));
+    }
+  }
+
+  void TrunkDial(uint64_t peer_id, trunk::Peer& p) {
+    if (p.sock_tag) {
+      auto sit = trunk_socks_.find(p.sock_tag);
+      if (sit != trunk_socks_.end() && sit->second.connecting)
+        return;  // a dial is already in flight — killing it on every
+      //           retry tick would livelock any connect slower than
+      //           the redial cadence (the kernel's own connect timeout
+      //           eventually fails it and emits DOWN)
+      TrunkSockDead(p.sock_tag, "redial");  // replace established link
+    }
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+      TrunkEmitDown(peer_id, "socket");
+      return;
+    }
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(p.port);
+    if (inet_pton(AF_INET, p.addr.c_str(), &addr.sin_addr) != 1) {
+      close(fd);
+      TrunkEmitDown(peer_id, "bad_addr");
+      return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      TrunkEmitDown(peer_id, "connect");
+      return;
+    }
+    uint64_t tag = kTrunkSockBit | next_trunk_tag_++;
+    trunk::Sock s;
+    s.fd = fd;
+    s.dialer = true;
+    s.peer_id = peer_id;
+    s.connecting = rc < 0;
+    epoll_event ev{};
+    ev.events = s.connecting ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+    ev.data.u64 = tag;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+    p.sock_tag = tag;
+    trunk_socks_.emplace(tag, std::move(s));
+    if (rc == 0) TrunkUp(peer_id, p);
+  }
+
+  // Link established: replay unacked qos1 batches BEFORE any new
+  // traffic (they carry their original seqs; the receiver acks them and
+  // the cumulative trim retires them), then tell Python (kind 9 sub 1)
+  // so it can flush permits — the ordering guard for the punt→trunk
+  // flip, same reasoning as the slow→fast permit grant.
+  void TrunkUp(uint64_t peer_id, trunk::Peer& p) {
+    p.up = true;
+    auto sit = trunk_socks_.find(p.sock_tag);
+    if (sit != trunk_socks_.end()) {
+      for (const trunk::Unacked& u : p.unacked) {
+        if (u.q1_record.empty()) continue;
+        sit->second.outbuf += u.q1_record;
+        stats_[kStTrunkReplays].fetch_add(1, std::memory_order_relaxed);
+      }
+      char sub = 1;
+      events_.push_back(EncodeRecord(9, peer_id, &sub, 1));
+      TrunkFlushSock(p.sock_tag, sit->second);
+    }
+  }
+
+  void TrunkEmitDown(uint64_t peer_id, const char* reason) {
+    std::string payload;
+    payload.push_back(2);
+    payload.append(reason);
+    events_.push_back(
+        EncodeRecord(9, peer_id, payload.data(), payload.size()));
+  }
+
+  void TrunkEvent(const epoll_event& ev) {
+    uint64_t tag = ev.data.u64;
+    auto it = trunk_socks_.find(tag);
+    if (it == trunk_socks_.end()) return;
+    trunk::Sock& s = it->second;
+    if (s.connecting) {
+      int err = 0;
+      socklen_t el = sizeof(err);
+      getsockopt(s.fd, SOL_SOCKET, SO_ERROR, &err, &el);
+      if (err != 0 || (ev.events & (EPOLLERR | EPOLLHUP))) {
+        TrunkSockDead(tag, "connect_failed");
+        return;
+      }
+      if (!(ev.events & EPOLLOUT)) return;
+      s.connecting = false;
+      epoll_event e2{};
+      e2.events = EPOLLIN;
+      e2.data.u64 = tag;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, s.fd, &e2);
+      auto pit = trunk_peers_.find(s.peer_id);
+      if (pit != trunk_peers_.end() && pit->second.sock_tag == tag)
+        TrunkUp(s.peer_id, pit->second);
+      return;
+    }
+    if (ev.events & (EPOLLHUP | EPOLLERR)) {
+      TrunkSockDead(tag, "sock_error");
+      return;
+    }
+    if (ev.events & EPOLLOUT) {
+      TrunkFlushSock(tag, s);
+      if (!trunk_socks_.count(tag)) return;  // flush hit an error
+    }
+    if (ev.events & EPOLLIN) TrunkRead(tag);
+  }
+
+  void TrunkSockDead(uint64_t tag, const char* reason) {
+    auto it = trunk_socks_.find(tag);
+    if (it == trunk_socks_.end()) return;
+    trunk::Sock s = std::move(it->second);
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, s.fd, nullptr);
+    close(s.fd);
+    trunk_socks_.erase(it);
+    if (!s.dialer) return;
+    auto pit = trunk_peers_.find(s.peer_id);
+    if (pit != trunk_peers_.end() && pit->second.sock_tag == tag) {
+      pit->second.sock_tag = 0;
+      pit->second.up = false;
+      // remote entries now behave as punt markers (TryFast reads
+      // p.up); the unacked ring is KEPT for the reconnect replay.
+      // Python sees DOWN (kind 9 sub 2) and drives the redial.
+      TrunkEmitDown(s.peer_id, reason);
+    }
+  }
+
+  void TrunkRead(uint64_t tag) {
+    auto it = trunk_socks_.find(tag);
+    if (it == trunk_socks_.end()) return;
+    trunk::Sock& s = it->second;
+    uint8_t chunk[kReadChunk];
+    for (;;) {
+      ssize_t n = recv(s.fd, chunk, sizeof(chunk), 0);
+      if (n > 0) {
+        s.inbuf.append(reinterpret_cast<char*>(chunk),
+                       static_cast<size_t>(n));
+        if (static_cast<size_t>(n) < sizeof(chunk)) break;
+      } else if (n == 0) {
+        TrunkSockDead(tag, "sock_closed");
+        return;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        TrunkSockDead(tag, "sock_error");
+        return;
+      }
+    }
+    size_t pos = 0;
+    while (s.inbuf.size() - pos >= 5) {
+      uint32_t len = 0;
+      memcpy(&len, s.inbuf.data() + pos, 4);
+      // protocol-fixed bound (trunk.h), NOT this host's max_size_:
+      // nodes with different max_packet_size configs must agree on
+      // what a well-formed record is, or a legal record from a
+      // bigger-configured peer poisons the link forever
+      if (len < 1 || len > trunk::kMaxRecordBytes) {
+        TrunkSockDead(tag, "bad_record");
+        return;
+      }
+      if (s.inbuf.size() - pos < 4 + static_cast<size_t>(len)) break;
+      uint8_t type = static_cast<uint8_t>(s.inbuf[pos + 4]);
+      const char* body = s.inbuf.data() + pos + 5;
+      size_t blen = len - 1;
+      if (type == trunk::kRecBatch) {
+        TrunkApplyBatch(s, body, blen);
+      } else if (type == trunk::kRecAck && s.dialer && blen >= 8) {
+        uint64_t seq = 0;
+        memcpy(&seq, body, 8);
+        TrunkApplyAck(s.peer_id, seq);
+      }
+      pos += 4 + len;
+    }
+    s.inbuf.erase(0, pos);
+    TrunkPuntFlush();
+    FlushDirty();             // deliveries written during ApplyBatch
+    TrunkFlushSock(tag, s);   // the per-batch ACKs appended above
+  }
+
+  // Apply one received BATCH record: per-entry local fan-out through
+  // the SAME match/deliver machinery the fast path uses. Entries whose
+  // match set contains punt markers (or shared groups — defensive:
+  // replication lag can race a group flip) go up to Python as kind-9
+  // punt records instead; rule taps do NOT fire here — rules run on
+  // the PUBLISHING node, exactly like the reference's forward lane
+  // (emqx_broker:dispatch runs no hooks on the receiving node).
+  void TrunkApplyBatch(trunk::Sock& s, const char* body, size_t blen) {
+    if (blen < 12) return;
+    uint64_t seq = 0;
+    uint32_t n = 0;
+    memcpy(&seq, body, 8);
+    memcpy(&n, body + 8, 4);
+    stats_[kStTrunkBatchesIn].fetch_add(1, std::memory_order_relaxed);
+    size_t pos = 12;
+    std::string_view prev_payload;
+    bool have_prev = false;
+    for (uint32_t i = 0; i < n && pos + 11 <= blen; i++) {
+      uint64_t origin = 0;
+      memcpy(&origin, body + pos, 8);
+      uint8_t flags = static_cast<uint8_t>(body[pos + 8]);
+      uint16_t tlen = 0;
+      memcpy(&tlen, body + pos + 9, 2);
+      pos += 11;
+      if (pos + tlen > blen) break;
+      std::string_view topic(body + pos, tlen);
+      pos += tlen;
+      std::string_view payload;
+      if (flags & 1) {
+        if (pos + 4 > blen) break;
+        uint32_t pl = 0;
+        memcpy(&pl, body + pos, 4);
+        pos += 4;
+        if (pos + pl > blen) break;
+        payload = std::string_view(body + pos, pl);
+        pos += pl;
+        prev_payload = payload;
+        have_prev = true;
+      } else {
+        if (!have_prev) break;  // corrupt batch: dedup with no reference
+        payload = prev_payload;
+      }
+      TrunkFanOut(origin, (flags >> 1) & 3, (flags & 8) != 0, topic,
+                  payload);
+    }
+    // ack AFTER fan-out: the sender's ring holds the qos1 copy until
+    // every local delivery for this batch has been written
+    char ab[8];
+    memcpy(ab, &seq, 8);
+    trunk::AppendRecord(&s.outbuf, trunk::kRecAck, ab, 8);
+  }
+
+  void TrunkFanOut(uint64_t origin, uint8_t qos, bool dup,
+                   std::string_view topic, std::string_view payload) {
+    stats_[kStTrunkIn].fetch_add(1, std::memory_order_relaxed);
+    match_scratch_.clear();
+    groups_scratch_.clear();
+    subs_.Match(topic, &match_scratch_, &groups_scratch_);
+    bool punt = !groups_scratch_.empty();
+    if (!punt)
+      for (const SubEntry* e : match_scratch_)
+        if (e->flags & kSubPunt) {
+          punt = true;
+          break;
+        }
+    if (punt) {
+      stats_[kStTrunkPunts].fetch_add(1, std::memory_order_relaxed);
+      TrunkPuntAppend(origin, qos, dup, topic, payload);
+      return;
+    }
+    if (telemetry_) cur_hash_ = TopicHash(topic);
+    // publisher id 0 can never collide with a local conn (ids start at
+    // 1), so no ack is written and no-local can never false-match a
+    // local subscriber that happens to share the REMOTE publisher's id
+    FanOut(0, qos, 0, topic, payload, /*count_fast=*/false);
+  }
+
+  // Receiver-side punts ride ONE kind-9 record per read batch (payload
+  // [u8 3] + entries, payloads always inline — the sender's dedup may
+  // reference an entry that was NOT punted).
+  void TrunkPuntAppend(uint64_t origin, uint8_t qos, bool dup,
+                       std::string_view topic, std::string_view payload) {
+    size_t cap = TeleCap();
+    size_t entry = 15 + topic.size() + payload.size();
+    if (!trunk_punt_buf_.empty() && trunk_punt_buf_.size() + entry > cap)
+      TrunkPuntFlush();
+    if (trunk_punt_buf_.empty()) trunk_punt_buf_.push_back(3);
+    trunk::AppendEntry(&trunk_punt_buf_, origin, qos, dup,
+                       /*inline_payload=*/true, topic, payload);
+  }
+
+  void TrunkPuntFlush() {
+    if (trunk_punt_buf_.empty()) return;
+    events_.push_back(EncodeRecord(9, 0, trunk_punt_buf_.data(),
+                                   trunk_punt_buf_.size()));
+    trunk_punt_buf_.clear();
+  }
+
+  // Sender: append one publish to the peer's batch under construction
+  // (payload deduped vs the previous entry — the kind-6 discipline);
+  // qos1 entries ALSO append a full copy to the qos1-only shadow that
+  // becomes this batch's replay record. One FIFO per peer keeps
+  // per-topic order trivially (total order per link).
+  void TrunkEnqueue(uint64_t peer_id, uint64_t origin, uint8_t qos,
+                    bool dup, std::string_view topic,
+                    std::string_view payload) {
+    auto it = trunk_peers_.find(peer_id);
+    if (it == trunk_peers_.end()) return;
+    trunk::Peer& p = it->second;
+    bool inline_payload = !(p.have_prev && payload == p.prev_payload);
+    trunk::AppendEntry(&p.batch, origin, qos, dup, inline_payload, topic,
+                       payload);
+    if (inline_payload) {
+      p.prev_payload.assign(payload.data(), payload.size());
+      p.have_prev = true;
+    }
+    if (qos) {
+      trunk::AppendEntry(&p.q1_batch, origin, qos, dup,
+                         /*inline_payload=*/true, topic, payload);
+      p.q1_n++;
+    } else {
+      p.q0_n++;
+    }
+    if (p.batch_n++ == 0) trunk_dirty_.push_back(peer_id);
+    stats_[kStTrunkOut].fetch_add(1, std::memory_order_relaxed);
+    size_t cap = TeleCap();
+    // BOTH buffers bound the flush: deduped entries add ~15 bytes to
+    // `batch` while adding the FULL payload to the qos1 shadow, so a
+    // same-payload qos1 burst could otherwise build a replay record
+    // past the receiver's record-size bound — which would poison every
+    // reconnect with "bad_record" forever
+    if (p.batch.size() > cap || p.q1_batch.size() > cap)
+      FlushTrunkPeer(p);
+  }
+
+  // Seal the batch under construction into one wire record + its ring
+  // entry. Writes to the socket only while the link is up; a batch
+  // sealed while down loses its qos0 entries (in-flight loss, same as
+  // a death mid-send) but its qos1 record replays on reconnect.
+  void FlushTrunkPeer(trunk::Peer& p) {
+    if (p.batch_n == 0) return;
+    uint64_t seq = p.next_seq++;
+    std::string body;
+    body.reserve(12 + p.batch.size());
+    body.append(reinterpret_cast<const char*>(&seq), 8);
+    body.append(reinterpret_cast<const char*>(&p.batch_n), 4);
+    body += p.batch;
+    trunk::Unacked u;
+    u.seq = seq;
+    u.t0_ns = telemetry_ ? NowNs() : 0;
+    if (p.q1_n) {
+      std::string q1body;
+      q1body.reserve(12 + p.q1_batch.size());
+      q1body.append(reinterpret_cast<const char*>(&seq), 8);
+      q1body.append(reinterpret_cast<const char*>(&p.q1_n), 4);
+      q1body += p.q1_batch;
+      trunk::AppendRecord(&u.q1_record, trunk::kRecBatch, q1body.data(),
+                          q1body.size());
+    }
+    if (p.up) {
+      auto sit = trunk_socks_.find(p.sock_tag);
+      if (sit != trunk_socks_.end()) {
+        trunk::Sock& s = sit->second;
+        // the kHighWater mqueue-drop policy applied to the trunk link:
+        // a connected-but-stalled peer must not grow the sender's
+        // socket backlog without bound. qos0 entries shed (the same
+        // fate a backpressured local delivery gets in DeliverTo);
+        // qos1 keeps flowing as the qos1-only record because its
+        // volume is already bounded by the unacked-ring admission gate
+        bool congested = s.outbuf.size() - s.outpos > kHighWater;
+        if (!congested) {
+          trunk::AppendRecord(&s.outbuf, trunk::kRecBatch,
+                              body.data(), body.size());
+        } else if (!u.q1_record.empty()) {
+          s.outbuf += u.q1_record;
+          if (p.q0_n)
+            stats_[kStTrunkShed].fetch_add(p.q0_n,
+                                           std::memory_order_relaxed);
+        } else {
+          stats_[kStTrunkShed].fetch_add(p.batch_n,
+                                         std::memory_order_relaxed);
+        }
+      }
+    }
+    // ring admission: qos0-only entries exist only for the RTT stage —
+    // never let them grow the ring past its bound (a front entry
+    // holding a qos1 record would otherwise block the trim below while
+    // qos0 ballast accumulated behind it indefinitely); qos1 overshoot
+    // stays soft-bounded by TryFast's admission gate
+    if (!u.q1_record.empty() || p.unacked.size() < kTrunkUnackedMax)
+      p.unacked.push_back(std::move(u));
+    while (p.unacked.size() > kTrunkUnackedMax &&
+           p.unacked.front().q1_record.empty())
+      p.unacked.pop_front();  // qos0-only entries are droppable ballast
+    if (telemetry_) RecordHist(kHistTrunkBatchN, p.batch_n);
+    stats_[kStTrunkBatchesOut].fetch_add(1, std::memory_order_relaxed);
+    p.batch.clear();
+    p.q1_batch.clear();
+    p.batch_n = 0;
+    p.q1_n = 0;
+    p.q0_n = 0;
+    p.prev_payload.clear();
+    p.have_prev = false;
+  }
+
+  // One batch record per poll cycle per dirty peer — the FlushTaps /
+  // FlushAcks batching discipline applied to the wire.
+  void FlushTrunks() {
+    if (trunk_dirty_.empty()) return;
+    std::vector<uint64_t> dirty;
+    dirty.swap(trunk_dirty_);
+    for (uint64_t peer_id : dirty) {
+      auto it = trunk_peers_.find(peer_id);
+      if (it == trunk_peers_.end()) continue;
+      FlushTrunkPeer(it->second);
+      if (it->second.up) {
+        uint64_t tag = it->second.sock_tag;
+        auto sit = trunk_socks_.find(tag);
+        if (sit != trunk_socks_.end()) TrunkFlushSock(tag, sit->second);
+      }
+    }
+  }
+
+  // Cumulative ack: retire every unacked batch <= seq; the exactly
+  // matching entry closes the enqueue→peer-ack RTT stage.
+  void TrunkApplyAck(uint64_t peer_id, uint64_t seq) {
+    auto it = trunk_peers_.find(peer_id);
+    if (it == trunk_peers_.end()) return;
+    trunk::Peer& p = it->second;
+    while (!p.unacked.empty() && p.unacked.front().seq <= seq) {
+      if (telemetry_ && p.unacked.front().seq == seq &&
+          p.unacked.front().t0_ns)
+        RecordHist(kHistTrunkRtt, NowNs() - p.unacked.front().t0_ns);
+      p.unacked.pop_front();
+    }
+  }
+
+  void TrunkFlushSock(uint64_t tag, trunk::Sock& s) {
+    while (s.outpos < s.outbuf.size()) {
+      ssize_t n = ::send(s.fd, s.outbuf.data() + s.outpos,
+                         s.outbuf.size() - s.outpos, MSG_NOSIGNAL);
+      if (n > 0) {
+        s.outpos += static_cast<size_t>(n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.u64 = tag;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, s.fd, &ev);
+        return;
+      } else if (n < 0 && errno == EINTR) {
+        continue;
+      } else {
+        TrunkSockDead(tag, "sock_error");
+        return;
+      }
+    }
+    s.outbuf.clear();
+    s.outpos = 0;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = tag;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, s.fd, &ev);
+  }
+
   // -- telemetry plane ----------------------------------------------------
 
   void RecordHist(int stage, uint64_t ns) {
@@ -2145,6 +2764,15 @@ class Host {
   int listen_ws_fd_ = -1;
   int ws_port_ = 0;
   std::string ws_path_ = "/mqtt";  // required upgrade request-target
+  // -- cluster trunk (poll-thread-owned) -----------------------------------
+  int listen_trunk_fd_ = -1;
+  int trunk_port_ = 0;
+  uint64_t next_trunk_tag_ = 1;
+  std::unordered_map<uint64_t, trunk::Sock> trunk_socks_;  // tag → sock
+  std::unordered_map<uint64_t, trunk::Peer> trunk_peers_;  // peer → state
+  std::vector<uint64_t> trunk_dirty_;    // peers batched this cycle
+  std::vector<uint64_t> trunk_scratch_;  // peers matched by ONE publish
+  std::string trunk_punt_buf_;           // kind-9 sub-3 under construction
 };
 
 }  // namespace
@@ -2315,6 +2943,59 @@ int emqx_host_set_telemetry(void* h, int enabled, uint64_t slow_ack_ns) {
   op.kind = emqx_native::Op::kSetTelemetry;
   op.flags = enabled ? 1 : 0;
   op.token = slow_ack_ns;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// --- cluster trunk plane (round 9) ----------------------------------------
+
+// Open the trunk listener (BEFORE the poll thread starts). Peer hosts
+// dial this port; received batch records fan out locally below the GIL.
+// Returns the bound port, or -1.
+int emqx_host_trunk_listen(void* h, const char* bind_addr, uint16_t port) {
+  return static_cast<emqx_native::Host*>(h)->ListenTrunk(bind_addr, port);
+}
+
+// Dial (or re-dial) a peer's trunk listener. Thread-safe; the poll
+// thread performs the nonblocking connect and reports the outcome as a
+// kind-9 UP/DOWN event. A successful (re)connect replays the peer's
+// unacked qos1 batches before any new traffic.
+int emqx_host_trunk_connect(void* h, uint64_t peer, const char* addr,
+                            uint16_t port) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kTrunkConnect;
+  op.owner = peer;
+  op.str = addr;
+  op.token = port;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Drop a peer link. forget=0 keeps the peer state (the qos1 replay
+// ring survives for the next connect); forget=1 erases it entirely
+// (the node left the cluster and its routes are gone).
+int emqx_host_trunk_disconnect(void* h, uint64_t peer, int forget) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kTrunkDisconnect;
+  op.owner = peer;
+  op.flags = forget ? 1 : 0;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Install/remove a remote entry: a cross-node route served by `peer`'s
+// trunk instead of a punt marker. While the trunk is down the entry
+// BEHAVES as a punt marker (degradation ladder trunk → punt → Python).
+int emqx_host_trunk_route_add(void* h, uint64_t peer, const char* filter) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kTrunkRouteAdd;
+  op.owner = peer;
+  op.str = filter;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_trunk_route_del(void* h, uint64_t peer, const char* filter) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kTrunkRouteDel;
+  op.owner = peer;
+  op.str = filter;
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 

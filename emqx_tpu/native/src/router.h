@@ -39,6 +39,15 @@ constexpr uint8_t kSubNoLocal = 2;  // MQTT5 no-local: skip the publisher
 // broad-rule permit cliff (one FROM '#' rule used to de-permit the
 // whole fast path).
 constexpr uint8_t kSubRuleTap = 4;
+// Remote entry (round 9): a cross-node route whose peer has a native
+// trunk link — the third entry kind, sibling of the round-5 punt
+// marker. A matched remote entry enqueues the publish onto that peer's
+// per-topic-ordered trunk batch (host.cc TrunkEnqueue) instead of
+// punting the frame to Python; when the trunk is down (or the qos1
+// replay ring is full, or the publish is qos2) the entry behaves
+// exactly like a punt marker and the Python forward_fn lane carries
+// the message. owner = kTrunkOwnerBase + peer id.
+constexpr uint8_t kSubRemote = 8;
 
 // A $share group on one filter, natively served: the Python server
 // installs one of these ONLY when every member is a fast native

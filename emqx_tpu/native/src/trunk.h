@@ -1,0 +1,135 @@
+// Native cluster trunk (round 9): the inter-node message plane.
+//
+// Two native hosts talk to each other over plain TCP "trunk" links so a
+// cross-node publish never touches either node's Python plane for QoS0
+// (and QoS1 rides with a bounded replay ring).  This is the gen_rpc
+// forwarding lane of the reference (emqx_broker.erl:302-324 casting
+// `dispatch` on a per-topic-ordered client pool, emqx_rpc.erl:74-84)
+// moved below the GIL, the way rounds 6-8 moved acks, WS and telemetry
+// there.
+//
+// Wire format (symmetric; in practice each direction of forwarding uses
+// its own dialed link — A dials B to forward A->B):
+//
+//   [u32 len][u8 type][body]          little-endian, len covers type+body
+//
+//   type 2 = BATCH  body = [u64 seq][u32 n] + n entries, one entry per
+//                   forwarded publish in the kind-6 pre-parse layout:
+//                   [u64 origin][u8 flags][u16 tlen][topic]
+//                   + (flags bit0 ? [u32 plen][payload] : payload
+//                   identical to the PREVIOUS entry in this batch).
+//                   flags bits 1-2 = qos, bit 3 = publisher DUP.
+//                   One batch per poll cycle per peer (the EmitTap /
+//                   FlushAcks batching discipline applied to the wire);
+//                   TCP framing + the receiver's sequential decode give
+//                   per-topic order for free.
+//   type 3 = ACK    body = [u64 seq] — the receiver acks each batch
+//                   AFTER local fan-out; acks are cumulative (an ack
+//                   for seq s retires every unacked batch <= s).  The
+//                   sender uses the ack for the enqueue->peer-ack RTT
+//                   stage and to trim the QoS1 replay ring.
+//
+// Reliability ladder (host.cc wires the seams):
+//   - QoS0: fire-and-forget; batches are not retained once written.
+//   - QoS1: every flushed batch containing elevated-qos entries keeps a
+//     qos1-only copy in a bounded per-peer unacked ring; on reconnect
+//     the ring replays before new traffic (at-least-once across a link
+//     death — duplicates allowed, loss not).  A full ring degrades NEW
+//     qos1 publishes to the Python forward lane.
+//   - QoS2: never trunks — exactly-once spans two nodes' session state
+//     and stays on the Python lane (the remote entry punts).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <string_view>
+
+namespace emqx_native {
+namespace trunk {
+
+constexpr uint8_t kRecBatch = 2;
+constexpr uint8_t kRecAck = 3;
+
+// PROTOCOL-level size bounds, deliberately independent of either
+// node's max_packet_size: a record sized by the sender's config but
+// validated against the receiver's would poison mismatched clusters
+// (the oversized replay record re-killing the link on every redial).
+// Publishes whose entry exceeds kMaxEntryBytes never trunk — they
+// degrade to the Python forward lane like any other punt.
+constexpr size_t kMaxEntryBytes = 128 * 1024;
+constexpr size_t kMaxRecordBytes = 512 * 1024;
+
+// Frame one trunk record onto a socket buffer.
+inline void AppendRecord(std::string* out, uint8_t type, const char* body,
+                         size_t blen) {
+  uint32_t len = static_cast<uint32_t>(1 + blen);
+  char hdr[5];
+  memcpy(hdr, &len, 4);
+  hdr[4] = static_cast<char>(type);
+  out->append(hdr, 5);
+  out->append(body, blen);
+}
+
+// Append one pre-parse entry ([origin][flags][topic][payload?]) to a
+// batch body under construction.  ``inline_payload=false`` emits the
+// dedup form (payload identical to the previous entry in this batch).
+inline void AppendEntry(std::string* out, uint64_t origin, uint8_t qos,
+                        bool dup, bool inline_payload,
+                        std::string_view topic, std::string_view payload) {
+  char hdr[11];
+  memcpy(hdr, &origin, 8);
+  hdr[8] = static_cast<char>((inline_payload ? 1 : 0) | (qos << 1) |
+                             (dup ? 8 : 0));
+  uint16_t tl = static_cast<uint16_t>(topic.size());
+  memcpy(hdr + 9, &tl, 2);
+  out->append(hdr, 11);
+  out->append(topic.data(), topic.size());
+  if (inline_payload) {
+    uint32_t pl = static_cast<uint32_t>(payload.size());
+    out->append(reinterpret_cast<const char*>(&pl), 4);
+    out->append(payload.data(), payload.size());
+  }
+}
+
+// One trunk TCP socket (dialer or accepted), poll-thread-owned.
+struct Sock {
+  int fd = -1;
+  bool dialer = false;      // we dialed it (it carries OUR batches out)
+  bool connecting = false;  // nonblocking connect still in flight
+  uint64_t peer_id = 0;     // dialer only: which peer this link serves
+  std::string inbuf;        // partial trunk records
+  std::string outbuf;       // unsent bytes (partial-write backlog)
+  size_t outpos = 0;
+};
+
+// A flushed-but-unacked batch (the QoS1 replay ring entry).
+struct Unacked {
+  uint64_t seq = 0;
+  uint64_t t0_ns = 0;       // flush stamp (0 = telemetry off)
+  // pre-framed qos1-only wire record for this batch ("" = batch held
+  // no elevated-qos entries; nothing to replay, ring entry exists only
+  // for the RTT stage)
+  std::string q1_record;
+};
+
+// Per-peer trunk state: link identity + the batch under construction.
+struct Peer {
+  uint64_t sock_tag = 0;    // live dialer sock tag (0 = no link)
+  bool up = false;          // connected; remote entries forward here
+  std::string addr;         // redial target (Python drives redial)
+  uint16_t port = 0;
+  std::string batch;        // BATCH entries accumulated this cycle
+  uint32_t batch_n = 0;
+  uint32_t q0_n = 0;        // qos0 entries in `batch` (shed accounting)
+  std::string q1_batch;     // qos1-only copies (full payloads, no dedup)
+  uint32_t q1_n = 0;
+  std::string prev_payload; // payload-dedup reference (batch-scoped)
+  bool have_prev = false;
+  uint64_t next_seq = 1;
+  std::deque<Unacked> unacked;
+};
+
+}  // namespace trunk
+}  // namespace emqx_native

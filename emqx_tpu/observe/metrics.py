@@ -54,6 +54,12 @@ MESSAGES = [
     "messages.dropped.await_pubrel_timeout", "messages.dropped.no_subscribers",
     "messages.forward", "messages.retained", "messages.delayed",
     "messages.delivered", "messages.acked",
+    # forward-lane split (ISSUE 4 satellite): .native counts trunked
+    # legs (C++ trunk plane, folded by native_server._merge_fast_
+    # metrics), .slow the Python forward_fn lane; messages.forward
+    # stays the total. Fixed slots so both render at zero and ride the
+    # $SYS metrics heartbeat before the first cross-node leg.
+    "messages.forward.native", "messages.forward.slow",
 ]
 DELIVERY = [
     "delivery.dropped", "delivery.dropped.no_local",

@@ -328,9 +328,13 @@ def test_jwt_acl_enforced_via_channel():
 # -- JWT RS256 / JWKS (emqx_authn_jwt public-key + jwks flavors) ---------------
 
 def _rsa_jwt(claims, kid="key-1"):
-    """Mint an RS256 token + matching JWKS doc with `cryptography`."""
+    """Mint an RS256 token + matching JWKS doc with `cryptography`.
+    Callers skip cleanly when the optional dep is absent (the container
+    ships without it; a ModuleNotFoundError here used to fail six tests
+    instead of skipping them)."""
     import json as _json
 
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
 
@@ -407,6 +411,7 @@ def test_jwt_es256():
     import json as _json
     import time as _t
 
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.hazmat.primitives.asymmetric.utils import (

@@ -408,6 +408,10 @@ def test_tcp_transport_per_key_lanes_order_and_parallelism():
         a.cast("lb", "lane.probe", _key="fast", seq=0, key="fast")
         assert fast_done.wait(5), \
             "a slow lane blocked an unrelated key's lane"
+        # deterministic settle (ISSUE 4 satellite): the explicit cast
+        # barrier proves every frame is on the wire; the remaining wait
+        # is only for the peer's sequential dispatch to drain them
+        a.flush_casts(timeout=15)
         deadline = _t.time() + 10
         while _t.time() < deadline and \
                 len([g for g in got if g[0] == "kA"]) < 50:

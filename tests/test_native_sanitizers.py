@@ -643,9 +643,147 @@ print("SANITIZED-RUN-OK", st["telemetry_batches"], st["fr_dumps"])
 """
 
 
+# Round-9 cluster-trunk coverage (ISSUE 4): TWO hosts in one process,
+# each with its own poll thread, forwarding publishes over a loopback
+# trunk link while a control thread races trunk connect/disconnect and
+# route add/del ops against both poll threads — the first time two
+# native hosts talk to each other, under both sanitizers.
+DRIVER_TRUNK = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+A = native.NativeHost(port=0, max_size=1 << 16)
+B = native.NativeHost(port=0, max_size=1 << 16)
+tp = B.trunk_listen()
+
+def connect(host, cid):
+    s = socket.create_connection(("127.0.0.1", host.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+def pub_frame(topic, payload, qos=0, pid=0):
+    vh = struct.pack(">H", len(topic)) + topic
+    if qos:
+        vh += struct.pack(">H", pid)
+    vh += payload
+    return bytes([0x30 | (qos << 1), len(vh)]) + vh
+
+pub_s = connect(A, b"tp")
+sub_s = connect(B, b"ts")
+ids = {"A": [], "B": []}
+framed = {"A": 0, "B": 0}
+deadline = time.time() + 15
+while ((not ids["A"] or not ids["B"] or framed["A"] < 1 or framed["B"] < 1)
+       and time.time() < deadline):
+    for name, h in (("A", A), ("B", B)):
+        for kind, conn, payload in h.poll(20):
+            if kind == native.EV_OPEN:
+                ids[name].append(conn)
+            elif kind == native.EV_FRAME:
+                framed[name] += 1
+                h.send(conn, b"\x20\x02\x00\x00")
+assert ids["A"] and ids["B"], ids
+pa, sb = ids["A"][0], ids["B"][0]
+A.enable_fast(pa, 4)
+A.permit(pa, "tr/x")
+A.trunk_route_add(1, "tr/x")
+A.trunk_connect(1, "127.0.0.1", tp)
+B.enable_fast(sb, 4)
+B.sub_add(sb, "tr/+", qos=1)
+
+stop = threading.Event()
+events = {"up": 0, "down": 0}
+def poller(h):
+    while not stop.is_set():
+        for kind, conn, payload in h.poll(20):
+            if kind == native.EV_TRUNK and payload:
+                if payload[0] == native.TRUNK_UP:
+                    events["up"] += 1
+                elif payload[0] == native.TRUNK_DOWN:
+                    events["down"] += 1
+tA = threading.Thread(target=poller, args=(A,))
+tB = threading.Thread(target=poller, args=(B,))
+tA.start(); tB.start()
+
+def churn():
+    j = 0
+    while not stop.is_set():
+        A.trunk_route_add(1, "churn/%%d" %% (j %% 5))
+        A.trunk_route_del(1, "churn/%%d" %% ((j + 2) %% 5))
+        A.stats(); B.stats()
+        if j %% 60 == 29:
+            # teardown/reconnect racing the poll threads (keep state:
+            # the replay ring survives and replays on the reconnect)
+            A.trunk_disconnect(1, forget=False)
+            A.trunk_connect(1, "127.0.0.1", tp)
+        j += 1
+        time.sleep(0.0005)
+ctl = threading.Thread(target=churn)
+ctl.start()
+
+def drain():
+    sub_s.settimeout(0.2)
+    buf = b""
+    while not stop.is_set():
+        try:
+            chunk = sub_s.recv(8192)
+        except (TimeoutError, OSError):
+            continue
+        if not chunk:
+            return
+        buf += chunk
+        # ack any qos1 deliveries so B's ack plane cycles too
+        while len(buf) >= 2:
+            ln = buf[1]
+            if ln & 0x80 or len(buf) < 2 + ln:
+                break
+            frame, buf = buf[: 2 + ln], buf[2 + ln:]
+            if frame[0] >> 4 == 3 and (frame[0] >> 1) & 3 == 1:
+                tlen = (frame[2] << 8) | frame[3]
+                pid = (frame[4 + tlen] << 8) | frame[5 + tlen]
+                try:
+                    sub_s.sendall(bytes([0x40, 2, pid >> 8, pid & 0xFF]))
+                except OSError:
+                    return
+dr = threading.Thread(target=drain)
+dr.start()
+
+time.sleep(0.3)
+N_MSG = 600
+for k in range(N_MSG):
+    pub_s.sendall(pub_frame(b"tr/x", b"p%%04d" %% k, k & 1,
+                            1 + (k %% 100)))
+    time.sleep(0.0004)
+
+deadline = time.time() + 20
+while time.time() < deadline:
+    a, b = A.stats(), B.stats()
+    if (a["trunk_out"] > N_MSG // 4 and b["trunk_in"] > 0
+            and a["trunk_batches_out"] > 0 and events["up"] > 0):
+        break
+    time.sleep(0.05)
+time.sleep(0.3)
+stop.set()
+ctl.join(); dr.join(); tA.join(); tB.join()
+a, b = A.stats(), B.stats()
+assert a["trunk_out"] > 0 and a["trunk_batches_out"] > 0, a
+assert b["trunk_in"] > 0 and b["trunk_batches_in"] > 0, b
+assert events["up"] > 0, events
+for s in (pub_s, sub_s):
+    try: s.close()
+    except OSError: pass
+for _ in range(10):
+    list(A.poll(10)); list(B.poll(10))
+A.destroy(); B.destroy()
+print("SANITIZED-RUN-OK", a["trunk_out"], b["trunk_in"], events)
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
-                                    "telemetry"])
+                                    "telemetry", "trunk"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -662,7 +800,7 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     }
     src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
            "lane": DRIVER_LANE, "ws": DRIVER_WS,
-           "telemetry": DRIVER_TELEMETRY}[driver]
+           "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

@@ -1,0 +1,490 @@
+"""Native cluster trunk (ISSUE 4): cross-node publish forwarding on the
+C++ plane.
+
+Two native hosts on loopback talk trunk records to each other
+(native/src/trunk.h wire format): QoS0/1 parity against the Python
+``forward_fn`` oracle lane, per-topic ordering across batch flushes,
+the degradation ladder (trunk → punt → Python) across a link kill with
+reconnect-replay proving zero QoS1 forward loss, receiver-side punts
+for non-native local audiences, and route add/remove races.
+"""
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp                              # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer    # noqa: E402
+from emqx_tpu.cluster.node import ClusterNode                   # noqa: E402
+from emqx_tpu.cluster.transport import LocalBus                 # noqa: E402
+from emqx_tpu.core.message import Message                       # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient                     # noqa: E402
+
+
+def run(main):
+    asyncio.run(main())
+
+
+def _wait(pred, timeout=8.0, step=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+class _TrunkedPair:
+    """Two ClusterNodes on a LocalBus, each fronted by a native server;
+    ``trunk=True`` opens trunk listeners and lets hello/ping wire the
+    links (the product path), ``trunk=False`` is the Python-oracle
+    topology (remote routes stay punt markers, forward_fn carries)."""
+
+    def __init__(self, trunk: bool, suffix: str):
+        self.fabric = LocalBus.Fabric()
+        self.nodes = []
+        self.servers = []
+        for name in (f"nA{suffix}", f"nB{suffix}"):
+            node = ClusterNode(name, LocalBus(name, self.fabric))
+            srv = NativeBrokerServer(
+                port=0, app=node.app,
+                trunk_port=0 if trunk else None)
+            if trunk:
+                node.attach_native(srv)
+            srv.start()
+            self.nodes.append(node)
+            self.servers.append(srv)
+        self.nodes[1].join([self.nodes[0].name])
+
+    @property
+    def a(self):
+        return self.servers[0]
+
+    @property
+    def b(self):
+        return self.servers[1]
+
+    def sync(self):
+        for n in self.nodes:
+            n.flush()
+
+    def wait_trunks_up(self, timeout=8.0):
+        def both_up():
+            return (self.a.trunk_peer_status().get(self.nodes[1].name)
+                    and self.b.trunk_peer_status().get(self.nodes[0].name))
+        assert _wait(both_up, timeout), (
+            self.a.trunk_peer_status(), self.b.trunk_peer_status())
+
+    def stop(self):
+        for s in self.servers:
+            s.stop()
+        for n in self.nodes:
+            n.transport.close()
+
+
+def _drive_cross_node(pair, topic_fmt, payloads, qos, settle=0.35):
+    """Subscriber on node B, publisher on node A; returns the received
+    (topic, payload) list in arrival order."""
+    got = []
+
+    async def main():
+        sub = MqttClient(port=pair.b.port, clientid="xsub")
+        await sub.connect()
+        await sub.subscribe(topic_fmt.replace("{i}", "+"), qos=qos)
+        pair.sync()                    # replicate the route to node A
+        pub = MqttClient(port=pair.a.port, clientid="xpub")
+        await pub.connect()
+        # first publish rides the Python lane and earns the permit
+        await pub.publish(topic_fmt.replace("{i}", "0"), b"warm", qos=qos)
+        m = await sub.recv(timeout=8)
+        got.append((m.topic, m.payload))
+        await asyncio.sleep(settle)    # permit grants on an idle step
+        for i, p in enumerate(payloads):
+            await pub.publish(topic_fmt.replace("{i}", str(i % 4)), p,
+                              qos=qos)
+        deadline = time.monotonic() + 15
+        while len(got) < len(payloads) + 1 and time.monotonic() < deadline:
+            try:
+                m = await sub.recv(timeout=2)
+            except asyncio.TimeoutError:
+                continue
+            got.append((m.topic, m.payload))
+        await pub.close()
+        await sub.close()
+
+    run(main)
+    return got
+
+
+def test_qos0_cross_node_parity_vs_python_oracle():
+    """The trunked pair must deliver the SAME (topic, payload) multiset
+    the Python forward_fn oracle topology delivers — and actually ride
+    the trunk for the steady state."""
+    payloads = [b"m%03d" % i for i in range(60)]
+    trunked = _TrunkedPair(trunk=True, suffix="q0t")
+    try:
+        trunked.wait_trunks_up()
+        got_trunk = _drive_cross_node(trunked, "t0/{i}", payloads, qos=0)
+        st = trunked.a.fast_stats()
+        assert st["trunk_out"] > 0, st            # the plane was used
+        assert trunked.b.fast_stats()["trunk_in"] > 0
+    finally:
+        trunked.stop()
+    oracle = _TrunkedPair(trunk=False, suffix="q0o")
+    try:
+        got_py = _drive_cross_node(oracle, "t0/{i}", payloads, qos=0)
+        assert oracle.a.fast_stats()["trunk_out"] == 0
+    finally:
+        oracle.stop()
+    assert sorted(got_trunk) == sorted(got_py)
+    assert len(got_trunk) == len(payloads) + 1    # zero loss either lane
+
+
+def test_qos1_cross_node_parity_and_forward_split_metrics():
+    """QoS1 publishes ride the trunk (publisher acked natively on A,
+    subscriber served from B's native ack plane) with zero loss, and
+    the messages.forward.native/.slow split accounts the legs."""
+    payloads = [b"q%03d" % i for i in range(40)]
+    pair = _TrunkedPair(trunk=True, suffix="q1")
+    try:
+        pair.wait_trunks_up()
+        got = _drive_cross_node(pair, "t1/{i}", payloads, qos=1)
+        assert sorted(p for _t, p in got) == sorted(payloads + [b"warm"])
+        assert pair.a.fast_stats()["trunk_out"] > 0
+        # housekeep folds trunk_out into the forward split; force one
+        pair.a._merge_fast_metrics()
+        m = pair.a.broker.metrics
+        assert m.val("messages.forward.native") > 0
+        assert m.val("messages.forward.slow") >= 1   # the warm-up leg
+        assert m.val("messages.forward") == (
+            m.val("messages.forward.native")
+            + m.val("messages.forward.slow"))
+    finally:
+        pair.stop()
+
+
+def test_per_topic_ordering_across_batch_flushes():
+    """Messages interleaved across two topics must arrive per-topic
+    ordered on the remote node even as the trunk chops the stream into
+    per-cycle batches (one FIFO per peer = total order per link)."""
+    pair = _TrunkedPair(trunk=True, suffix="ord")
+    try:
+        pair.wait_trunks_up()
+        n = 150
+
+        async def main():
+            sub = MqttClient(port=pair.b.port, clientid="osub")
+            await sub.connect()
+            await sub.subscribe("ord/+", qos=0)
+            pair.sync()
+            pub = MqttClient(port=pair.a.port, clientid="opub")
+            await pub.connect()
+            for t in ("ord/x", "ord/y"):
+                await pub.publish(t, b"warm", qos=0)
+            for _ in range(2):
+                await sub.recv(timeout=8)
+            await asyncio.sleep(0.4)
+            for i in range(n):
+                await pub.publish("ord/x", b"x%04d" % i, qos=0)
+                await pub.publish("ord/y", b"y%04d" % i, qos=0)
+            seen = {"ord/x": [], "ord/y": []}
+            deadline = time.monotonic() + 20
+            while (sum(len(v) for v in seen.values()) < 2 * n
+                   and time.monotonic() < deadline):
+                try:
+                    m = await sub.recv(timeout=2)
+                except asyncio.TimeoutError:
+                    continue
+                seen[m.topic].append(m.payload)
+            # per-topic order is strict; qos0 drops are legal under
+            # backpressure but must preserve relative order
+            for t, prefix in (("ord/x", b"x"), ("ord/y", b"y")):
+                idx = [int(p[1:]) for p in seen[t]]
+                assert idx == sorted(idx), (t, idx[:20])
+                assert len(idx) == n, (t, len(idx))  # loopback: no drops
+            await pub.close()
+            await sub.close()
+
+        run(main)
+        assert pair.a.fast_stats()["trunk_batches_out"] > 1  # really batched
+    finally:
+        pair.stop()
+
+
+def test_trunk_loss_punt_fallback_reconnect_replay_no_qos1_loss():
+    """The acceptance ladder: a dead link flips remote entries back to
+    punt behavior (Python forward lane carries), and the reconnect
+    replays the unacked qos1 ring — the union of deliveries is exactly
+    the published set (bit-identical to what the oracle would deliver),
+    with zero QoS1 loss.
+
+    The first link is a test-controlled sink that reads trunk batches
+    but NEVER acks, so the replay ring provably holds the in-flight
+    messages when the link dies."""
+    app_a, app_b = BrokerApp(), BrokerApp()
+    app_a.broker.node = "nodeA"
+    app_b.broker.node = "nodeB"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0)
+
+    # the Python oracle forward lane (what gen_rpc would do): dispatch
+    # straight into B's broker tables
+    def forward(dest, filt, msg):
+        deliveries = {}
+        app_b.broker._dispatch_local(filt, msg, deliveries)
+        app_b.cm.dispatch(deliveries)
+    app_a.broker.forward_fn = forward
+
+    srv_a.start()
+    srv_b.start()
+
+    # dead-end trunk sink: accepts, reads, never acks, then dies
+    sink = socket.socket()
+    sink.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+    sink_port = sink.getsockname()[1]
+    sink_conns = []
+
+    def sink_loop():
+        try:
+            c, _ = sink.accept()
+            sink_conns.append(c)
+            c.settimeout(0.2)
+            while True:
+                try:
+                    if not c.recv(65536):
+                        return
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+        except OSError:
+            return
+    st = threading.Thread(target=sink_loop, daemon=True)
+    st.start()
+
+    try:
+        run_payloads = [b"k%03d" % i for i in range(12)]
+
+        async def main():
+            sub = MqttClient(port=srv_b.port, clientid="ksub")
+            await sub.connect()
+            await sub.subscribe("kt/x", qos=1)
+            pub = MqttClient(port=srv_a.port, clientid="kpub")
+            await pub.connect()
+
+            # route + trunk wiring AFTER servers run (observer fires)
+            app_a.broker.router.add_route("kt/x", "nodeB")
+            srv_a.trunk_register("nodeB", "127.0.0.1", sink_port)
+            assert _wait(lambda: srv_a.trunk_peer_status().get("nodeB"))
+
+            # earn the permit through the Python lane
+            await pub.publish("kt/x", b"warm", qos=1)
+            m = await sub.recv(timeout=8)
+            assert m.payload == b"warm"
+            await asyncio.sleep(0.4)
+
+            # phase 1: publishes trunk into the sink (never acked, so
+            # the replay ring holds them); the subscriber sees nothing
+            for p in run_payloads[:6]:
+                await pub.publish("kt/x", p, qos=1)
+            assert _wait(
+                lambda: srv_a.fast_stats()["trunk_out"] >= 6), (
+                srv_a.fast_stats())
+
+            # phase 2: kill the link → DOWN → punt fallback: publishes
+            # ride forward_fn while the ring is preserved
+            sink_conns[0].close()
+            sink.close()
+            assert _wait(
+                lambda: not srv_a.trunk_peer_status().get("nodeB"))
+            got_during_down = []
+            for p in run_payloads[6:9]:
+                await pub.publish("kt/x", p, qos=1)
+            while True:
+                try:
+                    m = await sub.recv(timeout=3)
+                except asyncio.TimeoutError:
+                    break
+                got_during_down.append(m.payload)
+            assert sorted(got_during_down) == sorted(run_payloads[6:9])
+
+            # phase 3: re-point at B's REAL trunk and reconnect — the
+            # unacked qos1 batches replay into B's fan-out
+            srv_a.trunk_register("nodeB", "127.0.0.1", srv_b.trunk_port)
+            assert _wait(lambda: srv_a.trunk_peer_status().get("nodeB"))
+            assert _wait(
+                lambda: srv_a.fast_stats()["trunk_replays"] >= 1), (
+                srv_a.fast_stats())
+            replayed = []
+            deadline = time.monotonic() + 10
+            while len(replayed) < 6 and time.monotonic() < deadline:
+                try:
+                    m = await sub.recv(timeout=2)
+                except asyncio.TimeoutError:
+                    continue
+                replayed.append(m.payload)
+            assert sorted(replayed) == sorted(run_payloads[:6]), replayed
+
+            # phase 4: post-reconnect traffic rides the trunk again
+            # (permits were flushed on UP; re-earn through one slow leg)
+            for p in run_payloads[9:]:
+                await pub.publish("kt/x", p, qos=1)
+            tail = []
+            while len(tail) < 3:
+                m = await sub.recv(timeout=8)
+                tail.append(m.payload)
+            assert sorted(tail) == sorted(run_payloads[9:])
+            await pub.close()
+            await sub.close()
+
+        run(main)
+        # zero QoS1 forward loss across the whole ladder: every payload
+        # was delivered exactly through one of the three legs above
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+        try:
+            sink.close()
+        except OSError:
+            pass
+
+
+def test_receiver_side_punt_reaches_python_audience():
+    """A trunk-received publish whose local match set needs Python (a
+    subscriber with no native connection → punt marker) must surface as
+    a kind-9 punt and deliver through the receiver's Python dispatch."""
+    app_a, app_b = BrokerApp(), BrokerApp()
+    app_a.broker.node = "nodeA"
+    app_b.broker.node = "nodeB"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0)
+    app_a.broker.forward_fn = lambda *a: None
+    srv_a.start()
+    srv_b.start()
+    try:
+        got = []
+
+        class FakeChannel:
+            conn_state = "connected"
+
+            def handle_deliver(self, items):
+                got.extend(m for _t, m in items)
+                return []
+
+            def send(self, pkts):
+                pass
+
+        # a Python-plane audience on B: broker-table subscriber with no
+        # native conn (the punt-marker shape) + a local route
+        app_b.cm.register_channel("pysub", FakeChannel())
+        app_b.broker.subscribe("pysub", "pt/x")
+        app_b.broker.router.add_route("pt/x", "nodeB")  # local route
+
+        async def main():
+            pub = MqttClient(port=srv_a.port, clientid="ppub")
+            await pub.connect()
+            app_a.broker.router.add_route("pt/x", "nodeB")
+            srv_a.trunk_register("nodeB", "127.0.0.1", srv_b.trunk_port)
+            assert _wait(lambda: srv_a.trunk_peer_status().get("nodeB"))
+            # the warm-up leg rides A's PYTHON lane, whose forward_fn
+            # is a no-op here by design — only trunked messages may
+            # reach B, so the punt path is provably what delivered
+            await pub.publish("pt/x", b"warm", qos=0)
+            await asyncio.sleep(0.4)
+            for i in range(5):
+                await pub.publish("pt/x", b"p%d" % i, qos=0)
+            assert _wait(lambda: len(got) >= 5), [m.payload for m in got]
+            await pub.close()
+
+        run(main)
+        assert srv_b.fast_stats()["trunk_punts"] >= 1
+        payloads = sorted(m.payload for m in got)
+        assert payloads == sorted(b"p%d" % i for i in range(5))
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_route_add_remove_races_no_loss_no_dup():
+    """Trunk route flips racing a publish stream: every message is
+    delivered at most once (trunk OR Python lane, never both) and the
+    stream delivered while the route exists is loss-free."""
+    app_a, app_b = BrokerApp(), BrokerApp()
+    app_a.broker.node = "nodeA"
+    app_b.broker.node = "nodeB"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0)
+
+    def forward(dest, filt, msg):
+        deliveries = {}
+        app_b.broker._dispatch_local(filt, msg, deliveries)
+        app_b.cm.dispatch(deliveries)
+    app_a.broker.forward_fn = forward
+    srv_a.start()
+    srv_b.start()
+    try:
+        stop = threading.Event()
+
+        def churn():
+            # the route flaps while traffic flows: remote entry ↔ punt
+            # marker ↔ absent, all through the product observer path
+            while not stop.is_set():
+                app_a.broker.router.delete_route("rr/x", "nodeB")
+                time.sleep(0.002)
+                app_a.broker.router.add_route("rr/x", "nodeB")
+                time.sleep(0.004)
+
+        async def main():
+            sub = MqttClient(port=srv_b.port, clientid="rsub")
+            await sub.connect()
+            await sub.subscribe("rr/x", qos=1)
+            pub = MqttClient(port=srv_a.port, clientid="rpub")
+            await pub.connect()
+            app_a.broker.router.add_route("rr/x", "nodeB")
+            srv_a.trunk_register("nodeB", "127.0.0.1", srv_b.trunk_port)
+            assert _wait(lambda: srv_a.trunk_peer_status().get("nodeB"))
+            await pub.publish("rr/x", b"warm", qos=1)
+            await sub.recv(timeout=8)
+            await asyncio.sleep(0.4)
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+            n = 120
+            for i in range(n):
+                await pub.publish("rr/x", b"r%04d" % i, qos=1)
+            stop.set()
+            t.join(timeout=5)
+            app_a.broker.router.add_route("rr/x", "nodeB")
+            got = []
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                try:
+                    m = await sub.recv(timeout=1.5)
+                except asyncio.TimeoutError:
+                    break
+                got.append(m.payload)
+            # no duplicates ever (one delivery mechanism per message)
+            assert len(got) == len(set(got)), "duplicate delivery"
+            # the flap window may drop messages published while the
+            # route was absent (no audience = legal drop), but the
+            # plane must stay alive and keep delivering afterwards
+            await pub.publish("rr/x", b"after", qos=1)
+            m = await sub.recv(timeout=8)
+            assert m.payload in (b"after",) or b"after" in got
+            await pub.close()
+            await sub.close()
+
+        run(main)
+    finally:
+        srv_a.stop()
+        srv_b.stop()

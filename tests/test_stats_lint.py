@@ -93,3 +93,43 @@ def test_app_prometheus_carries_native_stats_when_wired():
     out = app.prometheus()
     for name in native.STAT_NAMES:
         assert f"emqx_native_{name}" in out
+
+
+# -- cluster trunk (ISSUE 4) -------------------------------------------------
+
+
+def test_trunk_slots_and_stages_exported():
+    """The trunk plane's StatSlots/HistStages must stay exported — the
+    mechanical enum lint above would pass if BOTH sides dropped them,
+    so their presence is pinned here by name."""
+    for name in ("trunk_out", "trunk_in", "trunk_batches_out",
+                 "trunk_batches_in", "trunk_punts", "trunk_replays"):
+        assert name in native.STAT_NAMES, name
+    assert "trunk_rtt" in native.HIST_STAGES
+    assert "trunk_batch_n" in native.HIST_STAGES
+    # and the C++ side actually defines them (not just the Python list)
+    src = _src()
+    assert "kStTrunkOut" in src and "kHistTrunkRtt" in src
+
+
+def test_forward_split_fixed_slots_render_at_zero():
+    """messages.forward.native / .slow are FIXED metric slots: they
+    render (at zero) in prometheus and ride the $SYS metrics heartbeat
+    before the first cross-node leg ever happens."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    m = Metrics()
+    assert m.val("messages.forward.native") == 0
+    assert m.val("messages.forward.slow") == 0
+    out = prometheus.render(metrics=m)
+    assert "emqx_messages_forward_native" in out
+    assert "emqx_messages_forward_slow" in out
+
+    seen = {}
+    hb = SysHeartbeat("n1", lambda msg: seen.__setitem__(
+        msg.topic, msg.payload), metrics=m)
+    hb.publish_metrics()
+    assert seen["$SYS/brokers/n1/metrics/messages.forward.native"] == b"0"
+    assert seen["$SYS/brokers/n1/metrics/messages.forward.slow"] == b"0"

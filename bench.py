@@ -1293,6 +1293,94 @@ def sec_observe_overhead() -> None:
 
 
 # ---------------------------------------------------------------------------
+# raw-socket MQTT codec shared by the trunk/durable sections (one copy:
+# a framing fix must not have to land twice)
+# ---------------------------------------------------------------------------
+
+def mqtt_connect(cid, clean=True):
+    import struct
+    flags = 0x02 if clean else 0x00
+    vh = (b"\x00\x04MQTT\x04" + bytes([flags]) + b"\x00\x3c"
+          + struct.pack(">H", len(cid)) + cid)
+    return bytes([0x10, len(vh)]) + vh
+
+
+def mqtt_subscribe(pid, topic, qos=0):
+    import struct
+    body = struct.pack(">H", pid) + struct.pack(">H", len(topic)) \
+        + topic + bytes([qos])
+    return bytes([0x82, len(body)]) + body
+
+
+def mqtt_publish(topic, payload, qos=0, pid=0):
+    import struct
+    body = struct.pack(">H", len(topic)) + topic
+    if qos:
+        body += struct.pack(">H", pid)
+    body += payload
+    head = bytes([0x30 | (qos << 1)])
+    remaining = len(body)
+    var = b""
+    while True:
+        b7 = remaining & 0x7F
+        remaining >>= 7
+        var += bytes([b7 | (0x80 if remaining else 0)])
+        if not remaining:
+            break
+    return head + var + body
+
+
+def count_publishes(buf, counts):
+    """Consume whole frames from buf, counting PUBLISHes; returns the
+    unconsumed tail."""
+    pos = 0
+    while True:
+        if len(buf) - pos < 2:
+            break
+        rl = 0
+        shift = 0
+        i = pos + 1
+        ok = True
+        while True:
+            if i >= len(buf):
+                ok = False
+                break
+            byte = buf[i]
+            rl |= (byte & 0x7F) << shift
+            shift += 7
+            i += 1
+            if not byte & 0x80:
+                break
+        if not ok or len(buf) - i < rl:
+            break
+        if buf[pos] >> 4 == 3:
+            counts[0] += 1
+        pos = i + rl
+    return buf[pos:]
+
+
+def publish_drainer(sock, counts, stop):
+    """Count inbound PUBLISHes until stop. select-based on purpose: the
+    durable replay leg shares the PUBLISHER's socket with the main
+    thread's sendall loop, and a socket-level settimeout would apply to
+    send too — a >200ms fsync stall mid-blast would then raise
+    TimeoutError out of sendall and kill the whole section."""
+    import select
+    buf = b""
+    while not stop.is_set():
+        try:
+            r, _, _ = select.select([sock], [], [], 0.2)
+            if not r:
+                continue
+            chunk = sock.recv(1 << 16)
+        except (OSError, ValueError):
+            return
+        if not chunk:
+            return
+        buf = count_publishes(buf + chunk, counts)
+
+
+# ---------------------------------------------------------------------------
 # section: trunk (cross-node forwarding on the native plane; CPU by design)
 # ---------------------------------------------------------------------------
 
@@ -1305,7 +1393,6 @@ def sec_trunk() -> None:
     B, the cluster plane replicating the route; the arms differ only by
     attach_native (trunk adverts on hello/ping)."""
     import socket
-    import struct
     import threading
 
     from emqx_tpu import native
@@ -1317,57 +1404,6 @@ def sec_trunk() -> None:
     from emqx_tpu.broker.native_server import NativeBrokerServer
     from emqx_tpu.cluster.node import ClusterNode
     from emqx_tpu.cluster.transport import TcpTransport
-
-    def mqtt_connect(cid):
-        vh = (b"\x00\x04MQTT\x04\x02\x00\x3c"
-              + struct.pack(">H", len(cid)) + cid)
-        return bytes([0x10, len(vh)]) + vh
-
-    def mqtt_subscribe(pid, topic, qos=0):
-        body = struct.pack(">H", pid) + struct.pack(">H", len(topic)) \
-            + topic + bytes([qos])
-        return bytes([0x82, len(body)]) + body
-
-    def mqtt_publish(topic, payload):
-        body = struct.pack(">H", len(topic)) + topic + payload
-        head = bytes([0x30])
-        remaining = len(body)
-        var = b""
-        while True:
-            b7 = remaining & 0x7F
-            remaining >>= 7
-            var += bytes([b7 | (0x80 if remaining else 0)])
-            if not remaining:
-                break
-        return head + var + body
-
-    def count_publishes(buf, counts):
-        """Consume whole frames from buf, counting PUBLISHes; returns
-        the unconsumed tail."""
-        pos = 0
-        while True:
-            if len(buf) - pos < 2:
-                break
-            rl = 0
-            shift = 0
-            i = pos + 1
-            ok = True
-            while True:
-                if i >= len(buf):
-                    ok = False
-                    break
-                byte = buf[i]
-                rl |= (byte & 0x7F) << shift
-                shift += 7
-                i += 1
-                if not byte & 0x80:
-                    break
-            if not ok or len(buf) - i < rl:
-                break
-            if buf[pos] >> 4 == 3:
-                counts[0] += 1
-            pos = i + rl
-        return buf[pos:]
 
     def build_pair(trunk: bool, suffix: str):
         ta = TcpTransport(f"bA{suffix}")
@@ -1406,19 +1442,8 @@ def sec_trunk() -> None:
                 assert sa.trunk_peer_status().get(nb.name), "trunk not up"
             counts = [0]
             stop = threading.Event()
-
-            def drain():
-                buf = b""
-                sub.settimeout(0.2)
-                while not stop.is_set():
-                    try:
-                        chunk = sub.recv(1 << 16)
-                    except (TimeoutError, OSError):
-                        continue
-                    if not chunk:
-                        return
-                    buf = count_publishes(buf + chunk, counts)
-            dt = threading.Thread(target=drain, daemon=True)
+            dt = threading.Thread(target=publish_drainer,
+                                  args=(sub, counts, stop), daemon=True)
             dt.start()
             # warm leg earns the permit through the Python lane
             pub.sendall(mqtt_publish(b"bt/x", b"warm-up-00000"))
@@ -1508,6 +1533,204 @@ def sec_trunk() -> None:
             put("trunk", **{
                 f"trunk_broker_{stage}_p50_us": round(s["p50_us"], 1),
                 f"trunk_broker_{stage}_p99_us": round(s["p99_us"], 1)})
+
+
+# ---------------------------------------------------------------------------
+# section: durable (ISSUE 5 acceptance)
+# ---------------------------------------------------------------------------
+
+def sec_durable() -> None:
+    """ISSUE 5 acceptance: with ONE persistent subscriber in a fan-out
+    audience, fast-path throughput must be >= 10x the punt-everything
+    behavior (pre-round-10, a single durable subscriber collapsed every
+    matching publish onto the Python plane). Same driver both arms —
+    raw-socket publisher + N fast subscribers + 1 persistent subscriber
+    — differing only by the durable plane being attached. Plus the
+    resume-replay drain rate (store -> native delivery machinery)."""
+    import socket
+    import tempfile
+    import threading
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.session.persistent import MemStore
+
+    def build(durable: bool):
+        app = BrokerApp(persistent_store=MemStore())
+        server = NativeBrokerServer(
+            port=0, app=app, durable=durable,
+            durable_dir=tempfile.mkdtemp(prefix="emqx_dur_")
+            if durable else None)
+        server.start()
+        return server
+
+    N_FAST = int(os.environ.get("BENCH_DURABLE_FANOUT", 4))
+
+    def drive(durable: bool, n_msg: int, deadline_s: float):
+        server = build(durable)
+        socks, threads, stop = [], [], threading.Event()
+        counts = [[0] for _ in range(N_FAST)]
+        try:
+            for i in range(N_FAST):
+                s = socket.create_connection(("127.0.0.1", server.port))
+                s.sendall(mqtt_connect(b"df%d" % i)
+                          + mqtt_subscribe(1, b"du/t"))
+                socks.append(s)
+                t = threading.Thread(target=publish_drainer,
+                                     args=(s, counts[i], stop),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+            ps = socket.create_connection(("127.0.0.1", server.port))
+            ps.sendall(mqtt_connect(b"dps", clean=False)
+                       + mqtt_subscribe(1, b"du/t", qos=1))
+            pcount = [0]
+            pt = threading.Thread(target=publish_drainer,
+                                  args=(ps, pcount, stop), daemon=True)
+            pt.start()
+            pub = socket.create_connection(("127.0.0.1", server.port))
+            pub.sendall(mqtt_connect(b"dpub"))
+            time.sleep(0.3)
+            # warm leg earns the permit through the Python plane
+            pub.sendall(mqtt_publish(b"du/t", b"warm-000"))
+            t0 = time.time()
+            while counts[0][0] < 1 and time.time() - t0 < 15:
+                time.sleep(0.05)
+            time.sleep(0.8)     # permit grants on an idle poll step
+            blob = mqtt_publish(b"du/t", b"x" * 16) * 256
+            sent = 0
+            t0 = time.time()
+            while sent < n_msg and time.time() - t0 < deadline_s:
+                pub.sendall(blob)
+                sent += 256
+            deadline = time.time() + max(15.0, deadline_s / 2)
+            while counts[0][0] < sent + 1 and time.time() < deadline:
+                time.sleep(0.05)
+            wall = time.time() - t0
+            received = counts[0][0] - 1          # minus the warm leg
+            rate = received / max(wall, 1e-9)
+            st = server.fast_stats()
+            return rate, received, sent, st, server, socks + [ps, pub], \
+                stop, threads + [pt]
+        except Exception:
+            stop.set()
+            server.stop()
+            raise
+
+    def teardown(server, socks, stop, threads):
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        server.stop()
+
+    n_before = int(os.environ.get("BENCH_DURABLE_PY_MSGS", 4096))
+    n_after = int(os.environ.get("BENCH_DURABLE_MSGS", 120000))
+
+    rate0, recv0, sent0, st0, srv0, socks0, stop0, th0 = drive(
+        False, n_before, 45.0)
+    log(f"durable BEFORE (punt-everything: 1 persistent sub among "
+        f"{N_FAST} fast subs, qos0): {recv0}/{sent0} = {rate0:,.0f} "
+        f"msg/s (punts={st0['punts']}, durable_in={st0['durable_in']})")
+    teardown(srv0, socks0, stop0, th0)
+    put("durable", durable_fanout_before_msgs_per_sec=round(rate0),
+        durable_fanout_n_fast=N_FAST)
+
+    rate1, recv1, sent1, st1, srv1, socks1, stop1, th1 = drive(
+        True, n_after, 60.0)
+    ratio = rate1 / max(rate0, 1e-9)
+    log(f"durable AFTER (native durable plane): {recv1}/{sent1} = "
+        f"{rate1:,.0f} msg/s ({ratio:,.1f}x the punt path"
+        f"{'' if ratio >= 10 else ' — UNDER the 10x acceptance'}; "
+        f"durable_in={st1['durable_in']} punts={st1['punts']} "
+        f"store_appends={st1['store_appends']})")
+    put("durable",
+        durable_fanout_after_msgs_per_sec=round(rate1),
+        durable_vs_punt=round(ratio, 2),
+        durable_10x_acceptance=bool(ratio >= 10))
+    put_broker_hists("durable", srv1, "durable")
+    teardown(srv1, socks1, stop1, th1)
+
+    # -- resume-replay drain rate -------------------------------------------
+    server = build(True)
+    try:
+        ps = socket.create_connection(("127.0.0.1", server.port))
+        ps.sendall(mqtt_connect(b"drp", clean=False)
+                   + mqtt_subscribe(1, b"dr/t", qos=1))
+        time.sleep(0.4)
+        ps.sendall(b"\xe0\x00")          # DISCONNECT: offline, session kept
+        ps.close()
+        pub = socket.create_connection(("127.0.0.1", server.port))
+        pub.sendall(mqtt_connect(b"drpub"))
+        stop = threading.Event()
+        acks = [0]
+        at = threading.Thread(target=publish_drainer, args=(pub, acks, stop),
+                              daemon=True)
+        at.start()
+        time.sleep(0.3)
+        pub.sendall(mqtt_publish(b"dr/t", b"warm", qos=1, pid=1))
+        time.sleep(0.8)                  # permit grant window
+        n_replay = int(os.environ.get("BENCH_DURABLE_REPLAY_MSGS", 20000))
+        sent = 0
+        blob = b"".join(mqtt_publish(b"dr/t", b"y" * 16, qos=1,
+                                     pid=1 + (k % 60000))
+                        for k in range(256))
+        while sent < n_replay:
+            pub.sendall(blob)
+            sent += 256
+        tok = server._durable_tokens.get("drp")
+        t0 = time.time()
+        while (tok is None or server._durable_store.pending(tok)
+               < sent) and time.time() - t0 < 30:
+            time.sleep(0.1)
+            tok = server._durable_tokens.get("drp")
+        stored = server._durable_store.pending(tok) if tok else 0
+        # resume: the replay rides session.deliver -> host.send
+        ps2 = socket.create_connection(("127.0.0.1", server.port))
+        rcount = [0]
+        rt = threading.Thread(target=publish_drainer, args=(ps2, rcount, stop),
+                              daemon=True)
+        t0 = time.time()
+        ps2.sendall(mqtt_connect(b"drp", clean=False))
+        rt.start()
+        deadline = t0 + 60
+        # qos1 replay throttles on the session window without acks; the
+        # drain counts deliveries, acking is out of scope — measure the
+        # first-window burst plus stored drain via the store gauge
+        while (tok and server._durable_store.pending(tok) > 0
+               and time.time() < deadline):
+            time.sleep(0.05)
+        drain_wall = time.time() - t0
+        drained = stored - (server._durable_store.pending(tok)
+                            if tok else 0)
+        drate = drained / max(drain_wall, 1e-9)
+        time.sleep(1.0)   # let the first-window deliveries hit the wire
+        log(f"durable replay: {stored} stored, {drained} drained in "
+            f"{drain_wall:.2f}s = {drate:,.0f} msg/s "
+            f"(first-window deliveries on the wire: {rcount[0]}; the "
+            f"rest ride the session mqueue/window as the client acks)")
+        put("durable",
+            durable_replay_stored=stored,
+            durable_replay_drain_msgs_per_sec=round(drate))
+        put_broker_hists("durable", server, "durable_replay")
+        stop.set()
+        for s in (pub, ps2):
+            try:
+                s.close()
+            except OSError:
+                pass
+    finally:
+        server.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -1790,6 +2013,7 @@ SECTIONS = {
     "host": sec_host,
     "ws": sec_ws,
     "trunk": sec_trunk,
+    "durable": sec_durable,
     "e2e": sec_e2e,
     "observe_overhead": sec_observe_overhead,
 }
@@ -1807,6 +2031,7 @@ DEVICE_PLAN = [
     ("host", False, True, 500),
     ("ws", False, True, 400),
     ("trunk", False, True, 400),
+    ("durable", False, True, 400),
     ("shared", False, True, 400),
     ("observe_overhead", False, True, 300),
 ]
@@ -1816,13 +2041,14 @@ CPU_PLAN = [
     ("host", False, True, 500),
     ("ws", False, True, 400),
     ("trunk", False, True, 400),
+    ("durable", False, True, 400),
     ("shared", False, True, 400),
     ("e2e", False, True, 600),
     ("observe_overhead", False, True, 300),
 ]
 
 _SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
-                  "shared", "host", "ws", "trunk", "e2e",
+                  "shared", "host", "ws", "trunk", "durable", "e2e",
                   "observe_overhead", "kernel_cpu"]
 
 

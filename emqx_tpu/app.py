@@ -401,6 +401,20 @@ class BrokerApp:
         # the RouterModel the broker registers subscriptions into and the
         # pipeline batches publishes through (VERDICT r1 item 1; the
         # reference's product IS its hot path, emqx_broker.erl:218-232)
+        # durable-session plane (round 10): durable.enable boots the
+        # PersistentSessions service on a restart-surviving DiskStore
+        # (subscriptions + Python-plane messages); the native server
+        # layers its below-the-GIL message store next to it, reading
+        # the same durable.* knobs
+        if conf.get("durable.enable") and "persistent_store" not in overrides:
+            import os as _os2
+
+            from emqx_tpu.session.persistent import DiskStore
+            base = (conf.get("durable.store_dir")
+                    or _os2.path.join(conf.get("node.data_dir", "data"),
+                                      "durable"))
+            overrides["persistent_store"] = DiskStore(
+                _os2.path.join(base, "sessions"))
         if conf.get("router.device.enable") and "router_model" not in overrides:
             from emqx_tpu.models.router_model import RouterModel
             from emqx_tpu.router.index import TrieIndex
@@ -477,6 +491,11 @@ class BrokerApp:
             conf.get("node.data_dir", "data"), "plugins")
         app.plugins.scan()
         app.plugins.ensure_started()      # enabled plugins, in order
+        if app.persistent is not None:
+            # operator retention bound for stored sessions (0 = each
+            # session's own expiry interval governs)
+            app.persistent.session_expiry_cap_ms = int(
+                float(conf.get("durable.session_expiry")) * 1000)
         ss = app.slow_subs
         ss.enable = bool(conf.get("slow_subs.enable"))
         ss.threshold_ms = int(float(conf.get("slow_subs.threshold")) * 1000)

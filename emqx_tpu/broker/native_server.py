@@ -146,6 +146,10 @@ class NativeBrokerServer:
         telemetry: Optional[bool] = None,
         trunk_port: Optional[int] = None,
         trunk_host: Optional[str] = None,
+        durable: Optional[bool] = None,
+        durable_dir: Optional[str] = None,
+        durable_fsync: Optional[str] = None,
+        durable_segment_bytes: Optional[int] = None,
     ):
         if not native.available():
             raise RuntimeError(
@@ -226,6 +230,73 @@ class NativeBrokerServer:
         self._trace_lock = threading.Lock()
         if self.app is not None:
             self.app.native_stats_fn = self.fast_stats
+        # -- durable-session plane (round 10) ------------------------------
+        # A persistent session's filter used to become a punt marker —
+        # one durable subscriber collapsed every matching publish onto
+        # the Python plane. Now it becomes a kSubDurable entry: the C++
+        # host appends matching publishes to a host-side message store
+        # (native/src/store.h, mmap segments + CRC framing) below the
+        # GIL and ships ONE batched kind-10 record per flush; this
+        # server reconciles markers (live delivery to the connected
+        # session + consumption) and clean_start=false resume replays
+        # the pending set through the native delivery machinery.
+        # Requires the app's PersistentSessions service (the marker/
+        # resume authority); EMQX_DURABLE_STORE=0 is the escape hatch
+        # back to punt-everything.
+        self._durable_store = None
+        self._durable_tokens: dict[str, int] = {}      # sid -> token
+        self._durable_sids: dict[int, str] = {}        # token -> sid
+        # sid -> filters with a live C++ durable entry (session discard
+        # must tear them down, or a dead token keeps accumulating
+        # never-consumed markers forever)
+        self._durable_filters: dict[str, set] = {}
+        # tokens whose session was discarded: durable_del is an async op
+        # (applied at the next ApplyPending), so a publish matched in
+        # that window still appends a marker AFTER discard's consume
+        # sweep — _on_durable consumes those orphans on sight instead of
+        # letting them pin segments forever / replay post-wipe
+        self._durable_dead: set[int] = set()
+        # sid -> highest guid a resume drain replayed: when a CONNECT
+        # and the publish it raced land in the SAME poll batch, the
+        # drain (CONNECT handling) replays the message before the
+        # queued kind-10 event is folded — _on_durable must not deliver
+        # those guids a second time
+        self._durable_drain_mark: dict[str, int] = {}
+        self._store_degraded_seen = 0
+        conf = getattr(app, "config", None) if app is not None else None
+        if durable is None:
+            durable = os.environ.get("EMQX_DURABLE_STORE", "1") != "0"
+        if (durable and self.fast_path and app is not None
+                and app.persistent is not None):
+            conf_on = conf is not None and conf.get("durable.enable")
+            if durable_dir is None and conf_on:
+                # <base>/store for the native message log, next to the
+                # Python session store at <base>/sessions (app.py)
+                base = (conf.get("durable.store_dir")
+                        or os.path.join(conf.get("node.data_dir", "data"),
+                                        "durable"))
+                durable_dir = os.path.join(base, "store")
+            if durable_fsync is None:
+                durable_fsync = (conf.get("durable.fsync") if conf_on
+                                 else "batch")
+            if durable_segment_bytes is None:
+                durable_segment_bytes = (
+                    int(conf.get("durable.segment_bytes")) if conf_on
+                    else 4 << 20)
+            try:
+                # dir "" = anonymous segments: the durable PLANE (fast
+                # path preserved + live kind-10 delivery + in-process
+                # replay) without restart survival
+                self._durable_store = native.NativeStore(
+                    durable_dir or "", durable_segment_bytes or 4 << 20,
+                    durable_fsync or "batch")
+                self.host.attach_store(self._durable_store)
+                app.persistent.native_drain = self._durable_drain
+                app.persistent.native_discard = self._durable_discard
+            except OSError as e:  # pragma: no cover — unwritable dir
+                log.warning("durable store unavailable (%s); persistent "
+                            "sessions stay on the punt path", e)
+                self._durable_store = None
         self.conns: dict[int, _NativeConn] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -316,6 +387,23 @@ class NativeBrokerServer:
         # (resumed persistent sessions, other transports on the same app)
         for (sid, topic), opts in list(self.broker.suboption.items()):
             self._on_sub_event("add", sid, topic, opts)
+        # restart gap (review finding): sessions recovered from the
+        # persistent store have NO broker-table subs until they resume,
+        # so the loop above cannot install their entries — a fast
+        # publish in that window would bypass BOTH stores and be
+        # acked-but-lost. Install durable entries for every stored
+        # session's plain filters at boot; the resume's re-fired sub
+        # events upsert them idempotently.
+        if self._durable_store is not None:
+            for sid, rec in self.app.persistent.store.all_sessions():
+                for filt, od in (rec.get("subs") or {}).items():
+                    grp, real = T.parse_share(filt)
+                    if grp is None:
+                        tok = self._durable_token(sid)
+                        self.host.durable_add(
+                            tok, real, int((od or {}).get("qos", 0) or 0))
+                        self._durable_filters.setdefault(
+                            sid, set()).add(real)
         # ...and pre-existing remote routes (a node joining a live
         # cluster replays the route snapshot before listeners start)
         for topic, dest in self.broker.router.dump():
@@ -618,11 +706,28 @@ class NativeBrokerServer:
                 if self._punt_refs[key] == 1:
                     self._token_refs[sid] = self._token_refs.get(sid, 0) + 1
                     self.host.sub_add(owner, real, 0, native.SUB_PUNT)
+        elif kind == "durable":
+            # idempotent in C++ (SubTable Upsert keys on owner+filter),
+            # so resume re-fires need no refcounting
+            self.host.durable_add(owner, real, qos)
+            dsid = self._durable_sids.get(owner)
+            if dsid is not None:
+                self._durable_filters.setdefault(dsid, set()).add(real)
         else:
             self.host.sub_add(owner, real, qos, flags)
 
     def _del_entry(self, sid: str, owner: int, real: str,
                    kind: str) -> None:
+        if kind == "durable":
+            self.host.durable_del(owner, real)
+            dsid = self._durable_sids.get(owner)
+            if dsid is not None:
+                filters = self._durable_filters.get(dsid)
+                if filters is not None:
+                    filters.discard(real)
+                    if not filters:
+                        del self._durable_filters[dsid]
+            return
         if kind == "punt":
             with self._mirror_lock:
                 key = (owner, real)
@@ -988,9 +1093,19 @@ class NativeBrokerServer:
                 owner, kind = conn_id, "real"
                 qos = getattr(opts, "qos", 0)
                 flags = native.SUB_NO_LOCAL if getattr(opts, "nl", 0) else 0
+            elif self._durable_ok(sid):
+                # persistent session with the durable plane up: a
+                # kSubDurable entry instead of a punt marker — the
+                # publisher and every fast subscriber stay native while
+                # the C++ host persists matching publishes for this
+                # session (kind-10 reconciliation delivers/consumes)
+                owner, kind = self._durable_token(sid), "durable"
+                qos = getattr(opts, "qos", 0)
+                flags = 0
             else:
-                # shared group / persistent session / subscription id /
-                # subscriber living on another transport: punt marker
+                # shared group / non-durable persistent session /
+                # subscription id on a fastless conn / subscriber
+                # living on another transport: punt marker
                 owner, kind = self._token("c:" + sid), "punt"
                 qos = flags = 0
             old = self._mirror.get((sid, topic))
@@ -1014,6 +1129,290 @@ class NativeBrokerServer:
             ent = self._mirror.pop((sid, topic), None)
             if ent is not None:
                 self._del_entry("c:" + sid, ent[0], ent[1], ent[2])
+
+    # -- durable-session plane (round 10) -----------------------------------
+
+    # Native store guids live far above Python message-id space so the
+    # takeover dedup ({m.id for m in pending}) can never false-match a
+    # Python-plane message against a store replay.
+    DURABLE_GUID_BASE = 1 << 60
+
+    def _durable_ok(self, sid: str) -> bool:
+        return (self._durable_store is not None
+                and self.app is not None
+                and self.app.persistent is not None
+                and self.app.persistent.is_persistent(sid))
+
+    def _durable_token(self, sid: str) -> int:
+        """sid -> store token (stable across restarts: the store
+        journals REGISTER records and recovery replays them)."""
+        with self._mirror_lock:
+            tok = self._durable_tokens.get(sid)
+            if tok is None:
+                tok = self._durable_store.register(sid)
+                self._durable_tokens[sid] = tok
+                self._durable_sids[tok] = sid
+            # the store reuses a sid's journaled token across discard/
+            # re-register, so a fresh persistent life revives it
+            self._durable_dead.discard(tok)
+            return tok
+
+    def _durable_consume(self, sid: str, guids: list) -> None:
+        if self._durable_store is None:
+            return
+        tok = self._durable_tokens.get(sid)
+        if tok is not None:
+            self._durable_store.consume(tok, guids)
+
+    def _on_durable(self, payload: bytes) -> None:
+        """Fold ONE batched kind-10 durable record: per entry, deliver
+        to each target persistent session's channel (live on ANY local
+        transport — the cm holds disconnected channels too, whose
+        session mqueue buffers) and consume the store marker when it
+        reached a CONNECTED session, mirroring cm.dispatch's
+        mark_delivered discipline. No channel at all (restart recovery
+        state) leaves the marker for the resume replay."""
+        from emqx_tpu.core.message import Message
+
+        base, ts, entries = native.parse_durable(payload)
+        pers = self.app.persistent if self.app is not None else None
+        metrics = self.broker.metrics
+        begin = now_ms()
+        # consumes BATCH per record: each store.consume call journals a
+        # record and pays the policy fsync — per-entry calls turned a
+        # 120k-msg blast into 120k msyncs on the poll thread (measured:
+        # the plane wedged for >30s draining them)
+        consumed: dict[str, list] = {}
+        dead: dict[int, list] = {}
+        for i, (origin, flags, toks, topic, body) in enumerate(entries):
+            guid = base + i
+            sids, seen = [], set()
+            for tok in toks:
+                if tok in self._durable_dead:
+                    # discard raced the async durable_del: the entry was
+                    # still installed when this batch flushed, but the
+                    # session is gone — spend the orphan marker now
+                    dead.setdefault(tok, []).append(guid)
+                    continue
+                sid = self._durable_sids.get(tok)
+                if sid is not None and sid not in seen:
+                    seen.add(sid)
+                    sids.append(sid)
+            if not sids:
+                continue
+            metrics.inc("messages.durable.stored", len(sids))
+            # resolve live channels BEFORE building the Message / trie
+            # match: the common durable workload is a DISCONNECTED
+            # persistent subscriber, and a 100k msg/s blast must not pay
+            # a Python payload copy + trie match per entry on the poll
+            # thread just to hit the marker-stays continue
+            live = []
+            for sid in sids:
+                if guid <= self._durable_drain_mark.get(sid, 0):
+                    # a resume drain in this same event window already
+                    # fetched+consumed this guid and replayed it through
+                    # the session — delivering again would duplicate
+                    # (guids are monotonic and the drain fetches the
+                    # whole pending set, so the watermark is exact)
+                    continue
+                ch = self.cm.lookup_channel(sid)
+                if ch is None or ch.session is None:
+                    continue       # marker stays: restart-resume replays
+                live.append((sid, ch))
+            if not live:
+                continue
+            info = self._conninfo_for(origin)
+            msg = Message(
+                topic=topic, payload=body, qos=(flags >> 1) & 3,
+                from_=info[0] if info else "$durable",
+                id=self.DURABLE_GUID_BASE + guid,
+                flags={"retain": False, "dup": bool(flags & 8)},
+                headers={"properties": {}, "protocol": "mqtt"},
+                timestamp=ts,
+            )
+            # one trie match per entry, not per target sid — the dict is
+            # already keyed by sid
+            matches = (pers.router.match_filters(topic)
+                       if pers is not None else {})
+            for sid, ch in live:
+                filt = matches.get(sid, topic)
+                msg.extra["deliver_begin_at"] = begin
+                ch.send(ch.handle_deliver([(filt, msg)]))
+                if ch.conn_state == "connected":
+                    # reached a live connection: the replay marker is
+                    # spent (disconnected sessions keep theirs — their
+                    # mqueue copy dedups against the store replay by id)
+                    consumed.setdefault(sid, []).append(guid)
+        for sid, guids in consumed.items():
+            self._durable_consume(sid, guids)
+        for tok, guids in dead.items():
+            self._durable_store.consume(tok, guids)
+
+    def _durable_drain(self, sid: str) -> list:
+        """PersistentSessions.native_drain seam: fetch + consume the
+        native store's pending set for a resuming session. On the
+        native server this runs on the poll thread (CONNECT handling),
+        so the replay rides the native delivery machinery — the
+        session.deliver packets go straight out through host.send —
+        and the drain cost lands on the replay_drain telemetry stage."""
+        from emqx_tpu.core.message import Message
+
+        store = self._durable_store
+        if store is None:
+            return []
+        t0 = time.perf_counter_ns()
+        # lookup, never register: a resuming session that never had a
+        # durable entry must not mint-and-journal a token per resume
+        tok = self._durable_tokens.get(sid) or store.lookup(sid)
+        if not tok:
+            return []
+        rows = store.fetch(tok)
+        pers = self.app.persistent
+        out, guids = [], []
+        for guid, origin, ts, qos, dup, topic, body in rows:
+            guids.append(guid)
+            # the sub_topic header names the MATCHED FILTER: without it
+            # a wildcard subscription's replay would miss the session's
+            # SubOpts lookup and be dropped as 'late delivery' AFTER
+            # its markers were consumed (review finding) — the same
+            # contract the Python store replay keeps in persistent.py
+            filt = pers.router.match_filters(topic).get(sid, topic)
+            out.append(Message(
+                topic=topic, payload=body, qos=qos, from_="$durable",
+                id=self.DURABLE_GUID_BASE + guid,
+                flags={"retain": False, "dup": dup},
+                headers={"properties": {}, "protocol": "mqtt",
+                         "sub_topic": filt},
+                timestamp=ts,
+            ))
+        if guids:
+            # watermark BEFORE consuming: _on_durable skips delivery of
+            # drained guids, and marking first keeps the skip engaged
+            # even if a kind-10 fold interleaves with the consume
+            self._durable_drain_mark[sid] = max(
+                self._durable_drain_mark.get(sid, 0), max(guids))
+            store.consume(tok, guids)
+            self.broker.metrics.inc("messages.durable.replayed",
+                                    len(guids))
+        # poll-thread-only stamp; a drain driven from another server's
+        # thread (asyncio resume sharing this app) is refused with -2
+        self.host.note_stage("replay_drain", time.perf_counter_ns() - t0)
+        return out
+
+    def _durable_discard(self, sid: str) -> None:
+        """PersistentSessions.native_discard seam (clean-start wipe /
+        session expiry): drop the session's native markers."""
+        store = self._durable_store
+        if store is None:
+            return
+        # lookup, never register: clean-start wipes of sessions that
+        # never had durable state must not journal REGISTER records
+        # (with session churn that grows the token map without bound)
+        tok = self._durable_tokens.get(sid) or store.lookup(sid)
+        if not tok:
+            return
+        # tear down the session's live durable entries too: a dead
+        # token left matching would accumulate never-consumed markers
+        # (and store segments) forever. durable_del applies at the NEXT
+        # ApplyPending, so mark the token dead FIRST — a batch flushed
+        # in the gap reaches _on_durable, which consumes the orphans
+        self._durable_dead.add(tok)
+        for filt in self._durable_filters.pop(sid, ()):
+            self.host.durable_del(tok, filt)
+        guids = [row[0] for row in store.fetch(tok)]
+        if guids:
+            store.consume(tok, guids)
+
+    # -- live plane handoff (round 10) --------------------------------------
+
+    def _on_handoff(self, conn_id: int, payload: bytes) -> None:
+        """Drain one kind-11 demotion record: the C++ AckState becomes
+        Python session state. Awaiting-rel ids adopt into the session's
+        qos2 dedup set (a DUP retransmit straddling the demotion now
+        answers PUBREC without re-delivering), unacked native
+        deliveries adopt as window entries the client's acks retire,
+        and window-full pending frames re-enqueue into the mqueue —
+        which also makes them resume-replayable (take_pending), the
+        retransmit-on-reconnect story the ROADMAP tracked."""
+        conn = self.conns.get(conn_id)
+        if conn is None:
+            return      # demotion raced the close; teardown owns cleanup
+        ho = native.parse_handoff(payload)
+        ch = conn.channel
+        sess = getattr(ch, "session", None)
+        if conn.fast:
+            self._demote_python_side(conn)
+        if sess is None:
+            return
+        if conn.recv_budget:
+            # the whole receive-maximum budget returns to the session
+            sess.inflight.max_size = conn.recv_budget
+            conn.native_cap = 0
+        pending = []
+        if ho["pending"]:
+            from emqx_tpu.core.message import Message
+
+            for frame in ho["pending"]:
+                try:
+                    pkt = parse_one(frame, ch.conninfo.proto_ver)
+                except Exception:  # noqa: BLE001 — defensive
+                    continue
+                filt = self._match_sub(sess, pkt.topic)
+                if filt is None:
+                    continue
+                pending.append((filt, Message(
+                    topic=pkt.topic, payload=pkt.payload, qos=pkt.qos,
+                    from_="$native",
+                    flags={"retain": False, "dup": False},
+                    headers={"properties": {}, "protocol": "mqtt"})))
+        pkts = sess.adopt_native_window(
+            ho["awaiting"], ho["inflight"], pending)
+        if pkts:
+            conn._send_packets(pkts)
+
+    @staticmethod
+    def _match_sub(sess, topic: str):
+        if topic in sess.subscriptions:
+            return topic
+        for filt in sess.subscriptions:
+            if T.match(topic, filt):
+                return filt
+        return None
+
+    def _demote_python_side(self, conn: _NativeConn) -> None:
+        """Python-side inverse of _maybe_enable_fast, driven by the
+        kind-11 record so a bare host.disable_fast also reconciles:
+        permits/grants drop, the clientid leaves the fast map, and the
+        client's REAL entries re-mirror as punt/durable shapes so
+        post-demotion deliveries run on the plane that owns the window."""
+        ch = conn.channel
+        cid = ch.clientid
+        conn.fast = False
+        with self._permit_lock:
+            self._granted.pop(conn.conn_id, None)
+        if self._fast_conn_of.get(cid) == conn.conn_id:
+            del self._fast_conn_of[cid]
+        for (sid, topic), (owner, real, kind) in list(self._mirror.items()):
+            if sid == cid and kind == "real":
+                opts = self.broker.suboption.get((sid, topic))
+                if opts is not None:
+                    self._on_sub_event("add", sid, topic, opts)
+        self._reconcile_sid_groups(cid)
+
+    def promote(self, clientid: str) -> bool:
+        """Re-enable the fast plane for a live clean-session conn after
+        a demotion — the symmetric half of the kind-11 handoff. Nothing
+        moves back into C++: every exchange the Python session holds
+        stays Python-owned by construction (low pids route to it, and
+        a PUBREL/DUP for an id the native awaiting-rel set doesn't own
+        forwards), so promotion is a budget re-split plus fresh native
+        state. Returns True when the conn re-qualified."""
+        for conn in list(self.conns.values()):
+            if (conn.channel.clientid == clientid and not conn.fast
+                    and conn.channel.conn_state == "connected"):
+                self._maybe_enable_fast(conn)
+                return conn.fast
+        return False
 
     def _maybe_enable_fast(self, conn: _NativeConn) -> None:
         """Post-CONNACK: clean sessions with no expiry get the fast
@@ -1188,6 +1587,10 @@ class NativeBrokerServer:
                 self._on_telemetry(payload)
             elif kind == native.EV_TRUNK:
                 self._on_trunk_event(conn_id, payload)
+            elif kind == native.EV_DURABLE:
+                self._on_durable(payload)
+            elif kind == native.EV_HANDOFF:
+                self._on_handoff(conn_id, payload)
             elif kind == native.EV_CLOSED:
                 with self._trace_lock:
                     self._traced_conns.discard(conn_id)
@@ -1575,6 +1978,21 @@ class NativeBrokerServer:
                 self._tick_running.clear()
         self._merge_fast_metrics()
         self._lane_auto()
+        if self._durable_store is not None:
+            # unlink all-consumed store segments / compact thin tails
+            self._durable_store.gc()
+            degraded = self._durable_store.stats()["degraded"]
+            if degraded > self._store_degraded_seen:
+                # mid-run segment-open/mmap failure (disk full?): the
+                # store fell back to anonymous segments — qos1 PUBACKs
+                # keep flowing but restart survival is GONE for the
+                # degraded stretch; say so loudly, once per incident
+                self._store_degraded_seen = degraded
+                log.error(
+                    "durable store degraded to in-memory segments "
+                    "(%d incidents): acked messages in this stretch "
+                    "will NOT survive a restart — check disk space at "
+                    "%r", degraded, self._durable_store.dir)
         if self.app is not None and self.telemetry:
             # follow a live slow_subs.threshold change (config update)
             # down to the C++ slow-ack report floor
@@ -1743,9 +2161,19 @@ class NativeBrokerServer:
         for conn in list(self.conns.values()):
             conn.channel.terminate("server_shutdown")
         self.conns.clear()
+        if (self.app is not None and self.app.persistent is not None
+                and self.app.persistent.native_drain
+                == self._durable_drain):
+            self.app.persistent.native_drain = None
+            self.app.persistent.native_discard = None
         if poll_dead:
             self._tick_pool.shutdown(wait=False)
             self.host.destroy()
+            if self._durable_store is not None:
+                # the host borrowed the store pointer; with the host
+                # destroyed (poll thread provably done) it can close
+                self._durable_store.close()
+                self._durable_store = None
         else:  # pragma: no cover — pathological wedge
             # STICKY: the wedged poll thread may still be inside
             # emqx_host_poll — nothing may ever free this host (not a

@@ -260,6 +260,28 @@ def root_schema() -> Struct:
             "enable": Field("bool", default=True),
             "max_delayed_messages": Field("int", default=0),
         }),
+        # durable-session plane (round 10): the host-side message store
+        # the C++ data plane appends to below the GIL (store.h) plus the
+        # PersistentSessions service backing resume. enable=false keeps
+        # CONFIG-BUILT apps persistence-less (persistent sessions punt,
+        # the pre-round-10 shape); an app constructed with an explicit
+        # persistent_store gets the native plane by default regardless —
+        # EMQX_DURABLE_STORE=0 is the runtime escape hatch for both.
+        "durable": Struct({
+            "enable": Field("bool", default=False),
+            # "" → <node.data_dir>/durable/store for the native message
+            # log (+ /durable/sessions for the Python session store)
+            "store_dir": Field("string", default=""),
+            "segment_bytes": Field("bytesize", default=4 * 1024 * 1024),
+            # never = page cache only; batch = msync per flushed batch
+            # (PUBACK-after-store gives real qos1 durability);
+            # interval = ~100ms cadence
+            "fsync": Field("enum", enum=["never", "batch", "interval"],
+                           default="batch"),
+            # global cap on stored-session retention; 0 = each
+            # session's own Session-Expiry-Interval governs
+            "session_expiry": Field("duration", default=0.0),
+        }),
         "router": Struct({
             # the TPU device router on the serving path: subscriptions
             # compile into the HBM trie + subscriber bitmaps; publishes

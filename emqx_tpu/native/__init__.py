@@ -155,6 +155,49 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_set_inflight_cap.restype = ctypes.c_int
     lib.emqx_host_set_inflight_cap.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.emqx_store_open.restype = ctypes.c_void_p
+    lib.emqx_store_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+    lib.emqx_store_close.restype = None
+    lib.emqx_store_close.argtypes = [ctypes.c_void_p]
+    lib.emqx_store_register.restype = ctypes.c_uint64
+    lib.emqx_store_register.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.emqx_store_lookup.restype = ctypes.c_uint64
+    lib.emqx_store_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.emqx_store_append.restype = ctypes.c_uint64
+    lib.emqx_store_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint16,
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+        ctypes.c_uint32]
+    lib.emqx_store_consume.restype = ctypes.c_long
+    lib.emqx_store_consume.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32]
+    lib.emqx_store_fetch.restype = ctypes.c_long
+    lib.emqx_store_fetch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_store_pending.restype = ctypes.c_long
+    lib.emqx_store_pending.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_store_gc.restype = ctypes.c_long
+    lib.emqx_store_gc.argtypes = [ctypes.c_void_p]
+    lib.emqx_store_sync.restype = ctypes.c_int
+    lib.emqx_store_sync.argtypes = [ctypes.c_void_p]
+    lib.emqx_store_stat.restype = ctypes.c_long
+    lib.emqx_store_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_attach_store.restype = ctypes.c_int
+    lib.emqx_host_attach_store.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.emqx_host_durable_add.restype = ctypes.c_int
+    lib.emqx_host_durable_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint8]
+    lib.emqx_host_durable_del.restype = ctypes.c_int
+    lib.emqx_host_durable_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.emqx_host_note_stage.restype = ctypes.c_int
+    lib.emqx_host_note_stage.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64]
     lib.emqx_subtable_match_filter.restype = ctypes.c_long
     lib.emqx_subtable_match_filter.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
@@ -291,6 +334,86 @@ class NativeFramer:
 EV_OPEN, EV_FRAME, EV_CLOSED, EV_LANE, EV_TAP, EV_ACKS = 1, 2, 3, 4, 6, 7
 EV_TELEMETRY = 8
 EV_TRUNK = 9
+EV_DURABLE = 10     # batched durable-store record (round 10)
+EV_HANDOFF = 11     # live plane demotion: AckState -> Python session
+
+
+def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
+    """Decode one kind-10 durable record into ``(base_guid, ts_ms,
+    [(origin_conn, flags, [tokens], topic, payload), ...])`` — entry i's
+    guid is ``base_guid + i``; flags bits1-2 = qos, bit3 = publisher
+    DUP (bit0 = payload-inline is resolved here)."""
+    base = int.from_bytes(payload[0:8], "little")
+    ts = int.from_bytes(payload[8:16], "little")
+    n = int.from_bytes(payload[16:20], "little")
+    out: list[tuple] = []
+    pos, blen = 20, len(payload)
+    body = b""
+    for _ in range(n):
+        if pos + 11 > blen:
+            break
+        origin = int.from_bytes(payload[pos:pos + 8], "little")
+        flags = payload[pos + 8]
+        ntok = int.from_bytes(payload[pos + 9:pos + 11], "little")
+        pos += 11
+        if pos + 8 * ntok + 2 > blen:
+            break
+        toks = [int.from_bytes(payload[pos + 8 * i:pos + 8 * i + 8],
+                               "little") for i in range(ntok)]
+        pos += 8 * ntok
+        tlen = int.from_bytes(payload[pos:pos + 2], "little")
+        pos += 2
+        topic = payload[pos:pos + tlen].decode("utf-8", "replace")
+        pos += tlen
+        if flags & 1:
+            if pos + 4 > blen:
+                break
+            plen = int.from_bytes(payload[pos:pos + 4], "little")
+            pos += 4
+            body = payload[pos:pos + plen]
+            pos += plen
+        out.append((origin, flags, toks, topic, body))
+    return base, ts, out
+
+
+def parse_handoff(payload: bytes) -> dict:
+    """Decode one kind-11 demotion-handoff record:
+
+    - sub 1 → ``{"awaiting": [pid...], "inflight": [(pid, qos, phase)]}``
+      (phase "publish" | "pubrel")
+    - sub 2 → ``{"pending": [frame bytes, ...]}``
+
+    Chunks are additive: callers merge the fields across records."""
+    out: dict = {"awaiting": [], "inflight": [], "pending": []}
+    if not payload:
+        return out
+    sub = payload[0]
+    pos = 1
+    if sub == 1:
+        n_aw = int.from_bytes(payload[pos:pos + 4], "little")
+        pos += 4
+        for _ in range(n_aw):
+            out["awaiting"].append(
+                int.from_bytes(payload[pos:pos + 2], "little"))
+            pos += 2
+        n_if = int.from_bytes(payload[pos:pos + 4], "little")
+        pos += 4
+        for _ in range(n_if):
+            pid = int.from_bytes(payload[pos:pos + 2], "little")
+            st = payload[pos + 2]
+            pos += 3
+            out["inflight"].append(
+                (pid, 2 if st & 1 else 1,
+                 "pubrel" if st & 2 else "publish"))
+    elif sub == 2:
+        n = int.from_bytes(payload[pos:pos + 4], "little")
+        pos += 4
+        for _ in range(n):
+            fl = int.from_bytes(payload[pos:pos + 4], "little")
+            pos += 4
+            out["pending"].append(payload[pos:pos + fl])
+            pos += fl
+    return out
 
 # kind-9 trunk event sub-kinds (payload[0])
 TRUNK_UP, TRUNK_DOWN, TRUNK_PUNT = 1, 2, 3
@@ -327,7 +450,12 @@ HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
                # trunk stages (round 9): trunk_rtt = batch flush →
                # peer ack; trunk_batch_n records ENTRIES per flushed
                # batch (occupancy — a count, not nanoseconds)
-               "trunk_rtt", "trunk_batch_n")
+               "trunk_rtt", "trunk_batch_n",
+               # durable plane (round 10): store_append = per-batch
+               # store write (+policy fsync); replay_drain = resume
+               # replay fetch+consume+decode (noted by Python via
+               # emqx_host_note_stage on the poll thread)
+               "store_append", "replay_drain")
 
 # flight-recorder event codes (host.cc FrEvent)
 FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
@@ -543,10 +671,118 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "punts_trace", "fr_dumps", "telemetry_batches",
               "trunk_out", "trunk_in", "trunk_batches_out",
               "trunk_batches_in", "trunk_punts", "trunk_replays",
-              "trunk_shed")
+              "trunk_shed",
+              "durable_in", "durable_batches", "store_appends",
+              "handoffs")
+
+# durable-store stat slots (store.h StoreStat order)
+STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
+                    "segments", "gc_segments", "rewrites", "torn_drops",
+                    "bytes", "degraded")
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP, SUB_REMOTE = 1, 2, 4, 8
+SUB_DURABLE = 16
+
+
+FSYNC_POLICY = {"never": 0, "batch": 1, "interval": 2}
+
+
+class NativeStore:
+    """ctypes wrapper over the durable-session message store (store.h):
+    a segmented mmap-backed append-only log with CRC32-framed records.
+    The data plane appends through an attached ``NativeHost`` below the
+    GIL; this wrapper is the Python control surface (register sessions,
+    resume fetch, marker consumption, GC) and the test surface."""
+
+    def __init__(self, dir_: str = "", segment_bytes: int = 4 << 20,
+                 fsync: str = "batch"):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        policy = FSYNC_POLICY.get(fsync, 1)
+        self._h = self._lib.emqx_store_open(
+            dir_.encode(), segment_bytes, policy)
+        if not self._h:
+            raise OSError(f"cannot open durable store at {dir_!r}")
+        self.dir = dir_
+
+    def register(self, sid: str) -> int:
+        """sid -> stable token (markers key on it; survives restart)."""
+        return int(self._lib.emqx_store_register(self._h, sid.encode()))
+
+    def lookup(self, sid: str) -> int:
+        """sid -> token without creating one; 0 = never registered."""
+        return int(self._lib.emqx_store_lookup(self._h, sid.encode()))
+
+    def append(self, origin: int, qos: int, tokens: list[int],
+               topic: str, payload: bytes, dup: bool = False) -> int:
+        """Single-message append (test surface); returns the guid."""
+        toks = (ctypes.c_uint64 * max(1, len(tokens)))(*tokens)
+        t = topic.encode()
+        flags = (qos << 1) | (8 if dup else 0)
+        return int(self._lib.emqx_store_append(
+            self._h, origin, flags, toks, len(tokens),
+            t, len(t), payload, len(payload)))
+
+    def consume(self, token: int, guids: list[int]) -> int:
+        if not guids:
+            return 0
+        arr = (ctypes.c_uint64 * len(guids))(*guids)
+        return int(self._lib.emqx_store_consume(
+            self._h, token, arr, len(guids)))
+
+    def fetch(self, token: int) -> list[tuple]:
+        """Pending messages for ``token`` in guid (arrival) order:
+        ``[(guid, origin, ts_ms, qos, dup, topic, payload), ...]``."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        n = self._lib.emqx_store_fetch(self._h, token,
+                                       ctypes.byref(out),
+                                       ctypes.byref(out_len))
+        raw = ctypes.string_at(out, out_len.value)
+        self._lib.emqx_buf_free(out)
+        entries, pos = [], 0
+        for _ in range(n):
+            guid = int.from_bytes(raw[pos:pos + 8], "little")
+            origin = int.from_bytes(raw[pos + 8:pos + 16], "little")
+            ts = int.from_bytes(raw[pos + 16:pos + 24], "little")
+            flags = raw[pos + 24]
+            tlen = int.from_bytes(raw[pos + 25:pos + 27], "little")
+            pos += 27
+            topic = raw[pos:pos + tlen].decode("utf-8", "replace")
+            pos += tlen
+            plen = int.from_bytes(raw[pos:pos + 4], "little")
+            pos += 4
+            body = raw[pos:pos + plen]
+            pos += plen
+            entries.append((guid, origin, ts, (flags >> 1) & 3,
+                            bool(flags & 8), topic, body))
+        return entries
+
+    def pending(self, token: int) -> int:
+        return int(self._lib.emqx_store_pending(self._h, token))
+
+    def gc(self) -> int:
+        return int(self._lib.emqx_store_gc(self._h))
+
+    def sync(self) -> None:
+        self._lib.emqx_store_sync(self._h)
+
+    def stats(self) -> dict[str, int]:
+        return {name: int(self._lib.emqx_store_stat(self._h, i))
+                for i, name in enumerate(STORE_STAT_NAMES)}
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.emqx_store_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class NativeHost:
@@ -568,8 +804,12 @@ class NativeHost:
         # The poll buffer must hold at least one whole event record: 13-byte
         # header + payload up to max_size (a max-size PUBLISH frame).  A
         # smaller buffer would leave host.cc unable to ever deliver that
-        # record, busy-spinning the poll thread forever.
-        self._buf = ctypes.create_string_buffer(max_size + 64)
+        # record, busy-spinning the poll thread forever. The 65600-byte
+        # margin covers the largest single durable entry on top of a
+        # max-size publish (host.cc kDurMaxToksPerEntry * 8 + headers) —
+        # a kind-10 record larger than this buffer would be dropped
+        # whole, silently skipping live persistent-session delivery.
+        self._buf = ctypes.create_string_buffer(max_size + 65600)
 
     def poll(self, timeout_ms: int = 100) -> Iterator[tuple[int, int, bytes]]:
         """Yield ``(kind, conn_id, payload)`` events from one loop step."""
@@ -705,6 +945,35 @@ class NativeHost:
         in milliseconds (sampled ack RTTs past it feed slow_subs)."""
         self._lib.emqx_host_set_telemetry(
             self._h, 1 if enabled else 0, int(slow_ack_ms * 1_000_000))
+
+    # -- durable-session plane (round 10) ----------------------------------
+
+    def attach_store(self, store: "NativeStore") -> None:
+        """Attach the durable store (BEFORE the poll thread starts).
+        The host borrows the handle: destroy the host first, then close
+        the store."""
+        self._lib.emqx_host_attach_store(self._h, store._h)
+
+    def durable_add(self, token: int, filter_: str, qos: int = 0) -> None:
+        """Install a durable entry (the fourth match-table entry kind):
+        publishes matching ``filter_`` persist below the GIL for the
+        session registered under ``token`` while the fast path — the
+        publisher and every fast subscriber — proceeds unpunted."""
+        self._lib.emqx_host_durable_add(self._h, token,
+                                        filter_.encode(), qos)
+
+    def durable_del(self, token: int, filter_: str) -> None:
+        self._lib.emqx_host_durable_del(self._h, token, filter_.encode())
+
+    def note_stage(self, stage_name: str, ns: int) -> int:
+        """POLL-THREAD ONLY: record one observation into a telemetry
+        stage (the resume replay_drain stamp). Returns 0, or -2 when
+        called off the poll thread (refused, like conn_idle_ms)."""
+        try:
+            idx = HIST_STAGES.index(stage_name)
+        except ValueError:
+            return -1
+        return int(self._lib.emqx_host_note_stage(self._h, idx, int(ns)))
 
     def set_inflight_cap(self, conn: int, cap: int) -> None:
         """Re-divide a conn's receive-maximum budget: set the native

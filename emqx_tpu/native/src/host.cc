@@ -79,6 +79,31 @@
 //                     groups) — Python runs the local dispatch for
 //                     them; entries in the pre-parse layout with
 //                     payloads always inline (conn_id = 0)
+//   kind 10 = DURABLE  payload = one batched durable-store record per
+//                   flush (round 10): [u64 base_guid][u64 ts_ms][u32 n]
+//                   + n x pre-parsed entries ([u64 origin][u8 flags]
+//                   [u16 ntok][u64 token x ntok][u16 tlen][topic] +
+//                   (flags bit0 ? [u32 plen][payload] : payload of the
+//                   PREVIOUS entry)) — the EXACT bytes appended to the
+//                   store (store.h kRecMsgBatch body), so the store
+//                   write and the Python marker-reconciliation event
+//                   are one buffer. Flushed BEFORE any socket write of
+//                   the same read batch: a qos1 publisher's PUBACK is
+//                   only wired after its durable append (+fsync per
+//                   policy) landed.
+//   kind 11 = HANDOFF  live plane demotion (kDisableFast): the conn's
+//                   AckState hands to the Python session instead of
+//                   evaporating. conn_id = conn; payload[0] = sub-kind:
+//                   [u8 1] window state: [u32 n_aw] + n x u16 pid
+//                     (publisher awaiting-rel ids we owned) +
+//                     [u32 n_if] + n x ([u16 pid][u8 state]) state
+//                     bit0 = qos2, bit1 = rel phase (PUBREL sent,
+//                     awaiting PUBCOMP); chunked at the tap bound,
+//                     fields additive across chunks
+//                   [u8 2] pending frames (the window-full mqueue):
+//                     [u32 n] + n x ([u32 len][serialized PUBLISH,
+//                     pid bytes zero]) — Python re-enqueues them into
+//                     the session mqueue (retransmit-on-reconnect)
 //   kind 8 = TELEMETRY  payload = concatenated sub-records, chunked at
 //                   the tap bound like kinds 6/7:
 //                   [u8 1] histogram delta: [u8 stage][u64 count_d]
@@ -116,6 +141,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -129,6 +155,7 @@
 
 #include "frame.h"
 #include "router.h"
+#include "store.h"
 #include "trunk.h"
 #include "ws.h"
 
@@ -183,6 +210,10 @@ enum HistStage {
   kHistTrunkBatchN,       // trunk batch occupancy: ENTRIES per flushed
                           // batch (a count, not ns — the one stage whose
                           // axis is not time; bench prints it raw)
+  kHistStoreAppend,       // durable store: batch append (+policy fsync)
+  kHistReplayDrain,       // resume replay: store fetch+consume+decode
+                          // (stamped by Python via emqx_host_note_stage;
+                          // poll-thread-only like conn_idle_ms)
   kHistCount
 };
 
@@ -298,6 +329,12 @@ struct AckState {
   // (emqx_mqueue.erl): serialized PUBLISH (qos header already final)
   // with zeroed pid bytes + the pid offset to patch at dequeue
   std::deque<std::pair<std::string, size_t>> pending;
+  // per-delivery phase bits for the demotion handoff (round 10): a
+  // bare inflight bitmap cannot say qos1-vs-qos2 or publish-vs-rel
+  // phase, and the Python session needs both to adopt the window.
+  // Bit ops only — the round-6 no-hash-churn discipline holds.
+  uint64_t infl_qos2[512] = {};  // bit set = the delivery was qos2
+  uint64_t infl_rel[512] = {};   // bit set = PUBREL sent (await PUBCOMP)
   // per-poll-cycle ack-record accumulators (flushed as ONE kind-7
   // event per cycle — the rule-tap batching discipline applied to the
   // ack plane)
@@ -361,6 +398,12 @@ constexpr size_t kTapFlushBytes = 192 * 1024;
 // Remote-entry owners live far above conn ids AND the Python punt-token
 // space (1 << 48): owner = kTrunkOwnerBase + peer id.
 constexpr uint64_t kTrunkOwnerBase = 1ull << 62;
+// Durable-entry owners (round 10) get their own namespace too: store
+// tokens are small sequential ints EXACTLY like conn ids, and SubTable
+// upserts key on (owner, filter) — an un-namespaced token N would
+// collide with conn N's real entry on the same filter (the real entry
+// would overwrite the durable one, silently un-persisting the session).
+constexpr uint64_t kDurableOwnerBase = 1ull << 61;
 // Trunk sock epoll tags carry this bit (conn ids are sequential small
 // ints; the three listener tags sit at ~0ull and below).
 constexpr uint64_t kTrunkSockBit = 1ull << 63;
@@ -376,7 +419,8 @@ struct Op {
     kSubAdd, kSubDel, kPermit, kEnableFast, kDisableFast, kPermitsFlush,
     kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos,
     kSetInflightCap, kSetTrace, kSetTelemetry,
-    kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel
+    kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
+    kDurableAdd, kDurableDel
   };
   Kind kind;
   uint64_t owner = 0;
@@ -428,6 +472,11 @@ enum StatSlot {
   kStTrunkPunts,       // received trunk entries handed to Python
   kStTrunkReplays,     // qos1 batches replayed after a reconnect
   kStTrunkShed,        // qos0 entries shed under trunk-link backpressure
+  kStDurableIn,        // publishes persisted below the GIL (durable
+                       // audience matched, fast path preserved)
+  kStDurableBatches,   // kind-10 store/event records flushed
+  kStStoreAppends,     // message entries appended to the durable store
+  kStHandoffs,         // demotion handoffs emitted (kind 11)
   kStatCount
 };
 
@@ -606,6 +655,24 @@ class Host {
     return static_cast<long>(stats_[slot].load(std::memory_order_relaxed));
   }
 
+  // Attach the durable-session store (call BEFORE the poll thread
+  // starts, like the listeners — store_ is read lock-free on the hot
+  // path). The host never owns the store; Python manages its lifetime
+  // and must destroy the host first.
+  void AttachStore(store::DurableStore* s) { store_ = s; }
+
+  // Record one observation into a telemetry stage from the POLL THREAD
+  // only (the native server's resume-replay drain runs there); the
+  // wrong-thread refusal mirrors ConnIdleMs.
+  int NoteStage(int stage, uint64_t ns) {
+    pthread_t poller = poll_thread_.load(std::memory_order_acquire);
+    if (poller != pthread_t{} && !pthread_equal(poller, pthread_self()))
+      return -2;
+    if (stage < 0 || stage >= kHistCount) return -1;
+    if (telemetry_) RecordHist(stage, ns);
+    return 0;
+  }
+
   uint64_t LaneBacklog() const {
     return lane_backlog_.load(std::memory_order_relaxed);
   }
@@ -659,6 +726,7 @@ class Host {
       for (int i = 0; i < n; i++) HandleEvent(evs[i]);
       ApplyPending();
       if (!lane_pending_.empty()) LaneStaleScan();
+      FlushDurables();   // catch-all for appends with no dirty socket
       FlushTaps();
       FlushAcks();
       FlushTrunks();
@@ -774,11 +842,20 @@ class Host {
       case Op::kDisableFast: {
         auto it = conns_.find(op.owner);
         if (it != conns_.end()) {
-          it->second.fast = false;
-          it->second.permits.clear();
+          Conn& c = it->second;
+          // live plane demotion (round 10): the AckState HANDS OFF to
+          // the Python session (kind 11) instead of evaporating — a
+          // qos2 retransmit straddling the demotion must dedup against
+          // the awaiting-rel ids we owned, and the window/pending state
+          // is the session's to finish. Emitted before the reset, and
+          // only when there was a fast plane to demote (a second
+          // disable on an already-slow conn is a no-op, not a loop).
+          if (c.fast || c.ack) EmitHandoff(op.owner, c);
+          c.fast = false;
+          c.permits.clear();
           // orphaned native window state would eat acks meant for the
           // Python session once the conn goes slow-only
-          it->second.ack.reset();
+          c.ack.reset();
         }
         break;
       }
@@ -888,6 +965,18 @@ class Host {
       case Op::kTrunkRouteDel:
         subs_.Remove(kTrunkOwnerBase + op.owner, op.str);
         punt_subs_.Remove(kTrunkOwnerBase + op.owner, op.str);
+        break;
+      case Op::kDurableAdd:
+        // the FOURTH entry kind (round 10): a persistent session's
+        // filter, served by the durable plane — NOT mirrored into
+        // punt_subs_ (it must not punt; FanOut persists it, and the
+        // device lane's MatchFilter finds it under the named filter).
+        // owner namespaced: raw store tokens would collide with conn ids
+        subs_.Add(kDurableOwnerBase + op.owner, op.str, op.qos,
+                  kSubDurable);
+        break;
+      case Op::kDurableDel:
+        subs_.Remove(kDurableOwnerBase + op.owner, op.str);
         break;
     }
   }
@@ -1026,12 +1115,28 @@ class Host {
     frame_v5_.clear();
     frame_q_v4_.clear();
     frame_q_v5_.clear();
+    dur_tok_scratch_.clear();
     for (const SubEntry* e : match_scratch_) {
       // rule taps never deliver; remote entries forward via the trunk
-      // (TryFast enqueues them) or punt — never through a local write
+      // (TryFast enqueues them) or punt — never through a local write;
+      // durable entries persist (below) instead of delivering
+      if (e->flags & kSubDurable) {
+        dur_tok_scratch_.push_back(e->owner - kDurableOwnerBase);
+        continue;
+      }
       if (e->flags & (kSubRuleTap | kSubRemote)) continue;
       if ((e->flags & kSubNoLocal) && e->owner == publisher) continue;
       DeliverTo(e->owner, *e, publisher, qos, topic, payload);
+    }
+    if (!dur_tok_scratch_.empty()) {
+      // dedup once, O(S log S): two filters of one session yield one
+      // marker + one replay (a per-entry linear scan was O(S^2) on the
+      // fast path for wide durable audiences)
+      std::sort(dur_tok_scratch_.begin(), dur_tok_scratch_.end());
+      dur_tok_scratch_.erase(
+          std::unique(dur_tok_scratch_.begin(), dur_tok_scratch_.end()),
+          dur_tok_scratch_.end());
+      if (store_) DurableAppend(publisher, qos, topic, payload);
     }
     // natively served $share groups: one member per group, rotating;
     // skipped members (gone / backpressured / window full) get the
@@ -1158,6 +1263,7 @@ class Host {
       stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
       if (le.qos == 1)
         stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
+      cur_dup_ = (static_cast<uint8_t>(le.frame[0]) & 0x08) != 0;
       FanOut(le.publisher, le.qos, le.pid, topic, payload);
     }
     FlushDirty();
@@ -1398,6 +1504,11 @@ class Host {
   // batch — one send() per touched subscriber instead of one per
   // delivered message.
   void FlushDirty() {
+    // durable batch FIRST: the qos1 publisher's PUBACK (and every
+    // fast delivery of this read batch) reaches the wire only after
+    // the matching store append — and its policy fsync — landed, so a
+    // kill -9 can never ack a message the store lost
+    FlushDurables();
     if (dirty_.empty()) {
       flush_t0_ = 0;  // sampled publish had no targets: no flush stage
       return;
@@ -1584,6 +1695,16 @@ class Host {
         stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
         return false;
       }
+      if (e->flags & kSubDurable) {
+        // durable audience: FanOut persists the publish below the GIL
+        // and the fast path proceeds. No attached store means Python
+        // misconfigured the flip — degrade to a punt (always correct).
+        if (!store_) {
+          stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+          return false;
+        }
+        continue;
+      }
       if (e->flags & kSubRuleTap) {
         tapped = true;
         continue;
@@ -1628,6 +1749,7 @@ class Host {
       stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
     }
     if (tapped) EmitTap(id, qos, (h & 0x08) != 0, topic, payload);
+    cur_dup_ = (h & 0x08) != 0;  // durable entries keep the DUP bit
     FanOut(id, qos, pid, topic, payload);
     // remote legs last: the local fan-out above and the trunk enqueue
     // below are the two halves of emqx_broker:publish's route loop
@@ -1664,7 +1786,7 @@ class Host {
                std::string_view topic, std::string_view payload) {
     stats_[kStTaps].fetch_add(1, std::memory_order_relaxed);
     // flush BEFORE an append that would overflow the cap: the Python
-    // poll buffer is max_size_+64, and Poll silently drops any record
+    // poll buffer is max_size_+65600, and Poll silently drops any record
     // larger than the caller's whole buffer — a lost batch would be
     // hundreds of rule messages with no accounting. With this
     // discipline a record never exceeds max(cap, one max-size entry)
@@ -1774,6 +1896,7 @@ class Host {
         return true;   // admitted; kStFastOut counts at dequeue
       }
       uint16_t tp = NextPid(a);
+      if (out_qos == 2) BitSet(a.infl_qos2, tp - kNativePidBase);
       if (telemetry_) {
         // ack-RTT sample (delivery write -> PUBACK/PUBCOMP): stamped
         // only while a slot is free, closed out in TeleAckRtt
@@ -1817,6 +1940,8 @@ class Host {
       auto [frame, pid_off] = std::move(a.pending.front());
       a.pending.pop_front();
       uint16_t np = NextPid(a);
+      if (((static_cast<uint8_t>(frame[0]) >> 1) & 3) == 2)
+        BitSet(a.infl_qos2, np - kNativePidBase);
       frame[pid_off] = static_cast<char>(np >> 8);
       frame[pid_off + 1] = static_cast<char>(np & 0xFF);
       AppendMqtt(c, frame.data(), frame.size());
@@ -1856,6 +1981,10 @@ class Host {
   bool TryFastPubrec(uint64_t id, Conn& c, const std::string& f) {
     uint16_t pid;
     if (!ParsePid(f, &pid) || pid < kNativePidBase) return false;
+    // phase advance for the demotion handoff: PUBREL is on the wire,
+    // the exchange now awaits PUBCOMP
+    if (c.ack && BitTest(c.ack->inflight, pid - kNativePidBase))
+      BitSet(c.ack->infl_rel, pid - kNativePidBase);
     // answer PUBREL even for an already-freed pid (a retransmitted
     // PUBREC must still complete the client's flow); Python can never
     // own a pid in this space, so consuming is always safe
@@ -1915,6 +2044,10 @@ class Host {
       uint32_t i = p - kNativePidBase;
       if (!BitTest(a.inflight, i)) {
         BitSet(a.inflight, i);
+        // fresh slot: stale phase bits from a previous tenant would
+        // corrupt a later demotion handoff
+        BitClr(a.infl_qos2, i);
+        BitClr(a.infl_rel, i);
         a.inflight_cnt++;
         return p;
       }
@@ -1967,6 +2100,195 @@ class Host {
     }
     ack_dirty_.clear();
     emit();
+  }
+
+  // -- durable-session plane (round 10) -----------------------------------
+  // A publish whose match set contains kSubDurable entries is appended
+  // to the per-flush batch here (pre-parsed layout, payload deduped vs
+  // the previous entry — the kind-6 discipline); FlushDurables writes
+  // the batch into the store (store.h) and ships the SAME bytes to
+  // Python as one kind-10 event for marker reconciliation + live
+  // delivery to the connected persistent session.
+
+  // A single entry's record must ALWAYS fit the Python poll buffer
+  // (max_size + 65600 — native/__init__.py), or Poll drops it whole
+  // and connected persistent sessions silently miss the live delivery
+  // while keeping their markers (a ghost replay on next resume). The
+  // worst case is 33 header bytes + 17 entry bytes + 8*ntok + the
+  // frame's topic+payload (< max_size), so capping tokens per entry at
+  // 4096 (32 KB) guarantees the fit; a wider audience splits into
+  // several entries sharing the deduped payload.
+  static constexpr size_t kDurMaxToksPerEntry = 4096;
+
+  void DurableAppend(uint64_t publisher, uint8_t qos,
+                     std::string_view topic, std::string_view payload) {
+    stats_[kStDurableIn].fetch_add(1, std::memory_order_relaxed);
+    for (size_t g = 0; g < dur_tok_scratch_.size();
+         g += kDurMaxToksPerEntry)
+      DurableAppendEntry(
+          publisher, qos, topic, payload, g,
+          std::min(dur_tok_scratch_.size(), g + kDurMaxToksPerEntry));
+  }
+
+  void DurableAppendEntry(uint64_t publisher, uint8_t qos,
+                          std::string_view topic, std::string_view payload,
+                          size_t tok_begin, size_t tok_end) {
+    size_t cap = TeleCap();
+    size_t ntok = tok_end - tok_begin;
+    size_t entry_max = 11 + 8 * ntok + 2 + topic.size() + 4
+                       + payload.size();
+    // 33 = 13-byte event-record header slot + 20-byte batch header
+    // ([base_guid][ts][n]); both patched at flush (EmitTap's
+    // seed-after-flush lesson: never append headerless post-flush)
+    if (dur_buf_.size() > 33 && dur_buf_.size() - 33 + entry_max > cap)
+      FlushDurables();
+    if (dur_buf_.empty()) dur_buf_.assign(33, '\0');
+    bool dup_pl = dur_have_prev_ && payload == dur_prev_payload_;
+    char hdr[11];
+    memcpy(hdr, &publisher, 8);
+    hdr[8] = static_cast<char>((dup_pl ? 0 : 1) | (qos << 1)
+                               | (cur_dup_ ? 8 : 0));
+    uint16_t nt = static_cast<uint16_t>(ntok);
+    memcpy(hdr + 9, &nt, 2);
+    dur_buf_.append(hdr, 11);
+    for (size_t k = tok_begin; k < tok_end; k++) {
+      uint64_t tok = dur_tok_scratch_[k];
+      dur_buf_.append(reinterpret_cast<const char*>(&tok), 8);
+    }
+    uint16_t tl = static_cast<uint16_t>(topic.size());
+    dur_buf_.append(reinterpret_cast<const char*>(&tl), 2);
+    dur_buf_.append(topic.data(), topic.size());
+    if (!dup_pl) {
+      uint32_t pl = static_cast<uint32_t>(payload.size());
+      dur_buf_.append(reinterpret_cast<const char*>(&pl), 4);
+      dur_buf_.append(payload.data(), payload.size());
+      dur_prev_payload_.assign(payload.data(), payload.size());
+      dur_have_prev_ = true;
+    }
+    dur_n_++;
+    if (dur_buf_.size() - 33 > cap) FlushDurables();
+  }
+
+  void FlushDurables() {
+    if (dur_buf_.size() <= 33 || !store_) {
+      dur_buf_.clear();
+      dur_n_ = 0;
+      dur_have_prev_ = false;
+      return;
+    }
+    uint64_t base = store_->AllocGuids(dur_n_);
+    uint64_t ts = store::WallMs();
+    memcpy(&dur_buf_[13], &base, 8);
+    memcpy(&dur_buf_[21], &ts, 8);
+    memcpy(&dur_buf_[29], &dur_n_, 4);
+    uint64_t t0 = telemetry_ ? NowNs() : 0;
+    store_->AppendBatch(dur_buf_.data() + 13, dur_buf_.size() - 13);
+    if (telemetry_) RecordHist(kHistStoreAppend, NowNs() - t0);
+    stats_[kStStoreAppends].fetch_add(dur_n_, std::memory_order_relaxed);
+    stats_[kStDurableBatches].fetch_add(1, std::memory_order_relaxed);
+    dur_buf_[0] = 10;
+    uint64_t id = 0;
+    memcpy(&dur_buf_[1], &id, 8);
+    uint32_t plen = static_cast<uint32_t>(dur_buf_.size() - 13);
+    memcpy(&dur_buf_[9], &plen, 4);
+    events_.push_back(std::move(dur_buf_));
+    dur_buf_.clear();
+    dur_n_ = 0;
+    dur_have_prev_ = false;
+  }
+
+  // Live plane demotion (kDisableFast): serialize the AckState into
+  // kind-11 records the Python session adopts — awaiting-rel ids (the
+  // publisher-side qos2 exactly-once set), the inflight window with
+  // per-delivery qos/phase, and the window-full pending frames.
+  // Chunked at the tap bound; fields are additive across chunks. At
+  // least one sub-1 record always goes out so Python sees the flip.
+  void EmitHandoff(uint64_t id, Conn& c) {
+    stats_[kStHandoffs].fetch_add(1, std::memory_order_relaxed);
+    size_t cap = TeleCap();
+    std::vector<uint16_t> aw, ifp;
+    std::vector<uint8_t> ifs;
+    if (c.ack) {
+      AckState& a = *c.ack;
+      if (a.awaiting_cnt)
+        for (uint32_t w = 0; w < 1024; w++) {
+          uint64_t bits = a.awaiting_rel[w];
+          while (bits) {
+            uint32_t b = static_cast<uint32_t>(__builtin_ctzll(bits));
+            bits &= bits - 1;
+            aw.push_back(static_cast<uint16_t>(w * 64 + b));
+          }
+        }
+      if (a.inflight_cnt)
+        for (uint32_t w = 0; w < 512; w++) {
+          uint64_t bits = a.inflight[w];
+          while (bits) {
+            uint32_t b = static_cast<uint32_t>(__builtin_ctzll(bits));
+            bits &= bits - 1;
+            uint32_t i = w * 64 + b;
+            ifp.push_back(static_cast<uint16_t>(kNativePidBase + i));
+            ifs.push_back(static_cast<uint8_t>(
+                (BitTest(a.infl_qos2, i) ? 1 : 0)
+                | (BitTest(a.infl_rel, i) ? 2 : 0)));
+          }
+        }
+    }
+    size_t ai = 0, ii = 0;
+    bool first = true;
+    while (first || ai < aw.size() || ii < ifp.size()) {
+      first = false;
+      std::string rec;
+      rec.push_back(1);
+      size_t aw_at = rec.size();
+      rec.append(4, '\0');
+      uint32_t na = 0;
+      while (ai < aw.size() && rec.size() + 2 + 4 < cap) {
+        uint16_t pid = aw[ai++];
+        rec.append(reinterpret_cast<const char*>(&pid), 2);
+        na++;
+      }
+      memcpy(&rec[aw_at], &na, 4);
+      size_t if_at = rec.size();
+      rec.append(4, '\0');
+      uint32_t ni = 0;
+      while (ii < ifp.size() && rec.size() + 3 < cap) {
+        uint16_t pid = ifp[ii];
+        rec.append(reinterpret_cast<const char*>(&pid), 2);
+        rec.push_back(static_cast<char>(ifs[ii]));
+        ii++;
+        ni++;
+      }
+      memcpy(&rec[if_at], &ni, 4);
+      events_.push_back(EncodeRecord(11, id, rec.data(), rec.size()));
+    }
+    if (c.ack && !c.ack->pending.empty()) {
+      std::string rec;
+      uint32_t n = 0;
+      auto open = [&]() {
+        rec.clear();
+        rec.push_back(2);
+        rec.append(4, '\0');
+        n = 0;
+      };
+      auto emit = [&]() {
+        if (!n) return;
+        memcpy(&rec[1], &n, 4);
+        events_.push_back(EncodeRecord(11, id, rec.data(), rec.size()));
+      };
+      open();
+      for (auto& [frame, off] : c.ack->pending) {
+        (void)off;
+        if (n && rec.size() + 4 + frame.size() > cap) {
+          emit();
+          open();
+        }
+        uint32_t fl = static_cast<uint32_t>(frame.size());
+        rec.append(reinterpret_cast<const char*>(&fl), 4);
+        rec += frame;
+        n++;
+      }
+      emit();
+    }
   }
 
   // -- cluster trunk (round 9) --------------------------------------------
@@ -2247,6 +2569,7 @@ class Host {
       return;
     }
     if (telemetry_) cur_hash_ = TopicHash(topic);
+    cur_dup_ = dup;
     // publisher id 0 can never collide with a local conn (ids start at
     // 1), so no ack is written and no-local can never false-match a
     // local subscriber that happens to share the REMOTE publisher's id
@@ -2749,6 +3072,17 @@ class Host {
   // after a nondeterministic punt); cleared as their counts drain
   std::unordered_set<std::string> lane_poisoned_;
   std::atomic<uint64_t> lane_backlog_{0};
+  // -- durable-session plane (poll-thread-owned) ---------------------------
+  // The host-side message store (store.h): attached by Python BEFORE
+  // the poll thread starts (like the listeners). Null = durable plane
+  // off; matched kSubDurable entries then degrade to punts.
+  store::DurableStore* store_ = nullptr;
+  std::string dur_buf_;            // bytes [0,33) = event+batch header slot
+  uint32_t dur_n_ = 0;             // entries in dur_buf_
+  std::string dur_prev_payload_;   // payload-dedup reference
+  bool dur_have_prev_ = false;
+  std::vector<uint64_t> dur_tok_scratch_;  // tokens matched by ONE publish
+  bool cur_dup_ = false;           // current publish's DUP bit (FanOut)
   // punt markers mirrored into their own table: the device model only
   // covers broker-table subscriptions, so lane delivery re-checks this
   // (usually tiny) trie per message — remote "n:" routes and any punt
@@ -2997,6 +3331,117 @@ int emqx_host_trunk_route_del(void* h, uint64_t peer, const char* filter) {
   op.owner = peer;
   op.str = filter;
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// --- durable-session plane (round 10) --------------------------------------
+
+// Open (or recover) a durable store. dir "" = anonymous (in-memory)
+// segments; fsync_policy: 0 never, 1 per batch, 2 ~100ms interval.
+// Returns null when the directory cannot be used at all.
+void* emqx_store_open(const char* dir, uint64_t segment_bytes,
+                      int fsync_policy) {
+  auto* s = new emqx_native::store::DurableStore(
+      dir ? dir : "", static_cast<size_t>(segment_bytes), fsync_policy);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void emqx_store_close(void* s) {
+  delete static_cast<emqx_native::store::DurableStore*>(s);
+}
+
+// sid -> stable (restart-surviving) token; markers key on it.
+uint64_t emqx_store_register(void* s, const char* sid) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Register(sid);
+}
+
+// sid -> token without creating one; 0 = never registered.
+uint64_t emqx_store_lookup(void* s, const char* sid) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Lookup(sid);
+}
+
+// Single-message append (test surface / Python-plane callers); the
+// data plane appends whole batches through the attached host instead.
+// Returns the assigned guid (0 on a malformed call).
+uint64_t emqx_store_append(void* s, uint64_t origin, uint8_t flags,
+                           const uint64_t* toks, uint16_t ntok,
+                           const char* topic, uint16_t tlen,
+                           const char* payload, uint32_t plen) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Append(
+      origin, flags, toks, ntok, topic, tlen, payload, plen);
+}
+
+// Consume (token, guid) markers; returns how many were live.
+long emqx_store_consume(void* s, uint64_t token, const uint64_t* guids,
+                        uint32_t n) {
+  return static_cast<long>(
+      static_cast<emqx_native::store::DurableStore*>(s)->Consume(
+          token, guids, n));
+}
+
+// Pending messages for a token (guid order) as a malloc'd blob of
+// [u64 guid][u64 origin][u64 ts_ms][u8 flags][u16 tlen][topic]
+// [u32 plen][payload] entries (free with emqx_buf_free). Returns count.
+long emqx_store_fetch(void* s, uint64_t token, uint8_t** out,
+                      size_t* out_len) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Fetch(
+      token, out, out_len);
+}
+
+long emqx_store_pending(void* s, uint64_t token) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Pending(token);
+}
+
+// Unlink all-consumed sealed segments + compact thin live tails;
+// returns segments freed.
+long emqx_store_gc(void* s) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Gc();
+}
+
+int emqx_store_sync(void* s) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Sync();
+}
+
+long emqx_store_stat(void* s, int slot) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->Stat(slot);
+}
+
+// Attach a store to a host (BEFORE the poll thread starts). The host
+// borrows the pointer: destroy the host first, then close the store.
+int emqx_host_attach_store(void* h, void* s) {
+  static_cast<emqx_native::Host*>(h)->AttachStore(
+      static_cast<emqx_native::store::DurableStore*>(s));
+  return 0;
+}
+
+// Install/remove a durable entry (the FOURTH match-table entry kind):
+// publishes matching `filter` are persisted below the GIL for the
+// session registered under `token` while the fast path proceeds.
+int emqx_host_durable_add(void* h, uint64_t token, const char* filter,
+                          uint8_t qos) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kDurableAdd;
+  op.owner = token;
+  op.str = filter;
+  op.qos = qos;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_durable_del(void* h, uint64_t token, const char* filter) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kDurableDel;
+  op.owner = token;
+  op.str = filter;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Poll-thread-only telemetry note (the replay_drain stage): -2 off
+// thread, -1 bad stage.
+int emqx_host_note_stage(void* h, int stage, uint64_t ns) {
+  return static_cast<emqx_native::Host*>(h)->NoteStage(stage, ns);
 }
 
 int emqx_host_set_max_qos(void* h, int max_qos) {

@@ -48,6 +48,17 @@ constexpr uint8_t kSubRuleTap = 4;
 // exactly like a punt marker and the Python forward_fn lane carries
 // the message. owner = kTrunkOwnerBase + peer id.
 constexpr uint8_t kSubRemote = 8;
+// Durable entry (round 10): a persistent session's filter, served by
+// the native durable plane instead of a punt marker — the FOURTH entry
+// kind, sibling of punt/remote. A matched durable entry neither punts
+// nor delivers directly: the publish is appended to the host-side
+// message store (store.h) in the per-cycle batched record and shipped
+// to Python as ONE kind-10 event, so the publisher and every fast
+// subscriber STAY on the fast path while the persistent session gets
+// its store marker + Python-side delivery (emqx_persistent_session
+// :persist_message semantics below the GIL). owner = a store token
+// registered per session id.
+constexpr uint8_t kSubDurable = 16;
 
 // A $share group on one filter, natively served: the Python server
 // installs one of these ONLY when every member is a fast native

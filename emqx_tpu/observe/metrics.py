@@ -80,6 +80,12 @@ NATIVE = [
     # zero in prometheus/$SYS instead of appearing only after the first
     # failover (PR 2 counted it; nothing surfaced it)
     "messages.device_failover",
+    # durable-session plane (round 10): .stored counts markers written
+    # for publishes the C++ host persisted below the GIL (kind-10
+    # reconciliation), .replayed counts messages drained from the
+    # native store on clean_start=false resume. Fixed slots: both
+    # render at zero and ride the $SYS metrics heartbeat.
+    "messages.durable.stored", "messages.durable.replayed",
 ]
 CLIENT = [
     "client.connect", "client.connack", "client.connected",

@@ -295,6 +295,18 @@ class PersistentSessions:
         self.store = store if store is not None else MemStore()
         self.router = SessionRouter()
         self.is_persistent = is_persistent or (lambda sid: True)
+        # native durable plane seams (round 10, set by
+        # broker/native_server.py when its below-the-GIL store is
+        # attached): messages persisted by the C++ host live in ITS
+        # store, not this one — resume merges both, discard drops both.
+        # native_drain(sid) -> list[Message] fetches + consumes the
+        # native pending set; native_discard(sid) drops it.
+        self.native_drain = None
+        self.native_discard = None
+        # optional global cap on stored-session expiry (config
+        # durable.session_expiry): gc() treats each session's expiry as
+        # min(its own, this) when set — the operator's retention bound
+        self.session_expiry_cap_ms = 0
         self._lock = threading.RLock()
         # restore session routes from a restart-surviving store
         for sid, rec in self.store.all_sessions():
@@ -395,6 +407,13 @@ class PersistentSessions:
                         # can find its SubOpts (the takeover sub_topic hdr)
                         out.append(m.set_header("sub_topic", sub_topic))
                 self.store.consume_marker(sid, guid)
+            if self.native_drain is not None:
+                # messages the C++ host persisted below the GIL: merge
+                # them in (dedup by id — a takeover may already hold a
+                # live-dispatched copy in the session mqueue)
+                seen = {m.id for m in out}
+                out.extend(m for m in self.native_drain(sid)
+                           if m.id not in seen)
             out.sort(key=lambda m: m.timestamp)
             return subs, out
 
@@ -403,6 +422,8 @@ class PersistentSessions:
             for filt in self.router.routes_of(sid):
                 self.router.delete_route(filt, sid)
             self.store.delete_session(sid)
+            if self.native_discard is not None:
+                self.native_discard(sid)
 
     # -- GC (emqx_persistent_session_gc.erl) ---------------------------------
 
@@ -410,8 +431,11 @@ class PersistentSessions:
         """Drop expired sessions, then messages with no live markers."""
         with self._lock:
             now = now_ms() if now is None else now
+            cap = self.session_expiry_cap_ms
             for sid, rec in list(self.store.all_sessions()):
                 exp = rec.get("expiry_ms")
+                if exp and cap:
+                    exp = min(exp, cap)
                 if exp and rec.get("disconnected_at") and \
                         now - rec["disconnected_at"] >= exp:
                     self.discard(sid)

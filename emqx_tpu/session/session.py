@@ -245,6 +245,43 @@ class Session:
             return self.dequeue(now)
         return []
 
+    def adopt_native_window(self, awaiting: list[int],
+                            inflight: list[tuple[int, int, str]],
+                            pending: list[tuple[str, Message]],
+                            now: Optional[int] = None) -> list[P.Packet]:
+        """Adopt the C++ host's AckState at live plane demotion
+        (broker/native_server.py _on_handoff drains kind-11 records
+        here). Three pieces, mirroring the handoff wire format:
+
+        - ``awaiting``: publisher-side qos2 packet ids the native plane
+          owned — adopted into ``awaiting_rel`` so a DUP retransmit
+          straddling the demotion dedups (PACKET_IDENTIFIER_IN_USE →
+          PUBREC, no re-delivery) and the client's PUBREL completes
+          here;
+        - ``inflight``: (pid, qos, phase) for native deliveries still
+          unacked. The pids are >= 32768 (the native space — disjoint
+          from ``next_packet_id``'s [1, 32767]), inserted with
+          ``msg=None``: the subscriber's PUBACK/PUBREC/PUBCOMP frees
+          the slot normally; the retry timer skips message-less entries
+          (the written bytes were never retained in C++ — ROADMAP notes
+          the edge);
+        - ``pending``: (sub_topic, Message) parsed from the window-full
+          queue frames — re-enqueued into the mqueue, so they survive a
+          later disconnect for the retransmit-on-reconnect replay.
+
+        Returns PUBLISH packets when freed window room lets the adopted
+        pending messages start flowing immediately."""
+        now = now_ms() if now is None else now
+        for pid in awaiting:
+            self.awaiting_rel.setdefault(pid, now)
+        for pid, qos, phase in inflight:
+            if not self.inflight.contain(pid):
+                self.inflight.insert(
+                    pid, InflightEntry(pid, None, phase, now, qos))
+        for sub_topic, msg in pending:
+            self.mqueue.insert(self._with_sub(msg, sub_topic))
+        return self.dequeue(now) if pending else []
+
     def dequeue(self, now: Optional[int] = None) -> list[P.Packet]:
         """Fill freed inflight slots from the mqueue (:520-530)."""
         now = now_ms() if now is None else now

@@ -170,9 +170,21 @@ def test_e2e_shared_and_retained_still_work(run):
 def test_small_batch_host_bypass_policy(run):
     """Latency policy (VERDICT r3 #3): batches below the knee answer
     from the host oracle (no device launch); a saturated batch still
-    takes the kernel. Deliveries are correct on both legs."""
+    takes the kernel. Deliveries are correct on both legs.
+
+    Deflaked (PR 4's documented timing flake) on BOTH wall-clock seams:
+    the burst used to ride 16 separate writes, so under full-suite load
+    the server could read them trickled into sub-knee batches; and the
+    ADAPTIVE spill deadline (>= 30ms queue sojourn) could divert even a
+    full batch to the host oracle on a loaded box. The burst is now ONE
+    socket write (one read batch, one >= knee submission) and spill_ms
+    is pinned far above any scheduler hiccup — the device launch is a
+    policy decision again, not a race."""
+    from emqx_tpu.mqtt.frame import serialize
+
     app = make_device_app()
     app.pipeline.min_device_batch = 4      # fixed knee for the test
+    app.pipeline.spill_ms = 60_000.0       # no sojourn spill in-test
 
     async def scenario(server):
         model = app.broker.model
@@ -182,16 +194,23 @@ def test_small_batch_host_bypass_policy(run):
         pub = MqttClient(port=server.port, clientid="bp-p")
         await pub.connect()
         launches0 = model.launch_count
-        # trickle: single-message batches stay on the host oracle
+        # trickle: single-message batches stay on the host oracle (the
+        # await-recv between publishes makes each its own batch)
         for i in range(3):
             await pub.publish("kb/t", f"lo{i}".encode(), qos=0)
             m = await sub.recv(timeout=10)
             assert m.payload == f"lo{i}".encode()
         assert app.pipeline.host_batches >= 3
         assert model.launch_count == launches0, "bypass launched kernel"
-        # burst: above the knee the device path runs
-        for i in range(16):
-            await pub.publish("kb/t", f"hi{i}".encode(), qos=0)
+        # burst: one coalesced write of 16 frames lands as one read
+        # batch well above the knee — the device path must run
+        burst = b"".join(
+            serialize(P.Publish(topic="kb/t", payload=f"hi{i}".encode(),
+                                qos=0, properties={}),
+                      pub.proto_ver)
+            for i in range(16))
+        pub._writer.write(burst)
+        await pub._writer.drain()
         got = sorted([(await sub.recv(timeout=10)).payload
                       for _ in range(16)])
         assert got == sorted(f"hi{i}".encode() for i in range(16))

@@ -311,16 +311,14 @@ def test_qos2_dup_across_permit_promotion_does_not_double_deliver():
     server.stop()
 
 
-# -- documented descope (strict xfail, not silent red) -----------------------
+# -- live plane handoff (round 10: the old strict-xfail, now green) ----------
 
-@pytest.mark.xfail(
-    strict=True,
-    reason="native plane demotion drops publisher awaiting-rel state: a "
-           "QoS2 retransmit straddling disable_fast re-delivers through "
-           "the Python session. Exactly-once across a LIVE demotion needs "
-           "an awaiting-rel handoff in the disable path (kDisableFast "
-           "currently resets the AckState); tracked in ROADMAP.")
 def test_qos2_exactly_once_across_live_plane_demotion():
+    """kDisableFast no longer resets the AckState into the void: the
+    kind-11 handoff hands the publisher's awaiting-rel ids to the
+    Python session (session.adopt_native_window), so a QoS2 retransmit
+    straddling the demotion dedups there — PUBREC, no second delivery —
+    and the client's PUBREL completes through the Python exchange."""
     server = NativeBrokerServer(port=0, app=BrokerApp())
     server.start()
 
@@ -343,14 +341,154 @@ def test_qos2_exactly_once_across_live_plane_demotion():
         conn_id = server._fast_conn_of["dmp"]
         server.host.disable_fast(conn_id)
         await _settle(0.4)
-        # DUP retransmit: exactly-once demands suppression, but the
-        # Python session never saw pid 55 and re-delivers
+        assert server.fast_stats()["handoffs"] >= 1
+        sess = next(c.channel.session for c in server.conns.values()
+                    if c.channel.clientid == "dmp")
+        assert pid in sess.awaiting_rel, sess.awaiting_rel
+        # DUP retransmit across the demotion: the adopted awaiting-rel
+        # id dedups it — PUBREC answered, nothing re-delivered
         await pub._send(P.Publish(topic="dm/t", payload=b"once", qos=2,
                                   packet_id=pid, dup=True, properties={}))
         await pub._expect(P.PUBREC, 10)
-        with pytest.raises(asyncio.TimeoutError):    # fails: dup arrives
+        with pytest.raises(asyncio.TimeoutError):
             await sub.recv(timeout=0.8)
+        # the exchange completes on the Python plane
+        await pub._send(P.PubRel(packet_id=pid))
+        comp = await pub._expect(P.PUBCOMP, 10)
+        assert comp.packet_id == pid
+        assert pid not in sess.awaiting_rel
         await sub.close(); await pub.close()
 
     run(main())
     server.stop()
+
+
+def test_qos2_exactly_once_across_promotion_handoff():
+    """The symmetric case: an exchange the PYTHON session owns stays
+    Python-owned across a re-promotion (server.promote) — its DUP
+    retransmit and PUBREL forward to the session (the native
+    awaiting-rel set doesn't own the id), so nothing double-delivers —
+    while fresh publishes return to the fast path."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="pms")
+        await sub.connect()
+        await sub.subscribe("pm/t", qos=2)
+        pub = MqttClient(port=server.port, clientid="pmp")
+        await pub.connect()
+        await pub.publish("pm/t", b"warm", qos=2)    # earn the permit
+        await sub.recv(timeout=10)
+        await _settle(0.5)
+        # demote, then open a Python-owned exchange while slow
+        conn_id = server._fast_conn_of["pmp"]
+        server.host.disable_fast(conn_id)
+        await _settle(0.4)
+        pid = 66
+        await pub._send(P.Publish(topic="pm/t", payload=b"slowq2", qos=2,
+                                  packet_id=pid, properties={}))
+        await pub._expect(P.PUBREC, 10)
+        assert (await sub.recv(timeout=10)).payload == b"slowq2"
+        # promote with the exchange still open
+        assert server.promote("pmp")
+        await _settle(0.4)
+        # DUP retransmit post-promotion: the native plane must forward
+        # it (it does not own pid 66) and the session dedups
+        await pub._send(P.Publish(topic="pm/t", payload=b"slowq2", qos=2,
+                                  packet_id=pid, dup=True, properties={}))
+        await pub._expect(P.PUBREC, 10)
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(timeout=0.8)
+        await pub._send(P.PubRel(packet_id=pid))
+        await pub._expect(P.PUBCOMP, 10)
+        # the fast plane is back: re-earn the permit once, then the
+        # next publish runs natively (native pid space >= 32768)
+        await pub.publish("pm/t", b"re-earn", qos=2)
+        await sub.recv(timeout=10)
+        await _settle(0.5)
+        await pub.publish("pm/t", b"fresh", qos=2)
+        m = await sub.recv(timeout=10)
+        assert m.payload == b"fresh" and m.packet_id >= 32768, m
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_demotion_hands_pending_frames_to_the_session_mqueue():
+    """A demotion with window-full pending deliveries must not lose
+    them: the kind-11 sub-2 records re-enqueue the parked frames into
+    the Python session's mqueue, and the client's acks drain them out
+    through the Python window (the retransmit-on-reconnect seam)."""
+    import socket
+    import struct
+
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        ids = []
+
+        def pump(deadline_s=5.0, want_opens=0, want_frames=0):
+            frames = []
+            t0 = time.time()
+            while time.time() - t0 < deadline_s:
+                for kind, conn, payload in host.poll(50):
+                    if kind == native.EV_OPEN:
+                        ids.append(conn)
+                    elif kind == native.EV_FRAME:
+                        frames.append((conn, payload))
+                if len(ids) >= want_opens and len(frames) >= want_frames:
+                    break
+            return frames
+
+        pub = socket.create_connection(("127.0.0.1", host.port))
+        pump(want_opens=1)
+        sub = socket.create_connection(("127.0.0.1", host.port))
+        pump(want_opens=2)
+        pub_id, sub_id = ids
+        pub.sendall(_mqtt_connect(b"hop"))
+        sub.sendall(_mqtt_connect(b"hos"))
+        pump(want_opens=2, want_frames=2)
+
+        host.enable_fast(pub_id, 4, 0)
+        host.enable_fast(sub_id, 4, 2)     # native window of TWO
+        host.sub_add(sub_id, "ho/t", 1, 0)
+        host.permit(pub_id, "ho/t")
+        list(host.poll(50))
+
+        # 5 qos1 publishes: 2 fill the window, 3 park on pending
+        frames = b"".join(
+            _mqtt_publish(b"ho/t", b"m%d" % i, qos=1, pid=10 + i)
+            for i in range(5))
+        pub.sendall(frames)
+        t0 = time.time()
+        while time.time() - t0 < 5:
+            list(host.poll(20))
+            st = host.stats()
+            if st["fast_out"] >= 2:
+                break
+        host.disable_fast(sub_id)
+        handoff = {"awaiting": [], "inflight": [], "pending": []}
+        t0 = time.time()
+        while time.time() - t0 < 5 and len(handoff["pending"]) < 3:
+            for kind, conn, payload in host.poll(50):
+                if kind == native.EV_HANDOFF:
+                    assert conn == sub_id
+                    part = native.parse_handoff(payload)
+                    for k in handoff:
+                        handoff[k] += part[k]
+        assert len(handoff["inflight"]) == 2, handoff
+        assert all(pid >= 32768 for pid, _q, _p in handoff["inflight"])
+        assert all(q == 1 and ph == "publish"
+                   for _pid, q, ph in handoff["inflight"])
+        assert len(handoff["pending"]) == 3, handoff
+        for frame in handoff["pending"]:
+            assert frame[0] >> 4 == 3           # serialized PUBLISH
+            tlen = (frame[2] << 8) | frame[3]
+            assert frame[4:4 + tlen] == b"ho/t"
+        pub.close()
+        sub.close()
+        for _ in range(5):
+            list(host.poll(10))
+    finally:
+        host.destroy()

@@ -1,0 +1,742 @@
+"""Native durable-session plane (round 10).
+
+The C++ host persists publishes matching a persistent session's filters
+into a segmented mmap store (native/src/store.h) BELOW the GIL — the
+reference's emqx_persistent_session.erl:93-109 persist_message +
+:275-310 resume, with the store host-side per SURVEY §5 — while the
+publisher and every fast subscriber stay on the fast path (the old
+behavior punted the whole topic to asyncio). Covered here:
+
+- the store's own contract: append/fetch/consume/register round trip,
+  restart recovery, CRC torn-tail drop (fuzz), segment GC + compaction;
+- the data plane: one persistent subscriber no longer collapses the
+  fast path (punts stay zero, durable counters move), live delivery
+  consumes markers, offline traffic replays on clean_start=false
+  resume exactly once;
+- crash safety: kill -9 → restart → resume replays every PUBACK'd QoS1
+  message exactly once (the PUBACK is only written after the store
+  append + fsync — host.cc FlushDirty orders it);
+- the escape hatch: EMQX_DURABLE_STORE=0 (and a persistence-less app)
+  restore the punt-everything behavior.
+"""
+
+import asyncio
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp                              # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer    # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient                     # noqa: E402
+from emqx_tpu.session.persistent import DiskStore, MemStore     # noqa: E402
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def make_server(tmp_path=None, **kw):
+    app = BrokerApp(persistent_store=MemStore())
+    if tmp_path is not None:
+        kw.setdefault("durable_dir", str(tmp_path / "store"))
+    server = NativeBrokerServer(port=0, app=app, **kw)
+    return server
+
+
+# -- the store itself ---------------------------------------------------------
+
+def test_store_roundtrip_and_restart_recovery(tmp_path):
+    d = str(tmp_path / "s1")
+    s = native.NativeStore(d, segment_bytes=1 << 20, fsync="batch")
+    tok = s.register("sess-a")
+    assert s.register("sess-a") == tok          # stable per sid
+    g1 = s.append(7, 1, [tok], "t/a", b"hello")
+    g2 = s.append(7, 0, [tok], "t/b", b"world", dup=True)
+    assert g2 == g1 + 1
+    rows = s.fetch(tok)
+    assert [(r[0], r[3], r[4], r[5], r[6]) for r in rows] == [
+        (g1, 1, False, "t/a", b"hello"),
+        (g2, 0, True, "t/b", b"world")]
+    assert s.pending(tok) == 2
+    assert s.consume(tok, [g1]) == 1
+    assert s.consume(tok, [g1]) == 0            # already spent
+    s.close()
+
+    # reopen: registration, the unconsumed message, and the consume
+    # journal all survive; guids keep advancing past the recovered max
+    s2 = native.NativeStore(d, segment_bytes=1 << 20, fsync="batch")
+    assert s2.register("sess-a") == tok
+    rows = s2.fetch(tok)
+    assert [(r[0], r[5], r[6]) for r in rows] == [(g2, "t/b", b"world")]
+    g3 = s2.append(7, 1, [tok], "t/c", b"!")
+    assert g3 > g2
+    assert s2.stats()["torn_drops"] == 0
+    s2.close()
+
+
+def test_store_lookup_never_registers(tmp_path):
+    s = native.NativeStore(str(tmp_path / "lk"))
+    assert s.lookup("ghost") == 0               # and no record journaled
+    tok = s.register("real")
+    assert s.lookup("real") == tok
+    assert s.lookup("ghost") == 0
+    s.close()
+
+
+def test_oversized_durable_entry_still_reaches_python():
+    """A near-max-size publish matched by several durable sessions
+    builds a kind-10 record larger than max_size: the poll buffer's
+    durable margin must still deliver it (a dropped record would skip
+    live delivery while keeping the markers — a ghost replay later)."""
+    import socket
+
+    store = native.NativeStore("")              # anonymous
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.attach_store(store)
+    try:
+        toks = [store.register(f"s{i}") for i in range(3)]
+        ids = []
+
+        def pump(want_opens=0, want_frames=0, deadline_s=5.0):
+            frames = []
+            t0 = time.time()
+            while time.time() - t0 < deadline_s:
+                for kind, conn, payload in host.poll(50):
+                    if kind == native.EV_OPEN:
+                        ids.append(conn)
+                    elif kind == native.EV_FRAME:
+                        frames.append(payload)
+                if len(ids) >= want_opens and len(frames) >= want_frames:
+                    break
+            return frames
+
+        pub = socket.create_connection(("127.0.0.1", host.port))
+        pump(want_opens=1)
+        pub_id = ids[0]
+        vh = b"\x00\x04MQTT\x04\x02\x00\x3c\x00\x02ov"
+        pub.sendall(bytes([0x10, len(vh)]) + vh)
+        pump(want_opens=1, want_frames=1)
+        host.enable_fast(pub_id, 4, 0)
+        for t in toks:
+            host.durable_add(t, "ov/t", 1)
+        host.permit(pub_id, "ov/t")
+        list(host.poll(50))
+
+        payload = b"z" * ((1 << 16) - 64)       # near max_size
+        body = struct.pack(">H", 4) + b"ov/t" + payload
+        head = bytes([0x30])
+        rl, var = len(body), b""
+        while True:
+            b7 = rl & 0x7F
+            rl >>= 7
+            var += bytes([b7 | (0x80 if rl else 0)])
+            if not rl:
+                break
+        pub.sendall(head + var + body)
+        got = []
+        t0 = time.time()
+        while not got and time.time() - t0 < 5:
+            for kind, conn, p in host.poll(50):
+                if kind == native.EV_DURABLE:
+                    got.append(native.parse_durable(p))
+        assert got, "oversized durable record never surfaced"
+        _base, _ts, entries = got[0]
+        assert len(entries) == 1
+        origin, flags, etoks, topic, ebody = entries[0]
+        assert sorted(etoks) == sorted(toks)
+        assert topic == "ov/t" and ebody == payload
+        assert store.stats()["appends"] == 1
+        pub.close()
+        for _ in range(5):
+            list(host.poll(10))
+    finally:
+        host.destroy()
+        store.close()
+
+
+def test_store_multi_token_marker_fanout(tmp_path):
+    s = native.NativeStore(str(tmp_path / "s2"))
+    ta, tb = s.register("a"), s.register("b")
+    g = s.append(1, 1, [ta, tb], "x", b"one")
+    assert s.pending(ta) == 1 and s.pending(tb) == 1
+    s.consume(ta, [g])
+    assert s.pending(ta) == 0 and s.pending(tb) == 1
+    assert s.stats()["messages"] == 1           # b's marker keeps it
+    s.consume(tb, [g])
+    assert s.stats()["messages"] == 0
+    s.close()
+
+
+def test_store_fuzz_torn_tail_drops_only_the_tail(tmp_path):
+    """Truncating / corrupting a segment mid-record must drop ONLY the
+    torn record and what follows it in that segment — every record
+    before the CRC boundary replays intact (satellite: crash-recovery
+    fuzz)."""
+    d = str(tmp_path / "fz")
+    s = native.NativeStore(d, segment_bytes=1 << 20, fsync="batch")
+    tok = s.register("fz")
+    guids = [s.append(1, 1, [tok], f"t/{i}", b"p%d" % i)
+             for i in range(10)]
+    s.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[0])
+    raw = open(seg, "rb").read()
+
+    # locate each frame boundary by walking the CRC framing
+    offs = []
+    pos = 0
+    while pos + 9 <= len(raw):
+        ln = int.from_bytes(raw[pos + 4:pos + 8], "little")
+        if ln == 0:
+            break
+        offs.append(pos)
+        pos += 8 + ln
+    assert len(offs) >= 11                      # register + 10 batches
+
+    # case 1: truncate mid-way through the 8th message record
+    cut = offs[8] + 11                          # inside the frame
+    with open(seg, "r+b") as f:
+        f.truncate(cut)
+    s = native.NativeStore(d, segment_bytes=1 << 20, fsync="batch")
+    rows = s.fetch(s.register("fz"))
+    assert [r[0] for r in rows] == guids[:7], rows  # 7 intact, tail gone
+    assert s.stats()["torn_drops"] >= 1
+    s.close()
+
+    # case 2: flip a payload byte mid-record — CRC refuses it and the
+    # scan stops THERE (records before it still replay)
+    with open(seg, "r+b") as f:
+        f.write(raw)                            # restore all 10
+        f.flush()
+    with open(seg, "r+b") as f:
+        f.seek(offs[5] + 20)
+        f.write(b"\xff")
+    s = native.NativeStore(d, segment_bytes=1 << 20, fsync="batch")
+    rows = s.fetch(s.register("fz"))
+    assert [r[0] for r in rows] == guids[:4], rows
+    assert s.stats()["torn_drops"] >= 1
+    s.close()
+
+
+def test_store_gc_unlinks_consumed_segments(tmp_path):
+    d = str(tmp_path / "gc")
+    s = native.NativeStore(d, segment_bytes=64 * 1024, fsync="never")
+    tok = s.register("g")
+    guids = [s.append(1, 1, [tok], "t", b"x" * 4096) for _ in range(64)]
+    assert s.stats()["segments"] > 1            # rolled at least once
+    s.consume(tok, guids)
+    freed = s.gc()
+    assert freed > 0
+    assert s.stats()["segments"] < 64
+    assert s.fetch(tok) == []
+    # survivor correctness after GC + reopen
+    g = s.append(1, 1, [tok], "t/live", b"live")
+    s.close()
+    s2 = native.NativeStore(d, segment_bytes=64 * 1024, fsync="never")
+    rows = s2.fetch(s2.register("g"))
+    assert [(r[0], r[5], r[6]) for r in rows] == [(g, "t/live", b"live")]
+    s2.close()
+
+
+def test_store_gc_after_reopen_keeps_live_messages(tmp_path):
+    """Regression: recovery must rebuild per-segment LIVE counts — a
+    reopen followed by Gc() used to see live=0 for recovered segments
+    and unlink files still holding unconsumed messages."""
+    d = str(tmp_path / "rg")
+    s = native.NativeStore(d, segment_bytes=64 * 1024, fsync="batch")
+    tok = s.register("r")
+    guids = [s.append(1, 1, [tok], f"t/{i}", b"z" * 4096)
+             for i in range(40)]
+    s.close()
+    s2 = native.NativeStore(d, segment_bytes=64 * 1024, fsync="batch")
+    s2.gc()                                     # must unlink NOTHING live
+    rows = s2.fetch(s2.register("r"))
+    assert [r[0] for r in rows] == guids
+    s2.close()
+    s3 = native.NativeStore(d, segment_bytes=64 * 1024, fsync="batch")
+    assert [r[0] for r in s3.fetch(s3.register("r"))] == guids
+    s3.close()
+
+
+def test_store_gc_compaction_rehomes_live_tail(tmp_path):
+    """Sealed segments holding only a thin live tail get their live
+    messages REWRITTEN forward and are unlinked; the re-homed messages
+    stay fetchable across a reopen (consumed-marker compaction)."""
+    d = str(tmp_path / "cp")
+    s = native.NativeStore(d, segment_bytes=64 * 1024, fsync="never")
+    tok = s.register("c")
+    guids = [s.append(1, 1, [tok], f"t/{i}", b"y" * 4096)
+             for i in range(64)]
+    segs0 = s.stats()["segments"]
+    assert segs0 > 2
+    keep = {guids[3], guids[40]}                # thin live tail
+    s.consume(tok, [g for g in guids if g not in keep])
+    s.gc()
+    st = s.stats()
+    assert st["segments"] < segs0
+    assert st["rewrites"] >= 1 or st["gc_segments"] >= 1
+    rows = s.fetch(tok)
+    assert {r[0] for r in rows} == keep
+    s.close()
+    s2 = native.NativeStore(d, segment_bytes=64 * 1024, fsync="never")
+    rows = s2.fetch(s2.register("c"))
+    assert {r[0] for r in rows} == keep
+    s2.close()
+
+
+# -- the data plane -----------------------------------------------------------
+
+def test_persistent_subscriber_no_longer_collapses_the_fast_path():
+    """The headline: with the durable plane up, one persistent
+    subscriber in the audience leaves the publisher and the fast
+    subscriber fully native (punts stay zero) while BOTH subscribers
+    receive every message and the store markers get consumed on live
+    delivery."""
+    server = make_server()
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="dp-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("dp/t", qos=1)
+        fs = MqttClient(port=server.port, clientid="dp-fs")
+        await fs.connect()
+        await fs.subscribe("dp/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="dp-pp")
+        await pub.connect()
+        await pub.publish("dp/t", b"warm", qos=1)   # slow path earns permit
+        await fs.recv(timeout=10)
+        await ps.recv(timeout=10)
+        await asyncio.sleep(0.6)
+        punts0 = server.fast_stats()["punts"]
+        for i in range(8):
+            await pub.publish("dp/t", f"m{i}".encode(), qos=1)
+            a = await fs.recv(timeout=10)
+            b = await ps.recv(timeout=10)
+            assert a.payload == b.payload == f"m{i}".encode()
+            # the persistent session's copy rides the Python window
+            assert b.packet_id is None or b.packet_id < 32768
+        st = server.fast_stats()
+        assert st["punts"] == punts0, st            # fast path held
+        assert st["durable_in"] >= 8, st
+        assert st["store_appends"] >= 8, st
+        await asyncio.sleep(0.5)
+        ss = server._durable_store.stats()
+        assert ss["pending"] == 0, ss               # live delivery consumed
+        m = server.broker.metrics
+        assert m.val("messages.durable.stored") >= 8
+        await ps.close(); await fs.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_offline_storage_and_resume_replays_exactly_once():
+    server = make_server()
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="or-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("or/t", qos=1)
+        pub = MqttClient(port=server.port, clientid="or-pp")
+        await pub.connect()
+        await pub.publish("or/t", b"warm", qos=1)
+        await ps.recv(timeout=10)
+        await asyncio.sleep(0.6)
+        await ps.close()                            # offline, session kept
+        await asyncio.sleep(0.3)
+        for i in range(5):
+            await pub.publish("or/t", f"off{i}".encode(), qos=1)
+        await asyncio.sleep(0.5)
+        assert server.fast_stats()["durable_in"] >= 5
+        ps2 = MqttClient(port=server.port, clientid="or-ps",
+                         clean_start=False, proto_ver=5,
+                         properties={"Session-Expiry-Interval": 300})
+        await ps2.connect()
+        got = [(await ps2.recv(timeout=10)).payload for _ in range(5)]
+        assert got == [f"off{i}".encode() for i in range(5)], got
+        with pytest.raises(asyncio.TimeoutError):   # no duplicates
+            await ps2.recv(timeout=0.8)
+        assert server.broker.metrics.val("messages.durable.replayed") >= 5
+        await ps2.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_wildcard_durable_subscription_replays_on_resume():
+    """Regression (review finding): the replayed Message must carry the
+    MATCHED FILTER as its sub_topic header — a wildcard subscription's
+    replay used to miss the session's SubOpts lookup and be dropped as
+    'late delivery' after its markers were already consumed."""
+    server = make_server()
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="wd-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("wd/+", qos=1)           # WILDCARD filter
+        pub = MqttClient(port=server.port, clientid="wd-pp")
+        await pub.connect()
+        await pub.publish("wd/t", b"warm", qos=1)
+        await ps.recv(timeout=10)
+        await asyncio.sleep(0.6)
+        await ps.close()
+        await asyncio.sleep(0.3)
+        for i in range(3):
+            await pub.publish("wd/t", f"w{i}".encode(), qos=1)
+        await asyncio.sleep(0.5)
+        assert server.fast_stats()["durable_in"] >= 3
+        ps2 = MqttClient(port=server.port, clientid="wd-ps",
+                         clean_start=False, proto_ver=5,
+                         properties={"Session-Expiry-Interval": 300})
+        await ps2.connect()
+        got = [(await ps2.recv(timeout=10)).payload for _ in range(3)]
+        assert got == [b"w0", b"w1", b"w2"], got
+        await ps2.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_restart_installs_durable_entries_for_offline_sessions(tmp_path):
+    """Regression (review finding): after a broker restart, a stored
+    session that has not resumed yet must STILL have durable entries —
+    otherwise fast-path publishes in the restart→resume window bypass
+    both stores and are acked-but-lost."""
+    sess_dir = str(tmp_path / "sessions")
+    store_dir = str(tmp_path / "store")
+
+    app1 = BrokerApp(persistent_store=DiskStore(sess_dir))
+    s1 = NativeBrokerServer(port=0, app=app1, durable_dir=store_dir)
+    s1.start()
+
+    async def phase1():
+        ps = MqttClient(port=s1.port, clientid="rg-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 600})
+        await ps.connect()
+        await ps.subscribe("rg/t", qos=1)
+        await ps.disconnect()
+
+    run(phase1())
+    s1.stop()
+    app1.persistent.store.close()
+
+    # restart: the subscriber is OFFLINE; fast traffic flows first
+    app2 = BrokerApp(persistent_store=DiskStore(sess_dir))
+    s2 = NativeBrokerServer(port=0, app=app2, durable_dir=store_dir)
+    s2.start()
+    try:
+        async def phase2():
+            fs = MqttClient(port=s2.port, clientid="rg-fs")
+            await fs.connect()
+            await fs.subscribe("rg/t", qos=0)
+            pub = MqttClient(port=s2.port, clientid="rg-pp")
+            await pub.connect()
+            await pub.publish("rg/t", b"warm", qos=1)   # python plane
+            await fs.recv(timeout=10)
+            await asyncio.sleep(0.7)                    # permit grant
+            for i in range(3):
+                await pub.publish("rg/t", f"gap{i}".encode(), qos=1)
+                await fs.recv(timeout=10)
+            st = s2.fast_stats()
+            # the boot-installed durable entry caught the fast traffic
+            assert st["durable_in"] >= 3, st
+            # ...and the offline session replays EVERYTHING on resume
+            ps = MqttClient(port=s2.port, clientid="rg-ps",
+                            clean_start=False, proto_ver=5,
+                            properties={"Session-Expiry-Interval": 600})
+            await ps.connect()
+            got = []
+            while True:
+                try:
+                    got.append((await ps.recv(timeout=3)).payload)
+                except asyncio.TimeoutError:
+                    break
+            want = [b"warm", b"gap0", b"gap1", b"gap2"]
+            assert sorted(got) == sorted(want), (got, want)
+            await ps.close(); await fs.close(); await pub.close()
+
+        run(phase2())
+    finally:
+        s2.stop()
+        app2.persistent.store.close()
+
+
+def test_clean_start_wipes_native_markers():
+    server = make_server()
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="cw-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("cw/t", qos=1)
+        pub = MqttClient(port=server.port, clientid="cw-pp")
+        await pub.connect()
+        await pub.publish("cw/t", b"warm", qos=1)
+        await ps.recv(timeout=10)
+        await asyncio.sleep(0.6)
+        await ps.close()
+        await asyncio.sleep(0.3)
+        await pub.publish("cw/t", b"stored", qos=1)
+        await asyncio.sleep(0.4)
+        # clean start discards the stored session AND its markers
+        ps2 = MqttClient(port=server.port, clientid="cw-ps",
+                         clean_start=True)
+        await ps2.connect()
+        with pytest.raises(asyncio.TimeoutError):
+            await ps2.recv(timeout=0.8)
+        await asyncio.sleep(0.3)
+        tok = server._durable_tokens.get("cw-ps")
+        assert tok is None or server._durable_store.pending(tok) == 0
+        await ps2.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_discard_race_orphan_markers_consumed_on_sight():
+    """A discard races the ASYNC durable_del (applied only at the next
+    ApplyPending): a batch flushed in that window still carries markers
+    for the dead token, appended AFTER discard's consume sweep. The
+    kind-10 reconciliation must spend those orphans on sight — left
+    alone they pin their segment against GC forever, and a later
+    clean_start=false life of the same sid would replay pre-wipe
+    messages (review finding)."""
+    server = make_server()
+    server.start()
+    try:
+        store = server._durable_store
+        tok = server._durable_token("rx-ps")
+        server._durable_discard("rx-ps")
+        assert tok in server._durable_dead
+        # simulate the raced flush: the host appends for the still-
+        # installed entry and ships the SAME bytes up as kind-10
+        guid = store.append(0, 1, [tok], "rx/t", b"late")
+        assert store.pending(tok) == 1
+        entry = (struct.pack("<QBH", 0, (1 << 1) | 1, 1)
+                 + struct.pack("<Q", tok)
+                 + struct.pack("<H", 4) + b"rx/t"
+                 + struct.pack("<I", 4) + b"late")
+        server._on_durable(struct.pack("<QQI", guid, 0, 1) + entry)
+        assert store.pending(tok) == 0          # orphan spent
+        # a fresh persistent life of the sid revives the journaled token
+        assert server._durable_token("rx-ps") == tok
+        assert tok not in server._durable_dead
+    finally:
+        server.stop()
+
+
+def test_drain_watermark_blocks_double_delivery():
+    """When a CONNECT and the publish it raced land in the same poll
+    batch, the resume drain (CONNECT handling) replays the message
+    BEFORE the queued kind-10 event is folded — _on_durable must then
+    skip the already-drained guid or the client sees it twice (review
+    finding). Guids are monotonic and the drain fetches the whole
+    pending set, so the per-sid watermark is an exact filter."""
+    server = make_server()
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="wm-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("wm/t", qos=1)
+        await asyncio.sleep(0.3)
+        store = server._durable_store
+        tok = server._durable_tokens["wm-ps"]
+        guid = store.append(0, 1, [tok], "wm/t", b"raced")
+        # the drain replays (and consumes) the planted message...
+        drained = server._durable_drain("wm-ps")
+        assert [m.payload for m in drained] == [b"raced"]
+        assert store.pending(tok) == 0
+        # ...so folding the SAME batch's kind-10 afterwards must not
+        # deliver it a second time through the connected channel
+        entry = (struct.pack("<QBH", 0, (1 << 1) | 1, 1)
+                 + struct.pack("<Q", tok)
+                 + struct.pack("<H", 4) + b"wm/t"
+                 + struct.pack("<I", 5) + b"raced")
+        server._on_durable(struct.pack("<QQI", guid, 0, 1) + entry)
+        with pytest.raises(asyncio.TimeoutError):
+            await ps.recv(timeout=0.8)
+        await ps.close()
+
+    run(main())
+    server.stop()
+
+
+def test_escape_hatch_restores_punt_behavior(monkeypatch):
+    """EMQX_DURABLE_STORE=0 keeps the pre-round-10 shape: persistent
+    sessions install punt markers and matching publishes run the
+    Python plane (still delivered, zero native persistence)."""
+    monkeypatch.setenv("EMQX_DURABLE_STORE", "0")
+    server = make_server()
+    assert server._durable_store is None
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="eh-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("eh/t", qos=1)
+        pub = MqttClient(port=server.port, clientid="eh-pp")
+        await pub.connect()
+        for i in range(3):
+            await pub.publish("eh/t", f"p{i}".encode(), qos=1)
+            m = await ps.recv(timeout=10)
+            assert m.payload == f"p{i}".encode()
+        st = server.fast_stats()
+        assert st["durable_in"] == 0 and st["fast_in"] == 0, st
+        await ps.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_config_wires_durable_store(tmp_path):
+    """durable.enable boots PersistentSessions on a DiskStore under
+    <data_dir>/durable and points the native server's store next to it
+    (satellite: config/schema wiring)."""
+    from emqx_tpu.config.config import Config
+
+    conf = Config()
+    conf.put("durable.enable", True)
+    conf.put("node.data_dir", str(tmp_path))
+    app = BrokerApp.from_config(conf)
+    assert app.persistent is not None
+    assert isinstance(app.persistent.store, DiskStore)
+    server = NativeBrokerServer(port=0, app=app)
+    try:
+        assert server._durable_store is not None
+        assert server._durable_store.dir == os.path.join(
+            str(tmp_path), "durable", "store")
+        assert os.path.isdir(server._durable_store.dir)
+    finally:
+        server.stop()
+        app.persistent.store.close()
+
+
+# -- crash safety -------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys, threading
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.native_server import NativeBrokerServer
+from emqx_tpu.session.persistent import DiskStore
+
+app = BrokerApp(persistent_store=DiskStore(%(sess)r))
+server = NativeBrokerServer(port=0, app=app, durable_dir=%(store)r,
+                            durable_fsync="batch")
+server.start()
+print("PORT %%d" %% server.port, flush=True)
+threading.Event().wait()          # run until killed
+"""
+
+
+def test_kill9_restart_resume_zero_qos1_loss(tmp_path):
+    """The acceptance gate: every QoS1 message the broker PUBACK'd
+    before a kill -9 replays exactly once after restart + clean_start=
+    false resume — the store append (+fsync) is ordered BEFORE the
+    PUBACK reaches the wire, so an acked message can never be lost."""
+    sess_dir = str(tmp_path / "sessions")
+    store_dir = str(tmp_path / "store")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = _CHILD % {"repo": repo, "sess": sess_dir, "store": store_dir}
+    proc = subprocess.Popen([sys.executable, "-c", src],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+
+        async def phase1():
+            ps = MqttClient(port=port, clientid="k9-ps",
+                            clean_start=False, proto_ver=5,
+                            properties={"Session-Expiry-Interval": 600})
+            await ps.connect()
+            await ps.subscribe("k9/t", qos=1)
+            await ps.disconnect()
+            pub = MqttClient(port=port, clientid="k9-pp")
+            await pub.connect()
+            # warm earns the permit (Python plane persists it too)
+            await pub.publish("k9/t", b"warm", qos=1)
+            await asyncio.sleep(0.8)
+            for i in range(20):
+                # publish() awaits the broker's PUBACK: every one of
+                # these is store-committed by the ordering contract
+                await pub.publish("k9/t", f"m{i:02d}".encode(), qos=1)
+
+        run(phase1())
+        os.kill(proc.pid, signal.SIGKILL)       # no goodbye
+        proc.wait(timeout=10)
+
+        # restart on the same directories, in-process
+        app = BrokerApp(persistent_store=DiskStore(sess_dir))
+        server = NativeBrokerServer(port=0, app=app, durable_dir=store_dir,
+                                    durable_fsync="batch")
+        # the native store recovered the acked messages
+        assert server._durable_store.stats()["messages"] >= 20
+        server.start()
+        try:
+            async def phase2():
+                ps = MqttClient(port=server.port, clientid="k9-ps",
+                                clean_start=False, proto_ver=5,
+                                properties={"Session-Expiry-Interval": 600})
+                await ps.connect()
+                got = []
+                while True:
+                    try:
+                        got.append((await ps.recv(timeout=3)).payload)
+                    except asyncio.TimeoutError:
+                        break
+                want = [b"warm"] + [f"m{i:02d}".encode()
+                                    for i in range(20)]
+                assert sorted(got) == sorted(want), (
+                    f"lost={set(want) - set(got)} "
+                    f"dup_or_extra={[g for g in got if got.count(g) > 1]}")
+                await ps.close()
+
+            run(phase2())
+        finally:
+            server.stop()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+# -- handoff wire sanity ------------------------------------------------------
+
+def test_parse_handoff_roundtrip_shapes():
+    rec1 = bytes([1]) + struct.pack("<I", 2) + struct.pack("<HH", 5, 9) \
+        + struct.pack("<I", 1) + struct.pack("<HB", 40000, 3)
+    out = native.parse_handoff(rec1)
+    assert out["awaiting"] == [5, 9]
+    assert out["inflight"] == [(40000, 2, "pubrel")]
+    frame = b"\x30\x05\x00\x01tAB"
+    rec2 = bytes([2]) + struct.pack("<I", 1) + struct.pack("<I", len(frame)) \
+        + frame
+    assert native.parse_handoff(rec2)["pending"] == [frame]

@@ -781,9 +781,135 @@ print("SANITIZED-RUN-OK", a["trunk_out"], b["trunk_in"], events)
 """
 
 
+# Round-10 durable-plane coverage: the poll thread appends batched
+# store records (FlushDurables) while foreign threads hammer the SAME
+# DurableStore with fetch/consume/gc/stats (the resume-replay and
+# marker-consumption call shapes) and race durable route add/del plus
+# disable/enable_fast churn (kind-11 handoff emission) against it —
+# the store's one-mutex contract under both sanitizers.
+DRIVER_DURABLE = r"""
+import socket, struct, sys, tempfile, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+store = native.NativeStore(tempfile.mkdtemp(), segment_bytes=1 << 16,
+                           fsync="batch")
+tok = store.register("dur-sess")
+host = native.NativeHost(port=0, max_size=1 << 16)
+host.attach_store(store)
+
+def mqtt_connect(cid):
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    return bytes([0x10, len(vh)]) + vh
+
+def mqtt_publish(topic, payload, qos=0, pid=0):
+    body = struct.pack(">H", len(topic)) + topic
+    if qos:
+        body += struct.pack(">H", pid)
+    body += payload
+    return bytes([0x30 | (qos << 1), len(body)]) + body
+
+socks = [socket.create_connection(("127.0.0.1", host.port))
+         for _ in range(2)]
+ids = []
+for i, s in enumerate(socks):
+    s.sendall(mqtt_connect(b"d%%d" %% i))
+deadline = time.time() + 15
+framed = 0
+while (len(ids) < 2 or framed < 2) and time.time() < deadline:
+    for kind, conn, payload in host.poll(50):
+        if kind == native.EV_OPEN:
+            ids.append(conn)
+        elif kind == native.EV_FRAME:
+            framed += 1
+            host.send(conn, b"\x20\x02\x00\x00")
+assert len(ids) == 2 and framed == 2, (ids, framed)
+sub, pub = ids
+for c in ids:
+    host.enable_fast(c, 4, 64)
+host.sub_add(sub, "du/x", qos=0)
+host.durable_add(tok, "du/+", 1)
+host.permit(pub, "du/x")
+
+stop = threading.Event()
+def store_churn():
+    # the resume-replay / marker-consumption shapes racing the poll
+    # thread's batched appends on the store's internal mutex
+    j = 0
+    while not stop.is_set():
+        rows = store.fetch(tok)
+        if rows and j %% 3 == 0:
+            store.consume(tok, [r[0] for r in rows[: len(rows) // 2 + 1]])
+        store.pending(tok)
+        store.stats()
+        if j %% 40 == 17:
+            store.gc()
+        j += 1
+        time.sleep(0.0005)
+
+def control_churn():
+    # durable route flips + plane demote/promote (handoff emission)
+    j = 0
+    while not stop.is_set():
+        if j %% 10 == 3:
+            host.durable_del(tok, "du/+")
+            host.durable_add(tok, "du/+", 1)
+        if j %% 25 == 7:
+            host.disable_fast(pub)
+            host.enable_fast(pub, 4, 64)
+            host.permit(pub, "du/x")
+        host.stats()
+        j += 1
+        time.sleep(0.0008)
+
+th = [threading.Thread(target=store_churn),
+      threading.Thread(target=control_churn)]
+for t in th: t.start()
+
+N_MSG = 400
+def blaster():
+    for k in range(N_MSG):
+        socks[1].sendall(mqtt_publish(b"du/x", b"p%%03d" %% k, k & 1,
+                                      1 + (k %% 100)))
+        time.sleep(0.0003)
+bl = threading.Thread(target=blaster)
+bl.start()
+
+durable_events = 0
+deadline = time.time() + 25
+while time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind == native.EV_DURABLE:
+            base, ts, entries = native.parse_durable(payload)
+            durable_events += len(entries)
+    st = host.stats()
+    if (st["durable_in"] > N_MSG // 4 and st["handoffs"] > 0
+            and st["store_appends"] > 0):
+        break
+bl.join()
+time.sleep(0.3)
+stop.set()
+for t in th: t.join()
+st = host.stats()
+assert st["durable_in"] > 0 and st["store_appends"] > 0, st
+assert st["handoffs"] > 0, st
+assert durable_events > 0, "no kind-10 records surfaced"
+ss = store.stats()
+assert ss["appends"] > 0, ss
+for s in socks:
+    try: s.close()
+    except OSError: pass
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+store.close()
+print("SANITIZED-RUN-OK", st["durable_in"], st["handoffs"], ss["appends"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
-                                    "telemetry", "trunk"])
+                                    "telemetry", "trunk", "durable"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -800,7 +926,8 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     }
     src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
            "lane": DRIVER_LANE, "ws": DRIVER_WS,
-           "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK}[driver]
+           "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
+           "durable": DRIVER_DURABLE}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

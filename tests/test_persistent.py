@@ -157,6 +157,50 @@ def test_resume_replays_in_publish_order():
     assert ps.resume("c1")[1] == []
 
 
+def test_resume_merges_native_drain_by_timestamp_and_id():
+    """The native durable plane's seam (round 10): resume merges the
+    below-the-GIL store's pending set into the Python store's, deduped
+    by message id (a takeover may already hold a live-dispatched copy)
+    and ordered by timestamp across both sources."""
+    ps = PersistentSessions(MemStore())
+    ps.router.add_route("t", "c1")
+    py_msg = _mkmsg("t", b"py", timestamp=200)
+    ps.persist_message(py_msg)
+    nat_old = _mkmsg("t", b"nat-old", id=(1 << 60) + 1, timestamp=100)
+    nat_dup = _mkmsg("t", b"dup", id=py_msg.id, timestamp=150)
+    drained = []
+
+    def drain(sid):
+        drained.append(sid)
+        return [nat_old, nat_dup]
+
+    ps.native_drain = drain
+    _subs, pending = ps.resume("c1")
+    assert drained == ["c1"]
+    assert [m.payload for m in pending] == [b"nat-old", b"py"]
+
+
+def test_discard_drops_native_markers_too():
+    ps = PersistentSessions(MemStore())
+    ps.router.add_route("t", "c1")
+    dropped = []
+    ps.native_discard = dropped.append
+    ps.discard("c1")
+    assert dropped == ["c1"]
+
+
+def test_gc_session_expiry_cap(monkeypatch):
+    """durable.session_expiry caps every stored session's retention:
+    a session with a week-long expiry is discarded once the operator
+    bound elapses."""
+    ps = PersistentSessions(MemStore())
+    ps.store.put_session("c1", {"subs": {}, "ts": 0})
+    ps.note_disconnected("c1", expiry_ms=7 * 86400 * 1000, now=1000)
+    ps.session_expiry_cap_ms = 10_000
+    ps.gc(now=12_000)
+    assert ps.lookup("c1") is None
+
+
 def test_gc_drops_expired_sessions():
     ps = PersistentSessions(MemStore())
     ps.store.put_session("c1", {"subs": {"t": {}}, "ts": 0})

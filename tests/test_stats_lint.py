@@ -133,3 +133,55 @@ def test_forward_split_fixed_slots_render_at_zero():
     hb.publish_metrics()
     assert seen["$SYS/brokers/n1/metrics/messages.forward.native"] == b"0"
     assert seen["$SYS/brokers/n1/metrics/messages.forward.slow"] == b"0"
+
+
+# -- durable-session plane (ISSUE 5) -----------------------------------------
+
+
+def test_durable_slots_and_stages_exported():
+    """The durable plane's StatSlots / HistStages stay exported — the
+    mechanical enum lint passes if BOTH sides dropped them, so their
+    presence is pinned here by name (the trunk-pin pattern)."""
+    for name in ("durable_in", "durable_batches", "store_appends",
+                 "handoffs"):
+        assert name in native.STAT_NAMES, name
+    assert "store_append" in native.HIST_STAGES
+    assert "replay_drain" in native.HIST_STAGES
+    src = _src()
+    assert "kStDurableIn" in src and "kHistStoreAppend" in src
+    assert "kStHandoffs" in src and "kHistReplayDrain" in src
+
+
+def test_store_stat_names_match_store_h_enum():
+    """STORE_STAT_NAMES mirrors store.h's StoreStat enum the same way
+    STAT_NAMES mirrors host.cc's StatSlot (kSsFooBar <-> foo_bar)."""
+    store_h = os.path.join(os.path.dirname(HOST_CC), "store.h")
+    with open(store_h) as f:
+        src = f.read()
+    slots = re.findall(r"\bkSs([A-Z]\w*)\b", _enum_body(src, "StoreStat"))
+    slots = [s for s in slots if s != "StatCount"]
+    assert [_snake(s) for s in slots] == list(native.STORE_STAT_NAMES), (
+        "store.h StoreStat drifted from native.STORE_STAT_NAMES")
+
+
+def test_durable_fixed_metric_slots_render_at_zero():
+    """messages.durable.stored / .replayed are FIXED metric slots: they
+    render (at zero) in prometheus and ride the $SYS metrics heartbeat
+    before the first durable publish ever happens."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    m = Metrics()
+    assert m.val("messages.durable.stored") == 0
+    assert m.val("messages.durable.replayed") == 0
+    out = prometheus.render(metrics=m)
+    assert "emqx_messages_durable_stored" in out
+    assert "emqx_messages_durable_replayed" in out
+
+    seen = {}
+    hb = SysHeartbeat("n1", lambda msg: seen.__setitem__(
+        msg.topic, msg.payload), metrics=m)
+    hb.publish_metrics()
+    assert seen["$SYS/brokers/n1/metrics/messages.durable.stored"] == b"0"
+    assert seen["$SYS/brokers/n1/metrics/messages.durable.replayed"] == b"0"

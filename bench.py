@@ -1734,6 +1734,251 @@ def sec_durable() -> None:
 
 
 # ---------------------------------------------------------------------------
+# section: mixed (edge-gateway plane: MQTT-SN + retained; CPU by design)
+# ---------------------------------------------------------------------------
+
+def sec_mixed() -> None:
+    """ISSUE 6 acceptance: (a) native-SN publish throughput >= 10x the
+    asyncio gateway/mqttsn.py path on the same box, (b) retained COLD
+    delivery on the native snapshot >= 10x the Python retain-lookup
+    path, with per-stage broker histograms (sn_ingest, retain_deliver)
+    recorded; plus the mixed-protocol blast (TCP+WS+SN publishers on
+    ONE broker, topic/cid spaces salted apart so the planes share the
+    match table without cross-plane fan-out)."""
+    import asyncio
+    import select
+    import socket
+    import threading
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.broker.server import BrokerServer
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.gateway import mqttsn as SN
+
+    n_before = int(os.environ.get("BENCH_SN_BEFORE_MSGS", 1000))
+    n_blast = int(os.environ.get("BENCH_SN_BLAST_MSGS", 20000))
+    n_mixed = int(os.environ.get("BENCH_MIXED_MSGS", 12000))
+    n_ret = int(os.environ.get("BENCH_RETAIN_TOPICS", 2000))
+
+    # -- before: asyncio SN gateway (gateway/mqttsn.py), SAME loadgen -------
+    # the SN loadgen speaks the shared sn.h codec against either plane,
+    # so both arms see identical wire traffic
+    gw_state: dict = {}
+    gw_stop = threading.Event()
+    gw_ready = threading.Event()
+
+    def gw_main():
+        async def run_gw():
+            app = BrokerApp()
+            gw = app.gateway.load(SN.MqttsnGateway(port=0))
+            await gw.start_listeners()
+            gw_state["port"] = gw.port
+            gw_ready.set()
+            while not gw_stop.is_set():
+                await asyncio.sleep(0.05)
+            await gw.stop_listeners()
+        asyncio.run(run_gw())
+
+    th = threading.Thread(target=gw_main)
+    th.start()
+    assert gw_ready.wait(10), "asyncio SN gateway did not come up"
+    try:
+        before = native.loadgen_sn_run(
+            "127.0.0.1", gw_state["port"], n_subs=4, n_pubs=4,
+            msgs_per_pub=n_before, qos=0, payload_len=16,
+            idle_timeout_ms=8000, window=256)
+    finally:
+        gw_stop.set()
+        th.join()
+    before_rate = before["received"] / max(before["wall_ns"] / 1e9, 1e-9)
+    log(f"sn plane BEFORE (asyncio gateway/mqttsn.py, qos0 windowed): "
+        f"{before['received']}/{before['sent']} = {before_rate:,.0f} msg/s")
+    put("mixed", sn_asyncio_msgs_per_sec=round(before_rate))
+
+    # -- after: native SN gateway (sn.h in the C++ host) --------------------
+    server = NativeBrokerServer(port=0, app=BrokerApp(), ws_port=0,
+                                sn_port=0,
+                                session_opts={"max_inflight": 1024})
+    server.start()
+    try:
+        # identical pacing to the BEFORE arm (window + idle timeout):
+        # the ratio must measure the plane, not the window depth
+        sn = native.loadgen_sn_run(
+            "127.0.0.1", server.sn_port, n_subs=4, n_pubs=4,
+            msgs_per_pub=n_blast, qos=0, payload_len=16,
+            idle_timeout_ms=8000, window=256)
+        sn_rate = sn["received"] / max(sn["wall_ns"] / 1e9, 1e-9)
+        log(f"sn plane AFTER (native sn.h + fast path, qos0 windowed): "
+            f"{sn['received']}/{sn['sent']} = {sn_rate:,.0f} msg/s  "
+            f"({sn_rate / max(before_rate, 1):,.0f}x asyncio-sn)  "
+            f"p99={sn['p99_ns'] / 1e6:.3f}ms")
+        put("mixed",
+            sn_native_msgs_per_sec=round(sn_rate),
+            sn_native_p99_ms=round(sn["p99_ns"] / 1e6, 3),
+            sn_vs_asyncio=round(sn_rate / max(before_rate, 1), 1))
+
+        # qos1 rides the native ack plane (inflight bitmaps + SN PUBACK)
+        q1 = native.loadgen_sn_run(
+            "127.0.0.1", server.sn_port, n_subs=4, n_pubs=4,
+            msgs_per_pub=n_blast // 4, qos=1, payload_len=16, window=512)
+        q1_rate = q1["received"] / max(q1["wall_ns"] / 1e9, 1e-9)
+        log(f"sn plane qos1 (windowed 512): {q1_rate:,.0f} msg/s "
+            f"acks={q1['acks']} p99={q1['p99_ns'] / 1e6:.3f}ms")
+        put("mixed",
+            sn_native_qos1_msgs_per_sec=round(q1_rate),
+            sn_native_qos1_p99_ms=round(q1["p99_ns"] / 1e6, 3))
+
+        # -- mixed-protocol blast: TCP + WS + SN fleets on ONE broker -------
+        res: dict = {}
+
+        def tcp_arm():
+            res["tcp"] = native.loadgen_run(
+                "127.0.0.1", server.port, n_subs=4, n_pubs=4,
+                msgs_per_pub=n_mixed, qos=0, payload_len=16)
+
+        def ws_arm():
+            res["ws"] = native.loadgen_run(
+                "127.0.0.1", server.ws_port, n_subs=4, n_pubs=4,
+                msgs_per_pub=n_mixed, qos=0, payload_len=16, ws=True,
+                salt=100)
+
+        def sn_arm():
+            res["sn"] = native.loadgen_sn_run(
+                "127.0.0.1", server.sn_port, n_subs=4, n_pubs=4,
+                msgs_per_pub=n_mixed, qos=0, payload_len=16)
+
+        arms = [threading.Thread(target=f)
+                for f in (tcp_arm, ws_arm, sn_arm)]
+        t0 = time.time()
+        for a in arms:
+            a.start()
+        for a in arms:
+            a.join()
+        wall = time.time() - t0
+        total = sum(r["received"] for r in res.values())
+        per = {k: round(r["received"] / max(r["wall_ns"] / 1e9, 1e-9))
+               for k, r in res.items()}
+        log(f"mixed blast (TCP+WS+SN concurrent, qos0): "
+            f"{total} delivered in {wall:.2f}s = {total / wall:,.0f} msg/s "
+            f"aggregate  (tcp={per['tcp']:,} ws={per['ws']:,} "
+            f"sn={per['sn']:,} msg/s)")
+        put("mixed",
+            mixed_total_msgs_per_sec=round(total / wall),
+            mixed_tcp_msgs_per_sec=per["tcp"],
+            mixed_ws_msgs_per_sec=per["ws"],
+            mixed_sn_msgs_per_sec=per["sn"])
+        # broker-side stages incl. sn_ingest (sampled SN decode+dispatch)
+        put_broker_hists("mixed", server, "mixed_broker")
+    finally:
+        server.stop()
+
+    # -- retained delivery: Python retain-lookup vs native snapshot ---------
+    # identical measurement sink on both arms: a raw-socket subscriber
+    # (the shared module codec) timing SUBSCRIBE -> n_ret-th retained
+    # PUBLISH; cold = first wildcard subscribe on a fresh conn, warm =
+    # repeat on another fresh conn
+    def seed_retainer(app):
+        for i in range(n_ret):
+            app.retainer.store(Message(topic=f"bret/{i:05d}",
+                                       payload=b"r" * 16, qos=0,
+                                       flags={"retain": True}))
+
+    def measure_retained(port, tag):
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(mqtt_connect(b"ret-" + tag))
+        got = b""
+        while len(got) < 4:                    # CONNACK
+            got += s.recv(4096)
+        t0 = time.time()
+        s.sendall(mqtt_subscribe(1, b"bret/#"))
+        counts = [0]
+        buf = got[4:]
+        deadline = time.time() + 60
+        while counts[0] < n_ret and time.time() < deadline:
+            r, _, _ = select.select([s], [], [], 0.5)
+            if not r:
+                continue
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf = count_publishes(buf + chunk, counts)
+        wall = time.time() - t0
+        s.close()
+        return counts[0], wall
+
+    # Python arm: asyncio BrokerServer, retainer.match + per-msg deliver
+    py_state: dict = {}
+    py_stop = threading.Event()
+    py_ready = threading.Event()
+    app_py = BrokerApp()
+    seed_retainer(app_py)
+
+    def py_main():
+        async def run_py():
+            srv = BrokerServer(port=0, app=app_py)
+            await srv.start()
+            py_state["port"] = srv.port
+            py_ready.set()
+            while not py_stop.is_set():
+                await asyncio.sleep(0.05)
+            await srv.stop()
+        asyncio.run(run_py())
+
+    th = threading.Thread(target=py_main)
+    th.start()
+    assert py_ready.wait(10), "asyncio broker did not come up"
+    try:
+        py_cold_n, py_cold_wall = measure_retained(py_state["port"], b"c1")
+        py_warm_n, py_warm_wall = measure_retained(py_state["port"], b"c2")
+    finally:
+        py_stop.set()
+        th.join()
+    py_cold = py_cold_n / max(py_cold_wall, 1e-9)
+    py_warm = py_warm_n / max(py_warm_wall, 1e-9)
+    log(f"retained BEFORE (python retain-lookup, {n_ret} topics): "
+        f"cold {py_cold_n} in {py_cold_wall:.3f}s = {py_cold:,.0f} msg/s, "
+        f"warm {py_warm:,.0f} msg/s")
+    put("mixed",
+        retain_py_cold_msgs_per_sec=round(py_cold),
+        retain_py_warm_msgs_per_sec=round(py_warm))
+
+    # native arm: the retainer mirror installs the host-side snapshot
+    # at boot; SUBSCRIBE-triggered delivery resolves below the GIL
+    app_nat = BrokerApp()
+    seed_retainer(app_nat)
+    srv_ret = NativeBrokerServer(port=0, app=app_nat,
+                                 session_opts={"max_inflight": 1024})
+    srv_ret.start()
+    try:
+        nat_cold_n, nat_cold_wall = measure_retained(srv_ret.port, b"n1")
+        nat_warm_n, nat_warm_wall = measure_retained(srv_ret.port, b"n2")
+        nat_cold = nat_cold_n / max(nat_cold_wall, 1e-9)
+        nat_warm = nat_warm_n / max(nat_warm_wall, 1e-9)
+        st = srv_ret.fast_stats()
+        log(f"retained AFTER (native snapshot, {n_ret} topics): "
+            f"cold {nat_cold_n} in {nat_cold_wall:.3f}s = "
+            f"{nat_cold:,.0f} msg/s ({nat_cold / max(py_cold, 1):,.0f}x "
+            f"python cold), warm {nat_warm:,.0f} msg/s  "
+            f"retain_msgs_out={st['retain_msgs_out']}")
+        put("mixed",
+            retain_native_cold_msgs_per_sec=round(nat_cold),
+            retain_native_warm_msgs_per_sec=round(nat_warm),
+            retain_native_vs_py_cold=round(nat_cold / max(py_cold, 1), 1))
+        # broker-side retain_deliver stage (one SUBSCRIBE's snapshot
+        # match + encode + write batch)
+        put_broker_hists("mixed", srv_ret, "retain_broker")
+    finally:
+        srv_ret.stop()
+
+
+# ---------------------------------------------------------------------------
 # section: e2e (full broker stack with the device router on path)
 # ---------------------------------------------------------------------------
 
@@ -2014,6 +2259,7 @@ SECTIONS = {
     "ws": sec_ws,
     "trunk": sec_trunk,
     "durable": sec_durable,
+    "mixed": sec_mixed,
     "e2e": sec_e2e,
     "observe_overhead": sec_observe_overhead,
 }
@@ -2032,6 +2278,7 @@ DEVICE_PLAN = [
     ("ws", False, True, 400),
     ("trunk", False, True, 400),
     ("durable", False, True, 400),
+    ("mixed", False, True, 500),
     ("shared", False, True, 400),
     ("observe_overhead", False, True, 300),
 ]
@@ -2042,14 +2289,15 @@ CPU_PLAN = [
     ("ws", False, True, 400),
     ("trunk", False, True, 400),
     ("durable", False, True, 400),
+    ("mixed", False, True, 500),
     ("shared", False, True, 400),
     ("e2e", False, True, 600),
     ("observe_overhead", False, True, 300),
 ]
 
 _SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
-                  "shared", "host", "ws", "trunk", "durable", "e2e",
-                  "observe_overhead", "kernel_cpu"]
+                  "shared", "host", "ws", "trunk", "durable", "mixed",
+                  "e2e", "observe_overhead", "kernel_cpu"]
 
 
 def _probe_device(attempts: int, timeout_s: float, backoff_s: float) -> dict:

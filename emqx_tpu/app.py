@@ -46,6 +46,12 @@ class BrokerApp:
         # so the prometheus scrape carries the fast-path counters
         # (emqx_native_*) next to the node metrics
         self.native_stats_fn = None
+        # retained delivery on the native plane (round 11): set by the
+        # native server to (sid, topic, real, opts) -> bool; True means
+        # the host resolved+delivered the retained set below the GIL
+        # and the Python lookup must NOT run (a double delivery
+        # otherwise). None / False falls back to the retainer here.
+        self.native_retain_fn = None
         self.metrics = Metrics()
         self.stats = Stats()
         self.alarms = AlarmManager(on_change=self._on_alarm)
@@ -592,6 +598,9 @@ class BrokerApp:
         group, real = T.parse_share(topic)
         if group:
             return                      # shared subs get no retained msgs
+        fn = self.native_retain_fn
+        if fn is not None and fn(sid, topic, real, opts):
+            return                      # served below the GIL
         msgs = self.retainer.match(real)
         if msgs:
             self.cm.dispatch({sid: [(topic, m) for m in msgs]})

@@ -97,13 +97,18 @@ TRUNK_RETRY_S = 1.0          # redial cadence for a down trunk peer
 
 
 class _NativeConn:
-    __slots__ = ("conn_id", "channel", "server", "fast",
+    __slots__ = ("conn_id", "channel", "server", "fast", "sn",
                  "recv_budget", "native_cap")
 
     def __init__(self, server: "NativeBrokerServer", conn_id: int, peer: str):
         self.server = server
         self.conn_id = conn_id
         self.fast = False
+        # MQTT-SN datagram conns (peer "sn:..."): their frames arrive
+        # pre-translated to MQTT by the C++ gateway; the housekeep
+        # keepalive feed covers them even when not fast (UDP peers
+        # never deliver a socket-close signal)
+        self.sn = peer.startswith("sn:")
         self.recv_budget = 0     # receive-maximum budget split across planes
         self.native_cap = 0      # the native plane's current share
         pipeline = server.pipeline
@@ -150,6 +155,10 @@ class NativeBrokerServer:
         durable_dir: Optional[str] = None,
         durable_fsync: Optional[str] = None,
         durable_segment_bytes: Optional[int] = None,
+        sn_port: Optional[int] = None,
+        sn_host: Optional[str] = None,
+        sn_gateway_id: int = 1,
+        sn_predefined: Optional[dict] = None,
     ):
         if not native.available():
             raise RuntimeError(
@@ -195,6 +204,19 @@ class NativeBrokerServer:
         if trunk_port is not None:
             self.trunk_port = self.host.trunk_listen(
                 trunk_host or host, trunk_port)
+        # -- mqtt-sn gateway plane (round 11) -------------------------------
+        # A third C++ listener speaks MQTT-SN 1.2 over UDP: the host
+        # decodes datagrams with the shared sn.h codec, translates them
+        # into MQTT frames, and SN clients ride the SAME permit/punt/
+        # lane/tap/ack-plane machinery as TCP and WS — only the framing
+        # differs. gateway/mqttsn.py stays the asyncio oracle and the
+        # deployment fallback when this listener is off (sn_port=None).
+        self.sn_port: Optional[int] = None
+        if sn_port is not None:
+            self.sn_port = self.host.listen_sn(sn_host or host, sn_port,
+                                               sn_gateway_id)
+            for tid, t in (sn_predefined or {}).items():
+                self.host.sn_predefined(int(tid), t)
         # node name → {"id", "addr", "port", "up", } under _mirror_lock
         self._trunk_peers: dict[str, dict] = {}
         self._trunk_id_nodes: dict[int, str] = {}   # peer id → node name
@@ -218,6 +240,11 @@ class NativeBrokerServer:
                    if self.app is not None else 500)
         self.host.set_telemetry(self.telemetry, slow_ack_ms=slow_ms)
         self._slow_ack_ms = slow_ms
+        # per-message stage sampling override for bench runs (README
+        # "Observability": default 1-in-8, hist deltas flush ~100ms)
+        shift = os.environ.get("EMQX_NATIVE_TELEMETRY_SHIFT", "")
+        if shift.isdigit():
+            self.host.set_telemetry_shift(int(shift))
         # recent flight-recorder dumps: (conn_id, reason, entries)
         self.flight_records: deque = deque(maxlen=64)
         # conns currently trace-punted in C++ (clientid-filter traces);
@@ -297,8 +324,26 @@ class NativeBrokerServer:
                 log.warning("durable store unavailable (%s); persistent "
                             "sessions stay on the punt path", e)
                 self._durable_store = None
+        # -- retained snapshot (round 11) -----------------------------------
+        # services/retainer.py stays the authoritative store + oracle;
+        # its observer stream mirrors every store/delete/expire into a
+        # host-side read-only snapshot so SUBSCRIBE-triggered retained
+        # delivery (TCP, WS, SN alike) resolves and writes below the
+        # GIL. Messages carrying v5 properties cannot be encoded by the
+        # fast path — ANY unmirrorable topic degrades the whole seam to
+        # the Python lookup (always correct, never a partial set).
+        self._retain_unmirrorable: set = set()
+        self._retain_mirrored = False
+        self._frame_conn: Optional[_NativeConn] = None
+        self._poll_ident: Optional[int] = None
         self.conns: dict[int, _NativeConn] = {}
         self._stop = threading.Event()
+        if self.fast_path and app is not None:
+            # replay-then-attach under the store lock: no mutation can
+            # slip between the boot snapshot and observer registration
+            app.retainer.mirror_attach(self._on_retained_event)
+            app.native_retain_fn = self._native_retained
+            self._retain_mirrored = True
         self._thread: Optional[threading.Thread] = None
         self._last_housekeep = time.monotonic()
         self._tick_running = threading.Event()
@@ -505,6 +550,53 @@ class NativeBrokerServer:
 
     def fast_stats(self) -> dict[str, int]:
         return self.host.stats()
+
+    # -- retained snapshot (round 11) ---------------------------------------
+
+    def _on_retained_event(self, op: str, topic: str, msg,
+                           deadline_ms: int) -> None:
+        """Retainer observer: mirror one store/delete into the host
+        snapshot. Fired under the retainer lock from any thread —
+        host ops enqueue + wake, never block."""
+        if self._stop.is_set():
+            return
+        if op == "del":
+            self._retain_unmirrorable.discard(topic)
+            self.host.retain_del(topic)
+            return
+        props = (msg.headers or {}).get("properties") or {}
+        # the native encode carries no v5 property section (fast-path
+        # contract); a message with properties (Message-Expiry included
+        # — Python forwards the REMAINING interval on delivery) would
+        # lose them on the native wire, so those stay Python-served
+        if props:
+            self._retain_unmirrorable.add(topic)
+            self.host.retain_del(topic)
+            return
+        self._retain_unmirrorable.discard(topic)
+        self.host.set_retained(topic, bytes(msg.payload or b""),
+                               int(msg.qos or 0), deadline_ms)
+
+    def _native_retained(self, sid: str, topic: str, real: str,
+                         opts) -> bool:
+        """app.native_retain_fn seam (called inside the
+        session.subscribed hook): serve this subscription's retained
+        set below the GIL when the subscriber is THIS server's live
+        fast conn. Degradation ladder: any unmirrorable message, a
+        non-fast/foreign subscriber, or an off-poll-thread call falls
+        back to the Python retainer lookup (always correct)."""
+        if self._retain_unmirrorable or self._stop.is_set():
+            return False
+        if threading.get_ident() != self._poll_ident:
+            return False          # another server/transport owns this sub
+        conn = self._frame_conn   # the conn whose frame is being handled
+        if (conn is None or not conn.fast
+                or conn.channel.clientid != sid
+                or conn.channel.conn_state != "connected"):
+            return False
+        self.host.retain_deliver(conn.conn_id, real,
+                                 int(getattr(opts, "qos", 0) or 0))
+        return True
 
     # -- device match lane --------------------------------------------------
     # Permitted PUBLISHes park in C++ while their topics ride batched
@@ -1625,6 +1717,11 @@ class NativeBrokerServer:
 
     def _on_frame(self, conn: _NativeConn, frame: bytes) -> None:
         ch = conn.channel
+        # context for the native retained seam: the session.subscribed
+        # hook fires INSIDE handle_in, and _native_retained must know
+        # which conn's SUBSCRIBE it is serving (poll thread only, so a
+        # plain attribute is race-free)
+        self._frame_conn = conn
         try:
             pkt = parse_one(frame, ch.conninfo.proto_ver)
             if pkt.type == P.CONNECT:
@@ -1644,6 +1741,8 @@ class NativeBrokerServer:
             log.exception("channel error from %s", ch.conninfo.peername)
             self._drop(conn, "channel_error")
             return
+        finally:
+            self._frame_conn = None
         conn._send_packets(out)
         if ch.conn_state == "disconnected":
             self._drop(conn, "normal")
@@ -2008,9 +2107,12 @@ class NativeBrokerServer:
                 self.flush_permits()
         for conn in list(self.conns.values()):
             ch = conn.channel
-            if conn.fast:
-                # fast-path frames never reach the channel; feed its
-                # keepalive clock from the C++ side's last-read stamp
+            if conn.fast or conn.sn:
+                # fast-path frames never reach the channel (and SN
+                # keepalive/sleep state lives wholly in C++): feed the
+                # keepalive clock from the host's last-read stamp — a
+                # sleeping SN client reads as idle 0 until its
+                # announced wake deadline
                 idle = self.host.conn_idle_ms(conn.conn_id)
                 if idle >= 0:
                     ch.last_packet_at = max(
@@ -2089,6 +2191,7 @@ class NativeBrokerServer:
         self._thread.start()
 
     def _run(self) -> None:
+        self._poll_ident = threading.get_ident()
         while not self._stop.is_set():
             try:
                 self._step(timeout_ms=50)
@@ -2127,6 +2230,13 @@ class NativeBrokerServer:
             self.broker.sub_observers.remove(self._on_sub_event)
         except ValueError:
             pass
+        if self._retain_mirrored and self.app is not None:
+            try:
+                self.app.retainer.observers.remove(self._on_retained_event)
+            except ValueError:
+                pass
+            if self.app.native_retain_fn == self._native_retained:
+                self.app.native_retain_fn = None
         try:
             self.broker.router.route_observers.remove(self._on_route_event)
         except ValueError:

@@ -27,6 +27,12 @@ PINGREQ, PINGRESP, DISCONNECT = 0x16, 0x17, 0x18
 
 RC_ACCEPTED, RC_CONGESTION, RC_INVALID_TOPIC_ID, RC_NOT_SUPPORTED = 0, 1, 2, 3
 
+# the long-form length prefix is a u16: no SN message may exceed 65535
+# wire bytes (§5.2.1). Oversized deliveries DROP at the translation seam
+# on both planes (sn.h kMaxPayload/kMaxTopic mirror these).
+MAX_PAYLOAD = 0xFFFF - 9          # PUBLISH overhead: long-form 2 + 7
+MAX_TOPIC = 0xFFFF - 8            # REGISTER overhead: long-form 2 + 6
+
 # flag bits
 F_DUP, F_RETAIN, F_WILL, F_CLEAN = 0x80, 0x10, 0x08, 0x04
 TID_NORMAL, TID_PREDEF, TID_SHORT = 0, 1, 2
@@ -66,10 +72,16 @@ class Frame(GwFrame):
                 (ln,) = struct.unpack_from(">H", data, 1)
                 if ln < 4:          # length covers the 3-byte prefix + type
                     break           # malformed: refuse, don't spin
+                if ln > len(data):  # truncated: refuse (a shorter slice
+                    break           # would crash the body parse below —
+                                    # oracle-parity audit; the native
+                                    # codec stops at the same boundary)
                 body, data = data[3:ln], data[ln:]
             else:
                 ln = data[0]
                 if ln < 2:          # ln==0/1 would not consume any bytes
+                    break
+                if ln > len(data):  # truncated datagram: refuse
                     break
                 body, data = data[1:ln], data[ln:]
             if body:
@@ -152,10 +164,20 @@ class Frame(GwFrame):
         elif t == SUBACK:
             body = bytes([t, m.flags]) + struct.pack(
                 ">HH", m.topic_id, m.msg_id) + bytes([m.rc])
-        elif t in (PINGREQ, PINGRESP):
+        elif t == PINGREQ:
+            # a sleeping client's wake ping carries its clientid
+            # (MQTT-SN §5.4.21) — the old bare serialization couldn't
+            # round-trip what parse() reads (oracle-parity audit)
+            body = bytes([t]) + m.clientid.encode()
+        elif t == PINGRESP:
             body = bytes([t])
         elif t == DISCONNECT:
+            # duration > 0 = the client announces sleep (§5.4.22); the
+            # old serializer dropped it, so a real client built on this
+            # codec could never ENTER sleep mode (oracle-parity audit)
             body = bytes([t])
+            if m.duration:
+                body += struct.pack(">H", m.duration)
         elif t == GWINFO:
             body = bytes([t, m.rc])
         elif t == ADVERTISE:
@@ -189,6 +211,10 @@ class Channel(GwChannel):
         self.id_of_topic: dict[str, int] = {}
         self._next_tid = 0
         self._next_mid = 0
+        # publisher-side qos2 exactly-once: msg ids published but not
+        # yet released (the broker-side "method B" hold, like the core
+        # session's awaiting-rel set)
+        self._awaiting_rel: set[int] = set()
         self.awake = True
         self._sleep_buffer: list = []   # deliveries parked during sleep
         self.max_sleep_buffer = 1000    # drop-oldest past this (mqueue-ish)
@@ -197,7 +223,17 @@ class Channel(GwChannel):
     def _alloc_tid(self, topic: str) -> int:
         tid = self.id_of_topic.get(topic)
         if tid is None:
-            self._next_tid += 1
+            # wrap in 1..0xFFFE skipping ids still in use — 0x0000 AND
+            # 0xFFFF are both reserved (§5.3.11); the old unbounded
+            # counter overflowed struct.pack(">H") after 65535
+            # registrations (oracle-parity audit: the native registry
+            # wraps, this one crashed)
+            for _ in range(0xFFFE):
+                self._next_tid = self._next_tid % 0xFFFE + 1
+                if self._next_tid not in self.topic_of_id:
+                    break
+            else:
+                return 0            # registry full: no id assignable
             tid = self._next_tid
             self.id_of_topic[topic] = tid
             self.topic_of_id[tid] = topic
@@ -247,6 +283,18 @@ class Channel(GwChannel):
             self.ctx.open_session(self.clientid, self)
             self._session_open = True
             self.conn_state = "connected"
+            # every (re-)CONNECT starts fresh per-session gateway state
+            # — native-plane parity (a re-CONNECT there is a brand-new
+            # conn): the topic-id registry, the qos2 dedup set, and
+            # sleep state do not survive the session boundary. A stale
+            # _awaiting_rel entry would otherwise swallow a rebooted
+            # client's qos2 publish reusing the same msg id (PUBREC
+            # answered, ctx.publish skipped — silent loss).
+            self.id_of_topic = {}
+            self.topic_of_id = {}
+            self._awaiting_rel = set()
+            self.awake = True
+            self._sleep_buffer = []
             return [SnMessage(CONNACK, rc=RC_ACCEPTED)]
         if t == PUBLISH and qos_of(m.flags) == -1:
             # QoS -1: fire-and-forget on a predefined/short topic,
@@ -262,8 +310,11 @@ class Channel(GwChannel):
                     if t not in (PINGREQ, DISCONNECT) else [])
         if t == REGISTER:
             tid = self._alloc_tid(m.topic_name)
+            # tid 0 is the reserved invalid id: a full registry answers
+            # "rejected: congestion" (native-plane parity), never a
+            # success carrying an id the client cannot publish on
             return [SnMessage(REGACK, topic_id=tid, msg_id=m.msg_id,
-                              rc=RC_ACCEPTED)]
+                              rc=RC_ACCEPTED if tid else RC_CONGESTION)]
         if t == PUBLISH:
             topic = self._resolve(m)
             qos = max(0, qos_of(m.flags))
@@ -272,12 +323,28 @@ class Channel(GwChannel):
                                    msg_id=m.msg_id,
                                    rc=RC_INVALID_TOPIC_ID)]
                         if qos > 0 else [])
+            if qos == 2:
+                # exactly-once, broker "method B" (publish on PUBLISH,
+                # hold the id until PUBREL): the old code answered
+                # PUBACK to a qos2 publish — a spec violation (§6.13
+                # mandates PUBREC) AND a double-publish on every DUP
+                # retransmit (oracle-parity audit)
+                if m.msg_id not in self._awaiting_rel:
+                    self._awaiting_rel.add(m.msg_id)
+                    self.ctx.publish(self.clientid, topic, m.data, qos,
+                                     retain=bool(m.flags & F_RETAIN))
+                return [SnMessage(PUBREC, msg_id=m.msg_id)]
             self.ctx.publish(self.clientid, topic, m.data, qos,
                              retain=bool(m.flags & F_RETAIN))
             if qos > 0:
                 return [SnMessage(PUBACK, topic_id=m.topic_id,
                                   msg_id=m.msg_id, rc=RC_ACCEPTED)]
             return []
+        if t == PUBREL:
+            # release half of the qos2 exchange; a PUBREL for an id we
+            # no longer hold still completes with PUBCOMP [MQTT-4.3.3]
+            self._awaiting_rel.discard(m.msg_id)
+            return [SnMessage(PUBCOMP, msg_id=m.msg_id)]
         if t == SUBSCRIBE:
             kind = m.flags & 0x3
             if kind == TID_PREDEF:
@@ -292,7 +359,11 @@ class Channel(GwChannel):
                 return [SnMessage(SUBACK, flags=m.flags, topic_id=0,
                                   msg_id=m.msg_id,
                                   rc=RC_INVALID_TOPIC_ID)]
-            qos = max(0, qos_of(m.flags))
+            # grant what delivery can honour: handle_deliver caps every
+            # outbound PUBLISH at qos1, so granting a requested qos2 was
+            # a lie on the wire (oracle-parity audit — the native plane
+            # grants the same cap)
+            qos = min(1, max(0, qos_of(m.flags)))
             if not self.ctx.subscribe(self.clientid, topic, qos):
                 return [SnMessage(SUBACK, flags=m.flags, topic_id=0,
                                   msg_id=m.msg_id, rc=RC_NOT_SUPPORTED)]
@@ -337,10 +408,21 @@ class Channel(GwChannel):
         out: list[SnMessage] = []
         for _sub_topic, msg in deliveries:
             topic = self.ctx.unmount(msg.topic)
+            if (len(msg.payload) > MAX_PAYLOAD
+                    or len(topic.encode()) > MAX_TOPIC):
+                # can't fit the u16 wire length: drop, exactly like the
+                # native plane — serializing would raise mid-delivery
+                continue
             tid = self.id_of_topic.get(topic)
             if tid is None:
                 # auto-register so the client can decode the id
                 tid = self._alloc_tid(topic)
+                if not tid:
+                    # registry full: nothing deliverable on this topic —
+                    # drop, exactly like the native plane (SnDeliverTid
+                    # returns 0 and bails); emitting the reserved id 0
+                    # on the wire would be a protocol violation
+                    continue
                 out.append(SnMessage(REGISTER, topic_id=tid,
                                      msg_id=self._mid(),
                                      topic_name=topic))

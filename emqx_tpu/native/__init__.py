@@ -198,6 +198,36 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_note_stage.restype = ctypes.c_int
     lib.emqx_host_note_stage.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64]
+    lib.emqx_host_listen_sn.restype = ctypes.c_int
+    lib.emqx_host_listen_sn.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+    lib.emqx_host_sn_predefined.restype = ctypes.c_int
+    lib.emqx_host_sn_predefined.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint16, ctypes.c_char_p]
+    lib.emqx_host_set_retained.restype = ctypes.c_int
+    lib.emqx_host_set_retained.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint64]
+    lib.emqx_host_retain_del.restype = ctypes.c_int
+    lib.emqx_host_retain_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.emqx_host_retain_deliver.restype = ctypes.c_int
+    lib.emqx_host_retain_deliver.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint8]
+    lib.emqx_host_set_telemetry_shift.restype = ctypes.c_int
+    lib.emqx_host_set_telemetry_shift.argtypes = [
+        ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_sn_roundtrip.restype = ctypes.c_long
+    lib.emqx_sn_roundtrip.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_loadgen_run_sn.restype = ctypes.c_int
+    lib.emqx_loadgen_run_sn.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint8,
+        ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64)]
     lib.emqx_subtable_match_filter.restype = ctypes.c_long
     lib.emqx_subtable_match_filter.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
@@ -232,7 +262,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32,
         ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint32, ctypes.c_int,
         ctypes.c_int, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_uint64)]
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
     lib.emqx_host_destroy.restype = None
     lib.emqx_host_destroy.argtypes = [ctypes.c_void_p]
     lib.emqx_framer_create.restype = ctypes.c_void_p
@@ -455,7 +485,11 @@ HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
                # store write (+policy fsync); replay_drain = resume
                # replay fetch+consume+decode (noted by Python via
                # emqx_host_note_stage on the poll thread)
-               "store_append", "replay_drain")
+               "store_append", "replay_drain",
+               # edge-gateway plane (round 11): sn_ingest = sampled SN
+               # datagram decode+dispatch; retain_deliver = one
+               # SUBSCRIBE-triggered retained snapshot lookup+write
+               "sn_ingest", "retain_deliver")
 
 # flight-recorder event codes (host.cc FrEvent)
 FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
@@ -538,14 +572,17 @@ def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
                 msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
                 proto_ver: int = 4, idle_timeout_ms: int = 5000,
                 window: int = 0, warmup: bool = True,
-                ws: bool = False) -> dict:
+                ws: bool = False, salt: int = 0) -> dict:
     """Run the native load generator (loadgen.cc) against a broker.
     Blocks for the duration of the run (ctypes releases the GIL, so an
     in-process broker keeps serving). ``window=0`` blasts for peak
     throughput; ``window>0`` caps total in-flight messages so the
     latency percentiles measure the broker, not loadgen queue depth.
     ``ws=True`` runs the fleet over MQTT-over-WebSocket (point ``port``
-    at a WS listener). Returns sent/received counts, wall ns and
+    at a WS listener). ``salt`` offsets clientids AND the lg/<i> topic
+    space so two fleets (e.g. the mixed bench's TCP + WS arms) can run
+    concurrently against one broker without takeover kicks or
+    cross-plane fan-out. Returns sent/received counts, wall ns and
     latency percentiles."""
     lib = load()
     if lib is None:
@@ -554,12 +591,52 @@ def loadgen_run(host: str, port: int, n_subs: int, n_pubs: int,
     rc = lib.emqx_loadgen_run(host.encode(), port, n_subs, n_pubs,
                               msgs_per_pub, qos, payload_len, proto_ver,
                               idle_timeout_ms, window, int(warmup),
-                              int(ws), out)
+                              int(ws), int(salt), out)
     if rc != 0:
         raise RuntimeError(f"loadgen failed rc={rc}")
     keys = ("sent", "received", "wall_ns", "p50_ns", "p99_ns", "max_ns",
             "acks", "errors")
     return dict(zip(keys, out))
+
+
+def loadgen_sn_run(host: str, port: int, n_subs: int, n_pubs: int,
+                   msgs_per_pub: int, qos: int = 0, payload_len: int = 16,
+                   idle_timeout_ms: int = 5000, window: int = 0,
+                   warmup: bool = True) -> dict:
+    """Run the MQTT-SN/UDP load generator (loadgen.cc, the shared sn.h
+    codec) against an SN gateway port — the native host's or the
+    asyncio gateway's, so the mixed bench can compare the two planes on
+    identical wire traffic. Pacing is always windowed (UDP has no
+    transport backpressure); ``window=0`` defaults to 1024."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = (ctypes.c_uint64 * 8)()
+    rc = lib.emqx_loadgen_run_sn(host.encode(), port, n_subs, n_pubs,
+                                 msgs_per_pub, qos, payload_len,
+                                 idle_timeout_ms, window, int(warmup),
+                                 out)
+    if rc != 0:
+        raise RuntimeError(f"sn loadgen failed rc={rc}")
+    keys = ("sent", "received", "wall_ns", "p50_ns", "p99_ns", "max_ns",
+            "acks", "errors")
+    return dict(zip(keys, out))
+
+
+def sn_roundtrip(data: bytes) -> tuple[int, bytes]:
+    """Parse + re-serialize SN datagram bytes with the NATIVE codec
+    (sn.h); returns (message count, reserialized bytes). The codec
+    parity test drives the Python oracle through the same vectors."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    n = lib.emqx_sn_roundtrip(data, len(data), ctypes.byref(out),
+                              ctypes.byref(out_len))
+    raw = ctypes.string_at(out, out_len.value)
+    lib.emqx_buf_free(out)
+    return int(n), raw
 
 
 class NativeSubTable:
@@ -673,7 +750,11 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "trunk_batches_in", "trunk_punts", "trunk_replays",
               "trunk_shed",
               "durable_in", "durable_batches", "store_appends",
-              "handoffs")
+              "handoffs",
+              "sn_in", "sn_out", "sn_qos_m1", "sn_pings",
+              "sn_registers", "sn_sleep_parked", "sn_drops_oversize",
+              "retain_set", "retain_del", "retain_deliver",
+              "retain_msgs_out")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
@@ -801,6 +882,7 @@ class NativeHost:
         self.port = self._lib.emqx_host_port(self._h)
         self.ws_port = 0       # set by listen_ws()
         self.trunk_port = 0    # set by trunk_listen()
+        self.sn_port = 0       # set by listen_sn()
         # The poll buffer must hold at least one whole event record: 13-byte
         # header + payload up to max_size (a max-size PUBLISH frame).  A
         # smaller buffer would leave host.cc unable to ever deliver that
@@ -974,6 +1056,54 @@ class NativeHost:
         except ValueError:
             return -1
         return int(self._lib.emqx_host_note_stage(self._h, idx, int(ns)))
+
+    # -- mqtt-sn gateway + retained snapshot (round 11) ---------------------
+
+    def listen_sn(self, host: str = "127.0.0.1", port: int = 0,
+                  gw_id: int = 1) -> int:
+        """Open the MQTT-SN/UDP gateway socket (BEFORE the poll thread
+        starts). Datagram peers become conns on their first CONNECT;
+        their OPEN events carry an ``sn:ip:port`` peer string. Returns
+        the bound port."""
+        p = self._lib.emqx_host_listen_sn(self._h, host.encode(), port,
+                                          int(gw_id))
+        if p < 0:
+            raise OSError(f"cannot bind sn listener {host}:{port}")
+        self.sn_port = p
+        return p
+
+    def sn_predefined(self, topic_id: int, topic: Optional[str]) -> None:
+        """Install (or, with ``topic=None``, forget) a gateway-wide
+        predefined topic id (MQTT-SN predefined id space)."""
+        self._lib.emqx_host_sn_predefined(
+            self._h, topic_id, (topic or "").encode())
+
+    def set_retained(self, topic: str, payload: bytes, qos: int,
+                     deadline_ms: int = 0) -> None:
+        """Mirror one retained message into the host-side snapshot.
+        ``deadline_ms`` is the EFFECTIVE absolute wall-clock expiry
+        (0 = never) — the caller folds per-message and store-default
+        expiry into one number."""
+        self._lib.emqx_host_set_retained(
+            self._h, topic.encode(), payload, len(payload), qos,
+            int(deadline_ms))
+
+    def retain_del(self, topic: str) -> None:
+        self._lib.emqx_host_retain_del(self._h, topic.encode())
+
+    def retain_deliver(self, conn: int, filter_: str,
+                       max_qos: int = 0) -> None:
+        """Deliver every live retained message matching ``filter_`` to
+        ``conn`` below the GIL (retain=1, qos capped at ``max_qos``;
+        elevated qos rides the native ack plane)."""
+        self._lib.emqx_host_retain_deliver(self._h, conn,
+                                           filter_.encode(), max_qos)
+
+    def set_telemetry_shift(self, shift: int) -> None:
+        """Per-message telemetry sampling override: stages sample
+        1-in-2^shift (default 3 = the documented 1-in-8). Out-of-range
+        values reset the default."""
+        self._lib.emqx_host_set_telemetry_shift(self._h, int(shift))
 
     def set_inflight_cap(self, conn: int, cap: int) -> None:
         """Re-divide a conn's receive-maximum budget: set the native

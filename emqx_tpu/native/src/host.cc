@@ -155,6 +155,7 @@
 
 #include "frame.h"
 #include "router.h"
+#include "sn.h"
 #include "store.h"
 #include "trunk.h"
 #include "ws.h"
@@ -214,6 +215,9 @@ enum HistStage {
   kHistReplayDrain,       // resume replay: store fetch+consume+decode
                           // (stamped by Python via emqx_host_note_stage;
                           // poll-thread-only like conn_idle_ms)
+  kHistSnIngest,          // sampled: SN datagram decode+dispatch
+  kHistRetainDeliver,     // retained snapshot: match+encode+write per
+                          // SUBSCRIBE-triggered delivery op
   kHistCount
 };
 
@@ -354,6 +358,53 @@ struct WsConnState {
   ws::WsDecoder dec{/*require_mask=*/true};  // clients MUST mask (§5.3)
 };
 
+// One tracked qos1 SN delivery awaiting its SN PUBACK: a full datagram
+// copy (resent with DUP set on timeout) + the flags-byte offset to
+// patch. The inflight BITMAP stays the authority — this is only the
+// bytes needed to retransmit, retired by the same PUBACK that clears
+// the bit.
+struct SnInflightRx {
+  uint16_t pid;
+  std::string dgram;
+  size_t flags_off;
+  uint64_t last_tx_ms;
+  uint8_t tries;
+};
+
+// Per-connection MQTT-SN transport state (round 11), allocated only
+// for datagram peers on the SN listener — TCP/WS conns pay nothing.
+// The conn has no socket of its own: egress rides sendto() on the
+// shared UDP fd, keyed by `addr`.
+struct SnConnState {
+  sockaddr_in addr{};
+  uint64_t conn_id = 0;     // this conn's id (for egress-side drains)
+  bool anon = false;        // the shared QoS -1 publisher (no egress)
+  bool connect_sent = false;  // MQTT CONNECT forwarded to Python
+  bool connected = false;     // CONNACK rc=0 observed on egress
+  bool connack_seen = false;  // any CONNACK observed (accept or reject)
+  // messages pipelined into the CONNECT->CONNACK round trip; the
+  // oracle connects synchronously so these must succeed, not bounce
+  std::deque<sn::SnMsg> preconn;
+  std::string clientid;
+  bool awake = true;          // sleep mode (§6.14): deliveries park
+  uint64_t sleep_until_ms = 0;  // announced wake deadline (keepalive)
+  // per-client NORMAL topic-id registry (emqx_sn_registry.erl); the
+  // predefined table is gateway-wide and lives on the Host
+  std::unordered_map<uint16_t, std::string> topic_of_id;
+  std::unordered_map<std::string, uint16_t> id_of_topic;
+  uint16_t next_tid = 0;
+  uint16_t next_mid = 0;
+  // egress-translation context: MQTT msg-id -> the SN fields the SN
+  // reply needs but the MQTT packet no longer carries
+  std::unordered_map<uint16_t, uint16_t> pub_tid;   // PUBACK topic id
+  std::unordered_map<uint16_t, uint32_t> sub_tid;   // (flags<<16)|tid
+  // Python-plane egress bytes are an MQTT byte stream; this framer
+  // splits them so each packet translates to one SN datagram
+  Framer egress{1 << 20};
+  std::deque<std::string> sleep_buf;   // parked datagrams, drop-oldest
+  std::vector<SnInflightRx> rexmit;    // qos1 deliveries awaiting ack
+};
+
 struct Conn {
   int fd = -1;
   Framer framer;
@@ -361,6 +412,7 @@ struct Conn {
   size_t outpos = 0;
   bool want_close = false;  // close once outbuf drains
   std::unique_ptr<WsConnState> ws;  // non-null = WebSocket transport
+  std::unique_ptr<SnConnState> sn;  // non-null = MQTT-SN datagram conn
   // -- fast path ----------------------------------------------------------
   bool fast = false;        // Python enabled the PUBLISH fast path
   uint8_t proto_ver = 4;    // 4 = MQTT 3.1.1, 5 = MQTT 5
@@ -412,6 +464,19 @@ constexpr uint64_t kTrunkSockBit = 1ull << 63;
 // ring itself may overshoot by the in-flight cycle — a soft bound).
 constexpr size_t kTrunkUnackedMax = 512;
 
+// -- mqtt-sn gateway bounds (round 11) --------------------------------------
+// Datagram conns get their own id range (the ISSUE's "own conn-id
+// range"): below the durable-owner (1<<61) and trunk-owner (1<<62)
+// namespaces, above any TCP/WS conn id the sequential counter could
+// ever reach and above the Python punt-token space (1<<48).
+constexpr uint64_t kSnConnBit = 1ull << 59;
+// qos1 delivery retransmit-on-timeout (UDP loses datagrams; TCP conns
+// never need this — the transport retransmits): resend with DUP after
+// kSnRetryMs, abandon the delivery (freeing its inflight slot like a
+// PUBACK would) after kSnMaxRetries attempts.
+constexpr uint64_t kSnRetryMs = 1000;
+constexpr uint8_t kSnMaxRetries = 3;
+
 // Fast-path control ops enqueued from Python threads, applied on the
 // poll thread (ApplyPending) so they serialize with matching.
 struct Op {
@@ -420,12 +485,14 @@ struct Op {
     kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos,
     kSetInflightCap, kSetTrace, kSetTelemetry,
     kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
-    kDurableAdd, kDurableDel
+    kDurableAdd, kDurableDel,
+    kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift
   };
   Kind kind;
   uint64_t owner = 0;
-  uint64_t token = 0;    // shared-group identity
+  uint64_t token = 0;    // shared-group identity / retained deadline
   std::string str;       // filter / topic
+  std::string str2;      // retained payload
   uint8_t qos = 0;
   uint8_t flags = 0;
   uint8_t proto_ver = 4;
@@ -477,16 +544,19 @@ enum StatSlot {
   kStDurableBatches,   // kind-10 store/event records flushed
   kStStoreAppends,     // message entries appended to the durable store
   kStHandoffs,         // demotion handoffs emitted (kind 11)
+  kStSnIn,             // SN PUBLISHes ingested over UDP (any qos >= 0)
+  kStSnOut,            // SN PUBLISH deliveries encoded (sent or parked)
+  kStSnQosM1,          // QoS -1 publish-without-connect datagrams
+  kStSnPings,          // SN PINGREQs handled (wake + keepalive)
+  kStSnRegisters,      // client REGISTERs answered with REGACK
+  kStSnSleepParked,    // deliveries parked for a sleeping client
+  kStSnDropsOversize,  // deliveries exceeding the SN u16 wire limit
+  kStRetainSet,        // retained-snapshot entries installed/updated
+  kStRetainDel,        // retained-snapshot entries removed
+  kStRetainDeliver,    // SUBSCRIBE-triggered native retained lookups
+  kStRetainMsgsOut,    // retained messages delivered below the GIL
   kStatCount
 };
-
-// Append one MQTT byte span to a conn's socket buffer; WS conns get it
-// wrapped in a binary frame (one frame per serialized span, matching
-// the asyncio server's one-frame-per-packet-batch shape).
-inline void AppendMqtt(Conn& c, const char* data, size_t len) {
-  if (c.ws) ws::AppendFrameHeader(&c.outbuf, ws::kOpBinary, len);
-  c.outbuf.append(data, len);
-}
 
 std::string EncodeRecord(uint8_t kind, uint64_t id, const char* data,
                          size_t len) {
@@ -512,11 +582,13 @@ class Host {
       : max_size_(max_size), max_conns_(max_conns) {}
 
   ~Host() {
-    for (auto& [id, c] : conns_) close(c.fd);
+    for (auto& [id, c] : conns_)
+      if (c.fd >= 0) close(c.fd);  // SN conns share the listener fd
     for (auto& [tag, s] : trunk_socks_) close(s.fd);
     if (listen_fd_ >= 0) close(listen_fd_);
     if (listen_ws_fd_ >= 0) close(listen_ws_fd_);
     if (listen_trunk_fd_ >= 0) close(listen_trunk_fd_);
+    if (sn_fd_ >= 0) close(sn_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
     if (epoll_fd_ >= 0) close(epoll_fd_);
   }
@@ -620,6 +692,47 @@ class Host {
     return trunk_port_;
   }
 
+  // Open the MQTT-SN/UDP gateway socket (call BEFORE the poll thread
+  // starts, like the other listeners — it mutates the epoll set from
+  // the caller's thread). One datagram socket serves every SN client;
+  // per-peer conns are minted on their first CONNECT. Returns the
+  // bound port, or -1.
+  int ListenSn(const char* bind_addr, uint16_t port, int gw_id) {
+    if (sn_fd_ >= 0) return -1;  // one SN listener per host
+    int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    // a datagram blast landing between two poll cycles must queue in
+    // the kernel, not drop at the default (small) socket buffers
+    int buf = 4 << 20;
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1 ||
+        bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      close(fd);
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenSnTag;
+    if (epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev) < 0) {
+      close(fd);
+      return -1;
+    }
+    sn_fd_ = fd;
+    sn_port_ = ntohs(addr.sin_port);
+    sn_gw_id_ = static_cast<uint8_t>(gw_id);
+    return sn_port_;
+  }
+
+  int sn_port() const { return sn_port_; }
+
   // Thread-safe enqueue of outbound bytes for a connection.
   int Send(uint64_t id, const uint8_t* data, size_t len) {
     {
@@ -697,8 +810,19 @@ class Host {
     }
     auto it = conns_.find(id);
     if (it == conns_.end()) return -1;
-    uint64_t last = it->second.last_rx_ms;
     uint64_t now = NowMs();
+    const Conn& c = it->second;
+    if (c.sn && !c.sn->awake) {
+      if (now < c.sn->sleep_until_ms)
+        return 0;  // announced sleep (§6.14): expected-silent, not idle
+      // past the announced wake deadline the idle clock starts AT the
+      // deadline — measuring from last_rx_ms would jump straight to
+      // the full sleep span and kill the session with zero grace just
+      // as the punctual wake PINGREQ is in flight
+      uint64_t due = c.sn->sleep_until_ms;
+      return static_cast<long>(now > due ? now - due : 0);
+    }
+    uint64_t last = c.last_rx_ms;
     return static_cast<long>(now > last ? now - last : 0);
   }
 
@@ -726,6 +850,7 @@ class Host {
       for (int i = 0; i < n; i++) HandleEvent(evs[i]);
       ApplyPending();
       if (!lane_pending_.empty()) LaneStaleScan();
+      SnRexmitScan();    // qos1-over-UDP retransmit timeouts
       FlushDurables();   // catch-all for appends with no dirty socket
       FlushTaps();
       FlushAcks();
@@ -771,6 +896,7 @@ class Host {
   static constexpr uint64_t kWakeTag = ~0ull - 1;
   static constexpr uint64_t kListenWsTag = ~0ull - 2;
   static constexpr uint64_t kListenTrunkTag = ~0ull - 3;
+  static constexpr uint64_t kListenSnTag = ~0ull - 4;
 
   void Wake() {
     uint64_t one = 1;
@@ -977,6 +1103,31 @@ class Host {
         break;
       case Op::kDurableDel:
         subs_.Remove(kDurableOwnerBase + op.owner, op.str);
+        break;
+      case Op::kSnPredef:
+        // gateway-wide predefined topic-id table (empty topic = forget)
+        if (op.str.empty())
+          sn_predefined_.erase(static_cast<uint16_t>(op.owner));
+        else
+          sn_predefined_[static_cast<uint16_t>(op.owner)] = op.str;
+        break;
+      case Op::kRetainSet:
+        retained_.Set(op.str, op.str2, op.qos, op.token);
+        stats_[kStRetainSet].fetch_add(1, std::memory_order_relaxed);
+        break;
+      case Op::kRetainDel:
+        if (retained_.Del(op.str))
+          stats_[kStRetainDel].fetch_add(1, std::memory_order_relaxed);
+        break;
+      case Op::kRetainDeliver:
+        RetainDeliver(op.owner, op.str, op.qos);
+        break;
+      case Op::kSetTeleShift:
+        // EMQX_NATIVE_TELEMETRY_SHIFT: per-message stages sample
+        // 1-in-2^shift (default shift 3 = 1-in-8); bench runs widen it
+        tele_mask_ = (op.token >= 1 && op.token <= 16)
+                         ? static_cast<uint32_t>((1ull << op.token) - 1)
+                         : 7u;
         break;
     }
   }
@@ -1283,6 +1434,12 @@ class Host {
       TrunkAccept();
       return;
     }
+    if (ev.data.u64 == kListenSnTag) {
+      // checked BEFORE the trunk-bit test: the listener tags live at
+      // the top of the u64 space and carry bit 63 too
+      SnRead();
+      return;
+    }
     if (ev.data.u64 & kTrunkSockBit) {
       TrunkEvent(ev);
       return;
@@ -1434,7 +1591,7 @@ class Host {
   // chunk pays on the WS transport (the TCP path feeds IngestMqtt
   // directly, so this stage is what RFC6455 adds to the plane).
   bool WsDecode(uint64_t id, Conn& c, uint8_t* data, size_t len) {
-    if (telemetry_ && ((++tele_tick_ws_ & 7) == 0)) {
+    if (telemetry_ && ((++tele_tick_ws_ & tele_mask_) == 0)) {
       uint64_t t0 = NowNs();
       bool ok = WsDecodeInner(id, c, data, len);
       RecordHist(kHistWsIngest, NowNs() - t0);
@@ -1520,6 +1677,15 @@ class Host {
       if (it == conns_.end()) continue;
       it->second.dirty = false;
       Flush(id, it->second);
+      // a stalled SN outbuf (sendmmsg EAGAIN on the shared UDP fd) has
+      // no per-conn EPOLLOUT to re-arm the way TCP's Flush does —
+      // re-queue it so the next poll cycle retries, or a want_close
+      // teardown would wait forever on unrelated traffic. Re-find: the
+      // Flush may have Dropped the conn.
+      auto rt = conns_.find(id);
+      if (rt != conns_.end() && rt->second.sn &&
+          rt->second.outpos < rt->second.outbuf.size())
+        MarkDirty(id, rt->second);
     }
     if (flush_t0_) {
       RecordHist(kHistRouteFlush, NowNs() - flush_t0_);
@@ -1543,7 +1709,7 @@ class Host {
     // be a measurable tax at 7 figures/s; the ticker is global so a
     // deterministic share of walk-path publishes lands in the histogram
     uint64_t t_in = 0;
-    if (telemetry_ && ((++tele_tick_ & 7) == 0)) t_in = NowNs();
+    if (telemetry_ && ((++tele_tick_ & tele_mask_) == 0)) t_in = NowNs();
     uint8_t qos = (h >> 1) & 3;
     bool retain = h & 1;
     if (qos > 2 || retain) return false;  // malformed qos / retained
@@ -1861,6 +2027,21 @@ class Host {
       return false;
     }
     uint8_t out_qos = qos < e.qos ? qos : e.qos;
+    if (t.sn) {
+      // SN subscribers take SN framing but the SAME window machinery;
+      // deliveries cap at qos1 (the oracle's handle_deliver cap)
+      if (out_qos == 0) {
+        if (telemetry_) FrNote(t, kFrDeliver, 3, 0, cur_hash_);
+        SnDeliverPublish(t, topic, payload, 0, false, false, 0);
+      } else {
+        int r = SnDeliverElevated(owner, t, topic, payload, false);
+        if (r == 0) return false;
+        if (r == 2) return true;  // parked; kStFastOut counts at dequeue
+      }
+      stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
+      MarkDirty(owner, t);
+      return true;
+    }
     if (out_qos == 0) {
       std::string& shared = t.proto_ver == 5 ? frame_v5_ : frame_v4_;
       if (shared.empty())
@@ -1933,6 +2114,8 @@ class Host {
   }
 
   // Freed window slots pull queued deliveries in (mqueue dequeue).
+  // SN conns park whole SN datagrams (always qos1): the dequeue
+  // patches the msg-id field and registers the retransmit copy.
   void DrainPending(uint64_t id, Conn& c) {
     if (!c.ack) return;
     AckState& a = *c.ack;
@@ -1940,14 +2123,21 @@ class Host {
       auto [frame, pid_off] = std::move(a.pending.front());
       a.pending.pop_front();
       uint16_t np = NextPid(a);
-      if (((static_cast<uint8_t>(frame[0]) >> 1) & 3) == 2)
+      if (!c.sn && ((static_cast<uint8_t>(frame[0]) >> 1) & 3) == 2)
         BitSet(a.infl_qos2, np - kNativePidBase);
       frame[pid_off] = static_cast<char>(np >> 8);
       frame[pid_off + 1] = static_cast<char>(np & 0xFF);
-      AppendMqtt(c, frame.data(), frame.size());
       stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
       stats_[kStFastBytesOut].fetch_add(frame.size(),
                                         std::memory_order_relaxed);
+      if (c.sn) {
+        stats_[kStSnOut].fetch_add(1, std::memory_order_relaxed);
+        SnOut(c, frame);
+        // msg-id offset sits 3 bytes past the flags byte (sn.h layout)
+        SnRexmitTrack(id, c, np, std::move(frame), pid_off - 3);
+      } else {
+        AppendMqtt(c, frame.data(), frame.size());
+      }
       AckNote(id, a);
       MarkDirty(id, c);
     }
@@ -2763,6 +2953,1091 @@ class Host {
     epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, s.fd, &ev);
   }
 
+  // -- mqtt-sn gateway (round 11) -----------------------------------------
+  // Foreign framing → same MQTT fast path, the ws.h pattern applied to
+  // the first UDP gateway: datagrams decode with the shared sn.h codec,
+  // translate into MQTT frames, and ride TryFast / the Python channel
+  // exactly like TCP bytes would. Egress reverses the translation (one
+  // SN datagram per MQTT packet), with a per-conn topic-id registry,
+  // sleeping-client buffering, and qos1 retransmit-on-timeout — the
+  // asyncio gateway (gateway/mqttsn.py) stays the protocol oracle.
+
+  static uint64_t SnAddrKey(const sockaddr_in& a) {
+    return (static_cast<uint64_t>(a.sin_addr.s_addr) << 16) | a.sin_port;
+  }
+
+  static void BuildMqttFrame(std::string* out, uint8_t header,
+                             const std::string& body) {
+    out->push_back(static_cast<char>(header));
+    size_t r = body.size();
+    do {
+      uint8_t b = r & 0x7F;
+      r >>= 7;
+      out->push_back(static_cast<char>(r ? b | 0x80 : b));
+    } while (r);
+    *out += body;
+  }
+
+  static void MakeMqttAck(std::string* out, uint8_t header, uint16_t pid) {
+    out->push_back(static_cast<char>(header));
+    out->push_back(0x02);
+    out->push_back(static_cast<char>(pid >> 8));
+    out->push_back(static_cast<char>(pid & 0xFF));
+  }
+
+  // One recvmmsg drains up to kSnRecvBatch datagrams per syscall.
+  // Per-datagram UDP syscalls are brutal on sandboxed kernels
+  // (~30us/recvfrom measured here vs ~5us amortized via recvmmsg),
+  // and peers aggregate messages per datagram (sn.h kPackDatagram),
+  // so one syscall can carry thousands of SN messages.
+  static constexpr int kSnRecvBatch = 32;
+  static constexpr size_t kSnRecvBuf = 65536;  // UDP max: never truncates
+
+  void SnRead() {
+    if (sn_rx_buf_.empty()) sn_rx_buf_.resize(kSnRecvBatch * kSnRecvBuf);
+    mmsghdr mm[kSnRecvBatch];
+    iovec iov[kSnRecvBatch];
+    sockaddr_in peers[kSnRecvBatch];
+    // bounded per cycle so an SN blast cannot starve the TCP/WS side
+    for (int budget = 0; budget < 4096; budget += kSnRecvBatch) {
+      for (int i = 0; i < kSnRecvBatch; i++) {
+        iov[i].iov_base = sn_rx_buf_.data() + i * kSnRecvBuf;
+        iov[i].iov_len = kSnRecvBuf;
+        memset(&mm[i].msg_hdr, 0, sizeof(mm[i].msg_hdr));
+        mm[i].msg_hdr.msg_name = &peers[i];
+        mm[i].msg_hdr.msg_namelen = sizeof(peers[i]);
+        mm[i].msg_hdr.msg_iov = &iov[i];
+        mm[i].msg_hdr.msg_iovlen = 1;
+      }
+      int n = recvmmsg(sn_fd_, mm, kSnRecvBatch, 0, nullptr);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;  // EAGAIN: drained
+      }
+      for (int i = 0; i < n; i++) {
+        if (mm[i].msg_len == 0) continue;
+        const uint8_t* d = sn_rx_buf_.data() + i * kSnRecvBuf;
+        if (telemetry_ && ((++tele_tick_sn_ & tele_mask_) == 0)) {
+          uint64_t t0 = NowNs();
+          SnIngest(peers[i], d, mm[i].msg_len);
+          RecordHist(kHistSnIngest, NowNs() - t0);
+        } else {
+          SnIngest(peers[i], d, mm[i].msg_len);
+        }
+      }
+      if (n < kSnRecvBatch) break;  // drained
+    }
+    FlushDirty();
+  }
+
+  void SnIngest(const sockaddr_in& peer, const uint8_t* data, size_t len) {
+    sn_msgs_scratch_.clear();
+    sn::ParseAll(data, len, &sn_msgs_scratch_);
+    for (sn::SnMsg& m : sn_msgs_scratch_) SnHandle(peer, m);
+  }
+
+  // Mirror of IngestMqtt's per-frame body for a single translated frame.
+  void SnForward(uint64_t id, Conn& c, const std::string& f) {
+    if (!c.fast || !TryFast(id, c, f)) {
+      FrNote(c, c.fast ? kFrPunt : kFrFrame,
+             static_cast<uint8_t>(f[0]) >> 4,
+             static_cast<uint16_t>(f.size() & 0xFFFF));
+      events_.push_back(EncodeRecord(2, id, f.data(), f.size()));
+    }
+  }
+
+  void SnReply(uint64_t id, Conn& c, const sn::SnMsg& m) {
+    // control answers bypass the sleep buffer (the oracle's handle_in
+    // replies go straight out too; only DELIVERIES park)
+    std::string dg;
+    sn::Serialize(m, &dg);
+    c.outbuf += dg;
+    MarkDirty(id, c);
+  }
+
+  // Conn-less direct answer (SEARCHGW, not-connected DISCONNECT).
+  void SnSendTo(const sockaddr_in& peer, const sn::SnMsg& m) {
+    std::string dg;
+    sn::Serialize(m, &dg);
+    sendto(sn_fd_, dg.data(), dg.size(), MSG_NOSIGNAL,
+           reinterpret_cast<const sockaddr*>(&peer), sizeof(peer));
+  }
+
+  std::string SnDefaultCid(uint64_t id) {
+    // the oracle mints "sn-<id(self)>"-style fallbacks; ours are the
+    // conn id, which is stable for the conn's lifetime
+    return "sn-" + std::to_string(id & 0xFFFFFFFFull);
+  }
+
+  uint64_t SnNewConn(const sockaddr_in& peer) {
+    Conn c;
+    c.fd = -1;  // egress rides sendto() on the shared UDP socket
+    c.framer = Framer(max_size_);
+    c.sn = std::make_unique<SnConnState>();
+    c.sn->addr = peer;
+    uint64_t id = kSnConnBit | next_sn_id_++;
+    c.sn->conn_id = id;
+    auto& cref = conns_.emplace(id, std::move(c)).first->second;
+    sn_addr_conn_[SnAddrKey(peer)] = id;
+    cref.last_rx_ms = NowMs();
+    FrNote(cref, kFrOpen, 0, 2);  // arg 2 = SN transport
+    char ip[INET_ADDRSTRLEN] = "?";
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    std::string info = std::string("sn:") + ip + ":" +
+                       std::to_string(ntohs(peer.sin_port));
+    events_.push_back(EncodeRecord(1, id, info.data(), info.size()));
+    return id;
+  }
+
+  // Translate + forward the CONNECT; the Python channel owns the
+  // session (auth, CM takeover, hooks) exactly as for TCP clients.
+  void SnConnect(uint64_t id, const sn::SnMsg& m) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    SnConnState& s = *c.sn;
+    s.clientid = m.clientid.empty() ? SnDefaultCid(id) : m.clientid;
+    s.connect_sent = true;
+    s.connected = false;
+    // duration 0 = "no keepalive" on the wire; the asyncio listener
+    // idle-times those peers out at 300s (conn.py UdpGwListener
+    // default) — translating 0 to 300 gives the native conn the same
+    // effective lifetime instead of leaking it forever
+    uint16_t keepalive = m.duration ? m.duration : 300;
+    std::string body;
+    body.push_back(0);
+    body.push_back(4);
+    body += "MQTT";
+    body.push_back(4);  // translated SN sessions speak MQTT 3.1.1
+    body.push_back((m.flags & sn::kFClean) ? 0x02 : 0x00);
+    sn::PutBe16(&body, keepalive);
+    sn::PutBe16(&body, static_cast<uint16_t>(s.clientid.size()));
+    body += s.clientid;
+    std::string f;
+    BuildMqttFrame(&f, 0x10, body);
+    SnForward(id, c, f);
+  }
+
+  bool SnResolveTopic(SnConnState& s, uint8_t kind, uint16_t topic_id,
+                      std::string* topic) {
+    if (kind == sn::kTidPredef) {
+      auto it = sn_predefined_.find(topic_id);
+      if (it == sn_predefined_.end()) return false;
+      *topic = it->second;
+      return true;
+    }
+    if (kind == sn::kTidShort) {
+      topic->clear();
+      topic->push_back(static_cast<char>(topic_id >> 8));
+      topic->push_back(static_cast<char>(topic_id & 0xFF));
+      return true;
+    }
+    auto it = s.topic_of_id.find(topic_id);
+    if (it == s.topic_of_id.end()) return false;
+    *topic = it->second;
+    return true;
+  }
+
+  // Per-conn NORMAL id allocation: wrap at the u16 ceiling skipping
+  // ids still in use and the reserved 0x0000 (the oracle's fixed
+  // _alloc_tid). Returns 0 only when all 65535 ids are taken.
+  uint16_t SnAllocTid(SnConnState& s, const std::string& topic) {
+    auto it = s.id_of_topic.find(topic);
+    if (it != s.id_of_topic.end()) return it->second;
+    // wrap in 1..0xFFFE: 0x0000 AND 0xFFFF are reserved (§5.3.11)
+    for (int guard = 0; guard < 0xFFFE; guard++) {
+      s.next_tid = static_cast<uint16_t>(s.next_tid % 0xFFFE + 1);
+      if (!s.topic_of_id.count(s.next_tid)) {
+        s.id_of_topic[topic] = s.next_tid;
+        s.topic_of_id[s.next_tid] = topic;
+        return s.next_tid;
+      }
+    }
+    return 0;
+  }
+
+  uint16_t SnNextMid(SnConnState& s) {
+    s.next_mid = static_cast<uint16_t>(s.next_mid % 0xFFFF + 1);
+    return s.next_mid;
+  }
+
+  void SnHandle(const sockaddr_in& peer, sn::SnMsg& m) {
+    if (m.type == sn::kSearchGw) {
+      sn::SnMsg gi;
+      gi.type = sn::kGwInfo;
+      gi.rc = sn_gw_id_;
+      SnSendTo(peer, gi);
+      return;
+    }
+    if (m.type == sn::kPublish && sn::QosOf(m.flags) < 0) {
+      SnQosM1(m);
+      return;
+    }
+    uint64_t key = SnAddrKey(peer);
+    auto ait = sn_addr_conn_.find(key);
+    if (ait == sn_addr_conn_.end()) {
+      if (m.type == sn::kConnect) {
+        if (conns_.size() >= max_conns_) return;  // esockd max-conn
+        SnConnect(SnNewConn(peer), m);
+      } else if (m.type != sn::kDisconnect && m.type != sn::kPingReq) {
+        // unknown peer mid-protocol: the oracle's not-connected answer
+        sn::SnMsg d;
+        d.type = sn::kDisconnect;
+        SnSendTo(peer, d);
+      }
+      return;
+    }
+    uint64_t id = ait->second;
+    auto cit = conns_.find(id);
+    if (cit == conns_.end()) {
+      sn_addr_conn_.erase(ait);
+      return;
+    }
+    Conn& c = cit->second;
+    SnConnState& s = *c.sn;
+    c.last_rx_ms = NowMs();
+    if (m.type == sn::kConnect) {
+      if (s.connected) {
+        // any CONNECT on a live conn re-runs the session open — the
+        // oracle re-authenticates and re-opens on EVERY CONNECT (a
+        // rebooted device with F_CLEAN must get clean-start semantics,
+        // and a freshly banned clientid must be re-checked, not waved
+        // through as a CONNACK retransmit). Release the old session
+        // through the Python channel (close_session parity) and
+        // connect fresh; same-clientid reconnects take over their old
+        // session in Python exactly like a TCP takeover. The old conn
+        // keeps draining; the addr now maps to the new conn.
+        sn_addr_conn_.erase(key);
+        std::string f;
+        f.push_back(static_cast<char>(0xE0));
+        f.push_back(0);
+        SnForward(id, c, f);
+        // conns_ may rehash on the emplace: no Conn& use after this
+        SnConnect(SnNewConn(peer), m);
+      }
+      // else: CONNECT retransmit while the first is awaiting its
+      // CONNACK — the in-flight answer covers it
+      return;
+    }
+    if (m.type == sn::kPingReq) {
+      stats_[kStSnPings].fetch_add(1, std::memory_order_relaxed);
+      if (!s.awake || !s.sleep_buf.empty()) {
+        // waking flushes parked deliveries BEFORE the ping answer
+        // (MQTT-SN §6.14 buffered delivery on the keepalive ping)
+        s.awake = true;
+        s.sleep_until_ms = 0;
+        while (!s.sleep_buf.empty()) {
+          c.outbuf += s.sleep_buf.front();
+          s.sleep_buf.pop_front();
+        }
+        // the flush IS the first transmission of any qos1 delivery
+        // parked during sleep — restart the retry clock from here
+        uint64_t woke = NowMs();
+        for (auto& r : s.rexmit) r.last_tx_ms = woke;
+        MarkDirty(id, c);
+      }
+      if (s.connected) {
+        std::string f;
+        f.push_back(static_cast<char>(0xC0));
+        f.push_back(0);
+        SnForward(id, c, f);  // Python answers PINGRESP -> SN PINGRESP
+      }
+      return;
+    }
+    if (m.type == sn::kDisconnect) {
+      sn::SnMsg d;
+      d.type = sn::kDisconnect;
+      if (m.duration) {
+        // sleep mode: keep the session, stop delivering, start the
+        // announced-silence window the keepalive feed honours
+        s.awake = false;
+        s.sleep_until_ms = NowMs() + static_cast<uint64_t>(m.duration)
+                                     * 1000;
+        SnReply(id, c, d);
+        return;
+      }
+      SnReply(id, c, d);
+      std::string f;
+      f.push_back(static_cast<char>(0xE0));
+      f.push_back(0);
+      SnForward(id, c, f);  // Python tears the session down + closes
+      return;
+    }
+    if (!s.connected) {
+      if (s.connect_sent && !s.connack_seen &&
+          s.preconn.size() < kSnPreconnMax) {
+        // CONNECT is in flight to the Python channel. The oracle
+        // connects synchronously, so a client that pipelines
+        // REGISTER/SUBSCRIBE/PUBLISH behind its CONNECT (or packs
+        // them into one datagram) must have them served, not bounced.
+        // Park until the CONNACK egresses, then replay in order.
+        s.preconn.push_back(std::move(m));
+        return;
+      }
+      // oracle: everything else requires a session
+      sn::SnMsg d;
+      d.type = sn::kDisconnect;
+      SnReply(id, c, d);
+      return;
+    }
+    SnDispatch(id, c, m);
+  }
+
+  // One post-session SN message (the oracle's connected-state
+  // handle_in). Split from SnHandle so the preconn replay after a
+  // CONNACK egress runs the identical code path.
+  static constexpr size_t kSnPreconnMax = 64;
+
+  void SnDispatch(uint64_t id, Conn& c, sn::SnMsg& m) {
+    SnConnState& s = *c.sn;
+    switch (m.type) {
+      case sn::kRegister: {
+        uint16_t tid = SnAllocTid(s, m.topic_name);
+        stats_[kStSnRegisters].fetch_add(1, std::memory_order_relaxed);
+        sn::SnMsg ra;
+        ra.type = sn::kRegack;
+        ra.topic_id = tid;
+        ra.msg_id = m.msg_id;
+        // tid 0 is the reserved invalid id: a full registry must answer
+        // "rejected: congestion", not hand 0 out as a success
+        ra.rc = tid ? sn::kRcAccepted : sn::kRcCongestion;
+        SnReply(id, c, ra);
+        break;
+      }
+      case sn::kPublish: {
+        int qi = sn::QosOf(m.flags);
+        uint8_t qos = qi < 0 ? 0 : static_cast<uint8_t>(qi);
+        std::string topic;
+        if (!SnResolveTopic(s, m.flags & 0x3, m.topic_id, &topic)) {
+          if (qos > 0) {
+            sn::SnMsg pa;
+            pa.type = sn::kPuback;
+            pa.topic_id = m.topic_id;
+            pa.msg_id = m.msg_id;
+            pa.rc = sn::kRcInvalidTopicId;
+            SnReply(id, c, pa);
+          }
+          break;
+        }
+        stats_[kStSnIn].fetch_add(1, std::memory_order_relaxed);
+        if (qos > 0) {
+          // the MQTT ack coming back carries only the msg id; the SN
+          // PUBACK needs the topic id too (runaway-bound: a client
+          // that never sees its acks can't grow this past the id space)
+          if (s.pub_tid.size() > 8192) s.pub_tid.clear();
+          s.pub_tid[m.msg_id] = m.topic_id;
+        }
+        std::string body;
+        sn::PutBe16(&body, static_cast<uint16_t>(topic.size()));
+        body += topic;
+        if (qos) sn::PutBe16(&body, m.msg_id);
+        body += m.data;
+        uint8_t h = static_cast<uint8_t>(0x30 | (qos << 1));
+        if (m.flags & sn::kFDup) h |= 0x08;
+        if (m.flags & sn::kFRetain) h |= 0x01;
+        std::string f;
+        BuildMqttFrame(&f, h, body);
+        SnForward(id, c, f);
+        break;
+      }
+      case sn::kPuback: {
+        // subscriber acked a delivery: retire the retransmit copy
+        // FIRST, then route the ack like any wire PUBACK (native pids
+        // consume in TryFastPuback, Python pids forward to the session)
+        SnRexmitAck(id, s, m.msg_id);
+        std::string f;
+        MakeMqttAck(&f, 0x40, m.msg_id);
+        SnForward(id, c, f);
+        break;
+      }
+      case sn::kPubrec: {
+        std::string f;
+        MakeMqttAck(&f, 0x50, m.msg_id);
+        SnForward(id, c, f);
+        break;
+      }
+      case sn::kPubrel: {
+        std::string f;
+        MakeMqttAck(&f, 0x62, m.msg_id);
+        SnForward(id, c, f);
+        break;
+      }
+      case sn::kPubcomp: {
+        std::string f;
+        MakeMqttAck(&f, 0x70, m.msg_id);
+        SnForward(id, c, f);
+        break;
+      }
+      case sn::kSubscribe: {
+        uint8_t kind = m.flags & 0x3;
+        std::string topic;
+        uint16_t tid = 0;
+        if (kind == sn::kTidPredef) {
+          auto pit = sn_predefined_.find(m.topic_id);
+          if (pit != sn_predefined_.end()) {
+            topic = pit->second;
+            tid = m.topic_id;
+          }
+        } else {
+          topic = m.topic_name;
+          bool wild = topic.find('+') != std::string::npos ||
+                      topic.find('#') != std::string::npos;
+          // wildcard filters get no id (delivery auto-registers one)
+          tid = (wild || topic.empty()) ? 0 : SnAllocTid(s, topic);
+        }
+        if (topic.empty()) {
+          sn::SnMsg sa;
+          sa.type = sn::kSuback;
+          sa.flags = m.flags;
+          sa.msg_id = m.msg_id;
+          sa.rc = sn::kRcInvalidTopicId;
+          SnReply(id, c, sa);
+          break;
+        }
+        // grant what delivery honours: SN deliveries cap at qos1
+        // (oracle handle_deliver), so the granted qos does too
+        int qi = sn::QosOf(m.flags);
+        uint8_t qos = qi < 1 ? 0 : 1;
+        if (s.sub_tid.size() > 1024) s.sub_tid.clear();
+        s.sub_tid[m.msg_id] =
+            (static_cast<uint32_t>(m.flags) << 16) | tid;
+        std::string body;
+        sn::PutBe16(&body, m.msg_id);
+        sn::PutBe16(&body, static_cast<uint16_t>(topic.size()));
+        body += topic;
+        body.push_back(static_cast<char>(qos));
+        std::string f;
+        BuildMqttFrame(&f, 0x82, body);
+        SnForward(id, c, f);  // SUBSCRIBE always runs the Python plane
+        break;
+      }
+      case sn::kUnsubscribe: {
+        std::string topic;
+        if ((m.flags & 0x3) == sn::kTidPredef) {
+          auto pit = sn_predefined_.find(m.topic_id);
+          if (pit != sn_predefined_.end()) topic = pit->second;
+        } else {
+          topic = m.topic_name;
+        }
+        if (topic.empty()) {
+          sn::SnMsg ua;
+          ua.type = sn::kUnsuback;
+          ua.msg_id = m.msg_id;
+          SnReply(id, c, ua);  // the oracle UNSUBACKs regardless
+          break;
+        }
+        std::string body;
+        sn::PutBe16(&body, m.msg_id);
+        sn::PutBe16(&body, static_cast<uint16_t>(topic.size()));
+        body += topic;
+        std::string f;
+        BuildMqttFrame(&f, 0xA2, body);
+        SnForward(id, c, f);
+        break;
+      }
+      default:
+        break;  // WILL machinery et al: not served (oracle parity)
+    }
+  }
+
+  // QoS -1 (§6.8): publish-without-connect on a predefined or short
+  // topic. Routed through ONE shared anonymous conn whose synthesized
+  // session ("sn-anon") earns publish permits like any client — so a
+  // hot QoS -1 topic runs the native fast path after its first pass.
+  void SnQosM1(const sn::SnMsg& m) {
+    stats_[kStSnQosM1].fetch_add(1, std::memory_order_relaxed);
+    uint8_t kind = m.flags & 0x3;
+    std::string topic;
+    if (kind == sn::kTidPredef) {
+      auto it = sn_predefined_.find(m.topic_id);
+      if (it == sn_predefined_.end()) return;  // fire-and-forget: drop
+      topic = it->second;
+    } else if (kind == sn::kTidShort) {
+      topic.push_back(static_cast<char>(m.topic_id >> 8));
+      topic.push_back(static_cast<char>(m.topic_id & 0xFF));
+    } else {
+      return;  // NORMAL ids need a connection's registry (oracle)
+    }
+    uint64_t id = EnsureSnAnon();
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    std::string body;
+    sn::PutBe16(&body, static_cast<uint16_t>(topic.size()));
+    body += topic;
+    body += m.data;
+    uint8_t h = static_cast<uint8_t>(
+        0x30 | ((m.flags & sn::kFRetain) ? 1 : 0));
+    std::string f;
+    BuildMqttFrame(&f, h, body);
+    SnForward(id, it->second, f);
+  }
+
+  uint64_t EnsureSnAnon() {
+    if (sn_anon_id_ && conns_.count(sn_anon_id_)) return sn_anon_id_;
+    Conn c;
+    c.fd = -1;
+    c.framer = Framer(max_size_);
+    c.sn = std::make_unique<SnConnState>();
+    c.sn->anon = true;
+    c.sn->connected = true;
+    c.sn->connect_sent = true;
+    c.sn->clientid = "sn-anon";
+    uint64_t id = kSnConnBit | next_sn_id_++;
+    c.sn->conn_id = id;
+    auto& cref = conns_.emplace(id, std::move(c)).first->second;
+    cref.last_rx_ms = NowMs();
+    sn_anon_id_ = id;
+    events_.push_back(EncodeRecord(1, id, "sn:anon", 7));
+    // synthesize the CONNECT so the Python channel opens a real
+    // session; keepalive 0 = the anon publisher never idles out
+    std::string body;
+    body.push_back(0);
+    body.push_back(4);
+    body += "MQTT";
+    body.push_back(4);
+    body.push_back(0x02);
+    sn::PutBe16(&body, 0);
+    sn::PutBe16(&body, 7);
+    body += "sn-anon";
+    std::string f;
+    BuildMqttFrame(&f, 0x10, body);
+    SnForward(id, cref, f);
+    return id;
+  }
+
+  // -- SN egress (MQTT -> SN translation) ---------------------------------
+
+  void SnEgress(Conn& c, const char* data, size_t len) {
+    sn_frames_scratch_.clear();
+    c.sn->egress.Feed(reinterpret_cast<const uint8_t*>(data), len,
+                      &sn_frames_scratch_);
+    for (const std::string& f : sn_frames_scratch_)
+      SnTranslateEgress(c, f);
+    // a CONNACK in this span settles the CONNECT round trip: replay
+    // pipelined messages AFTER the scratch loop (dispatch may re-enter
+    // egress paths) and after the CONNACK bytes joined the outbuf, so
+    // the client sees CONNACK before any REGACK/SUBACK/PUBACK
+    if (c.sn->connack_seen && !c.sn->preconn.empty())
+      SnDrainPreconn(c.sn->conn_id);
+  }
+
+  void SnDrainPreconn(uint64_t id) {
+    std::deque<sn::SnMsg> q;
+    {
+      auto it = conns_.find(id);
+      if (it == conns_.end() || !it->second.sn) return;
+      q.swap(it->second.sn->preconn);
+    }
+    for (sn::SnMsg& m : q) {
+      // re-find each round: a dispatched PUBLISH can rehash conns_
+      auto it = conns_.find(id);
+      if (it == conns_.end() || !it->second.sn) return;
+      Conn& c = it->second;
+      if (c.sn->connected) {
+        SnDispatch(id, c, m);
+      } else {
+        // CONNACK was a reject: the oracle answers each post-CONNECT
+        // message in the not-connected state with DISCONNECT
+        sn::SnMsg d;
+        d.type = sn::kDisconnect;
+        SnReply(id, c, d);
+      }
+    }
+  }
+
+  void SnTranslateEgress(Conn& c, const std::string& f) {
+    SnConnState& s = *c.sn;
+    uint8_t type = static_cast<uint8_t>(f[0]) >> 4;
+    size_t pos = 1;
+    while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
+    pos++;  // first body byte
+    auto pid_at = [&](size_t at) -> uint16_t {
+      if (at + 2 > f.size()) return 0;
+      return static_cast<uint16_t>(
+          (static_cast<uint8_t>(f[at]) << 8) |
+          static_cast<uint8_t>(f[at + 1]));
+    };
+    sn::SnMsg m;
+    switch (type) {
+      case 2: {  // CONNACK
+        if (pos + 2 > f.size()) return;
+        uint8_t rc = static_cast<uint8_t>(f[pos + 1]);
+        s.connack_seen = true;
+        if (rc == 0) s.connected = true;
+        m.type = sn::kConnack;
+        m.rc = rc ? sn::kRcNotSupported : sn::kRcAccepted;
+        break;
+      }
+      case 3: {  // PUBLISH: a Python-plane delivery for this SN client
+        uint8_t h = static_cast<uint8_t>(f[0]);
+        uint8_t qos = (h >> 1) & 3;
+        if (pos + 2 > f.size()) return;
+        uint16_t tlen = pid_at(pos);
+        pos += 2;
+        if (pos + tlen > f.size()) return;
+        std::string_view topic(f.data() + pos, tlen);
+        pos += tlen;
+        uint16_t pid = 0;
+        if (qos) {
+          pid = pid_at(pos);
+          pos += 2;
+          if (pos > f.size()) return;
+        }
+        std::string_view payload(f.data() + pos, f.size() - pos);
+        // the oracle's delivery cap: SN PUBLISHes never exceed qos1
+        SnDeliverPublish(c, topic, payload, qos > 1 ? 1 : qos,
+                         (h & 1) != 0, (h & 8) != 0, pid);
+        return;
+      }
+      case 4: {  // PUBACK: needs the topic id the MQTT ack dropped
+        uint16_t pid = pid_at(pos);
+        m.type = sn::kPuback;
+        m.msg_id = pid;
+        m.rc = sn::kRcAccepted;
+        auto it = s.pub_tid.find(pid);
+        if (it != s.pub_tid.end()) {
+          m.topic_id = it->second;
+          s.pub_tid.erase(it);
+        }
+        break;
+      }
+      case 5:
+        m.type = sn::kPubrec;
+        m.msg_id = pid_at(pos);
+        break;
+      case 6:
+        m.type = sn::kPubrel;
+        m.msg_id = pid_at(pos);
+        break;
+      case 7:
+        m.type = sn::kPubcomp;
+        m.msg_id = pid_at(pos);
+        s.pub_tid.erase(m.msg_id);  // the qos2 ingest entry retires here
+        break;
+      case 9: {  // SUBACK
+        uint16_t pid = pid_at(pos);
+        uint8_t rc = static_cast<uint8_t>(f.back());
+        m.type = sn::kSuback;
+        m.msg_id = pid;
+        uint32_t ctx2 = 0;
+        auto it = s.sub_tid.find(pid);
+        if (it != s.sub_tid.end()) {
+          ctx2 = it->second;
+          s.sub_tid.erase(it);
+        }
+        if (rc >= 0x80) {
+          // denied: echo the REQUEST flags, tid 0 (oracle shape)
+          m.flags = static_cast<uint8_t>(ctx2 >> 16);
+          m.topic_id = 0;
+          m.rc = sn::kRcNotSupported;
+        } else {
+          m.flags = sn::QosFlags(rc);
+          m.topic_id = static_cast<uint16_t>(ctx2 & 0xFFFF);
+          m.rc = sn::kRcAccepted;
+        }
+        break;
+      }
+      case 11:
+        m.type = sn::kUnsuback;
+        m.msg_id = pid_at(pos);
+        break;
+      case 13:
+        m.type = sn::kPingResp;
+        break;
+      case 14:
+        m.type = sn::kDisconnect;
+        break;
+      default:
+        return;  // nothing else egresses to an SN client
+    }
+    std::string dg;
+    sn::Serialize(m, &dg);
+    c.outbuf += dg;  // control answers bypass the sleep buffer
+  }
+
+  // -- SN delivery encode -------------------------------------------------
+
+  void SnOut(Conn& c, const std::string& dgram) {
+    SnConnState& s = *c.sn;
+    if (!s.awake) {
+      // asleep (radio off): park until the next PINGREQ, bounded
+      // drop-oldest like the session mqueue (oracle parity). Oldest
+      // means oldest PUBLISH — evicting a parked auto-REGISTER while
+      // keeping its paired PUBLISH would leave the client holding
+      // deliveries on a topic id it never learned, undecodable for
+      // the rest of the session (the oracle is immune: it parks
+      // deliveries pre-encoding and auto-registers at wake).
+      if (s.sleep_buf.size() >= kMaxPending) {
+        auto vic = s.sleep_buf.begin();
+        for (; vic != s.sleep_buf.end(); ++vic) {
+          const std::string& d = *vic;
+          size_t toff = static_cast<uint8_t>(d[0]) == 1 ? 3 : 1;
+          if (toff < d.size() &&
+              static_cast<uint8_t>(d[toff]) != sn::kRegister)
+            break;
+        }
+        s.sleep_buf.erase(vic == s.sleep_buf.end() ? s.sleep_buf.begin()
+                                                   : vic);
+      }
+      s.sleep_buf.push_back(dgram);
+      stats_[kStSnSleepParked].fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+    c.outbuf += dgram;
+  }
+
+  // Resolve (auto-registering) the NORMAL topic id a delivery needs —
+  // the REGISTER goes out (or parks) ahead of the PUBLISH, so the
+  // client can decode the id (oracle handle_deliver).
+  uint16_t SnDeliverTid(Conn& c, std::string_view topic) {
+    SnConnState& s = *c.sn;
+    if (topic.size() > sn::kMaxTopic) return 0;  // REGISTER can't frame it
+    std::string key(topic);
+    auto it = s.id_of_topic.find(key);
+    if (it != s.id_of_topic.end()) return it->second;
+    uint16_t tid = SnAllocTid(s, key);
+    if (!tid) return 0;  // registry full: nothing deliverable
+    sn::SnMsg rg;
+    rg.type = sn::kRegister;
+    rg.topic_id = tid;
+    rg.msg_id = SnNextMid(s);
+    rg.topic_name = key;
+    std::string dg;
+    sn::Serialize(rg, &dg);
+    SnOut(c, dg);
+    return tid;
+  }
+
+  void SnDeliverPublish(Conn& c, std::string_view topic,
+                        std::string_view payload, uint8_t qos, bool retain,
+                        bool dup, uint16_t pid) {
+    if (payload.size() > sn::kMaxPayload) {
+      // exceeds the SN u16 wire limit: drop, never truncate the length
+      stats_[kStSnDropsOversize].fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+    uint16_t tid = SnDeliverTid(c, topic);
+    if (!tid) return;
+    uint8_t flags = sn::QosFlags(qos);
+    if (retain) flags |= sn::kFRetain;
+    if (dup) flags |= sn::kFDup;
+    std::string dg;
+    sn::BuildPublish(&dg, flags, tid, qos ? pid : 0, payload, nullptr,
+                     nullptr);
+    stats_[kStSnOut].fetch_add(1, std::memory_order_relaxed);
+    stats_[kStFastBytesOut].fetch_add(dg.size(),
+                                      std::memory_order_relaxed);
+    SnOut(c, dg);
+  }
+
+  void SnRexmitTrack(uint64_t id, Conn& c, uint16_t pid, std::string dgram,
+                     size_t flags_off) {
+    c.sn->rexmit.push_back(
+        {pid, std::move(dgram), flags_off, NowMs(), 0});
+    sn_rexmit_.insert(id);
+  }
+
+  void SnRexmitAck(uint64_t id, SnConnState& s, uint16_t pid) {
+    auto& rx = s.rexmit;
+    for (size_t i = 0; i < rx.size(); i++) {
+      if (rx[i].pid != pid) continue;
+      rx[i] = std::move(rx.back());
+      rx.pop_back();
+      break;
+    }
+    if (rx.empty()) sn_rexmit_.erase(id);
+  }
+
+  // qos1 fast-path delivery to an SN subscriber: SN framing + the SAME
+  // AckState window/pending machinery as TCP, plus a retransmit copy
+  // (UDP loses datagrams; the inflight bitmap is the authority the
+  // timeout scan reads). Returns whether a delivery/admit happened.
+  // 0 = dropped, 1 = written to the outbuf, 2 = parked in the window
+  // queue (the caller must NOT count kStFastOut — the dequeue does)
+  int SnDeliverElevated(uint64_t owner, Conn& t, std::string_view topic,
+                        std::string_view payload, bool retain) {
+    if (payload.size() > sn::kMaxPayload) {
+      // exceeds the SN u16 wire limit: drop, never truncate the length
+      stats_[kStSnDropsOversize].fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    }
+    AckState& a = EnsureAck(t);
+    uint16_t tid = SnDeliverTid(t, topic);
+    if (!tid) return 0;
+    uint8_t flags = sn::QosFlags(1);
+    if (retain) flags |= sn::kFRetain;
+    if (a.inflight_cnt >= t.max_inflight) {
+      // receive window full: queue (the mqueue), drop on overflow —
+      // the parked copy is a whole SN datagram with a zero msg id the
+      // dequeue patches (DrainPending's SN branch)
+      if (a.pending.size() >= kMaxPending) {
+        stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
+        if (telemetry_) FrNote(t, kFrDrop, 3, 1, cur_hash_);
+        return 0;
+      }
+      std::string dg;
+      size_t fo, mo;
+      sn::BuildPublish(&dg, flags, tid, 0, payload, &fo, &mo);
+      a.pending.emplace_back(std::move(dg), mo);
+      AckNote(owner, a);
+      return 2;
+    }
+    uint16_t tp = NextPid(a);
+    std::string dg;
+    size_t fo, mo;
+    sn::BuildPublish(&dg, flags, tid, tp, payload, &fo, &mo);
+    if (telemetry_) {
+      if (a.rtt.size() < kRttSamples)
+        a.rtt.push_back({NowNs(), std::string(topic), tp, 1});
+      FrNote(t, kFrDeliver, 3, tp, cur_hash_);
+    }
+    stats_[kStSnOut].fetch_add(1, std::memory_order_relaxed);
+    stats_[kStFastBytesOut].fetch_add(dg.size(),
+                                      std::memory_order_relaxed);
+    SnOut(t, dg);
+    SnRexmitTrack(owner, t, tp, std::move(dg), fo);
+    AckNote(owner, a);
+    return 1;
+  }
+
+  // Timeout scan (~4/s, gated on any tracked delivery existing):
+  // resend with DUP, abandon after kSnMaxRetries freeing the window
+  // slot exactly as a PUBACK would.
+  void SnRexmitScan() {
+    if (sn_rexmit_.empty()) return;
+    uint64_t now = NowMs();
+    if (now - sn_last_rexmit_ms_ < 250) return;
+    sn_last_rexmit_ms_ = now;
+    bool resent = false;
+    for (auto it = sn_rexmit_.begin(); it != sn_rexmit_.end();) {
+      uint64_t id = *it;
+      auto cit = conns_.find(id);
+      if (cit == conns_.end() || !cit->second.sn) {
+        it = sn_rexmit_.erase(it);
+        continue;
+      }
+      Conn& c = cit->second;
+      if (!c.sn->awake) {
+        // announced sleep (§6.14): the radio is off, so neither the
+        // retry timer nor the abandonment counter may advance — the
+        // parked sleep_buf copy is this delivery's FIRST transmission,
+        // sent at wake, and the timer restarts there.
+        ++it;
+        continue;
+      }
+      auto& rx = c.sn->rexmit;
+      for (size_t i = 0; i < rx.size();) {
+        SnInflightRx& r = rx[i];
+        if (now - r.last_tx_ms < kSnRetryMs) {
+          i++;
+          continue;
+        }
+        if (r.tries >= kSnMaxRetries) {
+          if (c.ack) {
+            AckState& a = *c.ack;
+            uint32_t bi = r.pid - kNativePidBase;
+            if (BitTest(a.inflight, bi)) {
+              BitClr(a.inflight, bi);
+              a.inflight_cnt--;
+              a.cyc_acked++;
+              AckNote(id, a);
+            }
+          }
+          stats_[kStDropsInflight].fetch_add(1,
+                                             std::memory_order_relaxed);
+          rx[i] = std::move(rx.back());
+          rx.pop_back();
+          continue;
+        }
+        r.dgram[r.flags_off] = static_cast<char>(
+            static_cast<uint8_t>(r.dgram[r.flags_off]) | sn::kFDup);
+        c.outbuf += r.dgram;
+        MarkDirty(id, c);
+        resent = true;
+        r.last_tx_ms = now;
+        r.tries++;
+        i++;
+      }
+      if (c.ack) DrainPending(id, c);  // abandoned slots pull the queue
+      if (rx.empty())
+        it = sn_rexmit_.erase(it);
+      else
+        ++it;
+    }
+    if (resent) FlushDirty();
+  }
+
+  // Datagram egress: outbuf holds whole self-delimiting SN messages.
+  // Consecutive messages pack into aggregate datagrams up to
+  // sn::kPackDatagram (the peer's ParseAll loop decodes them all from
+  // one recv), and up to kSnSendBatch aggregates go out per sendmmsg —
+  // two layers of syscall amortization, because a per-message sendto
+  // costs ~65us on sandboxed kernels. EAGAIN keeps the tail for a
+  // later flush; other send errors (ICMP unreachable) drop one
+  // aggregate and keep going — UDP semantics.
+  static constexpr int kSnSendBatch = 16;
+
+  void SnFlush(uint64_t id, Conn& c) {
+    SnConnState& s = *c.sn;
+    if (s.anon) {
+      // the shared QoS -1 publisher has no peer to answer
+      c.outbuf.clear();
+      c.outpos = 0;
+      if (c.want_close) Drop(id, "closed_by_host", false);
+      return;
+    }
+    while (c.outpos < c.outbuf.size()) {
+      // carve the pending range into packed spans at message bounds
+      iovec iov[kSnSendBatch];
+      mmsghdr mm[kSnSendBatch];
+      size_t span_end[kSnSendBatch];
+      int nspan = 0;
+      size_t pos = c.outpos;
+      bool corrupt = false;
+      while (pos < c.outbuf.size() && nspan < kSnSendBatch) {
+        size_t start = pos;
+        while (pos < c.outbuf.size()) {
+          uint8_t b0 = static_cast<uint8_t>(c.outbuf[pos]);
+          size_t dlen;
+          if (b0 == 1) {
+            if (pos + 3 > c.outbuf.size()) {
+              corrupt = true;  // torn prefix: whole messages only live here
+              break;
+            }
+            dlen = (static_cast<uint8_t>(c.outbuf[pos + 1]) << 8) |
+                   static_cast<uint8_t>(c.outbuf[pos + 2]);
+          } else {
+            dlen = b0;
+          }
+          if (dlen < 2 || pos + dlen > c.outbuf.size()) {
+            corrupt = true;  // never spin on bad framing
+            break;
+          }
+          if (pos > start && pos + dlen - start > sn::kPackDatagram)
+            break;  // aggregate full; oversized singles go out alone
+          pos += dlen;
+        }
+        if (pos == start) break;  // corrupt head, nothing to carve
+        iov[nspan].iov_base = const_cast<char*>(c.outbuf.data() + start);
+        iov[nspan].iov_len = pos - start;
+        memset(&mm[nspan].msg_hdr, 0, sizeof(mm[nspan].msg_hdr));
+        mm[nspan].msg_hdr.msg_name = &s.addr;
+        mm[nspan].msg_hdr.msg_namelen = sizeof(s.addr);
+        mm[nspan].msg_hdr.msg_iov = &iov[nspan];
+        mm[nspan].msg_hdr.msg_iovlen = 1;
+        span_end[nspan] = pos;
+        nspan++;
+        if (corrupt) break;  // send what precedes the corrupt boundary
+      }
+      if (nspan == 0) {
+        if (corrupt) {  // bad framing at the head: never spin on it
+          c.outbuf.clear();
+          c.outpos = 0;
+        }
+        break;
+      }
+      int sentn = sendmmsg(sn_fd_, mm, nspan, MSG_NOSIGNAL);
+      if (sentn < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        c.outpos = span_end[0];  // drop one aggregate, keep going
+        continue;
+      }
+      c.outpos = span_end[sentn - 1];
+      // partial send or a corrupt boundary: loop — the next carve either
+      // retries the remainder or clears the corrupt head above
+    }
+    if (c.outpos >= c.outbuf.size()) {
+      c.outbuf.clear();
+      c.outpos = 0;
+    }
+    if (c.want_close && c.outbuf.empty())
+      Drop(id, "closed_by_host", false);
+  }
+
+  // -- retained snapshot (round 11) ---------------------------------------
+  // SUBSCRIBE-triggered retained delivery below the GIL: the Python
+  // retainer (services/retainer.py — the oracle and authoritative
+  // store) mirrors every store/delete/expire into retained_ via ops,
+  // and the server enqueues one kRetainDeliver op per eligible
+  // subscription. Resolution + encode + write all happen here, for
+  // TCP, WS, and SN subscribers alike.
+
+  void RetainDeliver(uint64_t id, const std::string& filter,
+                     uint8_t maxqos) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    stats_[kStRetainDeliver].fetch_add(1, std::memory_order_relaxed);
+    uint64_t t0 = telemetry_ ? NowNs() : 0;
+    retain_scratch_.clear();
+    retained_.Match(filter, store::WallMs(), &retain_scratch_);
+    // NO kHighWater break here: the acceptance contract is a retained
+    // set bit-identical to the Python oracle, and _native_retained has
+    // already told Python the subscription was served — truncating
+    // mid-set would silently lose the tail with no fallback. Memory is
+    // bounded by the retainer store itself (max_retained), exactly the
+    // exposure the asyncio path has; ordinary publish backpressure
+    // still applies to everything after this burst.
+    for (const RetainEntry* e : retain_scratch_) {
+      uint8_t oq = e->qos < maxqos ? e->qos : maxqos;
+      if (c.sn && oq > 1) oq = 1;  // the SN delivery cap
+      if (oq == 0) {
+        if (c.sn) {
+          SnDeliverPublish(c, e->topic, e->payload, 0, /*retain=*/true,
+                           false, 0);
+        } else {
+          pub_scratch_.clear();
+          BuildPublish(&pub_scratch_, e->topic, e->payload, 0, 0,
+                       c.proto_ver == 5);
+          pub_scratch_[0] = static_cast<char>(0x30 | 0x01);  // retain=1
+          AppendMqtt(c, pub_scratch_.data(), pub_scratch_.size());
+          stats_[kStFastBytesOut].fetch_add(pub_scratch_.size(),
+                                            std::memory_order_relaxed);
+        }
+      } else if (c.sn) {
+        if (!SnDeliverElevated(id, c, e->topic, e->payload,
+                               /*retain=*/true))
+          continue;
+      } else {
+        AckState& a = EnsureAck(c);
+        pub_scratch_.clear();
+        BuildPublish(&pub_scratch_, e->topic, e->payload, 1, 0,
+                     c.proto_ver == 5);
+        pub_scratch_[0] = static_cast<char>(0x30 | (oq << 1) | 0x01);
+        size_t var = 1;
+        while (static_cast<uint8_t>(pub_scratch_[var]) & 0x80) var++;
+        size_t qoff = var + 1 + 2 + e->topic.size();
+        if (a.inflight_cnt >= c.max_inflight) {
+          if (a.pending.size() >= kMaxPending) {
+            stats_[kStDropsInflight].fetch_add(
+                1, std::memory_order_relaxed);
+            continue;
+          }
+          // parked with the retain bit already in the header; the
+          // dequeue patch touches only the qos bits and the pid
+          a.pending.emplace_back(pub_scratch_, qoff);
+          AckNote(id, a);
+        } else {
+          uint16_t tp = NextPid(a);
+          if (oq == 2) BitSet(a.infl_qos2, tp - kNativePidBase);
+          if (telemetry_ && a.rtt.size() < kRttSamples)
+            a.rtt.push_back({NowNs(), e->topic, tp, oq});
+          pub_scratch_[qoff] = static_cast<char>(tp >> 8);
+          pub_scratch_[qoff + 1] = static_cast<char>(tp & 0xFF);
+          AppendMqtt(c, pub_scratch_.data(), pub_scratch_.size());
+          stats_[kStFastBytesOut].fetch_add(pub_scratch_.size(),
+                                            std::memory_order_relaxed);
+          AckNote(id, a);
+        }
+      }
+      stats_[kStRetainMsgsOut].fetch_add(1, std::memory_order_relaxed);
+    }
+    MarkDirty(id, c);
+    FlushDirty();
+    if (telemetry_) RecordHist(kHistRetainDeliver, NowNs() - t0);
+  }
+
   // -- telemetry plane ----------------------------------------------------
 
   void RecordHist(int stage, uint64_t ns) {
@@ -2946,7 +4221,24 @@ class Host {
     }
   }
 
+  // Append one MQTT byte span to a conn's transport buffer; WS conns
+  // get it wrapped in a binary frame (one frame per serialized span,
+  // matching the asyncio server's one-frame-per-packet-batch shape);
+  // SN conns run the MQTT->SN egress translation (sn gateway, below).
+  void AppendMqtt(Conn& c, const char* data, size_t len) {
+    if (c.sn) {
+      SnEgress(c, data, len);
+      return;
+    }
+    if (c.ws) ws::AppendFrameHeader(&c.outbuf, ws::kOpBinary, len);
+    c.outbuf.append(data, len);
+  }
+
   void Flush(uint64_t id, Conn& c) {
+    if (c.sn) {
+      SnFlush(id, c);
+      return;
+    }
     while (c.outpos < c.outbuf.size()) {
       ssize_t n = ::send(c.fd, c.outbuf.data() + c.outpos,
                          c.outbuf.size() - c.outpos, MSG_NOSIGNAL);
@@ -2979,9 +4271,15 @@ class Host {
     if (it == conns_.end()) return;
     if (telemetry_ && it->second.fr) {
       // flight-recorder dump on abnormal close / protocol error, and
-      // always for traced conns (the tail rides the trace log)
+      // always for traced conns (the tail rides the trace log).
+      // want_close means PYTHON asked for this teardown (channel error,
+      // keepalive, server shutdown): those close as closed_by_host even
+      // when the drain hits a dead socket mid-flush, so only genuine
+      // C++-level protocol errors dump the recorder (the Python-side
+      // teardown noise used to dump on every raced sock_error).
       Conn& c = it->second;
-      bool benign = strcmp(reason, "sock_closed") == 0 ||
+      bool benign = c.want_close ||
+                    strcmp(reason, "sock_closed") == 0 ||
                     strcmp(reason, "closed_by_host") == 0 ||
                     strcmp(reason, "ws_close") == 0;
       if (c.traced || !benign) {
@@ -2999,8 +4297,22 @@ class Host {
       subs_.Remove(id, filt);
     for (const auto& [token, filt] : it->second.own_shared)
       subs_.SharedRemove(token, id, filt);
-    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
-    close(it->second.fd);
+    if (it->second.sn) {
+      // datagram conns share the listener fd: release only the
+      // bookkeeping (the addr slot may already point at a successor
+      // after a new-identity re-CONNECT — never steal it)
+      SnConnState& s = *it->second.sn;
+      if (!s.anon) {
+        auto ait = sn_addr_conn_.find(SnAddrKey(s.addr));
+        if (ait != sn_addr_conn_.end() && ait->second == id)
+          sn_addr_conn_.erase(ait);
+      }
+      sn_rexmit_.erase(id);
+      if (id == sn_anon_id_) sn_anon_id_ = 0;
+    } else {
+      epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+      close(it->second.fd);
+    }
     conns_.erase(it);
     if (notify)
       events_.push_back(EncodeRecord(3, id, reason, strlen(reason)));
@@ -3045,8 +4357,12 @@ class Host {
   uint32_t hist_dirty_ = 0;         // bit per stage
   uint64_t poll_exit_ns_ = 0;       // GIL-stint reference stamp
   uint64_t flush_t0_ = 0;           // sampled route->flush stamp
-  uint32_t tele_tick_ = 0;          // 1-in-8 publish sampling counter
-  uint32_t tele_tick_ws_ = 0;       // 1-in-8 WS-ingest sampling counter
+  uint32_t tele_tick_ = 0;          // sampled publish-stage counter
+  uint32_t tele_tick_ws_ = 0;       // sampled WS-ingest counter
+  uint32_t tele_tick_sn_ = 0;       // sampled SN-ingest counter
+  // per-message stages sample 1-in-(mask+1); default 7 = the 1-in-8
+  // documented cadence, overridable via EMQX_NATIVE_TELEMETRY_SHIFT
+  uint32_t tele_mask_ = 7;
   uint64_t fr_now_ms_ = 0;          // per-cycle flight-recorder stamp
   uint64_t last_hist_flush_ms_ = 0;  // hist-delta emission cadence
   uint32_t cur_hash_ = 0;           // current publish's topic hash
@@ -3107,6 +4423,22 @@ class Host {
   std::vector<uint64_t> trunk_dirty_;    // peers batched this cycle
   std::vector<uint64_t> trunk_scratch_;  // peers matched by ONE publish
   std::string trunk_punt_buf_;           // kind-9 sub-3 under construction
+  // -- mqtt-sn gateway (round 11, poll-thread-owned) -----------------------
+  int sn_fd_ = -1;
+  int sn_port_ = 0;
+  uint8_t sn_gw_id_ = 1;
+  uint64_t next_sn_id_ = 1;             // ids minted under kSnConnBit
+  uint64_t sn_anon_id_ = 0;             // the shared QoS -1 publisher
+  std::unordered_map<uint64_t, uint64_t> sn_addr_conn_;  // addr → conn
+  std::unordered_map<uint16_t, std::string> sn_predefined_;
+  std::unordered_set<uint64_t> sn_rexmit_;  // conns with tracked qos1
+  uint64_t sn_last_rexmit_ms_ = 0;
+  std::vector<sn::SnMsg> sn_msgs_scratch_;
+  std::vector<std::string> sn_frames_scratch_;
+  std::vector<uint8_t> sn_rx_buf_;  // recvmmsg slots, sized on first read
+  // -- retained snapshot (round 11, poll-thread-owned) ---------------------
+  RetainTable retained_;
+  std::vector<const RetainEntry*> retain_scratch_;
 };
 
 }  // namespace
@@ -3331,6 +4663,89 @@ int emqx_host_trunk_route_del(void* h, uint64_t peer, const char* filter) {
   op.owner = peer;
   op.str = filter;
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// --- mqtt-sn gateway + retained snapshot (round 11) -------------------------
+
+// Open the MQTT-SN/UDP gateway socket (BEFORE the poll thread starts,
+// like the other listeners). Returns the bound port, or -1.
+int emqx_host_listen_sn(void* h, const char* bind_addr, uint16_t port,
+                        int gw_id) {
+  return static_cast<emqx_native::Host*>(h)->ListenSn(bind_addr, port,
+                                                      gw_id);
+}
+
+// Install/remove a gateway-wide predefined topic id (empty topic
+// forgets the id). Thread-safe; applied on the poll thread.
+int emqx_host_sn_predefined(void* h, uint16_t topic_id,
+                            const char* topic) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSnPredef;
+  op.owner = topic_id;
+  op.str = topic ? topic : "";
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Mirror one retained message into the host-side snapshot (the Python
+// retainer stays the oracle and the authoritative store). deadline_ms
+// is the EFFECTIVE absolute wall-clock expiry (0 = never): Python
+// folds per-message expiry and the store default into one number.
+int emqx_host_set_retained(void* h, const char* topic,
+                           const uint8_t* payload, uint32_t plen,
+                           uint8_t qos, uint64_t deadline_ms) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kRetainSet;
+  op.str = topic;
+  op.str2.assign(reinterpret_cast<const char*>(payload), plen);
+  op.qos = qos;
+  op.token = deadline_ms;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+int emqx_host_retain_del(void* h, const char* topic) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kRetainDel;
+  op.str = topic;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// SUBSCRIBE-triggered retained delivery below the GIL: match the
+// snapshot against `filter` and write every live entry to `conn`
+// (retain=1, qos = min(msg, max_qos); elevated qos rides the native
+// ack plane, SN conns get SN framing + the qos1 cap).
+int emqx_host_retain_deliver(void* h, uint64_t conn, const char* filter,
+                             uint8_t max_qos) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kRetainDeliver;
+  op.owner = conn;
+  op.str = filter;
+  op.qos = max_qos;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Per-message telemetry sampling override: stages sample 1-in-2^shift
+// (default 3). Out-of-range shifts reset to the default.
+int emqx_host_set_telemetry_shift(void* h, int shift) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetTeleShift;
+  op.token = static_cast<uint64_t>(shift);
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Codec test surface: parse every SN message in `in` with the shared
+// sn.h codec and re-serialize — tests/test_native_sn.py drives the
+// Python oracle codec through the same vectors and compares bytes.
+long emqx_sn_roundtrip(const uint8_t* in, size_t len, uint8_t** out,
+                       size_t* out_len) {
+  std::vector<emqx_native::sn::SnMsg> msgs;
+  emqx_native::sn::ParseAll(in, len, &msgs);
+  std::string buf;
+  for (const auto& m : msgs) emqx_native::sn::Serialize(m, &buf);
+  uint8_t* p = static_cast<uint8_t*>(malloc(buf.size() ? buf.size() : 1));
+  memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = buf.size();
+  return static_cast<long>(msgs.size());
 }
 
 // --- durable-session plane (round 10) --------------------------------------

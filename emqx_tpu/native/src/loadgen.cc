@@ -25,6 +25,7 @@
 #include <vector>
 
 #include "frame.h"
+#include "sn.h"
 #include "ws.h"
 
 namespace {
@@ -372,7 +373,8 @@ extern "C" {
 int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
                      uint32_t n_pubs, uint32_t msgs_per_pub, uint8_t qos,
                      uint32_t payload_len, int proto_ver, int idle_timeout_ms,
-                     uint32_t window, int warmup, int ws, uint64_t* out) {
+                     uint32_t window, int warmup, int ws, uint32_t salt,
+                     uint64_t* out) {
   Loadgen lg;
   lg.proto_ver = proto_ver;
   lg.qos = qos;
@@ -400,7 +402,7 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
     ev.events = EPOLLIN;
     ev.data.u32 = i;
     epoll_ctl(lg.ep, EPOLL_CTL_ADD, c.fd, &ev);
-    std::string cid = (c.is_sub ? "lgs" : "lgp") + std::to_string(i);
+    std::string cid = (c.is_sub ? "lgs" : "lgp") + std::to_string(salt + i);
     if (ws) {
       c.ws = true;
       c.cid = cid;
@@ -434,7 +436,7 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
   for (uint32_t i = 0; i < n_subs; i++) {
     LgConn& c = lg.conns[i];
     if (c.fd < 0) continue;
-    lg.AppendOut(c, Subscribe(1, "lg/" + std::to_string(i) + "/+", qos,
+    lg.AppendOut(c, Subscribe(1, "lg/" + std::to_string(salt + i) + "/+", qos,
                               proto_ver));
     lg.FlushOut(c);
   }
@@ -458,8 +460,8 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
         uint64_t stamp = NowNs();
         std::string payload(reinterpret_cast<char*>(&stamp), 8);
         payload += pad;
-        lg.AppendOut(c, Publish("lg/" + std::to_string(k) + "/m", payload,
-                                0, 0, proto_ver));
+        lg.AppendOut(c, Publish("lg/" + std::to_string(salt + k) + "/m",
+                                payload, 0, 0, proto_ver));
       }
       lg.FlushOut(c);
     }
@@ -495,7 +497,7 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
         std::string payload(reinterpret_cast<char*>(&stamp), 8);
         payload += pad;
         std::string topic =
-            "lg/" + std::to_string((j + next_msg[j]) % n_subs) + "/m";
+            "lg/" + std::to_string(salt + (j + next_msg[j]) % n_subs) + "/m";
         if (qos) pid = pid == 0x7FFF ? 1 : pid + 1;
         lg.AppendOut(c, Publish(topic, payload, qos, pid, proto_ver));
         next_msg[j]++;
@@ -540,6 +542,309 @@ int emqx_loadgen_run(const char* host, uint16_t port, uint32_t n_subs,
   out[5] = mx;
   out[6] = lg.acks;
   out[7] = lg.errors;
+  return 0;
+}
+
+// --- MQTT-SN/UDP fleet (round 11) -------------------------------------------
+// The emqtt-bench analogue for the SN gateway: a connected-UDP fleet
+// speaking the shared sn.h codec (the same functions the host decodes
+// with). Subscribers SUBSCRIBE "lgsn/<i>" and count deliveries (8-byte
+// ns stamp at the payload head, like the TCP fleet); publisher j
+// REGISTERs and blasts "lgsn/<j % n_subs>". UDP has no transport
+// backpressure, so pacing is ALWAYS windowed (sent-minus-progress cap;
+// window=0 defaults to 1024) — an unpaced blast would measure kernel
+// datagram drops, not the broker.
+
+namespace {
+
+struct SnLgConn {
+  int fd = -1;
+  uint32_t idx = 0;
+  bool is_sub = false;
+  bool connacked = false;
+  bool subacked = false;
+  bool regacked = false;
+  uint16_t pub_tid = 0;  // publisher's registered topic id
+  std::string obuf;      // messages packed per datagram (sn.h cap)
+};
+
+}  // namespace
+
+// out[8]: sent, received, wall_ns, p50_ns, p99_ns, max_ns, acks, errors
+int emqx_loadgen_run_sn(const char* host, uint16_t port, uint32_t n_subs,
+                        uint32_t n_pubs, uint32_t msgs_per_pub,
+                        uint8_t qos, uint32_t payload_len,
+                        int idle_timeout_ms, uint32_t window, int warmup,
+                        uint64_t* out) {
+  namespace lsn = emqx_native::sn;
+  if (window == 0) window = 1024;
+  uint32_t total = n_subs + n_pubs;
+  std::vector<SnLgConn> conns(total);
+  int ep = epoll_create1(EPOLL_CLOEXEC);
+  if (ep < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(ep);
+    return -2;
+  }
+  uint64_t sent = 0, received = 0, acks = 0, errors = 0;
+  std::vector<uint64_t> lat;
+  lat.reserve(1 << 20);
+
+  auto cleanup = [&]() {
+    for (auto& c : conns)
+      if (c.fd >= 0) close(c.fd);
+    close(ep);
+  };
+  // Messages pack into aggregate datagrams (one send() per ~46 small
+  // messages instead of one each — per-datagram UDP syscalls dominate
+  // on sandboxed kernels). flush_conn ships the pending aggregate;
+  // every sender loop flushes before blocking so nothing is stranded.
+  auto flush_conn = [&](SnLgConn& c) {
+    if (c.obuf.empty()) return;
+    if (send(c.fd, c.obuf.data(), c.obuf.size(), MSG_NOSIGNAL) < 0 &&
+        errno != EAGAIN && errno != EWOULDBLOCK)
+      errors++;
+    c.obuf.clear();
+  };
+  auto send_msg = [&](SnLgConn& c, const lsn::SnMsg& m) {
+    std::string dg;
+    lsn::Serialize(m, &dg);
+    if (!c.obuf.empty() && c.obuf.size() + dg.size() > lsn::kPackDatagram)
+      flush_conn(c);
+    c.obuf += dg;
+    if (c.obuf.size() >= lsn::kPackDatagram) flush_conn(c);
+  };
+
+  for (uint32_t i = 0; i < total; i++) {
+    SnLgConn& c = conns[i];
+    c.idx = i;
+    c.is_sub = i < n_subs;
+    c.fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (c.fd < 0 ||
+        connect(c.fd, reinterpret_cast<sockaddr*>(&addr),
+                sizeof(addr)) < 0) {
+      cleanup();
+      return -3;
+    }
+    int buf = 4 << 20;  // datagram bursts queue in the kernel, not drop
+    setsockopt(c.fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = i;
+    epoll_ctl(ep, EPOLL_CTL_ADD, c.fd, &ev);
+    lsn::SnMsg m;
+    m.type = lsn::kConnect;
+    m.flags = lsn::kFClean;
+    m.duration = 60;
+    m.clientid = (c.is_sub ? "lgsns" : "lgsnp") + std::to_string(i);
+    send_msg(c, m);
+  }
+
+  auto pump = [&](int timeout_ms) {
+    for (auto& c : conns) flush_conn(c);  // nothing stranded across waits
+    epoll_event evs[128];
+    int n = epoll_wait(ep, evs, 128, timeout_ms);
+    if (n < 0) return errno == EINTR;
+    uint8_t chunk[65536];
+    std::vector<lsn::SnMsg> msgs;
+    for (int i = 0; i < n; i++) {
+      SnLgConn& c = conns[evs[i].data.u32];
+      if (c.fd < 0) continue;
+      for (;;) {
+        ssize_t r = recv(c.fd, chunk, sizeof(chunk), 0);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          break;  // EAGAIN (or ICMP error: next send surfaces it)
+        }
+        if (r == 0) continue;
+        msgs.clear();
+        lsn::ParseAll(chunk, static_cast<size_t>(r), &msgs);
+        for (lsn::SnMsg& m : msgs) {
+          if (m.type == lsn::kConnack) {
+            c.connacked = true;
+          } else if (m.type == lsn::kSuback) {
+            c.subacked = true;
+          } else if (m.type == lsn::kRegack) {
+            c.regacked = true;
+            c.pub_tid = m.topic_id;
+          } else if (m.type == lsn::kRegister) {
+            // gateway auto-REGISTER ahead of a delivery: acknowledge
+            lsn::SnMsg ra;
+            ra.type = lsn::kRegack;
+            ra.topic_id = m.topic_id;
+            ra.msg_id = m.msg_id;
+            send_msg(c, ra);
+          } else if (m.type == lsn::kPublish) {
+            int dq = lsn::QosOf(m.flags);
+            if (dq > 0) {
+              lsn::SnMsg pa;
+              pa.type = lsn::kPuback;
+              pa.topic_id = m.topic_id;
+              pa.msg_id = m.msg_id;
+              send_msg(c, pa);
+            }
+            if (m.data.size() >= 8) {
+              uint64_t stamp;
+              memcpy(&stamp, m.data.data(), 8);
+              uint64_t now = NowNs();
+              if (now > stamp && now - stamp < 60ull * 1000000000ull)
+                lat.push_back(now - stamp);
+            }
+            received++;
+          } else if (m.type == lsn::kPuback) {
+            acks++;
+          }
+        }
+      }
+      flush_conn(c);  // replies (PUBACK/REGACK) go out with the drain
+    }
+    return true;
+  };
+
+  // handshake: CONNACKs, then SUBACKs + publisher REGACKs (deadline
+  // 15s with one datagram-loss retry at half time)
+  uint64_t deadline = NowNs() + 15ull * 1000000000ull;
+  bool retried = false;
+  auto phase_done = [&](int phase) {
+    for (auto& c : conns) {
+      if (phase == 0 && !c.connacked) return false;
+      if (phase == 1 && c.is_sub && !c.subacked) return false;
+      if (phase == 1 && !c.is_sub && !c.regacked) return false;
+    }
+    return true;
+  };
+  while (!phase_done(0)) {
+    if (NowNs() > deadline || !pump(100)) {
+      cleanup();
+      return -5;
+    }
+    if (!retried && NowNs() > deadline - 7ull * 1000000000ull) {
+      retried = true;  // a lost CONNECT datagram: one resend sweep
+      for (auto& c : conns)
+        if (!c.connacked) {
+          lsn::SnMsg m;
+          m.type = lsn::kConnect;
+          m.flags = lsn::kFClean;
+          m.duration = 60;
+          m.clientid =
+              (c.is_sub ? "lgsns" : "lgsnp") + std::to_string(c.idx);
+          send_msg(c, m);
+        }
+    }
+  }
+  for (uint32_t i = 0; i < total; i++) {
+    SnLgConn& c = conns[i];
+    if (c.is_sub) {
+      lsn::SnMsg m;
+      m.type = lsn::kSubscribe;
+      m.flags = lsn::QosFlags(qos);
+      m.msg_id = 1;
+      m.topic_name = "lgsn/" + std::to_string(i);
+      send_msg(c, m);
+    } else {
+      lsn::SnMsg m;
+      m.type = lsn::kRegister;
+      m.msg_id = 1;
+      m.topic_name =
+          "lgsn/" + std::to_string(n_subs ? (i - n_subs) % n_subs : 0);
+      send_msg(c, m);
+    }
+  }
+  while (!phase_done(1)) {
+    if (NowNs() > deadline || !pump(100)) {
+      cleanup();
+      return -6;
+    }
+  }
+
+  uint64_t expected = static_cast<uint64_t>(n_pubs) * msgs_per_pub;
+  std::string pad(payload_len > 8 ? payload_len - 8 : 0, 'x');
+
+  auto publish_one = [&](SnLgConn& c, uint8_t q, uint16_t mid) {
+    uint64_t stamp = NowNs();
+    lsn::SnMsg m;
+    m.type = lsn::kPublish;
+    m.flags = lsn::QosFlags(q);
+    m.topic_id = c.pub_tid;
+    m.msg_id = mid;
+    m.data.assign(reinterpret_cast<char*>(&stamp), 8);
+    m.data += pad;
+    send_msg(c, m);
+  };
+
+  if (warmup) {
+    // one slow-path message per publisher earns the publish permit;
+    // then idle past the broker's grant step (the TCP fleet's shape)
+    for (uint32_t j = 0; j < n_pubs; j++)
+      publish_one(conns[n_subs + j], 0, 0);
+    uint64_t settle = NowNs() + 800ull * 1000000ull;
+    while (NowNs() < settle) pump(50);
+    received = acks = 0;
+    lat.clear();
+  }
+
+  // windowed blast: total outstanding (unacked for qos1, undelivered
+  // for qos0-with-subs) capped at `window`
+  std::vector<uint32_t> next_msg(n_pubs, 0);
+  uint64_t t0 = NowNs();
+  uint64_t last_progress = t0;
+  uint64_t last_seen = 0;
+  uint16_t mid = 1;
+  while (true) {
+    bool done_sending = true;
+    uint64_t progress = qos ? acks : (n_subs ? received : sent);
+    for (uint32_t j = 0; j < n_pubs; j++) {
+      SnLgConn& c = conns[n_subs + j];
+      uint32_t burst = 0;
+      while (next_msg[j] < msgs_per_pub && sent - progress < window &&
+             burst++ < 64) {
+        mid = mid == 0xFFFF ? 1 : mid + 1;
+        publish_one(c, qos, mid);
+        next_msg[j]++;
+        sent++;
+      }
+      if (next_msg[j] < msgs_per_pub) done_sending = false;
+    }
+    bool complete = done_sending &&
+                    (qos ? acks >= expected : true) &&
+                    (n_subs ? received >= expected : true);
+    if (complete) break;
+    if (!pump(done_sending ? 50 : 1)) break;
+    uint64_t seen = received + acks;
+    uint64_t now = NowNs();
+    if (seen != last_seen) {
+      last_seen = seen;
+      last_progress = now;
+    } else if (now - last_progress >
+               static_cast<uint64_t>(idle_timeout_ms) * 1000000ull) {
+      break;  // stalled (datagram loss): report what we have
+    }
+  }
+  uint64_t wall = NowNs() - t0;
+
+  uint64_t p50 = 0, p99 = 0, mx = 0;
+  if (!lat.empty()) {
+    size_t i50 = lat.size() / 2;
+    size_t i99 = lat.size() * 99 / 100;
+    if (i99 >= lat.size()) i99 = lat.size() - 1;
+    std::nth_element(lat.begin(), lat.begin() + i50, lat.end());
+    p50 = lat[i50];
+    std::nth_element(lat.begin(), lat.begin() + i99, lat.end());
+    p99 = lat[i99];
+    mx = *std::max_element(lat.begin(), lat.end());
+  }
+  out[0] = sent;
+  out[1] = received;
+  out[2] = wall;
+  out[3] = p50;
+  out[4] = p99;
+  out[5] = mx;
+  out[6] = acks;
+  out[7] = errors;
+  cleanup();
   return 0;
 }
 

@@ -380,4 +380,138 @@ class SubTable {
   std::string key_scratch_;
 };
 
+// ---------------------------------------------------------------------------
+// Host-side retained snapshot (round 11): the INVERSE trie problem —
+// SubTable matches a topic NAME against stored FILTERS; this matches a
+// subscription FILTER against stored topic NAMES, which is exactly the
+// retainer's lookup (services/retainer.py, the Python oracle and the
+// authoritative store). The Python server mirrors every retainer
+// store/delete/expire into this table via poll-thread-applied ops (the
+// match-table mutation discipline: swap-on-update serialized with
+// matching), so SUBSCRIBE-triggered retained delivery resolves and
+// writes below the GIL for TCP, WS, and SN subscribers alike.
+//
+// Threading: poll-thread-owned, like SubTable.
+
+struct RetainEntry {
+  std::string topic;
+  std::string payload;
+  uint8_t qos = 0;
+  // absolute wall-clock expiry (ms since epoch, 0 = never): the
+  // EFFECTIVE deadline — Python folds the per-message expiry property
+  // and the store-wide default into one number at mirror time, so the
+  // C++ check is a single compare
+  uint64_t deadline_ms = 0;
+  bool dollar = false;  // topic starts with '$' (root-wildcard guard)
+};
+
+class RetainTable {
+ public:
+  void Set(const std::string& topic, std::string_view payload, uint8_t qos,
+           uint64_t deadline_ms) {
+    SplitLevels(topic, &levels_);
+    Node* n = &root_;
+    for (std::string_view w : levels_) {
+      auto& kid = n->kids[std::string(w)];
+      if (!kid) kid = std::make_unique<Node>();
+      n = kid.get();
+    }
+    if (!n->here) {
+      n->here = std::make_unique<RetainEntry>();
+      count_++;
+    }
+    n->here->topic = topic;
+    n->here->payload.assign(payload.data(), payload.size());
+    n->here->qos = qos;
+    n->here->deadline_ms = deadline_ms;
+    n->here->dollar = !topic.empty() && topic[0] == '$';
+  }
+
+  bool Del(const std::string& topic) {
+    SplitLevels(topic, &levels_);
+    Node* n = &root_;
+    for (std::string_view w : levels_) {
+      key_.assign(w.data(), w.size());
+      auto it = n->kids.find(key_);
+      if (it == n->kids.end()) return false;
+      n = it->second.get();
+    }
+    if (!n->here) return false;
+    n->here.reset();
+    count_--;
+    // interior nodes stay (the SubTable removal discipline: retained
+    // churn re-creates them constantly, the footprint is tiny)
+    return true;
+  }
+
+  // Every live (unexpired) retained topic matching `filter`, in trie
+  // order. MQTT 4.7.2: a root-level wildcard never exposes '$'-topics.
+  void Match(std::string_view filter, uint64_t now_ms,
+             std::vector<const RetainEntry*>* out) {
+    SplitLevels(filter, &match_levels_);
+    bool guard = !match_levels_.empty() &&
+                 (match_levels_[0] == "+" || match_levels_[0] == "#");
+    MatchNode(&root_, 0, guard, now_ms, out);
+  }
+
+  size_t size() const { return count_; }
+
+ private:
+  struct Node {
+    std::unordered_map<std::string, std::unique_ptr<Node>> kids;
+    std::unique_ptr<RetainEntry> here;  // topic ending exactly here
+  };
+
+  void Emit(const Node* n, bool guard, uint64_t now_ms,
+            std::vector<const RetainEntry*>* out) {
+    const RetainEntry* e = n->here.get();
+    if (!e) return;
+    if (guard && e->dollar) return;
+    if (e->deadline_ms && now_ms >= e->deadline_ms) return;  // expired:
+    // skipped here, DELETED when the Python retainer's own lazy
+    // expiry/sweep fires the delete observer
+    out->push_back(e);
+  }
+
+  void Collect(const Node* n, bool guard, uint64_t now_ms,
+               std::vector<const RetainEntry*>* out) {
+    Emit(n, guard, now_ms, out);
+    for (const auto& [w, kid] : n->kids)
+      Collect(kid.get(), guard, now_ms, out);
+  }
+
+  void MatchNode(const Node* n, size_t i, bool guard, uint64_t now_ms,
+                 std::vector<const RetainEntry*>* out) {
+    if (i == match_levels_.size()) {
+      Emit(n, guard, now_ms, out);
+      return;
+    }
+    std::string_view w = match_levels_[i];
+    if (w == "#") {
+      // '#' covers the remainder INCLUDING zero further levels
+      // ("a/#" matches "a") — emqx_topic.erl match semantics, same as
+      // the retainer oracle's depth >= need mask
+      Collect(n, guard, now_ms, out);
+      return;
+    }
+    if (w == "+") {
+      for (const auto& [word, kid] : n->kids) {
+        if (i == 0 && !word.empty() && word[0] == '$') continue;
+        MatchNode(kid.get(), i + 1, guard, now_ms, out);
+      }
+      return;
+    }
+    key_.assign(w.data(), w.size());
+    auto it = n->kids.find(key_);
+    if (it != n->kids.end()) MatchNode(it->second.get(), i + 1, guard,
+                                       now_ms, out);
+  }
+
+  Node root_;
+  size_t count_ = 0;
+  std::vector<std::string_view> levels_;
+  std::vector<std::string_view> match_levels_;
+  std::string key_;
+};
+
 }  // namespace emqx_native

@@ -124,6 +124,14 @@ class Retainer:
         self.default_expiry_ms = default_expiry_ms
         self._lock = threading.RLock()
         self.dropped = 0
+        # mirror observers (round 11): fired under the store lock as
+        # ("set", topic, msg, effective_deadline_ms) on store/update and
+        # ("del", topic, None, 0) on delete/expire — the native server
+        # replicates the store into the host-side retained snapshot so
+        # SUBSCRIBE-triggered delivery resolves below the GIL. Callbacks
+        # must be non-blocking (they enqueue ops); this store remains
+        # the oracle and the authority.
+        self.observers: list = []
         self._count = 0               # live retained messages (incl. deep)
         # row-aligned store
         self._row_of: dict[str, int] = {}
@@ -164,6 +172,22 @@ class Retainer:
         else:
             self.delete(msg.topic)     # empty retained payload = clear
 
+    def _eff_deadline_ms(self, msg: Message, stored_ms: int) -> int:
+        """Fold the per-message expiry and the store default into ONE
+        absolute wall-clock deadline (0 = never) — the number the
+        native snapshot checks with a single compare."""
+        dl = self._msg_deadline(msg)
+        if self.default_expiry_ms:
+            dl = min(dl, stored_ms + self.default_expiry_ms)
+        return 0 if dl == float("inf") else int(dl)
+
+    def _notify(self, op: str, topic: str, msg, deadline_ms: int) -> None:
+        for fn in self.observers:
+            try:
+                fn(op, topic, msg, deadline_ms)
+            except Exception:  # noqa: BLE001 — a mirror must never
+                pass           # break the authoritative store
+
     def _wid(self, w: str) -> int:
         wid = self._vocab.get(w)
         if wid is None:
@@ -195,6 +219,8 @@ class Retainer:
                         return False
                     self._count += 1
                 self._deep[topic] = (kept, now)
+                self._notify("set", topic, kept,
+                             self._eff_deadline_ms(kept, now))
                 return True
             row = self._row_of.get(topic)
             if row is not None:
@@ -212,6 +238,8 @@ class Retainer:
                 b.msgs[pos] = kept
                 if dl != np.inf:
                     b.finite = True
+                self._notify("set", topic, kept,
+                             self._eff_deadline_ms(kept, now))
                 return True
             if self.max_retained and self._count >= self.max_retained:
                 self.dropped += 1
@@ -240,6 +268,8 @@ class Retainer:
             self._bpos[row] = b.append(
                 row, self._tok[row], len(ids), dl, now, kept, topic)
             self._count += 1
+            self._notify("set", topic, kept,
+                         self._eff_deadline_ms(kept, now))
             return True
 
     def delete(self, topic: str) -> bool:
@@ -247,6 +277,7 @@ class Retainer:
             if topic in self._deep:
                 del self._deep[topic]
                 self._count -= 1
+                self._notify("del", topic, None, 0)
                 return True
             row = self._row_of.pop(topic, None)
             if row is None:
@@ -268,6 +299,7 @@ class Retainer:
             # amortized over >= n/2 deletes
             if self._dead > 1024 and self._dead * 2 > self._n:
                 self._compact()
+            self._notify("del", topic, None, 0)
             return True
 
     def _compact(self) -> None:
@@ -454,4 +486,33 @@ class Retainer:
             out = [self._topics[r] for r in range(self._n)
                    if self._alive[r]]
             out.extend(self._deep)
+            return out
+
+    def mirror_attach(self, fn) -> None:
+        """Atomically boot a mirror: replay the current store through
+        ``fn`` as ("set", ...) events, then register it as an observer —
+        all under the store lock. A store/delete racing the native
+        server's boot mirror therefore either lands in the replay or
+        fires the observer after it, in order; it can never fall in a
+        gap (missed mutation) or apply out of order (a delete overtaken
+        by a stale boot "set" would resurrect the topic)."""
+        with self._lock:
+            for topic, msg, dl in self.dump():
+                fn("set", topic, msg, dl)
+            self.observers.append(fn)
+
+    def dump(self) -> list[tuple]:
+        """Every live retained message as ``(topic, msg,
+        effective_deadline_ms)`` — the native server's boot-time mirror
+        snapshot (messages retained before the server started)."""
+        with self._lock:
+            out = []
+            for r in range(self._n):
+                if self._alive[r] and self._msgs[r] is not None:
+                    out.append((self._topics[r], self._msgs[r],
+                                self._eff_deadline_ms(self._msgs[r],
+                                                      self._stored[r])))
+            for topic, (msg, stored_at) in self._deep.items():
+                out.append((topic, msg,
+                            self._eff_deadline_ms(msg, stored_at)))
             return out

@@ -907,9 +907,149 @@ print("SANITIZED-RUN-OK", st["durable_in"], st["handoffs"], ss["appends"])
 """
 
 
+DRIVER_SN = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+host = native.NativeHost(port=0, max_size=1 << 16)
+sn_port = host.listen_sn("127.0.0.1", 0, gw_id=3)
+host.sn_predefined(1, "pre/one")
+
+def sn_connect(cid, clean=True, duration=60):
+    body = bytes([0x04, 0x04 if clean else 0x00, 0x01]) + \
+        struct.pack(">H", duration) + cid
+    return bytes([len(body) + 1]) + body
+
+def sn_subscribe(mid, topic):
+    body = bytes([0x12, 0x00]) + struct.pack(">H", mid) + topic
+    return bytes([len(body) + 1]) + body
+
+def sn_publish_predef(tid, data, qos=0, mid=0):
+    fl = (0x60 if qos == -1 else qos << 5) | 0x01
+    body = bytes([0x0C, fl]) + struct.pack(">HH", tid, mid) + data
+    return bytes([len(body) + 1]) + body
+
+def sn_register(mid, topic):
+    body = bytes([0x0A]) + struct.pack(">HH", 0, mid) + topic
+    return bytes([len(body) + 1]) + body
+
+def sn_short(name2, data):
+    tid = (name2[0] << 8) | name2[1]
+    body = bytes([0x0C, 0x02]) + struct.pack(">HH", tid, 0) + data
+    return bytes([len(body) + 1]) + body
+
+PING = bytes([2, 0x16])
+DISC = bytes([2, 0x18])
+
+stop = threading.Event()
+
+def retain_churn():
+    # retained-snapshot swaps (set/del/expiry-free) + predefined-id
+    # flips racing the poll thread's SUBSCRIBE-triggered matching
+    j = 0
+    while not stop.is_set():
+        host.set_retained("r/%%d" %% (j %% 24), b"v%%05d" %% j, j & 1, 0)
+        if j %% 5 == 3:
+            host.retain_del("r/%%d" %% ((j + 7) %% 24))
+        if j %% 17 == 11:
+            host.sn_predefined(1, None)
+            host.sn_predefined(1, "pre/one")
+        host.stats()
+        j += 1
+        time.sleep(0.0004)
+
+def udp_churn(seed):
+    # datagram conn churn: connect (identities recycle so the addr
+    # slot sees successor re-CONNECTs), register, subscribe (fires
+    # retained delivery), publish qos0/1 via predefined + short ids,
+    # ping, sometimes vanish without DISCONNECT
+    j = 0
+    while not stop.is_set():
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(0.05)
+        s.connect(("127.0.0.1", sn_port))
+        s.send(sn_connect(b"churn-%%d-%%d" %% (seed, j %% 3)))
+        s.send(sn_register(1 + (j & 0xFF), b"reg/%%d" %% (j %% 8)))
+        s.send(sn_subscribe(2 + (j & 0xFF), b"r/+"))
+        s.send(sn_publish_predef(1, b"p%%04d" %% j, qos=j %% 2,
+                                 mid=10 + (j & 0xFF)))
+        s.send(sn_short(b"ab", b"s%%d" %% j))
+        s.send(PING)
+        try:
+            while True:
+                s.recv(4096)
+        except OSError:
+            pass
+        if j %% 3 != 0:
+            s.send(DISC)
+        s.close()
+        j += 1
+
+def qosm1_blaster():
+    # publish-without-connect: every datagram rides the shared
+    # anonymous conn
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.connect(("127.0.0.1", sn_port))
+    j = 0
+    while not stop.is_set():
+        s.send(sn_publish_predef(1, b"m1-%%04d" %% j, qos=-1))
+        j += 1
+        time.sleep(0.0005)
+    s.close()
+
+th = [threading.Thread(target=retain_churn),
+      threading.Thread(target=udp_churn, args=(1,)),
+      threading.Thread(target=udp_churn, args=(2,)),
+      threading.Thread(target=qosm1_blaster)]
+for t in th: t.start()
+
+# main thread plays the Python plane exactly like native_server: answer
+# CONNECT/SUBSCRIBE punts, fast-enable + permit, fire the retained seam
+deadline = time.time() + 25
+while time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind != native.EV_FRAME:
+            continue
+        t = payload[0] >> 4
+        if t == 1:                                  # CONNECT
+            host.send(conn, b"\x20\x02\x00\x00")
+            host.enable_fast(conn, 4, 32)
+            host.permit(conn, "pre/one")
+        elif t == 8:                                # SUBSCRIBE
+            pid = struct.unpack(">H", payload[2:4])[0]
+            tl = struct.unpack(">H", payload[4:6])[0]
+            filt = payload[6:6 + tl].decode()
+            host.sub_add(conn, filt, qos=0)
+            host.send(conn, b"\x90\x03" + struct.pack(">H", pid) + b"\x00")
+            host.retain_deliver(conn, filt, 1)
+        elif t == 3:                                # punted PUBLISH
+            qos = (payload[0] >> 1) & 3
+            if qos:
+                tl = struct.unpack(">H", payload[2:4])[0]
+                pid = struct.unpack(">H", payload[4 + tl:6 + tl])[0]
+                host.send(conn, b"\x40\x02" + struct.pack(">H", pid))
+    st = host.stats()
+    if (st["sn_in"] > 150 and st["retain_set"] > 150
+            and st["retain_msgs_out"] > 20 and st["sn_qos_m1"] > 50):
+        break
+
+stop.set()
+for t in th: t.join()
+st = host.stats()
+assert st["sn_in"] > 0 and st["sn_registers"] > 0, st
+assert st["retain_set"] > 0 and st["retain_msgs_out"] > 0, st
+assert st["sn_qos_m1"] > 0, st
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+print("SANITIZED-RUN-OK", st["sn_in"], st["retain_msgs_out"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
-                                    "telemetry", "trunk", "durable"])
+                                    "telemetry", "trunk", "durable", "sn"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -927,7 +1067,7 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
            "lane": DRIVER_LANE, "ws": DRIVER_WS,
            "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
-           "durable": DRIVER_DURABLE}[driver]
+           "durable": DRIVER_DURABLE, "sn": DRIVER_SN}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

@@ -1,0 +1,833 @@
+"""The native (C++) MQTT-SN gateway plane — sn.h/host.cc driven against
+gateway/mqttsn.py as the protocol oracle: every test client speaks the
+ORACLE's codec over real UDP sockets, so any disagreement between the
+two MQTT-SN implementations fails here, and one shared vector set locks
+the codecs together byte-for-byte.
+
+Covers: the shared codec vectors (parse+serialize parity incl. the
+malformed-length set), CONNECT/REGISTER/SUBSCRIBE/PUBLISH end-to-end on
+the native plane, topic-id registry edges (idempotent REGISTER,
+invalid-id PUBACK, wildcard tid 0), the QoS -1 publish-without-connect
+lane, the fast-path permit ride, qos1 retransmit-on-timeout through the
+ack plane's inflight bitmaps, qos2 over SN (PUBREC/PUBREL/PUBCOMP),
+sleep-mode buffering until PINGREQ, retained-on-subscribe parity across
+SN/TCP/WS against the Python retainer oracle, the props-fallback
+degradation, and the asyncio-gateway deployment fallback."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from emqx_tpu import native
+from emqx_tpu.core.message import Message
+from emqx_tpu.gateway import mqttsn as SN
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib: {native.build_error()}")
+
+
+@pytest.fixture()
+def app():
+    from emqx_tpu.app import BrokerApp
+
+    return BrokerApp()
+
+
+@pytest.fixture()
+def server(app):
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+
+    srv = NativeBrokerServer(
+        port=0, app=app, sn_port=0, ws_port=0,
+        sn_predefined={1: "pre/defined", 7: "pre/seven"},
+        session_opts={"max_inflight": 32})
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class SnSock:
+    """Blocking UDP client speaking the ORACLE's codec (SN.Frame)."""
+
+    def __init__(self, port: int):
+        self.f = SN.Frame()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.settimeout(5)
+        self.sock.connect(("127.0.0.1", port))
+        self.inbox: list = []
+
+    def send(self, m: SN.SnMessage) -> None:
+        self.sock.send(self.f.serialize(m))
+
+    def recv(self, timeout: float = 5.0) -> SN.SnMessage:
+        self.sock.settimeout(timeout)
+        while not self.inbox:
+            data = self.sock.recv(65536)
+            self.inbox.extend(self.f.parse(data, None)[0])
+        return self.inbox.pop(0)
+
+    def recv_until(self, type_, timeout: float = 5.0) -> SN.SnMessage:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            m = self.recv(timeout=max(0.1, deadline - time.time()))
+            if m.type == type_:
+                return m
+        raise AssertionError(f"no SN message of type {type_}")
+
+    def connect(self, cid: str, duration: int = 60,
+                clean: bool = True) -> SN.SnMessage:
+        self.send(SN.SnMessage(SN.CONNECT,
+                               flags=SN.F_CLEAN if clean else 0,
+                               duration=duration, clientid=cid))
+        ack = self.recv()
+        assert ack.type == SN.CONNACK and ack.rc == SN.RC_ACCEPTED, (
+            ack.type, ack.rc)
+        return ack
+
+    def close(self):
+        self.sock.close()
+
+
+# ---------------------------------------------------------------------------
+# shared codec vectors: the oracle codec and sn.h must agree byte-level
+# ---------------------------------------------------------------------------
+
+def _vectors() -> list:
+    qf = SN.qos_flags
+    big = b"y" * 400                    # forces the 3-byte length form
+    return [
+        SN.SnMessage(SN.CONNECT, flags=SN.F_CLEAN, duration=30,
+                     clientid="dev-1"),
+        SN.SnMessage(SN.CONNECT, flags=SN.F_CLEAN | SN.F_WILL,
+                     duration=0, clientid=""),
+        SN.SnMessage(SN.CONNACK, rc=SN.RC_NOT_SUPPORTED),
+        SN.SnMessage(SN.REGISTER, topic_id=0, msg_id=9,
+                     topic_name="sensors/t1"),
+        SN.SnMessage(SN.REGACK, topic_id=3, msg_id=9, rc=0),
+        SN.SnMessage(SN.PUBLISH, flags=qf(0), topic_id=3, msg_id=0,
+                     data=b"hello"),
+        SN.SnMessage(SN.PUBLISH, flags=qf(1) | SN.F_RETAIN, topic_id=3,
+                     msg_id=11, data=b"r"),
+        SN.SnMessage(SN.PUBLISH, flags=qf(2) | SN.F_DUP, topic_id=3,
+                     msg_id=12, data=b""),
+        SN.SnMessage(SN.PUBLISH, flags=qf(-1) | SN.TID_PREDEF,
+                     topic_id=1, data=b"fire"),
+        SN.SnMessage(SN.PUBLISH, flags=qf(0), topic_id=3, msg_id=0,
+                     data=big),
+        SN.SnMessage(SN.PUBACK, topic_id=3, msg_id=11,
+                     rc=SN.RC_INVALID_TOPIC_ID),
+        SN.SnMessage(SN.PUBREC, msg_id=12),
+        SN.SnMessage(SN.PUBREL, msg_id=12),
+        SN.SnMessage(SN.PUBCOMP, msg_id=12),
+        SN.SnMessage(SN.SUBSCRIBE, flags=qf(1), msg_id=2,
+                     topic_name="sensors/#"),
+        SN.SnMessage(SN.SUBSCRIBE, flags=qf(0) | SN.TID_PREDEF,
+                     msg_id=3, topic_id=7),
+        SN.SnMessage(SN.SUBSCRIBE, flags=qf(0) | SN.TID_SHORT,
+                     msg_id=4, topic_name="ab"),
+        SN.SnMessage(SN.SUBACK, flags=qf(1), topic_id=5, msg_id=2,
+                     rc=0),
+        SN.SnMessage(SN.UNSUBSCRIBE, flags=qf(0), msg_id=5,
+                     topic_name="sensors/#"),
+        SN.SnMessage(SN.UNSUBACK, msg_id=5),
+        SN.SnMessage(SN.PINGREQ),
+        SN.SnMessage(SN.PINGREQ, clientid="sleeper-1"),
+        SN.SnMessage(SN.PINGRESP),
+        SN.SnMessage(SN.DISCONNECT),
+        SN.SnMessage(SN.DISCONNECT, duration=120),
+        SN.SnMessage(SN.SEARCHGW, rc=2),
+        SN.SnMessage(SN.GWINFO, rc=1),
+        SN.SnMessage(SN.ADVERTISE, rc=1, duration=900),
+    ]
+
+
+def test_codec_vectors_shared():
+    """Every vector's oracle parse→reserialize must equal the native
+    codec's parse→reserialize of the SAME datagram — the lock that
+    keeps the two MQTT-SN implementations from drifting apart."""
+    f = SN.Frame()
+    for m in _vectors():
+        wire = f.serialize(m)
+        # oracle roundtrip
+        parsed, _ = f.parse(wire, None)
+        assert len(parsed) == 1, m
+        oracle_bytes = f.serialize(parsed[0])
+        # native roundtrip of the same wire bytes
+        n, native_bytes = native.sn_roundtrip(wire)
+        assert n == 1, m
+        assert native_bytes == oracle_bytes, (
+            f"codec drift on type {m.type}: "
+            f"native={native_bytes!r} oracle={oracle_bytes!r}")
+    # several messages in one datagram parse identically too
+    blob = b"".join(f.serialize(m) for m in _vectors()[:6])
+    n, native_bytes = native.sn_roundtrip(blob)
+    parsed, _ = f.parse(blob, None)
+    assert n == len(parsed) == 6
+    assert native_bytes == b"".join(f.serialize(p) for p in parsed)
+
+
+def test_codec_malformed_lengths_terminate():
+    """The malformed-length set must yield ZERO messages on both
+    planes instead of spinning or over-reading."""
+    f = SN.Frame()
+    for bad in (b"\x00", b"\x01", b"\x01\x00", b"\x01\x00\x00",
+                b"\x01\x00\x02\x00", b"\x05\x0c\x00", b"\x02"):
+        pkts, _ = f.parse(bad, None)
+        n, out = native.sn_roundtrip(bad)
+        assert pkts == [] and n == 0 and out == b"", bad
+
+
+# ---------------------------------------------------------------------------
+# native gateway end-to-end
+# ---------------------------------------------------------------------------
+
+def test_register_publish_subscribe_e2e(server):
+    pub = SnSock(server.sn_port)
+    sub = SnSock(server.sn_port)
+    pub.connect("sn-pub")
+    sub.connect("sn-sub")
+    pub.send(SN.SnMessage(SN.REGISTER, msg_id=1,
+                          topic_name="sensors/t1"))
+    ra = pub.recv()
+    assert ra.type == SN.REGACK and ra.rc == SN.RC_ACCEPTED and \
+        ra.topic_id > 0
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(1), msg_id=2,
+                          topic_name="sensors/#"))
+    sa = sub.recv()
+    assert sa.type == SN.SUBACK and sa.rc == SN.RC_ACCEPTED
+    assert SN.qos_of(sa.flags) == 1          # granted (capped) qos
+    assert sa.topic_id == 0                  # wildcard: no id
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                          topic_id=ra.topic_id, msg_id=3, data=b"21.5"))
+    pa = pub.recv()
+    assert pa.type == SN.PUBACK and pa.rc == SN.RC_ACCEPTED
+    assert (pa.topic_id, pa.msg_id) == (ra.topic_id, 3)
+    reg = sub.recv_until(SN.REGISTER)        # auto-REGISTER on deliver
+    assert reg.topic_name == "sensors/t1" and reg.topic_id > 0
+    dlv = sub.recv_until(SN.PUBLISH)
+    assert dlv.data == b"21.5" and dlv.topic_id == reg.topic_id
+    assert SN.qos_of(dlv.flags) == 1
+    sub.send(SN.SnMessage(SN.PUBACK, topic_id=dlv.topic_id,
+                          msg_id=dlv.msg_id))
+    pub.close()
+    sub.close()
+
+
+def test_topic_id_registry_edges(server):
+    c = SnSock(server.sn_port)
+    c.connect("sn-reg")
+    # idempotent REGISTER: same topic, same id
+    c.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="a/b"))
+    t1 = c.recv().topic_id
+    c.send(SN.SnMessage(SN.REGISTER, msg_id=2, topic_name="a/b"))
+    assert c.recv().topic_id == t1
+    c.send(SN.SnMessage(SN.REGISTER, msg_id=3, topic_name="a/c"))
+    assert c.recv().topic_id != t1
+    # unregistered id: qos1 publish answers INVALID_TOPIC_ID
+    c.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                        topic_id=0x4242, msg_id=4, data=b"x"))
+    pa = c.recv()
+    assert pa.type == SN.PUBACK and pa.rc == SN.RC_INVALID_TOPIC_ID
+    # predefined subscribe echoes the predefined id in the SUBACK
+    c.send(SN.SnMessage(SN.SUBSCRIBE,
+                        flags=SN.qos_flags(0) | SN.TID_PREDEF,
+                        msg_id=5, topic_id=7))
+    sa = c.recv()
+    assert sa.type == SN.SUBACK and sa.rc == SN.RC_ACCEPTED
+    assert sa.topic_id == 7
+    # unknown predefined subscribe: INVALID_TOPIC_ID
+    c.send(SN.SnMessage(SN.SUBSCRIBE,
+                        flags=SN.qos_flags(0) | SN.TID_PREDEF,
+                        msg_id=6, topic_id=99))
+    sa = c.recv()
+    assert sa.type == SN.SUBACK and sa.rc == SN.RC_INVALID_TOPIC_ID
+    c.close()
+
+
+def test_qos_minus_one_predefined(server, app):
+    seen = []
+    app.hooks.add("message.publish",
+                  lambda m: seen.append((m.topic, m.payload)) or None,
+                  priority=-500)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.connect(("127.0.0.1", server.sn_port))
+    f = SN.Frame()
+    # no CONNECT at all: the spec's QoS -1 fire-and-forget
+    s.send(f.serialize(SN.SnMessage(
+        SN.PUBLISH, flags=SN.qos_flags(-1) | SN.TID_PREDEF,
+        topic_id=1, data=b"fire")))
+    deadline = time.time() + 5
+    while time.time() < deadline and ("pre/defined", b"fire") not in seen:
+        time.sleep(0.02)
+    assert ("pre/defined", b"fire") in seen
+    # unknown predefined id: silently dropped, nothing published
+    n0 = len(seen)
+    s.send(f.serialize(SN.SnMessage(
+        SN.PUBLISH, flags=SN.qos_flags(-1) | SN.TID_PREDEF,
+        topic_id=55, data=b"ghost")))
+    time.sleep(0.3)
+    assert len(seen) == n0
+    assert server.fast_stats()["sn_qos_m1"] >= 2
+    s.close()
+
+
+def test_sn_rides_the_fast_path(server):
+    """After the permit warms, SN publishes are consumed natively
+    (fast_in grows, punts stop) — the identical machinery TCP rides."""
+    pub = SnSock(server.sn_port)
+    sub = SnSock(server.sn_port)
+    pub.connect("sn-fast-p")
+    sub.connect("sn-fast-s")
+    pub.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="fast/t"))
+    tid = pub.recv().topic_id
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(0), msg_id=2,
+                          topic_name="fast/t"))
+    sub.recv_until(SN.SUBACK)
+    # first publish earns the permit on the full Python path
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(0),
+                          topic_id=tid, data=b"warm"))
+    sub.recv_until(SN.PUBLISH)
+    time.sleep(0.4)          # the grant runs on an idle poll step
+    base = server.fast_stats()
+    n = 50
+    for i in range(n):
+        pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(0),
+                              topic_id=tid, data=b"m%d" % i))
+    got = 0
+    deadline = time.time() + 10
+    while got < n and time.time() < deadline:
+        m = sub.recv_until(SN.PUBLISH, timeout=deadline - time.time())
+        got += 1
+    assert got == n
+    stats = server.fast_stats()
+    assert stats["fast_in"] - base["fast_in"] >= n, (base, stats)
+    assert stats["sn_in"] - base["sn_in"] >= n
+    assert stats["sn_out"] - base["sn_out"] >= n
+    pub.close()
+    sub.close()
+
+
+def test_qos1_retransmit_via_ack_plane(server):
+    """An unacked native qos1 delivery over UDP retransmits with DUP
+    (the ack plane's inflight bitmap is the authority); the PUBACK
+    stops the retransmits and frees the slot."""
+    pub = SnSock(server.sn_port)
+    sub = SnSock(server.sn_port)
+    pub.connect("sn-rx-p")
+    sub.connect("sn-rx-s")
+    pub.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="rx/t"))
+    tid = pub.recv().topic_id
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(1), msg_id=2,
+                          topic_name="rx/t"))
+    sub.recv_until(SN.SUBACK)
+    # warm the permit so the delivery rides the native ack plane
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                          topic_id=tid, msg_id=3, data=b"w"))
+    pub.recv_until(SN.PUBACK)
+    first = sub.recv_until(SN.PUBLISH)
+    sub.send(SN.SnMessage(SN.PUBACK, topic_id=first.topic_id,
+                          msg_id=first.msg_id))
+    time.sleep(0.4)
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                          topic_id=tid, msg_id=4, data=b"lost-ack"))
+    pub.recv_until(SN.PUBACK)
+    d1 = sub.recv_until(SN.PUBLISH)
+    assert d1.msg_id >= 32768        # native pid space: fast-path served
+    assert not (d1.flags & SN.F_DUP)
+    # no ack: the retransmit scan must resend the SAME msg id with DUP
+    d2 = sub.recv_until(SN.PUBLISH, timeout=4.0)
+    assert d2.msg_id == d1.msg_id and d2.data == b"lost-ack"
+    assert d2.flags & SN.F_DUP
+    # ack now: no further copies
+    sub.send(SN.SnMessage(SN.PUBACK, topic_id=d2.topic_id,
+                          msg_id=d2.msg_id))
+    time.sleep(1.6)
+    sub.sock.settimeout(0.3)
+    leftover = [m for m in sub.inbox if m.type == SN.PUBLISH]
+    try:
+        while True:
+            data = sub.sock.recv(65536)
+            leftover += [m for m in sub.f.parse(data, None)[0]
+                         if m.type == SN.PUBLISH]
+    except socket.timeout:
+        pass
+    assert leftover == []
+    pub.close()
+    sub.close()
+
+
+def test_qos2_exchange_over_sn(server, app):
+    """SN qos2 publish runs the full PUBREC/PUBREL/PUBCOMP exchange
+    (the oracle's fixed method-B shape) and publishes exactly once."""
+    seen = []
+    app.hooks.add("message.publish",
+                  lambda m: seen.append(m.payload) or None, priority=-500)
+    c = SnSock(server.sn_port)
+    c.connect("sn-q2")
+    c.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="q2/t"))
+    tid = c.recv().topic_id
+    c.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(2),
+                        topic_id=tid, msg_id=7, data=b"exactly"))
+    rec = c.recv_until(SN.PUBREC)
+    assert rec.msg_id == 7
+    c.send(SN.SnMessage(SN.PUBREL, msg_id=7))
+    comp = c.recv_until(SN.PUBCOMP)
+    assert comp.msg_id == 7
+    deadline = time.time() + 3
+    while time.time() < deadline and b"exactly" not in seen:
+        time.sleep(0.02)
+    assert seen.count(b"exactly") == 1
+    c.close()
+
+
+def test_sleep_mode_buffers_until_pingreq(server):
+    sub = SnSock(server.sn_port)
+    pub = SnSock(server.sn_port)
+    sub.connect("sn-sleeper")
+    pub.connect("sn-waker")
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(0), msg_id=1,
+                          topic_name="zz/t"))
+    sub.recv_until(SN.SUBACK)
+    pub.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="zz/t"))
+    tid = pub.recv().topic_id
+    # enter sleep (duration announces the silence window)
+    sub.send(SN.SnMessage(SN.DISCONNECT, duration=60))
+    d = sub.recv()
+    assert d.type == SN.DISCONNECT
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(0),
+                          topic_id=tid, data=b"zzz-1"))
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(0),
+                          topic_id=tid, data=b"zzz-2"))
+    time.sleep(0.5)
+    sub.sock.settimeout(0.3)
+    with pytest.raises(socket.timeout):
+        sub.sock.recv(65536)          # parked, not delivered
+    assert server.fast_stats()["sn_sleep_parked"] >= 2
+    # the wake ping flushes parked deliveries, THEN answers PINGRESP
+    sub.send(SN.SnMessage(SN.PINGREQ, clientid="sn-sleeper"))
+    kinds = []
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        m = sub.recv(timeout=deadline - time.time())
+        kinds.append((m.type, m.data))
+        if m.type == SN.PINGRESP:
+            break
+    types = [k for k, _ in kinds]
+    assert types[-1] == SN.PINGRESP
+    pubs = [d for k, d in kinds if k == SN.PUBLISH]
+    assert pubs == [b"zzz-1", b"zzz-2"]
+    assert types.index(SN.PINGRESP) > types.index(SN.PUBLISH)
+    pub.close()
+    sub.close()
+
+
+def test_disconnect_releases_session(server, app):
+    c = SnSock(server.sn_port)
+    c.connect("sn-bye")
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            app.cm.lookup_channel("sn-bye") is None:
+        time.sleep(0.02)
+    assert app.cm.lookup_channel("sn-bye") is not None
+    c.send(SN.SnMessage(SN.DISCONNECT))
+    d = c.recv()
+    assert d.type == SN.DISCONNECT
+    while time.time() < deadline and \
+            app.cm.lookup_channel("sn-bye") is not None:
+        time.sleep(0.02)
+    assert app.cm.lookup_channel("sn-bye") is None
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# retained delivery on the native plane (SN/TCP/WS parity vs the oracle)
+# ---------------------------------------------------------------------------
+
+def _retain_seed(app) -> None:
+    for topic, payload, qos in (
+            ("v/d/temp", b"t", 1), ("v/d/hum", b"h", 0),
+            ("v/other/x", b"o", 0), ("w/d/y", b"w", 2)):
+        app.retainer.store(Message(topic=topic, payload=payload, qos=qos,
+                                   flags={"retain": True}))
+
+
+def _oracle_set(app, filt: str) -> set:
+    return {(m.topic, m.payload) for m in app.retainer.match(filt)}
+
+
+def test_retained_parity_tcp_ws_sn(server, app):
+    """One retained store, three transports: the delivered
+    (topic, payload, retain) sets must be identical to the Python
+    retainer oracle on every plane — resolved below the GIL."""
+    _retain_seed(app)
+    time.sleep(0.3)
+    base = server.fast_stats()["retain_msgs_out"]
+    oracle = _oracle_set(app, "v/d/+")
+    assert len(oracle) == 2
+
+    # -- TCP ---------------------------------------------------------------
+    from emqx_tpu.mqtt.frame import Parser
+    s = socket.create_connection(("127.0.0.1", server.port))
+    s.settimeout(5)
+    p = Parser()
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", 3) + b"rt1"
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    pkts = []
+    while not pkts:
+        pkts += p.feed(s.recv(65536))
+    body = struct.pack(">H", 1) + struct.pack(">H", 5) + b"v/d/+" + b"\x01"
+    s.sendall(bytes([0x82, len(body)]) + body)
+    got = set()
+    deadline = time.time() + 5
+    while len(got) < 2 and time.time() < deadline:
+        for pkt in p.feed(s.recv(65536)):
+            if getattr(pkt, "topic", None):
+                assert pkt.retain is True
+                got.add((pkt.topic, pkt.payload))
+    assert got == oracle
+    s.close()
+
+    # -- WS (the round-7 plane rides the same retained snapshot) -----------
+    from test_native_ws import NativeWsClient
+    from emqx_tpu.mqtt import packet as P
+    ws = NativeWsClient(server.ws_port)
+    ws.handshake()
+    ws.mqtt_connect("rt2")
+    ws.send_mqtt(P.Subscribe(packet_id=1,
+                             topic_filters=[("v/d/+", {"qos": 0})]))
+    got = set()
+    deadline = time.time() + 5
+    while len(got) < 2 and time.time() < deadline:
+        pkt = ws.recv_mqtt(timeout=deadline - time.time())
+        if getattr(pkt, "topic", None):
+            assert pkt.retain is True
+            got.add((pkt.topic, pkt.payload))
+    assert got == oracle
+    ws.close()
+
+    # -- SN ----------------------------------------------------------------
+    c = SnSock(server.sn_port)
+    c.connect("rt3")
+    c.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(1), msg_id=1,
+                        topic_name="v/d/+"))
+    got = set()
+    names = {}
+    deadline = time.time() + 5
+    while len(got) < 2 and time.time() < deadline:
+        m = c.recv(timeout=deadline - time.time())
+        if m.type == SN.REGISTER:
+            names[m.topic_id] = m.topic_name
+        elif m.type == SN.PUBLISH:
+            assert m.flags & SN.F_RETAIN
+            got.add((names[m.topic_id], m.data))
+            if SN.qos_of(m.flags) > 0:
+                c.send(SN.SnMessage(SN.PUBACK, topic_id=m.topic_id,
+                                    msg_id=m.msg_id))
+    assert got == oracle
+    c.close()
+
+    assert server.fast_stats()["retain_msgs_out"] - base >= 6
+
+
+def test_retained_expiry_and_delete_mirror(server, app):
+    """Deletes and expiry reach the snapshot: a cleared slot stops
+    delivering natively, exactly like the oracle."""
+    app.retainer.store(Message(topic="e/d/a", payload=b"live", qos=0,
+                               flags={"retain": True}))
+    app.retainer.store(Message(topic="e/d/b", payload=b"gone", qos=0,
+                               flags={"retain": True}))
+    app.retainer.delete("e/d/b")
+    time.sleep(0.3)
+    c = SnSock(server.sn_port)
+    c.connect("rt-exp")
+    c.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(0), msg_id=1,
+                        topic_name="e/d/+"))
+    got = []
+    deadline = time.time() + 3
+    while time.time() < deadline:
+        try:
+            m = c.recv(timeout=0.5)
+        except socket.timeout:
+            break
+        if m.type == SN.PUBLISH:
+            got.append(m.data)
+    assert got == [b"live"]
+    c.close()
+
+
+def test_retained_props_fall_back_to_python(server, app):
+    """A retained message with v5 properties cannot ride the native
+    encode: the WHOLE seam degrades to the Python lookup (never a
+    partial set) and delivery still happens."""
+    app.retainer.store(Message(
+        topic="p/d/a", payload=b"plain", qos=0, flags={"retain": True}))
+    app.retainer.store(Message(
+        topic="p/d/b", payload=b"propd", qos=0, flags={"retain": True},
+        headers={"properties": {"Content-Type": "x"}}))
+    time.sleep(0.3)
+    assert server._retain_unmirrorable
+    base = server.fast_stats()["retain_deliver"]
+    from emqx_tpu.mqtt.frame import Parser
+    s = socket.create_connection(("127.0.0.1", server.port))
+    s.settimeout(5)
+    p = Parser()
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", 3) + b"rp1"
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    pkts = []
+    while not pkts:
+        pkts += p.feed(s.recv(65536))
+    body = struct.pack(">H", 1) + struct.pack(">H", 5) + b"p/d/+" + b"\x00"
+    s.sendall(bytes([0x82, len(body)]) + body)
+    got = set()
+    deadline = time.time() + 5
+    while len(got) < 2 and time.time() < deadline:
+        for pkt in p.feed(s.recv(65536)):
+            if getattr(pkt, "topic", None):
+                got.add((pkt.topic, pkt.payload))
+    assert got == _oracle_set(app, "p/d/+") == {
+        ("p/d/a", b"plain"), ("p/d/b", b"propd")}
+    # the native seam stayed OUT of it
+    assert server.fast_stats()["retain_deliver"] == base
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: the asyncio gateway still serves when sn_port off
+# ---------------------------------------------------------------------------
+
+def test_asyncio_gateway_fallback(app):
+    """NativeBrokerServer without sn_port + the asyncio MqttsnGateway
+    on the same app: SN clients land on the Python plane, TCP clients
+    on the native plane, one broker serves both."""
+    import asyncio
+
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+
+    srv = NativeBrokerServer(port=0, app=app)
+    srv.start()
+    try:
+        assert srv.sn_port is None
+        result = {}
+
+        async def main():
+            gw = app.gateway.load(SN.MqttsnGateway(port=0))
+            await gw.start_listeners()
+            loop = asyncio.get_running_loop()
+            f = SN.Frame()
+            q: asyncio.Queue = asyncio.Queue()
+
+            class Proto(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    for m in f.parse(data, None)[0]:
+                        q.put_nowait(m)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                Proto, remote_addr=("127.0.0.1", gw.port))
+            tr.sendto(f.serialize(SN.SnMessage(
+                SN.CONNECT, clientid="fb-dev")))
+            ack = await asyncio.wait_for(q.get(), 5)
+            result["rc"] = ack.rc
+            tr.close()
+            await gw.stop_listeners()
+            app.gateway.gateways.pop("mqttsn", None)
+            app.gateway.contexts.pop("mqttsn", None)
+
+        asyncio.run(main())
+        assert result["rc"] == SN.RC_ACCEPTED
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_sleep_does_not_burn_qos1_retries(server):
+    """A qos1 delivery parked during announced sleep must neither tick
+    its retry clock nor its abandonment counter while the radio is off:
+    after a sleep LONGER than kSnMaxRetries * kSnRetryMs (3s) the wake
+    flush is the FIRST transmission, and an unacked copy still
+    retransmits with DUP afterwards (regression: the rexmit scan used
+    to burn all tries during sleep, silently abandoning the delivery
+    and counting drops_inflight for messages that were never sent)."""
+    pub = SnSock(server.sn_port)
+    sub = SnSock(server.sn_port)
+    pub.connect("sn-slrx-p")
+    sub.connect("sn-slrx-s")
+    pub.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="slrx/t"))
+    tid = pub.recv().topic_id
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(1), msg_id=2,
+                          topic_name="slrx/t"))
+    sub.recv_until(SN.SUBACK)
+    # warm the permit so the parked delivery is native-plane tracked
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                          topic_id=tid, msg_id=3, data=b"warm"))
+    pub.recv_until(SN.PUBACK)
+    w = sub.recv_until(SN.PUBLISH)
+    sub.send(SN.SnMessage(SN.PUBACK, topic_id=w.topic_id,
+                          msg_id=w.msg_id))
+    time.sleep(0.4)
+    drops_before = server.fast_stats()["drops_inflight"]
+    sub.send(SN.SnMessage(SN.DISCONNECT, duration=60))
+    assert sub.recv().type == SN.DISCONNECT
+    pub.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                          topic_id=tid, msg_id=4, data=b"parked"))
+    pub.recv_until(SN.PUBACK)
+    time.sleep(3.6)                   # > kSnMaxRetries * kSnRetryMs
+    assert server.fast_stats()["drops_inflight"] == drops_before
+    sub.send(SN.SnMessage(SN.PINGREQ, clientid="sn-slrx-s"))
+    d1 = sub.recv_until(SN.PUBLISH)
+    assert d1.data == b"parked"
+    # no ack: the retry clock restarted at wake, so a DUP copy follows
+    d2 = sub.recv_until(SN.PUBLISH, timeout=4.0)
+    assert d2.msg_id == d1.msg_id and (d2.flags & SN.F_DUP)
+    sub.send(SN.SnMessage(SN.PUBACK, topic_id=d2.topic_id,
+                          msg_id=d2.msg_id))
+    pub.close()
+    sub.close()
+
+
+def test_reconnect_same_clientid_reruns_session(server):
+    """A CONNECT on a live conn with the SAME clientid re-runs the
+    session open (oracle parity: auth + open_session run on EVERY
+    CONNECT) instead of being waved through as a CONNACK retransmit —
+    a rebooted F_CLEAN device must get a fresh topic-id registry, not
+    the ghost of its old one."""
+    c = SnSock(server.sn_port)
+    c.connect("sn-reboot")
+    c.send(SN.SnMessage(SN.REGISTER, msg_id=1, topic_name="rb/t"))
+    old_tid = c.recv().topic_id
+    assert old_tid > 0
+    # the device reboots: same addr, same clientid, clean start
+    c.connect("sn-reboot")
+    # the old registry must be gone — a qos1 PUBLISH on the stale id
+    # answers INVALID_TOPIC_ID, the client's cue to re-REGISTER
+    c.send(SN.SnMessage(SN.PUBLISH, flags=SN.qos_flags(1),
+                        topic_id=old_tid, msg_id=2, data=b"stale"))
+    pa = c.recv_until(SN.PUBACK)
+    assert pa.rc == SN.RC_INVALID_TOPIC_ID
+    c.send(SN.SnMessage(SN.REGISTER, msg_id=3, topic_name="rb/t"))
+    assert c.recv_until(SN.REGACK).rc == SN.RC_ACCEPTED
+    c.close()
+
+
+def test_pipelined_connect_served_not_bounced(server):
+    """Messages pipelined behind CONNECT — even packed into the SAME
+    datagram — are parked through the CONNECT->CONNACK round trip and
+    then served in order (the oracle connects synchronously, so the
+    identical byte sequence succeeds there; the native plane used to
+    bounce each one with DISCONNECT)."""
+    sub = SnSock(server.sn_port)
+    sub.connect("sn-pipe-s")
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(1), msg_id=1,
+                          topic_name="pre/defined"))
+    sub.recv_until(SN.SUBACK)
+    c = SnSock(server.sn_port)
+    f = c.f
+    dgram = (f.serialize(SN.SnMessage(SN.CONNECT, flags=SN.F_CLEAN,
+                                      duration=60, clientid="sn-pipe"))
+             + f.serialize(SN.SnMessage(SN.REGISTER, msg_id=2,
+                                        topic_name="pipe/r"))
+             + f.serialize(SN.SnMessage(
+                 SN.PUBLISH, flags=SN.qos_flags(1) | SN.TID_PREDEF,
+                 topic_id=1, msg_id=3, data=b"piped")))
+    c.sock.send(dgram)
+    got = {}
+    deadline = time.time() + 5
+    while len(got) < 3 and time.time() < deadline:
+        m = c.recv(timeout=max(0.1, deadline - time.time()))
+        assert m.type != SN.DISCONNECT, "pipelined message was bounced"
+        got.setdefault(m.type, m)
+    assert got[SN.CONNACK].rc == SN.RC_ACCEPTED
+    assert got[SN.REGACK].rc == SN.RC_ACCEPTED
+    assert got[SN.PUBACK].rc == SN.RC_ACCEPTED
+    assert sub.recv_until(SN.PUBLISH).data == b"piped"
+    sub.close()
+    c.close()
+
+
+def test_retainer_mirror_attach_is_atomic_replay(app):
+    """mirror_attach replays the existing store through the callback
+    and registers it under ONE lock hold — the boot snapshot and the
+    observer stream are a single ordered event sequence, so a store or
+    delete racing server boot can never fall in a gap."""
+    app.retainer.store(Message(topic="ma/a", payload=b"1", qos=0,
+                               flags={"retain": True}))
+    events = []
+    app.retainer.mirror_attach(
+        lambda op, t, m, dl: events.append((op, t)))
+    assert events == [("set", "ma/a")]
+    app.retainer.store(Message(topic="ma/b", payload=b"2", qos=0,
+                               flags={"retain": True}))
+    app.retainer.delete("ma/a")
+    assert events == [("set", "ma/a"), ("set", "ma/b"), ("del", "ma/a")]
+
+
+def test_oversized_delivery_drops_not_truncates(server):
+    """A publish whose payload cannot fit the SN u16 wire length must
+    be DROPPED at the translation seam (sn_drops_oversize), never
+    length-truncated — a truncated length field would make the egress
+    carve misparse payload bytes as message boundaries and corrupt
+    every queued datagram behind it. Deliveries after the drop still
+    flow."""
+    sub = SnSock(server.sn_port)
+    sub.connect("sn-big-s")
+    sub.send(SN.SnMessage(SN.SUBSCRIBE, flags=SN.qos_flags(0), msg_id=1,
+                          topic_name="big/t"))
+    sub.recv_until(SN.SUBACK)
+
+    import asyncio
+    from emqx_tpu.mqtt.client import MqttClient
+
+    async def blast():
+        c = MqttClient(port=server.port, clientid="big-pub")
+        await c.connect()
+        await c.publish("big/t", b"x" * 70_000)      # > 65526: dropped
+        await c.publish("big/t", b"fits")            # must still arrive
+        await c.close()
+    asyncio.run(blast())
+    d = sub.recv_until(SN.PUBLISH)
+    assert d.data == b"fits"
+    assert server.fast_stats()["sn_drops_oversize"] >= 1
+    sub.close()
+
+
+def test_oracle_registry_full_parity():
+    """Both planes refuse the reserved id 0: a full NORMAL registry
+    answers REGACK rc=congestion, and a delivery needing an id it
+    cannot mint is dropped (not emitted with topic_id=0)."""
+    ch = SN.Channel.__new__(SN.Channel)
+    ch.registry = SN.Registry()
+    ch.id_of_topic = {}
+    ch.topic_of_id = {t: f"t/{t}" for t in range(1, 0x10000)}
+    ch._next_tid = 0
+    ch._next_mid = 0
+    ch.conn_state = "connected"
+    ch.awake = True
+    ch._sleep_buffer = []
+    ch.max_sleep_buffer = 10
+
+    out = ch.handle_in(SN.SnMessage(SN.REGISTER, msg_id=1,
+                                    topic_name="nope/t"))
+    assert out[0].type == SN.REGACK and out[0].rc == SN.RC_CONGESTION
+    assert out[0].topic_id == 0
+
+    class _Msg:
+        topic = "nope/t"
+        payload = b"p"
+        qos = 0
+
+    class _Ctx:
+        @staticmethod
+        def unmount(t):
+            return t
+    ch.ctx = _Ctx()
+    assert ch.handle_deliver([("nope/t", _Msg())]) == []
+
+    # oversized payloads drop on the oracle exactly like the native seam
+    class _Big(_Msg):
+        payload = b"x" * (SN.MAX_PAYLOAD + 1)
+    assert ch.handle_deliver([("nope/t", _Big())]) == []

@@ -406,6 +406,52 @@ def test_flight_recorder_dumps_on_protocol_error():
         server.stop()
 
 
+def test_python_teardown_closes_without_fr_dump():
+    """ISSUE 6 carried edge: a PYTHON-plane channel error tears the conn
+    down through _drop -> emqx_host_close_conn, which the C++ side closes
+    as closed_by_host — NO flight-recorder dump (Python-side teardown
+    used to read as an abnormal close and dump on every raced
+    sock_error).  A genuine C++-level framing error on the very same
+    server still dumps, so the recorder stays a protocol-error signal."""
+    server = NativeBrokerServer(port=0, app=BrokerApp(),
+                                max_packet_size=4096)
+    server.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port))
+        s.sendall(_connect_frame(b"pyerr"))
+        time.sleep(0.3)
+        # frames cleanly in C++ (remaining length 2) but the topic
+        # length claims 80 bytes: FrameError on the PYTHON plane ->
+        # _drop(conn, "frame_error") -> closed_by_host in the host
+        s.sendall(bytes([0x30, 0x02, 0x00, 0x50]))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                if s.recv(4096) == b"":
+                    break                        # host closed the socket
+            except OSError:
+                break
+        time.sleep(0.3)
+        assert server.fast_stats()["fr_dumps"] == 0, server.fast_stats()
+        assert not server.flight_records
+        s.close()
+        # control arm: a framer-level error (oversized remaining length)
+        # is a C++ protocol error and MUST dump
+        s2 = socket.create_connection(("127.0.0.1", server.port))
+        s2.sendall(_connect_frame(b"cpperr"))
+        time.sleep(0.3)
+        s2.sendall(bytes([0x30, 0xFF, 0xFF, 0xFF, 0x7F]))
+        deadline = time.time() + 5
+        while not server.flight_records and time.time() < deadline:
+            time.sleep(0.05)
+        assert server.fast_stats()["fr_dumps"] == 1, server.fast_stats()
+        _conn, reason, _entries = server.flight_records[-1]
+        assert reason == 2                       # protocol_error
+        s2.close()
+    finally:
+        server.stop()
+
+
 # -- slow_subs fed by native ack RTT -----------------------------------------
 
 def test_native_ack_rtt_feeds_slow_subs():

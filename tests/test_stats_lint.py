@@ -185,3 +185,24 @@ def test_durable_fixed_metric_slots_render_at_zero():
     hb.publish_metrics()
     assert seen["$SYS/brokers/n1/metrics/messages.durable.stored"] == b"0"
     assert seen["$SYS/brokers/n1/metrics/messages.durable.replayed"] == b"0"
+
+
+# -- edge-gateway plane (ISSUE 6) ---------------------------------------------
+
+
+def test_sn_retain_slots_and_stages_exported():
+    """The SN gateway + retained-snapshot planes' StatSlots/HistStages
+    stay exported — the mechanical enum lint above passes if BOTH sides
+    dropped them, so their presence is pinned here by name (the
+    trunk-pin pattern). fetch_add sites and prometheus render-at-zero
+    ride the mechanical tests at the top of this file."""
+    for name in ("sn_in", "sn_out", "sn_qos_m1", "sn_pings",
+                 "sn_registers", "sn_sleep_parked",
+                 "retain_set", "retain_del", "retain_deliver",
+                 "retain_msgs_out"):
+        assert name in native.STAT_NAMES, name
+    assert "sn_ingest" in native.HIST_STAGES
+    assert "retain_deliver" in native.HIST_STAGES
+    src = _src()
+    assert "kStSnIn" in src and "kStRetainMsgsOut" in src
+    assert "kHistSnIngest" in src and "kHistRetainDeliver" in src

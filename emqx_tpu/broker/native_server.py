@@ -94,6 +94,24 @@ LANE_MAX_BATCH = 16_384
 LANE_PIPE_DEPTH = 2          # submitted-but-uncollected device batches
 LANE_STALE_BACKOFF_S = 30.0  # sit-out after a C++ stale trip
 TRUNK_RETRY_S = 1.0          # redial cadence for a down trunk peer
+# Dynamic inflight-cap policy (re-derived for the sharded plane —
+# README "Multi-core native plane" carries the full derivation). The
+# policy is PER-CONN, and a conn lives on exactly one shard, so it is
+# per-shard by construction; the constants are shard-count-invariant:
+# - CAP_HEADROOM x occupancy covers demand that doubles within one
+#   kind-7 reporting cycle. Reporting stays per-shard-cycle under
+#   shards; the only new lag is the N poll threads' folds serializing
+#   behind the GIL, measured < 15% cycle stretch at N=2 on the 2-core
+#   container — far inside the 2x headroom.
+# - the deadband (budget/CAP_DEADBAND_DIV, floored at
+#   CAP_DEADBAND_MIN) must exceed per-cycle occupancy jitter, which
+#   scales with cycle LENGTH, not shard count: per-shard cycles are
+#   unchanged, so 1/8 stands. Re-dividing every wiggle taxed the data
+#   plane measurably when tuned (round 6) — the cap op is an
+#   enqueue+wake the owner shard must apply before its next read.
+CAP_HEADROOM = 2
+CAP_DEADBAND_DIV = 8
+CAP_DEADBAND_MIN = 8
 
 
 class _NativeConn:
@@ -128,6 +146,201 @@ class _NativeConn:
             self.server.host.send(self.conn_id, data)
 
 
+class _ShardedHost:
+    """The ``NativeHost`` control surface over N shard hosts (round 12).
+
+    One instance per sharded server; routes each call to the right
+    place so every existing call site works unchanged:
+
+    - **per-conn ops** (send/close/fast flags/permits/traces/caps/
+      retained delivery/idle probe) go to the shard whose prefix the
+      conn id carries (``native.shard_of``) — conn ids are minted with
+      bits 56-58 = shard, so the owner is always derivable;
+    - **table ops** (sub/shared/durable entries, retained mirror, SN
+      predefined ids, lane/qos/telemetry switches, permit flushes,
+      trunk ROUTES) broadcast to every shard: the match table is
+      replicated, each shard applies ops in its own ApplyPending;
+    - **trunk LINK ops** (listen/connect/disconnect) go to shard 0
+      only — the trunk plane lives there; other shards ring-forward
+      remote legs to it (host.cc XShip → kTrunkOwnerBase target);
+    - **aggregates** (stats, lane backlog) sum across shards.
+    """
+
+    def __init__(self, hosts: list):
+        self.hosts = hosts
+        self.port = hosts[0].port
+
+    # a wedged poll thread leaks EVERY shard host (any of the N poll
+    # threads may still be inside emqx_host_poll) — and the ring group,
+    # whose doorbells a leaked host's producers may still write
+    @property
+    def leaked(self) -> bool:
+        return any(h.leaked for h in self.hosts)
+
+    @leaked.setter
+    def leaked(self, v: bool) -> None:
+        for h in self.hosts:
+            h.leaked = v
+
+    # ports resolved by the per-shard listen calls in __init__
+    @property
+    def ws_port(self) -> int:
+        return self.hosts[0].ws_port
+
+    @property
+    def trunk_port(self) -> int:
+        return self.hosts[0].trunk_port
+
+    @property
+    def sn_port(self) -> int:
+        return self.hosts[0].sn_port
+
+    def _of(self, conn: int):
+        return self.hosts[native.shard_of(conn) % len(self.hosts)]
+
+    # -- per-conn ops (routed by the conn id's shard prefix) -----------------
+
+    def send(self, conn, data):
+        self._of(conn).send(conn, data)
+
+    def close_conn(self, conn):
+        self._of(conn).close_conn(conn)
+
+    def enable_fast(self, conn, proto_ver, max_inflight=0):
+        self._of(conn).enable_fast(conn, proto_ver, max_inflight)
+
+    def disable_fast(self, conn):
+        self._of(conn).disable_fast(conn)
+
+    def permit(self, conn, topic):
+        self._of(conn).permit(conn, topic)
+
+    def set_trace(self, conn, on):
+        self._of(conn).set_trace(conn, on)
+
+    def set_inflight_cap(self, conn, cap):
+        self._of(conn).set_inflight_cap(conn, cap)
+
+    def retain_deliver(self, conn, filter_, max_qos=0):
+        self._of(conn).retain_deliver(conn, filter_, max_qos)
+
+    def conn_idle_ms(self, conn):
+        # poll-thread-only on the OWNING shard (the per-shard housekeep
+        # scan runs on that shard's thread; C++ refuses -2 otherwise)
+        return self._of(conn).conn_idle_ms(conn)
+
+    # -- table ops (broadcast: the match table is replicated) ----------------
+
+    def sub_add(self, owner, filter_, qos=0, flags=0):
+        for h in self.hosts:
+            h.sub_add(owner, filter_, qos, flags)
+
+    def sub_del(self, owner, filter_):
+        for h in self.hosts:
+            h.sub_del(owner, filter_)
+
+    def shared_add(self, token, conn, filter_, qos=0, flags=0):
+        # the member entry replicates everywhere; a match on a foreign
+        # shard ships the delivery to the member's shard over the ring
+        for h in self.hosts:
+            h.shared_add(token, conn, filter_, qos, flags)
+
+    def shared_del(self, token, conn, filter_):
+        for h in self.hosts:
+            h.shared_del(token, conn, filter_)
+
+    def durable_add(self, token, filter_, qos=0):
+        for h in self.hosts:
+            h.durable_add(token, filter_, qos)
+
+    def durable_del(self, token, filter_):
+        for h in self.hosts:
+            h.durable_del(token, filter_)
+
+    def trunk_route_add(self, peer_id, filter_):
+        # remote ENTRIES replicate (any shard can match a publish);
+        # the legs converge on shard 0's links over the ring
+        for h in self.hosts:
+            h.trunk_route_add(peer_id, filter_)
+
+    def trunk_route_del(self, peer_id, filter_):
+        for h in self.hosts:
+            h.trunk_route_del(peer_id, filter_)
+
+    def sn_predefined(self, topic_id, topic):
+        for h in self.hosts:
+            h.sn_predefined(topic_id, topic)
+
+    def set_retained(self, topic, payload, qos, deadline_ms=0):
+        for h in self.hosts:
+            h.set_retained(topic, payload, qos, deadline_ms)
+
+    def retain_del(self, topic):
+        for h in self.hosts:
+            h.retain_del(topic)
+
+    def permits_flush(self):
+        for h in self.hosts:
+            h.permits_flush()
+
+    def set_lane(self, enabled):
+        for h in self.hosts:
+            h.set_lane(enabled)
+
+    def set_max_qos(self, max_qos):
+        for h in self.hosts:
+            h.set_max_qos(max_qos)
+
+    def set_telemetry(self, enabled, slow_ack_ms=500.0):
+        for h in self.hosts:
+            h.set_telemetry(enabled, slow_ack_ms)
+
+    def set_telemetry_shift(self, shift):
+        for h in self.hosts:
+            h.set_telemetry_shift(shift)
+
+    def attach_store(self, store):
+        # one shared store: appends batch per flush, its single internal
+        # mutex serializes the (rare) concurrent flushes across shards
+        for h in self.hosts:
+            h.attach_store(store)
+
+    # -- trunk link plane (shard 0 owns the links) ---------------------------
+
+    def trunk_listen(self, host="127.0.0.1", port=0):
+        return self.hosts[0].trunk_listen(host, port)
+
+    def trunk_connect(self, peer_id, host, port):
+        self.hosts[0].trunk_connect(peer_id, host, port)
+
+    def trunk_disconnect(self, peer_id, forget=False):
+        self.hosts[0].trunk_disconnect(peer_id, forget)
+
+    # -- aggregates ----------------------------------------------------------
+
+    def stats(self):
+        out = dict.fromkeys(native.STAT_NAMES, 0)
+        for h in self.hosts:
+            for k, v in h.stats().items():
+                out[k] += v
+        return out
+
+    def lane_backlog(self):
+        return sum(h.lane_backlog() for h in self.hosts)
+
+    def destroy(self):
+        if self.leaked:
+            return
+        for h in self.hosts:
+            h.destroy()
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
 class NativeBrokerServer:
     """Same surface as ``BrokerServer`` but socket IO and the QoS0/1
     publish hot path live in C++."""
@@ -159,6 +372,7 @@ class NativeBrokerServer:
         sn_host: Optional[str] = None,
         sn_gateway_id: int = 1,
         sn_predefined: Optional[dict] = None,
+        shards: int = 1,
     ):
         if not native.available():
             raise RuntimeError(
@@ -176,9 +390,36 @@ class NativeBrokerServer:
         if session_opts is None and app is not None:
             session_opts = getattr(app, "session_defaults", dict)()
         self.session_opts = dict(session_opts or {})
-        self.host = native.NativeHost(
-            host=host, port=port,
-            max_size=max_packet_size, max_conns=max_connections)
+        # -- multi-core shards (round 12) -----------------------------------
+        # shards=N runs N independent epoll hosts, each with its own
+        # poll thread, sharing one port via SO_REUSEPORT accept
+        # sharding. The match table replicates (every table op
+        # broadcasts); cross-shard delivery rides the lock-free SPSC
+        # rings of a NativeShardGroup. shards=1 (the default) keeps the
+        # exact unsharded host — no group, zero ring overhead.
+        self.shards = max(1, min(int(shards), native.MAX_SHARDS))
+        self._shard_group: Optional[native.NativeShardGroup] = None
+        if self.shards > 1:
+            self._shard_group = native.NativeShardGroup(self.shards)
+            # shard 0 may bind an ephemeral port; the others join it.
+            # EVERY listener sets SO_REUSEPORT (the kernel requires the
+            # flag on all members of a reuseport group, first included)
+            h0 = native.NativeHost(
+                host=host, port=port, max_size=max_packet_size,
+                max_conns=max_connections, reuseport=True)
+            self.hosts = [h0] + [
+                native.NativeHost(
+                    host=host, port=h0.port, max_size=max_packet_size,
+                    max_conns=max_connections, reuseport=True)
+                for _ in range(1, self.shards)]
+            for i, h in enumerate(self.hosts):
+                h.join_group(self._shard_group, i)
+            self.host = _ShardedHost(self.hosts)
+        else:
+            self.host = native.NativeHost(
+                host=host, port=port,
+                max_size=max_packet_size, max_conns=max_connections)
+            self.hosts = [self.host]
         self.port = self.host.port
         # WebSocket plane (round 7): a second C++ listener runs the
         # RFC6455 handshake + frame codec below the GIL; its conns ride
@@ -190,9 +431,14 @@ class NativeBrokerServer:
         if ws_port is not None:
             # ws_host defaults to the TCP bind host but stays
             # independently configurable (e.g. loopback-only WS next to
-            # an all-interfaces TCP listener)
-            self.ws_port = self.host.listen_ws(ws_host or host, ws_port,
-                                               ws_path)
+            # an all-interfaces TCP listener); with shards every host
+            # listens on one port (SO_REUSEPORT, shard 0 resolves it)
+            self.ws_port = self.hosts[0].listen_ws(
+                ws_host or host, ws_port, ws_path,
+                reuseport=self.shards > 1)
+            for h in self.hosts[1:]:
+                h.listen_ws(ws_host or host, self.ws_port, ws_path,
+                            reuseport=True)
         # -- cluster trunk (round 9) ----------------------------------------
         # Cross-node publish forwarding on the C++ plane: peers with a
         # registered trunk get REMOTE entries instead of punt markers
@@ -213,8 +459,15 @@ class NativeBrokerServer:
         # deployment fallback when this listener is off (sn_port=None).
         self.sn_port: Optional[int] = None
         if sn_port is not None:
-            self.sn_port = self.host.listen_sn(sn_host or host, sn_port,
-                                               sn_gateway_id)
+            # UDP SO_REUSEPORT source-hashes each SN peer onto ONE
+            # shard's socket, so a datagram conversation never splits
+            # across poll threads
+            self.sn_port = self.hosts[0].listen_sn(
+                sn_host or host, sn_port, sn_gateway_id,
+                reuseport=self.shards > 1)
+            for h in self.hosts[1:]:
+                h.listen_sn(sn_host or host, self.sn_port, sn_gateway_id,
+                            reuseport=True)
             for tid, t in (sn_predefined or {}).items():
                 self.host.sn_predefined(int(tid), t)
         # node name → {"id", "addr", "port", "up", } under _mirror_lock
@@ -236,6 +489,26 @@ class NativeBrokerServer:
         for stage in native.HIST_STAGES:
             self._hists[stage] = self.broker.metrics.register_hist(
                 f"latency.native.{stage}")
+        # per-shard stage breakdown (the bench's shards section reads
+        # it via shard_latency_summary): registered only when sharded,
+        # so the unsharded metric surface is byte-identical to round 11
+        self._shard_hists: dict[int, dict] = {}
+        if self.shards > 1:
+            for i in range(self.shards):
+                self._shard_hists[i] = {
+                    stage: self.broker.metrics.register_hist(
+                        f"latency.native.shard{i}.{stage}")
+                    for stage in native.HIST_STAGES}
+        # kind-7/8/10 records now arrive from N concurrent poll threads
+        # (each record carries its shard in the id slot): the folds
+        # below mutate shared server state, so each takes its lock
+        self._tele_lock = threading.Lock()
+        self._ack_lock = threading.Lock()
+        self._durable_lock = threading.Lock()
+        # serializes the _closed_conns capped insert+evict: EV_CLOSED
+        # fires on every shard's poll thread, and two threads evicting
+        # the same oldest key would KeyError mid-poll-batch
+        self._closed_lock = threading.Lock()
         slow_ms = (self.app.slow_subs.threshold_ms
                    if self.app is not None else 500)
         self.host.set_telemetry(self.telemetry, slow_ack_ms=slow_ms)
@@ -334,8 +607,11 @@ class NativeBrokerServer:
         # the Python lookup (always correct, never a partial set).
         self._retain_unmirrorable: set = set()
         self._retain_mirrored = False
-        self._frame_conn: Optional[_NativeConn] = None
-        self._poll_ident: Optional[int] = None
+        # per-poll-thread context (N threads when sharded): the conn
+        # whose frame is being handled and which shard host the thread
+        # drives (poll-thread-only seams route through these)
+        self._tls = threading.local()
+        self._poll_idents: set[int] = set()
         self.conns: dict[int, _NativeConn] = {}
         self._stop = threading.Event()
         if self.fast_path and app is not None:
@@ -345,6 +621,7 @@ class NativeBrokerServer:
             app.native_retain_fn = self._native_retained
             self._retain_mirrored = True
         self._thread: Optional[threading.Thread] = None
+        self._shard_threads: list[threading.Thread] = []
         self._last_housekeep = time.monotonic()
         self._tick_running = threading.Event()
         # device serving path: one poll step's PUBLISHes coalesce into
@@ -357,7 +634,6 @@ class NativeBrokerServer:
         self.device_lane = device_lane if fast_path else "off"
         self._lane_on = False
         self._lane_q: queue.SimpleQueue = queue.SimpleQueue()
-        self._lane_buf: list[tuple[int, str]] = []
         self._lane_stop = threading.Event()
         self._lane_thread: Optional[threading.Thread] = None
         self._lane_stale_seen = 0
@@ -587,9 +863,11 @@ class NativeBrokerServer:
         back to the Python retainer lookup (always correct)."""
         if self._retain_unmirrorable or self._stop.is_set():
             return False
-        if threading.get_ident() != self._poll_ident:
+        if threading.get_ident() not in self._poll_idents:
             return False          # another server/transport owns this sub
-        conn = self._frame_conn   # the conn whose frame is being handled
+        # the conn whose frame this thread is handling (thread-local:
+        # each shard's poll thread serves its own conns)
+        conn = getattr(self._tls, "frame_conn", None)
         if (conn is None or not conn.fast
                 or conn.channel.clientid != sid
                 or conn.channel.conn_state != "connected"):
@@ -696,8 +974,10 @@ class NativeBrokerServer:
                 while inbox and len(pending) < LANE_PIPE_DEPTH:
                     n = min(len(inbox), LANE_MAX_BATCH)
                     chunk = [inbox.popleft() for _ in range(n)]
-                    seqs = [s for s, _ in chunk]
-                    topics = [t for _, t in chunk]
+                    # items are (shard host, seq, topic): one device
+                    # batch may mix shards, the response splits per host
+                    seqs = [(h, s) for h, s, _ in chunk]
+                    topics = [t for _, _, t in chunk]
                     try:
                         pending.append(
                             (model.publish_batch_submit(topics), seqs))
@@ -739,34 +1019,45 @@ class NativeBrokerServer:
                     pass
                 self._lane_respond_punt(seqs)
             if inbox:
-                self._lane_respond_punt([s for s, _ in inbox])
+                self._lane_respond_punt([(h, s) for h, s, _ in inbox])
             if self._lane_on:
                 self._lane_on = False
                 self.host.set_lane(False)
 
     def _lane_respond(self, seqs, matched, fallback) -> None:
+        """``seqs`` are (shard host, seq) pairs: lane sequence numbers
+        are per-host counters, so each response blob goes back to the
+        host whose poll loop parked the frame."""
         fb = set(fallback or ())
-        parts = [struct.pack("<I", len(seqs))]
         pack = struct.pack
-        for i, seq in enumerate(seqs):
-            if i in fb:
-                # tokenizer reject / K-cap overflow: the kernel result
-                # is incomplete for this topic — Python re-matches it
-                parts.append(pack("<QBH", seq, 1, 0))
-                continue
-            fs = matched[i]
-            parts.append(pack("<QBH", seq, 0, len(fs)))
-            for f in fs:
-                b = f.encode()
-                parts.append(pack("<H", len(b)))
-                parts.append(b)
-        self.host.lane_deliver(b"".join(parts))
+        per: dict = {}
+        for i, (h, seq) in enumerate(seqs):
+            per.setdefault(h, []).append((i, seq))
+        for h, items in per.items():
+            parts = [pack("<I", len(items))]
+            for i, seq in items:
+                if i in fb:
+                    # tokenizer reject / K-cap overflow: the kernel
+                    # result is incomplete — Python re-matches it
+                    parts.append(pack("<QBH", seq, 1, 0))
+                    continue
+                fs = matched[i]
+                parts.append(pack("<QBH", seq, 0, len(fs)))
+                for f in fs:
+                    b = f.encode()
+                    parts.append(pack("<H", len(b)))
+                    parts.append(b)
+            h.lane_deliver(b"".join(parts))
 
     def _lane_respond_punt(self, seqs) -> None:
-        parts = [struct.pack("<I", len(seqs))]
-        for seq in seqs:
-            parts.append(struct.pack("<QBH", seq, 1, 0))
-        self.host.lane_deliver(b"".join(parts))
+        per: dict = {}
+        for h, seq in seqs:
+            per.setdefault(h, []).append(seq)
+        for h, ss in per.items():
+            parts = [struct.pack("<I", len(ss))]
+            for seq in ss:
+                parts.append(struct.pack("<QBH", seq, 1, 0))
+            h.lane_deliver(b"".join(parts))
 
     def _fast_global(self) -> bool:
         # clustered nodes stay eligible: remote routes mirror into the
@@ -1000,6 +1291,13 @@ class NativeBrokerServer:
                 self._trunk_punt_dispatch(qos, dup, topic, body)
             return
         node = self._trunk_id_nodes.get(peer_id)
+        # mirror the link state onto the non-trunk shards BEFORE the
+        # permit flush below: their TrunkEligible oracle must flip
+        # before publishers re-earn permits (the punt→trunk ordering
+        # guard, extended across shards). Conservative while it lags —
+        # a lagging mirror punts, never misroutes.
+        for h in self.hosts[1:]:
+            h.trunk_peer_state(peer_id, sub == native.TRUNK_UP)
         with self._mirror_lock:
             peer = self._trunk_peers.get(node) if node else None
             if peer is not None:
@@ -1263,9 +1561,19 @@ class NativeBrokerServer:
         session mqueue buffers) and consume the store marker when it
         reached a CONNECTED session, mirroring cm.dispatch's
         mark_delivered discipline. No channel at all (restart recovery
-        state) leaves the marker for the resume replay."""
+        state) leaves the marker for the resume replay.
+
+        With shards, kind-10 records arrive from N poll threads
+        concurrently (publishers on two shards can match one durable
+        session); _durable_lock serializes the fold against itself and
+        against a resume drain on another shard — the drain-watermark
+        dedup is only exact when fetch/consume/fold can't interleave."""
         from emqx_tpu.core.message import Message
 
+        with self._durable_lock:
+            self._on_durable_locked(payload, Message)
+
+    def _on_durable_locked(self, payload: bytes, Message) -> None:
         base, ts, entries = native.parse_durable(payload)
         pers = self.app.persistent if self.app is not None else None
         metrics = self.broker.metrics
@@ -1347,8 +1655,6 @@ class NativeBrokerServer:
         so the replay rides the native delivery machinery — the
         session.deliver packets go straight out through host.send —
         and the drain cost lands on the replay_drain telemetry stage."""
-        from emqx_tpu.core.message import Message
-
         store = self._durable_store
         if store is None:
             return []
@@ -1358,6 +1664,21 @@ class NativeBrokerServer:
         tok = self._durable_tokens.get(sid) or store.lookup(sid)
         if not tok:
             return []
+        # under _durable_lock: a kind-10 fold on ANOTHER shard's poll
+        # thread must see fetch + watermark + consume as one step, or
+        # the drained-guid dedup stops being exact
+        with self._durable_lock:
+            rows = self._durable_drain_locked(sid, store, tok)
+        # poll-thread-only stamp, routed to THIS thread's shard host; a
+        # drain driven from another server's thread (asyncio resume
+        # sharing this app) is refused with -2
+        host = getattr(self._tls, "host", None) or self.hosts[0]
+        host.note_stage("replay_drain", time.perf_counter_ns() - t0)
+        return rows
+
+    def _durable_drain_locked(self, sid: str, store, tok: int) -> list:
+        from emqx_tpu.core.message import Message
+
         rows = store.fetch(tok)
         pers = self.app.persistent
         out, guids = [], []
@@ -1386,9 +1707,6 @@ class NativeBrokerServer:
             store.consume(tok, guids)
             self.broker.metrics.inc("messages.durable.replayed",
                                     len(guids))
-        # poll-thread-only stamp; a drain driven from another server's
-        # thread (asyncio resume sharing this app) is refused with -2
-        self.host.note_stage("replay_drain", time.perf_counter_ns() - t0)
         return out
 
     def _durable_discard(self, sid: str) -> None:
@@ -1411,9 +1729,13 @@ class NativeBrokerServer:
         self._durable_dead.add(tok)
         for filt in self._durable_filters.pop(sid, ()):
             self.host.durable_del(tok, filt)
-        guids = [row[0] for row in store.fetch(tok)]
-        if guids:
-            store.consume(tok, guids)
+        with self._durable_lock:
+            # the wipe must not interleave with a concurrent kind-10
+            # fold on another shard's poll thread (fetch + consume is
+            # one step, same reasoning as the resume drain)
+            guids = [row[0] for row in store.fetch(tok)]
+            if guids:
+                store.consume(tok, guids)
 
     # -- live plane handoff (round 10) --------------------------------------
 
@@ -1608,20 +1930,23 @@ class NativeBrokerServer:
                 return True             # providers watch the message plane
         return False
 
-    def _grant_permits(self) -> None:
+    def _grant_permits(self, queued=None) -> None:
         """Runs after pipeline.flush() in _step: every queued slow-path
         publish already delivered, so granting now preserves per-topic
         ordering across the slow→fast transition. Holds _permit_lock so
         a concurrent flush_permits (trace started on a REST thread)
         cannot interleave: grants re-check the consumer list under the
         lock, so they either complete before the flush (which then
-        clears them) or start after it (and see the new watcher)."""
+        clears them) or start after it (and see the new watcher).
+        ``queued`` is the pre-flush snapshot _step took (None = drain
+        the live queue, the pre-shard call shape)."""
         with self._permit_lock:
-            self._grant_permits_locked()
+            self._grant_permits_locked(queued)
 
-    def _grant_permits_locked(self) -> None:
-        queue, self._permit_queue = self._permit_queue, []
-        if not queue:
+    def _grant_permits_locked(self, queued=None) -> None:
+        if queued is None:
+            queued, self._permit_queue = self._permit_queue, []
+        if not queued:
             return
         # topic-independent veto, hoisted so its O(rules) scan runs once
         # per grant cycle, not once per queued topic; the result feeds
@@ -1630,7 +1955,7 @@ class NativeBrokerServer:
                       and self.app.rules.watches_message_events())
         if msg_events:
             return
-        for conn, topic in queue:
+        for conn, topic in queued:
             ch = conn.channel
             if (not conn.fast or ch.conn_state != "connected"
                     or not self._fast_global()):
@@ -1656,8 +1981,13 @@ class NativeBrokerServer:
 
     # -- event loop ---------------------------------------------------------
 
-    def _step(self, timeout_ms: int = 100) -> None:
-        for kind, conn_id, payload in self.host.poll(timeout_ms):
+    def _step_host(self, host, timeout_ms: int = 100) -> None:
+        """Drain one poll cycle of ONE shard host. Runs concurrently on
+        N poll threads when sharded: per-conn work is naturally
+        shard-local (a conn id names its owner shard), the shared folds
+        (acks/telemetry/durable) take their locks inside."""
+        lane_buf = None
+        for kind, conn_id, payload in host.poll(timeout_ms):
             if kind == native.EV_OPEN:
                 self.conns[conn_id] = _NativeConn(
                     self, conn_id, payload.decode("ascii", "replace"))
@@ -1668,15 +1998,21 @@ class NativeBrokerServer:
                 else:
                     self._orphan_frame(conn_id, payload)
             elif kind == native.EV_LANE:
-                # conn field carries the lane sequence number
-                self._lane_buf.append(
-                    (conn_id, payload.decode("utf-8", "replace")))
+                # conn field carries the lane sequence number; the item
+                # remembers its host so the pump answers the right shard
+                # (lane seqs are per-host counters)
+                if lane_buf is None:
+                    lane_buf = []
+                lane_buf.append(
+                    (host, conn_id, payload.decode("utf-8", "replace")))
             elif kind == native.EV_TAP:
                 self._on_tap(conn_id, payload)
             elif kind == native.EV_ACKS:
+                # the id slot carries the producing shard (round 12);
+                # conn ids inside the record are globally unique
                 self._on_ack_batch(payload)
             elif kind == native.EV_TELEMETRY:
-                self._on_telemetry(payload)
+                self._on_telemetry(payload, conn_id)
             elif kind == native.EV_TRUNK:
                 self._on_trunk_event(conn_id, payload)
             elif kind == native.EV_DURABLE:
@@ -1692,22 +2028,40 @@ class NativeBrokerServer:
                     if conn.fast:
                         # a lane punt / rule tap may still surface this
                         # conn's frames (up to the stale deadline)
-                        self._closed_conns[conn_id] = (
-                            ch.clientid, ch.conninfo.proto_ver,
-                            ch.conninfo.username,
-                            ch.conninfo.peername)
-                        if len(self._closed_conns) > 4096:
-                            self._closed_conns.pop(
-                                next(iter(self._closed_conns)))
+                        with self._closed_lock:
+                            self._closed_conns[conn_id] = (
+                                ch.clientid, ch.conninfo.proto_ver,
+                                ch.conninfo.username,
+                                ch.conninfo.peername)
+                            if len(self._closed_conns) > 4096:
+                                self._closed_conns.pop(
+                                    next(iter(self._closed_conns)))
                     self._forget_fast(conn)
                     ch.terminate(payload.decode("ascii", "replace"))
-        if self._lane_buf:
-            self._lane_q.put(self._lane_buf)
-            self._lane_buf = []
+        if lane_buf:
+            self._lane_q.put(lane_buf)
+
+    def _step(self, timeout_ms: int = 100) -> None:
+        """One shard-0 loop step plus the server-global duties (the
+        pipeline flush, permit grants, trunk redial, housekeep).
+        Secondary shards run bare _step_host loops (_run_shard) with
+        only their own conns' keepalive scan."""
+        self._step_host(self.hosts[0], timeout_ms)
+        # snapshot the permit queue BEFORE the flush: entries appended
+        # by any shard's poll thread had their publishes submitted
+        # first (handle_in submits, _on_frame appends after), so every
+        # snapshotted entry's traffic is covered by THIS flush — while
+        # an entry appended mid-flush could still have a publish queued
+        # in the pipeline, and granting it now would let a fast message
+        # overtake a queued slow one
+        pending = None
+        if self._permit_queue:
+            with self._permit_lock:
+                pending, self._permit_queue = self._permit_queue, []
         if self.pipeline is not None:
             self.pipeline.flush()
-        if self._permit_queue:
-            self._grant_permits()
+        if pending:
+            self._grant_permits(pending)
         now = time.monotonic()
         if now >= self._trunk_retry_at:
             self._trunk_redial()
@@ -1719,9 +2073,9 @@ class NativeBrokerServer:
         ch = conn.channel
         # context for the native retained seam: the session.subscribed
         # hook fires INSIDE handle_in, and _native_retained must know
-        # which conn's SUBSCRIBE it is serving (poll thread only, so a
-        # plain attribute is race-free)
-        self._frame_conn = conn
+        # which conn's SUBSCRIBE it is serving (thread-local: each
+        # shard's poll thread handles only its own conns' frames)
+        self._tls.frame_conn = conn
         try:
             pkt = parse_one(frame, ch.conninfo.proto_ver)
             if pkt.type == P.CONNECT:
@@ -1742,7 +2096,7 @@ class NativeBrokerServer:
             self._drop(conn, "channel_error")
             return
         finally:
-            self._frame_conn = None
+            self._tls.frame_conn = None
         conn._send_packets(out)
         if ch.conn_state == "disconnected":
             self._drop(conn, "normal")
@@ -1898,7 +2252,7 @@ class NativeBrokerServer:
             return
         n = int.from_bytes(batch[:4], "little")
         pos = 4
-        tot_acked = tot_rel = 0
+        tot_acked = tot_rel = max_seen = 0
         ap = self.ack_plane
         for _ in range(n):
             if pos + 24 > len(batch):
@@ -1913,8 +2267,8 @@ class NativeBrokerServer:
             pos += 24
             tot_acked += acked
             tot_rel += rel
-            if inflight_now > ap["max_inflight_seen"]:
-                ap["max_inflight_seen"] = inflight_now
+            if inflight_now > max_seen:
+                max_seen = inflight_now
             conn = self.conns.get(cid)
             if conn is None or not conn.fast:
                 continue
@@ -1933,36 +2287,52 @@ class NativeBrokerServer:
                 # per-cycle cap op for every occupancy wiggle measurably
                 # taxed the data plane — only re-divide on a real shift
                 reserve = max(len(sess.inflight), 1)
-                want = max(budget // 2, 2 * inflight_now,
+                want = max(budget // 2, CAP_HEADROOM * inflight_now,
                            min(inflight_now + pending_now, budget))
                 cap = max(1, min(want, budget - reserve))
-                if abs(cap - conn.native_cap) >= max(8, budget // 8):
+                if abs(cap - conn.native_cap) >= max(CAP_DEADBAND_MIN,
+                                                     budget
+                                                     // CAP_DEADBAND_DIV):
                     conn.native_cap = cap
                     self.host.set_inflight_cap(cid, cap)
                     sess.inflight.max_size = max(1, budget - cap)
-        ap["acked"] += tot_acked
-        ap["rel"] += tot_rel
-        ap["batches"] += 1
+        # kind-7 records arrive from N poll threads when sharded: the
+        # shared totals fold under _ack_lock (each conn's session sync
+        # above is shard-local — a conn lives on exactly one shard)
+        with self._ack_lock:
+            ap["acked"] += tot_acked
+            ap["rel"] += tot_rel
+            ap["batches"] += 1
+            if max_seen > ap["max_inflight_seen"]:
+                ap["max_inflight_seen"] = max_seen
         m = self.broker.metrics
         if tot_acked:
             m.inc("messages.acked", tot_acked)
             m.inc("messages.native.acked", tot_acked)
 
-    def _on_telemetry(self, payload: bytes) -> None:
+    def _on_telemetry(self, payload: bytes, shard: int = 0) -> None:
         """Fold ONE batched kind-8 telemetry record (host.cc): per-cycle
         histogram deltas into the node metrics' LatencyHistograms,
         slow-ack samples into slow_subs (the native plane's entry into
         the slow-subscriber ranking), and flight-recorder dumps into
         the recent-dumps ring + any matching clientid trace log.
-        Runs on the poll thread: cycle-rate, small records, no I/O."""
+        Runs on the poll thread: cycle-rate, small records, no I/O.
+        ``shard`` is the record's id-slot field (round 12): N poll
+        threads fold concurrently under _tele_lock, and the deltas
+        land in both the global and the per-shard histograms."""
         stages = native.HIST_STAGES
+        shard_hists = self._shard_hists.get(shard)
         for rec in native.parse_telemetry(payload):
             kind = rec[0]
             if kind == "hist":
                 _, stage_i, cnt, sum_ns, buckets = rec
                 if stage_i < len(stages):
-                    self._hists[stages[stage_i]].observe_delta(
-                        cnt, sum_ns, buckets)
+                    with self._tele_lock:
+                        self._hists[stages[stage_i]].observe_delta(
+                            cnt, sum_ns, buckets)
+                        if shard_hists is not None:
+                            shard_hists[stages[stage_i]].observe_delta(
+                                cnt, sum_ns, buckets)
             elif kind == "slow_ack":
                 _, conn_id, rtt_us, _qos, topic = rec
                 info = self._conninfo_for(conn_id)
@@ -1991,6 +2361,18 @@ class NativeBrokerServer:
         surface next to the loadgen-side numbers."""
         return {stage: h.summary()
                 for stage, h in self._hists.items() if h.count > 0}
+
+    def shard_latency_summary(self) -> dict[int, dict]:
+        """Per-shard stage percentiles (bench surface for the shards
+        section); empty on an unsharded server."""
+        return {shard: {stage: h.summary()
+                        for stage, h in hists.items() if h.count > 0}
+                for shard, hists in self._shard_hists.items()}
+
+    def shard_stats(self) -> list[dict[str, int]]:
+        """Raw per-shard host counters in shard order (the aggregate is
+        ``fast_stats``)."""
+        return [h.stats() for h in self.hosts]
 
     def _orphan_frame(self, conn_id: int, frame: bytes) -> None:
         """A frame surfaced for a conn we already tore down — in
@@ -2105,7 +2487,17 @@ class NativeBrokerServer:
             self._last_permit_flush = time.monotonic()
             if self._granted:
                 self.flush_permits()
+        self._housekeep_conns(0)
+
+    def _housekeep_conns(self, shard: int) -> None:
+        """Keepalive/retry scan for ONE shard's conns. Must run on that
+        shard's poll thread: conn_idle_ms walks poll-thread-owned C++
+        state, and channel timeouts must not race the thread handling
+        the conn's frames. Shard 0's scan rides the global housekeep."""
+        sharded = self.shards > 1
         for conn in list(self.conns.values()):
+            if sharded and native.shard_of(conn.conn_id) != shard:
+                continue
             ch = conn.channel
             if conn.fast or conn.sn:
                 # fast-path frames never reach the channel (and SN
@@ -2189,9 +2581,22 @@ class NativeBrokerServer:
         self._thread = threading.Thread(
             target=self._run, name="emqx-native-host", daemon=True)
         self._thread.start()
+        # shards 1..N-1 (round 12): one poll thread per shard host,
+        # each driving its own epoll loop + its own conns' keepalive;
+        # server-global duties stay on shard 0's thread
+        for i in range(1, self.shards):
+            t = threading.Thread(
+                target=self._run_shard, args=(i,),
+                name=f"emqx-native-host-s{i}", daemon=True)
+            t.start()
+            self._shard_threads.append(t)
+
+    def _register_poll_thread(self, host) -> None:
+        self._tls.host = host
+        self._poll_idents.add(threading.get_ident())
 
     def _run(self) -> None:
-        self._poll_ident = threading.get_ident()
+        self._register_poll_thread(self.hosts[0])
         while not self._stop.is_set():
             try:
                 self._step(timeout_ms=50)
@@ -2199,6 +2604,21 @@ class NativeBrokerServer:
                 # broker: one bad housekeep/grant cycle (e.g. a raising
                 # authorize hook) must log, not stop serving every conn
                 log.exception("native poll step failed; continuing")
+
+    def _run_shard(self, idx: int) -> None:
+        host = self.hosts[idx]
+        self._register_poll_thread(host)
+        last_hk = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                self._step_host(host, timeout_ms=50)
+                now = time.monotonic()
+                if now - last_hk >= HOUSEKEEP_INTERVAL:
+                    last_hk = now
+                    self._housekeep_conns(idx)
+            except Exception:  # noqa: BLE001 — same containment as _run
+                log.exception("native shard %d poll step failed; "
+                              "continuing", idx)
 
     def stop(self) -> None:
         # Signal EVERY worker before joining any (VERDICT r5 weak #2 /
@@ -2226,6 +2646,14 @@ class NativeBrokerServer:
             self._thread.join(timeout=30)
             poll_dead = not self._thread.is_alive()
             self._thread = None
+        for t in self._shard_threads:
+            # EVERY shard's poll thread must be provably done before
+            # any host (or the ring group) can be torn down: a live
+            # producer shard writes into the group the destroy frees
+            t.join(timeout=30)
+            if t.is_alive():
+                poll_dead = False
+        self._shard_threads = []
         try:
             self.broker.sub_observers.remove(self._on_sub_event)
         except ValueError:
@@ -2279,16 +2707,23 @@ class NativeBrokerServer:
         if poll_dead:
             self._tick_pool.shutdown(wait=False)
             self.host.destroy()
+            if self._shard_group is not None:
+                # hosts first, THEN the group: the group owns the
+                # doorbell fds a dying host's producers may still ring
+                self._shard_group.destroy()
+                self._shard_group = None
             if self._durable_store is not None:
                 # the host borrowed the store pointer; with the host
                 # destroyed (poll thread provably done) it can close
                 self._durable_store.close()
                 self._durable_store = None
         else:  # pragma: no cover — pathological wedge
-            # STICKY: the wedged poll thread may still be inside
-            # emqx_host_poll — nothing may ever free this host (not a
-            # second stop(), not NativeHost.__del__ at gc time)
+            # STICKY: a wedged poll thread may still be inside
+            # emqx_host_poll — nothing may ever free these hosts or the
+            # ring group (not a second stop(), not __del__ at gc time)
             self._leaked = True
             self.host.leaked = True
+            if self._shard_group is not None:
+                self._shard_group.leaked = True
             log.warning("native poll thread still alive after 30s; "
                         "leaking host/executor to avoid a use-after-free")

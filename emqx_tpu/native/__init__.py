@@ -64,12 +64,24 @@ def _build() -> None:
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_create.restype = ctypes.c_void_p
     lib.emqx_host_create.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32]
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_int]
     lib.emqx_host_port.restype = ctypes.c_int
     lib.emqx_host_port.argtypes = [ctypes.c_void_p]
     lib.emqx_host_listen_ws.restype = ctypes.c_int
     lib.emqx_host_listen_ws.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p]
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.emqx_shard_group_create.restype = ctypes.c_void_p
+    lib.emqx_shard_group_create.argtypes = [ctypes.c_int]
+    lib.emqx_shard_group_destroy.restype = None
+    lib.emqx_shard_group_destroy.argtypes = [ctypes.c_void_p]
+    lib.emqx_host_join_group.restype = ctypes.c_int
+    lib.emqx_host_join_group.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_trunk_peer_state.restype = ctypes.c_int
+    lib.emqx_host_trunk_peer_state.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
     lib.emqx_host_poll.restype = ctypes.c_long
     lib.emqx_host_poll.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
@@ -200,7 +212,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64]
     lib.emqx_host_listen_sn.restype = ctypes.c_int
     lib.emqx_host_listen_sn.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int,
+        ctypes.c_int]
     lib.emqx_host_sn_predefined.restype = ctypes.c_int
     lib.emqx_host_sn_predefined.argtypes = [
         ctypes.c_void_p, ctypes.c_uint16, ctypes.c_char_p]
@@ -489,7 +502,11 @@ HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
                # edge-gateway plane (round 11): sn_ingest = sampled SN
                # datagram decode+dispatch; retain_deliver = one
                # SUBSCRIBE-triggered retained snapshot lookup+write
-               "sn_ingest", "retain_deliver")
+               "sn_ingest", "retain_deliver",
+               # multi-core shards (round 12): ENTRIES per applied
+               # cross-shard ring batch (occupancy — a count, the
+               # trunk_batch_n convention, not nanoseconds)
+               "shard_ring_n")
 
 # flight-recorder event codes (host.cc FrEvent)
 FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
@@ -754,7 +771,8 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "sn_in", "sn_out", "sn_qos_m1", "sn_pings",
               "sn_registers", "sn_sleep_parked", "sn_drops_oversize",
               "retain_set", "retain_del", "retain_deliver",
-              "retain_msgs_out")
+              "retain_msgs_out",
+              "shard_ring_out", "shard_ring_in", "shard_ring_full")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
@@ -764,6 +782,54 @@ STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP, SUB_REMOTE = 1, 2, 4, 8
 SUB_DURABLE = 16
+
+# multi-core shard conn-id scheme (host.cc, round 12): bits 56-58 carry
+# the shard index — above the Python punt-token space (1<<48), below
+# the SN (59), durable (61), trunk (62) and trunk-sock (63) namespaces.
+SHARD_SHIFT = 56
+SHARD_MASK = 7
+MAX_SHARDS = 8
+
+
+def shard_of(conn_id: int) -> int:
+    """Which shard's host owns this conn id (0 for unsharded hosts)."""
+    return (conn_id >> SHARD_SHIFT) & SHARD_MASK
+
+
+class NativeShardGroup:
+    """The cross-shard SPSC ring group (ring.h). Python owns it: create
+    BEFORE any host joins, destroy AFTER every member host is destroyed
+    (the group owns the doorbell eventfds a racing producer shard may
+    still write during a member's teardown)."""
+
+    def __init__(self, n: int):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        if not 1 <= n <= MAX_SHARDS:
+            raise ValueError(f"shards must be 1..{MAX_SHARDS}, got {n}")
+        self.n = n
+        self._h = self._lib.emqx_shard_group_create(n)
+        if not self._h:
+            raise OSError("cannot create shard group")
+
+    # set True by an owner that must abandon the group (a wedged shard
+    # poll thread may still push into the rings): destroy becomes a
+    # no-op forever, including the gc-time __del__ path
+    leaked = False
+
+    def destroy(self) -> None:
+        if self.leaked:
+            return
+        if self._h:
+            self._lib.emqx_shard_group_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.destroy()
+        except Exception:
+            pass
 
 
 FSYNC_POLICY = {"never": 0, "batch": 1, "interval": 2}
@@ -871,12 +937,13 @@ class NativeHost:
     ``close_conn`` are safe from any thread."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_size: int = 1 << 20, max_conns: int = 1_000_000):
+                 max_size: int = 1 << 20, max_conns: int = 1_000_000,
+                 reuseport: bool = False):
         self._lib = load()
         if self._lib is None:
             raise RuntimeError(f"native lib unavailable: {_build_error}")
         self._h = self._lib.emqx_host_create(
-            host.encode(), port, max_size, max_conns)
+            host.encode(), port, max_size, max_conns, int(reuseport))
         if not self._h:
             raise OSError(f"cannot bind {host}:{port}")
         self.port = self._lib.emqx_host_port(self._h)
@@ -910,17 +977,41 @@ class NativeHost:
             pos += plen
 
     def listen_ws(self, host: str = "127.0.0.1", port: int = 0,
-                  path: str = "/mqtt") -> int:
+                  path: str = "/mqtt", reuseport: bool = False) -> int:
         """Open the RFC6455 listener (BEFORE the poll thread starts).
         Conns accepted there run the WS handshake + frame codec in C++
         in front of the MQTT framer; their OPEN events carry a
         ``ws:ip:port`` peer string. Returns the bound port."""
         p = self._lib.emqx_host_listen_ws(
-            self._h, host.encode(), port, path.encode())
+            self._h, host.encode(), port, path.encode(), int(reuseport))
         if p < 0:
             raise OSError(f"cannot bind ws listener {host}:{port}")
         self.ws_port = p
         return p
+
+    # -- multi-core shards (round 12) ---------------------------------------
+
+    def join_group(self, group: "NativeShardGroup", shard_id: int) -> None:
+        """Make this host shard ``shard_id`` of ``group`` (call BEFORE
+        the poll thread starts): conn ids gain the shard prefix (bits
+        56-58), cross-shard deliveries ride the group's SPSC rings, and
+        the group's doorbell for this shard joins the epoll set."""
+        # hold the group FIRST: ~Host writes group_->alive at destroy
+        # time, so gc-order must never free the group before a member
+        # host (an abandoned half-built server has no stop() to order
+        # it) — held even across a failed join for symmetry
+        self._group = group
+        rc = self._lib.emqx_host_join_group(self._h, group._h,
+                                            int(shard_id))
+        if rc != 0:
+            raise ValueError(f"cannot join shard group as {shard_id}")
+
+    def trunk_peer_state(self, peer_id: int, up: bool) -> None:
+        """Mirror shard 0's trunk link state onto this (non-trunk)
+        shard: its trunk-vs-punt oracle for remote legs it would
+        ring-forward to shard 0."""
+        self._lib.emqx_host_trunk_peer_state(self._h, peer_id,
+                                             1 if up else 0)
 
     # -- cluster trunk (round 9) -------------------------------------------
 
@@ -1060,13 +1151,13 @@ class NativeHost:
     # -- mqtt-sn gateway + retained snapshot (round 11) ---------------------
 
     def listen_sn(self, host: str = "127.0.0.1", port: int = 0,
-                  gw_id: int = 1) -> int:
+                  gw_id: int = 1, reuseport: bool = False) -> int:
         """Open the MQTT-SN/UDP gateway socket (BEFORE the poll thread
         starts). Datagram peers become conns on their first CONNECT;
         their OPEN events carry an ``sn:ip:port`` peer string. Returns
         the bound port."""
         p = self._lib.emqx_host_listen_sn(self._h, host.encode(), port,
-                                          int(gw_id))
+                                          int(gw_id), int(reuseport))
         if p < 0:
             raise OSError(f"cannot bind sn listener {host}:{port}")
         self.sn_port = p

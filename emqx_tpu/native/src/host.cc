@@ -154,6 +154,7 @@
 #include <vector>
 
 #include "frame.h"
+#include "ring.h"
 #include "router.h"
 #include "sn.h"
 #include "store.h"
@@ -218,6 +219,9 @@ enum HistStage {
   kHistSnIngest,          // sampled: SN datagram decode+dispatch
   kHistRetainDeliver,     // retained snapshot: match+encode+write per
                           // SUBSCRIBE-triggered delivery op
+  kHistShardRingN,        // cross-shard ring occupancy: ENTRIES per
+                          // applied ring batch (count-valued, the
+                          // trunk_batch_n convention)
   kHistCount
 };
 
@@ -470,6 +474,29 @@ constexpr size_t kTrunkUnackedMax = 512;
 // namespaces, above any TCP/WS conn id the sequential counter could
 // ever reach and above the Python punt-token space (1<<48).
 constexpr uint64_t kSnConnBit = 1ull << 59;
+
+// -- multi-core shard bounds (round 12) -------------------------------------
+// The owner-namespace scheme extended to SHARDS: conn ids carry their
+// shard index in bits 56-58 — above the Python punt-token space
+// (tokens mint upward from 1<<48 and can never reach 1<<56), below the
+// SN bit (59), so an SN conn on shard k composes as
+// kSnConnBit | (k << kShardShift) | seq. Shard 0 ids are numerically
+// identical to the unsharded scheme (back-compat by construction).
+constexpr int kShardShift = 56;
+constexpr uint64_t kShardMask = 7;  // up to ring::kMaxShards shards
+
+inline int ShardOf(uint64_t conn_id) {
+  return static_cast<int>((conn_id >> kShardShift) & kShardMask);
+}
+
+// Membership append for ONE publish's tiny scratch vectors (trunk
+// peers, destination shards): linear scan beats any set at these sizes.
+template <typename T>
+inline void PushUnique(std::vector<T>* v, T x) {
+  for (T e : *v)
+    if (e == x) return;
+  v->push_back(x);
+}
 // qos1 delivery retransmit-on-timeout (UDP loses datagrams; TCP conns
 // never need this — the transport retransmits): resend with DUP after
 // kSnRetryMs, abandon the delivery (freeing its inflight slot like a
@@ -486,7 +513,8 @@ struct Op {
     kSetInflightCap, kSetTrace, kSetTelemetry,
     kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
     kDurableAdd, kDurableDel,
-    kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift
+    kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift,
+    kTrunkPeerState
   };
   Kind kind;
   uint64_t owner = 0;
@@ -555,6 +583,9 @@ enum StatSlot {
   kStRetainDel,        // retained-snapshot entries removed
   kStRetainDeliver,    // SUBSCRIBE-triggered native retained lookups
   kStRetainMsgsOut,    // retained messages delivered below the GIL
+  kStShardRingOut,     // deliveries shipped to another shard's ring
+  kStShardRingIn,      // ring entries applied from other shards
+  kStShardRingFull,    // publishes degraded ring-full -> punt -> Python
   kStatCount
 };
 
@@ -582,6 +613,11 @@ class Host {
       : max_size_(max_size), max_conns_(max_conns) {}
 
   ~Host() {
+    // producers in other shards stop shipping to this shard's rings;
+    // the doorbell fd stays open (group-owned) so racing doorbell
+    // writes never hit a recycled fd
+    if (group_)
+      group_->alive[shard_id_].store(false, std::memory_order_release);
     for (auto& [id, c] : conns_)
       if (c.fd >= 0) close(c.fd);  // SN conns share the listener fd
     for (auto& [tag, s] : trunk_socks_) close(s.fd);
@@ -593,13 +629,18 @@ class Host {
     if (epoll_fd_ >= 0) close(epoll_fd_);
   }
 
-  bool Init(const char* bind_addr, uint16_t port) {
+  bool Init(const char* bind_addr, uint16_t port, bool reuseport = false) {
     epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
     wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
     listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (epoll_fd_ < 0 || wake_fd_ < 0 || listen_fd_ < 0) return false;
     int one = 1;
     setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    // SO_REUSEPORT accept sharding (round 12): every shard binds its
+    // own listener on the SAME port and the kernel hash-distributes
+    // incoming connections across them — no accept lock, no handoff
+    if (reuseport)
+      setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(port);
@@ -629,12 +670,15 @@ class Host {
   // here run the RFC6455 handshake + frame codec in front of the MQTT
   // framer; `path` is the required upgrade request-target ("" accepts
   // any). Returns the bound port, or -1.
-  int ListenWs(const char* bind_addr, uint16_t port, const char* path) {
+  int ListenWs(const char* bind_addr, uint16_t port, const char* path,
+               bool reuseport = false) {
     if (listen_ws_fd_ >= 0) return -1;  // one WS listener per host
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (fd < 0) return -1;
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (reuseport)  // per-shard WS listeners on one port (round 12)
+      setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(port);
@@ -697,12 +741,18 @@ class Host {
   // the caller's thread). One datagram socket serves every SN client;
   // per-peer conns are minted on their first CONNECT. Returns the
   // bound port, or -1.
-  int ListenSn(const char* bind_addr, uint16_t port, int gw_id) {
+  int ListenSn(const char* bind_addr, uint16_t port, int gw_id,
+               bool reuseport = false) {
     if (sn_fd_ >= 0) return -1;  // one SN listener per host
     int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (fd < 0) return -1;
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    // UDP SO_REUSEPORT (round 12): the kernel source-hash pins each SN
+    // peer to ONE shard's socket, so a datagram conversation never
+    // splits across poll threads
+    if (reuseport)
+      setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     // a datagram blast landing between two poll cycles must queue in
     // the kernel, not drop at the default (small) socket buffers
     int buf = 4 << 20;
@@ -771,8 +821,32 @@ class Host {
   // Attach the durable-session store (call BEFORE the poll thread
   // starts, like the listeners — store_ is read lock-free on the hot
   // path). The host never owns the store; Python manages its lifetime
-  // and must destroy the host first.
+  // and must destroy the host first. With shards, EVERY shard attaches
+  // the same store: appends are batched per flush and the store's one
+  // internal mutex serializes the (rare) concurrent flushes.
   void AttachStore(store::DurableStore* s) { store_ = s; }
+
+  // Join a shard group (call BEFORE any poll thread starts). This host
+  // becomes shard `shard_id` of `g->n`: conn ids gain the shard
+  // prefix, cross-shard deliveries ride the group's SPSC rings, and
+  // the group's doorbell for this shard wakes our epoll loop.
+  int JoinGroup(ring::ShardGroup* g, int shard_id) {
+    if (!g || shard_id < 0 || shard_id >= g->n ||
+        g->n > ring::kMaxShards)
+      return -1;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kShardWakeTag;
+    if (epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, g->doorbell[shard_id],
+                  &ev) < 0)
+      return -1;  // state untouched: a failed join leaves no group
+                  // pointer for ~Host to chase and no alive=true for
+                  // producers to ship into
+    group_ = g;
+    shard_id_ = shard_id;
+    g->alive[shard_id].store(true, std::memory_order_release);
+    return 0;
+  }
 
   // Record one observation into a telemetry stage from the POLL THREAD
   // only (the native server's resume-replay drain runs there); the
@@ -849,12 +923,16 @@ class Host {
       }
       for (int i = 0; i < n; i++) HandleEvent(evs[i]);
       ApplyPending();
+      // inbound cross-shard deliveries apply before this cycle's
+      // flushes so their acks/appends ride the same batch records
+      if (group_) DrainShardRings();
       if (!lane_pending_.empty()) LaneStaleScan();
       SnRexmitScan();    // qos1-over-UDP retransmit timeouts
       FlushDurables();   // catch-all for appends with no dirty socket
       FlushTaps();
       FlushAcks();
       FlushTrunks();
+      if (group_) FlushShards();
       // histogram deltas ride a ~100ms cadence, not every cycle: under
       // blast the per-cycle record + its Python-side decode measurably
       // taxed the plane (the observe_overhead budget); flight-recorder
@@ -897,6 +975,7 @@ class Host {
   static constexpr uint64_t kListenWsTag = ~0ull - 2;
   static constexpr uint64_t kListenTrunkTag = ~0ull - 3;
   static constexpr uint64_t kListenSnTag = ~0ull - 4;
+  static constexpr uint64_t kShardWakeTag = ~0ull - 5;
 
   void Wake() {
     uint64_t one = 1;
@@ -1129,6 +1208,11 @@ class Host {
                          ? static_cast<uint32_t>((1ull << op.token) - 1)
                          : 7u;
         break;
+      case Op::kTrunkPeerState:
+        // shard 0's kind-9 UP/DOWN mirrored onto non-trunk shards by
+        // Python: the TrunkEligible oracle for ring-forwarded legs
+        trunk_peer_up_[op.owner] = op.flags != 0;
+        break;
     }
   }
 
@@ -1277,7 +1361,44 @@ class Host {
       }
       if (e->flags & (kSubRuleTap | kSubRemote)) continue;
       if ((e->flags & kSubNoLocal) && e->owner == publisher) continue;
+      if (group_) {
+        int ds = ShardOf(e->owner);
+        if (ds != shard_id_) {
+          // the subscriber's conn lives on another shard: collect it —
+          // ONE multi-target ring entry per (publish, shard) ships
+          // after the loop (admission already ran in ShardAdmit,
+          // BEFORE any side effect of this publish); the target
+          // shard's DeliverTo runs its window/backpressure machinery
+          // and counts kStFastOut there
+          uint8_t oq = qos < e->qos ? qos : e->qos;
+          xtgt_scratch_[ds].push_back(
+              e->owner | (static_cast<uint64_t>(oq) << 60));
+          continue;
+        }
+      }
       DeliverTo(e->owner, *e, publisher, qos, topic, payload);
+    }
+    if (group_) {
+      for (int ds = 0; ds < group_->n; ds++) {
+        if (xtgt_scratch_[ds].empty()) continue;
+        // Admitted publishes (TryFast/LaneDeliver ran ShardAdmit this
+        // cycle, same thread, nothing pushed since) always pass this
+        // re-check. The UNADMITTED caller — the trunk receiver's
+        // fan-out, which cannot punt a publish that already left its
+        // origin node — degrades ITS deliveries alone to a counted
+        // drop here instead of appending to a batch whose seal-time
+        // Push failure would discard other publishes' entries too.
+        if (RingRoom(ds)) {
+          XShipMulti(ds, xtgt_scratch_[ds], publisher, qos, topic,
+                     payload);
+        } else {
+          stats_[kStShardRingFull].fetch_add(1,
+                                             std::memory_order_relaxed);
+          stats_[kStDropsBackpressure].fetch_add(
+              xtgt_scratch_[ds].size(), std::memory_order_relaxed);
+        }
+        xtgt_scratch_[ds].clear();
+      }
     }
     if (!dur_tok_scratch_.empty()) {
       // dedup once, O(S log S): two filters of one session yield one
@@ -1300,6 +1421,22 @@ class Host {
         const SubEntry& e = g->members[g->cursor % nmem];
         g->cursor++;
         if ((e.flags & kSubNoLocal) && e.owner == publisher) continue;
+        if (group_ && ShardOf(e.owner) != shard_id_) {
+          // cross-shard member: a full ring admits the ship; a full
+          // one skips this member and the next takes the message —
+          // the nack/redispatch shape, not a punt (groups are picked
+          // one-member-at-a-time, so per-member degradation is safe)
+          int ds = ShardOf(e.owner);
+          if (RingRoom(ds)) {
+            uint8_t oq = qos < e.qos ? qos : e.qos;
+            XShip(ds, e.owner, publisher, oq, false, topic, payload);
+            delivered = true;
+          } else {
+            stats_[kStShardRingFull].fetch_add(1,
+                                               std::memory_order_relaxed);
+          }
+          continue;
+        }
         delivered = DeliverTo(e.owner, e, publisher, qos, topic, payload);
       }
       stats_[delivered ? kStSharedDispatch : kStSharedNoMember].fetch_add(
@@ -1377,10 +1514,30 @@ class Host {
       }
       // the device model only sees broker-table subscriptions; punt
       // markers it cannot know about (remote routes, flips raced with
-      // this batch) are re-checked against the punt-only trie
+      // this batch) are re-checked against the punt-only trie. Remote
+      // entries no longer punt wholesale (round 12, the lane+trunk
+      // coexistence edge): an eligible trunk audience collects here
+      // and the remote leg is enqueued AFTER the device-matched local
+      // fan-out — only real punt shapes (or a down/ineligible trunk)
+      // still force the Python path.
       punt_scratch_.clear();
       punt_subs_.Match(topic, &punt_scratch_);
-      if (!punt_scratch_.empty()) {
+      trunk_scratch_.clear();
+      bool lane_punt = false;
+      for (const SubEntry* pe : punt_scratch_) {
+        if (!(pe->flags & kSubRemote)) {
+          lane_punt = true;
+          break;
+        }
+        uint64_t peer = pe->owner - kTrunkOwnerBase;
+        if (!TrunkEligible(peer, le.qos,
+                           15 + topic.size() + payload.size())) {
+          lane_punt = true;
+          break;
+        }
+        PushUnique(&trunk_scratch_, peer);
+      }
+      if (lane_punt) {
         LanePunt(le, /*revoke_permit=*/false);
         continue;
       }
@@ -1407,15 +1564,30 @@ class Host {
         LanePunt(le, /*revoke_permit=*/false);
         continue;
       }
-      if (tapped)
-        EmitTap(le.publisher, le.qos,
-                (static_cast<uint8_t>(le.frame[0]) & 0x08) != 0, topic,
-                payload);
+      if (!ShardAdmit()) {
+        // a destination shard's ring cannot take this fan-out: the
+        // walk path's ring-full -> punt -> Python ladder, through the
+        // lane's punt seam (BEFORE the tap/ack side effects)
+        LanePunt(le, /*revoke_permit=*/false);
+        continue;
+      }
+      bool ldup = (static_cast<uint8_t>(le.frame[0]) & 0x08) != 0;
+      if (tapped) EmitTap(le.publisher, le.qos, ldup, topic, payload);
       stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
       if (le.qos == 1)
         stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
-      cur_dup_ = (static_cast<uint8_t>(le.frame[0]) & 0x08) != 0;
+      cur_dup_ = ldup;
       FanOut(le.publisher, le.qos, le.pid, topic, payload);
+      // the remote legs collected above (lane+trunk coexistence): the
+      // trunk enqueue next to the device-matched local fan-out — the
+      // TryFast walk path's two-halves discipline
+      for (uint64_t peer : trunk_scratch_) {
+        if (IsTrunkShard())
+          TrunkEnqueue(peer, le.publisher, le.qos, ldup, topic, payload);
+        else
+          XShip(0, kTrunkOwnerBase + peer, le.publisher, le.qos, ldup,
+                topic, payload);
+      }
     }
     FlushDirty();
   }
@@ -1424,6 +1596,14 @@ class Host {
     if (ev.data.u64 == kWakeTag) {
       uint64_t junk;
       while (read(wake_fd_, &junk, sizeof(junk)) > 0) {}
+      return;
+    }
+    if (ev.data.u64 == kShardWakeTag) {
+      // another shard pushed onto our inbound rings; the drain itself
+      // runs once per poll cycle (DrainShardRings) — just clear the
+      // doorbell here
+      uint64_t junk;
+      while (read(group_->doorbell[shard_id_], &junk, sizeof(junk)) > 0) {}
       return;
     }
     if (ev.data.u64 == kListenTag || ev.data.u64 == kListenWsTag) {
@@ -1473,7 +1653,7 @@ class Host {
       }
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      uint64_t id = next_id_++;
+      uint64_t id = MintConnId();
       Conn c;
       c.fd = fd;
       c.framer = Framer(max_size_);
@@ -1819,7 +1999,22 @@ class Host {
         // Topics with entries in flight stay on the lane (ordering).
         punt_scratch_.clear();
         punt_subs_.Match(topic, &punt_scratch_);
-        if (!punt_scratch_.empty()) {
+        bool must_punt = false;
+        for (const SubEntry* pe : punt_scratch_) {
+          // lane+trunk coexistence (round 12, carried edge): an
+          // ELIGIBLE remote audience no longer forces the Python
+          // path — the frame parks on the lane and LaneDeliver trunks
+          // the remote leg next to the device-matched local fan-out.
+          // Anything else in the punt trie (real punt markers, a down
+          // trunk, qos2) still punts like before.
+          if (!(pe->flags & kSubRemote) ||
+              !TrunkEligible(pe->owner - kTrunkOwnerBase, qos,
+                             15 + topic.size() + payload.size())) {
+            must_punt = true;
+            break;
+          }
+        }
+        if (must_punt) {
           stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
           return false;
         }
@@ -1882,23 +2077,26 @@ class Host {
         // state), in which case the entry degrades to a punt marker
         // and Python's forward_fn lane carries the message. Decided
         // BEFORE any side effect: a partial native fan-out followed by
-        // a punt would double-deliver the local audience.
+        // a punt would double-deliver the local audience. Non-trunk
+        // shards consult their link-state mirror; the leg itself rides
+        // the ring to shard 0 (TrunkEligible).
         uint64_t peer = e->owner - kTrunkOwnerBase;
-        auto tp = trunk_peers_.find(peer);
-        if (tp == trunk_peers_.end() || !tp->second.up || qos == 2 ||
-            (qos == 1 && tp->second.unacked.size() >= kTrunkUnackedMax) ||
-            15 + topic.size() + payload.size() > trunk::kMaxEntryBytes) {
+        if (!TrunkEligible(peer, qos,
+                           15 + topic.size() + payload.size())) {
           stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
           return false;
         }
-        bool seen = false;
-        for (uint64_t p : trunk_scratch_)
-          if (p == peer) {
-            seen = true;
-            break;
-          }
-        if (!seen) trunk_scratch_.push_back(peer);
+        PushUnique(&trunk_scratch_, peer);
+        continue;
       }
+    }
+    if (!ShardAdmit()) {
+      // a destination shard's ring cannot take this publish: the whole
+      // fan-out degrades ring-full -> punt -> Python BEFORE any side
+      // effect (the trunk-down ladder; ordering across the boundary is
+      // best-effort, same as the trunk's)
+      stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+      return false;
     }
     if (qos == 2) {
       AckState& a = EnsureAck(c);
@@ -1918,9 +2116,16 @@ class Host {
     cur_dup_ = (h & 0x08) != 0;  // durable entries keep the DUP bit
     FanOut(id, qos, pid, topic, payload);
     // remote legs last: the local fan-out above and the trunk enqueue
-    // below are the two halves of emqx_broker:publish's route loop
-    for (uint64_t peer : trunk_scratch_)
-      TrunkEnqueue(peer, id, qos, (h & 0x08) != 0, topic, payload);
+    // below are the two halves of emqx_broker:publish's route loop.
+    // Non-trunk shards ship the leg to shard 0 over the ring (target =
+    // the trunk owner-namespace id, the scheme the conn prefix reuses).
+    for (uint64_t peer : trunk_scratch_) {
+      if (IsTrunkShard())
+        TrunkEnqueue(peer, id, qos, (h & 0x08) != 0, topic, payload);
+      else
+        XShip(0, kTrunkOwnerBase + peer, id, qos, (h & 0x08) != 0,
+              topic, payload);
+    }
     if (telemetry_) {
       FrNote(c, kFrFastPub, 3, qos, cur_hash_);
       if (t_in) {
@@ -2263,8 +2468,11 @@ class Host {
       if (!n) return;
       std::string payload(reinterpret_cast<char*>(&n), 4);
       payload += ack_buf_;
-      events_.push_back(
-          EncodeRecord(7, 0, payload.data(), payload.size()));
+      // the record id slot carries the shard (round 12): concurrent
+      // poll threads feed one Python reconciler, which must attribute
+      // each ack batch to the producing shard's host
+      events_.push_back(EncodeRecord(7, static_cast<uint64_t>(shard_id_),
+                                     payload.data(), payload.size()));
       stats_[kStAckBatches].fetch_add(1, std::memory_order_relaxed);
       ack_buf_.clear();
       n = 0;
@@ -2377,7 +2585,10 @@ class Host {
     stats_[kStStoreAppends].fetch_add(dur_n_, std::memory_order_relaxed);
     stats_[kStDurableBatches].fetch_add(1, std::memory_order_relaxed);
     dur_buf_[0] = 10;
-    uint64_t id = 0;
+    // id slot = shard (round 12): durable consume folds kind-10
+    // batches from every shard; guids stay globally unique (the store
+    // is shared, AllocGuids is atomic) but attribution is per-shard
+    uint64_t id = static_cast<uint64_t>(shard_id_);
     memcpy(&dur_buf_[1], &id, 8);
     uint32_t plen = static_cast<uint32_t>(dur_buf_.size() - 13);
     memcpy(&dur_buf_[9], &plen, 4);
@@ -2953,6 +3164,296 @@ class Host {
     epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, s.fd, &ev);
   }
 
+  // -- multi-core shards (round 12) ---------------------------------------
+  // One Host instance per shard, each a full single-threaded epoll
+  // plane; the match table is replicated (Python broadcasts ops) and
+  // only DELIVERY crosses shards, over ring.h's SPSC rings in the
+  // trunk BATCH entry layout prefixed with an explicit [u64 target] —
+  // the producer shard did the match, so the consumer delivers by conn
+  // id instead of re-matching. Degradation ladder mirrors the trunk's:
+  // ring-full -> punt -> Python, decided BEFORE any side effect.
+
+  uint64_t ShardPrefix() const {
+    return static_cast<uint64_t>(shard_id_) << kShardShift;
+  }
+  uint64_t MintConnId() { return ShardPrefix() | next_id_++; }
+  // trunk links (listener + dials + peer rings) live on shard 0; an
+  // unsharded host IS shard 0
+  bool IsTrunkShard() const { return shard_id_ == 0; }
+
+  // Producer-side admission for one destination: alive consumer and
+  // >= 2 free slots (room for the open batch plus one mid-publish
+  // seal — a single publish can trigger at most one byte-cap seal, so
+  // the cycle-end seal always has a slot).
+  bool RingRoom(int dst) const {
+    return group_ != nullptr &&
+           group_->alive[dst].load(std::memory_order_acquire) &&
+           group_->rings[shard_id_][dst].Free() >= 2;
+  }
+
+  // Can this publish ride `peer`'s trunk from THIS shard? Non-trunk
+  // shards consult their Python-broadcast up/down mirror
+  // (kTrunkPeerState) and conservatively punt while the mirror lags;
+  // the qos1 replay-ring bound is enforced where the ring lives
+  // (shard 0 — ring-forwarded entries may overshoot it by the
+  // in-flight cycle, the trunk's documented soft bound).
+  bool TrunkEligible(uint64_t peer, uint8_t qos,
+                     size_t entry_bytes) const {
+    if (qos == 2 || entry_bytes > trunk::kMaxEntryBytes) return false;
+    if (IsTrunkShard()) {
+      auto tp = trunk_peers_.find(peer);
+      return tp != trunk_peers_.end() && tp->second.up &&
+             !(qos == 1 &&
+               tp->second.unacked.size() >= kTrunkUnackedMax);
+    }
+    auto it = trunk_peer_up_.find(peer);
+    return it != trunk_peer_up_.end() && it->second;
+  }
+
+  // Collect the destination shards this match set needs (plain
+  // cross-shard entries + shard 0 when trunk legs must ride the ring)
+  // and check ring room for each. False = the publish must degrade to
+  // a punt — called BEFORE any side effect, the trunk discipline.
+  bool ShardAdmit() {
+    if (!group_) return true;
+    xdst_scratch_.clear();
+    for (const SubEntry* e : match_scratch_) {
+      if (e->flags & (kSubPunt | kSubDurable | kSubRuleTap | kSubRemote))
+        continue;
+      int ds = ShardOf(e->owner);
+      if (ds == shard_id_) continue;
+      PushUnique(&xdst_scratch_, ds);
+    }
+    if (!IsTrunkShard() && !trunk_scratch_.empty())
+      PushUnique(&xdst_scratch_, 0);
+    for (int ds : xdst_scratch_) {
+      if (!RingRoom(ds)) {
+        stats_[kStShardRingFull].fetch_add(1, std::memory_order_relaxed);
+        return false;
+      }
+    }
+    return true;
+  }
+
+  // Append one cross-shard entry ([u64 target] + the trunk pre-parse
+  // entry, payload-deduped per destination batch) and seal at the byte
+  // cap. `target` is a conn id (delivery) or kTrunkOwnerBase + peer
+  // (trunk forward from a non-trunk shard). Bit 63 of the target word
+  // marks the MULTI-TARGET form below; every real target (conn ids
+  // top out at bit 59, the trunk owner bit is 62) keeps it clear.
+  void XShip(int dst, uint64_t target, uint64_t origin, uint8_t qos,
+             bool dup, std::string_view topic, std::string_view payload) {
+    std::string& b = XBatch(dst);
+    char t8[8];
+    memcpy(t8, &target, 8);
+    b.append(t8, 8);
+    XAppendEntry(dst, b, origin, qos, dup, topic, payload);
+    stats_[kStShardRingOut].fetch_add(1, std::memory_order_relaxed);
+    if (b.size() > kTapFlushBytes) SealShardBatch(dst);
+  }
+
+  // The fan-out form (the perf_opt spine): ONE entry per (publish,
+  // destination shard) — [u64 bit63|n][n x u64 (min_qos<<60 | conn)]
+  // + the shared trunk pre-parse entry. The consumer decodes the
+  // topic/payload ONCE and builds the shared frames ONCE per publish,
+  // exactly like FanOut's per-publish shared-frame discipline; the
+  // per-target min-qos rides bits 60-61 of each target word (conn ids
+  // top out at bit 59). Halves ring bytes and consumer decode for
+  // wide audiences vs one single-target entry per subscriber.
+  void XShipMulti(int dst, const std::vector<uint64_t>& targets,
+                  uint64_t origin, uint8_t qos, std::string_view topic,
+                  std::string_view payload) {
+    std::string& b = XBatch(dst);
+    uint64_t marker = (1ull << 63) | targets.size();
+    char t8[8];
+    memcpy(t8, &marker, 8);
+    b.append(t8, 8);
+    b.append(reinterpret_cast<const char*>(targets.data()),
+             8 * targets.size());
+    XAppendEntry(dst, b, origin, qos, /*dup=*/false, topic, payload);
+    stats_[kStShardRingOut].fetch_add(targets.size(),
+                                      std::memory_order_relaxed);
+    if (b.size() > kTapFlushBytes) SealShardBatch(dst);
+  }
+
+  std::string& XBatch(int dst) {
+    std::string& b = xbatch_[dst];
+    if (b.empty()) {
+      b.reserve(kTapFlushBytes + 512);  // one allocation per batch
+      b.assign(4, '\0');  // [u32 n] patched at seal
+      xdirty_.push_back(dst);
+    }
+    return b;
+  }
+
+  void XAppendEntry(int dst, std::string& b, uint64_t origin,
+                    uint8_t qos, bool dup, std::string_view topic,
+                    std::string_view payload) {
+    bool inline_payload =
+        !(xhave_prev_[dst] && payload == xprev_payload_[dst]);
+    trunk::AppendEntry(&b, origin, qos, dup, inline_payload, topic,
+                       payload);
+    if (inline_payload) {
+      xprev_payload_[dst].assign(payload.data(), payload.size());
+      xhave_prev_[dst] = true;
+    }
+    xbatch_n_[dst]++;
+  }
+
+  void SealShardBatch(int dst) {
+    std::string& b = xbatch_[dst];
+    if (xbatch_n_[dst] == 0) {
+      b.clear();
+      return;
+    }
+    memcpy(&b[0], &xbatch_n_[dst], 4);
+    // ring the doorbell on the FIRST seal of a cycle, not just at
+    // cycle end (FlushShards): a long read-backlog cycle seals many
+    // byte-cap batches, and a consumer sleeping until cycle end would
+    // turn the pipeline half-duplex (measured ~15% on the 2-core box)
+    bool first = xbatch_sealed_[dst] == 0;
+    xbatch_sealed_[dst]++;
+    if (!group_->rings[shard_id_][dst].Push(std::move(b))) {
+      // the consumer wedged past the admission margin (it only holds
+      // under a torn-down shard racing the pre-check): drop with the
+      // backpressure accounting a stalled local subscriber would get
+      stats_[kStShardRingFull].fetch_add(1, std::memory_order_relaxed);
+      stats_[kStDropsBackpressure].fetch_add(xbatch_n_[dst],
+                                             std::memory_order_relaxed);
+    }
+    b.clear();  // Push moved it on success; failure keeps it — clear both
+    xbatch_n_[dst] = 0;
+    xprev_payload_[dst].clear();
+    xhave_prev_[dst] = false;
+    if (first) group_->RingDoorbell(dst);
+  }
+
+  // Once per poll cycle (the FlushTrunks discipline): seal every dirty
+  // destination batch and ring its doorbell.
+  void FlushShards() {
+    if (xdirty_.empty()) return;
+    std::vector<int> dirty;
+    dirty.swap(xdirty_);
+    for (int dst : dirty) {
+      SealShardBatch(dst);
+      group_->RingDoorbell(dst);
+      xbatch_sealed_[dst] = 0;
+    }
+  }
+
+  // Consume every inbound ring once per poll cycle.
+  void DrainShardRings() {
+    bool any = false;
+    std::string rec;
+    for (int src = 0; src < group_->n; src++) {
+      if (src == shard_id_) continue;
+      ring::SpscRing& r = group_->rings[src][shard_id_];
+      while (r.Pop(&rec)) {
+        ApplyShardBatch(rec);
+        any = true;
+      }
+    }
+    if (any) FlushDirty();
+  }
+
+  // Apply one ring batch: explicit per-target deliveries (the producer
+  // shard did the match and pre-minned each target's qos), plus
+  // trunk-forward entries (target carries the trunk owner bit) from
+  // shards without trunk links. Fan-out entries carry one target LIST
+  // per publish (XShipMulti), so topic/payload decode and the shared
+  // frame builds run once per publish — FanOut's discipline, across
+  // the ring.
+  void ApplyShardBatch(const std::string& rec) {
+    if (rec.size() < 4) return;
+    uint32_t n = 0;
+    memcpy(&n, rec.data(), 4);
+    if (telemetry_) RecordHist(kHistShardRingN, n);
+    const char* body = rec.data();
+    size_t blen = rec.size();
+    size_t pos = 4;
+    std::string_view prev_payload;
+    bool have_prev = false;
+    std::string_view last_topic;
+    const char* last_pl = nullptr;
+    uint64_t applied = 0;
+    constexpr uint64_t kConnMask = (1ull << 60) - 1;
+    for (uint32_t i = 0; i < n && pos + 8 <= blen; i++) {
+      uint64_t t0 = 0;
+      memcpy(&t0, body + pos, 8);
+      pos += 8;
+      uint32_t ntgt = 0;
+      size_t tgts_at = 0;
+      if (t0 >> 63) {  // multi-target marker: [bit63|n][n x u64]
+        ntgt = static_cast<uint32_t>(t0 & 0xFFFFFFFFu);
+        if (ntgt == 0 || pos + 8ull * ntgt > blen) break;
+        tgts_at = pos;
+        pos += 8ull * ntgt;
+      }
+      if (pos + 11 > blen) break;
+      uint64_t origin = 0;
+      memcpy(&origin, body + pos, 8);
+      uint8_t flags = static_cast<uint8_t>(body[pos + 8]);
+      uint16_t tlen = 0;
+      memcpy(&tlen, body + pos + 9, 2);
+      pos += 11;
+      if (pos + tlen > blen) break;
+      std::string_view topic(body + pos, tlen);
+      pos += tlen;
+      std::string_view payload;
+      if (flags & 1) {
+        if (pos + 4 > blen) break;
+        uint32_t pl = 0;
+        memcpy(&pl, body + pos, 4);
+        pos += 4;
+        if (pos + pl > blen) break;
+        payload = std::string_view(body + pos, pl);
+        pos += pl;
+        prev_payload = payload;
+        have_prev = true;
+      } else {
+        if (!have_prev) break;  // corrupt batch: dedup with no reference
+        payload = prev_payload;
+      }
+      uint8_t qos = (flags >> 1) & 3;
+      bool dup = (flags & 8) != 0;
+      if (ntgt == 0 && (t0 & kTrunkOwnerBase)) {
+        applied++;
+        TrunkEnqueue(t0 - kTrunkOwnerBase, origin, qos, dup, topic,
+                     payload);
+        continue;
+      }
+      // DeliverTo's shared frames are per-publish scratch (the qos0
+      // frame and the zero-pid elevated frame are both qos-patched per
+      // target): rebuild only when (topic, payload) changed
+      if (topic != last_topic || payload.data() != last_pl) {
+        frame_v4_.clear();
+        frame_v5_.clear();
+        frame_q_v4_.clear();
+        frame_q_v5_.clear();
+        last_topic = topic;
+        last_pl = payload.data();
+        if (telemetry_) cur_hash_ = TopicHash(topic);
+      }
+      if (ntgt == 0) {
+        applied++;
+        SubEntry e{t0, qos, 0};
+        DeliverTo(t0, e, origin, qos, topic, payload);
+        continue;
+      }
+      applied += ntgt;
+      for (uint32_t k = 0; k < ntgt; k++) {
+        uint64_t w = 0;
+        memcpy(&w, body + tgts_at + 8ull * k, 8);
+        uint8_t oq = static_cast<uint8_t>((w >> 60) & 3);
+        uint64_t conn = w & kConnMask;
+        SubEntry e{conn, oq, 0};
+        DeliverTo(conn, e, origin, oq, topic, payload);
+      }
+    }
+    if (applied)
+      stats_[kStShardRingIn].fetch_add(applied, std::memory_order_relaxed);
+  }
+
   // -- mqtt-sn gateway (round 11) -----------------------------------------
   // Foreign framing → same MQTT fast path, the ws.h pattern applied to
   // the first UDP gateway: datagrams decode with the shared sn.h codec,
@@ -3075,7 +3576,7 @@ class Host {
     c.framer = Framer(max_size_);
     c.sn = std::make_unique<SnConnState>();
     c.sn->addr = peer;
-    uint64_t id = kSnConnBit | next_sn_id_++;
+    uint64_t id = kSnConnBit | ShardPrefix() | next_sn_id_++;
     c.sn->conn_id = id;
     auto& cref = conns_.emplace(id, std::move(c)).first->second;
     sn_addr_conn_[SnAddrKey(peer)] = id;
@@ -3481,8 +3982,13 @@ class Host {
     c.sn->anon = true;
     c.sn->connected = true;
     c.sn->connect_sent = true;
-    c.sn->clientid = "sn-anon";
-    uint64_t id = kSnConnBit | next_sn_id_++;
+    // per-shard clientid: two shards each minting "sn-anon" would CM-
+    // takeover-kick each other's session forever (shard 0 keeps the
+    // unsharded name)
+    std::string cid = shard_id_ ? "sn-anon-s" + std::to_string(shard_id_)
+                                : "sn-anon";
+    c.sn->clientid = cid;
+    uint64_t id = kSnConnBit | ShardPrefix() | next_sn_id_++;
     c.sn->conn_id = id;
     auto& cref = conns_.emplace(id, std::move(c)).first->second;
     cref.last_rx_ms = NowMs();
@@ -3497,8 +4003,8 @@ class Host {
     body.push_back(4);
     body.push_back(0x02);
     sn::PutBe16(&body, 0);
-    sn::PutBe16(&body, 7);
-    body += "sn-anon";
+    sn::PutBe16(&body, static_cast<uint16_t>(cid.size()));
+    body += cid;
     std::string f;
     BuildMqttFrame(&f, 0x10, body);
     SnForward(id, cref, f);
@@ -4086,7 +4592,9 @@ class Host {
   void FlushTelemetry() {
     if (tele_buf_.size() <= 13) return;
     tele_buf_[0] = 8;
-    uint64_t id = 0;
+    // id slot = shard (round 12): the telemetry fold runs under one
+    // lock across N poll threads and tags per-shard gauges by this
+    uint64_t id = static_cast<uint64_t>(shard_id_);
     memcpy(&tele_buf_[1], &id, 8);
     uint32_t plen = static_cast<uint32_t>(tele_buf_.size() - 13);
     memcpy(&tele_buf_[9], &plen, 4);
@@ -4439,6 +4947,27 @@ class Host {
   // -- retained snapshot (round 11, poll-thread-owned) ---------------------
   RetainTable retained_;
   std::vector<const RetainEntry*> retain_scratch_;
+  // -- multi-core shards (round 12, poll-thread-owned) ---------------------
+  // The group is Python-owned and outlives every member host; shard 0
+  // with group_ == nullptr IS the unsharded host (every shard check
+  // short-circuits). Outbound batches accumulate per destination and
+  // seal once per poll cycle (FlushShards) or at the byte cap.
+  ring::ShardGroup* group_ = nullptr;
+  int shard_id_ = 0;
+  std::string xbatch_[ring::kMaxShards];       // open batch per dest
+  uint32_t xbatch_n_[ring::kMaxShards] = {};   // entries in each batch
+  uint32_t xbatch_sealed_[ring::kMaxShards] = {};  // seals this cycle
+  std::string xprev_payload_[ring::kMaxShards];  // payload-dedup ref
+  bool xhave_prev_[ring::kMaxShards] = {};
+  std::vector<int> xdirty_;       // destinations batched this cycle
+  std::vector<int> xdst_scratch_;  // dest shards of ONE publish (admission)
+  // ONE publish's cross-shard audience per destination (FanOut collects,
+  // XShipMulti ships one multi-target entry per non-empty slot)
+  std::vector<uint64_t> xtgt_scratch_[ring::kMaxShards];
+  // shard 0's trunk link state mirrored here by Python (kTrunkPeerState
+  // broadcast off the kind-9 UP/DOWN events): non-trunk shards decide
+  // trunk-vs-punt from this, conservatively down while the mirror lags
+  std::unordered_map<uint64_t, bool> trunk_peer_up_;
 };
 
 }  // namespace
@@ -4449,10 +4978,13 @@ class Host {
 
 extern "C" {
 
+// reuseport != 0 binds the TCP listener with SO_REUSEPORT so N shard
+// hosts can share one port (kernel accept sharding — round 12).
 void* emqx_host_create(const char* bind_addr, uint16_t port,
-                       uint32_t max_size, uint32_t max_conns) {
+                       uint32_t max_size, uint32_t max_conns,
+                       int reuseport) {
   auto* h = new emqx_native::Host(max_size, max_conns);
-  if (!h->Init(bind_addr, port)) {
+  if (!h->Init(bind_addr, port, reuseport != 0)) {
     delete h;
     return nullptr;
   }
@@ -4467,9 +4999,10 @@ int emqx_host_port(void* h) {
 // the poll thread starts (the epoll set is mutated from this thread).
 // Returns the bound port, or -1.
 int emqx_host_listen_ws(void* h, const char* bind_addr, uint16_t port,
-                        const char* path) {
+                        const char* path, int reuseport) {
   return static_cast<emqx_native::Host*>(h)->ListenWs(bind_addr, port,
-                                                      path);
+                                                      path,
+                                                      reuseport != 0);
 }
 
 long emqx_host_poll(void* h, uint8_t* buf, size_t cap, int timeout_ms) {
@@ -4665,14 +5198,50 @@ int emqx_host_trunk_route_del(void* h, uint64_t peer, const char* filter) {
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
+// --- multi-core shard plane (round 12) --------------------------------------
+
+// Create the cross-shard ring group for `n` shard hosts. Python owns
+// the group: create it BEFORE any host joins, destroy it AFTER every
+// member host is destroyed (the group owns the doorbell eventfds a
+// racing producer may still write to during a member's teardown).
+void* emqx_shard_group_create(int n) {
+  if (n < 1 || n > emqx_native::ring::kMaxShards) return nullptr;
+  return new emqx_native::ring::ShardGroup(n);
+}
+
+void emqx_shard_group_destroy(void* g) {
+  delete static_cast<emqx_native::ring::ShardGroup*>(g);
+}
+
+// Make `h` shard `shard_id` of group `g` (call BEFORE the poll thread
+// starts): conn ids gain the shard prefix (bits 56-58), cross-shard
+// deliveries ride the group's SPSC rings, and the group's doorbell for
+// this shard joins the epoll set. Returns 0, or -1 on a bad id.
+int emqx_host_join_group(void* h, void* g, int shard_id) {
+  return static_cast<emqx_native::Host*>(h)->JoinGroup(
+      static_cast<emqx_native::ring::ShardGroup*>(g), shard_id);
+}
+
+// Mirror shard 0's trunk link state onto a non-trunk shard (Python
+// broadcasts the kind-9 UP/DOWN events here): the shard's
+// trunk-vs-punt oracle for legs it would ring-forward to shard 0.
+int emqx_host_trunk_peer_state(void* h, uint64_t peer, int up) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kTrunkPeerState;
+  op.owner = peer;
+  op.flags = up ? 1 : 0;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
 // --- mqtt-sn gateway + retained snapshot (round 11) -------------------------
 
 // Open the MQTT-SN/UDP gateway socket (BEFORE the poll thread starts,
 // like the other listeners). Returns the bound port, or -1.
 int emqx_host_listen_sn(void* h, const char* bind_addr, uint16_t port,
-                        int gw_id) {
+                        int gw_id, int reuseport) {
   return static_cast<emqx_native::Host*>(h)->ListenSn(bind_addr, port,
-                                                      gw_id);
+                                                      gw_id,
+                                                      reuseport != 0);
 }
 
 // Install/remove a gateway-wide predefined topic id (empty topic

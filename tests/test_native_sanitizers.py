@@ -1047,9 +1047,119 @@ print("SANITIZED-RUN-OK", st["sn_in"], st["retain_msgs_out"])
 """
 
 
+DRIVER_SHARDS = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+# Three shard hosts in one ring group (ISSUE 7): cross-shard publishes
+# race set_trace/set_telemetry toggles across ALL poll threads, then
+# shard 2 TEARS DOWN mid-traffic — the group-owned doorbells and the
+# alive flag are what keep the racing producer memory-safe; afterwards
+# the ladder degrades its deliveries ring-full/dead -> punt.
+group = native.NativeShardGroup(3)
+hosts = [native.NativeHost(port=0, max_size=1 << 16) for _ in range(3)]
+for i, h in enumerate(hosts):
+    h.join_group(group, i)
+
+def connect(h, cid):
+    s = socket.create_connection(("127.0.0.1", h.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+def pub_frame(topic, payload):
+    vh = struct.pack(">H", len(topic)) + topic + payload
+    return bytes([0x30, len(vh)]) + vh
+
+pub_s = connect(hosts[0], b"sp")
+sub1_s = connect(hosts[1], b"s1")
+sub2_s = connect(hosts[2], b"s2")
+
+ids = [[], [], []]
+framed = [0, 0, 0]
+deadline = time.time() + 15
+while ((any(not i for i in ids) or any(f < 1 for f in framed))
+       and time.time() < deadline):
+    for k in range(3):
+        for kind, conn, payload in hosts[k].poll(20):
+            if kind == native.EV_OPEN:
+                ids[k].append(conn)
+            elif kind == native.EV_FRAME:
+                framed[k] += 1
+                hosts[k].send(conn, b"\x20\x02\x00\x00")
+assert all(ids), ids
+pub_id, sub1, sub2 = ids[0][0], ids[1][0], ids[2][0]
+assert [native.shard_of(c) for c in (pub_id, sub1, sub2)] == [0, 1, 2]
+hosts[0].enable_fast(pub_id, 4)
+hosts[0].permit(pub_id, "sh/t")
+hosts[1].enable_fast(sub1, 4)
+hosts[2].enable_fast(sub2, 4)
+for h in hosts:                     # the broadcast table discipline
+    h.sub_add(sub1, "sh/t", 0, 0)
+    h.sub_add(sub2, "sh/t", 0, 0)
+
+stop = threading.Event()
+stop2 = threading.Event()           # shard 2 stops early (teardown race)
+def poller(k, ev):
+    h = hosts[k]
+    while not ev.is_set():
+        list(h.poll(20))
+threads = [threading.Thread(target=poller, args=(k, stop2 if k == 2 else stop))
+           for k in range(3)]
+for t in threads:
+    t.start()
+
+def blaster():
+    f = pub_frame(b"sh/t", b"x" * 32) * 16
+    while not stop.is_set():
+        try:
+            pub_s.sendall(f)
+        except OSError:
+            break
+        time.sleep(0.001)
+bt = threading.Thread(target=blaster)
+bt.start()
+
+def toggler():
+    # trace punts + telemetry master switch flipped from a management
+    # thread while every shard's poll thread is hot (hosts[2] is left
+    # alone: its teardown below must not race a control call)
+    j = 0
+    while not stop.is_set():
+        hosts[0].set_trace(pub_id, j %% 2 == 0)
+        hosts[1].set_telemetry(j %% 3 != 0)
+        hosts[0].stats(); hosts[1].stats()
+        j += 1
+        time.sleep(0.001)
+tg = threading.Thread(target=toggler)
+tg.start()
+
+time.sleep(2.0)
+# teardown race: shard 2 dies while shard 0 keeps shipping to it
+stop2.set()
+threads[2].join()
+hosts[2].destroy()
+time.sleep(1.0)
+st = hosts[0].stats()
+assert st["shard_ring_out"] > 0, st
+stop.set()
+bt.join(); tg.join()
+for t in threads[:2]:
+    t.join()
+st0 = hosts[0].stats()
+for s in (pub_s, sub1_s, sub2_s):
+    s.close()
+hosts[0].destroy(); hosts[1].destroy()
+group.destroy()
+print("SANITIZED-RUN-OK", st0["shard_ring_out"], st0["shard_ring_full"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
-                                    "telemetry", "trunk", "durable", "sn"])
+                                    "telemetry", "trunk", "durable", "sn",
+                                    "shards"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -1067,7 +1177,8 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     src = {"host": DRIVER, "fastpath": DRIVER_FASTPATH,
            "lane": DRIVER_LANE, "ws": DRIVER_WS,
            "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
-           "durable": DRIVER_DURABLE, "sn": DRIVER_SN}[driver]
+           "durable": DRIVER_DURABLE, "sn": DRIVER_SN,
+           "shards": DRIVER_SHARDS}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

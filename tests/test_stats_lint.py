@@ -206,3 +206,20 @@ def test_sn_retain_slots_and_stages_exported():
     src = _src()
     assert "kStSnIn" in src and "kStRetainMsgsOut" in src
     assert "kHistSnIngest" in src and "kHistRetainDeliver" in src
+
+
+# -- multi-core shard plane (ISSUE 7) -----------------------------------------
+
+
+def test_shard_slots_and_stage_exported():
+    """The shard plane's StatSlots / HistStage stay exported — the
+    mechanical enum lint above passes if BOTH sides dropped them, so
+    their presence is pinned here by name (the trunk-pin pattern).
+    fetch_add sites and prometheus render-at-zero ride the mechanical
+    tests at the top of this file."""
+    for name in ("shard_ring_out", "shard_ring_in", "shard_ring_full"):
+        assert name in native.STAT_NAMES, name
+    assert "shard_ring_n" in native.HIST_STAGES
+    src = _src()
+    assert "kStShardRingOut" in src and "kStShardRingFull" in src
+    assert "kHistShardRingN" in src

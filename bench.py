@@ -1252,7 +1252,12 @@ def sec_observe_overhead() -> None:
     throughput against the EMQX_NATIVE_TELEMETRY=0 escape hatch.
     Best-of-3 per arm, interleaved, same box — the arms differ ONLY by
     the telemetry toggle (NativeBrokerServer(telemetry=...), the same
-    switch the env var drives)."""
+    switch the env var drives).
+
+    ISSUE 8 acceptance: a second interleaved pair on the 2-SHARD qos0
+    fan-out measures the distributed-tracing sampler — sampled tracing
+    ON (1-in-64, the production default) vs OFF must also land within
+    the 2% budget."""
     from emqx_tpu import native
 
     if not native.available():
@@ -1266,7 +1271,11 @@ def sec_observe_overhead() -> None:
     reps = int(os.environ.get("BENCH_OBS_REPS", 3))
     best = {"on": 0.0, "off": 0.0}
     for rep in range(reps):
-        for arm in ("on", "off"):        # interleaved: drift hits both
+        # alternate the pair order per rep (round 13): on a warming box
+        # the SECOND arm of every pair wins systematically, and that
+        # drift measured bigger than the effect under test
+        arms = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in arms:                 # interleaved: drift hits both
             server = NativeBrokerServer(
                 port=0, app=BrokerApp(), telemetry=(arm == "on"),
                 session_opts={"max_inflight": 1024})
@@ -1285,11 +1294,54 @@ def sec_observe_overhead() -> None:
     log(f"observe_overhead: on={best['on']:,.0f} off={best['off']:,.0f} "
         f"msg/s  overhead={overhead * 100:.2f}% "
         f"({'within' if overhead < 0.02 else 'OVER'} the 2% budget)")
+
+    # -- tracing arm (ISSUE 8): 1-in-64 sampler on the 2-shard fan-out.
+    # Two poll threads + the loadgen fleet oversubscribe the 2-core
+    # container far harder than the single-host pair above, so this
+    # pair runs a smaller fleet (4x4) and more interleaved reps — the
+    # best-of convention needs both arms to find their scheduling peak.
+    tbest = {"on": 0.0, "off": 0.0}
+    tspans = 0
+    treps = max(reps, int(os.environ.get("BENCH_OBS_TRACE_REPS", 5)))
+    for rep in range(treps):
+        # alternate the pair order per rep: on a warming box the SECOND
+        # arm of every pair otherwise wins systematically (measured —
+        # the drift was bigger than the effect under test)
+        arms = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in arms:
+            server = NativeBrokerServer(
+                port=0, app=BrokerApp(), shards=2,
+                tracing=(arm == "on"), trace_sample_shift=6,
+                session_opts={"max_inflight": 1024})
+            server.start()
+            try:
+                r = native.loadgen_run(
+                    "127.0.0.1", server.port, n_subs=4, n_pubs=4,
+                    msgs_per_pub=n_msg, qos=0, payload_len=16)
+                rate = r["received"] / max(r["wall_ns"] / 1e9, 1e-9)
+                tbest[arm] = max(tbest[arm], rate)
+                if arm == "on":
+                    tspans = max(tspans,
+                                 server.fast_stats()["traced_pubs"])
+                log(f"observe_overhead rep{rep} tracing={arm} "
+                    f"(2 shards): {rate:,.0f} msg/s")
+            finally:
+                server.stop()
+    t_overhead = 1.0 - tbest["on"] / max(tbest["off"], 1e-9)
+    log(f"observe_overhead tracing (2-shard qos0 fan-out): "
+        f"on={tbest['on']:,.0f} off={tbest['off']:,.0f} msg/s  "
+        f"overhead={t_overhead * 100:.2f}% sampled={tspans} "
+        f"({'within' if t_overhead < 0.02 else 'OVER'} the 2% budget)")
     put("observe_overhead",
         qos0_msgs_per_sec_telemetry_on=round(best["on"]),
         qos0_msgs_per_sec_telemetry_off=round(best["off"]),
         overhead_frac=round(overhead, 4),
-        within_2pct_budget=bool(overhead < 0.02))
+        within_2pct_budget=bool(overhead < 0.02),
+        shard2_qos0_msgs_per_sec_tracing_on=round(tbest["on"]),
+        shard2_qos0_msgs_per_sec_tracing_off=round(tbest["off"]),
+        tracing_overhead_frac=round(t_overhead, 4),
+        tracing_sampled_pubs=int(tspans),
+        tracing_within_2pct_budget=bool(t_overhead < 0.02))
 
 
 # ---------------------------------------------------------------------------

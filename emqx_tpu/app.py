@@ -52,7 +52,19 @@ class BrokerApp:
         # and the Python lookup must NOT run (a double delivery
         # otherwise). None / False falls back to the retainer here.
         self.native_retain_fn = None
+        # native distributed tracing (round 13): set by the native
+        # server to (limit) -> list of assembled span timelines (the
+        # queryable last-N ring the mgmt API serves); per-shard stat
+        # dicts for the shard-labelled prometheus series
+        self.native_spans_fn = None
+        self.native_shard_stats_fn = None
         self.metrics = Metrics()
+        # degradation ledger (round 13): structured reason events for
+        # every native/Python degradation-ladder decision, folded into
+        # the fixed messages.ledger.* slots + a bounded event ring
+        # ($SYS heartbeat + mgmt API)
+        from emqx_tpu.observe.metrics import DegradationLedger
+        self.ledger = DegradationLedger(self.metrics)
         self.stats = Stats()
         self.alarms = AlarmManager(on_change=self._on_alarm)
         # security layer (emqx_access_control): banned/authn/authz hooks.
@@ -85,6 +97,7 @@ class BrokerApp:
             metrics=self.metrics,
         )
         self.broker.shared_dispatch_batch = self._shared_dispatch_batch
+        self.broker.ledger = self.ledger   # device-failover events
         # device serving path (router.device): coalesces the servers'
         # publishes into batched kernel launches (broker/pipeline.py)
         self.pipeline = None
@@ -93,7 +106,7 @@ class BrokerApp:
             self.pipeline = PublishPipeline(self.broker, self.cm)
         self.sys = SysHeartbeat(
             node=node, publish_fn=self._publish_dispatch,
-            metrics=self.metrics, stats=self.stats,
+            metrics=self.metrics, stats=self.stats, ledger=self.ledger,
         )
         self.retainer = Retainer(
             max_retained=max_retained, default_expiry_ms=retained_expiry_ms
@@ -228,7 +241,11 @@ class BrokerApp:
             qos=0, from_="$SYS", flags={"sys": True},
         ))
 
-    def prometheus(self) -> str:
+    def prometheus(self, openmetrics: bool = False) -> str:
+        """Text exposition. ``openmetrics=True`` adds trace-id
+        exemplars on histogram buckets — OpenMetrics-flavoured output
+        a classic 0.0.4 parser would reject, so it is opt-in
+        (the scrape endpoint's ``?format=openmetrics``)."""
         from emqx_tpu.observe import prometheus
 
         self.stats.tick()
@@ -238,8 +255,16 @@ class BrokerApp:
                 native = self.native_stats_fn()
             except Exception:  # noqa: BLE001 — a dying server must not
                 native = None  # break the scrape endpoint
+        shards = None
+        if self.native_shard_stats_fn is not None:
+            try:
+                shards = self.native_shard_stats_fn()
+            except Exception:  # noqa: BLE001 — same containment
+                shards = None
         return prometheus.render(self.metrics, self.stats,
-                                 node=self.broker.node, native=native)
+                                 node=self.broker.node, native=native,
+                                 native_shards=shards,
+                                 openmetrics=openmetrics)
 
     @classmethod
     def from_config(cls, conf, node: str = None, **overrides) -> "BrokerApp":

@@ -135,6 +135,10 @@ class Broker:
         # brackets the batch's hook fold (RuleEngine.publish_gate)
         self.rules_matched_fn = None
         self.rules_gate_fn = None
+        # degradation ledger (round 13, set by the app): device-loss
+        # failovers record a structured reason event next to the
+        # messages.device_failover counter
+        self.ledger = None
         self.slots = SlotRegistry(
             capacity=router_model.n_sub_slots
             if router_model is not None else 8192)
@@ -396,6 +400,8 @@ class Broker:
         import logging
 
         self._inc("messages.device_failover")
+        if self.ledger is not None:
+            self.ledger.record("device_failover", 1, detail=stage)
         logging.getLogger("emqx_tpu.broker").exception(
             "device router %s failed; batch served by the host oracle",
             stage)

@@ -67,7 +67,8 @@ import queue
 import struct
 import threading
 import time
-from collections import deque
+import zlib
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
@@ -79,6 +80,8 @@ from emqx_tpu.core import topic as T
 from emqx_tpu.core.message import now_ms
 from emqx_tpu.mqtt import packet as P
 from emqx_tpu.mqtt.frame import FrameError, parse_one, serialize
+from emqx_tpu.observe.metrics import DegradationLedger
+from emqx_tpu.observe.trace import SpanCollector
 
 log = logging.getLogger("emqx_tpu.native_server")
 
@@ -362,6 +365,8 @@ class NativeBrokerServer:
         ws_path: str = "/mqtt",
         ws_host: Optional[str] = None,
         telemetry: Optional[bool] = None,
+        tracing: Optional[bool] = None,
+        trace_sample_shift: Optional[int] = None,
         trunk_port: Optional[int] = None,
         trunk_host: Optional[str] = None,
         durable: Optional[bool] = None,
@@ -528,8 +533,46 @@ class NativeBrokerServer:
         # strand the conn trace-punted in C++ after the trace stops
         self._traced_conns: set[int] = set()
         self._trace_lock = threading.Lock()
+        # -- native distributed tracing (round 13) --------------------------
+        # A deterministic 1-in-2^shift publish sampler tags fast-path
+        # publishes with 64-bit trace ids that propagate through every
+        # native seam (ring entries, trunk wire v1, durable store); the
+        # planes emit kind-12 span events folded here into a bounded
+        # SpanCollector, the trace log (mode="native" clientid traces),
+        # and prometheus exemplars. The degradation ledger rides the
+        # same records: every ladder decision becomes a structured
+        # reason event in app.ledger. EMQX_NATIVE_TRACING=0 (or
+        # tracing=False) turns the sampler off; telemetry=False gates
+        # everything anyway.
+        if tracing is None:
+            tracing = os.environ.get("EMQX_NATIVE_TRACING", "1") != "0"
+        self.tracing = bool(tracing) and self.telemetry
+        if trace_sample_shift is None:
+            shift_env = os.environ.get("EMQX_NATIVE_TRACE_SHIFT", "")
+            trace_sample_shift = (int(shift_env) if shift_env.isdigit()
+                                  else 6)   # 1-in-64 default
+        self.trace_sample_shift = int(trace_sample_shift)
+        self.spans = SpanCollector()
+        self.ledger = (app.ledger if app is not None
+                       and getattr(app, "ledger", None) is not None
+                       else DegradationLedger(self.broker.metrics))
+        # per-shard trace-id seeds: node bits keep two-node traces
+        # disjoint, shard bits keep concurrent samplers disjoint, bit
+        # 63 keeps every seed (and so every id) nonzero
+        node_bits = zlib.crc32(self.broker.node.encode()) & 0x3FFF
+        for i, h in enumerate(self.hosts):
+            h.set_tracing(self.tracing, self.trace_sample_shift,
+                          (1 << 63) | (node_bits << 48) | (i << 44))
+        # trace ids whose publisher has a running native-mode trace ->
+        # that clientid (SPAN lines land on its trace log; the
+        # publisher resolves from the ingress span's aux = conn id)
+        self._trace_log_ids: OrderedDict = OrderedDict()
+        self._native_traced: set = set()
         if self.app is not None:
             self.app.native_stats_fn = self.fast_stats
+            self.app.native_spans_fn = self.spans_recent
+            if self.shards > 1:
+                self.app.native_shard_stats_fn = self.shard_stats
         # -- durable-session plane (round 10) ------------------------------
         # A persistent session's filter used to become a punt marker —
         # one durable subscriber collapsed every matching publish onto
@@ -752,6 +795,9 @@ class NativeBrokerServer:
             app.rules.on_topology_change.append(self._on_rules_change)
             if self.fast_path:
                 self._sync_rule_taps()
+        # native-mode traces running BEFORE this server existed must
+        # feed the span log from the first sampled publish
+        self._native_traced = self._native_trace_clientids()
 
     # -- fast-path control --------------------------------------------------
 
@@ -769,10 +815,22 @@ class NativeBrokerServer:
     # punted frames.
 
     def _traced_clientids(self) -> set:
+        """Clientids whose traces PUNT their conns (mode="punt", the
+        full-fidelity fallback). mode="native" traces never punt: their
+        clients stay on the fast path and the trace log receives the
+        sampled span timelines instead (_on_spans)."""
         if self.app is None:
             return set()
         return {t.filter_value for t in self.app.trace.running()
-                if t.filter_type == "clientid"}
+                if t.filter_type == "clientid"
+                and getattr(t, "mode", "punt") != "native"}
+
+    def _native_trace_clientids(self) -> set:
+        if self.app is None:
+            return set()
+        return {t.filter_value for t in self.app.trace.running()
+                if t.filter_type == "clientid"
+                and getattr(t, "mode", "punt") == "native"}
 
     def _sync_traces(self) -> None:
         """Reconcile the C++ per-conn trace flags with the running
@@ -793,6 +851,9 @@ class NativeBrokerServer:
 
     def _on_trace_change(self) -> None:
         self._sync_traces()
+        # refresh the native-mode set the span fold consults (a plain
+        # replace: reads are GIL-atomic snapshots)
+        self._native_traced = self._native_trace_clientids()
         self.flush_permits()
 
     def _sync_rule_taps(self) -> None:
@@ -1584,7 +1645,8 @@ class NativeBrokerServer:
         # the plane wedged for >30s draining them)
         consumed: dict[str, list] = {}
         dead: dict[int, list] = {}
-        for i, (origin, flags, toks, topic, body) in enumerate(entries):
+        for i, (origin, flags, toks, topic, body,
+                _trace) in enumerate(entries):
             guid = base + i
             sids, seen = [], set()
             for tok in toks:
@@ -1682,8 +1744,16 @@ class NativeBrokerServer:
         rows = store.fetch(tok)
         pers = self.app.persistent
         out, guids = [], []
-        for guid, origin, ts, qos, dup, topic, body in rows:
+        for guid, origin, ts, qos, dup, topic, body, trace in rows:
             guids.append(guid)
+            if trace:
+                # the persisted trace id re-joins its timeline: the
+                # replay span marks resume delivery of a sampled
+                # publish (poll-thread context, CLOCK_MONOTONIC like
+                # the C++ spans)
+                self.spans.record(trace, "replay",
+                                  time.monotonic_ns(), aux=guid,
+                                  node=self.broker.node)
             # the sub_topic header names the MATCHED FILTER: without it
             # a wildcard subscription's replay would miss the session's
             # SubOpts lookup and be dropped as 'late delivery' AFTER
@@ -1902,8 +1972,12 @@ class NativeBrokerServer:
             # the grant loop precomputes msg_events once per cycle)
             return True
         if any(t.matches(ch.clientid, topic, str(ch.conninfo.peername))
-                for t in app.trace.running()):   # locked snapshot
+                for t in app.trace.running()    # locked snapshot
+                if getattr(t, "mode", "punt") != "native"):
             return True                 # traced topics stay observable
+            # (native-mode traces deliberately do NOT veto the permit:
+            # they observe via the sampled span plane, keeping the
+            # traced workload on the fast path)
         if any(T.match(topic, f) for f in app.topic_metrics.topics()):
             return True
         rw = getattr(app, "rewrite", None)
@@ -2013,6 +2087,9 @@ class NativeBrokerServer:
                 self._on_ack_batch(payload)
             elif kind == native.EV_TELEMETRY:
                 self._on_telemetry(payload, conn_id)
+            elif kind == native.EV_SPANS:
+                # the id slot carries the producing shard (like 7/8/10)
+                self._on_spans(payload, conn_id)
             elif kind == native.EV_TRUNK:
                 self._on_trunk_event(conn_id, payload)
             elif kind == native.EV_DURABLE:
@@ -2355,6 +2432,80 @@ class NativeBrokerServer:
                     log.debug("flight recorder dump (%s) for %s: %s",
                               why, info[0], detail)
 
+    def _on_spans(self, payload: bytes, shard: int = 0) -> None:
+        """Fold ONE batched kind-12 trace record: span points into the
+        SpanCollector (+ the trace log for native-mode clientid traces
+        + prometheus exemplars), ledger entries into the degradation
+        ledger (fixed messages.ledger.* slots + the bounded event
+        ring). Cycle-rate and sampled — runs on the poll thread under
+        _tele_lock (N producers when sharded)."""
+        stages = native.SPAN_STAGES
+        reasons = native.LEDGER_REASONS
+        node = self.broker.node
+        with self._tele_lock:
+            for rec in native.parse_spans(payload):
+                if rec[0] == "span":
+                    _, tid, stage_i, t_ns, aux = rec
+                    stage = (stages[stage_i] if stage_i < len(stages)
+                             else f"stage{stage_i}")
+                    self.spans.record(tid, stage, t_ns, shard=shard,
+                                      aux=aux, node=node)
+                    if stage == "ingress" and self._native_traced:
+                        info = self._conninfo_for(aux)
+                        if (info is not None
+                                and info[0] in self._native_traced):
+                            self._trace_log_ids[tid] = info[0]
+                            while len(self._trace_log_ids) > 256:
+                                self._trace_log_ids.popitem(last=False)
+                    cid = self._trace_log_ids.get(tid)
+                    if cid is not None and self.app is not None:
+                        self.app.trace.log_for_client(
+                            cid, "SPAN",
+                            f"trace={tid:016x} {stage} shard={shard} "
+                            f"aux={aux} t_ns={t_ns}")
+                    # exemplars: hang the trace id off the stage
+                    # histograms its timeline measures
+                    if stage == "route":
+                        self._exemplar(tid, "ingress", t_ns,
+                                       "ingress_route")
+                    elif stage == "ack":
+                        # ack aux carries the delivery qos in bits
+                        # 60-61 (host.cc TeleAckRtt) so a qos2
+                        # exchange's exemplar lands on qos2_rtt
+                        qos = (aux >> 60) & 3
+                        self._exemplar(tid, "deliver_write", t_ns,
+                                       "qos2_rtt" if qos == 2
+                                       else "qos1_rtt")
+                else:
+                    _, reason_i, count, tid, aux, _t_ns = rec
+                    name = (reasons[reason_i - 1]
+                            if 1 <= reason_i <= len(reasons)
+                            else f"reason{reason_i}")
+                    self.ledger.record(name, count, shard=shard,
+                                       trace_id=tid, aux=aux)
+
+    def _exemplar(self, tid: int, from_stage: str, t_ns: int,
+                  hist: str) -> None:
+        """Attach ``t_ns - t(from_stage)`` of trace ``tid`` as an
+        OpenMetrics exemplar on ``hist`` (caller holds _tele_lock)."""
+        for t0, stage, _sh, _n, _aux in self.spans.trace(tid):
+            if stage == from_stage:
+                if t_ns > t0:
+                    self._hists[hist].put_exemplar(tid, t_ns - t0)
+                return
+
+    def spans_recent(self, limit: int = 32) -> list[dict]:
+        """Assembled recent traces, JSON-shaped (the mgmt surface)."""
+        out = []
+        for tid, spans in self.spans.recent(limit):
+            out.append({
+                "trace_id": f"{tid:016x}",
+                "spans": [{"t_ns": t, "stage": s, "shard": sh,
+                           "node": n, "aux": a}
+                          for t, s, sh, n, a in spans],
+            })
+        return out
+
     def latency_summary(self) -> dict[str, dict]:
         """Broker-side stage percentiles (p50/p99/p999 in µs + counts)
         for every stage with observations — the bench.py artifact
@@ -2468,7 +2619,10 @@ class NativeBrokerServer:
                 # store fell back to anonymous segments — qos1 PUBACKs
                 # keep flowing but restart survival is GONE for the
                 # degraded stretch; say so loudly, once per incident
+                delta = degraded - self._store_degraded_seen
                 self._store_degraded_seen = degraded
+                self.ledger.record("store_degraded", delta,
+                                   detail=self._durable_store.dir)
                 log.error(
                     "durable store degraded to in-memory segments "
                     "(%d incidents): acked messages in this stretch "
@@ -2682,6 +2836,12 @@ class NativeBrokerServer:
         if (self.app is not None
                 and self.app.native_stats_fn == self.fast_stats):
             self.app.native_stats_fn = None
+        if (self.app is not None
+                and self.app.native_spans_fn == self.spans_recent):
+            self.app.native_spans_fn = None
+        if (self.app is not None
+                and self.app.native_shard_stats_fn == self.shard_stats):
+            self.app.native_shard_stats_fn = None
         if self.app is not None and hasattr(self.app.rules,
                                             "on_topology_change"):
             try:

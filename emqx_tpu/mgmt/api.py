@@ -235,6 +235,10 @@ class ManagementApi:
         r("DELETE", "/api/v5/trace/{name}", self.h_trace_delete)
         r("PUT", "/api/v5/trace/{name}/stop", self.h_trace_stop)
         r("GET", "/api/v5/trace/{name}/log", self.h_trace_log)
+        # native distributed tracing (round 13): the queryable last-N
+        # span ring + the degradation ledger's event ring/totals
+        r("GET", "/api/v5/tracing/spans", self.h_tracing_spans)
+        r("GET", "/api/v5/tracing/ledger", self.h_tracing_ledger)
         r("GET", "/api/v5/slow_subscriptions", self.h_slow_subs)
         r("DELETE", "/api/v5/slow_subscriptions", self.h_slow_subs_clear)
         r("GET", "/api/v5/mqtt/topic_metrics", self.h_topic_metrics)
@@ -318,7 +322,11 @@ class ManagementApi:
         return self.app.stats.all()
 
     def h_prometheus(self, query, body):
-        return 200, self.app.prometheus()        # text passthrough
+        # ?format=openmetrics opts into trace-id exemplars (illegal in
+        # the default text 0.0.4 exposition — a classic parser would
+        # fail the whole scrape on them)
+        om = query.get("format") == "openmetrics"
+        return 200, self.app.prometheus(openmetrics=om)
 
     def h_alarms(self, query, body):
         which = ("activated" if query.get("activated") in ("true", "1")
@@ -549,10 +557,41 @@ class ManagementApi:
             self.app.trace.start(
                 body["name"], body.get("type", "clientid"),
                 body.get(body.get("type", "clientid"), body.get("value", "")),
-                duration_s=body.get("duration"))
+                duration_s=body.get("duration"),
+                # "punt" (default) = full-fidelity slow-path capture;
+                # "native" = stay on the fast path, log sampled span
+                # timelines instead (the production-safe mode)
+                mode=body.get("mode", "punt"))
         except (KeyError, ValueError) as e:
             raise ApiError(400, "BAD_REQUEST", str(e)) from None
         return 201, {"name": body["name"]}
+
+    def h_tracing_spans(self, query, body):
+        """Recent assembled span timelines from the native tracing
+        plane (empty when no native server is attached)."""
+        fn = getattr(self.app, "native_spans_fn", None)
+        if fn is None:
+            return []
+        try:
+            limit = int(query.get("limit", 32))
+        except (TypeError, ValueError):
+            limit = 32
+        return fn(max(1, limit))   # a negative slice would invert
+        #                            the newest-N semantics
+
+    def h_tracing_ledger(self, query, body):
+        """Degradation-ledger totals + the bounded structured event
+        ring (ring-full punts, trunk punts, sheds, device failovers,
+        store degradations)."""
+        led = getattr(self.app, "ledger", None)
+        if led is None:
+            return {"totals": {}, "events": []}
+        try:
+            limit = int(query.get("limit", 64))
+        except (TypeError, ValueError):
+            limit = 64
+        return {"totals": led.totals(),
+                "events": led.recent(max(1, limit))}
 
     def h_trace_delete(self, query, body, name):
         if not self.app.trace.delete(name):

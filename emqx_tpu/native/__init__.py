@@ -164,6 +164,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_set_telemetry.restype = ctypes.c_int
     lib.emqx_host_set_telemetry.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64]
+    lib.emqx_host_set_tracing.restype = ctypes.c_int
+    lib.emqx_host_set_tracing.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    lib.emqx_host_set_trunk_wire.restype = ctypes.c_int
+    lib.emqx_host_set_trunk_wire.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.emqx_host_set_inflight_cap.restype = ctypes.c_int
     lib.emqx_host_set_inflight_cap.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
@@ -181,7 +186,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint16,
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
-        ctypes.c_uint32]
+        ctypes.c_uint32, ctypes.c_uint64]
     lib.emqx_store_consume.restype = ctypes.c_long
     lib.emqx_store_consume.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64,
@@ -379,13 +384,15 @@ EV_TELEMETRY = 8
 EV_TRUNK = 9
 EV_DURABLE = 10     # batched durable-store record (round 10)
 EV_HANDOFF = 11     # live plane demotion: AckState -> Python session
+EV_SPANS = 12       # distributed-tracing spans + ledger (round 13)
 
 
 def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
     """Decode one kind-10 durable record into ``(base_guid, ts_ms,
-    [(origin_conn, flags, [tokens], topic, payload), ...])`` — entry i's
-    guid is ``base_guid + i``; flags bits1-2 = qos, bit3 = publisher
-    DUP (bit0 = payload-inline is resolved here)."""
+    [(origin_conn, flags, [tokens], topic, payload, trace_id), ...])``
+    — entry i's guid is ``base_guid + i``; flags bits1-2 = qos, bit3 =
+    publisher DUP (bit0 = payload-inline and bit4 = trace-id-present
+    are resolved here; trace_id is 0 for unsampled publishes)."""
     base = int.from_bytes(payload[0:8], "little")
     ts = int.from_bytes(payload[8:16], "little")
     n = int.from_bytes(payload[16:20], "little")
@@ -408,6 +415,12 @@ def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
         pos += 2
         topic = payload[pos:pos + tlen].decode("utf-8", "replace")
         pos += tlen
+        trace = 0
+        if flags & 0x10:
+            if pos + 8 > blen:
+                break
+            trace = int.from_bytes(payload[pos:pos + 8], "little")
+            pos += 8
         if flags & 1:
             if pos + 4 > blen:
                 break
@@ -415,7 +428,7 @@ def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
             pos += 4
             body = payload[pos:pos + plen]
             pos += plen
-        out.append((origin, flags, toks, topic, body))
+        out.append((origin, flags, toks, topic, body, trace))
     return base, ts, out
 
 
@@ -465,7 +478,8 @@ TRUNK_UP, TRUNK_DOWN, TRUNK_PUNT = 1, 2, 3
 def parse_trunk_punts(payload: bytes) -> list[tuple]:
     """Decode one kind-9 sub-3 record (receiver-side trunk punts) into
     ``(origin_conn, qos, dup, topic, payload)`` tuples. Payloads are
-    always inline in punt records (host.cc TrunkPuntAppend)."""
+    always inline in punt records (host.cc TrunkPuntAppend); a trace id
+    (flags bit4) is skipped — the message is leaving the native plane."""
     out: list[tuple] = []
     pos, n = 1, len(payload)
     while pos + 11 <= n:
@@ -475,6 +489,8 @@ def parse_trunk_punts(payload: bytes) -> list[tuple]:
         pos += 11
         topic = payload[pos:pos + tlen].decode("utf-8", "replace")
         pos += tlen
+        if flags & 0x10:
+            pos += 8          # trace_id: Python dispatch is untraced
         if pos + 4 > n:
             break
         plen = int.from_bytes(payload[pos:pos + 4], "little")
@@ -510,9 +526,104 @@ HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
 
 # flight-recorder event codes (host.cc FrEvent)
 FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
-                  5: "deliver", 6: "drop", 7: "ack"}
+                  5: "deliver", 6: "drop", 7: "ack",
+                  # round 13: cross-plane legs on the publisher's
+                  # recorder (the FR used to go blind off-shard)
+                  8: "ring_cross", 9: "trunk"}
 # dump reasons (host.cc FrReason)
 FR_REASON_NAMES = {1: "abnormal_close", 2: "protocol_error", 3: "trace"}
+
+# ---------------------------------------------------------------------------
+# native distributed tracing (host.cc kind-12 records, round 13)
+
+# span stage order (host.cc SpanStage enum — the stats-lint guards the
+# mapping mechanically, like HIST_STAGES)
+SPAN_STAGES = ("ingress", "route", "ring_cross", "trunk_flush",
+               "trunk_recv", "store_append", "replay", "deliver_write",
+               "ack")
+
+# degradation-ledger reasons. The C++ LedgerReason enum is a PREFIX of
+# this tuple (ring_full/trunk_punt/shed fold below the GIL);
+# device_failover and store_degraded are Python-plane decisions folded
+# into the same ledger by broker/native_server.py and broker/broker.py.
+LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "device_failover",
+                  "store_degraded")
+
+
+def parse_spans(payload: bytes) -> list[tuple]:
+    """Decode one kind-12 payload into its sub-records:
+
+    - ``("span", trace_id, stage_idx, t_ns, aux)`` — one point on a
+      sampled publish's timeline (stage indexes SPAN_STAGES);
+    - ``("ledger", reason_idx, count, trace_id, aux, t_ns)`` — one
+      folded degradation-ladder entry (reason 1-indexed into
+      LEDGER_REASONS).
+
+    Sub-records never split across kind-12 chunks (host.cc SpanAppend),
+    so each payload parses independently; the producing shard rides the
+    event record's id slot."""
+    out: list[tuple] = []
+    pos, n = 0, len(payload)
+    while pos < n:
+        sub = payload[pos]
+        pos += 1
+        if sub == 1:
+            if pos + 25 > n:
+                break
+            out.append((
+                "span",
+                int.from_bytes(payload[pos:pos + 8], "little"),
+                payload[pos + 8],
+                int.from_bytes(payload[pos + 9:pos + 17], "little"),
+                int.from_bytes(payload[pos + 17:pos + 25], "little"),
+            ))
+            pos += 25
+        elif sub == 2:
+            if pos + 33 > n:
+                break
+            out.append((
+                "ledger",
+                payload[pos],
+                int.from_bytes(payload[pos + 1:pos + 9], "little"),
+                int.from_bytes(payload[pos + 9:pos + 17], "little"),
+                int.from_bytes(payload[pos + 17:pos + 25], "little"),
+                int.from_bytes(payload[pos + 25:pos + 33], "little"),
+            ))
+            pos += 33
+        else:
+            break  # unknown sub-record kind: length unknowable, stop
+    return out
+
+
+# Declared field widths per event-record kind — what the decoders above
+# (and native_server's folds) actually consume. tests/test_native_wire_
+# lint.py parses the host.cc wire-format comment and asserts the
+# [uNN name] token set per kind matches this table exactly, so a field
+# added or widened on ONE side fails the build (the cross-plane
+# analogue of the StatSlot lint).
+WIRE_FIELDS: dict[int, frozenset] = {
+    6: frozenset({("u64", "publisher"), ("u8", "flags"),
+                  ("u16", "tlen"), ("u32", "plen")}),
+    7: frozenset({("u32", "n"), ("u64", "conn"), ("u32", "acked"),
+                  ("u32", "rel"), ("u32", "inflight_now"),
+                  ("u32", "pending_now")}),
+    8: frozenset({("u8", "stage"), ("u64", "count_d"), ("u64", "sum_d"),
+                  ("u16", "n"), ("u8", "bucket"), ("u32", "delta"),
+                  ("u64", "conn"), ("u8", "reason"), ("u8", "n"),
+                  ("u32", "ts_ms"), ("u8", "event"), ("u8", "ptype"),
+                  ("u16", "arg"), ("u32", "topic_hash"), ("u32", "arg2"),
+                  ("u32", "rtt_us"), ("u8", "qos"), ("u16", "tlen")}),
+    9: frozenset({("u64", "origin"), ("u8", "flags"), ("u16", "tlen"),
+                  ("u64", "trace_id"), ("u32", "plen")}),
+    10: frozenset({("u64", "base_guid"), ("u64", "ts_ms"), ("u32", "n"),
+                   ("u64", "origin"), ("u8", "flags"), ("u16", "ntok"),
+                   ("u64", "token"), ("u16", "tlen"),
+                   ("u64", "trace_id"), ("u32", "plen")}),
+    11: frozenset({("u32", "n_aw"), ("u16", "pid"), ("u32", "n_if"),
+                   ("u8", "state"), ("u32", "n"), ("u32", "len")}),
+    12: frozenset({("u64", "trace_id"), ("u8", "stage"), ("u64", "t_ns"),
+                   ("u64", "aux"), ("u8", "reason"), ("u64", "count")}),
+}
 
 
 def parse_telemetry(payload: bytes) -> list[tuple]:
@@ -772,7 +883,8 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "sn_registers", "sn_sleep_parked", "sn_drops_oversize",
               "retain_set", "retain_del", "retain_deliver",
               "retain_msgs_out",
-              "shard_ring_out", "shard_ring_in", "shard_ring_full")
+              "shard_ring_out", "shard_ring_in", "shard_ring_full",
+              "traced_pubs", "span_batches")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
@@ -863,14 +975,16 @@ class NativeStore:
         return int(self._lib.emqx_store_lookup(self._h, sid.encode()))
 
     def append(self, origin: int, qos: int, tokens: list[int],
-               topic: str, payload: bytes, dup: bool = False) -> int:
-        """Single-message append (test surface); returns the guid."""
+               topic: str, payload: bytes, dup: bool = False,
+               trace: int = 0) -> int:
+        """Single-message append (test surface); returns the guid.
+        ``trace`` persists a sampled trace id with the entry."""
         toks = (ctypes.c_uint64 * max(1, len(tokens)))(*tokens)
         t = topic.encode()
         flags = (qos << 1) | (8 if dup else 0)
         return int(self._lib.emqx_store_append(
             self._h, origin, flags, toks, len(tokens),
-            t, len(t), payload, len(payload)))
+            t, len(t), payload, len(payload), trace))
 
     def consume(self, token: int, guids: list[int]) -> int:
         if not guids:
@@ -881,7 +995,9 @@ class NativeStore:
 
     def fetch(self, token: int) -> list[tuple]:
         """Pending messages for ``token`` in guid (arrival) order:
-        ``[(guid, origin, ts_ms, qos, dup, topic, payload), ...]``."""
+        ``[(guid, origin, ts_ms, qos, dup, topic, payload, trace_id),
+        ...]`` — trace_id is 0 unless the appending publish was tagged
+        by the native trace sampler."""
         out = ctypes.POINTER(ctypes.c_uint8)()
         out_len = ctypes.c_size_t()
         n = self._lib.emqx_store_fetch(self._h, token,
@@ -899,12 +1015,16 @@ class NativeStore:
             pos += 27
             topic = raw[pos:pos + tlen].decode("utf-8", "replace")
             pos += tlen
+            trace = 0
+            if flags & 0x10:
+                trace = int.from_bytes(raw[pos:pos + 8], "little")
+                pos += 8
             plen = int.from_bytes(raw[pos:pos + 4], "little")
             pos += 4
             body = raw[pos:pos + plen]
             pos += plen
             entries.append((guid, origin, ts, (flags >> 1) & 3,
-                            bool(flags & 8), topic, body))
+                            bool(flags & 8), topic, body, trace))
         return entries
 
     def pending(self, token: int) -> int:
@@ -1118,6 +1238,22 @@ class NativeHost:
         in milliseconds (sampled ack RTTs past it feed slow_subs)."""
         self._lib.emqx_host_set_telemetry(
             self._h, 1 if enabled else 0, int(slow_ack_ms * 1_000_000))
+
+    def set_tracing(self, enabled: bool, shift: int = 6,
+                    seed: int = 0) -> None:
+        """Native distributed tracing (round 13): sample 1-in-2^shift
+        natively-consumed publishes (deterministic global ticker) and
+        tag them with trace ids minted under ``seed`` (the node+shard
+        prefix; 0 keeps the current seed). Gates on the telemetry
+        master switch too."""
+        self._lib.emqx_host_set_tracing(
+            self._h, 1 if enabled else 0, int(shift), int(seed))
+
+    def set_trunk_wire(self, version: int) -> None:
+        """Cap the trunk wire version this host advertises/accepts —
+        tests set 0 to simulate an old peer (trace ids are then
+        stripped from outgoing trunk entries, losslessly)."""
+        self._lib.emqx_host_set_trunk_wire(self._h, int(version))
 
     # -- durable-session plane (round 10) ----------------------------------
 

@@ -77,12 +77,16 @@
 //                   [u8 3] receiver-side punts: trunk entries whose
 //                     local match set contains punt markers (or shared
 //                     groups) — Python runs the local dispatch for
-//                     them; entries in the pre-parse layout with
-//                     payloads always inline (conn_id = 0)
+//                     them; entries in the pre-parse layout
+//                     ([u64 origin][u8 flags][u16 tlen][topic] +
+//                     (flags bit4 ? [u64 trace_id]) + [u32 plen]
+//                     [payload]) with payloads always inline
+//                     (conn_id = 0)
 //   kind 10 = DURABLE  payload = one batched durable-store record per
 //                   flush (round 10): [u64 base_guid][u64 ts_ms][u32 n]
 //                   + n x pre-parsed entries ([u64 origin][u8 flags]
 //                   [u16 ntok][u64 token x ntok][u16 tlen][topic] +
+//                   (flags bit4 ? [u64 trace_id]) +
 //                   (flags bit0 ? [u32 plen][payload] : payload of the
 //                   PREVIOUS entry)) — the EXACT bytes appended to the
 //                   store (store.h kRecMsgBatch body), so the store
@@ -94,7 +98,7 @@
 //   kind 11 = HANDOFF  live plane demotion (kDisableFast): the conn's
 //                   AckState hands to the Python session instead of
 //                   evaporating. conn_id = conn; payload[0] = sub-kind:
-//                   [u8 1] window state: [u32 n_aw] + n x u16 pid
+//                   [u8 1] window state: [u32 n_aw] + n x [u16 pid]
 //                     (publisher awaiting-rel ids we owned) +
 //                     [u32 n_if] + n x ([u16 pid][u8 state]) state
 //                     bit0 = qos2, bit1 = rel phase (PUBREL sent,
@@ -121,6 +125,29 @@
 //                     [u8 qos][u16 tlen][topic] — a sampled native
 //                     QoS1/2 delivery whose ack RTT crossed the
 //                     slow-ack threshold (feeds services/slow_subs.py)
+//   kind 12 = TRACE  native distributed-tracing plane (round 13):
+//                   payload = concatenated sub-records, chunked at the
+//                   tap bound (sub-records never split); the record id
+//                   slot carries the PRODUCING SHARD like kinds 7/8/10:
+//                   [u8 1] span: [u64 trace_id][u8 stage][u64 t_ns]
+//                     [u64 aux] — one point on a sampled publish's
+//                     timeline. stage indexes the SpanStage enum
+//                     (native/__init__.py SPAN_STAGES); t_ns is
+//                     CLOCK_MONOTONIC; aux is stage-specific (ingress =
+//                     publisher conn, route = match-set size,
+//                     ring_cross = source shard, trunk_flush = peer id,
+//                     store_append = durable-token count, deliver_write
+//                     = subscriber conn, ack = subscriber conn with the
+//                     delivery qos in bits 60-61, replay = guid).
+//                   [u8 2] ledger: [u64 count][u64 trace_id][u64 aux]
+//                     [u64 t_ns] preceded by [u8 reason] — ONE entry
+//                     per degradation reason per poll cycle: count
+//                     folds every ladder decision of that cycle
+//                     (ring-full→punt, trunk→punt, kHighWater shed),
+//                     trace_id is the last sampled publish that hit it
+//                     (0 = none sampled), aux the last deciding
+//                     peer/shard/conn. Reasons index the LedgerReason
+//                     enum (native/__init__.py LEDGER_REASONS prefix).
 //
 // WebSocket (round 7): a second listener serves MQTT-over-WebSocket
 // (RFC6455, ws.h) on the SAME data plane: the upgrade handshake and
@@ -253,6 +280,11 @@ enum FrEvent : uint8_t {
   kFrDeliver,    // fast-path delivery written; hash = topic hash
   kFrDrop,       // delivery dropped (backpressure / mqueue overflow)
   kFrAck,        // subscriber ack consumed natively; arg = pid
+  // round 13: the recorder used to go blind the moment a publish left
+  // its shard — these note the cross-plane legs on the PUBLISHER's
+  // recorder so an operator's FR dump shows where the message went
+  kFrRingCross,  // publish shipped to other shards; arg = shard count
+  kFrTrunk,      // publish enqueued onto a trunk; arg = first peer id
 };
 
 // Dump reasons (kind-8 sub-record 2 header).
@@ -299,7 +331,57 @@ struct RttSample {
   std::string topic;
   uint16_t pid;
   uint8_t qos;
+  uint64_t trace = 0;  // sampled trace id: PUBACK closes the ack span
 };
+
+// ---------------------------------------------------------------------------
+// Native distributed tracing (round 13): a deterministic 1-in-N
+// publish sampler tags fast-path publishes with a 64-bit trace id that
+// propagates through every native seam (cross-shard ring entries,
+// trunk BATCH records on wire-v1 links, durable MSG-BATCH records)
+// while the message stays on the fast path; each plane emits compact
+// kind-12 span events a Python collector stitches into per-message
+// timelines. Everything below is poll-thread-owned plain memory — the
+// telemetry-plane discipline.
+
+// Span stages (keep in sync with native/__init__.py SPAN_STAGES —
+// tests/test_stats_lint.py parses this enum). kSpanReplay is emitted
+// by PYTHON (the resume drain reads the persisted id back from the
+// store), so it has no C++ emission site.
+enum SpanStage : uint8_t {
+  kSpanIngress = 0,   // sampled publish accepted natively; aux = conn
+  kSpanRoute,         // native fan-out complete; aux = match-set size
+  kSpanRingCross,     // consumer shard applied the ring entry; aux = src
+  kSpanTrunkFlush,    // entry enqueued onto a trunk batch; aux = peer
+  kSpanTrunkRecv,     // receiver fanned the trunk entry out natively
+  kSpanStoreAppend,   // publish joined the durable batch; aux = n toks
+  kSpanReplay,        // Python: resume replay re-joined the trace
+  kSpanDeliverWrite,  // delivery written to a subscriber; aux = conn
+  kSpanAck,           // subscriber PUBACK/PUBCOMP closed the delivery
+  kSpanCount
+};
+
+// Degradation-ledger reasons (a PREFIX of native/__init__.py
+// LEDGER_REASONS — device_failover and store_degraded are Python-plane
+// decisions folded into the same ledger there).
+enum LedgerReason : uint8_t {
+  kLrRingFull = 1,   // cross-shard ring full: publish degraded to punt
+  kLrTrunkPunt,      // trunk down/ineligible: publish degraded to punt
+  kLrShed,           // kHighWater backpressure shed (conn or trunk)
+  kLrCount
+};
+
+// deliver_write spans per sampled publish are capped: a megafan-out
+// must not turn one sampled message into a span flood
+constexpr uint8_t kTraceMaxDeliverSpans = 8;
+// Sampled publishes per POLL CYCLE are capped too (the tick still
+// advances, so the 1-in-N ratio stays deterministic; the cap only
+// clips extra picks within one cycle). Under blast a cycle drains
+// thousands of publishes — 1-in-64 of 1M msg/s would be ~15k traces/s,
+// and the Python-side span fold runs on the poll thread's GIL stints,
+// which is exactly the plane-stall the telemetry rounds fought.
+// Interactive traffic (a cycle per publish) never hits the cap.
+constexpr uint32_t kTraceMaxPerCycle = 2;
 
 // elevated-qos mqueue bound per subscriber (emqx_mqueue default
 // max_len 1000); overflow drops the NEW message (kStDropsInflight)
@@ -514,7 +596,7 @@ struct Op {
     kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
     kDurableAdd, kDurableDel,
     kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift,
-    kTrunkPeerState
+    kTrunkPeerState, kSetTracing, kSetTrunkWire
   };
   Kind kind;
   uint64_t owner = 0;
@@ -586,6 +668,8 @@ enum StatSlot {
   kStShardRingOut,     // deliveries shipped to another shard's ring
   kStShardRingIn,      // ring entries applied from other shards
   kStShardRingFull,    // publishes degraded ring-full -> punt -> Python
+  kStTracedPubs,       // publishes tagged by the 1-in-N trace sampler
+  kStSpanBatches,      // batched kind-12 trace records emitted
   kStatCount
 };
 
@@ -905,6 +989,7 @@ class Host {
   // timeout with no events).
   long Poll(uint8_t* buf, size_t cap, int timeout_ms) {
     poll_thread_.store(pthread_self(), std::memory_order_release);
+    trace_cyc_used_ = 0;  // the per-cycle sampler budget (TraceSample)
     if (telemetry_) {
       fr_now_ms_ = NowMs();  // one stamp per cycle for every FrNote
       if (poll_exit_ns_) {
@@ -943,6 +1028,10 @@ class Host {
         FlushHistDeltas();
       }
       FlushTelemetry();
+      // span events are rare (1-in-N sampled) and timelines stitch
+      // best fresh: flush every cycle, no 100ms cadence; the same
+      // record carries this cycle's folded ledger entries
+      FlushSpans();
     }
     size_t written = 0;
     while (!events_.empty()) {
@@ -1213,6 +1302,23 @@ class Host {
         // Python: the TrunkEligible oracle for ring-forwarded legs
         trunk_peer_up_[op.owner] = op.flags != 0;
         break;
+      case Op::kSetTracing:
+        // the deterministic 1-in-2^shift publish sampler; seed carries
+        // the node/shard prefix Python composed (nonzero — trace id 0
+        // means "not sampled" everywhere)
+        tracing_ = op.flags != 0;
+        trace_mask_ = op.max_inflight <= 16
+                          ? (1u << op.max_inflight) - 1
+                          : 63u;
+        if (op.token) trace_seed_ = op.token;
+        break;
+      case Op::kSetTrunkWire:
+        // cap the advertised/accepted trunk wire version (tests dial
+        // this to 0 to exercise the old-peer downshift)
+        trunk_wire_max_ = op.qos <= trunk::kWireVersion
+                              ? op.qos
+                              : trunk::kWireVersion;
+        break;
     }
   }
 
@@ -1351,6 +1457,7 @@ class Host {
     frame_q_v4_.clear();
     frame_q_v5_.clear();
     dur_tok_scratch_.clear();
+    fan_xshipped_ = 0;
     for (const SubEntry* e : match_scratch_) {
       // rule taps never deliver; remote entries forward via the trunk
       // (TryFast enqueues them) or punt — never through a local write;
@@ -1391,11 +1498,13 @@ class Host {
         if (RingRoom(ds)) {
           XShipMulti(ds, xtgt_scratch_[ds], publisher, qos, topic,
                      payload);
+          fan_xshipped_++;
         } else {
           stats_[kStShardRingFull].fetch_add(1,
                                              std::memory_order_relaxed);
           stats_[kStDropsBackpressure].fetch_add(
               xtgt_scratch_[ds].size(), std::memory_order_relaxed);
+          LedgerNote(kLrRingFull, static_cast<uint64_t>(ds));
         }
         xtgt_scratch_[ds].clear();
       }
@@ -1430,10 +1539,12 @@ class Host {
           if (RingRoom(ds)) {
             uint8_t oq = qos < e.qos ? qos : e.qos;
             XShip(ds, e.owner, publisher, oq, false, topic, payload);
+            fan_xshipped_++;
             delivered = true;
           } else {
             stats_[kStShardRingFull].fetch_add(1,
                                                std::memory_order_relaxed);
+            LedgerNote(kLrRingFull, static_cast<uint64_t>(ds));
           }
           continue;
         }
@@ -1532,6 +1643,7 @@ class Host {
         uint64_t peer = pe->owner - kTrunkOwnerBase;
         if (!TrunkEligible(peer, le.qos,
                            15 + topic.size() + payload.size())) {
+          LedgerNote(kLrTrunkPunt, peer);
           lane_punt = true;
           break;
         }
@@ -1572,12 +1684,16 @@ class Host {
         continue;
       }
       bool ldup = (static_cast<uint8_t>(le.frame[0]) & 0x08) != 0;
+      // lane deliveries are native-consumed publishes too: same
+      // sampling commit point as the walk path (shared ticker)
+      TraceSample(le.publisher);
       if (tapped) EmitTap(le.publisher, le.qos, ldup, topic, payload);
       stats_[kStLaneOut].fetch_add(1, std::memory_order_relaxed);
       if (le.qos == 1)
         stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
       cur_dup_ = ldup;
       FanOut(le.publisher, le.qos, le.pid, topic, payload);
+      if (cur_trace_) SpanNote(kSpanRoute, match_scratch_.size());
       // the remote legs collected above (lane+trunk coexistence): the
       // trunk enqueue next to the device-matched local fan-out — the
       // TryFast walk path's two-halves discipline
@@ -1587,6 +1703,19 @@ class Host {
         else
           XShip(0, kTrunkOwnerBase + peer, le.publisher, le.qos, ldup,
                 topic, payload);
+      }
+      cur_trace_ = 0;  // this frame's trace context ends here
+      if (telemetry_ && (fan_xshipped_ || !trunk_scratch_.empty())) {
+        auto pit = conns_.find(le.publisher);
+        if (pit != conns_.end()) {
+          if (fan_xshipped_)
+            FrNote(pit->second, kFrRingCross, 3,
+                   static_cast<uint16_t>(fan_xshipped_), cur_hash_);
+          if (!trunk_scratch_.empty())
+            FrNote(pit->second, kFrTrunk, 3,
+                   static_cast<uint16_t>(trunk_scratch_[0] & 0xFFFF),
+                   cur_hash_);
+        }
       }
     }
     FlushDirty();
@@ -1878,6 +2007,10 @@ class Host {
   // Returns true when the frame was fully handled natively (consumed);
   // false forwards it to Python (the slow path), which is always safe.
   bool TryFast(uint64_t id, Conn& c, const std::string& f) {
+    // per-frame trace context: an ack frame's DrainPending (and any
+    // other delivery this frame triggers) must not inherit the LAST
+    // publish's sampled id
+    cur_trace_ = 0;
     uint8_t h = static_cast<uint8_t>(f[0]);
     uint8_t type = h >> 4;
     if (type == 4) return TryFastPuback(id, c, f);
@@ -2007,9 +2140,14 @@ class Host {
           // the remote leg next to the device-matched local fan-out.
           // Anything else in the punt trie (real punt markers, a down
           // trunk, qos2) still punts like before.
-          if (!(pe->flags & kSubRemote) ||
-              !TrunkEligible(pe->owner - kTrunkOwnerBase, qos,
+          if (!(pe->flags & kSubRemote)) {
+            must_punt = true;
+            break;
+          }
+          uint64_t peer = pe->owner - kTrunkOwnerBase;
+          if (!TrunkEligible(peer, qos,
                              15 + topic.size() + payload.size())) {
+            LedgerNote(kLrTrunkPunt, peer);
             must_punt = true;
             break;
           }
@@ -2084,6 +2222,7 @@ class Host {
         if (!TrunkEligible(peer, qos,
                            15 + topic.size() + payload.size())) {
           stats_[kStPunts].fetch_add(1, std::memory_order_relaxed);
+          LedgerNote(kLrTrunkPunt, peer);
           return false;
         }
         PushUnique(&trunk_scratch_, peer);
@@ -2112,9 +2251,13 @@ class Host {
     } else if (qos == 1) {
       stats_[kStQos1In].fetch_add(1, std::memory_order_relaxed);
     }
+    // the sampling commit point: every punt decision is behind us, so
+    // the tick counts exactly the natively-consumed publishes
+    TraceSample(id);
     if (tapped) EmitTap(id, qos, (h & 0x08) != 0, topic, payload);
     cur_dup_ = (h & 0x08) != 0;  // durable entries keep the DUP bit
     FanOut(id, qos, pid, topic, payload);
+    if (cur_trace_) SpanNote(kSpanRoute, match_scratch_.size());
     // remote legs last: the local fan-out above and the trunk enqueue
     // below are the two halves of emqx_broker:publish's route loop.
     // Non-trunk shards ship the leg to shard 0 over the ring (target =
@@ -2128,6 +2271,15 @@ class Host {
     }
     if (telemetry_) {
       FrNote(c, kFrFastPub, 3, qos, cur_hash_);
+      // cross-plane legs on the publisher's recorder (round 13): the
+      // FR used to go blind once a publish left its shard
+      if (fan_xshipped_)
+        FrNote(c, kFrRingCross, 3,
+               static_cast<uint16_t>(fan_xshipped_), cur_hash_);
+      if (!trunk_scratch_.empty())
+        FrNote(c, kFrTrunk, 3,
+               static_cast<uint16_t>(trunk_scratch_[0] & 0xFFFF),
+               cur_hash_);
       if (t_in) {
         uint64_t t1 = NowNs();
         RecordHist(kHistIngressRoute, t1 - t_in);
@@ -2228,6 +2380,7 @@ class Host {
     Conn& t = it->second;
     if (t.outbuf.size() - t.outpos > kHighWater) {
       stats_[kStDropsBackpressure].fetch_add(1, std::memory_order_relaxed);
+      LedgerNote(kLrShed, owner);
       if (telemetry_) FrNote(t, kFrDrop, 3, 0, cur_hash_);
       return false;
     }
@@ -2243,6 +2396,7 @@ class Host {
         if (r == 0) return false;
         if (r == 2) return true;  // parked; kStFastOut counts at dequeue
       }
+      TraceDeliverNote(owner);
       stats_[kStFastOut].fetch_add(1, std::memory_order_relaxed);
       MarkDirty(owner, t);
       return true;
@@ -2255,6 +2409,7 @@ class Host {
       stats_[kStFastBytesOut].fetch_add(shared.size(),
                                         std::memory_order_relaxed);
       if (telemetry_) FrNote(t, kFrDeliver, 3, 0, cur_hash_);
+      TraceDeliverNote(owner);
     } else {
       AckState& a = EnsureAck(t);
       std::string& sq = t.proto_ver == 5 ? frame_q_v5_ : frame_q_v4_;
@@ -2285,10 +2440,14 @@ class Host {
       if (out_qos == 2) BitSet(a.infl_qos2, tp - kNativePidBase);
       if (telemetry_) {
         // ack-RTT sample (delivery write -> PUBACK/PUBCOMP): stamped
-        // only while a slot is free, closed out in TeleAckRtt
+        // only while a slot is free, closed out in TeleAckRtt — it
+        // also carries the active trace id so the ack span can close
+        // the sampled publish's timeline
         if (a.rtt.size() < kRttSamples)
-          a.rtt.push_back({NowNs(), std::string(topic), tp, out_qos});
+          a.rtt.push_back({NowNs(), std::string(topic), tp, out_qos,
+                           cur_trace_});
         FrNote(t, kFrDeliver, 3, tp, cur_hash_);
+        TraceDeliverNote(owner);
       }
       if (t.ws)  // frame header first so `at` lands on the MQTT bytes
         ws::AppendFrameHeader(&t.outbuf, ws::kOpBinary, sq.size());
@@ -2521,6 +2680,7 @@ class Host {
   void DurableAppend(uint64_t publisher, uint8_t qos,
                      std::string_view topic, std::string_view payload) {
     stats_[kStDurableIn].fetch_add(1, std::memory_order_relaxed);
+    if (cur_trace_) SpanNote(kSpanStoreAppend, dur_tok_scratch_.size());
     for (size_t g = 0; g < dur_tok_scratch_.size();
          g += kDurMaxToksPerEntry)
       DurableAppendEntry(
@@ -2533,7 +2693,7 @@ class Host {
                           size_t tok_begin, size_t tok_end) {
     size_t cap = TeleCap();
     size_t ntok = tok_end - tok_begin;
-    size_t entry_max = 11 + 8 * ntok + 2 + topic.size() + 4
+    size_t entry_max = 19 + 8 * ntok + 2 + topic.size() + 4
                        + payload.size();
     // 33 = 13-byte event-record header slot + 20-byte batch header
     // ([base_guid][ts][n]); both patched at flush (EmitTap's
@@ -2545,7 +2705,8 @@ class Host {
     char hdr[11];
     memcpy(hdr, &publisher, 8);
     hdr[8] = static_cast<char>((dup_pl ? 0 : 1) | (qos << 1)
-                               | (cur_dup_ ? 8 : 0));
+                               | (cur_dup_ ? 8 : 0)
+                               | (cur_trace_ ? 0x10 : 0));
     uint16_t nt = static_cast<uint16_t>(ntok);
     memcpy(hdr + 9, &nt, 2);
     dur_buf_.append(hdr, 11);
@@ -2556,6 +2717,10 @@ class Host {
     uint16_t tl = static_cast<uint16_t>(topic.size());
     dur_buf_.append(reinterpret_cast<const char*>(&tl), 2);
     dur_buf_.append(topic.data(), topic.size());
+    // flags bit4 (round 13): the sampled trace id persists with the
+    // message so a resume replay can re-join its timeline
+    if (cur_trace_)
+      dur_buf_.append(reinterpret_cast<const char*>(&cur_trace_), 8);
     if (!dup_pl) {
       uint32_t pl = static_cast<uint32_t>(payload.size());
       dur_buf_.append(reinterpret_cast<const char*>(&pl), 4);
@@ -2774,6 +2939,15 @@ class Host {
     p.up = true;
     auto sit = trunk_socks_.find(p.sock_tag);
     if (sit != trunk_socks_.end()) {
+      // HELLO first (round 13): advertise our wire version before any
+      // batch. The peer's answer (TrunkRead) raises p.wire_ver; until
+      // then — and forever against an old peer that ignores unknown
+      // record types — entries go out v0 with trace ids stripped.
+      if (trunk_wire_max_ >= 1) {
+        char hv = static_cast<char>(trunk_wire_max_);
+        trunk::AppendRecord(&sit->second.outbuf, trunk::kRecHello,
+                            &hv, 1);
+      }
       for (const trunk::Unacked& u : p.unacked) {
         if (u.q1_record.empty()) continue;
         sit->second.outbuf += u.q1_record;
@@ -2840,6 +3014,9 @@ class Host {
     if (pit != trunk_peers_.end() && pit->second.sock_tag == tag) {
       pit->second.sock_tag = 0;
       pit->second.up = false;
+      // per-LINK negotiation: the next connect re-runs HELLO (the
+      // replacement peer may be an older build)
+      pit->second.wire_ver = 0;
       // remote entries now behave as punt markers (TryFast reads
       // p.up); the unacked ring is KEPT for the reconnect replay.
       // Python sees DOWN (kind 9 sub 2) and drives the redial.
@@ -2890,6 +3067,20 @@ class Host {
         uint64_t seq = 0;
         memcpy(&seq, body, 8);
         TrunkApplyAck(s.peer_id, seq);
+      } else if (type == trunk::kRecHello && blen >= 1) {
+        uint8_t theirs = static_cast<uint8_t>(body[0]);
+        if (s.dialer) {
+          // the peer's answer: the link speaks min(ours, theirs)
+          auto pit = trunk_peers_.find(s.peer_id);
+          if (pit != trunk_peers_.end() && pit->second.sock_tag == tag)
+            pit->second.wire_ver =
+                theirs < trunk_wire_max_ ? theirs : trunk_wire_max_;
+        } else if (trunk_wire_max_ >= 1) {
+          // receiver side: answer with our version (an old dialer
+          // never sends HELLO, so this branch never fires against one)
+          char hv = static_cast<char>(trunk_wire_max_);
+          trunk::AppendRecord(&s.outbuf, trunk::kRecHello, &hv, 1);
+        }
       }
       pos += 4 + len;
     }
@@ -2926,6 +3117,12 @@ class Host {
       if (pos + tlen > blen) break;
       std::string_view topic(body + pos, tlen);
       pos += tlen;
+      uint64_t trace = 0;
+      if (flags & 0x10) {  // wire-v1 trace extension (negotiated)
+        if (pos + 8 > blen) break;
+        memcpy(&trace, body + pos, 8);
+        pos += 8;
+      }
       std::string_view payload;
       if (flags & 1) {
         if (pos + 4 > blen) break;
@@ -2942,8 +3139,9 @@ class Host {
         payload = prev_payload;
       }
       TrunkFanOut(origin, (flags >> 1) & 3, (flags & 8) != 0, topic,
-                  payload);
+                  payload, trace);
     }
+    cur_trace_ = 0;  // batch context over
     // ack AFTER fan-out: the sender's ring holds the qos1 copy until
     // every local delivery for this batch has been written
     char ab[8];
@@ -2952,7 +3150,8 @@ class Host {
   }
 
   void TrunkFanOut(uint64_t origin, uint8_t qos, bool dup,
-                   std::string_view topic, std::string_view payload) {
+                   std::string_view topic, std::string_view payload,
+                   uint64_t trace = 0) {
     stats_[kStTrunkIn].fetch_add(1, std::memory_order_relaxed);
     match_scratch_.clear();
     groups_scratch_.clear();
@@ -2966,11 +3165,18 @@ class Host {
         }
     if (punt) {
       stats_[kStTrunkPunts].fetch_add(1, std::memory_order_relaxed);
-      TrunkPuntAppend(origin, qos, dup, topic, payload);
+      TrunkPuntAppend(origin, qos, dup, topic, payload, trace);
       return;
     }
     if (telemetry_) cur_hash_ = TopicHash(topic);
     cur_dup_ = dup;
+    // re-join the sampled publish's timeline on the RECEIVING node:
+    // the deliver_write spans below run under the wire-propagated id
+    cur_trace_ = trace;
+    if (trace) {
+      cur_trace_delivers_ = 0;
+      SpanNote(kSpanTrunkRecv, origin);
+    }
     // publisher id 0 can never collide with a local conn (ids start at
     // 1), so no ack is written and no-local can never false-match a
     // local subscriber that happens to share the REMOTE publisher's id
@@ -2981,14 +3187,15 @@ class Host {
   // [u8 3] + entries, payloads always inline — the sender's dedup may
   // reference an entry that was NOT punted).
   void TrunkPuntAppend(uint64_t origin, uint8_t qos, bool dup,
-                       std::string_view topic, std::string_view payload) {
+                       std::string_view topic, std::string_view payload,
+                       uint64_t trace = 0) {
     size_t cap = TeleCap();
-    size_t entry = 15 + topic.size() + payload.size();
+    size_t entry = 23 + topic.size() + payload.size();
     if (!trunk_punt_buf_.empty() && trunk_punt_buf_.size() + entry > cap)
       TrunkPuntFlush();
     if (trunk_punt_buf_.empty()) trunk_punt_buf_.push_back(3);
     trunk::AppendEntry(&trunk_punt_buf_, origin, qos, dup,
-                       /*inline_payload=*/true, topic, payload);
+                       /*inline_payload=*/true, topic, payload, trace);
   }
 
   void TrunkPuntFlush() {
@@ -3010,8 +3217,13 @@ class Host {
     if (it == trunk_peers_.end()) return;
     trunk::Peer& p = it->second;
     bool inline_payload = !(p.have_prev && payload == p.prev_payload);
+    // wire-versioned trace propagation (round 13): the id rides the
+    // entry only on links that negotiated >= v1 — an old peer gets v0
+    // entries with the id STRIPPED (losslessly; topic/payload intact)
+    uint64_t wire_trace = p.wire_ver >= 1 ? cur_trace_ : 0;
     trunk::AppendEntry(&p.batch, origin, qos, dup, inline_payload, topic,
-                       payload);
+                       payload, wire_trace);
+    if (wire_trace) SpanNote(kSpanTrunkFlush, peer_id, wire_trace);
     if (inline_payload) {
       p.prev_payload.assign(payload.data(), payload.size());
       p.have_prev = true;
@@ -3032,14 +3244,14 @@ class Host {
     // past the receiver's record-size bound — which would poison every
     // reconnect with "bad_record" forever
     if (p.batch.size() > cap || p.q1_batch.size() > cap)
-      FlushTrunkPeer(p);
+      FlushTrunkPeer(peer_id, p);
   }
 
   // Seal the batch under construction into one wire record + its ring
   // entry. Writes to the socket only while the link is up; a batch
   // sealed while down loses its qos0 entries (in-flight loss, same as
   // a death mid-send) but its qos1 record replays on reconnect.
-  void FlushTrunkPeer(trunk::Peer& p) {
+  void FlushTrunkPeer(uint64_t peer_id, trunk::Peer& p) {
     if (p.batch_n == 0) return;
     uint64_t seq = p.next_seq++;
     std::string body;
@@ -3075,12 +3287,15 @@ class Host {
                               body.data(), body.size());
         } else if (!u.q1_record.empty()) {
           s.outbuf += u.q1_record;
-          if (p.q0_n)
+          if (p.q0_n) {
             stats_[kStTrunkShed].fetch_add(p.q0_n,
                                            std::memory_order_relaxed);
+            LedgerNote(kLrShed, peer_id);
+          }
         } else {
           stats_[kStTrunkShed].fetch_add(p.batch_n,
                                          std::memory_order_relaxed);
+          LedgerNote(kLrShed, peer_id);
         }
       }
     }
@@ -3114,7 +3329,7 @@ class Host {
     for (uint64_t peer_id : dirty) {
       auto it = trunk_peers_.find(peer_id);
       if (it == trunk_peers_.end()) continue;
-      FlushTrunkPeer(it->second);
+      FlushTrunkPeer(peer_id, it->second);
       if (it->second.up) {
         uint64_t tag = it->second.sock_tag;
         auto sit = trunk_socks_.find(tag);
@@ -3229,6 +3444,7 @@ class Host {
     for (int ds : xdst_scratch_) {
       if (!RingRoom(ds)) {
         stats_[kStShardRingFull].fetch_add(1, std::memory_order_relaxed);
+        LedgerNote(kLrRingFull, static_cast<uint64_t>(ds));
         return false;
       }
     }
@@ -3291,8 +3507,11 @@ class Host {
                     std::string_view payload) {
     bool inline_payload =
         !(xhave_prev_[dst] && payload == xprev_payload_[dst]);
+    // the active trace id rides the ring entry (flags bit4): both ends
+    // are this binary, so no version negotiation — the consumer shard
+    // re-joins the sampled publish's timeline at ring_cross
     trunk::AppendEntry(&b, origin, qos, dup, inline_payload, topic,
-                       payload);
+                       payload, cur_trace_);
     if (inline_payload) {
       xprev_payload_[dst].assign(payload.data(), payload.size());
       xhave_prev_[dst] = true;
@@ -3320,6 +3539,7 @@ class Host {
       stats_[kStShardRingFull].fetch_add(1, std::memory_order_relaxed);
       stats_[kStDropsBackpressure].fetch_add(xbatch_n_[dst],
                                              std::memory_order_relaxed);
+      LedgerNote(kLrRingFull, static_cast<uint64_t>(dst));
     }
     b.clear();  // Push moved it on success; failure keeps it — clear both
     xbatch_n_[dst] = 0;
@@ -3349,7 +3569,7 @@ class Host {
       if (src == shard_id_) continue;
       ring::SpscRing& r = group_->rings[src][shard_id_];
       while (r.Pop(&rec)) {
-        ApplyShardBatch(rec);
+        ApplyShardBatch(src, rec);
         any = true;
       }
     }
@@ -3363,7 +3583,7 @@ class Host {
   // per publish (XShipMulti), so topic/payload decode and the shared
   // frame builds run once per publish — FanOut's discipline, across
   // the ring.
-  void ApplyShardBatch(const std::string& rec) {
+  void ApplyShardBatch(int src, const std::string& rec) {
     if (rec.size() < 4) return;
     uint32_t n = 0;
     memcpy(&n, rec.data(), 4);
@@ -3399,6 +3619,12 @@ class Host {
       if (pos + tlen > blen) break;
       std::string_view topic(body + pos, tlen);
       pos += tlen;
+      uint64_t trace = 0;
+      if (flags & 0x10) {  // the producer shard sampled this publish
+        if (pos + 8 > blen) break;
+        memcpy(&trace, body + pos, 8);
+        pos += 8;
+      }
       std::string_view payload;
       if (flags & 1) {
         if (pos + 4 > blen) break;
@@ -3416,6 +3642,15 @@ class Host {
       }
       uint8_t qos = (flags >> 1) & 3;
       bool dup = (flags & 8) != 0;
+      // re-join the sampled publish's timeline on THIS shard: the
+      // consumer-side deliveries below emit deliver_write spans under
+      // the propagated id, anchored by one ring_cross point (aux =
+      // the producing shard)
+      cur_trace_ = trace;
+      if (trace) {
+        cur_trace_delivers_ = 0;
+        SpanNote(kSpanRingCross, static_cast<uint64_t>(src));
+      }
       if (ntgt == 0 && (t0 & kTrunkOwnerBase)) {
         applied++;
         TrunkEnqueue(t0 - kTrunkOwnerBase, origin, qos, dup, topic,
@@ -3450,6 +3685,7 @@ class Host {
         DeliverTo(conn, e, origin, oq, topic, payload);
       }
     }
+    cur_trace_ = 0;  // batch context over: nothing later may inherit it
     if (applied)
       stats_[kStShardRingIn].fetch_add(applied, std::memory_order_relaxed);
   }
@@ -4294,7 +4530,8 @@ class Host {
     sn::BuildPublish(&dg, flags, tid, tp, payload, &fo, &mo);
     if (telemetry_) {
       if (a.rtt.size() < kRttSamples)
-        a.rtt.push_back({NowNs(), std::string(topic), tp, 1});
+        a.rtt.push_back({NowNs(), std::string(topic), tp, 1,
+                         cur_trace_});
       FrNote(t, kFrDeliver, 3, tp, cur_hash_);
     }
     stats_[kStSnOut].fetch_add(1, std::memory_order_relaxed);
@@ -4473,6 +4710,7 @@ class Host {
                      uint8_t maxqos) {
     auto it = conns_.find(id);
     if (it == conns_.end()) return;
+    cur_trace_ = 0;  // retained bursts are not part of any sampled trace
     Conn& c = it->second;
     stats_[kStRetainDeliver].fetch_add(1, std::memory_order_relaxed);
     uint64_t t0 = telemetry_ ? NowNs() : 0;
@@ -4573,6 +4811,110 @@ class Host {
     size_t cap = kTapFlushBytes;
     if (cap > max_size_ / 2) cap = max_size_ / 2 + 1;
     return cap;
+  }
+
+  // -- native distributed tracing (round 13) ------------------------------
+
+  // Mint the next sampled trace id (seed carries node+shard bits from
+  // Python; the low 44 bits count upward, so ids are unique per shard
+  // for ~17T sampled publishes).
+  uint64_t NextTraceId() {
+    return trace_seed_ | (++trace_ctr_ & ((1ull << 44) - 1));
+  }
+
+  // The per-publish sampling decision (the kind-8 ticker discipline):
+  // tick once per natively-consumed publish, tag 1-in-(mask+1). Called
+  // at the commit point — after every punt decision, before any side
+  // effect — so the tick count is exactly the native publish count and
+  // the sampled subset is deterministic. Rate-bounded per poll cycle
+  // (kTraceMaxPerCycle): a blast cycle draining thousands of publishes
+  // clips its extra picks instead of flooding the span plane.
+  void TraceSample(uint64_t publisher) {
+    cur_trace_ = 0;
+    if (!telemetry_ || !tracing_) return;
+    if ((++trace_tick_ & trace_mask_) != 0) return;
+    if (trace_cyc_used_ >= kTraceMaxPerCycle) return;
+    trace_cyc_used_++;
+    cur_trace_ = NextTraceId();
+    cur_trace_delivers_ = 0;
+    stats_[kStTracedPubs].fetch_add(1, std::memory_order_relaxed);
+    SpanNote(kSpanIngress, publisher);
+  }
+
+  // Emit one span point for the active (or explicitly named) trace.
+  void SpanNote(uint8_t stage, uint64_t aux, uint64_t trace = 0) {
+    if (!telemetry_) return;
+    uint64_t tid = trace ? trace : cur_trace_;
+    if (!tid) return;
+    char e[26];
+    e[0] = 1;
+    memcpy(e + 1, &tid, 8);
+    e[9] = static_cast<char>(stage);
+    uint64_t t = NowNs();
+    memcpy(e + 10, &t, 8);
+    memcpy(e + 18, &aux, 8);
+    SpanAppend(e, 26);
+  }
+
+  // Fold one degradation-ladder decision into this cycle's per-reason
+  // ledger slot (O(1), no allocation — ladder decisions can be
+  // message-rate under overload; FlushSpans emits at most one ledger
+  // entry per reason per cycle carrying the folded count).
+  void LedgerNote(uint8_t reason, uint64_t aux) {
+    if (!telemetry_ || reason == 0 || reason >= kLrCount) return;
+    ledger_cyc_[reason]++;
+    ledger_aux_[reason] = aux;
+    if (cur_trace_) ledger_trace_[reason] = cur_trace_;
+  }
+
+  // One deliver_write span per written delivery of the active sampled
+  // publish, capped so a wide fan-out cannot flood the span plane.
+  void TraceDeliverNote(uint64_t owner) {
+    if (cur_trace_ && cur_trace_delivers_ < kTraceMaxDeliverSpans) {
+      cur_trace_delivers_++;
+      SpanNote(kSpanDeliverWrite, owner);
+    }
+  }
+
+  // Whole-sub-record append at the tap bound (the TeleAppend shape —
+  // header slot seeded AFTER the flush check).
+  void SpanAppend(const char* data, size_t len) {
+    size_t cap = TeleCap();
+    if (span_buf_.size() > 13 && span_buf_.size() - 13 + len > cap)
+      FlushSpans();
+    if (span_buf_.empty()) span_buf_.assign(13, '\0');
+    span_buf_.append(data, len);
+    if (span_buf_.size() - 13 > cap) FlushSpans();
+  }
+
+  void FlushSpans() {
+    for (int r = 1; r < kLrCount; r++) {
+      if (!ledger_cyc_[r]) continue;
+      char e[34];
+      e[0] = 2;
+      e[1] = static_cast<char>(r);
+      memcpy(e + 2, &ledger_cyc_[r], 8);
+      memcpy(e + 10, &ledger_trace_[r], 8);
+      memcpy(e + 18, &ledger_aux_[r], 8);
+      uint64_t t = NowNs();
+      memcpy(e + 26, &t, 8);
+      ledger_cyc_[r] = ledger_trace_[r] = ledger_aux_[r] = 0;
+      SpanAppend(e, 34);  // zeroed first: a reentrant flush re-scans
+    }
+    if (span_buf_.size() <= 13) {
+      span_buf_.clear();
+      return;
+    }
+    span_buf_[0] = 12;
+    // id slot = shard, the kind-7/8/10 convention: N poll threads feed
+    // one Python fold, which attributes spans to the producing shard
+    uint64_t id = static_cast<uint64_t>(shard_id_);
+    memcpy(&span_buf_[1], &id, 8);
+    uint32_t plen = static_cast<uint32_t>(span_buf_.size() - 13);
+    memcpy(&span_buf_[9], &plen, 4);
+    events_.push_back(std::move(span_buf_));
+    span_buf_.clear();
+    stats_[kStSpanBatches].fetch_add(1, std::memory_order_relaxed);
   }
 
   // Append ONE whole sub-record; flushes at the tap bound so a chunk
@@ -4692,7 +5034,19 @@ class Host {
       if (a.rtt[i].pid != pid) continue;
       uint64_t rtt = NowNs() - a.rtt[i].t0_ns;
       RecordHist(a.rtt[i].qos == 2 ? kHistQos2Rtt : kHistQos1Rtt, rtt);
-      if (telemetry_) EmitSlowAck(id, a.rtt[i].qos, rtt, a.rtt[i].topic);
+      if (telemetry_) {
+        EmitSlowAck(id, a.rtt[i].qos, rtt, a.rtt[i].topic);
+        // a traced delivery's ack closes its timeline (round 13): the
+        // sample carried the publish's trace id across the exchange.
+        // aux = subscriber conn with the delivery qos in bits 60-61
+        // (conn ids top out at bit 59 + the shard prefix), so the
+        // Python fold can attribute the exemplar to the right RTT
+        // histogram (qos1_rtt vs qos2_rtt)
+        if (a.rtt[i].trace)
+          SpanNote(kSpanAck,
+                   id | (static_cast<uint64_t>(a.rtt[i].qos) << 60),
+                   a.rtt[i].trace);
+      }
       a.rtt[i] = std::move(a.rtt.back());
       a.rtt.pop_back();
       return;
@@ -4876,6 +5230,25 @@ class Host {
   uint32_t cur_hash_ = 0;           // current publish's topic hash
   std::string tele_buf_;      // kind-8 batch (bytes [0,13) = header slot)
   std::string tele_scratch_;  // one sub-record under construction
+  // -- native distributed tracing (round 13, poll-thread-owned) ------------
+  bool tracing_ = true;       // EMQX_NATIVE_TRACING=0 escape hatch
+  uint32_t trace_mask_ = 63;  // sample 1-in-(mask+1); default 1-in-64
+  uint32_t trace_tick_ = 0;   // global publish ticker (deterministic)
+  uint64_t trace_seed_ = 1ull << 63;  // node+shard prefix (Python sets)
+  uint64_t trace_ctr_ = 0;
+  uint32_t trace_cyc_used_ = 0;  // sampled publishes this poll cycle
+  uint64_t cur_trace_ = 0;    // active publish's trace id (0 = unsampled)
+  uint8_t cur_trace_delivers_ = 0;  // deliver_write spans emitted so far
+  uint32_t fan_xshipped_ = 0;  // shards shipped by the LAST FanOut
+  std::string span_buf_;      // kind-12 batch (bytes [0,13) = header slot)
+  // per-cycle degradation-ledger accumulators (one kind-12 sub-2 entry
+  // per nonzero reason per cycle)
+  uint64_t ledger_cyc_[kLrCount] = {};
+  uint64_t ledger_trace_[kLrCount] = {};
+  uint64_t ledger_aux_[kLrCount] = {};
+  // highest trunk wire version this host speaks/advertises (tests cap
+  // it at 0 to simulate an old peer)
+  uint8_t trunk_wire_max_ = trunk::kWireVersion;
   // -- device match lane (poll-thread-owned) ------------------------------
   // Permitted PUBLISHes whose wildcard match runs on the DEVICE router
   // instead of the C++ trie walk: the frame parks here keyed by a lane
@@ -5145,6 +5518,30 @@ int emqx_host_set_telemetry(void* h, int enabled, uint64_t slow_ack_ns) {
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
+// Native distributed tracing (round 13): the deterministic 1-in-2^shift
+// publish sampler. `seed` carries the node+shard prefix trace ids mint
+// under (nonzero; 0 keeps the current seed). Tracing also gates on the
+// telemetry master switch.
+int emqx_host_set_tracing(void* h, int enabled, int shift, uint64_t seed) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetTracing;
+  op.flags = enabled ? 1 : 0;
+  op.max_inflight = shift >= 0 && shift <= 16
+                        ? static_cast<uint32_t>(shift)
+                        : 6u;
+  op.token = seed;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Cap the trunk wire version this host advertises/accepts (tests set 0
+// to exercise the old-peer trace-id downshift).
+int emqx_host_set_trunk_wire(void* h, int version) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetTrunkWire;
+  op.qos = static_cast<uint8_t>(version < 0 ? 0 : version);
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
 // --- cluster trunk plane (round 9) ----------------------------------------
 
 // Open the trunk listener (BEFORE the poll thread starts). Peer hosts
@@ -5349,13 +5746,15 @@ uint64_t emqx_store_lookup(void* s, const char* sid) {
 
 // Single-message append (test surface / Python-plane callers); the
 // data plane appends whole batches through the attached host instead.
+// `trace` != 0 persists a sampled trace id with the entry (flags bit4).
 // Returns the assigned guid (0 on a malformed call).
 uint64_t emqx_store_append(void* s, uint64_t origin, uint8_t flags,
                            const uint64_t* toks, uint16_t ntok,
                            const char* topic, uint16_t tlen,
-                           const char* payload, uint32_t plen) {
+                           const char* payload, uint32_t plen,
+                           uint64_t trace) {
   return static_cast<emqx_native::store::DurableStore*>(s)->Append(
-      origin, flags, toks, ntok, topic, tlen, payload, plen);
+      origin, flags, toks, ntok, topic, tlen, payload, plen, trace);
 }
 
 // Consume (token, guid) markers; returns how many were live.

@@ -21,12 +21,16 @@
 //   type 1  = MSG BATCH   [u64 base_guid][u64 ts_ms][u32 n] + n x entry
 //             entry = [u64 origin][u8 flags][u16 ntok][u64 tok x ntok]
 //                     [u16 tlen][topic]
+//                     + (flags bit4 ? [u64 trace_id])
 //                     + (flags bit0 ? [u32 plen][payload]
 //                                   : payload of the PREVIOUS entry)
 //             guid of entry i = base_guid + i. flags: bit0 = payload
 //             inline (the kind-6 dedup discipline), bits1-2 = qos,
-//             bit3 = publisher DUP. The SAME bytes ride up to Python
-//             as the kind-10 event payload — one buffer, two sinks.
+//             bit3 = publisher DUP, bit4 = a sampled trace id follows
+//             the topic (round 13: the native tracing plane persists
+//             the id so a resume replay can re-join the trace). The
+//             SAME bytes ride up to Python as the kind-10 event
+//             payload — one buffer, two sinks.
 //   type 2  = CONSUME     [u32 n] + n x ([u64 token][u64 guid])
 //   type 3  = REGISTER    [u64 token][u16 len][sid utf-8]
 //   type 4  = REWRITE     like MSG BATCH but every entry is prefixed
@@ -136,6 +140,7 @@ struct StoredMsg {
   std::string payload;
   uint64_t origin = 0;
   uint64_t ts_ms = 0;
+  uint64_t trace = 0;           // sampled trace id (0 = not sampled)
   uint8_t flags = 0;            // bits1-2 qos, bit3 dup (bit0 meaningless)
   uint32_t seg = 0;             // homing segment (GC bookkeeping)
   std::vector<uint64_t> toks;   // tokens still holding a marker
@@ -238,20 +243,23 @@ class DurableStore {
   // Single-message append (test surface + Python-plane callers).
   uint64_t Append(uint64_t origin, uint8_t flags, const uint64_t* toks,
                   uint16_t ntok, const char* topic, uint16_t tlen,
-                  const char* payload, uint32_t plen) {
+                  const char* payload, uint32_t plen,
+                  uint64_t trace = 0) {
     std::string body;
-    body.reserve(20 + 11 + 8 * ntok + tlen + 4 + plen);
+    body.reserve(20 + 19 + 8 * ntok + tlen + 4 + plen);
     // reserve the guid properly: a bare next_guid_ read could collide
     // with a concurrent AllocGuids from the host's flush
     AppendU64(&body, AllocGuids(1));
     AppendU64(&body, WallMs());
     AppendU32(&body, 1);
     AppendU64(&body, origin);
-    body.push_back(static_cast<char>(flags | 1));  // inline payload
+    body.push_back(static_cast<char>(flags | 1              // inline
+                                     | (trace ? 0x10 : 0)));
     AppendU16(&body, ntok);
     for (uint16_t i = 0; i < ntok; i++) AppendU64(&body, toks[i]);
     AppendU16(&body, tlen);
     body.append(topic, tlen);
+    if (trace) AppendU64(&body, trace);
     AppendU32(&body, plen);
     body.append(payload, plen);
     uint64_t guid = RdU64(body.data());
@@ -282,7 +290,8 @@ class DurableStore {
 
   // Pending messages for a token, guid order (= arrival order), as a
   // malloc'd blob of [u64 guid][u64 origin][u64 ts_ms][u8 flags]
-  // [u16 tlen][topic][u32 plen][payload] entries. Returns the count.
+  // [u16 tlen][topic] + (flags bit4 ? [u64 trace_id]) + [u32 plen]
+  // [payload] entries. Returns the count.
   long Fetch(uint64_t token, uint8_t** out, size_t* out_len) {
     std::lock_guard<std::mutex> lk(mu_);
     std::string blob;
@@ -296,9 +305,11 @@ class DurableStore {
         AppendU64(&blob, guid);
         AppendU64(&blob, m.origin);
         AppendU64(&blob, m.ts_ms);
-        blob.push_back(static_cast<char>(m.flags));
+        blob.push_back(static_cast<char>((m.flags & 0x0E)
+                                         | (m.trace ? 0x10 : 0)));
         AppendU16(&blob, static_cast<uint16_t>(m.topic.size()));
         blob += m.topic;
+        if (m.trace) AppendU64(&blob, m.trace);
         AppendU32(&blob, static_cast<uint32_t>(m.payload.size()));
         blob += m.payload;
         n++;
@@ -367,6 +378,9 @@ class DurableStore {
             for (uint64_t t : m.toks) AppendU64(&body, t);
             AppendU16(&body, static_cast<uint16_t>(m.topic.size()));
             body += m.topic;
+            // bit4 survives in m.flags: recovery's ParseEntries expects
+            // the trace id after the topic for flagged entries
+            if (m.flags & 0x10) AppendU64(&body, m.trace);
             AppendU32(&body, static_cast<uint32_t>(m.payload.size()));
             body += m.payload;
           }
@@ -477,6 +491,11 @@ class DurableStore {
       if (pos + tlen > len) return false;
       m.topic.assign(p + pos, tlen);
       pos += tlen;
+      if (m.flags & 0x10) {  // wire-v1 tracing extension (see header)
+        if (pos + 8 > len) return false;
+        m.trace = RdU64(p + pos);
+        pos += 8;
+      }
       if (m.flags & 1) {
         if (pos + 4 > len) return false;
         uint32_t pl = RdU32(p + pos);

@@ -51,6 +51,18 @@ namespace trunk {
 
 constexpr uint8_t kRecBatch = 2;
 constexpr uint8_t kRecAck = 3;
+// HELLO (round 13, wire version negotiation): body = [u8 version].
+// The dialer sends its version on connect BEFORE any batch; the
+// receiver answers with its own. Either side missing the exchange
+// (an old peer ignores unknown record types and sends none) leaves
+// the negotiated version at 0, and the dialer then emits v0 entries —
+// trace ids are STRIPPED (losslessly: topic/payload untouched), never
+// put on a wire the peer cannot parse.
+constexpr uint8_t kRecHello = 4;
+// Version 1 adds the per-entry trace-id extension: entry flags bit 4
+// set means a [u64 trace_id] follows the topic bytes (before the
+// payload section). Both sides must have negotiated >= 1 to use it.
+constexpr uint8_t kWireVersion = 1;
 
 // PROTOCOL-level size bounds, deliberately independent of either
 // node's max_packet_size: a record sized by the sender's config but
@@ -72,20 +84,25 @@ inline void AppendRecord(std::string* out, uint8_t type, const char* body,
   out->append(body, blen);
 }
 
-// Append one pre-parse entry ([origin][flags][topic][payload?]) to a
-// batch body under construction.  ``inline_payload=false`` emits the
-// dedup form (payload identical to the previous entry in this batch).
+// Append one pre-parse entry ([origin][flags][topic][trace?][payload?])
+// to a batch body under construction.  ``inline_payload=false`` emits
+// the dedup form (payload identical to the previous entry in this
+// batch). ``trace != 0`` sets flags bit 4 and appends the [u64
+// trace_id] after the topic bytes (the wire-v1 tracing extension —
+// callers pass 0 on links whose negotiated version is below 1).
 inline void AppendEntry(std::string* out, uint64_t origin, uint8_t qos,
                         bool dup, bool inline_payload,
-                        std::string_view topic, std::string_view payload) {
+                        std::string_view topic, std::string_view payload,
+                        uint64_t trace = 0) {
   char hdr[11];
   memcpy(hdr, &origin, 8);
   hdr[8] = static_cast<char>((inline_payload ? 1 : 0) | (qos << 1) |
-                             (dup ? 8 : 0));
+                             (dup ? 8 : 0) | (trace ? 0x10 : 0));
   uint16_t tl = static_cast<uint16_t>(topic.size());
   memcpy(hdr + 9, &tl, 2);
   out->append(hdr, 11);
   out->append(topic.data(), topic.size());
+  if (trace) out->append(reinterpret_cast<const char*>(&trace), 8);
   if (inline_payload) {
     uint32_t pl = static_cast<uint32_t>(payload.size());
     out->append(reinterpret_cast<const char*>(&pl), 4);
@@ -118,6 +135,9 @@ struct Unacked {
 struct Peer {
   uint64_t sock_tag = 0;    // live dialer sock tag (0 = no link)
   bool up = false;          // connected; remote entries forward here
+  // negotiated wire version for the CURRENT link (reset to 0 on every
+  // link death; re-negotiated by the HELLO exchange per connection)
+  uint8_t wire_ver = 0;
   std::string addr;         // redial target (Python drives redial)
   uint16_t port = 0;
   std::string batch;        // BATCH entries accumulated this cycle

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Iterable, Optional
 
 import numpy as np
@@ -86,6 +87,14 @@ NATIVE = [
     # native store on clean_start=false resume. Fixed slots: both
     # render at zero and ride the $SYS metrics heartbeat.
     "messages.durable.stored", "messages.durable.replayed",
+    # degradation ledger (round 13): one fixed slot per ladder-decision
+    # reason (DegradationLedger folds both the C++ kind-12 ledger
+    # entries and the Python-plane decisions here), so every reason
+    # renders at zero in prometheus and rides the $SYS heartbeat before
+    # the first degradation ever happens.
+    "messages.ledger.ring_full", "messages.ledger.trunk_punt",
+    "messages.ledger.shed", "messages.ledger.device_failover",
+    "messages.ledger.store_degraded",
 ]
 CLIENT = [
     "client.connect", "client.connack", "client.connected",
@@ -148,12 +157,18 @@ class LatencyHistogram:
     and readers tolerate torn-but-monotone snapshots like the counter
     array above."""
 
-    __slots__ = ("counts", "sum_ns", "count")
+    __slots__ = ("counts", "sum_ns", "count", "exemplars")
 
     def __init__(self) -> None:
         self.counts = np.zeros(64, dtype=np.int64)
         self.sum_ns = 0
         self.count = 0
+        # OpenMetrics exemplars (round 13): bucket index -> (trace_id,
+        # value_ns, unix_ts) — the most recent sampled trace whose
+        # measured duration landed in that bucket; prometheus renders
+        # them on the _bucket lines so operators can jump from a
+        # histogram spike straight to a stitched trace timeline
+        self.exemplars: dict = {}
 
     def observe(self, ns: int) -> None:
         self.counts[hist_bucket(ns)] += 1
@@ -167,6 +182,11 @@ class LatencyHistogram:
         self.sum_ns += sum_d
         for idx, d in bucket_deltas.items():
             self.counts[idx] += d
+
+    def put_exemplar(self, trace_id: int, value_ns: int) -> None:
+        """Hang a trace id off the bucket ``value_ns`` falls into."""
+        self.exemplars[hist_bucket(value_ns)] = (
+            int(trace_id), int(value_ns), time.time())
 
     def percentile(self, q: float) -> float:
         """q in [0,1] -> ns, linearly interpolated inside the bucket
@@ -198,6 +218,68 @@ class LatencyHistogram:
             "p99_us": round(self.percentile(0.99) / 1e3, 2),
             "p999_us": round(self.percentile(0.999) / 1e3, 2),
         }
+
+
+# ---------------------------------------------------------------------------
+# degradation ledger (round 13)
+#
+# Every native-plane degradation-ladder decision — ring-full→punt,
+# trunk→punt, kHighWater shed — and every Python-plane one — device
+# failover, durable-store degradation — used to be visible only as bare
+# counters: when `trunk_punts` ticked up at 3am there was no record of
+# WHICH messages degraded or WHY. The ledger holds a bounded in-memory
+# event ring (surfaced via $SYS and the mgmt API) next to per-reason
+# FIXED metric slots (messages.ledger.*), each event carrying the
+# deciding shard, the reason, and the active trace id when the decision
+# hit a sampled publish.
+
+# canonical reason set — must match native/__init__.py LEDGER_REASONS
+# (test_stats_lint pins the pair; the C++ LedgerReason enum is a prefix)
+LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "device_failover",
+                  "store_degraded")
+
+
+class DegradationLedger:
+    """Bounded ring of structured degradation events + per-reason
+    totals folded into the fixed ``messages.ledger.*`` metric slots.
+    Thread-safe: C++ ledger entries arrive on N poll threads while
+    Python-plane sources (broker device failover, the durable-store
+    degradation watch) record from theirs."""
+
+    def __init__(self, metrics: Optional["Metrics"] = None,
+                 maxlen: int = 256) -> None:
+        self._events: deque = deque(maxlen=maxlen)
+        self._totals: dict[str, int] = {r: 0 for r in LEDGER_REASONS}
+        self._metrics = metrics
+        self._lock = threading.Lock()
+
+    def record(self, reason: str, count: int = 1, *, shard: int = 0,
+               trace_id: int = 0, aux: int = 0,
+               detail: str = "") -> None:
+        with self._lock:
+            self._totals[reason] = self._totals.get(reason, 0) + count
+            self._events.append({
+                "ts_ms": int(time.time() * 1000), "reason": reason,
+                "count": int(count), "shard": int(shard),
+                "trace_id": int(trace_id), "aux": int(aux),
+                "detail": detail,
+            })
+        if self._metrics is not None and reason in LEDGER_REASONS:
+            self._metrics.inc(f"messages.ledger.{reason}", count)
+
+    def totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._totals)
+
+    def recent(self, limit: int = 64) -> list[dict]:
+        """Newest-last event dicts (the mgmt/$SYS surface)."""
+        with self._lock:
+            ev = list(self._events)
+        return ev[-limit:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
 
 
 class Metrics:
@@ -235,6 +317,7 @@ class Metrics:
             for h in self._hists.values():
                 h.counts[:] = 0
                 h.sum_ns = h.count = 0
+                h.exemplars.clear()
 
     # -- latency histograms -------------------------------------------------
 

@@ -20,12 +20,13 @@ SYSDESCR = "emqx_tpu broker"
 
 class SysHeartbeat:
     def __init__(self, node: str, publish_fn: Callable[[Message], None],
-                 metrics=None, stats=None,
+                 metrics=None, stats=None, ledger=None,
                  heartbeat_s: float = 30.0, tick_s: float = 60.0) -> None:
         self.node = node
         self.publish_fn = publish_fn
         self.metrics = metrics
         self.stats = stats
+        self.ledger = ledger    # DegradationLedger (round 13), optional
         self.heartbeat_s = heartbeat_s
         self.tick_s = tick_s
         self.started_at = time.time()
@@ -84,6 +85,21 @@ class SysHeartbeat:
                 self._pub(f"{base}/{q}", f"{v / 1e6:.3f}")
             self._pub(f"{base}/count", str(int(h.count)))
 
+    def publish_ledger(self) -> None:
+        """Degradation-ledger heartbeat (round 13):
+        ``$SYS/brokers/<node>/ledger/<reason>`` = total decisions per
+        reason, plus ``ledger/last`` = the newest structured event —
+        the $SYS face of the bounded event ring the mgmt API pages."""
+        if self.ledger is None:
+            return
+        for reason, total in self.ledger.totals().items():
+            self._pub(f"ledger/{reason}", str(total))
+        recent = self.ledger.recent(1)
+        if recent:
+            import json
+
+            self._pub("ledger/last", json.dumps(recent[-1]))
+
     def tick(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         if now - self._last_heartbeat >= self.heartbeat_s:
@@ -94,3 +110,4 @@ class SysHeartbeat:
             self.publish_stats()
             self.publish_metrics()
             self.publish_latency()
+            self.publish_ledger()

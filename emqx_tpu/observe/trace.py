@@ -32,6 +32,14 @@ class Trace:
     end_at: Optional[float] = None          # None = until stopped
     status: str = "running"                 # running | stopped
     max_lines: int = 10_000
+    # clientid traces only (round 13): "punt" forces the traced conn's
+    # publishes through the Python plane (full hook fidelity — every
+    # message logged, at slow-path cost); "native" keeps the conn on
+    # the fast path and logs the 1-in-N SAMPLED publishes' span
+    # timelines instead (SPAN lines fed by the native server), so
+    # tracing a production workload no longer turns off the thing
+    # being observed.
+    mode: str = "punt"
     lines: deque = field(default_factory=deque)
 
     def matches(self, clientid: str, topic: Optional[str],
@@ -67,9 +75,12 @@ class TraceManager:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, name: str, filter_type: str, filter_value: str,
-              duration_s: Optional[float] = None) -> Trace:
+              duration_s: Optional[float] = None,
+              mode: str = "punt") -> Trace:
         if filter_type not in ("clientid", "topic", "ip_address"):
             raise ValueError(f"bad trace filter type {filter_type}")
+        if mode not in ("punt", "native"):
+            raise ValueError(f"bad trace mode {mode}")
         with self._lock:
             if name in self.traces:
                 raise ValueError(f"trace {name} already exists")
@@ -78,7 +89,8 @@ class TraceManager:
             now = time.time()
             tr = Trace(name=name, filter_type=filter_type,
                        filter_value=filter_value, start_at=now,
-                       end_at=now + duration_s if duration_s else None)
+                       end_at=now + duration_s if duration_s else None,
+                       mode=mode)
             self.traces[name] = tr
         for cb in self.on_topology_change:
             cb()
@@ -115,7 +127,7 @@ class TraceManager:
             return [{
                 "name": t.name, "type": t.filter_type,
                 "value": t.filter_value, "status": t.status,
-                "lines": len(t.lines),
+                "mode": t.mode, "lines": len(t.lines),
             } for t in self.traces.values()]
 
     def log_lines(self, name: str) -> list[str]:
@@ -200,3 +212,66 @@ class TraceManager:
     def _on_unsubscribed(self, sid, topic) -> None:
         self.trace("UNSUBSCRIBE", sid, topic, "",
                    f"{sid} unsubscribed {topic}")
+
+
+# ---------------------------------------------------------------------------
+# distributed-tracing span collector (round 13)
+
+
+class SpanCollector:
+    """Stitches kind-12 span events (and Python-emitted replay spans)
+    into per-message timelines.
+
+    A sampled publish's 64-bit trace id propagates through every native
+    seam — cross-shard ring entries, trunk BATCH records, durable
+    MSG-BATCH records — and each plane emits compact span points
+    (stage, t_ns, shard, aux). This class assembles them, bounded to
+    the last ``max_traces`` distinct ids (the queryable span ring the
+    mgmt API serves). Thread-safe: N poll threads feed it when sharded.
+
+    Span tuples are ``(t_ns, stage, shard, node, aux)``; t_ns is
+    CLOCK_MONOTONIC, so ordering is meaningful per machine (and across
+    the in-process multi-node tests)."""
+
+    def __init__(self, max_traces: int = 512,
+                 max_spans_per_trace: int = 64) -> None:
+        self.max_traces = max_traces
+        self.max_spans_per_trace = max_spans_per_trace
+        self._traces: "dict[int, list]" = {}
+        self._order: deque = deque()
+        self._lock = threading.Lock()
+
+    def record(self, trace_id: int, stage: str, t_ns: int,
+               shard: int = 0, aux: int = 0, node: str = "") -> None:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                spans = self._traces[trace_id] = []
+                self._order.append(trace_id)
+                while len(self._order) > self.max_traces:
+                    old = self._order.popleft()
+                    self._traces.pop(old, None)
+            elif len(spans) >= self.max_spans_per_trace:
+                return      # a megafan-out must not grow one timeline
+            spans.append((int(t_ns), stage, int(shard), node, int(aux)))
+
+    def trace(self, trace_id: int) -> list:
+        """One assembled timeline, sorted by t_ns ([] = unknown id)."""
+        with self._lock:
+            return sorted(self._traces.get(trace_id, ()))
+
+    def stages(self, trace_id: int) -> list:
+        """The stage names of one timeline in t_ns order."""
+        return [s for _t, s, _sh, _n, _a in self.trace(trace_id)]
+
+    def recent(self, limit: int = 32) -> list:
+        """Newest-first ``(trace_id, sorted spans)`` pairs."""
+        limit = max(1, int(limit))   # a negative slice would invert
+        with self._lock:
+            ids = list(self._order)[-limit:][::-1]
+            return [(tid, sorted(self._traces.get(tid, ())))
+                    for tid in ids]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)

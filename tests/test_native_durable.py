@@ -152,7 +152,7 @@ def test_oversized_durable_entry_still_reaches_python():
         assert got, "oversized durable record never surfaced"
         _base, _ts, entries = got[0]
         assert len(entries) == 1
-        origin, flags, etoks, topic, ebody = entries[0]
+        origin, flags, etoks, topic, ebody, _trace = entries[0]
         assert sorted(etoks) == sorted(toks)
         assert topic == "ov/t" and ebody == payload
         assert store.stats()["appends"] == 1

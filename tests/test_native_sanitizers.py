@@ -1156,10 +1156,123 @@ print("SANITIZED-RUN-OK", st0["shard_ring_out"], st0["shard_ring_full"])
 """
 
 
+DRIVER_TRACING = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+# Native distributed tracing (ISSUE 8): set_tracing toggles (enable,
+# shift, seed) race SHARDED CROSS-NODE traffic — two shard hosts in a
+# ring group blasting cross-shard deliveries while shard 0 trunks a
+# remote leg to a third (unsharded) host, kind-12 span batches flowing
+# the whole time; set_trunk_wire flips race the HELLO negotiation.
+group = native.NativeShardGroup(2)
+hosts = [native.NativeHost(port=0, max_size=1 << 16) for _ in range(2)]
+for i, h in enumerate(hosts):
+    h.join_group(group, i)
+peer = native.NativeHost(port=0, max_size=1 << 16)
+peer_trunk = peer.trunk_listen()
+
+def connect(h, cid):
+    s = socket.create_connection(("127.0.0.1", h.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+def pub_frame(topic, payload):
+    vh = struct.pack(">H", len(topic)) + topic + payload
+    return bytes([0x30, len(vh)]) + vh
+
+pub_s = connect(hosts[0], b"tp")
+sub1_s = connect(hosts[1], b"t1")
+subp_s = connect(peer, b"tb")
+
+ids = [[], [], []]
+all_hosts = hosts + [peer]
+deadline = time.time() + 15
+while any(not i for i in ids) and time.time() < deadline:
+    for k, h in enumerate(all_hosts):
+        for kind, conn, payload in h.poll(20):
+            if kind == native.EV_OPEN:
+                ids[k].append(conn)
+            elif kind == native.EV_FRAME:
+                h.send(conn, b"\x20\x02\x00\x00")
+assert all(ids), ids
+pub_id, sub1, subp = ids[0][0], ids[1][0], ids[2][0]
+hosts[0].enable_fast(pub_id, 4)
+hosts[0].permit(pub_id, "tr/t")
+hosts[1].enable_fast(sub1, 4)
+peer.enable_fast(subp, 4)
+for h in hosts:                     # broadcast table + remote route
+    h.sub_add(sub1, "tr/t", 0, 0)
+    h.trunk_route_add(1, "tr/t")
+peer.sub_add(subp, "tr/t", 0, 0)
+hosts[0].trunk_connect(1, "127.0.0.1", peer_trunk)
+
+stop = threading.Event()
+def poller(h):
+    while not stop.is_set():
+        for kind, conn, payload in h.poll(20):
+            if kind == native.EV_SPANS:
+                native.parse_spans(payload)   # decode under race too
+threads = [threading.Thread(target=poller, args=(h,)) for h in all_hosts]
+for t in threads:
+    t.start()
+time.sleep(0.5)
+hosts[1].trunk_peer_state(1, True)  # the Python UP mirror
+
+def blaster():
+    f = pub_frame(b"tr/t", b"x" * 32) * 16
+    while not stop.is_set():
+        try:
+            pub_s.sendall(f)
+        except OSError:
+            break
+        time.sleep(0.001)
+bt = threading.Thread(target=blaster)
+bt.start()
+
+def toggler():
+    # the tracing control plane flipped from a management thread while
+    # every poll thread is hot: enable/shift/seed churn plus trunk wire
+    # caps racing the HELLO negotiation on redials
+    j = 0
+    while not stop.is_set():
+        hosts[0].set_tracing(j %% 2 == 0, j %% 7, (1 << 63) | (j << 44))
+        hosts[1].set_tracing(j %% 3 != 0, 0, (1 << 62) | (j << 44))
+        peer.set_tracing(True, 0, 1 << 61)
+        if j %% 5 == 0:
+            peer.set_trunk_wire(j %% 2)
+        hosts[0].stats(); peer.stats()
+        j += 1
+        time.sleep(0.001)
+tg = threading.Thread(target=toggler)
+tg.start()
+
+time.sleep(3.0)
+stop.set()
+bt.join(); tg.join()
+for t in threads:
+    t.join()
+st0 = hosts[0].stats()
+stp = peer.stats()
+for s in (pub_s, sub1_s, subp_s):
+    s.close()
+hosts[0].destroy(); hosts[1].destroy()
+group.destroy()
+peer.destroy()
+assert st0["fast_in"] > 0, st0
+assert st0["traced_pubs"] > 0, st0
+assert st0["span_batches"] > 0, st0
+print("SANITIZED-RUN-OK", st0["traced_pubs"], st0["span_batches"],
+      stp["trunk_in"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
                                     "telemetry", "trunk", "durable", "sn",
-                                    "shards"])
+                                    "shards", "tracing"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -1178,7 +1291,7 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
            "lane": DRIVER_LANE, "ws": DRIVER_WS,
            "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
            "durable": DRIVER_DURABLE, "sn": DRIVER_SN,
-           "shards": DRIVER_SHARDS}[driver]
+           "shards": DRIVER_SHARDS, "tracing": DRIVER_TRACING}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

@@ -1,0 +1,568 @@
+"""Native distributed tracing + degradation ledger (ISSUE 8).
+
+A deterministic 1-in-2^shift publish sampler in the C++ host tags
+fast-path publishes with 64-bit trace ids that propagate through every
+native seam — cross-shard ring entries, trunk BATCH records (wire v1,
+negotiated away against old peers), durable MSG-BATCH records — while
+the message stays on the fast path. Each plane emits compact kind-12
+span events a Python SpanCollector stitches into per-message
+timelines; every degradation-ladder decision emits a structured ledger
+reason event. Covered here:
+
+- sampler determinism (the global ticker counts natively-consumed
+  publishes; 1-in-2^shift is exact, not probabilistic);
+- local span stitching: one sampled qos1 publish = one assembled trace
+  whose stage ordering matches the oracle (ingress -> deliver_write ->
+  route -> ack);
+- cross-shard parity: the trace id rides the ring; the consumer shard
+  re-joins the timeline (ring_cross -> deliver_write) and the stitched
+  ordering matches the oracle;
+- cross-node (two-host trunk pair) parity: the id rides the trunk wire
+  and BOTH nodes' collectors assemble one trace (trunk_flush on A,
+  trunk_recv + deliver_write on B);
+- old-peer downshift: a v0 peer never sees trace ids, deliveries stay
+  bit-identical (lossless strip);
+- the degradation ledger: trunk-down punts produce structured
+  trunk_punt events with per-reason fixed metric slots, and the
+  Python-plane reasons (device_failover, store_degraded) fold into the
+  same ledger; the mgmt endpoints page both rings;
+- native-mode clientid traces: the conn stays on the fast path
+  (punts_trace == 0) while sampled span timelines land on the trace
+  log — tracing no longer turns off the thing being observed;
+- the durable store persists trace ids (restart survival) and a resume
+  replay re-joins the timeline with a replay span;
+- the escape hatches (tracing=False / telemetry=False).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp                              # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer    # noqa: E402
+from emqx_tpu.cluster.node import ClusterNode                   # noqa: E402
+from emqx_tpu.cluster.transport import LocalBus                 # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient                     # noqa: E402
+from emqx_tpu.session.persistent import MemStore                # noqa: E402
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def _wait(pred, timeout=8.0, step=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+async def _await(pred, timeout=8.0, step=0.05):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        await asyncio.sleep(step)
+    return False
+
+
+async def _warm(pub, sub, topic, qos=0, settle=0.6):
+    """First publish rides the Python lane and earns the permit; the
+    grant lands once the pipeline is idle."""
+    await pub.publish(topic, b"warm", qos=qos)
+    await sub.recv(timeout=10)
+    await asyncio.sleep(settle)
+
+
+# -- sampler ------------------------------------------------------------------
+
+
+def test_sampler_determinism():
+    """shift=2 samples EXACTLY 1-in-4 natively-consumed publishes (the
+    global ticker, not a coin flip): 16 fast publishes -> 4 traces."""
+    server = NativeBrokerServer(port=0, app=BrokerApp(),
+                                trace_sample_shift=2)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="sd-s")
+        await sub.connect()
+        await sub.subscribe("sd/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="sd-p")
+        await pub.connect()
+        await _warm(pub, sub, "sd/t")
+        for i in range(16):
+            await pub.publish("sd/t", b"m%d" % i, qos=0)
+            await sub.recv(timeout=10)
+        assert await _await(
+            lambda: server.fast_stats()["fast_in"] >= 16)
+        st = server.fast_stats()
+        assert st["traced_pubs"] == 4, st
+        assert await _await(lambda: len(server.spans) == 4)
+        # every assembled trace has the local-qos0 oracle stage order
+        for tid, spans in server.spans.recent(4):
+            assert [s[1] for s in spans] == [
+                "ingress", "deliver_write", "route"], (tid, spans)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+def test_local_qos1_trace_stitching_and_exemplars():
+    """One sampled qos1 publish yields exactly one assembled trace:
+    ingress -> deliver_write -> route -> ack, t_ns non-decreasing,
+    ingress aux = the publisher's conn id — and the stitched trace
+    hangs exemplars off the stage histograms in prometheus."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app, trace_sample_shift=0)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="lt-s")
+        await sub.connect()
+        await sub.subscribe("lt/t", qos=1)
+        pub = MqttClient(port=server.port, clientid="lt-p")
+        await pub.connect()
+        await _warm(pub, sub, "lt/t", qos=1)
+        await pub.publish("lt/t", b"one", qos=1)
+        await sub.recv(timeout=10)
+        assert await _await(lambda: any(
+            "ack" in server.spans.stages(tid)
+            for tid, _ in server.spans.recent(4)))
+        tid, spans = next(
+            (t, s) for t, s in server.spans.recent(4)
+            if "ack" in [x[1] for x in s])
+        stages = [s[1] for s in spans]
+        assert stages == ["ingress", "deliver_write", "route", "ack"], spans
+        ts = [s[0] for s in spans]
+        assert ts == sorted(ts)
+        ingress = spans[0]
+        assert ingress[4] == server._fast_conn_of["lt-p"]   # aux
+        # exemplars: the route span closed the ingress_route duration —
+        # rendered only under the OpenMetrics flag (the default 0.0.4
+        # scrape must stay parseable by classic Prometheus)
+        out = app.prometheus(openmetrics=True)
+        assert "trace_id=" in out
+        assert "trace_id=" not in app.prometheus()
+        # the queryable ring serves the same trace
+        rec = server.spans_recent(8)
+        assert any(r["trace_id"] == f"{tid:016x}" for r in rec), rec
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- cross-shard --------------------------------------------------------------
+
+
+async def _client_on_shard(server, clientid, shard, **kw):
+    """Reconnect until SO_REUSEPORT lands the conn on ``shard``."""
+    for _ in range(80):
+        c = MqttClient(port=server.port, clientid=clientid, **kw)
+        await c.connect()
+        conn_id = None
+        for _ in range(100):
+            conn_id = server._fast_conn_of.get(clientid)
+            if conn_id is None:
+                for cid, conn in list(server.conns.items()):
+                    if conn.channel.clientid == clientid:
+                        conn_id = cid
+                        break
+            if conn_id is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert conn_id is not None, f"conn for {clientid} never surfaced"
+        if shard is None or native.shard_of(conn_id) == shard:
+            return c, conn_id
+        await c.close()
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"could not place {clientid} on shard {shard}")
+
+
+def test_cross_shard_span_stitching_parity():
+    """A sampled publish whose subscriber lives on ANOTHER shard yields
+    ONE assembled trace: the id rides the ring entry and the consumer
+    shard re-joins the timeline — ingress/route on the publisher's
+    shard, ring_cross/deliver_write on the subscriber's, in that
+    order."""
+    server = NativeBrokerServer(port=0, app=BrokerApp(), shards=2,
+                                trace_sample_shift=0)
+    server.start()
+
+    async def main():
+        sub, sub_conn = await _client_on_shard(server, "xs-s", None)
+        await sub.subscribe("xs/t", qos=0)
+        sshard = native.shard_of(sub_conn)
+        pub, pub_conn = await _client_on_shard(server, "xs-p",
+                                               1 - sshard)
+        pshard = native.shard_of(pub_conn)
+        assert pshard != sshard
+        await _warm(pub, sub, "xs/t")
+        n0 = len(server.spans)
+        await pub.publish("xs/t", b"cross", qos=0)
+        m = await sub.recv(timeout=10)
+        assert m.payload == b"cross"
+        assert await _await(lambda: len(server.spans) > n0 and any(
+            "deliver_write" in server.spans.stages(tid)
+            for tid, _ in server.spans.recent(2)))
+        tid, spans = next(
+            (t, s) for t, s in server.spans.recent(2)
+            if "deliver_write" in [x[1] for x in s])
+        stages = [s[1] for s in spans]
+        shards = {s[1]: s[2] for s in spans}
+        assert stages == ["ingress", "route", "ring_cross",
+                          "deliver_write"], spans
+        assert shards["ingress"] == pshard
+        assert shards["route"] == pshard
+        assert shards["ring_cross"] == sshard
+        assert shards["deliver_write"] == sshard
+        # ring_cross aux names the PRODUCING shard
+        aux = {s[1]: s[4] for s in spans}
+        assert aux["ring_cross"] == pshard
+        ts = [s[0] for s in spans]
+        assert ts == sorted(ts)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- cross-node (trunk pair) --------------------------------------------------
+
+
+class _TracedPair:
+    """Two ClusterNodes each fronted by a native server with the trace
+    sampler at 1-in-1 (the test_native_trunk fixture + tracing)."""
+
+    def __init__(self, suffix: str, b_wire_version: int = None):
+        self.fabric = LocalBus.Fabric()
+        self.nodes = []
+        self.servers = []
+        for name in (f"tA{suffix}", f"tB{suffix}"):
+            node = ClusterNode(name, LocalBus(name, self.fabric))
+            srv = NativeBrokerServer(port=0, app=node.app, trunk_port=0,
+                                     trace_sample_shift=0)
+            if name.startswith("tB") and b_wire_version is not None:
+                # simulate an old peer: cap B's advertised wire version
+                # BEFORE any link negotiates
+                for h in srv.hosts:
+                    h.set_trunk_wire(b_wire_version)
+            node.attach_native(srv)
+            srv.start()
+            self.nodes.append(node)
+            self.servers.append(srv)
+        self.nodes[1].join([self.nodes[0].name])
+
+    @property
+    def a(self):
+        return self.servers[0]
+
+    @property
+    def b(self):
+        return self.servers[1]
+
+    def sync(self):
+        for n in self.nodes:
+            n.flush()
+
+    def wait_trunks_up(self, timeout=8.0):
+        def both_up():
+            return (self.a.trunk_peer_status().get(self.nodes[1].name)
+                    and self.b.trunk_peer_status().get(self.nodes[0].name))
+        assert _wait(both_up, timeout), (
+            self.a.trunk_peer_status(), self.b.trunk_peer_status())
+
+    def stop(self):
+        for s in self.servers:
+            s.stop()
+        for n in self.nodes:
+            n.transport.close()
+
+
+def test_two_node_trunk_span_stitching():
+    """Cross-node parity: one sampled publish on node A delivered over
+    the trunk to a subscriber on node B yields ONE trace id known to
+    BOTH collectors; the merged timeline orders ingress/route/
+    trunk_flush (A) before trunk_recv/deliver_write (B)."""
+    pair = _TracedPair("st")
+    try:
+        async def main():
+            sub = MqttClient(port=pair.b.port, clientid="tn-s")
+            await sub.connect()
+            await sub.subscribe("tn/t", qos=0)
+            pair.sync()
+            pair.wait_trunks_up()
+            pub = MqttClient(port=pair.a.port, clientid="tn-p")
+            await pub.connect()
+            await _warm(pub, sub, "tn/t")
+            na = len(pair.a.spans)
+            await pub.publish("tn/t", b"xnode", qos=0)
+            m = await sub.recv(timeout=10)
+            assert m.payload == b"xnode"
+            assert await _await(lambda: len(pair.a.spans) > na)
+            # the newest A-side trace that flushed onto the trunk
+            tid = next(t for t, s in pair.a.spans.recent(4)
+                       if "trunk_flush" in [x[1] for x in s])
+            assert await _await(
+                lambda: "deliver_write" in pair.b.spans.stages(tid)), (
+                pair.b.spans.recent(4))
+            merged = sorted(
+                [(t, st, sh, "A", aux) for t, st, sh, _n, aux
+                 in pair.a.spans.trace(tid)]
+                + [(t, st, sh, "B", aux) for t, st, sh, _n, aux
+                   in pair.b.spans.trace(tid)])
+            stages = [(s[1], s[3]) for s in merged]
+            assert stages == [("ingress", "A"), ("route", "A"),
+                              ("trunk_flush", "A"), ("trunk_recv", "B"),
+                              ("deliver_write", "B")], merged
+            await sub.close(); await pub.close()
+
+        run(main())
+    finally:
+        pair.stop()
+
+
+def test_old_peer_downshift_strips_trace_ids_losslessly():
+    """Against a peer capped at wire v0 the dialer emits v0 entries:
+    trace ids are STRIPPED (no trunk_flush/trunk_recv spans, no ids on
+    B) while every message still arrives intact — the downshift is
+    lossless for the data plane."""
+    pair = _TracedPair("dn", b_wire_version=0)
+    try:
+        async def main():
+            sub = MqttClient(port=pair.b.port, clientid="dn-s")
+            await sub.connect()
+            await sub.subscribe("dn/t", qos=0)
+            pair.sync()
+            pair.wait_trunks_up()
+            pub = MqttClient(port=pair.a.port, clientid="dn-p")
+            await pub.connect()
+            await _warm(pub, sub, "dn/t")
+            payloads = [b"d%03d" % i for i in range(10)]
+            for p in payloads:
+                await pub.publish("dn/t", p, qos=0)
+            got = []
+            while len(got) < len(payloads):
+                m = await sub.recv(timeout=8)
+                got.append(m.payload)
+            assert got == payloads          # lossless, in order
+            st_a = pair.a.fast_stats()
+            assert st_a["trunk_out"] >= 10, st_a    # still trunked
+            # still sampled (shift 0, though pipelined publishes share
+            # poll cycles so the per-cycle sampler cap clips the count)
+            assert st_a["traced_pubs"] >= 1, st_a
+            # A sampled every publish but no trunk_flush span exists
+            # (the entry went out v0), and B never saw a trace id
+            for tid, spans in pair.a.spans.recent(16):
+                assert "trunk_flush" not in [s[1] for s in spans], spans
+                assert pair.b.spans.trace(tid) == []
+            await sub.close(); await pub.close()
+
+        run(main())
+    finally:
+        pair.stop()
+
+
+# -- degradation ledger -------------------------------------------------------
+
+
+def test_ledger_trunk_punt_events_and_mgmt():
+    """A down trunk degrades publishes trunk->punt->Python; every such
+    decision folds into ONE structured ledger entry per poll cycle
+    (reason=trunk_punt, deciding peer in aux) plus the fixed
+    messages.ledger.trunk_punt slot, and the mgmt endpoints page the
+    ring. Python-plane reasons fold into the SAME ledger."""
+    from emqx_tpu.mgmt.api import ManagementApi
+
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app, trunk_port=0,
+                                trace_sample_shift=0)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="lg-s")
+        await sub.connect()
+        await sub.subscribe("lg/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="lg-p")
+        await pub.connect()
+        await _warm(pub, sub, "lg/t")
+        # a trunk-registered peer whose link can never come up: the
+        # remote entry degrades matching publishes to punts
+        app.broker.router.add_route("lg/t", "ghost")
+        server.trunk_register("ghost", "127.0.0.1", 1)  # dead port
+        await asyncio.sleep(0.3)
+        for i in range(6):
+            await pub.publish("lg/t", b"p%d" % i, qos=0)
+            await sub.recv(timeout=10)
+        assert await _await(
+            lambda: app.ledger.totals().get("trunk_punt", 0) >= 6), (
+            app.ledger.totals())
+        ev = [e for e in app.ledger.recent(64)
+              if e["reason"] == "trunk_punt"]
+        assert ev, app.ledger.recent(64)
+        assert sum(e["count"] for e in ev) >= 6
+        assert app.metrics.val("messages.ledger.trunk_punt") >= 6
+        # Python-plane reasons land in the same ledger
+        app.ledger.record("device_failover", 1, detail="submit")
+        api = ManagementApi(app)
+        led = api.h_tracing_ledger({}, None)
+        assert led["totals"]["trunk_punt"] >= 6
+        assert led["totals"]["device_failover"] == 1
+        assert any(e["reason"] == "trunk_punt" for e in led["events"])
+        spans = api.h_tracing_spans({}, None)
+        assert isinstance(spans, list)
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- native-mode traces -------------------------------------------------------
+
+
+def test_native_mode_clientid_trace_samples_without_punting():
+    """mode="native" clientid traces keep the conn on the fast path
+    (punts_trace stays 0 — the observed workload is NOT turned off)
+    and the trace log receives the sampled publishes' SPAN timelines;
+    mode="punt" keeps the round-8 full-fidelity behaviour."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app, trace_sample_shift=0)
+    server.start()
+    app.trace.start("nt", "clientid", "nm-p", mode="native")
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="nm-s")
+        await sub.connect()
+        await sub.subscribe("nm/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="nm-p")
+        await pub.connect()
+        await _warm(pub, sub, "nm/t")
+        for i in range(4):
+            await pub.publish("nm/t", b"m%d" % i, qos=0)
+            await sub.recv(timeout=10)
+        st = server.fast_stats()
+        assert st["punts_trace"] == 0, st       # never punted
+        assert st["fast_in"] >= 4, st           # stayed native
+        assert await _await(lambda: any(
+            "[SPAN]" in ln and "ingress" in ln
+            for ln in app.trace.log_lines("nt"))), (
+            app.trace.log_lines("nt")[-5:])
+        assert any("deliver_write" in ln
+                   for ln in app.trace.log_lines("nt"))
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- durable store ------------------------------------------------------------
+
+
+def test_store_persists_trace_ids_across_restart(tmp_path):
+    """The MSG-BATCH trace extension survives the disk roundtrip AND
+    recovery: fetch returns the id before and after a reopen."""
+    d = str(tmp_path / "ts")
+    s = native.NativeStore(d, segment_bytes=64 * 1024, fsync="batch")
+    tok = s.register("sid")
+    g1 = s.append(1, 1, [tok], "t/a", b"traced", trace=0xDEADBEEF)
+    g2 = s.append(1, 1, [tok], "t/b", b"plain")
+    rows = s.fetch(tok)
+    assert [(r[0], r[7]) for r in rows] == [(g1, 0xDEADBEEF), (g2, 0)]
+    s.close()
+    s2 = native.NativeStore(d, segment_bytes=64 * 1024, fsync="batch")
+    rows = s2.fetch(s2.register("sid"))
+    assert [(r[0], r[7]) for r in rows] == [(g1, 0xDEADBEEF), (g2, 0)]
+    s2.close()
+
+
+def test_durable_replay_rejoins_trace():
+    """A sampled publish persisted for an OFFLINE persistent session
+    carries its trace id into the store (store_append span at write
+    time) and the clean_start=false resume replay re-joins the same
+    timeline with a replay span."""
+    app = BrokerApp(persistent_store=MemStore())
+    server = NativeBrokerServer(port=0, app=app, trace_sample_shift=0)
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="dr-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 300})
+        await ps.connect()
+        await ps.subscribe("dr/t", qos=1)
+        fs = MqttClient(port=server.port, clientid="dr-fs")
+        await fs.connect()
+        await fs.subscribe("dr/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="dr-p")
+        await pub.connect()
+        await pub.publish("dr/t", b"warm", qos=1)
+        await fs.recv(timeout=10)
+        await ps.recv(timeout=10)
+        await asyncio.sleep(0.6)
+        await ps.close()                    # session survives offline
+        await asyncio.sleep(0.2)
+        n0 = len(server.spans)
+        await pub.publish("dr/t", b"offline", qos=1)
+        await fs.recv(timeout=10)
+        assert await _await(lambda: len(server.spans) > n0)
+        tid = next(t for t, s in server.spans.recent(4)
+                   if "store_append" in [x[1] for x in s])
+        stages = server.spans.stages(tid)
+        assert stages[0] == "ingress"
+        assert "store_append" in stages and "route" in stages
+        # resume: the replay re-joins the SAME trace id
+        ps2 = MqttClient(port=server.port, clientid="dr-ps",
+                         clean_start=False, proto_ver=5,
+                         properties={"Session-Expiry-Interval": 300})
+        await ps2.connect()
+        m = await ps2.recv(timeout=10)
+        assert m.payload == b"offline"
+        assert await _await(
+            lambda: "replay" in server.spans.stages(tid)), (
+            server.spans.trace(tid))
+        await ps2.close(); await fs.close(); await pub.close()
+
+    run(main())
+    server.stop()
+
+
+# -- escape hatches -----------------------------------------------------------
+
+
+def test_tracing_escape_hatch():
+    """tracing=False: the sampler never ticks a trace — zero spans,
+    zero traced publishes, plane stays fast; telemetry histograms keep
+    working (tracing is its own switch under the telemetry hatch)."""
+    server = NativeBrokerServer(port=0, app=BrokerApp(), tracing=False)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="eh-s")
+        await sub.connect()
+        await sub.subscribe("eh/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="eh-p")
+        await pub.connect()
+        await _warm(pub, sub, "eh/t")
+        for i in range(8):
+            await pub.publish("eh/t", b"m%d" % i, qos=0)
+            await sub.recv(timeout=10)
+        await asyncio.sleep(0.4)
+        st = server.fast_stats()
+        assert st["fast_in"] >= 8, st
+        assert st["traced_pubs"] == 0, st
+        assert st["span_batches"] == 0, st
+        assert len(server.spans) == 0
+        await sub.close(); await pub.close()
+
+    run(main())
+    server.stop()

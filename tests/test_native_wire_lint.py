@@ -1,0 +1,114 @@
+"""Cross-plane wire-format drift guard (ISSUE 8 satellite).
+
+host.cc documents its event-record wire formats (kinds 6-12) as
+``[uNN name]`` field tokens in the header comment — the comment IS the
+writer's contract, maintained next to the emission code. The Python
+decoders in ``native/__init__.py`` declare what they consume in
+``WIRE_FIELDS``. These tests parse the C++ source directly (no
+compiler needed) and assert the two sides agree per kind on the exact
+(width, name) token set — the cross-plane analogue of the StatSlot
+lint: a field added, renamed, or widened on ONE side fails the build
+instead of silently mis-decoding every later field.
+"""
+
+import os
+import re
+
+from emqx_tpu import native
+
+HOST_CC = os.path.join(os.path.dirname(__file__), "..", "emqx_tpu",
+                       "native", "src", "host.cc")
+
+# [u32 name] / [u64 name x ntok] — sub-kind markers like [u8 1] are
+# excluded by the identifier-start requirement
+_TOKEN_RE = re.compile(
+    r"\[(u8|u16|u32|u64)\s+([A-Za-z_]\w*)(?:\s+x\s+\w+)?\]")
+_KIND_RE = re.compile(r"kind\s+(\d+)\s*=")
+
+
+def _wire_comment() -> str:
+    """The contiguous header-comment region documenting the event
+    record wire format (stops at the first preprocessor line)."""
+    with open(HOST_CC) as f:
+        src = f.read()
+    start = src.index("Event record wire format")
+    end = src.index("#include", start)
+    return src[start:end]
+
+
+def _kind_sections() -> dict[int, str]:
+    """kind number -> its slice of the wire-format comment."""
+    text = _wire_comment()
+    marks = [(int(m.group(1)), m.start()) for m in _KIND_RE.finditer(text)]
+    out: dict[int, str] = {}
+    for i, (kind, at) in enumerate(marks):
+        nxt = marks[i + 1][1] if i + 1 < len(marks) else len(text)
+        out[kind] = text[at:nxt]
+    return out
+
+
+def test_every_documented_kind_has_a_python_constant():
+    """Every event kind host.cc documents is named on the Python side
+    (EV_*), and the batched kinds 6-12 all have a WIRE_FIELDS entry."""
+    kinds = set(_kind_sections())
+    ev_consts = {
+        v for k, v in vars(native).items()
+        if k.startswith("EV_") and isinstance(v, int)}
+    missing = kinds - ev_consts
+    assert not missing, (
+        f"host.cc documents event kinds {sorted(missing)} with no EV_* "
+        f"constant in native/__init__.py")
+    for kind in range(6, 13):
+        assert kind in kinds, f"kind {kind} undocumented in host.cc"
+        assert kind in native.WIRE_FIELDS, (
+            f"kind {kind} has no WIRE_FIELDS declaration")
+
+
+def test_wire_fields_match_host_cc_comment_per_kind():
+    """Per kind 6-12: the set of (width, name) tokens in the C++
+    wire-format comment equals the Python decoder declaration exactly.
+    Width drift (u32 -> u64) changes the token and fails; a new field
+    on either side fails until both are updated."""
+    sections = _kind_sections()
+    for kind, want in sorted(native.WIRE_FIELDS.items()):
+        got = frozenset(_TOKEN_RE.findall(sections[kind]))
+        assert got == want, (
+            f"kind {kind} wire drift:\n"
+            f"  host.cc comment : {sorted(got)}\n"
+            f"  WIRE_FIELDS     : {sorted(want)}\n"
+            f"  only in C++     : {sorted(got - want)}\n"
+            f"  only in Python  : {sorted(want - got)}")
+
+
+def test_declared_widths_are_real_widths():
+    """Spot-check that WIRE_FIELDS agrees with what the decoders
+    actually slice — the table must describe the code, not just the
+    comment. Exercises one synthetic record per decoder."""
+    # kind 12 spans: 25-byte body per span sub-record
+    span = (bytes([1]) + (0xBEEF).to_bytes(8, "little") + bytes([7])
+            + (123456).to_bytes(8, "little") + (42).to_bytes(8, "little"))
+    ledger = (bytes([2, 3]) + (9).to_bytes(8, "little")
+              + (0xBEEF).to_bytes(8, "little") + (5).to_bytes(8, "little")
+              + (777).to_bytes(8, "little"))
+    recs = native.parse_spans(span + ledger)
+    assert recs == [("span", 0xBEEF, 7, 123456, 42),
+                    ("ledger", 3, 9, 0xBEEF, 5, 777)]
+
+    # kind 10 durable entry with the bit4 trace extension
+    entry = ((11).to_bytes(8, "little") + bytes([0b10011])  # inline+qos1+trace
+             + (1).to_bytes(2, "little") + (77).to_bytes(8, "little")
+             + (3).to_bytes(2, "little") + b"t/x"
+             + (0xCAFE).to_bytes(8, "little")
+             + (2).to_bytes(4, "little") + b"hi")
+    payload = ((100).to_bytes(8, "little") + (5).to_bytes(8, "little")
+               + (1).to_bytes(4, "little") + entry)
+    base, ts, entries = native.parse_durable(payload)
+    assert (base, ts) == (100, 5)
+    assert entries == [(11, 0b10011, [77], "t/x", b"hi", 0xCAFE)]
+
+    # kind 9 sub-3 punt entry with a trace id skipped losslessly
+    punt = (bytes([3]) + (11).to_bytes(8, "little") + bytes([0b10011])
+            + (3).to_bytes(2, "little") + b"t/y"
+            + (0xCAFE).to_bytes(8, "little")
+            + (2).to_bytes(4, "little") + b"yo")
+    assert native.parse_trunk_punts(punt) == [(11, 1, False, "t/y", b"yo")]

@@ -223,3 +223,127 @@ def test_shard_slots_and_stage_exported():
     src = _src()
     assert "kStShardRingOut" in src and "kStShardRingFull" in src
     assert "kHistShardRingN" in src
+
+
+# -- native distributed tracing + degradation ledger (ISSUE 8) ----------------
+
+
+def test_span_stages_match_cpp_enum():
+    """native.SPAN_STAGES mirrors host.cc's SpanStage enum the same
+    mechanical way HIST_STAGES mirrors HistStage."""
+    stages = re.findall(r"\bkSpan([A-Z]\w*)\b",
+                        _enum_body(_src(), "SpanStage"))
+    stages = [s for s in stages if s != "Count"]
+    assert [_snake(s) for s in stages] == list(native.SPAN_STAGES), (
+        "host.cc SpanStage drifted from native.SPAN_STAGES")
+
+
+def test_ledger_reasons_prefix_and_parity():
+    """host.cc's LedgerReason enum is a PREFIX of native.LEDGER_REASONS
+    (device_failover / store_degraded are Python-plane reasons), and
+    the observe-side canonical tuple matches the native one exactly."""
+    from emqx_tpu.observe import metrics as om
+
+    reasons = re.findall(r"\bkLr([A-Z]\w*)\b",
+                         _enum_body(_src(), "LedgerReason"))
+    reasons = [s for s in reasons if s != "Count"]
+    got = [_snake(s) for s in reasons]
+    assert got == list(native.LEDGER_REASONS[:len(got)]), (
+        f"C++ LedgerReason {got} is not a prefix of "
+        f"{native.LEDGER_REASONS}")
+    assert tuple(om.LEDGER_REASONS) == tuple(native.LEDGER_REASONS)
+    # every reason has a fixed messages.ledger.* metric slot
+    for r in native.LEDGER_REASONS:
+        assert f"messages.ledger.{r}" in om.ALL_NAMES, r
+
+
+def test_tracing_slots_exported():
+    """The tracing plane's StatSlots stay exported (trunk-pin
+    pattern)."""
+    for name in ("traced_pubs", "span_batches"):
+        assert name in native.STAT_NAMES, name
+    src = _src()
+    assert "kStTracedPubs" in src and "kStSpanBatches" in src
+
+
+def test_ledger_fixed_metric_slots_render_at_zero():
+    """messages.ledger.* are FIXED metric slots: they render (at zero)
+    in prometheus and ride the $SYS metrics heartbeat before the first
+    degradation ever happens; the ledger totals ride the dedicated
+    $SYS ledger heartbeat too."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import DegradationLedger, Metrics
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    m = Metrics()
+    for r in native.LEDGER_REASONS:
+        assert m.val(f"messages.ledger.{r}") == 0
+    out = prometheus.render(metrics=m)
+    for r in native.LEDGER_REASONS:
+        assert f"emqx_messages_ledger_{r}" in out, r
+
+    led = DegradationLedger(m)
+    led.record("shed", 3, shard=1, aux=42)
+    assert m.val("messages.ledger.shed") == 3
+    seen = {}
+    hb = SysHeartbeat("n1", lambda msg: seen.__setitem__(
+        msg.topic, msg.payload), metrics=m, ledger=led)
+    hb.publish_metrics()
+    assert seen["$SYS/brokers/n1/metrics/messages.ledger.shed"] == b"3"
+    hb.publish_ledger()
+    assert seen["$SYS/brokers/n1/ledger/shed"] == b"3"
+    assert seen["$SYS/brokers/n1/ledger/ring_full"] == b"0"
+    assert b'"reason": "shed"' in seen["$SYS/brokers/n1/ledger/last"]
+
+
+def test_prometheus_per_shard_label_set():
+    """ISSUE 8 satellite: emqx_native_* gauges AND the stage histograms
+    gain a ``shard`` label. The label set is pinned here: every
+    exported stat renders per shard as emqx_native_<name>{...,
+    shard="<i>"} next to the unlabelled aggregate, and a per-shard
+    stage histogram (latency.native.shard<i>.<stage>) renders under
+    the AGGREGATE metric name with the shard label."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+
+    agg = {k: 7 for k in native.STAT_NAMES}
+    shards = [{k: 3 for k in native.STAT_NAMES},
+              {k: 4 for k in native.STAT_NAMES}]
+    out = prometheus.render(native=agg, native_shards=shards)
+    for name in native.STAT_NAMES:
+        assert f'emqx_native_{name}{{node="emqx_tpu"}} 7' in out, name
+        for i in (0, 1):
+            assert (f'emqx_native_{name}'
+                    f'{{node="emqx_tpu",shard="{i}"}}') in out, (name, i)
+    # exactly ONE TYPE line per metric name despite three series
+    assert out.count("# TYPE emqx_native_fast_in gauge") == 1
+
+    m = Metrics()
+    m.register_hist("latency.native.ingress_route").observe(1000)
+    m.register_hist("latency.native.shard0.ingress_route").observe(1000)
+    m.register_hist("latency.native.shard1.ingress_route").observe(2000)
+    out = prometheus.render(metrics=m)
+    base = "emqx_latency_native_ingress_route_seconds"
+    assert f'{base}_count{{node="emqx_tpu"}} 1' in out
+    assert f'{base}_count{{node="emqx_tpu",shard="0"}} 1' in out
+    assert f'{base}_count{{node="emqx_tpu",shard="1"}} 1' in out
+    assert "shard0" not in out          # the name never leaks the shard
+    assert out.count(f"# TYPE {base} histogram") == 1
+
+
+def test_prometheus_bucket_exemplars():
+    """Histogram _bucket lines carry OpenMetrics-style exemplars once a
+    trace id is hung off them (round 13) — but ONLY under the
+    openmetrics flag: exemplar syntax is illegal in the default text
+    0.0.4 exposition, where a classic Prometheus parser would fail the
+    WHOLE scrape on the '#' after the sample value."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+
+    m = Metrics()
+    h = m.register_hist("latency.native.ingress_route")
+    h.observe(5_000)
+    h.put_exemplar(0xABC123, 5_000)
+    out = prometheus.render(metrics=m, openmetrics=True)
+    assert '# {trace_id="0000000000abc123"}' in out
+    assert "trace_id=" not in prometheus.render(metrics=m)  # 0.0.4-clean

@@ -476,7 +476,7 @@ class NativeBrokerServer:
             for tid, t in (sn_predefined or {}).items():
                 self.host.sn_predefined(int(tid), t)
         # node name → {"id", "addr", "port", "up", } under _mirror_lock
-        self._trunk_peers: dict[str, dict] = {}
+        self._trunk_peers: dict[str, dict] = {}  # @guards(_mirror_lock)
         self._trunk_id_nodes: dict[int, str] = {}   # peer id → node name
         self._trunk_id_next = 1
         self._trunk_routes: set[tuple[str, str]] = set()  # (node, topic)
@@ -490,7 +490,7 @@ class NativeBrokerServer:
         if telemetry is None:
             telemetry = os.environ.get("EMQX_NATIVE_TELEMETRY", "1") != "0"
         self.telemetry = bool(telemetry)
-        self._hists = {}
+        self._hists = {}                      # @guards(_tele_lock)
         for stage in native.HIST_STAGES:
             self._hists[stage] = self.broker.metrics.register_hist(
                 f"latency.native.{stage}")
@@ -531,7 +531,7 @@ class NativeBrokerServer:
         # _sync_traces' read-modify-write from management threads — an
         # unsynchronized replace could lose the poll thread's add and
         # strand the conn trace-punted in C++ after the trace stops
-        self._traced_conns: set[int] = set()
+        self._traced_conns: set[int] = set()  # @guards(_trace_lock)
         self._trace_lock = threading.Lock()
         # -- native distributed tracing (round 13) --------------------------
         # A deterministic 1-in-2^shift publish sampler tags fast-path
@@ -566,7 +566,7 @@ class NativeBrokerServer:
         # trace ids whose publisher has a running native-mode trace ->
         # that clientid (SPAN lines land on its trace log; the
         # publisher resolves from the ingress span's aux = conn id)
-        self._trace_log_ids: OrderedDict = OrderedDict()
+        self._trace_log_ids: OrderedDict = OrderedDict()  # @guards(_tele_lock)
         self._native_traced: set = set()
         if self.app is not None:
             self.app.native_stats_fn = self.fast_stats
@@ -588,7 +588,7 @@ class NativeBrokerServer:
         # back to punt-everything.
         self._durable_store = None
         self._durable_tokens: dict[str, int] = {}      # sid -> token
-        self._durable_sids: dict[int, str] = {}        # token -> sid
+        self._durable_sids: dict[int, str] = {}  # token -> sid @guards(_durable_lock)
         # sid -> filters with a live C++ durable entry (session discard
         # must tear them down, or a dead token keeps accumulating
         # never-consumed markers forever)
@@ -598,13 +598,13 @@ class NativeBrokerServer:
         # that window still appends a marker AFTER discard's consume
         # sweep — _on_durable consumes those orphans on sight instead of
         # letting them pin segments forever / replay post-wipe
-        self._durable_dead: set[int] = set()
+        self._durable_dead: set[int] = set()  # @guards(_durable_lock)
         # sid -> highest guid a resume drain replayed: when a CONNECT
         # and the publish it raced land in the SAME poll batch, the
         # drain (CONNECT handling) replays the message before the
         # queued kind-10 event is folded — _on_durable must not deliver
         # those guids a second time
-        self._durable_drain_mark: dict[str, int] = {}
+        self._durable_drain_mark: dict[str, int] = {}  # @guards(_durable_lock)
         self._store_degraded_seen = 0
         conf = getattr(app, "config", None) if app is not None else None
         if durable is None:
@@ -685,7 +685,7 @@ class NativeBrokerServer:
         # peername) kept so a lane frame punted — or a rule tap emitted
         # — AFTER its publisher disconnected can still be honoured; on
         # the walk path both are synchronous so this window cannot occur
-        self._closed_conns: dict[int, tuple] = {}
+        self._closed_conns: dict[int, tuple] = {}  # @guards(_closed_lock)
         # -- rule taps (VERDICT r4 #5: no broad-rule permit cliff) ----------
         # rule FROM filters mirror into the C++ table as NON-delivering
         # tap entries; matched frames copy here and a worker runs the
@@ -696,7 +696,12 @@ class NativeBrokerServer:
         # entries are BATCH records (~≤192KB each): 128 bounds worst-
         # case buffering at ~24MB / a few hundred thousand messages
         self._tap_q: queue.Queue = queue.Queue(maxsize=128)
-        self.tap_dropped = 0
+        self.tap_dropped = 0      # @guards(_tap_lock): N shard threads
+        # serializes the tap_dropped read-modify-write: queue.Full is
+        # decided per shard poll thread, and two threads folding the
+        # drop count with bare += lose updates (nativecheck pyfold
+        # finding, round 14)
+        self._tap_lock = threading.Lock()
         self._tap_thread: Optional[threading.Thread] = None
         # the mqtt.max_qos_allowed cap must hold on the fast path too:
         # over-cap publishes fall through to the channel's DISCONNECT
@@ -715,12 +720,18 @@ class NativeBrokerServer:
         # (sid, sub key) -> (owner, real filter, kind) for removal;
         # several sub keys can share one punt (token, real) C++ entry
         # ($share/g1/t + $share/g2/t), so punt entries are refcounted
-        self._mirror: dict[tuple[str, str], tuple[int, str, str]] = {}
+        self._mirror: dict[tuple[str, str], tuple[int, str, str]] = {}  # @guards(_mirror_lock)
         self._punt_refs: dict[tuple[int, str], int] = {}
         self._token_refs: dict[str, int] = {}           # sid -> live punts
-        # serializes the refcounted punt bookkeeping: sub events arrive
-        # on broker threads, route events on cluster threads
-        self._mirror_lock = threading.Lock()
+        # serializes the refcounted punt bookkeeping AND the _mirror
+        # read-modify-write itself: sub events arrive on broker
+        # threads, route events on cluster threads, and the
+        # demote/promote re-mirror loops on the poll thread.
+        # REENTRANT because _on_sub_event holds it across _add_entry /
+        # _del_entry / _token, which acquire it for the punt refcounts
+        # (nativecheck pyfold finding, round 14: the unlocked mirror
+        # get/set/pop raced the poll-thread loops' snapshot+re-add)
+        self._mirror_lock = threading.RLock()
         self._route_punts: set[tuple[str, str]] = set()
         self._fast_conn_of: dict[str, int] = {}         # clientid -> conn
         self._granted: dict[int, set[str]] = {}         # conn -> topics
@@ -730,7 +741,8 @@ class NativeBrokerServer:
         self._stats_seen = {k: 0 for k in native.STAT_NAMES}
         # drained ack-record totals (observability + the windowed-qos1
         # smoke test's "inflight never exceeds receive-maximum" probe)
-        self.ack_plane = {"acked": 0, "rel": 0, "batches": 0,
+        self.ack_plane = {"acked": 0, "rel": 0,  # @guards(_ack_lock)
+                          "batches": 0,
                           "max_inflight_seen": 0}
         # (group, real filter) -> {"members": {sid: opts},
         #                          "installed": None | "punt" | {sid: conn}}
@@ -738,7 +750,7 @@ class NativeBrokerServer:
         # threads while strategy changes arrive on the config thread,
         # and an interleaved reconcile would desync "installed" from
         # the C++ table
-        self._shared_state: dict[tuple[str, str], dict] = {}
+        self._shared_state: dict[tuple[str, str], dict] = {}  # @guards(_shared_lock)
         self._sid_groups: dict[str, set[tuple[str, str]]] = {}
         self._shared_lock = threading.Lock()
         if app is not None:
@@ -1154,9 +1166,10 @@ class NativeBrokerServer:
             # idempotent in C++ (SubTable Upsert keys on owner+filter),
             # so resume re-fires need no refcounting
             self.host.durable_add(owner, real, qos)
-            dsid = self._durable_sids.get(owner)
-            if dsid is not None:
-                self._durable_filters.setdefault(dsid, set()).add(real)
+            with self._durable_lock:
+                dsid = self._durable_sids.get(owner)
+                if dsid is not None:
+                    self._durable_filters.setdefault(dsid, set()).add(real)
         else:
             self.host.sub_add(owner, real, qos, flags)
 
@@ -1164,13 +1177,14 @@ class NativeBrokerServer:
                    kind: str) -> None:
         if kind == "durable":
             self.host.durable_del(owner, real)
-            dsid = self._durable_sids.get(owner)
-            if dsid is not None:
-                filters = self._durable_filters.get(dsid)
-                if filters is not None:
-                    filters.discard(real)
-                    if not filters:
-                        del self._durable_filters[dsid]
+            with self._durable_lock:
+                dsid = self._durable_sids.get(owner)
+                if dsid is not None:
+                    filters = self._durable_filters.get(dsid)
+                    if filters is not None:
+                        filters.discard(real)
+                        if not filters:
+                            del self._durable_filters[dsid]
             return
         if kind == "punt":
             with self._mirror_lock:
@@ -1460,6 +1474,7 @@ class NativeBrokerServer:
                         del self._sid_groups[sid]
             self._reconcile_shared(group, real)
 
+    # @locked(_shared_lock)
     def _reconcile_shared(self, group: str, real: str) -> None:
         """Idempotent: diff the desired serving shape for one group
         against what is installed in C++ and apply the delta.
@@ -1537,9 +1552,23 @@ class NativeBrokerServer:
         if group:
             self._on_shared_event(op, sid, group, real, opts)
             return
+        # the whole get → add/del → set sequence under _mirror_lock
+        # (reentrant: _token/_add_entry re-acquire it for the punt
+        # refcounts): a broker-thread unsubscribe used to race the
+        # poll thread's demote/promote re-mirror loops through the
+        # unlocked read-modify-write (nativecheck pyfold finding,
+        # round 14). Never holds across _on_shared_event — group subs
+        # returned above and are never _mirror keys.
+        with self._mirror_lock:
+            self._on_sub_event_locked(op, sid, topic, real, opts)
+
+    # @locked(_mirror_lock)
+    def _on_sub_event_locked(self, op: str, sid: str, topic: str,
+                             real: str, opts) -> None:
         if op == "add":
             conn_id = self._fast_conn_of.get(sid)
-            if (conn_id is not None and not group
+            # group subs never reach here (_on_sub_event routed them)
+            if (conn_id is not None
                     and getattr(opts, "subid", None) is None):
                 owner, kind = conn_id, "real"
                 qos = getattr(opts, "qos", 0)
@@ -1596,17 +1625,30 @@ class NativeBrokerServer:
 
     def _durable_token(self, sid: str) -> int:
         """sid -> store token (stable across restarts: the store
-        journals REGISTER records and recovery replays them)."""
+        journals REGISTER records and recovery replays them).
+
+        Two locks: the token mint under _mirror_lock, then the reverse
+        map + dead-set bookkeeping under _durable_lock — the kind-10
+        fold reads _durable_sids under _durable_lock on the poll
+        thread, and writing it under a DIFFERENT lock was no mutual
+        exclusion at all (nativecheck pyfold finding, round 14).
+
+        LOCK ORDER: _on_sub_event calls this while holding the
+        reentrant _mirror_lock, so _durable_lock nests UNDER
+        _mirror_lock here — that is the global order
+        (_shared_lock -> _mirror_lock -> _durable_lock); never acquire
+        _mirror_lock while holding _durable_lock."""
         with self._mirror_lock:
             tok = self._durable_tokens.get(sid)
             if tok is None:
                 tok = self._durable_store.register(sid)
                 self._durable_tokens[sid] = tok
-                self._durable_sids[tok] = sid
+        with self._durable_lock:
+            self._durable_sids[tok] = sid
             # the store reuses a sid's journaled token across discard/
             # re-register, so a fresh persistent life revives it
             self._durable_dead.discard(tok)
-            return tok
+        return tok
 
     def _durable_consume(self, sid: str, guids: list) -> None:
         if self._durable_store is None:
@@ -1634,6 +1676,7 @@ class NativeBrokerServer:
         with self._durable_lock:
             self._on_durable_locked(payload, Message)
 
+    # @locked(_durable_lock)
     def _on_durable_locked(self, payload: bytes, Message) -> None:
         base, ts, entries = native.parse_durable(payload)
         pers = self.app.persistent if self.app is not None else None
@@ -1796,8 +1839,14 @@ class NativeBrokerServer:
         # (and store segments) forever. durable_del applies at the NEXT
         # ApplyPending, so mark the token dead FIRST — a batch flushed
         # in the gap reaches _on_durable, which consumes the orphans
-        self._durable_dead.add(tok)
-        for filt in self._durable_filters.pop(sid, ()):
+        with self._durable_lock:
+            # the dead-set and filter-map writes hold the SAME lock the
+            # kind-10 fold and _del_entry read them under — an unlocked
+            # wipe raced _del_entry's filters.discard/del sequence
+            # (code-review finding, round 14)
+            self._durable_dead.add(tok)
+            filters = self._durable_filters.pop(sid, ())
+        for filt in filters:
             self.host.durable_del(tok, filt)
         with self._durable_lock:
             # the wipe must not interleave with a concurrent kind-10
@@ -1876,7 +1925,12 @@ class NativeBrokerServer:
             self._granted.pop(conn.conn_id, None)
         if self._fast_conn_of.get(cid) == conn.conn_id:
             del self._fast_conn_of[cid]
-        for (sid, topic), (owner, real, kind) in list(self._mirror.items()):
+        # snapshot under the lock, iterate outside it: _on_sub_event
+        # re-acquires it per key, and holding across the loop would
+        # also order _mirror_lock under whatever the re-adds take
+        with self._mirror_lock:
+            mirror_items = list(self._mirror.items())
+        for (sid, topic), (owner, real, kind) in mirror_items:
             if sid == cid and kind == "real":
                 opts = self.broker.suboption.get((sid, topic))
                 if opts is not None:
@@ -1934,8 +1988,11 @@ class NativeBrokerServer:
                 self._traced_conns.add(conn.conn_id)
         # an earlier mirror pass may have installed this client's subs
         # as punt markers (it wasn't fast yet); re-mirror them as real
-        # (_on_sub_event handles removal of the old entry on the flip)
-        for (sid, topic), (owner, real, kind) in list(self._mirror.items()):
+        # (_on_sub_event handles removal of the old entry on the flip);
+        # snapshot under the lock, re-add outside (the demote shape)
+        with self._mirror_lock:
+            mirror_items = list(self._mirror.items())
+        for (sid, topic), (owner, real, kind) in mirror_items:
             if sid == ch.clientid and owner != conn.conn_id:
                 opts = self.broker.suboption.get((sid, topic))
                 if opts is not None:
@@ -2198,7 +2255,10 @@ class NativeBrokerServer:
             ci = conn.channel.conninfo
             return (conn.channel.clientid, ci.proto_ver, ci.username,
                     ci.peername)
-        return self._closed_conns.get(conn_id)
+        # under _closed_lock: the capped insert+evict runs on every
+        # shard's poll thread while this reads from the tap worker
+        with self._closed_lock:
+            return self._closed_conns.get(conn_id)
 
     @staticmethod
     def _tap_count(batch: bytes) -> int:
@@ -2231,7 +2291,10 @@ class NativeBrokerServer:
         try:
             self._tap_q.put_nowait(batch)
         except queue.Full:
-            self.tap_dropped += self._tap_count(batch)
+            # under _tap_lock: += is a read-modify-write, and N shard
+            # poll threads hitting Full together lost drop counts
+            with self._tap_lock:
+                self.tap_dropped += self._tap_count(batch)
 
     def _tap_worker(self) -> None:
         """Evaluate rules against tapped publishes off the poll thread.
@@ -2330,7 +2393,6 @@ class NativeBrokerServer:
         n = int.from_bytes(batch[:4], "little")
         pos = 4
         tot_acked = tot_rel = max_seen = 0
-        ap = self.ack_plane
         for _ in range(n):
             if pos + 24 > len(batch):
                 break
@@ -2377,6 +2439,7 @@ class NativeBrokerServer:
         # shared totals fold under _ack_lock (each conn's session sync
         # above is shard-local — a conn lives on exactly one shard)
         with self._ack_lock:
+            ap = self.ack_plane
             ap["acked"] += tot_acked
             ap["rel"] += tot_rel
             ap["batches"] += 1
@@ -2484,6 +2547,7 @@ class NativeBrokerServer:
                     self.ledger.record(name, count, shard=shard,
                                        trace_id=tid, aux=aux)
 
+    # @locked(_tele_lock)
     def _exemplar(self, tid: int, from_stage: str, t_ns: int,
                   hist: str) -> None:
         """Attach ``t_ns - t(from_stage)`` of trace ``tid`` as an

@@ -549,6 +549,12 @@ constexpr uint64_t kTrunkSockBit = 1ull << 63;
 // with that remote audience degrade to the Python forward lane (the
 // ring itself may overshoot by the in-flight cycle — a soft bound).
 constexpr size_t kTrunkUnackedMax = 512;
+// HELLO-answer grace (round 14): a fresh link's qos1 replay + UP event
+// wait for the negotiated wire version so a replayed batch keeps its
+// trace annotation on v1 links; an old peer never answers, so the
+// deadline completes the link at v0 — one bounded delay per reconnect
+// against old peers, one loopback RTT against current ones.
+constexpr uint64_t kTrunkHelloGraceMs = 300;
 
 // -- mqtt-sn gateway bounds (round 11) --------------------------------------
 // Datagram conns get their own id range (the ISSUE's "own conn-id
@@ -713,6 +719,7 @@ class Host {
     if (epoll_fd_ >= 0) close(epoll_fd_);
   }
 
+  // @plane(control) — before the poll thread starts only
   bool Init(const char* bind_addr, uint16_t port, bool reuseport = false) {
     epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
     wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
@@ -754,6 +761,7 @@ class Host {
   // here run the RFC6455 handshake + frame codec in front of the MQTT
   // framer; `path` is the required upgrade request-target ("" accepts
   // any). Returns the bound port, or -1.
+  // @plane(control)
   int ListenWs(const char* bind_addr, uint16_t port, const char* path,
                bool reuseport = false) {
     if (listen_ws_fd_ >= 0) return -1;  // one WS listener per host
@@ -791,6 +799,7 @@ class Host {
   // starts, like ListenWs — it mutates the epoll set from the caller's
   // thread). Peers' hosts dial this port to forward publishes below
   // the GIL. Returns the bound port, or -1.
+  // @plane(control)
   int ListenTrunk(const char* bind_addr, uint16_t port) {
     if (listen_trunk_fd_ >= 0) return -1;  // one trunk listener per host
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
@@ -825,6 +834,7 @@ class Host {
   // the caller's thread). One datagram socket serves every SN client;
   // per-peer conns are minted on their first CONNECT. Returns the
   // bound port, or -1.
+  // @plane(control)
   int ListenSn(const char* bind_addr, uint16_t port, int gw_id,
                bool reuseport = false) {
     if (sn_fd_ >= 0) return -1;  // one SN listener per host
@@ -908,12 +918,14 @@ class Host {
   // and must destroy the host first. With shards, EVERY shard attaches
   // the same store: appends are batched per flush and the store's one
   // internal mutex serializes the (rare) concurrent flushes.
+  // @plane(control)
   void AttachStore(store::DurableStore* s) { store_ = s; }
 
   // Join a shard group (call BEFORE any poll thread starts). This host
   // becomes shard `shard_id` of `g->n`: conn ids gain the shard
   // prefix, cross-shard deliveries ride the group's SPSC rings, and
   // the group's doorbell for this shard wakes our epoll loop.
+  // @plane(control)
   int JoinGroup(ring::ShardGroup* g, int shard_id) {
     if (!g || shard_id < 0 || shard_id >= g->n ||
         g->n > ring::kMaxShards)
@@ -935,6 +947,7 @@ class Host {
   // Record one observation into a telemetry stage from the POLL THREAD
   // only (the native server's resume-replay drain runs there); the
   // wrong-thread refusal mirrors ConnIdleMs.
+  // @plane(poll)
   int NoteStage(int stage, uint64_t ns) {
     pthread_t poller = poll_thread_.load(std::memory_order_acquire);
     if (poller != pthread_t{} && !pthread_equal(poller, pthread_self()))
@@ -953,6 +966,7 @@ class Host {
   // caught exactly this against Drop's erase). The product calls it
   // from _housekeep inside the poll step; a wrong-thread call fails
   // fast with -2 instead of silently racing.
+  // @plane(poll)
   long ConnIdleMs(uint64_t id) const {
     pthread_t poller = poll_thread_.load(std::memory_order_acquire);
     if (poller != pthread_t{} && !pthread_equal(poller, pthread_self())) {
@@ -987,6 +1001,8 @@ class Host {
   // Run one event-loop step on the calling thread; fill `buf` with as
   // many whole event records as fit. Returns bytes written (0 on
   // timeout with no events).
+  // @plane(poll) — the nativecheck root: everything reachable from
+  // here runs on the poll thread (tools/nativecheck rule 1)
   long Poll(uint8_t* buf, size_t cap, int timeout_ms) {
     poll_thread_.store(pthread_self(), std::memory_order_release);
     trace_cyc_used_ = 0;  // the per-cycle sampler budget (TraceSample)
@@ -1013,6 +1029,7 @@ class Host {
       if (group_) DrainShardRings();
       if (!lane_pending_.empty()) LaneStaleScan();
       SnRexmitScan();    // qos1-over-UDP retransmit timeouts
+      TrunkHelloScan();  // old-peer HELLO grace deadlines (v0 links)
       FlushDurables();   // catch-all for appends with no dirty socket
       FlushTaps();
       FlushAcks();
@@ -1430,6 +1447,7 @@ class Host {
   // ``count_fast=false`` is the trunk-receiver call shape: the publish
   // arrived over a trunk link (publisher = 0, no local conn to ack) and
   // counts as kStTrunkIn at the call site, not kStFastIn here.
+  // @admit-gated — callers run the ladder (ShardAdmit) first
   void FanOut(uint64_t publisher, uint8_t qos, uint16_t pid,
               std::string_view topic, std::string_view payload,
               bool count_fast = true) {
@@ -2305,6 +2323,7 @@ class Host {
   // shared delivery frames were already built once per publish; the tap
   // plane now follows the same discipline. flags: bit0 = payload
   // inline, bits1-2 = qos, bit3 = publisher DUP.
+  // @admit-gated — a tap copy is a side effect of an ADMITTED publish
   void EmitTap(uint64_t publisher, uint8_t qos, bool dup_flag,
                std::string_view topic, std::string_view payload) {
     stats_[kStTaps].fetch_add(1, std::memory_order_relaxed);
@@ -2887,11 +2906,13 @@ class Host {
   void TrunkDial(uint64_t peer_id, trunk::Peer& p) {
     if (p.sock_tag) {
       auto sit = trunk_socks_.find(p.sock_tag);
-      if (sit != trunk_socks_.end() && sit->second.connecting)
-        return;  // a dial is already in flight — killing it on every
-      //           retry tick would livelock any connect slower than
-      //           the redial cadence (the kernel's own connect timeout
-      //           eventually fails it and emits DOWN)
+      if (sit != trunk_socks_.end()
+          && (sit->second.connecting || p.hello_pending))
+        return;  // a dial (or the HELLO grace) is already in flight —
+      //           killing it on every retry tick would livelock any
+      //           connect slower than the redial cadence (the kernel's
+      //           own connect timeout eventually fails it and emits
+      //           DOWN; the HELLO grace is deadline-bounded)
       TrunkSockDead(p.sock_tag, "redial");  // replace established link
     }
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
@@ -2930,32 +2951,68 @@ class Host {
     if (rc == 0) TrunkUp(peer_id, p);
   }
 
-  // Link established: replay unacked qos1 batches BEFORE any new
-  // traffic (they carry their original seqs; the receiver acks them and
-  // the cumulative trim retires them), then tell Python (kind 9 sub 1)
-  // so it can flush permits — the ordering guard for the punt→trunk
-  // flip, same reasoning as the slow→fast permit grant.
+  // Link established: HELLO first (round 13 — advertise our wire
+  // version before any batch), then WAIT for the answer (or the grace
+  // deadline, for old peers that ignore unknown record types) before
+  // completing the link: the qos1 replay must go out at the link's
+  // NEGOTIATED version, or a shadow carrying trace annotations would
+  // always downshift to v0 (the round-13 carried edge) — and a v1
+  // shadow must never hit a v0 peer's decoder. TrunkCompleteUp then
+  // replays BEFORE any new traffic (p.up stays false through the
+  // grace, so remote entries punt conservatively — the link-down
+  // ladder, bounded by kTrunkHelloGraceMs) and tells Python (kind 9
+  // sub 1) so it can flush permits — the ordering guard for the
+  // punt→trunk flip, same reasoning as the slow→fast permit grant.
   void TrunkUp(uint64_t peer_id, trunk::Peer& p) {
-    p.up = true;
     auto sit = trunk_socks_.find(p.sock_tag);
-    if (sit != trunk_socks_.end()) {
-      // HELLO first (round 13): advertise our wire version before any
-      // batch. The peer's answer (TrunkRead) raises p.wire_ver; until
-      // then — and forever against an old peer that ignores unknown
-      // record types — entries go out v0 with trace ids stripped.
-      if (trunk_wire_max_ >= 1) {
-        char hv = static_cast<char>(trunk_wire_max_);
-        trunk::AppendRecord(&sit->second.outbuf, trunk::kRecHello,
-                            &hv, 1);
-      }
-      for (const trunk::Unacked& u : p.unacked) {
-        if (u.q1_record.empty()) continue;
-        sit->second.outbuf += u.q1_record;
-        stats_[kStTrunkReplays].fetch_add(1, std::memory_order_relaxed);
-      }
-      char sub = 1;
-      events_.push_back(EncodeRecord(9, peer_id, &sub, 1));
+    if (sit == trunk_socks_.end()) return;
+    if (trunk_wire_max_ >= 1) {
+      char hv = static_cast<char>(trunk_wire_max_);
+      trunk::AppendRecord(&sit->second.outbuf, trunk::kRecHello, &hv, 1);
+      p.hello_pending = true;
+      p.hello_deadline_ms = NowMs() + kTrunkHelloGraceMs;
+      trunk_hello_pending_++;
       TrunkFlushSock(p.sock_tag, sit->second);
+      return;  // TrunkCompleteUp runs on the answer or the deadline
+    }
+    TrunkCompleteUp(peer_id, p);
+  }
+
+  // Negotiation resolved (answer arrived, deadline passed, or this
+  // host speaks v0 and never negotiates): replay the unacked qos1 ring
+  // at the negotiated version, then emit UP.
+  void TrunkCompleteUp(uint64_t peer_id, trunk::Peer& p) {
+    if (p.hello_pending) {
+      p.hello_pending = false;
+      if (trunk_hello_pending_) trunk_hello_pending_--;
+    }
+    auto sit = trunk_socks_.find(p.sock_tag);
+    if (sit == trunk_socks_.end()) return;  // link died in the window
+    p.up = true;
+    for (const trunk::Unacked& u : p.unacked) {
+      if (u.q1_record.empty()) continue;
+      // the shadow persists the sampled trace ids (round 14); a
+      // reconnect that negotiated below v1 strips them losslessly —
+      // never put bytes on a wire the peer cannot parse
+      if (u.has_trace && p.wire_ver < 1)
+        sit->second.outbuf += trunk::StripTraceRecord(u.q1_record);
+      else
+        sit->second.outbuf += u.q1_record;
+      stats_[kStTrunkReplays].fetch_add(1, std::memory_order_relaxed);
+    }
+    char sub = 1;
+    events_.push_back(EncodeRecord(9, peer_id, &sub, 1));
+    TrunkFlushSock(p.sock_tag, sit->second);
+  }
+
+  // Once per poll cycle: complete any link whose HELLO answer never
+  // came within the grace (an old peer) at wire v0.
+  void TrunkHelloScan() {
+    if (!trunk_hello_pending_) return;
+    uint64_t now = NowMs();
+    for (auto& [peer_id, p] : trunk_peers_) {
+      if (p.hello_pending && p.sock_tag && now >= p.hello_deadline_ms)
+        TrunkCompleteUp(peer_id, p);
     }
   }
 
@@ -3015,7 +3072,12 @@ class Host {
       pit->second.sock_tag = 0;
       pit->second.up = false;
       // per-LINK negotiation: the next connect re-runs HELLO (the
-      // replacement peer may be an older build)
+      // replacement peer may be an older build); a death inside the
+      // HELLO grace clears the pending state with the link
+      if (pit->second.hello_pending) {
+        pit->second.hello_pending = false;
+        if (trunk_hello_pending_) trunk_hello_pending_--;
+      }
       pit->second.wire_ver = 0;
       // remote entries now behave as punt markers (TryFast reads
       // p.up); the unacked ring is KEPT for the reconnect replay.
@@ -3070,11 +3132,22 @@ class Host {
       } else if (type == trunk::kRecHello && blen >= 1) {
         uint8_t theirs = static_cast<uint8_t>(body[0]);
         if (s.dialer) {
-          // the peer's answer: the link speaks min(ours, theirs)
+          // the peer's answer: the link speaks min(ours, theirs) —
+          // and negotiation resolving completes the deferred link
+          // bring-up (qos1 replay at the negotiated version + UP)
           auto pit = trunk_peers_.find(s.peer_id);
-          if (pit != trunk_peers_.end() && pit->second.sock_tag == tag)
+          if (pit != trunk_peers_.end() && pit->second.sock_tag == tag) {
             pit->second.wire_ver =
                 theirs < trunk_wire_max_ ? theirs : trunk_wire_max_;
+            if (pit->second.hello_pending) {
+              uint64_t peer_id = s.peer_id;
+              TrunkCompleteUp(peer_id, pit->second);
+              // CompleteUp's replay flush may have hit a dead socket:
+              // TrunkSockDead then erased `s` out from under this read
+              // loop (the TrunkEvent-after-flush guard, applied here)
+              if (!trunk_socks_.count(tag)) return;
+            }
+          }
         } else if (trunk_wire_max_ >= 1) {
           // receiver side: answer with our version (an old dialer
           // never sends HELLO, so this branch never fires against one)
@@ -3210,6 +3283,7 @@ class Host {
   // qos1 entries ALSO append a full copy to the qos1-only shadow that
   // becomes this batch's replay record. One FIFO per peer keeps
   // per-topic order trivially (total order per link).
+  // @admit-gated — TrunkEligible decides BEFORE the entry lands here
   void TrunkEnqueue(uint64_t peer_id, uint64_t origin, uint8_t qos,
                     bool dup, std::string_view topic,
                     std::string_view payload) {
@@ -3229,8 +3303,15 @@ class Host {
       p.have_prev = true;
     }
     if (qos) {
+      // the replay shadow keeps the SAMPLED id even on a v0 link: the
+      // replay happens on a FUTURE link whose version is negotiated
+      // then — TrunkCompleteUp strips at replay time when that link
+      // speaks v0 (round 14; the shadow used to be unconditionally v0
+      // and a replayed batch always lost its trace annotation)
       trunk::AppendEntry(&p.q1_batch, origin, qos, dup,
-                         /*inline_payload=*/true, topic, payload);
+                         /*inline_payload=*/true, topic, payload,
+                         cur_trace_);
+      if (cur_trace_) p.q1_has_trace = true;
       p.q1_n++;
     } else {
       p.q0_n++;
@@ -3262,6 +3343,7 @@ class Host {
     trunk::Unacked u;
     u.seq = seq;
     u.t0_ns = telemetry_ ? NowNs() : 0;
+    u.has_trace = p.q1_has_trace;
     if (p.q1_n) {
       std::string q1body;
       q1body.reserve(12 + p.q1_batch.size());
@@ -3316,6 +3398,7 @@ class Host {
     p.batch_n = 0;
     p.q1_n = 0;
     p.q0_n = 0;
+    p.q1_has_trace = false;
     p.prev_payload.clear();
     p.have_prev = false;
   }
@@ -3400,6 +3483,7 @@ class Host {
   // >= 2 free slots (room for the open batch plus one mid-publish
   // seal — a single publish can trigger at most one byte-cap seal, so
   // the cycle-end seal always has a slot).
+  // @admit-check
   bool RingRoom(int dst) const {
     return group_ != nullptr &&
            group_->alive[dst].load(std::memory_order_acquire) &&
@@ -3412,6 +3496,7 @@ class Host {
   // the qos1 replay-ring bound is enforced where the ring lives
   // (shard 0 — ring-forwarded entries may overshoot it by the
   // in-flight cycle, the trunk's documented soft bound).
+  // @admit-check
   bool TrunkEligible(uint64_t peer, uint8_t qos,
                      size_t entry_bytes) const {
     if (qos == 2 || entry_bytes > trunk::kMaxEntryBytes) return false;
@@ -3429,6 +3514,7 @@ class Host {
   // cross-shard entries + shard 0 when trunk legs must ride the ring)
   // and check ring room for each. False = the publish must degrade to
   // a punt — called BEFORE any side effect, the trunk discipline.
+  // @admit-check
   bool ShardAdmit() {
     if (!group_) return true;
     xdst_scratch_.clear();
@@ -3457,6 +3543,7 @@ class Host {
   // (trunk forward from a non-trunk shard). Bit 63 of the target word
   // marks the MULTI-TARGET form below; every real target (conn ids
   // top out at bit 59, the trunk owner bit is 62) keeps it clear.
+  // @admit-gated — RingRoom/ShardAdmit decide BEFORE a slot is spent
   void XShip(int dst, uint64_t target, uint64_t origin, uint8_t qos,
              bool dup, std::string_view topic, std::string_view payload) {
     std::string& b = XBatch(dst);
@@ -3476,6 +3563,7 @@ class Host {
   // per-target min-qos rides bits 60-61 of each target word (conn ids
   // top out at bit 59). Halves ring bytes and consumer decode for
   // wide audiences vs one single-target entry per subscriber.
+  // @admit-gated — RingRoom/ShardAdmit decide BEFORE a slot is spent
   void XShipMulti(int dst, const std::vector<uint64_t>& targets,
                   uint64_t origin, uint8_t qos, std::string_view topic,
                   std::string_view payload) {
@@ -4829,6 +4917,7 @@ class Host {
   // the sampled subset is deterministic. Rate-bounded per poll cycle
   // (kTraceMaxPerCycle): a blast cycle draining thousands of publishes
   // clips its extra picks instead of flooding the span plane.
+  // @admit-gated — the commit point sits AFTER every punt decision
   void TraceSample(uint64_t publisher) {
     cur_trace_ = 0;
     if (!telemetry_ || !tracing_) return;
@@ -5190,9 +5279,9 @@ class Host {
   std::unordered_map<uint64_t, Conn> conns_;
   std::deque<std::string> events_;  // encoded records awaiting pickup
   std::mutex mu_;
-  std::vector<std::pair<uint64_t, std::string>> pending_;
-  std::vector<uint64_t> pending_closes_;
-  std::vector<Op> pending_ops_;
+  std::vector<std::pair<uint64_t, std::string>> pending_;         // @guards(mu_)
+  std::vector<uint64_t> pending_closes_;                          // @guards(mu_)
+  std::vector<Op> pending_ops_;                                   // @guards(mu_)
   // fast path (poll-thread-owned)
   SubTable subs_;
   std::vector<const SubEntry*> match_scratch_;
@@ -5299,6 +5388,7 @@ class Host {
   int listen_trunk_fd_ = -1;
   int trunk_port_ = 0;
   uint64_t next_trunk_tag_ = 1;
+  uint32_t trunk_hello_pending_ = 0;  // links inside the HELLO grace
   std::unordered_map<uint64_t, trunk::Sock> trunk_socks_;  // tag → sock
   std::unordered_map<uint64_t, trunk::Peer> trunk_peers_;  // peer → state
   std::vector<uint64_t> trunk_dirty_;    // peers batched this cycle

@@ -151,6 +151,7 @@ class DurableStore {
   // dir == "" runs on anonymous mmaps: the full durable PLANE (fast
   // path preserved, kind-10 delivery, replay within the process) minus
   // restart survival — the default when no store_dir is configured.
+  // @locked(mu_) — construction precedes any concurrent caller
   DurableStore(std::string dir, size_t seg_bytes, int fsync_policy)
       : dir_(std::move(dir)),
         seg_bytes_(seg_bytes < 64 * 1024 ? 64 * 1024 : seg_bytes),
@@ -162,6 +163,7 @@ class DurableStore {
     if (segs_.empty()) Roll(seg_bytes_);
   }
 
+  // @locked(mu_) — destruction outlives every concurrent caller
   ~DurableStore() {
     for (auto& [id, s] : segs_) {
       if (s.base) {
@@ -175,7 +177,13 @@ class DurableStore {
   DurableStore(const DurableStore&) = delete;
   DurableStore& operator=(const DurableStore&) = delete;
 
-  bool ok() const { return ok_; }
+  // Mid-run degradation flag (Roll flips it on the poll thread while
+  // Python threads ask): locked like every other mu_-guarded read —
+  // the unguarded return nativecheck surfaced was a real data race.
+  bool ok() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return ok_;
+  }
 
   // sid -> stable token: returns the recovered token when the sid was
   // seen in a previous life (markers key on it), else registers a new
@@ -460,7 +468,9 @@ class DurableStore {
   }
 
   // Decode n batch entries; explicit_guids covers the REWRITE layout
-  // (guids written into *guids). Caller holds mu_.
+  // (guids written into *guids). Caller holds mu_; pure parsing into
+  // locals, so it carries no lock annotation (nothing guarded is
+  // touched — nativecheck's load-bearing contract).
   bool ParseEntries(const char* p, size_t len, uint32_t n, uint64_t ts,
                     bool explicit_guids, std::vector<uint64_t>* guids,
                     std::vector<StoredMsg>* out) {
@@ -516,6 +526,7 @@ class DurableStore {
     return true;
   }
 
+  // @locked(mu_)
   void IndexMsg(uint64_t guid, StoredMsg&& m, uint32_t seg) {
     if (m.toks.empty()) return;            // nothing to replay: skip
     if (msgs_.count(guid)) return;         // recovery: first record wins
@@ -527,6 +538,7 @@ class DurableStore {
     msgs_.emplace(guid, std::move(m));
   }
 
+  // @locked(mu_)
   bool ApplyConsume(uint64_t token, uint64_t guid) {
     auto pit = pending_.find(token);
     if (pit == pending_.end() || !pit->second.erase(guid)) return false;
@@ -546,6 +558,8 @@ class DurableStore {
 
   // -- segments ------------------------------------------------------------
 
+  // @locked(mu_) @blocking — open/ftruncate/mmap of a fresh segment
+  // (amortized over a whole segment of appends; see waivers.py)
   void Roll(size_t min_bytes) {
     size_t cap = std::max(seg_bytes_, min_bytes);
     Segment s;
@@ -588,6 +602,7 @@ class DurableStore {
     active_ = &segs_.emplace(s.id, s).first->second;
   }
 
+  // @locked(mu_)
   void DropSeg(Segment& s) {
     if (s.base) munmap(s.base, s.cap);
     if (s.fd >= 0) {
@@ -599,6 +614,7 @@ class DurableStore {
     stats_[kSsGcSegments]++;
   }
 
+  // @locked(mu_)
   void AppendFrame(uint8_t type, const char* body, size_t blen) {
     size_t need = 8 + 1 + blen;
     if (!active_ || active_->end + need > active_->cap)
@@ -622,6 +638,9 @@ class DurableStore {
     dirty_ = true;
   }
 
+  // @locked(mu_) @blocking — msync MS_SYNC is the fsync policy's disk
+  // wait; the poll-plane path through FlushDurables is the documented
+  // PUBACK-after-fsync contract (see waivers.py)
   void SyncSeg(Segment& s) {
     if (s.fd < 0 || !s.base) return;
     size_t pg = static_cast<size_t>(sysconf(_SC_PAGESIZE));
@@ -630,6 +649,7 @@ class DurableStore {
     dirty_ = false;
   }
 
+  // @locked(mu_)
   void MaybeSync() {
     if (!dirty_ || !active_ || active_->fd < 0) return;
     if (fsync_ == kFsyncBatch) {
@@ -645,6 +665,7 @@ class DurableStore {
 
   // -- recovery ------------------------------------------------------------
 
+  // @locked(mu_) @blocking — boot-time directory scan + mmap
   void Recover() {
     std::vector<uint32_t> ids;
     if (DIR* d = opendir(dir_.c_str())) {
@@ -698,6 +719,7 @@ class DurableStore {
     // resume appending AFTER the last valid frame of the newest segment
   }
 
+  // @locked(mu_)
   void ScanSeg(Segment* s) {
     size_t pos = 0;
     while (pos + 9 <= s->cap) {
@@ -721,6 +743,7 @@ class DurableStore {
     s->end = pos;
   }
 
+  // @locked(mu_)
   void ApplyRecord(uint8_t type, const char* body, size_t blen,
                    uint32_t seg) {
     if (type == kRecRegister && blen >= 10) {
@@ -763,23 +786,25 @@ class DurableStore {
     }
   }
 
-  std::string dir_;
-  size_t seg_bytes_;
-  int fsync_;
-  bool ok_ = true;
-  bool dirty_ = false;
-  uint64_t last_sync_ms_ = 0;
-  uint64_t next_guid_ = 1;
-  uint64_t next_token_ = 1;
-  uint32_t next_seg_id_ = 1;
+  std::string dir_;        // immutable after construction
+  size_t seg_bytes_;       // immutable after construction
+  int fsync_;              // immutable after construction
+  bool ok_ = true;         // @guards(mu_) — Roll flips it mid-run
+  bool dirty_ = false;             // @guards(mu_)
+  uint64_t last_sync_ms_ = 0;      // @guards(mu_)
+  uint64_t next_guid_ = 1;         // @guards(mu_)
+  uint64_t next_token_ = 1;        // @guards(mu_)
+  uint32_t next_seg_id_ = 1;       // @guards(mu_)
   std::mutex mu_;
-  std::map<uint32_t, Segment> segs_;   // ordered: recovery + GC walk
-  Segment* active_ = nullptr;
-  std::unordered_map<std::string, uint64_t> token_of_;
-  std::unordered_map<uint64_t, StoredMsg> msgs_;
+  // ordered: recovery + GC walk
+  std::map<uint32_t, Segment> segs_;                        // @guards(mu_)
+  Segment* active_ = nullptr;                               // @guards(mu_)
+  std::unordered_map<std::string, uint64_t> token_of_;      // @guards(mu_)
+  std::unordered_map<uint64_t, StoredMsg> msgs_;            // @guards(mu_)
   // token -> ordered guid set (fetch replays in guid = arrival order)
-  std::unordered_map<uint64_t, std::map<uint64_t, uint8_t>> pending_;
-  uint64_t stats_[kSsStatCount] = {};
+  std::unordered_map<uint64_t,
+                     std::map<uint64_t, uint8_t>> pending_; // @guards(mu_)
+  uint64_t stats_[kSsStatCount] = {};                       // @guards(mu_)
 };
 
 }  // namespace store

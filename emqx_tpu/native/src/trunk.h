@@ -110,6 +110,57 @@ inline void AppendEntry(std::string* out, uint64_t origin, uint8_t qos,
   }
 }
 
+// Re-encode one framed qos1 replay record at wire v0: clear each
+// entry's trace flag (bit 4) and drop its [u64 trace_id] — the
+// lossless strip (topic/payload untouched) TrunkEnqueue applies to
+// LIVE entries on v0 links, applied at REPLAY time to a shadow built
+// on a v1 link whose reconnect negotiated lower. Replay-shadow
+// entries are always payload-inline. Any parse inconsistency returns
+// the input unchanged (the caller built this record; a malformed one
+// is an upstream bug, and v0 peers reject oversized/garbled records
+// at the link layer anyway).
+inline std::string StripTraceRecord(const std::string& rec) {
+  if (rec.size() < 5 + 12 || static_cast<uint8_t>(rec[4]) != kRecBatch)
+    return rec;
+  const char* body = rec.data() + 5;
+  size_t blen = rec.size() - 5;
+  uint32_t n = 0;
+  memcpy(&n, body + 8, 4);
+  std::string out_body;
+  out_body.reserve(blen);
+  out_body.append(body, 12);  // [u64 seq][u32 n] unchanged
+  size_t pos = 12;
+  for (uint32_t i = 0; i < n; i++) {
+    if (pos + 11 > blen) return rec;
+    char hdr[11];
+    memcpy(hdr, body + pos, 11);
+    uint8_t flags = static_cast<uint8_t>(hdr[8]);
+    uint16_t tlen = 0;
+    memcpy(&tlen, hdr + 9, 2);
+    hdr[8] = static_cast<char>(flags & ~0x10);
+    pos += 11;
+    if (pos + tlen > blen) return rec;
+    out_body.append(hdr, 11);
+    out_body.append(body + pos, tlen);
+    pos += tlen;
+    if (flags & 0x10) {
+      if (pos + 8 > blen) return rec;
+      pos += 8;  // the dropped trace id
+    }
+    if (flags & 1) {
+      if (pos + 4 > blen) return rec;
+      uint32_t pl = 0;
+      memcpy(&pl, body + pos, 4);
+      if (pos + 4 + pl > blen) return rec;
+      out_body.append(body + pos, 4 + pl);
+      pos += 4 + pl;
+    }
+  }
+  std::string out;
+  AppendRecord(&out, kRecBatch, out_body.data(), out_body.size());
+  return out;
+}
+
 // One trunk TCP socket (dialer or accepted), poll-thread-owned.
 struct Sock {
   int fd = -1;
@@ -127,8 +178,12 @@ struct Unacked {
   uint64_t t0_ns = 0;       // flush stamp (0 = telemetry off)
   // pre-framed qos1-only wire record for this batch ("" = batch held
   // no elevated-qos entries; nothing to replay, ring entry exists only
-  // for the RTT stage)
+  // for the RTT stage). Built at the HIGHEST wire version the entries
+  // carry (sampled trace ids persist in the shadow, round 14): replay
+  // emits it verbatim on a >= v1 link and re-encodes it at v0 —
+  // StripTraceRecord — when the reconnected peer negotiated lower.
   std::string q1_record;
+  bool has_trace = false;   // any entry carries the bit-4 extension
 };
 
 // Per-peer trunk state: link identity + the batch under construction.
@@ -140,11 +195,18 @@ struct Peer {
   uint8_t wire_ver = 0;
   std::string addr;         // redial target (Python drives redial)
   uint16_t port = 0;
+  // HELLO sent on the live link, answer (or the bounded grace
+  // deadline, for old peers that never answer) still pending: the
+  // qos1 replay + the UP event wait for the negotiated version, so a
+  // replayed batch can keep its trace annotation on v1 links
+  bool hello_pending = false;
+  uint64_t hello_deadline_ms = 0;
   std::string batch;        // BATCH entries accumulated this cycle
   uint32_t batch_n = 0;
   uint32_t q0_n = 0;        // qos0 entries in `batch` (shed accounting)
   std::string q1_batch;     // qos1-only copies (full payloads, no dedup)
   uint32_t q1_n = 0;
+  bool q1_has_trace = false;  // q1_batch holds >= 1 bit-4 trace entry
   std::string prev_payload; // payload-dedup reference (batch-scoped)
   bool have_prev = false;
   uint64_t next_seq = 1;

@@ -212,12 +212,19 @@ def test_cross_shard_span_stitching_parity():
         await pub.publish("xs/t", b"cross", qos=0)
         m = await sub.recv(timeout=10)
         assert m.payload == b"cross"
+        # wait for the FULL stage set, not just the consumer shard's
+        # half: the two shards flush kind-12 batches on their OWN poll
+        # cycles, and under load the subscriber shard's ring_cross/
+        # deliver_write can fold BEFORE the publisher shard's
+        # ingress/route batch lands (deflaked in round 14 — the
+        # timeline is assembled from both, so assert once both arrived)
+        want = {"ingress", "route", "ring_cross", "deliver_write"}
         assert await _await(lambda: len(server.spans) > n0 and any(
-            "deliver_write" in server.spans.stages(tid)
-            for tid, _ in server.spans.recent(2)))
+            want <= set(server.spans.stages(tid))
+            for tid, _ in server.spans.recent(2))), server.spans.recent(2)
         tid, spans = next(
             (t, s) for t, s in server.spans.recent(2)
-            if "deliver_write" in [x[1] for x in s])
+            if want <= {x[1] for x in s})
         stages = [s[1] for s in spans]
         shards = {s[1]: s[2] for s in spans}
         assert stages == ["ingress", "route", "ring_cross",
@@ -566,3 +573,192 @@ def test_tracing_escape_hatch():
 
     run(main())
     server.stop()
+
+
+# -- qos1 replay shadow at the negotiated wire version (round 14) -------------
+
+
+def _hello_sink(answer_hello: bool):
+    """A test-controlled trunk endpoint: accepts one link, reads trunk
+    records, answers HELLO at wire v1 when asked to, and NEVER acks a
+    batch — so the dialer's qos1 replay ring provably holds every
+    flushed batch when the link dies."""
+    import socket
+    import struct
+    import threading
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    state = {"conns": [], "srv": srv, "port": srv.getsockname()[1]}
+
+    def loop():
+        try:
+            c, _ = srv.accept()
+        except OSError:
+            return
+        state["conns"].append(c)
+        c.settimeout(0.2)
+        buf = b""
+        while True:
+            try:
+                chunk = c.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while len(buf) >= 5:
+                (ln,) = struct.unpack_from("<I", buf, 0)
+                if len(buf) < 4 + ln:
+                    break
+                rtype = buf[4]
+                buf = buf[4 + ln:]
+                if rtype == 4 and answer_hello:
+                    try:    # HELLO answer: this sink speaks wire v1
+                        c.sendall(struct.pack("<IB", 2, 4) + bytes([1]))
+                    except OSError:
+                        return
+                # type 2 (BATCH) is read and dropped: never acked
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return state
+
+
+def _trunk_replay_pair(suffix: str):
+    """Two apps + native servers with every publish sampled, A's
+    forward_fn wired as the Python oracle lane into B (the
+    test_native_trunk kill-replay fixture + tracing)."""
+    app_a, app_b = BrokerApp(), BrokerApp()
+    app_a.broker.node = f"nA{suffix}"
+    app_b.broker.node = f"nB{suffix}"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0,
+                               trace_sample_shift=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0,
+                               trace_sample_shift=0)
+
+    def forward(dest, filt, msg):
+        deliveries = {}
+        app_b.broker._dispatch_local(filt, msg, deliveries)
+        app_b.cm.dispatch(deliveries)
+    app_a.broker.forward_fn = forward
+    srv_a.start()
+    srv_b.start()
+    return app_a, app_b, srv_a, srv_b
+
+
+async def _replay_phase1(app_a, srv_a, srv_b, sink, topic, n=6):
+    """Connect sub(B)/pub(A), earn the permit, trunk ``n`` sampled
+    qos1 publishes into the never-acking sink, and return (sub, pub,
+    payloads, flushed trace ids)."""
+    sub = MqttClient(port=srv_b.port, clientid="rp-s")
+    await sub.connect()
+    await sub.subscribe(topic, qos=1)
+    pub = MqttClient(port=srv_a.port, clientid="rp-p")
+    await pub.connect()
+    app_a.broker.router.add_route(topic, "nodeB")
+    srv_a.trunk_register("nodeB", "127.0.0.1", sink["port"])
+    assert await _await(
+        lambda: srv_a.trunk_peer_status().get("nodeB"), timeout=8)
+    await _warm(pub, sub, topic, qos=1)
+    payloads = [b"r%03d" % i for i in range(n)]
+    for p in payloads:
+        await pub.publish(topic, p, qos=1)
+        await asyncio.sleep(0.05)   # one poll cycle per publish: the
+        #                             per-cycle sampler cap never clips
+    assert await _await(
+        lambda: srv_a.fast_stats()["trunk_out"] >= n), srv_a.fast_stats()
+    flushed = [t for t, s in srv_a.spans.recent(64)
+               if "trunk_flush" in [x[1] for x in s]]
+    assert len(flushed) >= n - 1, (flushed, srv_a.spans.recent(64))
+    return sub, pub, payloads, flushed
+
+
+def test_trunk_replay_preserves_trace_ids_on_v1_links():
+    """ROADMAP carried edge closed: the qos1 replay shadow is built at
+    the link's negotiated wire version. Kill a link whose unacked ring
+    holds SAMPLED qos1 batches, reconnect to a real v1 peer — the
+    replayed batches keep their trace annotation: B's collector
+    re-joins the SAME trace ids (trunk_recv + deliver_write) and every
+    payload arrives."""
+    app_a, app_b, srv_a, srv_b = _trunk_replay_pair("rv1")
+    sink = _hello_sink(answer_hello=True)
+    try:
+        async def main():
+            sub, pub, payloads, flushed = await _replay_phase1(
+                app_a, srv_a, srv_b, sink, "rp/x")
+            # kill the link: the ring keeps the (traced) replay shadow
+            for c in sink["conns"]:
+                c.close()
+            sink["srv"].close()
+            assert await _await(
+                lambda: not srv_a.trunk_peer_status().get("nodeB"))
+            # reconnect to B's REAL trunk (wire v1): replay at v1
+            srv_a.trunk_register("nodeB", "127.0.0.1", srv_b.trunk_port)
+            assert await _await(
+                lambda: srv_a.fast_stats()["trunk_replays"] >= 1,
+                timeout=10), srv_a.fast_stats()
+            got = []
+            while len(got) < len(payloads):
+                m = await sub.recv(timeout=8)
+                got.append(m.payload)
+            assert sorted(got) == sorted(payloads), got
+            # the SAME ids A flushed re-join on B — the replayed batch
+            # kept its trace annotation across the kill
+            assert await _await(
+                lambda: any("trunk_recv" in srv_b.spans.stages(t)
+                            for t in flushed)), srv_b.spans.recent(16)
+            rejoined = [t for t in flushed
+                        if "trunk_recv" in srv_b.spans.stages(t)
+                        and "deliver_write" in srv_b.spans.stages(t)]
+            assert len(rejoined) >= len(payloads) - 1, (
+                flushed, srv_b.spans.recent(16))
+            await sub.close(); await pub.close()
+
+        run(main())
+    finally:
+        srv_a.stop(); srv_b.stop()
+
+
+def test_trunk_replay_strips_trace_ids_for_v0_peers():
+    """The symmetric safety edge: a replay shadow built on a v1 link
+    that reconnects to a v0 peer is re-encoded at v0 (StripTraceRecord)
+    — every payload still arrives (lossless strip) and the v0 peer
+    never sees a trace id."""
+    app_a, app_b, srv_a, srv_b = _trunk_replay_pair("rv0")
+    sink = _hello_sink(answer_hello=True)
+    try:
+        async def main():
+            sub, pub, payloads, flushed = await _replay_phase1(
+                app_a, srv_a, srv_b, sink, "rq/x")
+            for c in sink["conns"]:
+                c.close()
+            sink["srv"].close()
+            assert await _await(
+                lambda: not srv_a.trunk_peer_status().get("nodeB"))
+            # B becomes an old peer BEFORE the link re-negotiates: it
+            # never answers HELLO, so A completes the link at v0 after
+            # the bounded grace and strips the replay shadow
+            for h in srv_b.hosts:
+                h.set_trunk_wire(0)
+            srv_a.trunk_register("nodeB", "127.0.0.1", srv_b.trunk_port)
+            assert await _await(
+                lambda: srv_a.fast_stats()["trunk_replays"] >= 1,
+                timeout=10), srv_a.fast_stats()
+            got = []
+            while len(got) < len(payloads):
+                m = await sub.recv(timeout=8)
+                got.append(m.payload)
+            assert sorted(got) == sorted(payloads), got   # lossless
+            await asyncio.sleep(0.4)
+            for t in flushed:   # ...but no id ever reached B
+                assert srv_b.spans.trace(t) == [], srv_b.spans.recent(16)
+            await sub.close(); await pub.close()
+
+        run(main())
+    finally:
+        srv_a.stop(); srv_b.stop()

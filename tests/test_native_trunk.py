@@ -196,6 +196,12 @@ def test_per_topic_ordering_across_batch_flushes():
             for i in range(n):
                 await pub.publish("ord/x", b"x%04d" % i, qos=0)
                 await pub.publish("ord/y", b"y%04d" % i, qos=0)
+                if i % 50 == 49:
+                    # force >= 2 poll cycles: on a heavily loaded box
+                    # the whole pipelined burst can land in ONE read
+                    # batch (= one trunk batch), starving the
+                    # "really batched" assertion below of its premise
+                    await asyncio.sleep(0.02)
             seen = {"ord/x": [], "ord/y": []}
             deadline = time.monotonic() + 20
             while (sum(len(v) for v in seen.values()) < 2 * n

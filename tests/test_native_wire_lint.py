@@ -9,42 +9,30 @@ compiler needed) and assert the two sides agree per kind on the exact
 (width, name) token set — the cross-plane analogue of the StatSlot
 lint: a field added, renamed, or widened on ONE side fails the build
 instead of silently mis-decoding every later field.
+
+Round 14: the wire-comment parser moved into the shared nativecheck
+source model (tools/nativecheck/model.py wire_kind_sections /
+wire_tokens — [u8 1]-style sub-kind markers are still excluded by the
+identifier-start requirement); the assertions are unchanged.
 """
 
 import os
-import re
+import sys
 
 from emqx_tpu import native
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.nativecheck.model import (  # noqa: E402
+    wire_kind_sections, wire_tokens)
 
 HOST_CC = os.path.join(os.path.dirname(__file__), "..", "emqx_tpu",
                        "native", "src", "host.cc")
 
-# [u32 name] / [u64 name x ntok] — sub-kind markers like [u8 1] are
-# excluded by the identifier-start requirement
-_TOKEN_RE = re.compile(
-    r"\[(u8|u16|u32|u64)\s+([A-Za-z_]\w*)(?:\s+x\s+\w+)?\]")
-_KIND_RE = re.compile(r"kind\s+(\d+)\s*=")
-
-
-def _wire_comment() -> str:
-    """The contiguous header-comment region documenting the event
-    record wire format (stops at the first preprocessor line)."""
-    with open(HOST_CC) as f:
-        src = f.read()
-    start = src.index("Event record wire format")
-    end = src.index("#include", start)
-    return src[start:end]
-
 
 def _kind_sections() -> dict[int, str]:
-    """kind number -> its slice of the wire-format comment."""
-    text = _wire_comment()
-    marks = [(int(m.group(1)), m.start()) for m in _KIND_RE.finditer(text)]
-    out: dict[int, str] = {}
-    for i, (kind, at) in enumerate(marks):
-        nxt = marks[i + 1][1] if i + 1 < len(marks) else len(text)
-        out[kind] = text[at:nxt]
-    return out
+    """kind number -> its slice of the wire-format header comment."""
+    with open(HOST_CC) as f:
+        return wire_kind_sections(f.read())
 
 
 def test_every_documented_kind_has_a_python_constant():
@@ -71,7 +59,7 @@ def test_wire_fields_match_host_cc_comment_per_kind():
     on either side fails until both are updated."""
     sections = _kind_sections()
     for kind, want in sorted(native.WIRE_FIELDS.items()):
-        got = frozenset(_TOKEN_RE.findall(sections[kind]))
+        got = wire_tokens(sections[kind])
         assert got == want, (
             f"kind {kind} wire drift:\n"
             f"  host.cc comment : {sorted(got)}\n"

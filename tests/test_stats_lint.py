@@ -13,12 +13,22 @@ parse the C++ source directly (no compiler needed):
 - every exported stat renders in the prometheus text exposition
   (``emqx_native_<name>``), and the histogram stage list matches the
   C++ ``HistStage`` enum the same way.
+
+Round 14: the ad-hoc C++ parsing moved into the shared nativecheck
+source model (tools/nativecheck/model.py — comment-aware enum
+extraction, the mechanical CamelCase mapping); the assertions below
+are unchanged.
 """
 
 import os
 import re
+import sys
 
 from emqx_tpu import native
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.nativecheck.model import (  # noqa: E402
+    enum_body as _model_enum_body, enumerators, snake as _snake)
 
 HOST_CC = os.path.join(os.path.dirname(__file__), "..", "emqx_tpu",
                        "native", "src", "host.cc")
@@ -30,21 +40,15 @@ def _src() -> str:
 
 
 def _enum_body(src: str, name: str) -> str:
-    m = re.search(rf"enum {name}\b[^{{]*\{{(.*?)\}};", src, re.S)
-    assert m, f"enum {name} not found in host.cc"
-    # strip // comments: slot docs routinely NAME other slots ("subset
-    # of kStFastIn"), which must not count as enumerators
-    return re.sub(r"//[^\n]*", "", m.group(1))
-
-
-def _snake(camel: str) -> str:
-    return "_".join(p.lower() for p in re.findall(r"[A-Z][a-z0-9]*", camel))
+    # shared model: // comments stripped, so slot docs that NAME other
+    # slots ("subset of kStFastIn") never count as enumerators
+    return _model_enum_body(src, name)
 
 
 def _stat_slots() -> list:
     # kStatCount is the sentinel ('a' after kSt breaks the [A-Z] match,
-    # so the regex skips it by construction)
-    return re.findall(r"\bkSt([A-Z]\w*)\b", _enum_body(_src(), "StatSlot"))
+    # so the model's enumerator regex skips it by construction)
+    return enumerators(_src(), "StatSlot", "kSt")
 
 
 def test_stat_slots_match_python_names_and_order():
@@ -66,8 +70,7 @@ def test_every_stat_slot_is_incremented_in_host_cc():
 
 
 def test_hist_stages_match_cpp_enum():
-    stages = re.findall(r"\bkHist([A-Z]\w*)\b",
-                        _enum_body(_src(), "HistStage"))
+    stages = enumerators(_src(), "HistStage", "kHist")
     stages = [s for s in stages if s != "Count"]
     assert [_snake(s) for s in stages] == list(native.HIST_STAGES)
 
@@ -158,7 +161,7 @@ def test_store_stat_names_match_store_h_enum():
     store_h = os.path.join(os.path.dirname(HOST_CC), "store.h")
     with open(store_h) as f:
         src = f.read()
-    slots = re.findall(r"\bkSs([A-Z]\w*)\b", _enum_body(src, "StoreStat"))
+    slots = enumerators(src, "StoreStat", "kSs")
     slots = [s for s in slots if s != "StatCount"]
     assert [_snake(s) for s in slots] == list(native.STORE_STAT_NAMES), (
         "store.h StoreStat drifted from native.STORE_STAT_NAMES")
@@ -231,8 +234,7 @@ def test_shard_slots_and_stage_exported():
 def test_span_stages_match_cpp_enum():
     """native.SPAN_STAGES mirrors host.cc's SpanStage enum the same
     mechanical way HIST_STAGES mirrors HistStage."""
-    stages = re.findall(r"\bkSpan([A-Z]\w*)\b",
-                        _enum_body(_src(), "SpanStage"))
+    stages = enumerators(_src(), "SpanStage", "kSpan")
     stages = [s for s in stages if s != "Count"]
     assert [_snake(s) for s in stages] == list(native.SPAN_STAGES), (
         "host.cc SpanStage drifted from native.SPAN_STAGES")
@@ -244,8 +246,7 @@ def test_ledger_reasons_prefix_and_parity():
     the observe-side canonical tuple matches the native one exactly."""
     from emqx_tpu.observe import metrics as om
 
-    reasons = re.findall(r"\bkLr([A-Z]\w*)\b",
-                         _enum_body(_src(), "LedgerReason"))
+    reasons = enumerators(_src(), "LedgerReason", "kLr")
     reasons = [s for s in reasons if s != "Count"]
     got = [_snake(s) for s in reasons]
     assert got == list(native.LEDGER_REASONS[:len(got)]), (

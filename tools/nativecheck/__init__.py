@@ -1,0 +1,13 @@
+"""nativecheck: a compiler-free concurrency & contract analyzer for
+the C++ native plane (tools/nativecheck).
+
+Entry points:
+- ``python -m tools.nativecheck``  — CLI, nonzero exit on unwaived
+  findings or stale waivers (tier-1 wires it via
+  tests/test_nativecheck.py);
+- ``tools.nativecheck.rules.run(repo)`` — programmatic API;
+- ``tools.nativecheck.model`` — the shared C++ source model the legacy
+  lints (test_stats_lint / test_native_wire_lint) also build on.
+"""
+
+from .rules import Finding, Result, run  # noqa: F401

@@ -1,0 +1,34 @@
+"""CLI: ``python -m tools.nativecheck [repo_root]``.
+
+Prints every finding as ``file:line: [rule] message`` (waived findings
+annotated with their justification) and exits nonzero when any finding
+is unwaived or any waiver is stale — the tier-1 contract."""
+
+import sys
+import time
+
+from .rules import run
+
+
+def main(argv: list) -> int:
+    repo = argv[1] if len(argv) > 1 else "."
+    t0 = time.monotonic()
+    res = run(repo)
+    for f in sorted(res.findings, key=lambda f: (f.file, f.line)):
+        mark = f" [waived: {f.waived_by}]" if f.waived_by else ""
+        print(f"{f.file}:{f.line}: [{f.rule}] {f.message}{mark}")
+    for w in res.stale_waivers:
+        print(f"waivers.py:0: [waivers] stale waiver "
+              f"{w.get('rule')}:{w.get('site')} — matches no finding; "
+              f"delete it")
+    n_unwaived = len(res.unwaived)
+    n_waived = len(res.findings) - n_unwaived
+    dt = time.monotonic() - t0
+    print(f"nativecheck: {n_unwaived} unwaived finding(s), {n_waived} "
+          f"waived, {len(res.stale_waivers)} stale waiver(s) "
+          f"[{dt:.2f}s]")
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,484 @@
+"""Shared C++ source model for the native plane's static checks.
+
+No compiler, pure stdlib: the same source-reading discipline
+``tests/test_stats_lint.py`` and ``tests/test_native_wire_lint.py``
+proved out (parse the sources directly, fail the build on drift) grown
+into one reusable model that those lints AND the nativecheck rules
+(tools/nativecheck/rules.py) share:
+
+- ``strip()``: comment/string-stripping that PRESERVES offsets (every
+  stripped char becomes a space), so a position in the stripped text is
+  a position in the raw text and line numbers survive;
+- function extraction: every function/method definition with its body
+  extent (brace-matched on the stripped text);
+- an intra-model call graph (name-based: ``store_->AppendBatch(`` and
+  ``trunk::AppendRecord(`` resolve by the trailing identifier, which is
+  what a header-only codebase with unique-enough names needs);
+- ``lock_guard``/``unique_lock`` acquisition sites with their lexical
+  block scope;
+- ``// @annotation`` parsing (see ANNOTATION GRAMMAR below) attached to
+  the function or field the comment line precedes or trails;
+- the enum/wire-comment helpers the two legacy lints used to duplicate.
+
+ANNOTATION GRAMMAR (one per comment, ``//`` comments only):
+
+  // @plane(poll|control|any)   function runs on the poll thread only /
+                                must only run before the poll thread
+                                starts (or from management threads) /
+                                is thread-safe
+  // @blocking                  function may block the calling thread
+                                (msync, disk open, ...)
+  // @guards(mu_)               field: every access must hold ``mu_``
+  // @locked(mu_)               function: runs with ``mu_`` held (or
+                                with exclusivity equivalent to it —
+                                constructors/destructors); callers are
+                                checked instead
+  // @admit-gated               function has publish side effects that
+                                must lexically FOLLOW an admit check
+  // @admit-check               function is a ladder admission check
+                                (ShardAdmit / RingRoom / TrunkEligible)
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import re
+from dataclasses import dataclass, field
+
+# C++ keywords and common non-function tokens that precede '(' but
+# never name a function definition or a call edge we care about.
+_KEYWORDS = frozenset((
+    "if", "for", "while", "switch", "return", "sizeof", "catch",
+    "alignas", "alignof", "decltype", "static_assert", "static_cast",
+    "reinterpret_cast", "const_cast", "dynamic_cast", "new", "delete",
+    "throw", "assert", "defined", "noexcept", "typeid", "alignas",
+))
+
+_ANNOT_RE = re.compile(
+    r"@(plane|guards|blocking|locked|admit-gated|admit-check)"
+    r"(?:\(([^)]*)\))?")
+
+_LOCK_RE = re.compile(
+    r"\b(?:std\s*::\s*)?(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"<[^>;]*>\s*\w+\s*\(\s*([A-Za-z_]\w*)\s*\)")
+
+_CALL_RE = re.compile(r"(?<!\w)(~?[A-Za-z_]\w*)\s*\(")
+
+_FIELD_DECL_RE = re.compile(
+    r"\b([A-Za-z_]\w*)\s*(?:\[[^\]]*\])?\s*(?:=[^;]*|\{[^;]*)?;")
+
+
+def strip(src: str) -> str:
+    """Blank out comments and string/char literals, preserving length
+    and newlines so offsets/line numbers stay valid."""
+    out = list(src)
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == "/" and i + 1 < n and src[i + 1] == "/":
+            j = src.find("\n", i)
+            j = n if j < 0 else j
+            for k in range(i, j):
+                out[k] = " "
+            i = j
+        elif c == "/" and i + 1 < n and src[i + 1] == "*":
+            j = src.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            for k in range(i, j + 2):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 2
+        elif c == '"' or c == "'":
+            q = c
+            j = i + 1
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src[j] == q:
+                    break
+                j += 1
+            for k in range(i + 1, min(j, n)):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+@dataclass
+class Annotation:
+    kind: str           # plane / guards / blocking / locked / ...
+    arg: str            # "poll", "mu_", "" ...
+    line: int           # 1-based line of the comment
+
+
+@dataclass
+class CppFunction:
+    name: str
+    file: str           # basename, e.g. "host.cc"
+    line: int           # 1-based signature line
+    sig_start: int      # offset of the name token
+    body_start: int     # offset of '{'
+    body_end: int       # offset one past the matching '}'
+    annotations: dict = field(default_factory=dict)  # kind -> Annotation
+
+    def annotation(self, kind: str) -> str | None:
+        a = self.annotations.get(kind)
+        return a.arg if a is not None else None
+
+
+@dataclass
+class CppField:
+    name: str
+    file: str
+    line: int
+    annotations: dict = field(default_factory=dict)
+
+
+class CppSource:
+    """One parsed C++ file: raw text, stripped text, functions, fields,
+    annotations, lock sites."""
+
+    def __init__(self, path: str, text: str | None = None):
+        self.path = path
+        self.name = os.path.basename(path)
+        if text is None:
+            with open(path) as f:
+                text = f.read()
+        self.text = text
+        self.code = strip(text)
+        self._line_starts = [0]
+        for i, ch in enumerate(text):
+            if ch == "\n":
+                self._line_starts.append(i + 1)
+        self.functions: list[CppFunction] = []
+        self.fields: list[CppField] = []
+        self._extract_functions()
+        self._attach_annotations()
+
+    # -- positions -----------------------------------------------------------
+
+    def line_of(self, offset: int) -> int:
+        return bisect.bisect_right(self._line_starts, offset)
+
+    def _line_text(self, line: int) -> str:
+        start = self._line_starts[line - 1]
+        end = (self._line_starts[line] - 1
+               if line < len(self._line_starts) else len(self.text))
+        return self.text[start:end]
+
+    def _line_code(self, line: int) -> str:
+        start = self._line_starts[line - 1]
+        end = (self._line_starts[line] - 1
+               if line < len(self._line_starts) else len(self.code))
+        return self.code[start:end]
+
+    # -- function extraction -------------------------------------------------
+
+    def _match_paren(self, i: int) -> int:
+        """Offset one past the ')' matching the '(' at ``i`` (stripped
+        text), or -1."""
+        depth = 0
+        for j in range(i, len(self.code)):
+            c = self.code[j]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    return j + 1
+        return -1
+
+    def match_brace(self, i: int) -> int:
+        """Offset one past the '}' matching the '{' at ``i``."""
+        depth = 0
+        for j in range(i, len(self.code)):
+            c = self.code[j]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    return j + 1
+        return len(self.code)
+
+    def _extract_functions(self) -> None:
+        code = self.code
+        covered_until = 0
+        for m in _CALL_RE.finditer(code):
+            name = m.group(1)
+            if m.start() < covered_until:
+                continue  # inside a previous function's body
+            if name.lstrip("~") in _KEYWORDS:
+                continue
+            close = self._match_paren(m.end() - 1)
+            if close < 0:
+                continue
+            # skip qualifiers, then require '{' (or ': init-list ... {')
+            j = close
+            while True:
+                rest = code[j:j + 64]
+                m2 = re.match(r"\s*(const|noexcept|override|final)\b", rest)
+                if not m2:
+                    break
+                j += m2.end()
+            m3 = re.match(r"\s*(\{|:)", code[j:])
+            if not m3:
+                continue
+            if m3.group(1) == ":":
+                # constructor initializer list: scan to the body '{'
+                # outside parens; bail on ';' (declaration) or '::'
+                k = j + m3.end()
+                if code[k:k + 1] == ":":
+                    continue  # '::' qualified name, not an init list
+                depth = 0
+                body = -1
+                while k < len(code):
+                    c = code[k]
+                    if c == "(":
+                        depth += 1
+                    elif c == ")":
+                        depth -= 1
+                    elif c == "{" and depth == 0:
+                        body = k
+                        break
+                    elif c == ";" and depth == 0:
+                        break
+                    k += 1
+                if body < 0:
+                    continue
+                body_start = body
+            else:
+                body_start = j + m3.end() - 1
+            body_end = self.match_brace(body_start)
+            fn = CppFunction(
+                name=name, file=self.name, line=self.line_of(m.start()),
+                sig_start=m.start(), body_start=body_start,
+                body_end=body_end)
+            self.functions.append(fn)
+            covered_until = body_end
+
+    # -- annotations ---------------------------------------------------------
+
+    def _attach_annotations(self) -> None:
+        fn_by_line = {f.line: f for f in self.functions}
+        n_lines = len(self._line_starts)
+        field_by_line: dict = {}
+        for line in range(1, n_lines + 1):
+            raw = self._line_text(line)
+            at = raw.find("//")
+            if at < 0:
+                continue
+            anns = [Annotation(kind=k, arg=(a or "").strip(), line=line)
+                    for k, a in _ANNOT_RE.findall(raw[at:])]
+            if not anns:
+                continue
+            # attach to the declaration on this line if it has code,
+            # else to the next line that has code
+            target = line
+            while target <= n_lines and not self._line_code(target).strip():
+                target += 1
+            if target > n_lines:
+                continue
+            fn = fn_by_line.get(target)
+            if fn is None:
+                # the annotated signature may span lines; a function
+                # whose signature line is within 3 lines below counts
+                for probe in range(target, min(target + 3, n_lines) + 1):
+                    if probe in fn_by_line:
+                        fn = fn_by_line[probe]
+                        break
+            if fn is not None and fn.line <= target + 3:
+                for ann in anns:
+                    fn.annotations[ann.kind] = ann
+                continue
+            fm = _FIELD_DECL_RE.search(self._line_code(target))
+            if fm:
+                fld = field_by_line.get(target)
+                if fld is None:
+                    fld = CppField(name=fm.group(1), file=self.name,
+                                   line=target)
+                    field_by_line[target] = fld
+                    self.fields.append(fld)
+                for ann in anns:
+                    fld.annotations[ann.kind] = ann
+
+    # -- per-function views --------------------------------------------------
+
+    def body_code(self, fn: CppFunction) -> str:
+        return self.code[fn.body_start:fn.body_end]
+
+    def calls(self, fn: CppFunction) -> list[tuple[str, int]]:
+        """(callee name, absolute offset) for every identifier( token
+        in the body, keywords excluded. Callers filter against the
+        model's function table."""
+        out = []
+        for m in _CALL_RE.finditer(self.code, fn.body_start, fn.body_end):
+            name = m.group(1)
+            if name in _KEYWORDS:
+                continue
+            out.append((name, m.start()))
+        return out
+
+    def lock_sites(self, fn: CppFunction) -> list[tuple[str, int, int]]:
+        """(mutex name, lock offset, scope end offset) per acquisition
+        in the body. Scope = the innermost brace block containing the
+        lock site (lock_guard lifetime)."""
+        out = []
+        for m in _LOCK_RE.finditer(self.code, fn.body_start, fn.body_end):
+            scope_end = self._enclosing_block_end(fn, m.start())
+            out.append((m.group(1), m.start(), scope_end))
+        return out
+
+    def _enclosing_block_end(self, fn: CppFunction, pos: int) -> int:
+        """End offset of the innermost { } block of ``fn`` containing
+        ``pos``."""
+        stack = []
+        for j in range(fn.body_start, fn.body_end):
+            c = self.code[j]
+            if c == "{":
+                stack.append(j)
+            elif c == "}":
+                if stack:
+                    start = stack.pop()
+                    if start <= pos < j + 1 and j >= pos:
+                        return j + 1
+        return fn.body_end
+
+    def field_accesses(self, fn: CppFunction, name: str) -> list[int]:
+        """Absolute offsets of every ``name`` token in the body."""
+        pat = re.compile(rf"\b{re.escape(name)}\b")
+        return [m.start()
+                for m in pat.finditer(self.code, fn.body_start, fn.body_end)]
+
+
+# parse cache: the mutation/load-bearing tests re-analyze the tree
+# dozens of times with one file overridden — unchanged files reparse
+# from here (CppSource is immutable after construction)
+_SOURCE_CACHE: dict = {}
+
+
+def _cached_source(path: str, text: str | None) -> CppSource:
+    if text is None:
+        with open(path) as f:
+            text = f.read()
+    key = (path, hash(text))
+    src = _SOURCE_CACHE.get(key)
+    if src is None or src.text != text:
+        src = CppSource(path, text=text)
+        _SOURCE_CACHE[key] = src
+    return src
+
+
+class CppModel:
+    """The joint model over a set of native sources (host.cc + the
+    headers it includes): function table, call graph, annotations."""
+
+    def __init__(self, paths: list[str],
+                 overrides: dict[str, str] | None = None):
+        overrides = overrides or {}
+        self.sources: dict[str, CppSource] = {}
+        for p in paths:
+            name = os.path.basename(p)
+            self.sources[name] = _cached_source(p, overrides.get(name))
+        self.by_name: dict[str, list[CppFunction]] = {}
+        for src in self.sources.values():
+            for fn in src.functions:
+                self.by_name.setdefault(fn.name, []).append(fn)
+
+    def source_of(self, fn: CppFunction) -> CppSource:
+        return self.sources[fn.file]
+
+    def functions(self):
+        for src in self.sources.values():
+            yield from src.functions
+
+    def annotated(self, kind: str, arg: str | None = None):
+        for fn in self.functions():
+            a = fn.annotations.get(kind)
+            if a is not None and (arg is None or a.arg == arg):
+                yield fn
+
+    def fields_annotated(self, kind: str):
+        for src in self.sources.values():
+            for fld in src.fields:
+                if kind in fld.annotations:
+                    yield src, fld
+
+    def call_edges(self, fn: CppFunction):
+        """(callee CppFunction, call offset) resolved by name against
+        the model's function table (all same-named functions — a
+        deliberate over-approximation; waivers are the pressure
+        valve)."""
+        src = self.source_of(fn)
+        for name, off in src.calls(fn):
+            for callee in self.by_name.get(name, ()):
+                if callee is fn:
+                    continue
+                yield callee, off
+
+
+# -- legacy-lint helpers (shared with tests/test_stats_lint.py and
+# tests/test_native_wire_lint.py) ---------------------------------------------
+
+def enum_body(src_text: str, name: str) -> str:
+    """The body of ``enum <name> { ... };`` with // comments stripped
+    (slot docs routinely NAME other slots, which must not count as
+    enumerators)."""
+    m = re.search(rf"enum {name}\b[^{{]*\{{(.*?)\}};", src_text, re.S)
+    if not m:
+        raise AssertionError(f"enum {name} not found")
+    return re.sub(r"//[^\n]*", "", m.group(1))
+
+
+def enumerators(src_text: str, enum_name: str, prefix: str) -> list[str]:
+    """Enumerator names of ``enum_name`` carrying ``prefix``, with the
+    prefix removed (``kSt`` -> ``FastIn`` ...). Sentinel entries whose
+    first post-prefix char is lowercase (kStatCount-style) never match
+    by construction."""
+    return re.findall(rf"\b{prefix}([A-Z]\w*)\b",
+                      enum_body(src_text, enum_name))
+
+
+def snake(camel: str) -> str:
+    """kStFooBar's post-prefix CamelCase -> foo_bar (the mechanical
+    C++ <-> Python stat/stage name mapping)."""
+    return "_".join(p.lower() for p in re.findall(r"[A-Z][a-z0-9]*", camel))
+
+
+def header_comment_region(src_text: str, marker: str) -> str:
+    """The contiguous header-comment region starting at ``marker``
+    (stops at the first preprocessor line) — the wire-format contract
+    the cross-plane lint parses."""
+    start = src_text.index(marker)
+    end = src_text.index("#include", start)
+    return src_text[start:end]
+
+
+_WIRE_TOKEN_RE = re.compile(
+    r"\[(u8|u16|u32|u64)\s+([A-Za-z_]\w*)(?:\s+x\s+\w+)?\]")
+_WIRE_KIND_RE = re.compile(r"kind\s+(\d+)\s*=")
+
+
+def wire_kind_sections(src_text: str,
+                       marker: str = "Event record wire format"
+                       ) -> dict[int, str]:
+    """kind number -> its slice of the wire-format header comment."""
+    text = header_comment_region(src_text, marker)
+    marks = [(int(m.group(1)), m.start())
+             for m in _WIRE_KIND_RE.finditer(text)]
+    out: dict[int, str] = {}
+    for i, (kind, at) in enumerate(marks):
+        nxt = marks[i + 1][1] if i + 1 < len(marks) else len(text)
+        out[kind] = text[at:nxt]
+    return out
+
+
+def wire_tokens(section: str) -> frozenset:
+    """The (width, name) field tokens of one wire-comment section
+    (sub-kind markers like [u8 1] are excluded by the identifier-start
+    requirement)."""
+    return frozenset(_WIRE_TOKEN_RE.findall(section))

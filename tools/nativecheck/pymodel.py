@@ -1,0 +1,177 @@
+"""Python-side source model for the fold-discipline rule (rule 4).
+
+The native server's ``_on_*`` kind-folds run on N concurrent poll
+threads when sharded (PR 7): every fold that touches shared server
+state must take that state's lock. The shared state is ANNOTATED at its
+initialization site and the rule checks the folds mechanically:
+
+  self.ack_plane = {...}          # @guards(_ack_lock)
+  def _exemplar(self, ...):       # @locked(_tele_lock)
+
+Semantics (deliberately strict — restructure the code rather than
+teach the checker aliasing):
+
+- scope = every method named ``_on_*`` plus every method a scoped
+  method directly calls on ``self`` (one hop: the ``_on_durable`` ->
+  ``_on_durable_locked`` shape);
+- ANY mention of a guarded attribute inside a scoped method must be
+  lexically within a ``with self.<lock>:`` block naming the guarding
+  lock — or the method is annotated ``@locked(<lock>)`` (the
+  caller-holds contract), in which case its CALL SITES are checked
+  instead;
+- ``__init__`` is exempt (construction precedes concurrency).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+_ANNOT_RE = re.compile(r"#.*?@(guards|locked)\(([^)]*)\)")
+_ATTR_RE = re.compile(r"self\.(\w+)\s*[:=]")
+
+
+@dataclass
+class PyMethod:
+    name: str
+    node: ast.FunctionDef
+    locked: str | None = None      # @locked(<lock>) annotation
+    locked_line: int = 0           # 1-based line carrying it
+
+
+@dataclass
+class PyClassModel:
+    file: str
+    guarded: dict = field(default_factory=dict)   # attr -> lock name
+    guarded_lines: dict = field(default_factory=dict)  # attr -> line
+    methods: dict = field(default_factory=dict)   # name -> PyMethod
+
+
+class PySource:
+    def __init__(self, path: str, text: str | None = None,
+                 class_name: str = "NativeBrokerServer"):
+        self.path = path
+        if text is None:
+            with open(path) as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.split("\n")
+        self.tree = ast.parse(text)
+        self._method_index: dict = {}
+        self.model = self._build(class_name)
+
+    def _annotation_on(self, line: int) -> tuple[str, str, int] | None:
+        """@guards/@locked annotation trailing on ``line`` (1-based) or
+        on the comment line directly above it."""
+        for probe in (line, line - 1):
+            if 1 <= probe <= len(self.lines):
+                m = _ANNOT_RE.search(self.lines[probe - 1])
+                if m:
+                    return m.group(1), m.group(2).strip(), probe
+        return None
+
+    def _build(self, class_name: str) -> PyClassModel:
+        cls = None
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == class_name:
+                cls = node
+                break
+        model = PyClassModel(file=self.path)
+        if cls is None:
+            return model
+        for node in cls.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            ann = self._annotation_on(node.lineno)
+            locked = ann[1] if ann and ann[0] == "locked" else None
+            model.methods[node.name] = PyMethod(
+                node.name, node, locked, ann[2] if locked else 0)
+        # guarded attrs: any `self.X = ...` line in the class body
+        # carrying a @guards annotation (typically in __init__)
+        start = cls.lineno
+        end = max((getattr(n, "end_lineno", start) for n in cls.body),
+                  default=start)
+        for line in range(start, end + 1):
+            m = _ANNOT_RE.search(self.lines[line - 1])
+            if not m or m.group(1) != "guards":
+                continue
+            # the annotated statement: this line, or the next code line
+            target = line
+            am = _ATTR_RE.search(self.lines[target - 1])
+            while am is None and target < end:
+                target += 1
+                am = _ATTR_RE.search(self.lines[target - 1])
+            if am:
+                model.guarded[am.group(1)] = m.group(2).strip()
+                model.guarded_lines[am.group(1)] = line
+        return model
+
+    # -- rule-4 views --------------------------------------------------------
+
+    def scoped_methods(self) -> dict[str, PyMethod]:
+        """``_on_*`` methods plus their direct self.X() callees."""
+        model = self.model
+        scoped: dict[str, PyMethod] = {
+            n: m for n, m in model.methods.items() if n.startswith("_on_")}
+        for m in list(scoped.values()):
+            for callee in self._self_calls(m.node):
+                if callee in model.methods and callee not in scoped:
+                    scoped[callee] = model.methods[callee]
+        return scoped
+
+    @staticmethod
+    def _self_calls(node: ast.AST):
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "self"):
+                yield sub.func.attr
+
+    def _index(self, fn: ast.FunctionDef) -> dict:
+        """ONE walk per method (memoized — check_pyfold consults this
+        per guarded attr and per @locked callee): with-regions, every
+        self.<attr> mention line, every self.<name>() call line."""
+        cached = self._method_index.get(id(fn))
+        if cached is not None:
+            return cached
+        withs: list = []
+        attrs: dict = {}
+        calls: dict = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    ctx = item.context_expr
+                    if (isinstance(ctx, ast.Attribute)
+                            and isinstance(ctx.value, ast.Name)
+                            and ctx.value.id == "self"):
+                        withs.append((ctx.attr, sub.body[0].lineno,
+                                      sub.end_lineno))
+            elif (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                attrs.setdefault(sub.attr, []).append(sub.lineno)
+            elif (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "self"):
+                calls.setdefault(sub.func.attr, []).append(sub.lineno)
+        idx = {"withs": withs, "attrs": attrs, "calls": calls}
+        self._method_index[id(fn)] = idx
+        return idx
+
+    def with_regions(self, fn: ast.FunctionDef) -> list[tuple[str, int, int]]:
+        """(lock attr, first body line, last body line) for every
+        ``with self.<lock>:`` in the method."""
+        return self._index(fn)["withs"]
+
+    def attr_mentions(self, fn: ast.FunctionDef, attr: str) -> list[int]:
+        """Line numbers of every ``self.<attr>`` mention in the body."""
+        return self._index(fn)["attrs"].get(attr, [])
+
+    def locked_calls(self, fn: ast.FunctionDef,
+                     callee: str) -> list[int]:
+        """Line numbers of every ``self.<callee>(...)`` call."""
+        return self._index(fn)["calls"].get(callee, [])

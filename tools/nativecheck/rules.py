@@ -1,0 +1,298 @@
+"""nativecheck rules: five checked invariants over the native plane.
+
+Rule catalog (see README "Static analysis of the native plane"):
+
+  plane    — plane propagation: no function reachable from a
+             ``@plane(poll)`` root through the call graph may be
+             ``@blocking`` or ``@plane(control)`` (the
+             msync/fsync-on-the-poll-thread class).
+  lockset  — every access to a ``@guards(<mu>)``-annotated field is
+             inside a ``lock_guard(<mu>)`` block or in a function
+             annotated ``@locked(<mu>)`` (Eraser-style, lexical).
+  ladder   — within a function, every call to an ``@admit-gated``
+             side-effect function lexically FOLLOWS an
+             ``@admit-check`` call (ladder decisions BEFORE side
+             effects — the PR 4/7 contract).
+  pyfold   — every ``_on_*`` kind-fold in broker/native_server.py that
+             mentions a ``# @guards(<lock>)`` attribute does so under
+             ``with self.<lock>:`` (multi-producer safety, PR 7).
+  waivers  — waiver hygiene: every waiver names a known rule, carries
+             a justification, and matches a live finding (a stale
+             waiver is drift in the other direction).
+
+Findings carry a stable site key ``<rule>:<site>`` that waivers match
+exactly. ``run()`` accepts text overrides so the mutation self-test can
+re-analyze seeded-bad variants without touching the tree.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from .model import CppModel
+from .pymodel import PySource
+
+CPP_FILES = ("host.cc", "store.h", "trunk.h", "ring.h", "router.h",
+             "sn.h", "ws.h", "frame.h")
+PY_FOLD_FILE = os.path.join("emqx_tpu", "broker", "native_server.py")
+
+RULES = ("plane", "lockset", "ladder", "pyfold", "waivers")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str
+    line: int
+    site: str          # waiver-matchable key, e.g. "host.cc:TrunkFanOut->FanOut"
+    message: str
+    waived_by: str | None = None   # justification when a waiver matched
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.site}"
+
+
+@dataclass
+class Result:
+    findings: list          # every Finding, waived or not
+    stale_waivers: list     # waiver dicts that matched nothing
+
+    @property
+    def unwaived(self) -> list:
+        return [f for f in self.findings if f.waived_by is None]
+
+    @property
+    def ok(self) -> bool:
+        return not self.unwaived and not self.stale_waivers
+
+    def keys(self) -> frozenset:
+        """Canonical comparison view: every finding key (suffixed when
+        waived) plus stale-waiver keys — the 'rule result' the
+        load-bearing test diffs."""
+        out = {f.key + ("|waived" if f.waived_by else "")
+               for f in self.findings}
+        out |= {f"stale:{w['rule']}:{w['site']}" for w in self.stale_waivers}
+        return frozenset(out)
+
+
+_PY_CACHE: dict = {}
+
+
+def _cached_py(path: str, text: str | None) -> PySource:
+    if text is None:
+        with open(path) as f:
+            text = f.read()
+    key = (path, hash(text))
+    src = _PY_CACHE.get(key)
+    if src is None or src.text != text:
+        src = PySource(path, text=text)
+        _PY_CACHE[key] = src
+    return src
+
+
+def cpp_paths(repo: str) -> list[str]:
+    src = os.path.join(repo, "emqx_tpu", "native", "src")
+    return [os.path.join(src, f) for f in CPP_FILES]
+
+
+def build_cpp_model(repo: str,
+                    overrides: dict[str, str] | None = None) -> CppModel:
+    return CppModel(cpp_paths(repo), overrides=overrides)
+
+
+# -- rule: plane --------------------------------------------------------------
+
+def check_plane(model: CppModel) -> list[Finding]:
+    out: list[Finding] = []
+    roots = list(model.annotated("plane", "poll"))
+    if not roots:
+        out.append(Finding(
+            "plane", "host.cc", 1, "host.cc:<no-poll-root>",
+            "no @plane(poll) root found — the plane rule has nothing "
+            "to propagate from"))
+        return out
+    # BFS over the call graph from the poll roots; remember one example
+    # path per function for the finding message
+    seen: dict[int, list] = {}
+    queue: list = []
+    for r in roots:
+        seen[id(r)] = [r.name]
+        queue.append(r)
+    while queue:
+        fn = queue.pop()
+        path = seen[id(fn)]
+        for callee, _off in model.call_edges(fn):
+            if id(callee) in seen:
+                continue
+            seen[id(callee)] = path + [callee.name]
+            queue.append(callee)
+    flagged = set()
+    for fn in list(model.functions()):
+        if id(fn) not in seen:
+            continue
+        bad = None
+        if "blocking" in fn.annotations:
+            bad = "@blocking"
+        elif fn.annotation("plane") == "control":
+            bad = "@plane(control)"
+        if bad and fn.name not in flagged:
+            # key on the callee endpoint: one waiver covers every path
+            # to a deliberately-blocking function (the fsync contract)
+            flagged.add(fn.name)
+            path = " -> ".join(seen[id(fn)])
+            out.append(Finding(
+                "plane", fn.file, fn.line, f"{fn.file}:{fn.name}",
+                f"{bad} function {fn.name} is reachable from the poll "
+                f"plane: {path}"))
+    return out
+
+
+# -- rule: lockset ------------------------------------------------------------
+
+def check_lockset(model: CppModel) -> list[Finding]:
+    out: list[Finding] = []
+    for src, fld in model.fields_annotated("guards"):
+        mu = fld.annotations["guards"].arg
+        for fn in src.functions:
+            if fn.annotation("locked") == mu:
+                continue
+            accesses = src.field_accesses(fn, fld.name)
+            if not accesses:
+                continue
+            locks = [s for s in src.lock_sites(fn) if s[0] == mu]
+            for off in accesses:
+                if any(lo <= off < end for _m, lo, end in locks):
+                    continue
+                out.append(Finding(
+                    "lockset", src.name, src.line_of(off),
+                    f"{src.name}:{fn.name}:{fld.name}",
+                    f"{fn.name} accesses {fld.name} (guarded by {mu}) "
+                    f"outside any {mu} lock scope and is not "
+                    f"@locked({mu})"))
+                break  # one finding per (function, field)
+    return out
+
+
+# -- rule: ladder -------------------------------------------------------------
+
+def check_ladder(model: CppModel) -> list[Finding]:
+    out: list[Finding] = []
+    gated = {fn.name for fn in model.annotated("admit-gated")}
+    checks = {fn.name for fn in model.annotated("admit-check")}
+    if not gated or not checks:
+        return out
+    seen_sites = set()
+    for fn in model.functions():
+        if fn.name in gated or fn.name in checks:
+            continue
+        src = model.source_of(fn)
+        calls = src.calls(fn)
+        check_offs = [off for name, off in calls if name in checks]
+        for name, off in calls:
+            if name not in gated:
+                continue
+            site = f"{fn.file}:{fn.name}->{name}"
+            if site in seen_sites:
+                continue
+            if not any(co < off for co in check_offs):
+                seen_sites.add(site)
+                out.append(Finding(
+                    "ladder", fn.file, src.line_of(off), site,
+                    f"{fn.name} calls @admit-gated {name} with no "
+                    f"@admit-check (ShardAdmit/TrunkEligible/RingRoom) "
+                    f"lexically before it — ladder decisions must "
+                    f"precede side effects"))
+    return out
+
+
+# -- rule: pyfold -------------------------------------------------------------
+
+def check_pyfold(py: PySource) -> list[Finding]:
+    out: list[Finding] = []
+    model = py.model
+    fname = os.path.basename(py.path)
+    scoped = py.scoped_methods()
+    for name, meth in scoped.items():
+        if name == "__init__":
+            continue
+        regions_all = py.with_regions(meth.node)
+        for attr, lock in model.guarded.items():
+            if meth.locked == lock:
+                continue
+            regions = [(a, b) for w, a, b in regions_all if w == lock]
+            for line in py.attr_mentions(meth.node, attr):
+                if any(a <= line <= b for a, b in regions):
+                    continue
+                out.append(Finding(
+                    "pyfold", fname, line, f"{fname}:{name}:{attr}",
+                    f"{name} touches self.{attr} (guarded by {lock}) "
+                    f"outside `with self.{lock}:` and is not "
+                    f"@locked({lock})"))
+                break
+        # calls into @locked helpers must hold their lock
+        for callee_name, callee in model.methods.items():
+            if callee.locked is None or callee_name == name:
+                continue
+            if meth.locked == callee.locked:
+                continue
+            regions = [(a, b) for w, a, b in regions_all
+                       if w == callee.locked]
+            for line in py.locked_calls(meth.node, callee_name):
+                if any(a <= line <= b for a, b in regions):
+                    continue
+                out.append(Finding(
+                    "pyfold", fname, line,
+                    f"{fname}:{name}->{callee_name}",
+                    f"{name} calls @locked({callee.locked}) helper "
+                    f"{callee_name} outside `with self."
+                    f"{callee.locked}:`"))
+                break
+    return out
+
+
+# -- rule: waivers (hygiene) + assembly ---------------------------------------
+
+def apply_waivers(findings: list, waivers: list) -> Result:
+    out: list[Finding] = []
+    used = [False] * len(waivers)
+    extra: list[Finding] = []
+    by_key: dict[str, int] = {}
+    for i, w in enumerate(waivers):
+        if w.get("rule") not in RULES or not w.get("site") \
+                or not str(w.get("why", "")).strip():
+            extra.append(Finding(
+                "waivers", "waivers.py", 0,
+                f"waivers.py:{w.get('rule')}:{w.get('site')}",
+                f"malformed waiver {w!r}: needs a known rule, a site, "
+                f"and a non-empty why"))
+            used[i] = True  # malformed: never matches, already reported
+            continue
+        by_key[f"{w['rule']}:{w['site']}"] = i
+    for f in findings:
+        i = by_key.get(f.key)
+        if i is not None:
+            used[i] = True
+            out.append(Finding(f.rule, f.file, f.line, f.site, f.message,
+                               waived_by=str(waivers[i]["why"])))
+        else:
+            out.append(f)
+    stale = [w for i, w in enumerate(waivers) if not used[i]]
+    return Result(findings=out + extra, stale_waivers=stale)
+
+
+def run(repo: str, overrides: dict[str, str] | None = None,
+        waivers: list | None = None) -> Result:
+    """Analyze the tree (with optional per-file text overrides, keyed
+    by basename for C++ sources and by "native_server.py" for the
+    Python fold file) and apply waivers."""
+    overrides = overrides or {}
+    if waivers is None:
+        from .waivers import WAIVERS as waivers
+    model = build_cpp_model(repo, overrides=overrides)
+    py = _cached_py(os.path.join(repo, PY_FOLD_FILE),
+                    overrides.get("native_server.py"))
+    findings = (check_plane(model) + check_lockset(model)
+                + check_ladder(model) + check_pyfold(py))
+    return apply_waivers(findings, waivers)

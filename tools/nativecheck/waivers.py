@@ -1,0 +1,43 @@
+"""nativecheck waivers: deliberate, justified exceptions to the rules.
+
+Each entry names the rule, the exact finding site key, and a one-line
+justification. A waiver that matches no live finding FAILS the check
+(stale-waiver hygiene): when the code stops violating, the waiver must
+be deleted, so this file can never silently rot into a blanket
+allowlist. Keep justifications honest — they are the documented
+contract for why the violation is the design.
+"""
+
+WAIVERS = [
+    # -- plane: the durable store's fsync contract ---------------------------
+    # FlushDirty orders every socket write of a read batch BEHIND the
+    # durable batch append + policy msync (host.cc round 10): a QoS1
+    # PUBACK on the wire must imply the message is on disk, so the
+    # poll thread paying the (batched, once-per-flush) msync IS the
+    # durability design — the 120k-msyncs wedge this analyzer exists
+    # to prevent was PER-ENTRY consumes, which now batch per record on
+    # Python threads.
+    {"rule": "plane", "site": "store.h:SyncSeg",
+     "why": "PUBACK-after-fsync durability contract: one batched msync "
+            "per flush on the poll thread is the round-10 design"},
+    # AppendFrame rolls to a fresh segment when the active one fills:
+    # an open/ftruncate/mmap on the poll thread, amortized over a whole
+    # segment (default 4 MB) of appends.
+    {"rule": "plane", "site": "store.h:Roll",
+     "why": "segment roll (open+ftruncate+mmap) amortized over a whole "
+            "segment of batched appends; same contract as SyncSeg"},
+
+    # -- ladder: receivers of already-admitted publishes ---------------------
+    # The trunk receiver cannot punt a publish that already left its
+    # origin node (the sender ran the ladder); FanOut degrades its
+    # cross-shard legs per-destination through the RingRoom re-check
+    # instead (host.cc TrunkFanOut comment).
+    {"rule": "ladder", "site": "host.cc:TrunkFanOut->FanOut",
+     "why": "trunk receiver: the PUBLISHING node ran the ladder; FanOut "
+            "degrades per-destination via its RingRoom re-check"},
+    # Ring consumers apply entries the producer shard already admitted
+    # (ShardAdmit ran before the entry was shipped).
+    {"rule": "ladder", "site": "host.cc:ApplyShardBatch->TrunkEnqueue",
+     "why": "ring consumer: the producing shard ran ShardAdmit before "
+            "shipping the trunk-forward entry"},
+]

@@ -1345,6 +1345,92 @@ def sec_observe_overhead() -> None:
 
 
 # ---------------------------------------------------------------------------
+# section: fault_overhead (faultline disarmed cost; CPU by design)
+# ---------------------------------------------------------------------------
+
+_FAULT_ARM_SRC = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.native_server import NativeBrokerServer
+
+server = NativeBrokerServer(port=0, app=BrokerApp(),
+                            session_opts={"max_inflight": 1024})
+server.start()
+r = native.loadgen_run("127.0.0.1", server.port, n_subs=8, n_pubs=8,
+                       msgs_per_pub=%(n_msg)d, qos=0, payload_len=16)
+print("RATE", r["received"] / max(r["wall_ns"] / 1e9, 1e-9), flush=True)
+server.stop()
+"""
+
+
+def sec_fault_overhead() -> None:
+    """ISSUE 11 acceptance: disarmed fault sites are FREE — the qos0
+    fan-out with the faultline-compiled binary lands within the 2%
+    noise budget of a -DEMQX_NO_FAULTLINE build (every site compiled
+    out; EMQX_NATIVE_NOFAULT=1 selects it). Each arm runs the broker +
+    loadgen in a SUBPROCESS so the two .so variants never share a
+    process; interleaved best-of-N with alternating pair order (the
+    round-13 warm-box discipline)."""
+    import subprocess as sp
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n_msg = int(os.environ.get("BENCH_FAULT_MSGS", 40000))
+    reps = int(os.environ.get("BENCH_FAULT_REPS", 3))
+    src = _FAULT_ARM_SRC % {"repo": repo, "n_msg": n_msg}
+    best = {"faultline": 0.0, "nofault": 0.0}
+    for rep in range(reps):
+        arms = (("faultline", "nofault") if rep % 2 == 0
+                else ("nofault", "faultline"))
+        for arm in arms:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            if arm == "nofault":
+                env["EMQX_NATIVE_NOFAULT"] = "1"
+            else:
+                env.pop("EMQX_NATIVE_NOFAULT", None)
+            p = sp.run([sys.executable, "-c", src], env=env,
+                       capture_output=True, text=True, timeout=300)
+            rate = 0.0
+            for line in p.stdout.splitlines():
+                if line.startswith("RATE "):
+                    rate = float(line.split()[1])
+            if rate <= 0:
+                log(f"fault_overhead rep{rep} {arm}: FAILED "
+                    f"{p.stderr[-500:]}")
+                continue
+            best[arm] = max(best[arm], rate)
+            log(f"fault_overhead rep{rep} {arm}: {rate:,.0f} msg/s")
+    if best["faultline"] <= 0 or best["nofault"] <= 0:
+        # a dead arm must never read as a budget pass: with the
+        # baseline at 0 the overhead goes hugely negative and
+        # "< 2%" would be a false green on a run that measured nothing
+        log(f"fault_overhead: arm(s) produced no rate "
+            f"(faultline={best['faultline']:,.0f} "
+            f"compiled-out={best['nofault']:,.0f}) — no verdict")
+        put("fault_overhead",
+            qos0_msgs_per_sec_faultline=round(best["faultline"]),
+            qos0_msgs_per_sec_compiled_out=round(best["nofault"]),
+            within_2pct_budget=False, failed_arm=True)
+        return
+    overhead = 1.0 - best["faultline"] / best["nofault"]
+    log(f"fault_overhead: faultline={best['faultline']:,.0f} "
+        f"compiled-out={best['nofault']:,.0f} msg/s  "
+        f"overhead={overhead * 100:.2f}% "
+        f"({'within' if overhead < 0.02 else 'OVER'} the 2% budget)")
+    put("fault_overhead",
+        qos0_msgs_per_sec_faultline=round(best["faultline"]),
+        qos0_msgs_per_sec_compiled_out=round(best["nofault"]),
+        overhead_frac=round(overhead, 4),
+        within_2pct_budget=bool(overhead < 0.02))
+
+
+# ---------------------------------------------------------------------------
 # raw-socket MQTT codec shared by the trunk/durable sections (one copy:
 # a framing fix must not have to land twice)
 # ---------------------------------------------------------------------------
@@ -2509,6 +2595,7 @@ SECTIONS = {
     "shards": sec_shards,
     "e2e": sec_e2e,
     "observe_overhead": sec_observe_overhead,
+    "fault_overhead": sec_fault_overhead,
 }
 
 # (name, needs_device, pin_cpu, deadline_s). Device sections run first —
@@ -2529,6 +2616,7 @@ DEVICE_PLAN = [
     ("shards", False, True, 500),
     ("shared", False, True, 400),
     ("observe_overhead", False, True, 300),
+    ("fault_overhead", False, True, 400),
 ]
 CPU_PLAN = [
     ("kernel", False, True, 700),
@@ -2542,11 +2630,13 @@ CPU_PLAN = [
     ("shared", False, True, 400),
     ("e2e", False, True, 600),
     ("observe_overhead", False, True, 300),
+    ("fault_overhead", False, True, 400),
 ]
 
 _SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
                   "shared", "host", "ws", "trunk", "durable", "mixed",
-                  "shards", "e2e", "observe_overhead", "kernel_cpu"]
+                  "shards", "e2e", "observe_overhead", "fault_overhead",
+                  "kernel_cpu"]
 
 
 def _probe_device(attempts: int, timeout_s: float, backoff_s: float) -> dict:

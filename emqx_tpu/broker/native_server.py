@@ -64,6 +64,7 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import random
 import struct
 import threading
 import time
@@ -97,6 +98,10 @@ LANE_MAX_BATCH = 16_384
 LANE_PIPE_DEPTH = 2          # submitted-but-uncollected device batches
 LANE_STALE_BACKOFF_S = 30.0  # sit-out after a C++ stale trip
 TRUNK_RETRY_S = 1.0          # redial cadence for a down trunk peer
+TRUNK_RETRY_CAP_S = 30.0     # exponential-backoff ceiling
+# ±25% redial jitter (round 15): a healed partition must not wake every
+# peer's redial on the same capped boundary (full-mesh thundering herd)
+TRUNK_RETRY_JITTER = 0.25
 # Dynamic inflight-cap policy (re-derived for the sharded plane —
 # README "Multi-core native plane" carries the full derivation). The
 # policy is PER-CONN, and a conn lives on exactly one shard, so it is
@@ -163,9 +168,12 @@ class _ShardedHost:
       predefined ids, lane/qos/telemetry switches, permit flushes,
       trunk ROUTES) broadcast to every shard: the match table is
       replicated, each shard applies ops in its own ApplyPending;
-    - **trunk LINK ops** (listen/connect/disconnect) go to shard 0
-      only — the trunk plane lives there; other shards ring-forward
-      remote legs to it (host.cc XShip → kTrunkOwnerBase target);
+    - **trunk LINK ops** (connect/disconnect) go to the peer's OWNER
+      shard — peer P's dialer, replay ring, and authoritative state
+      live on shard ``P % N`` (round 15; links used to pin to shard
+      0). Every shard's trunk listener shares one port via
+      SO_REUSEPORT; non-owner shards ring-forward remote legs to the
+      owner (host.cc XShip → kTrunkOwnerBase target);
     - **aggregates** (stats, lane backlog) sum across shards.
     """
 
@@ -308,16 +316,73 @@ class _ShardedHost:
         for h in self.hosts:
             h.attach_store(store)
 
-    # -- trunk link plane (shard 0 owns the links) ---------------------------
+    # -- trunk link plane (links SPREAD across shards, round 15) -------------
+    # peer P's dialer, replay ring, and authoritative up/down state live
+    # on shard P % n (host.cc OwnsTrunkPeer mirrors this rule); every
+    # shard's trunk listener shares one port via SO_REUSEPORT so inbound
+    # links hash across shards too — the shard-0 hotspot an N-node mesh
+    # would otherwise measure is gone.
 
     def trunk_listen(self, host="127.0.0.1", port=0):
-        return self.hosts[0].trunk_listen(host, port)
+        p = self.hosts[0].trunk_listen(host, port, reuseport=True)
+        for h in self.hosts[1:]:
+            h.trunk_listen(host, p, reuseport=True)
+        return p
 
     def trunk_connect(self, peer_id, host, port):
-        self.hosts[0].trunk_connect(peer_id, host, port)
+        self.hosts[peer_id % len(self.hosts)].trunk_connect(
+            peer_id, host, port)
 
     def trunk_disconnect(self, peer_id, forget=False):
-        self.hosts[0].trunk_disconnect(peer_id, forget)
+        self.hosts[peer_id % len(self.hosts)].trunk_disconnect(
+            peer_id, forget)
+
+    def set_trunk_ack_timeout(self, ms):
+        for h in self.hosts:
+            h.set_trunk_ack_timeout(ms)
+
+    # -- faultline (round 15) ------------------------------------------------
+
+    _STORE_SITES = ("store_msync", "store_seg_open")
+
+    def fault_arm(self, site, mode="errno", n_or_prob=0.0, seed=1,
+                  key=0):
+        # store sites live in the ONE shared store: arm once via shard 0
+        # (broadcasting would reset the firing schedule N times)
+        if site in self._STORE_SITES:
+            self.hosts[0].fault_arm(site, mode, n_or_prob, seed, key)
+            return
+        # a KEY-scoped conn/trunk arm has exactly one owner shard (the
+        # conn id's prefix / peer % n — the round-15 spread rule):
+        # route it there so a count-limited arm fires exactly n times,
+        # not n per shard (review finding). Unscoped arms (and ring
+        # sites, whose key names the DESTINATION while any shard can
+        # be the firing producer) broadcast: their counts/schedules
+        # are PER SHARD by construction.
+        if key:
+            if site.startswith("conn_"):
+                self._of(key).fault_arm(site, mode, n_or_prob, seed,
+                                        key)
+                return
+            if site.startswith("trunk_"):
+                self.hosts[key % len(self.hosts)].fault_arm(
+                    site, mode, n_or_prob, seed, key)
+                return
+        for h in self.hosts:
+            h.fault_arm(site, mode, n_or_prob, seed, key)
+
+    def fault_disarm(self, site):
+        if site in self._STORE_SITES:
+            self.hosts[0].fault_disarm(site)
+            return
+        for h in self.hosts:
+            h.fault_disarm(site)
+
+    def fault_fired(self, site):
+        if site in self._STORE_SITES:
+            # one shared injector: summing N hosts would count aliases
+            return self.hosts[0].fault_fired(site)
+        return sum(h.fault_fired(site) for h in self.hosts)
 
     # -- aggregates ----------------------------------------------------------
 
@@ -481,6 +546,14 @@ class NativeBrokerServer:
         self._trunk_id_next = 1
         self._trunk_routes: set[tuple[str, str]] = set()  # (node, topic)
         self._trunk_retry_at = float("inf")         # next redial stamp
+        # redial jitter source (round 15): process-seeded; only the
+        # ±25% SHAPE matters, never a specific draw
+        self._redial_rng = random.Random()
+        # faultline (round 15): per-site injected-fault counters seen
+        # at the last housekeep fold (faults.* metric slots + the
+        # store-site ledger fold ride the deltas)
+        self._faults_seen: dict[str, int] = {
+            s: 0 for s in native.FAULT_SITES}
         # -- native telemetry plane (round 8) ------------------------------
         # In-host latency histograms + per-conn flight recorders, shipped
         # as batched kind-8 records and folded here into histogram-aware
@@ -1352,6 +1425,36 @@ class NativeBrokerServer:
         with self._mirror_lock:
             return {n: p["up"] for n, p in self._trunk_peers.items()}
 
+    # -- faultline (round 15) ------------------------------------------------
+    # Deterministic fault injection at the native plane's syscall seams
+    # (native/src/fault.h). The server surface is a passthrough: the
+    # host routes store sites to the attached durable store and, when
+    # sharded, link-scoped sites to every shard. Every fired fault
+    # counts a faults.<site> metric and lands in the degradation
+    # ledger (reason "fault", aux = the site index) — chaos observable
+    # through the same seams as organic degradation.
+
+    def fault_arm(self, site: str, mode: str = "errno",
+                  n_or_prob: float = 0.0, seed: int = 1,
+                  key: int = 0) -> None:
+        """Key-scoped conn/trunk arms land on the one shard that owns
+        the object, so counted arms fire exactly n times; UNSCOPED
+        arms on a sharded server broadcast — their counts and PRNG
+        schedules are per shard."""
+        self.host.fault_arm(site, mode, n_or_prob, seed, key)
+
+    def fault_disarm(self, site: str) -> None:
+        self.host.fault_disarm(site)
+
+    def fault_fired(self, site: str) -> int:
+        return self.host.fault_fired(site)
+
+    def set_trunk_ack_timeout(self, ms: int) -> None:
+        """Tighten/relax the silent-link watchdog (host.cc
+        TrunkAckScan); the mesh soak drops it to milliseconds so a
+        blackholed link resolves into a replay quickly."""
+        self.host.set_trunk_ack_timeout(ms)
+
     def _on_trunk_event(self, peer_id: int, payload: bytes) -> None:
         if not payload:
             return
@@ -1366,12 +1469,14 @@ class NativeBrokerServer:
                 self._trunk_punt_dispatch(qos, dup, topic, body)
             return
         node = self._trunk_id_nodes.get(peer_id)
-        # mirror the link state onto the non-trunk shards BEFORE the
+        # mirror the link state onto every NON-OWNER shard BEFORE the
         # permit flush below: their TrunkEligible oracle must flip
         # before publishers re-earn permits (the punt→trunk ordering
-        # guard, extended across shards). Conservative while it lags —
-        # a lagging mirror punts, never misroutes.
-        for h in self.hosts[1:]:
+        # guard, extended across shards). The owner shard (peer % n,
+        # round 15) ignores its own mirror entry — OwnsTrunkPeer routes
+        # it to the authoritative peer state. Conservative while it
+        # lags — a lagging mirror punts, never misroutes.
+        for h in self.hosts:
             h.trunk_peer_state(peer_id, sub == native.TRUNK_UP)
         with self._mirror_lock:
             peer = self._trunk_peers.get(node) if node else None
@@ -1380,12 +1485,19 @@ class NativeBrokerServer:
                 if sub == native.TRUNK_UP:
                     peer["backoff"] = TRUNK_RETRY_S
                 else:
-                    # exponential backoff (capped): a partitioned peer
-                    # must not be re-dialed — and warned about — every
-                    # second for the partition's whole duration
+                    # exponential backoff (capped) with ±25% jitter: a
+                    # partitioned peer must not be re-dialed — and
+                    # warned about — every second for the partition's
+                    # whole duration, and a HEALED partition must not
+                    # wake every peer's redial on the same capped
+                    # boundary (thundering-herd reconnect in a full
+                    # mesh — the round-15 satellite)
                     backoff = peer.get("backoff", TRUNK_RETRY_S)
-                    peer["retry_at"] = time.monotonic() + backoff
-                    peer["backoff"] = min(backoff * 2, 30.0)
+                    peer["retry_at"] = time.monotonic() + (
+                        backoff * self._redial_rng.uniform(
+                            1 - TRUNK_RETRY_JITTER,
+                            1 + TRUNK_RETRY_JITTER))
+                    peer["backoff"] = min(backoff * 2, TRUNK_RETRY_CAP_S)
         if sub == native.TRUNK_UP:
             log.info("trunk up: peer %s (replay done)", node)
             # ordering guard for the punt→trunk flip: every publisher
@@ -1428,10 +1540,15 @@ class NativeBrokerServer:
                     continue
                 at = p.get("retry_at", 0.0)
                 if now >= at:
-                    # schedule the NEXT attempt at this peer's backoff;
-                    # the C++ side ignores a dial while one is already
-                    # in flight, so a slow connect is never torn down
-                    p["retry_at"] = now + p.get("backoff", TRUNK_RETRY_S)
+                    # schedule the NEXT attempt at this peer's backoff
+                    # (±25% jitter — see _on_trunk_event); the C++ side
+                    # ignores a dial while one is already in flight, so
+                    # a slow connect is never torn down
+                    p["retry_at"] = now + (
+                        p.get("backoff", TRUNK_RETRY_S)
+                        * self._redial_rng.uniform(
+                            1 - TRUNK_RETRY_JITTER,
+                            1 + TRUNK_RETRY_JITTER))
                     dial.append((p["id"], p["addr"], p["port"]))
                     nxt = min(nxt, p["retry_at"])
                 else:
@@ -2776,6 +2893,19 @@ class NativeBrokerServer:
             m.inc("messages.delivered", d_out)
         if d_drop:
             m.inc("messages.dropped", d_drop)
+        # faultline (round 15): per-site injected-fault counters fold
+        # into the fixed faults.* metric slots. Host-plane fires are
+        # already ledger-visible below the GIL (kind-12, reason
+        # "fault"); STORE-site fires happen under the store mutex on
+        # arbitrary threads, so their ledger entries fold here instead.
+        for i, site in enumerate(native.FAULT_SITES):
+            fired = self.host.fault_fired(site)
+            d_f = fired - self._faults_seen[site]
+            if d_f:
+                self._faults_seen[site] = fired
+                m.inc(f"faults.{site}", d_f)
+                if site in ("store_msync", "store_seg_open"):
+                    self.ledger.record("fault", d_f, aux=i, detail=site)
         d_fwd = stats["trunk_out"] - seen["trunk_out"]
         if d_fwd:
             # the native half of the messages.forward split (ISSUE 4

@@ -27,7 +27,12 @@ _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 # (separate artifact; the sanitizer runtime must be LD_PRELOADed into the
 # interpreter — see tests/test_native_sanitizers.py for the harness)
 _SANITIZE = os.environ.get("EMQX_NATIVE_SANITIZE", "")
+# EMQX_NATIVE_NOFAULT=1 builds the faultline-compiled-OUT variant
+# (-DEMQX_NO_FAULTLINE): bench.py's fault_overhead section compares it
+# against the normal binary to prove disarmed fault sites are free
+_NOFAULT = os.environ.get("EMQX_NATIVE_NOFAULT", "") == "1"
 _LIB_NAME = (f"libemqx_native.{_SANITIZE}.so" if _SANITIZE
+             else "libemqx_native.nofault.so" if _NOFAULT
              else "libemqx_native.so")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), _LIB_NAME)
 
@@ -58,6 +63,8 @@ def _build() -> None:
     if _SANITIZE:
         cmd[1:1] = [f"-fsanitize={_SANITIZE}", "-g",
                     "-fno-omit-frame-pointer"]
+    elif _NOFAULT:
+        cmd[1:1] = ["-DEMQX_NO_FAULTLINE"]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
@@ -145,7 +152,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_set_max_qos.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.emqx_host_trunk_listen.restype = ctypes.c_int
     lib.emqx_host_trunk_listen.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16]
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+    lib.emqx_host_set_trunk_ack_timeout.restype = ctypes.c_int
+    lib.emqx_host_set_trunk_ack_timeout.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_host_fault_arm.restype = ctypes.c_int
+    lib.emqx_host_fault_arm.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_uint64, ctypes.c_uint64]
+    lib.emqx_host_fault_fired.restype = ctypes.c_long
+    lib.emqx_host_fault_fired.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_store_fault_arm.restype = ctypes.c_int
+    lib.emqx_store_fault_arm.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_uint64, ctypes.c_uint64]
+    lib.emqx_store_fault_fired.restype = ctypes.c_long
+    lib.emqx_store_fault_fired.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_store_set_compact_age.restype = ctypes.c_int
+    lib.emqx_store_set_compact_age.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64]
     lib.emqx_host_trunk_connect.restype = ctypes.c_int
     lib.emqx_host_trunk_connect.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint16]
@@ -543,11 +568,39 @@ SPAN_STAGES = ("ingress", "route", "ring_cross", "trunk_flush",
                "ack")
 
 # degradation-ledger reasons. The C++ LedgerReason enum is a PREFIX of
-# this tuple (ring_full/trunk_punt/shed fold below the GIL);
+# this tuple (ring_full/trunk_punt/shed/fault fold below the GIL);
 # device_failover and store_degraded are Python-plane decisions folded
 # into the same ledger by broker/native_server.py and broker/broker.py.
-LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "device_failover",
-                  "store_degraded")
+# "fault" (round 15) is a faultline injection firing — chaos lands in
+# the SAME ledger as organic degradation (aux = the fault-site index).
+LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
+                  "device_failover", "store_degraded")
+
+# ---------------------------------------------------------------------------
+# faultline (round 15): deterministic fault injection (fault.h)
+
+# fault-site order (fault.h Site enum — tests/test_stats_lint.py guards
+# the mechanical mapping; the nativecheck `fault` rule guards that every
+# site has an annotated C++ fire site exercised by a test)
+FAULT_SITES = ("conn_read", "conn_write", "conn_accept",
+               "trunk_read", "trunk_write", "trunk_accept",
+               "trunk_connect", "store_msync", "store_seg_open",
+               "ring_seal", "ring_doorbell", "housekeep_clock")
+
+# fault modes (fault.h Mode enum): what an armed site does when it
+# fires — see the fault.h header for per-site semantics
+FAULT_MODES = {"off": 0, "errno": 1, "short": 2, "blackhole": 3,
+               "full": 4, "skew": 5}
+
+
+def fault_site_index(site: str) -> int:
+    """Site name -> fault.h enum index; unknown names FAIL loudly (the
+    sanitizer-lint discipline: a typo'd site must never arm nothing)."""
+    try:
+        return FAULT_SITES.index(site)
+    except ValueError:
+        raise ValueError(
+            f"unknown fault site {site!r}; valid: {FAULT_SITES}") from None
 
 
 def parse_spans(payload: bytes) -> list[tuple]:
@@ -884,7 +937,7 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "retain_set", "retain_del", "retain_deliver",
               "retain_msgs_out",
               "shard_ring_out", "shard_ring_in", "shard_ring_full",
-              "traced_pubs", "span_batches")
+              "traced_pubs", "span_batches", "faults_injected")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
@@ -1036,6 +1089,27 @@ class NativeStore:
     def sync(self) -> None:
         self._lib.emqx_store_sync(self._h)
 
+    def set_compact_age_ms(self, ms: int) -> None:
+        """Age-based compaction trigger (round 15): a sealed segment
+        whose live tail has sat past ``ms`` re-homes regardless of the
+        thin-tail byte bound — one huge live message can no longer pin
+        an otherwise-dead segment forever. 0 disables; default 60s."""
+        self._lib.emqx_store_set_compact_age(self._h, int(ms))
+
+    def fault_arm(self, site: str, mode: str = "errno",
+                  n_or_prob: float = 0.0, seed: int = 1,
+                  key: int = 0) -> None:
+        """Arm a store fault site directly (store_msync /
+        store_seg_open) — the raw-store test surface; the product path
+        arms through the host, which forwards here."""
+        self._lib.emqx_store_fault_arm(
+            self._h, fault_site_index(site), FAULT_MODES[mode],
+            float(n_or_prob), int(seed), int(key))
+
+    def fault_fired(self, site: str) -> int:
+        return int(self._lib.emqx_store_fault_fired(
+            self._h, fault_site_index(site)))
+
     def stats(self) -> dict[str, int]:
         return {name: int(self._lib.emqx_store_stat(self._h, i))
                 for i, name in enumerate(STORE_STAT_NAMES)}
@@ -1127,24 +1201,65 @@ class NativeHost:
             raise ValueError(f"cannot join shard group as {shard_id}")
 
     def trunk_peer_state(self, peer_id: int, up: bool) -> None:
-        """Mirror shard 0's trunk link state onto this (non-trunk)
-        shard: its trunk-vs-punt oracle for remote legs it would
-        ring-forward to shard 0."""
+        """Mirror a peer's OWNER-shard link state onto this
+        (non-owner) shard: its trunk-vs-punt oracle for remote legs it
+        would ring-forward to the owner (``peer_id % n_shards`` since
+        round 15)."""
         self._lib.emqx_host_trunk_peer_state(self._h, peer_id,
                                              1 if up else 0)
 
     # -- cluster trunk (round 9) -------------------------------------------
 
-    def trunk_listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    def trunk_listen(self, host: str = "127.0.0.1", port: int = 0,
+                     reuseport: bool = False) -> int:
         """Open the cluster-trunk listener (BEFORE the poll thread
         starts). Peer hosts dial it to forward publishes below the GIL;
         received batches fan out locally without touching Python.
-        Returns the bound port."""
-        p = self._lib.emqx_host_trunk_listen(self._h, host.encode(), port)
+        ``reuseport=True`` lets every shard listen on one port (the
+        round-15 link spread). Returns the bound port."""
+        p = self._lib.emqx_host_trunk_listen(self._h, host.encode(), port,
+                                             int(reuseport))
         if p < 0:
             raise OSError(f"cannot bind trunk listener {host}:{port}")
         self.trunk_port = p
         return p
+
+    def set_trunk_ack_timeout(self, ms: int) -> None:
+        """Silent-link watchdog deadline: a front replay-ring entry
+        unacked this long on an UP link kills the link (the only
+        resolution for an up-but-black partition). Default 10s;
+        0 disables the watchdog."""
+        self._lib.emqx_host_set_trunk_ack_timeout(self._h, int(ms))
+
+    # -- faultline (round 15) ------------------------------------------------
+
+    def fault_arm(self, site: str, mode: str = "errno",
+                  n_or_prob: float = 0.0, seed: int = 1,
+                  key: int = 0) -> None:
+        """Arm one named fault site (see fault.h / FAULT_SITES).
+        ``n_or_prob``: 0 fires every hit while armed; n >= 1 fires the
+        next n hits then auto-disarms; 0 < p < 1 fires each hit with
+        probability p from a PRNG seeded by ``seed`` (same seed + same
+        hit order = the bit-identical firing sequence). ``key`` scopes
+        the site to one conn/peer (0 = all). Unknown site or mode names
+        raise — a typo must never arm nothing. Store sites forward to
+        the attached durable store's injector."""
+        idx = fault_site_index(site)
+        rc = self._lib.emqx_host_fault_arm(
+            self._h, idx, FAULT_MODES[mode], float(n_or_prob),
+            int(seed), int(key))
+        if rc != 0:
+            raise ValueError(
+                f"cannot arm fault site {site!r} (no store attached?)")
+
+    def fault_disarm(self, site: str) -> None:
+        idx = fault_site_index(site)
+        self._lib.emqx_host_fault_arm(self._h, idx, 0, 0.0, 0, 0)
+
+    def fault_fired(self, site: str) -> int:
+        """Faults injected at ``site`` on this host so far."""
+        return int(self._lib.emqx_host_fault_fired(
+            self._h, fault_site_index(site)))
 
     def trunk_connect(self, peer_id: int, host: str, port: int) -> None:
         """Dial (or re-dial) a peer's trunk listener; the outcome

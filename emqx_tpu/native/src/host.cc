@@ -180,6 +180,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "fault.h"
 #include "frame.h"
 #include "ring.h"
 #include "router.h"
@@ -368,6 +369,7 @@ enum LedgerReason : uint8_t {
   kLrRingFull = 1,   // cross-shard ring full: publish degraded to punt
   kLrTrunkPunt,      // trunk down/ineligible: publish degraded to punt
   kLrShed,           // kHighWater backpressure shed (conn or trunk)
+  kLrFault,          // faultline injection fired (aux = the fault site)
   kLrCount
 };
 
@@ -602,7 +604,7 @@ struct Op {
     kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
     kDurableAdd, kDurableDel,
     kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift,
-    kTrunkPeerState, kSetTracing, kSetTrunkWire
+    kTrunkPeerState, kSetTracing, kSetTrunkWire, kSetTrunkAckTimeout
   };
   Kind kind;
   uint64_t owner = 0;
@@ -676,6 +678,7 @@ enum StatSlot {
   kStShardRingFull,    // publishes degraded ring-full -> punt -> Python
   kStTracedPubs,       // publishes tagged by the 1-in-N trace sampler
   kStSpanBatches,      // batched kind-12 trace records emitted
+  kStFaultsInjected,   // faultline fires on this host (all sites)
   kStatCount
 };
 
@@ -800,12 +803,17 @@ class Host {
   // thread). Peers' hosts dial this port to forward publishes below
   // the GIL. Returns the bound port, or -1.
   // @plane(control)
-  int ListenTrunk(const char* bind_addr, uint16_t port) {
+  int ListenTrunk(const char* bind_addr, uint16_t port,
+                  bool reuseport = false) {
     if (listen_trunk_fd_ >= 0) return -1;  // one trunk listener per host
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (fd < 0) return -1;
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    // per-shard trunk listeners on ONE port (round 15, the link-spread
+    // satellite): inbound peer links hash across shards like conns do
+    if (reuseport)
+      setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(port);
@@ -921,6 +929,34 @@ class Host {
   // @plane(control)
   void AttachStore(store::DurableStore* s) { store_ = s; }
 
+  // -- faultline control surface (thread-safe: atomics only) ---------------
+  // One arm API covers the whole node: host sites arm this host's
+  // injector, the two store_* sites forward to the attached store's
+  // (shared across shard hosts — Python arms it once, via shard 0).
+  int FaultArm(int site, int mode, double n_or_prob, uint64_t seed,
+               uint64_t key) {
+    if (site < 0 || site >= fault::kSiteCount) return -1;
+    if (site == fault::kSiteStoreMsync ||
+        site == fault::kSiteStoreSegOpen) {
+      if (store_ == nullptr) return -1;
+      store_->injector()->Arm(site, mode, n_or_prob, seed, key);
+      return 0;
+    }
+    fault_.Arm(site, mode, n_or_prob, seed, key);
+    return 0;
+  }
+
+  long FaultFired(int site) {
+    if (site < 0 || site >= fault::kSiteCount) return -1;
+    if (site == fault::kSiteStoreMsync ||
+        site == fault::kSiteStoreSegOpen)
+      return store_ == nullptr
+                 ? 0
+                 : static_cast<long>(
+                       store_->injector()->FiredCount(site));
+    return static_cast<long>(fault_.FiredCount(site));
+  }
+
   // Join a shard group (call BEFORE any poll thread starts). This host
   // becomes shard `shard_id` of `g->n`: conn ids gain the shard
   // prefix, cross-shard deliveries ride the group's SPSC rings, and
@@ -966,8 +1002,10 @@ class Host {
   // caught exactly this against Drop's erase). The product calls it
   // from _housekeep inside the poll step; a wrong-thread call fails
   // fast with -2 instead of silently racing.
+  // (non-const since round 15: the housekeep_clock fault site counts
+  // its fire; the poll-thread contract below already serializes it)
   // @plane(poll)
-  long ConnIdleMs(uint64_t id) const {
+  long ConnIdleMs(uint64_t id) {
     pthread_t poller = poll_thread_.load(std::memory_order_acquire);
     if (poller != pthread_t{} && !pthread_equal(poller, pthread_self())) {
       // abort-free warn-once: misuse must show up in plain test output
@@ -982,7 +1020,9 @@ class Host {
     }
     auto it = conns_.find(id);
     if (it == conns_.end()) return -1;
-    uint64_t now = NowMs();
+    // housekeep clock skew (faultline): keepalive scans judge conns
+    // against a future clock while the site is armed
+    uint64_t now = NowMs() + FaultSkewMs();
     const Conn& c = it->second;
     if (c.sn && !c.sn->awake) {
       if (now < c.sn->sleep_until_ms)
@@ -1030,6 +1070,7 @@ class Host {
       if (!lane_pending_.empty()) LaneStaleScan();
       SnRexmitScan();    // qos1-over-UDP retransmit timeouts
       TrunkHelloScan();  // old-peer HELLO grace deadlines (v0 links)
+      TrunkAckScan();    // silent-link watchdog (up-but-black links)
       FlushDurables();   // catch-all for appends with no dirty socket
       FlushTaps();
       FlushAcks();
@@ -1315,8 +1356,11 @@ class Host {
                          : 7u;
         break;
       case Op::kTrunkPeerState:
-        // shard 0's kind-9 UP/DOWN mirrored onto non-trunk shards by
-        // Python: the TrunkEligible oracle for ring-forwarded legs
+        // the owner shard's kind-9 UP/DOWN mirrored onto every OTHER
+        // shard by Python (round 15 — owners spread as peer % n): the
+        // TrunkEligible oracle for ring-forwarded legs; the owner
+        // ignores its own mirror entry (OwnsTrunkPeer routes it to
+        // the authoritative peer state)
         trunk_peer_up_[op.owner] = op.flags != 0;
         break;
       case Op::kSetTracing:
@@ -1335,6 +1379,13 @@ class Host {
         trunk_wire_max_ = op.qos <= trunk::kWireVersion
                               ? op.qos
                               : trunk::kWireVersion;
+        break;
+      case Op::kSetTrunkAckTimeout:
+        // silent-link watchdog deadline (round 15); tests tighten it
+        // so a blackholed link dies in milliseconds instead of
+        // seconds, and 0 DISABLES the watchdog (the store's
+        // compact-age convention — a swallowed 0 was a review finding)
+        trunk_ack_timeout_ms_ = op.token;
         break;
     }
   }
@@ -1716,11 +1767,11 @@ class Host {
       // trunk enqueue next to the device-matched local fan-out — the
       // TryFast walk path's two-halves discipline
       for (uint64_t peer : trunk_scratch_) {
-        if (IsTrunkShard())
+        if (OwnsTrunkPeer(peer))
           TrunkEnqueue(peer, le.publisher, le.qos, ldup, topic, payload);
         else
-          XShip(0, kTrunkOwnerBase + peer, le.publisher, le.qos, ldup,
-                topic, payload);
+          XShip(TrunkShardOf(peer), kTrunkOwnerBase + peer,
+                le.publisher, le.qos, ldup, topic, payload);
       }
       cur_trace_ = 0;  // this frame's trace context ends here
       if (telemetry_ && (fan_xshipped_ || !trunk_scratch_.empty())) {
@@ -1794,6 +1845,12 @@ class Host {
       int fd = accept4(lfd, reinterpret_cast<sockaddr*>(&peer), &plen,
                        SOCK_NONBLOCK | SOCK_CLOEXEC);
       if (fd < 0) return;
+      // @fault(conn_accept) — the accepted conn is torn down on the
+      // spot (the client sees an RST: an accept-storm shed)
+      if (FaultHit(fault::kSiteConnAccept, 0)) {
+        close(fd);
+        continue;
+      }
       if (conns_.size() >= max_conns_) {  // esockd max-conn limiting
         close(fd);
         continue;
@@ -1823,7 +1880,9 @@ class Host {
     uint8_t chunk[kReadChunk];
     c.last_rx_ms = NowMs();
     for (;;) {
-      ssize_t n = recv(c.fd, chunk, sizeof(chunk), 0);
+      // @fault(conn_read) — errno/blackhole injection on the conn recv
+      ssize_t n = FaultRecv(fault::kSiteConnRead, id, c.fd, chunk,
+                            sizeof(chunk));
       if (n > 0) {
         bool ok;
         if (c.ws) {
@@ -2278,14 +2337,15 @@ class Host {
     if (cur_trace_) SpanNote(kSpanRoute, match_scratch_.size());
     // remote legs last: the local fan-out above and the trunk enqueue
     // below are the two halves of emqx_broker:publish's route loop.
-    // Non-trunk shards ship the leg to shard 0 over the ring (target =
-    // the trunk owner-namespace id, the scheme the conn prefix reuses).
+    // Non-owner shards ship the leg to the peer's OWNER shard over the
+    // ring (target = the trunk owner-namespace id, the scheme the conn
+    // prefix reuses; round 15 spread the owners across shards).
     for (uint64_t peer : trunk_scratch_) {
-      if (IsTrunkShard())
+      if (OwnsTrunkPeer(peer))
         TrunkEnqueue(peer, id, qos, (h & 0x08) != 0, topic, payload);
       else
-        XShip(0, kTrunkOwnerBase + peer, id, qos, (h & 0x08) != 0,
-              topic, payload);
+        XShip(TrunkShardOf(peer), kTrunkOwnerBase + peer, id, qos,
+              (h & 0x08) != 0, topic, payload);
     }
     if (telemetry_) {
       FrNote(c, kFrFastPub, 3, qos, cur_hash_);
@@ -2889,6 +2949,12 @@ class Host {
       int fd = accept4(listen_trunk_fd_, reinterpret_cast<sockaddr*>(&peer),
                        &plen, SOCK_NONBLOCK | SOCK_CLOEXEC);
       if (fd < 0) return;
+      // @fault(trunk_accept) — the peer's dial lands on an RST and its
+      // redial backoff machinery takes over
+      if (FaultHit(fault::kSiteTrunkAccept, 0)) {
+        close(fd);
+        continue;
+      }
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       uint64_t tag = kTrunkSockBit | next_trunk_tag_++;
@@ -2918,6 +2984,13 @@ class Host {
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (fd < 0) {
       TrunkEmitDown(peer_id, "socket");
+      return;
+    }
+    // @fault(trunk_connect) — the dial fails before it starts; Python
+    // sees DOWN and drives the (jittered) redial backoff
+    if (FaultHit(fault::kSiteTrunkConnect, peer_id)) {
+      close(fd);
+      TrunkEmitDown(peer_id, "fault_connect");
       return;
     }
     sockaddr_in addr{};
@@ -2989,8 +3062,18 @@ class Host {
     auto sit = trunk_socks_.find(p.sock_tag);
     if (sit == trunk_socks_.end()) return;  // link died in the window
     p.up = true;
-    for (const trunk::Unacked& u : p.unacked) {
+    // qos0-only ring entries (empty q1_record: they existed for the
+    // OLD link's RTT stage) are dropped here, not replayed: with
+    // exact-match acks (round 15) an unreplayable entry at the ring
+    // front would read as an ack_gap the moment the peer acked the
+    // first replayed batch behind it. Survivors re-stamp their
+    // watchdog clock — a ring carried across a down window must not
+    // trip ack_timeout the instant the link comes back.
+    uint64_t now = NowMs();
+    std::deque<trunk::Unacked> keep;
+    for (trunk::Unacked& u : p.unacked) {
       if (u.q1_record.empty()) continue;
+      u.flush_ms = now;
       // the shadow persists the sampled trace ids (round 14); a
       // reconnect that negotiated below v1 strips them losslessly —
       // never put bytes on a wire the peer cannot parse
@@ -2999,7 +3082,9 @@ class Host {
       else
         sit->second.outbuf += u.q1_record;
       stats_[kStTrunkReplays].fetch_add(1, std::memory_order_relaxed);
+      keep.push_back(std::move(u));
     }
+    p.unacked.swap(keep);
     char sub = 1;
     events_.push_back(EncodeRecord(9, peer_id, &sub, 1));
     TrunkFlushSock(p.sock_tag, sit->second);
@@ -3092,7 +3177,10 @@ class Host {
     trunk::Sock& s = it->second;
     uint8_t chunk[kReadChunk];
     for (;;) {
-      ssize_t n = recv(s.fd, chunk, sizeof(chunk), 0);
+      // @fault(trunk_read) — a blackholed trunk read is one half of a
+      // partition: the peer's batches/acks/HELLOs vanish in flight
+      ssize_t n = FaultRecv(fault::kSiteTrunkRead, s.peer_id, s.fd,
+                            chunk, sizeof(chunk));
       if (n > 0) {
         s.inbuf.append(reinterpret_cast<char*>(chunk),
                        static_cast<size_t>(n));
@@ -3124,11 +3212,26 @@ class Host {
       const char* body = s.inbuf.data() + pos + 5;
       size_t blen = len - 1;
       if (type == trunk::kRecBatch) {
+        // per-sock seqs must strictly ascend (round 15): a regressed
+        // or duplicate seq means the byte stream desynced (an injected
+        // partition chopped it) — kill the link; redial replays
+        if (blen >= 8) {
+          uint64_t bseq = 0;
+          memcpy(&bseq, body, 8);
+          if (s.last_seq && bseq <= s.last_seq) {
+            TrunkSockDead(tag, "seq_regress");
+            return;
+          }
+          s.last_seq = bseq;
+        }
         TrunkApplyBatch(s, body, blen);
       } else if (type == trunk::kRecAck && s.dialer && blen >= 8) {
         uint64_t seq = 0;
         memcpy(&seq, body, 8);
         TrunkApplyAck(s.peer_id, seq);
+        // an ack_gap verdict kills THIS sock from under the read loop
+        // (the TrunkEvent-after-flush guard, applied here too)
+        if (!trunk_socks_.count(tag)) return;
       } else if (type == trunk::kRecHello && blen >= 1) {
         uint8_t theirs = static_cast<uint8_t>(body[0]);
         if (s.dialer) {
@@ -3343,6 +3446,7 @@ class Host {
     trunk::Unacked u;
     u.seq = seq;
     u.t0_ns = telemetry_ ? NowNs() : 0;
+    u.flush_ms = NowMs();   // the ack_timeout watchdog's reference
     u.has_trace = p.q1_has_trace;
     if (p.q1_n) {
       std::string q1body;
@@ -3421,24 +3525,54 @@ class Host {
     }
   }
 
-  // Cumulative ack: retire every unacked batch <= seq; the exactly
-  // matching entry closes the enqueue→peer-ack RTT stage.
+  // Exact-match ack (round 15 — was cumulative): retire precisely the
+  // ring entry the ack names. A cumulative trim was the silent-loss
+  // enabler under an up-but-black link: batches written into the void
+  // were retired by the first post-heal ack for a LATER seq. Acks
+  // arrive in seq order on a healthy link, so the front always
+  // matches; an ack AHEAD of the front is proof the peer never saw
+  // the front batch — kill the link and let the redial replay it
+  // (loss becomes at-least-once dups, never silence).
   void TrunkApplyAck(uint64_t peer_id, uint64_t seq) {
     auto it = trunk_peers_.find(peer_id);
     if (it == trunk_peers_.end()) return;
     trunk::Peer& p = it->second;
-    while (!p.unacked.empty() && p.unacked.front().seq <= seq) {
-      if (telemetry_ && p.unacked.front().seq == seq &&
-          p.unacked.front().t0_ns)
-        RecordHist(kHistTrunkRtt, NowNs() - p.unacked.front().t0_ns);
-      p.unacked.pop_front();
+    if (p.unacked.empty() || seq < p.unacked.front().seq)
+      return;  // stale ack (entry already retired): ignore
+    if (seq > p.unacked.front().seq) {
+      if (p.sock_tag) TrunkSockDead(p.sock_tag, "ack_gap");
+      return;
+    }
+    if (telemetry_ && p.unacked.front().t0_ns)
+      RecordHist(kHistTrunkRtt, NowNs() - p.unacked.front().t0_ns);
+    p.unacked.pop_front();
+  }
+
+  // Silent-link watchdog (round 15), once per poll cycle next to the
+  // HELLO grace scan: a partitioned-but-ESTABLISHED link never fails a
+  // syscall, so nothing else would ever notice its acks stopped. A
+  // front ring entry unacked past the timeout kills the link; the
+  // redial replays the ring. Entries sealed while the link was down
+  // are exempt by construction (the scan requires p.up, and
+  // TrunkCompleteUp re-stamps every survivor at replay time).
+  void TrunkAckScan() {
+    if (!trunk_ack_timeout_ms_ || trunk_peers_.empty()) return;
+    uint64_t now = NowMs();
+    for (auto& [peer_id, p] : trunk_peers_) {
+      if (!p.up || p.unacked.empty() || !p.sock_tag) continue;
+      if (now >= p.unacked.front().flush_ms + trunk_ack_timeout_ms_)
+        TrunkSockDead(p.sock_tag, "ack_timeout");
     }
   }
 
   void TrunkFlushSock(uint64_t tag, trunk::Sock& s) {
     while (s.outpos < s.outbuf.size()) {
-      ssize_t n = ::send(s.fd, s.outbuf.data() + s.outpos,
-                         s.outbuf.size() - s.outpos, MSG_NOSIGNAL);
+      // @fault(trunk_write) — blackhole = the up-but-black link: sends
+      // "succeed" while the bytes vanish; the ack_gap/ack_timeout
+      // watchdogs are what turn that loss back into a replay
+      ssize_t n = FaultSend(fault::kSiteTrunkWrite, s.peer_id, s.fd,
+                            s.outbuf.data() + s.outpos,
+                            s.outbuf.size() - s.outpos);
       if (n > 0) {
         s.outpos += static_cast<size_t>(n);
       } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
@@ -3475,32 +3609,48 @@ class Host {
     return static_cast<uint64_t>(shard_id_) << kShardShift;
   }
   uint64_t MintConnId() { return ShardPrefix() | next_id_++; }
-  // trunk links (listener + dials + peer rings) live on shard 0; an
-  // unsharded host IS shard 0
-  bool IsTrunkShard() const { return shard_id_ == 0; }
+  // Trunk peer links SPREAD across shards (round 15 — they all lived
+  // on shard 0, the hotspot an N-node mesh would have measured): peer
+  // P's dialer, replay ring, and peer state live on shard P % n.
+  // Python routes the link ops there; every shard's trunk LISTENER
+  // shares one port via SO_REUSEPORT so inbound links spread too. An
+  // unsharded host owns every peer.
+  int TrunkShardOf(uint64_t peer) const {
+    return group_ ? static_cast<int>(peer % group_->n) : 0;
+  }
+  bool OwnsTrunkPeer(uint64_t peer) const {
+    return TrunkShardOf(peer) == shard_id_;
+  }
 
   // Producer-side admission for one destination: alive consumer and
   // >= 2 free slots (room for the open batch plus one mid-publish
   // seal — a single publish can trigger at most one byte-cap seal, so
   // the cycle-end seal always has a slot).
+  // (non-const since round 15: the forced-ring_full fault site counts
+  // its fire through the stats/ledger accounting)
   // @admit-check
-  bool RingRoom(int dst) const {
+  bool RingRoom(int dst) {
+    // @fault(ring_seal) — forced ring_full: the publish degrades
+    // ring-full -> punt -> Python through the REAL ladder accounting
+    if (FaultHit(fault::kSiteRingSeal,
+                 static_cast<uint64_t>(dst) + 1))
+      return false;
     return group_ != nullptr &&
            group_->alive[dst].load(std::memory_order_acquire) &&
            group_->rings[shard_id_][dst].Free() >= 2;
   }
 
-  // Can this publish ride `peer`'s trunk from THIS shard? Non-trunk
+  // Can this publish ride `peer`'s trunk from THIS shard? Non-owner
   // shards consult their Python-broadcast up/down mirror
   // (kTrunkPeerState) and conservatively punt while the mirror lags;
   // the qos1 replay-ring bound is enforced where the ring lives
-  // (shard 0 — ring-forwarded entries may overshoot it by the
-  // in-flight cycle, the trunk's documented soft bound).
+  // (the peer's owner shard — ring-forwarded entries may overshoot it
+  // by the in-flight cycle, the trunk's documented soft bound).
   // @admit-check
   bool TrunkEligible(uint64_t peer, uint8_t qos,
                      size_t entry_bytes) const {
     if (qos == 2 || entry_bytes > trunk::kMaxEntryBytes) return false;
-    if (IsTrunkShard()) {
+    if (OwnsTrunkPeer(peer)) {
       auto tp = trunk_peers_.find(peer);
       return tp != trunk_peers_.end() && tp->second.up &&
              !(qos == 1 &&
@@ -3511,9 +3661,10 @@ class Host {
   }
 
   // Collect the destination shards this match set needs (plain
-  // cross-shard entries + shard 0 when trunk legs must ride the ring)
-  // and check ring room for each. False = the publish must degrade to
-  // a punt — called BEFORE any side effect, the trunk discipline.
+  // cross-shard entries + each trunk leg's owner shard when it must
+  // ride the ring) and check ring room for each. False = the publish
+  // must degrade to a punt — called BEFORE any side effect, the trunk
+  // discipline.
   // @admit-check
   bool ShardAdmit() {
     if (!group_) return true;
@@ -3525,8 +3676,10 @@ class Host {
       if (ds == shard_id_) continue;
       PushUnique(&xdst_scratch_, ds);
     }
-    if (!IsTrunkShard() && !trunk_scratch_.empty())
-      PushUnique(&xdst_scratch_, 0);
+    for (uint64_t peer : trunk_scratch_) {
+      int ts = TrunkShardOf(peer);
+      if (ts != shard_id_) PushUnique(&xdst_scratch_, ts);
+    }
     for (int ds : xdst_scratch_) {
       if (!RingRoom(ds)) {
         stats_[kStShardRingFull].fetch_add(1, std::memory_order_relaxed);
@@ -3633,7 +3786,11 @@ class Host {
     xbatch_n_[dst] = 0;
     xprev_payload_[dst].clear();
     xhave_prev_[dst] = false;
-    if (first) group_->RingDoorbell(dst);
+    // @fault(ring_doorbell) — a suppressed wakeup: the consumer must
+    // still drain on its next natural poll timeout (late, never lost)
+    if (first && !FaultHit(fault::kSiteRingDoorbell,
+                           static_cast<uint64_t>(dst) + 1))
+      group_->RingDoorbell(dst);
   }
 
   // Once per poll cycle (the FlushTrunks discipline): seal every dirty
@@ -3644,7 +3801,10 @@ class Host {
     dirty.swap(xdirty_);
     for (int dst : dirty) {
       SealShardBatch(dst);
-      group_->RingDoorbell(dst);
+      // @fault(ring_doorbell) — cycle-end wakeup suppressed too
+      if (!FaultHit(fault::kSiteRingDoorbell,
+                    static_cast<uint64_t>(dst) + 1))
+        group_->RingDoorbell(dst);
       xbatch_sealed_[dst] = 0;
     }
   }
@@ -4872,6 +5032,77 @@ class Host {
 
   // -- telemetry plane ----------------------------------------------------
 
+  // -- faultline (round 15) ------------------------------------------------
+  // Deterministic fault injection at the syscall seams (fault.h). The
+  // disarmed cost is ONE relaxed atomic load + branch per seam; every
+  // fired fault is observable through the same seams as organic
+  // degradation: a faults_injected stat tick + a kLrFault ledger entry
+  // (aux = the site) folded once per poll cycle. All fire sites below
+  // run on the poll thread (LedgerNote's ownership contract); the
+  // store's own sites live in store.h under its mutex.
+
+  void FaultNote(int site) {
+    stats_[kStFaultsInjected].fetch_add(1, std::memory_order_relaxed);
+    LedgerNote(kLrFault, static_cast<uint64_t>(site));
+  }
+
+  // Armed-site decision + accounting for sites with one behavior
+  // (accept/connect/ring/doorbell/clock): true = the fault fires.
+  bool FaultHit(int site, uint64_t key) {
+    if (!fault_.armed(site)) return false;
+    if (!fault_.Fire(site, key)) return false;
+    FaultNote(site);
+    return true;
+  }
+
+  // The socket-read seam. errno mode fails with ECONNRESET; blackhole
+  // models a partition: whatever the kernel holds is drained and
+  // DISCARDED (bytes in flight are lost in the void, and the
+  // level-triggered epoll quiesces) while the caller sees "nothing
+  // arrived" — no FIN/RST ever surfaces through a blackholed read.
+  ssize_t FaultRecv(int site, uint64_t key, int fd, void* buf,
+                    size_t cap) {
+    if (!fault_.armed(site)) return recv(fd, buf, cap, 0);
+    int m = fault_.Fire(site, key);
+    if (m == 0) return recv(fd, buf, cap, 0);
+    FaultNote(site);
+    if (m == fault::kModeBlackhole) {
+      [[maybe_unused]] ssize_t junk = recv(fd, buf, cap, 0);
+      errno = EAGAIN;
+      return -1;
+    }
+    errno = ECONNRESET;
+    return -1;
+  }
+
+  // The socket-write seam. short mode genuinely sends only a prefix
+  // (the partial-write backlog machinery runs for real); blackhole
+  // claims full success while the bytes vanish — the up-but-black
+  // link shape the trunk watchdog exists for.
+  ssize_t FaultSend(int site, uint64_t key, int fd, const char* buf,
+                    size_t len) {
+    if (!fault_.armed(site))
+      return ::send(fd, buf, len, MSG_NOSIGNAL);
+    int m = fault_.Fire(site, key);
+    if (m == 0) return ::send(fd, buf, len, MSG_NOSIGNAL);
+    FaultNote(site);
+    if (m == fault::kModeBlackhole) return static_cast<ssize_t>(len);
+    if (m == fault::kModeShort)
+      return ::send(fd, buf, len > 1 ? len / 2 : 1, MSG_NOSIGNAL);
+    errno = ECONNRESET;
+    return -1;
+  }
+
+  // Housekeep clock skew: ConnIdleMs sees NowMs() + this many ms while
+  // the site is armed (keepalive scans judge conns against a future
+  // clock — the idle-teardown machinery under test).
+  uint64_t FaultSkewMs() {
+    // @fault(housekeep_clock)
+    if (!FaultHit(fault::kSiteHousekeepClock, 0)) return 0;
+    return static_cast<uint64_t>(
+        fault_.Param(fault::kSiteHousekeepClock));
+  }
+
   void RecordHist(int stage, uint64_t ns) {
     Hist& h = hists_[stage];
     h.b[HistBucket(ns)]++;
@@ -5191,8 +5422,10 @@ class Host {
       return;
     }
     while (c.outpos < c.outbuf.size()) {
-      ssize_t n = ::send(c.fd, c.outbuf.data() + c.outpos,
-                         c.outbuf.size() - c.outpos, MSG_NOSIGNAL);
+      // @fault(conn_write) — errno/short/blackhole on the conn send
+      ssize_t n = FaultSend(fault::kSiteConnWrite, id, c.fd,
+                            c.outbuf.data() + c.outpos,
+                            c.outbuf.size() - c.outpos);
       if (n > 0) {
         c.outpos += static_cast<size_t>(n);
       } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
@@ -5338,6 +5571,14 @@ class Host {
   // highest trunk wire version this host speaks/advertises (tests cap
   // it at 0 to simulate an old peer)
   uint8_t trunk_wire_max_ = trunk::kWireVersion;
+  // -- faultline (round 15) ------------------------------------------------
+  // deterministic fault injection (fault.h): armed from any thread,
+  // fired on the poll thread; disarmed sites cost one relaxed load
+  fault::Injector fault_;
+  // silent-link watchdog deadline: a front ring entry unacked this
+  // long on an UP link kills it (TrunkAckScan) — the only way an
+  // up-but-black partition ever resolves into a replay
+  uint64_t trunk_ack_timeout_ms_ = 10000;
   // -- device match lane (poll-thread-owned) ------------------------------
   // Permitted PUBLISHes whose wildcard match runs on the DEVICE router
   // instead of the C++ trie walk: the frame parks here keyed by a lane
@@ -5427,9 +5668,10 @@ class Host {
   // ONE publish's cross-shard audience per destination (FanOut collects,
   // XShipMulti ships one multi-target entry per non-empty slot)
   std::vector<uint64_t> xtgt_scratch_[ring::kMaxShards];
-  // shard 0's trunk link state mirrored here by Python (kTrunkPeerState
-  // broadcast off the kind-9 UP/DOWN events): non-trunk shards decide
-  // trunk-vs-punt from this, conservatively down while the mirror lags
+  // each peer's OWNER-shard link state mirrored here by Python
+  // (kTrunkPeerState broadcast off the kind-9 UP/DOWN events, round
+  // 15 spread): non-owner shards decide trunk-vs-punt from this,
+  // conservatively down while the mirror lags
   std::unordered_map<uint64_t, bool> trunk_peer_up_;
 };
 
@@ -5637,8 +5879,38 @@ int emqx_host_set_trunk_wire(void* h, int version) {
 // Open the trunk listener (BEFORE the poll thread starts). Peer hosts
 // dial this port; received batch records fan out locally below the GIL.
 // Returns the bound port, or -1.
-int emqx_host_trunk_listen(void* h, const char* bind_addr, uint16_t port) {
-  return static_cast<emqx_native::Host*>(h)->ListenTrunk(bind_addr, port);
+int emqx_host_trunk_listen(void* h, const char* bind_addr, uint16_t port,
+                           int reuseport) {
+  return static_cast<emqx_native::Host*>(h)->ListenTrunk(bind_addr, port,
+                                                         reuseport != 0);
+}
+
+// Silent-link watchdog deadline in ms (round 15): a front replay-ring
+// entry unacked this long on an UP link kills the link so the redial
+// can replay it — the only resolution for an up-but-black partition.
+// 0 disables the watchdog (default 10s).
+int emqx_host_set_trunk_ack_timeout(void* h, uint64_t ms) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetTrunkAckTimeout;
+  op.token = ms;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// --- faultline (round 15) ---------------------------------------------------
+
+// Arm (mode 0 disarms) one named fault site — see fault.h for the
+// site/mode catalog and the n_or_prob/seed/key determinism contract.
+// Store sites forward to the attached store's injector. Thread-safe.
+int emqx_host_fault_arm(void* h, int site, int mode, double n_or_prob,
+                        uint64_t seed, uint64_t key) {
+  return static_cast<emqx_native::Host*>(h)->FaultArm(site, mode,
+                                                      n_or_prob, seed,
+                                                      key);
+}
+
+// Faults fired at one site so far (-1 on a bad site index).
+long emqx_host_fault_fired(void* h, int site) {
+  return static_cast<emqx_native::Host*>(h)->FaultFired(site);
 }
 
 // Dial (or re-dial) a peer's trunk listener. Thread-safe; the poll
@@ -5709,9 +5981,10 @@ int emqx_host_join_group(void* h, void* g, int shard_id) {
       static_cast<emqx_native::ring::ShardGroup*>(g), shard_id);
 }
 
-// Mirror shard 0's trunk link state onto a non-trunk shard (Python
-// broadcasts the kind-9 UP/DOWN events here): the shard's
-// trunk-vs-punt oracle for legs it would ring-forward to shard 0.
+// Mirror a peer's OWNER-shard link state onto the other shards
+// (Python broadcasts the kind-9 UP/DOWN events here): each shard's
+// trunk-vs-punt oracle for legs it would ring-forward to the owner
+// (peer % n since round 15).
 int emqx_host_trunk_peer_state(void* h, uint64_t peer, int up) {
   emqx_native::Op op;
   op.kind = emqx_native::Op::kTrunkPeerState;
@@ -5880,6 +6153,32 @@ int emqx_store_sync(void* s) {
 
 long emqx_store_stat(void* s, int slot) {
   return static_cast<emqx_native::store::DurableStore*>(s)->Stat(slot);
+}
+
+// Age-based compaction trigger (round 15): a sealed segment whose live
+// tail has sat past `ms` gets re-homed regardless of the thin-tail
+// byte bound, so one huge live message can no longer pin an otherwise
+// dead segment forever. 0 disables the age trigger.
+int emqx_store_set_compact_age(void* s, uint64_t ms) {
+  static_cast<emqx_native::store::DurableStore*>(s)->SetCompactAge(ms);
+  return 0;
+}
+
+// Direct store-injector surface (raw store tests; the product path
+// arms through emqx_host_fault_arm, which forwards store sites here).
+int emqx_store_fault_arm(void* s, int site, int mode, double n_or_prob,
+                         uint64_t seed, uint64_t key) {
+  if (site < 0 || site >= emqx_native::fault::kSiteCount) return -1;
+  static_cast<emqx_native::store::DurableStore*>(s)->injector()->Arm(
+      site, mode, n_or_prob, seed, key);
+  return 0;
+}
+
+long emqx_store_fault_fired(void* s, int site) {
+  return static_cast<long>(
+      static_cast<emqx_native::store::DurableStore*>(s)
+          ->injector()
+          ->FiredCount(site));
 }
 
 // Attach a store to a host (BEFORE the poll thread starts). The host

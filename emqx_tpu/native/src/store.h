@@ -73,6 +73,8 @@
 #include <unordered_set>
 #include <vector>
 
+#include "fault.h"
+
 namespace emqx_native {
 namespace store {
 
@@ -133,6 +135,9 @@ struct Segment {
   size_t cap = 0;
   size_t end = 0;       // append offset
   uint32_t live = 0;    // live message records homed here
+  // when this segment stopped being the active append target (0 =
+  // still active / unknown): the age-based compaction trigger's clock
+  uint64_t sealed_ms = 0;
 };
 
 struct StoredMsg {
@@ -183,6 +188,19 @@ class DurableStore {
   bool ok() {
     std::lock_guard<std::mutex> lk(mu_);
     return ok_;
+  }
+
+  // The store's own faultline injector (fault.h): msync and
+  // segment-open sites fire under mu_ like the real failures they
+  // model; the host forwards store-site arms here. Thread-safe.
+  fault::Injector* injector() { return &fault_; }
+
+  // Age-based compaction trigger (round 15): sealed segments whose
+  // live tail has sat past `ms` get re-homed regardless of the
+  // thin-tail byte bound. 0 disables the trigger.
+  void SetCompactAge(uint64_t ms) {
+    std::lock_guard<std::mutex> lk(mu_);
+    compact_age_ms_ = ms;
   }
 
   // sid -> stable token: returns the recovered token when the sid was
@@ -355,25 +373,68 @@ class DurableStore {
       }
     }
     // pass 2: compaction — sealed segments whose combined live payload
-    // is small get rewritten forward, then unlinked
-    if (segs_.size() > 2) {
+    // is small get rewritten forward, then unlinked. Round 15 adds the
+    // AGE trigger: a sealed segment whose live tail has sat past
+    // compact_age_ms_ re-homes regardless of the thin-tail byte bound,
+    // so one huge live message can no longer pin an otherwise-dead
+    // segment across gc cycles forever (AppendFrame rolls as needed
+    // when the aged rewrite exceeds the current segment's room).
+    if (segs_.size() > 1) {
       // hashed victim set: Gc holds the SAME mutex the poll thread's
       // FlushDurables needs (and FlushDirty orders PUBACKs behind it),
       // so these sweeps must stay O(M), never O(M*V)
       std::unordered_set<uint32_t> victims;
+      std::unordered_set<uint32_t> aged;
+      uint64_t now = WallMs();
       size_t live_bytes = 0, live_msgs = 0;
       for (auto& [id, s] : segs_) {
         if (&s == active_ || s.live == 0) continue;
         victims.insert(id);
+        if (compact_age_ms_ && s.sealed_ms &&
+            now >= s.sealed_ms + compact_age_ms_)
+          aged.insert(id);
       }
-      if (victims.size() >= 2) {
+      if (!victims.empty()) {
+        // per-segment live bytes alongside the combined totals (one
+        // O(M) sweep): the age trigger needs each candidate's own
+        // dead fraction, not just the pool-wide sum
+        std::unordered_map<uint32_t, size_t> seg_live;
         for (auto& [guid, m] : msgs_) {
           if (victims.count(m.seg)) {
-            live_bytes += m.topic.size() + m.payload.size() + 64;
+            size_t b = m.topic.size() + m.payload.size() + 64;
+            live_bytes += b;
+            seg_live[m.seg] += b;
             live_msgs++;
           }
         }
-        if (live_msgs && live_bytes < seg_bytes_ / 2) {
+        // an aged segment is only a victim if it is MOSTLY DEAD (live
+        // tail <= half its used bytes): the trigger exists for "one
+        // live record pinning an otherwise-dead segment" — a fully
+        // live sealed segment (a persistent subscriber's offline
+        // backlog, the store's core workload) must NOT be re-homed
+        // once a minute forever, and a freshly re-homed all-live
+        // segment must not age straight back into the victim set
+        for (auto it = aged.begin(); it != aged.end();) {
+          auto sit = segs_.find(*it);
+          if (sit == segs_.end() ||
+              seg_live[*it] * 2 > sit->second.end)
+            it = aged.erase(it);
+          else
+            ++it;
+        }
+        bool thin = victims.size() >= 2 && live_msgs &&
+                    live_bytes < seg_bytes_ / 2;
+        bool age_due = !aged.empty();
+        if (!thin && age_due) {
+          // age-triggered: re-home ONLY the expired mostly-dead
+          // segments (a young sealed segment keeps waiting for the
+          // thin-tail rule)
+          victims.swap(aged);
+          live_msgs = 0;
+          for (auto& [guid, m] : msgs_)
+            if (victims.count(m.seg)) live_msgs++;
+        }
+        if ((thin || age_due) && live_msgs) {
           std::string body;
           AppendU64(&body, WallMs());
           AppendU32(&body, static_cast<uint32_t>(live_msgs));
@@ -572,7 +633,14 @@ class DurableStore {
       char name[32];
       snprintf(name, sizeof(name), "/%08u.seg", s.id);
       std::string path = dir_ + name;
-      s.fd = open(path.c_str(), O_RDWR | O_CREAT | O_CLOEXEC, 0644);
+      // @fault(store_seg_open) — injected ENOSPC on the segment-open
+      // seam: the real disk-full degradation machinery below runs
+      bool inject = fault_.armed(fault::kSiteStoreSegOpen) &&
+                    fault_.Fire(fault::kSiteStoreSegOpen) != 0;
+      if (inject) errno = ENOSPC;
+      s.fd = inject ? -1
+                    : open(path.c_str(), O_RDWR | O_CREAT | O_CLOEXEC,
+                           0644);
       if (s.fd < 0 || ftruncate(s.fd, static_cast<off_t>(cap)) != 0) {
         if (s.fd >= 0) close(s.fd);
         ok_ = false;
@@ -599,6 +667,9 @@ class DurableStore {
     s.cap = cap;
     if (active_ && active_->fd >= 0 && fsync_ != kFsyncNever)
       SyncSeg(*active_);
+    // the outgoing active segment is sealed NOW: the age-based
+    // compaction clock starts here
+    if (active_) active_->sealed_ms = WallMs();
     active_ = &segs_.emplace(s.id, s).first->second;
   }
 
@@ -645,7 +716,27 @@ class DurableStore {
     if (s.fd < 0 || !s.base) return;
     size_t pg = static_cast<size_t>(sysconf(_SC_PAGESIZE));
     size_t len = ((s.end + pg - 1) / pg) * pg;
-    msync(s.base, std::min(len, s.cap), MS_SYNC);
+    int rc;
+    // @fault(store_msync) — injected EIO on the fsync seam; the REAL
+    // msync return was previously ignored, which silently voided the
+    // PUBACK-after-fsync contract on an erroring disk (round 15)
+    if (fault_.armed(fault::kSiteStoreMsync) &&
+        fault_.Fire(fault::kSiteStoreMsync)) {
+      rc = -1;
+      errno = EIO;
+    } else {
+      rc = msync(s.base, std::min(len, s.cap), MS_SYNC);
+    }
+    if (rc != 0) {
+      // the durability this segment's PUBACKs assert is gone for the
+      // failed stretch: count it (Python warns + folds the ledger) and
+      // flip ok_ STICKY — a sealed segment whose sync failed is never
+      // re-synced, so a later clean sync of a NEWER segment is no
+      // evidence the failed stretch ever reached disk (review
+      // finding); Roll's anonymous fallback is sticky the same way
+      ok_ = false;
+      stats_[kSsDegraded]++;
+    }
     dirty_ = false;
   }
 
@@ -714,6 +805,9 @@ class DurableStore {
       Segment& ref = segs_.emplace(id, s).first->second;
       ScanSeg(&ref);
       if (id >= next_seg_id_) next_seg_id_ = id + 1;
+      // the previous newest is sealed by this one arriving; its age
+      // clock (compaction trigger) restarts at recovery — conservative
+      if (active_) active_->sealed_ms = WallMs();
       active_ = &ref;  // newest scanned segment resumes as active
     }
     // resume appending AFTER the last valid frame of the newest segment
@@ -789,6 +883,10 @@ class DurableStore {
   std::string dir_;        // immutable after construction
   size_t seg_bytes_;       // immutable after construction
   int fsync_;              // immutable after construction
+  // faultline injector (all-atomic: arming never takes mu_; firing
+  // happens under it with the syscall it replaces)
+  fault::Injector fault_;
+  uint64_t compact_age_ms_ = 60000;  // @guards(mu_) — 0 = age trigger off
   bool ok_ = true;         // @guards(mu_) — Roll flips it mid-run
   bool dirty_ = false;             // @guards(mu_)
   uint64_t last_sync_ms_ = 0;      // @guards(mu_)

@@ -24,10 +24,19 @@
 //                   TCP framing + the receiver's sequential decode give
 //                   per-topic order for free.
 //   type 3 = ACK    body = [u64 seq] — the receiver acks each batch
-//                   AFTER local fan-out; acks are cumulative (an ack
-//                   for seq s retires every unacked batch <= s).  The
-//                   sender uses the ack for the enqueue->peer-ack RTT
-//                   stage and to trim the QoS1 replay ring.
+//                   AFTER local fan-out. Acks retire EXACTLY the ring
+//                   entry they name (round 15): a cumulative trim let
+//                   an up-but-black link (a TCP partition, not a
+//                   close) lose acked qos1 silently — batches written
+//                   into the void were retired by the first
+//                   post-heal ack for a LATER seq. Now an ack for a
+//                   seq ahead of the ring front is evidence the link
+//                   skipped data and kills it ("ack_gap"), and a link
+//                   whose front entry goes unacked past the ack
+//                   timeout dies too ("ack_timeout") — both deaths
+//                   redial + replay the ring, so loss becomes dups.
+//                   The sender also uses the ack for the
+//                   enqueue->peer-ack RTT stage.
 //
 // Reliability ladder (host.cc wires the seams):
 //   - QoS0: fire-and-forget; batches are not retained once written.
@@ -170,12 +179,23 @@ struct Sock {
   std::string inbuf;        // partial trunk records
   std::string outbuf;       // unsent bytes (partial-write backlog)
   size_t outpos = 0;
+  // highest BATCH seq applied on this sock (receiver side): seqs must
+  // strictly ascend per link — a regressed/duplicate seq is a poisoned
+  // stream and kills the sock ("seq_regress", round 15). Gaps are
+  // legal (replay skips acked/empty batches; down-window seals burn
+  // seqs), so only monotonicity is enforced here; loss detection is
+  // the SENDER's ack_gap/ack_timeout job.
+  uint64_t last_seq = 0;
 };
 
 // A flushed-but-unacked batch (the QoS1 replay ring entry).
 struct Unacked {
   uint64_t seq = 0;
   uint64_t t0_ns = 0;       // flush stamp (0 = telemetry off)
+  // coarse flush/replay stamp for the silent-link watchdog (round 15):
+  // refreshed at replay so a ring carried across a down window does
+  // not trip the timeout the instant the link comes back up
+  uint64_t flush_ms = 0;
   // pre-framed qos1-only wire record for this batch ("" = batch held
   // no elevated-qos entries; nothing to replay, ring entry exists only
   // for the RTT stage). Built at the HIGHEST wire version the entries

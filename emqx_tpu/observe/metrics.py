@@ -93,9 +93,19 @@ NATIVE = [
     # renders at zero in prometheus and rides the $SYS heartbeat before
     # the first degradation ever happens.
     "messages.ledger.ring_full", "messages.ledger.trunk_punt",
-    "messages.ledger.shed", "messages.ledger.device_failover",
+    "messages.ledger.shed", "messages.ledger.fault",
+    "messages.ledger.device_failover",
     "messages.ledger.store_degraded",
 ]
+# faultline (round 15): one fixed slot per fault-injection site, so
+# every faults.<site> counter renders at zero in prometheus/$SYS before
+# the first injection — canonical site order mirrors native/__init__.py
+# FAULT_SITES (test_stats_lint pins the pair against the fault.h enum)
+FAULT_SITES = ("conn_read", "conn_write", "conn_accept",
+               "trunk_read", "trunk_write", "trunk_accept",
+               "trunk_connect", "store_msync", "store_seg_open",
+               "ring_seal", "ring_doorbell", "housekeep_clock")
+FAULTS = [f"faults.{s}" for s in FAULT_SITES]
 CLIENT = [
     "client.connect", "client.connack", "client.connected",
     "client.authenticate", "client.auth.anonymous", "client.authorize",
@@ -111,7 +121,7 @@ OLP = ["olp.delay.ok", "olp.delay.timeout", "olp.hbn", "olp.gc",
        "olp.new_conn"]
 
 ALL_NAMES: list[str] = (BYTES + PACKETS + MESSAGES + DELIVERY + NATIVE
-                        + CLIENT + SESSION + AUTHZ + OLP)
+                        + FAULTS + CLIENT + SESSION + AUTHZ + OLP)
 
 
 # ---------------------------------------------------------------------------
@@ -234,9 +244,10 @@ class LatencyHistogram:
 # hit a sampled publish.
 
 # canonical reason set — must match native/__init__.py LEDGER_REASONS
-# (test_stats_lint pins the pair; the C++ LedgerReason enum is a prefix)
-LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "device_failover",
-                  "store_degraded")
+# (test_stats_lint pins the pair; the C++ LedgerReason enum is a prefix:
+# "fault" is a faultline injection firing, round 15)
+LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
+                  "device_failover", "store_degraded")
 
 
 class DegradationLedger:

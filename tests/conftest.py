@@ -26,6 +26,12 @@ import random
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soaks excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return random.Random(0xE19)

@@ -1,0 +1,595 @@
+"""Faultline (ISSUE 11 tentpole): deterministic fault injection at the
+native plane's syscall seams (native/src/fault.h).
+
+Covered here, site by site (the nativecheck ``fault`` rule requires
+every declared site to be named by at least one test):
+
+- replay determinism: same seed => the bit-identical firing sequence
+  (the acceptance-criteria pin), different seed => a different one;
+- conn_read / conn_write / conn_accept: errno (ECONNRESET), short
+  writes (the partial-write backlog machinery makes real progress),
+  and blackhole (bytes vanish, the socket stays up);
+- trunk_connect / trunk_accept: injected dial/accept failures drive
+  the real DOWN -> redial machinery;
+- ring_seal / ring_doorbell: forced ring_full degrades through the
+  REAL ladder (punt -> Python, nothing lost); a suppressed doorbell
+  delays delivery but never loses it;
+- housekeep_clock: ConnIdleMs reads a skewed clock;
+- store_msync / store_seg_open: EIO/ENOSPC drive the store's real
+  degradation machinery (degraded stat, anonymous-segment fallback);
+- observability: every fired fault counts faults.<site> and lands in
+  the degradation ledger as reason "fault" — chaos through the same
+  seams as organic degradation;
+- disarmed sites are inert: zero fires under traffic with nothing
+  armed.
+"""
+
+import asyncio
+import socket
+import struct
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp                              # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer    # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient                     # noqa: E402
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def _wait(pred, timeout=8.0, step=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _mqtt_connect(cid: bytes) -> bytes:
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    return bytes([0x10, len(vh)]) + vh
+
+
+def _mqtt_publish(topic: bytes, payload: bytes, qos=0, pid=0) -> bytes:
+    body = struct.pack(">H", len(topic)) + topic
+    if qos:
+        body += struct.pack(">H", pid)
+    body += payload
+    return bytes([0x30 | (qos << 1), len(body)]) + body
+
+
+def _raw_conn(host, cid: bytes):
+    """Connect a raw socket to a poll-driven-by-the-test host; returns
+    (sock, conn_id) once the CONNECT frame surfaced (answered with a
+    CONNACK). The TEST thread drives host.poll(), so it IS the poll
+    thread for poll-thread-only surfaces like conn_idle_ms."""
+    s = socket.create_connection(("127.0.0.1", host.port))
+    s.sendall(_mqtt_connect(cid))
+    conn_id = None
+    framed = False
+    deadline = time.time() + 10
+    while (conn_id is None or not framed) and time.time() < deadline:
+        for kind, conn, _payload in host.poll(50):
+            if kind == native.EV_OPEN:
+                conn_id = conn
+            elif kind == native.EV_FRAME:
+                framed = True
+                host.send(conn, b"\x20\x02\x00\x00")
+    assert conn_id is not None and framed, (conn_id, framed)
+    return s, conn_id
+
+
+# -- API hygiene --------------------------------------------------------------
+
+
+def test_unknown_site_or_mode_fails_loudly():
+    """A typo'd site must never arm nothing (the sanitizer-lint
+    discipline, enforced at runtime here and statically by the
+    nativecheck fault rule)."""
+    host = native.NativeHost(port=0)
+    try:
+        with pytest.raises(ValueError):
+            host.fault_arm("conn_raed")
+        with pytest.raises(KeyError):
+            host.fault_arm("conn_read", mode="explode")
+        # store sites with no attached store refuse instead of no-op
+        with pytest.raises(ValueError):
+            host.fault_arm("store_msync")
+    finally:
+        host.destroy()
+
+
+def test_disarmed_sites_are_inert_under_traffic():
+    """Nothing armed => zero fires, zero faults_injected, ledger clean
+    — the disarmed branch is a single relaxed atomic load."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        s, conn = _raw_conn(host, b"inert")
+        host.enable_fast(conn, 4)
+        s.sendall(_mqtt_publish(b"f/x", b"p"))
+        for _ in range(10):
+            list(host.poll(10))
+        assert host.stats()["faults_injected"] == 0
+        for site in native.FAULT_SITES:
+            assert host.fault_fired(site) == 0, site
+        s.close()
+    finally:
+        host.destroy()
+
+
+# -- replay determinism (acceptance criterion) --------------------------------
+
+
+def test_same_seed_same_firing_sequence():
+    """Probabilistic arming replays bit-identically: the per-hit
+    fire/no-fire sequence over 200 store appends (each append is one
+    store_msync hit under fsync=batch) is equal for equal seeds and
+    different for a different seed."""
+
+    def sequence(tmpdir, seed):
+        st = native.NativeStore(tmpdir, 1 << 20, "batch")
+        st.fault_arm("store_msync", "errno", n_or_prob=0.5, seed=seed)
+        tok = st.register("det-sid")
+        seq, last = [], 0
+        for i in range(200):
+            st.append(1, 1, [tok], "d/t", b"x%d" % i)
+            fired = st.fault_fired("store_msync")
+            seq.append(fired - last)
+            last = fired
+        st.close()
+        return seq
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as d2, \
+            tempfile.TemporaryDirectory() as d3:
+        a = sequence(d1, seed=42)
+        b = sequence(d2, seed=42)
+        c = sequence(d3, seed=43)
+    assert a == b                     # same seed => identical replay
+    assert 20 < sum(a) < 180          # p=0.5 actually fires
+    assert a != c                     # a different seed diverges
+
+
+def test_counted_arm_fires_exactly_n_then_disarms(tmp_path):
+    """n_or_prob >= 1 fires on exactly the next n hits, then the site
+    auto-disarms (deterministic with no PRNG at all). (An anonymous
+    store never msyncs — fd < 0 — so this runs on a real dir.)"""
+    st = native.NativeStore(str(tmp_path), 1 << 20, "batch")
+    try:
+        tok = st.register("cnt-sid")
+        st.fault_arm("store_msync", "errno", n_or_prob=3)
+        for i in range(10):
+            st.append(1, 1, [tok], "c/t", b"y%d" % i)
+        assert st.fault_fired("store_msync") == 3
+    finally:
+        st.close()
+
+
+# -- conn sites ---------------------------------------------------------------
+
+
+def test_conn_read_errno_drops_conn_and_counts():
+    """Injected ECONNRESET on the conn recv seam tears the conn down
+    through the REAL sock_error path, counted in faults_injected."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        s, conn = _raw_conn(host, b"crd")
+        host.fault_arm("conn_read", "errno", n_or_prob=1, key=conn)
+        s.sendall(b"\xc0\x00")   # PINGREQ: any inbound bytes trigger
+        closed = []
+        deadline = time.time() + 8
+        while not closed and time.time() < deadline:
+            for kind, cid, payload in host.poll(50):
+                if kind == native.EV_CLOSED and cid == conn:
+                    closed.append(payload)
+        assert closed and closed[0] == b"sock_error", closed
+        assert host.fault_fired("conn_read") == 1
+        assert host.stats()["faults_injected"] == 1
+        s.close()
+    finally:
+        host.destroy()
+
+
+def test_conn_write_short_writes_still_deliver_everything():
+    """Short writes exercise the partial-write backlog (outbuf/outpos +
+    EPOLLOUT re-arm) for real: every delivery arrives intact, just in
+    more pieces."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        pub_s, pub = _raw_conn(host, b"swp")
+        sub_s, sub = _raw_conn(host, b"sws")
+        host.enable_fast(pub, 4)
+        host.enable_fast(sub, 4)
+        host.sub_add(sub, "sw/+")
+        host.permit(pub, "sw/x")
+        host.fault_arm("conn_write", "short", key=sub)  # every send
+        want = [b"m%04d" % i for i in range(50)]
+        for p in want:
+            pub_s.sendall(_mqtt_publish(b"sw/x", p))
+        sub_s.settimeout(0.2)
+        got = b""
+        deadline = time.time() + 10
+        while time.time() < deadline and got.count(b"sw/x") < len(want):
+            list(host.poll(20))
+            try:
+                got += sub_s.recv(65536)
+            except TimeoutError:
+                continue
+        for p in want:
+            assert p in got, p
+        # the backlog halves per armed send: a handful of short writes
+        # carried the whole burst (deliveries coalesce per poll cycle)
+        assert host.fault_fired("conn_write") >= 5
+        pub_s.close()
+        sub_s.close()
+    finally:
+        host.destroy()
+
+
+def test_conn_write_blackhole_bytes_vanish_conn_survives():
+    """A blackholed conn write claims success while nothing reaches the
+    wire — the conn stays open (no FIN/RST), exactly a partitioned
+    subscriber. Healing resumes delivery."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        pub_s, pub = _raw_conn(host, b"bhp")
+        sub_s, sub = _raw_conn(host, b"bhs")
+        host.enable_fast(pub, 4)
+        host.enable_fast(sub, 4)
+        host.sub_add(sub, "bh/+")
+        host.permit(pub, "bh/x")
+        host.fault_arm("conn_write", "blackhole", key=sub)
+        pub_s.sendall(_mqtt_publish(b"bh/x", b"void"))
+        for _ in range(10):
+            list(host.poll(10))
+        sub_s.settimeout(0.3)
+        with pytest.raises((TimeoutError, socket.timeout)):
+            sub_s.recv(4096)
+        assert host.fault_fired("conn_write") >= 1
+        host.fault_disarm("conn_write")
+        pub_s.sendall(_mqtt_publish(b"bh/x", b"healed"))
+        got = b""
+        deadline = time.time() + 8
+        while b"healed" not in got and time.time() < deadline:
+            list(host.poll(20))
+            try:
+                got += sub_s.recv(4096)
+            except TimeoutError:
+                continue
+        assert b"healed" in got
+        pub_s.close()
+        sub_s.close()
+    finally:
+        host.destroy()
+
+
+def test_conn_accept_shed_then_recovers():
+    """An injected accept fault sheds exactly the armed count of
+    connections (the client sees a close); the next connect lands."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        host.fault_arm("conn_accept", "errno", n_or_prob=1)
+        s1 = socket.create_connection(("127.0.0.1", host.port))
+        s1.sendall(_mqtt_connect(b"shed1"))
+        # the shed conn never surfaces as OPEN; the socket dies
+        t0 = time.time()
+        opened = []
+        while time.time() - t0 < 1.0:
+            for kind, conn, _p in host.poll(20):
+                if kind == native.EV_OPEN:
+                    opened.append(conn)
+        assert opened == [], opened
+        assert host.fault_fired("conn_accept") == 1
+        s2, _conn = _raw_conn(host, b"shed2")   # site auto-disarmed
+        s1.close()
+        s2.close()
+    finally:
+        host.destroy()
+
+
+# -- housekeep clock ----------------------------------------------------------
+
+
+def test_housekeep_clock_skew_ages_idle_conns():
+    """With housekeep_clock armed (skew mode), ConnIdleMs reads a
+    future clock: an idle conn ages by the skew instantly — the
+    keepalive-teardown machinery's input under test."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        s, conn = _raw_conn(host, b"skw")
+        list(host.poll(10))
+        base = host.conn_idle_ms(conn)
+        assert 0 <= base < 5000, base
+        host.fault_arm("housekeep_clock", "skew", n_or_prob=70000)
+        aged = host.conn_idle_ms(conn)
+        assert aged >= 70000, aged
+        assert host.fault_fired("housekeep_clock") >= 1
+        host.fault_disarm("housekeep_clock")
+        assert host.conn_idle_ms(conn) < 5000
+        s.close()
+    finally:
+        host.destroy()
+
+
+# -- trunk link sites ---------------------------------------------------------
+
+
+def test_trunk_connect_and_trunk_accept_faults_drive_down_up():
+    """Injected dial/accept failures surface as kind-9 DOWN events and
+    the link still comes up once the sites disarm — the redial
+    machinery under injected (not just organic) failure."""
+    A = native.NativeHost(port=0, max_size=1 << 16)
+    B = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        tp = B.trunk_listen()
+
+        events = {"up": 0, "down": []}
+
+        def pump(timeout=0.05):
+            for h in (A, B):
+                for kind, _cid, payload in h.poll(int(timeout * 1000)):
+                    if kind == native.EV_TRUNK and payload and h is A:
+                        if payload[0] == native.TRUNK_UP:
+                            events["up"] += 1
+                        elif payload[0] == native.TRUNK_DOWN:
+                            events["down"].append(payload[1:])
+
+        # dial fault: DOWN with the injected reason, no socket made
+        A.fault_arm("trunk_connect", "errno", n_or_prob=1, key=7)
+        A.trunk_connect(7, "127.0.0.1", tp)
+        deadline = time.time() + 5
+        while not events["down"] and time.time() < deadline:
+            pump()
+        assert events["down"] and events["down"][0] == b"fault_connect"
+        assert A.fault_fired("trunk_connect") == 1
+
+        # accept fault on B: A's dial lands on an RST; A reports DOWN
+        B.fault_arm("trunk_accept", "errno", n_or_prob=1)
+        A.trunk_connect(7, "127.0.0.1", tp)
+        deadline = time.time() + 5
+        while len(events["down"]) < 2 and time.time() < deadline:
+            pump()
+        assert B.fault_fired("trunk_accept") == 1
+
+        # healed: the next dial completes UP
+        A.trunk_connect(7, "127.0.0.1", tp)
+        deadline = time.time() + 8
+        while events["up"] == 0 and time.time() < deadline:
+            pump()
+        assert events["up"] >= 1, events
+    finally:
+        A.destroy()
+        B.destroy()
+
+
+# -- store sites --------------------------------------------------------------
+
+
+def test_store_seg_open_enospc_degrades_to_anonymous():
+    """Injected ENOSPC on the segment-open seam drives the REAL
+    disk-full machinery: the store degrades to an anonymous segment,
+    counts it, and keeps serving (PUBACKs keep flowing; restart
+    survival is what is lost)."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        st = native.NativeStore(d, 1 << 16, "never")
+        try:
+            tok = st.register("eno-sid")
+            st.fault_arm("store_seg_open", "errno", n_or_prob=1)
+            # roll past the tiny segment so Roll() runs the armed site
+            big = b"z" * 8192
+            for i in range(20):
+                st.append(1, 1, [tok], "e/t", big)
+            assert st.fault_fired("store_seg_open") == 1
+            assert st.stats()["degraded"] >= 1
+            assert st.pending(tok) == 20   # the plane kept running
+        finally:
+            st.close()
+
+
+def test_store_msync_eio_counts_degraded_and_heals():
+    """Injected EIO on the fsync seam: each failed sync counts degraded
+    (the PUBACK-after-fsync contract is void for that stretch); a
+    clean sync afterwards keeps the store serving."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        st = native.NativeStore(d, 1 << 20, "batch")
+        try:
+            tok = st.register("eio-sid")
+            st.append(1, 1, [tok], "m/t", b"pre")
+            assert st.stats()["degraded"] == 0
+            st.fault_arm("store_msync", "errno", n_or_prob=2)
+            st.append(1, 1, [tok], "m/t", b"d1")
+            st.append(1, 1, [tok], "m/t", b"d2")
+            assert st.fault_fired("store_msync") == 2
+            assert st.stats()["degraded"] == 2
+            st.append(1, 1, [tok], "m/t", b"post")   # auto-disarmed
+            assert st.stats()["degraded"] == 2
+            assert st.pending(tok) == 4
+        finally:
+            st.close()
+
+
+# -- ring sites (sharded) -----------------------------------------------------
+
+
+def _group_pair():
+    """Two raw hosts in one shard group (the test_native_shards raw
+    pattern): the TEST drives both polls, so placement is explicit."""
+    group = native.NativeShardGroup(2)
+    h0 = native.NativeHost(port=0, max_size=1 << 16)
+    h1 = native.NativeHost(port=0, max_size=1 << 16)
+    h0.join_group(group, 0)
+    h1.join_group(group, 1)
+    return group, h0, h1
+
+
+def test_ring_seal_forced_full_degrades_to_punt():
+    """An armed ring_seal site makes the admission check report no
+    room: the publish degrades ring-full -> punt -> Python BEFORE any
+    side effect (the frame surfaces to Python verbatim), and both the
+    organic shard_ring_full stat and the faults counter tick."""
+    group, h0, h1 = _group_pair()
+    try:
+        pub_s, pub = _raw_conn(h0, b"rsp")
+        sub_s, sub = _raw_conn(h1, b"rss")
+        h0.enable_fast(pub, 4)
+        h1.enable_fast(sub, 4)
+        for h in (h0, h1):                 # replicated table
+            h.sub_add(sub, "rs/+")
+        h0.permit(pub, "rs/x")
+        h0.fault_arm("ring_seal", "full", n_or_prob=1, key=2)  # dst 1
+        pub_s.sendall(_mqtt_publish(b"rs/x", b"punted"))
+        punted = []
+        deadline = time.time() + 8
+        while not punted and time.time() < deadline:
+            for kind, cid, payload in h0.poll(20):
+                if kind == native.EV_FRAME and cid == pub:
+                    punted.append(payload)
+            list(h1.poll(0))
+        assert punted and b"punted" in punted[0]
+        st = h0.stats()
+        assert st["shard_ring_full"] >= 1
+        assert st["faults_injected"] >= 1
+        assert h0.fault_fired("ring_seal") == 1
+        # healed (count exhausted): the next publish crosses natively
+        pub_s.sendall(_mqtt_publish(b"rs/x", b"across"))
+        sub_s.settimeout(0.2)
+        got = b""
+        deadline = time.time() + 8
+        while b"across" not in got and time.time() < deadline:
+            list(h0.poll(20))
+            list(h1.poll(20))
+            try:
+                got += sub_s.recv(4096)
+            except TimeoutError:
+                continue
+        assert b"across" in got
+        pub_s.close()
+        sub_s.close()
+    finally:
+        h0.destroy()
+        h1.destroy()
+        group.destroy()
+
+
+def test_ring_doorbell_suppressed_delivery_late_never_lost():
+    """A suppressed doorbell delays the consumer shard to its next
+    natural poll timeout — delivery still happens (late, never lost)
+    and the suppression is counted."""
+    group, h0, h1 = _group_pair()
+    try:
+        pub_s, pub = _raw_conn(h0, b"dbp")
+        sub_s, sub = _raw_conn(h1, b"dbs")
+        h0.enable_fast(pub, 4)
+        h1.enable_fast(sub, 4)
+        for h in (h0, h1):
+            h.sub_add(sub, "db/+")
+        h0.permit(pub, "db/x")
+        h0.fault_arm("ring_doorbell", "blackhole")   # every wakeup
+        pub_s.sendall(_mqtt_publish(b"db/x", b"late"))
+        sub_s.settimeout(0.2)
+        got = b""
+        deadline = time.time() + 10
+        while b"late" not in got and time.time() < deadline:
+            list(h0.poll(20))
+            list(h1.poll(20))   # natural poll drains the ring anyway
+            try:
+                got += sub_s.recv(4096)
+            except TimeoutError:
+                continue
+        assert b"late" in got
+        assert h0.fault_fired("ring_doorbell") >= 1
+        pub_s.close()
+        sub_s.close()
+    finally:
+        h0.destroy()
+        h1.destroy()
+        group.destroy()
+
+
+# -- observability through the product seams ----------------------------------
+
+
+def test_fired_faults_land_in_ledger_and_faults_metrics():
+    """Server-level: a fired host-plane fault surfaces as (a) the
+    faults.<site> fixed metric slot, (b) a degradation-ledger event
+    with reason "fault" and aux = the site index, (c) the
+    faults_injected host stat — the same observability seams organic
+    degradation uses."""
+    app = BrokerApp()
+    srv = NativeBrokerServer(port=0, app=app)
+    srv.start()
+    try:
+        async def main():
+            c = MqttClient(port=srv.port, clientid="lf")
+            await c.connect()
+            assert _wait(lambda: "lf" in srv._fast_conn_of)
+            conn_id = srv._fast_conn_of["lf"]
+            srv.fault_arm("conn_read", "errno", n_or_prob=1,
+                          key=conn_id)
+            try:
+                await c.publish("lf/x", b"boom")   # inbound bytes fire
+            except (ConnectionError, OSError):
+                pass
+            assert _wait(lambda: srv.fault_fired("conn_read") >= 1), (
+                srv.fast_stats())
+            try:
+                await c.close()
+            except (ConnectionError, OSError):
+                pass
+
+        run(main())
+        srv._merge_fast_metrics()
+        m = srv.broker.metrics
+        assert m.val("faults.conn_read") >= 1
+        assert srv.fast_stats()["faults_injected"] >= 1
+        # the C++ kind-12 ledger fold carries reason "fault"
+        assert _wait(lambda: srv.ledger.totals().get("fault", 0) >= 1), (
+            srv.ledger.totals())
+        idx = native.FAULT_SITES.index("conn_read")
+        assert any(e["reason"] == "fault" and e["aux"] == idx
+                   for e in srv.ledger.recent()), srv.ledger.recent()
+        assert m.val("messages.ledger.fault") >= 1
+    finally:
+        srv.stop()
+
+
+def test_store_faults_fold_into_ledger_via_housekeep(tmp_path):
+    """Store-site fires happen under the store mutex on arbitrary
+    threads: their ledger entries fold in _merge_fast_metrics (detail
+    = the site name), next to the faults.store_* metric slots. (A real
+    durable_dir: an anonymous store never msyncs.)"""
+    from emqx_tpu.session.persistent import MemStore
+
+    app = BrokerApp(persistent_store=MemStore())
+    srv = NativeBrokerServer(port=0, app=app,
+                             durable_dir=str(tmp_path),
+                             durable_fsync="batch")
+    if srv._durable_store is None:
+        srv.stop()
+        pytest.skip("durable store unavailable")
+    srv.start()
+    try:
+        srv.fault_arm("store_msync", "errno", n_or_prob=1)
+        # one direct append drives the armed msync under fsync=batch
+        tok = srv._durable_store.register("lf-sid")
+        srv._durable_store.append(1, 1, [tok], "lf/t", b"x")
+        assert srv.fault_fired("store_msync") == 1
+        srv._merge_fast_metrics()
+        m = srv.broker.metrics
+        assert m.val("faults.store_msync") == 1
+        assert any(e["reason"] == "fault" and e["detail"] == "store_msync"
+                   for e in srv.ledger.recent()), srv.ledger.recent()
+    finally:
+        srv.stop()

@@ -1,0 +1,553 @@
+"""The N>=3 trunk-mesh kill/partition/heal soak (ISSUE 11 tentpole).
+
+The ROADMAP's cluster story named its own acceptance test — "a 3-node
+kill/partition/heal soak with zero qos1 loss and ledger-visible
+degradation". Two variants live here:
+
+- the FAST deterministic tier-1 variant: a full 3-node mesh (node A
+  sharded, so trunk links provably SPREAD across shards — the round-15
+  satellite) runs a scripted faultline schedule in-process: blackhole
+  the A<->C link mid-qos1-stream, force ring_full on the sharded node,
+  EIO node B's durable store, heal — asserting zero acked-QoS1 loss,
+  every injected fault ledger-visible (faults.* stats + reason
+  "fault"), and cross-node trace stitching (one sampled publish's
+  timeline spans A's trunk_flush and C's trunk_recv);
+
+- the SLOW soak (pytest.mark.slow): node B is a real SUBPROCESS killed
+  with SIGKILL mid-stream (no goodbye), restarted, and resumed — its
+  durable store replays every trunk-acked QoS1 message to the
+  clean_start=false subscriber — while the A<->C link is blackholed
+  mid-replay and healed. The at-least-once dup bound is asserted too.
+
+Faultline site names exercised here (the nativecheck fault rule greps
+for them): trunk_write, trunk_read, ring_seal, store_msync.
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp                              # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer    # noqa: E402
+from emqx_tpu.mqtt.client import MqttClient                     # noqa: E402
+from emqx_tpu.session.persistent import MemStore                # noqa: E402
+
+
+def run(main):
+    asyncio.run(main())
+
+
+def _wait(pred, timeout=10.0, step=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+class _Mesh:
+    """Three manually-wired native servers in a FULL trunk mesh (six
+    directed links). Node A runs 2 shards so peer links land on
+    different shards (peer ids 1 and 2 -> shards 1 and 0: the round-15
+    link spread under test). The Python forward_fn oracle lane routes
+    by destination node, modeling the cluster transport as reliable
+    (store-and-forward is the cluster layer's own contract)."""
+
+    NAMES = ("mA", "mB", "mC")
+
+    def __init__(self, tmp_path, shards_a=2):
+        self.apps = {}
+        self.servers = {}
+        for name in self.NAMES:
+            app = BrokerApp(persistent_store=MemStore())
+            app.broker.node = name
+            self.apps[name] = app
+        for name in self.NAMES:
+            srv = NativeBrokerServer(
+                port=0, app=self.apps[name], trunk_port=0,
+                shards=shards_a if name == "mA" else 1,
+                durable_dir=str(tmp_path / f"dur-{name}"),
+                durable_fsync="batch",
+                trace_sample_shift=0)
+            self.servers[name] = srv
+
+            def forward(dest, filt, msg, _self=self):
+                dapp = _self.apps.get(dest)
+                if dapp is None:
+                    return
+                deliveries = {}
+                dapp.broker._dispatch_local(filt, msg, deliveries)
+                dapp.cm.dispatch(deliveries)
+            self.apps[name].broker.forward_fn = forward
+        for srv in self.servers.values():
+            srv.start()
+            srv.set_trunk_ack_timeout(400)
+
+    def wire(self):
+        """Register every directed trunk link (the full mesh)."""
+        for a in self.NAMES:
+            for b in self.NAMES:
+                if a != b:
+                    self.servers[a].trunk_register(
+                        b, "127.0.0.1", self.servers[b].trunk_port)
+        for a in self.NAMES:
+            assert _wait(lambda a=a: all(
+                self.servers[a].trunk_peer_status().get(b)
+                for b in self.NAMES if b != a), 15), (
+                a, self.servers[a].trunk_peer_status())
+
+    def peer_id(self, on, of):
+        with self.servers[on]._mirror_lock:
+            return self.servers[on]._trunk_peers[of]["id"]
+
+    def stop(self):
+        for srv in self.servers.values():
+            srv.stop()
+
+
+def test_three_node_mesh_fault_schedule_fast(tmp_path):
+    """The tier-1 variant: mesh up (links spread across A's shards), a
+    scripted blackhole -> ring_full -> store-EIO -> heal schedule with
+    zero acked-QoS1 loss, ledger-visible chaos, and a cross-node
+    stitched trace."""
+    mesh = _Mesh(tmp_path)
+    try:
+        mesh.wire()
+        A, B, C = (mesh.servers[n] for n in _Mesh.NAMES)
+
+        # -- link spread (satellite): A's two peer links live on
+        # different shards by peer-id modulo
+        pid_b, pid_c = mesh.peer_id("mA", "mB"), mesh.peer_id("mA", "mC")
+        assert pid_b % 2 != pid_c % 2, (pid_b, pid_c)
+
+        got = {"b": [], "c": []}
+
+        async def main():
+            sub_b = MqttClient(port=B.port, clientid="msub-b")
+            await sub_b.connect()
+            await sub_b.subscribe("mesh/b", qos=1)
+            sub_c = MqttClient(port=C.port, clientid="msub-c")
+            await sub_c.connect()
+            await sub_c.subscribe("mesh/c", qos=1)
+            # a PERSISTENT subscriber on B: trunk-received publishes
+            # persist in B's durable store (the store-EIO phase's prey)
+            dur_b = MqttClient(port=B.port, clientid="mdur-b",
+                               clean_start=False)
+            await dur_b.connect()
+            await dur_b.subscribe("mesh/b", qos=1)
+
+            pub = MqttClient(port=A.port, clientid="mpub")
+            await pub.connect()
+            for topic, node in (("mesh/b", "mB"), ("mesh/c", "mC")):
+                mesh.apps["mA"].broker.router.add_route(topic, node)
+                await pub.publish(topic, b"warm", qos=1)
+            for q in (sub_b, sub_c):
+                m = await q.recv(timeout=10)
+                assert m.payload == b"warm"
+            await dur_b.recv(timeout=10)
+            await asyncio.sleep(0.5)           # permits grant on idle
+
+            async def drain(cli, key, n, timeout=20):
+                deadline = time.monotonic() + timeout
+                while (len([p for p in got[key] if p != b"warm"]) < n
+                       and time.monotonic() < deadline):
+                    try:
+                        m = await cli.recv(timeout=2)
+                    except asyncio.TimeoutError:
+                        continue
+                    got[key].append(m.payload)
+
+            # -- healthy phase: both legs ride the trunk natively
+            for i in range(6):
+                await pub.publish("mesh/b", b"hb%02d" % i, qos=1)
+                await pub.publish("mesh/c", b"hc%02d" % i, qos=1)
+            await drain(sub_b, "b", 6)
+            await drain(sub_c, "c", 6)
+            assert _wait(lambda: A.fast_stats()["trunk_out"] >= 8), (
+                A.fast_stats())
+            # ...and on BOTH of A's shards (the spread, not a hotspot)
+            per_shard = [s["trunk_batches_out"] for s in A.shard_stats()]
+            assert all(n > 0 for n in per_shard), per_shard
+
+            async def rewarm():
+                # an UP event flushes A's permits (the punt->trunk
+                # ordering guard): one sacrificial publish per topic
+                # re-earns them so the next phase provably exercises
+                # the NATIVE seams, not the Python fallback
+                for t in ("mesh/b", "mesh/c"):
+                    await pub.publish(t, b"warm", qos=1)
+                await asyncio.sleep(0.6)
+
+            # -- phase 1: BLACKHOLE the A->C link mid-stream
+            A.fault_arm("trunk_write", "blackhole", key=pid_c)
+            A.fault_arm("trunk_read", "blackhole", key=pid_c)
+            for i in range(8):
+                await pub.publish("mesh/c", b"pc%02d" % i, qos=1)
+                await pub.publish("mesh/b", b"pb%02d" % i, qos=1)
+            # B keeps flowing through the partition (mesh, not chain)
+            await drain(sub_b, "b", 14)
+            # the watchdog kills the silent link; A<->B stays up
+            assert _wait(
+                lambda: not A.trunk_peer_status().get("mC"), 12), (
+                A.trunk_peer_status())
+            assert A.trunk_peer_status().get("mB")
+            # heal: redial + replay deliver every blackholed payload
+            A.fault_disarm("trunk_write")
+            A.fault_disarm("trunk_read")
+            assert _wait(lambda: A.trunk_peer_status().get("mC"), 15)
+            await drain(sub_c, "c", 14)
+
+            # -- phase 2: forced ring_full on the sharded node — the
+            # publish degrades through the REAL ladder and still lands
+            # (one of the two trunk legs always crosses A's ring: the
+            # peers live on DIFFERENT shards, the publisher on one)
+            await rewarm()
+            A.fault_arm("ring_seal", "full")
+            for i in range(4):
+                await pub.publish("mesh/b", b"rb%02d" % i, qos=1)
+                await pub.publish("mesh/c", b"rc%02d" % i, qos=1)
+            await drain(sub_b, "b", 18)
+            await drain(sub_c, "c", 18)
+            assert _wait(lambda: A.fault_fired("ring_seal") >= 1, 10), (
+                A.fast_stats())
+            A.fault_disarm("ring_seal")
+
+            # -- phase 3: EIO node B's durable store under fsync=batch
+            # (trunk-received publishes persist for the clean_start=
+            # false subscriber; each batched append pays one msync)
+            await rewarm()
+            B.fault_arm("store_msync", "errno")
+            for i in range(6):
+                await pub.publish("mesh/b", b"sb%02d" % i, qos=1)
+            await drain(sub_b, "b", 24)
+            assert _wait(lambda: B.fault_fired("store_msync") >= 1, 10), (
+                B.fast_stats())
+            B.fault_disarm("store_msync")
+
+            for c in (pub, sub_b, sub_c, dur_b):
+                await c.close()
+
+        run(main)
+
+        # -- zero acked-QoS1 loss: every published payload delivered
+        want_b = ({b"hb%02d" % i for i in range(6)}
+                  | {b"pb%02d" % i for i in range(8)}
+                  | {b"rb%02d" % i for i in range(4)}
+                  | {b"sb%02d" % i for i in range(6)})
+        want_c = ({b"hc%02d" % i for i in range(6)}
+                  | {b"pc%02d" % i for i in range(8)}
+                  | {b"rc%02d" % i for i in range(4)})
+        assert want_b <= set(got["b"]), sorted(want_b - set(got["b"]))
+        assert want_c <= set(got["c"]), sorted(want_c - set(got["c"]))
+
+        # -- every injected fault is ledger-visible + counted
+        assert A.fault_fired("trunk_write") >= 1
+        assert A.fault_fired("ring_seal") >= 1
+        assert B.fault_fired("store_msync") >= 1
+        assert _wait(lambda: A.ledger.totals().get("fault", 0) >= 1)
+        A._merge_fast_metrics()
+        B._merge_fast_metrics()
+        assert A.broker.metrics.val("faults.trunk_write") >= 1
+        assert A.broker.metrics.val("faults.ring_seal") >= 1
+        assert B.broker.metrics.val("faults.store_msync") >= 1
+        assert any(e["reason"] == "fault" for e in B.ledger.recent())
+        # organic degradation from the schedule shows up too
+        led = A.ledger.totals()
+        assert led.get("ring_full", 0) >= 1, led
+
+        # -- cross-node trace stitching: one sampled publish's id has
+        # trunk_flush on A and trunk_recv (or deliver_write) on B/C
+        stitched = False
+        for tid, spans in A.spans.recent(256):
+            stages_a = {s[1] for s in spans}
+            if "trunk_flush" not in stages_a:
+                continue
+            for other in (B, C):
+                stages_o = {s[1] for s in other.spans.trace(tid)}
+                if "trunk_recv" in stages_o or "deliver_write" in stages_o:
+                    stitched = True
+        assert stitched, (A.spans.recent(8), B.spans.recent(8))
+    finally:
+        mesh.stop()
+
+
+# -- the slow soak: a REAL kill -9 in the schedule ----------------------------
+
+_NODE_B_SRC = r"""
+import sys, threading
+sys.path.insert(0, %(repo)r)
+from emqx_tpu.app import BrokerApp
+from emqx_tpu.broker.native_server import NativeBrokerServer
+from emqx_tpu.session.persistent import DiskStore
+
+app = BrokerApp(persistent_store=DiskStore(%(sess_dir)r))
+app.broker.node = "soakB"
+srv = NativeBrokerServer(port=%(port)d, app=app, trunk_port=%(trunk)d,
+                         durable_dir=%(dur_dir)r, durable_fsync="batch")
+srv.start()
+print("READY", srv.port, srv.trunk_port, flush=True)
+threading.Event().wait()          # run until killed
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _spawn_node_b(repo, port, trunk, sess_dir, dur_dir):
+    src = _NODE_B_SRC % {"repo": repo, "port": port, "trunk": trunk,
+                         "sess_dir": sess_dir, "dur_dir": dur_dir}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", src],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    line = proc.stdout.readline()
+    assert line.startswith("READY"), line
+    return proc
+
+
+@pytest.mark.slow
+def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
+    """The full acceptance soak: node B is a subprocess killed with
+    SIGKILL mid-qos1-stream (its durable store holds the trunk-acked
+    messages for the clean_start=false subscriber), the A<->C link is
+    blackholed mid-replay and healed, and node C's store takes an EIO
+    burst — after heal: zero acked-QoS1 loss (every payload the
+    publisher got a PUBACK for reaches its subscriber), at-least-once
+    dup bounds honored, the chaos ledger-visible on every node."""
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    port_b, trunk_b = _free_port(), _free_port()
+    sess_dir = str(tmp_path / "sessB")
+    dur_b = str(tmp_path / "durB")
+
+    # nodes A and C in-process (A sharded: the spread rides the soak)
+    apps = {}
+    servers = {}
+    pending_b = []    # the oracle lane's store-and-forward while B is dead
+    for name in ("sA", "sC"):
+        app = BrokerApp(persistent_store=MemStore())
+        app.broker.node = name
+        apps[name] = app
+    for name in ("sA", "sC"):
+        srv = NativeBrokerServer(
+            port=0, app=apps[name], trunk_port=0,
+            shards=2 if name == "sA" else 1,
+            durable_dir=str(tmp_path / f"dur-{name}"),
+            durable_fsync="batch")
+        servers[name] = srv
+
+        def forward(dest, filt, msg, _apps=apps):
+            dapp = _apps.get(dest)
+            if dapp is None:
+                if dest == "soakB":
+                    # B is remote (or dead): the cluster transport's
+                    # store-and-forward contract, modeled by the test
+                    pending_b.append((filt, msg))
+                return
+            deliveries = {}
+            dapp.broker._dispatch_local(filt, msg, deliveries)
+            dapp.cm.dispatch(deliveries)
+        apps[name].broker.forward_fn = forward
+        srv.start()
+        srv.set_trunk_ack_timeout(500)
+    A, C = servers["sA"], servers["sC"]
+
+    proc = _spawn_node_b(repo, port_b, trunk_b, sess_dir, dur_b)
+    got_b, got_c = [], []
+    acked_b, acked_c = [], []
+    try:
+        A.trunk_register("soakB", "127.0.0.1", trunk_b)
+        A.trunk_register("sC", "127.0.0.1", C.trunk_port)
+        assert _wait(lambda: A.trunk_peer_status().get("soakB"), 15)
+        assert _wait(lambda: A.trunk_peer_status().get("sC"), 15)
+        pid_c = None
+        with A._mirror_lock:
+            pid_c = A._trunk_peers["sC"]["id"]
+
+        async def main():
+            nonlocal proc
+            # clean_start=false subscriber on B: its session (DiskStore)
+            # and its pending messages (B's durable store) survive kill
+            sub_b = MqttClient(port=port_b, clientid="soaksub",
+                               clean_start=False)
+            await sub_b.connect()
+            await sub_b.subscribe("soak/b", qos=1)
+            # persistent: trunk-received publishes persist in C's
+            # durable store — the EIO phase's prey
+            sub_c = MqttClient(port=C.port, clientid="soakc",
+                               clean_start=False)
+            await sub_c.connect()
+            await sub_c.subscribe("soak/c", qos=1)
+
+            pub = MqttClient(port=A.port, clientid="soakpub")
+            await pub.connect()
+            apps["sA"].broker.router.add_route("soak/b", "soakB")
+            apps["sA"].broker.router.add_route("soak/c", "sC")
+
+            relay_n = [0]
+
+            async def relay_pending():
+                # the oracle lane's store-and-forward: B is a separate
+                # process, so A's PYTHON-lane legs for it (permit
+                # windows + down windows) queue here and re-publish
+                # into B whenever it is reachable
+                if not pending_b:
+                    return
+                relay_n[0] += 1
+                r = MqttClient(port=port_b,
+                               clientid=f"soakrelay{relay_n[0]}")
+                await r.connect()
+                items = list(pending_b)
+                pending_b.clear()
+                for _filt, msg in items:
+                    await r.publish(msg.topic, msg.payload, qos=1)
+                await r.close()
+
+            await pub.publish("soak/b", b"warm", qos=1)
+            await pub.publish("soak/c", b"warm", qos=1)
+            assert (await sub_c.recv(timeout=12)).payload == b"warm"
+            await asyncio.sleep(0.5)
+
+            async def pub_acked(topic, payload, sink):
+                # qos1 publish() returns after PUBACK: every payload in
+                # `sink` is an ACKED message the soak must not lose
+                await pub.publish(topic, payload, qos=1)
+                sink.append(payload)
+
+            # healthy stream — drain the connected subscriber live
+            for i in range(10):
+                await pub_acked("soak/b", b"h%03d" % i, acked_b)
+                await pub_acked("soak/c", b"g%03d" % i, acked_c)
+            deadline = time.monotonic() + 25
+            while (len([p for p in got_b if p != b"warm"]) < 10
+                   and time.monotonic() < deadline):
+                try:
+                    m = await sub_b.recv(timeout=2)
+                except asyncio.TimeoutError:
+                    continue
+                got_b.append(m.payload)
+
+            # the subscriber goes OFFLINE before the kill window: a
+            # delivery written-but-unacked at SIGKILL time is the
+            # documented PR-5 edge (bytes not retained in C++ —
+            # ROADMAP); the soak's claim is the BROKER-side pipeline:
+            # acked publish -> trunk/replay-ring -> B's durable store
+            # -> clean_start=false resume, across a kill -9
+            await sub_b.close()
+            # let the disconnect settle at B: a publish racing it could
+            # still be marker-consumed into the PYTHON session's
+            # in-memory inflight (the same PR-5 edge), which kill -9
+            # then drops — the soak's window starts with the session
+            # provably offline
+            await asyncio.sleep(0.8)
+
+            # -- KILL -9 node B mid-stream (no goodbye): some of these
+            # land durably in B (trunk-acked after fsync=batch), the
+            # in-flight rest stays in A's replay ring
+            for i in range(10, 16):
+                await pub_acked("soak/b", b"h%03d" % i, acked_b)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            assert _wait(
+                lambda: not A.trunk_peer_status().get("soakB"), 15)
+            # acked publishes keep flowing: the down window rides the
+            # oracle lane's store-and-forward (pending_b)
+            for i in range(16, 22):
+                await pub_acked("soak/b", b"h%03d" % i, acked_b)
+
+            # -- RESTART B; mid-replay, BLACKHOLE the A<->C link
+            proc = _spawn_node_b(repo, port_b, trunk_b, sess_dir,
+                                 dur_b)
+            A.fault_arm("trunk_write", "blackhole", key=pid_c)
+            A.fault_arm("trunk_read", "blackhole", key=pid_c)
+            for i in range(10, 18):
+                await pub_acked("soak/c", b"g%03d" % i, acked_c)
+            assert _wait(lambda: A.trunk_peer_status().get("soakB"),
+                         20)
+            # drain the oracle lane's store-and-forward into revived B
+            await relay_pending()
+            # the subscriber reconnects (clean_start=false) and drains
+            # the durable-store replay + live traffic
+            sub_b2 = MqttClient(port=port_b, clientid="soaksub",
+                                clean_start=False)
+            await sub_b2.connect()
+
+            # -- HEAL the partition, then EIO C's durable store under
+            # the restored native stream (the heal's UP event flushed
+            # A's permits: one warm publish re-earns the trunk path so
+            # C's store provably takes the batched appends)
+            A.fault_disarm("trunk_write")
+            A.fault_disarm("trunk_read")
+            assert _wait(lambda: A.trunk_peer_status().get("sC"), 20)
+            await pub.publish("soak/c", b"warm", qos=1)
+            await asyncio.sleep(0.7)
+            C.fault_arm("store_msync", "errno")
+            for i in range(18, 24):
+                await pub_acked("soak/c", b"g%03d" % i, acked_c)
+            assert _wait(lambda: C.fault_fired("store_msync") >= 1, 15)
+            C.fault_disarm("store_msync")
+
+            # -- HEAL everything; drain both subscribers to the acked sets
+            async def drain(cli, sink, want, timeout=40):
+                deadline = time.monotonic() + timeout
+                while (not want <= {p for p in sink}
+                       and time.monotonic() < deadline):
+                    try:
+                        m = await cli.recv(timeout=2)
+                    except asyncio.TimeoutError:
+                        continue
+                    if m.payload != b"warm":
+                        sink.append(m.payload)
+
+            # any Python-lane legs that queued during permit windows
+            # while B was alive replay now too
+            await relay_pending()
+            await drain(sub_b2, got_b, set(acked_b))
+            await drain(sub_c, got_c, set(acked_c))
+            for c in (pub, sub_b2, sub_c):
+                try:
+                    await c.close()
+                except (ConnectionError, OSError):
+                    pass
+
+        run(main)
+
+        # -- ZERO acked-QoS1 loss: every PUBACK'd payload arrived
+        assert set(acked_b) <= set(got_b), sorted(
+            set(acked_b) - set(got_b))
+        assert set(acked_c) <= set(got_c), sorted(
+            set(acked_c) - set(got_c))
+        # -- at-least-once dup bound: replays may duplicate, but each
+        # payload at most once per reconnect leg (generous bound: 4)
+        for name, sink in (("b", got_b), ("c", got_c)):
+            for p in set(sink):
+                assert sink.count(p) <= 4, (name, p, sink.count(p))
+        # -- chaos is ledger-visible on the injecting nodes
+        assert A.fault_fired("trunk_write") >= 1
+        assert C.fault_fired("store_msync") >= 1
+        assert _wait(lambda: A.ledger.totals().get("fault", 0) >= 1)
+        C._merge_fast_metrics()
+        assert C.broker.metrics.val("faults.store_msync") >= 1
+        assert any(e["reason"] == "fault" for e in C.ledger.recent())
+    finally:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        for srv in servers.values():
+            srv.stop()

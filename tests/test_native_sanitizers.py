@@ -1163,9 +1163,10 @@ from emqx_tpu import native
 
 # Native distributed tracing (ISSUE 8): set_tracing toggles (enable,
 # shift, seed) race SHARDED CROSS-NODE traffic — two shard hosts in a
-# ring group blasting cross-shard deliveries while shard 0 trunks a
-# remote leg to a third (unsharded) host, kind-12 span batches flowing
-# the whole time; set_trunk_wire flips race the HELLO negotiation.
+# ring group blasting cross-shard deliveries while peer 1's OWNER shard
+# (1 %% 2 = 1, the round-15 link spread) trunks a remote leg to a third
+# (unsharded) host, kind-12 span batches flowing the whole time;
+# set_trunk_wire flips race the HELLO negotiation.
 group = native.NativeShardGroup(2)
 hosts = [native.NativeHost(port=0, max_size=1 << 16) for _ in range(2)]
 for i, h in enumerate(hosts):
@@ -1207,7 +1208,9 @@ for h in hosts:                     # broadcast table + remote route
     h.sub_add(sub1, "tr/t", 0, 0)
     h.trunk_route_add(1, "tr/t")
 peer.sub_add(subp, "tr/t", 0, 0)
-hosts[0].trunk_connect(1, "127.0.0.1", peer_trunk)
+# round 15: peer 1's link lives on its OWNER shard (1 %% 2 = 1); the
+# publisher on shard 0 ring-forwards the trunk leg there
+hosts[1].trunk_connect(1, "127.0.0.1", peer_trunk)
 
 stop = threading.Event()
 def poller(h):
@@ -1219,7 +1222,7 @@ threads = [threading.Thread(target=poller, args=(h,)) for h in all_hosts]
 for t in threads:
     t.start()
 time.sleep(0.5)
-hosts[1].trunk_peer_state(1, True)  # the Python UP mirror
+hosts[0].trunk_peer_state(1, True)  # the NON-owner shard's UP mirror
 
 def blaster():
     f = pub_frame(b"tr/t", b"x" * 32) * 16
@@ -1269,10 +1272,137 @@ print("SANITIZED-RUN-OK", st0["traced_pubs"], st0["span_batches"],
 """
 
 
+# Round-15 faultline coverage: fault arm/disarm churn across EVERY site
+# racing the poll threads (arming is all-atomics and explicitly allowed
+# from any thread mid-traffic), against a trunk pair + a durable store,
+# with blackhole/errno/short modes cycling while qos0/1 traffic flows
+# and the sites keep counting — the injector's threading contract under
+# both sanitizers.
+DRIVER_FAULT = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+A = native.NativeHost(port=0, max_size=1 << 16)
+B = native.NativeHost(port=0, max_size=1 << 16)
+store = native.NativeStore("", 1 << 20, "batch")
+A.attach_store(store)
+tp = B.trunk_listen()
+A.set_trunk_ack_timeout(300)
+
+def connect(host, cid):
+    s = socket.create_connection(("127.0.0.1", host.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+def pub_frame(topic, payload, qos=0, pid=0):
+    vh = struct.pack(">H", len(topic)) + topic
+    if qos:
+        vh += struct.pack(">H", pid)
+    vh += payload
+    return bytes([0x30 | (qos << 1), len(vh)]) + vh
+
+pub_s = connect(A, b"fp")
+sub_s = connect(A, b"fs")
+ids, framed = [], 0
+deadline = time.time() + 15
+while (len(ids) < 2 or framed < 2) and time.time() < deadline:
+    for kind, conn, payload in A.poll(20):
+        if kind == native.EV_OPEN:
+            ids.append(conn)
+        elif kind == native.EV_FRAME:
+            framed += 1
+            A.send(conn, b"\x20\x02\x00\x00")
+    list(B.poll(0))
+assert len(ids) == 2, ids
+pub, sub = ids
+A.enable_fast(pub, 4)
+A.enable_fast(sub, 4)
+A.sub_add(sub, "fl/+", qos=1)
+A.permit(pub, "fl/x")
+A.trunk_route_add(1, "fl/x")
+A.trunk_connect(1, "127.0.0.1", tp)
+
+stop = threading.Event()
+def poller(h):
+    while not stop.is_set():
+        list(h.poll(20))
+tB = threading.Thread(target=poller, args=(B,))
+tB.start()
+
+SITES = list(native.FAULT_SITES)
+MODES = ["errno", "short", "blackhole", "full", "skew", "off"]
+def churner(salt):
+    j = 0
+    while not stop.is_set():
+        site = SITES[(j + salt) %% len(SITES)]
+        mode = MODES[j %% len(MODES)]
+        try:
+            A.fault_arm(site, mode, n_or_prob=(j %% 3) * 0.25,
+                        seed=j + 1, key=0)
+        except ValueError:
+            pass
+        A.fault_fired(site)
+        store.fault_arm("store_msync", MODES[(j + 1) %% len(MODES)],
+                        n_or_prob=2, seed=j)
+        if j %% 7 == 0:
+            for s in SITES:
+                A.fault_disarm(s)
+        j += 1
+        time.sleep(0.0005)
+c1 = threading.Thread(target=churner, args=(0,))
+c2 = threading.Thread(target=churner, args=(5,))
+c1.start(); c2.start()
+
+tok = store.register("fl-sid")
+def store_hammer():
+    k = 0
+    while not stop.is_set():
+        store.append(1, 1, [tok], "fl/d", b"s%%04d" %% k)
+        if k %% 50 == 49:
+            store.gc()
+        k += 1
+        time.sleep(0.0005)
+sh = threading.Thread(target=store_hammer)
+sh.start()
+
+N_MSG = 1200
+sub_s.settimeout(0.01)
+for k in range(N_MSG):
+    try:
+        pub_s.sendall(pub_frame(b"fl/x", b"p%%04d" %% k, k & 1,
+                                1 + (k %% 100)))
+    except OSError:
+        break                      # injected conn fault killed the pub
+    for kind, conn, payload in A.poll(0):
+        pass
+    try:
+        while sub_s.recv(8192):
+            pass
+    except (TimeoutError, OSError):
+        pass
+    time.sleep(0.0004)
+
+time.sleep(0.2)
+stop.set()
+c1.join(); c2.join(); sh.join(); tB.join()
+for s in SITES:
+    A.fault_disarm(s)
+a = A.stats()
+assert a["fast_in"] > 0 or a["punts"] > 0, a
+for _ in range(10):
+    list(A.poll(10)); list(B.poll(10))
+A.destroy(); B.destroy()
+store.close()
+print("SANITIZED-RUN-OK", a["faults_injected"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
                                     "telemetry", "trunk", "durable", "sn",
-                                    "shards", "tracing"])
+                                    "shards", "tracing", "fault"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -1291,7 +1421,8 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
            "lane": DRIVER_LANE, "ws": DRIVER_WS,
            "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
            "durable": DRIVER_DURABLE, "sn": DRIVER_SN,
-           "shards": DRIVER_SHARDS, "tracing": DRIVER_TRACING}[driver]
+           "shards": DRIVER_SHARDS, "tracing": DRIVER_TRACING,
+           "fault": DRIVER_FAULT}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

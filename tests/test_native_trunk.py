@@ -366,6 +366,198 @@ def test_trunk_loss_punt_fallback_reconnect_replay_no_qos1_loss():
             pass
 
 
+def _half_open_pair(suffix: str, wire_v0: bool = False):
+    """Two manually-wired servers (the kill-test shape) prepared for
+    partition testing: forward_fn oracle on A, trunk A->B registered,
+    a tight ack-timeout so an up-but-black link resolves fast. Returns
+    (srv_a, srv_b, app_a, app_b)."""
+    app_a, app_b = BrokerApp(), BrokerApp()
+    app_a.broker.node = f"hoA{suffix}"
+    app_b.broker.node = f"hoB{suffix}"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0)
+
+    def forward(dest, filt, msg):
+        deliveries = {}
+        app_b.broker._dispatch_local(filt, msg, deliveries)
+        app_b.cm.dispatch(deliveries)
+    app_a.broker.forward_fn = forward
+    if wire_v0:
+        # the old-peer twin: A speaks wire v0 — no HELLO, links
+        # complete immediately, trace ids stripped
+        srv_a.host.set_trunk_wire(0)
+    srv_a.start()
+    srv_b.start()
+    srv_a.set_trunk_ack_timeout(400)
+    return srv_a, srv_b, app_a, app_b
+
+
+def _drive_half_open(srv_a, srv_b, app_a, topic, n_black=8):
+    """The partition twin of the kill/replay test: blackhole (not
+    kill) the A->B link mid-qos1-stream, assert the silent link DIES
+    through the ack watchdog (no FIN/RST ever fires — SIGKILL tests
+    cannot make this shape), heal, and prove the replay shadow loses
+    nothing: every published payload reaches the subscriber at least
+    once (at-least-once: dups legal, silence not)."""
+    node_b = srv_b.app.broker.node if srv_b.app else "nodeB"
+    got = []
+
+    async def main():
+        sub = MqttClient(port=srv_b.port, clientid="hsub" + topic[-1])
+        await sub.connect()
+        await sub.subscribe(topic, qos=1)
+        pub = MqttClient(port=srv_a.port, clientid="hpub" + topic[-1])
+        await pub.connect()
+        app_a.broker.router.add_route(topic, node_b)
+        srv_a.trunk_register(node_b, "127.0.0.1", srv_b.trunk_port)
+        assert _wait(lambda: srv_a.trunk_peer_status().get(node_b))
+        pid = srv_a._trunk_peers[node_b]["id"]
+
+        await pub.publish(topic, b"warm", qos=1)
+        m = await sub.recv(timeout=8)
+        assert m.payload == b"warm"
+        await asyncio.sleep(0.4)
+
+        # healthy stream first (really on the trunk)
+        for i in range(4):
+            await pub.publish(topic, b"pre%02d" % i, qos=1)
+        assert _wait(lambda: srv_a.fast_stats()["trunk_out"] >= 4)
+
+        # PARTITION mid-stream: both directions of A's link to B go
+        # black — writes claim success into the void, reads yield
+        # nothing; the socket stays ESTABLISHED
+        srv_a.fault_arm("trunk_write", "blackhole", key=pid)
+        srv_a.fault_arm("trunk_read", "blackhole", key=pid)
+        for i in range(n_black):
+            await pub.publish(topic, b"blk%02d" % i, qos=1)
+
+        # the watchdog (ack_timeout 400ms) kills the silent link — the
+        # ONLY way an up-but-black partition ever resolves
+        assert _wait(
+            lambda: not srv_a.trunk_peer_status().get(node_b), 10), (
+            srv_a.trunk_peer_status())
+        assert srv_a.fault_fired("trunk_write") >= 1
+
+        # publishes during the partition ride the Python oracle lane
+        for i in range(3):
+            await pub.publish(topic, b"dwn%02d" % i, qos=1)
+
+        # HEAL: disarm; the jittered redial reconnects and the replay
+        # shadow delivers every blackholed qos1 batch
+        srv_a.fault_disarm("trunk_write")
+        srv_a.fault_disarm("trunk_read")
+        assert _wait(lambda: srv_a.trunk_peer_status().get(node_b), 15)
+        assert _wait(
+            lambda: srv_a.fast_stats()["trunk_replays"] >= 1, 10), (
+            srv_a.fast_stats())
+
+        want = ({b"pre%02d" % i for i in range(4)}
+                | {b"blk%02d" % i for i in range(n_black)}
+                | {b"dwn%02d" % i for i in range(3)})
+        deadline = time.monotonic() + 20
+        seen = set()
+        while not want <= seen and time.monotonic() < deadline:
+            try:
+                m = await sub.recv(timeout=2)
+            except asyncio.TimeoutError:
+                continue
+            got.append(m.payload)
+            seen.add(m.payload)
+        assert want <= seen, sorted(want - seen)
+        await pub.close()
+        await sub.close()
+
+    run(main)
+    return got
+
+
+def test_half_open_blackhole_v1_link_replays_on_heal():
+    """The partition twin of the kill/replay test on a CURRENT (wire
+    v1) link: the HELLO grace expires against the blackholed peer
+    (redials inside the partition complete at v0 after 300ms and
+    replay into the void — trunk_replays advances while still black),
+    the watchdog kills the silent link, and the heal loses nothing."""
+    srv_a, srv_b, app_a, _app_b = _half_open_pair("v1")
+    try:
+        replays_before = srv_a.fast_stats()["trunk_replays"]
+        _drive_half_open(srv_a, srv_b, app_a, "ho1/x")
+        # at least one replay happened (black-window grace completions
+        # and/or the healing reconnect)
+        assert srv_a.fast_stats()["trunk_replays"] > replays_before
+        # every injected fault is ledger-visible as reason "fault"
+        assert srv_a.ledger.totals().get("fault", 0) >= 1
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_half_open_blackhole_v0_link_replays_on_heal():
+    """The same partition against an OLD peer link (A capped at wire
+    v0: no HELLO, immediate completion): the up-but-black machinery
+    is wire-version-independent."""
+    srv_a, srv_b, app_a, _app_b = _half_open_pair("v0", wire_v0=True)
+    try:
+        _drive_half_open(srv_a, srv_b, app_a, "ho0/y")
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_redial_backoff_jitter_caps_and_resets_on_up():
+    """The redial schedule: exponential backoff with ±25% jitter (a
+    healed partition must not wake every peer's redial on the same
+    capped boundary — the full-mesh thundering herd), capped at 30s,
+    reset to the base on UP."""
+    from emqx_tpu.broker.native_server import (TRUNK_RETRY_CAP_S,
+                                               TRUNK_RETRY_JITTER,
+                                               TRUNK_RETRY_S)
+
+    app_a = BrokerApp()
+    app_a.broker.node = "joA"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=BrokerApp(), trunk_port=0)
+    srv_b.app.broker.node = "joB"
+    srv_a.start()
+    srv_b.start()
+    try:
+        # every dial fails (injected): DOWNs accumulate and the
+        # backoff doubles toward the cap
+        srv_a.fault_arm("trunk_connect", "errno")
+        srv_a.trunk_register("joB", "127.0.0.1", srv_b.trunk_port)
+        pid = srv_a._trunk_peers["joB"]["id"]
+
+        def backoff():
+            with srv_a._mirror_lock:
+                return srv_a._trunk_peers["joB"]["backoff"]
+
+        assert _wait(lambda: backoff() >= 4.0, 15), backoff()
+        # the next-retry stamp wears the ±25% jitter around the
+        # PREVIOUS backoff step (retry_at was scheduled before the
+        # doubling): always strictly inside the jitter envelope
+        with srv_a._mirror_lock:
+            p = dict(srv_a._trunk_peers["joB"])
+        delay = p["retry_at"] - time.monotonic()
+        assert delay <= p["backoff"] * (1 + TRUNK_RETRY_JITTER), (
+            delay, p["backoff"])
+        # force the cap and take one more DOWN: it must not exceed 30
+        with srv_a._mirror_lock:
+            srv_a._trunk_peers["joB"]["backoff"] = TRUNK_RETRY_CAP_S
+        assert _wait(lambda: backoff() == TRUNK_RETRY_CAP_S, 10)
+        # heal: the injected dial failure lifts, the link comes UP and
+        # the backoff resets to the base
+        srv_a.fault_disarm("trunk_connect")
+        with srv_a._mirror_lock:   # dial now, not at the capped stamp
+            srv_a._trunk_peers["joB"]["retry_at"] = 0.0
+            srv_a._trunk_retry_at = 0.0
+        assert _wait(lambda: srv_a.trunk_peer_status().get("joB"), 15)
+        assert backoff() == TRUNK_RETRY_S
+        assert srv_a.fault_fired("trunk_connect") >= 2
+        assert pid >= 1
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
 def test_receiver_side_punt_reaches_python_audience():
     """A trunk-received publish whose local match set needs Python (a
     subscriber with no native connection → punt marker) must surface as

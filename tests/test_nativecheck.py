@@ -111,11 +111,18 @@ def test_cli_exits_nonzero_on_unwaived_finding(tmp_path):
     scratch = tmp_path / "repo"
     (scratch / "emqx_tpu" / "native" / "src").mkdir(parents=True)
     (scratch / "emqx_tpu" / "broker").mkdir(parents=True)
+    (scratch / "tests").mkdir(parents=True)
     for f in rules.CPP_FILES:
         shutil.copy(os.path.join(SRC, f),
                     scratch / "emqx_tpu" / "native" / "src" / f)
     shutil.copy(SERVER_PY, scratch / "emqx_tpu" / "broker"
                 / "native_server.py")
+    # the fault rule reads FAULT_SITES parity + tests/ coverage too
+    shutil.copy(os.path.join(REPO, "emqx_tpu", "native", "__init__.py"),
+                scratch / "emqx_tpu" / "native" / "__init__.py")
+    for tf in ("test_native_fault.py", "test_native_trunk.py"):
+        shutil.copy(os.path.join(REPO, "tests", tf),
+                    scratch / "tests" / tf)
     bad = scratch / "emqx_tpu" / "native" / "src" / "store.h"
     bad.write_text(bad.read_text()
                    + "\nvoid NcMutant__(long* o) { (void)o; }\n")
@@ -183,6 +190,71 @@ def test_mutation_pyfold_rule_fires():
     res = rules.run(REPO, overrides={"native_server.py": mut})
     assert "pyfold:native_server.py:_on_nc_mutant__:ack_plane" in {
         f.key for f in res.unwaived}, [f.key for f in res.unwaived]
+
+
+def test_mutation_fault_rule_fires():
+    """Seed an UNANNOTATED fault fire site: the fault rule must flag
+    the line (every kSite use with firing vocabulary needs a matching
+    // @fault(<site>) nearby — the faultline coverage contract)."""
+    mut = _insert_in_body(_host(), "host.cc", "HandleEvent",
+                          "FaultHit(fault::kSiteConnRead, 0);")
+    res = rules.run(REPO, overrides={"host.cc": mut})
+    bad = [f for f in res.unwaived
+           if f.rule == "fault" and f.site.endswith(":conn_read")]
+    assert bad, [f.key for f in res.unwaived]
+    # ...and an annotation naming a NONEXISTENT site fires too
+    mut2 = _host() + "\n// @fault(conn_raed)\n"
+    res2 = rules.run(REPO, overrides={"host.cc": mut2})
+    assert any(f.rule == "fault" and "conn_raed" in f.site
+               for f in res2.unwaived), [f.key for f in res2.unwaived]
+
+
+def test_fault_rule_python_parity_and_test_coverage():
+    """The fault rule's other two legs: a FAULT_SITES drift on the
+    Python side fails, and a site no test names fails (the
+    sanitizer-lint pattern — a chaos lever nothing pulls is dead)."""
+    # drop one site from a scratch copy of the Python tuple
+    nat = _read(os.path.join(REPO, "emqx_tpu", "native", "__init__.py"))
+    assert '"housekeep_clock"' in nat
+    # parity is currently green on the real tree
+    res = rules.run(REPO)
+    assert not any(f.rule == "fault" for f in res.unwaived), (
+        [f.key for f in res.unwaived if f.rule == "fault"])
+    # a site declared in fault.h but absent from tests' text would fail:
+    # prove the detector by scanning for an impossible site name
+    blob = rules._tests_blob(REPO)
+    for site in ("conn_read", "conn_write", "conn_accept", "trunk_read",
+                 "trunk_write", "trunk_accept", "trunk_connect",
+                 "store_msync", "store_seg_open", "ring_seal",
+                 "ring_doorbell", "housekeep_clock"):
+        assert re.search(rf"\b{site}\b", blob), (
+            f"fault site {site} lost its test coverage")
+
+
+def test_every_fault_annotation_is_load_bearing():
+    """Stripping ANY single // @fault(<site>) annotation flips the
+    fault rule (its fire site loses coverage) — the load-bearing sweep
+    extended to the faultline grammar (the @fault tokens live outside
+    the shared model's function-attachment machinery, so the main
+    sweep cannot see them)."""
+    base_keys = rules.run(REPO).keys()
+    stripped = 0
+    for fname in ("host.cc", "store.h"):
+        text = _read(os.path.join(SRC, fname))
+        lines = text.split("\n")
+        for i, line in enumerate(lines):
+            m = re.search(r"@fault\([a-z0-9_]+\)", line)
+            if not m:
+                continue
+            mut_lines = list(lines)
+            mut_lines[i] = line.replace(m.group(0), "", 1)
+            res = rules.run(REPO,
+                            overrides={fname: "\n".join(mut_lines)})
+            assert res.keys() != base_keys, (
+                f"stripping {m.group(0)} at {fname}:{i + 1} flips "
+                f"nothing — dead annotation")
+            stripped += 1
+    assert stripped >= 12, stripped   # every site has >= 1 annotation
 
 
 def test_mutation_waiver_hygiene_fires():
@@ -375,6 +447,7 @@ SAN_TEST = os.path.join(REPO, "tests", "test_native_sanitizers.py")
 # ASan+TSan driver yet must be waived BY NAME below (the CoAP rule:
 # new gateway headers land with their driver or an explicit IOU).
 SANCOV_HEADERS = {
+    "fault.h": ("fault", "fault_arm"),       # arm/disarm vs poll races
     "frame.h": ("host", "NativeHost"),       # byte-dribbled framing
     "router.h": ("fastpath", "sub_add"),     # match-table churn
     "ring.h": ("shards", "NativeShardGroup"),

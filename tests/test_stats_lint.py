@@ -348,3 +348,59 @@ def test_prometheus_bucket_exemplars():
     out = prometheus.render(metrics=m, openmetrics=True)
     assert '# {trace_id="0000000000abc123"}' in out
     assert "trace_id=" not in prometheus.render(metrics=m)  # 0.0.4-clean
+
+
+# -- faultline (ISSUE 11) -----------------------------------------------------
+
+
+def test_fault_sites_match_cpp_enum_everywhere():
+    """fault.h's Site enum, native.FAULT_SITES, and the observe-side
+    canonical tuple all agree (order + the mechanical name mapping) —
+    the STAT_NAMES discipline applied to the fault-site catalog."""
+    from emqx_tpu.observe import metrics as om
+
+    fault_h = os.path.join(os.path.dirname(HOST_CC), "fault.h")
+    with open(fault_h) as f:
+        src = f.read()
+    sites = [s for s in enumerators(src, "Site", "kSite")
+             if s != "Count"]
+    assert [_snake(s) for s in sites] == list(native.FAULT_SITES), (
+        "fault.h Site enum drifted from native.FAULT_SITES")
+    assert tuple(om.FAULT_SITES) == tuple(native.FAULT_SITES)
+    # modes too: the Python dict must cover the C++ Mode enum exactly
+    modes = [m for m in enumerators(src, "Mode", "kMode")]
+    assert sorted(native.FAULT_MODES.values()) == list(
+        range(len(modes))), (modes, native.FAULT_MODES)
+
+
+def test_faults_injected_slot_exported_and_ledger_reason_present():
+    """The faultline plane's StatSlot stays exported (trunk-pin
+    pattern), and "fault" is a C++-prefix ledger reason with a fixed
+    messages.ledger.fault metric slot."""
+    from emqx_tpu.observe import metrics as om
+
+    assert "faults_injected" in native.STAT_NAMES
+    src = _src()
+    assert "kStFaultsInjected" in src and "kLrFault" in src
+    assert "fault" in native.LEDGER_REASONS
+    # kLrFault sits inside the C++ prefix (ledger entries fold below
+    # the GIL for host-plane fires)
+    reasons = [_snake(s) for s in enumerators(src, "LedgerReason", "kLr")
+               if s != "Count"]
+    assert "fault" in reasons
+    assert "messages.ledger.fault" in om.ALL_NAMES
+
+
+def test_faults_fixed_metric_slots_render_at_zero():
+    """faults.<site> are FIXED metric slots: they render (at zero) in
+    prometheus before the first injection ever fires — chaos
+    observability is not opt-in."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+
+    m = Metrics()
+    for s in native.FAULT_SITES:
+        assert m.val(f"faults.{s}") == 0
+    out = prometheus.render(metrics=m)
+    for s in native.FAULT_SITES:
+        assert f"emqx_faults_{s}" in out, s

@@ -16,6 +16,14 @@ Rule catalog (see README "Static analysis of the native plane"):
   pyfold   — every ``_on_*`` kind-fold in broker/native_server.py that
              mentions a ``# @guards(<lock>)`` attribute does so under
              ``with self.<lock>:`` (multi-producer safety, PR 7).
+  fault    — faultline coverage (round 15): every C++ fault-injection
+             fire site names its ``fault.h`` site with an
+             ``@fault(<site>)`` annotation, every declared site has at
+             least one annotated fire site AND is exercised by at
+             least one test, and the Python ``FAULT_SITES`` tuple
+             matches the enum exactly (the sanitizer-lint pattern:
+             a typo'd site name must fail the build, never arm
+             nothing).
   waivers  — waiver hygiene: every waiver names a known rule, carries
              a justification, and matches a live finding (a stale
              waiver is drift in the other direction).
@@ -28,16 +36,17 @@ re-analyze seeded-bad variants without touching the tree.
 from __future__ import annotations
 
 import os
+import re
 from dataclasses import dataclass
 
-from .model import CppModel
+from .model import CppModel, enumerators, snake
 from .pymodel import PySource
 
 CPP_FILES = ("host.cc", "store.h", "trunk.h", "ring.h", "router.h",
-             "sn.h", "ws.h", "frame.h")
+             "sn.h", "ws.h", "frame.h", "fault.h")
 PY_FOLD_FILE = os.path.join("emqx_tpu", "broker", "native_server.py")
 
-RULES = ("plane", "lockset", "ladder", "pyfold", "waivers")
+RULES = ("plane", "lockset", "ladder", "pyfold", "fault", "waivers")
 
 
 @dataclass(frozen=True)
@@ -252,6 +261,131 @@ def check_pyfold(py: PySource) -> list[Finding]:
     return out
 
 
+# -- rule: fault (faultline coverage, round 15) -------------------------------
+# The sanitizer-lint pattern applied to fault injection: fault.h's Site
+# enum is the canonical catalog, every C++ FIRE site (a line using a
+# kSite token together with the firing vocabulary) must carry a
+# matching // @fault(<site>) within its preceding 4 lines, every
+# declared site needs >= 1 such fire site AND a test that names it, and
+# native/__init__.py's FAULT_SITES must mirror the enum exactly. A site
+# that exists only on one side — or a chaos lever no test ever pulls —
+# fails the build.
+
+_FAULT_TOKEN_RE = re.compile(r"\bkSite([A-Z]\w*)\b")
+_FAULT_ANN_RE = re.compile(r"@fault\(([a-z0-9_]+)\)")
+# only lines that DECIDE a firing are fire sites; arm/forwarding
+# plumbing (FaultArm routing store sites) names kSite tokens too
+_FIRE_VOCAB = ("Fire(", "FaultHit(", "FaultRecv(", "FaultSend(",
+               "armed(")
+_PY_SITES_RE = re.compile(r"FAULT_SITES = \(([^)]*)\)", re.S)
+
+_TESTS_BLOB_CACHE: dict = {}
+
+
+def _tests_blob(repo: str) -> str:
+    # keyed by the directory's (name, mtime, size) signature so a
+    # long-lived process (editor integration) sees edits — a stale
+    # blob would keep passing a site whose test was deleted
+    tdir = os.path.join(repo, "tests")
+    names = (sorted(f for f in os.listdir(tdir) if f.endswith(".py"))
+             if os.path.isdir(tdir) else [])
+    sig = []
+    for f in names:
+        try:
+            st = os.stat(os.path.join(tdir, f))
+            sig.append((f, st.st_mtime_ns, st.st_size))
+        except OSError:
+            pass
+    key = (repo, tuple(sig))
+    blob = _TESTS_BLOB_CACHE.get(key)
+    if blob is None:
+        parts = []
+        for f in names:
+            try:
+                with open(os.path.join(tdir, f)) as fh:
+                    parts.append(fh.read())
+            except OSError:
+                pass
+        blob = "\n".join(parts)
+        _TESTS_BLOB_CACHE.clear()       # one live entry per process
+        _TESTS_BLOB_CACHE[key] = blob
+    return blob
+
+
+def check_fault(model: CppModel, repo: str) -> list[Finding]:
+    out: list[Finding] = []
+    fh = model.sources.get("fault.h")
+    if fh is None:
+        return [Finding("fault", "fault.h", 1, "fault.h:<missing>",
+                        "fault.h is absent — the fault rule has no "
+                        "site catalog")]
+    sites = [snake(s) for s in enumerators(fh.text, "Site", "kSite")
+             if s != "Count"]
+    covered: set = set()
+    for src in model.sources.values():
+        if src.name == "fault.h":
+            continue
+        raw_lines = src.text.split("\n")
+        code_lines = src.code.split("\n")
+        for i, cl in enumerate(code_lines):
+            toks = [snake(m.group(1))
+                    for m in _FAULT_TOKEN_RE.finditer(cl)
+                    if m.group(1) != "Count"]
+            if not toks or not any(v in cl for v in _FIRE_VOCAB):
+                continue
+            anns: set = set()
+            for back in range(0, 5):
+                if i - back < 0:
+                    break
+                anns.update(_FAULT_ANN_RE.findall(raw_lines[i - back]))
+            for name in toks:
+                if name in anns:
+                    covered.add(name)
+                else:
+                    out.append(Finding(
+                        "fault", src.name, i + 1,
+                        f"{src.name}:{i + 1}:{name}",
+                        f"fault fire site for {name} lacks a matching "
+                        f"// @fault({name}) annotation nearby"))
+        # unknown site names in annotations anywhere
+        for j, raw in enumerate(raw_lines):
+            for name in _FAULT_ANN_RE.findall(raw):
+                if name not in sites:
+                    out.append(Finding(
+                        "fault", src.name, j + 1,
+                        f"{src.name}:{j + 1}:@fault({name})",
+                        f"@fault({name}) names no fault.h site "
+                        f"(valid: {sites})"))
+    for s in sites:
+        if s not in covered:
+            out.append(Finding(
+                "fault", "fault.h", 1, f"fault.h:{s}",
+                f"fault site {s} is declared but has no annotated C++ "
+                f"fire site"))
+    blob = _tests_blob(repo)
+    for s in sites:
+        if not re.search(rf"\b{s}\b", blob):
+            out.append(Finding(
+                "fault", "tests", 0, f"tests:{s}",
+                f"fault site {s} is never exercised by any test under "
+                f"tests/ (name it in an arm/assert)"))
+    # Python parity: a site name armable from Python must exist in C++
+    # and vice versa, same order (the mechanical STAT_NAMES discipline)
+    nat = os.path.join(repo, "emqx_tpu", "native", "__init__.py")
+    try:
+        with open(nat) as f:
+            m = _PY_SITES_RE.search(f.read())
+    except OSError:
+        m = None
+    py_sites = re.findall(r'"([a-z0-9_]+)"', m.group(1)) if m else []
+    if py_sites != sites:
+        out.append(Finding(
+            "fault", "__init__.py", 0, "native/__init__.py:FAULT_SITES",
+            f"native.FAULT_SITES {py_sites} drifted from fault.h Site "
+            f"enum {sites}"))
+    return out
+
+
 # -- rule: waivers (hygiene) + assembly ---------------------------------------
 
 def apply_waivers(findings: list, waivers: list) -> Result:
@@ -294,5 +428,6 @@ def run(repo: str, overrides: dict[str, str] | None = None,
     py = _cached_py(os.path.join(repo, PY_FOLD_FILE),
                     overrides.get("native_server.py"))
     findings = (check_plane(model) + check_lockset(model)
-                + check_ladder(model) + check_pyfold(py))
+                + check_ladder(model) + check_pyfold(py)
+                + check_fault(model, repo))
     return apply_waivers(findings, waivers)

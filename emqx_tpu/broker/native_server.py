@@ -124,7 +124,7 @@ CAP_DEADBAND_MIN = 8
 
 class _NativeConn:
     __slots__ = ("conn_id", "channel", "server", "fast", "sn",
-                 "recv_budget", "native_cap")
+                 "recv_budget", "native_cap", "native_ka")
 
     def __init__(self, server: "NativeBrokerServer", conn_id: int, peer: str):
         self.server = server
@@ -137,6 +137,9 @@ class _NativeConn:
         self.sn = peer.startswith("sn:")
         self.recv_budget = 0     # receive-maximum budget split across planes
         self.native_cap = 0      # the native plane's current share
+        # keepalive lives on the C++ timer wheel (armed post-CONNACK):
+        # the Python housekeep stops scanning this conn's idle clock
+        self.native_ka = False
         pipeline = server.pipeline
         self.channel = Channel(
             server.broker, server.cm,
@@ -151,6 +154,10 @@ class _NativeConn:
         data = b"".join(
             serialize(p, self.channel.conninfo.proto_ver) for p in pkts)
         if data:
+            # Python-plane egress implies possible session timer work
+            # (retry / awaiting-rel expiry): re-enter the housekeep
+            # scan set; the scan drops the conn again once idle
+            self.server._scan_watch(self)
             self.server.host.send(self.conn_id, data)
 
 
@@ -232,6 +239,9 @@ class _ShardedHost:
     def set_inflight_cap(self, conn, cap):
         self._of(conn).set_inflight_cap(conn, cap)
 
+    def set_keepalive(self, conn, deadline_ms):
+        self._of(conn).set_keepalive(conn, deadline_ms)
+
     def retain_deliver(self, conn, filter_, max_qos=0):
         self._of(conn).retain_deliver(conn, filter_, max_qos)
 
@@ -309,6 +319,12 @@ class _ShardedHost:
     def set_telemetry_shift(self, shift):
         for h in self.hosts:
             h.set_telemetry_shift(shift)
+
+    def set_park(self, enabled=True, park_after_ms=0, accept_burst=0,
+                 mem_budget_bytes=0):
+        for h in self.hosts:
+            h.set_park(enabled, park_after_ms, accept_burst,
+                       mem_budget_bytes)
 
     def attach_store(self, store):
         # one shared store: appends batch per flush, its single internal
@@ -443,6 +459,10 @@ class NativeBrokerServer:
         sn_gateway_id: int = 1,
         sn_predefined: Optional[dict] = None,
         shards: int = 1,
+        park: Optional[bool] = None,
+        park_after_ms: int = 0,
+        accept_burst: int = 0,
+        conn_mem_budget: int = 0,
     ):
         if not native.available():
             raise RuntimeError(
@@ -540,6 +560,23 @@ class NativeBrokerServer:
                             reuseport=True)
             for tid, t in (sn_predefined or {}).items():
                 self.host.sn_predefined(int(tid), t)
+        # -- conn-scale plane (round 16) ------------------------------------
+        # Hibernation of idle conns + accept-storm governance live in
+        # C++ (park.h / wheel.h); this just forwards the knobs. Parking
+        # is ON by default (EMQX_NATIVE_PARK=0 is the escape hatch) —
+        # it is invisible on the wire: the first byte re-inflates.
+        if park is None:
+            park = os.environ.get("EMQX_NATIVE_PARK", "1") != "0"
+        self.park = bool(park)
+        if not self.park or park_after_ms or accept_burst \
+                or conn_mem_budget:
+            self.host.set_park(self.park, park_after_ms, accept_burst,
+                               conn_mem_budget)
+        # conns whose Python session may hold timer work (retry /
+        # awaiting-rel expiry) — the housekeep scans ONLY these; conns
+        # with a native keepalive and an idle session leave the set.
+        self._scan_conns: dict = {}      # @guards(_scan_lock)
+        self._scan_lock = threading.Lock()
         # node name → {"id", "addr", "port", "up", } under _mirror_lock
         self._trunk_peers: dict[str, dict] = {}  # @guards(_mirror_lock)
         self._trunk_id_nodes: dict[int, str] = {}   # peer id → node name
@@ -2237,8 +2274,13 @@ class NativeBrokerServer:
         lane_buf = None
         for kind, conn_id, payload in host.poll(timeout_ms):
             if kind == native.EV_OPEN:
-                self.conns[conn_id] = _NativeConn(
+                conn = _NativeConn(
                     self, conn_id, payload.decode("ascii", "replace"))
+                self.conns[conn_id] = conn
+                # scanned until a native keepalive is armed and the
+                # session proves idle (the housekeep drops it then)
+                with self._scan_lock:
+                    self._scan_conns[conn_id] = conn
             elif kind == native.EV_FRAME:
                 conn = self.conns.get(conn_id)
                 if conn is not None:
@@ -2273,6 +2315,8 @@ class NativeBrokerServer:
             elif kind == native.EV_CLOSED:
                 with self._trace_lock:
                     self._traced_conns.discard(conn_id)
+                with self._scan_lock:
+                    self._scan_conns.pop(conn_id, None)
                 conn = self.conns.pop(conn_id, None)
                 if conn is not None:
                     ch = conn.channel
@@ -2353,6 +2397,15 @@ class NativeBrokerServer:
             self._drop(conn, "normal")
             return
         if pkt.type == P.CONNECT and ch.conn_state == "connected":
+            # keepalive moves onto the C++ timer wheel for EVERY conn
+            # (the host's last_rx stamp covers fast, slow, and SN
+            # transports alike): the Python housekeep's O(N) idle
+            # sweep is gone — C++ closes as "keepalive_timeout", the
+            # same reason string the old Python path used
+            ka = ch.conninfo.keepalive
+            self.host.set_keepalive(
+                conn.conn_id, ka * 1500 if ka else 0)
+            conn.native_ka = True
             self._maybe_enable_fast(conn)
         elif (conn.fast and pkt.type == P.PUBLISH
               and not pkt.retain and pkt.topic
@@ -2758,7 +2811,16 @@ class NativeBrokerServer:
         # re-qualifies it)
         self._reconcile_sid_groups(cid)
 
+    def _scan_watch(self, conn: _NativeConn) -> None:
+        """(Re-)enter a conn into the housekeep scan set — called on
+        every Python-plane packet egress, so a session that regrows
+        retry/awaiting state is scanned again until it drains."""
+        with self._scan_lock:
+            self._scan_conns[conn.conn_id] = conn
+
     def _drop(self, conn: _NativeConn, reason: str) -> None:
+        with self._scan_lock:
+            self._scan_conns.pop(conn.conn_id, None)
         self.conns.pop(conn.conn_id, None)
         self._forget_fast(conn)
         conn.channel.terminate(reason)
@@ -2825,30 +2887,58 @@ class NativeBrokerServer:
         self._housekeep_conns(0)
 
     def _housekeep_conns(self, shard: int) -> None:
-        """Keepalive/retry scan for ONE shard's conns. Must run on that
-        shard's poll thread: conn_idle_ms walks poll-thread-owned C++
-        state, and channel timeouts must not race the thread handling
-        the conn's frames. Shard 0's scan rides the global housekeep."""
+        """Session-timer scan for ONE shard's ACTIVE conns. Must run
+        on that shard's poll thread: conn_idle_ms walks poll-thread-
+        owned C++ state, and channel timeouts must not race the thread
+        handling the conn's frames. Shard 0's scan rides the global
+        housekeep.
+
+        Round 16: the full-conn keepalive sweep is GONE — keepalive
+        deadlines live on the C++ timer wheel (set_keepalive at
+        CONNACK), so this loop walks only the scan set: conns whose
+        Python session may hold retry/awaiting-rel work. A conn leaves
+        the set once its session drains (and re-enters through
+        _scan_watch on any Python-plane egress), so housekeep cost
+        tracks ACTIVE sessions, not the parked million."""
         sharded = self.shards > 1
-        for conn in list(self.conns.values()):
+        with self._scan_lock:
+            scan = list(self._scan_conns.values())
+        for conn in scan:
             if sharded and native.shard_of(conn.conn_id) != shard:
                 continue
-            ch = conn.channel
-            if conn.fast or conn.sn:
-                # fast-path frames never reach the channel (and SN
-                # keepalive/sleep state lives wholly in C++): feed the
-                # keepalive clock from the host's last-read stamp — a
-                # sleeping SN client reads as idle 0 until its
-                # announced wake deadline
-                idle = self.host.conn_idle_ms(conn.conn_id)
-                if idle >= 0:
-                    ch.last_packet_at = max(
-                        ch.last_packet_at, now_ms() - idle)
-            if ch.keepalive_expired():
-                self._drop(conn, "keepalive_timeout")
+            if conn.conn_id not in self.conns:   # raced a teardown
+                with self._scan_lock:
+                    self._scan_conns.pop(conn.conn_id, None)
                 continue
+            ch = conn.channel
+            if not conn.native_ka:
+                # pre-CONNACK (or legacy-armed) conns: the old path —
+                # feed the idle clock for transports whose frames never
+                # reach the channel, enforce keepalive in Python
+                if conn.fast or conn.sn:
+                    idle = self.host.conn_idle_ms(conn.conn_id)
+                    if idle >= 0:
+                        ch.last_packet_at = max(
+                            ch.last_packet_at, now_ms() - idle)
+                if ch.keepalive_expired():
+                    self._drop(conn, "keepalive_timeout")
+                    continue
             conn._send_packets(ch.handle_timeout("retry"))
             ch.handle_timeout("expire_awaiting_rel")
+            if conn.native_ka:
+                sess = getattr(ch, "session", None)
+                # idle-check and pop under ONE lock hold: a concurrent
+                # delivery grows the session BEFORE its _scan_watch
+                # re-add, so evaluating idleness inside the lock means
+                # either we see the growth (no pop) or the re-add
+                # serializes after our pop (conn stays scanned) — never
+                # a popped conn with live retry state
+                with self._scan_lock:
+                    if sess is None or (sess.inflight.is_empty()
+                                        and not sess.awaiting_rel):
+                        # no session timer work left: leave the scan
+                        # until the next egress re-enters us
+                        self._scan_conns.pop(conn.conn_id, None)
 
     def _merge_fast_metrics(self) -> None:
         """Fold the C++ counters into the node metrics so $SYS /
@@ -2906,6 +2996,15 @@ class NativeBrokerServer:
                 m.inc(f"faults.{site}", d_f)
                 if site in ("store_msync", "store_seg_open"):
                     self.ledger.record("fault", d_f, aux=i, detail=site)
+        # conn-scale plane (round 16): hibernation + accept-shed
+        # counters fold into the fixed conns.* slots (accept_shed
+        # LEDGER entries arrive separately through the kind-12 fold)
+        for slot, name in (("conns_parked", "conns.parked"),
+                           ("conns_inflated", "conns.inflated"),
+                           ("conns_shed", "conns.shed")):
+            d_c = stats[slot] - seen[slot]
+            if d_c:
+                m.inc(name, d_c)
         d_fwd = stats["trunk_out"] - seen["trunk_out"]
         if d_fwd:
             # the native half of the messages.forward split (ISSUE 4

@@ -306,6 +306,32 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint32, ctypes.c_int,
         ctypes.c_int, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
         ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
+    lib.emqx_host_set_keepalive.restype = ctypes.c_int
+    lib.emqx_host_set_keepalive.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.emqx_host_set_park.restype = ctypes.c_int
+    lib.emqx_host_set_park.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint64]
+    lib.emqx_host_synth_conns.restype = ctypes.c_int
+    lib.emqx_host_synth_conns.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_char_p]
+    lib.emqx_host_conn_counts.restype = ctypes.c_int
+    lib.emqx_host_conn_counts.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.emqx_wheel_selftest.restype = ctypes.c_long
+    lib.emqx_wheel_selftest.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_loadgen_conn_scale.restype = ctypes.c_int
+    lib.emqx_loadgen_conn_scale.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint16, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64)]
     lib.emqx_host_destroy.restype = None
     lib.emqx_host_destroy.argtypes = [ctypes.c_void_p]
     lib.emqx_framer_create.restype = ctypes.c_void_p
@@ -573,8 +599,10 @@ SPAN_STAGES = ("ingress", "route", "ring_cross", "trunk_flush",
 # into the same ledger by broker/native_server.py and broker/broker.py.
 # "fault" (round 15) is a faultline injection firing — chaos lands in
 # the SAME ledger as organic degradation (aux = the fault-site index).
+# "accept_shed" (round 16) is the accept-storm rung: admission denied
+# in the accept loop before any conn side effect (conn-scale plane).
 LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
-                  "device_failover", "store_degraded")
+                  "accept_shed", "device_failover", "store_degraded")
 
 # ---------------------------------------------------------------------------
 # faultline (round 15): deterministic fault injection (fault.h)
@@ -804,6 +832,82 @@ def loadgen_sn_run(host: str, port: int, n_subs: int, n_pubs: int,
     return dict(zip(keys, out))
 
 
+def wheel_selftest(seed: int, n_ops: int = 20000) -> list[tuple]:
+    """Run the C++ timer wheel's seeded self-test script (wheel.h
+    SelfTestScript) and decode its op/fire journal:
+
+    - ``("arm", key, deadline_ms)``
+    - ``("cancel", key)``
+    - ``("advance", now_ms, [fired keys...])``
+
+    The connscale test replays the journal through a brute-force
+    oracle: fired sets must match {armed keys whose deadline, rounded
+    up to the 16ms tick, is <= the advance clock's tick} exactly."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    lib.emqx_wheel_selftest(int(seed), int(n_ops), ctypes.byref(out),
+                            ctypes.byref(out_len))
+    raw = ctypes.string_at(out, out_len.value)
+    lib.emqx_buf_free(out)
+    events: list[tuple] = []
+    pos, n = 0, len(raw)
+    while pos < n:
+        kind = raw[pos]
+        pos += 1
+        if kind == 2:
+            events.append(("arm",
+                           int.from_bytes(raw[pos:pos + 8], "little"),
+                           int.from_bytes(raw[pos + 8:pos + 16],
+                                          "little")))
+            pos += 16
+        elif kind == 3:
+            events.append(("cancel",
+                           int.from_bytes(raw[pos:pos + 8], "little")))
+            pos += 8
+        elif kind == 1:
+            now = int.from_bytes(raw[pos:pos + 8], "little")
+            fired_n = int.from_bytes(raw[pos + 8:pos + 16], "little")
+            pos += 16
+            fired = [int.from_bytes(raw[pos + 8 * i:pos + 8 * i + 8],
+                                    "little") for i in range(fired_n)]
+            pos += 8 * fired_n
+            events.append(("advance", now, fired))
+        else:
+            raise ValueError(f"bad selftest record kind {kind}")
+    return events
+
+
+def loadgen_conn_scale(host: str, port: int, n_conns: int,
+                       burst: int = 512, keepalive_s: int = 30,
+                       sub_every: int = 0, hold_ms: int = 5000,
+                       proto_ver: int = 4, stop=None, live=None) -> dict:
+    """Run the conn-scale herd (loadgen.cc): a connect storm of
+    ``n_conns`` mostly-idle clients that then hold for ``hold_ms``
+    honoring staggered keepalives; PINGREQ round trips are the
+    keepalive-latency probe. ``stop``/``live`` are optional
+    ctypes.c_int32 / (ctypes.c_uint64 * 4) the caller polls/sets from
+    another thread (ctypes releases the GIL for the whole call)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = (ctypes.c_uint64 * 8)()
+    rc = lib.emqx_loadgen_conn_scale(
+        host.encode(), port, int(n_conns), int(burst), int(keepalive_s),
+        int(sub_every), int(hold_ms), int(proto_ver),
+        ctypes.byref(stop) if stop is not None else None,
+        ctypes.cast(live, ctypes.POINTER(ctypes.c_uint64))
+        if live is not None else None,
+        out)
+    if rc != 0:
+        raise RuntimeError(f"conn-scale loadgen failed rc={rc}")
+    keys = ("connected", "errors", "pings", "ping_p50_ns", "ping_p99_ns",
+            "ping_max_ns", "wall_ns", "broker_closes")
+    return dict(zip(keys, out))
+
+
 def sn_roundtrip(data: bytes) -> tuple[int, bytes]:
     """Parse + re-serialize SN datagram bytes with the NATIVE codec
     (sn.h); returns (message count, reserialized bytes). The codec
@@ -937,7 +1041,10 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "retain_set", "retain_del", "retain_deliver",
               "retain_msgs_out",
               "shard_ring_out", "shard_ring_in", "shard_ring_full",
-              "traced_pubs", "span_batches", "faults_injected")
+              "traced_pubs", "span_batches", "faults_injected",
+              # conn-scale plane (round 16): hibernation + accept shed
+              "conns_parked", "conns_inflated", "conns_shed",
+              "parked_pings")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
@@ -1459,6 +1566,45 @@ class NativeHost:
     def stats(self) -> dict[str, int]:
         return {name: self._lib.emqx_host_stat(self._h, i)
                 for i, name in enumerate(STAT_NAMES)}
+
+    # -- conn-scale plane (round 16) ----------------------------------------
+
+    def set_keepalive(self, conn: int, deadline_ms: int) -> None:
+        """Arm (or, with 0, disarm) a conn's native keepalive deadline
+        on the shard's timer wheel. Pass the EFFECTIVE expiry — the
+        server passes 1.5x the negotiated keepalive, the MQTT grace.
+        Conns armed here leave the Python housekeep scan entirely."""
+        self._lib.emqx_host_set_keepalive(self._h, conn, int(deadline_ms))
+
+    def set_park(self, enabled: bool = True, park_after_ms: int = 0,
+                 accept_burst: int = 0, mem_budget_bytes: int = 0) -> None:
+        """Conn-scale knobs: hibernation on/off, the no-keepalive
+        park-after fallback (0 keeps the 30s default; keepalive'd conns
+        park after 2x their grace), the per-cycle accept burst cap
+        (defer rung) and the conn-memory shed budget (accept_shed)."""
+        self._lib.emqx_host_set_park(
+            self._h, 1 if enabled else 0, int(park_after_ms),
+            int(accept_burst), int(mem_budget_bytes))
+
+    def synth_conns(self, n: int, keepalive_ms: int = 0,
+                    sub_every: int = 0, topic_prefix: str = "synth") -> None:
+        """Bench/test surface (raw hosts only): conjure ``n`` resident
+        fast conns with no socket so the conn-scale structures run at
+        10^6 scale inside an fd-capped container. Not a product path —
+        the server never sees these ids (no OPEN events)."""
+        self._lib.emqx_host_synth_conns(
+            self._h, int(n), int(keepalive_ms), int(sub_every),
+            topic_prefix.encode())
+
+    def conn_counts(self) -> dict[str, int]:
+        """POLL-THREAD ONLY (the conn_idle_ms contract): resident and
+        parked conn counts, parked-record bytes, armed wheel timers."""
+        out = (ctypes.c_uint64 * 4)()
+        rc = self._lib.emqx_host_conn_counts(self._h, out)
+        if rc != 0:
+            raise RuntimeError("conn_counts refused off the poll thread")
+        return {"resident": int(out[0]), "parked": int(out[1]),
+                "parked_bytes": int(out[2]), "timers_armed": int(out[3])}
 
     def conn_idle_ms(self, conn: int) -> int:
         """POLL-THREAD ONLY (unlike the other control calls): walks the

@@ -81,6 +81,11 @@ class Framer {
     return FrameStatus::kOk;
   }
 
+  // No partial frame buffered — the conn-scale park plane only
+  // hibernates a conn whose framer sits at a packet boundary (a
+  // parked conn's framer is dropped and rebuilt at inflation).
+  bool idle() const { return phase_ == Phase::kHeader; }
+
  private:
   enum class Phase { kHeader, kLength, kBody };
   uint32_t max_size_;

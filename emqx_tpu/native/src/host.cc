@@ -182,11 +182,13 @@
 
 #include "fault.h"
 #include "frame.h"
+#include "park.h"
 #include "ring.h"
 #include "router.h"
 #include "sn.h"
 #include "store.h"
 #include "trunk.h"
+#include "wheel.h"
 #include "ws.h"
 
 namespace emqx_native {
@@ -370,6 +372,8 @@ enum LedgerReason : uint8_t {
   kLrTrunkPunt,      // trunk down/ineligible: publish degraded to punt
   kLrShed,           // kHighWater backpressure shed (conn or trunk)
   kLrFault,          // faultline injection fired (aux = the fault site)
+  kLrAcceptShed,     // accept-storm shed: admission denied before any
+                     // conn side effect (round 16, aux = conn count)
   kLrCount
 };
 
@@ -491,6 +495,12 @@ struct SnConnState {
   Framer egress{1 << 20};
   std::deque<std::string> sleep_buf;   // parked datagrams, drop-oldest
   std::vector<SnInflightRx> rexmit;    // qos1 deliveries awaiting ack
+  // qos1 retransmit wheel handle (round 16): the per-poll
+  // SnRexmitScan sweep moved onto the timer wheel — armed when the
+  // first rexmit copy is tracked, parked across announced sleep (the
+  // retry clock restarts at wake), re-armed from the fire at the
+  // conn's next retry deadline
+  uint64_t tm_rexmit = 0;
 };
 
 struct Conn {
@@ -510,6 +520,16 @@ struct Conn {
                             // Python so the hook fold sees them; the
                             // flight-recorder tail rides the trace log
   uint64_t last_rx_ms = 0;  // any inbound bytes (keepalive feed)
+  // -- conn-scale plane (round 16) ----------------------------------------
+  // last non-PINGREQ frame: the park-after clock. Keepalive pings are
+  // traffic (last_rx_ms) but not WORK — an idle-but-pinging device
+  // must still hibernate, and parked pings answer from the parked
+  // record without inflation.
+  uint64_t last_work_ms = 0;
+  uint32_t keepalive_ms = 0;    // effective deadline (1.5x keepalive);
+                                // 0 = no native keepalive enforcement
+  uint64_t tm_keepalive = 0;    // wheel handles (0 = unarmed)
+  uint64_t tm_park = 0;
   std::unique_ptr<FlightRec> fr;             // telemetry flight recorder
   std::unique_ptr<AckState> ack;             // elevated-qos window state
   std::unordered_set<std::string> permits;   // publisher-side topic grants
@@ -594,6 +614,24 @@ inline void PushUnique(std::vector<T>* v, T x) {
 constexpr uint64_t kSnRetryMs = 1000;
 constexpr uint8_t kSnMaxRetries = 3;
 
+// -- conn-scale plane bounds (round 16) --------------------------------------
+// Timer kinds on the per-shard wheel (wheel.h): the key is a conn id
+// for keepalive/park/rexmit and a trunk peer id for the ack watchdog.
+enum TimerKind : uint8_t {
+  kTmKeepalive = 1,  // keepalive deadline (lazy-reprogrammed on fire)
+  kTmPark,           // park-after check (hibernate idle conns)
+  kTmSnRexmit,       // SN qos1 retransmit deadline (per conn)
+  kTmTrunkAck,       // trunk silent-link watchdog (per peer)
+};
+// Default park-after when no keepalive is known (a conn with a
+// keepalive parks after 2x its grace deadline = 3x keepalive).
+constexpr uint64_t kParkAfterDefaultMs = 30000;
+// Resident-conn memory estimate for the accept governor's budget: the
+// struct + map node + framer/outbuf/permit steady-state heap. The
+// bench measures the real number (RSS/conn); this constant only needs
+// the right ORDER for the shed decision.
+constexpr uint64_t kConnResidentEstBytes = 1024;
+
 // Fast-path control ops enqueued from Python threads, applied on the
 // poll thread (ApplyPending) so they serialize with matching.
 struct Op {
@@ -604,7 +642,8 @@ struct Op {
     kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
     kDurableAdd, kDurableDel,
     kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift,
-    kTrunkPeerState, kSetTracing, kSetTrunkWire, kSetTrunkAckTimeout
+    kTrunkPeerState, kSetTracing, kSetTrunkWire, kSetTrunkAckTimeout,
+    kSetKeepalive, kSetPark, kSynthConns
   };
   Kind kind;
   uint64_t owner = 0;
@@ -679,6 +718,10 @@ enum StatSlot {
   kStTracedPubs,       // publishes tagged by the 1-in-N trace sampler
   kStSpanBatches,      // batched kind-12 trace records emitted
   kStFaultsInjected,   // faultline fires on this host (all sites)
+  kStConnsParked,      // conns hibernated into parked records
+  kStConnsInflated,    // parked conns re-inflated (first byte/delivery)
+  kStConnsShed,        // accepts shed (memory budget / max_conns)
+  kStParkedPings,      // PINGREQs answered from the parked record
   kStatCount
 };
 
@@ -713,6 +756,10 @@ class Host {
       group_->alive[shard_id_].store(false, std::memory_order_release);
     for (auto& [id, c] : conns_)
       if (c.fd >= 0) close(c.fd);  // SN conns share the listener fd
+    for (auto& [id, slot] : parked_) {
+      int pfd = park_slab_.at(slot).fd;
+      if (pfd >= 0) close(pfd);
+    }
     for (auto& [tag, s] : trunk_socks_) close(s.fd);
     if (listen_fd_ >= 0) close(listen_fd_);
     if (listen_ws_fd_ >= 0) close(listen_ws_fd_);
@@ -1019,7 +1066,14 @@ class Host {
       return -2;  // wrong thread: refuse rather than race conns_
     }
     auto it = conns_.find(id);
-    if (it == conns_.end()) return -1;
+    if (it == conns_.end()) {
+      auto pit = parked_.find(id);
+      if (pit == parked_.end()) return -1;
+      // housekeep clock skew applies to hibernating conns too
+      uint64_t pnow = NowMs() + FaultSkewMs();
+      uint64_t last = park_slab_.at(pit->second).last_rx_ms;
+      return static_cast<long>(pnow > last ? pnow - last : 0);
+    }
     // housekeep clock skew (faultline): keepalive scans judge conns
     // against a future clock while the site is armed
     uint64_t now = NowMs() + FaultSkewMs();
@@ -1036,6 +1090,23 @@ class Host {
     }
     uint64_t last = c.last_rx_ms;
     return static_cast<long>(now > last ? now - last : 0);
+  }
+
+  // Conn-scale gauges (round 16): resident conns, parked conns,
+  // parked-record bytes, armed wheel timers. POLL-THREAD ONLY like
+  // ConnIdleMs (it reads poll-thread-owned containers); refuses with
+  // -2 off thread. parked bytes alone is an atomic a cross-thread
+  // caller may read via the stat surface.
+  // @plane(poll)
+  int ConnCounts(uint64_t out[4]) {
+    pthread_t poller = poll_thread_.load(std::memory_order_acquire);
+    if (poller != pthread_t{} && !pthread_equal(poller, pthread_self()))
+      return -2;
+    out[0] = conns_.size();
+    out[1] = parked_.size();
+    out[2] = parked_bytes_.load(std::memory_order_relaxed);
+    out[3] = wheel_.armed();
+    return 0;
   }
 
   // Run one event-loop step on the calling thread; fill `buf` with as
@@ -1056,6 +1127,7 @@ class Host {
     }
     if (events_.empty()) {
       ApplyPending();
+      gov_.BeginCycle();  // accept-burst defer window resets per cycle
       epoll_event evs[256];
       int n = epoll_wait(epoll_fd_, evs, 256, timeout_ms);
       if (n < 0) {
@@ -1068,9 +1140,13 @@ class Host {
       // flushes so their acks/appends ride the same batch records
       if (group_) DrainShardRings();
       if (!lane_pending_.empty()) LaneStaleScan();
-      SnRexmitScan();    // qos1-over-UDP retransmit timeouts
+      // the timer wheel replaced the per-cycle O(N) deadline sweeps
+      // (SN rexmit scan, trunk ack watchdog, the Python keepalive
+      // loop): one Advance pays O(expired + cascades) per cycle
+      wheel_.Advance(NowMs(), [this](uint64_t key, uint8_t kind) {
+        FireTimer(key, kind);
+      });
       TrunkHelloScan();  // old-peer HELLO grace deadlines (v0 links)
-      TrunkAckScan();    // silent-link watchdog (up-but-black links)
       FlushDurables();   // catch-all for appends with no dirty socket
       FlushTaps();
       FlushAcks();
@@ -1142,7 +1218,7 @@ class Host {
     }
     for (auto& op : ops) ApplyOp(op);
     for (auto& [id, data] : sends) {
-      auto it = conns_.find(id);
+      auto it = FindConnInflate(id);  // egress re-inflates a parked conn
       if (it == conns_.end()) continue;
       // one WS binary frame per send() batch on WS conns
       AppendMqtt(it->second, data.data(), data.size());
@@ -1150,7 +1226,10 @@ class Host {
     }
     for (uint64_t id : closes) {
       auto it = conns_.find(id);
-      if (it == conns_.end()) continue;
+      if (it == conns_.end()) {
+        DropParked(id, "closed_by_host", false);  // no inflation to die
+        continue;
+      }
       it->second.want_close = true;
       if (it->second.outbuf.size() == it->second.outpos)
         Drop(id, "closed_by_host", false);
@@ -1165,7 +1244,7 @@ class Host {
           punt_subs_.Add(op.owner, op.str, op.qos, op.flags);
         // real entries (owner == a live conn id) are torn down with the
         // conn; remember them on the conn for that cleanup
-        auto it = conns_.find(op.owner);
+        auto it = FindConnInflate(op.owner);
         if (it != conns_.end() && !(op.flags & kSubPunt))
           it->second.own_subs.push_back(op.str);
         break;
@@ -1175,13 +1254,13 @@ class Host {
         punt_subs_.Remove(op.owner, op.str);
         break;
       case Op::kPermit: {
-        auto it = conns_.find(op.owner);
+        auto it = FindConnInflate(op.owner);
         if (it != conns_.end() && it->second.permits.size() < 4096)
           it->second.permits.insert(op.str);
         break;
       }
       case Op::kEnableFast: {
-        auto it = conns_.find(op.owner);
+        auto it = FindConnInflate(op.owner);
         if (it != conns_.end()) {
           it->second.fast = true;
           it->second.proto_ver = op.proto_ver;
@@ -1192,7 +1271,7 @@ class Host {
         break;
       }
       case Op::kDisableFast: {
-        auto it = conns_.find(op.owner);
+        auto it = FindConnInflate(op.owner);
         if (it != conns_.end()) {
           Conn& c = it->second;
           // live plane demotion (round 10): the AckState HANDS OFF to
@@ -1216,7 +1295,7 @@ class Host {
         // receive-maximum budget between the planes per ack cycle; the
         // caller guarantees native_cap + python_cap <= budget at every
         // step, so the sum of occupancies can never exceed the budget
-        auto it = conns_.find(op.owner);
+        auto it = FindConnInflate(op.owner);
         if (it != conns_.end()) {
           it->second.max_inflight =
               op.max_inflight < 0x7FFFu ? op.max_inflight : 0x7FFFu;
@@ -1272,7 +1351,7 @@ class Host {
         max_qos_allowed_ = op.qos;
         break;
       case Op::kSetTrace: {
-        auto it = conns_.find(op.owner);
+        auto it = FindConnInflate(op.owner);
         if (it == conns_.end()) break;
         bool on = op.flags != 0;
         if (on && !it->second.traced) {
@@ -1380,12 +1459,76 @@ class Host {
                               ? op.qos
                               : trunk::kWireVersion;
         break;
+      case Op::kSetKeepalive: {
+        // keepalive moves onto the wheel: `token` is the EFFECTIVE
+        // deadline (Python passes 1.5x the negotiated keepalive); 0
+        // disarms. The park horizon derives from it (2x the grace).
+        auto it = FindConnInflate(op.owner);
+        if (it == conns_.end()) break;
+        Conn& c = it->second;
+        c.keepalive_ms = static_cast<uint32_t>(op.token);
+        if (c.tm_keepalive) {
+          wheel_.Cancel(c.tm_keepalive);
+          c.tm_keepalive = 0;
+        }
+        if (c.keepalive_ms)
+          c.tm_keepalive = wheel_.Arm(op.owner, kTmKeepalive,
+                                      NowMs() + c.keepalive_ms);
+        if (c.tm_park) {
+          wheel_.Cancel(c.tm_park);
+          c.tm_park = 0;
+        }
+        // SN conns never park (CanPark rejects them; sleep mode is
+        // their hibernation) — don't churn a timer that can't fire
+        if (park_enabled_ && !c.sn) {
+          uint64_t base = c.last_work_ms ? c.last_work_ms : c.last_rx_ms;
+          c.tm_park = wheel_.Arm(op.owner, kTmPark,
+                                 base + ParkAfterOf(c));
+        }
+        break;
+      }
+      case Op::kSetPark: {
+        // conn-scale knobs: flags = park enabled, max_inflight = the
+        // no-keepalive park-after fallback (ms, 0 keeps the default),
+        // owner = accept burst/cycle, token = conn-memory budget bytes
+        bool was = park_enabled_;
+        park_enabled_ = op.flags != 0;
+        park_after_ms_ = op.max_inflight;  // 0 = the 2x-grace default
+        gov_.Configure(static_cast<uint32_t>(op.owner), op.token);
+        if (park_enabled_) {
+          // (re-)arm park deadlines against each conn's IDLE BASE —
+          // not "now": reconfiguring must preserve elapsed idleness,
+          // or a periodic set_park would postpone every park forever
+          for (auto& [cid, c] : conns_) {
+            if (c.sn) continue;
+            if (c.tm_park) wheel_.Cancel(c.tm_park);
+            uint64_t base = c.last_work_ms ? c.last_work_ms
+                                           : c.last_rx_ms;
+            c.tm_park = wheel_.Arm(cid, kTmPark, base + ParkAfterOf(c));
+          }
+        }
+        break;
+      }
+      case Op::kSynthConns:
+        SynthConns(static_cast<uint32_t>(op.owner),
+                   static_cast<uint32_t>(op.token), op.max_inflight,
+                   op.str);
+        break;
       case Op::kSetTrunkAckTimeout:
         // silent-link watchdog deadline (round 15); tests tighten it
         // so a blackholed link dies in milliseconds instead of
         // seconds, and 0 DISABLES the watchdog (the store's
         // compact-age convention — a swallowed 0 was a review finding)
         trunk_ack_timeout_ms_ = op.token;
+        // the deadline changed: re-arm every peer's wheel entry
+        // against it (round 16 — the watchdog rides the wheel now)
+        for (auto& [peer_id, p] : trunk_peers_) {
+          if (p.tm_ack) {
+            wheel_.Cancel(p.tm_ack);
+            p.tm_ack = 0;
+          }
+          if (p.up) TrunkAckWatch(peer_id, p);
+        }
         break;
     }
   }
@@ -1448,7 +1591,7 @@ class Host {
       // FIFO — until the topic's parked count drains to zero.
       if (lane_topic_pending_.count(key_scratch_))
         lane_poisoned_.insert(key_scratch_);
-      auto it = conns_.find(le.publisher);
+      auto it = FindConnInflate(le.publisher);
       if (it != conns_.end()) it->second.permits.erase(key_scratch_);
     }
     events_.push_back(
@@ -1775,7 +1918,7 @@ class Host {
       }
       cur_trace_ = 0;  // this frame's trace context ends here
       if (telemetry_ && (fan_xshipped_ || !trunk_scratch_.empty())) {
-        auto pit = conns_.find(le.publisher);
+        auto pit = FindConnInflate(le.publisher);
         if (pit != conns_.end()) {
           if (fan_xshipped_)
             FrNote(pit->second, kFrRingCross, 3,
@@ -1824,7 +1967,19 @@ class Host {
     }
     uint64_t id = ev.data.u64;
     auto it = conns_.find(id);
-    if (it == conns_.end()) return;
+    if (it == conns_.end()) {
+      // hibernating conns keep their fd registered under the same tag:
+      // the first byte (or HUP) lands here and is served from — or
+      // re-inflates — the parked record before any fast-path work
+      auto pit = parked_.find(id);
+      if (pit == parked_.end()) return;
+      if (ev.events & (EPOLLHUP | EPOLLERR)) {
+        DropParked(id, "sock_error", true);
+        return;
+      }
+      if (ev.events & EPOLLIN) ParkedRead(id, pit->second);
+      return;
+    }
     if (ev.events & (EPOLLHUP | EPOLLERR)) {
       Drop(id, "sock_error", true);
       return;
@@ -1840,6 +1995,11 @@ class Host {
   void Accept(bool is_ws) {
     int lfd = is_ws ? listen_ws_fd_ : listen_fd_;
     for (;;) {
+      // backlog-pressure rung: past the per-cycle burst the kernel
+      // listen backlog keeps the remainder for the next cycle — a
+      // connect storm is paced, not serviced at the expense of every
+      // established conn's poll latency (no side effects, no shed)
+      if (gov_.Defer()) return;
       sockaddr_in peer{};
       socklen_t plen = sizeof(peer);
       int fd = accept4(lfd, reinterpret_cast<sockaddr*>(&peer), &plen,
@@ -1851,29 +2011,508 @@ class Host {
         close(fd);
         continue;
       }
-      if (conns_.size() >= max_conns_) {  // esockd max-conn limiting
+      // accept-shed rung: admission (memory budget, esockd max-conn
+      // limiting) is decided BEFORE any conn side effect — no id, no
+      // table entry, no OPEN event for a shed accept; the close is
+      // ledger-visible instead of silent
+      // the estimate INCLUDES the conn under admission: crossing the
+      // budget sheds the conn that would cross it, not the one after
+      bool admit = gov_.Admit(ConnMemEstimate() + kConnResidentEstBytes);
+      if (!admit || conns_.size() + parked_.size() >= max_conns_) {
         close(fd);
+        stats_[kStConnsShed].fetch_add(1, std::memory_order_relaxed);
+        LedgerNote(kLrAcceptShed, conns_.size() + parked_.size());
         continue;
       }
-      int one = 1;
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      AcceptConn(fd, peer, is_ws);
+    }
+  }
+
+  // Accept side effects: id mint, conn-table insert, epoll
+  // registration, the OPEN event. Accept() calls this only after the
+  // governor's admit check (the ladder contract — nativecheck rule 3).
+  // @admit-gated
+  void AcceptConn(int fd, const sockaddr_in& peer, bool is_ws) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint64_t id = MintConnId();
+    Conn c;
+    c.fd = fd;
+    c.framer = Framer(max_size_);
+    c.last_rx_ms = c.last_work_ms = NowMs();
+    if (is_ws) c.ws = std::make_unique<WsConnState>();
+    auto& cref = conns_.emplace(id, std::move(c)).first->second;
+    if (park_enabled_)
+      cref.tm_park =
+          wheel_.Arm(id, kTmPark, cref.last_rx_ms + ParkAfterOf(cref));
+    FrNote(cref, kFrOpen, 0, is_ws ? 1 : 0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = id;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+    char ip[INET_ADDRSTRLEN] = "?";
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    std::string info = std::string(is_ws ? "ws:" : "") + ip + ":" +
+                       std::to_string(ntohs(peer.sin_port));
+    events_.push_back(EncodeRecord(1, id, info.data(), info.size()));
+  }
+
+  // -- conn-scale plane (round 16): timer-wheel fires + hibernation -------
+  // The per-shard wheel replaced every per-cycle deadline sweep; these
+  // handlers run on the poll thread from wheel_.Advance and re-arm
+  // themselves (handles are consumed by the fire — wheel.h contract).
+
+  uint64_t ParkAfterOf(const Conn& c) const {
+    // configured override wins; the DEFAULT is "2x keepalive grace
+    // passed" (grace = the 1.5x-keepalive deadline), falling back to
+    // a flat horizon for keepalive-less conns
+    if (park_after_ms_) return park_after_ms_;
+    return c.keepalive_ms ? 2ull * c.keepalive_ms : kParkAfterDefaultMs;
+  }
+
+  uint64_t ConnMemEstimate() const {
+    return conns_.size() * kConnResidentEstBytes +
+           parked_bytes_.load(std::memory_order_relaxed);
+  }
+
+  void FireTimer(uint64_t key, uint8_t kind) {
+    switch (kind) {
+      case kTmKeepalive: FireKeepalive(key); break;
+      case kTmPark: FirePark(key); break;
+      case kTmSnRexmit: FireSnRexmit(key); break;
+      case kTmTrunkAck: FireTrunkAck(key); break;
+    }
+  }
+
+  // Keepalive is lazy-reprogrammed: traffic never touches the wheel;
+  // the fire re-checks the real idle clock and either closes the conn
+  // or re-arms at the earliest possible expiry. Parked conns are
+  // judged (and closed) WITHOUT inflation.
+  void FireKeepalive(uint64_t id) {
+    // housekeep clock skew (faultline): the wheel judges conns against
+    // a future clock while the site is armed, exactly like ConnIdleMs
+    uint64_t now = NowMs() + FaultSkewMs();
+    auto it = conns_.find(id);
+    if (it != conns_.end()) {
+      Conn& c = it->second;
+      c.tm_keepalive = 0;
+      if (!c.keepalive_ms) return;
+      uint64_t base = c.last_rx_ms;
+      if (c.sn && !c.sn->awake) {
+        if (now < c.sn->sleep_until_ms) {
+          // announced sleep: expected-silent until the wake deadline;
+          // the idle clock restarts AT the deadline (PR 6 grace rule)
+          c.tm_keepalive = wheel_.Arm(
+              id, kTmKeepalive, c.sn->sleep_until_ms + c.keepalive_ms);
+          return;
+        }
+        if (c.sn->sleep_until_ms > base) base = c.sn->sleep_until_ms;
+      }
+      if (now - base >= c.keepalive_ms) {
+        Drop(id, "keepalive_timeout", true);
+        return;
+      }
+      c.tm_keepalive = wheel_.Arm(id, kTmKeepalive, base + c.keepalive_ms);
+      return;
+    }
+    auto pit = parked_.find(id);
+    if (pit == parked_.end()) return;
+    park::Parked& p = park_slab_.at(pit->second);
+    p.tm_keepalive = 0;
+    if (!p.keepalive_ms) return;
+    if (now - p.last_rx_ms >= p.keepalive_ms) {
+      DropParked(id, "keepalive_timeout", true);
+      return;
+    }
+    p.tm_keepalive =
+        wheel_.Arm(id, kTmKeepalive, p.last_rx_ms + p.keepalive_ms);
+  }
+
+  void FirePark(uint64_t id) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;  // gone, or already parked
+    Conn& c = it->second;
+    c.tm_park = 0;
+    if (!park_enabled_) return;
+    uint64_t now = NowMs();
+    uint64_t after = ParkAfterOf(c);
+    uint64_t base = c.last_work_ms ? c.last_work_ms : c.last_rx_ms;
+    if (now - base >= after && CanPark(c)) {
+      Park(id, it);
+      return;
+    }
+    // not idle enough (or mid-flight state blocks the diet): re-check
+    // at the earliest possible park point
+    c.tm_park = wheel_.Arm(
+        id, kTmPark, (now - base >= after ? now : base) + after);
+  }
+
+  // Hibernation preconditions: everything the compact record cannot
+  // carry must be empty/at-rest. Mid-flight ack windows ARE carried
+  // (sparse summary); a queued-pending window or half-written outbuf
+  // is not.
+  bool CanPark(const Conn& c) const {
+    if (c.sn || c.traced || c.want_close || c.dirty) return false;
+    if (!c.outbuf.empty() || c.outpos) return false;
+    if (!c.framer.idle()) return false;
+    if (c.ws && (!c.ws->open || !c.ws->dec.idle() || !c.ws->hs_buf.empty()))
+      return false;
+    if (c.ack && (!c.ack->pending.empty() || c.ack->cyc_dirty))
+      return false;
+    return true;
+  }
+
+  void Park(uint64_t id, std::unordered_map<uint64_t, Conn>::iterator it) {
+    Conn& c = it->second;
+    uint32_t slot = park_slab_.Alloc();
+    park::Parked& p = park_slab_.at(slot);
+    p.fd = c.fd;
+    p.flags = (c.fast ? park::kPkFast : 0) |
+              (c.ws ? park::kPkWs : 0) |
+              (c.fd < 0 ? park::kPkSynth : 0);
+    p.proto_ver = c.proto_ver;
+    p.max_inflight = c.max_inflight;
+    p.keepalive_ms = c.keepalive_ms;
+    p.last_rx_ms = c.last_rx_ms;
+    p.tm_keepalive = c.tm_keepalive;  // survives hibernation
+    p.next_pid = kNativePidBase;
+    if (c.ack) {
+      // the 20KB bitmap AckState collapses to a sparse summary; the
+      // window is INTACT across park/inflate (pids, qos2/rel phase,
+      // publisher awaiting-rel, pid allocator position)
+      AckState& a = *c.ack;
+      p.next_pid = a.next_pid;
+      if (a.inflight_cnt) {
+        p.infl.reserve(a.inflight_cnt);
+        for (uint32_t w = 0; w < 512; w++) {
+          uint64_t bits = a.inflight[w];
+          while (bits) {
+            uint32_t b = static_cast<uint32_t>(__builtin_ctzll(bits));
+            bits &= bits - 1;
+            uint32_t bi = w * 64 + b;
+            uint32_t e = bi;
+            if (BitTest(a.infl_qos2, bi)) e |= 1u << 16;
+            if (BitTest(a.infl_rel, bi)) e |= 1u << 17;
+            p.infl.push_back(e);
+          }
+        }
+      }
+      if (a.awaiting_cnt) {
+        p.awrel.reserve(a.awaiting_cnt);
+        for (uint32_t w = 0; w < 1024; w++) {
+          uint64_t bits = a.awaiting_rel[w];
+          while (bits) {
+            uint32_t b = static_cast<uint32_t>(__builtin_ctzll(bits));
+            bits &= bits - 1;
+            p.awrel.push_back(static_cast<uint16_t>(w * 64 + b));
+          }
+        }
+      }
+    }
+    // subscriptions stay LIVE in the match table (a delivery to a
+    // parked conn re-inflates it); only the teardown bookkeeping moves
+    p.own_subs = std::move(c.own_subs);
+    p.own_shared = std::move(c.own_shared);
+    // permits are a cache: dropped here, re-earned through one punt
+    // after the conn wakes (the authz-cache-miss path, always correct)
+    parked_bytes_.fetch_add(park::RecordBytes(p),
+                            std::memory_order_relaxed);
+    parked_.emplace(id, slot);
+    conns_.erase(it);
+    stats_[kStConnsParked].fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // Re-inflate a hibernating conn (first byte, delivery, control op).
+  // Returns conns_.end() when the id is not parked either.
+  std::unordered_map<uint64_t, Conn>::iterator InflateParked(uint64_t id) {
+    auto pit = parked_.find(id);
+    if (pit == parked_.end()) return conns_.end();
+    uint32_t slot = pit->second;
+    park::Parked& p = park_slab_.at(slot);
+    size_t rec_bytes = park::RecordBytes(p);
+    Conn c;
+    c.fd = p.fd;
+    c.framer = Framer(max_size_);
+    c.fast = (p.flags & park::kPkFast) != 0;
+    c.proto_ver = p.proto_ver;
+    if (p.max_inflight) c.max_inflight = p.max_inflight;
+    c.keepalive_ms = p.keepalive_ms;
+    c.tm_keepalive = p.tm_keepalive;
+    c.last_rx_ms = p.last_rx_ms;
+    c.last_work_ms = NowMs();  // inflation IS work: no instant re-park
+    if (p.flags & park::kPkWs) {
+      c.ws = std::make_unique<WsConnState>();
+      c.ws->open = true;
+    }
+    if (!p.infl.empty() || !p.awrel.empty() ||
+        (p.next_pid && p.next_pid != kNativePidBase)) {
+      c.ack = std::make_unique<AckState>();
+      AckState& a = *c.ack;
+      a.next_pid = p.next_pid ? p.next_pid : kNativePidBase;
+      for (uint32_t e : p.infl) {
+        uint32_t bi = e & 0xFFFFu;
+        BitSet(a.inflight, bi);
+        if (e & (1u << 16)) BitSet(a.infl_qos2, bi);
+        if (e & (1u << 17)) BitSet(a.infl_rel, bi);
+        a.inflight_cnt++;
+      }
+      for (uint16_t pidv : p.awrel) {
+        BitSet(a.awaiting_rel, pidv);
+        a.awaiting_cnt++;
+      }
+    }
+    c.own_subs = std::move(p.own_subs);
+    c.own_shared = std::move(p.own_shared);
+    parked_bytes_.fetch_sub(rec_bytes, std::memory_order_relaxed);
+    park_slab_.Free(slot);
+    parked_.erase(pit);
+    auto it = conns_.emplace(id, std::move(c)).first;
+    if (park_enabled_)
+      it->second.tm_park =
+          wheel_.Arm(id, kTmPark, NowMs() + ParkAfterOf(it->second));
+    stats_[kStConnsInflated].fetch_add(1, std::memory_order_relaxed);
+    return it;
+  }
+
+  // Inflate-on-demand lookup: delivery/egress/control paths resolve a
+  // conn that may be hibernating.
+  std::unordered_map<uint64_t, Conn>::iterator FindConnInflate(uint64_t id) {
+    auto it = conns_.find(id);
+    if (it != conns_.end()) return it;
+    return InflateParked(id);
+  }
+
+  // Tear a parked conn down without inflating it (keepalive expiry,
+  // close_conn, socket death while hibernating).
+  void DropParked(uint64_t id, const char* reason, bool notify) {
+    auto pit = parked_.find(id);
+    if (pit == parked_.end()) return;
+    park::Parked& p = park_slab_.at(pit->second);
+    for (const std::string& filt : p.own_subs) subs_.Remove(id, filt);
+    for (const auto& [token, filt] : p.own_shared)
+      subs_.SharedRemove(token, id, filt);
+    if (p.tm_keepalive) wheel_.Cancel(p.tm_keepalive);
+    if (p.fd >= 0) {
+      epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, p.fd, nullptr);
+      close(p.fd);
+    }
+    parked_bytes_.fetch_sub(park::RecordBytes(p),
+                            std::memory_order_relaxed);
+    park_slab_.Free(pit->second);
+    parked_.erase(pit);
+    if (notify)
+      events_.push_back(EncodeRecord(3, id, reason, strlen(reason)));
+  }
+
+  // Inbound bytes on a hibernating conn. The keepalive fast path —
+  // reads that are nothing but whole PINGREQs — answers from the
+  // parked record and STAYS parked, so a million idle-but-pinging
+  // devices never churn the park plane; anything else re-inflates
+  // before a single fast-path byte is processed.
+  void ParkedRead(uint64_t id, uint32_t slot) {
+    park::Parked& p = park_slab_.at(slot);
+    if (p.fd < 0) return;  // synthetic conns have no socket
+    if (p.flags & park::kPkWs) {
+      // WS pings arrive framed — not worth a parked-path codec; the
+      // inflation cost is one WsConnState + a fresh decoder
+      auto it = InflateParked(id);
+      if (it != conns_.end()) Read(id, it->second);
+      return;
+    }
+    uint8_t buf[512];
+    for (;;) {
+      // @fault(conn_read) — the same read seam as Read(): park-during-
+      // storm chaos hits hibernating conns too
+      ssize_t n = FaultRecv(fault::kSiteConnRead, id, p.fd, buf,
+                            sizeof(buf));
+      if (n == 0) {
+        DropParked(id, "sock_closed", true);
+        return;
+      }
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno != EAGAIN && errno != EWOULDBLOCK)
+          DropParked(id, "sock_error", true);
+        return;
+      }
+      p.last_rx_ms = NowMs();
+      bool all_ping = (n % 2) == 0;
+      for (ssize_t i = 0; all_ping && i < n; i += 2)
+        all_ping = buf[i] == 0xC0 && buf[i + 1] == 0x00;
+      if (!all_ping) {
+        // real work: inflate FIRST, then run the normal ingest over
+        // these bytes and drain whatever else the kernel holds
+        auto it = InflateParked(id);
+        if (it == conns_.end()) return;
+        if (!IngestMqtt(id, it->second, buf, static_cast<size_t>(n))) {
+          Drop(id, "frame_error", true);
+          return;
+        }
+        auto again = conns_.find(id);
+        if (again != conns_.end()) Read(id, again->second);
+        return;
+      }
+      size_t k = static_cast<size_t>(n) / 2;
+      std::string pong(k * 2, '\0');
+      for (size_t i = 0; i < k; i++)
+        pong[2 * i] = static_cast<char>(0xD0);
+      size_t off = 0;
+      while (off < pong.size()) {
+        // @fault(conn_write) — the parked egress seam
+        ssize_t w = FaultSend(fault::kSiteConnWrite, id, p.fd,
+                              pong.data() + off, pong.size() - off);
+        if (w > 0) {
+          off += static_cast<size_t>(w);
+          continue;
+        }
+        if (w < 0 && errno == EINTR) continue;
+        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          // slow reader: inflate and let the outbuf machinery own it
+          auto it = InflateParked(id);
+          if (it == conns_.end()) return;
+          it->second.outbuf.append(pong, off, std::string::npos);
+          MarkDirty(id, it->second);
+          Flush(id, it->second);
+          return;
+        }
+        DropParked(id, "sock_error", true);
+        return;
+      }
+      stats_[kStParkedPings].fetch_add(k, std::memory_order_relaxed);
+      if (n < static_cast<ssize_t>(sizeof(buf))) return;
+    }
+  }
+
+  // Bench/test surface (raw host only): conjure n resident conns with
+  // no socket (fd < 0; egress is discarded) so the conn-scale
+  // structures — wheel, park plane, match table — run at 10^6 scale
+  // inside a 20k-fd container. Every conn takes the REAL park
+  // machinery; none emits OPEN events (the Python server never sees
+  // these ids — this is not a product path).
+  void SynthConns(uint32_t n, uint32_t keepalive_ms, uint32_t sub_every,
+                  const std::string& prefix) {
+    uint64_t now = NowMs();
+    std::string filt;
+    for (uint32_t i = 0; i < n; i++) {
+      // the synthetic herd respects the same admission budget
+      if (!gov_.Admit(ConnMemEstimate() + kConnResidentEstBytes)) {
+        stats_[kStConnsShed].fetch_add(1, std::memory_order_relaxed);
+        LedgerNote(kLrAcceptShed, conns_.size() + parked_.size());
+        continue;
+      }
       uint64_t id = MintConnId();
       Conn c;
-      c.fd = fd;
+      c.fd = -1;
       c.framer = Framer(max_size_);
-      if (is_ws) c.ws = std::make_unique<WsConnState>();
+      c.fast = true;
+      c.last_rx_ms = c.last_work_ms = now;
+      c.keepalive_ms = keepalive_ms;
       auto& cref = conns_.emplace(id, std::move(c)).first->second;
-      FrNote(cref, kFrOpen, 0, is_ws ? 1 : 0);
-      epoll_event ev{};
-      ev.events = EPOLLIN;
-      ev.data.u64 = id;
-      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
-      char ip[INET_ADDRSTRLEN] = "?";
-      inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
-      std::string info = std::string(is_ws ? "ws:" : "") + ip + ":" +
-                         std::to_string(ntohs(peer.sin_port));
-      events_.push_back(EncodeRecord(1, id, info.data(), info.size()));
+      if (keepalive_ms)
+        cref.tm_keepalive =
+            wheel_.Arm(id, kTmKeepalive, now + keepalive_ms);
+      if (park_enabled_)
+        cref.tm_park = wheel_.Arm(id, kTmPark, now + ParkAfterOf(cref));
+      if (sub_every && (i % sub_every) == 0) {
+        filt = prefix;
+        filt += '/';
+        filt += std::to_string(id & 0xFFFFFFFFFFFFull);
+        subs_.Add(id, filt, 0, 0);
+        cref.own_subs.push_back(filt);
+      }
     }
+  }
+
+  // Per-conn qos1-over-UDP retransmit: the old SnRexmitScan body for
+  // ONE conn, driven by its wheel deadline instead of a per-cycle
+  // sweep over every tracked conn.
+  void FireSnRexmit(uint64_t id) {
+    auto cit = conns_.find(id);
+    if (cit == conns_.end() || !cit->second.sn) return;
+    Conn& c = cit->second;
+    c.sn->tm_rexmit = 0;
+    if (c.sn->rexmit.empty()) return;
+    if (!c.sn->awake) {
+      // announced sleep (§6.14): the radio is off, so neither the
+      // retry timer nor the abandonment counter may advance — the
+      // parked sleep_buf copy is this delivery's FIRST transmission,
+      // sent at wake, and the wake flush re-arms this timer there
+      // (the PR 6 retry-clock lesson)
+      return;
+    }
+    uint64_t now = NowMs();
+    uint64_t next_due = 0;
+    bool resent = false;
+    auto& rx = c.sn->rexmit;
+    for (size_t i = 0; i < rx.size();) {
+      SnInflightRx& r = rx[i];
+      if (now - r.last_tx_ms < kSnRetryMs) {
+        uint64_t due = r.last_tx_ms + kSnRetryMs;
+        if (!next_due || due < next_due) next_due = due;
+        i++;
+        continue;
+      }
+      if (r.tries >= kSnMaxRetries) {
+        if (c.ack) {
+          AckState& a = *c.ack;
+          uint32_t bi = r.pid - kNativePidBase;
+          if (BitTest(a.inflight, bi)) {
+            BitClr(a.inflight, bi);
+            a.inflight_cnt--;
+            a.cyc_acked++;
+            AckNote(id, a);
+          }
+        }
+        stats_[kStDropsInflight].fetch_add(1, std::memory_order_relaxed);
+        rx[i] = std::move(rx.back());
+        rx.pop_back();
+        continue;
+      }
+      r.dgram[r.flags_off] = static_cast<char>(
+          static_cast<uint8_t>(r.dgram[r.flags_off]) | sn::kFDup);
+      c.outbuf += r.dgram;
+      MarkDirty(id, c);
+      resent = true;
+      r.last_tx_ms = now;
+      r.tries++;
+      uint64_t due = now + kSnRetryMs;
+      if (!next_due || due < next_due) next_due = due;
+      i++;
+    }
+    if (c.ack) DrainPending(id, c);  // abandoned slots pull the queue
+    // DrainPending may have tracked a fresh delivery (SnRexmitTrack
+    // arms the timer it found zeroed): never double-arm over it
+    if (!rx.empty() && next_due && !c.sn->tm_rexmit)
+      c.sn->tm_rexmit = wheel_.Arm(id, kTmSnRexmit, next_due);
+    if (resent) FlushDirty();
+  }
+
+  // Trunk silent-link watchdog: the old per-cycle TrunkAckScan sweep,
+  // now fired per peer from the wheel against the live ring front.
+  void FireTrunkAck(uint64_t peer_id) {
+    auto it = trunk_peers_.find(peer_id);
+    if (it == trunk_peers_.end()) return;
+    trunk::Peer& p = it->second;
+    p.tm_ack = 0;
+    if (!trunk_ack_timeout_ms_ || !p.up || !p.sock_tag ||
+        p.unacked.empty())
+      return;  // re-armed by the next flush/replay re-stamp
+    uint64_t due = p.unacked.front().flush_ms + trunk_ack_timeout_ms_;
+    uint64_t now = NowMs();
+    if (now >= due) {
+      TrunkSockDead(p.sock_tag, "ack_timeout");
+      return;
+    }
+    p.tm_ack = wheel_.Arm(peer_id, kTmTrunkAck, due);
+  }
+
+  // Arm the watchdog when the ring front (re)gains its reference
+  // stamp; a fire against a younger front simply re-arms.
+  void TrunkAckWatch(uint64_t peer_id, trunk::Peer& p) {
+    if (!trunk_ack_timeout_ms_ || p.tm_ack || p.unacked.empty()) return;
+    p.tm_ack = wheel_.Arm(
+        peer_id, kTmTrunkAck,
+        p.unacked.front().flush_ms + trunk_ack_timeout_ms_);
   }
 
   void Read(uint64_t id, Conn& c) {
@@ -1915,6 +2554,10 @@ class Host {
     std::vector<std::string> frames;
     FrameStatus st = c.framer.Feed(data, len, &frames);
     for (auto& f : frames) {
+      // park-after clock: any frame but PINGREQ is WORK (keepalive
+      // pings keep the conn alive without keeping it resident)
+      if ((static_cast<uint8_t>(f[0]) >> 4) != 12)
+        c.last_work_ms = c.last_rx_ms;
       if (!c.fast || !TryFast(id, c, f)) {
         // flight recorder: a frame bound for Python is a PUNT when the
         // conn was fast-eligible, a plain slow-plane FRAME otherwise
@@ -2454,7 +3097,8 @@ class Host {
   bool DeliverTo(uint64_t owner, const SubEntry& e, uint64_t publisher,
                  uint8_t qos, std::string_view topic,
                  std::string_view payload) {
-    auto it = conns_.find(owner);
+    // a delivery to a hibernating subscriber re-inflates it first
+    auto it = FindConnInflate(owner);
     if (it == conns_.end()) return false;  // stale entry (conn mid-close)
     Conn& t = it->second;
     if (t.outbuf.size() - t.outpos > kHighWater) {
@@ -3085,6 +3729,12 @@ class Host {
       keep.push_back(std::move(u));
     }
     p.unacked.swap(keep);
+    // the watchdog reference moved: re-arm against the fresh front
+    if (p.tm_ack) {
+      wheel_.Cancel(p.tm_ack);
+      p.tm_ack = 0;
+    }
+    TrunkAckWatch(peer_id, p);
     char sub = 1;
     events_.push_back(EncodeRecord(9, peer_id, &sub, 1));
     TrunkFlushSock(p.sock_tag, sit->second);
@@ -3495,6 +4145,7 @@ class Host {
     while (p.unacked.size() > kTrunkUnackedMax &&
            p.unacked.front().q1_record.empty())
       p.unacked.pop_front();  // qos0-only entries are droppable ballast
+    TrunkAckWatch(peer_id, p);  // first unacked entry arms the watchdog
     if (telemetry_) RecordHist(kHistTrunkBatchN, p.batch_n);
     stats_[kStTrunkBatchesOut].fetch_add(1, std::memory_order_relaxed);
     p.batch.clear();
@@ -3549,21 +4200,13 @@ class Host {
   }
 
   // Silent-link watchdog (round 15), once per poll cycle next to the
-  // HELLO grace scan: a partitioned-but-ESTABLISHED link never fails a
-  // syscall, so nothing else would ever notice its acks stopped. A
-  // front ring entry unacked past the timeout kills the link; the
-  // redial replays the ring. Entries sealed while the link was down
-  // are exempt by construction (the scan requires p.up, and
-  // TrunkCompleteUp re-stamps every survivor at replay time).
-  void TrunkAckScan() {
-    if (!trunk_ack_timeout_ms_ || trunk_peers_.empty()) return;
-    uint64_t now = NowMs();
-    for (auto& [peer_id, p] : trunk_peers_) {
-      if (!p.up || p.unacked.empty() || !p.sock_tag) continue;
-      if (now >= p.unacked.front().flush_ms + trunk_ack_timeout_ms_)
-        TrunkSockDead(p.sock_tag, "ack_timeout");
-    }
-  }
+  // The HELLO-grace deadline stays a (tiny, O(peers)) scan; the ack
+  // watchdog itself moved onto the wheel (FireTrunkAck): a
+  // partitioned-but-ESTABLISHED link never fails a syscall, so only
+  // the unacked-front deadline notices its acks stopped. Entries
+  // sealed while the link was down are exempt by construction (the
+  // fire requires p.up, and TrunkCompleteUp re-stamps every survivor
+  // at replay time).
 
   void TrunkFlushSock(uint64_t tag, trunk::Sock& s) {
     while (s.outpos < s.outbuf.size()) {
@@ -4219,6 +4862,9 @@ class Host {
         // parked during sleep — restart the retry clock from here
         uint64_t woke = NowMs();
         for (auto& r : s.rexmit) r.last_tx_ms = woke;
+        // re-arm the rexmit wheel deadline the sleep entry cancelled
+        if (!s.rexmit.empty() && !s.tm_rexmit)
+          s.tm_rexmit = wheel_.Arm(id, kTmSnRexmit, woke + kSnRetryMs);
         MarkDirty(id, c);
       }
       if (s.connected) {
@@ -4238,6 +4884,11 @@ class Host {
         s.awake = false;
         s.sleep_until_ms = NowMs() + static_cast<uint64_t>(m.duration)
                                      * 1000;
+        // park the retry clock with the radio (wake re-arms it)
+        if (s.tm_rexmit) {
+          wheel_.Cancel(s.tm_rexmit);
+          s.tm_rexmit = 0;
+        }
         SnReply(id, c, d);
         return;
       }
@@ -4722,9 +5373,12 @@ class Host {
 
   void SnRexmitTrack(uint64_t id, Conn& c, uint16_t pid, std::string dgram,
                      size_t flags_off) {
-    c.sn->rexmit.push_back(
-        {pid, std::move(dgram), flags_off, NowMs(), 0});
-    sn_rexmit_.insert(id);
+    uint64_t now = NowMs();
+    c.sn->rexmit.push_back({pid, std::move(dgram), flags_off, now, 0});
+    // the wheel replaced the per-cycle scan: one deadline per conn,
+    // parked while the client announced sleep (armed again at wake)
+    if (!c.sn->tm_rexmit && c.sn->awake)
+      c.sn->tm_rexmit = wheel_.Arm(id, kTmSnRexmit, now + kSnRetryMs);
   }
 
   void SnRexmitAck(uint64_t id, SnConnState& s, uint16_t pid) {
@@ -4735,7 +5389,10 @@ class Host {
       rx.pop_back();
       break;
     }
-    if (rx.empty()) sn_rexmit_.erase(id);
+    if (rx.empty() && s.tm_rexmit) {
+      wheel_.Cancel(s.tm_rexmit);
+      s.tm_rexmit = 0;
+    }
   }
 
   // qos1 fast-path delivery to an SN subscriber: SN framing + the SAME
@@ -4794,70 +5451,6 @@ class Host {
   // Timeout scan (~4/s, gated on any tracked delivery existing):
   // resend with DUP, abandon after kSnMaxRetries freeing the window
   // slot exactly as a PUBACK would.
-  void SnRexmitScan() {
-    if (sn_rexmit_.empty()) return;
-    uint64_t now = NowMs();
-    if (now - sn_last_rexmit_ms_ < 250) return;
-    sn_last_rexmit_ms_ = now;
-    bool resent = false;
-    for (auto it = sn_rexmit_.begin(); it != sn_rexmit_.end();) {
-      uint64_t id = *it;
-      auto cit = conns_.find(id);
-      if (cit == conns_.end() || !cit->second.sn) {
-        it = sn_rexmit_.erase(it);
-        continue;
-      }
-      Conn& c = cit->second;
-      if (!c.sn->awake) {
-        // announced sleep (§6.14): the radio is off, so neither the
-        // retry timer nor the abandonment counter may advance — the
-        // parked sleep_buf copy is this delivery's FIRST transmission,
-        // sent at wake, and the timer restarts there.
-        ++it;
-        continue;
-      }
-      auto& rx = c.sn->rexmit;
-      for (size_t i = 0; i < rx.size();) {
-        SnInflightRx& r = rx[i];
-        if (now - r.last_tx_ms < kSnRetryMs) {
-          i++;
-          continue;
-        }
-        if (r.tries >= kSnMaxRetries) {
-          if (c.ack) {
-            AckState& a = *c.ack;
-            uint32_t bi = r.pid - kNativePidBase;
-            if (BitTest(a.inflight, bi)) {
-              BitClr(a.inflight, bi);
-              a.inflight_cnt--;
-              a.cyc_acked++;
-              AckNote(id, a);
-            }
-          }
-          stats_[kStDropsInflight].fetch_add(1,
-                                             std::memory_order_relaxed);
-          rx[i] = std::move(rx.back());
-          rx.pop_back();
-          continue;
-        }
-        r.dgram[r.flags_off] = static_cast<char>(
-            static_cast<uint8_t>(r.dgram[r.flags_off]) | sn::kFDup);
-        c.outbuf += r.dgram;
-        MarkDirty(id, c);
-        resent = true;
-        r.last_tx_ms = now;
-        r.tries++;
-        i++;
-      }
-      if (c.ack) DrainPending(id, c);  // abandoned slots pull the queue
-      if (rx.empty())
-        it = sn_rexmit_.erase(it);
-      else
-        ++it;
-    }
-    if (resent) FlushDirty();
-  }
-
   // Datagram egress: outbuf holds whole self-delimiting SN messages.
   // Consecutive messages pack into aggregate datagrams up to
   // sn::kPackDatagram (the peer's ParseAll loop decodes them all from
@@ -4956,7 +5549,7 @@ class Host {
 
   void RetainDeliver(uint64_t id, const std::string& filter,
                      uint8_t maxqos) {
-    auto it = conns_.find(id);
+    auto it = FindConnInflate(id);
     if (it == conns_.end()) return;
     cur_trace_ = 0;  // retained bursts are not part of any sampled trace
     Conn& c = it->second;
@@ -5421,6 +6014,14 @@ class Host {
       SnFlush(id, c);
       return;
     }
+    if (c.fd < 0) {
+      // synthetic conns (bench/test herd) have no socket: egress is
+      // discarded, want_close honours the normal teardown path
+      c.outbuf.clear();
+      c.outpos = 0;
+      if (c.want_close) Drop(id, "closed_by_host", false);
+      return;
+    }
     while (c.outpos < c.outbuf.size()) {
       // @fault(conn_write) — errno/short/blackhole on the conn send
       ssize_t n = FaultSend(fault::kSiteConnWrite, id, c.fd,
@@ -5452,7 +6053,18 @@ class Host {
 
   void Drop(uint64_t id, const char* reason, bool notify) {
     auto it = conns_.find(id);
-    if (it == conns_.end()) return;
+    if (it == conns_.end()) {
+      // hibernating conns tear down from the parked record directly —
+      // no inflation on the way to the grave
+      DropParked(id, reason, notify);
+      return;
+    }
+    // wheel timers die with the conn (generation-checked: a handle
+    // already consumed by a same-tick fire no-ops here)
+    if (it->second.tm_keepalive) wheel_.Cancel(it->second.tm_keepalive);
+    if (it->second.tm_park) wheel_.Cancel(it->second.tm_park);
+    if (it->second.sn && it->second.sn->tm_rexmit)
+      wheel_.Cancel(it->second.sn->tm_rexmit);
     if (telemetry_ && it->second.fr) {
       // flight-recorder dump on abnormal close / protocol error, and
       // always for traced conns (the tail rides the trace log).
@@ -5491,9 +6103,8 @@ class Host {
         if (ait != sn_addr_conn_.end() && ait->second == id)
           sn_addr_conn_.erase(ait);
       }
-      sn_rexmit_.erase(id);
       if (id == sn_anon_id_) sn_anon_id_ = 0;
-    } else {
+    } else if (it->second.fd >= 0) {  // synthetic conns have no socket
       epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
       close(it->second.fd);
     }
@@ -5643,8 +6254,17 @@ class Host {
   uint64_t sn_anon_id_ = 0;             // the shared QoS -1 publisher
   std::unordered_map<uint64_t, uint64_t> sn_addr_conn_;  // addr → conn
   std::unordered_map<uint16_t, std::string> sn_predefined_;
-  std::unordered_set<uint64_t> sn_rexmit_;  // conns with tracked qos1
-  uint64_t sn_last_rexmit_ms_ = 0;
+  // -- conn-scale plane (round 16, poll-thread-owned) ----------------------
+  // The per-shard timer wheel (keepalive, park-after, SN rexmit, trunk
+  // ack watchdog) + the hibernation plane. parked_bytes_/counters are
+  // atomics only because Python-side gauges read them cross-thread.
+  wheel::Wheel wheel_{NowMs()};
+  park::Slab<park::Parked> park_slab_;
+  std::unordered_map<uint64_t, uint32_t> parked_;  // conn id -> slab slot
+  std::atomic<uint64_t> parked_bytes_{0};
+  park::AcceptGovernor gov_;
+  bool park_enabled_ = true;
+  uint64_t park_after_ms_ = 0;  // explicit override; 0 = 2x-grace auto
   std::vector<sn::SnMsg> sn_msgs_scratch_;
   std::vector<std::string> sn_frames_scratch_;
   std::vector<uint8_t> sn_rx_buf_;  // recvmmsg slots, sized on first read
@@ -6233,6 +6853,74 @@ long emqx_host_conn_idle_ms(void* h, uint64_t conn) {
 
 void emqx_host_destroy(void* h) {
   delete static_cast<emqx_native::Host*>(h);
+}
+
+// -- conn-scale plane (round 16) -------------------------------------------
+
+// Arm/replace a conn's native keepalive deadline on the shard's timer
+// wheel. `deadline_ms` is the EFFECTIVE expiry (callers pass 1.5x the
+// negotiated keepalive, the [MQTT-3.1.2-24] grace); 0 disarms. The
+// Python housekeep loop stops scanning conns whose keepalive lives
+// here — the O(N)-per-tick sweep becomes O(expired).
+int emqx_host_set_keepalive(void* h, uint64_t conn, uint64_t deadline_ms) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetKeepalive;
+  op.owner = conn;
+  op.token = deadline_ms;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Conn-scale knobs: `enabled` gates hibernation, `park_after_ms` is
+// the no-keepalive park horizon fallback (0 keeps the default; conns
+// with a keepalive park after 2x their grace deadline),
+// `accept_burst` caps accepts per poll cycle (0 = unlimited; the
+// remainder defers to the kernel backlog), `mem_budget_bytes` sheds
+// accepts once the conn-memory estimate crosses it (0 = unlimited,
+// sheds are ledger-visible as accept_shed).
+int emqx_host_set_park(void* h, int enabled, uint32_t park_after_ms,
+                       uint32_t accept_burst, uint64_t mem_budget_bytes) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetPark;
+  op.flags = enabled ? 1 : 0;
+  op.max_inflight = park_after_ms;
+  op.owner = accept_burst;
+  op.token = mem_budget_bytes;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Bench/test surface (raw hosts only): conjure `n` resident fast
+// conns with no socket so the conn-scale structures run at 10^6 scale
+// inside an fd-capped container; every `sub_every`-th conn installs a
+// unique subscription under `topic_prefix`. Not a product path.
+int emqx_host_synth_conns(void* h, uint32_t n, uint32_t keepalive_ms,
+                          uint32_t sub_every, const char* topic_prefix) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSynthConns;
+  op.owner = n;
+  op.token = keepalive_ms;
+  op.max_inflight = sub_every;
+  op.str = topic_prefix ? topic_prefix : "synth";
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// out[4] = {resident conns, parked conns, parked bytes, armed timers}.
+// POLL-THREAD ONLY (returns -2 off thread, the ConnIdleMs contract).
+int emqx_host_conn_counts(void* h, uint64_t* out) {
+  return static_cast<emqx_native::Host*>(h)->ConnCounts(out);
+}
+
+// Timer-wheel parity surface: run a seeded op script on a standalone
+// wheel (caller's thread, no host) and return the op/fire journal the
+// Python brute-force oracle replays (free with emqx_buf_free).
+long emqx_wheel_selftest(uint64_t seed, uint32_t n_ops, uint8_t** out,
+                         size_t* out_len) {
+  std::vector<uint8_t> buf;
+  emqx_native::wheel::SelfTestScript(seed, n_ops, &buf);
+  uint8_t* mem = static_cast<uint8_t*>(malloc(buf.empty() ? 1 : buf.size()));
+  if (!buf.empty()) memcpy(mem, buf.data(), buf.size());
+  *out = mem;
+  *out_len = buf.size();
+  return static_cast<long>(buf.size());
 }
 
 // --- standalone sub table (differential testing vs router/trie.py) --------

@@ -848,4 +848,279 @@ int emqx_loadgen_run_sn(const char* host, uint16_t port, uint32_t n_subs,
   return 0;
 }
 
+// -- conn-scale herd (round 16) --------------------------------------------
+//
+// The C10M axis the fleet above cannot exercise: N mostly-idle conns
+// that connect in a storm, then just sit there honoring staggered
+// keepalives while a (separate, small) loadgen fleet measures fan-out
+// throughput against the same broker. Per-conn state is deliberately
+// tiny (the herd itself must not be the memory story it measures) and
+// PINGREQ->PINGRESP round trips are the keepalive-latency probe: the
+// bench's "keepalive p99 honored" gate is this herd's ping RTT p99
+// plus zero broker-initiated closes during the hold.
+//
+// ctypes releases the GIL for the whole call; `live` is a 4-slot
+// progress surface the caller polls from Python (connacked, errors,
+// pings, broker_closes) and `stop` ends the hold early.
+
+int emqx_loadgen_conn_scale(const char* host, uint16_t port,
+                            uint32_t n_conns, uint32_t burst,
+                            uint16_t keepalive_s, uint32_t sub_every,
+                            uint32_t hold_ms, int proto_ver,
+                            volatile int32_t* stop,
+                            volatile uint64_t* live, uint64_t* out) {
+  struct HerdConn {
+    int fd = -1;
+    // 0 = TCP connecting (awaiting writability), 1 = CONNECT sent
+    // (awaiting CONNACK), 2 = up, 3 = dead
+    uint8_t state = 0;
+    uint64_t ping_t0 = 0;       // outstanding PINGREQ stamp (0 = none)
+    uint64_t next_ping_ms = 0;  // staggered schedule
+    std::string inbuf;          // partial-frame carry (tiny)
+  };
+  if (burst == 0) burst = 512;
+  int ep = epoll_create1(EPOLL_CLOEXEC);
+  if (ep < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(ep);
+    return -2;
+  }
+  std::vector<HerdConn> conns(n_conns);
+  std::vector<uint64_t> rtts;
+  uint64_t t_start = NowNs();
+  uint64_t connacked = 0, errors = 0, pings = 0, closes = 0;
+  uint32_t started = 0;
+  uint64_t ka_ms = static_cast<uint64_t>(keepalive_s) * 1000;
+  auto now_ms = []() { return NowNs() / 1000000ull; };
+  auto fail = [&](HerdConn& c) {
+    if (c.fd >= 0) close(c.fd);
+    c.fd = -1;
+    if (c.state == 2) closes++;
+    c.state = 3;
+    errors++;
+    if (live) {
+      live[1] = errors;
+      live[3] = closes;
+    }
+  };
+  // minimal inbound machine: split frames (1-byte varints cover every
+  // packet the herd can see except a delivered PUBLISH, which it skips
+  // with the full varint), count CONNACK/PINGRESP
+  auto ingest = [&](uint32_t idx, const uint8_t* data, size_t len) {
+    HerdConn& c = conns[idx];
+    c.inbuf.append(reinterpret_cast<const char*>(data), len);
+    size_t pos = 0;
+    while (true) {
+      if (c.inbuf.size() - pos < 2) break;
+      size_t hp = pos + 1;
+      uint32_t rem = 0, mult = 1;
+      bool done = false, bad = false;
+      while (hp < c.inbuf.size()) {
+        uint8_t b = static_cast<uint8_t>(c.inbuf[hp++]);
+        rem += (b & 0x7F) * mult;
+        if (!(b & 0x80)) {
+          done = true;
+          break;
+        }
+        if (mult > 128u * 128u * 128u) {
+          bad = true;
+          break;
+        }
+        mult *= 128;
+      }
+      if (bad) {
+        fail(c);
+        return;
+      }
+      if (!done || c.inbuf.size() - hp < rem) break;
+      uint8_t type = static_cast<uint8_t>(c.inbuf[pos]) >> 4;
+      if (type == 2 && c.state == 1) {
+        c.state = 2;
+        connacked++;
+        if (live) live[0] = connacked;
+        uint64_t base = now_ms();
+        // stagger first pings uniformly across one keepalive interval
+        c.next_ping_ms =
+            base + 1 + (ka_ms ? (static_cast<uint64_t>(idx) * ka_ms) /
+                                    (n_conns ? n_conns : 1)
+                              : 0);
+        if (sub_every && idx % sub_every == 0) {
+          std::string sub = Subscribe(
+              1, "herd/" + std::to_string(idx), 0, proto_ver);
+          if (send(c.fd, sub.data(), sub.size(), MSG_NOSIGNAL) < 0 &&
+              errno != EAGAIN && errno != EWOULDBLOCK)
+            fail(c);
+        }
+      } else if (type == 13 && c.ping_t0) {  // PINGRESP
+        uint64_t rtt = NowNs() - c.ping_t0;
+        c.ping_t0 = 0;
+        rtts.push_back(rtt);
+        pings++;
+        if (live) live[2] = pings;
+      }
+      pos = hp + rem;
+    }
+    if (pos) c.inbuf.erase(0, pos);
+  };
+  // CONNECT goes out only once the TCP handshake completed (a send
+  // right after a nonblocking connect() EAGAINs and would silently
+  // strand the conn pre-CONNECT — measured as a ~40%% stall at 6k)
+  auto send_connect = [&](uint32_t idx, HerdConn& c) {
+    std::string body;
+    PutU16(&body, 4);
+    body += "MQTT";
+    body.push_back(static_cast<char>(proto_ver));
+    body.push_back(0x02);
+    PutU16(&body, keepalive_s);
+    if (proto_ver == 5) body.push_back('\0');
+    std::string cid = "herd" + std::to_string(idx);
+    PutU16(&body, static_cast<uint16_t>(cid.size()));
+    body += cid;
+    std::string f;
+    f.push_back(0x10);
+    PutVarint(&f, body.size());
+    f += body;
+    if (send(c.fd, f.data(), f.size(), MSG_NOSIGNAL) !=
+        static_cast<ssize_t>(f.size())) {
+      fail(c);
+      return;
+    }
+    c.state = 1;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = idx;
+    epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+  };
+  auto pump = [&](int timeout_ms) {
+    epoll_event evs[256];
+    int n = epoll_wait(ep, evs, 256, timeout_ms);
+    uint8_t chunk[16 * 1024];
+    for (int i = 0; i < n; i++) {
+      uint32_t idx = evs[i].data.u32;
+      HerdConn& c = conns[idx];
+      if (c.fd < 0) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        fail(c);
+        continue;
+      }
+      if ((evs[i].events & EPOLLOUT) && c.state == 0) {
+        int err = 0;
+        socklen_t el = sizeof(err);
+        getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &el);
+        if (err) {
+          fail(c);
+          continue;
+        }
+        send_connect(idx, c);
+        if (c.fd < 0) continue;
+      }
+      if (!(evs[i].events & EPOLLIN)) continue;
+      for (;;) {
+        ssize_t r = recv(c.fd, chunk, sizeof(chunk), 0);
+        if (r > 0) {
+          ingest(idx, chunk, static_cast<size_t>(r));
+          if (c.fd < 0 || static_cast<size_t>(r) < sizeof(chunk)) break;
+        } else if (r == 0) {
+          fail(c);
+          break;
+        } else {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          if (errno == EINTR) continue;
+          fail(c);
+          break;
+        }
+      }
+    }
+  };
+  // the keepalive service: runs during the STORM too — a large herd's
+  // connect phase can outlast a keepalive interval, and the broker's
+  // wheel shows no mercy to a client that negotiated one and went mute
+  uint64_t next_ping_scan = 0;
+  auto service_pings = [&]() {
+    if (!ka_ms) return;
+    uint64_t t = now_ms();
+    // the herd must not become its own O(N)-per-pump sweep: ping
+    // deadlines have second granularity, so a 250ms scan cadence
+    // keeps the fleet honest without stealing the (possibly single)
+    // core from the broker under measurement
+    if (t < next_ping_scan) return;
+    next_ping_scan = t + 250;
+    for (uint32_t i = 0; i < started; i++) {
+      HerdConn& c = conns[i];
+      if (c.state != 2 || c.fd < 0 || t < c.next_ping_ms) continue;
+      uint8_t pingreq[2] = {0xC0, 0x00};
+      ssize_t w = send(c.fd, pingreq, 2, MSG_NOSIGNAL);
+      if (w == 2) {
+        if (!c.ping_t0) c.ping_t0 = NowNs();
+        c.next_ping_ms = t + ka_ms;
+      } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        fail(c);
+      } else {
+        c.next_ping_ms = t + 50;  // backpressured: retry shortly
+      }
+    }
+  };
+  // connect storm, paced at `burst` initiations per pump cycle
+  uint64_t connect_deadline =
+      now_ms() + 60000 + static_cast<uint64_t>(n_conns) / 10;
+  while (started < n_conns || connacked + errors < started) {
+    uint32_t launched = 0;
+    while (started < n_conns && launched < burst) {
+      uint32_t i = started++;
+      launched++;
+      HerdConn& c = conns[i];
+      c.fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+      if (c.fd < 0) {
+        c.state = 3;
+        errors++;
+        continue;
+      }
+      int rc = connect(c.fd, reinterpret_cast<sockaddr*>(&addr),
+                       sizeof(addr));
+      if (rc < 0 && errno != EINPROGRESS) {
+        fail(c);
+        continue;
+      }
+      epoll_event ev{};
+      // writability = handshake done; send_connect flips to EPOLLIN
+      ev.events = EPOLLIN | EPOLLOUT;
+      ev.data.u32 = i;
+      epoll_ctl(ep, EPOLL_CTL_ADD, c.fd, &ev);
+      if (rc == 0) send_connect(i, c);  // loopback same-call completion
+    }
+    pump(5);
+    service_pings();
+    if (stop && *stop) break;
+    if (now_ms() > connect_deadline) break;
+  }
+  uint64_t peak = connacked;
+  // hold: idle herd honoring staggered keepalives
+  uint64_t hold_end = now_ms() + hold_ms;
+  while ((stop == nullptr || !*stop) && now_ms() < hold_end) {
+    pump(20);
+    service_pings();
+  }
+  for (auto& c : conns)
+    if (c.fd >= 0) close(c.fd);
+  close(ep);
+  std::sort(rtts.begin(), rtts.end());
+  auto pct = [&](double q) -> uint64_t {
+    if (rtts.empty()) return 0;
+    size_t k = static_cast<size_t>(q * (rtts.size() - 1));
+    return rtts[k];
+  };
+  out[0] = peak;
+  out[1] = errors;
+  out[2] = pings;
+  out[3] = pct(0.50);
+  out[4] = pct(0.99);
+  out[5] = rtts.empty() ? 0 : rtts.back();
+  out[6] = NowNs() - t_start;
+  out[7] = closes;
+  return 0;
+}
+
 }  // extern "C"

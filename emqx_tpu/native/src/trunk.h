@@ -231,6 +231,11 @@ struct Peer {
   bool have_prev = false;
   uint64_t next_seq = 1;
   std::deque<Unacked> unacked;
+  // ack-watchdog wheel handle (round 16): the per-poll TrunkAckScan
+  // sweep moved onto the host's timer wheel — armed when the ring
+  // front gains its watchdog reference (first unacked entry, replay
+  // re-stamp), re-armed from the fire against the live front
+  uint64_t tm_ack = 0;
 };
 
 }  // namespace trunk

@@ -395,6 +395,13 @@ class WsDecoder {
     return WsStatus::kOk;
   }
 
+  // Decoder sits at a frame AND message boundary — the park plane's
+  // hibernation precondition for WS conns (the decoder is dropped and
+  // rebuilt at inflation, so mid-frame state must not exist).
+ public:
+  bool idle() const { return phase_ == Phase::kB0 && !in_msg_; }
+
+ private:
   enum class Phase { kB0, kB1, kExtLen, kMask, kPayload };
   bool require_mask_;
   Phase phase_ = Phase::kB0;

@@ -94,8 +94,14 @@ NATIVE = [
     # the first degradation ever happens.
     "messages.ledger.ring_full", "messages.ledger.trunk_punt",
     "messages.ledger.shed", "messages.ledger.fault",
+    "messages.ledger.accept_shed",
     "messages.ledger.device_failover",
     "messages.ledger.store_degraded",
+    # conn-scale plane (round 16): hibernation + accept-storm shedding.
+    # Cumulative event counters folded from the host's stat slots by
+    # native_server._merge_fast_metrics — fixed so all three render at
+    # zero and ride the $SYS metrics heartbeat before the first park.
+    "conns.parked", "conns.inflated", "conns.shed",
 ]
 # faultline (round 15): one fixed slot per fault-injection site, so
 # every faults.<site> counter renders at zero in prometheus/$SYS before
@@ -247,7 +253,7 @@ class LatencyHistogram:
 # (test_stats_lint pins the pair; the C++ LedgerReason enum is a prefix:
 # "fault" is a faultline injection firing, round 15)
 LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
-                  "device_failover", "store_degraded")
+                  "accept_shed", "device_failover", "store_degraded")
 
 
 class DegradationLedger:

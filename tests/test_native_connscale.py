@@ -1,0 +1,524 @@
+"""Conn-scale plane (round 16): timer wheel, parked-conn hibernation,
+accept-storm shedding.
+
+The reference broker's headline is 100M+ connections per cluster; it
+gets there by hibernating idle connection processes and waking them on
+traffic. Our analogue (native/src/wheel.h + park.h + host.cc):
+
+- a hierarchical timer wheel per shard replaces every per-cycle O(N)
+  deadline sweep (keepalive, SN qos1 retransmit, trunk ack watchdog)
+  with O(expired) cascades — pinned here against a brute-force oracle;
+- idle conns hibernate into a slab-allocated parked record a couple
+  hundred bytes wide (the 20KB ack-bitmap AckState collapses to a
+  sparse summary) and re-inflate on the FIRST BYTE via the epoll
+  wakeup, before any fast-path work — a mid-flight qos1 window
+  survives the round trip intact;
+- keepalive PINGREQs are answered from the parked record without
+  inflation, so an idle-but-pinging herd stays hibernated;
+- accept storms hit a governor rung BEFORE any conn side effect:
+  backlog pressure defers to the kernel backlog, a memory-budget
+  breach sheds close-with-ledger (messages.ledger.accept_shed).
+"""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from emqx_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable")
+
+from emqx_tpu.app import BrokerApp            # noqa: E402
+from emqx_tpu.broker.native_server import NativeBrokerServer  # noqa: E402
+
+CONNECT_VH = b"\x00\x04MQTT\x04\x02\x00\x3c"
+
+
+def _connect(port, cid: bytes):
+    s = socket.create_connection(("127.0.0.1", port))
+    vh = CONNECT_VH + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+
+def _pub_frame(topic: bytes, payload: bytes, qos=0, pid=0):
+    vh = struct.pack(">H", len(topic)) + topic
+    if qos:
+        vh += struct.pack(">H", pid)
+    body = vh + payload
+    return bytes([0x30 | (qos << 1)]) + bytes([len(body)]) + body
+
+
+def _pump(host, events=None, ms=20):
+    for kind, cid, payload in host.poll(ms):
+        if events is not None:
+            events.append((kind, cid, payload))
+
+
+def _pump_until(host, cond, timeout=5.0, events=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        _pump(host, events)
+        if cond():
+            return True
+    return False
+
+
+def _open_fast_conn(host, port, cid: bytes, keepalive_ms=60000):
+    """Connect a raw socket, CONNACK it, enable the fast path + native
+    keepalive. Returns (socket, conn_id)."""
+    s = _connect(port, cid)
+    got = {}
+
+    def see():
+        for kind, c, payload in host.poll(20):
+            if kind == native.EV_OPEN:
+                got["open"] = c
+            elif kind == native.EV_FRAME:
+                got["frame"] = True
+        return "open" in got and "frame" in got
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 5 and not see():
+        pass
+    assert "frame" in got, "CONNECT never surfaced"
+    conn = got["open"]
+    host.send(conn, b"\x20\x02\x00\x00")
+    _pump(host)
+    s.settimeout(5)
+    assert s.recv(4) == b"\x20\x02\x00\x00"
+    host.enable_fast(conn, 4)
+    if keepalive_ms:
+        host.set_keepalive(conn, keepalive_ms)
+    _pump(host)
+    return s, conn
+
+
+# -- the wheel vs a brute-force oracle ---------------------------------------
+
+
+@pytest.mark.parametrize("seed", [7, 1234, 0xDEADBEEF])
+def test_wheel_matches_brute_force_oracle(seed):
+    """10k+ timers through a seeded arm/cancel/advance script: every
+    Advance's fired set must equal the brute-force oracle's EXACTLY —
+    {armed keys whose deadline, rounded up to the 16ms tick, passed the
+    advance clock's tick}. This pins never-early (a deadline fires only
+    once its tick is reached), never-lost (the final drain flushes
+    everything), and cascade correctness across all wheel levels (the
+    script jumps up to 30s per advance, crossing level-1/2 windows)."""
+    events = native.wheel_selftest(seed, 30000)
+    armed: dict = {}
+    arms = cancels = fired_total = 0
+    max_live = 0
+    for rec in events:
+        if rec[0] == "arm":
+            armed[rec[1]] = rec[2]
+            arms += 1
+            max_live = max(max_live, len(armed))
+        elif rec[0] == "cancel":
+            assert rec[1] in armed, "script cancelled a dead timer"
+            del armed[rec[1]]
+            cancels += 1
+        else:
+            _, now, fired = rec
+            cur_tick = now >> 4
+            due = {k for k, d in armed.items()
+                   if ((d + 15) >> 4) <= cur_tick}
+            got = set(fired)
+            assert got == due, (
+                f"advance to {now}: missing {sorted(due - got)[:5]} "
+                f"extra {sorted(got - due)[:5]}")
+            assert len(fired) == len(got), "duplicate fire in one batch"
+            for k in fired:
+                del armed[k]
+            fired_total += len(fired)
+    assert arms >= 10000, arms          # the 10k-timer bar
+    assert fired_total == arms - cancels
+    assert not armed, "final drain left timers armed"
+
+
+# -- hibernation: park -> first byte -> inflate ------------------------------
+
+
+def test_park_first_byte_reinflate_qos1_window_intact():
+    """A subscriber with a MID-FLIGHT qos1 delivery (unacked pid in the
+    native window) hibernates; its PUBACK — the first byte after the
+    park — re-inflates the conn and lands on the right window slot,
+    and the pid allocator resumes where it left off."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=250)
+    sub_s, sub = _open_fast_conn(host, host.port, b"pksub")
+    pub_s, pub = _open_fast_conn(host, host.port, b"pkpub")
+    host.sub_add(sub, "pk/w", qos=1)
+    host.permit(pub, "pk/w")
+    _pump(host)
+    pub_s.sendall(_pub_frame(b"pk/w", b"m0", qos=1, pid=7))
+    # subscriber receives the delivery with a NATIVE pid (>= 32768)
+    buf = b""
+    t0 = time.monotonic()
+    while len(buf) < 12 and time.monotonic() - t0 < 5:
+        _pump(host)
+        try:
+            sub_s.settimeout(0.1)
+            buf += sub_s.recv(64)
+        except socket.timeout:
+            pass
+    assert buf[:1] == b"\x32", buf      # qos1 PUBLISH
+    pid1 = struct.unpack(">H", buf[8:10])[0]
+    assert pid1 == 32768
+    st = host.stats()
+    assert st["fast_in"] == 1 and st["qos1_in"] == 1
+    # both conns idle past the park horizon WITH the window open
+    assert _pump_until(host,
+                       lambda: host.conn_counts()["parked"] == 2, 5)
+    # the first byte: the unacked delivery's PUBACK
+    sub_s.sendall(b"\x40\x02" + struct.pack(">H", pid1))
+    assert _pump_until(host,
+                       lambda: host.stats()["native_acks"] == 1, 5)
+    cc = host.conn_counts()
+    assert cc["resident"] >= 1          # the subscriber woke
+    assert host.stats()["conns_inflated"] >= 1
+    # window intact: the next delivery continues the pid sequence
+    host.permit(pub, "pk/w")            # permits re-earn after a park
+    _pump(host)
+    pub_s.sendall(_pub_frame(b"pk/w", b"m1", qos=1, pid=8))
+    buf2 = b""
+    t0 = time.monotonic()
+    while len(buf2) < 12 and time.monotonic() - t0 < 5:
+        _pump(host)
+        try:
+            sub_s.settimeout(0.1)
+            buf2 += sub_s.recv(64)
+        except socket.timeout:
+            pass
+    pid2 = struct.unpack(">H", buf2[8:10])[0]
+    assert pid2 == 32769, "pid allocator lost its place across the park"
+    host.destroy()
+
+
+def test_parked_ping_answers_without_inflation():
+    """Keepalive PINGREQs on a hibernating conn are answered from the
+    parked record: the herd stays parked through its keepalive
+    schedule (parked_pings counts them; conns_inflated stays 0)."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=200)
+    s, conn = _open_fast_conn(host, host.port, b"pping")
+    assert _pump_until(host,
+                       lambda: host.conn_counts()["parked"] == 1, 5)
+    for _ in range(3):
+        s.sendall(b"\xc0\x00")
+        got = b""
+        t0 = time.monotonic()
+        while len(got) < 2 and time.monotonic() - t0 < 3:
+            _pump(host)
+            try:
+                s.settimeout(0.1)
+                got += s.recv(2 - len(got))
+            except socket.timeout:
+                pass
+        assert got == b"\xd0\x00"
+    st = host.stats()
+    cc = host.conn_counts()
+    assert cc["parked"] == 1, "a ping inflated the conn"
+    assert st["parked_pings"] == 3
+    assert st["conns_inflated"] == 0
+    host.destroy()
+
+
+def test_delivery_to_parked_conn_inflates():
+    """A publish matching a hibernating subscriber re-inflates it on
+    the delivery path (FindConnInflate) — hibernation is invisible to
+    the fan-out contract."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=200)
+    sub_s, sub = _open_fast_conn(host, host.port, b"dsub")
+    host.sub_add(sub, "d/t", qos=0)
+    _pump(host)
+    assert _pump_until(host,
+                       lambda: host.conn_counts()["parked"] == 1, 5)
+    pub_s, pub = _open_fast_conn(host, host.port, b"dpub")
+    host.permit(pub, "d/t")
+    _pump(host)
+    pub_s.sendall(_pub_frame(b"d/t", b"hello"))
+    got = b""
+    t0 = time.monotonic()
+    while len(got) < 12 and time.monotonic() - t0 < 5:
+        _pump(host)
+        try:
+            sub_s.settimeout(0.1)
+            got += sub_s.recv(64)
+        except socket.timeout:
+            pass
+    assert b"hello" in got
+    assert host.stats()["conns_inflated"] >= 1
+    host.destroy()
+
+
+# -- keepalive on the wheel --------------------------------------------------
+
+
+def test_keepalive_wheel_closes_idle_and_honors_traffic():
+    """The wheel's keepalive fire closes a silent conn with the same
+    "keepalive_timeout" reason the Python sweep used — and a conn that
+    keeps pinging (even while PARKED) never trips it."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=150)
+    quiet_s, quiet = _open_fast_conn(host, host.port, b"kaq",
+                                     keepalive_ms=400)
+    live_s, live = _open_fast_conn(host, host.port, b"kal",
+                                   keepalive_ms=400)
+    closed = []
+
+    def see():
+        for kind, c, payload in host.poll(20):
+            if kind == native.EV_CLOSED:
+                closed.append((c, payload))
+        return any(c == quiet for c, _ in closed)
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 3.0:
+        if see():
+            break
+        if time.monotonic() - t0 < 2.5:
+            try:
+                live_s.sendall(b"\xc0\x00")   # live conn keeps pinging
+            except OSError:
+                pass
+        time.sleep(0.05)
+    reasons = {c: p for c, p in closed}
+    assert quiet in reasons, "idle conn never timed out on the wheel"
+    assert reasons[quiet] == b"keepalive_timeout"
+    assert live not in reasons, "pinging conn was killed"
+    host.destroy()
+
+
+# -- accept-storm governance -------------------------------------------------
+
+
+def test_shed_ladder_order_and_ledger():
+    """Memory-budget breach sheds the accept BEFORE any side effect —
+    no conn id, no OPEN event — and every shed is visible as the
+    conns_shed stat + a messages.ledger.accept_shed entry (kind-12)."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    # budget sized for ONE resident-conn estimate: the first accept
+    # fits, the second crosses the budget and sheds
+    host.set_park(True, park_after_ms=0, accept_burst=0,
+                  mem_budget_bytes=1500)
+    events = []
+    s1 = socket.create_connection(("127.0.0.1", host.port))
+    assert _pump_until(
+        host, lambda: any(e[0] == native.EV_OPEN for e in events), 5,
+        events=events)
+    s2 = socket.create_connection(("127.0.0.1", host.port))
+    ledger = []
+
+    def cond():
+        return host.stats()["conns_shed"] >= 1 and ledger
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 5 and not cond():
+        for kind, cid, payload in host.poll(20):
+            events.append((kind, cid, payload))
+            if kind == native.EV_SPANS:
+                ledger += [r for r in native.parse_spans(payload)
+                           if r[0] == "ledger"]
+    assert host.stats()["conns_shed"] >= 1
+    opens = [e for e in events if e[0] == native.EV_OPEN]
+    assert len(opens) == 1, (
+        "a shed accept leaked an OPEN event — side effect before admit")
+    want = native.LEDGER_REASONS.index("accept_shed") + 1
+    assert any(r[1] == want for r in ledger), ledger
+    # the shed socket is really dead (closed, not silently parked)
+    s2.settimeout(3)
+    assert s2.recv(16) == b""
+    s1.close()
+    host.destroy()
+
+
+def test_accept_burst_defers_without_shedding():
+    """Backlog pressure (the per-cycle accept burst cap) DEFERS: every
+    conn still connects — across later cycles — and nothing sheds."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=0, accept_burst=2)
+    socks = [socket.create_connection(("127.0.0.1", host.port))
+             for _ in range(9)]
+    opens = []
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 5 and len(opens) < 9:
+        for kind, cid, payload in host.poll(20):
+            if kind == native.EV_OPEN:
+                opens.append(cid)
+    assert len(opens) == 9, "deferred accepts were lost"
+    assert host.stats()["conns_shed"] == 0
+    for s in socks:
+        s.close()
+    host.destroy()
+
+
+# -- the memory diet ---------------------------------------------------------
+
+
+def test_parked_record_memory_bound():
+    """The parked record stays inside its diet: a few hundred bytes per
+    conn INCLUDING the subscription bookkeeping — against the ~20KB a
+    resident conn's AckState alone could hold."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=100)
+    host.synth_conns(1000, keepalive_ms=600000, sub_every=1,
+                     topic_prefix="diet")
+    assert _pump_until(
+        host, lambda: host.conn_counts()["parked"] >= 1000, 10)
+    cc = host.conn_counts()
+    per_conn = cc["parked_bytes"] / cc["parked"]
+    assert per_conn <= 512, f"parked record grew to {per_conn:.0f}B/conn"
+    assert cc["timers_armed"] >= 1000   # keepalives stay armed, parked
+    host.destroy()
+
+
+def test_housekeep_cost_is_o_expired_not_o_parked():
+    """50k parked conns with armed (far-future) keepalives must not
+    make the idle poll cycle O(N): the wheel pays O(expired + cascade)
+    per cycle, so 20 idle cycles over a 50k-parked herd complete fast
+    even on the 1-core CI box (the old per-conn sweep walked every
+    conn every housekeep)."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=100)
+    for _ in range(5):
+        host.synth_conns(10000, keepalive_ms=3_600_000)
+    assert _pump_until(
+        host, lambda: host.conn_counts()["parked"] >= 50000, 20)
+    t0 = time.monotonic()
+    for _ in range(20):
+        list(host.poll(0))
+    dt = time.monotonic() - t0
+    assert dt < 2.0, f"20 idle cycles over 50k parked took {dt:.2f}s"
+    host.destroy()
+
+
+# -- the full server ---------------------------------------------------------
+
+
+def test_server_parks_conns_and_housekeep_scan_drains():
+    """End-to-end through NativeBrokerServer: a real client hibernates
+    after the park horizon, publishes still reach it (inflate on
+    delivery), the housekeep scan set drains to empty once sessions
+    are idle (the O(N) Python sweep is gone), and the conns.* fixed
+    metric slots fold the events."""
+    import asyncio
+
+    from emqx_tpu.mqtt.client import MqttClient
+
+    server = NativeBrokerServer(port=0, app=BrokerApp(),
+                                park_after_ms=300)
+    server.start()
+
+    async def main():
+        sub = MqttClient(port=server.port, clientid="scale-sub")
+        await sub.connect()
+        await sub.subscribe("sc/t", qos=0)
+        pub = MqttClient(port=server.port, clientid="scale-pub")
+        await pub.connect()
+        await pub.publish("sc/t", b"before", qos=0)
+        m = await sub.recv(timeout=5)
+        assert m.payload == b"before"
+        # idle past the horizon: both conns hibernate
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 5:
+            if server.fast_stats()["conns_parked"] >= 2:
+                break
+            await asyncio.sleep(0.1)
+        assert server.fast_stats()["conns_parked"] >= 2
+        # the housekeep scan set drained (sessions hold no timer work)
+        server._housekeep_conns(0)
+        with server._scan_lock:
+            assert not server._scan_conns, list(server._scan_conns)
+        # a publish wakes the publisher AND the parked subscriber
+        await pub.publish("sc/t", b"after", qos=0)
+        m = await sub.recv(timeout=5)
+        assert m.payload == b"after"
+        assert server.fast_stats()["conns_inflated"] >= 1
+        # the fixed metric slots fold the events (render-at-zero is
+        # pinned in test_stats_lint; here they must count)
+        server._merge_fast_metrics()
+        assert server.broker.metrics.val("conns.parked") >= 2
+        assert server.broker.metrics.val("conns.inflated") >= 1
+        await sub.close()
+        await pub.close()
+
+    asyncio.run(main())
+    server.stop()
+
+
+def test_server_native_keepalive_closes_dead_conn():
+    """A conn that negotiates keepalive=1 and goes silent is closed by
+    the C++ wheel (no Python sweep involved) and reaped from the
+    server's conn table."""
+    server = NativeBrokerServer(port=0, app=BrokerApp())
+    server.start()
+    s = socket.create_connection(("127.0.0.1", server.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x01" + struct.pack(">H", 4) + b"dead"
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    s.settimeout(5)
+    assert s.recv(4)[:1] == b"\x20"     # CONNACK
+    # keepalive 1s -> native deadline 1500ms; the socket must die
+    t0 = time.monotonic()
+    dead = False
+    while time.monotonic() - t0 < 6:
+        try:
+            if s.recv(16) == b"":
+                dead = True
+                break
+        except socket.timeout:
+            break
+    assert dead, "idle conn outlived its keepalive on the wheel"
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 3 and server.conns:
+        time.sleep(0.1)
+    assert not server.conns
+    server.stop()
+
+
+# -- the storm soak (slow) ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_connscale_200k_storm_soak():
+    """200k-conn storm at CI scale (the bench drives 1M on the box):
+    a synthetic herd floods in through the real admission + park
+    machinery, hibernates whole, survives an inflate/re-park churn
+    wave, and tears down clean — with the parked-record memory bound
+    holding at scale."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    host.set_park(True, park_after_ms=150)
+    n = 200_000
+    for _ in range(20):
+        host.synth_conns(n // 20, keepalive_ms=3_600_000, sub_every=10,
+                         topic_prefix="soak")
+        _pump(host, ms=0)
+    assert _pump_until(
+        host, lambda: host.conn_counts()["parked"] >= n, 60)
+    cc = host.conn_counts()
+    assert cc["parked_bytes"] / cc["parked"] <= 512
+    # churn wave: cross-thread sends inflate a sample of the herd
+    # (synthetic egress is discarded; the park machinery is real)
+    sample = range(1, n, 997)
+    for cid in sample:
+        host.send(cid, b"\xd0\x00")
+    assert _pump_until(
+        host,
+        lambda: host.stats()["conns_inflated"] >= len(list(sample)) // 2,
+        30)
+    # they re-park
+    assert _pump_until(
+        host, lambda: host.conn_counts()["parked"] >= n, 60)
+    # teardown a slab of the herd while parked
+    for cid in range(1, 5001):
+        host.close_conn(cid)
+    assert _pump_until(
+        host,
+        lambda: host.conn_counts()["parked"] + host.conn_counts()[
+            "resident"] <= n - 4000, 30)
+    host.destroy()

@@ -321,6 +321,94 @@ def test_housekeep_clock_skew_ages_idle_conns():
         host.destroy()
 
 
+# -- conn-scale plane seams (round 16) ----------------------------------------
+
+
+def test_conn_accept_fault_during_park_storm_ledger_visible():
+    """park-during-storm: with a hibernating herd resident, an armed
+    conn_accept fault sheds exactly the counted storm connects while
+    the PARKED conns stay untouched — and every fire is ledger-visible
+    (kind-12 reason "fault") next to the faults.conn_accept counter."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        host.set_park(True, park_after_ms=100)
+        host.synth_conns(500, keepalive_ms=600_000)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            list(host.poll(20))
+            if host.conn_counts()["parked"] >= 500:
+                break
+        assert host.conn_counts()["parked"] >= 500
+        host.fault_arm("conn_accept", "errno", n_or_prob=3)
+        storm = [socket.create_connection(("127.0.0.1", host.port))
+                 for _ in range(6)]
+        opened, ledger = [], []
+        t0 = time.time()
+        while time.time() - t0 < 5 and (
+                len(opened) < 3 or host.fault_fired("conn_accept") < 3
+                or not ledger):
+            for kind, conn, payload in host.poll(20):
+                if kind == native.EV_OPEN:
+                    opened.append(conn)
+                elif kind == native.EV_SPANS:
+                    ledger += [r for r in native.parse_spans(payload)
+                               if r[0] == "ledger"]
+        assert host.fault_fired("conn_accept") == 3
+        assert len(opened) == 3, opened      # the other 3 were shed
+        fault_reason = native.LEDGER_REASONS.index("fault") + 1
+        assert any(r[1] == fault_reason for r in ledger), ledger
+        # the hibernating herd rode out the storm untouched
+        assert host.conn_counts()["parked"] >= 500
+        for sk in storm:
+            sk.close()
+    finally:
+        host.destroy()
+
+
+def test_clock_skew_reaps_parked_conns_wake_still_inflates():
+    """wake-during-skew: housekeep_clock skew feeds the WHEEL's
+    keepalive fires too — a hibernating conn is judged against the
+    future clock and reaped from its parked record (no inflation on
+    the way to the grave), while a first byte arriving under skew
+    still re-inflates normally; every fire ledger-visible."""
+    host = native.NativeHost(port=0, max_size=1 << 16)
+    try:
+        host.set_park(True, park_after_ms=120)
+        s1, c1 = _raw_conn(host, b"skp1")
+        s2, c2 = _raw_conn(host, b"skp2")
+        host.set_keepalive(c1, 900)
+        host.set_keepalive(c2, 900)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            list(host.poll(20))
+            if host.conn_counts()["parked"] == 2:
+                break
+        assert host.conn_counts()["parked"] == 2
+        host.fault_arm("housekeep_clock", "skew", n_or_prob=70_000)
+        # the wake: a first byte under skew re-inflates c2 before the
+        # skewed keepalive reaps it
+        s2.sendall(b"\xc0\x00\xc0")   # pings + a torn byte => inflate
+        closed, ledger = {}, []
+        t0 = time.time()
+        while time.time() - t0 < 6 and len(closed) < 2:
+            for kind, conn, payload in host.poll(20):
+                if kind == native.EV_CLOSED:
+                    closed[conn] = payload
+                elif kind == native.EV_SPANS:
+                    ledger += [r for r in native.parse_spans(payload)
+                               if r[0] == "ledger"]
+        assert closed.get(c1) == b"keepalive_timeout", closed
+        assert closed.get(c2) == b"keepalive_timeout", closed
+        assert host.stats()["conns_inflated"] >= 1   # the wake worked
+        assert host.fault_fired("housekeep_clock") >= 1
+        fault_reason = native.LEDGER_REASONS.index("fault") + 1
+        assert any(r[1] == fault_reason for r in ledger), ledger
+        s1.close()
+        s2.close()
+    finally:
+        host.destroy()
+
+
 # -- trunk link sites ---------------------------------------------------------
 
 

@@ -1399,10 +1399,106 @@ print("SANITIZED-RUN-OK", a["faults_injected"])
 """
 
 
+# Round 16 conn-scale plane: park/inflate churn + connect/teardown
+# storms racing the poll thread. One raw host with an aggressive park
+# horizon; real socket conns connect, idle into hibernation, and wake
+# (first byte / cross-thread send / delivery) while control threads
+# churn set_park / set_keepalive / sub_add and a synthetic herd parks
+# and re-inflates through deliveries — the wheel (keepalive + park
+# timers), the parked-record slab, and the accept governor all run
+# under ASan+TSan.
+DRIVER_PARK = r"""
+import socket, struct, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+
+host = native.NativeHost(port=0, max_size=1 << 16)
+host.set_park(True, park_after_ms=60, accept_burst=64)
+host.synth_conns(2000, keepalive_ms=600000, sub_every=4,
+                 topic_prefix="synth")
+
+def connect(cid):
+    s = socket.create_connection(("127.0.0.1", host.port))
+    vh = b"\x00\x04MQTT\x04\x02\x00\x3c" + struct.pack(">H", len(cid)) + cid
+    s.sendall(bytes([0x10, len(vh)]) + vh)
+    return s
+
+stop = threading.Event()
+conns = []
+lock = threading.Lock()
+
+def churner(salt):
+    # connect/teardown storm: half the conns idle long enough to park,
+    # then either close or send (a parked first byte -> inflate)
+    for round_ in range(8):
+        if stop.is_set():
+            return
+        socks = []
+        try:
+            socks = [connect(b"pk%%d-%%d" %% (salt, round_ * 8 + i))
+                     for i in range(8)]
+        except OSError:
+            pass
+        time.sleep(0.12)  # beyond the park horizon
+        for j, s in enumerate(socks):
+            try:
+                if j %% 2:
+                    s.sendall(b"\xc0\x00")   # parked ping fast path
+                    time.sleep(0.005)
+                s.close()
+            except OSError:
+                pass
+
+def controller():
+    # control ops racing the poll thread: keepalive re-arms, park
+    # toggles, table churn, cross-thread sends to (parked) conns
+    n = 0
+    while not stop.is_set():
+        n += 1
+        with lock:
+            targets = list(conns)[-16:]
+        for c in targets:
+            host.set_keepalive(c, 5000 + (n %% 7) * 1000)
+            host.send(c, b"\xd0\x00")
+        host.set_park(True, park_after_ms=60 + (n %% 3) * 20,
+                      accept_burst=64)
+        host.sub_add((n %% 8) + 1, "churn/%%d" %% (n %% 32), qos=1)
+        host.sub_del((n %% 8) + 1, "churn/%%d" %% ((n + 16) %% 32))
+        time.sleep(0.01)
+
+threads = [threading.Thread(target=churner, args=(i,)) for i in range(3)]
+threads.append(threading.Thread(target=controller))
+for t in threads: t.start()
+
+deadline = time.time() + 9
+parked_seen = 0
+while time.time() < deadline:
+    for kind, cid, payload in host.poll(20):
+        if kind == native.EV_OPEN:
+            with lock:
+                conns.append(cid)
+            host.send(cid, b"\x20\x02\x00\x00")
+            host.enable_fast(cid, 4)
+    st = host.stats()
+    parked_seen = max(parked_seen, st["conns_parked"])
+stop.set()
+for t in threads: t.join()
+for _ in range(10):
+    list(host.poll(10))
+cc = host.conn_counts()
+st = host.stats()
+assert parked_seen > 0, "nothing ever parked"
+assert st["conns_inflated"] > 0, "nothing ever inflated"
+host.destroy()
+print("SANITIZED-RUN-OK", parked_seen, st["conns_inflated"],
+      st["parked_pings"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
                                     "telemetry", "trunk", "durable", "sn",
-                                    "shards", "tracing", "fault"])
+                                    "shards", "tracing", "fault", "park"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -1422,7 +1518,7 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
            "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
            "durable": DRIVER_DURABLE, "sn": DRIVER_SN,
            "shards": DRIVER_SHARDS, "tracing": DRIVER_TRACING,
-           "fault": DRIVER_FAULT}[driver]
+           "fault": DRIVER_FAULT, "park": DRIVER_PARK}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

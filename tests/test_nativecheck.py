@@ -449,11 +449,13 @@ SAN_TEST = os.path.join(REPO, "tests", "test_native_sanitizers.py")
 SANCOV_HEADERS = {
     "fault.h": ("fault", "fault_arm"),       # arm/disarm vs poll races
     "frame.h": ("host", "NativeHost"),       # byte-dribbled framing
+    "park.h": ("park", "set_park"),          # park/inflate + shed churn
     "router.h": ("fastpath", "sub_add"),     # match-table churn
     "ring.h": ("shards", "NativeShardGroup"),
     "sn.h": ("sn", "listen_sn"),
     "store.h": ("durable", "NativeStore"),
     "trunk.h": ("trunk", "trunk_connect"),
+    "wheel.h": ("park", "set_keepalive"),    # keepalive/park timer churn
     "ws.h": ("ws", "listen_ws"),
 }
 SANCOV_WAIVED: set = set()   # e.g. {"coap.h"} until its driver lands

@@ -297,6 +297,52 @@ def test_ledger_fixed_metric_slots_render_at_zero():
     assert b'"reason": "shed"' in seen["$SYS/brokers/n1/ledger/last"]
 
 
+def test_connscale_slots_ledger_and_render_at_zero():
+    """Conn-scale plane (ISSUE 12): the hibernation/shed stat slots
+    stay exported by name, accept_shed is a ledger reason on BOTH
+    planes in the C++-prefix position, and the conns.* fixed metric
+    slots render at zero in prometheus and ride the $SYS metrics
+    heartbeat before the first park ever happens."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import (
+        LEDGER_REASONS as M_REASONS, DegradationLedger, Metrics)
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    for name in ("conns_parked", "conns_inflated", "conns_shed",
+                 "parked_pings"):
+        assert name in native.STAT_NAMES, name
+    src = _src()
+    assert "kStConnsParked" in src and "kStConnsShed" in src
+    # accept_shed sits inside the C++ LedgerReason prefix (the enum
+    # parity test above checks order; presence is pinned by name here)
+    assert "accept_shed" in native.LEDGER_REASONS
+    assert "kLrAcceptShed" in src
+    assert tuple(M_REASONS) == tuple(native.LEDGER_REASONS)
+
+    m = Metrics()
+    for slot in ("conns.parked", "conns.inflated", "conns.shed",
+                 "messages.ledger.accept_shed"):
+        assert m.val(slot) == 0
+    out = prometheus.render(metrics=m)
+    for tok in ("emqx_conns_parked", "emqx_conns_inflated",
+                "emqx_conns_shed", "emqx_messages_ledger_accept_shed"):
+        assert tok in out, tok
+    led = DegradationLedger(m)
+    led.record("accept_shed", 2, aux=7)
+    assert m.val("messages.ledger.accept_shed") == 2
+    m.inc("conns.parked", 5)
+    seen = {}
+    hb = SysHeartbeat("n1", lambda msg: seen.__setitem__(
+        msg.topic, msg.payload), metrics=m, ledger=led)
+    hb.publish_metrics()
+    assert seen["$SYS/brokers/n1/metrics/conns.parked"] == b"5"
+    assert seen["$SYS/brokers/n1/metrics/conns.inflated"] == b"0"
+    assert seen[
+        "$SYS/brokers/n1/metrics/messages.ledger.accept_shed"] == b"2"
+    hb.publish_ledger()
+    assert seen["$SYS/brokers/n1/ledger/accept_shed"] == b"2"
+
+
 def test_prometheus_per_shard_label_set():
     """ISSUE 8 satellite: emqx_native_* gauges AND the stage histograms
     gain a ``shard`` label. The label set is pinned here: every

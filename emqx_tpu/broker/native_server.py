@@ -2692,10 +2692,16 @@ class NativeBrokerServer:
                                 self._trace_log_ids.popitem(last=False)
                     cid = self._trace_log_ids.get(tid)
                     if cid is not None and self.app is not None:
+                        # only deliver_write defines bit 63 (the span
+                        # cap's truncation marker) — other stages' aux
+                        # passes through untouched
+                        trunc = ""
+                        if stage == "deliver_write" and aux >> 63:
+                            trunc, aux = " truncated", aux & ~(1 << 63)
                         self.app.trace.log_for_client(
                             cid, "SPAN",
                             f"trace={tid:016x} {stage} shard={shard} "
-                            f"aux={aux} t_ns={t_ns}")
+                            f"aux={aux} t_ns={t_ns}{trunc}")
                     # exemplars: hang the trace id off the stage
                     # histograms its timeline measures
                     if stage == "route":
@@ -2732,10 +2738,20 @@ class NativeBrokerServer:
         """Assembled recent traces, JSON-shaped (the mgmt surface)."""
         out = []
         for tid, spans in self.spans.recent(limit):
+            # deliver_write aux bit 63 = the 8-per-publish span cap
+            # clipped this fan-out (host.cc kSpanTruncBit): surface it
+            # so a stitched timeline never silently reads as the full
+            # audience. Only deliver_write defines the bit — other
+            # stages' aux passes through unmasked (ack already packs
+            # qos into bits 60-61).
             out.append({
                 "trace_id": f"{tid:016x}",
                 "spans": [{"t_ns": t, "stage": s, "shard": sh,
-                           "node": n, "aux": a}
+                           "node": n,
+                           "aux": (a & ~(1 << 63)
+                                   if s == "deliver_write" else a),
+                           "truncated": (s == "deliver_write"
+                                         and bool(a >> 63))}
                           for t, s, sh, n, a in spans],
             })
         return out

@@ -91,13 +91,23 @@ enum Mode {
 };
 
 struct SiteState {
-  std::atomic<uint32_t> mode{0};      // 0 = disarmed: THE hot branch
-  std::atomic<uint64_t> key{0};       // 0 = any object
-  std::atomic<int64_t> remaining{-1};  // -1 = until disarmed
-  std::atomic<uint32_t> prob{0};      // 0 = always; else 2^-32 units
-  std::atomic<uint64_t> prng{0};      // xorshift64 state (seeded)
-  std::atomic<int64_t> param{0};      // raw n_or_prob (skew ms)
-  std::atomic<uint64_t> fired{0};     // faults injected at this site
+  // 0 = disarmed: THE hot branch. Arm publishes the schedule fields
+  // below with its release store; Fire's acquire load pairs with it
+  // (armed()'s relaxed peek only gates whether to pay Fire at all).
+  // @atomic(acq_rel: Arm release-publishes the schedule fields; Fire acquire-loads before reading them)
+  std::atomic<uint32_t> mode{0};
+  // @atomic(relaxed: written before mode's release publish, read after Fire's acquire) 0 = any object
+  std::atomic<uint64_t> key{0};
+  // @atomic(relaxed: single consumer per site in practice; -1 = until disarmed) countdown
+  std::atomic<int64_t> remaining{-1};
+  // @atomic(relaxed: published by mode, read-only after arm) 0 = always; else 2^-32 units
+  std::atomic<uint32_t> prob{0};
+  // @atomic(relaxed: xorshift64 state, single consumer per site keeps replay deterministic)
+  std::atomic<uint64_t> prng{0};
+  // @atomic(relaxed: raw n_or_prob magnitude, read by Param for skew ms)
+  std::atomic<int64_t> param{0};
+  // @atomic(relaxed: monotone fire counter, cross-thread gauge read)
+  std::atomic<uint64_t> fired{0};
 };
 
 class Injector {

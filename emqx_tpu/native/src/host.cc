@@ -360,6 +360,8 @@ enum SpanStage : uint8_t {
   kSpanStoreAppend,   // publish joined the durable batch; aux = n toks
   kSpanReplay,        // Python: resume replay re-joined the trace
   kSpanDeliverWrite,  // delivery written to a subscriber; aux = conn
+                      // (bit 63 = truncation marker: the 8-per-publish
+                      // cap clipped this fan-out — timeline is partial)
   kSpanAck,           // subscriber PUBACK/PUBCOMP closed the delivery
   kSpanCount
 };
@@ -378,8 +380,13 @@ enum LedgerReason : uint8_t {
 };
 
 // deliver_write spans per sampled publish are capped: a megafan-out
-// must not turn one sampled message into a span flood
+// must not turn one sampled message into a span flood. When the cap
+// clips a wide fan-out, ONE extra deliver_write span goes out with
+// aux bit 63 set (the truncation bit — conn-id namespaces stop at bit
+// 62) so a stitched timeline reads "first 8 of more", never silently
+// as the full audience (round 17).
 constexpr uint8_t kTraceMaxDeliverSpans = 8;
+constexpr uint64_t kSpanTruncBit = 1ull << 63;
 // Sampled publishes per POLL CYCLE are capped too (the tick still
 // advances, so the 1-in-N ratio stays deterministic; the cap only
 // clips extra picks within one cycle). Under blast a cycle drains
@@ -499,7 +506,7 @@ struct SnConnState {
   // SnRexmitScan sweep moved onto the timer wheel — armed when the
   // first rexmit copy is tracked, parked across announced sleep (the
   // retry clock restarts at wake), re-armed from the fire at the
-  // conn's next retry deadline
+  // conn's next retry deadline; @gen-handle
   uint64_t tm_rexmit = 0;
 };
 
@@ -528,8 +535,9 @@ struct Conn {
   uint64_t last_work_ms = 0;
   uint32_t keepalive_ms = 0;    // effective deadline (1.5x keepalive);
                                 // 0 = no native keepalive enforcement
-  uint64_t tm_keepalive = 0;    // wheel handles (0 = unarmed)
-  uint64_t tm_park = 0;
+  uint64_t tm_keepalive = 0;    // wheel handles (0 = unarmed; the
+                                // park.h twin carries the annotation)
+  uint64_t tm_park = 0;         // @gen-handle
   std::unique_ptr<FlightRec> fr;             // telemetry flight recorder
   std::unique_ptr<AckState> ack;             // elevated-qos window state
   std::unordered_set<std::string> permits;   // publisher-side topic grants
@@ -3027,6 +3035,7 @@ class Host {
   // plane now follows the same discipline. flags: bit0 = payload
   // inline, bits1-2 = qos, bit3 = publisher DUP.
   // @admit-gated — a tap copy is a side effect of an ADMITTED publish
+  // @bounded(tap_buf_)
   void EmitTap(uint64_t publisher, uint8_t qos, bool dup_flag,
                std::string_view topic, std::string_view payload) {
     stats_[kStTaps].fetch_add(1, std::memory_order_relaxed);
@@ -3411,6 +3420,7 @@ class Host {
           std::min(dur_tok_scratch_.size(), g + kDurMaxToksPerEntry));
   }
 
+  // @bounded(dur_buf_)
   void DurableAppendEntry(uint64_t publisher, uint8_t qos,
                           std::string_view topic, std::string_view payload,
                           size_t tok_begin, size_t tok_end) {
@@ -5782,15 +5792,22 @@ class Host {
 
   // One deliver_write span per written delivery of the active sampled
   // publish, capped so a wide fan-out cannot flood the span plane.
+  // The first delivery past the cap emits ONE truncation marker
+  // (aux bit 63) so the clipped timeline declares itself clipped.
   void TraceDeliverNote(uint64_t owner) {
-    if (cur_trace_ && cur_trace_delivers_ < kTraceMaxDeliverSpans) {
+    if (!cur_trace_) return;
+    if (cur_trace_delivers_ < kTraceMaxDeliverSpans) {
       cur_trace_delivers_++;
       SpanNote(kSpanDeliverWrite, owner);
+    } else if (cur_trace_delivers_ == kTraceMaxDeliverSpans) {
+      cur_trace_delivers_++;  // marker fires once per (publish, shard)
+      SpanNote(kSpanDeliverWrite, owner | kSpanTruncBit);
     }
   }
 
   // Whole-sub-record append at the tap bound (the TeleAppend shape —
   // header slot seeded AFTER the flush check).
+  // @bounded(span_buf_)
   void SpanAppend(const char* data, size_t len) {
     size_t cap = TeleCap();
     if (span_buf_.size() > 13 && span_buf_.size() - 13 + len > cap)
@@ -5835,6 +5852,7 @@ class Host {
   // than the caller's whole buffer — the kind-6/7 lesson). The header
   // slot is seeded AFTER the flush check (the round-7 EmitTap bug:
   // a headerless post-flush append gets overwritten by the patch).
+  // @bounded(tele_buf_)
   void TeleAppend(const char* data, size_t len) {
     size_t cap = TeleCap();
     if (tele_buf_.size() > 13 && tele_buf_.size() - 13 + len > cap)
@@ -6141,8 +6159,12 @@ class Host {
   std::vector<uint64_t> ack_dirty_;
   std::string ack_buf_;
   std::vector<uint64_t> dirty_;
+  // @atomic(relaxed: monotone counters; poll thread bumps, gauge reads tear-free but unordered)
   std::atomic<uint64_t> stats_[kStatCount] = {};
-  std::atomic<pthread_t> poll_thread_{};  // enforces ConnIdleMs contract
+  // enforces ConnIdleMs contract
+  // @atomic(acq_rel: poll-thread start release-publishes loop state; misuse checks acquire-load)
+  std::atomic<pthread_t> poll_thread_{};
+  // @atomic(relaxed: warn-once latch, exact count never matters)
   mutable std::atomic<bool> idle_misuse_warned_{false};
   // -- telemetry plane (poll-thread-owned) --------------------------------
   bool telemetry_ = true;        // EMQX_NATIVE_TELEMETRY=0 escape hatch
@@ -6161,7 +6183,8 @@ class Host {
   uint64_t fr_now_ms_ = 0;          // per-cycle flight-recorder stamp
   uint64_t last_hist_flush_ms_ = 0;  // hist-delta emission cadence
   uint32_t cur_hash_ = 0;           // current publish's topic hash
-  std::string tele_buf_;      // kind-8 batch (bytes [0,13) = header slot)
+  // @bounded — kind-8 batch (bytes [0,13) = header slot)
+  std::string tele_buf_;
   std::string tele_scratch_;  // one sub-record under construction
   // -- native distributed tracing (round 13, poll-thread-owned) ------------
   bool tracing_ = true;       // EMQX_NATIVE_TRACING=0 escape hatch
@@ -6173,7 +6196,8 @@ class Host {
   uint64_t cur_trace_ = 0;    // active publish's trace id (0 = unsampled)
   uint8_t cur_trace_delivers_ = 0;  // deliver_write spans emitted so far
   uint32_t fan_xshipped_ = 0;  // shards shipped by the LAST FanOut
-  std::string span_buf_;      // kind-12 batch (bytes [0,13) = header slot)
+  // @bounded — kind-12 batch (bytes [0,13) = header slot)
+  std::string span_buf_;
   // per-cycle degradation-ledger accumulators (one kind-12 sub-2 entry
   // per nonzero reason per cycle)
   uint64_t ledger_cyc_[kLrCount] = {};
@@ -6209,13 +6233,15 @@ class Host {
   // topics whose remaining parked frames must punt (ordering guard
   // after a nondeterministic punt); cleared as their counts drain
   std::unordered_set<std::string> lane_poisoned_;
+  // @atomic(relaxed: backlog gauge; poll thread stores, mgmt reads tear-free but unordered)
   std::atomic<uint64_t> lane_backlog_{0};
   // -- durable-session plane (poll-thread-owned) ---------------------------
   // The host-side message store (store.h): attached by Python BEFORE
   // the poll thread starts (like the listeners). Null = durable plane
   // off; matched kSubDurable entries then degrade to punts.
   store::DurableStore* store_ = nullptr;
-  std::string dur_buf_;            // bytes [0,33) = event+batch header slot
+  // @bounded — bytes [0,33) = event+batch header slot
+  std::string dur_buf_;
   uint32_t dur_n_ = 0;             // entries in dur_buf_
   std::string dur_prev_payload_;   // payload-dedup reference
   bool dur_have_prev_ = false;
@@ -6229,6 +6255,7 @@ class Host {
   std::vector<const SubEntry*> punt_scratch_;
   // batched rule-tap entries awaiting one event; bytes [0,13) are the
   // record header slot FlushTaps patches before moving the buffer out
+  // @bounded
   std::string tap_buf_;
   std::string tap_prev_payload_;  // payload-dedup reference
   bool tap_have_prev_ = false;
@@ -6261,6 +6288,7 @@ class Host {
   wheel::Wheel wheel_{NowMs()};
   park::Slab<park::Parked> park_slab_;
   std::unordered_map<uint64_t, uint32_t> parked_;  // conn id -> slab slot
+  // @atomic(relaxed: parked-memory gauge; poll thread adds/subs, conn_counts reads tear-free but unordered)
   std::atomic<uint64_t> parked_bytes_{0};
   park::AcceptGovernor gov_;
   bool park_enabled_ = true;

@@ -99,7 +99,9 @@ struct Parked {
   uint32_t keepalive_ms = 0;     // effective deadline (1.5x keepalive)
   uint32_t max_inflight = 0;
   uint64_t last_rx_ms = 0;
-  uint64_t tm_keepalive = 0;     // wheel handle — survives hibernation
+  // wheel handle — survives hibernation; @gen-handle: flows only into
+  // generation-checked wheel consumers (a recycled slot must no-op)
+  uint64_t tm_keepalive = 0;
   std::vector<uint32_t> infl;    // sparse in-flight window summary
   std::vector<uint16_t> awrel;   // publisher qos2 awaiting-rel pids
   std::vector<std::string> own_subs;

@@ -81,8 +81,13 @@ class SpscRing {
   }
 
  private:
+  // @published(head_, tail_) — slot data is made visible to the other
+  // side ONLY by the index's release store: every slots_ write/read
+  // must lexically precede the publish in Push/Pop
   std::string slots_[kRingSlots];
+  // @atomic(acq_rel: producer release-publishes filled slots; consumer acquire-loads; own-side reads relaxed)
   alignas(64) std::atomic<size_t> head_{0};
+  // @atomic(acq_rel: consumer release-publishes freed slots; producer acquire-loads; own-side reads relaxed)
   alignas(64) std::atomic<size_t> tail_{0};
 };
 
@@ -113,7 +118,9 @@ struct ShardGroup {
   int n;
   SpscRing rings[kMaxShards][kMaxShards];  // [src][dst]
   int doorbell[kMaxShards];
-  std::atomic<bool> alive[kMaxShards];  // set at join, cleared at ~Host
+  // set at join, cleared at ~Host
+  // @atomic(acq_rel: join release-publishes the shard's readiness; producers acquire-load before pushing; ctor init relaxed)
+  std::atomic<bool> alive[kMaxShards];
 };
 
 }  // namespace ring

@@ -235,6 +235,7 @@ struct Peer {
   // sweep moved onto the host's timer wheel — armed when the ring
   // front gains its watchdog reference (first unacked entry, replay
   // re-stamp), re-armed from the fire against the live front
+  // @gen-handle
   uint64_t tm_ack = 0;
 };
 

@@ -69,6 +69,7 @@ class Wheel {
 
   // O(1) unlink. Generation-checked: a handle whose timer already
   // fired (or was cancelled) is a no-op even if the slot was reused.
+  // @gen-checked
   bool Cancel(uint64_t h) {
     int32_t i = NodeOf(h);
     if (i < 0) return false;
@@ -123,6 +124,8 @@ class Wheel {
     uint8_t kind;
   };
 
+  // @gen-check — the ONE place a raw handle becomes a slot index:
+  // the generation in the handle's high word must match the node's
   int32_t NodeOf(uint64_t h) const {
     if (!h) return -1;
     int32_t i = static_cast<int32_t>(h & 0xFFFFFFFFull) - 1;
@@ -144,6 +147,7 @@ class Wheel {
     return static_cast<int32_t>(pool_.size() - 1);
   }
 
+  // @gen-bump — recycling a slot MUST advance its generation
   void FreeNode(int32_t i) {
     Node& nd = pool_[i];
     nd.live = false;

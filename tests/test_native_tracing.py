@@ -546,6 +546,77 @@ def test_durable_replay_rejoins_trace():
 # -- escape hatches -----------------------------------------------------------
 
 
+def test_wide_fanout_span_cap_sets_truncation_marker():
+    """Round-17 satellite: the 8-per-publish deliver_write span cap
+    used to clip a wide fan-out SILENTLY — a stitched timeline of a
+    12-subscriber publish read as an 8-subscriber audience. Now the
+    first clipped delivery emits ONE extra deliver_write span with aux
+    bit 63 (host.cc kSpanTruncBit), and spans_recent surfaces it as
+    truncated=True with the bit masked out of aux."""
+    app = BrokerApp()
+    server = NativeBrokerServer(port=0, app=app, trace_sample_shift=0)
+    server.start()
+    n_subs = 12
+
+    async def main():
+        subs = []
+        for i in range(n_subs):
+            s = MqttClient(port=server.port, clientid=f"tr-s{i}")
+            await s.connect()
+            await s.subscribe("tr/t", qos=0)
+            subs.append(s)
+        pub = MqttClient(port=server.port, clientid="tr-p")
+        await pub.connect()
+        await _warm(pub, subs[0], "tr/t")
+        await pub.publish("tr/t", b"wide", qos=0)
+        for s in subs:
+            await s.recv(timeout=10)
+
+        def widest():
+            for tid, spans in server.spans.recent(8):
+                dw = [s for s in spans if s[1] == "deliver_write"]
+                if len(dw) == 9:
+                    return tid, dw
+            return None
+        assert await _await(lambda: widest() is not None)
+        _tid, dw = widest()
+        # exactly the 8 capped spans plus ONE truncation marker
+        marked = [s for s in dw if s[4] >> 63]
+        clean = [s for s in dw if not s[4] >> 63]
+        assert len(clean) == 8 and len(marked) == 1, dw
+        # the marker's aux (bit 63 masked) is still a real conn id
+        assert (marked[0][4] & ~(1 << 63)) in set(
+            server._fast_conn_of.values())
+        # the mgmt surface says so, with aux cleaned
+        rec = server.spans_recent(8)
+        tr = [sp for r in rec for sp in r["spans"]
+              if sp["stage"] == "deliver_write" and sp["truncated"]]
+        assert len(tr) == 1 and tr[0]["aux"] < (1 << 63), rec
+        # an EXACTLY-at-cap fan-out stays unmarked: only the 9th
+        # delivery mints the marker, the 8th is not a false positive
+        for i in range(8, n_subs):
+            await subs[i].unsubscribe("tr/t")
+        await asyncio.sleep(0.3)
+        await pub.publish("tr/t", b"exact", qos=0)
+        for s in subs[:8]:
+            await s.recv(timeout=10)
+
+        def exact8():
+            for tid, spans in server.spans.recent(8):
+                dw = [s for s in spans if s[1] == "deliver_write"]
+                if len(dw) == 8:
+                    return dw
+            return None
+        assert await _await(lambda: exact8() is not None)
+        assert all(not (s[4] >> 63) for s in exact8()), exact8()
+        await pub.close()
+        for s in subs:
+            await s.close()
+
+    run(main())
+    server.stop()
+
+
 def test_tracing_escape_hatch():
     """tracing=False: the sampler never ticks a trace — zero spans,
     zero traced publishes, plane stays fast; telemetry histograms keep

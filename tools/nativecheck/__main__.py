@@ -1,9 +1,24 @@
-"""CLI: ``python -m tools.nativecheck [repo_root]``.
+"""CLI: ``python -m tools.nativecheck [--json] [repo_root]``.
 
-Prints every finding as ``file:line: [rule] message`` (waived findings
-annotated with their justification) and exits nonzero when any finding
-is unwaived or any waiver is stale — the tier-1 contract."""
+Text mode prints every finding as ``file:line: [rule] message`` (waived
+findings annotated with their justification) and exits nonzero when any
+finding is unwaived or any waiver is stale — the tier-1 contract.
 
+``--json`` emits one stable JSON document instead, for CI gates and
+editor integrations that should not scrape text (schema below is
+versioned and pinned by tests/test_nativecheck.py):
+
+    {"schema": 1, "ok": bool, "elapsed_s": float,
+     "unwaived": int, "waived": int, "stale": int,
+     "findings": [{"rule", "file", "line", "site", "message",
+                   "waived_by"  # null when unwaived
+                  }, ...],                       # sorted (file, line)
+     "stale_waivers": [{"rule", "site", "why"}, ...]}
+
+Exit status is identical in both modes.
+"""
+
+import json
 import sys
 import time
 
@@ -11,19 +26,42 @@ from .rules import run
 
 
 def main(argv: list) -> int:
-    repo = argv[1] if len(argv) > 1 else "."
+    args = [a for a in argv[1:] if a != "--json"]
+    as_json = "--json" in argv[1:]
+    repo = args[0] if args else "."
     t0 = time.monotonic()
     res = run(repo)
-    for f in sorted(res.findings, key=lambda f: (f.file, f.line)):
+    dt = time.monotonic() - t0
+    findings = sorted(res.findings, key=lambda f: (f.file, f.line))
+    n_unwaived = len(res.unwaived)
+    n_waived = len(res.findings) - n_unwaived
+    if as_json:
+        doc = {
+            "schema": 1,
+            "ok": res.ok,
+            "elapsed_s": round(dt, 3),
+            "unwaived": n_unwaived,
+            "waived": n_waived,
+            "stale": len(res.stale_waivers),
+            "findings": [
+                {"rule": f.rule, "file": f.file, "line": f.line,
+                 "site": f.site, "message": f.message,
+                 "waived_by": f.waived_by}
+                for f in findings],
+            "stale_waivers": [
+                {"rule": w.get("rule"), "site": w.get("site"),
+                 "why": w.get("why")}
+                for w in res.stale_waivers],
+        }
+        print(json.dumps(doc, indent=1))
+        return 0 if res.ok else 1
+    for f in findings:
         mark = f" [waived: {f.waived_by}]" if f.waived_by else ""
         print(f"{f.file}:{f.line}: [{f.rule}] {f.message}{mark}")
     for w in res.stale_waivers:
         print(f"waivers.py:0: [waivers] stale waiver "
               f"{w.get('rule')}:{w.get('site')} — matches no finding; "
               f"delete it")
-    n_unwaived = len(res.unwaived)
-    n_waived = len(res.findings) - n_unwaived
-    dt = time.monotonic() - t0
     print(f"nativecheck: {n_unwaived} unwaived finding(s), {n_waived} "
           f"waived, {len(res.stale_waivers)} stale waiver(s) "
           f"[{dt:.2f}s]")

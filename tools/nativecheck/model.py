@@ -37,6 +37,36 @@ ANNOTATION GRAMMAR (one per comment, ``//`` comments only):
                                 must lexically FOLLOW an admit check
   // @admit-check               function is a ladder admission check
                                 (ShardAdmit / RingRoom / TrunkEligible)
+
+Round-17 additions (nativecheck v2 — rules 7-9):
+
+  // @atomic(relaxed: why)      std::atomic field: every load/store/RMW
+     @atomic(acq_rel: why)      site must pass an EXPLICIT
+     @atomic(acquire: why)      std::memory_order_* argument within the
+     @atomic(release: why)      declared discipline (bare seq_cst-
+                                defaulted accesses always flag); the
+                                why is mandatory — it documents what
+                                the ordering protects
+  // @published(idx, ...)       field holds data published by release
+                                stores of the named index atomics: no
+                                access to it may lexically FOLLOW such
+                                a store in the same function (the SPSC
+                                write-data-then-publish-index shape)
+  // @gen-check                 function validates a generation handle
+                                (must compare .gen against the handle's
+                                high word)
+  // @gen-bump                  function recycles a slot (must bump the
+                                generation — the ABA guard)
+  // @gen-checked               function consumes a raw handle and must
+                                call a @gen-check validator FIRST
+  // @gen-handle                field holds a generation handle: call
+                                uses may only flow into @gen-checked /
+                                @gen-check functions
+  // @bounded                   field: a poll-cycle event buffer with a
+                                margin discipline (needs a writer)
+  // @bounded(<buf>)            function: the buffer's writer — every
+                                append is preceded by a chunk-or-flush
+                                margin check against the buffer cap
 """
 
 from __future__ import annotations
@@ -56,8 +86,21 @@ _KEYWORDS = frozenset((
 ))
 
 _ANNOT_RE = re.compile(
-    r"@(plane|guards|blocking|locked|admit-gated|admit-check)"
+    r"@(plane|guards|blocking|locked|admit-gated|admit-check"
+    r"|atomic|published|gen-checked|gen-check|gen-bump|gen-handle"
+    r"|bounded)"
     r"(?:\(([^)]*)\))?")
+
+_CLASS_RE = re.compile(r"\b(?:class|struct)\s+([A-Za-z_]\w*)[^;{()]*\{")
+
+_ATOMIC_DECL_RE = re.compile(
+    r"\batomic\s*<[^;>]*>\s*([A-Za-z_]\w*)\s*(?:\[[^\]]*\])?\s*[{=;]")
+
+# one atomic load/store/RMW access: field name (possibly indexed), op
+_ATOMIC_OPS = ("load", "store", "exchange", "fetch_add", "fetch_sub",
+               "fetch_or", "fetch_and", "fetch_xor",
+               "compare_exchange_weak", "compare_exchange_strong")
+_MEMORY_ORDER_RE = re.compile(r"\bmemory_order_(\w+)")
 
 _LOCK_RE = re.compile(
     r"\b(?:std\s*::\s*)?(?:lock_guard|unique_lock|scoped_lock)\s*"
@@ -124,6 +167,7 @@ class CppFunction:
     body_start: int     # offset of '{'
     body_end: int       # offset one past the matching '}'
     annotations: dict = field(default_factory=dict)  # kind -> Annotation
+    cls: str = ""       # innermost enclosing class/struct ("" = free)
 
     def annotation(self, kind: str) -> str | None:
         a = self.annotations.get(kind)
@@ -156,8 +200,17 @@ class CppSource:
                 self._line_starts.append(i + 1)
         self.functions: list[CppFunction] = []
         self.fields: list[CppField] = []
+        self._class_extents: list[tuple[str, int, int]] = []
+        self._extract_classes()
         self._extract_functions()
         self._attach_annotations()
+        # per-function memos: a CppSource is immutable after
+        # construction and cached across runs, so the mutation /
+        # load-bearing sweeps (which re-run the rules dozens of times
+        # with ONE file overridden) reuse every other file's scans
+        self._calls_memo: dict = {}
+        self._locks_memo: dict = {}
+        self._atomics_memo: dict = {}
 
     # -- positions -----------------------------------------------------------
 
@@ -204,6 +257,23 @@ class CppSource:
                 if depth == 0:
                     return j + 1
         return len(self.code)
+
+    def _extract_classes(self) -> None:
+        """(name, body_start, body_end) for every class/struct
+        definition — the call graph resolves same-named methods by
+        enclosing-class scope (round 17)."""
+        for m in _CLASS_RE.finditer(self.code):
+            body_start = self.code.index("{", m.start())
+            self._class_extents.append(
+                (m.group(1), body_start, self.match_brace(body_start)))
+
+    def class_of(self, offset: int) -> str:
+        """Innermost class/struct whose body contains ``offset``."""
+        best, best_span = "", None
+        for name, a, b in self._class_extents:
+            if a <= offset < b and (best_span is None or b - a < best_span):
+                best, best_span = name, b - a
+        return best
 
     def _extract_functions(self) -> None:
         code = self.code
@@ -257,7 +327,7 @@ class CppSource:
             fn = CppFunction(
                 name=name, file=self.name, line=self.line_of(m.start()),
                 sig_start=m.start(), body_start=body_start,
-                body_end=body_end)
+                body_end=body_end, cls=self.class_of(m.start()))
             self.functions.append(fn)
             covered_until = body_end
 
@@ -315,22 +385,30 @@ class CppSource:
         """(callee name, absolute offset) for every identifier( token
         in the body, keywords excluded. Callers filter against the
         model's function table."""
+        memo = self._calls_memo.get(id(fn))
+        if memo is not None:
+            return memo
         out = []
         for m in _CALL_RE.finditer(self.code, fn.body_start, fn.body_end):
             name = m.group(1)
             if name in _KEYWORDS:
                 continue
             out.append((name, m.start()))
+        self._calls_memo[id(fn)] = out
         return out
 
     def lock_sites(self, fn: CppFunction) -> list[tuple[str, int, int]]:
         """(mutex name, lock offset, scope end offset) per acquisition
         in the body. Scope = the innermost brace block containing the
         lock site (lock_guard lifetime)."""
+        memo = self._locks_memo.get(id(fn))
+        if memo is not None:
+            return memo
         out = []
         for m in _LOCK_RE.finditer(self.code, fn.body_start, fn.body_end):
             scope_end = self._enclosing_block_end(fn, m.start())
             out.append((m.group(1), m.start(), scope_end))
+        self._locks_memo[id(fn)] = out
         return out
 
     def _enclosing_block_end(self, fn: CppFunction, pos: int) -> int:
@@ -353,6 +431,65 @@ class CppSource:
         pat = re.compile(rf"\b{re.escape(name)}\b")
         return [m.start()
                 for m in pat.finditer(self.code, fn.body_start, fn.body_end)]
+
+    # -- round-17 views (rules 7-9) ------------------------------------------
+
+    def atomic_decls(self) -> list[tuple[str, int]]:
+        """(field name, line) of every ``std::atomic<...>`` member
+        declaration in this file — the rule-7 catalog is the DECLS,
+        not the annotations, so an unannotated atomic is a finding."""
+        return [(m.group(1), self.line_of(m.start()))
+                for m in _ATOMIC_DECL_RE.finditer(self.code)]
+
+    def atomic_accesses(self, names) -> list[tuple[str, str, int, list]]:
+        """(field, op, offset, memory orders) for every load/store/RMW
+        site of any field in ``names`` anywhere in this file. Orders
+        come from the call's full paren extent (multi-line calls)."""
+        if not names:
+            return []
+        key = tuple(sorted(names))
+        memo = self._atomics_memo.get(key)
+        if memo is not None:
+            return memo
+        pat = re.compile(
+            r"\b(" + "|".join(re.escape(n) for n in sorted(names)) + r")"
+            r"\s*(?:\[[^\]]*\])?\s*\.\s*(" + "|".join(_ATOMIC_OPS)
+            + r")\s*\(")
+        out = []
+        for m in pat.finditer(self.code):
+            close = self._match_paren(m.end() - 1)
+            args = self.code[m.end():max(m.end(), close - 1)]
+            out.append((m.group(1), m.group(2), m.start(),
+                        _MEMORY_ORDER_RE.findall(args)))
+        self._atomics_memo[key] = out
+        return out
+
+    def call_arg_uses(self, fn: CppFunction, name: str) -> list[tuple[str, int]]:
+        """(innermost callee, token offset) for every use of ``name``
+        inside a call's argument extent within the body — the
+        @gen-handle flow check."""
+        if name not in self.code[fn.body_start:fn.body_end]:
+            return []
+        memo = self._atomics_memo.get((id(fn), name))
+        if memo is not None:
+            return memo
+        calls = []
+        for cm in _CALL_RE.finditer(self.code, fn.body_start, fn.body_end):
+            if cm.group(1) in _KEYWORDS:
+                continue
+            close = self._match_paren(cm.end() - 1)
+            if close > 0:
+                calls.append((cm.group(1), cm.end(), close))
+        out = []
+        for off in self.field_accesses(fn, name):
+            inner = None
+            for callee, a, b in calls:
+                if a <= off < b and (inner is None or b - a < inner[2]):
+                    inner = (callee, off, b - a)
+            if inner is not None:
+                out.append((inner[0], off))
+        self._atomics_memo[(id(fn), name)] = out
+        return out
 
 
 # parse cache: the mutation/load-bearing tests re-analyze the tree
@@ -410,15 +547,35 @@ class CppModel:
 
     def call_edges(self, fn: CppFunction):
         """(callee CppFunction, call offset) resolved by name against
-        the model's function table (all same-named functions — a
-        deliberate over-approximation; waivers are the pressure
-        valve)."""
+        the model's function table. Same-named functions are resolved
+        by enclosing-class scope when the call is UNQUALIFIED (or
+        ``this->``-qualified) and the caller's class defines the name
+        (round 17); qualified calls (``obj->f(``, ``x.f(``, ``Ns::f(``)
+        keep the over-approximation — waivers stay the pressure
+        valve."""
         src = self.source_of(fn)
         for name, off in src.calls(fn):
-            for callee in self.by_name.get(name, ()):
+            cands = self.by_name.get(name, ())
+            if len(cands) > 1 and fn.cls and not self._qualified(src, off):
+                same_cls = [c for c in cands
+                            if c.file == fn.file and c.cls == fn.cls]
+                if same_cls:
+                    cands = same_cls
+            for callee in cands:
                 if callee is fn:
                     continue
                 yield callee, off
+
+    @staticmethod
+    def _qualified(src: CppSource, off: int) -> bool:
+        """True when the call token at ``off`` is reached through an
+        object or namespace (``.``/``->``/``::``) other than ``this``."""
+        j = off - 1
+        while j >= 0 and src.code[j] in " \t\n":
+            j -= 1
+        if j >= 1 and src.code[j - 1:j + 1] in ("->", "::"):
+            return not src.code[:j - 1].rstrip().endswith("this")
+        return j >= 0 and src.code[j] == "."
 
 
 # -- legacy-lint helpers (shared with tests/test_stats_lint.py and

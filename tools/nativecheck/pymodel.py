@@ -11,9 +11,10 @@ initialization site and the rule checks the folds mechanically:
 Semantics (deliberately strict — restructure the code rather than
 teach the checker aliasing):
 
-- scope = every method named ``_on_*`` plus every method a scoped
-  method directly calls on ``self`` (one hop: the ``_on_durable`` ->
-  ``_on_durable_locked`` shape);
+- scope = every method named ``_on_*`` plus every method TRANSITIVELY
+  reachable from one through ``self.X()`` calls (round 17 — the old
+  one-hop scope left a second callee hop unchecked; waivers are the
+  pressure valve if the closure over-fires);
 - ANY mention of a guarded attribute inside a scoped method must be
   lexically within a ``with self.<lock>:`` block naming the guarding
   lock — or the method is annotated ``@locked(<lock>)`` (the
@@ -46,6 +47,7 @@ class PyClassModel:
     guarded: dict = field(default_factory=dict)   # attr -> lock name
     guarded_lines: dict = field(default_factory=dict)  # attr -> line
     methods: dict = field(default_factory=dict)   # name -> PyMethod
+    rlocks: set = field(default_factory=set)      # threading.RLock attrs
 
 
 class PySource:
@@ -93,7 +95,11 @@ class PySource:
         start = cls.lineno
         end = max((getattr(n, "end_lineno", start) for n in cls.body),
                   default=start)
+        rlock_re = re.compile(r"self\.(\w+)\s*=\s*threading\.RLock\(")
         for line in range(start, end + 1):
+            rm = rlock_re.search(self.lines[line - 1])
+            if rm:
+                model.rlocks.add(rm.group(1))
             m = _ANNOT_RE.search(self.lines[line - 1])
             if not m or m.group(1) != "guards":
                 continue
@@ -111,15 +117,38 @@ class PySource:
     # -- rule-4 views --------------------------------------------------------
 
     def scoped_methods(self) -> dict[str, PyMethod]:
-        """``_on_*`` methods plus their direct self.X() callees."""
+        """``_on_*`` methods plus every method transitively reachable
+        from one through ``self.X()`` calls (round 17: the full
+        closure within the file — a fold's guarded-state touch two
+        callee hops down is no longer invisible)."""
         model = self.model
         scoped: dict[str, PyMethod] = {
             n: m for n, m in model.methods.items() if n.startswith("_on_")}
-        for m in list(scoped.values()):
+        frontier = list(scoped.values())
+        while frontier:
+            m = frontier.pop()
             for callee in self._self_calls(m.node):
                 if callee in model.methods and callee not in scoped:
                     scoped[callee] = model.methods[callee]
+                    frontier.append(model.methods[callee])
         return scoped
+
+    def transitive_acquires(self, name: str,
+                            _seen: set | None = None) -> set:
+        """Every lock attr a call to method ``name`` may acquire —
+        directly or through transitive ``self.X()`` callees (the
+        lock-order rule's interprocedural view)."""
+        seen = _seen if _seen is not None else set()
+        if name in seen:
+            return set()
+        seen.add(name)
+        m = self.model.methods.get(name)
+        if m is None:
+            return set()
+        out = {w for w, _a, _b in self.with_regions(m.node)}
+        for callee in self._index(m.node)["calls"]:
+            out |= self.transitive_acquires(callee, seen)
+        return out
 
     @staticmethod
     def _self_calls(node: ast.AST):

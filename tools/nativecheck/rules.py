@@ -24,6 +24,31 @@ Rule catalog (see README "Static analysis of the native plane"):
              matches the enum exactly (the sanitizer-lint pattern:
              a typo'd site name must fail the build, never arm
              nothing).
+  atomics  — memory-order discipline (round 17): every ``std::atomic``
+             field carries ``@atomic(<discipline>: why)`` and every
+             load/store/RMW site passes an explicit
+             ``std::memory_order_*`` within the discipline (a bare
+             seq_cst-defaulted access always flags). Structural legs:
+             ``@published(<idx>)`` data may never be touched lexically
+             AFTER a release store of its index in the same function
+             (the SPSC write-then-publish shape, ring.h), and the
+             generation-handle protocol (wheel.h/park.h): ``@gen-check``
+             validators compare generations, ``@gen-bump`` recyclers
+             bump them, ``@gen-checked`` consumers validate FIRST, and
+             ``@gen-handle`` fields only flow into checked consumers.
+  lock-order — the global lock-acquisition graph (lock_guard scopes +
+             ``with self._lock`` regions, both languages, call-graph
+             propagated) must match the ``LOCK_ORDER`` edges declared
+             in waivers.py: an undeclared nesting, a declared-but-
+             never-observed edge, a cycle, or a self-acquisition of a
+             non-reentrant lock is a finding (the PR 9
+             _shared_lock -> _mirror_lock -> _durable_lock docstring,
+             enforced).
+  tap-bound — every append into a ``@bounded`` poll-cycle event buffer
+             happens in a ``@bounded(<buf>)`` writer whose append is
+             lexically preceded by a chunk-or-flush margin check
+             against the buffer cap (the kind-6 header-seed and
+             kind-10 4096-token lessons, static).
   waivers  — waiver hygiene: every waiver names a known rule, carries
              a justification, and matches a live finding (a stale
              waiver is drift in the other direction).
@@ -39,14 +64,15 @@ import os
 import re
 from dataclasses import dataclass
 
-from .model import CppModel, enumerators, snake
+from .model import CppModel, _MEMORY_ORDER_RE, enumerators, snake
 from .pymodel import PySource
 
 CPP_FILES = ("host.cc", "store.h", "trunk.h", "ring.h", "router.h",
              "sn.h", "ws.h", "frame.h", "fault.h", "wheel.h", "park.h")
 PY_FOLD_FILE = os.path.join("emqx_tpu", "broker", "native_server.py")
 
-RULES = ("plane", "lockset", "ladder", "pyfold", "fault", "waivers")
+RULES = ("plane", "lockset", "ladder", "pyfold", "fault",
+         "atomics", "lock-order", "tap-bound", "waivers")
 
 
 @dataclass(frozen=True)
@@ -279,7 +305,8 @@ _FIRE_VOCAB = ("Fire(", "FaultHit(", "FaultRecv(", "FaultSend(",
                "armed(")
 _PY_SITES_RE = re.compile(r"FAULT_SITES = \(([^)]*)\)", re.S)
 
-_TESTS_BLOB_CACHE: dict = {}
+_TESTS_BLOB_CACHE: dict = {}   # key -> (blob, {site: covered} memo)
+_PY_SITES_CACHE: dict = {}     # (path, mtime_ns) -> FAULT_SITES list
 
 
 def _tests_blob(repo: str) -> str:
@@ -297,8 +324,8 @@ def _tests_blob(repo: str) -> str:
         except OSError:
             pass
     key = (repo, tuple(sig))
-    blob = _TESTS_BLOB_CACHE.get(key)
-    if blob is None:
+    ent = _TESTS_BLOB_CACHE.get(key)
+    if ent is None:
         parts = []
         for f in names:
             try:
@@ -306,10 +333,10 @@ def _tests_blob(repo: str) -> str:
                     parts.append(fh.read())
             except OSError:
                 pass
-        blob = "\n".join(parts)
+        ent = ("\n".join(parts), {})
         _TESTS_BLOB_CACHE.clear()       # one live entry per process
-        _TESTS_BLOB_CACHE[key] = blob
-    return blob
+        _TESTS_BLOB_CACHE[key] = ent
+    return ent[0]
 
 
 def check_fault(model: CppModel, repo: str) -> list[Finding]:
@@ -363,8 +390,14 @@ def check_fault(model: CppModel, repo: str) -> list[Finding]:
                 f"fault site {s} is declared but has no annotated C++ "
                 f"fire site"))
     blob = _tests_blob(repo)
+    # the per-site coverage memo lives WITH its blob in the cache
+    # entry, so it can never outlive (or be confused across) blobs
+    cover = next(c for b, c in _TESTS_BLOB_CACHE.values() if b is blob)
     for s in sites:
-        if not re.search(rf"\b{s}\b", blob):
+        hit = cover.get(s)
+        if hit is None:
+            hit = cover[s] = bool(re.search(rf"\b{s}\b", blob))
+        if not hit:
             out.append(Finding(
                 "fault", "tests", 0, f"tests:{s}",
                 f"fault site {s} is never exercised by any test under "
@@ -373,16 +406,450 @@ def check_fault(model: CppModel, repo: str) -> list[Finding]:
     # and vice versa, same order (the mechanical STAT_NAMES discipline)
     nat = os.path.join(repo, "emqx_tpu", "native", "__init__.py")
     try:
-        with open(nat) as f:
-            m = _PY_SITES_RE.search(f.read())
+        key = (nat, os.stat(nat).st_mtime_ns)
+        py_sites = _PY_SITES_CACHE.get(key)
+        if py_sites is None:
+            with open(nat) as f:
+                m = _PY_SITES_RE.search(f.read())
+            py_sites = (re.findall(r'"([a-z0-9_]+)"', m.group(1))
+                        if m else [])
+            _PY_SITES_CACHE.clear()
+            _PY_SITES_CACHE[key] = py_sites
     except OSError:
-        m = None
-    py_sites = re.findall(r'"([a-z0-9_]+)"', m.group(1)) if m else []
+        py_sites = []
     if py_sites != sites:
         out.append(Finding(
             "fault", "__init__.py", 0, "native/__init__.py:FAULT_SITES",
             f"native.FAULT_SITES {py_sites} drifted from fault.h Site "
             f"enum {sites}"))
+    return out
+
+
+# -- rule: atomics (memory-order + SPSC + generation handles, round 17) -------
+# The lock-free surfaces the Eraser-style lockset rule is blind to:
+# every std::atomic field declares its ordering discipline and every
+# access site's EXPLICIT memory_order argument is checked against it.
+# A bare access (seq_cst silently defaulted — almost always an
+# unconsidered ordering, and a fence nobody asked for on the hot path)
+# always flags. Two structural legs ride along: the SPSC
+# publish/consume shape (data writes lexically precede the index's
+# release store) and the wheel/park generation-handle protocol.
+
+_DISCIPLINES = {
+    "relaxed": {"load": {"relaxed"}, "store": {"relaxed"},
+                "rmw": {"relaxed"}},
+    # publish/consume pairing: stores release (relaxed allowed for
+    # pre-publication init), loads acquire (relaxed allowed for the
+    # owner side's own-index reads — the SPSC shape)
+    "acq_rel": {"load": {"acquire", "relaxed"},
+                "store": {"release", "relaxed"},
+                "rmw": {"acq_rel", "acquire", "release", "relaxed"}},
+    "acquire": {"load": {"acquire"}, "store": set(), "rmw": {"acquire"}},
+    "release": {"load": {"relaxed"}, "store": {"release"},
+                "rmw": {"release"}},
+}
+
+
+def _op_class(op: str) -> str:
+    if op == "load":
+        return "load"
+    if op == "store":
+        return "store"
+    return "rmw"
+
+
+def check_atomics(model: CppModel) -> list[Finding]:
+    out: list[Finding] = []
+    # leg 1: every atomic declaration is annotated with a valid
+    # discipline + why
+    disc_of: dict[str, tuple[str, str]] = {}   # field -> (disc, file)
+    for src in model.sources.values():
+        ann_fields = {f.name: f for f in src.fields
+                      if "atomic" in f.annotations}
+        for name, line in src.atomic_decls():
+            fld = ann_fields.get(name)
+            if fld is None:
+                out.append(Finding(
+                    "atomics", src.name, line, f"{src.name}:{name}",
+                    f"std::atomic field {name} lacks an "
+                    f"@atomic(<discipline>: why) annotation"))
+                continue
+            arg = fld.annotations["atomic"].arg
+            disc, _, why = arg.partition(":")
+            disc = disc.strip()
+            if disc not in _DISCIPLINES or not why.strip():
+                out.append(Finding(
+                    "atomics", src.name, fld.line,
+                    f"{src.name}:{name}:@atomic",
+                    f"@atomic({arg}) on {name}: needs "
+                    f"'<relaxed|acquire|release|acq_rel>: why'"))
+                continue
+            # access sites are matched by NAME across files (that is
+            # what lets host.cc's group_->alive hit ring.h's field), so
+            # two files declaring the same atomic name under different
+            # disciplines would be checked against whichever file was
+            # scanned last — make the ambiguity loud instead
+            prev = disc_of.get(name)
+            if prev is not None and prev[0] != disc:
+                out.append(Finding(
+                    "atomics", src.name, fld.line,
+                    f"{src.name}:{name}:ambiguous",
+                    f"atomic field name {name} is declared "
+                    f"@atomic({disc}) here but @atomic({prev[0]}) in "
+                    f"{prev[1]} — accesses resolve by name, so rename "
+                    f"one field or align the disciplines"))
+                continue
+            disc_of[name] = (disc, src.name)
+    # leg 2: every access site uses an explicit in-discipline order
+    for src in model.sources.values():
+        for name, op, off, orders in src.atomic_accesses(set(disc_of)):
+            disc = disc_of[name][0]
+            line = src.line_of(off)
+            if not orders:
+                out.append(Finding(
+                    "atomics", src.name, line,
+                    f"{src.name}:{line}:{name}",
+                    f"bare {name}.{op}() — seq_cst silently defaulted; "
+                    f"pass an explicit std::memory_order_* within the "
+                    f"declared @atomic({disc}) discipline"))
+                continue
+            allowed = (_DISCIPLINES[disc][_op_class(op)]
+                       | (_DISCIPLINES[disc]["load"]
+                          if op.startswith("compare_exchange") else set()))
+            for mo in orders:
+                if mo not in allowed:
+                    out.append(Finding(
+                        "atomics", src.name, line,
+                        f"{src.name}:{line}:{name}",
+                        f"{name}.{op}(memory_order_{mo}) violates the "
+                        f"declared @atomic({disc}) discipline "
+                        f"(allowed: {sorted(allowed)})"))
+                    break
+    # leg 3: @published data precedes its index publish lexically
+    for src, fld in model.fields_annotated("published"):
+        idx = {n.strip() for n in
+               re.split(r"[,\s]+", fld.annotations["published"].arg)
+               if n.strip()}
+        rel_re = re.compile(
+            r"\b(" + "|".join(re.escape(n) for n in sorted(idx))
+            + r")\s*\.\s*store\s*\(")
+        for fn in src.functions:
+            for m in rel_re.finditer(src.code, fn.body_start, fn.body_end):
+                close = src._match_paren(m.end() - 1)
+                if "release" not in _MEMORY_ORDER_RE.findall(
+                        src.code[m.end():max(m.end(), close)]):
+                    continue
+                late = [o for o in src.field_accesses(fn, fld.name)
+                        if o > m.start()]
+                if late:
+                    line = src.line_of(late[0])
+                    out.append(Finding(
+                        "atomics", src.name, line,
+                        f"{src.name}:{fn.name}:{fld.name}",
+                        f"{fn.name} touches @published {fld.name} AFTER "
+                        f"the release store of {m.group(1)} — data "
+                        f"writes must lexically precede the index "
+                        f"publish (SPSC contract)"))
+                    break
+    # leg 4: the generation-handle protocol
+    gen_checks = {f.name for f in model.annotated("gen-check")}
+    for fn in model.annotated("gen-check"):
+        src = model.source_of(fn)
+        body = src.body_code(fn)
+        if not re.search(r"\bgen\b", body) or ">> 32" not in body:
+            out.append(Finding(
+                "atomics", fn.file, fn.line, f"{fn.file}:{fn.name}",
+                f"@gen-check {fn.name} never compares a generation "
+                f"against the handle's high word"))
+    for fn in model.annotated("gen-bump"):
+        src = model.source_of(fn)
+        if not re.search(r"\bgen\s*(?:\+\+|\+=)",
+                         src.body_code(fn)):
+            out.append(Finding(
+                "atomics", fn.file, fn.line, f"{fn.file}:{fn.name}",
+                f"@gen-bump {fn.name} never bumps the generation — the "
+                f"ABA guard is gone"))
+    for fn in model.annotated("gen-checked"):
+        src = model.source_of(fn)
+        first = next(((n, o) for n, o in src.calls(fn)
+                      if model.by_name.get(n)), None)
+        if first is None or first[0] not in gen_checks:
+            out.append(Finding(
+                "atomics", fn.file, fn.line, f"{fn.file}:{fn.name}",
+                f"@gen-checked {fn.name} must call a @gen-check "
+                f"validator before anything else touches the slot"))
+        if not any(model.source_of(f2).name == fn.file
+                   for f2 in model.annotated("gen-check")):
+            out.append(Finding(
+                "atomics", fn.file, fn.line,
+                f"{fn.file}:{fn.name}:no-validator",
+                f"{fn.file} has @gen-checked consumers but no "
+                f"@gen-check validator"))
+    # a file with a validator must also have the ABA bump half
+    for fn in model.annotated("gen-check"):
+        if not any(model.source_of(f2).name == fn.file
+                   for f2 in model.annotated("gen-bump")):
+            out.append(Finding(
+                "atomics", fn.file, fn.line,
+                f"{fn.file}:{fn.name}:no-bump",
+                f"{fn.file} has a @gen-check validator but no @gen-bump "
+                f"recycler — stale handles would never die"))
+    ok_callees = gen_checks | {f.name for f in model.annotated("gen-checked")}
+    for hsrc, hfld in model.fields_annotated("gen-handle"):
+        for src in model.sources.values():
+            for fn in src.functions:
+                for callee, off in src.call_arg_uses(fn, hfld.name):
+                    if callee in ok_callees:
+                        continue
+                    line = src.line_of(off)
+                    out.append(Finding(
+                        "atomics", src.name, line,
+                        f"{src.name}:{fn.name}:{hfld.name}",
+                        f"{fn.name} passes @gen-handle {hfld.name} to "
+                        f"{callee}(), which is not a @gen-check/"
+                        f"@gen-checked consumer — a stale handle could "
+                        f"act on a recycled slot"))
+    return out
+
+
+# -- rule: lock-order ---------------------------------------------------------
+# Build the global lock-acquisition graph: C++ lock_guard scopes
+# (locks qualified as "<file>:<mutex>") and Python `with self._lock`
+# regions, with call-graph propagation in both languages (a lock held
+# across a call inherits every lock the callee may transitively take).
+# The PR 9 docstring contract — _shared_lock -> _mirror_lock ->
+# _durable_lock — becomes the checked LOCK_ORDER config: undeclared
+# nesting, stale declared edges, cycles, and self-acquisition of
+# non-reentrant locks are findings.
+
+_ORDER_SEP_RE = re.compile(r"\s*<\s*")
+
+
+def _cpp_transitive_acquires(model: CppModel, fn, memo: dict,
+                             stack: set) -> tuple:
+    """(locks transitively acquirable from ``fn``, clean). A walk
+    truncated by a call cycle through the current stack is NOT clean
+    and must never be memoized: the cycle member's partial set would
+    poison every later query and silently hide real nesting edges.
+    (Top-level results stay complete regardless — every cycle node
+    contributes its direct locks at its own frame.)"""
+    hit = memo.get(id(fn))
+    if hit is not None:
+        return hit, True
+    if id(fn) in stack:
+        return set(), False
+    stack.add(id(fn))
+    src = model.source_of(fn)
+    out = {f"{fn.file}:{m}" for m, _lo, _end in src.lock_sites(fn)}
+    clean = True
+    for callee, _off in model.call_edges(fn):
+        sub, sub_clean = _cpp_transitive_acquires(model, callee, memo,
+                                                  stack)
+        out |= sub
+        clean = clean and sub_clean
+    stack.discard(id(fn))
+    if clean:
+        memo[id(fn)] = out
+    return out, clean
+
+
+def check_lock_order(model: CppModel, py: PySource,
+                     lock_order: list) -> list[Finding]:
+    out: list[Finding] = []
+    # observed edges: (outer, inner) -> (file, line, witness)
+    observed: dict[tuple, tuple] = {}
+
+    def note(a, b, file, line, witness):
+        observed.setdefault((a, b), (file, line, witness))
+
+    memo: dict = {}
+    for fn in model.functions():
+        src = model.source_of(fn)
+        sites = [(f"{fn.file}:{m}", lo, end)
+                 for m, lo, end in src.lock_sites(fn)]
+        locked = fn.annotation("locked")
+        if locked:
+            held = f"{fn.file}:{locked}"
+            for inner in _cpp_transitive_acquires(model, fn, memo,
+                                                  set())[0]:
+                note(held, inner, fn.file, fn.line,
+                     f"{fn.name} (@locked)")
+        for lname, lo, end in sites:
+            for l2, lo2, _e2 in sites:
+                if lo < lo2 < end:
+                    note(lname, l2, fn.file, src.line_of(lo2), fn.name)
+            for callee, off in model.call_edges(fn):
+                if lo < off < end:
+                    for l2 in _cpp_transitive_acquires(
+                            model, callee, memo, set())[0]:
+                        note(lname, l2, fn.file, src.line_of(off),
+                             f"{fn.name}->{callee.name}")
+    pmodel = py.model
+    fname = os.path.basename(py.path)
+    for name, meth in pmodel.methods.items():
+        regs = py.with_regions(meth.node)
+        idx = py._index(meth.node)
+        if meth.locked:
+            for inner in py.transitive_acquires(name):
+                note(meth.locked, inner, fname, meth.node.lineno,
+                     f"{name} (@locked)")
+        for w, a, b in regs:
+            for w2, a2, b2 in regs:
+                if (a, b) != (a2, b2) and a < a2 and b2 <= b:
+                    note(w, w2, fname, a2, name)
+            for callee, lines in idx["calls"].items():
+                for ln in lines:
+                    if a <= ln <= b:
+                        for l2 in py.transitive_acquires(callee):
+                            note(w, l2, fname, ln, f"{name}->{callee}")
+                        break
+    # declared edges from the LOCK_ORDER config ("a < b < c" chains)
+    declared: dict[tuple, str] = {}
+    for ent in lock_order:
+        order = str(ent.get("order", ""))
+        why = str(ent.get("why", "")).strip()
+        locks = _ORDER_SEP_RE.split(order)
+        if len(locks) < 2 or not all(locks) or not why:
+            out.append(Finding(
+                "lock-order", "waivers.py", 0,
+                f"waivers.py:{order}",
+                f"malformed LOCK_ORDER entry {ent!r}: needs "
+                f"'a < b' (optionally chained) and a non-empty why"))
+            continue
+        for a, b in zip(locks, locks[1:]):
+            declared[(a, b)] = why
+    # reentrant self-edges are the lock's documented semantics, not
+    # nesting; a self-edge on a plain Lock is a guaranteed deadlock
+    for (a, b), (file, line, witness) in sorted(observed.items()):
+        if a == b:
+            bare = b.rsplit(":", 1)[-1]
+            if bare in pmodel.rlocks:
+                continue
+            out.append(Finding(
+                "lock-order", file, line, f"{a}<{b}",
+                f"{witness} re-acquires non-reentrant {a} while "
+                f"holding it — self-deadlock"))
+        elif (a, b) not in declared:
+            out.append(Finding(
+                "lock-order", file, line, f"{a}<{b}",
+                f"{witness} acquires {b} while holding {a}: undeclared "
+                f"nesting — declare '{a} < {b}' in LOCK_ORDER or "
+                f"restructure"))
+    for (a, b), why in sorted(declared.items()):
+        if (a, b) not in observed:
+            out.append(Finding(
+                "lock-order", "waivers.py", 0, f"stale:{a}<{b}",
+                f"declared lock order '{a} < {b}' is never observed — "
+                f"delete the LOCK_ORDER entry"))
+    # cycles over observed + declared edges (self-edges handled above)
+    graph: dict = {}
+    for a, b in list(observed) + list(declared):
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+    state: dict = {}
+
+    def dfs(n, path):
+        state[n] = 1
+        for nxt in sorted(graph.get(n, ())):
+            if state.get(nxt) == 1:
+                cyc = path[path.index(nxt):] + [nxt] \
+                    if nxt in path else [n, nxt]
+                out.append(Finding(
+                    "lock-order", "waivers.py", 0,
+                    "cycle:" + "<".join(cyc),
+                    f"lock-order cycle: {' -> '.join(cyc)} — a "
+                    f"deadlock waiting for its interleaving"))
+            elif state.get(nxt, 0) == 0:
+                dfs(nxt, path + [nxt])
+        state[n] = 2
+
+    for n in sorted(graph):
+        if state.get(n, 0) == 0:
+            dfs(n, [n])
+    return out
+
+
+# -- rule: tap-bound ----------------------------------------------------------
+# Every poll-cycle event buffer (@bounded field) takes appends only in
+# its @bounded(<buf>) writers, and a writer's first append is lexically
+# preceded by a margin check (an if on <buf>.size() whose hit flushes).
+# This is the static form of two bugs that each cost a review pass:
+# the kind-6 header-seed-after-flush corruption and the kind-10 entry
+# that outgrew the whole poll buffer and was dropped silently.
+
+def check_tap_bound(model: CppModel) -> list[Finding]:
+    out: list[Finding] = []
+    declared: dict[str, str] = {}    # buf -> declaring file
+    for src, fld in model.fields_annotated("bounded"):
+        if not fld.annotations["bounded"].arg:
+            declared[fld.name] = src.name
+    writers: dict[str, list] = {}    # buf -> [CppFunction]
+    for fn in model.annotated("bounded"):
+        buf = fn.annotation("bounded")
+        if not buf:
+            continue
+        if buf not in declared:
+            out.append(Finding(
+                "tap-bound", fn.file, fn.line,
+                f"{fn.file}:{fn.name}:@bounded",
+                f"@bounded({buf}) on {fn.name} names no @bounded "
+                f"buffer field (declared: {sorted(declared)})"))
+            continue
+        writers.setdefault(buf, []).append(fn)
+        src = model.source_of(fn)
+        app_re = re.compile(rf"\b{re.escape(buf)}\s*\.\s*append\s*\(")
+        appends = [m.start() for m in
+                   app_re.finditer(src.code, fn.body_start, fn.body_end)]
+        if not appends:
+            out.append(Finding(
+                "tap-bound", fn.file, fn.line,
+                f"{fn.file}:{fn.name}:no-append",
+                f"@bounded({buf}) writer {fn.name} never appends to "
+                f"{buf} — dead annotation"))
+            continue
+        # the margin check: an if condition naming <buf>.size() whose
+        # controlled statement flushes, lexically before the first
+        # append
+        guarded = False
+        if_re = re.compile(r"\bif\s*\(")
+        for im in if_re.finditer(src.code, fn.body_start, appends[0]):
+            close = src._match_paren(im.end() - 1)
+            if close < 0 or close > appends[0]:
+                continue
+            cond = src.code[im.end():close]
+            if re.search(rf"\b{re.escape(buf)}\s*\.\s*size\s*\(\s*\)",
+                         cond) and ">" in cond \
+                    and re.search(r"\bFlush\w*\s*\(",
+                                  src.code[close:appends[0]]):
+                guarded = True
+                break
+        if not guarded:
+            line = src.line_of(appends[0])
+            out.append(Finding(
+                "tap-bound", fn.file, line,
+                f"{fn.file}:{fn.name}:{buf}",
+                f"{fn.name} appends to @bounded {buf} with no "
+                f"chunk-or-flush margin check (if on {buf}.size() "
+                f"that flushes) lexically before the append — an "
+                f"oversized record gets dropped whole by Poll"))
+    for buf, file in sorted(declared.items()):
+        wfns = {id(f) for f in writers.get(buf, ())}
+        app_re = re.compile(rf"\b{re.escape(buf)}\s*\.\s*append\s*\(")
+        for src in model.sources.values():
+            for m in app_re.finditer(src.code):
+                holder = next((f for f in src.functions
+                               if f.body_start <= m.start() < f.body_end),
+                              None)
+                if holder is not None and id(holder) in wfns:
+                    continue
+                line = src.line_of(m.start())
+                hname = holder.name if holder else "<toplevel>"
+                out.append(Finding(
+                    "tap-bound", src.name, line,
+                    f"{src.name}:{hname}:{buf}",
+                    f"{hname} appends to @bounded {buf} outside its "
+                    f"@bounded({buf}) writer — the margin discipline "
+                    f"is bypassed"))
     return out
 
 
@@ -417,17 +884,23 @@ def apply_waivers(findings: list, waivers: list) -> Result:
 
 
 def run(repo: str, overrides: dict[str, str] | None = None,
-        waivers: list | None = None) -> Result:
+        waivers: list | None = None,
+        lock_order: list | None = None) -> Result:
     """Analyze the tree (with optional per-file text overrides, keyed
     by basename for C++ sources and by "native_server.py" for the
-    Python fold file) and apply waivers."""
+    Python fold file) and apply waivers. ``lock_order`` overrides the
+    declared LOCK_ORDER edges (the mutation self-test's seam)."""
     overrides = overrides or {}
     if waivers is None:
         from .waivers import WAIVERS as waivers
+    if lock_order is None:
+        from .waivers import LOCK_ORDER as lock_order
     model = build_cpp_model(repo, overrides=overrides)
     py = _cached_py(os.path.join(repo, PY_FOLD_FILE),
                     overrides.get("native_server.py"))
     findings = (check_plane(model) + check_lockset(model)
                 + check_ladder(model) + check_pyfold(py)
-                + check_fault(model, repo))
+                + check_fault(model, repo) + check_atomics(model)
+                + check_lock_order(model, py, lock_order)
+                + check_tap_bound(model))
     return apply_waivers(findings, waivers)

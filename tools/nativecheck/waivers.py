@@ -41,3 +41,37 @@ WAIVERS = [
      "why": "ring consumer: the producing shard ran ShardAdmit before "
             "shipping the trunk-forward entry"},
 ]
+
+# The declared lock-acquisition order (rule 8, round 17). Every edge
+# the analyzer OBSERVES in the global graph (lock_guard scopes + `with
+# self._lock` regions, call-graph propagated across both languages)
+# must be declared here; every edge declared here must still be
+# observed (stale edges fail, the waiver-hygiene discipline). The
+# chain below is the PR 9 _durable_token docstring, now enforced.
+# Reentrant self-acquisition of an RLock is the lock's own semantics
+# and needs no entry; a self-edge on a plain Lock always fails.
+LOCK_ORDER = [
+    # subscribe events fold shared-group state, then reconcile the
+    # C++ install under the mirror lock (_reconcile_shared)
+    {"order": "_shared_lock < _mirror_lock",
+     "why": "_on_shared_event holds _shared_lock across "
+            "_reconcile_shared, which takes _mirror_lock for the punt "
+            "refcounts"},
+    # the sub-event fold runs whole under the reentrant _mirror_lock
+    # and mints durable tokens inside it (_durable_token)
+    {"order": "_mirror_lock < _durable_lock",
+     "why": "_on_sub_event holds _mirror_lock across "
+            "_on_sub_event_locked -> _durable_token, which writes the "
+            "reverse map under _durable_lock; never acquire "
+            "_mirror_lock while holding _durable_lock"},
+    # kind-10 folds resolve closed-conn info for disconnected sessions
+    {"order": "_durable_lock < _closed_lock",
+     "why": "_on_durable_locked (@locked(_durable_lock)) resolves "
+            "conninfo through _conninfo_for, which reads _closed_conns "
+            "under _closed_lock"},
+    # the span fold attributes ingress spans to (possibly just-closed)
+    # publisher conns
+    {"order": "_tele_lock < _closed_lock",
+     "why": "_on_spans holds _tele_lock across _conninfo_for's "
+            "_closed_conns read"},
+]

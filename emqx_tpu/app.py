@@ -58,6 +58,10 @@ class BrokerApp:
         # dicts for the shard-labelled prometheus series
         self.native_spans_fn = None
         self.native_shard_stats_fn = None
+        # the durable store's slot dict (round 18): set by the native
+        # server (or the app's own NativeDurableStore boot) so the
+        # one-recovery-path surface scrapes as emqx_native_store_*
+        self.native_store_stats_fn = None
         self.metrics = Metrics()
         # degradation ledger (round 13): structured reason events for
         # every native/Python degradation-ladder decision, folded into
@@ -86,6 +90,11 @@ class BrokerApp:
                 store=persistent_store,
                 is_persistent=self._session_is_persistent,
             )
+            # the one-recovery-path store's slots scrape even without a
+            # native server attached (the asyncio-only durable broker)
+            nst = getattr(persistent_store, "native", None)
+            if nst is not None:
+                self.native_store_stats_fn = nst.stats
         self.cm = CM(persistence=self.persistent)
         self.shared = SharedSub(node=node, strategy=shared_strategy)
         self.broker = Broker(
@@ -261,9 +270,16 @@ class BrokerApp:
                 shards = self.native_shard_stats_fn()
             except Exception:  # noqa: BLE001 — same containment
                 shards = None
+        store = None
+        if self.native_store_stats_fn is not None:
+            try:
+                store = self.native_store_stats_fn()
+            except Exception:  # noqa: BLE001 — same containment
+                store = None
         return prometheus.render(self.metrics, self.stats,
                                  node=self.broker.node, native=native,
                                  native_shards=shards,
+                                 native_store=store,
                                  openmetrics=openmetrics)
 
     @classmethod
@@ -432,20 +448,40 @@ class BrokerApp:
         # the RouterModel the broker registers subscriptions into and the
         # pipeline batches publishes through (VERDICT r1 item 1; the
         # reference's product IS its hot path, emqx_broker.erl:218-232)
-        # durable-session plane (round 10): durable.enable boots the
-        # PersistentSessions service on a restart-surviving DiskStore
-        # (subscriptions + Python-plane messages); the native server
-        # layers its below-the-GIL message store next to it, reading
-        # the same durable.* knobs
+        # durable-session plane (round 10, unified round 18):
+        # durable.enable boots the PersistentSessions service on the
+        # ONE native durable store (sessions, subscriptions, messages,
+        # markers and the trunk replay ring share its segments); the
+        # native server attaches to the SAME store instance, so a
+        # persistence-enabled broker has one recovery path walked once
+        # at boot. A pre-round-18 JSON sessions.log is boot-migrated
+        # once. Falls back to MemStore (no restart survival) with a
+        # loud warning when the native toolchain is unavailable.
         if conf.get("durable.enable") and "persistent_store" not in overrides:
             import os as _os2
 
-            from emqx_tpu.session.persistent import DiskStore
+            from emqx_tpu import native as _native
             base = (conf.get("durable.store_dir")
                     or _os2.path.join(conf.get("node.data_dir", "data"),
                                       "durable"))
-            overrides["persistent_store"] = DiskStore(
-                _os2.path.join(base, "sessions"))
+            if _native.available():
+                from emqx_tpu.session.persistent import NativeDurableStore
+                overrides["persistent_store"] = NativeDurableStore(
+                    base,
+                    segment_bytes=int(conf.get("durable.segment_bytes")),
+                    fsync=conf.get("durable.fsync") or "batch")
+            else:
+                # still install persistence (in-memory): disconnect
+                # survival, offline queuing and resume keep working —
+                # only RESTART survival is gone without the native store
+                import logging as _logging
+
+                from emqx_tpu.session.persistent import MemStore
+                overrides["persistent_store"] = MemStore()
+                _logging.getLogger("emqx_tpu.app").warning(
+                    "durable.enable set but the native store is "
+                    "unavailable (%s): sessions persist in MEMORY only "
+                    "— no restart survival", _native.build_error())
         if conf.get("router.device.enable") and "router_model" not in overrides:
             from emqx_tpu.models.router_model import RouterModel
             from emqx_tpu.router.index import TrieIndex

@@ -29,6 +29,18 @@ class CM:
         with self._glock:
             return self._locks.setdefault(clientid, threading.Lock())
 
+    def _wire_settle(self, clientid: str, session: Session) -> None:
+        """Wire the session's delivery-settlement observer to the
+        persistence layer (round 18, consume-on-ack): a store replay
+        marker is spent when the delivery SETTLES — subscriber ack,
+        effective-qos0 write, or a final drop — never at delivery-write
+        time, so a conn that dies between the socket write and the
+        PUBACK keeps its marker and restart resume retransmits."""
+        if self.persistence is not None and session is not None:
+            session.settle_fn = (
+                lambda mid, _sid=clientid:
+                self.persistence.settle(_sid, mid))
+
     def lookup_channel(self, clientid: str) -> Optional[Any]:
         return self._channels.get(clientid)
 
@@ -67,6 +79,7 @@ class CM:
                     clientid=clientid, clean_start=True,
                     **(session_opts or {}),
                 )
+                self._wire_settle(clientid, session)
                 self._channels[clientid] = new_channel
                 return session, False, []
             # resume path
@@ -75,6 +88,7 @@ class CM:
                 self._channels[clientid] = new_channel
                 if session is not None:
                     session.clean_start = False
+                    self._wire_settle(clientid, session)
                     if (self.persistence is not None
                             and self.persistence.lookup(clientid)
                             is not None):
@@ -92,6 +106,7 @@ class CM:
                 clientid=clientid, clean_start=False,
                 **(session_opts or {}),
             )
+            self._wire_settle(clientid, session)
             # restart-resume: no live channel — replay from the store
             # (emqx_persistent_session:resume, :275-310)
             if (self.persistence is not None
@@ -117,14 +132,12 @@ class CM:
                 m.extra["deliver_begin_at"] = begin
             ch = self._channels.get(sid)
             if ch is not None:
+                # marker consumption moved to the SETTLE seam (round
+                # 18): the session spends each marker when the delivery
+                # settles — subscriber ack / effective-qos0 write /
+                # final drop — never here at delivery-write time, so a
+                # conn death before the ack keeps the replay marker
                 ch.send(ch.handle_deliver(items))
-                if (self.persistence is not None
-                        and ch.conn_state == "connected"):
-                    # reached a live connection: the replay marker is
-                    # spent (disconnected sessions keep theirs so a node
-                    # restart can replay from the store)
-                    self.persistence.mark_delivered(
-                        sid, [m.id for _, m in items])
 
     def kick(self, clientid: str) -> bool:
         """Administrative kick (emqx_cm:kick_session)."""

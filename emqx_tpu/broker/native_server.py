@@ -224,8 +224,9 @@ class _ShardedHost:
     def close_conn(self, conn):
         self._of(conn).close_conn(conn)
 
-    def enable_fast(self, conn, proto_ver, max_inflight=0):
-        self._of(conn).enable_fast(conn, proto_ver, max_inflight)
+    def enable_fast(self, conn, proto_ver, max_inflight=0, clientid=""):
+        self._of(conn).enable_fast(conn, proto_ver, max_inflight,
+                                   clientid)
 
     def disable_fast(self, conn):
         self._of(conn).disable_fast(conn)
@@ -348,6 +349,10 @@ class _ShardedHost:
     def trunk_connect(self, peer_id, host, port):
         self.hosts[peer_id % len(self.hosts)].trunk_connect(
             peer_id, host, port)
+
+    def trunk_ident(self, peer_id, name):
+        # the persisted-ring key lives on the peer's OWNER shard
+        self.hosts[peer_id % len(self.hosts)].trunk_ident(peer_id, name)
 
     def trunk_disconnect(self, peer_id, forget=False):
         self.hosts[peer_id % len(self.hosts)].trunk_disconnect(
@@ -698,6 +703,11 @@ class NativeBrokerServer:
         # back to punt-everything.
         self._durable_store = None
         self._durable_tokens: dict[str, int] = {}      # sid -> token
+        # post-restart settle fast path (round 18): sid -> token
+        # resolved by a store lookup when the primary cache is cold;
+        # GIL-atomic get/set only, popped on discard (see
+        # _durable_consume for why it avoids _mirror_lock)
+        self._durable_tok_cache: dict[str, int] = {}
         self._durable_sids: dict[int, str] = {}  # token -> sid @guards(_durable_lock)
         # sid -> filters with a live C++ durable entry (session discard
         # must tear them down, or a dead token keeps accumulating
@@ -716,6 +726,9 @@ class NativeBrokerServer:
         # those guids a second time
         self._durable_drain_mark: dict[str, int] = {}  # @guards(_durable_lock)
         self._store_degraded_seen = 0
+        # one-shot loud warning for the punt-everything fallback of
+        # persistent sessions on a persistence-less app (round 18)
+        self._durable_punt_warned = False
         conf = getattr(app, "config", None) if app is not None else None
         if durable is None:
             durable = os.environ.get("EMQX_DURABLE_STORE", "1") != "0"
@@ -737,15 +750,31 @@ class NativeBrokerServer:
                     int(conf.get("durable.segment_bytes")) if conf_on
                     else 4 << 20)
             try:
-                # dir "" = anonymous segments: the durable PLANE (fast
-                # path preserved + live kind-10 delivery + in-process
-                # replay) without restart survival
-                self._durable_store = native.NativeStore(
-                    durable_dir or "", durable_segment_bytes or 4 << 20,
-                    durable_fsync or "batch")
+                # ONE recovery path (round 18): when the app's
+                # persistence backend is already native-store-backed
+                # (session/persistent.py NativeDurableStore), attach to
+                # the SAME store instance — sessions, subscriptions,
+                # Python-plane messages, fast-path messages and the
+                # trunk replay ring all share one segment walk. Two
+                # stores on one dir would double-mmap the segments.
+                shared = getattr(app.persistent.store, "native", None)
+                if shared is not None:
+                    self._durable_store = shared
+                    self._durable_store_owned = False
+                else:
+                    # dir "" = anonymous segments: the durable PLANE
+                    # (fast path preserved + live kind-10 delivery +
+                    # in-process replay) without restart survival
+                    self._durable_store = native.NativeStore(
+                        durable_dir or "",
+                        durable_segment_bytes or 4 << 20,
+                        durable_fsync or "batch")
+                    self._durable_store_owned = True
                 self.host.attach_store(self._durable_store)
                 app.persistent.native_drain = self._durable_drain
                 app.persistent.native_discard = self._durable_discard
+                app.persistent.native_ack = self._durable_consume
+                app.native_store_stats_fn = self._durable_store.stats
             except OSError as e:  # pragma: no cover — unwritable dir
                 log.warning("durable store unavailable (%s); persistent "
                             "sessions stay on the punt path", e)
@@ -1412,6 +1441,14 @@ class NativeBrokerServer:
                     pid = peer["id"]
                     peer.update(addr=host, port=port, up=False,
                                 backoff=TRUNK_RETRY_S, retry_at=0.0)
+        # bind the peer id to its stable NODE NAME BEFORE any remote
+        # entry exists (ops apply FIFO on the poll thread): a qos1
+        # publish matching a freshly converted route could otherwise
+        # seal + journal a trunk batch under the per-process fallback
+        # key in the cycle before the ident applied, stranding that
+        # record AND skipping the previous life's ring merge (review
+        # finding). Idempotent — the C++ side loads once per peer life.
+        self.host.trunk_ident(pid, node)
         sid = f"n:{node}"
         # list() snapshot: route observers on other threads mutate the
         # set, and a bare comprehension can die mid-iteration
@@ -1742,6 +1779,7 @@ class NativeBrokerServer:
                 # living on another transport: punt marker
                 owner, kind = self._token("c:" + sid), "punt"
                 qos = flags = 0
+                self._warn_durable_punt(sid, topic)
             old = self._mirror.get((sid, topic))
             if old is not None and (old[0], old[1], old[2]) != (
                     owner, real, kind):
@@ -1777,6 +1815,31 @@ class NativeBrokerServer:
                 and self.app.persistent is not None
                 and self.app.persistent.is_persistent(sid))
 
+    def _warn_durable_punt(self, sid: str, topic: str) -> None:
+        """Carried edge (round 18): a persistence-less app used to
+        degrade a persistent session's filters to punt-everything
+        SILENTLY. Name the fallback once, loudly — the operator is one
+        config knob away from the one-recovery-path durable plane."""
+        if self._durable_punt_warned or self._durable_store is not None:
+            return
+        ch = self.cm.lookup_channel(sid)
+        ci = getattr(ch, "conninfo", None)
+        if ci is None or (ci.clean_start
+                          and not ci.expiry_interval_ms):
+            return    # clean session: the punt is not a durability story
+        self._durable_punt_warned = True
+        log.warning(
+            "durable filter %r from persistent session %r has no "
+            "persistence backing (app.persistent=%s, durable store "
+            "off): falling back to PUNT-EVERYTHING — matching "
+            "publishes take the Python slow path and queued messages "
+            "will NOT survive a broker restart. Set durable.enable "
+            "(or attach a persistent store) for the one-recovery-path "
+            "durable plane.",
+            topic, sid,
+            "missing" if (self.app is None or self.app.persistent
+                          is None) else "present")
+
     def _durable_token(self, sid: str) -> int:
         """sid -> store token (stable across restarts: the store
         journals REGISTER records and recovery replays them).
@@ -1805,11 +1868,30 @@ class NativeBrokerServer:
         return tok
 
     def _durable_consume(self, sid: str, guids: list) -> None:
+        """Spend store markers for ``sid`` — also the
+        ``PersistentSessions.native_ack`` settle seam (round 18): the
+        session calls here when a delivery of a store-backed message
+        SETTLES (subscriber ack / qos0 write / final drop). Lookup
+        falls back to the store: after a restart the token cache is
+        empty but the registration survived."""
         if self._durable_store is None:
             return
-        tok = self._durable_tokens.get(sid)
-        if tok is not None:
-            self._durable_store.consume(tok, guids)
+        tok = (self._durable_tokens.get(sid)
+               or self._durable_tok_cache.get(sid))
+        if not tok:
+            tok = self._durable_store.lookup(sid)
+            if tok:
+                # GIL-atomic write, deliberately NOT under _mirror_lock
+                # (this runs with _durable_lock held from the kind-10
+                # fold, and _mirror_lock must never nest under it):
+                # sid→tok is stable within a token life, and a lost
+                # race just repeats one lookup. _durable_discard pops
+                # it with the primary cache.
+                self._durable_tok_cache[sid] = tok
+        if tok:
+            n = self._durable_store.consume(tok, guids)
+            if n:
+                self.broker.metrics.inc("messages.durable.settled", n)
 
     def _on_durable(self, payload: bytes) -> None:
         """Fold ONE batched kind-10 durable record: per entry, deliver
@@ -1842,8 +1924,16 @@ class NativeBrokerServer:
         # the plane wedged for >30s draining them)
         consumed: dict[str, list] = {}
         dead: dict[int, list] = {}
+        # consume-on-ack (round 18): a marker is spent only when the
+        # delivery SETTLES. Effective-qos0 deliveries settle inside
+        # handle_deliver (collected through a per-call settle sink so
+        # this fold keeps its batched consume); qos1/2 deliveries keep
+        # their marker until the subscriber's PUBACK/PUBCOMP reaches
+        # the session's settle seam — a conn death between the socket
+        # write and the ack keeps the marker, so a restart resume
+        # RETRANSMITS instead of losing the message.
         for i, (origin, flags, toks, topic, body,
-                _trace) in enumerate(entries):
+                _trace, cid) in enumerate(entries):
             guid = base + i
             sids, seen = [], set()
             for tok in toks:
@@ -1883,7 +1973,9 @@ class NativeBrokerServer:
             info = self._conninfo_for(origin)
             msg = Message(
                 topic=topic, payload=body, qos=(flags >> 1) & 3,
-                from_=info[0] if info else "$durable",
+                # the persisted origin clientid wins (it also survives
+                # a restart, where conninfo cannot)
+                from_=cid or (info[0] if info else "$durable"),
                 id=self.DURABLE_GUID_BASE + guid,
                 flags={"retain": False, "dup": bool(flags & 8)},
                 headers={"properties": {}, "protocol": "mqtt"},
@@ -1896,11 +1988,37 @@ class NativeBrokerServer:
             for sid, ch in live:
                 filt = matches.get(sid, topic)
                 msg.extra["deliver_begin_at"] = begin
-                ch.send(ch.handle_deliver([(filt, msg)]))
-                if ch.conn_state == "connected":
-                    # reached a live connection: the replay marker is
-                    # spent (disconnected sessions keep theirs — their
-                    # mqueue copy dedups against the store replay by id)
+                sess = ch.session
+                # the sink is a FILTER, not a replacement: another
+                # thread (a PUBACK handled on a different shard's poll
+                # thread, or the asyncio transport) can fire the
+                # session's settle_fn concurrently with this fold —
+                # its settle must still reach the persistence seam, or
+                # an acked message's marker would replay forever; only
+                # THIS entry's id collects locally (review finding)
+                settled_here: list = []
+                old_fn = getattr(sess, "settle_fn", None)
+                if sess is not None:
+                    this_id = msg.id
+
+                    def sink(mid, _prev=old_fn, _cur=this_id,
+                             _out=settled_here):
+                        if mid == _cur:
+                            _out.append(mid)
+                        elif _prev is not None:
+                            _prev(mid)
+
+                    sess.settle_fn = sink
+                try:
+                    ch.send(ch.handle_deliver([(filt, msg)]))
+                finally:
+                    if sess is not None:
+                        sess.settle_fn = old_fn
+                if settled_here and ch.conn_state == "connected":
+                    # the delivery settled synchronously (effective
+                    # qos0 / final drop): the replay marker is spent.
+                    # qos1/2 entries keep it until the ack settles
+                    # through the session's own settle_fn.
                     consumed.setdefault(sid, []).append(guid)
         for sid, guids in consumed.items():
             self._durable_consume(sid, guids)
@@ -1940,8 +2058,15 @@ class NativeBrokerServer:
 
         rows = store.fetch(tok)
         pers = self.app.persistent
+        # this process's python ids for Python-plane-persisted copies
+        # (the unified store): a takeover mqueue copy carries the
+        # python id, so the replay copy must dedup under the SAME id.
+        # take_pyid is DESTRUCTIVE — this drain consumes the markers,
+        # so the translations retire with the lookup (map hygiene)
+        pyid_of = getattr(pers.store, "take_pyid", None) \
+            if pers is not None else None
         out, guids = [], []
-        for guid, origin, ts, qos, dup, topic, body, trace in rows:
+        for guid, origin, ts, qos, dup, topic, body, trace, cid in rows:
             guids.append(guid)
             if trace:
                 # the persisted trace id re-joins its timeline: the
@@ -1957,9 +2082,14 @@ class NativeBrokerServer:
             # its markers were consumed (review finding) — the same
             # contract the Python store replay keeps in persistent.py
             filt = pers.router.match_filters(topic).get(sid, topic)
+            pyid = pyid_of(guid) if pyid_of is not None else None
             out.append(Message(
-                topic=topic, payload=body, qos=qos, from_="$durable",
-                id=self.DURABLE_GUID_BASE + guid,
+                # the persisted origin clientid keeps no-local honest
+                # across the restart (round 18)
+                topic=topic, payload=body, qos=qos,
+                from_=cid or "$durable",
+                id=(pyid if pyid is not None
+                    else self.DURABLE_GUID_BASE + guid),
                 flags={"retain": False, "dup": dup},
                 headers={"properties": {}, "protocol": "mqtt",
                          "sub_topic": filt},
@@ -2009,6 +2139,16 @@ class NativeBrokerServer:
             guids = [row[0] for row in store.fetch(tok)]
             if guids:
                 store.consume(tok, guids)
+        # retire the REGISTER/SESSION records too (round 18, the
+        # session-expiry GC contract): a discarded session's metadata
+        # must stop pinning segments. The store mints a FRESH token on
+        # re-registration, so the per-sid cache must drop the old one —
+        # a stale cached token would persist markers resume can no
+        # longer find (acked-but-lost).
+        store.unregister(sid)
+        self._durable_tok_cache.pop(sid, None)
+        with self._mirror_lock:
+            self._durable_tokens.pop(sid, None)
 
     # -- live plane handoff (round 10) --------------------------------------
 
@@ -2132,7 +2272,10 @@ class NativeBrokerServer:
             sess.inflight.max_size = max(1, budget - max_inflight)
             conn.recv_budget = budget
             conn.native_cap = max_inflight
-        self.host.enable_fast(conn.conn_id, ci.proto_ver, max_inflight)
+        # the clientid rides along (round 18): durable appends stamp it
+        # into persisted entries so no-local / from_ survive a restart
+        self.host.enable_fast(conn.conn_id, ci.proto_ver, max_inflight,
+                              ch.clientid or "")
         self._fast_conn_of[ch.clientid] = conn.conn_id
         if ch.clientid in self._traced_clientids():
             # a running clientid trace predates this connection: punt
@@ -3173,6 +3316,7 @@ class NativeBrokerServer:
                 == self._durable_drain):
             self.app.persistent.native_drain = None
             self.app.persistent.native_discard = None
+            self.app.persistent.native_ack = None
         if poll_dead:
             self._tick_pool.shutdown(wait=False)
             self.host.destroy()
@@ -3183,8 +3327,11 @@ class NativeBrokerServer:
                 self._shard_group = None
             if self._durable_store is not None:
                 # the host borrowed the store pointer; with the host
-                # destroyed (poll thread provably done) it can close
-                self._durable_store.close()
+                # destroyed (poll thread provably done) it can close —
+                # unless the app's persistence backend owns it (the
+                # shared one-recovery-path store outlives this server)
+                if getattr(self, "_durable_store_owned", True):
+                    self._durable_store.close()
                 self._durable_store = None
         else:  # pragma: no cover — pathological wedge
             # STICKY: a wedged poll thread may still be inside

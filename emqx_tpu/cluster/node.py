@@ -660,6 +660,11 @@ class ClusterNode:
             if state is not None:
                 session = Session(clientid=clientid, clean_start=False,
                                   **(session_opts or {}))
+                # consume-on-ack (round 18): sessions minted OUTSIDE
+                # CM.open_session must wire the settle seam too, or a
+                # durable-enabled node's acks would never spend their
+                # store replay markers (review finding)
+                self.app.cm._wire_settle(clientid, session)
                 for t, o in state["subscriptions"].items():
                     opts = codec.subopts_from_dict(o)
                     session.subscribe(t, opts)

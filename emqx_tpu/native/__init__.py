@@ -99,7 +99,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.emqx_host_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.emqx_host_enable_fast.restype = ctypes.c_int
     lib.emqx_host_enable_fast.argtypes = [
-        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32]
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_char_p]
+    lib.emqx_host_trunk_ident.restype = ctypes.c_int
+    lib.emqx_host_trunk_ident.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
     lib.emqx_host_disable_fast.restype = ctypes.c_int
     lib.emqx_host_disable_fast.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.emqx_host_sub_add.restype = ctypes.c_int
@@ -211,7 +215,34 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint16,
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
-        ctypes.c_uint32, ctypes.c_uint64]
+        ctypes.c_uint32, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint8]
+    lib.emqx_store_unregister.restype = ctypes.c_int
+    lib.emqx_store_unregister.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_store_put_session.restype = ctypes.c_int
+    lib.emqx_store_put_session.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint32]
+    lib.emqx_store_sessions.restype = ctypes.c_long
+    lib.emqx_store_sessions.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_store_trunk_put.restype = ctypes.c_int
+    lib.emqx_store_trunk_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_uint8, ctypes.c_char_p, ctypes.c_size_t]
+    lib.emqx_store_trunk_ack.restype = ctypes.c_int
+    lib.emqx_store_trunk_ack.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.emqx_store_trunk_fetch.restype = ctypes.c_long
+    lib.emqx_store_trunk_fetch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_store_trunk_pending.restype = ctypes.c_long
+    lib.emqx_store_trunk_pending.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p]
     lib.emqx_store_consume.restype = ctypes.c_long
     lib.emqx_store_consume.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64,
@@ -440,10 +471,12 @@ EV_SPANS = 12       # distributed-tracing spans + ledger (round 13)
 
 def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
     """Decode one kind-10 durable record into ``(base_guid, ts_ms,
-    [(origin_conn, flags, [tokens], topic, payload, trace_id), ...])``
-    — entry i's guid is ``base_guid + i``; flags bits1-2 = qos, bit3 =
-    publisher DUP (bit0 = payload-inline and bit4 = trace-id-present
-    are resolved here; trace_id is 0 for unsampled publishes)."""
+    [(origin_conn, flags, [tokens], topic, payload, trace_id, cid),
+    ...])`` — entry i's guid is ``base_guid + i``; flags bits1-2 =
+    qos, bit3 = publisher DUP (bit0 = payload-inline, bit4 =
+    trace-id-present and bit5 = clientid-present are resolved here;
+    trace_id is 0 for unsampled publishes, cid is "" when the
+    publisher's clientid was unknown)."""
     base = int.from_bytes(payload[0:8], "little")
     ts = int.from_bytes(payload[8:16], "little")
     n = int.from_bytes(payload[16:20], "little")
@@ -472,6 +505,13 @@ def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
                 break
             trace = int.from_bytes(payload[pos:pos + 8], "little")
             pos += 8
+        cid = ""
+        if flags & 0x20:
+            if pos + 1 > blen:
+                break
+            cl = payload[pos]
+            cid = payload[pos + 1:pos + 1 + cl].decode("utf-8", "replace")
+            pos += 1 + cl
         if flags & 1:
             if pos + 4 > blen:
                 break
@@ -479,7 +519,7 @@ def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
             pos += 4
             body = payload[pos:pos + plen]
             pos += plen
-        out.append((origin, flags, toks, topic, body, trace))
+        out.append((origin, flags, toks, topic, body, trace, cid))
     return base, ts, out
 
 
@@ -699,7 +739,8 @@ WIRE_FIELDS: dict[int, frozenset] = {
     10: frozenset({("u64", "base_guid"), ("u64", "ts_ms"), ("u32", "n"),
                    ("u64", "origin"), ("u8", "flags"), ("u16", "ntok"),
                    ("u64", "token"), ("u16", "tlen"),
-                   ("u64", "trace_id"), ("u32", "plen")}),
+                   ("u64", "trace_id"), ("u8", "cidlen"),
+                   ("u32", "plen")}),
     11: frozenset({("u32", "n_aw"), ("u16", "pid"), ("u32", "n_if"),
                    ("u8", "state"), ("u32", "n"), ("u32", "len")}),
     12: frozenset({("u64", "trace_id"), ("u8", "stage"), ("u64", "t_ns"),
@@ -1044,12 +1085,25 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "traced_pubs", "span_batches", "faults_injected",
               # conn-scale plane (round 16): hibernation + accept shed
               "conns_parked", "conns_inflated", "conns_shed",
-              "parked_pings")
+              "parked_pings",
+              # one-recovery-path plane (round 18): the trunk qos1
+              # replay ring is store-backed
+              "trunk_ring_persisted", "trunk_ring_recovered")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
                     "segments", "gc_segments", "rewrites", "torn_drops",
-                    "bytes", "degraded")
+                    "bytes", "degraded",
+                    # one-recovery-path plane (round 18)
+                    "replay_bytes", "sessions", "trunk_pending",
+                    "meta_rewrites")
+
+# durable-store on-disk record types (store.h kRec* constants — the
+# record catalog of the ONE recovery path; tests/test_native_wire_lint
+# pins name/value parity against the C++ side)
+STORE_RECORD_TYPES = {"msg_batch": 1, "consume": 2, "register": 3,
+                      "rewrite": 4, "session": 5, "unregister": 6,
+                      "trunk": 7, "trunk_ack": 8}
 
 # subscription-entry flags (router.h)
 SUB_PUNT, SUB_NO_LOCAL, SUB_RULE_TAP, SUB_REMOTE = 1, 2, 4, 8
@@ -1136,15 +1190,102 @@ class NativeStore:
 
     def append(self, origin: int, qos: int, tokens: list[int],
                topic: str, payload: bytes, dup: bool = False,
-               trace: int = 0) -> int:
-        """Single-message append (test surface); returns the guid.
-        ``trace`` persists a sampled trace id with the entry."""
+               trace: int = 0, cid: str = "") -> int:
+        """Single-message append (Python-plane persistence + test
+        surface); returns the guid. ``trace`` persists a sampled trace
+        id with the entry; ``cid`` persists the publisher's clientid
+        (no-local / from_ attribution across restart)."""
         toks = (ctypes.c_uint64 * max(1, len(tokens)))(*tokens)
         t = topic.encode()
+        c = (cid or "").encode()
+        if len(c) > 255:
+            # the bit5 extension carries a u8 length: an oversized
+            # clientid is DROPPED (from_ degrades to "$durable", the
+            # pre-round-18 behavior), never truncated — a truncated
+            # prefix could falsely equal ANOTHER client's id and
+            # wrongly suppress its no-local delivery. Mirrors the C++
+            # kEnableFast bound.
+            c = b""
         flags = (qos << 1) | (8 if dup else 0)
         return int(self._lib.emqx_store_append(
             self._h, origin, flags, toks, len(tokens),
-            t, len(t), payload, len(payload), trace))
+            t, len(t), payload, len(payload), trace, c, len(c)))
+
+    def unregister(self, sid: str) -> None:
+        """Retire a sid's REGISTER token (session-expiry GC): the
+        sid→token mapping, SESSION record, and leftover markers die
+        with it, so a dead session stops pinning segments."""
+        tok = self.lookup(sid)
+        if tok:
+            self._lib.emqx_store_unregister(self._h, tok)
+
+    def put_session(self, sid: str, body: bytes) -> None:
+        """Write the sid's session-catalog record (subscriptions +
+        expiry metadata — the bytes the Python JSON DiskStore used to
+        hold). Registers the sid when needed."""
+        tok = self.register(sid)
+        self._lib.emqx_store_put_session(self._h, tok, body, len(body))
+
+    def delete_session(self, sid: str) -> None:
+        tok = self.lookup(sid)
+        if tok:
+            self._lib.emqx_store_put_session(self._h, tok, b"", 0)
+
+    def sessions(self) -> list[tuple[str, bytes]]:
+        """All live session-catalog records as (sid, body) — the boot
+        walk of the one recovery path."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        n = self._lib.emqx_store_sessions(self._h, ctypes.byref(out),
+                                          ctypes.byref(out_len))
+        raw = ctypes.string_at(out, out_len.value)
+        self._lib.emqx_buf_free(out)
+        entries, pos = [], 0
+        for _ in range(n):
+            sl = int.from_bytes(raw[pos + 8:pos + 10], "little")
+            sid = raw[pos + 10:pos + 10 + sl].decode("utf-8", "replace")
+            pos += 10 + sl
+            bl = int.from_bytes(raw[pos:pos + 4], "little")
+            body = raw[pos + 4:pos + 4 + bl]
+            pos += 4 + bl
+            entries.append((sid, body))
+        return entries
+
+    def trunk_put(self, name: str, seq: int, record: bytes,
+                  has_trace: bool = False) -> None:
+        """Journal one trunk replay-ring record under the peer NODE
+        NAME (raw test surface; the host's data plane journals through
+        its attached store)."""
+        self._lib.emqx_store_trunk_put(
+            self._h, name.encode(), seq, 1 if has_trace else 0,
+            record, len(record))
+
+    def trunk_ack(self, name: str, seq: int) -> None:
+        self._lib.emqx_store_trunk_ack(self._h, name.encode(), seq)
+
+    def trunk_fetch(self, name: str) -> list[tuple[int, bool, bytes]]:
+        """The named peer's persisted ring in seq order:
+        ``[(seq, has_trace, record bytes), ...]``."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        n = self._lib.emqx_store_trunk_fetch(
+            self._h, name.encode(), ctypes.byref(out),
+            ctypes.byref(out_len))
+        raw = ctypes.string_at(out, out_len.value)
+        self._lib.emqx_buf_free(out)
+        entries, pos = [], 0
+        for _ in range(n):
+            seq = int.from_bytes(raw[pos:pos + 8], "little")
+            tf = raw[pos + 8]
+            rl = int.from_bytes(raw[pos + 9:pos + 13], "little")
+            pos += 13
+            entries.append((seq, bool(tf & 1), raw[pos:pos + rl]))
+            pos += rl
+        return entries
+
+    def trunk_pending(self, name: str) -> int:
+        return int(self._lib.emqx_store_trunk_pending(
+            self._h, name.encode()))
 
     def consume(self, token: int, guids: list[int]) -> int:
         if not guids:
@@ -1155,9 +1296,10 @@ class NativeStore:
 
     def fetch(self, token: int) -> list[tuple]:
         """Pending messages for ``token`` in guid (arrival) order:
-        ``[(guid, origin, ts_ms, qos, dup, topic, payload, trace_id),
-        ...]`` — trace_id is 0 unless the appending publish was tagged
-        by the native trace sampler."""
+        ``[(guid, origin, ts_ms, qos, dup, topic, payload, trace_id,
+        cid), ...]`` — trace_id is 0 unless the appending publish was
+        tagged by the native trace sampler; cid is the persisted
+        origin clientid ("" = unknown)."""
         out = ctypes.POINTER(ctypes.c_uint8)()
         out_len = ctypes.c_size_t()
         n = self._lib.emqx_store_fetch(self._h, token,
@@ -1179,12 +1321,17 @@ class NativeStore:
             if flags & 0x10:
                 trace = int.from_bytes(raw[pos:pos + 8], "little")
                 pos += 8
+            cid = ""
+            if flags & 0x20:
+                cl = raw[pos]
+                cid = raw[pos + 1:pos + 1 + cl].decode("utf-8", "replace")
+                pos += 1 + cl
             plen = int.from_bytes(raw[pos:pos + 4], "little")
             pos += 4
             body = raw[pos:pos + plen]
             pos += plen
             entries.append((guid, origin, ts, (flags >> 1) & 3,
-                            bool(flags & 8), topic, body, trace))
+                            bool(flags & 8), topic, body, trace, cid))
         return entries
 
     def pending(self, token: int) -> int:
@@ -1375,6 +1522,13 @@ class NativeHost:
         self._lib.emqx_host_trunk_connect(self._h, peer_id,
                                           host.encode(), port)
 
+    def trunk_ident(self, peer_id: int, name: str) -> None:
+        """Bind ``peer_id`` to its stable NODE NAME: the durable store
+        keys the persisted trunk replay ring on it (peer ids renumber
+        per process). Call before trunk_connect so the previous life's
+        ring merges ahead of fresh traffic."""
+        self._lib.emqx_host_trunk_ident(self._h, peer_id, name.encode())
+
     def trunk_disconnect(self, peer_id: int, forget: bool = False) -> None:
         """Drop the peer link. ``forget=False`` keeps the replay ring
         for the next connect; ``forget=True`` erases the peer state."""
@@ -1402,9 +1556,13 @@ class NativeHost:
     # -- fast-path control plane (thread-safe) -----------------------------
 
     def enable_fast(self, conn: int, proto_ver: int,
-                    max_inflight: int = 0) -> None:
+                    max_inflight: int = 0, clientid: str = "") -> None:
+        """``clientid`` binds the conn's clientid for origin
+        attribution: durable appends persist it (flags bit5) so
+        no-local / from_ survive a restart."""
         self._lib.emqx_host_enable_fast(self._h, conn, proto_ver,
-                                        max_inflight)
+                                        max_inflight,
+                                        (clientid or "").encode())
 
     def disable_fast(self, conn: int) -> None:
         self._lib.emqx_host_disable_fast(self._h, conn)

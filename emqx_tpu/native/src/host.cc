@@ -87,6 +87,7 @@
 //                   + n x pre-parsed entries ([u64 origin][u8 flags]
 //                   [u16 ntok][u64 token x ntok][u16 tlen][topic] +
 //                   (flags bit4 ? [u64 trace_id]) +
+//                   (flags bit5 ? [u8 cidlen][origin clientid]) +
 //                   (flags bit0 ? [u32 plen][payload] : payload of the
 //                   PREVIOUS entry)) — the EXACT bytes appended to the
 //                   store (store.h kRecMsgBatch body), so the store
@@ -648,6 +649,7 @@ struct Op {
     kSharedAdd, kSharedDel, kSetLane, kLaneDeliver, kSetMaxQos,
     kSetInflightCap, kSetTrace, kSetTelemetry,
     kTrunkConnect, kTrunkDisconnect, kTrunkRouteAdd, kTrunkRouteDel,
+    kTrunkIdent,
     kDurableAdd, kDurableDel,
     kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift,
     kTrunkPeerState, kSetTracing, kSetTrunkWire, kSetTrunkAckTimeout,
@@ -730,6 +732,10 @@ enum StatSlot {
   kStConnsInflated,    // parked conns re-inflated (first byte/delivery)
   kStConnsShed,        // accepts shed (memory budget / max_conns)
   kStParkedPings,      // PINGREQs answered from the parked record
+  kStTrunkRingPersisted,  // trunk qos1 ring entries journaled into the
+                          // durable store (round 18)
+  kStTrunkRingRecovered,  // ring entries rebuilt from store segments
+                          // after a restart/reattach
   kStatCount
 };
 
@@ -1275,6 +1281,12 @@ class Host {
           if (op.max_inflight)
             it->second.max_inflight =
                 op.max_inflight < 0x7FFFu ? op.max_inflight : 0x7FFFu;
+          // the publisher's clientid (round 18): durable appends stamp
+          // it into the store (flags bit5) so no-local / from_
+          // attribution survive a restart. Side map, not Conn state —
+          // it must outlive park/inflate cycles.
+          if (!op.str.empty() && op.str.size() <= 255)
+            conn_cids_[op.owner] = op.str;
         }
         break;
       }
@@ -1380,7 +1392,28 @@ class Host {
         trunk::Peer& p = trunk_peers_[op.owner];
         p.addr = op.str;
         p.port = static_cast<uint16_t>(op.token);
+        TrunkRingLoad(op.owner, p);
         TrunkDial(op.owner, p);
+        break;
+      }
+      case Op::kTrunkIdent: {
+        // bind the peer id to its stable NODE NAME (round 18): the
+        // store keys trunk replay rings on it, since peer ids are
+        // minted per-process and a restart renumbers them
+        trunk::Peer& p = trunk_peers_[op.owner];
+        if (p.store_name.empty()) {
+          p.store_name = op.str;
+        } else if (p.store_name != op.str && p.unacked.empty()) {
+          // a name change with NOTHING journaled yet (e.g. a flush
+          // that raced ahead load-marked the fallback key): adopt the
+          // real name and re-open the one-shot merge, or the previous
+          // life's ring under the node name would never replay
+          // (review finding). With live ring entries the old key is
+          // authoritative — never strand their ack path.
+          p.store_name = op.str;
+          p.ring_loaded = false;
+        }
+        TrunkRingLoad(op.owner, p);
         break;
       }
       case Op::kTrunkDisconnect: {
@@ -1388,9 +1421,14 @@ class Host {
         if (it == trunk_peers_.end()) break;
         if (it->second.sock_tag) TrunkSockDead(it->second.sock_tag, "drop");
         // flags != 0 forgets the peer entirely (node left the cluster:
-        // routes are already gone, the replay ring dies with it);
+        // routes are already gone, the replay ring — including its
+        // store-backed records — dies with it);
         // flags == 0 keeps the state so a redial replays unacked qos1
-        if (op.flags) trunk_peers_.erase(op.owner);
+        if (op.flags) {
+          if (store_) store_->TrunkDrop(TrunkStoreName(op.owner,
+                                                       it->second));
+          trunk_peers_.erase(op.owner);
+        }
         break;
       }
       case Op::kTrunkRouteAdd:
@@ -2308,6 +2346,7 @@ class Host {
                             std::memory_order_relaxed);
     park_slab_.Free(pit->second);
     parked_.erase(pit);
+    conn_cids_.erase(id);
     if (notify)
       events_.push_back(EncodeRecord(3, id, reason, strlen(reason)));
   }
@@ -2703,6 +2742,22 @@ class Host {
     // the matching store append — and its policy fsync — landed, so a
     // kill -9 can never ack a message the store lost
     FlushDurables();
+    // the SAME discipline for trunk-routed qos1 (round 18): a dirty
+    // peer batch holding elevated entries seals NOW — its replay
+    // record journals into the store (TrunkPut + policy fsync) before
+    // any socket write of this read batch, so the publisher's PUBACK
+    // can never outrun the ring record a post-kill replay needs.
+    // qos0-only batches keep the cheaper cycle-end seal (nothing to
+    // replay, nothing a crash could lose that the contract covers).
+    if (store_ && !trunk_dirty_.empty()) {
+      for (uint64_t peer_id : trunk_dirty_) {
+        auto it = trunk_peers_.find(peer_id);
+        if (it != trunk_peers_.end() && it->second.q1_n) {
+          FlushTrunks();
+          break;
+        }
+      }
+    }
     if (dirty_.empty()) {
       flush_t0_ = 0;  // sampled publish had no targets: no flush stage
       return;
@@ -3413,21 +3468,30 @@ class Host {
                      std::string_view topic, std::string_view payload) {
     stats_[kStDurableIn].fetch_add(1, std::memory_order_relaxed);
     if (cur_trace_) SpanNote(kSpanStoreAppend, dur_tok_scratch_.size());
+    // the publisher's clientid persists with the entry (flags bit5):
+    // no-local and from_ attribution must survive a restart, and the
+    // origin conn id is meaningless in the next life
+    const std::string* cid = nullptr;
+    auto cit = conn_cids_.find(publisher);
+    if (cit != conn_cids_.end() && !cit->second.empty())
+      cid = &cit->second;
     for (size_t g = 0; g < dur_tok_scratch_.size();
          g += kDurMaxToksPerEntry)
       DurableAppendEntry(
-          publisher, qos, topic, payload, g,
+          publisher, qos, topic, payload, cid, g,
           std::min(dur_tok_scratch_.size(), g + kDurMaxToksPerEntry));
   }
 
   // @bounded(dur_buf_)
   void DurableAppendEntry(uint64_t publisher, uint8_t qos,
                           std::string_view topic, std::string_view payload,
+                          const std::string* cid,
                           size_t tok_begin, size_t tok_end) {
     size_t cap = TeleCap();
     size_t ntok = tok_end - tok_begin;
     size_t entry_max = 19 + 8 * ntok + 2 + topic.size() + 4
-                       + payload.size();
+                       + payload.size()
+                       + (cid ? 1 + cid->size() : 0);
     // 33 = 13-byte event-record header slot + 20-byte batch header
     // ([base_guid][ts][n]); both patched at flush (EmitTap's
     // seed-after-flush lesson: never append headerless post-flush)
@@ -3439,7 +3503,8 @@ class Host {
     memcpy(hdr, &publisher, 8);
     hdr[8] = static_cast<char>((dup_pl ? 0 : 1) | (qos << 1)
                                | (cur_dup_ ? 8 : 0)
-                               | (cur_trace_ ? 0x10 : 0));
+                               | (cur_trace_ ? 0x10 : 0)
+                               | (cid ? 0x20 : 0));
     uint16_t nt = static_cast<uint16_t>(ntok);
     memcpy(hdr + 9, &nt, 2);
     dur_buf_.append(hdr, 11);
@@ -3454,6 +3519,12 @@ class Host {
     // message so a resume replay can re-join its timeline
     if (cur_trace_)
       dur_buf_.append(reinterpret_cast<const char*>(&cur_trace_), 8);
+    // flags bit5 (round 18): the publisher's clientid (<= 255 bytes —
+    // kEnableFast refuses longer ones at the bind)
+    if (cid) {
+      dur_buf_.push_back(static_cast<char>(cid->size()));
+      dur_buf_.append(*cid);
+    }
     if (!dup_pl) {
       uint32_t pl = static_cast<uint32_t>(payload.size());
       dur_buf_.append(reinterpret_cast<const char*>(&pl), 4);
@@ -3703,6 +3774,59 @@ class Host {
       return;  // TrunkCompleteUp runs on the answer or the deadline
     }
     TrunkCompleteUp(peer_id, p);
+  }
+
+  // -- store-backed trunk ring (round 18) ---------------------------------
+  // The per-peer unacked qos1 ring journals into the durable store
+  // (kRecTrunk / kRecTrunkAck, keyed by peer NODE NAME): kill -9 of a
+  // node no longer loses the ring — the reconnect replay draws from
+  // recovered segments and the exact-match ack machinery retires store
+  // records alongside memory slots.
+
+  const std::string& TrunkStoreName(uint64_t peer_id, trunk::Peer& p) {
+    if (p.store_name.empty()) {
+      // raw/single-process fallback: tests that never call trunk_ident
+      // still get a stable-within-the-dir key
+      char buf[24];
+      snprintf(buf, sizeof(buf), "peer:%llu",
+               static_cast<unsigned long long>(peer_id));
+      p.store_name = buf;
+    }
+    return p.store_name;
+  }
+
+  // Merge the persisted ring into the in-memory one (once per peer
+  // life): runs before the first dial/journal so a recovered entry can
+  // never duplicate a live one.
+  void TrunkRingLoad(uint64_t peer_id, trunk::Peer& p) {
+    if (!store_ || p.ring_loaded) return;
+    p.ring_loaded = true;
+    if (!p.unacked.empty()) return;  // live ring exists: nothing to merge
+    uint8_t* blob = nullptr;
+    size_t blen = 0;
+    long n = store_->TrunkFetch(TrunkStoreName(peer_id, p), &blob, &blen);
+    size_t pos = 0;
+    uint64_t now = NowMs();
+    for (long i = 0; i < n && pos + 13 <= blen; i++) {
+      uint64_t seq;
+      memcpy(&seq, blob + pos, 8);
+      uint8_t tf = blob[pos + 8];
+      uint32_t rl;
+      memcpy(&rl, blob + pos + 9, 4);
+      pos += 13;
+      if (pos + rl > blen) break;
+      trunk::Unacked u;
+      u.seq = seq;
+      u.flush_ms = now;  // watchdog clock restarts at recovery
+      u.has_trace = (tf & 1) != 0;
+      u.q1_record.assign(reinterpret_cast<const char*>(blob + pos), rl);
+      pos += rl;
+      p.unacked.push_back(std::move(u));
+      if (seq >= p.next_seq) p.next_seq = seq + 1;
+      stats_[kStTrunkRingRecovered].fetch_add(1,
+                                              std::memory_order_relaxed);
+    }
+    free(blob);
   }
 
   // Negotiation resolved (answer arrived, deadline passed, or this
@@ -4097,6 +4221,10 @@ class Host {
   // a death mid-send) but its qos1 record replays on reconnect.
   void FlushTrunkPeer(uint64_t peer_id, trunk::Peer& p) {
     if (p.batch_n == 0) return;
+    // merge the previous life's persisted ring BEFORE minting this
+    // batch's seq: recovered entries carry the old (higher) seqs, and
+    // a fresh seq minted below them would regress the link's stream
+    if (store_ && !p.ring_loaded) TrunkRingLoad(peer_id, p);
     uint64_t seq = p.next_seq++;
     std::string body;
     body.reserve(12 + p.batch.size());
@@ -4116,6 +4244,17 @@ class Host {
       q1body += p.q1_batch;
       trunk::AppendRecord(&u.q1_record, trunk::kRecBatch, q1body.data(),
                           q1body.size());
+      if (store_) {
+        // journal the replay record BEFORE any socket write of this
+        // batch (the PUBACK-after-store discipline applied to the
+        // trunk): a kill -9 between the write and the journal could
+        // otherwise lose a batch the peer never processed
+        store_->TrunkPut(TrunkStoreName(peer_id, p), seq,
+                         u.has_trace ? 1 : 0, u.q1_record.data(),
+                         u.q1_record.size());
+        stats_[kStTrunkRingPersisted].fetch_add(
+            1, std::memory_order_relaxed);
+      }
     }
     if (p.up) {
       auto sit = trunk_socks_.find(p.sock_tag);
@@ -4206,6 +4345,10 @@ class Host {
     }
     if (telemetry_ && p.unacked.front().t0_ns)
       RecordHist(kHistTrunkRtt, NowNs() - p.unacked.front().t0_ns);
+    // the ack retires the STORE record alongside the memory slot
+    // (round 18): qos0-only entries were never journaled
+    if (store_ && !p.unacked.front().q1_record.empty())
+      store_->TrunkAck(TrunkStoreName(peer_id, p), seq);
     p.unacked.pop_front();
   }
 
@@ -6127,6 +6270,7 @@ class Host {
       close(it->second.fd);
     }
     conns_.erase(it);
+    conn_cids_.erase(id);
     if (notify)
       events_.push_back(EncodeRecord(3, id, reason, strlen(reason)));
   }
@@ -6139,6 +6283,11 @@ class Host {
   int port_ = 0;
   uint64_t next_id_ = 1;
   std::unordered_map<uint64_t, Conn> conns_;
+  // conn -> clientid (round 18, poll-thread-owned like conns_): set by
+  // kEnableFast, read by DurableAppend to stamp the origin clientid
+  // into persisted entries; a SIDE map (not Conn state) so it survives
+  // park/inflate cycles — erased only at real teardown
+  std::unordered_map<uint64_t, std::string> conn_cids_;
   std::deque<std::string> events_;  // encoded records awaiting pickup
   std::mutex mu_;
   std::vector<std::pair<uint64_t, std::string>> pending_;         // @guards(mu_)
@@ -6372,13 +6521,17 @@ int emqx_host_close_conn(void* h, uint64_t conn) {
 
 // --- fast-path control plane (thread-safe, applied on the poll thread) ----
 
+// ``clientid`` (nullable) binds the conn's clientid for origin
+// attribution: durable appends persist it (store entry flags bit5) so
+// no-local / from_ survive a restart (round 18).
 int emqx_host_enable_fast(void* h, uint64_t conn, int proto_ver,
-                          uint32_t max_inflight) {
+                          uint32_t max_inflight, const char* clientid) {
   emqx_native::Op op;
   op.kind = emqx_native::Op::kEnableFast;
   op.owner = conn;
   op.proto_ver = static_cast<uint8_t>(proto_ver);
   op.max_inflight = max_inflight;
+  if (clientid) op.str = clientid;
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
@@ -6575,9 +6728,22 @@ int emqx_host_trunk_connect(void* h, uint64_t peer, const char* addr,
   return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
 }
 
+// Bind a peer id to its stable NODE NAME (round 18): the durable store
+// keys the persisted trunk replay ring on it, since peer ids renumber
+// per process. Call before trunk_connect so the previous life's ring
+// merges ahead of fresh traffic.
+int emqx_host_trunk_ident(void* h, uint64_t peer, const char* name) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kTrunkIdent;
+  op.owner = peer;
+  op.str = name ? name : "";
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
 // Drop a peer link. forget=0 keeps the peer state (the qos1 replay
 // ring survives for the next connect); forget=1 erases it entirely
-// (the node left the cluster and its routes are gone).
+// (the node left the cluster and its routes are gone — including the
+// store-backed ring records).
 int emqx_host_trunk_disconnect(void* h, uint64_t peer, int forget) {
   emqx_native::Op op;
   op.kind = emqx_native::Op::kTrunkDisconnect;
@@ -6755,17 +6921,74 @@ uint64_t emqx_store_lookup(void* s, const char* sid) {
   return static_cast<emqx_native::store::DurableStore*>(s)->Lookup(sid);
 }
 
-// Single-message append (test surface / Python-plane callers); the
+// Single-message append (Python-plane persistence + test surface); the
 // data plane appends whole batches through the attached host instead.
-// `trace` != 0 persists a sampled trace id with the entry (flags bit4).
-// Returns the assigned guid (0 on a malformed call).
+// `trace` != 0 persists a sampled trace id with the entry (flags bit4);
+// `cid`/`cl` persist the publisher's clientid (flags bit5) so no-local
+// and from_ attribution survive a restart. Returns the assigned guid
+// (0 on a malformed call).
 uint64_t emqx_store_append(void* s, uint64_t origin, uint8_t flags,
                            const uint64_t* toks, uint16_t ntok,
                            const char* topic, uint16_t tlen,
                            const char* payload, uint32_t plen,
-                           uint64_t trace) {
+                           uint64_t trace, const char* cid, uint8_t cl) {
   return static_cast<emqx_native::store::DurableStore*>(s)->Append(
-      origin, flags, toks, ntok, topic, tlen, payload, plen, trace);
+      origin, flags, toks, ntok, topic, tlen, payload, plen, trace,
+      cid, cl);
+}
+
+// --- one-recovery-path surfaces (round 18) ---------------------------------
+
+// Retire a REGISTER token (session-expiry GC): sid→token mapping,
+// SESSION record, and leftover markers die with it.
+int emqx_store_unregister(void* s, uint64_t token) {
+  static_cast<emqx_native::store::DurableStore*>(s)->Unregister(token);
+  return 0;
+}
+
+// Write (blen > 0) or delete (blen == 0) a session-catalog record.
+int emqx_store_put_session(void* s, uint64_t token, const char* body,
+                           uint32_t blen) {
+  static_cast<emqx_native::store::DurableStore*>(s)->PutSession(
+      token, body ? body : "", blen);
+  return 0;
+}
+
+// All live session-catalog records as a malloc'd blob of
+// [u64 token][u16 sidlen][sid][u32 blen][body] entries (free with
+// emqx_buf_free). Returns the count — the boot walk.
+long emqx_store_sessions(void* s, uint8_t** out, size_t* out_len) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->FetchSessions(
+      out, out_len);
+}
+
+// Trunk replay-ring records, keyed by peer NODE NAME (the host's data
+// plane journals through these via its attached store; this is the
+// raw test/inspection surface).
+int emqx_store_trunk_put(void* s, const char* name, uint64_t seq,
+                         uint8_t tflags, const char* data, size_t len) {
+  static_cast<emqx_native::store::DurableStore*>(s)->TrunkPut(
+      name ? name : "", seq, tflags, data, len);
+  return 0;
+}
+
+int emqx_store_trunk_ack(void* s, const char* name, uint64_t seq) {
+  static_cast<emqx_native::store::DurableStore*>(s)->TrunkAck(
+      name ? name : "", seq);
+  return 0;
+}
+
+// The named ring in seq order as a malloc'd blob of
+// [u64 seq][u8 tflags][u32 len][record bytes] entries. Returns count.
+long emqx_store_trunk_fetch(void* s, const char* name, uint8_t** out,
+                            size_t* out_len) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->TrunkFetch(
+      name ? name : "", out, out_len);
+}
+
+long emqx_store_trunk_pending(void* s, const char* name) {
+  return static_cast<emqx_native::store::DurableStore*>(s)->TrunkPending(
+      name ? name : "");
 }
 
 // Consume (token, guid) markers; returns how many were live.

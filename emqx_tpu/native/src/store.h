@@ -22,21 +22,48 @@
 //             entry = [u64 origin][u8 flags][u16 ntok][u64 tok x ntok]
 //                     [u16 tlen][topic]
 //                     + (flags bit4 ? [u64 trace_id])
+//                     + (flags bit5 ? [u8 cidlen][origin clientid])
 //                     + (flags bit0 ? [u32 plen][payload]
 //                                   : payload of the PREVIOUS entry)
 //             guid of entry i = base_guid + i. flags: bit0 = payload
 //             inline (the kind-6 dedup discipline), bits1-2 = qos,
 //             bit3 = publisher DUP, bit4 = a sampled trace id follows
 //             the topic (round 13: the native tracing plane persists
-//             the id so a resume replay can re-join the trace). The
-//             SAME bytes ride up to Python as the kind-10 event
-//             payload — one buffer, two sinks.
+//             the id so a resume replay can re-join the trace), bit5 =
+//             the publisher's clientid follows (round 18: no-local and
+//             from_ attribution survive a restart — origin conn ids
+//             are meaningless in the next life). The SAME bytes ride
+//             up to Python as the kind-10 event payload — one buffer,
+//             two sinks.
 //   type 2  = CONSUME     [u32 n] + n x ([u64 token][u64 guid])
 //   type 3  = REGISTER    [u64 token][u16 len][sid utf-8]
 //   type 4  = REWRITE     like MSG BATCH but every entry is prefixed
 //             [u64 guid] (explicit ids: GC compaction re-homes LIVE
 //             messages from mostly-dead sealed segments, then unlinks
 //             them; [u64 ts_ms] header, no base_guid)
+//   type 5  = SESSION     [u64 token][u32 blen][body] — the session
+//             catalog record (round 18): subscriptions + expiry
+//             metadata the Python JSON DiskStore used to hold, keyed
+//             by the sid's REGISTER token. blen 0 deletes the entry.
+//             Newest record per token wins at recovery.
+//   type 6  = UNREGISTER  [u64 token] — retires a REGISTER (session
+//             expiry GC): the sid→token mapping, its SESSION record
+//             and any leftover markers die with it, so a dead
+//             session's records stop pinning segments.
+//   type 7  = TRUNK       [u16 nlen][peer name][u64 seq][u8 tflags]
+//             [record bytes] — one flushed-but-unacked trunk qos1
+//             replay record (round 18: the per-peer unacked ring,
+//             store-backed so kill -9 no longer loses it). Keyed by
+//             the PEER NODE NAME (peer ids are minted per-process).
+//             tflags bit0 = the record carries >= 1 trace id.
+//   type 8  = TRUNK ACK   [u16 nlen][peer name][u64 seq] — the peer
+//             acked that batch; seq UINT64_MAX drops the whole ring
+//             (peer forgotten).
+//
+// REGISTER / SESSION / TRUNK records are LIVE state, not a log tail:
+// they count toward their segment's live total, and GC re-journals the
+// survivors forward (meta_rewrites) before unlinking a segment — a
+// sid→token mapping must never die with an all-consumed segment.
 //
 // Recovery replays segments in id order; within a segment it stops at
 // the first bad frame (no resync marker — by construction only the
@@ -82,6 +109,13 @@ constexpr uint8_t kRecMsgBatch = 1;
 constexpr uint8_t kRecConsume = 2;
 constexpr uint8_t kRecRegister = 3;
 constexpr uint8_t kRecRewrite = 4;
+constexpr uint8_t kRecSession = 5;
+constexpr uint8_t kRecUnregister = 6;
+constexpr uint8_t kRecTrunk = 7;
+constexpr uint8_t kRecTrunkAck = 8;
+
+// TRUNK ACK seq sentinel: drop the named peer's whole ring.
+constexpr uint64_t kTrunkDropAll = ~0ull;
 
 constexpr int kFsyncNever = 0;
 constexpr int kFsyncBatch = 1;
@@ -103,6 +137,10 @@ enum StoreStat {
                     // fell back to anonymous (non-durable) segments —
                     // Python warns, since PUBACK-after-store keeps
                     // asserting a durability this segment cannot give
+  kSsReplayBytes,   // bytes handed back for replay (Fetch + TrunkFetch)
+  kSsSessions,      // live SESSION catalog records (gauge)
+  kSsTrunkPending,  // live trunk replay-ring records (gauge)
+  kSsMetaRewrites,  // REGISTER/SESSION/TRUNK records re-homed by GC
   kSsStatCount
 };
 
@@ -143,12 +181,22 @@ struct Segment {
 struct StoredMsg {
   std::string topic;
   std::string payload;
+  std::string cid;              // origin clientid ("" = unknown): the
+                                // no-local / from_ attribution that
+                                // must survive a restart (flags bit5)
   uint64_t origin = 0;
   uint64_t ts_ms = 0;
   uint64_t trace = 0;           // sampled trace id (0 = not sampled)
   uint8_t flags = 0;            // bits1-2 qos, bit3 dup (bit0 meaningless)
   uint32_t seg = 0;             // homing segment (GC bookkeeping)
   std::vector<uint64_t> toks;   // tokens still holding a marker
+};
+
+// One persisted trunk replay-ring entry (kRecTrunk).
+struct TrunkRec {
+  std::string bytes;            // the pre-framed qos1 wire record
+  uint8_t flags = 0;            // bit0 = carries >= 1 trace id
+  uint32_t seg = 0;             // homing segment (GC bookkeeping)
 };
 
 class DurableStore {
@@ -220,15 +268,126 @@ class DurableStore {
     auto it = token_of_.find(sid);
     if (it != token_of_.end()) return it->second;
     uint64_t tok = next_token_++;
-    token_of_[sid] = tok;
-    std::string body;
-    body.reserve(11 + sid.size());
-    AppendU64(&body, tok);
-    AppendU16(&body, static_cast<uint16_t>(sid.size()));
-    body += sid;
-    AppendFrame(kRecRegister, body.data(), body.size());
+    JournalRegister(tok, sid);
     MaybeSync();
     return tok;
+  }
+
+  // Retire a REGISTER token (session-expiry GC): the sid→token
+  // mapping, the SESSION catalog record, and any leftover markers die
+  // with it — a dead session must not pin segments. Thread-safe.
+  void Unregister(uint64_t token) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!sid_of_.count(token)) return;
+    std::string body;
+    AppendU64(&body, token);
+    AppendFrame(kRecUnregister, body.data(), body.size());
+    ApplyUnregister(token);
+    MaybeSync();
+  }
+
+  // -- session catalog (round 18) -----------------------------------------
+  // The subscription/expiry metadata the Python JSON DiskStore used to
+  // hold: one SESSION record per token, newest wins, deleted with
+  // blen 0. Thread-safe.
+
+  void PutSession(uint64_t token, const char* body, uint32_t blen) {
+    std::lock_guard<std::mutex> lk(mu_);
+    JournalSession(token, body, blen);
+    ApplySession(token, body, blen, active_ ? active_->id : 0);
+    MaybeSync();
+  }
+
+  // All live SESSION records as a malloc'd blob of
+  // [u64 token][u16 sidlen][sid][u32 blen][body] entries (the boot
+  // walk). Returns the count.
+  long FetchSessions(uint8_t** out, size_t* out_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string blob;
+    long n = 0;
+    for (auto& [tok, rec] : sess_) {
+      auto sit = sid_of_.find(tok);
+      if (sit == sid_of_.end()) continue;
+      AppendU64(&blob, tok);
+      AppendU16(&blob, static_cast<uint16_t>(sit->second.size()));
+      blob += sit->second;
+      AppendU32(&blob, static_cast<uint32_t>(rec.body.size()));
+      blob += rec.body;
+      n++;
+    }
+    uint8_t* buf =
+        static_cast<uint8_t*>(malloc(blob.size() ? blob.size() : 1));
+    memcpy(buf, blob.data(), blob.size());
+    *out = buf;
+    *out_len = blob.size();
+    return n;
+  }
+
+  // -- trunk replay ring (round 18) ---------------------------------------
+  // The per-peer unacked qos1 ring, store-backed: kill -9 of a node no
+  // longer loses it. Keyed by peer NODE NAME (peer ids are per-process).
+  // Thread-safe (the host's poll thread is the only product caller,
+  // but raw tests drive these from Python threads).
+
+  void TrunkPut(const std::string& name, uint64_t seq, uint8_t tflags,
+                const char* data, size_t len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    JournalTrunk(name, seq, tflags, data, len);
+    ApplyTrunk(name, seq, tflags, data, len,
+               active_ ? active_->id : 0);
+    MaybeSync();
+  }
+
+  void TrunkAck(const std::string& name, uint64_t seq) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = trunk_.find(name);
+    if (it == trunk_.end()) return;
+    if (seq != kTrunkDropAll && !it->second.count(seq)) return;
+    std::string body;
+    body.reserve(10 + name.size());
+    AppendU16(&body, static_cast<uint16_t>(name.size()));
+    body += name;
+    AppendU64(&body, seq);
+    AppendFrame(kRecTrunkAck, body.data(), body.size());
+    ApplyTrunkAck(name, seq);
+    MaybeSync();
+  }
+
+  // The named peer's persisted ring in seq order, as a malloc'd blob
+  // of [u64 seq][u8 tflags][u32 len][record bytes] entries. Returns
+  // the count — the host rebuilds its in-memory ring from this at
+  // reconnect after a restart.
+  long TrunkFetch(const std::string& name, uint8_t** out,
+                  size_t* out_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string blob;
+    long n = 0;
+    auto it = trunk_.find(name);
+    if (it != trunk_.end()) {
+      for (auto& [seq, rec] : it->second) {
+        AppendU64(&blob, seq);
+        blob.push_back(static_cast<char>(rec.flags));
+        AppendU32(&blob, static_cast<uint32_t>(rec.bytes.size()));
+        blob += rec.bytes;
+        n++;
+      }
+    }
+    stats_[kSsReplayBytes] += blob.size();
+    uint8_t* buf =
+        static_cast<uint8_t*>(malloc(blob.size() ? blob.size() : 1));
+    memcpy(buf, blob.data(), blob.size());
+    *out = buf;
+    *out_len = blob.size();
+    return n;
+  }
+
+  // Forget a peer's whole persisted ring (node left the cluster).
+  void TrunkDrop(const std::string& name) { TrunkAck(name, kTrunkDropAll); }
+
+  long TrunkPending(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = trunk_.find(name);
+    return it == trunk_.end() ? 0 : static_cast<long>(it->second.size());
   }
 
   // Reserve n contiguous guids for the batch about to be appended (the
@@ -267,12 +426,16 @@ class DurableStore {
   }
 
   // Single-message append (test surface + Python-plane callers).
+  // ``cid``/``cl`` persist the publisher's clientid (flags bit5) so
+  // no-local and from_ attribution survive a restart.
   uint64_t Append(uint64_t origin, uint8_t flags, const uint64_t* toks,
                   uint16_t ntok, const char* topic, uint16_t tlen,
                   const char* payload, uint32_t plen,
-                  uint64_t trace = 0) {
+                  uint64_t trace = 0, const char* cid = nullptr,
+                  uint8_t cl = 0) {
+    if (cid == nullptr) cl = 0;
     std::string body;
-    body.reserve(20 + 19 + 8 * ntok + tlen + 4 + plen);
+    body.reserve(20 + 19 + 8 * ntok + tlen + 4 + plen + 1 + cl);
     // reserve the guid properly: a bare next_guid_ read could collide
     // with a concurrent AllocGuids from the host's flush
     AppendU64(&body, AllocGuids(1));
@@ -280,12 +443,17 @@ class DurableStore {
     AppendU32(&body, 1);
     AppendU64(&body, origin);
     body.push_back(static_cast<char>(flags | 1              // inline
-                                     | (trace ? 0x10 : 0)));
+                                     | (trace ? 0x10 : 0)
+                                     | (cl ? 0x20 : 0)));
     AppendU16(&body, ntok);
     for (uint16_t i = 0; i < ntok; i++) AppendU64(&body, toks[i]);
     AppendU16(&body, tlen);
     body.append(topic, tlen);
     if (trace) AppendU64(&body, trace);
+    if (cl) {
+      body.push_back(static_cast<char>(cl));
+      body.append(cid, cl);
+    }
     AppendU32(&body, plen);
     body.append(payload, plen);
     uint64_t guid = RdU64(body.data());
@@ -316,8 +484,9 @@ class DurableStore {
 
   // Pending messages for a token, guid order (= arrival order), as a
   // malloc'd blob of [u64 guid][u64 origin][u64 ts_ms][u8 flags]
-  // [u16 tlen][topic] + (flags bit4 ? [u64 trace_id]) + [u32 plen]
-  // [payload] entries. Returns the count.
+  // [u16 tlen][topic] + (flags bit4 ? [u64 trace_id]) + (flags bit5 ?
+  // [u8 cidlen][clientid]) + [u32 plen][payload] entries. Returns the
+  // count.
   long Fetch(uint64_t token, uint8_t** out, size_t* out_len) {
     std::lock_guard<std::mutex> lk(mu_);
     std::string blob;
@@ -332,15 +501,21 @@ class DurableStore {
         AppendU64(&blob, m.origin);
         AppendU64(&blob, m.ts_ms);
         blob.push_back(static_cast<char>((m.flags & 0x0E)
-                                         | (m.trace ? 0x10 : 0)));
+                                         | (m.trace ? 0x10 : 0)
+                                         | (m.cid.empty() ? 0 : 0x20)));
         AppendU16(&blob, static_cast<uint16_t>(m.topic.size()));
         blob += m.topic;
         if (m.trace) AppendU64(&blob, m.trace);
+        if (!m.cid.empty()) {
+          blob.push_back(static_cast<char>(m.cid.size()));
+          blob += m.cid;
+        }
         AppendU32(&blob, static_cast<uint32_t>(m.payload.size()));
         blob += m.payload;
         n++;
       }
     }
+    stats_[kSsReplayBytes] += blob.size();
     uint8_t* buf = static_cast<uint8_t*>(malloc(blob.size() ? blob.size() : 1));
     memcpy(buf, blob.data(), blob.size());
     *out = buf;
@@ -379,6 +554,10 @@ class DurableStore {
     // so one huge live message can no longer pin an otherwise-dead
     // segment across gc cycles forever (AppendFrame rolls as needed
     // when the aged rewrite exceeds the current segment's room).
+    // Round 18: REGISTER/SESSION/TRUNK metadata counts as live too —
+    // a segment whose only live records are metadata re-homes them
+    // unconditionally (they are tiny) and unlinks, and any message
+    // victim carrying metadata re-journals it before the unlink.
     if (segs_.size() > 1) {
       // hashed victim set: Gc holds the SAME mutex the poll thread's
       // FlushDurables needs (and FlushDirty orders PUBACKs behind it),
@@ -387,13 +566,23 @@ class DurableStore {
       std::unordered_set<uint32_t> aged;
       uint64_t now = WallMs();
       size_t live_bytes = 0, live_msgs = 0;
+      // per-sealed-segment live MESSAGE counts: metadata-only segments
+      // take the unconditional re-home path, not the thin/age rules
+      std::unordered_map<uint32_t, size_t> seg_msgs;
+      for (auto& [guid, m] : msgs_) seg_msgs[m.seg]++;
+      std::unordered_set<uint32_t> meta_only;
       for (auto& [id, s] : segs_) {
         if (&s == active_ || s.live == 0) continue;
+        if (seg_msgs.find(id) == seg_msgs.end()) {
+          meta_only.insert(id);
+          continue;
+        }
         victims.insert(id);
         if (compact_age_ms_ && s.sealed_ms &&
             now >= s.sealed_ms + compact_age_ms_)
           aged.insert(id);
       }
+      bool rewrote = false;
       if (!victims.empty()) {
         // per-segment live bytes alongside the combined totals (one
         // O(M) sweep): the age trigger needs each candidate's own
@@ -447,9 +636,14 @@ class DurableStore {
             for (uint64_t t : m.toks) AppendU64(&body, t);
             AppendU16(&body, static_cast<uint16_t>(m.topic.size()));
             body += m.topic;
-            // bit4 survives in m.flags: recovery's ParseEntries expects
-            // the trace id after the topic for flagged entries
+            // bit4/bit5 survive in m.flags: recovery's ParseEntries
+            // expects the trace id / clientid after the topic for
+            // flagged entries
             if (m.flags & 0x10) AppendU64(&body, m.trace);
+            if (m.flags & 0x20) {
+              body.push_back(static_cast<char>(m.cid.size()));
+              body += m.cid;
+            }
             AppendU32(&body, static_cast<uint32_t>(m.payload.size()));
             body += m.payload;
           }
@@ -462,19 +656,32 @@ class DurableStore {
               stats_[kSsRewrites]++;
             }
           }
-          // the REWRITE record must be ON DISK before its victims are
-          // unlinked, regardless of the interval cadence: a crash in
-          // the gap would lose messages that were already durably
-          // acked — strictly worse than the policy's append-lag bound
-          if (active_ && active_->fd >= 0 && fsync_ != kFsyncNever)
-            SyncSeg(*active_);
-          for (uint32_t id : victims) {
-            auto it = segs_.find(id);
-            if (it != segs_.end()) {
-              DropSeg(it->second);
-              segs_.erase(it);
-              freed++;
-            }
+          rewrote = true;
+        } else {
+          victims.clear();
+        }
+      }
+      // unified unlink set: message victims (REWRITE written above)
+      // plus metadata-only segments; live metadata homed in ANY of
+      // them re-journals forward first — a sid→token mapping must
+      // never die with its segment
+      victims.insert(meta_only.begin(), meta_only.end());
+      if (!victims.empty()) {
+        rewrote = RehomeMeta(victims) || rewrote;
+        // the REWRITE / re-journaled metadata must be ON DISK before
+        // the victims are unlinked, regardless of the interval
+        // cadence: a crash in the gap would lose records that were
+        // already durably acked — strictly worse than the policy's
+        // append-lag bound
+        if (rewrote && active_ && active_->fd >= 0 &&
+            fsync_ != kFsyncNever)
+          SyncSeg(*active_);
+        for (uint32_t id : victims) {
+          auto it = segs_.find(id);
+          if (it != segs_.end()) {
+            DropSeg(it->second);
+            segs_.erase(it);
+            freed++;
           }
         }
       }
@@ -498,6 +705,12 @@ class DurableStore {
     }
     if (slot == kSsMessages) return static_cast<long>(msgs_.size());
     if (slot == kSsSegments) return static_cast<long>(segs_.size());
+    if (slot == kSsSessions) return static_cast<long>(sess_.size());
+    if (slot == kSsTrunkPending) {
+      long n = 0;
+      for (auto& [name, ring] : trunk_) n += static_cast<long>(ring.size());
+      return n;
+    }
     return static_cast<long>(stats_[slot]);
   }
 
@@ -567,6 +780,14 @@ class DurableStore {
         m.trace = RdU64(p + pos);
         pos += 8;
       }
+      if (m.flags & 0x20) {  // origin-clientid extension (round 18)
+        if (pos + 1 > len) return false;
+        uint8_t cl = static_cast<uint8_t>(p[pos]);
+        pos += 1;
+        if (pos + cl > len) return false;
+        m.cid.assign(p + pos, cl);
+        pos += cl;
+      }
       if (m.flags & 1) {
         if (pos + 4 > len) return false;
         uint32_t pl = RdU32(p + pos);
@@ -597,6 +818,173 @@ class DurableStore {
     if (sit != segs_.end()) sit->second.live++;
     stats_[kSsBytes] += m.topic.size() + m.payload.size();
     msgs_.emplace(guid, std::move(m));
+  }
+
+  // @locked(mu_) — clamped live-record counter delta for one segment
+  void SegLive(uint32_t seg, int d) {
+    auto it = segs_.find(seg);
+    if (it == segs_.end()) return;
+    if (d >= 0)
+      it->second.live += static_cast<uint32_t>(d);
+    else if (it->second.live >= static_cast<uint32_t>(-d))
+      it->second.live -= static_cast<uint32_t>(-d);
+    else
+      it->second.live = 0;
+  }
+
+  // @locked(mu_) — journal + index one REGISTER record into the active
+  // segment (fresh registration, recovery replays via ApplyRegister,
+  // GC re-homes call this again)
+  void JournalRegister(uint64_t tok, const std::string& sid) {
+    std::string body;
+    body.reserve(10 + sid.size());
+    AppendU64(&body, tok);
+    AppendU16(&body, static_cast<uint16_t>(sid.size()));
+    body += sid;
+    AppendFrame(kRecRegister, body.data(), body.size());
+    ApplyRegister(tok, sid, active_ ? active_->id : 0);
+  }
+
+  // @locked(mu_)
+  void ApplyRegister(uint64_t tok, const std::string& sid, uint32_t seg) {
+    auto rit = reg_seg_.find(tok);
+    if (rit != reg_seg_.end()) SegLive(rit->second, -1);
+    token_of_[sid] = tok;
+    sid_of_[tok] = sid;
+    reg_seg_[tok] = seg;
+    SegLive(seg, 1);
+    if (tok >= next_token_) next_token_ = tok + 1;
+  }
+
+  // @locked(mu_)
+  void ApplyUnregister(uint64_t tok) {
+    auto sit = sid_of_.find(tok);
+    if (sit != sid_of_.end()) {
+      token_of_.erase(sit->second);
+      sid_of_.erase(sit);
+    }
+    auto rit = reg_seg_.find(tok);
+    if (rit != reg_seg_.end()) {
+      SegLive(rit->second, -1);
+      reg_seg_.erase(rit);
+    }
+    ApplySession(tok, nullptr, 0, 0);
+    auto pit = pending_.find(tok);
+    if (pit != pending_.end()) {
+      std::vector<uint64_t> guids;
+      guids.reserve(pit->second.size());
+      for (auto& [g, _] : pit->second) guids.push_back(g);
+      for (uint64_t g : guids) ApplyConsume(tok, g);
+    }
+  }
+
+  // @locked(mu_) — ONE serializer per record type, shared by the
+  // fresh-write path and GC's RehomeMeta (a layout change must never
+  // diverge between them — review finding)
+  void JournalSession(uint64_t tok, const char* body, uint32_t blen) {
+    std::string rec;
+    rec.reserve(12 + blen);
+    AppendU64(&rec, tok);
+    AppendU32(&rec, blen);
+    if (blen) rec.append(body, blen);
+    AppendFrame(kRecSession, rec.data(), rec.size());
+  }
+
+  // @locked(mu_)
+  void JournalTrunk(const std::string& name, uint64_t seq, uint8_t tf,
+                    const char* data, size_t len) {
+    std::string body;
+    body.reserve(11 + name.size() + len);
+    AppendU16(&body, static_cast<uint16_t>(name.size()));
+    body += name;
+    AppendU64(&body, seq);
+    body.push_back(static_cast<char>(tf));
+    body.append(data, len);
+    AppendFrame(kRecTrunk, body.data(), body.size());
+  }
+
+  // @locked(mu_)
+  void ApplySession(uint64_t tok, const char* body, uint32_t blen,
+                    uint32_t seg) {
+    auto it = sess_.find(tok);
+    if (it != sess_.end()) {
+      SegLive(it->second.seg, -1);
+      sess_.erase(it);
+    }
+    if (blen == 0 || body == nullptr) return;
+    SessRec r;
+    r.body.assign(body, blen);
+    r.seg = seg;
+    SegLive(seg, 1);
+    sess_.emplace(tok, std::move(r));
+  }
+
+  // @locked(mu_)
+  void ApplyTrunk(const std::string& name, uint64_t seq, uint8_t tf,
+                  const char* data, size_t len, uint32_t seg) {
+    TrunkRec& r = trunk_[name][seq];
+    if (!r.bytes.empty()) SegLive(r.seg, -1);  // superseded (recovery)
+    r.bytes.assign(data, len);
+    r.flags = tf;
+    r.seg = seg;
+    SegLive(seg, 1);
+  }
+
+  // @locked(mu_)
+  void ApplyTrunkAck(const std::string& name, uint64_t seq) {
+    auto it = trunk_.find(name);
+    if (it == trunk_.end()) return;
+    if (seq == kTrunkDropAll) {
+      for (auto& [s, r] : it->second) SegLive(r.seg, -1);
+      trunk_.erase(it);
+      return;
+    }
+    auto rit = it->second.find(seq);
+    if (rit == it->second.end()) return;
+    SegLive(rit->second.seg, -1);
+    it->second.erase(rit);
+    if (it->second.empty()) trunk_.erase(it);
+  }
+
+  // @locked(mu_) — re-journal live REGISTER/SESSION/TRUNK records
+  // homed in the victim segments into the active one (GC must never
+  // unlink a sid→token mapping, a session catalog entry, or a trunk
+  // replay record with the segment that happens to hold it). Returns
+  // whether anything was journaled.
+  bool RehomeMeta(const std::unordered_set<uint32_t>& victims) {
+    bool any = false;
+    for (auto& [tok, seg] : reg_seg_) {
+      if (!victims.count(seg)) continue;
+      auto sit = sid_of_.find(tok);
+      if (sit == sid_of_.end()) continue;
+      // updates reg_seg_'s VALUE in place (no rehash mid-iteration)
+      JournalRegister(tok, sit->second);
+      stats_[kSsMetaRewrites]++;
+      any = true;
+    }
+    for (auto& [tok, rec] : sess_) {
+      if (!victims.count(rec.seg)) continue;
+      JournalSession(tok, rec.body.data(),
+                     static_cast<uint32_t>(rec.body.size()));
+      SegLive(rec.seg, -1);
+      rec.seg = active_ ? active_->id : 0;
+      SegLive(rec.seg, 1);
+      stats_[kSsMetaRewrites]++;
+      any = true;
+    }
+    for (auto& [name, ring] : trunk_) {
+      for (auto& [seq, rec] : ring) {
+        if (!victims.count(rec.seg)) continue;
+        JournalTrunk(name, seq, rec.flags, rec.bytes.data(),
+                     rec.bytes.size());
+        SegLive(rec.seg, -1);
+        rec.seg = active_ ? active_->id : 0;
+        SegLive(rec.seg, 1);
+        stats_[kSsMetaRewrites]++;
+        any = true;
+      }
+    }
+    return any;
   }
 
   // @locked(mu_)
@@ -843,10 +1231,27 @@ class DurableStore {
     if (type == kRecRegister && blen >= 10) {
       uint64_t tok = RdU64(body);
       uint16_t sl = RdU16(body + 8);
-      if (10u + sl <= blen) {
-        token_of_[std::string(body + 10, sl)] = tok;
-        if (tok >= next_token_) next_token_ = tok + 1;
+      if (10u + sl <= blen)
+        ApplyRegister(tok, std::string(body + 10, sl), seg);
+    } else if (type == kRecSession && blen >= 12) {
+      uint64_t tok = RdU64(body);
+      uint32_t bl = RdU32(body + 8);
+      if (12u + bl <= blen) ApplySession(tok, body + 12, bl, seg);
+    } else if (type == kRecUnregister && blen >= 8) {
+      ApplyUnregister(RdU64(body));
+    } else if (type == kRecTrunk && blen >= 11) {
+      uint16_t nl = RdU16(body);
+      if (2u + nl + 9 <= blen) {
+        std::string name(body + 2, nl);
+        uint64_t seq = RdU64(body + 2 + nl);
+        uint8_t tf = static_cast<uint8_t>(body[2 + nl + 8]);
+        ApplyTrunk(name, seq, tf, body + 2 + nl + 9,
+                   blen - 2 - nl - 9, seg);
       }
+    } else if (type == kRecTrunkAck && blen >= 10) {
+      uint16_t nl = RdU16(body);
+      if (2u + nl + 8 <= blen)
+        ApplyTrunkAck(std::string(body + 2, nl), RdU64(body + 2 + nl));
     } else if (type == kRecMsgBatch && blen >= 20) {
       uint64_t base = RdU64(body);
       uint64_t ts = RdU64(body + 8);
@@ -898,6 +1303,18 @@ class DurableStore {
   std::map<uint32_t, Segment> segs_;                        // @guards(mu_)
   Segment* active_ = nullptr;                               // @guards(mu_)
   std::unordered_map<std::string, uint64_t> token_of_;      // @guards(mu_)
+  std::unordered_map<uint64_t, std::string> sid_of_;        // @guards(mu_)
+  // token -> segment homing its current REGISTER record (GC re-home)
+  std::unordered_map<uint64_t, uint32_t> reg_seg_;          // @guards(mu_)
+  // session catalog (round 18): newest SESSION record per token
+  struct SessRec {
+    std::string body;
+    uint32_t seg = 0;
+  };
+  std::unordered_map<uint64_t, SessRec> sess_;              // @guards(mu_)
+  // trunk replay rings (round 18): peer name -> seq-ordered records
+  std::unordered_map<std::string,
+                     std::map<uint64_t, TrunkRec>> trunk_;  // @guards(mu_)
   std::unordered_map<uint64_t, StoredMsg> msgs_;            // @guards(mu_)
   // token -> ordered guid set (fetch replays in guid = arrival order)
   std::unordered_map<uint64_t,

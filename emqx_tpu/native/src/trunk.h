@@ -215,6 +215,15 @@ struct Peer {
   uint8_t wire_ver = 0;
   std::string addr;         // redial target (Python drives redial)
   uint16_t port = 0;
+  // stable store key for the persisted replay ring (round 18): the
+  // peer's NODE NAME, set by trunk_ident — peer ids renumber across
+  // restarts, so the ring must key on something that survives them.
+  // Empty = no ident yet; the host falls back to "peer:<id>" (raw
+  // single-process tests).
+  std::string store_name;
+  // the persisted ring was merged into `unacked` (or this peer started
+  // journaling fresh) — guards against a later load duplicating entries
+  bool ring_loaded = false;
   // HELLO sent on the live link, answer (or the bounded grace
   // deadline, for old peers that never answer) still pending: the
   // qos1 replay + the UP event wait for the negotiated version, so a

@@ -84,9 +84,12 @@ NATIVE = [
     # durable-session plane (round 10): .stored counts markers written
     # for publishes the C++ host persisted below the GIL (kind-10
     # reconciliation), .replayed counts messages drained from the
-    # native store on clean_start=false resume. Fixed slots: both
-    # render at zero and ride the $SYS metrics heartbeat.
+    # native store on clean_start=false resume, .settled counts
+    # markers spent at the SETTLE seam — subscriber ack / qos0 write /
+    # final drop, the round-18 consume-on-ack contract. Fixed slots:
+    # all render at zero and ride the $SYS metrics heartbeat.
     "messages.durable.stored", "messages.durable.replayed",
+    "messages.durable.settled",
     # degradation ledger (round 13): one fixed slot per ladder-decision
     # reason (DegradationLedger folds both the C++ kind-12 ledger
     # entries and the Python-plane decisions here), so every reason

@@ -102,6 +102,7 @@ def _render_hists(lines: list[str], hists: dict, node: str,
 def render(metrics=None, stats=None, extra: Optional[dict] = None,
            node: str = "emqx_tpu", native: Optional[dict] = None,
            native_shards: Optional[list] = None,
+           native_store: Optional[dict] = None,
            openmetrics: bool = False) -> str:
     lines: list[str] = []
     label = f'{{node="{node}"}}'
@@ -128,6 +129,14 @@ def render(metrics=None, stats=None, extra: Optional[dict] = None,
             mn = "emqx_native_" + name.replace(".", "_")
             lines.append(f"# TYPE {mn} gauge")
             typed_native.add(mn)
+            lines.append(f"{mn}{label} {val}")
+    if native_store:
+        # the durable store's slots (STORE_STAT_NAMES — round 18, the
+        # one-recovery-path surface: segment/session/trunk-ring gauges
+        # next to the append/replay counters)
+        for name, val in sorted(native_store.items()):
+            mn = "emqx_native_store_" + name.replace(".", "_")
+            lines.append(f"# TYPE {mn} gauge")
             lines.append(f"{mn}{label} {val}")
     if native_shards:
         # per-shard series under the same names, shard-labelled (round

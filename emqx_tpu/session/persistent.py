@@ -19,10 +19,22 @@ adds: surviving a *node restart*. Three pieces, mirroring the reference:
   (emqx_persistent_session.erl:275-310).
 
 Backends mirror the reference's trio: ``MemStore`` (ram copies),
-``DiskStore`` (append-only op log + compaction — the disc/rocksdb slot,
-kept host-side: SURVEY §5 "the HBM trie is a pure cache; persistence
-stays host-side"), and ``DummyStore`` (the null backend,
+``NativeDurableStore`` (the restart-surviving tier — session metadata,
+messages AND markers all live in the ONE native durable store,
+native/src/store.h, the same CRC-framed segments the C++ host appends
+below the GIL; the disc/rocksdb slot, kept host-side: SURVEY §5 "the
+HBM trie is a pure cache; persistence stays host-side"), and
+``DummyStore`` (the null backend,
 emqx_persistent_session_backend_dummy.erl).
+
+Round 18 (one recovery path): the JSON ``DiskStore`` op log is GONE —
+its ``sessions.log`` is boot-migrated once into the native store's
+SESSION/REGISTER/MSG records, so a persistence-enabled broker recovers
+everything (sessions, subscriptions, messages, markers, trunk rings)
+from one segment walk. Marker consumption moved from delivery-write
+time to the SETTLE seam (``Session.settle_fn`` → ``settle``): a conn
+that drops after the socket write but before the PUBACK keeps its
+marker, and restart resume retransmits the message.
 """
 
 from __future__ import annotations
@@ -36,6 +48,21 @@ from typing import Any, Optional
 from emqx_tpu.core import topic as T
 from emqx_tpu.core.message import Message, SubOpts, now_ms
 from emqx_tpu.router.trie import Trie
+
+# Native store guids map into Python message-id space in their own
+# window, so replayed-store copies and live copies of one message dedup
+# by id without ever false-matching (the round-10 contract;
+# broker/native_server.py re-exports this constant). Broker-minted ids
+# (core/message.py guid(): microsecond clock << 16) live far ABOVE this
+# window — bits 61+ are always set for them — so membership is the
+# exact bit-60-only test below, not a >= compare.
+DURABLE_GUID_BASE = 1 << 60
+
+
+def is_native_msg_id(mid: int) -> bool:
+    """True when ``mid`` is a native-store replay id (DURABLE_GUID_BASE
+    + guid): bit 60 set, nothing above it."""
+    return (mid >> 60) == 1
 
 
 def msg_to_dict(m: Message) -> dict:
@@ -174,111 +201,226 @@ class DummyStore(MemStore):
         pass
 
 
-class DiskStore(MemStore):
-    """Append-only JSON op log + in-memory index; compacts when the log
-    grows past ``compact_every`` ops. Restart-safe."""
+class NativeDurableStore(MemStore):
+    """The restart-surviving backend over the ONE native durable store
+    (native/src/store.h): session metadata rides SESSION records,
+    messages + markers ride MSG/CONSUME records under the sid's
+    REGISTER token — the exact records the C++ host's durable plane
+    appends below the GIL, so boot recovery is one segment walk shared
+    with the native server and the trunk replay ring.
 
-    def __init__(self, dir: str, compact_every: int = 10_000) -> None:
+    The old JSON ``DiskStore`` op log (``<dir>/sessions/sessions.log``)
+    is boot-migrated once into these records, then renamed
+    ``.migrated``.
+    """
+
+    persistent = True
+
+    def __init__(self, base_dir: str, segment_bytes: int = 4 << 20,
+                 fsync: str = "batch", native_store=None) -> None:
         super().__init__()
-        self.dir = dir
-        self.compact_every = compact_every
-        self._ops = 0
+        self.dir = base_dir
         self._lock = threading.RLock()
-        os.makedirs(dir, exist_ok=True)
-        self._path = os.path.join(dir, "sessions.log")
-        self._replay()
-        self._f = open(self._path, "a")
+        if native_store is None:
+            from emqx_tpu import native as _native
+            if not _native.available():
+                raise RuntimeError(
+                    f"native store unavailable: {_native.build_error()}")
+            store_dir = os.path.join(base_dir, "store") if base_dir else ""
+            if store_dir:
+                os.makedirs(store_dir, exist_ok=True)
+            native_store = _native.NativeStore(
+                store_dir, segment_bytes, fsync)
+        self.native = native_store
+        # python msg id <-> native guid for THIS process's live copies
+        # (after a restart no live copy carries a python id, so the
+        # maps start empty by construction); refcounted by surviving
+        # markers so they never grow past the pending set
+        self._guid_of: dict[int, int] = {}
+        self._pyid_of: dict[int, int] = {}
+        self._refs: dict[int, int] = {}
+        # the boot walk: session catalog out of SESSION records
+        for sid, body in self.native.sessions():
+            try:
+                MemStore.put_session(self, sid, json.loads(body.decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        if base_dir:
+            self._migrate(os.path.join(base_dir, "sessions",
+                                       "sessions.log"))
 
-    def _replay(self) -> None:
-        try:
-            with open(self._path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        op = json.loads(line)
-                    except ValueError:
-                        continue                  # torn tail write
-                    self._apply(op)
-                    self._ops += 1
-        except FileNotFoundError:
-            pass
+    # -- one-time JSON op-log migration -------------------------------------
 
-    def _apply(self, op: dict) -> None:
-        kind = op["op"]
-        if kind == "sess":
-            MemStore.put_session(self, op["sid"], op["rec"])
-        elif kind == "del_sess":
-            MemStore.delete_session(self, op["sid"])
-        elif kind == "msg":
-            MemStore.put_message(self, op["guid"], op["m"])
-        elif kind == "mark":
-            MemStore.put_marker(self, op["sid"], op["guid"], op["st"])
-        elif kind == "consume":
-            MemStore.consume_marker(self, op["sid"], op["guid"])
+    def _migrate(self, path: str) -> None:
+        """Fold a pre-round-18 DiskStore op log into native records,
+        then retire the file (renamed ``.migrated``) — the promised
+        one-shot boot migration.
 
-    def _log(self, op: dict) -> None:
-        with self._lock:
-            self._f.write(json.dumps(op) + "\n")
-            self._f.flush()
-            self._ops += 1
-            if self._ops >= self.compact_every:
-                self._compact()
+        Crash discipline (review finding): the log is CLAIMED first
+        (renamed ``.migrating``) before any append — a kill -9
+        mid-migration can therefore duplicate at most ONE crash
+        window's worth of appends on the resumed run (at-least-once),
+        never re-run the whole migration on every boot (the appends
+        mint fresh guids, so re-runs would not dedup)."""
+        claimed = path + ".migrating"
+        if os.path.exists(path):
+            os.replace(path, claimed)
+        if not os.path.exists(claimed):
+            return
+        path = claimed
+        sessions: dict[str, dict] = {}
+        messages: dict[int, dict] = {}
+        markers: dict[str, dict[int, str]] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    op = json.loads(line)
+                except ValueError:
+                    continue                      # torn tail write
+                kind = op.get("op")
+                if kind == "sess":
+                    sessions[op["sid"]] = op["rec"]
+                elif kind == "del_sess":
+                    sessions.pop(op["sid"], None)
+                    markers.pop(op["sid"], None)
+                elif kind == "msg":
+                    messages.setdefault(op["guid"], op["m"])
+                elif kind == "mark":
+                    markers.setdefault(op["sid"], {})[op["guid"]] = op["st"]
+                elif kind == "consume":
+                    markers.get(op["sid"], {}).pop(op["guid"], None)
+        for sid, rec in sessions.items():
+            self.put_session(sid, rec)
+        by_msg: dict[int, list[str]] = {}
+        for sid, marks in markers.items():
+            if sid not in sessions:
+                continue
+            for old_guid in marks:
+                by_msg.setdefault(old_guid, []).append(sid)
+        for old_guid, sids in by_msg.items():
+            d = messages.get(old_guid)
+            if d is None:
+                continue
+            toks = [self.native.register(s) for s in sids]
+            self.native.append(
+                0, int(d.get("qos", 0) or 0), toks, d["topic"],
+                base64.b64decode(d["payload"]),
+                dup=bool((d.get("flags") or {}).get("dup")),
+                cid=str(d.get("from") or ""))
+        self.native.sync()
+        os.replace(path, path.replace(".migrating", "") + ".migrated")
 
-    def _compact(self) -> None:
-        """Rewrite the log as the current state (drops consumed churn)."""
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            for sid, rec in self.sessions.items():
-                f.write(json.dumps({"op": "sess", "sid": sid, "rec": rec}) + "\n")
-            live = {g for ms in self.markers.values() for g in ms}
-            for guid, m in self.messages.items():
-                if guid in live:
-                    f.write(json.dumps({"op": "msg", "guid": guid, "m": m}) + "\n")
-            for sid, ms in self.markers.items():
-                for guid, st in ms.items():
-                    f.write(json.dumps(
-                        {"op": "mark", "sid": sid, "guid": guid, "st": st}) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        self._f.close()
-        os.replace(tmp, self._path)
-        self._f = open(self._path, "a")
-        self._ops = len(self.sessions) + len(self.messages) + sum(
-            len(m) for m in self.markers.values())
+    # -- session catalog -----------------------------------------------------
 
     def put_session(self, sid: str, record: dict) -> None:
-        MemStore.put_session(self, sid, record)
-        self._log({"op": "sess", "sid": sid, "rec": record})
+        with self._lock:
+            MemStore.put_session(self, sid, record)
+            self.native.put_session(sid, json.dumps(record).encode())
 
     def delete_session(self, sid: str) -> None:
-        MemStore.delete_session(self, sid)
-        self._log({"op": "del_sess", "sid": sid})
+        with self._lock:
+            MemStore.delete_session(self, sid)
+            self.native.delete_session(sid)
+            # retire the REGISTER token too (session-expiry GC): the
+            # sid→token mapping and any leftover markers must stop
+            # pinning segments once the session is gone
+            self.native.unregister(sid)
 
-    def put_message(self, guid: int, msg: dict) -> None:
-        if guid not in self.messages:
-            MemStore.put_message(self, guid, msg)
-            self._log({"op": "msg", "guid": guid, "m": msg})
+    # -- messages + markers (delegated to the native store) ------------------
 
-    def put_marker(self, sid: str, guid: int, sub_topic: str) -> None:
-        MemStore.put_marker(self, sid, guid, sub_topic)
-        self._log({"op": "mark", "sid": sid, "guid": guid, "st": sub_topic})
+    # the id-translation maps are an OPTIMIZATION (takeover dedup +
+    # consume-by-python-id); guids consumed through paths this class
+    # cannot see (the native server's drain/discard seams) can strand
+    # entries, so a hard cap bounds the worst case — losing an entry
+    # only means a marker lingers until the next resume drain spends it
+    _MAP_CAP = 65536
 
-    def consume_marker(self, sid: str, guid: int) -> None:
-        if guid in self.markers.get(sid, {}):
-            MemStore.consume_marker(self, sid, guid)
-            self._log({"op": "consume", "sid": sid, "guid": guid})
+    def persist(self, msg: Message, sids: list[str]) -> int:
+        """One store append covers the message AND every matching
+        session's marker (PersistentSessions.persist_message fast
+        seam)."""
+        with self._lock:
+            toks = [self.native.register(s) for s in sids]
+            guid = self.native.append(
+                0, msg.qos, toks, msg.topic, bytes(msg.payload or b""),
+                dup=bool((msg.flags or {}).get("dup")),
+                cid=str(msg.from_ or ""))
+            if guid:
+                if len(self._pyid_of) >= self._MAP_CAP:
+                    self._guid_of.clear()
+                    self._pyid_of.clear()
+                    self._refs.clear()
+                self._guid_of[msg.id] = guid
+                self._pyid_of[guid] = msg.id
+                self._refs[guid] = len(toks)
+            return len(toks)
+
+    def pyid_of(self, guid: int):
+        """This process's live python id for a native guid (None after
+        a restart) — lets replay copies dedup against takeover copies."""
+        return self._pyid_of.get(guid)
+
+    def take_pyid(self, guid: int):
+        """``pyid_of`` that also RETIRES the translation (the drain
+        consumed the guid's marker, so the entry is dead after this
+        lookup — review finding: entries pruned any other way leaked or
+        broke the takeover dedup)."""
+        with self._lock:
+            pyid = self._pyid_of.pop(guid, None)
+            self._refs.pop(guid, None)
+            if pyid is not None:
+                self._guid_of.pop(pyid, None)
+            return pyid
+
+    def consume_marker(self, sid: str, mid: int) -> None:
+        with self._lock:
+            tok = self.native.lookup(sid)
+            if not tok:
+                return
+            guid = (mid - DURABLE_GUID_BASE if is_native_msg_id(mid)
+                    else self._guid_of.get(mid))
+            if not guid:
+                return
+            if self.native.consume(tok, [guid]):
+                refs = self._refs.get(guid)
+                if refs is not None:
+                    if refs <= 1:
+                        self._refs.pop(guid, None)
+                        pyid = self._pyid_of.pop(guid, None)
+                        if pyid is not None:
+                            self._guid_of.pop(pyid, None)
+                    else:
+                        self._refs[guid] = refs - 1
+
+    def pending(self, sid: str) -> list[tuple[int, str]]:
+        # messages live natively; resume replays them through drain()
+        # (or the native server's drain seam) instead of this view
+        return []
+
+    def drain(self, sid: str) -> list[tuple]:
+        """Fetch + consume the sid's whole pending set (restart-resume
+        replay). Returns native fetch rows: (guid, origin, ts, qos,
+        dup, topic, payload, trace, cid)."""
+        with self._lock:
+            tok = self.native.lookup(sid)
+            if not tok:
+                return []
+            rows = self.native.fetch(tok)
+            if rows:
+                self.native.consume(tok, [r[0] for r in rows])
+                # NOTE: the id-translation entries for these guids are
+                # retired by the caller's take_pyid (it still needs the
+                # pyid for the takeover dedup) — never here
+            return rows
 
     def gc_messages(self) -> int:
-        with self._lock:
-            n = MemStore.gc_messages(self)
-            if n:
-                self._compact()
-            return n
+        return int(self.native.gc())
 
     def close(self) -> None:
-        self._f.close()
+        self.native.close()
 
 
 class PersistentSessions:
@@ -298,11 +440,14 @@ class PersistentSessions:
         # native durable plane seams (round 10, set by
         # broker/native_server.py when its below-the-GIL store is
         # attached): messages persisted by the C++ host live in ITS
-        # store, not this one — resume merges both, discard drops both.
-        # native_drain(sid) -> list[Message] fetches + consumes the
-        # native pending set; native_discard(sid) drops it.
+        # store — with a NativeDurableStore backend it is the SAME
+        # store, one recovery path. native_drain(sid) -> list[Message]
+        # fetches + consumes the native pending set; native_discard(sid)
+        # drops it; native_ack(sid, [guid]) spends markers at the
+        # settle seam (consume-on-ack, round 18).
         self.native_drain = None
         self.native_discard = None
+        self.native_ack = None
         # optional global cap on stored-session expiry (config
         # durable.session_expiry): gc() treats each session's expiry as
         # min(its own, this) when set — the operator's retention bound
@@ -365,6 +510,10 @@ class PersistentSessions:
             sids = self.router.match_filters(msg.topic)
             if not sids:
                 return 0
+            if hasattr(self.store, "persist"):
+                # native-backed store: ONE append covers the message
+                # and every marker (the kRecMsgBatch multi-token shape)
+                return self.store.persist(msg, list(sids))
             d = msg_to_dict(msg)
             self.store.put_message(msg.id, d)
             n = 0
@@ -373,9 +522,25 @@ class PersistentSessions:
                 n += 1
             return n
 
+    def settle(self, sid: str, mid) -> None:
+        """A delivery SETTLED (subscriber ack / effective-qos0 write /
+        final drop): spend its replay marker now — never at
+        delivery-write time, so a conn death between the socket write
+        and the ack keeps the marker and restart resume retransmits
+        (``Session.settle_fn`` wires here via the CM)."""
+        if not isinstance(mid, int) or mid <= 0:
+            return
+        if is_native_msg_id(mid) and self.native_ack is not None:
+            # a native-plane guid with the native server attached: its
+            # consume seam owns the token bookkeeping
+            self.native_ack(sid, [mid - DURABLE_GUID_BASE])
+            return
+        with self._lock:
+            self.store.consume_marker(sid, mid)
+
     def mark_delivered(self, sid: str, msg_ids: list[int]) -> None:
-        """Connected-path consumption: the message reached the session's
-        window, so its replay marker is spent."""
+        """Legacy delivery-time consumption (pre-settle-seam callers
+        and tests): spends markers immediately."""
         with self._lock:
             for mid in msg_ids:
                 self.store.consume_marker(sid, mid)
@@ -414,8 +579,38 @@ class PersistentSessions:
                 seen = {m.id for m in out}
                 out.extend(m for m in self.native_drain(sid)
                            if m.id not in seen)
+            elif hasattr(self.store, "drain"):
+                # native-backed store WITHOUT a native server (asyncio
+                # broker on the one recovery path): drain the store's
+                # pending set directly
+                seen = {m.id for m in out}
+                for row in self.store.drain(sid):
+                    m = self._native_row_msg(sid, row)
+                    if m.id not in seen:
+                        out.append(m)
             out.sort(key=lambda m: m.timestamp)
             return subs, out
+
+    def _native_row_msg(self, sid: str, row: tuple) -> Message:
+        """One native fetch row -> a deliverable Message: ids translate
+        back to this process's python id when the copy is live (takeover
+        dedup), else map into the disjoint DURABLE_GUID_BASE space."""
+        guid, _origin, ts, qos, dup, topic, body, _trace, cid = row
+        pyid = None
+        if hasattr(self.store, "take_pyid"):
+            # destructive: the drain already consumed this guid's
+            # marker, so the translation retires with this lookup
+            pyid = self.store.take_pyid(guid)
+        filt = self.router.match_filters(topic).get(sid, topic)
+        return Message(
+            topic=topic, payload=body, qos=qos,
+            from_=cid or "$durable",
+            id=pyid if pyid is not None else DURABLE_GUID_BASE + guid,
+            flags={"retain": False, "dup": dup},
+            headers={"properties": {}, "protocol": "mqtt",
+                     "sub_topic": filt},
+            timestamp=ts,
+        )
 
     def discard(self, sid: str, *args) -> None:
         with self._lock:

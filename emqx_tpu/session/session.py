@@ -34,6 +34,10 @@ class InflightEntry:
     sent_at: int
     qos: int
     subopts: "SubOpts" = None  # as-delivered opts (subid/rap survive retry)
+    # the delivered message's broker id, kept past pubrec's msg=None
+    # drop so the PUBCOMP settle (store marker consume-on-ack) can
+    # still name it (round 18)
+    msg_id: int = 0
 
 
 class SessionError(Exception):
@@ -62,6 +66,16 @@ class Session:
         self.mqueue = MQueue(self.mqueue_opts)
         self.awaiting_rel: dict[int, int] = {}     # packet_id -> ts
         self._next_pkt_id = 0
+        # delivery-settlement observer (round 18, the one-recovery-path
+        # contract): called with a message id when that delivery will
+        # never need a store replay again — the subscriber ACKED it
+        # (PUBACK/PUBCOMP), it went out at effective qos0 (no ack
+        # exists), or it was dropped for good (no-local, expiry, late
+        # unsubscribe, mqueue overflow). The persistence layer consumes
+        # its replay marker HERE, not at delivery-write time: a conn
+        # that drops after the socket write but before the ack keeps
+        # its marker, so restart resume retransmits the message.
+        self.settle_fn = None
         # native ack-plane mirror (broker/native_server.py): the C++
         # host owns the window state for pids >= 32768 and reports ONE
         # batched ack record per poll cycle; these gauges are that
@@ -128,6 +142,13 @@ class Session:
 
     # -- outbound delivery (broker → client) -------------------------------
 
+    def _settle(self, msg_id) -> None:
+        """This delivery will never need a store replay again: tell the
+        persistence layer to spend its marker (no-op when unwired or
+        the message was never persisted)."""
+        if self.settle_fn is not None and msg_id:
+            self.settle_fn(msg_id)
+
     def deliver(self, deliveries: list[tuple[str, Message]],
                 now: Optional[int] = None) -> list[P.Packet]:
         """Route matched messages into the window/queue; return PUBLISH
@@ -137,21 +158,30 @@ class Session:
         for sub_topic, msg in deliveries:
             opts = self.subscriptions.get(sub_topic)
             if opts is None:
-                # late delivery after unsubscribe — drop
+                # late delivery after unsubscribe — drop (settled: the
+                # subscription is gone, a replay would drop it again)
+                self._settle(msg.id)
                 continue
             if opts.nl and msg.from_ == self.clientid:
+                self._settle(msg.id)
                 continue  # MQTT5 no-local
             qos = max(opts.qos, msg.qos) if self.upgrade_qos else min(opts.qos, msg.qos)
             if msg.is_expired(now):
+                self._settle(msg.id)
                 continue
             if qos == 0:
                 out.append(self._pub_packet(None, msg, qos, opts))
+                # effective qos0 has no ack: the socket write is final
+                self._settle(msg.id)
             elif self.inflight.is_full():
+                # mqueue drops do NOT settle: the store is a superset —
+                # resume replays what the bounded queue had to shed
                 self.mqueue.insert(self._with_sub(msg, sub_topic))
             else:
                 pid = self.next_packet_id()
                 self.inflight.insert(
-                    pid, InflightEntry(pid, msg, "publish", now, qos, opts)
+                    pid, InflightEntry(pid, msg, "publish", now, qos,
+                                       opts, msg.id)
                 )
                 out.append(self._pub_packet(pid, msg, qos, opts))
         return out
@@ -182,9 +212,12 @@ class Session:
         """Buffer while disconnected (persistent sessions, :594-607)."""
         opts = self.subscriptions.get(sub_topic)
         if opts is None:
+            self._settle(msg.id)
             return
         if opts.nl and msg.from_ == self.clientid:
+            self._settle(msg.id)
             return
+        # mqueue drops do NOT settle: resume replays from the store
         self.mqueue.insert(self._with_sub(msg, sub_topic))
 
     # -- acks --------------------------------------------------------------
@@ -195,6 +228,9 @@ class Session:
         an outgoing publish the client's Maximum-Packet-Size forbids is
         dropped): release the window slot regardless of QoS/phase and
         pull the next queued messages into it."""
+        entry = self.inflight.lookup(packet_id)
+        if entry is not None:
+            self._settle(entry.msg_id)
         self.inflight.delete(packet_id)
         return self.dequeue(now)
 
@@ -203,6 +239,10 @@ class Session:
         entry = self.inflight.lookup(packet_id)
         if entry is None or entry.phase != "publish" or entry.qos != 1:
             raise SessionError(P.RC_PACKET_IDENTIFIER_NOT_FOUND)
+        # the ack is the settlement point (round 18): only now is the
+        # store's replay marker spent — a conn death between the write
+        # and this PUBACK keeps it, so restart resume retransmits
+        self._settle(entry.msg_id)
         self.inflight.delete(packet_id)
         return self.dequeue(now)
 
@@ -224,6 +264,9 @@ class Session:
         entry = self.inflight.lookup(packet_id)
         if entry is None or entry.phase != "pubrel":
             raise SessionError(P.RC_PACKET_IDENTIFIER_NOT_FOUND)
+        # qos2 settlement: PUBCOMP ends the exchange (msg_id survives
+        # pubrec's msg=None drop exactly for this)
+        self._settle(entry.msg_id)
         self.inflight.delete(packet_id)
         return self.dequeue(now)
 
@@ -293,16 +336,20 @@ class Session:
             sub_topic = msg.headers.get("sub_topic", msg.topic)
             opts = self.subscriptions.get(sub_topic)
             if opts is None:
+                self._settle(msg.id)   # late unsubscribe: final drop
                 continue
             qos = max(opts.qos, msg.qos) if self.upgrade_qos else min(opts.qos, msg.qos)
             if msg.is_expired(now):
+                self._settle(msg.id)
                 continue
             if qos == 0:
                 out.append(self._pub_packet(None, msg, qos, opts))
+                self._settle(msg.id)
             else:
                 pid = self.next_packet_id()
                 self.inflight.insert(
-                    pid, InflightEntry(pid, msg, "publish", now, qos, opts)
+                    pid, InflightEntry(pid, msg, "publish", now, qos,
+                                       opts, msg.id)
                 )
                 out.append(self._pub_packet(pid, msg, qos, opts))
         return out
@@ -322,6 +369,7 @@ class Session:
                 out.append(P.PubRel(packet_id=pid))
             elif entry.msg is not None:
                 if entry.msg.is_expired(now):
+                    self._settle(entry.msg_id)  # expired: final drop
                     self.inflight.delete(pid)
                     continue
                 # reuse the as-delivered subopts so Subscription-Identifier

@@ -38,7 +38,8 @@ pytestmark = pytest.mark.skipif(
 from emqx_tpu.app import BrokerApp                              # noqa: E402
 from emqx_tpu.broker.native_server import NativeBrokerServer    # noqa: E402
 from emqx_tpu.mqtt.client import MqttClient                     # noqa: E402
-from emqx_tpu.session.persistent import DiskStore, MemStore     # noqa: E402
+from emqx_tpu.session.persistent import (                       # noqa: E402
+    MemStore, NativeDurableStore)
 
 
 def run(coro):
@@ -152,7 +153,7 @@ def test_oversized_durable_entry_still_reaches_python():
         assert got, "oversized durable record never surfaced"
         _base, _ts, entries = got[0]
         assert len(entries) == 1
-        origin, flags, etoks, topic, ebody, _trace = entries[0]
+        origin, flags, etoks, topic, ebody, _trace, _cid = entries[0]
         assert sorted(etoks) == sorted(toks)
         assert topic == "ov/t" and ebody == payload
         assert store.stats()["appends"] == 1
@@ -482,7 +483,7 @@ def test_restart_installs_durable_entries_for_offline_sessions(tmp_path):
     sess_dir = str(tmp_path / "sessions")
     store_dir = str(tmp_path / "store")
 
-    app1 = BrokerApp(persistent_store=DiskStore(sess_dir))
+    app1 = BrokerApp(persistent_store=NativeDurableStore(sess_dir))
     s1 = NativeBrokerServer(port=0, app=app1, durable_dir=store_dir)
     s1.start()
 
@@ -499,7 +500,7 @@ def test_restart_installs_durable_entries_for_offline_sessions(tmp_path):
     app1.persistent.store.close()
 
     # restart: the subscriber is OFFLINE; fast traffic flows first
-    app2 = BrokerApp(persistent_store=DiskStore(sess_dir))
+    app2 = BrokerApp(persistent_store=NativeDurableStore(sess_dir))
     s2 = NativeBrokerServer(port=0, app=app2, durable_dir=store_dir)
     s2.start()
     try:
@@ -598,9 +599,14 @@ def test_discard_race_orphan_markers_consumed_on_sight():
                  + struct.pack("<I", 4) + b"late")
         server._on_durable(struct.pack("<QQI", guid, 0, 1) + entry)
         assert store.pending(tok) == 0          # orphan spent
-        # a fresh persistent life of the sid revives the journaled token
-        assert server._durable_token("rx-ps") == tok
-        assert tok not in server._durable_dead
+        # round 18: the discard RETIRED the journaled token
+        # (unregister) — a fresh persistent life mints a NEW one, and
+        # the old token stays dead so straggler batches keep consuming
+        # on sight
+        new_tok = server._durable_token("rx-ps")
+        assert new_tok != tok
+        assert tok in server._durable_dead
+        assert new_tok not in server._durable_dead
     finally:
         server.stop()
 
@@ -674,9 +680,9 @@ def test_escape_hatch_restores_punt_behavior(monkeypatch):
 
 
 def test_config_wires_durable_store(tmp_path):
-    """durable.enable boots PersistentSessions on a DiskStore under
-    <data_dir>/durable and points the native server's store next to it
-    (satellite: config/schema wiring)."""
+    """durable.enable boots PersistentSessions on the native-backed
+    store under <data_dir>/durable and the native server attaches to
+    the SAME store instance — one recovery path (round 18)."""
     from emqx_tpu.config.config import Config
 
     conf = Config()
@@ -684,10 +690,12 @@ def test_config_wires_durable_store(tmp_path):
     conf.put("node.data_dir", str(tmp_path))
     app = BrokerApp.from_config(conf)
     assert app.persistent is not None
-    assert isinstance(app.persistent.store, DiskStore)
+    assert isinstance(app.persistent.store, NativeDurableStore)
     server = NativeBrokerServer(port=0, app=app)
     try:
         assert server._durable_store is not None
+        # the server shares the app's store instance (no second mmap)
+        assert server._durable_store is app.persistent.store.native
         assert server._durable_store.dir == os.path.join(
             str(tmp_path), "durable", "store")
         assert os.path.isdir(server._durable_store.dir)
@@ -704,9 +712,9 @@ sys.path.insert(0, %(repo)r)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from emqx_tpu.app import BrokerApp
 from emqx_tpu.broker.native_server import NativeBrokerServer
-from emqx_tpu.session.persistent import DiskStore
+from emqx_tpu.session.persistent import NativeDurableStore
 
-app = BrokerApp(persistent_store=DiskStore(%(sess)r))
+app = BrokerApp(persistent_store=NativeDurableStore(%(sess)r))
 server = NativeBrokerServer(port=0, app=app, durable_dir=%(store)r,
                             durable_fsync="batch")
 server.start()
@@ -753,7 +761,7 @@ def test_kill9_restart_resume_zero_qos1_loss(tmp_path):
         proc.wait(timeout=10)
 
         # restart on the same directories, in-process
-        app = BrokerApp(persistent_store=DiskStore(sess_dir))
+        app = BrokerApp(persistent_store=NativeDurableStore(sess_dir))
         server = NativeBrokerServer(port=0, app=app, durable_dir=store_dir,
                                     durable_fsync="batch")
         # the native store recovered the acked messages
@@ -799,3 +807,206 @@ def test_parse_handoff_roundtrip_shapes():
     rec2 = bytes([2]) + struct.pack("<I", 1) + struct.pack("<I", len(frame)) \
         + frame
     assert native.parse_handoff(rec2)["pending"] == [frame]
+
+
+# -- one recovery path (round 18) ---------------------------------------------
+
+def test_written_unacked_delivery_retransmits_after_restart(tmp_path):
+    """Tentpole acceptance (round 18): a qos1 delivery WRITTEN to the
+    subscriber's socket but never ACKED keeps its store marker
+    (consume-on-ack) — after a restart, clean_start=false resume
+    retransmits it. The pre-round-18 plane consumed the marker at
+    delivery-write time and lost exactly this message. Once the
+    retransmitted copy IS acked, the marker settles for good: a third
+    boot replays nothing."""
+    base = str(tmp_path / "ps")
+    app1 = BrokerApp(persistent_store=NativeDurableStore(base))
+    s1 = NativeBrokerServer(port=0, app=app1)
+    s1.start()
+
+    async def phase1():
+        ps = MqttClient(port=s1.port, clientid="wu-ps",
+                        clean_start=False, proto_ver=5, auto_ack=False,
+                        properties={"Session-Expiry-Interval": 600})
+        await ps.connect()
+        await ps.subscribe("wu/t", qos=1)
+        pub = MqttClient(port=s1.port, clientid="wu-pp")
+        await pub.connect()
+        await pub.publish("wu/t", b"written-not-acked", qos=1)
+        pkt = await ps.recv(timeout=10)      # written to the wire...
+        assert pkt.payload == b"written-not-acked"
+        await ps.close()                     # ...but never acked
+        await pub.close()
+
+    run(phase1())
+    s1.stop()
+    app1.persistent.store.close()
+
+    app2 = BrokerApp(persistent_store=NativeDurableStore(base))
+    s2 = NativeBrokerServer(port=0, app=app2)
+    s2.start()
+
+    async def phase2():
+        ps = MqttClient(port=s2.port, clientid="wu-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 600})
+        await ps.connect()
+        got = (await ps.recv(timeout=10)).payload   # auto-acked now
+        assert got == b"written-not-acked"
+        await asyncio.sleep(0.4)                    # ack settles marker
+        await ps.close()
+
+    run(phase2())
+    s2.stop()
+    app2.persistent.store.close()
+
+    app3 = BrokerApp(persistent_store=NativeDurableStore(base))
+    s3 = NativeBrokerServer(port=0, app=app3)
+    s3.start()
+    try:
+        async def phase3():
+            ps = MqttClient(port=s3.port, clientid="wu-ps",
+                            clean_start=False, proto_ver=5,
+                            properties={"Session-Expiry-Interval": 600})
+            await ps.connect()
+            with pytest.raises(asyncio.TimeoutError):   # settled: gone
+                await ps.recv(timeout=0.8)
+            await ps.close()
+
+        run(phase3())
+    finally:
+        s3.stop()
+        app3.persistent.store.close()
+
+
+def test_no_local_survives_restart(tmp_path):
+    """The persisted origin clientid (entry flags bit5) keeps MQTT5
+    no-local honest across a restart: a session's OWN publishes must
+    not replay to it, while another publisher's do. Pre-round-18 the
+    replay's from_ was "$durable", so the no-local filter never
+    matched and the session received its own message back."""
+    base = str(tmp_path / "ps")
+    app1 = BrokerApp(persistent_store=NativeDurableStore(base))
+    s1 = NativeBrokerServer(port=0, app=app1)
+    s1.start()
+
+    async def phase1():
+        ps = MqttClient(port=s1.port, clientid="nl-ps",
+                        clean_start=False, proto_ver=5, auto_ack=False,
+                        properties={"Session-Expiry-Interval": 600})
+        await ps.connect()
+        await ps.subscribe("nl/t", qos=1, nl=1)
+        # its own publish: no-local means it must never come back
+        await ps.publish("nl/t", b"mine", qos=1)
+        # someone else's publish: must replay after the restart
+        pub = MqttClient(port=s1.port, clientid="nl-pp")
+        await pub.connect()
+        await pub.publish("nl/t", b"theirs", qos=1)
+        # neither is acked by nl-ps: "theirs" was delivered unacked
+        # (marker kept), "mine" was dropped by no-local live
+        await asyncio.sleep(0.5)
+        await ps.close()
+        await pub.close()
+
+    run(phase1())
+    s1.stop()
+    app1.persistent.store.close()
+
+    app2 = BrokerApp(persistent_store=NativeDurableStore(base))
+    s2 = NativeBrokerServer(port=0, app=app2)
+    s2.start()
+    try:
+        async def phase2():
+            ps = MqttClient(port=s2.port, clientid="nl-ps",
+                            clean_start=False, proto_ver=5,
+                            properties={"Session-Expiry-Interval": 600})
+            await ps.connect()
+            got = []
+            while True:
+                try:
+                    got.append((await ps.recv(timeout=1.5)).payload)
+                except asyncio.TimeoutError:
+                    break
+            assert got == [b"theirs"], got
+            await ps.close()
+
+        run(phase2())
+    finally:
+        s2.stop()
+        app2.persistent.store.close()
+
+
+def test_fast_path_publish_persists_origin_clientid(tmp_path):
+    """The C++ durable plane stamps the publisher's clientid into the
+    store entry (conn_cids_ bound at enable_fast): after a restart the
+    drained rows still name the publisher."""
+    base = str(tmp_path / "ps")
+    app = BrokerApp(persistent_store=NativeDurableStore(base))
+    server = NativeBrokerServer(port=0, app=app)
+    server.start()
+
+    async def main():
+        ps = MqttClient(port=server.port, clientid="oc-ps",
+                        clean_start=False, proto_ver=5,
+                        properties={"Session-Expiry-Interval": 600})
+        await ps.connect()
+        await ps.subscribe("oc/t", qos=1)
+        await ps.close()                          # offline: markers keep
+        await asyncio.sleep(0.3)
+        pub = MqttClient(port=server.port, clientid="oc-fast-pub")
+        await pub.connect()
+        await pub.publish("oc/t", b"warm", qos=1)   # slow: earns permit
+        await asyncio.sleep(0.7)
+        for i in range(3):
+            await pub.publish("oc/t", f"f{i}".encode(), qos=1)
+        await asyncio.sleep(0.5)
+        st = server.fast_stats()
+        assert st["durable_in"] >= 3, st          # fast path persisted
+        await pub.close()
+
+    run(main())
+    server.stop()
+    app.persistent.store.close()
+
+    # reopen the bare store: every entry names the publisher
+    store2 = NativeDurableStore(base)
+    rows = store2.drain("oc-ps")
+    assert len(rows) >= 4
+    assert {r[8] for r in rows} == {"oc-fast-pub"}, rows
+    store2.close()
+
+
+def test_session_expiry_gc_retires_register_and_session_records(tmp_path):
+    """Satellite (round 18): the expiry GC retires a dead session's
+    REGISTER + SESSION records and markers, and the retirement
+    SURVIVES a reopen — age compaction can no longer pin a dead
+    session's segments."""
+    base = str(tmp_path / "ps")
+    store = NativeDurableStore(base)
+    from emqx_tpu.session.persistent import PersistentSessions
+    ps = PersistentSessions(store)
+    ps.router.add_route("gc/t", "gc-sid")
+    store.put_session("gc-sid", {"subs": {"gc/t": {"qos": 1}}, "ts": 0})
+    from emqx_tpu.core.message import Message
+    for i in range(4):
+        ps.persist_message(Message(topic="gc/t",
+                                   payload=f"m{i}".encode(), qos=1))
+    tok = store.native.lookup("gc-sid")
+    assert tok and store.native.pending(tok) == 4
+    assert store.native.stats()["sessions"] == 1
+    ps.note_disconnected("gc-sid", expiry_ms=1000, now=1_000_000)
+    ps.gc(now=1_002_000)                         # expired: discard
+    assert store.native.lookup("gc-sid") == 0    # REGISTER retired
+    assert store.native.stats()["sessions"] == 0
+    assert store.native.pending(tok) == 0
+    store.close()
+
+    store2 = NativeDurableStore(base)
+    assert store2.native.lookup("gc-sid") == 0   # retirement persisted
+    assert store2.native.stats()["sessions"] == 0
+    assert store2.get_session("gc-sid") is None
+    # the dead session's records no longer pin segments: GC can reach
+    # the all-consumed state and compaction has nothing to re-home
+    store2.native.gc()
+    assert store2.native.stats()["pending"] == 0
+    store2.close()

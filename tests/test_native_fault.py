@@ -27,6 +27,7 @@ every declared site to be named by at least one test):
 import asyncio
 import socket
 import struct
+import threading
 import time
 
 import pytest
@@ -681,3 +682,130 @@ def test_store_faults_fold_into_ledger_via_housekeep(tmp_path):
                    for e in srv.ledger.recent()), srv.ledger.recent()
     finally:
         srv.stop()
+
+
+# -- one-recovery-path seams (round 18) ---------------------------------------
+
+def test_store_eio_during_trunk_ring_append_ledger_visible(tmp_path):
+    """Satellite (round 18): store_msync EIO armed while the TRUNK
+    RING journals (FlushTrunkPeer → TrunkPut → policy fsync) drives
+    the real degradation ladder — the store flips degraded (sticky),
+    the fire counts in faults.store_msync, and the ledger carries the
+    fault. The ring itself keeps working: qos1 forwarding is
+    at-least-once via replay, never blocked on a dying disk."""
+    from emqx_tpu.session.persistent import NativeDurableStore
+
+    base = str(tmp_path / "nodeA")
+    app = BrokerApp(persistent_store=NativeDurableStore(base))
+    app.broker.node = "ftA"
+    srv = NativeBrokerServer(port=0, app=app, trunk_port=0)
+    srv.start()
+
+    # a never-acking sink: the ring provably holds (and journals) the
+    # batches while the fault is armed
+    sink = socket.socket()
+    sink.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+
+    def sink_loop():
+        try:
+            c, _ = sink.accept()
+            c.settimeout(0.2)
+            while True:
+                try:
+                    if not c.recv(65536):
+                        return
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+        except OSError:
+            return
+    threading.Thread(target=sink_loop, daemon=True).start()
+
+    try:
+        async def main():
+            pub = MqttClient(port=srv.port, clientid="ft-pub")
+            await pub.connect()
+            app.broker.router.add_route("ft/x", "ftB")
+            srv.trunk_register("ftB", "127.0.0.1",
+                               sink.getsockname()[1])
+            assert _wait(lambda: srv.trunk_peer_status().get("ftB"))
+            await pub.publish("ft/x", b"warm", qos=1)
+            await asyncio.sleep(0.6)
+            srv.fault_arm("store_msync", "errno", n_or_prob=2)
+            for i in range(4):
+                await pub.publish("ft/x", b"f%d" % i, qos=1)
+            assert _wait(
+                lambda: srv.fault_fired("store_msync") >= 2), (
+                srv.fault_fired("store_msync"))
+            await pub.close()
+
+        run(main())
+        # the ring journaled through the erroring disk (counted)...
+        st = srv.fast_stats()
+        assert st["trunk_ring_persisted"] >= 1, st
+        # ...the store flipped degraded (sticky)...
+        assert srv._durable_store.stats()["degraded"] >= 1
+        # ...and the chaos is ledger-visible + counted
+        srv._merge_fast_metrics()
+        assert srv.broker.metrics.val("faults.store_msync") >= 2
+        assert any(e["reason"] == "fault"
+                   and e["detail"] == "store_msync"
+                   for e in srv.ledger.recent()), srv.ledger.recent()
+    finally:
+        srv.stop()
+        app.persistent.store.close()
+        try:
+            sink.close()
+        except OSError:
+            pass
+
+
+def test_store_enospc_during_delivery_retention_append(tmp_path):
+    """Satellite (round 18): store_seg_open ENOSPC armed while the
+    durable plane appends retained-delivery bytes (the consume-on-ack
+    records a resume replay draws from) degrades to anonymous segments
+    — PUBACKs keep flowing, restart survival is loudly gone (degraded
+    counted, ledger store_degraded via housekeep)."""
+    from emqx_tpu.session.persistent import NativeDurableStore
+
+    base = str(tmp_path / "nodeB")
+    app = BrokerApp(persistent_store=NativeDurableStore(
+        base, segment_bytes=64 * 1024))
+    srv = NativeBrokerServer(port=0, app=app)
+    srv.start()
+    try:
+        async def main():
+            ps = MqttClient(port=srv.port, clientid="en-ps",
+                            clean_start=False, proto_ver=5,
+                            properties={"Session-Expiry-Interval": 600})
+            await ps.connect()
+            await ps.subscribe("en/t", qos=1)
+            await ps.close()                 # offline: appends retained
+            await asyncio.sleep(0.3)
+            pub = MqttClient(port=srv.port, clientid="en-pub")
+            await pub.connect()
+            srv.fault_arm("store_seg_open", "errno", n_or_prob=1)
+            # enough payload to force a segment Roll through the
+            # armed open → ENOSPC → anonymous-segment fallback
+            blob = b"x" * 24_000
+            for i in range(6):
+                await pub.publish("en/t", blob + b"%d" % i, qos=1)
+            assert _wait(
+                lambda: srv.fault_fired("store_seg_open") >= 1), (
+                srv.fault_fired("store_seg_open"))
+            await pub.close()
+
+        run(main())
+        assert srv._durable_store.stats()["degraded"] >= 1
+        srv._merge_fast_metrics()
+        assert srv.broker.metrics.val("faults.store_seg_open") >= 1
+        led = srv.ledger.totals()
+        assert led.get("store_degraded", 0) >= 1 or any(
+            e["reason"] == "fault" and e["detail"] == "store_seg_open"
+            for e in srv.ledger.recent()), (led, srv.ledger.recent())
+    finally:
+        srv.stop()
+        app.persistent.store.close()

@@ -252,6 +252,16 @@ def test_three_node_mesh_fault_schedule_fast(tmp_path):
         assert want_b <= set(got["b"]), sorted(want_b - set(got["b"]))
         assert want_c <= set(got["c"]), sorted(want_c - set(got["c"]))
 
+        # -- store-backed trunk ring (round 18): A's qos1 batches
+        # journaled into its durable store alongside the memory ring,
+        # and the peers' acks retired every record — the persisted
+        # ring tracks the live one, never a grow-forever journal
+        assert A.fast_stats()["trunk_ring_persisted"] >= 1, (
+            A.fast_stats())
+        assert _wait(
+            lambda: A._durable_store.stats()["trunk_pending"] == 0), (
+            A._durable_store.stats())
+
         # -- every injected fault is ledger-visible + counted
         assert A.fault_fired("trunk_write") >= 1
         assert A.fault_fired("ring_seal") >= 1
@@ -290,13 +300,20 @@ import sys, threading
 sys.path.insert(0, %(repo)r)
 from emqx_tpu.app import BrokerApp
 from emqx_tpu.broker.native_server import NativeBrokerServer
-from emqx_tpu.session.persistent import DiskStore
+from emqx_tpu.session.persistent import NativeDurableStore
 
-app = BrokerApp(persistent_store=DiskStore(%(sess_dir)r))
+# ONE recovery path (round 18): sessions, markers, messages AND the
+# trunk replay ring recover from the same store walk after the kill
+app = BrokerApp(persistent_store=NativeDurableStore(%(sess_dir)r))
 app.broker.node = "soakB"
 srv = NativeBrokerServer(port=%(port)d, app=app, trunk_port=%(trunk)d,
-                         durable_dir=%(dur_dir)r, durable_fsync="batch")
+                         durable_fsync="batch")
 srv.start()
+if %(trunk_a)d:
+    # B is also a trunk SENDER toward A: its outbound qos1 ring is the
+    # store-backed leg the kill -9 must not lose
+    app.broker.router.add_route("soak/a", "sA")
+    srv.trunk_register("sA", "127.0.0.1", %(trunk_a)d)
 print("READY", srv.port, srv.trunk_port, flush=True)
 threading.Event().wait()          # run until killed
 """
@@ -310,9 +327,9 @@ def _free_port():
     return p
 
 
-def _spawn_node_b(repo, port, trunk, sess_dir, dur_dir):
+def _spawn_node_b(repo, port, trunk, sess_dir, trunk_a=0):
     src = _NODE_B_SRC % {"repo": repo, "port": port, "trunk": trunk,
-                         "sess_dir": sess_dir, "dur_dir": dur_dir}
+                         "sess_dir": sess_dir, "trunk_a": trunk_a}
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen([sys.executable, "-c", src],
                             stdout=subprocess.PIPE, text=True, env=env)
@@ -324,16 +341,23 @@ def _spawn_node_b(repo, port, trunk, sess_dir, dur_dir):
 @pytest.mark.slow
 def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
     """The full acceptance soak: node B is a subprocess killed with
-    SIGKILL mid-qos1-stream (its durable store holds the trunk-acked
-    messages for the clean_start=false subscriber), the A<->C link is
-    blackholed mid-replay and healed, and node C's store takes an EIO
-    burst — after heal: zero acked-QoS1 loss (every payload the
-    publisher got a PUBACK for reaches its subscriber), at-least-once
-    dup bounds honored, the chaos ledger-visible on every node."""
+    SIGKILL mid-qos1-stream (its ONE durable store holds the session,
+    the markers, the messages AND its outbound trunk replay ring), the
+    A<->C link is blackholed mid-replay and healed, and node C's store
+    takes an EIO burst — after heal: zero acked-QoS1 loss (every
+    payload the publisher got a PUBACK for reaches its subscriber),
+    at-least-once dup bounds honored, the chaos ledger-visible on
+    every node.
+
+    Round 18 extends the soak to the remaining two legs: B's
+    subscriber stays CONNECTED through the kill (consume-on-ack keeps
+    the marker of a written-but-unacked delivery, so resume
+    retransmits — the closed PR-5 edge), and B is also a trunk SENDER
+    toward A whose store-backed ring replays from recovered segments
+    after the restart."""
     repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
     port_b, trunk_b = _free_port(), _free_port()
     sess_dir = str(tmp_path / "sessB")
-    dur_b = str(tmp_path / "durB")
 
     # nodes A and C in-process (A sharded: the spread rides the soak)
     apps = {}
@@ -367,9 +391,10 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
         srv.set_trunk_ack_timeout(500)
     A, C = servers["sA"], servers["sC"]
 
-    proc = _spawn_node_b(repo, port_b, trunk_b, sess_dir, dur_b)
-    got_b, got_c = [], []
-    acked_b, acked_c = [], []
+    trunk_a = A.trunk_port
+    proc = _spawn_node_b(repo, port_b, trunk_b, sess_dir, trunk_a)
+    got_b, got_c, got_a = [], [], []
+    acked_b, acked_c, acked_a = [], [], []
     try:
         A.trunk_register("soakB", "127.0.0.1", trunk_b)
         A.trunk_register("sC", "127.0.0.1", C.trunk_port)
@@ -381,12 +406,20 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
 
         async def main():
             nonlocal proc
-            # clean_start=false subscriber on B: its session (DiskStore)
-            # and its pending messages (B's durable store) survive kill
+            # clean_start=false subscriber on B: its session and its
+            # pending messages (B's ONE durable store) survive the kill
             sub_b = MqttClient(port=port_b, clientid="soaksub",
                                clean_start=False)
             await sub_b.connect()
             await sub_b.subscribe("soak/b", qos=1)
+            # the trunk-sender leg (round 18): a subscriber on A for
+            # the stream B publishes — B's outbound qos1 ring is
+            # store-backed, so B's kill must not lose acked publishes
+            sub_a = MqttClient(port=A.port, clientid="soaka")
+            await sub_a.connect()
+            await sub_a.subscribe("soak/a", qos=1)
+            pub_b = MqttClient(port=port_b, clientid="soakbpub")
+            await pub_b.connect()
             # persistent: trunk-received publishes persist in C's
             # durable store — the EIO phase's prey
             sub_c = MqttClient(port=C.port, clientid="soakc",
@@ -420,8 +453,12 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
 
             await pub.publish("soak/b", b"warm", qos=1)
             await pub.publish("soak/c", b"warm", qos=1)
+            # B's sender leg warms its permit through B's python lane
+            # (forward_fn-less: the warm publish is excluded from the
+            # acked set) — later publishes ride B's trunk to A
+            await pub_b.publish("soak/a", b"warm", qos=1)
             assert (await sub_c.recv(timeout=12)).payload == b"warm"
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(0.7)
 
             async def pub_acked(topic, payload, sink):
                 # qos1 publish() returns after PUBACK: every payload in
@@ -433,6 +470,11 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
             for i in range(10):
                 await pub_acked("soak/b", b"h%03d" % i, acked_b)
                 await pub_acked("soak/c", b"g%03d" % i, acked_c)
+            for i in range(6):
+                # B PUBACKs only after the ring record journaled (the
+                # FlushDirty ordering) — acked means replayable
+                await pub_b.publish("soak/a", b"a%03d" % i, qos=1)
+                acked_a.append(b"a%03d" % i)
             deadline = time.monotonic() + 25
             while (len([p for p in got_b if p != b"warm"]) < 10
                    and time.monotonic() < deadline):
@@ -442,27 +484,41 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
                     continue
                 got_b.append(m.payload)
 
-            # the subscriber goes OFFLINE before the kill window: a
-            # delivery written-but-unacked at SIGKILL time is the
-            # documented PR-5 edge (bytes not retained in C++ —
-            # ROADMAP); the soak's claim is the BROKER-side pipeline:
-            # acked publish -> trunk/replay-ring -> B's durable store
-            # -> clean_start=false resume, across a kill -9
-            await sub_b.close()
-            # let the disconnect settle at B: a publish racing it could
-            # still be marker-consumed into the PYTHON session's
-            # in-memory inflight (the same PR-5 edge), which kill -9
-            # then drops — the soak's window starts with the session
-            # provably offline
-            await asyncio.sleep(0.8)
+            # round 18: the subscriber STAYS CONNECTED through the
+            # kill window. Consume-on-ack means a delivery written to
+            # its socket but unacked at SIGKILL time keeps its store
+            # marker, so the clean_start=false resume RETRANSMITS it —
+            # the PR-5 edge ("written-but-unacked cannot retransmit"),
+            # closed. Acked deliveries consumed their markers and are
+            # already counted through the client's local queue below.
 
             # -- KILL -9 node B mid-stream (no goodbye): some of these
             # land durably in B (trunk-acked after fsync=batch), the
-            # in-flight rest stays in A's replay ring
+            # in-flight rest stays in A's replay ring; B's OWN sender
+            # burst journals into its store-backed ring mid-flush
             for i in range(10, 16):
                 await pub_acked("soak/b", b"h%03d" % i, acked_b)
+            for i in range(6, 12):
+                await pub_b.publish("soak/a", b"a%03d" % i, qos=1)
+                acked_a.append(b"a%03d" % i)
             os.kill(proc.pid, signal.SIGKILL)
             proc.wait(timeout=10)
+            # whatever B's subscriber already received (and auto-acked
+            # — markers consumed) must count before the socket dies
+            while True:
+                try:
+                    m = await sub_b.recv(timeout=1.0)
+                except Exception:  # noqa: BLE001 — quiet or conn died
+                    break
+                got_b.append(m.payload)
+            try:
+                await sub_b.close()
+            except Exception:  # noqa: BLE001 — socket died with B
+                pass
+            try:
+                await pub_b.close()
+            except Exception:  # noqa: BLE001
+                pass
             assert _wait(
                 lambda: not A.trunk_peer_status().get("soakB"), 15)
             # acked publishes keep flowing: the down window rides the
@@ -470,9 +526,12 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
             for i in range(16, 22):
                 await pub_acked("soak/b", b"h%03d" % i, acked_b)
 
-            # -- RESTART B; mid-replay, BLACKHOLE the A<->C link
+            # -- RESTART B; mid-replay, BLACKHOLE the A<->C link.
+            # B's child re-registers its "sA" peer at boot: trunk_ident
+            # merges the persisted ring from recovered segments and the
+            # reconnect replays it into A (the sender leg's zero-loss)
             proc = _spawn_node_b(repo, port_b, trunk_b, sess_dir,
-                                 dur_b)
+                                 trunk_a)
             A.fault_arm("trunk_write", "blackhole", key=pid_c)
             A.fault_arm("trunk_read", "blackhole", key=pid_c)
             for i in range(10, 18):
@@ -519,7 +578,9 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
             await relay_pending()
             await drain(sub_b2, got_b, set(acked_b))
             await drain(sub_c, got_c, set(acked_c))
-            for c in (pub, sub_b2, sub_c):
+            # the sender leg: B's recovered ring replayed into A
+            await drain(sub_a, got_a, set(acked_a))
+            for c in (pub, sub_b2, sub_c, sub_a):
                 try:
                     await c.close()
                 except (ConnectionError, OSError):
@@ -532,9 +593,13 @@ def test_three_node_mesh_kill_partition_heal_soak(tmp_path):
             set(acked_b) - set(got_b))
         assert set(acked_c) <= set(got_c), sorted(
             set(acked_c) - set(got_c))
+        # the store-backed trunk ring leg: B's acked publishes reached
+        # A through live delivery or the post-restart segment replay
+        assert set(acked_a) <= set(got_a), sorted(
+            set(acked_a) - set(got_a))
         # -- at-least-once dup bound: replays may duplicate, but each
         # payload at most once per reconnect leg (generous bound: 4)
-        for name, sink in (("b", got_b), ("c", got_c)):
+        for name, sink in (("b", got_b), ("c", got_c), ("a", got_a)):
             for p in set(sink):
                 assert sink.count(p) <= 4, (name, p, sink.count(p))
         # -- chaos is ledger-visible on the injecting nodes

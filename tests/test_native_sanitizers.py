@@ -833,7 +833,7 @@ host.permit(pub, "du/x")
 
 stop = threading.Event()
 def store_churn():
-    # the resume-replay / marker-consumption shapes racing the poll
+    # the resume-replay / consume-on-ack shapes racing the poll
     # thread's batched appends on the store's internal mutex
     j = 0
     while not stop.is_set():
@@ -847,8 +847,27 @@ def store_churn():
         j += 1
         time.sleep(0.0005)
 
+def meta_churn():
+    # round 18: session-catalog writes, REGISTER retirement, and the
+    # trunk-ring journal/ack/fetch surfaces racing the poll thread's
+    # FlushDurables + FlushTrunkPeer appends on the same mutex
+    j = 0
+    while not stop.is_set():
+        store.put_session("dur-gc", b'{"subs": {"g/%%d": {}}}' %% j)
+        if j %% 7 == 3:
+            store.unregister("dur-gc")
+        store.sessions()
+        store.trunk_put("peerZ", j + 1, b"R" * 48, has_trace=(j & 1) == 1)
+        store.trunk_fetch("peerZ")
+        if j %% 2:
+            store.trunk_ack("peerZ", j + 1)
+        store.trunk_pending("peerZ")
+        j += 1
+        time.sleep(0.0007)
+
 def control_churn():
-    # durable route flips + plane demote/promote (handoff emission)
+    # durable route flips + plane demote/promote (handoff emission) +
+    # clientid rebinds (conn_cids_) + trunk-ident ring loads
     j = 0
     while not stop.is_set():
         if j %% 10 == 3:
@@ -856,13 +875,16 @@ def control_churn():
             host.durable_add(tok, "du/+", 1)
         if j %% 25 == 7:
             host.disable_fast(pub)
-            host.enable_fast(pub, 4, 64)
+            host.enable_fast(pub, 4, 64, "d1")
             host.permit(pub, "du/x")
+        if j %% 33 == 11:
+            host.trunk_ident(9, "peerY")
         host.stats()
         j += 1
         time.sleep(0.0008)
 
 th = [threading.Thread(target=store_churn),
+      threading.Thread(target=meta_churn),
       threading.Thread(target=control_churn)]
 for t in th: t.start()
 

@@ -686,3 +686,186 @@ def test_route_add_remove_races_no_loss_no_dup():
     finally:
         srv_a.stop()
         srv_b.stop()
+
+
+# -- store-backed trunk ring (round 18) ---------------------------------------
+
+def test_trunk_ring_survives_broker_restart_zero_qos1_loss(tmp_path):
+    """Tentpole (round 18): the per-peer unacked qos1 ring is
+    store-backed — kill/restart of the SENDING node no longer loses
+    it. Phase 1 trunks into a never-acking sink (ring provably holds
+    the batches, journaled as kRecTrunk records); the node then
+    restarts on the same store dir, re-registers the peer at B's REAL
+    trunk, and the recovered ring replays from segments: the
+    subscriber receives every qos1 payload."""
+    from emqx_tpu.session.persistent import NativeDurableStore
+
+    base_a = str(tmp_path / "nodeA")
+    app_a = BrokerApp(persistent_store=NativeDurableStore(base_a))
+    app_b = BrokerApp()
+    app_a.broker.node = "nodeA"
+    app_b.broker.node = "nodeB"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0)
+
+    def forward(dest, filt, msg):
+        deliveries = {}
+        app_b.broker._dispatch_local(filt, msg, deliveries)
+        app_b.cm.dispatch(deliveries)
+    app_a.broker.forward_fn = forward
+
+    srv_a.start()
+    srv_b.start()
+
+    sink = socket.socket()
+    sink.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+    sink_port = sink.getsockname()[1]
+
+    def sink_loop():
+        try:
+            c, _ = sink.accept()
+            c.settimeout(0.2)
+            while True:
+                try:
+                    if not c.recv(65536):
+                        return
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+        except OSError:
+            return
+    threading.Thread(target=sink_loop, daemon=True).start()
+
+    payloads = [b"r%03d" % i for i in range(6)]
+    try:
+        async def phase1():
+            pub = MqttClient(port=srv_a.port, clientid="rr-pub")
+            await pub.connect()
+            app_a.broker.router.add_route("rr/x", "nodeB")
+            srv_a.trunk_register("nodeB", "127.0.0.1", sink_port)
+            assert _wait(lambda: srv_a.trunk_peer_status().get("nodeB"))
+            # earn the permit through the Python lane
+            await pub.publish("rr/x", b"warm", qos=1)
+            await asyncio.sleep(0.5)
+            for p in payloads:
+                await pub.publish("rr/x", p, qos=1)
+            assert _wait(
+                lambda: srv_a.fast_stats()["trunk_out"] >= 6), (
+                srv_a.fast_stats())
+            await pub.close()
+
+        run(phase1)
+        # the ring journaled into the store before any socket write
+        assert _wait(
+            lambda: srv_a.fast_stats()["trunk_ring_persisted"] >= 1), (
+            srv_a.fast_stats())
+        store = app_a.persistent.store.native
+        assert store.trunk_pending("nodeB") >= 1
+        assert store.stats()["trunk_pending"] >= 1
+    finally:
+        srv_a.stop()
+        app_a.persistent.store.close()
+        try:
+            sink.close()
+        except OSError:
+            pass
+
+    # ---- restart node A on the same store dir -----------------------------
+    app_a2 = BrokerApp(persistent_store=NativeDurableStore(base_a))
+    app_a2.broker.node = "nodeA"
+    srv_a2 = NativeBrokerServer(port=0, app=app_a2, trunk_port=0)
+    srv_a2.start()
+    try:
+        async def phase2():
+            sub = MqttClient(port=srv_b.port, clientid="rr-sub")
+            await sub.connect()
+            await sub.subscribe("rr/x", qos=1)
+            # re-register the peer at B's REAL trunk: trunk_ident binds
+            # the node name, the recovered ring replays on UP
+            app_a2.broker.router.add_route("rr/x", "nodeB")
+            srv_a2.trunk_register("nodeB", "127.0.0.1",
+                                  srv_b.trunk_port)
+            assert _wait(
+                lambda: srv_a2.trunk_peer_status().get("nodeB"))
+            assert _wait(
+                lambda: srv_a2.fast_stats()["trunk_ring_recovered"]
+                >= 1), srv_a2.fast_stats()
+            got = []
+            deadline = time.monotonic() + 12
+            while len(got) < len(payloads) and \
+                    time.monotonic() < deadline:
+                try:
+                    m = await sub.recv(timeout=2)
+                except asyncio.TimeoutError:
+                    continue
+                if m.payload != b"warm":
+                    got.append(m.payload)
+            assert sorted(got) == sorted(payloads), got
+            await sub.close()
+
+        run(phase2)
+        # the peer's acks retired the store records with the ring slots
+        store2 = app_a2.persistent.store.native
+        assert _wait(lambda: store2.trunk_pending("nodeB") == 0)
+    finally:
+        srv_a2.stop()
+        srv_b.stop()
+        app_a2.persistent.store.close()
+
+
+def test_trunk_acks_retire_store_ring_records(tmp_path):
+    """Healthy-pair counterpart: every acked batch retires its store
+    record (kRecTrunkAck) — the persisted ring tracks the in-memory
+    ring, not a grow-forever journal."""
+    from emqx_tpu.session.persistent import NativeDurableStore
+
+    base_a = str(tmp_path / "nodeA")
+    app_a = BrokerApp(persistent_store=NativeDurableStore(base_a))
+    app_b = BrokerApp()
+    app_a.broker.node = "nodeA"
+    app_b.broker.node = "nodeB"
+    srv_a = NativeBrokerServer(port=0, app=app_a, trunk_port=0)
+    srv_b = NativeBrokerServer(port=0, app=app_b, trunk_port=0)
+
+    def forward(dest, filt, msg):
+        deliveries = {}
+        app_b.broker._dispatch_local(filt, msg, deliveries)
+        app_b.cm.dispatch(deliveries)
+    app_a.broker.forward_fn = forward
+
+    srv_a.start()
+    srv_b.start()
+    try:
+        async def main():
+            sub = MqttClient(port=srv_b.port, clientid="ak-sub")
+            await sub.connect()
+            await sub.subscribe("ak/x", qos=1)
+            pub = MqttClient(port=srv_a.port, clientid="ak-pub")
+            await pub.connect()
+            app_a.broker.router.add_route("ak/x", "nodeB")
+            srv_a.trunk_register("nodeB", "127.0.0.1",
+                                 srv_b.trunk_port)
+            assert _wait(lambda: srv_a.trunk_peer_status().get("nodeB"))
+            await pub.publish("ak/x", b"warm", qos=1)
+            await sub.recv(timeout=8)
+            await asyncio.sleep(0.5)
+            for i in range(8):
+                await pub.publish("ak/x", b"a%d" % i, qos=1)
+                await sub.recv(timeout=8)
+            await pub.close()
+            await sub.close()
+
+        run(main)
+        st = srv_a.fast_stats()
+        assert st["trunk_ring_persisted"] >= 1, st
+        store = app_a.persistent.store.native
+        # acks retired every journaled record alongside the ring slots
+        assert _wait(lambda: store.trunk_pending("nodeB") == 0), (
+            store.trunk_pending("nodeB"))
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+        app_a.persistent.store.close()

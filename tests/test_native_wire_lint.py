@@ -92,7 +92,7 @@ def test_declared_widths_are_real_widths():
                + (1).to_bytes(4, "little") + entry)
     base, ts, entries = native.parse_durable(payload)
     assert (base, ts) == (100, 5)
-    assert entries == [(11, 0b10011, [77], "t/x", b"hi", 0xCAFE)]
+    assert entries == [(11, 0b10011, [77], "t/x", b"hi", 0xCAFE, "")]
 
     # kind 9 sub-3 punt entry with a trace id skipped losslessly
     punt = (bytes([3]) + (11).to_bytes(8, "little") + bytes([0b10011])
@@ -100,3 +100,24 @@ def test_declared_widths_are_real_widths():
             + (0xCAFE).to_bytes(8, "little")
             + (2).to_bytes(4, "little") + b"yo")
     assert native.parse_trunk_punts(punt) == [(11, 1, False, "t/y", b"yo")]
+
+
+def test_store_record_types_match_store_h_constants():
+    """The store's on-disk record catalog (ISSUE 14): every kRec*
+    constant in store.h matches native.STORE_RECORD_TYPES by name AND
+    value — a record type added or renumbered on one side fails here
+    instead of silently mis-walking the recovery scan."""
+    import re
+
+    store_h = os.path.join(os.path.dirname(HOST_CC), "store.h")
+    with open(store_h) as f:
+        src = f.read()
+    got = {}
+    for m in re.finditer(
+            r"constexpr\s+uint8_t\s+kRec([A-Za-z0-9]+)\s*=\s*(\d+)\s*;",
+            src):
+        name = re.sub(r"(?<!^)(?=[A-Z])", "_", m.group(1)).lower()
+        got[name] = int(m.group(2))
+    assert got == native.STORE_RECORD_TYPES, (
+        f"store.h kRec* drifted from native.STORE_RECORD_TYPES:\n"
+        f"  C++   : {got}\n  Python: {native.STORE_RECORD_TYPES}")

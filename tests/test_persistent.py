@@ -8,7 +8,8 @@ from emqx_tpu.broker.channel import Channel
 from emqx_tpu.core.message import Message, SubOpts
 from emqx_tpu.mqtt import packet as P
 from emqx_tpu.session.persistent import (
-    DiskStore, DummyStore, MemStore, PersistentSessions, SessionRouter,
+    DummyStore, MemStore, NativeDurableStore, PersistentSessions,
+    SessionRouter,
 )
 from emqx_tpu.utils.replayq import ReplayQ
 
@@ -79,12 +80,8 @@ def test_session_router_exact_and_wildcard():
 
 # -- stores -----------------------------------------------------------------
 
-@pytest.mark.parametrize("mk", [
-    lambda tmp: MemStore(),
-    lambda tmp: DiskStore(str(tmp / "ps")),
-])
-def test_store_marker_lifecycle(mk, tmp_path):
-    s = mk(tmp_path)
+def test_store_marker_lifecycle(tmp_path):
+    s = MemStore()
     s.put_session("c1", {"subs": {"a/+": {}}, "ts": 0})
     s.put_message(7, {"topic": "a/b"})
     s.put_marker("c1", 7, "a/+")
@@ -95,30 +92,82 @@ def test_store_marker_lifecycle(mk, tmp_path):
     assert 7 not in s.messages
 
 
-def test_disk_store_replays_after_reopen(tmp_path):
+def test_native_store_persist_drain_lifecycle(tmp_path):
+    """The unified backend (round 18): persist() writes message +
+    markers into the ONE native store; drain() fetches + consumes."""
+    s = NativeDurableStore(str(tmp_path / "ps"))
+    s.put_session("c1", {"subs": {"a/+": {}}, "ts": 0})
+    m = Message(topic="a/b", payload=b"x", qos=1, from_="pub")
+    assert s.persist(m, ["c1"]) == 1
+    assert s.native.pending(s.native.lookup("c1")) == 1
+    rows = s.drain("c1")
+    assert len(rows) == 1
+    guid, _origin, _ts, qos, _dup, topic, body, _trace, cid = rows[0]
+    assert (qos, topic, body, cid) == (1, "a/b", b"x", "pub")
+    assert s.native.pending(s.native.lookup("c1")) == 0
+    s.close()
+
+
+def test_native_store_replays_after_reopen(tmp_path):
     d = str(tmp_path / "ps")
-    s = DiskStore(d)
+    s = NativeDurableStore(d)
     s.put_session("c1", {"subs": {"t": {"qos": 1}}, "ts": 1})
-    s.put_message(42, {"topic": "t"})
-    s.put_marker("c1", 42, "t")
+    s.persist(Message(topic="t", payload=b"m", qos=1), ["c1"])
     s.close()
-    s2 = DiskStore(d)
+    s2 = NativeDurableStore(d)
     assert s2.get_session("c1")["subs"] == {"t": {"qos": 1}}
-    assert s2.pending("c1") == [(42, "t")]
+    rows = s2.drain("c1")
+    assert [(r[5], r[6]) for r in rows] == [("t", b"m")]
+    s2.close()
 
 
-def test_disk_store_compaction_preserves_state(tmp_path):
-    s = DiskStore(str(tmp_path / "ps"), compact_every=10)
-    for i in range(30):
-        s.put_message(i, {"topic": f"t{i}"})
-        s.put_marker("c1", i, f"t{i}")
-        s.consume_marker("c1", i)
-    s.put_marker("c1", 29, "t29")          # one live marker
-    s.gc_messages()
+def test_native_store_consume_on_settle_survives_reopen(tmp_path):
+    """A consumed (settled) marker stays consumed across reopen; an
+    unconsumed one replays."""
+    d = str(tmp_path / "ps")
+    s = NativeDurableStore(d)
+    s.put_session("c1", {"subs": {"t": {}}, "ts": 0})
+    m1 = Message(topic="t", payload=b"acked", qos=1)
+    m2 = Message(topic="t", payload=b"unacked", qos=1)
+    s.persist(m1, ["c1"])
+    s.persist(m2, ["c1"])
+    s.consume_marker("c1", m1.id)        # the settle seam's spend
     s.close()
-    s2 = DiskStore(str(tmp_path / "ps"))
-    assert s2.pending("c1") == [(29, "t29")]
-    assert set(s2.messages) == {29}
+    s2 = NativeDurableStore(d)
+    rows = s2.drain("c1")
+    assert [r[6] for r in rows] == [b"unacked"]
+    s2.close()
+
+
+def test_disk_store_log_boot_migrates_once(tmp_path):
+    """A pre-round-18 JSON sessions.log folds into native records at
+    boot, exactly once (renamed .migrated)."""
+    import json as _json
+    import os as _os
+    sess_dir = tmp_path / "ps" / "sessions"
+    sess_dir.mkdir(parents=True)
+    log = sess_dir / "sessions.log"
+    m = Message(topic="t", payload=b"old", qos=1)
+    from emqx_tpu.session.persistent import msg_to_dict
+    ops = [
+        {"op": "sess", "sid": "c1", "rec": {"subs": {"t": {"qos": 1}},
+                                            "ts": 1}},
+        {"op": "msg", "guid": m.id, "m": msg_to_dict(m)},
+        {"op": "mark", "sid": "c1", "guid": m.id, "st": "t"},
+    ]
+    log.write_text("\n".join(_json.dumps(o) for o in ops) + "\n")
+    s = NativeDurableStore(str(tmp_path / "ps"))
+    assert s.get_session("c1")["subs"] == {"t": {"qos": 1}}
+    rows = s.drain("c1")
+    assert [r[6] for r in rows] == [b"old"]
+    assert not _os.path.exists(str(log))
+    assert _os.path.exists(str(log) + ".migrated")
+    s.close()
+    # second boot: no re-migration (markers were consumed by the drain)
+    s2 = NativeDurableStore(str(tmp_path / "ps"))
+    assert s2.drain("c1") == []
+    assert s2.get_session("c1") is not None
+    s2.close()
 
 
 def test_dummy_store_remembers_nothing():
@@ -234,7 +283,16 @@ class Client:
 
 
 def _app(tmp_path):
-    return BrokerApp(persistent_store=DiskStore(str(tmp_path / "ps")))
+    return BrokerApp(
+        persistent_store=NativeDurableStore(str(tmp_path / "ps")))
+
+
+def _ack_all(client):
+    """Acknowledge every qos1 delivery sitting in the client's window —
+    with consume-on-ack (round 18) only the ACK spends the replay
+    marker; an unacked delivery deliberately replays after restart."""
+    for pid, _entry in client.ch.session.inflight.items():
+        client.ch.handle_in(P.PubAck(packet_id=pid))
 
 
 def test_restart_resume_replays_offline_messages(tmp_path):
@@ -245,7 +303,8 @@ def test_restart_resume_replays_offline_messages(tmp_path):
     # publisher on the same node
     pub = Client(app1, "pub1")
     pub.publish("news/a", b"while-up", qos=1)
-    # delivered live → marker consumed; now the node "crashes"
+    # delivered live AND ACKED → marker settled; now the node "crashes"
+    _ack_all(sub)
     app1.persistent.store.close()
 
     # a second node boots on the same store: only subscriptions survive
@@ -289,12 +348,14 @@ def test_takeover_consumes_stored_markers(tmp_path):
     sub.ch.terminate("sock_closed")
     pub = Client(app, "p1")
     pub.publish("t", b"offline", qos=1)
-    assert app.persistent.store.pending("s1")          # marker stored
+    store = app.persistent.store
+    tok = store.native.lookup("s1")
+    assert store.native.pending(tok) == 1              # marker stored
     sub2 = Client(app, "s1", clean_start=False,
                   properties={"Session-Expiry-Interval": 3600})
     pubs = [p for p in sub2.out if isinstance(p, P.Publish)]
     assert [p.payload for p in pubs] == [b"offline"]   # delivered once
-    assert app.persistent.store.pending("s1") == []    # marker consumed
+    assert store.native.pending(tok) == 0              # marker consumed
 
 
 def test_restart_resume_does_not_resend_retained(tmp_path):

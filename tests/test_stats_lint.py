@@ -190,6 +190,56 @@ def test_durable_fixed_metric_slots_render_at_zero():
     assert seen["$SYS/brokers/n1/metrics/messages.durable.replayed"] == b"0"
 
 
+# -- one-recovery-path plane (ISSUE 14) ---------------------------------------
+
+
+def test_one_recovery_path_slots_exported():
+    """The store-backed trunk ring's StatSlots and the store's new
+    slots stay exported — presence pinned by name (the trunk-pin
+    pattern; the mechanical enum lints pass if BOTH sides dropped
+    them)."""
+    for name in ("trunk_ring_persisted", "trunk_ring_recovered"):
+        assert name in native.STAT_NAMES, name
+    for name in ("replay_bytes", "sessions", "trunk_pending",
+                 "meta_rewrites"):
+        assert name in native.STORE_STAT_NAMES, name
+    src = _src()
+    assert "kStTrunkRingPersisted" in src
+    assert "kStTrunkRingRecovered" in src
+
+
+def test_store_stats_render_in_prometheus():
+    """Every STORE_STAT_NAMES slot scrapes as an emqx_native_store_*
+    gauge (render-at-zero: a fresh store exports the whole surface)."""
+    from emqx_tpu.observe import prometheus
+
+    store = dict.fromkeys(native.STORE_STAT_NAMES, 0)
+    out = prometheus.render(native_store=store)
+    for name in native.STORE_STAT_NAMES:
+        assert f"emqx_native_store_{name}" in out, name
+
+
+def test_durable_settled_fixed_slot_renders_at_zero():
+    """messages.durable.settled (consume-on-ack marker spends) is a
+    FIXED metric slot: renders at zero in prometheus and rides the
+    $SYS metrics heartbeat before the first settle."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    m = Metrics()
+    assert m.val("messages.durable.settled") == 0
+    out = prometheus.render(metrics=m)
+    assert "emqx_messages_durable_settled" in out
+
+    seen = {}
+    hb = SysHeartbeat("n1", lambda msg: seen.__setitem__(
+        msg.topic, msg.payload), metrics=m)
+    hb.publish_metrics()
+    assert seen[
+        "$SYS/brokers/n1/metrics/messages.durable.settled"] == b"0"
+
+
 # -- edge-gateway plane (ISSUE 6) ---------------------------------------------
 
 

@@ -2820,6 +2820,152 @@ def sec_shards() -> None:
     put("shards", shards_accept_2x_fanout_ge_1_6x=bool(ok))
 
 
+def sec_coap() -> None:
+    """ISSUE 15 acceptance: native-CoAP publish throughput AND observe
+    fan-out >= 10x the asyncio gateway/coap.py path on IDENTICAL wire
+    traffic with IDENTICAL pacing (the SN gate shape: the same coap.h
+    loadgen fleet drives both planes, windowed the same), with
+    broker-side stage hists (coap_ingest, observe_notify) recorded."""
+    import asyncio
+    import threading
+
+    from emqx_tpu import native
+
+    if not native.available():
+        log(f"native host unavailable, skipping: {native.build_error()}")
+        return
+
+    from emqx_tpu.app import BrokerApp
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.gateway import coap as COAP
+
+    n_before = int(os.environ.get("BENCH_COAP_BEFORE_MSGS", 1000))
+    n_blast = int(os.environ.get("BENCH_COAP_BLAST_MSGS", 20000))
+    n_fan = int(os.environ.get("BENCH_COAP_FANOUT_MSGS", 16000))
+
+    def run_asyncio_arm(fn):
+        """One measurement against a fresh asyncio CoapGateway."""
+        state: dict = {}
+        stop = threading.Event()
+        ready = threading.Event()
+
+        def gw_main():
+            async def run_gw():
+                app = BrokerApp()
+                gw = app.gateway.load(COAP.CoapGateway(port=0))
+                await gw.start_listeners()
+                state["port"] = gw.port
+                ready.set()
+                while not stop.is_set():
+                    await asyncio.sleep(0.05)
+                await gw.stop_listeners()
+            asyncio.run(run_gw())
+
+        th = threading.Thread(target=gw_main)
+        th.start()
+        assert ready.wait(10), "asyncio CoAP gateway did not come up"
+        try:
+            return fn(state["port"])
+        finally:
+            stop.set()
+            th.join()
+
+    # -- before: asyncio gateway/coap.py, the SAME loadgen fleet ------------
+    before = run_asyncio_arm(lambda port: native.loadgen_coap_run(
+        "127.0.0.1", port, n_subs=4, n_pubs=4, msgs_per_pub=n_before,
+        qos=0, payload_len=16, idle_timeout_ms=8000, window=256))
+    before_rate = before["received"] / max(before["wall_ns"] / 1e9, 1e-9)
+    log(f"coap plane BEFORE (asyncio gateway/coap.py, NON windowed): "
+        f"{before['received']}/{before['sent']} = "
+        f"{before_rate:,.0f} msg/s")
+    put("coap", coap_asyncio_msgs_per_sec=round(before_rate))
+
+
+    # -- after: the native CoAP plane (coap.h in the C++ host) --------------
+    server = NativeBrokerServer(port=0, app=BrokerApp(), coap_port=0,
+                                session_opts={"max_inflight": 1024})
+    server.start()
+    try:
+        # identical pacing to the BEFORE arm (window + idle timeout):
+        # the ratio must measure the plane, not the window depth
+        after = native.loadgen_coap_run(
+            "127.0.0.1", server.coap_port, n_subs=4, n_pubs=4,
+            msgs_per_pub=n_blast, qos=0, payload_len=16,
+            idle_timeout_ms=8000, window=256)
+        after_rate = after["received"] / max(after["wall_ns"] / 1e9, 1e-9)
+        log(f"coap plane AFTER (native coap.h + fast path, NON "
+            f"windowed): {after['received']}/{after['sent']} = "
+            f"{after_rate:,.0f} msg/s  "
+            f"({after_rate / max(before_rate, 1):,.0f}x asyncio-coap)  "
+            f"p99={after['p99_ns'] / 1e6:.3f}ms")
+        put("coap",
+            coap_native_msgs_per_sec=round(after_rate),
+            coap_native_p99_ms=round(after["p99_ns"] / 1e6, 3),
+            coap_vs_asyncio=round(after_rate / max(before_rate, 1), 1),
+            coap_pub_10x_gate=bool(
+                after_rate >= 10 * max(before_rate, 1)))
+
+        # qos1: CON publishes gated on the native ack plane
+        q1 = native.loadgen_coap_run(
+            "127.0.0.1", server.coap_port, n_subs=4, n_pubs=4,
+            msgs_per_pub=n_blast // 4, qos=1, payload_len=16,
+            window=256)
+        q1_rate = q1["received"] / max(q1["wall_ns"] / 1e9, 1e-9)
+        log(f"coap plane qos1 (CON windowed 256): {q1_rate:,.0f} msg/s "
+            f"acks={q1['acks']} p99={q1['p99_ns'] / 1e6:.3f}ms")
+        put("coap",
+            coap_native_qos1_msgs_per_sec=round(q1_rate),
+            coap_native_qos1_p99_ms=round(q1["p99_ns"] / 1e6, 3))
+
+        # observe fan-out: 8 observers on ONE topic, identical shape
+        # on both planes. Interleaved best-of-3 with the pair order
+        # ALTERNATED per rep (the observe_overhead discipline): this
+        # 1-core box's run-to-run drift swamps a single-shot ratio.
+        def native_fan_arm():
+            return native.loadgen_coap_run(
+                "127.0.0.1", server.coap_port, n_subs=8, n_pubs=1,
+                msgs_per_pub=max(n_fan // 8, 200), qos=0,
+                payload_len=16, idle_timeout_ms=8000, window=512,
+                fanout=True)
+
+        def asyncio_fan_arm():
+            return run_asyncio_arm(lambda port: native.loadgen_coap_run(
+                "127.0.0.1", port, n_subs=8, n_pubs=1,
+                msgs_per_pub=max(n_fan // 8, 200), qos=0,
+                payload_len=16, idle_timeout_ms=8000, window=512,
+                fanout=True))
+
+        def rate_of(r):
+            return r["received"] / max(r["wall_ns"] / 1e9, 1e-9)
+
+        fan_rate = bf_rate = 0.0
+        for rep in range(3):
+            arms = ([asyncio_fan_arm, native_fan_arm] if rep % 2 == 0
+                    else [native_fan_arm, asyncio_fan_arm])
+            for arm in arms:
+                r = rate_of(arm())
+                if arm is native_fan_arm:
+                    fan_rate = max(fan_rate, r)
+                else:
+                    bf_rate = max(bf_rate, r)
+        log(f"coap observe fan-out (8 observers/1 topic, best-of-3 "
+            f"interleaved): native {fan_rate:,.0f} notify/s vs asyncio "
+            f"{bf_rate:,.0f} notify/s "
+            f"({fan_rate / max(bf_rate, 1):,.0f}x)")
+        put("coap",
+            coap_asyncio_fanout_notifies_per_sec=round(bf_rate),
+            coap_native_fanout_notifies_per_sec=round(fan_rate),
+            coap_fanout_vs_asyncio=round(fan_rate / max(bf_rate, 1), 1),
+            coap_fanout_10x_gate=bool(fan_rate >= 10 * max(bf_rate, 1)))
+        # broker-side stages incl. coap_ingest + observe_notify
+        put_broker_hists("coap", server, "coap_broker")
+        st = server.host.stats()
+        put("coap", coap_in=st["coap_in"], coap_punts=st["coap_punts"],
+            coap_notifies=st["coap_notifies"])
+    finally:
+        server.stop()
+
+
 SECTIONS = {
     "kernel": sec_kernel,
     "tenm": sec_tenm,
@@ -2832,6 +2978,7 @@ SECTIONS = {
     "trunk": sec_trunk,
     "durable": sec_durable,
     "mixed": sec_mixed,
+    "coap": sec_coap,
     "shards": sec_shards,
     "e2e": sec_e2e,
     "observe_overhead": sec_observe_overhead,
@@ -2854,6 +3001,7 @@ DEVICE_PLAN = [
     ("trunk", False, True, 400),
     ("durable", False, True, 400),
     ("mixed", False, True, 500),
+    ("coap", False, True, 400),
     ("shards", False, True, 500),
     ("shared", False, True, 400),
     ("observe_overhead", False, True, 300),
@@ -2868,6 +3016,7 @@ CPU_PLAN = [
     ("trunk", False, True, 400),
     ("durable", False, True, 400),
     ("mixed", False, True, 500),
+    ("coap", False, True, 400),
     ("shards", False, True, 500),
     ("shared", False, True, 400),
     ("e2e", False, True, 600),
@@ -2878,8 +3027,8 @@ CPU_PLAN = [
 
 _SECTION_ORDER = ["kernel", "tenm", "churn", "xdev", "xcpp",
                   "shared", "host", "ws", "trunk", "durable", "mixed",
-                  "shards", "e2e", "observe_overhead", "fault_overhead",
-                  "conn_scale", "kernel_cpu"]
+                  "coap", "shards", "e2e", "observe_overhead",
+                  "fault_overhead", "conn_scale", "kernel_cpu"]
 
 
 def _probe_device(attempts: int, timeout_s: float, backoff_s: float) -> dict:

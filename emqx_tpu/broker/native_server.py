@@ -123,7 +123,7 @@ CAP_DEADBAND_MIN = 8
 
 
 class _NativeConn:
-    __slots__ = ("conn_id", "channel", "server", "fast", "sn",
+    __slots__ = ("conn_id", "channel", "server", "fast", "sn", "coap",
                  "recv_budget", "native_cap", "native_ka")
 
     def __init__(self, server: "NativeBrokerServer", conn_id: int, peer: str):
@@ -135,6 +135,9 @@ class _NativeConn:
         # keepalive feed covers them even when not fast (UDP peers
         # never deliver a socket-close signal)
         self.sn = peer.startswith("sn:")
+        # CoAP datagram conns (peer "coap:..."): same shape — frames
+        # arrive pre-translated to MQTT by the C++ gateway
+        self.coap = peer.startswith("coap:")
         self.recv_budget = 0     # receive-maximum budget split across planes
         self.native_cap = 0      # the native plane's current share
         # keepalive lives on the C++ timer wheel (armed post-CONNACK):
@@ -213,6 +216,10 @@ class _ShardedHost:
     def sn_port(self) -> int:
         return self.hosts[0].sn_port
 
+    @property
+    def coap_port(self) -> int:
+        return self.hosts[0].coap_port
+
     def _of(self, conn: int):
         return self.hosts[native.shard_of(conn) % len(self.hosts)]
 
@@ -288,6 +295,17 @@ class _ShardedHost:
     def trunk_route_del(self, peer_id, filter_):
         for h in self.hosts:
             h.trunk_route_del(peer_id, filter_)
+
+    def coap_send(self, conn, data):
+        self._of(conn).coap_send(conn, data)
+
+    def coap_retain_state(self, complete):
+        for h in self.hosts:
+            h.coap_retain_state(complete)
+
+    def set_coap_ack_timeout(self, ms):
+        for h in self.hosts:
+            h.set_coap_ack_timeout(ms)
 
     def sn_predefined(self, topic_id, topic):
         for h in self.hosts:
@@ -463,6 +481,9 @@ class NativeBrokerServer:
         sn_host: Optional[str] = None,
         sn_gateway_id: int = 1,
         sn_predefined: Optional[dict] = None,
+        coap_port: Optional[int] = None,
+        coap_host: Optional[str] = None,
+        coap_oracle=None,
         shards: int = 1,
         park: Optional[bool] = None,
         park_after_ms: int = 0,
@@ -565,6 +586,67 @@ class NativeBrokerServer:
                             reuseport=True)
             for tid, t in (sn_predefined or {}).items():
                 self.host.sn_predefined(int(tid), t)
+        # -- coap gateway plane (round 19) ----------------------------------
+        # A fourth C++ listener speaks CoAP (RFC 7252) over UDP: the
+        # host decodes datagrams with the shared coap.h codec, the /ps
+        # pub-sub surface translates into MQTT frames riding the SAME
+        # permit/punt/lane/tap/ack-plane machinery as TCP/WS/SN, and
+        # observe notifications resolve host-side on the delivery seam.
+        # gateway/coap.py stays the asyncio oracle, the deployment
+        # fallback (coap_port=None), AND the serving plane for punted
+        # exchanges (kind 13: block-wise transfers, props-carrying
+        # retained reads, non-/ps paths — ``coap_oracle`` swaps the
+        # punt channel class, e.g. the LwM2M channel over /rd).
+        self.coap_port: Optional[int] = None
+        self._coap_oracle: dict = {}  # conn id → channel @guards(_coap_lock)
+        # RLock: an oracle channel's uplink publish can dispatch into
+        # ANOTHER oracle channel's handle_deliver on the same thread
+        self._coap_lock = threading.RLock()
+        self._coap_retain_ok = True
+        if coap_port is not None:
+            if self.app is None:
+                raise ValueError("coap_port requires an app")
+            self.coap_port = self.hosts[0].listen_coap(
+                coap_host or host, coap_port, reuseport=self.shards > 1)
+            for h in self.hosts[1:]:
+                h.listen_coap(coap_host or host, self.coap_port,
+                              reuseport=True)
+            from emqx_tpu.gateway import coap as _coap_mod
+            from emqx_tpu.gateway.ctx import GwContext as _GwContext
+
+            self._coap_frame = _coap_mod.Frame()
+            srv = self
+
+            class _OracleCtx(_GwContext):
+                """The punt seam's broker surface: identical to the
+                asyncio gateway's context, except open_session never
+                discards a channel belonging to one of THIS server's
+                native conns — a device that publishes natively under
+                the same clientid keeps its session; the oracle only
+                serves the exchanges the native vocabulary excludes."""
+
+                def open_session(self, clientid, channel):
+                    old = self.app.cm.lookup_channel(clientid)
+                    if old is not None and old is not channel:
+                        for conn in list(srv.conns.values()):
+                            if conn.channel is old:
+                                return
+                    super().open_session(clientid, channel)
+
+                def close_session(self, clientid, channel=None,
+                                  reason="closed"):
+                    # the mirror guard: an oracle channel that never
+                    # owned the CM slot (a native conn holds the
+                    # identity) must not strip the LIVE session's
+                    # subscriptions on its teardown (review finding —
+                    # subscriber_down is unconditional in the base)
+                    if self.app.cm.lookup_channel(clientid) is not channel:
+                        return
+                    super().close_session(clientid, channel, reason)
+
+            self._coap_ctx = _OracleCtx(self.app, "coap-native")
+            self._coap_factory = coap_oracle or (
+                lambda ctx: _coap_mod.Channel(ctx))
         # -- conn-scale plane (round 16) ------------------------------------
         # Hibernation of idle conns + accept-storm governance live in
         # C++ (park.h / wheel.h); this just forwards the knobs. Parking
@@ -1051,6 +1133,7 @@ class NativeBrokerServer:
         if op == "del":
             self._retain_unmirrorable.discard(topic)
             self.host.retain_del(topic)
+            self._coap_retain_sync()
             return
         props = (msg.headers or {}).get("properties") or {}
         # the native encode carries no v5 property section (fast-path
@@ -1060,10 +1143,12 @@ class NativeBrokerServer:
         if props:
             self._retain_unmirrorable.add(topic)
             self.host.retain_del(topic)
+            self._coap_retain_sync()
             return
         self._retain_unmirrorable.discard(topic)
         self.host.set_retained(topic, bytes(msg.payload or b""),
                                int(msg.qos or 0), deadline_ms)
+        self._coap_retain_sync()
 
     def _native_retained(self, sid: str, topic: str, real: str,
                          opts) -> bool:
@@ -1087,6 +1172,84 @@ class NativeBrokerServer:
         self.host.retain_deliver(conn.conn_id, real,
                                  int(getattr(opts, "qos", 0) or 0))
         return True
+
+    def _coap_retain_sync(self) -> None:
+        """Keep the host's plain-GET gate aligned with the mirror:
+        ANY props-carrying retained topic makes the snapshot
+        incomplete, and native CoAP reads degrade whole to the
+        oracle's lookup (never a partial answer)."""
+        if self.coap_port is None:
+            return
+        complete = not self._retain_unmirrorable
+        if complete != self._coap_retain_ok:
+            self._coap_retain_ok = complete
+            self.host.coap_retain_state(complete)
+
+    # -- coap oracle seam (round 19) ----------------------------------------
+    # Exchanges the native CoAP vocabulary excludes (block-wise
+    # transfers, props-carrying retained reads, non-/ps paths — the
+    # LwM2M registration surface) arrive as kind-13 events carrying the
+    # raw datagram; a per-peer gateway/coap.py channel (or the
+    # configured ``coap_oracle`` class) serves them WHOLE and answers
+    # back through the native datagram socket. The channel's ``send``
+    # binding also carries broker deliveries (LwM2M downlink commands)
+    # to the device over the native transport.
+
+    # @locked(_coap_lock)
+    def _coap_channel(self, conn_id: int):
+        ch = self._coap_oracle.get(conn_id)
+        if ch is None:
+            ch = self._coap_factory(self._coap_ctx)
+            ch.send = (lambda frames, _cid=conn_id:
+                       self._coap_reply(_cid, frames))
+            # broker deliveries (cm.dispatch) call handle_deliver from
+            # whatever thread published: serialize with the poll
+            # thread's handle_in under the (reentrant) channel lock
+            orig_hd = ch.handle_deliver
+
+            def _hd(items, _o=orig_hd):
+                with self._coap_lock:
+                    return _o(items)
+
+            ch.handle_deliver = _hd
+            self._coap_oracle[conn_id] = ch
+        return ch
+
+    def _coap_reply(self, conn_id: int, frames) -> None:
+        """Serialize + ship oracle-channel responses to the peer (the
+        channel's ``send`` binding; Frame.serialize is stateless and
+        coap_send is a thread-safe op enqueue)."""
+        for f in frames or ():
+            self.host.coap_send(conn_id, self._coap_frame.serialize(f))
+
+    def _on_coap(self, conn_id: int, dgram: bytes) -> None:
+        """Kind-13 fold: one exchange degraded WHOLE to the oracle."""
+        with self._coap_lock:
+            try:
+                ch = self._coap_channel(conn_id)
+                msgs, _ = self._coap_frame.parse(dgram, None)
+                out = []
+                for m in msgs:
+                    out.extend(ch.handle_in(m) or [])
+            except Exception:
+                log.exception("coap oracle channel error (conn %#x)",
+                              conn_id)
+                return
+        self._coap_reply(conn_id, out)
+
+    def _coap_housekeep(self) -> None:
+        """Oracle-channel tick: CON retransmits and give-ups (LwM2M
+        downlink commands) — the asyncio listener's housekeep twin."""
+        with self._coap_lock:
+            for conn_id, ch in list(self._coap_oracle.items()):
+                hk = getattr(ch, "housekeep", None)
+                if hk is None:
+                    continue
+                try:
+                    out = hk()
+                except Exception:
+                    continue
+                self._coap_reply(conn_id, out)
 
     # -- device match lane --------------------------------------------------
     # Permitted PUBLISHes park in C++ while their topics ride batched
@@ -2455,11 +2618,21 @@ class NativeBrokerServer:
                 self._on_durable(payload)
             elif kind == native.EV_HANDOFF:
                 self._on_handoff(conn_id, payload)
+            elif kind == native.EV_COAP:
+                self._on_coap(conn_id, payload)
             elif kind == native.EV_CLOSED:
                 with self._trace_lock:
                     self._traced_conns.discard(conn_id)
                 with self._scan_lock:
                     self._scan_conns.pop(conn_id, None)
+                with self._coap_lock:
+                    och = self._coap_oracle.pop(conn_id, None)
+                    if och is not None:
+                        try:
+                            och.terminate(payload.decode(
+                                "ascii", "replace"))
+                        except Exception:
+                            pass
                 conn = self.conns.pop(conn_id, None)
                 if conn is not None:
                     ch = conn.channel
@@ -3043,6 +3216,8 @@ class NativeBrokerServer:
             self._last_permit_flush = time.monotonic()
             if self._granted:
                 self.flush_permits()
+        if self.coap_port is not None:
+            self._coap_housekeep()
         self._housekeep_conns(0)
 
     def _housekeep_conns(self, shard: int) -> None:
@@ -3074,7 +3249,7 @@ class NativeBrokerServer:
                 # pre-CONNACK (or legacy-armed) conns: the old path —
                 # feed the idle clock for transports whose frames never
                 # reach the channel, enforce keepalive in Python
-                if conn.fast or conn.sn:
+                if conn.fast or conn.sn or conn.coap:
                     idle = self.host.conn_idle_ms(conn.conn_id)
                     if idle >= 0:
                         ch.last_packet_at = max(

@@ -186,8 +186,15 @@ class TransportManager:
     def __init__(self, now_fn=None) -> None:
         import time as _time
         self.now = now_fn or _time.monotonic
-        # inbound: mid → (cached response frames, expire_at)
-        self._seen: dict[int, tuple[list, float]] = {}
+        # inbound: mid → (request token, cached response frames,
+        # expire_at). The token rides along because a retransmission is
+        # BYTE-IDENTICAL (RFC 7252 §4.2): a fast client whose 16-bit mid
+        # counter wraps inside EXCHANGE_LIFETIME reuses a mid for a NEW
+        # exchange, and keying on the mid alone replayed the OLD cached
+        # response at it (the parity-audit "MID-dedup window wrap" bug —
+        # the request was silently swallowed). A differing token proves
+        # the mid was recycled: evict, treat as fresh.
+        self._seen: dict[int, tuple[bytes, list, float]] = {}
         # outbound: mid → [msg, tries, next_at, timeout]
         self._pending: dict[int, list] = {}
 
@@ -198,15 +205,20 @@ class TransportManager:
         hit = self._seen.get(m.mid)
         if hit is None:
             return None
-        frames, expire_at = hit
+        token, frames, expire_at = hit
         if self.now() >= expire_at:
+            del self._seen[m.mid]
+            return None
+        if token != m.token:
+            # recycled mid (client counter wrapped): a new exchange,
+            # not a retransmission — never replay the old response
             del self._seen[m.mid]
             return None
         return frames        # may be [] (duplicate NON → drop silently)
 
     def remember(self, m: CoapMessage, response: list) -> None:
         life = EXCHANGE_LIFETIME if m.type == CON else NON_LIFETIME
-        self._seen[m.mid] = (list(response), self.now() + life)
+        self._seen[m.mid] = (m.token, list(response), self.now() + life)
 
     # -- outbound CON reliability -------------------------------------------
 
@@ -239,7 +251,7 @@ class TransportManager:
             st[2] = now + st[3]
             retx.append(msg)
         # dedup-cache GC rides the same tick
-        for mid, (_f, exp) in list(self._seen.items()):
+        for mid, (_t, _f, exp) in list(self._seen.items()):
             if now >= exp:
                 del self._seen[mid]
         return retx, gave_up
@@ -257,8 +269,11 @@ class Channel(GwChannel):
         self.ctx = ctx
         self.conn_state = "connected"       # connectionless transport
         self.clientid: Optional[str] = None
-        self.observers: dict[str, tuple[bytes, int]] = {}  # topic→(token,qos)
-        self._obs_seq = 0
+        # topic → [token, qos, seq]: PER-OBSERVER 24-bit sequence
+        # numbers (RFC 7641 §4.4 orders notifications per observation;
+        # the old channel-wide counter also CRASHED in to_bytes(3) at
+        # 2^24 — the parity-audit rollover bug). seq wraps mod 2^24.
+        self.observers: dict[str, list] = {}
         self._mid = 0
         self._registered = False
         self.tm = TransportManager()
@@ -274,10 +289,25 @@ class Channel(GwChannel):
         return self._mid
 
     def _ensure_client(self, m: CoapMessage) -> bool:
-        if self._registered:
-            return True
         q = m.queries()
-        self.clientid = q.get("clientid") or f"coap-{id(self):x}"
+        want = q.get("clientid")
+        if self._registered:
+            if not want or want == self.clientid:
+                return True
+            # the peer RE-REGISTERS under a new identity (a rebooted or
+            # re-provisioned device on the same 5-tuple): the old
+            # session's observers must not leak into the new one — a
+            # stale token the new client reuses for its own exchange
+            # would mis-correlate notifications — and the new clientid
+            # must be re-authenticated, not waved through (the SN
+            # re-CONNECT ghost/ban-bypass analogue from the PR 6 audit)
+            for topic in list(self.observers):
+                self._cancel_observe(topic)
+            self._con_topic.clear()
+            self._block1.clear()
+            self.ctx.close_session(self.clientid, self, "re-register")
+            self._registered = False
+        self.clientid = want or f"coap-{id(self):x}"
         if not self.ctx.authenticate(self.clientid,
                                      username=q.get("username"),
                                      password=q.get("password")):
@@ -373,11 +403,10 @@ class Channel(GwChannel):
             obs = m.observe()
             if obs == 0:
                 qos = int(m.queries().get("qos", 0))
-                self.observers[topic] = (m.token, qos)
+                self.observers[topic] = [m.token, qos, 1]
                 self.ctx.subscribe(self.clientid, topic, qos=qos)
-                self._obs_seq += 1
                 return [reply(CONTENT, options=[
-                    (OPT_OBSERVE, self._obs_seq.to_bytes(3, "big"))])]
+                    (OPT_OBSERVE, (1).to_bytes(3, "big"))])]
             if obs == 1:
                 self._cancel_observe(topic if topic in self.observers
                                      else None)
@@ -427,15 +456,20 @@ class Channel(GwChannel):
         out = []
         for sub_topic, msg in deliveries:
             plain = self.ctx.unmount(msg.topic)
-            token = qos = obs_topic_hit = None
-            for obs_topic, (tok, q) in self.observers.items():
+            token = qos = None
+            obs = None
+            for obs_topic, rec in self.observers.items():
                 from emqx_tpu.core import topic as T
                 if T.match(plain, obs_topic):
-                    token, qos, obs_topic_hit = tok, q, obs_topic
+                    token, qos, obs_topic_hit = rec[0], rec[1], obs_topic
+                    obs = rec
                     break
             if token is None:
                 continue
-            self._obs_seq += 1
+            # the observation's OWN rolling sequence, wrapping mod 2^24
+            # (the Observe option is a 3-byte uint — RFC 7641 §4.4; the
+            # old shared counter crashed in to_bytes at the boundary)
+            obs[2] = (obs[2] + 1) & 0xFFFFFF
             # QoS≥1 subscriptions notify as CON: tracked, retransmitted,
             # observation cancelled on RST or give-up (emqx_coap
             # notify_type per-subscription qos)
@@ -443,7 +477,7 @@ class Channel(GwChannel):
             mid = self._next_mid()
             note = CoapMessage(
                 mtype, CONTENT, mid, token,
-                [(OPT_OBSERVE, self._obs_seq.to_bytes(3, "big"))],
+                [(OPT_OBSERVE, obs[2].to_bytes(3, "big"))],
                 msg.payload)
             if mtype == CON:
                 self.tm.track(note)

@@ -296,6 +296,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_size_t,
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_host_listen_coap.restype = ctypes.c_int
+    lib.emqx_host_listen_coap.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+    lib.emqx_host_coap_send.restype = ctypes.c_int
+    lib.emqx_host_coap_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint32]
+    lib.emqx_host_coap_retain_state.restype = ctypes.c_int
+    lib.emqx_host_coap_retain_state.argtypes = [
+        ctypes.c_void_p, ctypes.c_int]
+    lib.emqx_host_set_coap_ack_timeout.restype = ctypes.c_int
+    lib.emqx_host_set_coap_ack_timeout.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64]
+    lib.emqx_coap_roundtrip.restype = ctypes.c_long
+    lib.emqx_coap_roundtrip.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    lib.emqx_loadgen_run_coap.restype = ctypes.c_int
+    lib.emqx_loadgen_run_coap.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint8,
+        ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
     lib.emqx_loadgen_run_sn.restype = ctypes.c_int
     lib.emqx_loadgen_run_sn.argtypes = [
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint32,
@@ -467,6 +491,8 @@ EV_TRUNK = 9
 EV_DURABLE = 10     # batched durable-store record (round 10)
 EV_HANDOFF = 11     # live plane demotion: AckState -> Python session
 EV_SPANS = 12       # distributed-tracing spans + ledger (round 13)
+EV_COAP = 13        # CoAP exchange degraded whole to the Python oracle
+                    # (round 19): payload = the raw datagram verbatim
 
 
 def parse_durable(payload: bytes) -> tuple[int, int, list[tuple]]:
@@ -613,7 +639,11 @@ HIST_STAGES = ("ingress_route", "route_flush", "qos1_rtt", "qos2_rtt",
                # multi-core shards (round 12): ENTRIES per applied
                # cross-shard ring batch (occupancy — a count, the
                # trunk_batch_n convention, not nanoseconds)
-               "shard_ring_n")
+               "shard_ring_n",
+               # coap gateway plane (round 19): coap_ingest = sampled
+               # CoAP datagram decode+dispatch; observe_notify = one
+               # observe notification resolve+encode+write
+               "coap_ingest", "observe_notify")
 
 # flight-recorder event codes (host.cc FrEvent)
 FR_EVENT_NAMES = {1: "open", 2: "frame", 3: "punt", 4: "fast_pub",
@@ -642,7 +672,8 @@ SPAN_STAGES = ("ingress", "route", "ring_cross", "trunk_flush",
 # "accept_shed" (round 16) is the accept-storm rung: admission denied
 # in the accept loop before any conn side effect (conn-scale plane).
 LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
-                  "accept_shed", "device_failover", "store_degraded")
+                  "accept_shed", "coap_giveup",
+                  "device_failover", "store_degraded")
 
 # ---------------------------------------------------------------------------
 # faultline (round 15): deterministic fault injection (fault.h)
@@ -745,6 +776,8 @@ WIRE_FIELDS: dict[int, frozenset] = {
                    ("u8", "state"), ("u32", "n"), ("u32", "len")}),
     12: frozenset({("u64", "trace_id"), ("u8", "stage"), ("u64", "t_ns"),
                    ("u64", "aux"), ("u8", "reason"), ("u64", "count")}),
+    # kind 13 carries the raw CoAP datagram verbatim — no fields
+    13: frozenset(),
 }
 
 
@@ -965,6 +998,50 @@ def sn_roundtrip(data: bytes) -> tuple[int, bytes]:
     return int(n), raw
 
 
+def coap_roundtrip(data: bytes) -> tuple[int, bytes]:
+    """Parse + re-serialize one CoAP datagram with the NATIVE codec
+    (coap.h); returns (message count — 0 or 1, reserialized bytes).
+    The codec parity test drives the gateway/coap.py oracle through
+    the same vectors."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    n = lib.emqx_coap_roundtrip(data, len(data), ctypes.byref(out),
+                                ctypes.byref(out_len))
+    raw = ctypes.string_at(out, out_len.value)
+    lib.emqx_buf_free(out)
+    return int(n), raw
+
+
+def loadgen_coap_run(host: str, port: int, n_subs: int, n_pubs: int,
+                     msgs_per_pub: int, qos: int = 0,
+                     payload_len: int = 16, idle_timeout_ms: int = 8000,
+                     window: int = 256, warmup: bool = True,
+                     fanout: bool = False) -> dict:
+    """CoAP observer/publisher fleet (loadgen.cc, shared coap.h codec):
+    observers GET+Observe /ps topics, publishers POST to them (NON for
+    qos0, CON with ?qos=1 for qos1 — acks gate the window). Runs
+    IDENTICALLY against the native listener and the asyncio gateway,
+    so both bench arms see the same wire traffic and pacing. With
+    ``fanout`` every observer watches ONE topic (the fan-out arm)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native lib unavailable: {_build_error}")
+    out = (ctypes.c_uint64 * 8)()
+    rc = lib.emqx_loadgen_run_coap(
+        host.encode(), port, int(n_subs), int(n_pubs),
+        int(msgs_per_pub), int(qos), int(payload_len),
+        int(idle_timeout_ms), int(window), 1 if warmup else 0,
+        1 if fanout else 0, out)
+    if rc != 0:
+        raise RuntimeError(f"coap loadgen failed rc={rc}")
+    keys = ("sent", "received", "wall_ns", "p50_ns", "p99_ns", "max_ns",
+            "acks", "errors")
+    return dict(zip(keys, out))
+
+
 class NativeSubTable:
     """Standalone wrapper over the C++ subscription table (router.h) —
     the differential-test surface against router/trie.py."""
@@ -1088,7 +1165,11 @@ STAT_NAMES = ("fast_in", "fast_out", "fast_bytes_out", "punts",
               "parked_pings",
               # one-recovery-path plane (round 18): the trunk qos1
               # replay ring is store-backed
-              "trunk_ring_persisted", "trunk_ring_recovered")
+              "trunk_ring_persisted", "trunk_ring_recovered",
+              # coap gateway plane (round 19)
+              "coap_in", "coap_notifies", "coap_pings",
+              "coap_dedup_hits", "coap_rexmits", "coap_giveups",
+              "coap_punts", "coap_drops_oversize")
 
 # durable-store stat slots (store.h StoreStat order)
 STORE_STAT_NAMES = ("appends", "consumed", "pending", "messages",
@@ -1398,6 +1479,7 @@ class NativeHost:
         self.ws_port = 0       # set by listen_ws()
         self.trunk_port = 0    # set by trunk_listen()
         self.sn_port = 0       # set by listen_sn()
+        self.coap_port = 0     # set by listen_coap()
         # The poll buffer must hold at least one whole event record: 13-byte
         # header + payload up to max_size (a max-size PUBLISH frame).  A
         # smaller buffer would leave host.cc unable to ever deliver that
@@ -1678,6 +1760,36 @@ class NativeHost:
             raise OSError(f"cannot bind sn listener {host}:{port}")
         self.sn_port = p
         return p
+
+    def listen_coap(self, host: str = "127.0.0.1", port: int = 0,
+                    reuseport: bool = False) -> int:
+        """Open the CoAP/UDP gateway socket (BEFORE the poll thread
+        starts). Datagram peers become conns on their first request;
+        their OPEN events carry a ``coap:ip:port`` peer string.
+        Returns the bound port."""
+        p = self._lib.emqx_host_listen_coap(self._h, host.encode(), port,
+                                            int(reuseport))
+        if p < 0:
+            raise OSError(f"cannot bind coap listener {host}:{port}")
+        self.coap_port = p
+        return p
+
+    def coap_send(self, conn: int, data: bytes) -> None:
+        """Send raw CoAP response bytes to ``conn``'s peer — the answer
+        path for oracle-served (kind-13 punted) exchanges."""
+        self._lib.emqx_host_coap_send(self._h, conn, data, len(data))
+
+    def coap_retain_state(self, complete: bool) -> None:
+        """Mirror whether the retained snapshot is complete (no
+        props-carrying topics excluded): plain CoAP GETs serve natively
+        only while it is."""
+        self._lib.emqx_host_coap_retain_state(self._h,
+                                              1 if complete else 0)
+
+    def set_coap_ack_timeout(self, ms: int) -> None:
+        """CON-notify retransmit base in ms (0 restores the RFC 7252
+        default ACK_TIMEOUT x 1.5 = 3000ms)."""
+        self._lib.emqx_host_set_coap_ack_timeout(self._h, int(ms))
 
     def sn_predefined(self, topic_id: int, topic: Optional[str]) -> None:
         """Install (or, with ``topic=None``, forget) a gateway-wide

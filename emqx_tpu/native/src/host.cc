@@ -149,6 +149,14 @@
 //                     (0 = none sampled), aux the last deciding
 //                     peer/shard/conn. Reasons index the LedgerReason
 //                     enum (native/__init__.py LEDGER_REASONS prefix).
+//   kind 13 = COAP  one CoAP exchange degraded WHOLE to the Python
+//                   oracle (round 19): conn_id = the CoAP conn, payload
+//                   = the raw datagram verbatim (no fields — the
+//                   gateway/coap.py oracle channel parses it itself and
+//                   answers through emqx_host_coap_send). Punted for
+//                   block-wise transfers, props-carrying retained
+//                   reads, and non-/ps paths (the LwM2M seam) — never
+//                   a partial exchange.
 //
 // WebSocket (round 7): a second listener serves MQTT-over-WebSocket
 // (RFC6455, ws.h) on the SAME data plane: the upgrade handshake and
@@ -181,6 +189,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "coap.h"
 #include "fault.h"
 #include "frame.h"
 #include "park.h"
@@ -253,6 +262,8 @@ enum HistStage {
   kHistShardRingN,        // cross-shard ring occupancy: ENTRIES per
                           // applied ring batch (count-valued, the
                           // trunk_batch_n convention)
+  kHistCoapIngest,        // sampled: CoAP datagram decode+dispatch
+  kHistObserveNotify,     // sampled: observe notify resolve+encode+write
   kHistCount
 };
 
@@ -377,6 +388,9 @@ enum LedgerReason : uint8_t {
   kLrFault,          // faultline injection fired (aux = the fault site)
   kLrAcceptShed,     // accept-storm shed: admission denied before any
                      // conn side effect (round 16, aux = conn count)
+  kLrCoapGiveup,     // CoAP CON-notify retransmit exhaustion: the
+                     // unresponsive observer is dropped (RFC 7641
+                     // §4.5; aux = the conn id)
   kLrCount
 };
 
@@ -511,6 +525,92 @@ struct SnConnState {
   uint64_t tm_rexmit = 0;
 };
 
+// -- native CoAP gateway state (round 19) -----------------------------------
+
+// Inbound MID dedup entry (RFC 7252 §4.5, the oracle's parity-audited
+// TransportManager window): a byte-identical retransmission replays
+// the cached response instead of re-executing the request; a DIFFERENT
+// token under the same mid is a recycled mid (the client's 16-bit
+// counter wrapped inside the lifetime) and evicts the entry.
+struct CoapSeen {
+  std::string token;
+  std::string response;  // "" = response still in flight: dup drops
+  uint64_t expire_ms;
+};
+
+// One outstanding CON notify awaiting its ACK: resent VERBATIM on the
+// RFC 7252 exponential backoff (ACK_TIMEOUT x 1.5, doubling — CoAP has
+// no DUP bit; a retransmission is the same bytes), retired by the ACK
+// (which also frees the MQTT window slot via a synthesized PUBACK),
+// cancelled together with its observation by RST or exhaustion.
+struct CoapConRx {
+  uint16_t mid;         // CoAP message id (the wire key)
+  uint16_t pid;         // MQTT delivery pid (0 = none to settle)
+  std::string dgram;    // bare message bytes (no outbuf length prefix)
+  std::string filter;   // owning observation (the RST/give-up cancel)
+  uint64_t next_ms;     // retransmit deadline
+  uint64_t timeout_ms;  // current backoff span (doubles per try)
+  uint8_t tries;
+};
+
+// One observation (RFC 7641): GET+Observe registered this token on a
+// /ps topic; notifications carry the token and the observation's OWN
+// rolling 24-bit sequence (the oracle's per-observer counter).
+struct CoapObserver {
+  std::string filter;
+  std::string token;
+  uint8_t qos;    // subscription qos: >= 1 notifies as tracked CON
+  uint32_t seq;   // 24-bit rolling observe sequence (starts at 1)
+};
+
+// Per-connection CoAP transport state, allocated only for datagram
+// peers on the CoAP listener — TCP/WS/SN conns pay nothing. Like SN,
+// the conn has no socket of its own: egress rides sendmmsg on the
+// shared UDP fd keyed by `addr`, and MQTT translation gives the peer a
+// real Python channel/session (auth, CM takeover, hooks) on demand.
+struct CoapConnState {
+  sockaddr_in addr{};
+  uint64_t conn_id = 0;
+  bool connect_sent = false;   // MQTT CONNECT forwarded to Python
+  bool connected = false;      // CONNACK rc=0 observed on egress
+  bool connack_seen = false;   // any CONNACK observed (accept or reject)
+  bool oracle_used = false;    // ever punted to the Python oracle: an
+                               // ACK/RST for an unknown mid routes there
+  std::string clientid;        // registered identity (query ?clientid=)
+  // requests pipelined into the CONNECT->CONNACK round trip (the
+  // oracle registers synchronously, so these must be served, not
+  // bounced); parked PARSED — the codec re-serializes byte-exactly
+  std::deque<coap::CoapMsg> preconn;
+  uint16_t next_mid = 0;       // notify mid allocator (oracle _next_mid)
+  uint16_t next_mqtt_mid = 0;  // translated PUBLISH/SUBSCRIBE mid space
+  std::unordered_map<uint16_t, CoapSeen> seen;  // inbound MID dedup
+  // insertion-order companion for O(1) over-bound eviction: a
+  // sustained blast must not pay an O(kCoapSeenMax) sweep per message
+  // (may hold mids whose entry was already evicted/recycled — the
+  // evictor just skips those)
+  std::deque<uint16_t> seen_fifo;
+  // MQTT mid -> the CoAP exchange whose response awaits that ack
+  struct PendingPub { uint16_t mid; std::string token; bool con; };
+  struct PendingSub { uint16_t mid; std::string token; std::string topic;
+                      uint8_t qos; bool con; };
+  std::unordered_map<uint16_t, PendingPub> pending_pub;
+  std::unordered_map<uint16_t, PendingSub> pending_sub;
+  std::vector<CoapObserver> observers;
+  std::vector<CoapConRx> rexmit;     // CON notifies awaiting ACK
+  // recent notify mid -> observing filter: RST cancels the observation
+  // for ANY notify type (RFC 7641 §3.6); bounded, never evicting a mid
+  // still awaiting its ACK (the oracle's _con_topic discipline)
+  std::unordered_map<uint16_t, std::string> notify_obs;
+  // Python-plane egress bytes are an MQTT byte stream; this framer
+  // splits them so each packet translates to one CoAP message
+  Framer egress{1 << 20};
+  // CON retransmit wheel handle — armed when the first tracked notify
+  // lands, re-armed from the fire at the conn's next backoff deadline
+  // (named apart from SnConnState::tm_rexmit so each annotation stays
+  // independently load-bearing); @gen-handle
+  uint64_t tm_notify = 0;
+};
+
 struct Conn {
   int fd = -1;
   Framer framer;
@@ -519,6 +619,7 @@ struct Conn {
   bool want_close = false;  // close once outbuf drains
   std::unique_ptr<WsConnState> ws;  // non-null = WebSocket transport
   std::unique_ptr<SnConnState> sn;  // non-null = MQTT-SN datagram conn
+  std::unique_ptr<CoapConnState> coap;  // non-null = CoAP datagram conn
   // -- fast path ----------------------------------------------------------
   bool fast = false;        // Python enabled the PUBLISH fast path
   uint8_t proto_ver = 4;    // 4 = MQTT 3.1.1, 5 = MQTT 5
@@ -594,6 +695,16 @@ constexpr uint64_t kTrunkHelloGraceMs = 300;
 // ever reach and above the Python punt-token space (1<<48).
 constexpr uint64_t kSnConnBit = 1ull << 59;
 
+// -- coap gateway bounds (round 19) -----------------------------------------
+// CoAP datagram conns get their own id namespace too — but every bit
+// ABOVE 59 is spoken for in contexts conn ids flow through (ring
+// multi-target entries pack min_qos into bits 60-61 of the target word
+// and mask conns to (1<<60)-1; durable/trunk owners sit at 61/62), so
+// the CoAP discriminator composes bit 59 with bit 55, just below the
+// shard field: sequential per-shard counters never approach 2^55, so
+// SN ids (bit 55 clear) and CoAP ids can never collide.
+constexpr uint64_t kCoapConnBit = (1ull << 59) | (1ull << 55);
+
 // -- multi-core shard bounds (round 12) -------------------------------------
 // The owner-namespace scheme extended to SHARDS: conn ids carry their
 // shard index in bits 56-58 — above the Python punt-token space
@@ -631,6 +742,7 @@ enum TimerKind : uint8_t {
   kTmPark,           // park-after check (hibernate idle conns)
   kTmSnRexmit,       // SN qos1 retransmit deadline (per conn)
   kTmTrunkAck,       // trunk silent-link watchdog (per peer)
+  kTmCoapRexmit,     // CoAP CON-notify retransmit deadline (per conn)
 };
 // Default park-after when no keepalive is known (a conn with a
 // keepalive parks after 2x its grace deadline = 3x keepalive).
@@ -653,7 +765,8 @@ struct Op {
     kDurableAdd, kDurableDel,
     kSnPredef, kRetainSet, kRetainDel, kRetainDeliver, kSetTeleShift,
     kTrunkPeerState, kSetTracing, kSetTrunkWire, kSetTrunkAckTimeout,
-    kSetKeepalive, kSetPark, kSynthConns
+    kSetKeepalive, kSetPark, kSynthConns,
+    kCoapRetainState, kSetCoapAckTimeout, kCoapSend
   };
   Kind kind;
   uint64_t owner = 0;
@@ -736,6 +849,16 @@ enum StatSlot {
                           // durable store (round 18)
   kStTrunkRingRecovered,  // ring entries rebuilt from store segments
                           // after a restart/reattach
+  kStCoapIn,              // CoAP /ps publishes ingested natively
+  kStCoapNotifies,        // observe notifications encoded (CON or NON)
+  kStCoapPings,           // CoAP pings (CON empty) answered with RST
+  kStCoapDedupHits,       // retransmitted requests served from the MID
+                          // dedup window (replay, or in-flight drop)
+  kStCoapRexmits,         // CON notify retransmissions sent
+  kStCoapGiveups,         // CON retransmit exhaustion: observer dropped
+  kStCoapPunts,           // exchanges degraded WHOLE to the Python
+                          // oracle (block-wise, props, non-/ps paths)
+  kStCoapDropsOversize,   // deliveries exceeding the CoAP frame cap
   kStatCount
 };
 
@@ -779,6 +902,7 @@ class Host {
     if (listen_ws_fd_ >= 0) close(listen_ws_fd_);
     if (listen_trunk_fd_ >= 0) close(listen_trunk_fd_);
     if (sn_fd_ >= 0) close(sn_fd_);
+    if (coap_fd_ >= 0) close(coap_fd_);
     if (wake_fd_ >= 0) close(wake_fd_);
     if (epoll_fd_ >= 0) close(epoll_fd_);
   }
@@ -945,6 +1069,52 @@ class Host {
   }
 
   int sn_port() const { return sn_port_; }
+
+  // Open the CoAP/UDP gateway socket (call BEFORE the poll thread
+  // starts, like the other listeners — it mutates the epoll set from
+  // the caller's thread). One datagram socket serves every CoAP peer;
+  // per-peer conns are minted on their first request. Returns the
+  // bound port, or -1.
+  // @plane(control)
+  int ListenCoap(const char* bind_addr, uint16_t port,
+                 bool reuseport = false) {
+    if (coap_fd_ >= 0) return -1;  // one CoAP listener per host
+    int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    // UDP SO_REUSEPORT source-hash (the SN discipline): each CoAP peer
+    // pins to ONE shard's socket, so an endpoint's message layer
+    // (dedup window, observers, retransmit state) never splits across
+    // poll threads
+    if (reuseport)
+      setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+    int buf = 4 << 20;  // datagram blasts queue in the kernel, not drop
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1 ||
+        bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      close(fd);
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenCoapTag;
+    if (epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev) < 0) {
+      close(fd);
+      return -1;
+    }
+    coap_fd_ = fd;
+    coap_port_ = ntohs(addr.sin_port);
+    return coap_port_;
+  }
+
+  int coap_port() const { return coap_port_; }
 
   // Thread-safe enqueue of outbound bytes for a connection.
   int Send(uint64_t id, const uint8_t* data, size_t len) {
@@ -1213,6 +1383,7 @@ class Host {
   static constexpr uint64_t kListenTrunkTag = ~0ull - 3;
   static constexpr uint64_t kListenSnTag = ~0ull - 4;
   static constexpr uint64_t kShardWakeTag = ~0ull - 5;
+  static constexpr uint64_t kListenCoapTag = ~0ull - 6;
 
   void Wake() {
     uint64_t one = 1;
@@ -1236,7 +1407,11 @@ class Host {
       if (it == conns_.end()) continue;
       // one WS binary frame per send() batch on WS conns
       AppendMqtt(it->second, data.data(), data.size());
-      Flush(id, it->second);
+      // AppendMqtt can rehash conns_ for CoAP conns (a CONNACK drains
+      // preconn, and a parked re-register mints a successor conn):
+      // never Flush through the pre-append iterator (review finding)
+      auto again = conns_.find(id);
+      if (again != conns_.end()) Flush(id, again->second);
     }
     for (uint64_t id : closes) {
       auto it = conns_.find(id);
@@ -1473,6 +1648,28 @@ class Host {
       case Op::kRetainDeliver:
         RetainDeliver(op.owner, op.str, op.qos);
         break;
+      case Op::kCoapRetainState:
+        // Python's retained mirror is complete (no props-carrying
+        // topics excluded) -> plain CoAP GETs may serve from the
+        // native snapshot; incomplete -> they degrade to the oracle
+        coap_retain_complete_ = op.flags != 0;
+        break;
+      case Op::kSetCoapAckTimeout:
+        // CON retransmit base (tests compress the RFC 7252 clock;
+        // 0 restores the default ACK_TIMEOUT x 1.5)
+        coap_ack_timeout_ms_ = op.token ? op.token : coap::kAckTimeoutMs;
+        break;
+      case Op::kCoapSend: {
+        // raw oracle-plane response bytes for a CoAP peer (the punt
+        // seam's answer path): framed into the conn outbuf verbatim
+        auto cit = conns_.find(op.owner);
+        if (cit == conns_.end() || !cit->second.coap) break;
+        if (op.str.size() <= coap::kMaxMessage) {
+          CoapOut(cit->second, op.str);
+          Flush(op.owner, cit->second);
+        }
+        break;
+      }
       case Op::kSetTeleShift:
         // EMQX_NATIVE_TELEMETRY_SHIFT: per-message stages sample
         // 1-in-2^shift (default shift 3 = 1-in-8); bench runs widen it
@@ -2007,6 +2204,10 @@ class Host {
       SnRead();
       return;
     }
+    if (ev.data.u64 == kListenCoapTag) {
+      CoapRead();
+      return;
+    }
     if (ev.data.u64 & kTrunkSockBit) {
       TrunkEvent(ev);
       return;
@@ -2127,6 +2328,7 @@ class Host {
       case kTmPark: FirePark(key); break;
       case kTmSnRexmit: FireSnRexmit(key); break;
       case kTmTrunkAck: FireTrunkAck(key); break;
+      case kTmCoapRexmit: FireCoapRexmit(key); break;
     }
   }
 
@@ -2198,7 +2400,11 @@ class Host {
   // (sparse summary); a queued-pending window or half-written outbuf
   // is not.
   bool CanPark(const Conn& c) const {
-    if (c.sn || c.traced || c.want_close || c.dirty) return false;
+    // datagram conns never park: SN sleep mode already parks
+    // deliveries, and a CoAP endpoint's message-layer state (dedup
+    // window, observers, retransmit copies) has no compact summary
+    if (c.sn || c.coap || c.traced || c.want_close || c.dirty)
+      return false;
     if (!c.outbuf.empty() || c.outpos) return false;
     if (!c.framer.idle()) return false;
     if (c.ws && (!c.ws->open || !c.ws->dec.idle() || !c.ws->hs_buf.empty()))
@@ -3172,6 +3378,18 @@ class Host {
       return false;
     }
     uint8_t out_qos = qos < e.qos ? qos : e.qos;
+    if (t.coap) {
+      // observe notifies cap at qos1 (CON) and at the CoAP frame
+      // limit; the oversize decision lands BEFORE any window slot is
+      // allocated (the SN discipline — a slot with no deliverable
+      // bytes would leak until conn death)
+      if (out_qos > 1) out_qos = 1;
+      if (payload.size() > coap::kMaxPayload) {
+        stats_[kStCoapDropsOversize].fetch_add(1,
+                                               std::memory_order_relaxed);
+        return false;
+      }
+    }
     if (t.sn) {
       // SN subscribers take SN framing but the SAME window machinery;
       // deliveries cap at qos1 (the oracle's handle_deliver cap)
@@ -3236,13 +3454,24 @@ class Host {
         FrNote(t, kFrDeliver, 3, tp, cur_hash_);
         TraceDeliverNote(owner);
       }
-      if (t.ws)  // frame header first so `at` lands on the MQTT bytes
-        ws::AppendFrameHeader(&t.outbuf, ws::kOpBinary, sq.size());
-      size_t at = t.outbuf.size();
-      t.outbuf += sq;
-      t.outbuf[at] = static_cast<char>(0x30 | (out_qos << 1));
-      t.outbuf[at + qoff] = static_cast<char>(tp >> 8);
-      t.outbuf[at + qoff + 1] = static_cast<char>(tp & 0xFF);
+      if (t.coap) {
+        // CoAP conns cannot take raw MQTT bytes in the outbuf: patch
+        // the shared frame in a scratch and run the egress translation
+        // (-> a tracked CON notify carrying this pid)
+        coap_pub_scratch_.assign(sq);
+        coap_pub_scratch_[0] = static_cast<char>(0x30 | (out_qos << 1));
+        coap_pub_scratch_[qoff] = static_cast<char>(tp >> 8);
+        coap_pub_scratch_[qoff + 1] = static_cast<char>(tp & 0xFF);
+        AppendMqtt(t, coap_pub_scratch_.data(), coap_pub_scratch_.size());
+      } else {
+        if (t.ws)  // frame header first so `at` lands on the MQTT bytes
+          ws::AppendFrameHeader(&t.outbuf, ws::kOpBinary, sq.size());
+        size_t at = t.outbuf.size();
+        t.outbuf += sq;
+        t.outbuf[at] = static_cast<char>(0x30 | (out_qos << 1));
+        t.outbuf[at + qoff] = static_cast<char>(tp >> 8);
+        t.outbuf[at + qoff + 1] = static_cast<char>(tp & 0xFF);
+      }
       stats_[kStFastBytesOut].fetch_add(sq.size(),
                                         std::memory_order_relaxed);
       AckNote(owner, a);
@@ -5692,6 +5921,960 @@ class Host {
       Drop(id, "closed_by_host", false);
   }
 
+  // -- native CoAP gateway (round 19) -------------------------------------
+  // RFC 7252 terminates in the host: datagrams decode with the shared
+  // coap.h codec on the SN plane's listener machinery (recvmmsg
+  // ingress, batched sendmmsg egress, per-peer conns in their own
+  // id namespace), the /ps pub-sub surface translates into MQTT
+  // frames that ride TryFast / the Python channel exactly like SN
+  // bytes, and observe notifications resolve host-side on the
+  // delivery seam (per-observer 24-bit sequences; CON mode on the
+  // native ack plane with wheel-driven RFC 7252 backoff). The asyncio
+  // gateway (gateway/coap.py) stays the protocol oracle; any exchange
+  // outside the native vocabulary — block-wise transfers,
+  // props-carrying retained reads, non-/ps paths (the LwM2M seam) —
+  // degrades WHOLE to it as a kind-13 event, never a partial set.
+
+  static constexpr int kCoapRecvBatch = 32;
+  static constexpr size_t kCoapRecvBuf = 65536;  // UDP max: no truncation
+  static constexpr size_t kCoapSeenMax = 8192;   // MID dedup entries/conn
+  static constexpr size_t kCoapNotifyObsMax = 512;  // RST-cancel history
+  static constexpr size_t kCoapBlock2Threshold = 1024;  // oracle's
+                                                        // block2_size
+
+  void CoapRead() {
+    if (coap_rx_buf_.empty())
+      coap_rx_buf_.resize(kCoapRecvBatch * kCoapRecvBuf);
+    mmsghdr mm[kCoapRecvBatch];
+    iovec iov[kCoapRecvBatch];
+    sockaddr_in peers[kCoapRecvBatch];
+    // bounded per cycle so a CoAP blast cannot starve the TCP/WS side
+    for (int budget = 0; budget < 4096; budget += kCoapRecvBatch) {
+      for (int i = 0; i < kCoapRecvBatch; i++) {
+        iov[i].iov_base = coap_rx_buf_.data() + i * kCoapRecvBuf;
+        iov[i].iov_len = kCoapRecvBuf;
+        memset(&mm[i].msg_hdr, 0, sizeof(mm[i].msg_hdr));
+        mm[i].msg_hdr.msg_name = &peers[i];
+        mm[i].msg_hdr.msg_namelen = sizeof(peers[i]);
+        mm[i].msg_hdr.msg_iov = &iov[i];
+        mm[i].msg_hdr.msg_iovlen = 1;
+      }
+      int n = recvmmsg(coap_fd_, mm, kCoapRecvBatch, 0, nullptr);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;  // EAGAIN: drained
+      }
+      for (int i = 0; i < n; i++) {
+        if (mm[i].msg_len == 0) continue;
+        const uint8_t* d = coap_rx_buf_.data() + i * kCoapRecvBuf;
+        // a fired read fault LOSES the datagram (errno and blackhole
+        // alike: UDP's loss shape), scoped to the peer's conn
+        // @fault(conn_read) — the CoAP datagram-ingress seam
+        if (fault_.armed(fault::kSiteConnRead)) {
+          auto ait = coap_addr_conn_.find(SnAddrKey(peers[i]));
+          uint64_t fkey = ait == coap_addr_conn_.end() ? 0 : ait->second;
+          if (fault_.Fire(fault::kSiteConnRead, fkey)) {
+            FaultNote(fault::kSiteConnRead);
+            continue;
+          }
+        }
+        if (telemetry_ && ((++tele_tick_coap_ & tele_mask_) == 0)) {
+          uint64_t t0 = NowNs();
+          CoapIngest(peers[i], d, mm[i].msg_len);
+          RecordHist(kHistCoapIngest, NowNs() - t0);
+        } else {
+          CoapIngest(peers[i], d, mm[i].msg_len);
+        }
+      }
+      if (n < kCoapRecvBatch) break;  // drained
+    }
+    FlushDirty();
+  }
+
+  void CoapIngest(const sockaddr_in& peer, const uint8_t* data,
+                  size_t len) {
+    coap::CoapMsg m;
+    if (!coap::Parse(data, len, &m)) return;  // the oracle drops it too
+    uint64_t key = SnAddrKey(peer);
+    auto ait = coap_addr_conn_.find(key);
+    uint64_t id;
+    if (ait != coap_addr_conn_.end() && conns_.count(ait->second)) {
+      id = ait->second;
+    } else {
+      // only REQUESTS (and pings) mint endpoint state: a bare ACK/RST
+      // from an unknown peer settles nothing, and letting reflected
+      // garbage fill the conn table would be an amplification surface
+      bool request = (m.type == coap::kCon || m.type == coap::kNon) &&
+                     m.code >= coap::kGet && m.code <= 0x1F;
+      bool ping = m.type == coap::kCon && m.code == coap::kEmpty;
+      if (!request && !ping) return;
+      if (conns_.size() >= max_conns_) return;  // esockd max-conn
+      id = CoapNewConn(peer);
+    }
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    it->second.last_rx_ms = NowMs();
+    CoapHandle(id, it->second, m, data, len);
+  }
+
+  uint64_t CoapNewConn(const sockaddr_in& peer) {
+    Conn c;
+    c.fd = -1;  // egress rides sendmmsg on the shared UDP socket
+    c.framer = Framer(max_size_);
+    c.coap = std::make_unique<CoapConnState>();
+    c.coap->addr = peer;
+    uint64_t id = kCoapConnBit | ShardPrefix() | next_coap_id_++;
+    c.coap->conn_id = id;
+    auto& cref = conns_.emplace(id, std::move(c)).first->second;
+    coap_addr_conn_[SnAddrKey(peer)] = id;
+    uint64_t now = NowMs();
+    cref.last_rx_ms = now;
+    // connectionless transport: reap silent endpoints like the asyncio
+    // UDP listener's 300s idle default (a later translated CONNECT
+    // re-arms the real deadline through set_keepalive)
+    cref.keepalive_ms = 300000;
+    cref.tm_keepalive = wheel_.Arm(id, kTmKeepalive, now + 300000);
+    FrNote(cref, kFrOpen, 0, 3);  // arg 3 = CoAP transport
+    char ip[INET_ADDRSTRLEN] = "?";
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    std::string info = std::string("coap:") + ip + ":" +
+                       std::to_string(ntohs(peer.sin_port));
+    events_.push_back(EncodeRecord(1, id, info.data(), info.size()));
+    return id;
+  }
+
+  // Frame one CoAP message into the conn outbuf. CoAP messages are not
+  // self-delimiting (the datagram boundary is the delimiter), so an
+  // internal [u16 len] prefix carries each message to CoapFlush, which
+  // re-establishes the boundaries with one datagram per message.
+  void CoapOut(Conn& c, const std::string& dgram) {
+    c.outbuf.push_back(static_cast<char>(dgram.size() >> 8));
+    c.outbuf.push_back(static_cast<char>(dgram.size() & 0xFF));
+    c.outbuf += dgram;
+  }
+
+  static coap::CoapMsg CoapResp(const coap::CoapMsg& req, uint8_t code) {
+    coap::CoapMsg r;
+    r.type = req.type == coap::kCon ? coap::kAck : coap::kNon;
+    r.code = code;
+    r.mid = req.mid;
+    r.token = req.token;
+    return r;
+  }
+
+  // Serialize + emit one response, caching the bytes in the MID dedup
+  // window so a retransmitted request replays them (oracle remember).
+  void CoapReply(uint64_t id, Conn& c, const coap::CoapMsg& resp) {
+    std::string dg;
+    coap::Serialize(resp, &dg);
+    auto it = c.coap->seen.find(resp.mid);
+    if (it != c.coap->seen.end()) it->second.response = dg;
+    CoapOut(c, dg);
+    MarkDirty(id, c);
+  }
+
+  uint16_t CoapNextMid(CoapConnState& s) {
+    s.next_mid = static_cast<uint16_t>(s.next_mid % 0xFFFF + 1);
+    return s.next_mid;
+  }
+
+  uint16_t CoapNextMqttMid(CoapConnState& s) {
+    s.next_mqtt_mid = static_cast<uint16_t>(s.next_mqtt_mid % 0xFFFF + 1);
+    return s.next_mqtt_mid;
+  }
+
+  void CoapHandle(uint64_t id, Conn& c, coap::CoapMsg& m,
+                  const uint8_t* raw, size_t len) {
+    CoapConnState& s = *c.coap;
+    if (m.type == coap::kCon && m.code == coap::kEmpty) {
+      // CoAP ping (§4.3): pong with RST. The client's mid space is
+      // independent of ours — it must NOT settle a pending notify
+      // that happens to share the number (oracle parity).
+      stats_[kStCoapPings].fetch_add(1, std::memory_order_relaxed);
+      coap::CoapMsg r;
+      r.type = coap::kRst;
+      r.mid = m.mid;
+      std::string dg;
+      coap::Serialize(r, &dg);
+      CoapOut(c, dg);
+      MarkDirty(id, c);
+      return;
+    }
+    if (m.type == coap::kAck || m.type == coap::kRst) {
+      CoapSettle(id, c, m, raw, len);
+      return;
+    }
+    if (m.code == coap::kEmpty) return;  // NON empty: nothing to do
+    if (m.code >= 0x20) return;  // a response class from a client
+    // the native-vs-oracle decision comes BEFORE any side effect —
+    // one exchange is served whole by exactly one plane
+    if (!CoapEligible(m)) {
+      CoapPunt(id, c, raw, len);
+      return;
+    }
+    // inbound MID dedup (the oracle's parity-audited window): a
+    // byte-identical retransmission replays the cached response; a
+    // recycled mid (different token) evicts and runs fresh
+    auto sit = s.seen.find(m.mid);
+    if (sit != s.seen.end()) {
+      if (NowMs() >= sit->second.expire_ms ||
+          sit->second.token != m.token) {
+        s.seen.erase(sit);
+      } else {
+        stats_[kStCoapDedupHits].fetch_add(1, std::memory_order_relaxed);
+        if (!sit->second.response.empty()) {
+          CoapOut(c, sit->second.response);
+          MarkDirty(id, c);
+        }
+        return;  // response still in flight: drop the retransmission
+      }
+    }
+    CoapSeenInsert(s, m);
+    CoapServe(id, c, m);
+  }
+
+  void CoapSeenInsert(CoapConnState& s, const coap::CoapMsg& m) {
+    uint64_t life = m.type == coap::kCon ? coap::kExchangeLifetimeMs
+                                         : coap::kNonLifetimeMs;
+    uint64_t now = NowMs();
+    // over the bound, evict OLDEST-INSERTED first (amortized O(1) via
+    // the fifo — a sustained NON blast wraps the 16-bit mid space well
+    // inside the RFC lifetimes, and bounded memory beats a perfect
+    // replay window there; the natural-expiry case never gets here)
+    while (s.seen.size() >= kCoapSeenMax && !s.seen_fifo.empty()) {
+      s.seen.erase(s.seen_fifo.front());
+      s.seen_fifo.pop_front();
+    }
+    s.seen[m.mid] = {m.token, "", now + life};
+    s.seen_fifo.push_back(m.mid);
+    // the fifo tolerates stale mids (recycled entries); cap its drift
+    if (s.seen_fifo.size() > 2 * kCoapSeenMax) {
+      std::deque<uint16_t> fresh;
+      for (uint16_t mid : s.seen_fifo)
+        if (s.seen.count(mid) &&
+            (fresh.empty() || fresh.back() != mid))
+          fresh.push_back(mid);
+      s.seen_fifo.swap(fresh);
+    }
+  }
+
+  // ACK/RST for a message WE originated (a CON notify): settle the
+  // retransmit copy — the CoAP ACK is the delivery ack, so a tracked
+  // pid routes as a synthesized MQTT PUBACK (native pids consume in
+  // TryFastPuback, Python pids forward to the session). RST cancels
+  // the observation for ANY notification type (RFC 7641 §3.6). Mids
+  // unknown to the native plane route to the Python oracle when it has
+  // ever served this endpoint (its own CON commands — e.g. LwM2M
+  // downlinks — are tracked there).
+  void CoapSettle(uint64_t id, Conn& c, const coap::CoapMsg& m,
+                  const uint8_t* raw, size_t len) {
+    CoapConnState& s = *c.coap;
+    bool known = false;
+    auto& rx = s.rexmit;
+    for (size_t i = 0; i < rx.size(); i++) {
+      if (rx[i].mid != m.mid) continue;
+      known = true;
+      uint16_t pid = rx[i].pid;
+      std::string filter = std::move(rx[i].filter);
+      rx[i] = std::move(rx.back());
+      rx.pop_back();
+      if (rx.empty() && s.tm_notify) {
+        wheel_.Cancel(s.tm_notify);
+        s.tm_notify = 0;
+      }
+      if (pid) {
+        std::string f;
+        MakeMqttAck(&f, 0x40, pid);
+        SnForward(id, c, f);
+      }
+      if (m.type == coap::kRst) CoapCancelObserve(id, c, filter);
+      break;
+    }
+    auto nit = s.notify_obs.find(m.mid);
+    if (nit != s.notify_obs.end()) {
+      known = true;
+      if (m.type == coap::kRst) CoapCancelObserve(id, c, nit->second);
+      s.notify_obs.erase(nit);
+    }
+    if (!known && s.oracle_used) CoapPunt(id, c, raw, len);
+  }
+
+  // Drop one observation: remove the observer entry and release the
+  // broker subscription through the SAME seam a client unobserve takes
+  // (a synthesized MQTT UNSUBSCRIBE — the Python session owns the
+  // subscription state; the match-table entry tears down through it).
+  void CoapCancelObserve(uint64_t id, Conn& c, const std::string& filter) {
+    CoapConnState& s = *c.coap;
+    bool found = false;
+    for (size_t i = 0; i < s.observers.size(); i++) {
+      if (s.observers[i].filter != filter) continue;
+      s.observers[i] = std::move(s.observers.back());
+      s.observers.pop_back();
+      found = true;
+      break;
+    }
+    if (!found || !s.connected) return;
+    std::string body;
+    sn::PutBe16(&body, CoapNextMqttMid(s));
+    sn::PutBe16(&body, static_cast<uint16_t>(filter.size()));
+    body += filter;
+    std::string f;
+    BuildMqttFrame(&f, 0xA2, body);
+    SnForward(id, c, f);  // the UNSUBACK egress is swallowed
+  }
+
+  // The native-vocabulary test: everything this rejects is served
+  // WHOLE by the Python oracle (gateway/coap.py or the configured
+  // channel) — block-wise transfers and any other unknown option,
+  // plain reads while the retained mirror is incomplete (v5 props),
+  // and non-/ps paths including the LwM2M /rd surface. Decided before
+  // ANY side effect, so an exchange never splits across planes.
+  // @admit-check
+  bool CoapEligible(const coap::CoapMsg& m) {
+    bool first_seen = false, first_is_ps = false;
+    for (const auto& [n, v] : m.options) {
+      if (n == coap::kOptUriPath) {
+        if (!first_seen) {
+          first_seen = true;
+          first_is_ps = v == "ps";
+        }
+      } else if (n != coap::kOptObserve && n != coap::kOptUriQuery &&
+                 n != coap::kOptContentFormat) {
+        return false;  // Block1/Block2/ETag/...: oracle vocabulary
+      }
+    }
+    if (!first_is_ps) return false;  // /rd et al -> the oracle channel
+    if (m.code == coap::kGet && coap::ObserveOf(m) < 0 &&
+        !coap_retain_complete_)
+      return false;  // plain read with an incomplete retained mirror
+    return true;
+  }
+
+  // Degrade one exchange WHOLE to the Python oracle (kind 13): the raw
+  // datagram ships verbatim; gateway/coap.py (or the configured
+  // oracle channel — LwM2M) parses, dedups, executes, and answers
+  // through emqx_host_coap_send. The native plane took no side effect
+  // for it — never a partial exchange.
+  void CoapPunt(uint64_t id, Conn& c, const uint8_t* raw, size_t len) {
+    c.coap->oracle_used = true;
+    stats_[kStCoapPunts].fetch_add(1, std::memory_order_relaxed);
+    FrNote(c, kFrPunt, 0, static_cast<uint16_t>(len & 0xFFFF));
+    events_.push_back(EncodeRecord(
+        13, id, reinterpret_cast<const char*>(raw), len));
+  }
+
+  // Execute one admitted (native-vocabulary) request — the oracle's
+  // _handle_request shape. Requests arriving before the translated
+  // CONNECT's CONNACK park in preconn and replay through here; the
+  // drain (and every other caller) re-runs CoapEligible first.
+  // @admit-gated
+  void CoapServe(uint64_t id, Conn& c, coap::CoapMsg& m) {
+    CoapConnState& s = *c.coap;
+    coap_path_scratch_.clear();
+    coap::JoinPath(m, &coap_path_scratch_);
+    std::string topic;  // "/".join(path[1:]), the oracle's topic
+    for (size_t i = 1; i < coap_path_scratch_.size(); i++) {
+      if (i > 1) topic += '/';
+      topic.append(coap_path_scratch_[i].data(),
+                   coap_path_scratch_[i].size());
+    }
+    if (topic.empty()) {
+      CoapReply(id, c, CoapResp(m, coap::kBadRequest));
+      return;
+    }
+    if (!s.connected) {
+      if (s.connect_sent && !s.connack_seen) {
+        // CONNECT in flight to the Python channel: requests pipelined
+        // into the round trip park and replay after the CONNACK (the
+        // oracle registers synchronously, so they must be served)
+        if (s.preconn.size() < kSnPreconnMax)
+          s.preconn.push_back(std::move(m));
+        return;
+      }
+      if (s.connack_seen) {
+        // rejected CONNACK: denied auth (oracle UNAUTHORIZED parity);
+        // the Python channel is tearing this conn down
+        CoapReply(id, c, CoapResp(m, coap::kUnauthorized));
+        return;
+      }
+      CoapConnect(id, c, m);
+      auto it = conns_.find(id);
+      if (it != conns_.end() && it->second.coap)
+        it->second.coap->preconn.push_back(std::move(m));
+      return;
+    }
+    std::string_view want;
+    if (coap::Query(m, "clientid", &want) && !want.empty() &&
+        want != s.clientid) {
+      // the peer RE-REGISTERS under a new identity: the old session's
+      // observers must not leak into the new one and the new clientid
+      // must be re-authenticated (the parity-audited oracle fix; the
+      // SN re-CONNECT discipline — the addr slot moves to a successor
+      // conn, the old one keeps draining)
+      // the successor conn pays the same admission the first datagram
+      // did (review finding: an endpoint flipping identities at the
+      // cap must not grow the table past max_conns_ while its old
+      // conns drain) — at the cap the request drops like any other
+      // over-cap datagram and the client's retransmit retries
+      if (conns_.size() >= max_conns_) return;
+      CoapSeen carry{};
+      auto old_seen = s.seen.find(m.mid);
+      bool have_seen = old_seen != s.seen.end();
+      if (have_seen) carry = old_seen->second;
+      sockaddr_in peer = s.addr;
+      coap_addr_conn_.erase(SnAddrKey(peer));
+      std::string f;
+      f.push_back(static_cast<char>(0xE0));
+      f.push_back(0);
+      SnForward(id, c, f);  // Python closes the old session
+      // conns_ may rehash on the emplace: no Conn& use after this
+      uint64_t nid = CoapNewConn(peer);
+      auto nit = conns_.find(nid);
+      if (nit != conns_.end() && nit->second.coap) {
+        // the dedup entry follows the exchange to the successor conn
+        // (a retransmission must not re-execute on the new identity)
+        if (have_seen) nit->second.coap->seen[m.mid] = carry;
+        CoapConnect(nid, nit->second, m);
+        nit->second.coap->preconn.push_back(std::move(m));
+      }
+      return;
+    }
+    CoapExecute(id, c, m, topic);
+  }
+
+  void CoapExecute(uint64_t id, Conn& c, coap::CoapMsg& m,
+                   const std::string& topic) {
+    CoapConnState& s = *c.coap;
+    if (m.code == coap::kPut || m.code == coap::kPost) {
+      // publish: qos/retain from the Uri-Query (oracle parity). A
+      // qos>=1 publish answers 2.04 only when its MQTT ack lands —
+      // the native ack plane gates the CoAP response (CON reliability
+      // means "the broker has it", not "the gateway heard it")
+      std::string_view qv;
+      uint8_t qos = 0;
+      if (coap::Query(m, "qos", &qv) && !qv.empty() && qv[0] >= '0' &&
+          qv[0] <= '2')
+        qos = static_cast<uint8_t>(qv[0] - '0');
+      std::string_view rv;
+      bool retain =
+          coap::Query(m, "retain", &rv) && (rv == "true" || rv == "1");
+      stats_[kStCoapIn].fetch_add(1, std::memory_order_relaxed);
+      uint16_t mqtt_mid = 0;
+      if (qos > 0) {
+        mqtt_mid = CoapNextMqttMid(s);
+        // runaway bound: a client that never sees its 2.04s cannot
+        // grow this past the mid space (the SN pub_tid discipline)
+        if (s.pending_pub.size() > 8192) s.pending_pub.clear();
+        s.pending_pub[mqtt_mid] = {m.mid, m.token,
+                                   m.type == coap::kCon};
+      }
+      std::string body;
+      sn::PutBe16(&body, static_cast<uint16_t>(topic.size()));
+      body += topic;
+      if (qos) sn::PutBe16(&body, mqtt_mid);
+      body += m.payload;
+      uint8_t h =
+          static_cast<uint8_t>(0x30 | (qos << 1) | (retain ? 1 : 0));
+      std::string f;
+      BuildMqttFrame(&f, h, body);
+      SnForward(id, c, f);
+      if (qos == 0)  // nothing acks a qos0 publish: answer now
+        CoapReply(id, c, CoapResp(m, coap::kChanged));
+      return;
+    }
+    if (m.code == coap::kGet) {
+      long obs = coap::ObserveOf(m);
+      if (obs == 0) {
+        // observe register -> MQTT SUBSCRIBE (always the Python
+        // plane, like SN); the observer entry and the 2.05 reply
+        // land when the SUBACK egresses
+        std::string_view qv;
+        uint8_t qos = 0;
+        if (coap::Query(m, "qos", &qv) && !qv.empty() &&
+            qv[0] >= '0' && qv[0] <= '2')
+          qos = static_cast<uint8_t>(qv[0] - '0');
+        uint16_t mqtt_mid = CoapNextMqttMid(s);
+        if (s.pending_sub.size() > 1024) s.pending_sub.clear();
+        s.pending_sub[mqtt_mid] = {m.mid, m.token, topic, qos,
+                                   m.type == coap::kCon};
+        std::string body;
+        sn::PutBe16(&body, mqtt_mid);
+        sn::PutBe16(&body, static_cast<uint16_t>(topic.size()));
+        body += topic;
+        body.push_back(static_cast<char>(qos));
+        std::string f;
+        BuildMqttFrame(&f, 0x82, body);
+        SnForward(id, c, f);
+        return;
+      }
+      if (obs == 1) {
+        // deregister: the oracle replies 2.05 whether or not the
+        // observation existed
+        CoapCancelObserve(id, c, topic);
+        CoapReply(id, c, CoapResp(m, coap::kContent));
+        return;
+      }
+      // plain read: latest retained message. The mirror is complete
+      // (CoapEligible gated on it); bodies past the oracle's block2
+      // threshold degrade the WHOLE exchange to its slicing — decided
+      // before any side effect (a read has none)
+      retain_scratch_.clear();
+      retained_.Match(topic, store::WallMs(), &retain_scratch_);
+      if (retain_scratch_.empty()) {
+        CoapReply(id, c, CoapResp(m, coap::kNotFound));
+        return;
+      }
+      const RetainEntry* e = retain_scratch_.back();
+      if (e->payload.size() > kCoapBlock2Threshold) {
+        s.seen.erase(m.mid);  // the oracle owns this exchange's dedup
+        std::string raw;
+        coap::Serialize(m, &raw);  // codec roundtrips byte-exactly
+        CoapPunt(id, c, reinterpret_cast<const uint8_t*>(raw.data()),
+                 raw.size());
+        return;
+      }
+      coap::CoapMsg r = CoapResp(m, coap::kContent);
+      r.payload = e->payload;
+      CoapReply(id, c, r);
+      return;
+    }
+    if (m.code == coap::kDelete) {
+      CoapReply(id, c, CoapResp(m, coap::kDeleted));
+      return;
+    }
+    CoapReply(id, c, CoapResp(m, coap::kNotAllowed));
+  }
+
+  // Translate the endpoint's registration into an MQTT CONNECT the
+  // Python channel owns (auth/CM takeover/hooks exactly like TCP/SN).
+  // Identity comes from the Uri-Query (?clientid/?username/?password,
+  // the oracle's _ensure_client), defaulting like SnDefaultCid.
+  void CoapConnect(uint64_t id, Conn& c, const coap::CoapMsg& m) {
+    CoapConnState& s = *c.coap;
+    std::string_view cid, user, pass;
+    coap::Query(m, "clientid", &cid);
+    bool has_user = coap::Query(m, "username", &user);
+    bool has_pass = coap::Query(m, "password", &pass);
+    if (has_pass && !has_user) {
+      has_user = true;  // 3.1.1 forbids password-without-username
+      user = std::string_view();
+    }
+    s.clientid = cid.empty()
+                     ? "coap-" + std::to_string(id & 0xFFFFFFFFull)
+                     : std::string(cid);
+    s.connect_sent = true;
+    s.connected = false;
+    std::string body;
+    body.push_back(0);
+    body.push_back(4);
+    body += "MQTT";
+    body.push_back(4);  // translated CoAP sessions speak MQTT 3.1.1
+    uint8_t flags = 0x02;  // clean session: CoAP endpoints are
+                           // connectionless; state lives in observers
+    if (has_user) flags |= 0x80;
+    if (has_pass) flags |= 0x40;
+    body.push_back(static_cast<char>(flags));
+    sn::PutBe16(&body, 300);  // the asyncio UDP listener's idle default
+    sn::PutBe16(&body, static_cast<uint16_t>(s.clientid.size()));
+    body += s.clientid;
+    if (has_user) {
+      sn::PutBe16(&body, static_cast<uint16_t>(user.size()));
+      body.append(user.data(), user.size());
+    }
+    if (has_pass) {
+      sn::PutBe16(&body, static_cast<uint16_t>(pass.size()));
+      body.append(pass.data(), pass.size());
+    }
+    std::string f;
+    BuildMqttFrame(&f, 0x10, body);
+    SnForward(id, c, f);
+  }
+
+  void CoapDrainPreconn(uint64_t id) {
+    std::deque<coap::CoapMsg> q;
+    {
+      auto it = conns_.find(id);
+      if (it == conns_.end() || !it->second.coap) return;
+      q.swap(it->second.coap->preconn);
+    }
+    for (coap::CoapMsg& m : q) {
+      // re-find each round: a dispatched request can rehash conns_
+      auto it = conns_.find(id);
+      if (it == conns_.end() || !it->second.coap) return;
+      Conn& c = it->second;
+      if (!c.coap->connected) {
+        // the CONNACK was a reject: the oracle answers UNAUTHORIZED
+        CoapReply(id, c, CoapResp(m, coap::kUnauthorized));
+        continue;
+      }
+      // the ladder re-decides per parked message (the vocabulary may
+      // have narrowed while parked — e.g. the retained mirror went
+      // incomplete); parked messages were already dedup-inserted
+      if (!CoapEligible(m)) {
+        c.coap->seen.erase(m.mid);
+        std::string raw;
+        coap::Serialize(m, &raw);
+        CoapPunt(id, c, reinterpret_cast<const uint8_t*>(raw.data()),
+                 raw.size());
+        continue;
+      }
+      CoapServe(id, c, m);
+    }
+  }
+
+  // -- CoAP egress (MQTT -> CoAP translation) -----------------------------
+
+  void CoapEgress(Conn& c, const char* data, size_t len) {
+    // LOCAL frame list: translation re-enters this function on the
+    // same conn (PUBREC -> synthesized PUBREL -> PUBCOMP egress), and
+    // a member scratch would be cleared mid-iteration (review
+    // finding). The swap recycles the member's capacity in the
+    // common non-nested case.
+    std::vector<std::string> frames;
+    frames.swap(coap_frames_scratch_);
+    frames.clear();
+    c.coap->egress.Feed(reinterpret_cast<const uint8_t*>(data), len,
+                        &frames);
+    for (const std::string& f : frames) CoapTranslateEgress(c, f);
+    frames.clear();
+    coap_frames_scratch_.swap(frames);
+    // a CONNACK in this span settles the CONNECT round trip: replay
+    // parked requests AFTER the scratch loop (dispatch re-enters
+    // egress paths) and after the responses above joined the outbuf
+    if (c.coap->connack_seen && !c.coap->preconn.empty())
+      CoapDrainPreconn(c.coap->conn_id);
+  }
+
+  void CoapTranslateEgress(Conn& c, const std::string& f) {
+    CoapConnState& s = *c.coap;
+    uint8_t type = static_cast<uint8_t>(f[0]) >> 4;
+    size_t pos = 1;
+    while (pos < f.size() && (static_cast<uint8_t>(f[pos]) & 0x80)) pos++;
+    pos++;  // first body byte
+    auto pid_at = [&](size_t at) -> uint16_t {
+      if (at + 2 > f.size()) return 0;
+      return static_cast<uint16_t>((static_cast<uint8_t>(f[at]) << 8) |
+                                   static_cast<uint8_t>(f[at + 1]));
+    };
+    switch (type) {
+      case 2: {  // CONNACK: no CoAP analogue — flips the session gate
+        if (pos + 2 > f.size()) return;
+        s.connack_seen = true;
+        if (static_cast<uint8_t>(f[pos + 1]) == 0) s.connected = true;
+        return;
+      }
+      case 3: {  // PUBLISH: a delivery for this endpoint's observers
+        uint8_t h = static_cast<uint8_t>(f[0]);
+        uint8_t qos = (h >> 1) & 3;
+        if (pos + 2 > f.size()) return;
+        uint16_t tlen = pid_at(pos);
+        pos += 2;
+        if (pos + tlen > f.size()) return;
+        std::string_view topic(f.data() + pos, tlen);
+        pos += tlen;
+        uint16_t pid = 0;
+        if (qos) {
+          pid = pid_at(pos);
+          pos += 2;
+          if (pos > f.size()) return;
+        }
+        std::string_view payload(f.data() + pos, f.size() - pos);
+        CoapDeliverNotify(c, topic, payload, pid);
+        return;
+      }
+      case 4:  // PUBACK: the client's qos1 publish is done -> 2.04
+        CoapPubDone(c, pid_at(pos));
+        return;
+      case 5: {  // PUBREC: self-complete the qos2 exchange (the CoAP
+                 // client knows nothing of the PUBREL leg)
+        std::string rel;
+        MakeMqttAck(&rel, 0x62, pid_at(pos));
+        SnForward(s.conn_id, c, rel);
+        return;
+      }
+      case 7:  // PUBCOMP: the qos2 publish is done -> 2.04
+        CoapPubDone(c, pid_at(pos));
+        return;
+      case 9: {  // SUBACK: complete the observe registration
+        uint16_t pid = pid_at(pos);
+        auto it = s.pending_sub.find(pid);
+        if (it == s.pending_sub.end()) return;
+        CoapConnState::PendingSub ctx = it->second;
+        s.pending_sub.erase(it);
+        // the oracle registers the observer unconditionally (before
+        // ctx.subscribe, denied or not) and replies 2.05 regardless —
+        // mirror exactly; a same-filter re-register replaces the
+        // token/qos and restarts the observation's sequence
+        bool replaced = false;
+        for (auto& o : s.observers) {
+          if (o.filter != ctx.topic) continue;
+          o.token = ctx.token;
+          o.qos = ctx.qos;
+          o.seq = 1;
+          replaced = true;
+          break;
+        }
+        if (!replaced)
+          s.observers.push_back({ctx.topic, ctx.token, ctx.qos, 1});
+        coap::CoapMsg r;
+        r.type = ctx.con ? coap::kAck : coap::kNon;
+        r.code = coap::kContent;
+        r.mid = ctx.mid;
+        r.token = ctx.token;
+        r.options.emplace_back(coap::kOptObserve,
+                               std::string("\x00\x00\x01", 3));
+        CoapReply(s.conn_id, c, r);
+        return;
+      }
+      default:
+        return;  // UNSUBACK/PINGRESP/DISCONNECT: nothing to translate
+    }
+  }
+
+  // Shared PUBACK/PUBCOMP tail: the MQTT ack for a translated publish
+  // answers the original exchange 2.04 Changed (piggybacked on the
+  // CoAP ACK for CON requests — the response rides the ack plane).
+  void CoapPubDone(Conn& c, uint16_t pid) {
+    CoapConnState& s = *c.coap;
+    auto it = s.pending_pub.find(pid);
+    if (it == s.pending_pub.end()) return;
+    coap::CoapMsg r;
+    r.type = it->second.con ? coap::kAck : coap::kNon;
+    r.code = coap::kChanged;
+    r.mid = it->second.mid;
+    r.token = it->second.token;
+    s.pending_pub.erase(it);
+    CoapReply(s.conn_id, c, r);
+  }
+
+  // Resolve + encode one observe notification on the delivery seam.
+  // pid != 0 ties the notify to an MQTT window slot (the peer's ACK,
+  // by mid, becomes the synthesized PUBACK that frees it). Per-observer
+  // 24-bit sequences; oracle parity throughout.
+  void CoapDeliverNotify(Conn& c, std::string_view topic,
+                         std::string_view payload, uint16_t pid) {
+    CoapConnState& s = *c.coap;
+    uint64_t t0 = 0;
+    if (telemetry_ && ((++tele_tick_notify_ & tele_mask_) == 0))
+      t0 = NowNs();
+    CoapObserver* obs = nullptr;
+    for (auto& o : s.observers) {
+      if (coap::TopicMatch(topic, o.filter)) {
+        obs = &o;
+        break;
+      }
+    }
+    if (obs == nullptr || payload.size() > coap::kMaxPayload) {
+      if (obs != nullptr)
+        stats_[kStCoapDropsOversize].fetch_add(
+            1, std::memory_order_relaxed);
+      // a delivery that cannot reach the peer abandons its window
+      // slot exactly as an ack would (the SN exhaustion discipline)
+      CoapAbandonPid(s.conn_id, c, pid);
+      return;
+    }
+    obs->seq = (obs->seq + 1) & 0xFFFFFF;
+    uint16_t mid = CoapNextMid(s);
+    // CON-vs-NON follows the OBSERVER's subscription qos (the oracle's
+    // notify_type rule — even a qos0-published message notifies a
+    // qos>=1 observation as a tracked CON; pid 0 just means there is
+    // no window slot to settle when it resolves)
+    uint8_t mtype = obs->qos ? coap::kCon : coap::kNon;
+    std::string dg;
+    coap::BuildNotify(&dg, mtype, mid, obs->token, obs->seq, payload);
+    // the RST-cancel map covers NON notifies too (RFC 7641 §3.6);
+    // bounded — but never evict a mid whose CON still awaits its ACK
+    // (losing it would orphan the give-up/RST cancel path)
+    if (s.notify_obs.size() >= kCoapNotifyObsMax) {
+      for (auto it = s.notify_obs.begin(); it != s.notify_obs.end();
+           ++it) {
+        bool tracked = false;
+        for (const auto& r : s.rexmit)
+          if (r.mid == it->first) {
+            tracked = true;
+            break;
+          }
+        if (!tracked) {
+          s.notify_obs.erase(it);
+          break;
+        }
+      }
+    }
+    s.notify_obs[mid] = obs->filter;
+    if (mtype == coap::kCon) {
+      uint64_t now = NowMs();
+      s.rexmit.push_back({mid, pid, dg, obs->filter,
+                          now + coap_ack_timeout_ms_,
+                          coap_ack_timeout_ms_, 0});
+      if (!s.tm_notify)
+        s.tm_notify = wheel_.Arm(s.conn_id, kTmCoapRexmit,
+                                 now + coap_ack_timeout_ms_);
+    }
+    stats_[kStCoapNotifies].fetch_add(1, std::memory_order_relaxed);
+    CoapOut(c, dg);
+    MarkDirty(s.conn_id, c);
+    if (t0) RecordHist(kHistObserveNotify, NowNs() - t0);
+  }
+
+  // A delivery that cannot reach the peer (no observer / oversize /
+  // retransmit exhaustion) abandons its window slot exactly as a
+  // PUBACK would: native pids free inline; Python pids stay with
+  // their session's retry machinery.
+  void CoapAbandonPid(uint64_t id, Conn& c, uint16_t pid) {
+    if (pid < kNativePidBase || !c.ack) return;
+    AckState& a = *c.ack;
+    uint32_t bi = pid - kNativePidBase;
+    if (!BitTest(a.inflight, bi)) return;
+    BitClr(a.inflight, bi);
+    a.inflight_cnt--;
+    a.cyc_acked++;
+    AckNote(id, a);
+  }
+
+  // Per-conn CON-notify retransmit: the RFC 7252 exponential backoff
+  // (base x 2^n) on the timer wheel — the FireSnRexmit shape with
+  // per-entry doubling deadlines. Exhaustion drops the unresponsive
+  // observer (RFC 7641 §4.5 — stop notifying dead clients), frees the
+  // window slot, and lands in the degradation ledger as coap_giveup.
+  void FireCoapRexmit(uint64_t id) {
+    auto cit = conns_.find(id);
+    if (cit == conns_.end() || !cit->second.coap) return;
+    Conn& c = cit->second;
+    c.coap->tm_notify = 0;
+    if (c.coap->rexmit.empty()) return;
+    uint64_t now = NowMs();
+    uint64_t next_due = 0;
+    bool resent = false;
+    std::vector<std::string> cancel;
+    auto& rx = c.coap->rexmit;
+    for (size_t i = 0; i < rx.size();) {
+      CoapConRx& r = rx[i];
+      if (now < r.next_ms) {
+        if (!next_due || r.next_ms < next_due) next_due = r.next_ms;
+        i++;
+        continue;
+      }
+      if (r.tries >= coap::kMaxRetransmit) {
+        stats_[kStCoapGiveups].fetch_add(1, std::memory_order_relaxed);
+        LedgerNote(kLrCoapGiveup, id);
+        CoapAbandonPid(id, c, r.pid);
+        c.coap->notify_obs.erase(r.mid);
+        cancel.push_back(std::move(r.filter));
+        rx[i] = std::move(rx.back());
+        rx.pop_back();
+        continue;
+      }
+      CoapOut(c, r.dgram);  // resent VERBATIM (CoAP has no DUP bit)
+      MarkDirty(id, c);
+      resent = true;
+      r.tries++;
+      r.timeout_ms *= 2;
+      r.next_ms = now + r.timeout_ms;
+      if (!next_due || r.next_ms < next_due) next_due = r.next_ms;
+      stats_[kStCoapRexmits].fetch_add(1, std::memory_order_relaxed);
+      i++;
+    }
+    // cancellations AFTER the scan: CoapCancelObserve forwards MQTT
+    // frames whose handling can re-enter the delivery paths
+    for (const std::string& filt : cancel) CoapCancelObserve(id, c, filt);
+    auto again = conns_.find(id);
+    if (again == conns_.end() || !again->second.coap) return;
+    Conn& c2 = again->second;
+    if (c2.ack) DrainPending(id, c2);  // freed slots pull the queue
+    // DrainPending may have tracked a fresh CON (CoapDeliverNotify
+    // arms the timer it found zeroed): never double-arm over it
+    if (!c2.coap->rexmit.empty() && next_due && !c2.coap->tm_notify)
+      c2.coap->tm_notify = wheel_.Arm(id, kTmCoapRexmit, next_due);
+    if (resent) FlushDirty();
+  }
+
+  // Datagram egress: the outbuf holds [u16 len]-prefixed CoAP
+  // messages (one message = one datagram on the wire, RFC 7252 §3);
+  // up to kCoapSendBatch go out per sendmmsg — the SN syscall
+  // amortization minus packing, which CoAP forbids (so the batch runs
+  // deeper than SN's: every message pays its own datagram).
+  static constexpr int kCoapSendBatch = 32;
+
+  void CoapFlush(uint64_t id, Conn& c) {
+    CoapConnState& s = *c.coap;
+    while (c.outpos < c.outbuf.size()) {
+      iovec iov[kCoapSendBatch];
+      mmsghdr mm[kCoapSendBatch];
+      size_t span_end[kCoapSendBatch];
+      int nspan = 0;
+      size_t pos = c.outpos;
+      bool corrupt = false;
+      while (pos < c.outbuf.size() && nspan < kCoapSendBatch) {
+        if (pos + 2 > c.outbuf.size()) {
+          corrupt = true;  // torn prefix: whole messages only live here
+          break;
+        }
+        size_t dlen =
+            (static_cast<size_t>(static_cast<uint8_t>(c.outbuf[pos]))
+             << 8) |
+            static_cast<uint8_t>(c.outbuf[pos + 1]);
+        if (pos + 2 + dlen > c.outbuf.size()) {
+          corrupt = true;
+          break;
+        }
+        iov[nspan].iov_base =
+            const_cast<char*>(c.outbuf.data() + pos + 2);
+        iov[nspan].iov_len = dlen;
+        memset(&mm[nspan].msg_hdr, 0, sizeof(mm[nspan].msg_hdr));
+        mm[nspan].msg_hdr.msg_name = &s.addr;
+        mm[nspan].msg_hdr.msg_namelen = sizeof(s.addr);
+        mm[nspan].msg_hdr.msg_iov = &iov[nspan];
+        mm[nspan].msg_hdr.msg_iovlen = 1;
+        span_end[nspan] = pos + 2 + dlen;
+        nspan++;
+        pos += 2 + dlen;
+      }
+      if (nspan == 0) {
+        if (corrupt) {  // bad framing at the head: never spin on it
+          c.outbuf.clear();
+          c.outpos = 0;
+        }
+        break;
+      }
+      // errno loses the head datagram, short sends only the first of
+      // the batch, blackhole claims success while every byte vanishes
+      // (the CON-exhaustion rig: notifies into the void retransmit to
+      // give-up with no FIN/RST ever surfacing)
+      int want = nspan;
+      // @fault(conn_write) — the CoAP datagram-egress seam
+      if (fault_.armed(fault::kSiteConnWrite)) {
+        int fmode = fault_.Fire(fault::kSiteConnWrite, id);
+        if (fmode) {
+          FaultNote(fault::kSiteConnWrite);
+          if (fmode == fault::kModeBlackhole) {
+            c.outpos = span_end[nspan - 1];
+            continue;
+          }
+          if (fmode == fault::kModeShort) {
+            want = 1;
+          } else {  // errno: the datagram is lost (UDP semantics)
+            c.outpos = span_end[0];
+            continue;
+          }
+        }
+      }
+      int sentn = sendmmsg(coap_fd_, mm, want, MSG_NOSIGNAL);
+      if (sentn < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        c.outpos = span_end[0];  // drop one datagram, keep going
+        continue;
+      }
+      c.outpos = span_end[sentn - 1];
+    }
+    if (c.outpos >= c.outbuf.size()) {
+      c.outbuf.clear();
+      c.outpos = 0;
+    }
+    if (c.want_close && c.outbuf.empty())
+      Drop(id, "closed_by_host", false);
+  }
+
   // -- retained snapshot (round 11) ---------------------------------------
   // SUBSCRIBE-triggered retained delivery below the GIL: the Python
   // retainer (services/retainer.py — the oracle and authoritative
@@ -5719,7 +6902,7 @@ class Host {
     // still applies to everything after this burst.
     for (const RetainEntry* e : retain_scratch_) {
       uint8_t oq = e->qos < maxqos ? e->qos : maxqos;
-      if (c.sn && oq > 1) oq = 1;  // the SN delivery cap
+      if ((c.sn || c.coap) && oq > 1) oq = 1;  // the datagram-gw cap
       if (oq == 0) {
         if (c.sn) {
           SnDeliverPublish(c, e->topic, e->payload, 0, /*retain=*/true,
@@ -6166,6 +7349,10 @@ class Host {
       SnEgress(c, data, len);
       return;
     }
+    if (c.coap) {
+      CoapEgress(c, data, len);
+      return;
+    }
     if (c.ws) ws::AppendFrameHeader(&c.outbuf, ws::kOpBinary, len);
     c.outbuf.append(data, len);
   }
@@ -6173,6 +7360,10 @@ class Host {
   void Flush(uint64_t id, Conn& c) {
     if (c.sn) {
       SnFlush(id, c);
+      return;
+    }
+    if (c.coap) {
+      CoapFlush(id, c);
       return;
     }
     if (c.fd < 0) {
@@ -6226,6 +7417,8 @@ class Host {
     if (it->second.tm_park) wheel_.Cancel(it->second.tm_park);
     if (it->second.sn && it->second.sn->tm_rexmit)
       wheel_.Cancel(it->second.sn->tm_rexmit);
+    if (it->second.coap && it->second.coap->tm_notify)
+      wheel_.Cancel(it->second.coap->tm_notify);
     if (telemetry_ && it->second.fr) {
       // flight-recorder dump on abnormal close / protocol error, and
       // always for traced conns (the tail rides the trace log).
@@ -6265,6 +7458,13 @@ class Host {
           sn_addr_conn_.erase(ait);
       }
       if (id == sn_anon_id_) sn_anon_id_ = 0;
+    } else if (it->second.coap) {
+      // CoAP conns share the listener fd too: release only the addr
+      // slot, and only if it still points at US (a new-identity
+      // re-register may have handed it to a successor conn)
+      auto ait = coap_addr_conn_.find(SnAddrKey(it->second.coap->addr));
+      if (ait != coap_addr_conn_.end() && ait->second == id)
+        coap_addr_conn_.erase(ait);
     } else if (it->second.fd >= 0) {  // synthetic conns have no socket
       epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
       close(it->second.fd);
@@ -6445,6 +7645,22 @@ class Host {
   std::vector<sn::SnMsg> sn_msgs_scratch_;
   std::vector<std::string> sn_frames_scratch_;
   std::vector<uint8_t> sn_rx_buf_;  // recvmmsg slots, sized on first read
+  // -- coap gateway (round 19, poll-thread-owned) --------------------------
+  int coap_fd_ = -1;
+  int coap_port_ = 0;
+  uint64_t next_coap_id_ = 1;           // ids minted under kCoapConnBit
+  std::unordered_map<uint64_t, uint64_t> coap_addr_conn_;  // addr → conn
+  std::vector<uint8_t> coap_rx_buf_;    // recvmmsg slots, lazy-sized
+  std::vector<std::string> coap_frames_scratch_;  // egress MQTT frames
+  std::vector<std::string_view> coap_path_scratch_;
+  std::string coap_pub_scratch_;        // per-target qos1 frame patch
+  // Python's retained mirror carries no props-bearing topics; while
+  // ANY exist the mirror is incomplete and plain GETs degrade whole
+  // to the oracle (kCoapRetainState keeps this in sync)
+  bool coap_retain_complete_ = true;
+  uint64_t coap_ack_timeout_ms_ = coap::kAckTimeoutMs;
+  uint32_t tele_tick_coap_ = 0;         // sampled CoAP-ingest counter
+  uint32_t tele_tick_notify_ = 0;       // sampled observe-notify counter
   // -- retained snapshot (round 11, poll-thread-owned) ---------------------
   RetainTable retained_;
   std::vector<const RetainEntry*> retain_scratch_;
@@ -6889,6 +8105,66 @@ long emqx_sn_roundtrip(const uint8_t* in, size_t len, uint8_t** out,
   *out = p;
   *out_len = buf.size();
   return static_cast<long>(msgs.size());
+}
+
+// --- coap gateway (round 19) ------------------------------------------------
+
+// Open the CoAP/UDP gateway socket (BEFORE the poll thread starts,
+// like the other listeners). Returns the bound port, or -1.
+int emqx_host_listen_coap(void* h, const char* bind_addr, uint16_t port,
+                          int reuseport) {
+  return static_cast<emqx_native::Host*>(h)->ListenCoap(bind_addr, port,
+                                                        reuseport != 0);
+}
+
+// Answer path for oracle-served (kind-13 punted) exchanges: raw CoAP
+// response bytes for `conn`'s peer. Thread-safe; applied on the poll
+// thread, framed into the conn's datagram outbuf verbatim.
+int emqx_host_coap_send(void* h, uint64_t conn, const uint8_t* data,
+                        uint32_t len) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kCoapSend;
+  op.owner = conn;
+  op.str.assign(reinterpret_cast<const char*>(data), len);
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Mirror whether the retained snapshot is COMPLETE (no props-carrying
+// topics excluded): plain CoAP GETs serve natively only while it is;
+// otherwise they degrade whole to the Python oracle's lookup.
+int emqx_host_coap_retain_state(void* h, int complete) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kCoapRetainState;
+  op.flags = complete ? 1 : 0;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// CON-notify retransmit base in ms (0 restores the RFC 7252 default
+// ACK_TIMEOUT x 1.5 = 3000); tests compress the backoff clock with it.
+int emqx_host_set_coap_ack_timeout(void* h, uint64_t ms) {
+  emqx_native::Op op;
+  op.kind = emqx_native::Op::kSetCoapAckTimeout;
+  op.token = ms;
+  return static_cast<emqx_native::Host*>(h)->Enqueue(std::move(op));
+}
+
+// Codec test surface: parse one CoAP datagram with the shared coap.h
+// codec and re-serialize — tests/test_native_coap.py drives the Python
+// oracle codec through the same vectors and compares bytes.
+long emqx_coap_roundtrip(const uint8_t* in, size_t len, uint8_t** out,
+                         size_t* out_len) {
+  emqx_native::coap::CoapMsg m;
+  std::string buf;
+  long n = 0;
+  if (emqx_native::coap::Parse(in, len, &m)) {
+    emqx_native::coap::Serialize(m, &buf);
+    n = 1;
+  }
+  uint8_t* p = static_cast<uint8_t*>(malloc(buf.size() ? buf.size() : 1));
+  memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = buf.size();
+  return n;
 }
 
 // --- durable-session plane (round 10) --------------------------------------

@@ -24,6 +24,7 @@
 #include <string>
 #include <vector>
 
+#include "coap.h"
 #include "frame.h"
 #include "sn.h"
 #include "ws.h"
@@ -1120,6 +1121,312 @@ int emqx_loadgen_conn_scale(const char* host, uint16_t port,
   out[5] = rtts.empty() ? 0 : rtts.back();
   out[6] = NowNs() - t_start;
   out[7] = closes;
+  return 0;
+}
+
+// -- CoAP observer/publisher fleet (round 19) --------------------------------
+//
+// The coap-bench client: observers GET+Observe /ps/lgc/<i> topics,
+// publishers POST to them — NON for qos0 (deliveries counted at the
+// observers), CON with ?qos=1 for qos1 (the 2.04 acks gate the
+// window). Speaks the SHARED coap.h codec, so the same fleet drives
+// the native listener and the asyncio gateway/coap.py with identical
+// wire traffic and identical pacing (the SN bench discipline). CoAP
+// forbids packing messages into one datagram, so the syscall
+// amortization is sendmmsg/recvmmsg batches of WHOLE datagrams.
+// `fanout` puts every observer on ONE topic (the fan-out arm).
+//
+// out[8]: sent, received, wall_ns, p50_ns, p99_ns, max_ns, acks, errors
+int emqx_loadgen_run_coap(const char* host, uint16_t port, uint32_t n_subs,
+                          uint32_t n_pubs, uint32_t msgs_per_pub,
+                          uint8_t qos, uint32_t payload_len,
+                          int idle_timeout_ms, uint32_t window, int warmup,
+                          int fanout, uint64_t* out) {
+  namespace lco = emqx_native::coap;
+  if (window == 0) window = 256;
+  uint32_t total = n_subs + n_pubs;
+  struct CoapLg {
+    int fd = -1;
+    uint32_t idx = 0;
+    bool is_sub = false;
+    bool ready = false;       // observer: 2.05 seen; publisher: warm ack
+    uint16_t mid = 0;
+    std::vector<std::string> obuf;  // whole datagrams, sendmmsg-batched
+  };
+  std::vector<CoapLg> conns(total);
+  int ep = epoll_create1(EPOLL_CLOEXEC);
+  if (ep < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(ep);
+    return -2;
+  }
+  uint64_t sent = 0, received = 0, acks = 0, errors = 0;
+  std::vector<uint64_t> lat;
+  lat.reserve(1 << 20);
+  auto cleanup = [&]() {
+    for (auto& c : conns)
+      if (c.fd >= 0) close(c.fd);
+    close(ep);
+  };
+  constexpr int kBatch = 32;
+  auto flush_conn = [&](CoapLg& c) {
+    size_t i = 0;
+    while (i < c.obuf.size()) {
+      mmsghdr mm[kBatch];
+      iovec iov[kBatch];
+      int n = 0;
+      for (; n < kBatch && i + n < c.obuf.size(); n++) {
+        iov[n].iov_base = const_cast<char*>(c.obuf[i + n].data());
+        iov[n].iov_len = c.obuf[i + n].size();
+        memset(&mm[n].msg_hdr, 0, sizeof(mm[n].msg_hdr));
+        mm[n].msg_hdr.msg_iov = &iov[n];
+        mm[n].msg_hdr.msg_iovlen = 1;
+      }
+      int sn2 = sendmmsg(c.fd, mm, n, MSG_NOSIGNAL);
+      if (sn2 < 0) {
+        if (errno == EINTR) continue;
+        if (errno != EAGAIN && errno != EWOULDBLOCK) errors++;
+        break;  // keep the tail for the next flush
+      }
+      i += static_cast<size_t>(sn2);
+    }
+    c.obuf.erase(c.obuf.begin(), c.obuf.begin() + i);
+  };
+  auto send_msg = [&](CoapLg& c, const lco::CoapMsg& m) {
+    std::string dg;
+    lco::Serialize(m, &dg);
+    c.obuf.push_back(std::move(dg));
+    if (c.obuf.size() >= kBatch) flush_conn(c);
+  };
+  auto topic_of = [&](uint32_t i) {
+    return std::to_string(fanout ? 0 : (n_subs ? i % n_subs : 0));
+  };
+  auto make_req = [&](CoapLg& c, uint8_t type, uint8_t code,
+                      const std::string& tseg) {
+    lco::CoapMsg m;
+    m.type = type;
+    m.code = code;
+    c.mid = c.mid == 0xFFFF ? 1 : c.mid + 1;
+    m.mid = c.mid;
+    if (c.is_sub) {
+      m.token.push_back(static_cast<char>(c.idx >> 8));
+      m.token.push_back(static_cast<char>(c.idx & 0xFF));
+    }
+    m.options.emplace_back(lco::kOptUriPath, "ps");
+    m.options.emplace_back(lco::kOptUriPath, "lgc");
+    m.options.emplace_back(lco::kOptUriPath, tseg);
+    m.options.emplace_back(
+        lco::kOptUriQuery,
+        std::string("clientid=") + (c.is_sub ? "lgcs" : "lgcp") +
+            std::to_string(c.idx));
+    if (qos)
+      m.options.emplace_back(lco::kOptUriQuery,
+                             std::string("qos=") + char('0' + qos));
+    return m;
+  };
+
+  for (uint32_t i = 0; i < total; i++) {
+    CoapLg& c = conns[i];
+    c.idx = i;
+    c.is_sub = i < n_subs;
+    c.fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (c.fd < 0 ||
+        connect(c.fd, reinterpret_cast<sockaddr*>(&addr),
+                sizeof(addr)) < 0) {
+      cleanup();
+      return -3;
+    }
+    int buf = 4 << 20;
+    setsockopt(c.fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = i;
+    epoll_ctl(ep, EPOLL_CTL_ADD, c.fd, &ev);
+  }
+
+  // handshake: observers register (CON GET Observe:0 -> 2.05);
+  // publishers send ONE CON warmup POST (earns the permit; the 2.04
+  // proves the gateway session is up) — both planes, same shape
+  auto kickoff = [&](CoapLg& c) {
+    if (c.is_sub) {
+      lco::CoapMsg m = make_req(c, lco::kCon, lco::kGet,
+                                topic_of(c.idx));
+      m.options.emplace_back(lco::kOptObserve, std::string());
+      send_msg(c, m);
+    } else {
+      lco::CoapMsg m =
+          make_req(c, lco::kCon, lco::kPost, topic_of(c.idx));
+      m.payload.assign(8, '\0');  // zero stamp: never a latency sample
+      send_msg(c, m);
+    }
+  };
+  for (auto& c : conns) kickoff(c);
+
+  uint8_t rxbuf[kBatch * 2048];
+  auto pump = [&](int timeout_ms) {
+    for (auto& c : conns) flush_conn(c);
+    epoll_event evs[128];
+    int n = epoll_wait(ep, evs, 128, timeout_ms);
+    if (n < 0) return errno == EINTR;
+    for (int i = 0; i < n; i++) {
+      CoapLg& c = conns[evs[i].data.u32];
+      if (c.fd < 0) continue;
+      for (;;) {
+        mmsghdr mm[kBatch];
+        iovec iov[kBatch];
+        for (int k = 0; k < kBatch; k++) {
+          iov[k].iov_base = rxbuf + k * 2048;
+          iov[k].iov_len = 2048;
+          memset(&mm[k].msg_hdr, 0, sizeof(mm[k].msg_hdr));
+          mm[k].msg_hdr.msg_iov = &iov[k];
+          mm[k].msg_hdr.msg_iovlen = 1;
+        }
+        int r = recvmmsg(c.fd, mm, kBatch, 0, nullptr);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          break;  // EAGAIN (or ICMP error: the next send surfaces it)
+        }
+        for (int k = 0; k < r; k++) {
+          lco::CoapMsg m;
+          if (!lco::Parse(rxbuf + k * 2048, mm[k].msg_len, &m)) continue;
+          if (m.code == lco::kContent &&
+              (m.type == lco::kCon || m.type == lco::kNon) &&
+              m.Opt(lco::kOptObserve) != nullptr) {
+            // an observe notification (the registration reply is an
+            // ACK and lands in the branch below)
+            if (m.type == lco::kCon) {
+              lco::CoapMsg a;
+              a.type = lco::kAck;
+              a.mid = m.mid;
+              send_msg(c, a);
+            }
+            if (m.payload.size() >= 8) {
+              uint64_t stamp;
+              memcpy(&stamp, m.payload.data(), 8);
+              uint64_t now = NowNs();
+              if (stamp && now > stamp &&
+                  now - stamp < 60ull * 1000000000ull)
+                lat.push_back(now - stamp);
+            }
+            received++;
+          } else if (m.type == lco::kAck) {
+            if (m.code == lco::kContent) {
+              c.ready = true;  // observe registration accepted
+            } else if (m.code == lco::kChanged) {
+              if (!c.ready)
+                c.ready = true;  // the warmup POST's ack
+              else
+                acks++;
+            } else if (m.code >= 0x80) {
+              errors++;
+            }
+          }
+        }
+        if (r < kBatch) break;
+      }
+      flush_conn(c);
+    }
+    return true;
+  };
+
+  uint64_t deadline = NowNs() + 15ull * 1000000000ull;
+  bool retried = false;
+  auto all_ready = [&]() {
+    for (auto& c : conns)
+      if (!c.ready) return false;
+    return true;
+  };
+  while (!all_ready()) {
+    if (NowNs() > deadline || !pump(100)) {
+      cleanup();
+      return -5;
+    }
+    if (!retried && NowNs() > deadline - 7ull * 1000000000ull) {
+      retried = true;  // one datagram-loss resend sweep
+      for (auto& c : conns)
+        if (!c.ready) kickoff(c);
+    }
+  }
+  if (warmup) {
+    // idle past the broker's permit-grant step (the SN fleet's shape)
+    uint64_t settle = NowNs() + 800ull * 1000000ull;
+    while (NowNs() < settle) pump(50);
+    received = acks = 0;
+    lat.clear();
+  }
+
+  uint64_t expected = static_cast<uint64_t>(n_pubs) * msgs_per_pub;
+  uint64_t expect_rx = fanout ? expected * n_subs : expected;
+  std::string pad(payload_len > 8 ? payload_len - 8 : 0, 'x');
+  std::vector<uint32_t> next_msg(n_pubs, 0);
+  uint64_t t0 = NowNs();
+  uint64_t last_progress = t0;
+  uint64_t last_seen = 0;
+  bool stalled = false;
+  while (true) {
+    bool done_sending = true;
+    uint64_t progress = qos ? acks : (n_subs ? received : sent);
+    uint64_t scale = (!qos && n_subs && fanout) ? n_subs : 1;
+    for (uint32_t j = 0; j < n_pubs; j++) {
+      CoapLg& c = conns[n_subs + j];
+      uint32_t burst = 0;
+      while (next_msg[j] < msgs_per_pub &&
+             sent - progress / scale < window && burst++ < 64) {
+        lco::CoapMsg m = make_req(c, qos ? lco::kCon : lco::kNon,
+                                  lco::kPost, topic_of(c.idx));
+        uint64_t stamp = NowNs();
+        m.payload.assign(reinterpret_cast<char*>(&stamp), 8);
+        m.payload += pad;
+        send_msg(c, m);
+        next_msg[j]++;
+        sent++;
+      }
+      if (next_msg[j] < msgs_per_pub) done_sending = false;
+    }
+    bool complete = done_sending && (qos ? acks >= expected : true) &&
+                    (n_subs ? received >= expect_rx : true);
+    if (complete) break;
+    if (!pump(done_sending ? 50 : 1)) break;
+    uint64_t seen = received + acks;
+    uint64_t now = NowNs();
+    if (seen != last_seen) {
+      last_seen = seen;
+      last_progress = now;
+    } else if (now - last_progress >
+               static_cast<uint64_t>(idle_timeout_ms) * 1000000ull) {
+      // stalled (datagram loss): report what we have — measured to
+      // the LAST progress stamp, so the dead idle-timeout tail never
+      // deflates the rate (which would flatter whichever arm runs
+      // against the lossier plane)
+      stalled = true;
+      break;
+    }
+  }
+  uint64_t wall = (stalled ? last_progress : NowNs()) - t0;
+  if (wall == 0) wall = 1;
+  uint64_t p50 = 0, p99 = 0, mx = 0;
+  if (!lat.empty()) {
+    size_t i50 = lat.size() / 2;
+    size_t i99 = lat.size() * 99 / 100;
+    if (i99 >= lat.size()) i99 = lat.size() - 1;
+    std::nth_element(lat.begin(), lat.begin() + i50, lat.end());
+    p50 = lat[i50];
+    std::nth_element(lat.begin(), lat.begin() + i99, lat.end());
+    p99 = lat[i99];
+    mx = *std::max_element(lat.begin(), lat.end());
+  }
+  out[0] = sent;
+  out[1] = received;
+  out[2] = wall;
+  out[3] = p50;
+  out[4] = p99;
+  out[5] = mx;
+  out[6] = acks;
+  out[7] = errors;
+  cleanup();
   return 0;
 }
 

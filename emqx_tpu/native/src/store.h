@@ -878,9 +878,11 @@ class DurableStore {
     }
   }
 
-  // @locked(mu_) — ONE serializer per record type, shared by the
-  // fresh-write path and GC's RehomeMeta (a layout change must never
-  // diverge between them — review finding)
+  // Callers hold mu_ (the AppendFrame caller-holds contract; this
+  // helper touches no guarded field directly, so the checker derives
+  // nothing from an annotation here). ONE serializer per record type,
+  // shared by the fresh-write path and GC's RehomeMeta (a layout
+  // change must never diverge between them — review finding).
   void JournalSession(uint64_t tok, const char* body, uint32_t blen) {
     std::string rec;
     rec.reserve(12 + blen);
@@ -890,7 +892,7 @@ class DurableStore {
     AppendFrame(kRecSession, rec.data(), rec.size());
   }
 
-  // @locked(mu_)
+  // callers hold mu_ (see JournalSession)
   void JournalTrunk(const std::string& name, uint64_t seq, uint8_t tf,
                     const char* data, size_t len) {
     std::string body;

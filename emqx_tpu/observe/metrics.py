@@ -97,7 +97,7 @@ NATIVE = [
     # the first degradation ever happens.
     "messages.ledger.ring_full", "messages.ledger.trunk_punt",
     "messages.ledger.shed", "messages.ledger.fault",
-    "messages.ledger.accept_shed",
+    "messages.ledger.accept_shed", "messages.ledger.coap_giveup",
     "messages.ledger.device_failover",
     "messages.ledger.store_degraded",
     # conn-scale plane (round 16): hibernation + accept-storm shedding.
@@ -256,7 +256,8 @@ class LatencyHistogram:
 # (test_stats_lint pins the pair; the C++ LedgerReason enum is a prefix:
 # "fault" is a faultline injection firing, round 15)
 LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
-                  "accept_shed", "device_failover", "store_degraded")
+                  "accept_shed", "coap_giveup",
+                  "device_failover", "store_degraded")
 
 
 class DegradationLedger:

@@ -1,0 +1,997 @@
+"""The native (C++) CoAP gateway plane — coap.h/host.cc driven against
+gateway/coap.py as the protocol oracle: every test client speaks the
+ORACLE's codec over real UDP sockets, so any disagreement between the
+two RFC 7252 implementations fails here, and one shared vector set
+locks the codecs together byte-for-byte (the sn.h discipline).
+
+Covers: the shared codec vectors (parse+serialize parity incl. the
+malformed set), /ps publish + observe end-to-end on the native plane,
+observe-notify parity BIT-IDENTICAL to the asyncio gateway across
+TCP/WS/SN/CoAP cross-protocol fan-out, the MID-dedup window (replay,
+in-flight drop, and the parity-audited counter-wrap eviction), CON
+retransmit timing on the timer wheel vs the oracle's backoff, the
+retransmit-exhaustion give-up (observer dropped, ledger-visible), the
+fast-path permit ride with punts==0, block-wise + props fallback to
+the Python oracle (never a partial exchange), the plain-GET retained
+read, qos1 publishes gated on the native ack plane, re-register under
+a new clientid, faultline coverage of the conn_read/conn_write seams,
+the LwM2M register/observe flows over the native CoAP transport, and
+the asyncio-gateway deployment fallback."""
+
+import socket
+import time
+
+import pytest
+
+from emqx_tpu import native
+from emqx_tpu.gateway import coap as C
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib: {native.build_error()}")
+
+
+@pytest.fixture()
+def app():
+    from emqx_tpu.app import BrokerApp
+
+    return BrokerApp()
+
+
+@pytest.fixture()
+def server(app):
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+
+    srv = NativeBrokerServer(
+        port=0, app=app, coap_port=0, sn_port=0, ws_port=0,
+        session_opts={"max_inflight": 32})
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class CoapSock:
+    """Blocking UDP client speaking the ORACLE's codec (C.Frame)."""
+
+    def __init__(self, port: int):
+        self.f = C.Frame()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.settimeout(5)
+        self.sock.connect(("127.0.0.1", port))
+        self._mid = 0
+
+    def next_mid(self) -> int:
+        self._mid = self._mid % 0xFFFF + 1
+        return self._mid
+
+    def send(self, m: C.CoapMessage) -> None:
+        self.sock.send(self.f.serialize(m))
+
+    def send_raw(self, data: bytes) -> None:
+        self.sock.send(data)
+
+    def recv(self, timeout: float = 5.0) -> C.CoapMessage:
+        self.sock.settimeout(timeout)
+        data = self.sock.recv(65536)
+        msgs, _ = self.f.parse(data, None)
+        assert msgs, f"unparseable datagram {data!r}"
+        return msgs[0]
+
+    def recv_raw(self, timeout: float = 5.0) -> bytes:
+        self.sock.settimeout(timeout)
+        return self.sock.recv(65536)
+
+    def request(self, code, path, payload=b"", token=b"t", options=(),
+                queries=(), con=True, mid=None):
+        opts = list(options) + C.uri_path_opts(path)
+        for q in queries:
+            opts.append((C.OPT_URI_QUERY, q.encode()))
+        m = C.CoapMessage(C.CON if con else C.NON, code,
+                          mid if mid is not None else self.next_mid(),
+                          token, opts, payload)
+        self.send(m)
+        return m
+
+    def observe(self, topic, token=b"obs", cid="c-obs", qos=0):
+        qs = [f"clientid={cid}"]
+        if qos:
+            qs.append(f"qos={qos}")
+        self.request(C.GET, f"ps/{topic}", token=token,
+                     options=[(C.OPT_OBSERVE, b"")], queries=qs)
+        ack = self.recv()
+        assert ack.code == C.CONTENT, hex(ack.code)
+        return ack
+
+    def close(self):
+        self.sock.close()
+
+
+# ---------------------------------------------------------------------------
+# shared codec vectors: the oracle codec and coap.h must agree byte-level
+# ---------------------------------------------------------------------------
+
+def _vectors() -> list:
+    return [
+        C.CoapMessage(C.CON, C.GET, 1, b"", C.uri_path_opts("ps/a/b")),
+        C.CoapMessage(C.CON, C.POST, 0xFFFF, b"tok12345",
+                      C.uri_path_opts("ps/t")
+                      + [(C.OPT_URI_QUERY, b"qos=1"),
+                         (C.OPT_URI_QUERY, b"clientid=dev-1")],
+                      b"payload"),
+        C.CoapMessage(C.NON, C.PUT, 7, b"t",
+                      C.uri_path_opts("ps/x") + [(C.OPT_OBSERVE, b"")],
+                      b""),
+        C.CoapMessage(C.ACK, C.CONTENT, 42, b"obs1",
+                      [(C.OPT_OBSERVE, b"\x00\x00\x01")], b"21"),
+        C.CoapMessage(C.ACK, C.CHANGED, 43, b"tk"),
+        C.CoapMessage(C.RST, C.EMPTY, 44, b""),
+        C.CoapMessage(C.CON, C.EMPTY, 45, b""),          # CoAP ping
+        # 13/14 delta+length extensions, out-of-order options (the
+        # serializer's stable sort), empty option values
+        C.CoapMessage(C.CON, C.GET, 46, b"zz",
+                      [(2000, b"x" * 300), (C.OPT_URI_PATH, b"ps"),
+                       (C.OPT_URI_PATH, b"t"), (C.OPT_ETAG, b"\x01")]),
+        C.CoapMessage(C.CON, C.POST, 47, b"",
+                      C.uri_path_opts("ps/t")
+                      + [(C.OPT_BLOCK1, b"\x0a"),
+                         (C.OPT_SIZE1, b"\x01\x00")], b"chunk"),
+        C.CoapMessage(C.NON, C.CONTENT, 0, b"\x00" * 8,
+                      [(C.OPT_OBSERVE, b"\xff\xff\xff")], b"\xff\x00"),
+    ]
+
+
+def test_codec_vectors_shared():
+    """Every vector's oracle parse→reserialize must equal the native
+    codec's parse→reserialize of the SAME datagram — the lock that
+    keeps the two RFC 7252 implementations from drifting apart."""
+    f = C.Frame()
+    for m in _vectors():
+        wire = f.serialize(m)
+        parsed, _ = f.parse(wire, None)
+        assert len(parsed) == 1, m
+        oracle_bytes = f.serialize(parsed[0])
+        n, native_bytes = native.coap_roundtrip(wire)
+        assert n == 1, m
+        assert native_bytes == oracle_bytes, (
+            f"codec drift on {m}: native={native_bytes!r} "
+            f"oracle={oracle_bytes!r}")
+
+
+def test_codec_malformed_drops_both_planes():
+    """The malformed set yields ZERO messages on both planes: short
+    headers, bad version, tkl > 8, truncated 13/14 extension bytes."""
+    f = C.Frame()
+    bad = [b"", b"\x40", b"\x40\x01\x00",        # short header
+           b"\x80\x01\x00\x01",                  # version 2
+           b"\x49\x01\x00\x01" + b"t" * 9,       # tkl 9
+           b"\x40\x01\x00\x01\xd1",              # 13-ext delta cut off
+           b"\x40\x01\x00\x01\xe1\x00"]          # 14-ext needs 2 bytes
+    for w in bad:
+        try:
+            pkts, _ = f.parse(w, None)
+        except Exception:
+            pkts = []  # the oracle raises mid-parse; its UDP listener
+            #            drops the datagram — the same observable outcome
+        n, out = native.coap_roundtrip(w)
+        assert pkts == [] and n == 0 and out == b"", w
+
+
+def test_codec_clamped_option_value_parity():
+    """An option whose declared length overruns the datagram yields a
+    clamped short value on BOTH planes (Python slice semantics)."""
+    f = C.Frame()
+    # delta 11 (uri-path), len 8, but only 3 value bytes present
+    w = b"\x40\x01\x00\x01\xb8abc"
+    pkts, _ = f.parse(w, None)
+    assert pkts[0].opt(C.OPT_URI_PATH) == b"abc"
+    n, out = native.coap_roundtrip(w)
+    assert n == 1 and out == f.serialize(pkts[0])
+
+
+# ---------------------------------------------------------------------------
+# native gateway end-to-end
+# ---------------------------------------------------------------------------
+
+def test_publish_observe_e2e(server):
+    sub = CoapSock(server.coap_port)
+    ack = sub.observe("room/t", token=b"obs1", cid="c-sub")
+    assert ack.opt(C.OPT_OBSERVE) == (1).to_bytes(3, "big")
+    assert ack.token == b"obs1"
+
+    pub = CoapSock(server.coap_port)
+    pub.request(C.PUT, "ps/room/t", payload=b"21",
+                queries=["clientid=c-pub"])
+    pack = pub.recv()
+    assert pack.code == C.CHANGED
+    note = sub.recv()
+    assert note.type == C.NON and note.code == C.CONTENT
+    assert note.payload == b"21" and note.token == b"obs1"
+    assert note.opt(C.OPT_OBSERVE) == (2).to_bytes(3, "big")
+    # unobserve: no further notifications
+    sub.request(C.GET, "ps/room/t", token=b"obs1",
+                options=[(C.OPT_OBSERVE, (1).to_bytes(1, "big"))],
+                queries=["clientid=c-sub"])
+    assert sub.recv().code == C.CONTENT
+    pub.request(C.PUT, "ps/room/t", payload=b"22",
+                queries=["clientid=c-pub"])
+    assert pub.recv().code == C.CHANGED
+    with pytest.raises(socket.timeout):
+        sub.recv(timeout=0.6)
+    sub.close()
+    pub.close()
+
+
+def test_coap_ping_answers_rst(server):
+    c = CoapSock(server.coap_port)
+    c.send(C.CoapMessage(C.CON, C.EMPTY, 99, b""))
+    pong = c.recv()
+    assert pong.type == C.RST and pong.code == C.EMPTY and pong.mid == 99
+    assert server.host.stats()["coap_pings"] >= 1
+    c.close()
+
+
+def test_mid_dedup_replays_cached_response(server, app):
+    seen = []
+    app.hooks.add("message.publish",
+                  lambda m: seen.append(bytes(m.payload)) or None,
+                  priority=-500)
+    c = CoapSock(server.coap_port)
+    req = c.request(C.POST, "ps/dup/t", payload=b"once",
+                    queries=["clientid=c-dup"], mid=77)
+    first = c.recv_raw()
+    # byte-identical retransmission: replayed response, NOT re-executed
+    c.send_raw(c.f.serialize(req))
+    second = c.recv_raw(timeout=5)
+    assert second == first
+    deadline = time.time() + 2
+    while time.time() < deadline and seen.count(b"once") < 1:
+        time.sleep(0.05)
+    assert seen.count(b"once") == 1
+    assert server.host.stats()["coap_dedup_hits"] >= 1
+    c.close()
+
+
+def test_mid_dedup_wrap_evicts_on_new_token(server):
+    """The parity-audited wrap bug: a recycled mid under a DIFFERENT
+    token is a NEW exchange, not a retransmission — an observer sees
+    BOTH publishes (a message-publish hook would go blind the moment
+    the topic earns its fast-path permit)."""
+    sub = CoapSock(server.coap_port)
+    sub.observe("wrap/t", token=b"wsub", cid="c-wsub")
+    c = CoapSock(server.coap_port)
+    c.request(C.POST, "ps/wrap/t", payload=b"one", token=b"tk1",
+              queries=["clientid=c-wrap"], mid=5)
+    assert c.recv().code == C.CHANGED
+    c.request(C.POST, "ps/wrap/t", payload=b"two", token=b"tk2",
+              queries=["clientid=c-wrap"], mid=5)
+    assert c.recv().code == C.CHANGED
+    assert sub.recv().payload == b"one"
+    assert sub.recv().payload == b"two"
+    c.close()
+    sub.close()
+
+
+def test_oracle_tm_dedup_token_wrap_unit():
+    """The oracle TransportManager's own wrap fix (no server)."""
+    clock = [0.0]
+    tm = C.TransportManager(now_fn=lambda: clock[0])
+    m1 = C.CoapMessage(C.CON, C.POST, 9, b"tk1")
+    tm.remember(m1, ["resp1"])
+    assert tm.dedup(m1) == ["resp1"]
+    m2 = C.CoapMessage(C.CON, C.POST, 9, b"tk2")  # recycled mid
+    assert tm.dedup(m2) is None                   # evicted, fresh
+    assert tm.dedup(m1) is None                   # old entry gone
+
+
+def test_observe_seq_rollover_oracle_unit(app):
+    """The parity-audited 2^24 rollover: per-observer seq wraps instead
+    of crashing in to_bytes(3)."""
+    from emqx_tpu.gateway.ctx import GwContext
+
+    class Msg:
+        def __init__(self, topic, payload):
+            self.topic, self.payload = topic, payload
+
+    ch = C.Channel(GwContext(app, "coap"))
+    ch.clientid = "c-roll"
+    ch.observers["t"] = [b"tok", 0, 0xFFFFFE]
+    out = ch.handle_deliver([("t", Msg("t", b"a")), ("t", Msg("t", b"b")),
+                             ("t", Msg("t", b"c"))])
+    seqs = [int.from_bytes(m.opt(C.OPT_OBSERVE), "big") for m in out]
+    assert seqs == [0xFFFFFF, 0, 1]
+
+
+def test_con_retransmit_timing_on_wheel_vs_oracle(server, app):
+    """A qos1 observer's CON notify retransmits on the wheel with the
+    oracle's exponential shape (base, 2x, 4x...), resent byte-VERBATIM;
+    exhaustion drops the observer (RFC 7641 §4.5), frees the window
+    slot, and lands in the degradation ledger as coap_giveup."""
+    server.host.set_coap_ack_timeout(150)
+    time.sleep(0.3)  # ops apply on the next poll cycle
+    try:
+        sub = CoapSock(server.coap_port)
+        sub.observe("rex/t", token=b"rex", cid="c-rex", qos=1)
+        pub = CoapSock(server.coap_port)
+        pub.request(C.PUT, "ps/rex/t", payload=b"x",
+                    queries=["clientid=c-rexp"])
+        assert pub.recv().code == C.CHANGED
+        # first transmission + kMaxRetransmit verbatim retransmissions
+        first = sub.recv_raw()
+        stamps = [time.monotonic()]
+        copies = [first]
+        for _ in range(4):
+            copies.append(sub.recv_raw(timeout=6))
+            stamps.append(time.monotonic())
+        assert all(cp == first for cp in copies[1:])
+        gaps = [stamps[i + 1] - stamps[i] for i in range(4)]
+        # exponential shape: each gap roughly doubles (wheel ticks and
+        # poll cadence blur the edges; the RATIO is the contract)
+        for a, b in zip(gaps, gaps[1:]):
+            assert b > a * 1.3, gaps
+        # give-up: no more copies, observer dropped, ledger-visible
+        with pytest.raises(socket.timeout):
+            sub.recv(timeout=3.0)
+        st = server.host.stats()
+        assert st["coap_rexmits"] >= 4
+        assert st["coap_giveups"] == 1
+        deadline = time.time() + 3
+        m = app.broker.metrics
+        while (time.time() < deadline
+               and m.val("messages.ledger.coap_giveup") < 1):
+            time.sleep(0.05)
+        assert m.val("messages.ledger.coap_giveup") >= 1
+        # the observation is gone: a new publish draws no notify
+        pub.request(C.PUT, "ps/rex/t", payload=b"y",
+                    queries=["clientid=c-rexp"])
+        assert pub.recv().code == C.CHANGED
+        with pytest.raises(socket.timeout):
+            sub.recv(timeout=0.8)
+        sub.close()
+        pub.close()
+    finally:
+        server.host.set_coap_ack_timeout(0)
+
+
+def test_con_notify_ack_frees_ack_plane_slot(server):
+    """ACKing a CON notify settles it (no retransmit) and frees the
+    native window slot via the synthesized PUBACK."""
+    server.host.set_coap_ack_timeout(200)
+    time.sleep(0.3)
+    try:
+        sub = CoapSock(server.coap_port)
+        sub.observe("ackf/t", token=b"af", cid="c-ackf", qos=1)
+        pub = CoapSock(server.coap_port)
+        for i in range(3):
+            pub.request(C.PUT, "ps/ackf/t", payload=b"m%d" % i,
+                        queries=["clientid=c-afp"])
+            assert pub.recv().code == C.CHANGED
+            note = sub.recv()
+            assert note.type == C.CON and note.payload == b"m%d" % i
+            sub.send(C.CoapMessage(C.ACK, C.EMPTY, note.mid, b""))
+        time.sleep(0.6)  # past the base timeout: nothing retransmits
+        with pytest.raises(socket.timeout):
+            sub.recv(timeout=0.3)
+        assert server.host.stats()["coap_rexmits"] == 0
+        sub.close()
+        pub.close()
+    finally:
+        server.host.set_coap_ack_timeout(0)
+
+
+def test_rst_on_notify_cancels_observation(server):
+    sub = CoapSock(server.coap_port)
+    sub.observe("rstc/t", token=b"rc", cid="c-rst")
+    pub = CoapSock(server.coap_port)
+    pub.request(C.PUT, "ps/rstc/t", payload=b"a",
+                queries=["clientid=c-rstp"])
+    assert pub.recv().code == C.CHANGED
+    note = sub.recv()
+    assert note.payload == b"a"
+    # RFC 7641 §3.6: RST cancels the observation for ANY notify type
+    sub.send(C.CoapMessage(C.RST, C.EMPTY, note.mid, b""))
+    time.sleep(0.3)
+    pub.request(C.PUT, "ps/rstc/t", payload=b"b",
+                queries=["clientid=c-rstp"])
+    assert pub.recv().code == C.CHANGED
+    with pytest.raises(socket.timeout):
+        sub.recv(timeout=0.8)
+    sub.close()
+    pub.close()
+
+
+def test_fast_path_ride_with_punts_zero(server):
+    """After the permit grant, CoAP publishes ride the native fast
+    path: the blast adds ZERO punts and the observer sees every
+    message in order."""
+    sub = CoapSock(server.coap_port)
+    sub.observe("fast/t", token=b"fp", cid="c-fsub")
+    pub = CoapSock(server.coap_port)
+    pub.request(C.PUT, "ps/fast/t", payload=b"warm",
+                queries=["clientid=c-fpub"])
+    assert pub.recv().code == C.CHANGED
+    assert sub.recv().payload == b"warm"
+    time.sleep(1.0)  # the permit-grant settle
+    before = server.host.stats()
+    n = 200
+    got = []
+    for i in range(n):
+        pub.request(C.PUT, "ps/fast/t", payload=b"%04d" % i, con=False,
+                    queries=["clientid=c-fpub"])
+        # lockstep drain: UDP offers no backpressure, and the point is
+        # the plane, not the burst rate
+        got.append(sub.recv().payload)
+    after = server.host.stats()
+    assert got == [b"%04d" % i for i in range(n)]
+    assert after["punts"] == before["punts"], "fast-path publishes punted"
+    assert after["coap_in"] - before["coap_in"] == n
+    assert after["fast_in"] - before["fast_in"] == n
+    sub.close()
+    pub.close()
+
+
+def test_qos1_publish_ack_gated_on_ack_plane(server, app):
+    """A CON ?qos=1 publish answers 2.04 exactly once, only after the
+    MQTT ack lands (broker-side accounting proves the qos1 ingest)."""
+    c = CoapSock(server.coap_port)
+    c.request(C.POST, "ps/q1/t", payload=b"v", token=b"q1",
+              queries=["clientid=c-q1", "qos=1"])
+    ack = c.recv()
+    assert ack.code == C.CHANGED and ack.token == b"q1"
+    with pytest.raises(socket.timeout):
+        c.recv(timeout=0.4)   # exactly once
+    c.close()
+
+
+def test_plain_get_retained_native(server, app):
+    from emqx_tpu.core.message import Message
+
+    app.retainer.store(Message(topic="ret/t", payload=b"body",
+                               flags={"retain": True}))
+    time.sleep(0.3)  # mirror op applies on the next poll cycle
+    c = CoapSock(server.coap_port)
+    before = server.host.stats()["coap_punts"]
+    c.request(C.GET, "ps/ret/t", queries=["clientid=c-get"])
+    r = c.recv()
+    assert r.code == C.CONTENT and r.payload == b"body"
+    c.request(C.GET, "ps/ret/missing", queries=["clientid=c-get"])
+    assert c.recv().code == C.NOT_FOUND
+    assert server.host.stats()["coap_punts"] == before, \
+        "plain GETs must serve natively from the snapshot"
+    c.close()
+
+
+def test_props_retained_fallback_to_oracle(server, app):
+    """A props-carrying retained message makes the mirror incomplete:
+    plain GETs degrade WHOLE to the Python oracle — and still answer
+    correctly (never a partial set)."""
+    from emqx_tpu.core.message import Message
+
+    app.retainer.store(Message(
+        topic="pr/t", payload=b"withprops", flags={"retain": True},
+        headers={"properties": {"user_property": [("k", "v")]}}))
+    time.sleep(0.3)
+    c = CoapSock(server.coap_port)
+    before = server.host.stats()["coap_punts"]
+    c.request(C.GET, "ps/pr/t", queries=["clientid=c-pr"])
+    r = c.recv()
+    assert r.code == C.CONTENT and r.payload == b"withprops"
+    assert server.host.stats()["coap_punts"] > before
+    c.close()
+
+
+def test_blockwise_upload_falls_back_whole(server, app):
+    """A Block1 upload degrades the WHOLE exchange to the oracle: the
+    blocks reassemble there and publish once, through the same broker
+    the native plane serves."""
+    seen = []
+    app.hooks.add("message.publish",
+                  lambda m: seen.append(bytes(m.payload)) or None,
+                  priority=-500)
+    c = CoapSock(server.coap_port)
+    chunks = [b"A" * 64, b"B" * 64, b"C" * 10]
+    for i, chunk in enumerate(chunks):
+        more = 1 if i < len(chunks) - 1 else 0
+        c.request(C.POST, "ps/blk/t", payload=chunk,
+                  options=[(C.OPT_BLOCK1, C.encode_block(i, more, 64))],
+                  queries=["clientid=c-blk"])
+        r = c.recv()
+        assert r.code == (C.CONTINUE_231 if more else C.CHANGED), hex(r.code)
+    deadline = time.time() + 3
+    while time.time() < deadline and not seen:
+        time.sleep(0.05)
+    assert seen == [b"".join(chunks)]
+    assert server.host.stats()["coap_punts"] >= len(chunks)
+    c.close()
+
+
+def test_block2_download_served_by_oracle(server, app):
+    """A retained body past the block2 threshold punts to the oracle's
+    stateless slicing (ETag + Block2 + Size2)."""
+    from emqx_tpu.core.message import Message
+
+    body = bytes(range(256)) * 10          # 2560B > the 1024 threshold
+    app.retainer.store(Message(topic="big/t", payload=body,
+                               flags={"retain": True}))
+    time.sleep(0.3)
+    c = CoapSock(server.coap_port)
+    got = bytearray()
+    num = 0
+    while True:
+        c.request(C.GET, "ps/big/t",
+                  options=[(C.OPT_BLOCK2, C.encode_block(num, 0, 512))],
+                  queries=["clientid=c-big"])
+        r = c.recv()
+        assert r.code == C.CONTENT
+        got += r.payload
+        _, more, _ = C.parse_block(r.opt(C.OPT_BLOCK2))
+        if not more:
+            break
+        num += 1
+    assert bytes(got) == body
+    assert server.host.stats()["coap_punts"] >= 1
+    c.close()
+
+
+def test_reregister_new_clientid_drops_old_observers(server, app):
+    """A request carrying a NEW ?clientid= re-registers the endpoint:
+    old observers are dropped (their tokens never leak into the new
+    session) and the new identity is re-authenticated — the oracle
+    parity-audit fix, native edition."""
+    c = CoapSock(server.coap_port)
+    c.observe("rr/t", token=b"old", cid="c-old")
+    pub = CoapSock(server.coap_port)
+    pub.request(C.PUT, "ps/rr/t", payload=b"one",
+                queries=["clientid=c-rrp"])
+    assert pub.recv().code == C.CHANGED
+    assert c.recv().payload == b"one"
+    # same endpoint re-registers as a different device
+    c.request(C.POST, "ps/other/t", payload=b"hello",
+              queries=["clientid=c-new"])
+    assert c.recv().code == C.CHANGED
+    time.sleep(0.3)
+    pub.request(C.PUT, "ps/rr/t", payload=b"two",
+                queries=["clientid=c-rrp"])
+    assert pub.recv().code == C.CHANGED
+    with pytest.raises(socket.timeout):
+        c.recv(timeout=0.8)    # the old observation died with c-old
+    c.close()
+    pub.close()
+
+
+def test_oracle_reregister_unit(app):
+    """The oracle Channel's own re-register fix (no server): observers
+    and sessions reset when the clientid changes."""
+    from emqx_tpu.gateway.ctx import GwContext
+
+    ctx = GwContext(app, "coap")
+    ch = C.Channel(ctx)
+    out = ch.handle_in(C.CoapMessage(
+        C.CON, C.GET, 1, b"tk",
+        C.uri_path_opts("ps/t") + [(C.OPT_OBSERVE, b""),
+                                   (C.OPT_URI_QUERY, b"clientid=c1")]))
+    assert out[0].code == C.CONTENT and "t" in ch.observers
+    assert ch.clientid == "c1"
+    out = ch.handle_in(C.CoapMessage(
+        C.CON, C.POST, 2, b"tk",
+        C.uri_path_opts("ps/t") + [(C.OPT_URI_QUERY, b"clientid=c2")],
+        b"x"))
+    assert out[0].code == C.CHANGED
+    assert ch.clientid == "c2" and ch.observers == {}
+
+
+# ---------------------------------------------------------------------------
+# observe-notify parity: bit-identical to the asyncio oracle across
+# TCP/WS/SN/CoAP cross-protocol fan-out (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+def test_observe_notify_parity_bit_identical_cross_protocol(server, app):
+    """One CoAP observer on the NATIVE plane, fed by publishers on all
+    four transports (TCP, WS, SN, CoAP) in strict order; the SAME
+    observer registration + payload sequence driven through the asyncio
+    gateway must yield BYTE-IDENTICAL datagrams — registration ACK and
+    every notification (mids, tokens, per-observer sequence numbers,
+    payloads)."""
+    import asyncio
+    import base64 as b64
+    import os as _os
+    import threading
+
+    from emqx_tpu.broker.ws import (OP_BINARY, FrameDecoder, encode_frame)
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.gateway import mqttsn as SN
+    from emqx_tpu.mqtt import packet as P
+    from emqx_tpu.mqtt.frame import Parser, serialize
+
+    payloads = [b"from-tcp", b"from-ws", b"from-sn", b"from-coap"]
+    reg = C.CoapMessage(
+        C.CON, C.GET, 1, b"xp",
+        C.uri_path_opts("ps/xp/t") + [(C.OPT_OBSERVE, b""),
+                                      (C.OPT_URI_QUERY,
+                                       b"clientid=c-xp")])
+    reg_wire = C.Frame().serialize(reg)
+
+    # -- native arm: the observer on the C++ plane, one publisher per
+    # transport, lockstep so ordering is strict
+    sub = CoapSock(server.coap_port)
+    sub.send_raw(reg_wire)
+    native_raw = [sub.recv_raw()]
+
+    # TCP publisher
+    tcp = socket.create_connection(("127.0.0.1", server.port))
+    tcp.settimeout(5)
+    parser = Parser()
+    tcp.sendall(serialize(P.Connect(clientid="xp-tcp")))
+    while not parser.feed(tcp.recv(4096)):
+        pass
+    tcp.sendall(serialize(P.Publish(topic="xp/t", payload=payloads[0])))
+    native_raw.append(sub.recv_raw())
+
+    # WS publisher (masked frames, the oracle codec)
+    ws = socket.create_connection(("127.0.0.1", server.ws_port))
+    ws.settimeout(5)
+    key = b64.b64encode(_os.urandom(16)).decode()
+    ws.sendall((f"GET /mqtt HTTP/1.1\r\nHost: x\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += ws.recv(4096)
+    head, rest = resp.split(b"\r\n\r\n", 1)
+    assert b"101" in head.split(b"\r\n")[0]
+    dec = FrameDecoder(require_mask=False)
+    wparser = Parser()
+    ws.sendall(encode_frame(OP_BINARY,
+                            serialize(P.Connect(clientid="xp-ws")),
+                            mask=True))
+    connacked = False
+    if rest:
+        for op, pl in dec.feed(rest):
+            if op == OP_BINARY and wparser.feed(pl):
+                connacked = True
+    while not connacked:
+        for op, pl in dec.feed(ws.recv(4096)):
+            if op == OP_BINARY and wparser.feed(pl):
+                connacked = True
+    ws.sendall(encode_frame(
+        OP_BINARY, serialize(P.Publish(topic="xp/t",
+                                       payload=payloads[1])),
+        mask=True))
+    native_raw.append(sub.recv_raw())
+
+    # SN publisher (the SN oracle codec)
+    snf = SN.Frame()
+    sn = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sn.settimeout(5)
+    sn.connect(("127.0.0.1", server.sn_port))
+    sn.send(snf.serialize(SN.SnMessage(SN.CONNECT, flags=SN.F_CLEAN,
+                                       duration=60, clientid="xp-sn")))
+    ack = snf.parse(sn.recv(2048), None)[0][0]
+    assert ack.type == SN.CONNACK and ack.rc == 0
+    sn.send(snf.serialize(SN.SnMessage(SN.REGISTER, msg_id=1,
+                                       topic_name="xp/t")))
+    ra = snf.parse(sn.recv(2048), None)[0][0]
+    assert ra.type == SN.REGACK and ra.rc == 0
+    sn.send(snf.serialize(SN.SnMessage(
+        SN.PUBLISH, flags=SN.qos_flags(0), topic_id=ra.topic_id,
+        data=payloads[2])))
+    native_raw.append(sub.recv_raw())
+
+    # CoAP publisher
+    cpub = CoapSock(server.coap_port)
+    cpub.request(C.PUT, "ps/xp/t", payload=payloads[3],
+                 queries=["clientid=xp-coap"])
+    assert cpub.recv().code == C.CHANGED
+    native_raw.append(sub.recv_raw())
+    for s in (tcp, ws, sn):
+        s.close()
+    sub.close()
+    cpub.close()
+
+    # -- oracle arm: the asyncio gateway, same registration bytes,
+    # same payload sequence (dispatched through the broker like any
+    # cross-protocol publish reaching the gateway channel)
+    from emqx_tpu.app import BrokerApp
+
+    oracle_raw: list = []
+    done = threading.Event()
+
+    def oracle_main():
+        async def run():
+            oapp = BrokerApp()
+            gw = oapp.gateway.load(C.CoapGateway(port=0))
+            await gw.start_listeners()
+            loop = asyncio.get_running_loop()
+            q: asyncio.Queue = asyncio.Queue()
+
+            class Proto(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    q.put_nowait(data)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                Proto, remote_addr=("127.0.0.1", gw.port))
+            tr.sendto(reg_wire)
+            oracle_raw.append(await asyncio.wait_for(q.get(), 5))
+            for body in payloads:
+                oapp.cm.dispatch(oapp.broker.publish(
+                    Message(topic="xp/t", payload=body)))
+                oracle_raw.append(await asyncio.wait_for(q.get(), 5))
+            tr.close()
+            await gw.stop_listeners()
+        asyncio.run(run())
+        done.set()
+
+    th = threading.Thread(target=oracle_main)
+    th.start()
+    th.join(timeout=30)
+    assert done.is_set(), "oracle arm did not complete"
+    assert len(native_raw) == len(oracle_raw) == 5
+    for i, (nb, ob) in enumerate(zip(native_raw, oracle_raw)):
+        assert nb == ob, (
+            f"datagram {i} drifted:\n  native: {nb!r}\n  oracle: {ob!r}")
+
+
+# ---------------------------------------------------------------------------
+# LwM2M over the native CoAP transport (the oracle-punt seam)
+# ---------------------------------------------------------------------------
+
+def test_lwm2m_register_observe_e2e_over_native_transport(app):
+    """gateway/lwm2m.py stays asyncio-shaped, but its register/observe
+    flows run end-to-end over the NATIVE CoAP transport: /rd exchanges
+    punt whole to the LwM2M channel (coap_oracle=), downlink observe
+    commands reach the device as CON POSTs through the native datagram
+    socket, and device notifies publish uplink."""
+    import json
+
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+    from emqx_tpu.core.message import Message
+    from emqx_tpu.gateway import lwm2m as L
+
+    uplinks = []
+    app.hooks.add("message.publish",
+                  lambda m: uplinks.append(
+                      (m.topic, bytes(m.payload))) or None,
+                  priority=-500)
+    srv = NativeBrokerServer(port=0, app=app, coap_port=0,
+                             coap_oracle=lambda ctx: L.Channel(ctx))
+    srv.start()
+    try:
+        dev = CoapSock(srv.coap_port)
+        dev.request(C.POST, "rd", payload=b"</1/0>,</3/0>",
+                    queries=["ep=dev-9", "lt=120", "lwm2m=1.0"])
+        created = dev.recv()
+        assert created.code == C.CREATED
+        loc = [v.decode() for v in created.opts(C.OPT_LOCATION_PATH)]
+        assert loc[0] == "rd" and len(loc) == 2
+        deadline = time.time() + 3
+        while time.time() < deadline and not any(
+                t == "lwm2m/dev-9/up/register" for t, _ in uplinks):
+            time.sleep(0.05)
+        reg = json.loads([p for t, p in uplinks
+                          if t == "lwm2m/dev-9/up/register"][0])
+        assert {o["path"] for o in reg["objects"]} == {"/1/0", "/3/0"}
+        assert srv.host.stats()["coap_punts"] >= 1
+
+        # downlink observe command -> the device receives a CON POST
+        # over the native transport; its ACK settles the command and
+        # surfaces the response uplink
+        app.cm.dispatch(app.broker.publish(Message(
+            topic="lwm2m/dev-9/dn/observe",
+            payload=json.dumps({"reqID": 7, "msgType": "observe",
+                                "data": {"path": "/3/0/0"}}).encode())))
+        cmd = dev.recv()
+        assert cmd.type == C.CON and cmd.code == C.POST
+        assert cmd.uri_path()[0] == "dn"
+        dev.send(C.CoapMessage(C.ACK, C.CHANGED, cmd.mid, cmd.token,
+                               [], b"ok"))
+        deadline = time.time() + 3
+        while time.time() < deadline and not any(
+                t == "lwm2m/dev-9/up/response" for t, _ in uplinks):
+            time.sleep(0.05)
+        resp = json.loads([p for t, p in uplinks
+                           if t == "lwm2m/dev-9/up/response"][-1])
+        assert resp["reqID"] == 7 and resp["msgType"] == "observe"
+
+        # device-originated notify publishes the uplink
+        dev.request(C.POST, f"rd/{loc[1]}/notify", payload=b"23.5",
+                    queries=["path=/3/0/0"])
+        assert dev.recv().code == C.CHANGED
+        deadline = time.time() + 3
+        while time.time() < deadline and not any(
+                t == "lwm2m/dev-9/up/notify" for t, _ in uplinks):
+            time.sleep(0.05)
+        note = json.loads([p for t, p in uplinks
+                           if t == "lwm2m/dev-9/up/notify"][-1])
+        assert note["payload"] == "23.5"
+        dev.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# faultline coverage: the CoAP datagram seams
+# ---------------------------------------------------------------------------
+
+def test_fault_conn_read_loses_datagrams(server, app):
+    """conn_read errno armed against the CoAP ingress: exactly n
+    datagrams are lost, every fire counted + ledger-visible."""
+    c = CoapSock(server.coap_port)
+    c.request(C.POST, "ps/fr/t", payload=b"ok",
+              queries=["clientid=c-fr"])
+    assert c.recv().code == C.CHANGED
+    fired0 = server.host.fault_fired("conn_read")
+    server.fault_arm("conn_read", "errno", n_or_prob=2)
+    time.sleep(0.3)
+    try:
+        for i in range(2):
+            c.request(C.POST, "ps/fr/t", payload=b"lost%d" % i,
+                      queries=["clientid=c-fr"])
+        with pytest.raises(socket.timeout):
+            c.recv(timeout=0.8)        # both datagrams vanished
+        assert server.host.fault_fired("conn_read") - fired0 == 2
+        # the seam heals once the counted arm is spent
+        c.request(C.POST, "ps/fr/t", payload=b"alive",
+                  queries=["clientid=c-fr"])
+        assert c.recv(timeout=5).code == C.CHANGED
+        m = app.broker.metrics
+        deadline = time.time() + 3
+        while time.time() < deadline and m.val("messages.ledger.fault") < 2:
+            time.sleep(0.05)
+        assert m.val("messages.ledger.fault") >= 2
+    finally:
+        server.fault_disarm("conn_read")
+    c.close()
+
+
+def test_fault_conn_write_blackhole_forces_con_exhaustion(server, app):
+    """conn_write blackhole scoped to the observer's conn: CON notifies
+    vanish into the void (claimed sent, never delivered), retransmit
+    to exhaustion, and the give-up lands in faults.* AND the ledger."""
+    server.host.set_coap_ack_timeout(100)
+    time.sleep(0.3)
+    try:
+        sub = CoapSock(server.coap_port)
+        sub.observe("bh/t", token=b"bh", cid="c-bh", qos=1)
+        # resolve the observer's conn id (the only coap:* conn w/ c-bh)
+        deadline = time.time() + 3
+        sub_conn = None
+        while time.time() < deadline and sub_conn is None:
+            for cid, conn in list(server.conns.items()):
+                if conn.coap and conn.channel.clientid == "c-bh":
+                    sub_conn = cid
+            time.sleep(0.05)
+        assert sub_conn is not None
+        fired0 = server.host.fault_fired("conn_write")
+        server.fault_arm("conn_write", "blackhole", key=sub_conn)
+        try:
+            pub = CoapSock(server.coap_port)
+            pub.request(C.PUT, "ps/bh/t", payload=b"void",
+                        queries=["clientid=c-bhp"])
+            assert pub.recv().code == C.CHANGED
+            with pytest.raises(socket.timeout):
+                sub.recv(timeout=1.0)  # the notify went into the void
+            deadline = time.time() + 8
+            while (time.time() < deadline
+                   and server.host.stats()["coap_giveups"] < 1):
+                time.sleep(0.1)
+            st = server.host.stats()
+            assert st["coap_giveups"] >= 1
+            assert server.host.fault_fired("conn_write") > fired0
+            m = app.broker.metrics
+            deadline = time.time() + 3
+            while (time.time() < deadline
+                   and m.val("messages.ledger.coap_giveup") < 1):
+                time.sleep(0.05)
+            assert m.val("messages.ledger.coap_giveup") >= 1
+            assert m.val("messages.ledger.fault") >= 1
+        finally:
+            server.fault_disarm("conn_write")
+        sub.close()
+        pub.close()
+    finally:
+        server.host.set_coap_ack_timeout(0)
+
+
+def test_fault_conn_write_short_sends_prefix_of_batch(server):
+    """conn_write short against the datagram egress: only the first
+    datagram of a batch goes out on the fired flush; the tail follows
+    on the next (whole datagrams — never a torn CoAP message)."""
+    sub = CoapSock(server.coap_port)
+    sub.observe("sh/t", token=b"sh", cid="c-sh")
+    pub = CoapSock(server.coap_port)
+    fired0 = server.host.fault_fired("conn_write")
+    server.fault_arm("conn_write", "short", n_or_prob=1)
+    time.sleep(0.2)
+    try:
+        for i in range(3):
+            pub.request(C.PUT, "ps/sh/t", payload=b"s%d" % i,
+                        queries=["clientid=c-shp"])
+            assert pub.recv(timeout=5).code == C.CHANGED
+        got = sorted(sub.recv(timeout=5).payload for _ in range(3))
+        assert got == [b"s0", b"s1", b"s2"]
+        assert server.host.fault_fired("conn_write") >= fired0
+    finally:
+        server.fault_disarm("conn_write")
+    sub.close()
+    pub.close()
+
+
+def test_oracle_channel_teardown_spares_live_native_session(server, app):
+    """Review regression: a punted-exchange oracle channel that never
+    owned the CM slot (a native conn holds the clientid) must not
+    strip the LIVE session's subscriptions when its conn dies — its
+    close_session is guarded by CM ownership."""
+    sub = CoapSock(server.coap_port)
+    sub.observe("guard/t", token=b"gd", cid="c-guard")
+    # a SECOND endpoint claims the same clientid through the punt seam
+    # (a Block1 upload is oracle-served; _ensure_client registers there)
+    other = CoapSock(server.coap_port)
+    other.request(C.POST, "ps/guard/up", payload=b"A" * 16,
+                  options=[(C.OPT_BLOCK1, C.encode_block(0, 1, 16))],
+                  queries=["clientid=c-guard"])
+    assert other.recv().code == C.CONTINUE_231
+    # find + kill the punting endpoint's conn (the one holding an
+    # oracle channel): its terminate runs, and the guard must leave
+    # c-guard's broker state alone
+    victim = None
+    deadline = time.time() + 3
+    while time.time() < deadline and victim is None:
+        with server._coap_lock:
+            ids = list(server._coap_oracle)
+        victim = ids[0] if ids else None
+        time.sleep(0.05)
+    assert victim is not None
+    server.host.close_conn(victim)
+    time.sleep(0.4)
+    pub = CoapSock(server.coap_port)
+    pub.request(C.PUT, "ps/guard/t", payload=b"still-here",
+                queries=["clientid=c-gpub"])
+    assert pub.recv().code == C.CHANGED
+    assert sub.recv().payload == b"still-here"
+    sub.close()
+    other.close()
+    pub.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: the asyncio gateway still serves when coap_port off
+# ---------------------------------------------------------------------------
+
+def test_asyncio_gateway_fallback(app):
+    """NativeBrokerServer without coap_port + the asyncio CoapGateway
+    side-by-side: the deployment fallback stays fully functional."""
+    import asyncio
+    import threading
+
+    from emqx_tpu.broker.native_server import NativeBrokerServer
+
+    srv = NativeBrokerServer(port=0, app=app)
+    srv.start()
+    state: dict = {}
+    stop = threading.Event()
+    ready = threading.Event()
+
+    def gw_main():
+        async def run_gw():
+            gw = app.gateway.load(C.CoapGateway(port=0))
+            await gw.start_listeners()
+            state["port"] = gw.port
+            ready.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.05)
+            await gw.stop_listeners()
+        asyncio.run(run_gw())
+
+    th = threading.Thread(target=gw_main)
+    th.start()
+    try:
+        assert srv.coap_port is None
+        assert ready.wait(10)
+        c = CoapSock(state["port"])
+        c.request(C.POST, "ps/fb/t", payload=b"v",
+                  queries=["clientid=c-fb"])
+        assert c.recv().code == C.CHANGED
+        c.close()
+    finally:
+        stop.set()
+        th.join()
+        srv.stop()

@@ -1517,10 +1517,160 @@ print("SANITIZED-RUN-OK", parked_seen, st["conns_inflated"],
 """
 
 
+DRIVER_COAP = r"""
+import socket, sys, threading, time
+sys.path.insert(0, %(repo)r)
+from emqx_tpu import native
+from emqx_tpu.gateway import coap as C
+
+host = native.NativeHost(port=0, max_size=1 << 16)
+coap_port = host.listen_coap("127.0.0.1", 0)
+host.set_coap_ack_timeout(50)
+f = C.Frame()
+
+def req(code, segs, mid, token=b"t", obs=None, queries=(), payload=b"",
+        con=True, extra=()):
+    opts = [(C.OPT_URI_PATH, s) for s in segs] + list(extra)
+    if obs is not None:
+        opts.append((C.OPT_OBSERVE, obs))
+    for q in queries:
+        opts.append((C.OPT_URI_QUERY, q))
+    return f.serialize(C.CoapMessage(C.CON if con else C.NON, code, mid,
+                                     token, opts, payload))
+
+stop = threading.Event()
+
+def control_churn():
+    # retained-mirror swaps + the plain-GET completeness gate + the
+    # CON backoff knob flipping, all racing the poll thread's dispatch
+    j = 0
+    while not stop.is_set():
+        host.set_retained("cr/" + str(j %% 16), b"v" + str(j).encode(),
+                          j & 1, 0)
+        if j %% 7 == 3:
+            host.retain_del("cr/" + str((j + 5) %% 16))
+        if j %% 11 == 5:
+            host.coap_retain_state(j %% 2 == 0)
+        if j %% 13 == 7:
+            host.set_coap_ack_timeout(50 + (j %% 3) * 25)
+        host.stats()
+        j += 1
+        time.sleep(0.0004)
+
+def udp_churn(seed):
+    # endpoint churn: observe register (CON), NON + CON-qos1 publishes
+    # (with one byte-identical dup for the MID-dedup window), CoAP
+    # pings, a block-wise punt, plain GETs against the flipping
+    # retained gate, CON-notify ACK/RST answers, a new-identity
+    # re-register, and endpoints that vanish mid-rexmit
+    j = 0
+    while not stop.is_set():
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(0.05)
+        s.connect(("127.0.0.1", coap_port))
+        cid = ("clientid=ch-" + str(seed) + "-" + str(j %% 3)).encode()
+        t = str(j %% 16).encode()
+        s.send(req(C.GET, [b"ps", b"cr", t], 1, token=b"ob", obs=b"",
+                   queries=[cid, b"qos=1"]))
+        s.send(req(C.POST, [b"ps", b"cr", t], 2, token=b"p1",
+                   queries=[cid], payload=b"x", con=False))
+        dup = req(C.POST, [b"ps", b"cr", t], 3, token=b"p2",
+                  queries=[cid, b"qos=1"], payload=b"y")
+        s.send(dup)
+        s.send(dup)
+        s.send(f.serialize(C.CoapMessage(C.CON, C.EMPTY, 9, b"")))
+        s.send(req(C.POST, [b"ps", b"blk"], 4, token=b"bk",
+                   queries=[cid], payload=b"c",
+                   extra=[(C.OPT_BLOCK1, b"\x08")]))
+        s.send(req(C.GET, [b"ps", b"cr", t], 5, token=b"rd",
+                   queries=[cid]))
+        try:
+            while True:
+                for m in f.parse(s.recv(4096), None)[0]:
+                    if m.type == C.CON:
+                        t2 = C.ACK if (j + m.mid) %% 3 else C.RST
+                        s.send(f.serialize(
+                            C.CoapMessage(t2, C.EMPTY, m.mid, b"")))
+        except OSError:
+            pass
+        if j %% 2:
+            s.send(req(C.POST, [b"ps", b"cr", b"0"], 6,
+                       queries=[b"clientid=re-" + str(seed).encode()],
+                       payload=b"z", con=False))
+        s.close()
+        j += 1
+
+th = [threading.Thread(target=control_churn),
+      threading.Thread(target=udp_churn, args=(1,)),
+      threading.Thread(target=udp_churn, args=(2,))]
+for t in th: t.start()
+
+# main thread plays the Python plane exactly like native_server: answer
+# CONNECT/SUBSCRIBE/UNSUBSCRIBE/qos1 punts, fast-enable + permit, and
+# serve kind-13 oracle punts with a canned response
+import struct
+deadline = time.time() + 25
+while time.time() < deadline:
+    for kind, conn, payload in host.poll(20):
+        if kind == 13:
+            try:
+                m = f.parse(payload, None)[0][0]
+            except Exception:
+                continue
+            if m.type in (0, 1) and m.code:
+                host.coap_send(conn, f.serialize(C.CoapMessage(
+                    C.ACK if m.type == 0 else C.NON, C.NOT_FOUND,
+                    m.mid, m.token)))
+            continue
+        if kind != native.EV_FRAME:
+            continue
+        t = payload[0] >> 4
+        if t == 1:                                  # CONNECT
+            host.send(conn, b"\x20\x02\x00\x00")
+            host.enable_fast(conn, 4, 32)
+            for k in range(16):
+                host.permit(conn, "cr/" + str(k))
+        elif t == 8:                                # SUBSCRIBE
+            pid = struct.unpack(">H", payload[2:4])[0]
+            tl = struct.unpack(">H", payload[4:6])[0]
+            filt = payload[6:6 + tl].decode()
+            host.sub_add(conn, filt, qos=1)
+            host.send(conn, b"\x90\x03" + struct.pack(">H", pid) + b"\x01")
+        elif t == 10:                               # UNSUBSCRIBE
+            pid = struct.unpack(">H", payload[2:4])[0]
+            tl = struct.unpack(">H", payload[4:6])[0]
+            host.sub_del(conn, payload[6:6 + tl].decode())
+            host.send(conn, b"\xB0\x02" + struct.pack(">H", pid))
+        elif t == 3:                                # punted PUBLISH
+            qos = (payload[0] >> 1) & 3
+            if qos:
+                tl = struct.unpack(">H", payload[2:4])[0]
+                pid = struct.unpack(">H", payload[4 + tl:6 + tl])[0]
+                host.send(conn, b"\x40\x02" + struct.pack(">H", pid))
+    st = host.stats()
+    if (st["coap_in"] > 150 and st["coap_notifies"] > 20
+            and st["coap_punts"] > 10 and st["coap_pings"] > 20):
+        break
+
+stop.set()
+for t in th: t.join()
+st = host.stats()
+assert st["coap_in"] > 0 and st["coap_notifies"] > 0, st
+assert st["coap_punts"] > 0 and st["coap_pings"] > 0, st
+assert st["coap_dedup_hits"] > 0, st
+for _ in range(10):
+    list(host.poll(10))
+host.destroy()
+print("SANITIZED-RUN-OK", st["coap_in"], st["coap_notifies"],
+      st["coap_giveups"])
+"""
+
+
 @pytest.mark.parametrize("sanitizer", ["address", "thread"])
 @pytest.mark.parametrize("driver", ["host", "fastpath", "lane", "ws",
                                     "telemetry", "trunk", "durable", "sn",
-                                    "shards", "tracing", "fault", "park"])
+                                    "shards", "tracing", "fault", "park",
+                                    "coap"])
 def test_host_cc_sanitized(sanitizer, driver, tmp_path):
     if sanitizer not in _SAN_LIBS:
         pytest.skip(f"{sanitizer} sanitizer runtime not available")
@@ -1540,7 +1690,8 @@ def test_host_cc_sanitized(sanitizer, driver, tmp_path):
            "telemetry": DRIVER_TELEMETRY, "trunk": DRIVER_TRUNK,
            "durable": DRIVER_DURABLE, "sn": DRIVER_SN,
            "shards": DRIVER_SHARDS, "tracing": DRIVER_TRACING,
-           "fault": DRIVER_FAULT, "park": DRIVER_PARK}[driver]
+           "fault": DRIVER_FAULT, "park": DRIVER_PARK,
+           "coap": DRIVER_COAP}[driver]
     proc = subprocess.run(
         [sys.executable, "-c", src % {"repo": repo}],
         capture_output=True, text=True, env=env, timeout=180)

@@ -261,6 +261,28 @@ def test_sn_retain_slots_and_stages_exported():
     assert "kHistSnIngest" in src and "kHistRetainDeliver" in src
 
 
+# -- native coap gateway plane (ISSUE 15) -------------------------------------
+
+
+def test_coap_slots_and_stages_exported():
+    """The CoAP gateway plane's StatSlots / HistStages / ledger reason
+    stay exported — the mechanical enum lint above passes if BOTH sides
+    dropped them, so their presence is pinned here by name (the
+    trunk-pin pattern). fetch_add sites and prometheus render-at-zero
+    ride the mechanical tests at the top of this file."""
+    for name in ("coap_in", "coap_notifies", "coap_pings",
+                 "coap_dedup_hits", "coap_rexmits", "coap_giveups",
+                 "coap_punts", "coap_drops_oversize"):
+        assert name in native.STAT_NAMES, name
+    assert "coap_ingest" in native.HIST_STAGES
+    assert "observe_notify" in native.HIST_STAGES
+    assert "coap_giveup" in native.LEDGER_REASONS
+    src = _src()
+    assert "kStCoapIn" in src and "kStCoapDropsOversize" in src
+    assert "kHistCoapIngest" in src and "kHistObserveNotify" in src
+    assert "kLrCoapGiveup" in src
+
+
 # -- multi-core shard plane (ISSUE 7) -----------------------------------------
 
 

@@ -68,7 +68,8 @@ from .model import CppModel, _MEMORY_ORDER_RE, enumerators, snake
 from .pymodel import PySource
 
 CPP_FILES = ("host.cc", "store.h", "trunk.h", "ring.h", "router.h",
-             "sn.h", "ws.h", "frame.h", "fault.h", "wheel.h", "park.h")
+             "sn.h", "ws.h", "frame.h", "fault.h", "wheel.h", "park.h",
+             "coap.h")
 PY_FOLD_FILE = os.path.join("emqx_tpu", "broker", "native_server.py")
 
 RULES = ("plane", "lockset", "ladder", "pyfold", "fault",

@@ -28,8 +28,9 @@ small [B, M] tensor along tp) so fan-out can keep W sharded over tp.
 from __future__ import annotations
 
 import functools
+import os
 import threading
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding
 from emqx_tpu.ops import fanout as fo
 from emqx_tpu.ops import trie_match as tm
 from emqx_tpu.parallel import mesh as pmesh
-from emqx_tpu.router.index import TrieIndex
+from emqx_tpu.router.index import ShardedTrieIndex, TrieIndex
 
 
 def router_step(
@@ -89,6 +90,62 @@ def router_step(
     return fids, out, overflow, fan_any
 
 
+def router_step_sharded(
+    trie: tm.DeviceTrie,   # fields [S, H] / [S, N] — shard axis over tp
+    rowmap: jax.Array,
+    pool: jax.Array,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    sys_flags: jax.Array,
+    *,
+    n_shards: int,
+    K: int = 32,
+    M: int = 128,
+    max_probes: int = 8,
+    ret_cap: Optional[int] = None,
+    shardings: Optional[dict[str, NamedSharding]] = None,
+):
+    """The routing step over a subscription-sharded trie.
+
+    Layout: the trie's shard axis is partitioned over ``tp`` (each
+    device holds its fid-range slice), the topic batch over ``dp`` only
+    (tp-replicated — every shard must see every topic).  Each shard
+    matches and compacts its own slice to M shard-local fids, local
+    fids translate to the interleaved global namespace, and the [B,
+    S·M] shard-major merge is the ONLY tensor the tp collective moves —
+    compacted ids, never the [S, B, (L+1)·2K] candidate block and never
+    the bitmaps.  After the merge the step is exactly ``router_step``:
+    one more compact, then the tp-sharded dense-pool OR over GLOBAL
+    fids.
+
+    n_shards=1 degenerates bit-identically to ``router_step`` on the
+    flat trie (identity fid translation, no-op second compact).
+    """
+    cand, overflow = tm.match_batch_sharded(
+        trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
+    )
+    S, B, _ = cand.shape
+    per, trunc = jax.vmap(lambda c: tm.compact_fids(c, M=M))(cand)
+    shard_ids = jnp.arange(S, dtype=per.dtype)[:, None, None]
+    per = jnp.where(per >= 0, per * n_shards + shard_ids, -1)
+    merged = jnp.moveaxis(per, 0, 1).reshape(B, S * M)
+    if shardings is not None:
+        # the tp all-gather: [B, S*M] compacted global fids to dp-only
+        merged = jax.lax.with_sharding_constraint(
+            merged, shardings["batch_dp"])
+    fids, trunc2 = tm.compact_fids(merged, M=M)
+    truncated = jnp.any(trunc, axis=0) | trunc2
+    out = fo.fanout_pool(rowmap, pool, fids)
+    if shardings is not None:
+        out = jax.lax.with_sharding_constraint(out, shardings["fanout_out"])
+    fan_any = jnp.any(out != 0)
+    overflow = overflow | truncated
+    if ret_cap is not None and ret_cap < M:
+        overflow = overflow | (jnp.sum(fids >= 0, axis=1) > ret_cap)
+        fids = fids[:, :ret_cap]
+    return fids, out, overflow, fan_any
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def _apply_patches(trie: tm.DeviceTrie, rowmap: jax.Array, pool: jax.Array,
                    tupd: dict, rowmap_upd: tuple, pool_upd: tuple) -> tuple:
@@ -99,6 +156,8 @@ def _apply_patches(trie: tm.DeviceTrie, rowmap: jax.Array, pool: jax.Array,
     new = {}
     for name in tm.DeviceTrie._fields:
         arr = getattr(trie, name)
+        # idx is a 1-D index array (flat trie) or a (shard_idx, elem_idx)
+        # pair (sharded [S, ...] trie) — .at[] takes both
         idx, vals = tupd[name]
         new[name] = arr.at[idx].set(vals)
     ridx, rvals = rowmap_upd
@@ -126,6 +185,76 @@ def _pad_to(cap: int, idx: np.ndarray, vals: np.ndarray):
             np.concatenate([vals, np.repeat(vals[:1], pad)]))
 
 
+class _HostMatcher:
+    """CPU-platform serving path: an exact host matcher keyed by fid.
+
+    BENCH_r05 measured the XLA kernel at 11.9k topics/s on CPU against
+    2.07M/s for the C++ SubTable on the same box — a 0.1x
+    ``vs_host_oracle`` regression the model used to serve whenever the
+    resolved platform was cpu.  When active (see
+    ``RouterModel._resolve_host_dispatch``) ``publish_batch`` routes
+    through this mirror instead of dispatching the XLA program.
+
+    Backend: the C++ ``NativeSubTable`` (owner = fid) when the native
+    plane built, else the pure-python host-oracle ``Trie``.  Entries are
+    guarded by a fid→filter dict so refcount drift in either backend is
+    impossible (adds/removes are idempotent per fid).
+    """
+
+    def __init__(self) -> None:
+        self._fids: dict[int, str] = {}
+        self._native = None
+        self._trie = None
+        self._by_filt: dict[str, int] = {}
+        from emqx_tpu import native
+        if native.available():
+            self._native = native.NativeSubTable()
+        else:
+            from emqx_tpu.router.trie import Trie
+            self._trie = Trie()
+        self.backend = "native" if self._native is not None else "oracle"
+
+    def add(self, fid: int, filt: str) -> None:
+        if fid in self._fids:
+            return
+        self._fids[fid] = filt
+        if self._native is not None:
+            self._native.add(fid, filt)
+        else:
+            self._trie.insert(filt)
+            self._by_filt[filt] = fid
+
+    def remove(self, fid: int) -> None:
+        filt = self._fids.pop(fid, None)
+        if filt is None:
+            return
+        if self._native is not None:
+            self._native.remove(fid, filt)
+        else:
+            self._trie.delete(filt)
+            self._by_filt.pop(filt, None)
+
+    def match(self, topic: str) -> list[int]:
+        if self._native is not None:
+            fids = list(self._native.match(topic))
+        else:
+            fids = [self._by_filt[f] for f in self._trie.match(topic)
+                    if f in self._by_filt]
+        if topic.startswith("$"):
+            # MQTT-3.7.2-1: a root-level wildcard must not match a
+            # $-topic.  The oracle Trie enforces this itself; the C++
+            # SubTable does not, so filter uniformly here (matches the
+            # device kernel's sys_block lane kill at level 0)
+            fids = [f for f in fids
+                    if self._fids[f].split("/", 1)[0] not in ("+", "#")]
+        return fids
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+
+
 class RouterModel:
     """Host wrapper: TrieIndex + subscriber bitmaps + the jitted step.
 
@@ -144,7 +273,7 @@ class RouterModel:
 
     def __init__(
         self,
-        index: Optional[TrieIndex] = None,
+        index: Optional[Union[TrieIndex, ShardedTrieIndex]] = None,
         *,
         n_sub_slots: int = 8192,
         K: int = 32,
@@ -152,14 +281,32 @@ class RouterModel:
         ret_cap: int = 16,
         dense_threshold: int = 64,
         mesh: Optional[Mesh] = None,
+        trie_shards: Optional[int] = None,
     ) -> None:
-        self.index = index or TrieIndex()
+        if index is None:
+            index = (ShardedTrieIndex(trie_shards) if trie_shards
+                     else TrieIndex())
+        elif trie_shards is not None and (
+                getattr(index, "n_shards", 1) != trie_shards):
+            raise ValueError(
+                f"trie_shards={trie_shards} conflicts with the supplied "
+                f"index ({getattr(index, 'n_shards', 1)} shard(s))")
+        self.index = index
+        self._sharded = isinstance(index, ShardedTrieIndex)
+        self.n_shards = index.n_shards if self._sharded else 1
         self.n_sub_slots = n_sub_slots
         self.K, self.M = K, M
         self.ret_cap = min(ret_cap, M)
         self.dense_threshold = dense_threshold
         self.mesh = mesh
         self.shardings = pmesh.router_shardings(mesh) if mesh else None
+        if self._sharded and mesh is not None:
+            tp_ext = mesh.shape[pmesh.TP]
+            if self.n_shards % tp_ext:
+                raise ValueError(
+                    f"trie shard count {self.n_shards} must be a multiple "
+                    f"of the tp mesh extent {tp_ext} — the stacked [S, ...]"
+                    f" buffers partition their shard axis evenly over tp")
         # fid → {slot: refcount} — slots are SHARDS (SlotRegistry may
         # hash many sids into one), so a slot stays set while any local
         # subscriber of the filter lives in it
@@ -199,9 +346,15 @@ class RouterModel:
         self.upload_count = 0      # full device uploads (test/obs hook)
         self.patch_count = 0       # incremental scatter flushes
         self.launch_count = 0      # publish_batch kernel launches
+        self.host_match_count = 0  # batches served by the host matcher
+        if self._sharded:
+            step_fn = functools.partial(
+                router_step_sharded, n_shards=self.n_shards)
+        else:
+            step_fn = router_step
         self._step = jax.jit(
             functools.partial(
-                router_step,
+                step_fn,
                 K=K,
                 M=M,
                 ret_cap=self.ret_cap,
@@ -209,6 +362,27 @@ class RouterModel:
                 shardings=self.shardings,
             )
         )
+        # platform-aware dispatch: on a cpu backend the XLA kernel is a
+        # ~0.1x regression vs the host matcher (BENCH_r05), so serve
+        # from the host mirror unless the escape hatch says otherwise
+        self._host_matcher = (_HostMatcher()
+                              if self._resolve_host_dispatch() else None)
+
+    def _resolve_host_dispatch(self) -> bool:
+        """Should publish_batch serve from the host matcher?
+
+        ``EMQX_TPU_CPU_KERNEL``: ``host`` forces the host matcher,
+        ``xla`` forces the device kernel (the bench's validation-mode
+        escape hatch — measuring the XLA program ON cpu is the point
+        there), anything else is auto: host matcher iff the resolved
+        platform is cpu and no mesh was requested.
+        """
+        mode = os.environ.get("EMQX_TPU_CPU_KERNEL", "auto").lower()
+        if mode == "host":
+            return True
+        if mode == "xla":
+            return False
+        return self.mesh is None and jax.default_backend() == "cpu"
 
     # -- subscription surface (driven by the broker layer) -----------------
 
@@ -236,6 +410,8 @@ class RouterModel:
             )
         with self._mlock:
             fid = self.index.insert(filt)
+            if self._host_matcher is not None:
+                self._host_matcher.add(fid, filt)
             self._mark("_sub_mask", fid, True)
             slots = self._subs.setdefault(fid, {})
             n = slots.get(slot, 0)
@@ -264,6 +440,8 @@ class RouterModel:
                     # trie entry alive past the last subscriber
                     if fid not in self._aux_refs:
                         self.index.delete(filt)
+                        if self._host_matcher is not None:
+                            self._host_matcher.remove(fid)
                 self._dirty = True
 
     # -- auxiliary (rule-engine) filters ------------------------------------
@@ -273,6 +451,8 @@ class RouterModel:
         device trie; refcounted across rules sharing a filter."""
         with self._mlock:
             fid = self.index.insert(filt)
+            if self._host_matcher is not None:
+                self._host_matcher.add(fid, filt)
             self._aux_refs[fid] = self._aux_refs.get(fid, 0) + 1
             self._mark("_aux_mask", fid, True)
             self._dirty = True
@@ -290,6 +470,8 @@ class RouterModel:
             self._mark("_aux_mask", fid, False)
             if fid not in self._subs:      # no subscribers either
                 self.index.delete(filt)
+                if self._host_matcher is not None:
+                    self._host_matcher.remove(fid)
             self._dirty = True
 
     # -- dense-pool promotion / demotion -----------------------------------
@@ -388,14 +570,26 @@ class RouterModel:
             self._refresh_locked()
 
     def _refresh_locked(self) -> None:
-        full_trie = (self.index.needs_rebuild or self.index.arrays is None
-                     or self._trie_dev is None)
+        full_trie = (self.index.needs_rebuild or self._trie_dev is None
+                     or (not self._sharded and self.index.arrays is None))
         if full_trie:
-            arrays = self.index.ensure()
-            trie_dev = tm.device_trie(arrays)
-            if self.shardings is not None:
-                trie_dev = jax.device_put(
-                    trie_dev, self.shardings["replicated"])
+            if self._sharded:
+                # ensure() also equalizes the per-shard edge-table sizes
+                # so the [S, H] stack shares one probe mask
+                shard_arrays = self.index.ensure()
+                trie_dev = tm.stacked_device_trie(shard_arrays)
+                if self.shardings is not None:
+                    trie_dev = jax.device_put(
+                        trie_dev, self.shardings["trie_sub"])
+                else:
+                    trie_dev = tm.DeviceTrie(
+                        *(jnp.asarray(x) for x in trie_dev))
+            else:
+                arrays = self.index.ensure()
+                trie_dev = tm.device_trie(arrays)
+                if self.shardings is not None:
+                    trie_dev = jax.device_put(
+                        trie_dev, self.shardings["replicated"])
             self._trie_dev = trie_dev
             self.index.drain_updates()    # superseded by the upload
             self.upload_count += 1
@@ -426,11 +620,29 @@ class RouterModel:
             cap = _patch_bucket(max(
                 max((len(v) for v in updates.values()), default=0),
                 len(rm_dirty), len(pool_dirty)))
-            arrays = self.index.arrays
             tupd = {}
             for name in tm.DeviceTrie._fields:
                 idxs = updates.get(name)
-                host = getattr(arrays, name)
+                if self._sharded:
+                    # (shard, idx) pairs → a 2-D scatter into [S, ...]:
+                    # a steady-state subscribe patches just the owning
+                    # shard's slice, never the whole stack
+                    if idxs:
+                        sidx = np.asarray([s for s, _ in idxs], np.int32)
+                        eidx = np.asarray([i for _, i in idxs], np.int32)
+                    else:
+                        sidx = np.zeros(1, np.int32)   # no-op self-write
+                        eidx = np.zeros(1, np.int32)
+                    shards = self.index.shards
+                    vals = np.asarray(
+                        [getattr(shards[s].arrays, name)[i]
+                         for s, i in zip(sidx, eidx)], np.int32)
+                    sidx, vals = _pad_to(cap, sidx, vals)
+                    eidx, _ = _pad_to(cap, eidx, eidx)
+                    tupd[name] = ((jnp.asarray(sidx), jnp.asarray(eidx)),
+                                  jnp.asarray(vals))
+                    continue
+                host = getattr(self.index.arrays, name)
                 if idxs:
                     idx = np.asarray(idxs, np.int32)
                 else:
@@ -485,6 +697,11 @@ class RouterModel:
         pipeline overlaps this launch's device round trip (~70 ms on a
         tunneled TPU, fixed per synchronous fetch) with the NEXT batch's
         hook fold and tokenization — the SURVEY §2.5-6 double-buffering."""
+        if self._host_matcher is not None:
+            # cpu platform: serve synchronously from the host matcher —
+            # the "pending" handle is the finished result, so the
+            # pipeline's submit/collect overlap degenerates harmlessly
+            return ("host", self._publish_batch_host(topics))
         with self._mlock:
             if self._dirty or self._trie_dev is None:
                 self._refresh_locked()
@@ -506,7 +723,11 @@ class RouterModel:
             sys_flags[n:] = True
             args = (tokens, lengths, sys_flags)
             if self.shardings is not None:
-                args = jax.device_put(args, self.shardings["batch_full"])
+                # sharded trie: topics go dp-only (tp-REPLICATED — every
+                # trie shard matches every topic); replicated trie keeps
+                # the full dp×tp batch split
+                key = "batch_dp" if self._sharded else "batch_full"
+                args = jax.device_put(args, self.shardings[key])
             fids, fanout, overflow, fan_any = self._step(
                 self._trie_dev, self._rowmap_dev, self._pool_dev, *args
             )
@@ -518,6 +739,9 @@ class RouterModel:
 
     def publish_batch_collect(self, pending):
         """Stage 2: fetch + decode a submitted batch's results."""
+        if isinstance(pending, tuple) and len(pending) == 2 \
+                and pending[0] == "host":
+            return pending[1]
         topics, too_long, fids, fanout, overflow, fan_any = pending
         try:
             # ONE device_get for all needed outputs: it issues
@@ -545,6 +769,41 @@ class RouterModel:
         finally:
             with self._mlock:
                 self.index.end_inflight()
+
+    def _publish_batch_host(self, topics):
+        """Serve one batch from the host matcher (cpu-platform path).
+
+        Same ``(matched, aux, slots, fallback)`` contract as the device
+        decode.  The host walk is exact and depth-unbounded, so there is
+        no overflow/too-long leg: fallback is always empty.  Slots come
+        straight from the subscription dict for every matched filter —
+        dense-pool promotion is a device-bandwidth optimization with no
+        meaning here.
+        """
+        with self._mlock:
+            self.host_match_count += 1
+            filters = self.index.filters
+            any_aux = bool(self._aux_refs)
+            matched: list[list[str]] = []
+            aux: list[list[str]] = []
+            slots_out: list[list[int]] = []
+            for topic in topics:
+                m: list[str] = []
+                a: list[str] = []
+                sl: set[int] = set()
+                for fid in self._host_matcher.match(topic):
+                    filt = filters[fid]
+                    if filt is None:
+                        continue
+                    if fid in self._subs:
+                        m.append(filt)
+                        sl.update(self._subs[fid])
+                    if any_aux and fid in self._aux_refs:
+                        a.append(filt)
+                matched.append(m)
+                aux.append(a)
+                slots_out.append(sorted(sl))
+            return matched, aux, slots_out, []
 
     def _decode_locked(self, topics, too_long, fids, fan, overflow):
         # -- vectorized batch decode (the r2 host hot-spot): classify the

@@ -85,6 +85,25 @@ def _g(x: jax.Array) -> jax.Array:
     return jax.lax.optimization_barrier(x)
 
 
+def _register_barrier_batching() -> None:
+    """optimization_barrier has no vmap batching rule in jax<=0.4.x, but
+    it is the identity — batch dims pass straight through.  The sharded
+    match vmaps the kernel over the trie's shard axis, so register the
+    trivial rule (what newer jax ships upstream) when it's missing."""
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:      # layout moved: newer jax has the rule anyway
+        return
+    if optimization_barrier_p not in batching.primitive_batchers:
+        def _rule(args, dims):
+            return optimization_barrier_p.bind(*args), list(dims)
+        batching.primitive_batchers[optimization_barrier_p] = _rule
+
+
+_register_barrier_batching()
+
+
 def _edge_hash(parent: jax.Array, word: jax.Array, mask: int) -> jax.Array:
     """Must stay bit-identical to index.edge_hash (host builder)."""
     h = (
@@ -245,3 +264,99 @@ def compact_fids(cand: jax.Array, *, M: int = 128) -> tuple[jax.Array, jax.Array
     packed = _g(jnp.take_along_axis(cand, order[:, :M], axis=1))
     n = jnp.sum(cand >= 0, axis=1)
     return packed, n > M
+
+
+# ---------------------------------------------------------------------------
+# sharded trie: S per-shard tries stacked into [S, ...] buffers
+# ---------------------------------------------------------------------------
+
+
+def stacked_device_trie(shard_arrays) -> DeviceTrie:
+    """Stack S per-shard TrieIndexArrays into one [S, ...] DeviceTrie.
+
+    The edge hash tables must already share one pow2 size H — the probe
+    mask (H-1) is baked per stacked buffer, so ShardedTrieIndex.ensure()
+    equalizes them before this runs.  Node arrays just pad to the max N
+    with -1: a -1 child/fid lane is already "miss" everywhere in the
+    kernel, so padding is semantically invisible.
+
+    Returns host (numpy-backed) arrays — the caller device_puts the
+    pytree with the ``trie_sub`` sharding (shard axis 0 over ``tp``).
+    """
+    sizes = {a.ht_parent.shape[0] for a in shard_arrays}
+    if len(sizes) != 1:
+        raise ValueError(f"unequal edge-table sizes across shards: {sizes}")
+    N = max(a.plus_child.shape[0] for a in shard_arrays)
+
+    def pad_n(x: np.ndarray) -> np.ndarray:
+        if x.shape[0] == N:
+            return x
+        return np.concatenate(
+            [x, np.full(N - x.shape[0], -1, x.dtype)])
+
+    return DeviceTrie(
+        ht_parent=np.stack([a.ht_parent for a in shard_arrays]),
+        ht_word=np.stack([a.ht_word for a in shard_arrays]),
+        ht_child=np.stack([a.ht_child for a in shard_arrays]),
+        plus_child=np.stack([pad_n(a.plus_child) for a in shard_arrays]),
+        hash_fid=np.stack([pad_n(a.hash_fid) for a in shard_arrays]),
+        node_fid=np.stack([pad_n(a.node_fid) for a in shard_arrays]),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("K", "max_probes"))
+def match_batch_sharded(
+    trie: DeviceTrie,      # fields [S, H] / [S, N]
+    tokens: jax.Array,     # [B, L]
+    lengths: jax.Array,    # [B]
+    sys_flags: jax.Array,  # [B]
+    *,
+    K: int = 32,
+    max_probes: int = 8,
+) -> tuple[jax.Array, jax.Array]:
+    """match_batch vmapped over the shard axis of a stacked trie.
+
+    Each shard walks the SAME (tp-replicated) topic batch against its
+    own subscription slice, so the returned fids are shard-LOCAL.
+    Overflow is per-shard: shard s's K-frontier can spill on a topic
+    even when the replicated trie's would not (its wildcard branches
+    are a subset but the cap is per walk) and vice versa — the [S, B]
+    flags are OR-reduced because any spilled shard makes the merged
+    result potentially incomplete for that topic.
+
+    Returns ``(cand [S, B, (L+1)*2K], overflow [B])``.
+    """
+    cand, over = jax.vmap(
+        lambda t: match_batch(
+            t, tokens, lengths, sys_flags, K=K, max_probes=max_probes
+        )
+    )(trie)
+    return cand, jnp.any(over, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("M", "n_shards"))
+def compact_fids_sharded(
+    cand: jax.Array, *, M: int = 128, n_shards: int = 1
+) -> tuple[jax.Array, jax.Array]:
+    """Per-shard compact + local→global fid translation + merge.
+
+    ``cand`` is the [S, B, C] shard-local candidate tensor from
+    ``match_batch_sharded``.  Each shard compacts its own candidates to
+    M slots (so the merge tensor is [B, S·M], tiny next to C), local
+    fids translate to the interleaved global namespace
+    (``global = local * S + shard``), and a second stable compact packs
+    the shard-major concatenation down to the first M global matches.
+
+    Returns (fids [B, M] global, truncated [B]).  Truncation is the OR
+    of any per-shard spill and the merged spill — either loses matches.
+    For S=1 the translation is the identity and the second compact of
+    an already-packed row is a no-op, so this degenerates bit-for-bit
+    to ``compact_fids``.
+    """
+    S, B, _ = cand.shape
+    per, trunc = jax.vmap(lambda c: compact_fids(c, M=M))(cand)
+    shard_ids = jnp.arange(S, dtype=per.dtype)[:, None, None]
+    per = jnp.where(per >= 0, per * n_shards + shard_ids, -1)
+    merged = jnp.moveaxis(per, 0, 1).reshape(B, S * M)   # [B, S*M]
+    fids, trunc2 = compact_fids(merged, M=M)
+    return fids, jnp.any(trunc, axis=0) | trunc2

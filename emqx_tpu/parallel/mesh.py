@@ -15,15 +15,25 @@ ICI mesh. Axis mapping (broker → mesh):
   kernel (lax.scan) — intentionally NOT sharded: L ≤ 16 while B is
   thousands, so the parallel win lives on dp/tp (this is the design
   answer to ring/Ulysses-style sequence parallelism for this workload).
-- The trie itself is **replicated** across devices — the same decision as
-  the reference's full route-table replication per node
-  (emqx_router.erl:148-153): matching must be local; only fan-out shards.
+- ``sub`` (subscription space): the trie supports TWO layouts.
+  *Replicated* (the v1 decision, still the TrieIndex default — the
+  reference's full route-table replication per node,
+  emqx_router.erl:148-153): matching is local, only fan-out shards.
+  *Sharded* (ShardedTrieIndex): the fid space partitions into S
+  per-shard tries stacked into [S, ...] buffers whose shard axis rides
+  ``tp`` (``trie_sub`` below) — each device holds only its subscription
+  slice, so 10M-filter HBM residency and match bandwidth both scale
+  with tp instead of being a single chip's problem.
 
-During a step, match runs with B sharded over BOTH axes (dp×tp — full
-data parallelism), then matched fids reshard to dp-only (an all-gather
-along tp that XLA inserts from the sharding constraints) so the bitmap-OR
-can run with W sharded over tp. That collective rides ICI and moves only
-the compacted [B, M] fid tensor, never the bitmaps.
+During a replicated-trie step, match runs with B sharded over BOTH axes
+(dp×tp — full data parallelism), then matched fids reshard to dp-only
+(an all-gather along tp that XLA inserts from the sharding constraints)
+so the bitmap-OR can run with W sharded over tp.  During a SHARDED-trie
+step the batch is dp-only (tp-replicated — every shard sees every
+topic); each shard matches + compacts its slice in place, and the tp
+collective moves the [B, S·M] merged compacted-fid tensor before the
+same tp-sharded bitmap-OR.  Either way the collective rides ICI and
+moves only compacted fids, never candidate blocks or bitmaps.
 """
 
 from __future__ import annotations
@@ -69,4 +79,5 @@ def router_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
         "batch_dp": s(DP),               # fids after reshard: B over dp
         "bitmaps": s(None, TP),          # [F, W]: W over tp, F replicated
         "fanout_out": s(DP, TP),         # [B, W] result tiles
+        "trie_sub": s(TP),               # stacked trie [S, ...]: S over tp
     }

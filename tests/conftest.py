@@ -8,6 +8,10 @@ dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/tpu default
+# the suite validates the XLA kernel ON the cpu backend — keep the
+# platform-aware host-matcher dispatch out of the way except in the
+# tests that opt back in (test_host_dispatch)
+os.environ.setdefault("EMQX_TPU_CPU_KERNEL", "xla")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -16,9 +16,11 @@ import pytest
 
 from emqx_tpu.models.router_model import RouterModel
 from emqx_tpu.parallel.mesh import make_mesh
-from emqx_tpu.router.index import TrieIndex
+from emqx_tpu.router.index import ShardedTrieIndex, TrieIndex
 
 N_SLOTS = 64 * 32 * 2      # divisible by 32*tp for every tp in {1,2,4}
+
+TRIE_SHARDS = 4            # divisible by every tp extent in MESH_SHAPES
 
 # every 8-device (dp, tp) split: tp=1 (pure data parallel), the default
 # 4x2, and tp=4 (fan-out-heavy) — tp-sharding must stay a pure layout
@@ -134,6 +136,157 @@ def test_parity_single_vs_mesh_at_100k(single_model, sharded_models,
         assert len(r1[2][j]) >= 90, len(r1[2][j])
 
 
+# -- subscription-sharded trie (ISSUE 17): the fid space partitioned
+# over tp instead of replicating the whole trie per device ------------
+
+
+@pytest.fixture(scope="module")
+def sharded_trie_models():
+    """One populated SHARDED-trie model per mesh shape (S=4 trie shards
+    stacked over tp), cached across the parametrized matrix. The 110k
+    host build (subscribe loop + per-shard trie rebuild + pool build)
+    happens ONCE: later shapes share the same ShardedTrieIndex and
+    clone the first model's host-side sub-state, paying only their own
+    device upload + compile — a fresh build per shape would add ~30s
+    of pure host-side repetition to tier-1."""
+    cache: dict = {}
+
+    def get(shape):
+        if shape not in cache:
+            mesh = make_mesh(8, shape=shape)
+            if not cache:
+                model = RouterModel(
+                    ShardedTrieIndex(TRIE_SHARDS, max_levels=8),
+                    n_sub_slots=N_SLOTS, K=32, M=64, mesh=mesh)
+                _populate(model)
+            else:
+                proto = next(iter(cache.values()))
+                model = RouterModel(proto.index, n_sub_slots=N_SLOTS,
+                                    K=32, M=64, mesh=mesh)
+                model._subs = {f: dict(s)
+                               for f, s in proto._subs.items()}
+                model._aux_refs = dict(proto._aux_refs)
+                model._sub_mask = proto._sub_mask.copy()
+                model._aux_mask = proto._aux_mask.copy()
+                model._dense_row = dict(proto._dense_row)
+                model._next_row = proto._next_row
+                model._rowmap_host = proto._rowmap_host.copy()
+                model._pool_host = proto._pool_host.copy()
+                model.refresh()
+            assert len(model._dense_row) >= 8
+            cache[shape] = model
+        return cache[shape]
+
+    return get
+
+
+# publish results memo: the parity matrix computes every (layout,
+# shape, nbatch) result once; the layout-invariance test then compares
+# ACROSS shapes without re-running any of them
+_RESULTS: dict = {}
+
+
+def _memo_publish(key, model, topics):
+    if key not in _RESULTS:
+        _RESULTS[key] = model.publish_batch(topics)
+    return _RESULTS[key]
+
+
+@pytest.mark.parametrize("shape", MESH_SHAPES,
+                         ids=[f"dp{d}tp{t}" for d, t in MESH_SHAPES])
+@pytest.mark.parametrize("nbatch", [128, 77], ids=["aligned", "uneven"])
+def test_sharded_trie_parity_vs_single(single_model, sharded_trie_models,
+                                       shape, nbatch):
+    """The same 100k-filter set on the subscription-sharded trie must
+    route identically to the flat single-device oracle at every tp
+    split and batch geometry.  The sharded merge is shard-major, so
+    matched/aux lists are compared as sets — the CONTENT contract;
+    order stability across layouts is covered by
+    test_sharded_layout_invariant_across_meshes and the S=1 bit-exact
+    degeneracy below."""
+    sharded = sharded_trie_models(shape)
+    topics = _topics()[:nbatch]
+    r1 = _memo_publish(("single", nbatch), single_model, topics)
+    r2 = _memo_publish(("sharded", shape, nbatch), sharded, topics)
+    assert [sorted(x) for x in r1[0]] == [sorted(x) for x in r2[0]]
+    assert [sorted(x) for x in r1[1]] == [sorted(x) for x in r2[1]]
+    assert [sorted(s) for s in r1[2]] == [sorted(s) for s in r2[2]]
+    assert r1[3] == r2[3]
+    # the dense broadcast filters fan out at high degree on the
+    # sharded layout too (global fids feed the same rowmap/pool OR)
+    bcast_rows = [j for j, t in enumerate(topics)
+                  if t.startswith("broadcast/")]
+    assert bcast_rows
+    for j in bcast_rows:
+        assert len(r2[2][j]) >= 90, len(r2[2][j])
+
+
+@pytest.mark.parametrize("nbatch", [128, 77], ids=["aligned", "uneven"])
+def test_sharded_layout_invariant_across_meshes(sharded_trie_models,
+                                                nbatch):
+    """With the shard count FIXED (S=4), every (dp, tp) placement of
+    the stacked trie must return bit-identical results — which mesh
+    axis owns the shard slices is a layout choice, never semantic."""
+    topics = _topics()[:nbatch]
+    results = [_memo_publish(("sharded", s, nbatch),
+                             sharded_trie_models(s), topics)
+               for s in MESH_SHAPES]
+    for r in results[1:]:
+        assert r == results[0]
+
+
+def test_single_shard_degenerates_bit_identical():
+    """S=1 is today's flat layout, bit-for-bit: identity fid
+    translation, no-op second compact — matched order included. The
+    property is structural (fid interleaving with S=1 is the identity,
+    the second compact sees already-packed rows), so a compact filter
+    set proves it; the 110k-scale sharded path is covered by the S=4
+    fixtures above."""
+    flat = RouterModel(TrieIndex(max_levels=8),
+                       n_sub_slots=N_SLOTS, K=32, M=64)
+    model = RouterModel(ShardedTrieIndex(1, max_levels=8),
+                        n_sub_slots=N_SLOTS, K=32, M=64)
+    for m in (flat, model):
+        _populate(m, n=3_000, dense_fids=4, dense_degree=100)
+    topics = _topics()[:77]
+    r1 = flat.publish_batch(topics)
+    r2 = model.publish_batch(topics)
+    assert r1 == r2
+
+
+def test_sharded_incremental_stays_per_shard_patches(sharded_trie_models):
+    """Steady-state subscribe/unsubscribe on the sharded layout must
+    stay per-shard element patches: upload_count (full [S, ...] stack
+    re-uploads) may not grow, while the patch stream advances and the
+    new routes serve (ISSUE 17 acceptance)."""
+    model = sharded_trie_models((4, 2))
+    ups, pats = model.upload_count, model.patch_count
+    new = [(f"hotadd/dev{i}/+/m{i % 4}", (37 * i) % N_SLOTS)
+           for i in range(12)]
+    for f, s in new:
+        model.subscribe(f, s)
+    model.refresh()
+    assert model.upload_count == ups, "subscribe forced a full re-upload"
+    assert model.patch_count > pats
+    # pad probes to the 128-bucket the parity matrix already compiled —
+    # a 1-topic publish would otherwise compile a fresh B=64 program
+    pad = ["no/subscribers/here"] * 116
+    r = model.publish_batch(["hotadd/dev3/x/m3"] + pad[:127])
+    assert "hotadd/dev3/+/m3" in r[0][0]
+    # fids interleave shard-locally: every hot-added filter must decode
+    # back through the global namespace
+    assert sorted(model.publish_batch(
+        [f"hotadd/dev{i}/y/m{i % 4}" for i in range(12)] + pad)[2][:12],
+        key=len) != [[]] * 12
+    pats2 = model.patch_count
+    for f, s in new:
+        model.unsubscribe(f, s)
+    model.refresh()
+    assert model.upload_count == ups, "unsubscribe forced a full re-upload"
+    assert model.patch_count > pats2
+    assert model.publish_batch(["hotadd/dev3/x/m3"] + pad)[0][0] == []
+
+
 def test_full_stack_serving_on_mesh():
     """broker + pipeline + kernel on a 4x2 mesh, real MQTT clients over
     TCP: deliveries must come off mesh-sharded kernel launches."""
@@ -178,11 +331,14 @@ def test_full_stack_serving_on_mesh():
 
 
 @pytest.mark.parametrize("stage", ["submit", "collect"])
-def test_device_loss_fails_over_to_host(stage):
+@pytest.mark.parametrize("layout", ["replicated", "sharded"])
+def test_device_loss_fails_over_to_host(stage, layout):
     """Device loss mid-serving (VERDICT weak #7): when the mesh kernel
     dies — at launch or at collect — the broker serves the batch from
     the host oracle instead of dropping it, counts the failover, and
-    keeps delivering."""
+    keeps delivering.  Both trie layouts: the failover contract may not
+    depend on whether the dead kernel held a replicated or a
+    subscription-sharded trie."""
     import jax
 
     from emqx_tpu.app import BrokerApp
@@ -191,7 +347,9 @@ def test_device_loss_fails_over_to_host(stage):
 
     assert len(jax.devices()) >= 8
     mesh = make_mesh(8, shape=(4, 2))
-    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=N_SLOTS,
+    index = (ShardedTrieIndex(TRIE_SHARDS, max_levels=8)
+             if layout == "sharded" else TrieIndex(max_levels=8))
+    model = RouterModel(index, n_sub_slots=N_SLOTS,
                         K=32, M=64, mesh=mesh)
     app = BrokerApp(router_model=model)
     app.pipeline.min_device_batch = 0      # force the device path

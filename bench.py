@@ -394,6 +394,39 @@ def sec_kernel() -> None:
         f"{chain_ms:.2f} ms/update")
     put("kernel", inc_chain_ms=round(chain_ms, 2))
 
+    # -- kernel-plane telemetry percentiles (round 19) ----------------------
+    # drive the full submit→collect path with a DeviceMetricsFold
+    # attached so the artifact records the device-clock stage split
+    # (kernel_summary(), the same surface server.kernel_summary()
+    # serves) next to the loadgen-free step numbers above
+    from emqx_tpu.observe.device_metrics import DeviceMetricsFold
+    from emqx_tpu.observe.metrics import Metrics as _Metrics
+
+    fold = DeviceMetricsFold(_Metrics(), model=model)
+    hm, model._host_matcher = model._host_matcher, None
+    model.telemetry = fold
+    try:
+        tel_topics = make_topics(live, rng, 1024, n_vehicles)
+        for _ in range(10):
+            model.publish_batch_collect(
+                model.publish_batch_submit(tel_topics))
+    finally:
+        model.telemetry = None
+        model._host_matcher = hm
+    ks = fold.kernel_summary()
+    log(f"kernel telemetry stages us: "
+        + " ".join(f"{s}=p50:{v['p50_us']}/p99:{v['p99_us']}"
+                   for s, v in ks["stages"].items())
+        + f" counters={ks['counters']}")
+    put("kernel",
+        kernel_submit_p50_us=ks["stages"]["submit"]["p50_us"],
+        kernel_submit_p99_us=ks["stages"]["submit"]["p99_us"],
+        kernel_step_p50_us=ks["stages"]["step"]["p50_us"],
+        kernel_step_p99_us=ks["stages"]["step"]["p99_us"],
+        kernel_decode_p50_us=ks["stages"]["decode"]["p50_us"],
+        kernel_decode_p99_us=ks["stages"]["decode"]["p99_us"],
+        kernel_telemetry_batches=ks["batches"])
+
 
 # ---------------------------------------------------------------------------
 # section: tenm (BASELINE config 3 — 10M subscriptions)
@@ -1415,6 +1448,73 @@ def sec_ws() -> None:
 # section: observe_overhead (telemetry plane cost; CPU by design)
 # ---------------------------------------------------------------------------
 
+def _observe_overhead_kernel() -> None:
+    """Kernel-counters overhead pair (round 19): publish_batch
+    submit→collect throughput with in-kernel counters + the host fold
+    ON vs OFF. Same interleaved alternating-order best-of-N convention
+    as the native pairs — the two models differ ONLY by the
+    kernel_telemetry flag (the EMQX_TPU_KERNEL_TELEMETRY switch)."""
+    from emqx_tpu.models.router_model import RouterModel
+    from emqx_tpu.observe.device_metrics import DeviceMetricsFold
+    from emqx_tpu.observe.metrics import Metrics as _Metrics
+    from emqx_tpu.router.index import TrieIndex
+
+    n_filters = int(os.environ.get("BENCH_OBS_KERNEL_FILTERS", 20000))
+    B = int(os.environ.get("BENCH_OBS_KERNEL_BATCH", 2048))
+    n_batches = int(os.environ.get("BENCH_OBS_KERNEL_BATCHES", 20))
+    reps = int(os.environ.get("BENCH_OBS_REPS", 3))
+    rng = np.random.default_rng(7)
+    filters = build_filters(n_filters, rng)
+    n_vehicles = max(1000, n_filters // 2)
+
+    models = {}
+    for arm, flag in (("on", True), ("off", False)):
+        index = TrieIndex(max_levels=8)
+        model = RouterModel(index, n_sub_slots=64, K=32, M=128,
+                            kernel_telemetry=flag)
+        index.load(filters)
+        for fid in range(len(index.filters)):
+            if index.filters[fid] is not None:
+                model._subs.setdefault(fid, {})[fid % 64] = 1
+        model.refresh()
+        model._host_matcher = None    # force the device path on cpu
+        if flag:
+            model.telemetry = DeviceMetricsFold(_Metrics(), model=model)
+        models[arm] = model
+
+    live = [f for f in filters]
+    topic_sets = [make_topics(live, rng, B, n_vehicles)
+                  for _ in range(4)]
+    for model in models.values():      # compile off the clock
+        model.publish_batch_collect(
+            model.publish_batch_submit(topic_sets[0]))
+
+    best = {"on": 0.0, "off": 0.0}
+    for rep in range(reps):
+        arms = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in arms:
+            model = models[arm]
+            t0 = time.time()
+            for i in range(n_batches):
+                model.publish_batch_collect(
+                    model.publish_batch_submit(
+                        topic_sets[i % len(topic_sets)]))
+            rate = n_batches * B / (time.time() - t0)
+            best[arm] = max(best[arm], rate)
+            log(f"observe_overhead rep{rep} kernel_counters={arm}: "
+                f"{rate:,.0f} topics/s")
+    overhead = 1.0 - best["on"] / max(best["off"], 1e-9)
+    log(f"observe_overhead kernel counters: on={best['on']:,.0f} "
+        f"off={best['off']:,.0f} topics/s  "
+        f"overhead={overhead * 100:.2f}% "
+        f"({'within' if overhead < 0.02 else 'OVER'} the 2% budget)")
+    put("observe_overhead",
+        kernel_counters_on_topics_per_sec=round(best["on"]),
+        kernel_counters_off_topics_per_sec=round(best["off"]),
+        kernel_counters_overhead_frac=round(overhead, 4),
+        kernel_counters_within_2pct_budget=bool(overhead < 0.02))
+
+
 def sec_observe_overhead() -> None:
     """ISSUE 3 acceptance: the native telemetry plane (histograms +
     flight recorders + kind-8 export) must cost < 2% QoS0 native-TCP
@@ -1426,7 +1526,16 @@ def sec_observe_overhead() -> None:
     ISSUE 8 acceptance: a second interleaved pair on the 2-SHARD qos0
     fan-out measures the distributed-tracing sampler — sampled tracing
     ON (1-in-64, the production default) vs OFF must also land within
-    the 2% budget."""
+    the 2% budget.
+
+    ISSUE 19 acceptance: a third interleaved pair on the DEVICE router
+    path measures the in-kernel counters + host fold
+    (kernel_telemetry=True with a DeviceMetricsFold attached vs False)
+    — the counters ride the existing collect device_get, so they must
+    also land within the 2% budget. Model-plane only: runs even when
+    the native host is unavailable."""
+    _observe_overhead_kernel()
+
     from emqx_tpu import native
 
     if not native.available():

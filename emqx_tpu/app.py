@@ -113,9 +113,29 @@ class BrokerApp:
         if self.broker.model is not None:
             from emqx_tpu.broker.pipeline import PublishPipeline
             self.pipeline = PublishPipeline(self.broker, self.cm)
+        # kernel-plane observability fold (round 19): device counters +
+        # stage timings from the router model's collect seam land in
+        # the shared Metrics/ledger/span surfaces; attached only when
+        # the model computes counters (EMQX_TPU_KERNEL_TELEMETRY=0
+        # leaves the model's telemetry hook unset — zero fold cost)
+        self.device_metrics = None
+        if (self.broker.model is not None
+                and getattr(self.broker.model, "kernel_telemetry", False)):
+            from emqx_tpu.observe.device_metrics import DeviceMetricsFold
+            from emqx_tpu.observe.trace import SpanCollector
+            self.device_metrics = DeviceMetricsFold(
+                self.metrics, ledger=self.ledger, spans=SpanCollector(),
+                model=self.broker.model, node=node)
+            self.broker.model.telemetry = self.device_metrics
+            # the kernel fold's sampled traces serve the tracing-spans
+            # mgmt surface when no native server attaches (a booted
+            # native server overrides this with its own richer ring)
+            if self.native_spans_fn is None:
+                self.native_spans_fn = self.device_metrics.spans_recent
         self.sys = SysHeartbeat(
             node=node, publish_fn=self._publish_dispatch,
             metrics=self.metrics, stats=self.stats, ledger=self.ledger,
+            kernel=self.device_metrics,
         )
         self.retainer = Retainer(
             max_retained=max_retained, default_expiry_ms=retained_expiry_ms
@@ -276,11 +296,27 @@ class BrokerApp:
                 store = self.native_store_stats_fn()
             except Exception:  # noqa: BLE001 — same containment
                 store = None
+        kern = None
+        if self.device_metrics is not None:
+            try:
+                kern = self.device_metrics.gauges()
+            except Exception:  # noqa: BLE001 — same containment
+                kern = None
         return prometheus.render(self.metrics, self.stats,
                                  node=self.broker.node, native=native,
                                  native_shards=shards,
-                                 native_store=store,
+                                 native_store=store, kernel=kern,
                                  openmetrics=openmetrics)
+
+    def kernel_summary(self) -> dict:
+        """Device-router stage percentiles + counter totals + trie
+        health — the bench/server convenience surface; {} when no
+        device model (or kernel telemetry disabled)."""
+        if self.device_metrics is None:
+            return {}
+        out = self.device_metrics.kernel_summary()
+        out["gauges"] = self.device_metrics.gauges()
+        return out
 
     @classmethod
     def from_config(cls, conf, node: str = None, **overrides) -> "BrokerApp":

@@ -424,6 +424,15 @@ class Broker:
                     self.rules_matched_fn(m, None)
                 out[i] = self._route(m.topic, m)
             return out
+        if isinstance(pending, tuple) and len(pending) == 2 \
+                and pending[0] == "host":
+            # cpu host-matcher served this batch instead of the kernel:
+            # count it in its fixed slot and on the degradation ledger,
+            # next to device_failover — same seam, softer reason
+            self._inc("messages.kernel.hostmatch")
+            if self.ledger is not None:
+                self.ledger.record("kernel_hostmatch", 1,
+                                   detail="cpu host dispatch")
         try:
             matched, aux, slots, fallback = self.model.publish_batch_collect(
                 pending)
@@ -439,6 +448,17 @@ class Broker:
                 out[i] = self._route(m.topic, m)
             return out
         fb = set(fallback)
+        if fb:
+            # rows the kernel punted (frontier/candidate overflow or
+            # too-long topic) re-route on the host oracle below; record
+            # the degradation with its row count so an operator sees
+            # capacity pressure before it becomes a failover
+            if self.ledger is not None:
+                self.ledger.record(
+                    "kernel_overflow", len(fb),
+                    detail="device overflow; host-oracle fallback")
+            else:
+                self._inc("messages.ledger.kernel_overflow", len(fb))
         batch_legs: list = []    # (out index, msg, group, route topic)
         for j, (i, m) in enumerate(live):
             self._inc("messages.publish")

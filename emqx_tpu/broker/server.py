@@ -270,6 +270,15 @@ class BrokerServer:
             return LimiterContainer()
         return self.limiter.make_container(self.listener_id)
 
+    def kernel_summary(self) -> dict:
+        """Device-router stage percentiles + counters + trie health
+        (the bench harness reads this after a run); {} when the app
+        has no kernel telemetry attached."""
+        if self.app is None:
+            return {}
+        fn = getattr(self.app, "kernel_summary", None)
+        return fn() if callable(fn) else {}
+
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         if len(self.connections) >= self.max_connections:

@@ -239,6 +239,9 @@ class ManagementApi:
         # span ring + the degradation ledger's event ring/totals
         r("GET", "/api/v5/tracing/spans", self.h_tracing_spans)
         r("GET", "/api/v5/tracing/ledger", self.h_tracing_ledger)
+        # kernel-plane observability (round 19): trie-health snapshot
+        # from the device-metrics fold (counters + gauges + stages)
+        r("GET", "/api/v5/kernel/stats", self.h_kernel_stats)
         r("GET", "/api/v5/slow_subscriptions", self.h_slow_subs)
         r("DELETE", "/api/v5/slow_subscriptions", self.h_slow_subs_clear)
         r("GET", "/api/v5/mqtt/topic_metrics", self.h_topic_metrics)
@@ -578,6 +581,16 @@ class ManagementApi:
             limit = 32
         return fn(max(1, limit))   # a negative slice would invert
         #                            the newest-N semantics
+
+    def h_kernel_stats(self, query, body):
+        """Trie-health + device-counter snapshot from the kernel-plane
+        fold; 404 when the app runs without a device router (or with
+        EMQX_TPU_KERNEL_TELEMETRY=0)."""
+        dm = getattr(self.app, "device_metrics", None)
+        if dm is None:
+            raise ApiError(404, "NOT_FOUND",
+                           "kernel telemetry not attached")
+        return dm.snapshot()
 
     def h_tracing_ledger(self, query, body):
         """Degradation-ledger totals + the bounded structured event

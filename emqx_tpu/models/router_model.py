@@ -30,6 +30,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from typing import Optional, Sequence, Union
 
 import jax
@@ -56,12 +57,14 @@ def router_step(
     max_probes: int = 8,
     ret_cap: Optional[int] = None,
     shardings: Optional[dict[str, NamedSharding]] = None,
+    with_counters: bool = False,
 ):
     """The full publish-batch routing step (pure, jittable).
 
     Returns (fids [B, ret_cap or M], fanout [B, W], overflow [B],
-    fan_any []); fanout covers the dense-pool (high-degree) filters,
-    low-degree slots decode host-side from the subscription dict.
+    fan_any [], counters); fanout covers the dense-pool (high-degree)
+    filters, low-degree slots decode host-side from the subscription
+    dict.
 
     ``ret_cap`` trims the RETURNED fid columns: device→host transfer is
     the serving path's dominant cost (a tunneled TPU pays ~90 ms/RTT and
@@ -71,11 +74,31 @@ def router_step(
     correctness never depends on the trim. ``fan_any`` (scalar) lets the
     host skip fetching the [B, W] fanout block entirely when no
     dense-pool row matched (the common case below the dense threshold).
+
+    ``with_counters`` adds the kernel-plane counters vector (ISSUE 18):
+    a [C] int32 pack in tm.KERNEL_COUNTER_FIELDS order, computed by the
+    same program with elementwise reductions and fetched in the SAME
+    publish_batch_collect device_get — no extra sync. ``counters`` is
+    None when disabled (a dropped pytree leaf, so callers unpack a
+    5-tuple either way). The ret_cap trim's spill is NOT a counter — it
+    rides ``overflow`` into the broker's fallback/ledger seam.
     """
-    cand, overflow = tm.match_batch(
+    cand, overflow, mstats = tm.match_batch(
         trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
     )
     fids, truncated = tm.compact_fids(cand, M=M)
+    counters = None
+    if with_counters:
+        occ = jnp.sum((fids >= 0).astype(jnp.int32), axis=1)   # [B]
+        counters = tm.pack_counters(
+            frontier_peak=mstats["frontier_peak"],
+            probe_iters=mstats["probe_iters"],
+            cand_pre=mstats["cand_pre"],
+            cand_post=jnp.sum(occ),
+            compact_peak=jnp.max(occ),
+            overflow_rows=mstats["overflow_rows"],
+            trunc_rows=jnp.sum(truncated.astype(jnp.int32)),
+        )
     if shardings is not None:
         # reshard the compacted fids to dp-only before the tp-sharded OR
         fids = jax.lax.with_sharding_constraint(fids, shardings["batch_dp"])
@@ -87,7 +110,7 @@ def router_step(
     if ret_cap is not None and ret_cap < M:
         overflow = overflow | (jnp.sum(fids >= 0, axis=1) > ret_cap)
         fids = fids[:, :ret_cap]
-    return fids, out, overflow, fan_any
+    return fids, out, overflow, fan_any, counters
 
 
 def router_step_sharded(
@@ -104,6 +127,7 @@ def router_step_sharded(
     max_probes: int = 8,
     ret_cap: Optional[int] = None,
     shardings: Optional[dict[str, NamedSharding]] = None,
+    with_counters: bool = False,
 ):
     """The routing step over a subscription-sharded trie.
 
@@ -120,12 +144,31 @@ def router_step_sharded(
 
     n_shards=1 degenerates bit-identically to ``router_step`` on the
     flat trie (identity fid translation, no-op second compact).
+
+    ``with_counters`` packs a PER-SHARD [S, C] counters block (tm.
+    KERNEL_COUNTER_FIELDS order): match-side fields come per shard from
+    the vmapped walk, compact-side fields from each shard's own M
+    compact (pre-merge — the shard-skew signal).  The merged second
+    compact's spill rides ``overflow`` to the broker fallback seam, not
+    the counters.
     """
-    cand, overflow = tm.match_batch_sharded(
+    cand, overflow, mstats = tm.match_batch_sharded(
         trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
     )
     S, B, _ = cand.shape
     per, trunc = jax.vmap(lambda c: tm.compact_fids(c, M=M))(cand)
+    counters = None
+    if with_counters:
+        occ = jnp.sum((per >= 0).astype(jnp.int32), axis=2)    # [S, B]
+        counters = tm.pack_counters(
+            frontier_peak=mstats["frontier_peak"],
+            probe_iters=mstats["probe_iters"],
+            cand_pre=mstats["cand_pre"],
+            cand_post=jnp.sum(occ, axis=1),
+            compact_peak=jnp.max(occ, axis=1),
+            overflow_rows=mstats["overflow_rows"],
+            trunc_rows=jnp.sum(trunc.astype(jnp.int32), axis=1),
+        )
     shard_ids = jnp.arange(S, dtype=per.dtype)[:, None, None]
     per = jnp.where(per >= 0, per * n_shards + shard_ids, -1)
     merged = jnp.moveaxis(per, 0, 1).reshape(B, S * M)
@@ -143,7 +186,7 @@ def router_step_sharded(
     if ret_cap is not None and ret_cap < M:
         overflow = overflow | (jnp.sum(fids >= 0, axis=1) > ret_cap)
         fids = fids[:, :ret_cap]
-    return fids, out, overflow, fan_any
+    return fids, out, overflow, fan_any, counters
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -282,6 +325,7 @@ class RouterModel:
         dense_threshold: int = 64,
         mesh: Optional[Mesh] = None,
         trie_shards: Optional[int] = None,
+        kernel_telemetry: Optional[bool] = None,
     ) -> None:
         if index is None:
             index = (ShardedTrieIndex(trie_shards) if trie_shards
@@ -347,6 +391,19 @@ class RouterModel:
         self.patch_count = 0       # incremental scatter flushes
         self.launch_count = 0      # publish_batch kernel launches
         self.host_match_count = 0  # batches served by the host matcher
+        # kernel-plane observability (ISSUE 18): with_counters bakes the
+        # [*, C] counters pack into the step so it rides the SAME
+        # collect-time device_get; EMQX_TPU_KERNEL_TELEMETRY=0 is the
+        # escape hatch (compiles the counters out entirely)
+        if kernel_telemetry is None:
+            kernel_telemetry = os.environ.get(
+                "EMQX_TPU_KERNEL_TELEMETRY", "1"
+            ).lower() not in ("0", "off", "false")
+        self.kernel_telemetry = bool(kernel_telemetry)
+        # DeviceMetricsFold attach point (observe/device_metrics.py);
+        # the model never imports the observe plane — the app wires it
+        self.telemetry = None
+        self.patch_upload_bytes = 0   # unpadded dirty bytes scattered
         if self._sharded:
             step_fn = functools.partial(
                 router_step_sharded, n_shards=self.n_shards)
@@ -360,6 +417,7 @@ class RouterModel:
                 ret_cap=self.ret_cap,
                 max_probes=self.index.max_probes,
                 shardings=self.shardings,
+                with_counters=self.kernel_telemetry,
             )
         )
         # platform-aware dispatch: on a cpu backend the XLA kernel is a
@@ -617,6 +675,14 @@ class RouterModel:
         rm_dirty = [] if full_pool else sorted(self._rowmap_dirty)
         pool_dirty = [] if full_pool else sorted(self._pool_dirty)
         if updates or rm_dirty or pool_dirty:
+            # patch-upload accounting (UNPADDED dirty counts — the pad
+            # repeats a no-op write): each trie element scatters an
+            # (index, value) int32 pair, +4 B for the shard index on the
+            # stacked layout; pool writes carry (row, col, val)
+            n_elems = sum(len(v) for v in updates.values())
+            self.patch_upload_bytes += (
+                n_elems * (12 if self._sharded else 8)
+                + len(rm_dirty) * 8 + len(pool_dirty) * 12)
             cap = _patch_bucket(max(
                 max((len(v) for v in updates.values()), default=0),
                 len(rm_dirty), len(pool_dirty)))
@@ -702,6 +768,7 @@ class RouterModel:
             # the "pending" handle is the finished result, so the
             # pipeline's submit/collect overlap degenerates harmlessly
             return ("host", self._publish_batch_host(topics))
+        t0 = time.monotonic_ns()
         with self._mlock:
             if self._dirty or self._trie_dev is None:
                 self._refresh_locked()
@@ -728,21 +795,25 @@ class RouterModel:
                 # the full dp×tp batch split
                 key = "batch_dp" if self._sharded else "batch_full"
                 args = jax.device_put(args, self.shardings[key])
-            fids, fanout, overflow, fan_any = self._step(
+            fids, fanout, overflow, fan_any, counters = self._step(
                 self._trie_dev, self._rowmap_dev, self._pool_dev, *args
             )
             # freed fids stay quarantined until this batch is decoded —
             # a reused fid would decode as the WRONG (new) filter
             self.index.begin_inflight()
+            # (t0, t1) stamps the submit stage (tokenize + dispatch) for
+            # the telemetry fold; the dispatch is async, so t1 is NOT a
+            # device sync point
             return (list(topics), too_long, fids, fanout, overflow,
-                    fan_any)
+                    fan_any, counters, (t0, time.monotonic_ns()))
 
     def publish_batch_collect(self, pending):
         """Stage 2: fetch + decode a submitted batch's results."""
         if isinstance(pending, tuple) and len(pending) == 2 \
                 and pending[0] == "host":
             return pending[1]
-        topics, too_long, fids, fanout, overflow, fan_any = pending
+        (topics, too_long, fids, fanout, overflow, fan_any, counters,
+         (t0, t1)) = pending
         try:
             # ONE device_get for all needed outputs: it issues
             # copy_to_host_async for every array before materializing,
@@ -752,20 +823,39 @@ class RouterModel:
             # dominated the e2e broker latency. The [B, W] fanout block
             # starts its copy speculatively so the fan_any=True case
             # (dense rows matched) costs no SECOND dependent round trip;
-            # it only materializes when needed.
+            # it only materializes when needed. The kernel counters
+            # (when enabled) join the SAME device_get — telemetry costs
+            # no extra sync.
             try:
                 fanout.copy_to_host_async()
             except AttributeError:     # non-jax array (tests/mocks)
                 pass
-            fids, overflow, fan_any = jax.device_get(
-                (fids, overflow, fan_any))
+            t2 = time.monotonic_ns()
+            if counters is not None:
+                fids, overflow, fan_any, counters = jax.device_get(
+                    (fids, overflow, fan_any, counters))
+            else:
+                fids, overflow, fan_any = jax.device_get(
+                    (fids, overflow, fan_any))
+            t3 = time.monotonic_ns()
             if fan_any:
                 fan = np.asarray(fanout)
             else:
                 fan = np.zeros(fanout.shape, np.uint32)
             with self._mlock:
-                return self._decode_locked(topics, too_long, fids, fan,
-                                           overflow)
+                res = self._decode_locked(topics, too_long, fids, fan,
+                                          overflow)
+            tel = self.telemetry
+            if tel is not None:
+                try:   # telemetry must never break the serving path
+                    tel.on_batch(
+                        counters, n_topics=len(topics),
+                        submit_ns=t1 - t0, step_ns=t3 - t2,
+                        decode_ns=time.monotonic_ns() - t3,
+                        t_submit_ns=t0, t_collect_ns=t3)
+                except Exception:  # noqa: BLE001 — observe-plane bug
+                    pass
+            return res
         finally:
             with self._mlock:
                 self.index.end_inflight()
@@ -782,6 +872,12 @@ class RouterModel:
         """
         with self._mlock:
             self.host_match_count += 1
+            tel = self.telemetry
+            if tel is not None:
+                try:
+                    tel.on_host_batch(len(topics))
+                except Exception:  # noqa: BLE001 — observe-plane bug
+                    pass
             filters = self.index.filters
             any_aux = bool(self._aux_refs)
             matched: list[list[str]] = []

@@ -671,9 +671,15 @@ SPAN_STAGES = ("ingress", "route", "ring_cross", "trunk_flush",
 # the SAME ledger as organic degradation (aux = the fault-site index).
 # "accept_shed" (round 16) is the accept-storm rung: admission denied
 # in the accept loop before any conn side effect (conn-scale plane).
+# "kernel_overflow" / "kernel_hostmatch" (ISSUE 18) are the device
+# router's degradation legs — K/M/ret_cap spill falling back to the
+# host oracle, and a whole batch served by the cpu host-matcher —
+# folded by broker/broker.py at the publish_batch_collect seam.
+# Python-plane, so they append at the END (the C++ enum stays a prefix).
 LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
                   "accept_shed", "coap_giveup",
-                  "device_failover", "store_degraded")
+                  "device_failover", "store_degraded",
+                  "kernel_overflow", "kernel_hostmatch")
 
 # ---------------------------------------------------------------------------
 # faultline (round 15): deterministic fault injection (fault.h)

@@ -129,8 +129,25 @@ AUTHZ = ["authorization.allow", "authorization.deny",
 OLP = ["olp.delay.ok", "olp.delay.timeout", "olp.hbn", "olp.gc",
        "olp.new_conn"]
 
+# kernel plane (ISSUE 18): device-router observability. Fixed slots so
+# every counter renders at zero in prometheus and rides the $SYS metrics
+# heartbeat before the first batch. messages.kernel.hostmatch counts
+# batches the cpu host-matcher served (RouterModel.host_match_count,
+# promoted from an ad-hoc attribute); kernel.uploads/upload_patches
+# mirror the full-upload and incremental-scatter counts the same way.
+# The two messages.ledger.* slots back the kernel_overflow /
+# kernel_hostmatch degradation reasons (appended at the END of
+# LEDGER_REASONS — Python-plane reasons, so the C++ enum stays a prefix).
+KERNEL = [
+    "messages.kernel.hostmatch",
+    "kernel.uploads", "kernel.upload_patches",
+    "messages.ledger.kernel_overflow",
+    "messages.ledger.kernel_hostmatch",
+]
+
 ALL_NAMES: list[str] = (BYTES + PACKETS + MESSAGES + DELIVERY + NATIVE
-                        + FAULTS + CLIENT + SESSION + AUTHZ + OLP)
+                        + FAULTS + CLIENT + SESSION + AUTHZ + OLP
+                        + KERNEL)
 
 
 # ---------------------------------------------------------------------------
@@ -254,10 +271,14 @@ class LatencyHistogram:
 
 # canonical reason set — must match native/__init__.py LEDGER_REASONS
 # (test_stats_lint pins the pair; the C++ LedgerReason enum is a prefix:
-# "fault" is a faultline injection firing, round 15)
+# "fault" is a faultline injection firing, round 15). kernel_overflow /
+# kernel_hostmatch (ISSUE 18) are Python-plane reasons folded at the
+# broker's publish_batch_collect seam — appended at the END so the C++
+# prefix is preserved.
 LEDGER_REASONS = ("ring_full", "trunk_punt", "shed", "fault",
                   "accept_shed", "coap_giveup",
-                  "device_failover", "store_degraded")
+                  "device_failover", "store_degraded",
+                  "kernel_overflow", "kernel_hostmatch")
 
 
 class DegradationLedger:

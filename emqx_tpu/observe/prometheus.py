@@ -28,7 +28,7 @@ import re
 import time
 from typing import Optional
 
-_SHARD_HIST_RE = re.compile(r"^latency\.native\.shard(\d+)\.(.+)$")
+_SHARD_HIST_RE = re.compile(r"^latency\.(native|kernel)\.shard(\d+)\.(.+)$")
 
 
 def _san(name: str) -> str:
@@ -52,9 +52,10 @@ def _render_hists(lines: list[str], hists: dict, node: str,
     for name, h in hists.items():
         m = _SHARD_HIST_RE.match(name)
         if m:
-            base = _san(f"latency.native.{m.group(2)}") + "_seconds"
-            label = f'{{node="{node}",shard="{m.group(1)}"}}'
-            bucket_label = f'node="{node}",shard="{m.group(1)}"'
+            base = _san(
+                f"latency.{m.group(1)}.{m.group(3)}") + "_seconds"
+            label = f'{{node="{node}",shard="{m.group(2)}"}}'
+            bucket_label = f'node="{node}",shard="{m.group(2)}"'
         else:
             base = _san(name) + "_seconds"
             label = f'{{node="{node}"}}'
@@ -103,6 +104,7 @@ def render(metrics=None, stats=None, extra: Optional[dict] = None,
            node: str = "emqx_tpu", native: Optional[dict] = None,
            native_shards: Optional[list] = None,
            native_store: Optional[dict] = None,
+           kernel: Optional[dict] = None,
            openmetrics: bool = False) -> str:
     lines: list[str] = []
     label = f'{{node="{node}"}}'
@@ -149,6 +151,19 @@ def render(metrics=None, stats=None, extra: Optional[dict] = None,
                     lines.append(f"# TYPE {mn} gauge")
                     typed_native.add(mn)
                 lines.append(f'{mn}{{node="{node}",shard="{i}"}} {val}')
+    if kernel:
+        # the TPU router's trie-health gauges (DeviceMetricsFold
+        # .gauges()): list-valued entries are per-shard and render one
+        # shard-labelled series each, scalars render plain
+        for name, val in sorted(kernel.items()):
+            mn = "emqx_kernel_" + name.replace(".", "_")
+            lines.append(f"# TYPE {mn} gauge")
+            if isinstance(val, (list, tuple)):
+                for i, v in enumerate(val):
+                    lines.append(
+                        f'{mn}{{node="{node}",shard="{i}"}} {v}')
+            else:
+                lines.append(f"{mn}{label} {val}")
     # VM slice (the reference exports erlang_vm_*; we export process RSS)
     try:
         with open(f"/proc/{os.getpid()}/statm") as f:

@@ -20,13 +20,14 @@ SYSDESCR = "emqx_tpu broker"
 
 class SysHeartbeat:
     def __init__(self, node: str, publish_fn: Callable[[Message], None],
-                 metrics=None, stats=None, ledger=None,
+                 metrics=None, stats=None, ledger=None, kernel=None,
                  heartbeat_s: float = 30.0, tick_s: float = 60.0) -> None:
         self.node = node
         self.publish_fn = publish_fn
         self.metrics = metrics
         self.stats = stats
         self.ledger = ledger    # DegradationLedger (round 13), optional
+        self.kernel = kernel    # DeviceMetricsFold (round 19), optional
         self.heartbeat_s = heartbeat_s
         self.tick_s = tick_s
         self.started_at = time.time()
@@ -100,6 +101,22 @@ class SysHeartbeat:
 
             self._pub("ledger/last", json.dumps(recent[-1]))
 
+    def publish_kernel(self) -> None:
+        """Kernel-plane heartbeat (round 19):
+        ``$SYS/brokers/<node>/kernel/<stage>/p50|p99`` in ms plus
+        ``.../count`` for every device-path stage histogram
+        (submit/step/decode). Unlike publish_latency this publishes
+        UNCONDITIONALLY — a kernel stage that never observed anything
+        is itself a signal (the device plane is dark), so the fixed
+        stage set renders at zero."""
+        if self.kernel is None:
+            return
+        for stage, h in self.kernel.stage_hists().items():
+            for q, v in (("p50", h.percentile(0.5)),
+                         ("p99", h.percentile(0.99))):
+                self._pub(f"kernel/{stage}/{q}", f"{v / 1e6:.3f}")
+            self._pub(f"kernel/{stage}/count", str(int(h.count)))
+
     def tick(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         if now - self._last_heartbeat >= self.heartbeat_s:
@@ -111,3 +128,4 @@ class SysHeartbeat:
             self.publish_metrics()
             self.publish_latency()
             self.publish_ledger()
+            self.publish_kernel()

@@ -51,6 +51,42 @@ from emqx_tpu.router.index import HASH_ID, PAD, TrieIndexArrays
 _MIX_A = 0x9E3779B1
 _MIX_B = 0x85EBCA77
 
+# kernel-plane observability (ISSUE 18): the per-batch counters vector's
+# field order, declared ONCE here — observe/device_metrics.py carries a
+# literal copy the counters-layout lint (tests/test_kernel_counters_lint
+# .py) holds in parity, so the in-kernel packer and the host decoder
+# cannot drift. Flat layout packs to [C]; the sharded step packs [S, C]
+# (one row per trie shard). All int32, computed alongside the match with
+# elementwise reductions only — no extra device sync, no data-dependent
+# shapes.
+KERNEL_COUNTER_FIELDS = (
+    "frontier_peak",   # max per-topic frontier occupancy over all steps (≤K)
+    "probe_iters",     # total live edge-hash probe-loop iterations
+    "cand_pre",        # valid candidate fids before the M compact
+    "cand_post",       # candidate fids surviving the M compact
+    "compact_peak",    # max per-topic compact-slot occupancy (M utilization)
+    "overflow_rows",   # topics whose K frontier spilled (incomplete match)
+    "trunc_rows",      # topics truncated by the M compact
+)
+
+
+def pack_counters(**fields) -> jax.Array:
+    """Stack the named counter values in KERNEL_COUNTER_FIELDS order.
+
+    Scalars pack to ``[C]``; per-shard ``[S]`` vectors pack to
+    ``[S, C]``.  Keyword-only so a caller can never silently permute
+    the layout — order lives in one place.
+    """
+    if set(fields) != set(KERNEL_COUNTER_FIELDS):
+        missing = set(KERNEL_COUNTER_FIELDS) - set(fields)
+        extra = set(fields) - set(KERNEL_COUNTER_FIELDS)
+        raise TypeError(
+            f"pack_counters field mismatch: missing={sorted(missing)} "
+            f"extra={sorted(extra)}")
+    vals = [jnp.asarray(fields[n], jnp.int32)
+            for n in KERNEL_COUNTER_FIELDS]
+    return jnp.stack(jnp.broadcast_arrays(*vals), axis=-1)
+
 
 class DeviceTrie(NamedTuple):
     """TrieIndexArrays uploaded to device (a jit-friendly pytree)."""
@@ -131,10 +167,14 @@ def _edge_step(parent: jax.Array, word: jax.Array, mask: int) -> jax.Array:
 
 def _probe_exact(
     trie: DeviceTrie, parent: jax.Array, word: jax.Array, max_probes: int
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     """Exact-edge lookup for [B, K] (parent, word) pairs; -1 on miss.
 
     The probe bound is builder-verified, so the loop unrolls statically.
+    Returns ``(child, iters)`` — iters counts live probe rounds per lane
+    (the hash-table health signal: mean ≈ 1 on a well-sized table); the
+    count is an elementwise add per unrolled round, DCE'd by XLA when
+    the counters output goes unused.
     """
     hmask = trie.ht_parent.shape[0] - 1
     # hash the raw parent (-1 included): indices stay in-bounds via the
@@ -144,14 +184,16 @@ def _probe_exact(
     h = _edge_hash(parent, word, hmask)
     step = _edge_step(parent, word, hmask)
     child = jnp.full_like(parent, -1)
+    iters = jnp.zeros(parent.shape, jnp.int32)
     done = parent < 0
     for p in range(max_probes):
+        iters = iters + (~done).astype(jnp.int32)
         s = (h + p * step) & hmask
         slot_parent = _g(trie.ht_parent[s])
         hit = (slot_parent == parent) & (_g(trie.ht_word[s]) == word) & ~done
         child = jnp.where(hit, _g(trie.ht_child[s]), child)
         done = done | hit | (slot_parent == -1)
-    return child
+    return child, iters
 
 
 def _pack_frontier(cand: jax.Array, K: int) -> tuple[jax.Array, jax.Array]:
@@ -177,13 +219,19 @@ def match_batch(
     *,
     K: int = 32,
     max_probes: int = 8,
-) -> tuple[jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, dict]:
     """Match a topic batch against the trie.
 
-    Returns ``(cand_fids [B, (L+1)*2K] int32, overflow [B] bool)``.
-    ``cand_fids`` holds each matched filter id exactly once, -1 elsewhere.
-    ``overflow[b]`` means topic *b*'s frontier exceeded K and the result
-    may be incomplete — route it through the host oracle.
+    Returns ``(cand_fids [B, (L+1)*2K] int32, overflow [B] bool,
+    mstats)``.  ``cand_fids`` holds each matched filter id exactly once,
+    -1 elsewhere.  ``overflow[b]`` means topic *b*'s frontier exceeded K
+    and the result may be incomplete — route it through the host oracle.
+    ``mstats`` is the match half of the kernel counters (scalar int32
+    leaves: frontier_peak / probe_iters / cand_pre / overflow_rows —
+    see KERNEL_COUNTER_FIELDS); the compact-side fields are the step
+    functions' (router_model) to fill.  The reductions are elementwise
+    and ride the same program — XLA DCEs them when the caller drops the
+    dict.
     """
     B, L = tokens.shape
     tokens_ext = jnp.concatenate(
@@ -192,11 +240,15 @@ def match_batch(
 
     frontier0 = jnp.full((B, K), -1, jnp.int32).at[:, 0].set(0)  # root
     overflow0 = jnp.zeros((B,), bool)
+    peak0 = jnp.zeros((), jnp.int32)
+    probes0 = jnp.zeros((), jnp.int32)
 
     def step(carry, xs):
-        frontier, overflow = carry
+        frontier, overflow, peak, probes = carry
         i, tok = xs                               # i scalar, tok [B]
         valid = frontier >= 0
+        peak = jnp.maximum(
+            peak, jnp.max(jnp.sum(valid.astype(jnp.int32), axis=1)))
         node = jnp.where(valid, frontier, 0)
         active = (i <= lengths)[:, None]          # may still emit '#'
         ended = (i == lengths)[:, None]
@@ -209,20 +261,21 @@ def match_batch(
         end_em = jnp.where(valid & ended, _g(trie.node_fid[node]), -1)
 
         wordk = jnp.broadcast_to(tok[:, None], (B, K))
-        exact = _probe_exact(
+        exact, iters = _probe_exact(
             trie, jnp.where(advancing, frontier, -1), wordk, max_probes
         )
+        probes = probes + jnp.sum(iters)
         plus = jnp.where(
             valid & advancing & ~sys_block, _g(trie.plus_child[node]), -1
         )
         nxt, over = _pack_frontier(
             jnp.concatenate([exact, plus], axis=1), K
         )
-        return (nxt, overflow | over), (hash_em, end_em)
+        return (nxt, overflow | over, peak, probes), (hash_em, end_em)
 
-    (_, overflow), (hash_ems, end_ems) = jax.lax.scan(
+    (_, overflow, peak, probes), (hash_ems, end_ems) = jax.lax.scan(
         step,
-        (frontier0, overflow0),
+        (frontier0, overflow0, peak0, probes0),
         (jnp.arange(L + 1), tokens_ext.T),
     )
     # [L+1, B, K] → [B, (L+1)*K] each → concat
@@ -233,7 +286,13 @@ def match_batch(
         ],
         axis=1,
     )
-    return cand, overflow
+    mstats = {
+        "frontier_peak": peak,
+        "probe_iters": probes,
+        "cand_pre": jnp.sum((cand >= 0).astype(jnp.int32)),
+        "overflow_rows": jnp.sum(overflow.astype(jnp.int32)),
+    }
+    return cand, overflow, mstats
 
 
 @functools.partial(jax.jit, static_argnames=("K", "max_probes"))
@@ -248,7 +307,7 @@ def match_counts(
 ) -> tuple[jax.Array, jax.Array]:
     """Matched-filter count per topic (the emqx_broker_bench LookupRps
     analogue — the full match with only the reduction materialized)."""
-    cand, overflow = match_batch(
+    cand, overflow, _ = match_batch(
         trie, tokens, lengths, sys_flags, K=K, max_probes=max_probes
     )
     return jnp.sum(cand >= 0, axis=1), overflow
@@ -313,7 +372,7 @@ def match_batch_sharded(
     *,
     K: int = 32,
     max_probes: int = 8,
-) -> tuple[jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, dict]:
     """match_batch vmapped over the shard axis of a stacked trie.
 
     Each shard walks the SAME (tp-replicated) topic batch against its
@@ -324,14 +383,17 @@ def match_batch_sharded(
     flags are OR-reduced because any spilled shard makes the merged
     result potentially incomplete for that topic.
 
-    Returns ``(cand [S, B, (L+1)*2K], overflow [B])``.
+    Returns ``(cand [S, B, (L+1)*2K], overflow [B], mstats)``; the
+    vmap turns every mstats leaf into a PER-SHARD [S] vector — the
+    shard-skew signal the host fold wants — including overflow_rows,
+    which stays per-shard (pre-OR) by design.
     """
-    cand, over = jax.vmap(
+    cand, over, mstats = jax.vmap(
         lambda t: match_batch(
             t, tokens, lengths, sys_flags, K=K, max_probes=max_probes
         )
     )(trie)
-    return cand, jnp.any(over, axis=0)
+    return cand, jnp.any(over, axis=0), mstats
 
 
 @functools.partial(jax.jit, static_argnames=("M", "n_shards"))

@@ -24,6 +24,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE_KEYS_SINCE = 5          # probe_ok / probe_log landed in r05
 PLATFORM_KEY_SINCE = 6        # kernel_platform retention (this issue)
 TENM_KEYS_SINCE = 6           # the standing 10M capture + sharded arm
+KERNEL_TELEMETRY_KEYS_SINCE = 7   # ISSUE 19: stage percentiles + the
+#                                   counters-overhead interleaved pair
 
 TENM_KEYS = (
     "tenm_platform",
@@ -37,6 +39,18 @@ SHARDED_ARM_KEYS = (
     "tenm_sharded_mesh",
     "tenm_sharded_topics_per_sec",
     "tenm_sharded_sync_p99_ms",
+)
+KERNEL_TELEMETRY_KEYS = (
+    "kernel_submit_p50_us",
+    "kernel_submit_p99_us",
+    "kernel_step_p50_us",
+    "kernel_step_p99_us",
+    "kernel_decode_p50_us",
+    "kernel_decode_p99_us",
+    "kernel_counters_on_topics_per_sec",
+    "kernel_counters_off_topics_per_sec",
+    "kernel_counters_overhead_frac",
+    "kernel_counters_within_2pct_budget",
 )
 
 
@@ -85,4 +99,8 @@ def test_bench_artifact_schema(rnd, path):
 
     if rnd >= TENM_KEYS_SINCE:
         for key in TENM_KEYS + SHARDED_ARM_KEYS:
+            assert key in parsed, f"r{rnd:02d}: missing {key}"
+
+    if rnd >= KERNEL_TELEMETRY_KEYS_SINCE:
+        for key in KERNEL_TELEMETRY_KEYS:
             assert key in parsed, f"r{rnd:02d}: missing {key}"

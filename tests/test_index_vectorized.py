@@ -49,7 +49,7 @@ def match_all(idx, topics, K=64):
     dev = tm.device_trie(idx.arrays)
     tokens, lengths, sys_flags, too_long = idx.tokenize(topics)
     assert not too_long
-    cand, overflow = tm.match_batch(
+    cand, overflow, _ = tm.match_batch(
         dev, np.asarray(tokens), np.asarray(lengths),
         np.asarray(sys_flags), K=K)
     cand = np.asarray(cand)

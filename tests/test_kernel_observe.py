@@ -1,0 +1,303 @@
+"""Kernel-plane observability (ISSUE 19): in-kernel per-batch counters
+ride the existing collect device_get, the DeviceMetricsFold turns them
+plus the submit/step/decode wall timings into histograms, trie-health
+gauges, ledger reasons and stitched spans — the same surfaces every
+other plane exports through."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.core.message import Message
+from emqx_tpu.models.router_model import RouterModel
+from emqx_tpu.observe.device_metrics import (KERNEL_COUNTER_FIELDS,
+                                             DeviceMetricsFold)
+from emqx_tpu.observe.metrics import Metrics, DegradationLedger
+from emqx_tpu.observe.trace import SpanCollector
+from emqx_tpu.router.index import ShardedTrieIndex, TrieIndex
+
+# a full exact/plus fan: the frontier doubles every level, so K=2
+# overflows on the 4-deep topic (differentially verified in
+# test_kernel_counters_lint)
+FAN_FILTERS = ["a/b/c/d", "a/b/c/+", "a/b/+/d", "a/b/+/+",
+               "a/+/c/d", "a/+/c/+", "a/+/+/d", "a/+/+/+"]
+
+
+def _fold(model, **kw):
+    metrics = Metrics()
+    ledger = DegradationLedger(metrics)
+    fold = DeviceMetricsFold(metrics, ledger=ledger,
+                             spans=SpanCollector(), model=model,
+                             node="n1", **kw)
+    model.telemetry = fold
+    return metrics, ledger, fold
+
+
+def _drive(model, topics):
+    return model.publish_batch_collect(model.publish_batch_submit(topics))
+
+
+# -- fold math ---------------------------------------------------------------
+
+
+def test_fold_counters_and_stage_hists_flat():
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    for f in ("a/+/c", "a/b/#", "d/e"):
+        model.subscribe(f, 1)
+    metrics, _ledger, fold = _fold(model)
+
+    _drive(model, ["a/b/c", "d/e", "x/y"])
+    assert fold.batches == 1
+    assert fold.last is not None and fold.last.n_shards == 1
+    last = fold.last.as_dict()
+    assert last["cand_pre"] == 3          # a/+/c + a/b/# + d/e
+    assert last["cand_post"] == 3
+    assert last["overflow_rows"] == 0 and last["trunc_rows"] == 0
+    assert last["frontier_peak"] >= 2 and last["probe_iters"] >= 1
+    # every stage histogram observed exactly one batch
+    for h in fold.stage_hists().values():
+        assert h.count == 1
+    _drive(model, ["a/b/c"])
+    assert fold.batches == 2
+    assert fold.totals["cand_pre"] == 3 + 2   # sums across batches
+
+    ks = fold.kernel_summary()
+    assert ks["batches"] == 2
+    assert set(ks["counters"]) == set(KERNEL_COUNTER_FIELDS)
+    assert ks["stages"]["step"]["count"] == 2
+
+
+def test_fold_truncation_counter():
+    # M=1 candidate cap: a topic matching 2 filters truncates its row
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64, M=1)
+    model.subscribe("t/+", 1)
+    model.subscribe("t/1", 2)
+    _metrics, _ledger, fold = _fold(model)
+    _drive(model, ["t/1"])
+    last = fold.last.as_dict()
+    assert last["trunc_rows"] >= 1
+    assert last["cand_post"] <= last["cand_pre"]
+
+
+def test_fold_gauges_flat_and_upload_slots():
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    for f in ("a/b", "c/+", "d/#"):
+        model.subscribe(f, 1)
+    metrics, _ledger, fold = _fold(model)
+    model.refresh()
+    g = fold.gauges()
+    assert g["filters_total"] == 3 and g["shards"] == 1
+    assert g["shard_skew"] == 1.0
+    assert 0 < g["node_occupancy"] < 1 and 0 < g["edge_load"] < 1
+    assert g["uploads"] >= 1
+    # the promoted fixed slots sync from the model's ad-hoc counters
+    assert metrics.val("kernel.uploads") == model.upload_count >= 1
+    # an incremental subscribe after the first upload scatter-patches:
+    # patch slot + unpadded byte gauge move
+    model.subscribe("new/filter/x", 3)
+    model.refresh()
+    g = fold.gauges()
+    assert g["upload_patches"] == model.patch_count >= 1
+    assert g["patch_upload_bytes"] > 0
+    assert metrics.val("kernel.upload_patches") >= 1
+
+
+def test_fold_gauges_sharded_skew():
+    idx = ShardedTrieIndex(4, max_levels=8)
+    model = RouterModel(idx, n_sub_slots=64)
+    # all filters hash wherever they hash; skew = max/mean over the
+    # per-shard live-filter counts, computed from the index itself
+    for i in range(16):
+        model.subscribe(f"s/{i}/x", 1)
+    _metrics, _ledger, fold = _fold(model)
+    g = fold.gauges()
+    assert g["shards"] == 4
+    assert isinstance(g["filters"], list) and len(g["filters"]) == 4
+    assert sum(g["filters"]) == g["filters_total"] == 16
+    counts = [c for c in g["filters"] if c > 0]
+    assert g["shard_skew"] == pytest.approx(max(g["filters"]) / 4.0)
+    assert isinstance(g["node_occupancy"], list)
+    # per-shard counters decode as [S, C]
+    _drive(model, ["s/3/x", "s/7/x"])
+    assert fold.last.n_shards == 4
+    assert int(fold.last.field("cand_pre").sum()) == 2
+
+
+# -- trace stitching ---------------------------------------------------------
+
+
+def test_span_stitching_submit_collect():
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    model.subscribe("a/b", 1)
+    _metrics, _ledger, fold = _fold(model)
+    _drive(model, ["a/b"])
+    assert fold.last_trace_id != 0
+    stages = fold.spans.stages(fold.last_trace_id)
+    assert stages == ["kernel_submit", "kernel_collect"]
+    spans = fold.spans.trace(fold.last_trace_id)
+    assert spans[0][0] <= spans[1][0]        # monotone timeline
+    # JSON shape matches the native server's spans_recent
+    rec = fold.spans_recent(4)
+    assert rec and rec[0]["trace_id"] == f"{fold.last_trace_id:016x}"
+    assert [s["stage"] for s in rec[0]["spans"]] == stages
+    assert rec[0]["spans"][0]["node"] == "n1"
+    # the sampled batch hung an exemplar on the step histogram
+    assert fold.stage_hists()["step"].exemplars
+
+
+def test_span_sampling_1_in_n():
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    model.subscribe("a/b", 1)
+    _metrics, _ledger, fold = _fold(model, sample_every=4)
+    for _ in range(8):
+        _drive(model, ["a/b"])
+    assert len(fold.spans) == 2              # batches 1 and 5
+
+
+# -- broker fallback seam ----------------------------------------------------
+
+
+def test_broker_kernel_overflow_ledger():
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64, K=2)
+    metrics = Metrics()
+    b = Broker(router_model=model, metrics=metrics)
+    b.ledger = DegradationLedger(metrics)
+    for i, f in enumerate(FAN_FILTERS):
+        b.subscribe(f"c{i}", f)
+    out = b.publish_batch([Message(topic="a/b/c/d"),
+                           Message(topic="a/b/c/x")])
+    # the K=2 frontier punts both rows to the host oracle — delivery
+    # still complete...
+    assert len(out[0]) == len(FAN_FILTERS)
+    # ...and the degradation is on the ledger with its row count
+    assert b.ledger.totals()["kernel_overflow"] == 2
+    ev = [e for e in b.ledger.recent(8)
+          if e["reason"] == "kernel_overflow"]
+    assert ev and ev[-1]["count"] == 2
+    assert metrics.val("messages.ledger.kernel_overflow") == 2
+    assert metrics.val("messages.ledger.kernel_hostmatch") == 0
+
+
+def test_broker_kernel_hostmatch_ledger(monkeypatch):
+    monkeypatch.setenv("EMQX_TPU_CPU_KERNEL", "host")
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    assert model._host_matcher is not None
+    metrics = Metrics()
+    b = Broker(router_model=model, metrics=metrics)
+    b.ledger = DegradationLedger(metrics)
+    b.subscribe("c1", "a/b")
+    out = b.publish_batch([Message(topic="a/b")])
+    assert "c1" in out[0]
+    assert metrics.val("messages.kernel.hostmatch") == 1
+    assert b.ledger.totals()["kernel_hostmatch"] == 1
+    assert metrics.val("messages.ledger.kernel_hostmatch") == 1
+    assert b.ledger.totals().get("kernel_overflow", 0) == 0
+
+
+# -- escape hatch ------------------------------------------------------------
+
+
+def test_kernel_telemetry_escape_hatch(monkeypatch):
+    monkeypatch.setenv("EMQX_TPU_KERNEL_TELEMETRY", "0")
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    assert model.kernel_telemetry is False
+    model.subscribe("a/b", 1)
+    _metrics, _ledger, fold = _fold(model)
+    _drive(model, ["a/b"])
+    # stage timings still fold (host-side clocks cost nothing); the
+    # device counters are compiled out
+    assert fold.batches == 1
+    assert fold.last is None
+    monkeypatch.setenv("EMQX_TPU_KERNEL_TELEMETRY", "1")
+    assert RouterModel(TrieIndex()).kernel_telemetry is True
+    # explicit ctor flag beats the env
+    monkeypatch.setenv("EMQX_TPU_KERNEL_TELEMETRY", "0")
+    assert RouterModel(TrieIndex(),
+                       kernel_telemetry=True).kernel_telemetry is True
+
+
+# -- app wiring: prometheus, $SYS, mgmt, server surface ----------------------
+
+
+def _app():
+    from emqx_tpu.app import BrokerApp
+
+    model = RouterModel(TrieIndex(max_levels=8), n_sub_slots=64)
+    return BrokerApp(router_model=model)
+
+
+def test_app_wires_fold_and_prometheus_gauges():
+    app = _app()
+    assert app.device_metrics is not None
+    assert app.broker.model.telemetry is app.device_metrics
+    # the kernel fold serves tracing spans until a native server boots
+    assert app.native_spans_fn == app.device_metrics.spans_recent
+    app.broker.subscribe("c1", "a/b")
+    app.broker.publish_batch([Message(topic="a/b")])
+    out = app.prometheus()
+    assert "emqx_kernel_filters_total" in out
+    assert "emqx_kernel_shard_skew" in out
+    assert "emqx_kernel_batches" in out
+    assert "emqx_latency_kernel_submit_seconds_count" in out
+    assert "emqx_latency_kernel_decode_seconds_count" in out
+    ks = app.kernel_summary()
+    assert ks["batches"] == 1 and "gauges" in ks
+
+
+def test_app_without_kernel_telemetry(monkeypatch):
+    monkeypatch.setenv("EMQX_TPU_KERNEL_TELEMETRY", "off")
+    app = _app()
+    assert app.device_metrics is None
+    assert app.kernel_summary() == {}
+    assert "emqx_kernel_batches" not in app.prometheus()
+
+
+def test_sys_kernel_heartbeat_renders_at_zero():
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    app = _app()
+    seen = {}
+    hb = SysHeartbeat("n1", lambda m: seen.__setitem__(
+        m.topic, m.payload), metrics=app.metrics,
+        kernel=app.device_metrics)
+    hb.publish_kernel()
+    for stage in ("submit", "step", "decode"):
+        assert seen[f"$SYS/brokers/n1/kernel/{stage}/p50"] == b"0.000"
+        assert seen[f"$SYS/brokers/n1/kernel/{stage}/p99"] == b"0.000"
+        assert seen[f"$SYS/brokers/n1/kernel/{stage}/count"] == b"0"
+    # and it rides the slow tick next to metrics/latency/ledger
+    hb.tick(now=1e12)
+    assert "$SYS/brokers/n1/kernel/step/p99" in seen
+
+
+def test_mgmt_kernel_stats_endpoint():
+    from emqx_tpu.mgmt.api import ApiError, ManagementApi
+
+    app = _app()
+    app.broker.subscribe("c1", "a/b")
+    app.broker.publish_batch([Message(topic="a/b")])
+    api = ManagementApi(app)
+    snap = api.h_kernel_stats({}, None)
+    assert snap["gauges"]["filters_total"] == 1
+    assert snap["summary"]["batches"] == 1
+    assert snap["last_per_shard"]["cand_pre"] == [1]
+    status, body = api.handle("GET", "/api/v5/kernel/stats", {}, None,
+                              authed=True)
+    assert status == 200 and body["summary"]["batches"] == 1
+
+    app.device_metrics = None
+    with pytest.raises(ApiError) as ei:
+        api.h_kernel_stats({}, None)
+    assert ei.value.status == 404
+
+
+def test_server_kernel_summary_surface():
+    from emqx_tpu.broker.server import BrokerServer
+
+    app = _app()
+    srv = BrokerServer(app=app, port=0)
+    app.broker.subscribe("c1", "a/b")
+    app.broker.publish_batch([Message(topic="a/b")])
+    ks = srv.kernel_summary()
+    assert ks["batches"] == 1
+    assert ks["stages"]["submit"]["count"] == 1

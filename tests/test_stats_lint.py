@@ -522,3 +522,75 @@ def test_faults_fixed_metric_slots_render_at_zero():
     out = prometheus.render(metrics=m)
     for s in native.FAULT_SITES:
         assert f"emqx_faults_{s}" in out, s
+
+
+# -- kernel plane (ISSUE 19) --------------------------------------------------
+
+
+def test_kernel_fixed_metric_slots_render_at_zero():
+    """The kernel plane's promoted slots (messages.kernel.hostmatch,
+    kernel.uploads, kernel.upload_patches) and the two appended ledger
+    reasons' slots are FIXED: they render (at zero) in prometheus and
+    ride the $SYS metrics heartbeat before the first device batch."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.observe.sys import SysHeartbeat
+
+    slots = ("messages.kernel.hostmatch", "kernel.uploads",
+             "kernel.upload_patches", "messages.ledger.kernel_overflow",
+             "messages.ledger.kernel_hostmatch")
+    m = Metrics()
+    for s in slots:
+        assert m.val(s) == 0, s
+    out = prometheus.render(metrics=m)
+    for s in slots:
+        assert "emqx_" + s.replace(".", "_") in out, s
+
+    seen = {}
+    hb = SysHeartbeat("n1", lambda msg: seen.__setitem__(
+        msg.topic, msg.payload), metrics=m)
+    hb.publish_metrics()
+    for s in slots:
+        assert seen[f"$SYS/brokers/n1/metrics/{s}"] == b"0", s
+
+
+def test_kernel_ledger_reasons_appended_not_inserted():
+    """kernel_overflow / kernel_hostmatch are Python-plane ledger
+    reasons: they live AFTER the C++ prefix in both canonical tuples
+    (native and observe agree by the existing parity lint; this pins
+    that nobody reorders them INTO the prefix, which would shift the
+    kind-13 wire encoding)."""
+    from emqx_tpu.observe import metrics as om
+
+    reasons = [_snake(s) for s in enumerators(_src(), "LedgerReason",
+                                              "kLr") if s != "Count"]
+    for r in ("kernel_overflow", "kernel_hostmatch"):
+        assert r in om.LEDGER_REASONS and r in native.LEDGER_REASONS
+        assert r not in reasons, f"{r} must not enter the C++ enum"
+        assert list(om.LEDGER_REASONS).index(r) >= len(reasons)
+
+
+def test_kernel_stage_hists_render_at_zero_and_shard_labelled():
+    """latency.kernel.<stage> histograms render their +Inf/_sum/_count
+    series at zero the moment a DeviceMetricsFold exists, and a
+    per-shard latency.kernel.shard<i>.<stage> name renders under the
+    aggregate metric name with a shard label (the native-plane
+    convention, generalized)."""
+    from emqx_tpu.observe import prometheus
+    from emqx_tpu.observe.device_metrics import (KERNEL_STAGES,
+                                                 DeviceMetricsFold)
+    from emqx_tpu.observe.metrics import Metrics
+
+    m = Metrics()
+    DeviceMetricsFold(m)
+    out = prometheus.render(metrics=m, node="n1")
+    for stage in KERNEL_STAGES:
+        base = f"emqx_latency_kernel_{stage}_seconds"
+        assert f'{base}_bucket{{node="n1",le="+Inf"}} 0' in out, stage
+        assert f'{base}_count{{node="n1"}} 0' in out, stage
+
+    m2 = Metrics()
+    m2.register_hist("latency.kernel.shard0.step").observe(1_000_000)
+    out2 = prometheus.render(metrics=m2, node="n1")
+    assert ('emqx_latency_kernel_step_seconds_count'
+            '{node="n1",shard="0"} 1') in out2

@@ -22,7 +22,7 @@ def build(filters, max_levels=10):
 def run_match(idx, trie_dev, topics, K=32):
     tokens, lengths, sys_flags, too_long = idx.tokenize(topics)
     assert not too_long
-    cand, overflow = tm.match_batch(
+    cand, overflow, _ = tm.match_batch(
         trie_dev, np.asarray(tokens), np.asarray(lengths), np.asarray(sys_flags), K=K
     )
     cand = np.asarray(cand)
